@@ -1,0 +1,24 @@
+"""gpustack_tpu — a TPU-native cluster manager and serving stack for AI models.
+
+A ground-up re-design of the capabilities of gpustack/gpustack (reference:
+/root/reference) for Cloud TPU:
+
+- ``models/``    functional JAX transformer families (Llama/Qwen/Mistral dense,
+                 Mixtral-class MoE) built for XLA: scan-over-layers, static
+                 shapes, bf16 MXU matmuls.
+- ``parallel/``  device-mesh construction and sharding policies (dp/sp/ep/tp
+                 axes over ICI/DCN) — the TPU replacement for the reference's
+                 NCCL rank-table plumbing (see reference
+                 gpustack/worker/backends/vllm.py:941-1025).
+- ``engine/``    the built-in TPU serving engine (slot-based KV cache,
+                 continuous batching, OpenAI HTTP front) — the data plane the
+                 reference delegates to vLLM/SGLang containers.
+- ``ops/``       Pallas TPU kernels for the hot paths.
+- ``schemas/``, ``orm/``, ``server/``, ``scheduler/``, ``policies/``,
+  ``routes/``, ``api/``, ``worker/``, ``detectors/``, ``client/`` — the
+  control plane (state machine, reconcilers, slice-aware scheduler, worker
+  agent, OpenAI gateway), mirroring the reference's layer map (SURVEY.md §1)
+  with a TPU-native device model.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Model families for the built-in TPU serving engine.
+
+The reference (gpustack/gpustack) ships no model code — it orchestrates
+vLLM/SGLang containers. Our data plane is in-repo and TPU-native, so the model
+zoo lives here: a single functional transformer core covering the Llama/Qwen/
+Mistral dense families and Mixtral-class MoE, parameterized by
+:class:`~gpustack_tpu.models.config.ModelConfig`.
+"""
+
+from gpustack_tpu.models.config import ModelConfig, PRESETS, config_from_hf
+from gpustack_tpu.models.transformer import (
+    KVCache,
+    forward,
+    init_params,
+)
+
+__all__ = [
+    "ModelConfig",
+    "PRESETS",
+    "config_from_hf",
+    "KVCache",
+    "forward",
+    "init_params",
+]

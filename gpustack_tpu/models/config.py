@@ -1,0 +1,258 @@
+"""Model hyperparameter config for the transformer core.
+
+Replaces the reference's scattered HF-config probing (reference
+gpustack/policies/candidate_selectors/base_candidate_selector.py:56-165 parses
+hidden_size / num_attention_heads / num_key_value_heads / moe experts for
+memory estimation) with one typed config that both the serving engine and the
+scheduler's HBM estimator consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture description for a Llama/Qwen/Mistral/Mixtral-class LM.
+
+    Attention type is derived, not stored: MHA when num_kv_heads ==
+    num_heads, GQA when 1 < num_kv_heads < num_heads, MQA when
+    num_kv_heads == 1 (mirrors the attention-type taxonomy the reference
+    scheduler uses for KV-cache sizing,
+    base_candidate_selector.py:148-165).
+    """
+
+    name: str = "custom"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    # HF-style rope_scaling dict: {"rope_type": "llama3"|"linear", "factor": ..}
+    rope_scaling: Optional[Dict[str, Any]] = None
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False          # Qwen2-style attention bias
+    max_position_embeddings: int = 8192
+    sliding_window: int = 0         # 0 = full attention
+    # MoE (Mixtral / Qwen-MoE class); num_experts == 0 means dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    dtype: str = "bfloat16"
+
+    # ---- derived ----
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def group_size(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def attention_type(self) -> str:
+        if self.num_kv_heads == 1:
+            return "MQA"
+        if self.num_kv_heads == self.num_heads:
+            return "MHA"
+        return "GQA"
+
+    def validate(self) -> "ModelConfig":
+        assert self.num_heads % self.num_kv_heads == 0, (
+            "num_heads must be divisible by num_kv_heads"
+        )
+        if self.is_moe:
+            assert self.num_experts_per_tok > 0
+            assert self.moe_intermediate_size > 0
+        return self
+
+    # ---- memory accounting (used by scheduler + engine sizing) ----
+    def param_count(self) -> int:
+        """Exact parameter count for this architecture."""
+        d, v = self.hidden_size, self.vocab_size
+        embed = v * d
+        lm_head = 0 if self.tie_word_embeddings else d * v
+        attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
+        if self.qkv_bias:
+            attn += self.q_dim + 2 * self.kv_dim
+        if self.is_moe:
+            mlp = d * self.num_experts + self.num_experts * (
+                3 * d * self.moe_intermediate_size
+            )
+        else:
+            mlp = 3 * d * self.intermediate_size
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        return embed + lm_head + self.num_layers * per_layer + d
+
+    def weight_bytes(self, bits: int = 16) -> int:
+        return self.param_count() * bits // 8
+
+    def kv_cache_bytes_per_token(self, bits: int = 16) -> int:
+        """Bytes of K+V cache per token position (all layers)."""
+        return 2 * self.num_layers * self.kv_dim * bits // 8
+
+
+def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
+    """Build a ModelConfig from an HF ``config.json`` dict.
+
+    Covers LlamaForCausalLM / Qwen2ForCausalLM / MistralForCausalLM /
+    MixtralForCausalLM / Qwen2MoeForCausalLM-style keys — the same families the
+    reference's selectors introspect (base_candidate_selector.py:56-165).
+    """
+    hidden = cfg["hidden_size"]
+    heads = cfg["num_attention_heads"]
+    head_dim = cfg.get("head_dim") or hidden // heads
+    archs = cfg.get("architectures") or [""]
+    arch = archs[0] if archs else ""
+    num_experts = (
+        cfg.get("num_local_experts")      # Mixtral
+        or cfg.get("num_experts")         # Qwen2-MoE
+        or 0
+    )
+    return ModelConfig(
+        name=name,
+        vocab_size=cfg["vocab_size"],
+        hidden_size=hidden,
+        intermediate_size=cfg.get("intermediate_size", 4 * hidden),
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=cfg.get("num_key_value_heads", heads),
+        head_dim=head_dim,
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        rope_scaling=cfg.get("rope_scaling"),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+        qkv_bias="Qwen2" in arch and not cfg.get("no_bias", False),
+        max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+        sliding_window=cfg.get("sliding_window") or 0,
+        num_experts=num_experts,
+        num_experts_per_tok=cfg.get("num_experts_per_tok", 0),
+        moe_intermediate_size=(
+            cfg.get("moe_intermediate_size")
+            or (cfg.get("intermediate_size", 0) if num_experts else 0)
+        ),
+        norm_topk_prob=cfg.get("norm_topk_prob", True),
+    ).validate()
+
+
+def load_hf_config(path: str, name: str = "") -> ModelConfig:
+    """Read ``config.json`` from a local HF model directory."""
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = json.load(f)
+    return config_from_hf(cfg, name=name or os.path.basename(path.rstrip("/")))
+
+
+# ---------------------------------------------------------------------------
+# Presets. Flagship = llama3-8b (BASELINE.json north-star model). Tiny configs
+# are for hermetic CPU tests (mirrors the reference's fixture doctrine,
+# SURVEY.md §4).
+# ---------------------------------------------------------------------------
+PRESETS: Dict[str, ModelConfig] = {
+    "llama3-8b": ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=8192,
+    ),
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+        tie_word_embeddings=False,
+        max_position_embeddings=32768,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=14336,
+        max_position_embeddings=32768,
+    ),
+    # Hermetic-test configs (run everywhere, compile in seconds).
+    "tiny": ModelConfig(
+        name="tiny",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+    ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_intermediate_size=96,
+        max_position_embeddings=256,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name in PRESETS:
+        return PRESETS[name]
+    raise KeyError(
+        f"unknown model preset {name!r}; known: {sorted(PRESETS)}"
+    )

@@ -1,0 +1,336 @@
+"""Functional transformer core (Llama/Qwen/Mistral dense + Mixtral-class MoE).
+
+TPU-first design notes:
+
+- **scan over stacked layers**: per-layer weights are stacked on a leading
+  ``[L, ...]`` axis and the block loop is a ``lax.scan`` — compile time stays
+  O(1) in depth (an 80-layer Llama-70B traces one block, not eighty).
+- **static shapes everywhere**: prefill and decode are separate jit
+  specializations over fixed ``[B, T]``; the KV cache is a preallocated
+  ``[L, B, S_max, H_kv, hd]`` buffer written in place (slot model, JetStream
+  style) — no dynamic shapes, so XLA tiles every matmul onto the MXU.
+- **GQA without materializing repeated KV**: queries are reshaped to
+  ``[B, T, H_kv, G, hd]`` and contracted against the *unexpanded* KV — saves
+  HBM bandwidth, which is the decode bottleneck.
+- **bf16 matmuls, fp32 softmax/norm accumulations**.
+
+The reference (gpustack/gpustack) has no model code — its data plane is
+vLLM/SGLang in containers; this module is the heart of our in-repo TPU
+engine that replaces them (reference worker/backends/vllm.py role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gpustack_tpu.models.config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Slot-based KV cache: ``k, v`` are ``[L, B, S_max, H_kv, head_dim]``.
+
+    Rows (batch slots) are owned by the engine's slot allocator; positions are
+    absolute token indices, so writing at ``positions`` and masking with
+    ``cache_index <= query_position`` is all the bookkeeping attention needs.
+
+    Bounds contract: writes use ``dynamic_update_slice``, which CLAMPS
+    out-of-range starts instead of failing (static-shape jit semantics) —
+    writing at ``position >= max_len`` silently corrupts the tail of the
+    cache. Callers (the engine slot allocator) must enforce
+    ``position + T <= max_len`` before dispatching a step.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @staticmethod
+    def create(
+        cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+    ) -> "KVCache":
+        shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    """Random init with layer weights stacked on a leading [L] axis."""
+    d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    keys = iter(jax.random.split(key, 32))
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers: Dict[str, jax.Array] = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": w(next(keys), L, d, cfg.q_dim),
+        "wk": w(next(keys), L, d, cfg.kv_dim),
+        "wv": w(next(keys), L, d, cfg.kv_dim),
+        "wo": w(next(keys), L, cfg.q_dim, d),
+        "mlp_norm": jnp.ones((L, d), dtype),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.is_moe:
+        fm, E = cfg.moe_intermediate_size, cfg.num_experts
+        layers["router"] = w(next(keys), L, d, E)
+        layers["we_gate"] = w(next(keys), L, E, d, fm)
+        layers["we_up"] = w(next(keys), L, E, d, fm)
+        layers["we_down"] = w(next(keys), L, E, fm, d, scale=1.0 / math.sqrt(fm))
+    else:
+        layers["w_gate"] = w(next(keys), L, d, f)
+        layers["w_up"] = w(next(keys), L, d, f)
+        layers["w_down"] = w(next(keys), L, f, d)
+
+    params: Params = {
+        "embed": w(next(keys), cfg.vocab_size, d, scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), d, cfg.vocab_size)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
+    """Inverse RoPE frequencies with HF-compatible llama3/linear scaling."""
+    half = cfg.head_dim // 2
+    inv = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    rs = cfg.rope_scaling or {}
+    rope_type = rs.get("rope_type") or rs.get("type")
+    if rope_type == "linear":
+        inv = inv / rs["factor"]
+    elif rope_type == "llama3":
+        # HF reference semantics: high-freq band (short wavelength) keeps
+        # raw frequencies, low-freq band divides by `factor`, and the
+        # medium band interpolates between the two.
+        factor = rs["factor"]
+        low = rs.get("low_freq_factor", 1.0)
+        high = rs.get("high_freq_factor", 4.0)
+        orig = rs.get("original_max_position_embeddings", 8192)
+        wavelen = 2 * math.pi / inv
+        smooth = (orig / wavelen - low) / (high - low)
+        interpolated = (1 - smooth) * inv / factor + smooth * inv
+        inv = jnp.where(
+            wavelen > orig / low,
+            inv / factor,
+            jnp.where(wavelen < orig / high, inv, interpolated),
+        )
+    return inv
+
+
+def rope_sin_cos(
+    positions: jax.Array, inv_freq: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """positions [B, T] -> (sin, cos) each [B, T, head_dim/2], fp32."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """HF 'rotate_half' convention. x: [B, T, H, hd], sin/cos: [B, T, hd/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin = sin[:, :, None, :].astype(x.dtype)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _attend(
+    q: jax.Array,      # [B, T, Hkv, G, hd]
+    k: jax.Array,      # [B, S, Hkv, hd]
+    v: jax.Array,      # [B, S, Hkv, hd]
+    mask: jax.Array,   # [B, T, S] bool (True = attend)
+    scale: float,
+) -> jax.Array:
+    """Grouped-query attention; fp32 softmax; returns [B, T, Hkv*G*hd]."""
+    scores = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", weights, v)
+    b, t = out.shape[0], out.shape[1]
+    return out.reshape(b, t, -1)
+
+
+def _moe_mlp(
+    x: jax.Array,           # [B, T, D]
+    router_w: jax.Array,    # [D, E]
+    we_gate: jax.Array,     # [E, D, Fm]
+    we_up: jax.Array,       # [E, D, Fm]
+    we_down: jax.Array,     # [E, Fm, D]
+    cfg: ModelConfig,
+) -> jax.Array:
+    """Mixtral-style top-k MoE, dense-dispatch formulation.
+
+    Every expert runs over every token and the top-k router weights (zeroed
+    elsewhere) combine the results. This is collective-free under an ``ep``
+    mesh axis sharding the E dimension (each device computes its local experts
+    for all tokens; the final contraction is a psum XLA inserts), trading
+    FLOPs for zero token-shuffling — the right first tradeoff on TPU where
+    MXU FLOPs are cheap and all-to-all is not. A capacity-based dispatch
+    kernel is the planned perf upgrade for large-E models.
+    """
+    gates = jax.nn.softmax(
+        jnp.einsum("btd,de->bte", x, router_w).astype(jnp.float32), axis=-1
+    )
+    top_w, top_idx = lax.top_k(gates, cfg.num_experts_per_tok)
+    if cfg.norm_topk_prob:
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    # Scatter top-k weights back to a dense [B, T, E] combine tensor.
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.float32)
+        * top_w[..., None],
+        axis=-2,
+    ).astype(x.dtype)
+    g = jnp.einsum("btd,edf->btef", x, we_gate)
+    u = jnp.einsum("btd,edf->btef", x, we_up)
+    h = jax.nn.silu(g) * u
+    y = jnp.einsum("btef,efd->bted", h, we_down)
+    return jnp.einsum("bted,bte->btd", y, combine)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,                # [B, T] int32
+    positions: jax.Array,             # [B, T] int32 absolute positions
+    cache: Optional[KVCache] = None,
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Run the model.
+
+    Without ``cache``: plain causal forward (training / scoring path).
+    With ``cache``: writes K/V at ``positions`` into the cache and attends
+    over the whole cache with an absolute-position causal mask. ``T > 1`` is
+    a prefill step, ``T == 1`` a decode step — same code path, different jit
+    specialization.
+
+    Returns ``(logits [B, T, vocab] fp32, updated cache or None)``.
+    """
+    B, T = tokens.shape
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    x = jnp.take(params["embed"], tokens, axis=0).astype(dtype)
+    sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    if cache is None:
+        # mask[b, t, s] — query t attends key s (both in-window positions)
+        mask = positions[:, :, None] >= positions[:, None, :]
+        if cfg.sliding_window:
+            mask &= (
+                positions[:, :, None] - positions[:, None, :]
+            ) < cfg.sliding_window
+        key_sin, key_cos = sin, cos
+    else:
+        S = cache.max_len
+        cache_pos = jnp.arange(S, dtype=jnp.int32)
+        mask = cache_pos[None, None, :] <= positions[:, :, None]
+        if cfg.sliding_window:
+            mask &= (
+                positions[:, :, None] - cache_pos[None, None, :]
+            ) < cfg.sliding_window
+
+    def block(x_in: jax.Array, scanned):
+        lp, k_cache_l, v_cache_l = scanned
+        h = rms_norm(x_in, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("btd,dq->btq", h, lp["wq"])
+        k = jnp.einsum("btd,dk->btk", h, lp["wk"])
+        v = jnp.einsum("btd,dk->btk", h, lp["wv"])
+        if cfg.qkv_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim)
+        k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(
+            q.reshape(B, T, cfg.num_heads, cfg.head_dim), sin, cos
+        ).reshape(B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim)
+        k = apply_rope(k, sin, cos)
+
+        if cache is None:
+            attn = _attend(q, k, v, mask, scale)
+            new_k, new_v = k_cache_l, v_cache_l
+        else:
+            # Write this step's K/V into the cache at each row's start
+            # position (positions are contiguous per row).
+            def write(buf, val, start):
+                return lax.dynamic_update_slice(buf, val, (start, 0, 0))
+
+            new_k = jax.vmap(write)(k_cache_l, k, positions[:, 0])
+            new_v = jax.vmap(write)(v_cache_l, v, positions[:, 0])
+            attn = _attend(q, new_k, new_v, mask, scale)
+
+        x_mid = x_in + jnp.einsum("btq,qd->btd", attn, lp["wo"])
+
+        h2 = rms_norm(x_mid, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            mlp = _moe_mlp(
+                h2, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"],
+                cfg,
+            )
+        else:
+            g = jnp.einsum("btd,df->btf", h2, lp["w_gate"])
+            u = jnp.einsum("btd,df->btf", h2, lp["w_up"])
+            mlp = jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["w_down"])
+        return x_mid + mlp, (new_k, new_v)
+
+    if cache is None:
+        L = cfg.num_layers
+        dummy = jnp.zeros((L, B, 0, cfg.num_kv_heads, cfg.head_dim), dtype)
+        x, _ = lax.scan(block, x, (params["layers"], dummy, dummy))
+        new_cache = None
+    else:
+        x, (k_new, v_new) = lax.scan(
+            block, x, (params["layers"], cache.k, cache.v)
+        )
+        new_cache = KVCache(k=k_new, v=v_new)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = (
+        params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    )
+    logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+    return logits, new_cache

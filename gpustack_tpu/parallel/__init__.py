@@ -1,0 +1,42 @@
+"""Device-mesh and sharding policies (the TPU parallelism layer).
+
+Replaces the reference's parallelism-argument plumbing (world size =
+tp*pp*pcp*dp parsed from engine flags, reference
+gpustack/policies/candidate_selectors/vllm_resource_fit_selector.py:109-164;
+NCCL rank tables / Ray bootstrap, reference worker/backends/vllm.py:941-1025)
+with first-class JAX mesh axes over ICI/DCN.
+"""
+
+from gpustack_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_SP,
+    AXIS_TP,
+    MESH_AXES,
+    MeshPlan,
+    make_mesh,
+    plan_mesh,
+)
+from gpustack_tpu.parallel.sharding import (
+    activation_pspec,
+    cache_pspec,
+    logical_pspecs,
+    param_pspecs,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_SP",
+    "AXIS_EP",
+    "AXIS_TP",
+    "MESH_AXES",
+    "MeshPlan",
+    "make_mesh",
+    "plan_mesh",
+    "param_pspecs",
+    "activation_pspec",
+    "cache_pspec",
+    "logical_pspecs",
+    "shard_params",
+]

@@ -1,0 +1,135 @@
+"""Mesh construction and auto-parallelism planning.
+
+Canonical mesh axes (outer → inner; inner axes ride ICI, the outermost rides
+DCN on multi-slice deployments):
+
+- ``dp``: data/replica parallelism — independent request batches. Doubles as
+  the FSDP weight-sharding axis in the training path.
+- ``sp``: sequence/context parallelism — long-context prefill shards the
+  sequence dimension here (ring attention / XLA all-gather attention).
+- ``ep``: expert parallelism — MoE expert dimension.
+- ``tp``: tensor parallelism — attention heads and FFN width.
+
+This replaces the reference's flag-based world-size model
+(tp×pp×pcp×dp parsed from vLLM args, reference
+vllm_resource_fit_selector.py:109-164): on TPU a parallelism plan is a mesh
+shape, and XLA inserts the collectives.
+
+Pipeline parallelism is intentionally absent from the serving mesh: on TPU
+slices, TP over ICI dominates PP for inference (no microbatch bubbles, no
+per-stage KV replication); DCN-scale pipelining belongs to multi-slice
+training, not this engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A concrete parallelism plan: axis sizes for one model replica.
+
+    ``chips`` (the product) is the schedulable unit the scheduler places onto
+    a TPU slice — the analogue of the reference's computed world size.
+    """
+
+    dp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def chips(self) -> int:
+        return self.dp * self.sp * self.ep * self.tp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            AXIS_DP: self.dp,
+            AXIS_SP: self.sp,
+            AXIS_EP: self.ep,
+            AXIS_TP: self.tp,
+        }
+
+    def __str__(self) -> str:
+        return f"dp{self.dp}xsp{self.sp}xep{self.ep}xtp{self.tp}"
+
+    @staticmethod
+    def parse(s: str) -> "MeshPlan":
+        """Parse 'dp2xsp1xep1xtp4' (any subset/order of axes)."""
+        sizes = {"dp": 1, "sp": 1, "ep": 1, "tp": 1}
+        for part in s.lower().split("x"):
+            for ax in sizes:
+                if part.startswith(ax):
+                    sizes[ax] = int(part[len(ax):])
+                    break
+            else:
+                raise ValueError(f"bad mesh plan component {part!r} in {s!r}")
+        return MeshPlan(**sizes)
+
+
+def make_mesh(
+    plan: MeshPlan, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a named Mesh from a plan over the given (or all) devices.
+
+    Axis order is (dp, sp, ep, tp) outer→inner so that ``tp`` — the most
+    communication-heavy axis — maps to the innermost, highest-bandwidth ICI
+    neighbors in the default device order.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != plan.chips:
+        raise ValueError(
+            f"plan {plan} needs {plan.chips} devices, got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(plan.dp, plan.sp, plan.ep, plan.tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def _largest_pow2_divisor(n: int, cap: int) -> int:
+    best = 1
+    d = 1
+    while d <= cap and n % d == 0:
+        best = d
+        d *= 2
+    return best
+
+
+def plan_mesh(
+    n_devices: int,
+    num_kv_heads: int,
+    num_experts: int = 0,
+    long_context: bool = False,
+) -> MeshPlan:
+    """Auto-parallelism: pick a mesh shape for ``n_devices`` chips.
+
+    Heuristic (serving-oriented):
+    - TP first, up to the KV-head count (beyond that TP replicates KV heads
+      and wastes HBM — mirrors the reference's head-divisibility checks,
+      base_candidate_selector.py:229-234).
+    - MoE models spend remaining factor on EP up to the expert count.
+    - ``long_context`` spends remaining factor on SP (context parallelism);
+      otherwise on DP (replica throughput).
+    """
+    if n_devices <= 0 or n_devices & (n_devices - 1):
+        raise ValueError(f"device count must be a power of two, got {n_devices}")
+    tp = _largest_pow2_divisor(num_kv_heads, n_devices)
+    rest = n_devices // tp
+    ep = 1
+    if num_experts:
+        ep = _largest_pow2_divisor(num_experts, rest)
+        rest //= ep
+    if long_context:
+        return MeshPlan(dp=1, sp=rest, ep=ep, tp=tp)
+    return MeshPlan(dp=rest, sp=1, ep=ep, tp=tp)

@@ -1,0 +1,29 @@
+"""Global test config: hermetic 8-device CPU mesh (no TPU required).
+
+Mirrors the reference's doctrine that all tests run without real
+accelerators (reference tests use fake worker fixtures, no GPU —
+SURVEY.md §4): we force the JAX CPU backend with 8 virtual devices so every
+mesh/sharding path (tp/dp/sp/ep, multi-host placement logic) is exercised on
+any machine.
+
+Note: a TPU-tunnel sitecustomize may have force-selected a TPU platform at
+interpreter startup via ``jax.config.update("jax_platforms", ...)`` — env
+vars alone don't win against that, so we override through jax.config here,
+before any backend initializes.
+"""
+
+import os
+import sys
+
+# XLA reads this at backend init; conftest runs before any test imports jax.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,211 @@
+"""Transformer core correctness: shapes, causality, cache parity, MoE."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpustack_tpu.models import (
+    KVCache,
+    ModelConfig,
+    PRESETS,
+    forward,
+    init_params,
+)
+from gpustack_tpu.models.config import config_from_hf, get_config
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _tokens(cfg, b, t, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (b, t), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+
+def test_forward_shapes(tiny):
+    cfg, params = tiny
+    toks = _tokens(cfg, 2, 8)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+    logits, cache = forward(params, cfg, toks, pos)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+
+
+def test_causality(tiny):
+    cfg, params = tiny
+    toks = _tokens(cfg, 1, 8)
+    pos = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits1, _ = forward(params, cfg, toks, pos)
+    toks2 = toks.at[0, 5].set((toks[0, 5] + 1) % cfg.vocab_size)
+    logits2, _ = forward(params, cfg, toks2, pos)
+    # Positions before the edit are unaffected; position 5+ change.
+    np.testing.assert_allclose(logits1[0, :5], logits2[0, :5], atol=1e-5)
+    assert not np.allclose(logits1[0, 5], logits2[0, 5])
+
+
+@pytest.mark.parametrize("preset", ["tiny", "tiny-moe"])
+def test_prefill_decode_matches_full_forward(preset):
+    """The load-bearing engine invariant: prefill + N decode steps produce
+    the same logits as one full causal forward."""
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.key(0))
+    B, T_pre, T_total, S = 2, 5, 9, 16
+    toks = _tokens(cfg, B, T_total)
+    pos_full = jnp.broadcast_to(jnp.arange(T_total, dtype=jnp.int32), (B, T_total))
+    full_logits, _ = forward(params, cfg, toks, pos_full)
+
+    cache = KVCache.create(cfg, B, S)
+    pre_logits, cache = forward(
+        params, cfg, toks[:, :T_pre], pos_full[:, :T_pre], cache
+    )
+    np.testing.assert_allclose(
+        full_logits[:, :T_pre], pre_logits, rtol=5e-2, atol=5e-2
+    )
+    for t in range(T_pre, T_total):
+        step_logits, cache = forward(
+            params, cfg, toks[:, t : t + 1], pos_full[:, t : t + 1], cache
+        )
+        np.testing.assert_allclose(
+            full_logits[:, t], step_logits[:, 0], rtol=5e-2, atol=5e-2
+        )
+
+
+def test_qkv_bias_and_sliding_window_run():
+    cfg = dataclasses.replace(
+        get_config("tiny"), qkv_bias=True, sliding_window=4
+    )
+    params = init_params(cfg, jax.random.key(0))
+    toks = _tokens(cfg, 1, 8)
+    pos = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _ = forward(params, cfg, toks, pos)
+    assert jnp.isfinite(logits).all()
+
+
+def test_sliding_window_limits_attention():
+    cfg = dataclasses.replace(get_config("tiny"), sliding_window=3)
+    params = init_params(cfg, jax.random.key(0))
+    toks = _tokens(cfg, 1, 10)
+    pos = jnp.arange(10, dtype=jnp.int32)[None, :]
+    logits1, _ = forward(params, cfg, toks, pos)
+    # Tokens outside every remaining window can change freely.
+    toks2 = toks.at[0, 0].set((toks[0, 0] + 1) % cfg.vocab_size)
+    logits2, _ = forward(params, cfg, toks2, pos)
+    np.testing.assert_allclose(logits1[0, -1], logits2[0, -1], atol=1e-5)
+
+
+def test_llama3_rope_inv_freq_matches_hf_formula():
+    """Numeric check of the llama3 band-wise frequency scaling."""
+    from gpustack_tpu.models.transformer import rope_inv_freq
+
+    cfg = dataclasses.replace(
+        PRESETS["llama3-8b"],
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        },
+    )
+    inv = np.asarray(rope_inv_freq(cfg))
+    half = cfg.head_dim // 2
+    base = 1.0 / (
+        cfg.rope_theta ** (np.arange(0, half, dtype=np.float64) / half)
+    )
+    ref = np.empty_like(base)
+    for i, f in enumerate(base):
+        wavelen = 2 * np.pi / f
+        if wavelen < 8192 / 4.0:          # high-freq band: unscaled
+            ref[i] = f
+        elif wavelen > 8192 / 1.0:        # low-freq band: /factor
+            ref[i] = f / 8.0
+        else:                              # medium band: interpolate
+            smooth = (8192 / wavelen - 1.0) / (4.0 - 1.0)
+            ref[i] = (1 - smooth) * f / 8.0 + smooth * f
+    np.testing.assert_allclose(inv, ref, rtol=1e-6)
+
+
+def test_llama3_rope_scaling_runs():
+    cfg = dataclasses.replace(
+        get_config("tiny"),
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+    )
+    params = init_params(cfg, jax.random.key(0))
+    toks = _tokens(cfg, 1, 8)
+    pos = jnp.arange(8, dtype=jnp.int32)[None, :]
+    logits, _ = forward(params, cfg, toks, pos)
+    assert jnp.isfinite(logits).all()
+
+
+def test_moe_matches_per_token_loop():
+    """Dense-dispatch MoE == explicit per-token top-k loop."""
+    from gpustack_tpu.models.transformer import _moe_mlp
+
+    cfg = get_config("tiny-moe")
+    key = jax.random.key(3)
+    ks = jax.random.split(key, 5)
+    d, fm, E = cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts
+    x = jax.random.normal(ks[0], (1, 6, d), jnp.float32)
+    router = jax.random.normal(ks[1], (d, E), jnp.float32) * 0.1
+    wg = jax.random.normal(ks[2], (E, d, fm), jnp.float32) * 0.1
+    wu = jax.random.normal(ks[3], (E, d, fm), jnp.float32) * 0.1
+    wd = jax.random.normal(ks[4], (E, fm, d), jnp.float32) * 0.1
+
+    out = _moe_mlp(x, router, wg, wu, wd, cfg)
+
+    ref = np.zeros_like(np.asarray(x))
+    gates = jax.nn.softmax(x @ router, axis=-1)
+    for b in range(x.shape[0]):
+        for t in range(x.shape[1]):
+            g = np.asarray(gates[b, t])
+            topk = np.argsort(-g)[: cfg.num_experts_per_tok]
+            w = g[topk] / g[topk].sum()
+            for wi, e in zip(w, topk):
+                h = np.asarray(x[b, t]) @ np.asarray(wg[e])
+                u = np.asarray(x[b, t]) @ np.asarray(wu[e])
+                act = np.asarray(jax.nn.silu(h)) * u
+                ref[b, t] += wi * (act @ np.asarray(wd[e]))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_param_count_matches_init():
+    for preset in ["tiny", "tiny-moe"]:
+        cfg = get_config(preset)
+        params = init_params(cfg, jax.random.key(0))
+        n = sum(x.size for x in jax.tree.leaves(params))
+        assert n == cfg.param_count(), preset
+
+
+def test_config_from_hf_llama():
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "hidden_size": 4096,
+        "intermediate_size": 14336,
+        "num_hidden_layers": 32,
+        "num_attention_heads": 32,
+        "num_key_value_heads": 8,
+        "vocab_size": 128256,
+        "rope_theta": 500000.0,
+        "rms_norm_eps": 1e-5,
+        "max_position_embeddings": 8192,
+    }
+    cfg = config_from_hf(hf, "llama")
+    assert cfg.head_dim == 128 and cfg.attention_type == "GQA"
+    assert cfg.param_count() == PRESETS["llama3-8b"].param_count()
+    # ~8.03B params for Llama-3-8B
+    assert 7.9e9 < cfg.param_count() < 8.1e9

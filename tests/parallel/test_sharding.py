@@ -1,0 +1,116 @@
+"""Mesh planning + sharded-forward equivalence on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from gpustack_tpu.models import KVCache, forward, init_params
+from gpustack_tpu.models.config import get_config
+from gpustack_tpu.parallel import (
+    MeshPlan,
+    activation_pspec,
+    cache_pspec,
+    make_mesh,
+    param_pspecs,
+    plan_mesh,
+    shard_params,
+)
+
+
+def test_plan_mesh_heuristics():
+    assert plan_mesh(8, num_kv_heads=8) == MeshPlan(dp=1, tp=8)
+    assert plan_mesh(8, num_kv_heads=4) == MeshPlan(dp=2, tp=4)
+    assert plan_mesh(8, num_kv_heads=4, long_context=True) == MeshPlan(sp=2, tp=4)
+    assert plan_mesh(8, num_kv_heads=2, num_experts=4) == MeshPlan(
+        dp=1, ep=4, tp=2
+    )
+    assert plan_mesh(1, num_kv_heads=8) == MeshPlan()
+    with pytest.raises(ValueError):
+        plan_mesh(6, num_kv_heads=8)
+    with pytest.raises(ValueError):
+        plan_mesh(0, num_kv_heads=8)
+
+
+def test_mesh_plan_parse_roundtrip():
+    plan = MeshPlan(dp=2, sp=1, ep=1, tp=4)
+    assert MeshPlan.parse(str(plan)) == plan
+    assert MeshPlan.parse("tp4xdp2") == MeshPlan(dp=2, tp=4)
+
+
+@pytest.mark.parametrize("preset,plan", [
+    ("tiny", MeshPlan(dp=2, tp=2, sp=2)),
+    ("tiny", MeshPlan(dp=1, tp=2, sp=1, ep=4)),
+    ("tiny-moe", MeshPlan(dp=2, ep=2, tp=2)),
+])
+def test_sharded_forward_matches_single_device(preset, plan):
+    cfg = get_config(preset)
+    params = init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 8), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (4, 8))
+    ref_logits, _ = forward(params, cfg, toks, pos)
+
+    mesh = make_mesh(plan)
+    sharded = shard_params(params, mesh)
+    tok_sharding = NamedSharding(mesh, activation_pspec())
+    toks_s = jax.device_put(toks, tok_sharding)
+    pos_s = jax.device_put(pos, tok_sharding)
+
+    fwd = jax.jit(lambda p, t, q: forward(p, cfg, t, q)[0])
+    out = fwd(sharded, toks_s, pos_s)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_logits), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_sharded_decode_with_cache():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    plan = MeshPlan(dp=2, tp=2, sp=1, ep=2)
+    mesh = make_mesh(plan)
+    sharded = shard_params(params, mesh)
+    B, S = 4, 16
+    cache = KVCache.create(cfg, B, S)
+    cache_sharding = NamedSharding(mesh, cache_pspec())
+    cache = jax.tree.map(lambda x: jax.device_put(x, cache_sharding), cache)
+
+    toks = jax.random.randint(
+        jax.random.key(1), (B, 4), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (B, 4))
+
+    prefill = jax.jit(lambda p, t, q, c: forward(p, cfg, t, q, c))
+    logits, cache = prefill(sharded, toks, pos, cache)
+    assert logits.shape == (B, 4, cfg.vocab_size)
+
+    step_tok = jnp.full((B, 1), 7, jnp.int32)
+    step_pos = jnp.full((B, 1), 4, jnp.int32)
+    logits2, cache = prefill(sharded, step_tok, step_pos, cache)
+    assert logits2.shape == (B, 1, cfg.vocab_size)
+    assert jnp.isfinite(logits2).all()
+
+
+def test_param_pspecs_cover_tree():
+    for preset in ["tiny", "tiny-moe"]:
+        cfg = get_config(preset)
+        params = init_params(cfg, jax.random.key(0))
+        specs = param_pspecs(params, train=True)
+        # Structure must match exactly (tree_map would raise otherwise).
+        jax.tree.map(
+            lambda x, s: None, params, specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+
+def test_train_pspecs_shard_big_weights():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    specs = param_pspecs(params, train=True)
+    assert specs["layers"]["wq"] == P(None, "dp", "tp")
+    assert specs["embed"] == P("tp", "dp")
+    inf = param_pspecs(params, train=False)
+    assert inf["layers"]["wq"] == P(None, None, "tp")
