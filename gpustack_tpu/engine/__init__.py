@@ -1,0 +1,14 @@
+"""The built-in TPU serving engine (data plane).
+
+The reference delegates its data plane to vLLM/SGLang/MindIE containers
+(reference gpustack/worker/backends/); on TPU we ship the engine in-repo:
+
+- ``quant``      int8 weight-only quantization (HBM-bandwidth-bound decode
+                 reads int8, computes bf16 on the MXU).
+- ``sampling``   vectorized temperature/top-k/top-p samplers.
+- ``runner``     jitted prefill/decode with a slot-based decode state.
+- ``engine``     continuous-batching orchestrator (request queue, slot
+                 allocator, streaming).
+- ``tokenizer``  HF tokenizer wrapper + hermetic byte-level fallback.
+- ``api_server`` OpenAI-compatible HTTP front (aiohttp).
+"""

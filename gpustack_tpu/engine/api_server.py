@@ -1,0 +1,328 @@
+"""OpenAI-compatible HTTP front for the engine (aiohttp).
+
+Endpoint parity with the engine-level API surface the reference proxies to
+(reference gpustack/routes/openai.py registers chat/completions/embeddings
+prefixes; the engine containers serve them): ``/v1/completions``,
+``/v1/chat/completions`` (+SSE streaming), ``/v1/models``, ``/healthz``,
+``/metrics``.
+
+Runs as a standalone process per model instance — the unit the worker's
+serve manager launches and health-probes (reference
+worker/serve_manager.py:1291-1412 spawns engine processes the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import queue
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+
+logger = logging.getLogger(__name__)
+
+
+def _usage(req: GenRequest) -> Dict[str, int]:
+    return {
+        "prompt_tokens": len(req.prompt_ids),
+        "completion_tokens": len(req.output_ids),
+        "total_tokens": len(req.prompt_ids) + len(req.output_ids),
+    }
+
+
+class OpenAIServer:
+    """aiohttp application serving one LLMEngine."""
+
+    def __init__(self, engine: LLMEngine, model_name: Optional[str] = None):
+        self.engine = engine
+        self.model_name = model_name or engine.cfg.name
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/healthz", self.healthz),
+                web.get("/v1/models", self.models),
+                web.post("/v1/completions", self.completions),
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.get("/metrics", self.metrics),
+            ]
+        )
+        self._started = time.time()
+
+    # ---- endpoints ------------------------------------------------------
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response(self.engine.health())
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.model_name,
+                        "object": "model",
+                        "created": int(self._started),
+                        "owned_by": "gpustack_tpu",
+                    }
+                ],
+            }
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        h = self.engine.health()
+        lines = [
+            "# TYPE gpustack_engine_slots_used gauge",
+            f"gpustack_engine_slots_used {h['slots_used']}",
+            "# TYPE gpustack_engine_slots_total gauge",
+            f"gpustack_engine_slots_total {h['slots_total']}",
+            "# TYPE gpustack_engine_waiting gauge",
+            f"gpustack_engine_waiting {h['waiting']}",
+            "# TYPE gpustack_engine_decode_steps_total counter",
+            f"gpustack_engine_decode_steps_total {h['steps']}",
+            "# TYPE gpustack_engine_tokens_generated_total counter",
+            f"gpustack_engine_tokens_generated_total {h['tokens_generated']}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        prompt = body.get("prompt")
+        if prompt is None:
+            return _error(400, "missing 'prompt'")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        prompt_ids = self.engine.tokenizer.encode(str(prompt))
+        return await self._run(request, body, prompt_ids, chat=False)
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return _error(400, "missing 'messages'")
+        try:
+            prompt_ids = self.engine.tokenizer.apply_chat_template(messages)
+        except Exception as e:  # tokenizer/template errors are client errors
+            return _error(400, f"chat template failed: {e}")
+        return await self._run(request, body, prompt_ids, chat=True)
+
+    # ---- core -----------------------------------------------------------
+
+    def _gen_request(self, body: Dict[str, Any], prompt_ids) -> GenRequest:
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        stop_texts = tuple(str(s) for s in stop if s)
+        max_tokens = int(
+            body.get("max_tokens") or body.get("max_completion_tokens") or 128
+        )
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        return GenRequest(
+            prompt_ids=prompt_ids,
+            max_tokens=max_tokens,
+            temperature=float(
+                1.0 if body.get("temperature") is None
+                else body.get("temperature")
+            ),
+            top_k=int(body.get("top_k") or 0),
+            top_p=float(body.get("top_p") or 1.0),
+            stop_texts=stop_texts,
+            request_id=str(uuid.uuid4()),
+        )
+
+    async def _run(
+        self, request: web.Request, body: Dict[str, Any], prompt_ids, chat: bool
+    ) -> web.StreamResponse:
+        try:
+            gen = self._gen_request(body, prompt_ids)
+        except (TypeError, ValueError) as e:
+            return _error(400, f"bad sampling params: {e}")
+        if body.get("stream"):
+            return await self._stream(request, gen, chat)
+        loop = asyncio.get_running_loop()
+        try:
+            self.engine.submit(gen)
+        except ValueError as e:
+            return _error(400, str(e))
+        await loop.run_in_executor(None, gen.done.wait, 600)
+        if not gen.done.is_set():
+            return _error(504, "generation timed out")
+        text = gen.output_text
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
+        if chat:
+            choice = {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": gen.finish_reason,
+            }
+            obj = "chat.completion"
+        else:
+            choice = {
+                "index": 0,
+                "text": text,
+                "finish_reason": gen.finish_reason,
+            }
+            obj = "text_completion"
+        return web.json_response(
+            {
+                "id": rid,
+                "object": obj,
+                "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [choice],
+                "usage": _usage(gen),
+            }
+        )
+
+    async def _stream(
+        self, request: web.Request, gen: GenRequest, chat: bool
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        gen.stream = queue.Queue()
+        loop = asyncio.get_running_loop()
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        try:
+            self.engine.submit(gen)
+        except ValueError as e:
+            await resp.write(
+                f"data: {json.dumps({'error': str(e)})}\n\n".encode()
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+
+        if chat:
+            first = {
+                "id": rid, "object": obj, "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{
+                    "index": 0,
+                    "delta": {"role": "assistant", "content": ""},
+                    "finish_reason": None,
+                }],
+            }
+            await resp.write(f"data: {json.dumps(first)}\n\n".encode())
+
+        while True:
+            item = await loop.run_in_executor(None, gen.stream.get)
+            if item is None:
+                break
+            _tok, piece = item
+            delta = (
+                {"delta": {"content": piece}} if chat else {"text": piece}
+            )
+            chunk = {
+                "id": rid, "object": obj, "created": int(time.time()),
+                "model": self.model_name,
+                "choices": [{"index": 0, **delta, "finish_reason": None}],
+            }
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+        final = {
+            "id": rid, "object": obj, "created": int(time.time()),
+            "model": self.model_name,
+            "choices": [
+                {
+                    "index": 0,
+                    **({"delta": {}} if chat else {"text": ""}),
+                    "finish_reason": gen.finish_reason,
+                }
+            ],
+            "usage": _usage(gen),
+        }
+        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        return resp
+
+
+def _error(status: int, message: str) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": "invalid_request_error"}},
+        status=status,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Process entrypoint (what the worker's serve manager launches)
+# ---------------------------------------------------------------------------
+
+
+def build_engine_from_args(args) -> LLMEngine:
+    import jax
+
+    from gpustack_tpu.models import init_params
+    from gpustack_tpu.models.config import get_config, load_hf_config
+    from gpustack_tpu.models.quant import quantize_params
+    from gpustack_tpu.parallel.mesh import MeshPlan, plan_mesh
+
+    if args.model_dir:
+        cfg = load_hf_config(args.model_dir)
+    else:
+        cfg = get_config(args.preset)
+
+    if args.mesh_plan:
+        plan = MeshPlan.parse(args.mesh_plan)
+    else:
+        plan = plan_mesh(
+            min(len(jax.devices()), args.num_devices or len(jax.devices())),
+            cfg.num_kv_heads,
+            cfg.num_experts,
+        )
+
+    from gpustack_tpu.engine.weights import load_or_init_params
+
+    params = load_or_init_params(cfg, args.model_dir, seed=0)
+    if args.quantization == "int8":
+        params = quantize_params(params)
+
+    return LLMEngine(
+        cfg,
+        params,
+        model_dir=args.model_dir,
+        max_slots=args.max_slots,
+        max_seq_len=args.max_seq_len,
+        plan=plan,
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("gpustack-tpu engine API server")
+    p.add_argument("--model-dir", default="")
+    p.add_argument("--preset", default="llama3-8b")
+    p.add_argument("--served-name", default="")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--max-slots", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--quantization", choices=["", "int8"], default="")
+    p.add_argument("--mesh-plan", default="", help="e.g. dp1xsp1xep1xtp4")
+    p.add_argument("--num-devices", type=int, default=0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    engine = build_engine_from_args(args)
+    engine.start()
+    server = OpenAIServer(engine, model_name=args.served_name or None)
+    web.run_app(server.app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,327 @@
+"""Continuous-batching engine: request queue → slots → streamed tokens.
+
+This is the TPU replacement for the engine containers the reference
+launches (reference gpustack/worker/backends/vllm.py role): an in-process
+orchestrator around :class:`~gpustack_tpu.engine.runner.ModelRunner`.
+
+Scheduling loop (one thread, device never idles on the host):
+
+1. admit: while a slot is free and requests wait → prefill (bucketed) +
+   insert.
+2. decode: one ``decode_step`` advances all active slots; sampled tokens are
+   fetched with a small async lag so the device pipeline stays full.
+3. retire: EOS / max_tokens / capacity → free slot, finish stream.
+
+The reference's per-instance health probe contract (serve_manager health
+checks) maps to :meth:`LLMEngine.health`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from gpustack_tpu.engine.runner import DecodeState, ModelRunner
+from gpustack_tpu.engine.tokenizer import load_tokenizer
+from gpustack_tpu.models.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+_FETCH_LAG = 2  # decode steps in flight before the host inspects tokens
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One generation request (already tokenized)."""
+
+    prompt_ids: List[int]
+    max_tokens: int = 128
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_ids: Tuple[int, ...] = ()
+    stop_texts: Tuple[str, ...] = ()       # OpenAI 'stop' strings
+    stream: Optional[queue.Queue] = None   # receives (token_id, text_piece)
+    request_id: str = ""
+
+    # filled by the engine
+    output_ids: List[int] = dataclasses.field(default_factory=list)
+    output_text: str = ""                  # stop-truncated decoded text
+    finish_reason: str = ""
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    submitted_at: float = 0.0
+    first_token_at: float = 0.0
+    finished_at: float = 0.0
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.first_token_at - self.submitted_at) * 1e3
+
+
+@dataclasses.dataclass
+class _SlotInfo:
+    request: GenRequest
+    # Incremental detokenization state: undecoded token ids are buffered
+    # until they decode cleanly (no dangling multibyte sequence), then the
+    # text accumulates here — the tokenizer only ever decodes the small
+    # buffer, keeping streaming O(tokens) instead of O(tokens^2).
+    buffer_ids: List[int] = dataclasses.field(default_factory=list)
+    text: str = ""            # decoded text (post stop-truncation)
+    emitted: int = 0          # chars of ``text`` already streamed
+
+
+class LLMEngine:
+    """Single-replica continuous-batching LLM engine."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Dict[str, Any],
+        *,
+        tokenizer=None,
+        model_dir: Optional[str] = None,
+        max_slots: int = 8,
+        max_seq_len: int = 1024,
+        plan=None,
+        mesh=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.tokenizer = tokenizer or load_tokenizer(model_dir)
+        self.runner = ModelRunner(
+            cfg, params, plan=plan, mesh=mesh,
+            max_slots=max_slots, max_seq_len=max_seq_len,
+        )
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self._state: DecodeState = self.runner.new_state()
+        self._slots: Dict[int, _SlotInfo] = {}
+        self._free = list(range(max_slots))
+        self._waiting: "queue.Queue[GenRequest]" = queue.Queue()
+        self._key = jax.random.key(seed)
+        self._pending: List[Tuple[Any, Dict[int, int]]] = []
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._id_counter = itertools.count()
+        self._step_count = 0
+        self._tokens_generated = 0
+
+    # ---- public API -----------------------------------------------------
+
+    def submit(self, req: GenRequest) -> GenRequest:
+        if not req.request_id:
+            req.request_id = f"req-{next(self._id_counter)}"
+        req.submitted_at = time.time()
+        if len(req.prompt_ids) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_ids)} tokens >= max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        self._waiting.put(req)
+        return req
+
+    def generate(self, req: GenRequest, timeout: float = 300.0) -> GenRequest:
+        """Blocking helper: submit and wait for completion."""
+        self.submit(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request {req.request_id} timed out")
+        return req
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="llm-engine", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "status": "ok",
+            "model": self.cfg.name,
+            "slots_total": self.max_slots,
+            "slots_used": self.max_slots - len(self._free),
+            "waiting": self._waiting.qsize(),
+            "steps": self._step_count,
+            "tokens_generated": self._tokens_generated,
+        }
+
+    # ---- scheduling loop ------------------------------------------------
+
+    def _loop(self) -> None:
+        while self._running:
+            busy = self.step()
+            if not busy:
+                time.sleep(0.002)
+
+    def step(self) -> bool:
+        """One scheduling iteration. Returns False when fully idle."""
+        admitted = self._admit()
+        if self._slots:
+            self._decode_once()
+            return True
+        if admitted:
+            return True
+        # Nothing active: drain any lagging fetches so finished requests
+        # complete deterministically.
+        self._drain_pending()
+        return not self._waiting.empty()
+
+    # admit as many waiting requests as there are free slots
+    def _admit(self) -> bool:
+        admitted = False
+        while self._free and not self._waiting.empty():
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            slot = self._free.pop(0)
+            self._start_request(slot, req)
+            admitted = True
+        return admitted
+
+    def _start_request(self, slot: int, req: GenRequest) -> None:
+        import jax.numpy as jnp
+
+        from gpustack_tpu.engine.sampling import SamplingState, sample
+
+        ids = req.prompt_ids
+        bucket = self.runner.bucket_for(max(1, len(ids)))
+        padded = list(ids) + [0] * (bucket - len(ids))
+        last_logits, k, v = self.runner.prefill(padded, len(ids))
+        # First generated token: same device sampler as decode, one row —
+        # one sampling semantics for the whole sequence, seeded by the
+        # engine's key.
+        self._key, first_key = jax.random.split(self._key)
+        first = int(
+            sample(
+                last_logits[None, :],
+                SamplingState(
+                    temperature=jnp.asarray([req.temperature], jnp.float32),
+                    top_k=jnp.asarray([req.top_k], jnp.int32),
+                    top_p=jnp.asarray([req.top_p], jnp.float32),
+                ),
+                first_key,
+            )[0]
+        )
+        req.first_token_at = time.time()
+        self._state = self.runner.insert(
+            self._state, k, v, slot, len(ids), first,
+            req.temperature, req.top_k, req.top_p,
+        )
+        info = _SlotInfo(request=req)
+        self._slots[slot] = info
+        self._deliver(slot, info, [first])
+
+    def _decode_once(self) -> None:
+        self._key, step_key = jax.random.split(self._key)
+        self._state, sampled = self.runner.decode_step(self._state, step_key)
+        self._step_count += 1
+        # Snapshot slot ownership at dispatch time: by the time this step's
+        # tokens are fetched (lagged), a slot may have been retired and
+        # re-used — the request_id check drops such stale tokens.
+        owners = {
+            s: info.request.request_id for s, info in self._slots.items()
+        }
+        self._pending.append((sampled, owners))
+        if len(self._pending) > _FETCH_LAG:
+            self._process_fetch(*self._pending.pop(0))
+
+    def _drain_pending(self) -> None:
+        while self._pending:
+            self._process_fetch(*self._pending.pop(0))
+
+    def _process_fetch(self, sampled, owners: Dict[int, str]) -> None:
+        tokens = np.asarray(sampled)  # sync point (lagged)
+        for slot, owner_id in owners.items():
+            info = self._slots.get(slot)
+            if info is None or info.request.request_id != owner_id:
+                continue
+            self._deliver(slot, info, [int(tokens[slot])])
+
+    def _deliver(self, slot: int, info: _SlotInfo, toks: List[int]) -> None:
+        req = info.request
+        for tok in toks:
+            is_eos = tok in self.tokenizer.eos_ids or tok in req.stop_ids
+            if not is_eos:
+                req.output_ids.append(tok)
+                self._tokens_generated += 1
+                info.buffer_ids.append(tok)
+                if self._emit_text(info, final=False):
+                    self._finish(slot, info, "stop")
+                    return
+            at_cap = (
+                len(req.prompt_ids) + len(req.output_ids)
+                >= self.max_seq_len - 1
+            )
+            if is_eos or at_cap or len(req.output_ids) >= req.max_tokens:
+                self._finish(slot, info, "stop" if is_eos else "length")
+                return
+
+    def _emit_text(self, info: _SlotInfo, final: bool) -> bool:
+        """Advance incremental detokenization; stream newly-safe text.
+
+        Returns True when a stop string matched (text already truncated and
+        flushed). Text that could still turn into a stop string — or a
+        dangling multibyte sequence — is held back until resolved.
+        """
+        req = info.request
+        if info.buffer_ids:
+            piece = self.tokenizer.decode(info.buffer_ids)
+            if final or not piece.endswith("�"):
+                info.text += piece
+                info.buffer_ids.clear()
+        unemitted = info.text[info.emitted:]
+        # Stop-string search: hold-back guarantees no stop can straddle the
+        # emitted boundary, so searching the unemitted tail is complete.
+        for s in req.stop_texts:
+            idx = unemitted.find(s)
+            if idx != -1:
+                info.text = info.text[: info.emitted + idx]
+                self._push(info, info.text[info.emitted:])
+                return True
+        hold = 0
+        if not final:
+            for s in req.stop_texts:
+                for k in range(min(len(s) - 1, len(unemitted)), 0, -1):
+                    if unemitted.endswith(s[:k]):
+                        hold = max(hold, k)
+                        break
+        self._push(info, unemitted[: len(unemitted) - hold] if hold else unemitted)
+        return False
+
+    def _push(self, info: _SlotInfo, piece: str) -> None:
+        if not piece:
+            return
+        info.emitted += len(piece)
+        req = info.request
+        if req.stream is not None:
+            last = req.output_ids[-1] if req.output_ids else 0
+            req.stream.put((last, piece))
+
+    def _finish(self, slot: int, info: _SlotInfo, reason: str) -> None:
+        req = info.request
+        # A late stop-match during the final flush upgrades the reason.
+        if self._emit_text(info, final=True):
+            reason = "stop"
+        req.finish_reason = reason
+        req.output_text = info.text
+        req.finished_at = time.time()
+        self._state = self.runner.deactivate(self._state, slot)
+        del self._slots[slot]
+        self._free.append(slot)
+        if req.stream is not None:
+            req.stream.put(None)  # sentinel: stream end
+        req.done.set()

@@ -1,0 +1,235 @@
+"""Jitted model execution: prefill, insert, decode — all static-shape.
+
+Execution model (JetStream-style, TPU-first):
+
+- One resident **decode batch** of ``max_slots`` rows over a shared KV cache.
+  ``decode_step`` advances every active slot one token per call.
+- **Prefill** runs per request at a power-of-two bucketed length (bounded jit
+  specializations), into a scratch cache; **insert** copies the prompt KV
+  into the slot's rows. Pad positions in the scratch cache are harmless: a
+  slot's decode write at position p lands before any query attends p, so
+  stale/pad KV beyond the current position is never visible through the
+  causal mask.
+- All sequencing state (last token, position, active mask) lives **on
+  device** so the decode loop never blocks on a host roundtrip — the host
+  fetches sampled tokens asynchronously a couple of steps behind (EOS
+  handling lags; surplus tokens are dropped host-side). This is what makes
+  decode throughput survive a high-latency host↔TPU link.
+- Capacity: a slot auto-deactivates on device when it reaches
+  ``max_seq_len`` (enforcing the KVCache bounds contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from gpustack_tpu.engine.sampling import SamplingState, sample
+from gpustack_tpu.models.config import ModelConfig
+from gpustack_tpu.models.transformer import KVCache, forward
+from gpustack_tpu.parallel.mesh import MeshPlan, make_mesh
+from gpustack_tpu.parallel.sharding import cache_pspec, param_pspecs
+from gpustack_tpu.models.quant import QuantW, quant_pspecs
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecodeState:
+    """Device-resident continuous-batch state."""
+
+    cache: KVCache
+    last_tokens: jax.Array   # i32 [B] — token to feed next step
+    positions: jax.Array     # i32 [B] — next write position (== seq len)
+    active: jax.Array        # bool [B]
+    sampling: SamplingState
+
+    @staticmethod
+    def create(cfg: ModelConfig, batch: int, max_len: int) -> "DecodeState":
+        return DecodeState(
+            cache=KVCache.create(cfg, batch, max_len),
+            last_tokens=jnp.zeros((batch,), jnp.int32),
+            positions=jnp.zeros((batch,), jnp.int32),
+            active=jnp.zeros((batch,), jnp.bool_),
+            sampling=SamplingState.create(batch),
+        )
+
+
+class ModelRunner:
+    """Owns sharded params + jitted prefill/insert/decode for one model."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Dict[str, Any],
+        plan: Optional[MeshPlan] = None,
+        mesh: Optional[Mesh] = None,
+        max_slots: int = 8,
+        max_seq_len: int = 1024,
+        prefill_buckets: Tuple[int, ...] = (),
+    ):
+        self.cfg = cfg
+        self.plan = plan or MeshPlan()
+        self.mesh = mesh or make_mesh(self.plan)
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        if not prefill_buckets:
+            b, buckets = 32, []
+            while b < max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(max_seq_len)
+            prefill_buckets = tuple(buckets)
+        self.prefill_buckets = tuple(sorted(set(prefill_buckets)))
+
+        specs = param_pspecs(params, train=False)
+        if any(isinstance(x, QuantW) for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantW)
+        )):
+            specs = quant_pspecs(specs, params)
+        def put(x, spec):
+            if isinstance(x, QuantW):
+                return jax.device_put(
+                    x,
+                    QuantW(
+                        q=NamedSharding(self.mesh, spec.q),
+                        s=NamedSharding(self.mesh, spec.s),
+                    ),
+                )
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+        self.params = jax.tree.map(
+            put, params, specs,
+            is_leaf=lambda x: isinstance(x, (QuantW, P)),
+        )
+
+        self._cache_sharding = NamedSharding(self.mesh, cache_pspec())
+        self._slot_sharding = NamedSharding(self.mesh, P(None))
+
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._prefills: Dict[int, Any] = {}
+        self._inserts: Dict[int, Any] = {}
+
+    # -- state ------------------------------------------------------------
+
+    def new_state(self) -> DecodeState:
+        state = DecodeState.create(self.cfg, self.max_slots, self.max_seq_len)
+        return jax.device_put(
+            state,
+            DecodeState(
+                cache=KVCache(self._cache_sharding, self._cache_sharding),
+                last_tokens=self._slot_sharding,
+                positions=self._slot_sharding,
+                active=self._slot_sharding,
+                sampling=SamplingState(
+                    self._slot_sharding,
+                    self._slot_sharding,
+                    self._slot_sharding,
+                ),
+            ),
+        )
+
+    # -- prefill ----------------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds max bucket "
+            f"{self.prefill_buckets[-1]}"
+        )
+
+    def _prefill_impl(self, params, tokens, true_len):
+        """tokens [1, Tb]; returns (last_logits [V], k, v [L, Tb, H, hd])."""
+        Tb = tokens.shape[1]
+        cache = KVCache.create(self.cfg, 1, Tb)
+        positions = jnp.arange(Tb, dtype=jnp.int32)[None, :]
+        logits, cache = forward(params, self.cfg, tokens, positions, cache)
+        last = jnp.take(logits[0], true_len - 1, axis=0)
+        return last, cache.k[:, 0], cache.v[:, 0]
+
+    def prefill(self, token_ids, true_len: int):
+        """Run prefill at the bucket for ``true_len``. ``token_ids`` must be
+        padded to the bucket length already (any pad id)."""
+        Tb = len(token_ids)
+        assert Tb in self.prefill_buckets, (Tb, self.prefill_buckets)
+        fn = self._prefills.get(Tb)
+        if fn is None:
+            fn = jax.jit(self._prefill_impl)
+            self._prefills[Tb] = fn
+        tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
+        return fn(self.params, tokens, jnp.int32(true_len))
+
+    # -- insert -----------------------------------------------------------
+
+    def _insert_impl(
+        self, state, k, v, slot, true_len, first_token,
+        temperature, top_k, top_p,
+    ):
+        Tb = k.shape[1]
+        cache = state.cache
+        new_k = cache.k.at[:, slot, :Tb].set(k)
+        new_v = cache.v.at[:, slot, :Tb].set(v)
+        return DecodeState(
+            cache=KVCache(k=new_k, v=new_v),
+            last_tokens=state.last_tokens.at[slot].set(first_token),
+            positions=state.positions.at[slot].set(true_len),
+            active=state.active.at[slot].set(True),
+            sampling=state.sampling.set_slot(slot, temperature, top_k, top_p),
+        )
+
+    def insert(
+        self, state: DecodeState, k, v, slot: int, true_len: int,
+        first_token: int, temperature: float, top_k: int, top_p: float,
+    ) -> DecodeState:
+        Tb = k.shape[1]
+        fn = self._inserts.get(Tb)
+        if fn is None:
+            fn = jax.jit(self._insert_impl, donate_argnums=(0,))
+            self._inserts[Tb] = fn
+        return fn(
+            state, k, v, jnp.int32(slot), jnp.int32(true_len),
+            jnp.int32(first_token), jnp.float32(temperature),
+            jnp.int32(top_k), jnp.float32(top_p),
+        )
+
+    def deactivate(self, state: DecodeState, slot: int) -> DecodeState:
+        return dataclasses.replace(
+            state, active=state.active.at[slot].set(False)
+        )
+
+    # -- decode -----------------------------------------------------------
+
+    def _decode_impl(self, params, state, key):
+        tokens = state.last_tokens[:, None]
+        positions = state.positions[:, None]
+        logits, cache = forward(params, self.cfg, tokens, positions, state.cache)
+        sampled = sample(logits[:, 0], state.sampling, key)
+        # Inactive slots keep feeding their last token at a frozen position;
+        # their cache writes are confined to their own rows and invisible
+        # through the causal mask of any future tenant.
+        next_tokens = jnp.where(state.active, sampled, state.last_tokens)
+        at_capacity = state.positions + 1 >= self.max_seq_len
+        new_positions = jnp.where(
+            state.active, jnp.minimum(state.positions + 1, self.max_seq_len - 1),
+            state.positions,
+        )
+        return (
+            DecodeState(
+                cache=cache,
+                last_tokens=next_tokens,
+                positions=new_positions,
+                active=state.active & ~at_capacity,
+                sampling=state.sampling,
+            ),
+            sampled,
+        )
+
+    def decode_step(self, state: DecodeState, key) -> Tuple[DecodeState, jax.Array]:
+        return self._decode(self.params, state, key)

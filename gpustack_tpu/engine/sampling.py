@@ -1,0 +1,81 @@
+"""Vectorized token samplers.
+
+All sampling state is per-slot arrays of shape ``[B]`` so one jitted
+``sample`` call serves a heterogeneous continuous batch (each request may
+carry its own temperature/top-k/top-p, as OpenAI API params allow) without
+re-specialization — static shapes, no host branching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SamplingState:
+    """Per-slot sampling parameters, shape ``[B]`` each.
+
+    ``temperature == 0`` selects greedy decoding for that slot.
+    ``top_k == 0`` / ``top_p == 1`` disable the respective filters.
+    """
+
+    temperature: jax.Array  # f32 [B]
+    top_k: jax.Array        # i32 [B]
+    top_p: jax.Array        # f32 [B]
+
+    @staticmethod
+    def create(batch: int) -> "SamplingState":
+        return SamplingState(
+            temperature=jnp.zeros((batch,), jnp.float32),
+            top_k=jnp.zeros((batch,), jnp.int32),
+            top_p=jnp.ones((batch,), jnp.float32),
+        )
+
+    def set_slot(self, slot, temperature, top_k, top_p) -> "SamplingState":
+        return SamplingState(
+            temperature=self.temperature.at[slot].set(temperature),
+            top_k=self.top_k.at[slot].set(top_k),
+            top_p=self.top_p.at[slot].set(top_p),
+        )
+
+
+def sample(
+    logits: jax.Array,       # [B, V] f32
+    state: SamplingState,
+    key: jax.Array,
+) -> jax.Array:
+    """Sample one token per row honoring per-row temperature/top-k/top-p."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # One descending sort serves both filters.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+
+    # top-k: mask logits strictly below the k-th largest value.
+    k = jnp.where(state.top_k > 0, state.top_k, V)
+    kth = jnp.take_along_axis(
+        sorted_logits, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+    )
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p over the sorted distribution: keep the smallest prefix whose
+    # cumulative probability reaches p (the first token always survives).
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    keep_sorted = (cum - probs_sorted) < state.top_p[:, None]
+    # Translate the per-row threshold back to logit space: the cutoff is the
+    # smallest kept sorted-logit.
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(scaled < cutoff, -jnp.inf, masked)
+
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(state.temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,70 @@
+"""Tokenizers for the engine: HF wrapper + hermetic byte-level fallback.
+
+The byte tokenizer exists so the whole serving stack (engine, API server,
+benchmark harness) runs hermetically in tests with the ``tiny`` model
+configs — same doctrine as the reference's fixture-driven tests (no real
+model downloads in CI, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Lossless byte tokenizer: UTF-8 byte b -> id b+1; id 0 is EOS/pad,
+    id 257 is BOS (reserved). Vocab 258."""
+
+    vocab_size = 258
+    eos_ids = (0,)
+
+    def encode(self, text: str) -> List[int]:
+        return [b + 1 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(
+            i - 1 for i in ids if 0 < i < 257
+        ).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> List[int]:
+        text = "".join(
+            f"<{m['role']}>{m['content']}</{m['role']}>" for m in messages
+        ) + "<assistant>"
+        return self.encode(text)
+
+
+class HFTokenizer:
+    """transformers.AutoTokenizer wrapper (local files only — zero egress)."""
+
+    def __init__(self, model_dir: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(
+            model_dir, local_files_only=True
+        )
+        self.vocab_size = len(self._tok)
+        eos = self._tok.eos_token_id
+        self.eos_ids = tuple(eos if isinstance(eos, (list, tuple)) else [eos])
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=True)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> List[int]:
+        return self._tok.apply_chat_template(
+            messages, add_generation_prompt=True, tokenize=True
+        )
+
+
+def load_tokenizer(model_dir: Optional[str]):
+    """HF tokenizer when a model dir with tokenizer files exists, else the
+    byte fallback."""
+    if model_dir and (
+        os.path.exists(os.path.join(model_dir, "tokenizer.json"))
+        or os.path.exists(os.path.join(model_dir, "tokenizer_config.json"))
+    ):
+        return HFTokenizer(model_dir)
+    return ByteTokenizer()

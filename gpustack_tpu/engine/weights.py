@@ -1,0 +1,193 @@
+"""Weight loading: HF safetensors checkpoints → stacked functional params.
+
+Covers the LlamaForCausalLM / Qwen2ForCausalLM / MistralForCausalLM /
+MixtralForCausalLM tensor naming. Torch stores linear weights as
+``[out_features, in_features]``; our functional matmuls contract
+``x @ W`` with ``W[in, out]``, so every projection transposes on load.
+
+When no checkpoint directory is given (hermetic tests, synthetic
+benchmarks under zero egress) params are randomly initialized from the
+config instead.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpustack_tpu.models.config import ModelConfig
+from gpustack_tpu.models.transformer import init_params
+
+logger = logging.getLogger(__name__)
+
+
+def _to_jnp(t, dtype=jnp.bfloat16) -> jax.Array:
+    """torch tensor (possibly bf16) → jnp array."""
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+    return jnp.asarray(t.numpy()).astype(dtype)
+
+
+def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
+    """Load *.safetensors from a local HF model dir into our param tree."""
+    from safetensors import safe_open
+
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+
+    tensors: Dict[str, Any] = {}
+    for f in files:
+        with safe_open(f, framework="pt") as st:
+            for name in st.keys():
+                tensors[name] = st.get_tensor(name)
+
+    L = cfg.num_layers
+
+    def take(name: str, transpose: bool = False) -> jax.Array:
+        t = tensors.pop(name)
+        if transpose:
+            t = t.T
+        return _to_jnp(t)
+
+    def stack(fmt: str, transpose: bool = False) -> jax.Array:
+        return jnp.stack([take(fmt.format(i), transpose) for i in range(L)])
+
+    layers: Dict[str, Any] = {
+        "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+        "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
+        layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
+        layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+    if cfg.is_moe:
+        layers["router"] = stack(
+            "model.layers.{}.block_sparse_moe.gate.weight", True
+        )
+        E = cfg.num_experts
+
+        def stack_experts(w: str, transpose: bool) -> jax.Array:
+            return jnp.stack([
+                jnp.stack([
+                    _to_jnp(
+                        tensors.pop(
+                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                        ).T if transpose else tensors.pop(
+                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                        )
+                    )
+                    for e in range(E)
+                ])
+                for i in range(L)
+            ])
+
+        layers["we_gate"] = stack_experts("w1", True)
+        layers["we_down"] = stack_experts("w2", True)
+        layers["we_up"] = stack_experts("w3", True)
+    else:
+        layers["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight", True)
+        layers["w_up"] = stack("model.layers.{}.mlp.up_proj.weight", True)
+        layers["w_down"] = stack("model.layers.{}.mlp.down_proj.weight", True)
+
+    params: Dict[str, Any] = {
+        "embed": take("model.embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": take("model.norm.weight"),
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in tensors:
+            params["lm_head"] = take("lm_head.weight", True)
+        else:
+            logger.warning("no lm_head.weight; tying to embeddings")
+            params["lm_head"] = params["embed"].T
+    if tensors:
+        logger.warning("unused checkpoint tensors: %s", sorted(tensors)[:8])
+    return params
+
+
+def load_or_init_params(
+    cfg: ModelConfig, model_dir: Optional[str], seed: int = 0
+) -> Dict[str, Any]:
+    if model_dir and glob.glob(os.path.join(model_dir, "*.safetensors")):
+        logger.info("loading checkpoint from %s", model_dir)
+        return load_hf_checkpoint(cfg, model_dir)
+    logger.warning(
+        "no checkpoint at %r — initializing random weights for %s",
+        model_dir, cfg.name,
+    )
+    return init_params(cfg, jax.random.key(seed))
+
+
+def save_checkpoint(params: Dict[str, Any], path: str) -> None:
+    """Save params in our native stacked layout (orbax-free, npz-based) —
+    used for engine-local caching of (possibly int8-quantized) weights.
+    ``QuantW`` leaves round-trip via explicit ``::q`` / ``::s`` suffixes."""
+    from gpustack_tpu.models.quant import QuantW
+
+    flat: Dict[str, np.ndarray] = {}
+
+    def to_np(leaf) -> tuple:
+        """npz has no bfloat16; store as float32 with a dtype tag."""
+        arr = np.asarray(leaf)
+        if arr.dtype == jnp.bfloat16:
+            return arr.astype(np.float32), "#bf16"
+        return arr, ""
+
+    def walk(node, prefix: str) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}/{k}" if prefix else k)
+        elif isinstance(node, QuantW):
+            arr, tag = to_np(node.q)
+            flat[prefix + "::q" + tag] = arr
+            arr, tag = to_np(node.s)
+            flat[prefix + "::s" + tag] = arr
+        else:
+            arr, tag = to_np(node)
+            flat[prefix + tag] = arr
+
+    walk(params, "")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    from gpustack_tpu.models.quant import QuantW
+
+    data = np.load(path)
+    tree: Dict[str, Any] = {}
+    pending_quant: Dict[str, Dict[str, Any]] = {}
+    for name, arr in data.items():
+        if name.endswith("#bf16"):
+            name = name[: -len("#bf16")]
+            arr = jnp.asarray(arr).astype(jnp.bfloat16)
+        base, _, qs = name.partition("::")
+        if qs:
+            pending_quant.setdefault(base, {})[qs] = jnp.asarray(arr)
+            continue
+        parts = name.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    for base, qs in pending_quant.items():
+        parts = base.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = QuantW(q=qs["q"], s=qs["s"])
+    return tree

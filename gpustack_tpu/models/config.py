@@ -222,7 +222,7 @@ PRESETS: Dict[str, ModelConfig] = {
     # Hermetic-test configs (run everywhere, compile in seconds).
     "tiny": ModelConfig(
         name="tiny",
-        vocab_size=256,
+        vocab_size=264,
         hidden_size=64,
         intermediate_size=128,
         num_layers=2,
@@ -234,7 +234,7 @@ PRESETS: Dict[str, ModelConfig] = {
     ),
     "tiny-moe": ModelConfig(
         name="tiny-moe",
-        vocab_size=256,
+        vocab_size=264,
         hidden_size=64,
         intermediate_size=128,
         num_layers=2,

@@ -30,8 +30,29 @@ import jax.numpy as jnp
 from jax import lax
 
 from gpustack_tpu.models.config import ModelConfig
+from gpustack_tpu.models.quant import QuantW
 
 Params = Dict[str, Any]
+
+
+def _mm(eq: str, x: jax.Array, w) -> jax.Array:
+    """Weight matmul that transparently handles int8 ``QuantW`` leaves.
+
+    For quantized weights the contraction runs on the int8 tensor (upcast in
+    the MXU feed; the dequantized weight never hits HBM) and the
+    per-output-channel scale multiplies the result — valid because every
+    weight einsum here puts its scale axes last in the output.
+    """
+    if isinstance(w, QuantW):
+        return jnp.einsum(eq, x, w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return jnp.einsum(eq, x, w)
+
+
+def _embed_lookup(embed, tokens: jax.Array, dtype) -> jax.Array:
+    if isinstance(embed, QuantW):
+        x = jnp.take(embed.q, tokens, axis=0).astype(dtype)
+        return x * embed.s[tokens].astype(dtype)[..., None]
+    return jnp.take(embed, tokens, axis=0).astype(dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -210,8 +231,15 @@ def _moe_mlp(
     MXU FLOPs are cheap and all-to-all is not. A capacity-based dispatch
     kernel is the planned perf upgrade for large-E models.
     """
+    # Router math in fp32: top-k selection must not flip on bf16 rounding
+    # (which differs between sharded and unsharded contraction orders).
     gates = jax.nn.softmax(
-        jnp.einsum("btd,de->bte", x, router_w).astype(jnp.float32), axis=-1
+        jnp.einsum(
+            "btd,de->bte",
+            x.astype(jnp.float32),
+            router_w.astype(jnp.float32),
+        ),
+        axis=-1,
     )
     top_w, top_idx = lax.top_k(gates, cfg.num_experts_per_tok)
     if cfg.norm_topk_prob:
@@ -222,10 +250,10 @@ def _moe_mlp(
         * top_w[..., None],
         axis=-2,
     ).astype(x.dtype)
-    g = jnp.einsum("btd,edf->btef", x, we_gate)
-    u = jnp.einsum("btd,edf->btef", x, we_up)
+    g = _mm("btd,edf->btef", x, we_gate)
+    u = _mm("btd,edf->btef", x, we_up)
     h = jax.nn.silu(g) * u
-    y = jnp.einsum("btef,efd->bted", h, we_down)
+    y = _mm("btef,efd->bted", h, we_down)
     return jnp.einsum("bted,bte->btd", y, combine)
 
 
@@ -253,7 +281,7 @@ def forward(
     """
     B, T = tokens.shape
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-    x = jnp.take(params["embed"], tokens, axis=0).astype(dtype)
+    x = _embed_lookup(params["embed"], tokens, dtype)
     sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
@@ -277,9 +305,9 @@ def forward(
     def block(x_in: jax.Array, scanned):
         lp, k_cache_l, v_cache_l = scanned
         h = rms_norm(x_in, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("btd,dq->btq", h, lp["wq"])
-        k = jnp.einsum("btd,dk->btk", h, lp["wk"])
-        v = jnp.einsum("btd,dk->btk", h, lp["wv"])
+        q = _mm("btd,dq->btq", h, lp["wq"])
+        k = _mm("btd,dk->btk", h, lp["wk"])
+        v = _mm("btd,dk->btk", h, lp["wv"])
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim)
@@ -303,7 +331,7 @@ def forward(
             new_v = jax.vmap(write)(v_cache_l, v, positions[:, 0])
             attn = _attend(q, new_k, new_v, mask, scale)
 
-        x_mid = x_in + jnp.einsum("btq,qd->btd", attn, lp["wo"])
+        x_mid = x_in + _mm("btq,qd->btd", attn, lp["wo"])
 
         h2 = rms_norm(x_mid, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
@@ -312,9 +340,9 @@ def forward(
                 cfg,
             )
         else:
-            g = jnp.einsum("btd,df->btf", h2, lp["w_gate"])
-            u = jnp.einsum("btd,df->btf", h2, lp["w_up"])
-            mlp = jnp.einsum("btf,fd->btd", jax.nn.silu(g) * u, lp["w_down"])
+            g = _mm("btd,df->btf", h2, lp["w_gate"])
+            u = _mm("btd,df->btf", h2, lp["w_up"])
+            mlp = _mm("btf,fd->btd", jax.nn.silu(g) * u, lp["w_down"])
         return x_mid + mlp, (new_k, new_v)
 
     if cache is None:
@@ -329,8 +357,8 @@ def forward(
         new_cache = KVCache(k=k_new, v=v_new)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = (
-        params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    )
-    logits = jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
-    return logits, new_cache
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"])
+    else:
+        logits = _mm("btd,dv->btv", x, params["lm_head"])
+    return logits.astype(jnp.float32), new_cache

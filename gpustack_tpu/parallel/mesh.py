@@ -88,12 +88,15 @@ def make_mesh(
     communication-heavy axis — maps to the innermost, highest-bandwidth ICI
     neighbors in the default device order.
     """
+    explicit = devices is not None
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) != plan.chips:
+    if len(devices) < plan.chips or (explicit and len(devices) != plan.chips):
         raise ValueError(
             f"plan {plan} needs {plan.chips} devices, got {len(devices)}"
         )
-    arr = np.array(devices).reshape(plan.dp, plan.sp, plan.ep, plan.tp)
+    arr = np.array(devices[: plan.chips]).reshape(
+        plan.dp, plan.sp, plan.ep, plan.tp
+    )
     return Mesh(arr, MESH_AXES)
 
 

@@ -1,0 +1,137 @@
+"""OpenAI API server tests (hermetic: tiny model + byte tokenizer).
+
+No pytest-asyncio in the image — each test drives its own event loop via
+``asyncio.run`` around aiohttp's TestClient.
+"""
+
+import asyncio
+import json
+
+import jax
+import pytest
+
+from gpustack_tpu.engine.api_server import OpenAIServer
+from gpustack_tpu.engine.engine import LLMEngine
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(cfg, params, max_slots=2, max_seq_len=64)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _client_run(engine, coro_fn):
+    """Fresh OpenAIServer per test: aiohttp freezes an Application once a
+    server starts, so the app object can't be reused across event loops."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = OpenAIServer(engine, model_name="tiny-test")
+
+    async def run():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_healthz_and_models(engine):
+    async def go(client):
+        r = await client.get("/healthz")
+        assert r.status == 200
+        h = await r.json()
+        assert h["status"] == "ok"
+        r = await client.get("/v1/models")
+        data = await r.json()
+        assert data["data"][0]["id"] == "tiny-test"
+
+    _client_run(engine, go)
+
+
+def test_completions(engine):
+    async def go(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "hello", "max_tokens": 4, "temperature": 0},
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "text_completion"
+        assert data["usage"]["completion_tokens"] >= 1
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+
+    _client_run(engine, go)
+
+
+def test_chat_completions(engine):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+            },
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "chat.completion"
+        assert data["choices"][0]["message"]["role"] == "assistant"
+
+    _client_run(engine, go)
+
+
+def test_streaming_chat(engine):
+    async def go(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "temperature": 0,
+                "stream": True,
+            },
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        events = [
+            json.loads(line[6:])
+            for line in raw.splitlines()
+            if line.startswith("data: ") and line != "data: [DONE]"
+        ]
+        assert raw.rstrip().endswith("data: [DONE]")
+        # final chunk carries finish_reason + usage
+        assert events[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        assert events[-1]["usage"]["completion_tokens"] >= 1
+
+    _client_run(engine, go)
+
+
+def test_error_paths(engine):
+    async def go(client):
+        r = await client.post("/v1/completions", data=b"not json")
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={"max_tokens": 4})
+        assert r.status == 400
+        assert "prompt" in (await r.json())["error"]["message"]
+        r = await client.post("/v1/chat/completions", json={"messages": []})
+        assert r.status == 400
+        # oversized prompt -> 400 from engine bounds check
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "x" * 500, "max_tokens": 4},
+        )
+        assert r.status == 400
+        assert "max_seq_len" in (await r.json())["error"]["message"]
+
+    _client_run(engine, go)

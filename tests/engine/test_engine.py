@@ -1,0 +1,239 @@
+"""Engine-level tests: continuous batching, streaming, quantization.
+
+Hermetic (tiny model, byte tokenizer, CPU) — the reference's doctrine of
+fixture-driven tests with no real accelerators (SURVEY.md §4).
+"""
+
+import queue
+
+import jax
+import numpy as np
+import pytest
+
+from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+from gpustack_tpu.engine.sampling import SamplingState, sample
+from gpustack_tpu.models import forward, init_params
+from gpustack_tpu.models.config import get_config
+from gpustack_tpu.models.quant import dequantize, quantize_params
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(cfg, params, max_slots=4, max_seq_len=64)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _greedy_reference(cfg, params, prompt_ids, n):
+    """Greedy generation via repeated full forward (no cache) — the slow
+    but obviously-correct oracle."""
+    ids = list(prompt_ids)
+    out = []
+    for _ in range(n):
+        toks = jnp.asarray(ids, jnp.int32)[None, :]
+        pos = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
+        logits, _ = forward(params, cfg, toks, pos)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def test_engine_greedy_matches_oracle(engine):
+    prompt = [5, 17, 42, 99, 7]
+    req = engine.generate(
+        GenRequest(prompt_ids=prompt, max_tokens=8, temperature=0.0),
+        timeout=120,
+    )
+    oracle = _greedy_reference(engine.cfg, engine.runner.params, prompt, 8)
+    # Stop tokens would truncate; compare up to the engine's output length.
+    assert len(req.output_ids) >= 1
+    assert req.output_ids == oracle[: len(req.output_ids)]
+    assert req.finish_reason in ("stop", "length")
+
+
+def test_engine_concurrent_requests_isolated(engine):
+    """More requests than slots; every request completes and matches its own
+    single-request output (continuous batching must not cross-pollute)."""
+    prompts = [[3, 1, 4], [15, 9, 2, 6], [5, 3], [5, 8, 9, 7, 9], [31, 41], [2, 7]]
+    solo = [
+        _greedy_reference(engine.cfg, engine.runner.params, p, 5)
+        for p in prompts
+    ]
+    reqs = [
+        engine.submit(GenRequest(prompt_ids=p, max_tokens=5, temperature=0.0))
+        for p in prompts
+    ]
+    for r in reqs:
+        assert r.done.wait(180), r.request_id
+    for r, s in zip(reqs, solo):
+        assert r.output_ids == s[: len(r.output_ids)], r.request_id
+
+
+def test_engine_streaming(engine):
+    q = queue.Queue()
+    req = engine.generate(
+        GenRequest(
+            prompt_ids=[72, 102, 109], max_tokens=6, temperature=0.0, stream=q
+        ),
+        timeout=120,
+    )
+    pieces = []
+    while True:
+        item = q.get(timeout=10)
+        if item is None:
+            break
+        pieces.append(item)
+    assert pieces, "stream delivered nothing"
+    assert "".join(p for _, p in pieces) == engine.tokenizer.decode(
+        req.output_ids
+    )
+
+
+def test_engine_stop_ids(engine):
+    # Find a token greedy emits later in the sequence (distinct from the
+    # earlier ones), then rerun with it as a stop id.
+    prompt = [9, 9, 9]
+    probe = engine.generate(
+        GenRequest(prompt_ids=prompt, max_tokens=6, temperature=0.0),
+        timeout=120,
+    )
+    idx = next(
+        (
+            i
+            for i, t in enumerate(probe.output_ids)
+            if i > 0 and t not in probe.output_ids[:i]
+        ),
+        None,
+    )
+    if idx is None:
+        pytest.skip("tiny model repeated a single token; no distinct stop id")
+    stop = probe.output_ids[idx]
+    req = engine.generate(
+        GenRequest(
+            prompt_ids=prompt, max_tokens=10, temperature=0.0,
+            stop_ids=(stop,),
+        ),
+        timeout=120,
+    )
+    assert req.finish_reason == "stop"
+    assert stop not in req.output_ids
+    assert req.output_ids == probe.output_ids[:idx]
+
+
+def test_engine_stop_texts(engine):
+    """Text stop sequences truncate output and upgrade finish_reason."""
+    prompt = [9, 9, 9]
+    probe = engine.generate(
+        GenRequest(prompt_ids=prompt, max_tokens=6, temperature=0.0),
+        timeout=120,
+    )
+    full_text = probe.output_text
+    if len(full_text) < 2:
+        pytest.skip("tiny model produced too little text to split")
+    stop = full_text[1:2]
+    if stop in full_text[:1]:
+        pytest.skip("stop char appears earlier; ambiguous")
+    req = engine.generate(
+        GenRequest(
+            prompt_ids=prompt, max_tokens=10, temperature=0.0,
+            stop_texts=(stop,),
+        ),
+        timeout=120,
+    )
+    assert req.finish_reason == "stop"
+    assert stop not in req.output_text
+    assert req.output_text == full_text[:1]
+
+
+def test_checkpoint_roundtrip_quantized(tmp_path):
+    from gpustack_tpu.engine.weights import load_checkpoint, save_checkpoint
+    from gpustack_tpu.models.quant import QuantW
+
+    cfg = get_config("tiny")
+    params = quantize_params(init_params(cfg, jax.random.key(0)))
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(params, path)
+    loaded = load_checkpoint(path)
+    assert isinstance(loaded["layers"]["wq"], QuantW)
+    toks = jnp.asarray([[5, 17, 42]], jnp.int32)
+    pos = jnp.arange(3, dtype=jnp.int32)[None, :]
+    ref, _ = forward(params, cfg, toks, pos)
+    out, _ = forward(loaded, cfg, toks, pos)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_engine_rejects_oversized_prompt(engine):
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.submit(GenRequest(prompt_ids=list(range(64)), max_tokens=1))
+
+
+def test_engine_health(engine):
+    h = engine.health()
+    assert h["status"] == "ok" and h["slots_total"] == 4
+
+
+def test_sampling_greedy_and_filters():
+    logits = jnp.asarray(
+        [[1.0, 2.0, 3.0, 0.5], [10.0, 0.0, 0.0, 0.0]], jnp.float32
+    )
+    st = SamplingState(
+        temperature=jnp.asarray([0.0, 1.0], jnp.float32),
+        top_k=jnp.asarray([0, 1], jnp.int32),
+        top_p=jnp.asarray([1.0, 1.0], jnp.float32),
+    )
+    toks = sample(logits, st, jax.random.key(0))
+    assert int(toks[0]) == 2            # greedy row
+    assert int(toks[1]) == 0            # top_k=1 forces argmax
+
+
+def test_sampling_top_p_excludes_tail():
+    # One dominant token (p≈0.88); top_p=0.5 must always pick it.
+    logits = jnp.asarray([[5.0, 3.0, 1.0, 0.0]] * 8, jnp.float32)
+    st = SamplingState(
+        temperature=jnp.ones((8,), jnp.float32),
+        top_k=jnp.zeros((8,), jnp.int32),
+        top_p=jnp.full((8,), 0.5, jnp.float32),
+    )
+    for seed in range(5):
+        toks = sample(logits, st, jax.random.key(seed))
+        assert np.all(np.asarray(toks) == 0)
+
+
+def test_quantized_params_close_and_smaller():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    qparams = quantize_params(params)
+    # int8 tensor + per-channel scale reconstructs within quant error
+    w = np.asarray(params["layers"]["wq"], np.float32)
+    wq = np.asarray(
+        dequantize("wq", qparams["layers"]["wq"]), np.float32
+    )
+    err = np.abs(w - wq).max() / (np.abs(w).max() + 1e-9)
+    assert err < 0.01, err
+    # quantized forward is close to bf16 forward
+    toks = jnp.asarray([[5, 17, 42, 99]], jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+    ref, _ = forward(params, cfg, toks, pos)
+    out, _ = forward(qparams, cfg, toks, pos)
+    # logits drift under int8 but ranking of the top token should hold
+    assert int(jnp.argmax(out[0, -1])) == int(jnp.argmax(ref[0, -1]))
+
+
+def test_quantized_engine_generates():
+    cfg = get_config("tiny")
+    params = quantize_params(init_params(cfg, jax.random.key(0)))
+    eng = LLMEngine(cfg, params, max_slots=2, max_seq_len=64)
+    eng.start()
+    try:
+        req = eng.generate(
+            GenRequest(prompt_ids=[1, 2, 3], max_tokens=4, temperature=0.0),
+            timeout=120,
+        )
+        assert len(req.output_ids) >= 1
+    finally:
+        eng.stop()
