@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Benchmark: flagship serving throughput on the local accelerator.
+
+Profile mirrors the reference's "Throughput" benchmark shape (1024-token
+prompts / 128 output tokens, unlimited rate — reference
+gpustack/assets/profiles_config/profiles_config.yaml:2-11) driven through
+the in-repo engine on Llama-3-8B (int8 weight-only, random weights — zero
+egress; token throughput is weight-content-independent).
+
+Metric: output tokens/sec/chip. Baseline anchor (BASELINE.md): the
+reference's closest published number for an 8B-dense model —
+Qwen3-8B on Ascend 910B×8, 1512.21 output tok/s total → 189 output
+tok/s/chip (docs/performance-lab/qwen3-8b/910b.md:95-98).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_OUT_TPS_PER_CHIP = 189.0  # Qwen3-8B, 910B x8: 1512.21/8
+
+PROMPT_LEN = 1000      # pads into the 1024 prefill bucket
+OUTPUT_LEN = 128
+NUM_REQUESTS = 48
+MAX_SLOTS = 16
+MAX_SEQ_LEN = 1280
+
+
+def build_engine(cfg_name: str, max_slots: int, max_seq_len: int):
+    import jax
+
+    from gpustack_tpu.engine.engine import LLMEngine
+    from gpustack_tpu.models.config import get_config
+    from gpustack_tpu.models.quant import quantize_params
+    from gpustack_tpu.models.transformer import init_params
+
+    cfg = get_config(cfg_name)
+    # Init + quantize on host CPU: bf16 8B (16 GB) must not touch the 16 GB
+    # chip; the int8 tree (~8 GB) is what ships to HBM.
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        params = quantize_params(init_params(cfg, jax.random.key(0)))
+    return LLMEngine(
+        cfg, params, max_slots=max_slots, max_seq_len=max_seq_len
+    )
+
+
+def main() -> None:
+    import numpy as np
+
+    from gpustack_tpu.engine.engine import GenRequest
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    cfg_name = "tiny" if smoke else "llama3-8b"
+    prompt_len = 56 if smoke else PROMPT_LEN
+    output_len = 16 if smoke else OUTPUT_LEN
+    num_requests = 6 if smoke else NUM_REQUESTS
+    max_slots = 4 if smoke else MAX_SLOTS
+    max_seq_len = 128 if smoke else MAX_SEQ_LEN
+
+    engine = build_engine(cfg_name, max_slots, max_seq_len)
+    engine.start()
+    rng = np.random.default_rng(0)
+    vocab = engine.cfg.vocab_size
+
+    def make_req():
+        return GenRequest(
+            prompt_ids=rng.integers(1, vocab, prompt_len).tolist(),
+            max_tokens=output_len,
+            temperature=0.0,
+            # random-weight models rarely emit eos, but make termination
+            # deterministic regardless:
+            stop_ids=(),
+        )
+
+    # Warmup: compile prefill bucket + decode step.
+    engine.generate(make_req(), timeout=1800)
+
+    reqs = [make_req() for _ in range(num_requests)]
+    t0 = time.time()
+    for r in reqs:
+        engine.submit(r)
+    for r in reqs:
+        if not r.done.wait(3600):
+            raise TimeoutError(f"bench request {r.request_id} unfinished")
+    wall = time.time() - t0
+    engine.stop()
+
+    out_tokens = sum(len(r.output_ids) for r in reqs)
+    in_tokens = sum(len(r.prompt_ids) for r in reqs)
+    ttfts = sorted(r.ttft_ms for r in reqs)
+    p50_ttft = ttfts[len(ttfts) // 2]
+
+    import jax
+
+    n_chips = 1  # bench runs single-chip; scheduler handles multi-chip
+    value = out_tokens / wall / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "output_tok_per_s_per_chip (llama3-8b int8, "
+                "1024/128 throughput profile)"
+                if not smoke
+                else "output_tok_per_s_per_chip (SMOKE tiny)",
+                "value": round(value, 2),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(value / BASELINE_OUT_TPS_PER_CHIP, 3),
+                "detail": {
+                    "requests": num_requests,
+                    "output_tokens": out_tokens,
+                    "input_tokens": in_tokens,
+                    "wall_s": round(wall, 2),
+                    "total_tok_per_s": round(
+                        (out_tokens + in_tokens) / wall, 2
+                    ),
+                    "p50_ttft_ms": round(p50_ttft, 1),
+                    "platform": jax.default_backend(),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from gpustack_tpu.main import main
+
+raise SystemExit(main())

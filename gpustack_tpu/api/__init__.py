@@ -1,0 +1,1 @@
+"""API-layer helpers: auth, middlewares (reference gpustack/api)."""

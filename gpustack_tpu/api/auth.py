@@ -1,0 +1,201 @@
+"""Authentication: password hashing, JWT (stdlib HMAC), API keys.
+
+Reference parity (gpustack/api/auth.py): JWT cookie/bearer sessions, API
+keys of the form ``<prefix>_<access>_<secret>`` where only a hash of the
+secret is stored (gpustack/security.py), worker/system principals for the
+agent, scopes (management vs inference).
+
+No PyJWT in the image — JWTs are HS256 via stdlib hmac/hashlib, which is
+all the server ever issues or accepts.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import json
+import secrets
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from gpustack_tpu.schemas.users import API_KEY_PREFIX, ApiKey, User
+
+JWT_TTL_SECONDS = 12 * 3600
+
+
+# ---------------------------------------------------------------------------
+# Password hashing (scrypt, stdlib)
+# ---------------------------------------------------------------------------
+
+
+def hash_password(password: str) -> str:
+    salt = secrets.token_bytes(16)
+    digest = hashlib.scrypt(
+        password.encode(), salt=salt, n=2**14, r=8, p=1
+    )
+    return f"scrypt${salt.hex()}${digest.hex()}"
+
+
+def verify_password(password: str, stored: str) -> bool:
+    try:
+        algo, salt_hex, digest_hex = stored.split("$")
+        assert algo == "scrypt"
+        digest = hashlib.scrypt(
+            password.encode(), salt=bytes.fromhex(salt_hex), n=2**14, r=8, p=1
+        )
+        return hmac.compare_digest(digest.hex(), digest_hex)
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# JWT (HS256)
+# ---------------------------------------------------------------------------
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def jwt_encode(payload: Dict[str, Any], secret: str) -> str:
+    header = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = _b64(json.dumps(payload).encode())
+    signing = f"{header}.{body}".encode()
+    sig = _b64(hmac.new(secret.encode(), signing, hashlib.sha256).digest())
+    return f"{header}.{body}.{sig}"
+
+
+def jwt_decode(token: str, secret: str) -> Optional[Dict[str, Any]]:
+    try:
+        header, body, sig = token.split(".")
+        signing = f"{header}.{body}".encode()
+        expect = _b64(
+            hmac.new(secret.encode(), signing, hashlib.sha256).digest()
+        )
+        if not hmac.compare_digest(expect, sig):
+            return None
+        payload = json.loads(_unb64(body))
+        if payload.get("exp", 0) < time.time():
+            return None
+        return payload
+    except Exception:
+        return None
+
+
+def issue_session_token(user: User, secret: str) -> str:
+    return jwt_encode(
+        {
+            "sub": user.id,
+            "username": user.username,
+            "admin": user.is_admin,
+            "exp": int(time.time()) + JWT_TTL_SECONDS,
+        },
+        secret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# API keys
+# ---------------------------------------------------------------------------
+
+
+def generate_api_key() -> Tuple[str, str, str]:
+    """Returns (full_key, access_key, hashed_secret)."""
+    access = secrets.token_hex(8)
+    secret = secrets.token_urlsafe(24)
+    full = f"{API_KEY_PREFIX}_{access}_{secret}"
+    return full, access, hash_secret(secret)
+
+
+def hash_secret(secret: str) -> str:
+    return hashlib.sha256(secret.encode()).hexdigest()
+
+
+def parse_api_key(token: str) -> Optional[Tuple[str, str]]:
+    parts = token.split("_", 2)
+    if len(parts) != 3 or parts[0] != API_KEY_PREFIX:
+        return None
+    return parts[1], parts[2]
+
+
+# ---------------------------------------------------------------------------
+# Principals
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Principal:
+    """The authenticated caller: a user, a worker, or the system."""
+
+    kind: str = "user"                # user | worker | system
+    user: Optional[User] = None
+    worker_id: int = 0
+    scopes: Tuple[str, ...] = ("management", "inference")
+
+    @property
+    def is_admin(self) -> bool:
+        return self.kind == "system" or bool(self.user and self.user.is_admin)
+
+    def has_scope(self, scope: str) -> bool:
+        return scope in self.scopes
+
+
+async def authenticate(
+    token: str, jwt_secret: str
+) -> Optional[Principal]:
+    """Resolve a bearer token: API key, worker token, or session JWT."""
+    if not token:
+        return None
+    if token.startswith(API_KEY_PREFIX + "_"):
+        parsed = parse_api_key(token)
+        if not parsed:
+            return None
+        access, secret = parsed
+        key = await ApiKey.first(access_key=access)
+        if key is None:
+            return None
+        if not hmac.compare_digest(key.hashed_secret, hash_secret(secret)):
+            return None
+        if key.expires_at and key.expires_at < time_iso_now():
+            return None
+        user = await User.get(key.user_id)
+        if user is None:
+            return None
+        return Principal(kind="user", user=user, scopes=tuple(key.scopes))
+    payload = jwt_decode(token, jwt_secret)
+    if payload is None:
+        return None
+    if payload.get("worker"):
+        return Principal(
+            kind="worker",
+            worker_id=int(payload["worker"]),
+            scopes=("worker",),
+        )
+    user = await User.get(int(payload.get("sub", 0)))
+    if user is None:
+        return None
+    return Principal(kind="user", user=user)
+
+
+def issue_worker_token(worker_id: int, secret: str) -> str:
+    return jwt_encode(
+        {
+            "worker": worker_id,
+            # worker tokens are long-lived; rotation happens via
+            # re-registration
+            "exp": int(time.time()) + 365 * 24 * 3600,
+        },
+        secret,
+    )
+
+
+def time_iso_now() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()

@@ -1,0 +1,73 @@
+"""aiohttp middlewares: authentication + request logging.
+
+Reference analogue: the FastAPI dependency chain ``get_current_user``
+(gpustack/api/auth.py:118) + middleware stack (server/app.py:26)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from aiohttp import web
+
+from gpustack_tpu.api import auth as auth_mod
+
+logger = logging.getLogger(__name__)
+
+# Paths reachable without a principal.
+PUBLIC_PATHS = {
+    "/healthz",
+    "/readyz",
+    "/auth/login",
+    "/v2/workers/register",
+    "/metrics",
+}
+
+
+def _extract_token(request: web.Request) -> str:
+    authz = request.headers.get("Authorization", "")
+    if authz.startswith("Bearer "):
+        return authz[7:]
+    from gpustack_tpu.routes.auth_routes import SESSION_COOKIE
+
+    return request.cookies.get(SESSION_COOKIE, "")
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    path = request.path
+    if path in PUBLIC_PATHS:
+        return await handler(request)
+    cfg = request.app["config"]
+    token = _extract_token(request)
+    principal = await auth_mod.authenticate(token, cfg.jwt_secret)
+    if principal is None:
+        return web.json_response(
+            {"error": "authentication required"}, status=401
+        )
+    if path.startswith("/v1/") and not principal.has_scope("inference"):
+        if principal.kind == "user":
+            return web.json_response(
+                {"error": "token lacks inference scope"}, status=403
+            )
+    if path.startswith("/v2/") and principal.kind == "user":
+        if not principal.has_scope("management"):
+            return web.json_response(
+                {"error": "token lacks management scope"}, status=403
+            )
+    request["principal"] = principal
+    return await handler(request)
+
+
+@web.middleware
+async def timing_middleware(request: web.Request, handler):
+    start = time.monotonic()
+    try:
+        return await handler(request)
+    finally:
+        elapsed = (time.monotonic() - start) * 1e3
+        if elapsed > 1000:
+            logger.warning(
+                "slow request: %s %s took %.0fms",
+                request.method, request.path, elapsed,
+            )

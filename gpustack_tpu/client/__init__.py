@@ -1,0 +1,6 @@
+"""Async client SDK for the server API (reference gpustack/client
+generated per-resource clients with watch support, used by workers)."""
+
+from gpustack_tpu.client.client import ClientSet
+
+__all__ = ["ClientSet"]

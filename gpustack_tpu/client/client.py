@@ -1,0 +1,149 @@
+"""Async HTTP client for the control-plane API with watch streams.
+
+The worker agent's only line to the server (reference gpustack/client
+ClientSet). Watch protocol: NDJSON event lines from
+``GET /v2/<kind>?watch=true`` (see routes/crud.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import aiohttp
+
+from gpustack_tpu.server.bus import Event
+
+logger = logging.getLogger(__name__)
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class ClientSet:
+    def __init__(self, base_url: str, token: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _headers(self) -> Dict[str, str]:
+        return (
+            {"Authorization": f"Bearer {self.token}"} if self.token else {}
+        )
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    # ---- generic --------------------------------------------------------
+
+    async def request(
+        self,
+        method: str,
+        path: str,
+        json_body: Optional[Dict[str, Any]] = None,
+        timeout: float = 30.0,
+    ) -> Any:
+        url = self.base_url + path
+        async with self.session.request(
+            method,
+            url,
+            json=json_body,
+            headers=self._headers(),
+            timeout=aiohttp.ClientTimeout(total=timeout),
+        ) as resp:
+            if resp.status >= 400:
+                try:
+                    message = (await resp.json()).get("error", "")
+                except Exception:
+                    message = await resp.text()
+                raise APIError(resp.status, message)
+            return await resp.json()
+
+    async def list(self, kind: str, **filters: Any) -> List[Dict[str, Any]]:
+        query = "&".join(f"{k}={v}" for k, v in filters.items())
+        path = f"/v2/{kind}" + (f"?{query}" if query else "")
+        return (await self.request("GET", path))["items"]
+
+    async def get(self, kind: str, id: int) -> Dict[str, Any]:
+        return await self.request("GET", f"/v2/{kind}/{id}")
+
+    async def create(self, kind: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        return await self.request("POST", f"/v2/{kind}", body)
+
+    async def update(
+        self, kind: str, id: int, fields: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        return await self.request("PATCH", f"/v2/{kind}/{id}", fields)
+
+    async def delete(self, kind: str, id: int) -> Any:
+        return await self.request("DELETE", f"/v2/{kind}/{id}")
+
+    # ---- watch ----------------------------------------------------------
+
+    async def watch(
+        self, kind: str, retry_delay: float = 3.0
+    ) -> AsyncIterator[Event]:
+        """Yields events forever; reconnects (emitting RESYNC) on errors."""
+        from gpustack_tpu.server.bus import EventType
+
+        first = True
+        while True:
+            if not first:
+                yield Event(kind="*", type=EventType.RESYNC)
+            first = False
+            try:
+                async with self.session.get(
+                    f"{self.base_url}/v2/{kind}?watch=true",
+                    headers=self._headers(),
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_read=120
+                    ),
+                ) as resp:
+                    if resp.status >= 400:
+                        raise APIError(resp.status, await resp.text())
+                    async for line in resp.content:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        yield Event.from_wire(json.loads(line))
+            except (
+                aiohttp.ClientError,
+                asyncio.TimeoutError,
+                json.JSONDecodeError,
+                APIError,
+            ) as e:
+                logger.warning(
+                    "watch %s dropped (%s); reconnecting in %.0fs",
+                    kind, e, retry_delay,
+                )
+                await asyncio.sleep(retry_delay)
+
+    # ---- worker-specific ------------------------------------------------
+
+    async def register_worker(
+        self, body: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        return await self.request("POST", "/v2/workers/register", body)
+
+    async def post_status(
+        self, worker_id: int, status: Dict[str, Any]
+    ) -> None:
+        await self.request(
+            "POST", f"/v2/workers/{worker_id}/status", {"status": status}
+        )
+
+    async def heartbeat(self, worker_id: int) -> None:
+        await self.request("POST", f"/v2/workers/{worker_id}/heartbeat", {})

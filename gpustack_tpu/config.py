@@ -1,0 +1,116 @@
+"""Layered configuration: CLI flags > YAML config file > environment.
+
+Mirrors the reference's precedence (reference gpustack/cmd/start.py:763-781)
+without pydantic-settings (absent from the image): env vars use the
+``GPUSTACK_TPU_`` prefix, field names upper-cased.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+from typing import Any, Dict, Optional
+
+import pydantic
+
+ENV_PREFIX = "GPUSTACK_TPU_"
+
+
+class Config(pydantic.BaseModel):
+    # role: run an API server, a worker agent, or both (embedded worker) —
+    # decided by server_url like the reference (cmd/start.py:727-730)
+    server_url: str = ""              # set => worker role
+    disable_worker: bool = False      # server only
+
+    # server
+    host: str = "0.0.0.0"
+    port: int = 10150
+    data_dir: str = ""
+    database_path: str = ""           # derived from data_dir when empty
+    jwt_secret: str = ""              # auto-generated + persisted when empty
+    bootstrap_password: str = ""      # admin password; random when empty
+    registration_token: str = ""      # cluster join token; random when empty
+
+    # worker
+    worker_name: str = ""
+    worker_ip: str = ""
+    worker_port: int = 10151
+    cache_dir: str = ""               # model file cache
+    heartbeat_interval: float = 10.0
+    status_interval: float = 30.0
+    fake_detector: str = ""           # path to a fixture JSON (tests)
+
+    # engine defaults
+    engine_port_base: int = 40000
+    engine_port_range: int = 200
+    force_platform: str = ""          # "cpu" for hermetic tests
+
+    # observability
+    enable_metrics: bool = True
+
+    debug: bool = False
+
+    # ---- derivation -----------------------------------------------------
+
+    def finalize(self) -> "Config":
+        if not self.data_dir:
+            self.data_dir = os.path.expanduser("~/.gpustack-tpu")
+        os.makedirs(self.data_dir, exist_ok=True)
+        if not self.database_path:
+            self.database_path = os.path.join(self.data_dir, "state.db")
+        if not self.cache_dir:
+            self.cache_dir = os.path.join(self.data_dir, "cache")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        if not self.jwt_secret:
+            self.jwt_secret = self._load_or_create_secret("jwt_secret")
+        if not self.registration_token:
+            self.registration_token = self._load_or_create_secret(
+                "registration_token"
+            )
+        return self
+
+    def _load_or_create_secret(self, name: str) -> str:
+        """Auto-generate and persist a secret under data_dir (reference
+        persists the JWT secret the same way, config/config.py:728-742)."""
+        path = os.path.join(self.data_dir, name)
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip()
+        value = secrets.token_urlsafe(32)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(value)
+        return value
+
+    @property
+    def is_server(self) -> bool:
+        return not self.server_url
+
+    # ---- loading --------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        cli_overrides: Optional[Dict[str, Any]] = None,
+        config_file: Optional[str] = None,
+    ) -> "Config":
+        values: Dict[str, Any] = {}
+        # env (lowest of the explicit layers)
+        for field in cls.model_fields:
+            env_val = os.environ.get(ENV_PREFIX + field.upper())
+            if env_val is not None:
+                values[field] = env_val
+        # yaml file
+        if config_file:
+            import yaml
+
+            with open(config_file) as f:
+                file_vals = yaml.safe_load(f) or {}
+            if not isinstance(file_vals, dict):
+                raise ValueError(f"config file {config_file} must be a map")
+            values.update(file_vals)
+        # cli
+        for k, v in (cli_overrides or {}).items():
+            if v is not None:
+                values[k] = v
+        return cls(**values).finalize()

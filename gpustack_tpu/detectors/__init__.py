@@ -1,0 +1,15 @@
+"""Hardware detection (reference gpustack/detectors + gpustack-runtime's
+device probing, re-targeted at TPU hosts).
+
+``TPUDetector`` reads TPU-VM environment metadata + /dev/accel* +
+/proc; ``FakeDetector`` loads a fixture JSON (the test/fleet-simulation
+path, mirroring the reference's fixture-driven worker corpus,
+tests/fixtures/workers/*)."""
+
+from gpustack_tpu.detectors.detector import (
+    FakeDetector,
+    TPUDetector,
+    create_detector,
+)
+
+__all__ = ["TPUDetector", "FakeDetector", "create_detector"]

@@ -1,0 +1,149 @@
+"""TPU + system detection.
+
+Replaces the reference's detector stack (fastfetch binary wrapper +
+gpustack-runtime NVML probing, reference detectors/detector_factory.py):
+on a TPU-VM the source of truth is environment metadata
+(``TPU_ACCELERATOR_TYPE`` like "v5litepod-8", ``TPU_TOPOLOGY`` like
+"2x4", ``TPU_WORKER_ID``) plus ``/dev/accel*`` device nodes; system info
+comes straight from /proc (the C++ ``sysinfo`` tool in native/ provides
+the same JSON contract for non-Python consumers).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import platform
+from typing import Dict, Optional
+
+from gpustack_tpu.schemas.workers import SliceTopology, TPUChip, WorkerStatus
+
+logger = logging.getLogger(__name__)
+
+# HBM per chip by generation (GiB)
+CHIP_HBM_GIB: Dict[str, int] = {
+    "v4": 32,
+    "v5e": 16,
+    "v5p": 95,
+    "v6e": 32,
+}
+
+_ACCEL_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5p": "v5p",
+    "v6e": "v6e",
+    "v4": "v4",
+}
+
+
+def parse_accelerator_type(accel: str):
+    """'v5litepod-8' -> ('v5e', 8); 'v4-32' -> ('v4', 32)."""
+    if not accel or "-" not in accel:
+        return None
+    gen_raw, _, count = accel.rpartition("-")
+    gen = _ACCEL_ALIASES.get(gen_raw.strip().lower())
+    try:
+        return (gen, int(count)) if gen else None
+    except ValueError:
+        return None
+
+
+class TPUDetector:
+    """Detect TPU chips + slice topology on this host."""
+
+    def detect(self) -> WorkerStatus:
+        status = WorkerStatus(
+            cpu_count=os.cpu_count() or 0,
+            os=platform.system(),
+            kernel=platform.release(),
+            arch=platform.machine(),
+        )
+        self._fill_memory(status)
+        self._fill_tpu(status)
+        self._fill_versions(status)
+        return status
+
+    def _fill_memory(self, status: WorkerStatus) -> None:
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    info[key.strip()] = rest.strip()
+            total = int(info.get("MemTotal", "0 kB").split()[0]) * 1024
+            avail = int(info.get("MemAvailable", "0 kB").split()[0]) * 1024
+            status.memory_total_bytes = total
+            status.memory_used_bytes = max(0, total - avail)
+        except (OSError, ValueError, IndexError):
+            pass
+
+    def _fill_tpu(self, status: WorkerStatus) -> None:
+        accel = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        parsed = parse_accelerator_type(accel)
+        devices = sorted(glob.glob("/dev/accel*")) or sorted(
+            glob.glob("/dev/vfio/*")
+        )
+        if parsed is None and not devices:
+            return
+        if parsed:
+            gen, total_chips = parsed
+        else:
+            gen, total_chips = "v5e", len(devices)
+        topology = os.environ.get("TPU_TOPOLOGY", "")
+        num_hosts = max(
+            1, int(os.environ.get("TPU_WORKER_COUNT", "0") or 0)
+        )
+        host_index = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        if num_hosts == 1 and hostnames:
+            num_hosts = max(1, len(hostnames.split(",")))
+        chips_here = (
+            len(devices) if devices else total_chips // num_hosts or 1
+        )
+        hbm = CHIP_HBM_GIB.get(gen, 16) * 2**30
+        status.chips = [
+            TPUChip(index=i, chip_type=gen, hbm_bytes=hbm)
+            for i in range(chips_here)
+        ]
+        status.slice = SliceTopology(
+            topology=topology,
+            chips_per_host=chips_here,
+            num_hosts=num_hosts,
+            host_index=host_index,
+            ici_domain=os.environ.get("TPU_SLICE_NAME", "")
+            or (accel if num_hosts > 1 else ""),
+        )
+
+    def _fill_versions(self, status: WorkerStatus) -> None:
+        try:
+            import jax
+
+            status.jax_version = jax.__version__
+        except Exception:
+            pass
+        try:
+            import importlib.metadata as md
+
+            status.libtpu_version = md.version("libtpu")
+        except Exception:
+            pass
+
+
+class FakeDetector:
+    """Fixture-driven detector (tests / simulated fleets)."""
+
+    def __init__(self, fixture_path: str):
+        self.fixture_path = fixture_path
+
+    def detect(self) -> WorkerStatus:
+        with open(self.fixture_path) as f:
+            return WorkerStatus.model_validate(json.load(f))
+
+
+def create_detector(fake_fixture: Optional[str] = None):
+    if fake_fixture:
+        return FakeDetector(fake_fixture)
+    return TPUDetector()

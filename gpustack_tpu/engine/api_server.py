@@ -17,6 +17,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import queue
 import time
 import uuid
@@ -266,7 +267,29 @@ def _error(status: int, message: str) -> web.Response:
 
 
 def build_engine_from_args(args) -> LLMEngine:
+    # Hermetic-test hook: the serve manager sets GPUSTACK_TPU_PLATFORM=cpu
+    # so engine subprocesses run on the CPU backend. jax.config wins over
+    # env vars even against TPU-plugin sitecustomize overrides.
+    forced = os.environ.get("GPUSTACK_TPU_PLATFORM")
     import jax
+
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    # Multi-host replica: rendezvous through the JAX distributed
+    # coordinator (the serve manager sets these from the placement — the
+    # TPU replacement for the reference's Ray bootstrap,
+    # worker/backends/vllm.py:258-328). After initialize(), jax.devices()
+    # spans every host of the slice and the mesh plan tiles all of them.
+    coordinator = os.environ.get("GPUSTACK_TPU_COORDINATOR")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(
+                os.environ.get("GPUSTACK_TPU_NUM_PROCESSES", "1")
+            ),
+            process_id=int(os.environ.get("GPUSTACK_TPU_PROCESS_ID", "0")),
+        )
 
     from gpustack_tpu.models import init_params
     from gpustack_tpu.models.config import get_config, load_hf_config

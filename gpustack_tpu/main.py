@@ -1,0 +1,144 @@
+"""CLI entrypoint (reference gpustack/main.py + cmd/start.py).
+
+``python -m gpustack_tpu start`` runs a server (with embedded worker), a
+pure worker when ``--server-url`` is given — same role derivation as the
+reference (cmd/start.py:727-730).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "gpustack-tpu", description="TPU-native model serving cluster manager"
+    )
+    sub = p.add_subparsers(dest="command")
+
+    start = sub.add_parser("start", help="start server or worker")
+    start.add_argument("--config-file", default="")
+    start.add_argument("--server-url", default=None,
+                       help="run as worker against this server")
+    start.add_argument("--host", default=None)
+    start.add_argument("--port", type=int, default=None)
+    start.add_argument("--data-dir", default=None)
+    start.add_argument("--registration-token", default=None)
+    start.add_argument("--bootstrap-password", default=None)
+    start.add_argument("--worker-name", default=None)
+    start.add_argument("--worker-ip", default=None)
+    start.add_argument("--disable-worker", action="store_true", default=None)
+    start.add_argument("--fake-detector", default=None)
+    start.add_argument("--force-platform", default=None)
+    start.add_argument("--debug", action="store_true", default=None)
+
+    sub.add_parser("version", help="print version")
+
+    migrate = sub.add_parser("migrate", help="apply DB migrations and exit")
+    migrate.add_argument("--data-dir", default=None)
+    migrate.add_argument("--config-file", default="")
+
+    reset = sub.add_parser(
+        "reset-admin-password", help="reset the admin password"
+    )
+    reset.add_argument("--data-dir", default=None)
+    reset.add_argument("--password", required=True)
+    reset.add_argument("--config-file", default="")
+    return p
+
+
+def _config_from_args(args) -> "Config":
+    from gpustack_tpu.config import Config
+
+    overrides = {
+        k: v
+        for k, v in vars(args).items()
+        if k not in ("command", "config_file") and v is not None
+    }
+    return Config.load(overrides, config_file=args.config_file or None)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if getattr(args, "debug", False) else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    if args.command == "version":
+        from gpustack_tpu import __version__
+
+        print(__version__)
+        return 0
+    if args.command == "migrate":
+        from gpustack_tpu.orm.db import Database, run_migrations
+
+        cfg = _config_from_args(args)
+        db = Database(cfg.database_path)
+        n = run_migrations(db)
+        print(f"applied {n} migrations")
+        db.close()
+        return 0
+    if args.command == "reset-admin-password":
+        return _reset_admin_password(args)
+    if args.command == "start":
+        cfg = _config_from_args(args)
+        if cfg.is_server:
+            from gpustack_tpu.server.server import Server
+
+            server = Server(cfg)
+            try:
+                asyncio.run(server.run_forever())
+            except KeyboardInterrupt:
+                pass
+            return 0
+        from gpustack_tpu.worker.worker import WorkerAgent
+
+        agent = WorkerAgent(cfg)
+        try:
+            asyncio.run(agent.run_forever())
+        except KeyboardInterrupt:
+            pass
+        return 0
+    build_parser().print_help()
+    return 1
+
+
+def _reset_admin_password(args) -> int:
+    from gpustack_tpu.api import auth as auth_mod
+    from gpustack_tpu.orm.db import Database
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import User
+    from gpustack_tpu.server.bus import EventBus
+
+    cfg = _config_from_args(args)
+
+    async def go():
+        db = Database(cfg.database_path)
+        Record.bind(db, EventBus())
+        Record.create_all_tables(db)
+        user = await User.first(username="admin")
+        if user is None:
+            await User.create(
+                User(
+                    username="admin",
+                    is_admin=True,
+                    password_hash=auth_mod.hash_password(args.password),
+                )
+            )
+        else:
+            await user.update(
+                password_hash=auth_mod.hash_password(args.password),
+                require_password_change=False,
+            )
+        db.close()
+
+    asyncio.run(go())
+    print("admin password updated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

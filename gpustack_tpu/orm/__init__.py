@@ -1,0 +1,24 @@
+"""Lightweight async ORM over sqlite (stdlib) with ActiveRecord semantics.
+
+The reference builds on SQLModel/SQLAlchemy with an ActiveRecord mixin that
+publishes a bus event after every commit (reference
+gpustack/mixins/active_record.py:78-92) — neither SQLAlchemy nor SQLModel
+exists in this image, and a cluster-manager appliance doesn't need a full
+RDBMS abstraction. This ORM keeps the *semantics* that matter:
+
+- async CRUD (``create/get/filter/update/delete``) on typed pydantic records
+- changed-field diffing on update (reference active_record.py:46-74)
+- post-commit event publication into the EventBus
+- watch streams (``subscribe``) with heartbeats for HTTP watchers
+
+Storage model: one sqlite table per record type with a JSON document column
+plus extracted index columns — document-store reads, SQL-indexed filters.
+sqlite runs in WAL mode behind a single writer thread; Postgres can slot in
+behind the same interface later (the reference defaults to embedded
+Postgres, docs/architecture.md:33).
+"""
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record, register_record
+
+__all__ = ["Database", "Record", "register_record"]

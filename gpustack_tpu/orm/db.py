@@ -1,0 +1,183 @@
+"""Async sqlite database: single writer thread, WAL, migrations.
+
+All sqlite calls run on one dedicated thread (sqlite serializes writers
+anyway); async callers await a future. This gives true async semantics to
+the aiohttp control plane without aiosqlite (absent from the image).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import queue
+import sqlite3
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class Database:
+    """One sqlite file (or ':memory:') + a writer thread + migrations."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._work: "queue.Queue[Optional[Tuple[Callable, asyncio.Future, asyncio.AbstractEventLoop]]]" = (
+            queue.Queue()
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="db-writer", daemon=True
+        )
+        self._conn: Optional[sqlite3.Connection] = None
+        self._started = threading.Event()
+        self._thread.start()
+        self._started.wait(10)
+
+    # ---- worker thread --------------------------------------------------
+
+    def _run(self) -> None:
+        self._conn = sqlite3.connect(self.path, check_same_thread=True)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._started.set()
+        while True:
+            item = self._work.get()
+            if item is None:
+                break
+            fn, fut, loop = item
+            try:
+                result = fn(self._conn)
+            except Exception as e:  # propagate to awaiting caller
+                loop.call_soon_threadsafe(self._set_exc, fut, e)
+            else:
+                loop.call_soon_threadsafe(self._set_result, fut, result)
+        self._conn.close()
+
+    @staticmethod
+    def _set_result(fut: asyncio.Future, result: Any) -> None:
+        if not fut.cancelled():
+            fut.set_result(result)
+
+    @staticmethod
+    def _set_exc(fut: asyncio.Future, exc: Exception) -> None:
+        if not fut.cancelled():
+            fut.set_exception(exc)
+
+    # ---- async API ------------------------------------------------------
+
+    async def run(self, fn: Callable[[sqlite3.Connection], Any]) -> Any:
+        """Run ``fn(conn)`` on the db thread; commit is the fn's concern."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._work.put((fn, fut, loop))
+        return await fut
+
+    async def execute(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> List[sqlite3.Row]:
+        def go(conn: sqlite3.Connection):
+            cur = conn.execute(sql, tuple(params))
+            rows = cur.fetchall()
+            conn.commit()
+            return rows
+
+        return await self.run(go)
+
+    def execute_sync(
+        self, sql: str, params: Iterable[Any] = ()
+    ) -> List[sqlite3.Row]:
+        """Blocking variant for startup/migration code (no loop running)."""
+        done = threading.Event()
+        box: List[Any] = [None, None]
+
+        def go(conn: sqlite3.Connection):
+            try:
+                cur = conn.execute(sql, tuple(params))
+                rows = cur.fetchall()
+                conn.commit()
+                box[0] = rows
+            except Exception as e:
+                box[1] = e
+            finally:
+                done.set()
+
+        # Bypass the futures machinery (no event loop required).
+        self._work.put((lambda conn: go(conn), _NullFuture(), _NullLoop()))
+        done.wait(30)
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def close(self) -> None:
+        self._work.put(None)
+        self._thread.join(timeout=10)
+
+
+class _NullFuture:
+    def cancelled(self) -> bool:
+        return True
+
+
+class _NullLoop:
+    def call_soon_threadsafe(self, *a, **k) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Migrations (alembic replacement: ordered, versioned, idempotent)
+# ---------------------------------------------------------------------------
+
+Migration = Tuple[int, str, Callable[[sqlite3.Connection], None]]
+_MIGRATIONS: List[Migration] = []
+
+
+def migration(version: int, description: str):
+    """Register a schema migration (runs once, in version order)."""
+
+    def deco(fn: Callable[[sqlite3.Connection], None]):
+        _MIGRATIONS.append((version, description, fn))
+        return fn
+
+    return deco
+
+
+def run_migrations(db: Database) -> int:
+    """Apply pending migrations synchronously (server startup, before the
+    event loop). Mirrors the reference's migrate-on-start (reference
+    server/server.py:346-369 runs alembic first)."""
+    db.execute_sync(
+        "CREATE TABLE IF NOT EXISTS schema_version ("
+        "version INTEGER PRIMARY KEY, description TEXT, applied_at TEXT)"
+    )
+    rows = db.execute_sync("SELECT version FROM schema_version")
+    applied = {r["version"] for r in rows}
+    count = 0
+    done = threading.Event()
+    err: List[Any] = [None]
+
+    pending = sorted(
+        (m for m in _MIGRATIONS if m[0] not in applied), key=lambda m: m[0]
+    )
+
+    def go(conn: sqlite3.Connection):
+        try:
+            for version, desc, fn in pending:
+                fn(conn)
+                conn.execute(
+                    "INSERT INTO schema_version VALUES (?, ?, datetime('now'))",
+                    (version, desc),
+                )
+                conn.commit()
+                logger.info("applied migration %d: %s", version, desc)
+        except Exception as e:
+            err[0] = e
+        finally:
+            done.set()
+
+    db._work.put((go, _NullFuture(), _NullLoop()))
+    done.wait(60)
+    if err[0] is not None:
+        raise err[0]
+    return len(pending) if not err[0] else count

@@ -1,0 +1,339 @@
+"""ActiveRecord base: typed pydantic records with CRUD + post-commit events.
+
+API parity with the reference mixin (reference
+gpustack/mixins/active_record.py:510-837): create/get/filter/update/delete,
+changed-field diffing, subscribe with heartbeats. Storage is a JSON document
+column plus extracted index columns (see orm/__init__ docstring).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+from typing import (
+    Any,
+    AsyncIterator,
+    ClassVar,
+    Dict,
+    List,
+    Optional,
+    Tuple,
+    Type,
+    TypeVar,
+)
+
+import pydantic
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.server.bus import Event, EventBus, EventType
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T", bound="Record")
+
+_REGISTRY: Dict[str, Type["Record"]] = {}
+
+
+def register_record(cls: Type[T]) -> Type[T]:
+    """Register a Record subclass (table + event kind)."""
+    _REGISTRY[cls.__kind__] = cls
+    return cls
+
+
+def registered_records() -> Dict[str, Type["Record"]]:
+    return dict(_REGISTRY)
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+class Record(pydantic.BaseModel):
+    """Base record. Subclasses set ``__kind__`` and optional ``__indexes__``
+    (field names extracted into SQL columns for indexed filtering)."""
+
+    model_config = pydantic.ConfigDict(validate_assignment=False)
+
+    __kind__: ClassVar[str] = ""
+    __indexes__: ClassVar[Tuple[str, ...]] = ()
+
+    id: int = 0
+    created_at: str = ""
+    updated_at: str = ""
+
+    # ---- binding --------------------------------------------------------
+
+    _db: ClassVar[Optional[Database]] = None
+    _bus: ClassVar[Optional[EventBus]] = None
+
+    @classmethod
+    def bind(cls, db: Database, bus: EventBus) -> None:
+        """Bind the shared database + bus (server startup / test setup)."""
+        Record._db = db
+        Record._bus = bus
+
+    @classmethod
+    def db(cls) -> Database:
+        assert Record._db is not None, "Record.bind() not called"
+        return Record._db
+
+    @classmethod
+    def bus(cls) -> EventBus:
+        assert Record._bus is not None, "Record.bind() not called"
+        return Record._bus
+
+    # ---- schema ---------------------------------------------------------
+
+    @classmethod
+    def _create_table_sql(cls) -> List[str]:
+        cols = ", ".join(
+            f"{f} TEXT" for f in cls.__indexes__
+        )
+        cols = (", " + cols) if cols else ""
+        stmts = [
+            f"CREATE TABLE IF NOT EXISTS {cls.__kind__} ("
+            f"id INTEGER PRIMARY KEY AUTOINCREMENT, data TEXT NOT NULL, "
+            f"created_at TEXT, updated_at TEXT{cols})"
+        ]
+        for f in cls.__indexes__:
+            stmts.append(
+                f"CREATE INDEX IF NOT EXISTS idx_{cls.__kind__}_{f} "
+                f"ON {cls.__kind__} ({f})"
+            )
+        return stmts
+
+    @classmethod
+    def create_all_tables(cls, db: Database) -> None:
+        for rec_cls in _REGISTRY.values():
+            for stmt in rec_cls._create_table_sql():
+                db.execute_sync(stmt)
+
+    # ---- serialization --------------------------------------------------
+
+    def _index_values(self) -> List[Any]:
+        vals = []
+        for f in self.__indexes__:
+            v = getattr(self, f)
+            if isinstance(v, (dict, list)):
+                v = json.dumps(v)
+            elif v is not None and not isinstance(v, (str, int, float)):
+                v = str(v)
+            vals.append(v)
+        return vals
+
+    @classmethod
+    def _from_row(cls: Type[T], row) -> T:
+        obj = cls.model_validate_json(row["data"])
+        obj.id = row["id"]
+        return obj
+
+    # ---- CRUD -----------------------------------------------------------
+
+    @classmethod
+    async def create(cls: Type[T], obj: T) -> T:
+        obj.created_at = obj.created_at or _now()
+        obj.updated_at = _now()
+        idx_cols = "".join(f", {f}" for f in cls.__indexes__)
+        idx_q = ", ?" * len(cls.__indexes__)
+        data = obj.model_dump_json(exclude={"id"})
+        params = [data, obj.created_at, obj.updated_at] + obj._index_values()
+
+        def go(conn):
+            cur = conn.execute(
+                f"INSERT INTO {cls.__kind__} "
+                f"(data, created_at, updated_at{idx_cols}) "
+                f"VALUES (?, ?, ?{idx_q})",
+                params,
+            )
+            conn.commit()
+            return cur.lastrowid
+
+        obj.id = await cls.db().run(go)
+        cls.bus().publish(
+            Event(
+                kind=cls.__kind__,
+                type=EventType.CREATED,
+                id=obj.id,
+                data=obj.model_dump(mode="json"),
+            )
+        )
+        return obj
+
+    @classmethod
+    async def get(cls: Type[T], id: int) -> Optional[T]:
+        rows = await cls.db().execute(
+            f"SELECT * FROM {cls.__kind__} WHERE id = ?", (id,)
+        )
+        return cls._from_row(rows[0]) if rows else None
+
+    @classmethod
+    async def filter(
+        cls: Type[T],
+        limit: Optional[int] = None,
+        offset: int = 0,
+        order_by: str = "id",
+        **conds: Any,
+    ) -> List[T]:
+        """Filter by equality conditions. Index fields filter in SQL; other
+        fields post-filter in Python."""
+        sql_conds = {
+            k: v for k, v in conds.items() if k in cls.__indexes__ or k == "id"
+        }
+        py_conds = {k: v for k, v in conds.items() if k not in sql_conds}
+        where = ""
+        params: List[Any] = []
+        if sql_conds:
+            parts = []
+            for k, v in sql_conds.items():
+                if isinstance(v, (dict, list)):
+                    v = json.dumps(v)
+                elif v is not None and not isinstance(v, (str, int, float)):
+                    v = str(v)
+                parts.append(f"{k} = ?")
+                params.append(v)
+            where = " WHERE " + " AND ".join(parts)
+        sql = f"SELECT * FROM {cls.__kind__}{where} ORDER BY {order_by}"
+        if limit is not None and not py_conds:
+            sql += f" LIMIT {int(limit)} OFFSET {int(offset)}"
+        rows = await cls.db().execute(sql, params)
+        out = [cls._from_row(r) for r in rows]
+        if py_conds:
+            def match(o: T) -> bool:
+                for k, v in py_conds.items():
+                    ov = getattr(o, k)
+                    ov = ov.value if hasattr(ov, "value") else ov
+                    vv = v.value if hasattr(v, "value") else v
+                    if ov != vv:
+                        return False
+                return True
+
+            out = [o for o in out if match(o)]
+            if limit is not None:
+                out = out[offset : offset + limit]
+        return out
+
+    @classmethod
+    async def all(cls: Type[T]) -> List[T]:
+        return await cls.filter()
+
+    @classmethod
+    async def first(cls: Type[T], **conds: Any) -> Optional[T]:
+        items = await cls.filter(limit=1, **conds)
+        return items[0] if items else None
+
+    @classmethod
+    async def count(cls: Type[T], **conds: Any) -> int:
+        return len(await cls.filter(**conds))
+
+    async def refresh(self: T) -> Optional[T]:
+        fresh = await type(self).get(self.id)
+        if fresh is not None:
+            for f in type(self).model_fields:
+                setattr(self, f, getattr(fresh, f))
+        return fresh
+
+    async def update(self: T, **fields: Any) -> T:
+        """Apply field updates, persist, publish UPDATED with a
+        changed-field diff (old, new) — reference active_record.py:46-74."""
+        changes: Dict[str, Any] = {}
+        for k, v in fields.items():
+            old = getattr(self, k)
+            if old != v:
+                old_j = old.value if hasattr(old, "value") else old
+                new_j = v.value if hasattr(v, "value") else v
+                changes[k] = (_jsonable(old_j), _jsonable(new_j))
+            setattr(self, k, v)
+        if not changes:
+            return self
+        await self.save(changes=changes)
+        return self
+
+    async def save(self: T, changes: Optional[Dict[str, Any]] = None) -> T:
+        self.updated_at = _now()
+        cls = type(self)
+        idx_sets = "".join(f", {f} = ?" for f in cls.__indexes__)
+        data = self.model_dump_json(exclude={"id"})
+        params = (
+            [data, self.updated_at] + self._index_values() + [self.id]
+        )
+
+        def go(conn):
+            cur = conn.execute(
+                f"UPDATE {cls.__kind__} SET data = ?, updated_at = ?"
+                f"{idx_sets} WHERE id = ?",
+                params,
+            )
+            conn.commit()
+            return cur.rowcount
+
+        count = await cls.db().run(go)
+        if count == 0:
+            raise KeyError(f"{cls.__kind__} id={self.id} does not exist")
+        cls.bus().publish(
+            Event(
+                kind=cls.__kind__,
+                type=EventType.UPDATED,
+                id=self.id,
+                data=self.model_dump(mode="json"),
+                changes=changes,
+            )
+        )
+        return self
+
+    async def delete(self) -> None:
+        cls = type(self)
+
+        def go(conn):
+            cur = conn.execute(
+                f"DELETE FROM {cls.__kind__} WHERE id = ?", (self.id,)
+            )
+            conn.commit()
+            return cur.rowcount
+
+        count = await cls.db().run(go)
+        if count:
+            cls.bus().publish(
+                Event(
+                    kind=cls.__kind__,
+                    type=EventType.DELETED,
+                    id=self.id,
+                    data=self.model_dump(mode="json"),
+                )
+            )
+
+    # ---- watch ----------------------------------------------------------
+
+    @classmethod
+    async def subscribe(
+        cls: Type[T],
+        send_initial: bool = True,
+        heartbeat: float = 15.0,
+    ) -> AsyncIterator[Event]:
+        """Async stream of events for this kind. With ``send_initial``,
+        existing rows are replayed as synthetic CREATED events first
+        (informer-style list+watch); a RESYNC event means the consumer
+        must re-list. HEARTBEAT every ``heartbeat`` seconds of silence
+        (reference active_record.py:789-837)."""
+        sub = cls.bus().subscribe(kinds={cls.__kind__})
+        try:
+            if send_initial:
+                for obj in await cls.all():
+                    yield Event(
+                        kind=cls.__kind__,
+                        type=EventType.CREATED,
+                        id=obj.id,
+                        data=obj.model_dump(mode="json"),
+                    )
+            while True:
+                yield await sub.get(timeout=heartbeat)
+        finally:
+            sub.close()
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)

@@ -1,0 +1,15 @@
+"""Placement policies: worker filters, candidate building, scorers
+(reference gpustack/policies re-designed for the TPU slice device model)."""
+
+from gpustack_tpu.policies.allocatable import worker_allocatable_chips
+from gpustack_tpu.policies.candidates import Candidate, build_candidates
+from gpustack_tpu.policies.filters import filter_workers
+from gpustack_tpu.policies.scorers import score_candidates
+
+__all__ = [
+    "Candidate",
+    "build_candidates",
+    "filter_workers",
+    "score_candidates",
+    "worker_allocatable_chips",
+]

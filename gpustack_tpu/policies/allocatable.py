@@ -1,0 +1,45 @@
+"""Allocatable accounting: free chips per worker = detected − claimed by
+placed instances (reference gpustack/policies/utils.py
+get_worker_allocatable_resource: total − reserved − Σ claims)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set
+
+from gpustack_tpu.schemas import ModelInstance, ModelInstanceState, Worker
+
+# States whose placements count against capacity.
+CLAIMING_STATES = {
+    ModelInstanceState.SCHEDULED,
+    ModelInstanceState.DOWNLOADING,
+    ModelInstanceState.STARTING,
+    ModelInstanceState.RUNNING,
+    ModelInstanceState.UNREACHABLE,   # the worker may come back; hold chips
+}
+
+
+def claimed_chip_indexes(
+    worker_id: int, instances: Iterable[ModelInstance]
+) -> Set[int]:
+    used: Set[int] = set()
+    for inst in instances:
+        if inst.state not in CLAIMING_STATES:
+            continue
+        if inst.worker_id == worker_id:
+            used.update(inst.chip_indexes)
+        for sub in inst.subordinate_workers:
+            if sub.worker_id == worker_id:
+                used.update(sub.chip_indexes)
+    return used
+
+
+def worker_allocatable_chips(
+    worker: Worker, instances: Iterable[ModelInstance]
+) -> List[int]:
+    """Free (usable, unclaimed) chip indexes on this worker, sorted."""
+    used = claimed_chip_indexes(worker.id, instances)
+    return sorted(
+        c.index
+        for c in worker.status.chips
+        if c.usable and c.index not in used
+    )

@@ -1,0 +1,120 @@
+"""Candidate construction: where can one replica's mesh land?
+
+TPU-native selector (replaces the reference's per-backend VRAM-fit
+selectors, gpustack/policies/candidate_selectors/): a replica needs
+``claim.chips`` chips. Candidates:
+
+1. single-worker: any READY worker with >= chips free (chips taken in
+   index order — contiguous on the host's ICI).
+2. multi-host: when no single worker fits and the model is distributable,
+   workers sharing an ``ici_domain`` (one TPU slice spanning hosts)
+   combine — leader + subordinate workers, each contributing whole hosts.
+   Only complete per-host chip sets are used: a multi-host mesh must tile
+   the slice (SURVEY.md §2.11 — "place a replica on a complete slice, not
+   an arbitrary GPU set").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from gpustack_tpu.policies.allocatable import worker_allocatable_chips
+from gpustack_tpu.schemas import (
+    ComputedResourceClaim,
+    Model,
+    ModelInstance,
+    SubordinateWorker,
+    Worker,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Candidate:
+    worker: Worker
+    chip_indexes: List[int]
+    claim: ComputedResourceClaim
+    subordinates: List[SubordinateWorker] = dataclasses.field(
+        default_factory=list
+    )
+    score: float = 0.0
+
+    @property
+    def multi_host(self) -> bool:
+        return bool(self.subordinates)
+
+
+def build_candidates(
+    model: Model,
+    claim: ComputedResourceClaim,
+    workers: List[Worker],
+    instances: List[ModelInstance],
+) -> List[Candidate]:
+    free: Dict[int, List[int]] = {
+        w.id: worker_allocatable_chips(w, instances) for w in workers
+    }
+    chips_needed = claim.chips
+
+    singles: List[Candidate] = []
+    for w in workers:
+        if len(free[w.id]) >= chips_needed:
+            singles.append(
+                Candidate(
+                    worker=w,
+                    chip_indexes=free[w.id][:chips_needed],
+                    claim=claim,
+                )
+            )
+    if singles:
+        return singles
+    if not model.distributable:
+        return []
+
+    # multi-host: group by ici_domain (one physical slice spanning hosts)
+    groups: Dict[str, List[Worker]] = {}
+    for w in workers:
+        sl = w.status.slice
+        if sl is not None and sl.ici_domain and sl.num_hosts > 1:
+            groups.setdefault(sl.ici_domain, []).append(w)
+
+    out: List[Candidate] = []
+    for domain, members in groups.items():
+        # complete-host constraint: a member participates only with ALL of
+        # its chips free (the jax coordinator owns whole hosts of a slice)
+        usable = [
+            w for w in members if len(free[w.id]) == w.total_chips > 0
+        ]
+        total = sum(w.total_chips for w in usable)
+        if total < chips_needed:
+            continue
+        usable.sort(key=lambda w: w.status.slice.host_index)
+        needed_hosts: List[Worker] = []
+        acc = 0
+        for w in usable:
+            needed_hosts.append(w)
+            acc += w.total_chips
+            if acc >= chips_needed:
+                break
+        if acc < chips_needed:
+            continue
+        leader, *others = needed_hosts
+        out.append(
+            Candidate(
+                worker=leader,
+                chip_indexes=free[leader.id],
+                claim=claim,
+                subordinates=[
+                    SubordinateWorker(
+                        worker_id=w.id,
+                        worker_name=w.name,
+                        chip_indexes=free[w.id],
+                        process_index=i + 1,
+                    )
+                    for i, w in enumerate(others)
+                ],
+            )
+        )
+    return out

@@ -1,0 +1,44 @@
+"""Worker filter chain (reference gpustack/policies/worker_filters/ —
+ClusterFilter, LabelMatchingFilter, StatusFilter chained per
+scheduler/scheduler.py:424-434)."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+from gpustack_tpu.schemas import Model, Worker, WorkerState
+
+logger = logging.getLogger(__name__)
+
+
+def filter_workers(
+    workers: List[Worker], model: Model
+) -> Tuple[List[Worker], List[str]]:
+    """Apply the filter chain; returns (survivors, reasons-for-drops)."""
+    reasons: List[str] = []
+    out: List[Worker] = []
+    for w in workers:
+        reason = _drop_reason(w, model)
+        if reason:
+            reasons.append(f"{w.name}: {reason}")
+        else:
+            out.append(w)
+    return out, reasons
+
+
+def _drop_reason(worker: Worker, model: Model) -> str:
+    # StatusFilter
+    if worker.state != WorkerState.READY:
+        return f"state is {worker.state.value}"
+    # ClusterFilter
+    if model.cluster_id and worker.cluster_id != model.cluster_id:
+        return "different cluster"
+    # LabelMatchingFilter (worker_selector)
+    for key, value in (model.worker_selector or {}).items():
+        if worker.labels.get(key) != value:
+            return f"label {key}={value!r} not matched"
+    # TPU presence
+    if worker.total_chips == 0:
+        return "no usable TPU chips"
+    return ""

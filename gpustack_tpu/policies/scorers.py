@@ -1,0 +1,78 @@
+"""Candidate scoring: spread vs binpack + model-file locality.
+
+Reference analogue: PlacementScorer with spread as the default strategy
+(gpustack/policies/scorers/placement_scorer.py:31-60; default at
+schemas/models.py:230) summed with ModelFileLocalityScorer via a score
+chain (scorers/score_chain.py)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Set
+
+from gpustack_tpu.policies.allocatable import CLAIMING_STATES
+from gpustack_tpu.policies.candidates import Candidate
+from gpustack_tpu.schemas import (
+    Model,
+    ModelFile,
+    ModelFileState,
+    ModelInstance,
+    PlacementStrategy,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def score_candidates(
+    candidates: List[Candidate],
+    model: Model,
+    instances: List[ModelInstance],
+    model_files: List[ModelFile],
+) -> List[Candidate]:
+    """Assign scores in place; higher is better."""
+    # chips in use per worker (for spread/binpack)
+    used: Dict[int, int] = {}
+    for inst in instances:
+        if inst.state not in CLAIMING_STATES:
+            continue
+        if inst.worker_id is not None:
+            used[inst.worker_id] = (
+                used.get(inst.worker_id, 0) + len(inst.chip_indexes)
+            )
+        for sub in inst.subordinate_workers:
+            used[sub.worker_id] = (
+                used.get(sub.worker_id, 0) + len(sub.chip_indexes)
+            )
+
+    # same-model replica counts per worker (anti-affinity under spread)
+    same_model: Dict[int, int] = {}
+    for inst in instances:
+        if inst.model_id == model.id and inst.worker_id is not None:
+            same_model[inst.worker_id] = same_model.get(inst.worker_id, 0) + 1
+
+    # workers that already cached this model's files
+    source = model.source_str()
+    cached_workers: Set[int] = {
+        f.worker_id
+        for f in model_files
+        if f.state == ModelFileState.READY and source in (
+            f.preset, f.local_path, f.huggingface_repo_id
+        )
+    }
+
+    for cand in candidates:
+        w = cand.worker
+        total = max(1, w.total_chips)
+        utilization = used.get(w.id, 0) / total
+        if model.placement_strategy == PlacementStrategy.BINPACK:
+            placement = utilization                      # fuller is better
+        else:
+            placement = 1.0 - utilization                # emptier is better
+        anti_affinity = -0.5 * same_model.get(w.id, 0)
+        locality = 0.3 if w.id in cached_workers else 0.0
+        multi_host_penalty = -0.2 if cand.multi_host else 0.0
+        cand.score = (
+            placement + anti_affinity + locality + multi_host_penalty
+        )
+    candidates.sort(key=lambda c: c.score, reverse=True)
+    return candidates

@@ -1,0 +1,9 @@
+"""HTTP route layer (aiohttp) — the reference's gpustack/routes re-designed.
+
+Surface parity (reference routes/routes.py:86-443):
+- ``/v2/*``   management CRUD + watch streams
+- ``/v1/*``   OpenAI-compatible inference proxy
+- ``/auth/*`` login/logout/me
+- probes: ``/healthz`` ``/readyz``
+- worker-facing: register, status, heartbeat
+"""

@@ -1,0 +1,220 @@
+"""Auth routes: login/logout/me, API key management, worker registration.
+
+Reference parity: routes/auth.py (login flows), routes/api_keys, and the
+worker registration handshake (cluster token → server-issued worker token,
+reference worker/worker_manager.py:83-135 client side).
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import uuid
+
+from aiohttp import web
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.routes.crud import json_error, require_admin
+from gpustack_tpu.schemas import ApiKey, Cluster, User, Worker, WorkerState
+
+logger = logging.getLogger(__name__)
+
+SESSION_COOKIE = "gpustack_tpu_session"
+
+
+def add_auth_routes(app: web.Application) -> None:
+    cfg = app["config"]
+
+    async def login(request: web.Request):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        username = body.get("username", "")
+        password = body.get("password", "")
+        user = await User.first(username=username)
+        if user is None or not auth_mod.verify_password(
+            password, user.password_hash
+        ):
+            return json_error(401, "invalid username or password")
+        token = auth_mod.issue_session_token(user, cfg.jwt_secret)
+        resp = web.json_response(
+            {
+                "token": token,
+                "user": {
+                    "id": user.id,
+                    "username": user.username,
+                    "is_admin": user.is_admin,
+                    "require_password_change": user.require_password_change,
+                },
+            }
+        )
+        resp.set_cookie(
+            SESSION_COOKIE, token, httponly=True, samesite="Lax"
+        )
+        return resp
+
+    async def logout(request: web.Request):
+        resp = web.json_response({"ok": True})
+        resp.del_cookie(SESSION_COOKIE)
+        return resp
+
+    async def me(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.user is None:
+            return json_error(401, "not authenticated")
+        u = principal.user
+        return web.json_response(
+            {"id": u.id, "username": u.username, "is_admin": u.is_admin}
+        )
+
+    async def change_password(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.user is None:
+            return json_error(401, "not authenticated")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        current = body.get("current_password", "")
+        new = body.get("new_password", "")
+        if len(new) < 6:
+            return json_error(400, "new password must be >= 6 chars")
+        user = principal.user
+        if not auth_mod.verify_password(current, user.password_hash):
+            return json_error(401, "current password incorrect")
+        await user.update(
+            password_hash=auth_mod.hash_password(new),
+            require_password_change=False,
+        )
+        return web.json_response({"ok": True})
+
+    # ---- API keys -------------------------------------------------------
+
+    async def create_api_key(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.user is None:
+            return json_error(401, "not authenticated")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        full, access, hashed = auth_mod.generate_api_key()
+        key = await ApiKey.create(
+            ApiKey(
+                name=body.get("name") or f"key-{access[:6]}",
+                user_id=principal.user.id,
+                access_key=access,
+                hashed_secret=hashed,
+                scopes=body.get("scopes") or ["management", "inference"],
+                expires_at=body.get("expires_at") or "",
+            )
+        )
+        data = key.model_dump(mode="json")
+        data.pop("hashed_secret", None)
+        # the full secret is returned exactly once
+        data["value"] = full
+        return web.json_response(data, status=201)
+
+    # ---- worker registration -------------------------------------------
+
+    async def register_worker(request: web.Request):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        token = body.get("registration_token", "")
+        cluster = await Cluster.first()
+        if cluster is None:
+            return json_error(500, "no cluster configured")
+        if not hmac.compare_digest(
+            auth_mod.hash_secret(token), cluster.registration_token_hash
+        ):
+            return json_error(401, "invalid registration token")
+        name = body.get("name") or f"worker-{uuid.uuid4().hex[:8]}"
+        worker_uuid = body.get("worker_uuid") or uuid.uuid4().hex
+        existing = await Worker.first(name=name)
+        if existing is not None and existing.worker_uuid != worker_uuid:
+            return json_error(409, f"worker name {name!r} already taken")
+        if existing is None:
+            existing = await Worker.create(
+                Worker(
+                    name=name,
+                    cluster_id=cluster.id,
+                    worker_uuid=worker_uuid,
+                    ip=body.get("ip", request.remote or ""),
+                    port=int(body.get("port", 10151)),
+                    state=WorkerState.NOT_READY,
+                )
+            )
+        else:
+            await existing.update(
+                ip=body.get("ip", existing.ip),
+                port=int(body.get("port", existing.port)),
+            )
+        worker_token = auth_mod.issue_worker_token(
+            existing.id, cfg.jwt_secret
+        )
+        return web.json_response(
+            {"worker_id": existing.id, "token": worker_token, "name": name}
+        )
+
+    app.router.add_post("/auth/login", login)
+    app.router.add_post("/auth/logout", logout)
+    app.router.add_get("/auth/me", me)
+    app.router.add_post("/auth/change-password", change_password)
+    app.router.add_post("/v2/api-keys", create_api_key)
+    app.router.add_post("/v2/workers/register", register_worker)
+
+
+def add_worker_facing_routes(app: web.Application) -> None:
+    """Routes the worker agent calls with its worker token."""
+
+    def worker_principal(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.kind not in ("worker", "system"):
+            return None
+        return principal
+
+    async def post_status(request: web.Request):
+        principal = worker_principal(request)
+        if principal is None:
+            return json_error(403, "worker token required")
+        worker_id = int(request.match_info["id"])
+        if principal.kind == "worker" and principal.worker_id != worker_id:
+            return json_error(403, "token does not match worker")
+        worker = await Worker.get(worker_id)
+        if worker is None:
+            return json_error(404, "worker not found")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        from gpustack_tpu.schemas.workers import WorkerStatus
+
+        status = WorkerStatus.model_validate(body.get("status") or {})
+        await worker.update(
+            status=status,
+            state=WorkerState.READY,
+            state_message="",
+            heartbeat_at=auth_mod.time_iso_now(),
+        )
+        return web.json_response({"ok": True})
+
+    async def heartbeat(request: web.Request):
+        principal = worker_principal(request)
+        if principal is None:
+            return json_error(403, "worker token required")
+        worker_id = int(request.match_info["id"])
+        worker = await Worker.get(worker_id)
+        if worker is None:
+            return json_error(404, "worker not found")
+        updates = {"heartbeat_at": auth_mod.time_iso_now()}
+        if worker.state == WorkerState.UNREACHABLE:
+            updates["state"] = WorkerState.READY
+        await worker.update(**updates)
+        return web.json_response({"ok": True})
+
+    app.router.add_post("/v2/workers/{id:\\d+}/status", post_status)
+    app.router.add_post("/v2/workers/{id:\\d+}/heartbeat", heartbeat)

@@ -1,0 +1,170 @@
+"""Generic CRUD + watch routes for any Record type.
+
+One factory replaces the reference's per-resource route modules where those
+are mechanical (list/get/create/update/delete + HTTP watch). Resources with
+extra behavior (API keys, workers, models) layer custom handlers on top.
+
+Watch protocol: ``GET /v2/<kind>?watch=true`` streams NDJSON events
+(CREATED/UPDATED/DELETED/HEARTBEAT/RESYNC) — the reference's ActiveRecord
+``streaming()`` equivalent (mixins/active_record.py:840).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Optional, Type
+
+import pydantic
+from aiohttp import web
+
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.server.bus import EventType
+
+logger = logging.getLogger(__name__)
+
+
+def json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def require_admin(request: web.Request) -> Optional[web.Response]:
+    principal = request.get("principal")
+    if principal is None or not principal.is_admin:
+        return json_error(403, "admin privileges required")
+    return None
+
+
+def add_crud_routes(
+    app: web.Application,
+    cls: Type[Record],
+    path: str,
+    *,
+    create_hook: Optional[Callable] = None,
+    update_hook: Optional[Callable] = None,
+    delete_hook: Optional[Callable] = None,
+    readonly: bool = False,
+    admin_write: bool = True,
+) -> None:
+    base = f"/v2/{path}"
+
+    async def list_or_watch(request: web.Request):
+        if request.query.get("watch") in ("true", "1"):
+            return await watch(request)
+        filters = {}
+        for key, value in request.query.items():
+            if key in ("limit", "offset", "watch"):
+                continue
+            if key in cls.model_fields:
+                filters[key] = value
+        try:
+            limit = int(request.query.get("limit", 100))
+            offset = int(request.query.get("offset", 0))
+        except ValueError:
+            return json_error(400, "limit/offset must be integers")
+        items = await cls.filter(limit=limit, offset=offset, **filters)
+        total = await cls.count(**filters)
+        return web.json_response(
+            {
+                "items": [i.model_dump(mode="json") for i in items],
+                "pagination": {
+                    "total": total,
+                    "limit": limit,
+                    "offset": offset,
+                },
+            }
+        )
+
+    async def watch(request: web.Request):
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"}
+        )
+        await resp.prepare(request)
+        agen = cls.subscribe(send_initial=True, heartbeat=15.0)
+        try:
+            async for event in agen:
+                await resp.write(
+                    (json.dumps(event.to_wire()) + "\n").encode()
+                )
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            await agen.aclose()
+        return resp
+
+    async def get_one(request: web.Request):
+        obj = await cls.get(int(request.match_info["id"]))
+        if obj is None:
+            return json_error(404, f"{path} not found")
+        return web.json_response(obj.model_dump(mode="json"))
+
+    async def create(request: web.Request):
+        if admin_write and (err := require_admin(request)):
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        try:
+            obj = cls.model_validate(body)
+        except pydantic.ValidationError as e:
+            return json_error(400, str(e))
+        obj.id = 0
+        if create_hook:
+            err = await create_hook(request, obj, body)
+            if err is not None:
+                return err
+        await cls.create(obj)
+        return web.json_response(obj.model_dump(mode="json"), status=201)
+
+    async def update(request: web.Request):
+        if admin_write and (err := require_admin(request)):
+            return err
+        obj = await cls.get(int(request.match_info["id"]))
+        if obj is None:
+            return json_error(404, f"{path} not found")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        fields = {
+            k: v for k, v in body.items()
+            if k in cls.model_fields and k not in ("id", "created_at")
+        }
+        # validate merged doc before persisting
+        merged = obj.model_dump()
+        merged.update(fields)
+        try:
+            validated = cls.model_validate(merged)
+        except pydantic.ValidationError as e:
+            return json_error(400, str(e))
+        if update_hook:
+            err = await update_hook(request, obj, fields)
+            if err is not None:
+                return err
+        await obj.update(
+            **{k: getattr(validated, k) for k in fields}
+        )
+        return web.json_response(obj.model_dump(mode="json"))
+
+    async def delete(request: web.Request):
+        if admin_write and (err := require_admin(request)):
+            return err
+        obj = await cls.get(int(request.match_info["id"]))
+        if obj is None:
+            return json_error(404, f"{path} not found")
+        if delete_hook:
+            err = await delete_hook(request, obj)
+            if err is not None:
+                return err
+        await obj.delete()
+        return web.json_response({"deleted": obj.id})
+
+    app.router.add_get(base, list_or_watch)
+    app.router.add_get(base + "/{id:\\d+}", get_one)
+    if not readonly:
+        app.router.add_post(base, create)
+        app.router.add_put(base + "/{id:\\d+}", update)
+        app.router.add_patch(base + "/{id:\\d+}", update)
+        app.router.add_delete(base + "/{id:\\d+}", delete)

@@ -1,0 +1,207 @@
+"""OpenAI-compatible inference proxy: route → target → instance → stream.
+
+Reference call path parity (gpustack/routes/openai.py:185-313):
+auth → model route resolution (weighted targets) → pick a RUNNING instance
+(round-robin) → relay the request, streaming SSE chunks through unbuffered
+— with token usage extracted from the response and recorded
+(api/middlewares.py:226-307 analogue, in-process)."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import random
+from typing import Dict, List, Optional, Tuple
+
+import aiohttp
+from aiohttp import web
+
+from gpustack_tpu.routes.crud import json_error
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    ModelRoute,
+)
+from gpustack_tpu.schemas.usage import ModelUsage
+
+logger = logging.getLogger(__name__)
+
+_rr_counters: Dict[int, itertools.count] = {}
+
+
+async def _resolve_model(name: str) -> Optional[Model]:
+    """Route name → weighted target model, else direct model name."""
+    route = await ModelRoute.first(name=name)
+    if route is not None and route.enabled and route.targets:
+        targets = route.targets
+        total = sum(max(t.weight, 0) for t in targets) or len(targets)
+        pick = random.uniform(0, total)
+        acc = 0.0
+        chosen = targets[-1]
+        for t in targets:
+            acc += max(t.weight, 0) or total / len(targets)
+            if pick <= acc:
+                chosen = t
+                break
+        return await Model.get(chosen.model_id)
+    return await Model.first(name=name)
+
+
+async def _pick_instance(model: Model) -> Optional[ModelInstance]:
+    instances = await ModelInstance.filter(
+        model_id=model.id, state=ModelInstanceState.RUNNING
+    )
+    if not instances:
+        return None
+    counter = _rr_counters.setdefault(model.id, itertools.count())
+    return instances[next(counter) % len(instances)]
+
+
+def _extract_usage(payload: dict) -> Tuple[int, int]:
+    usage = payload.get("usage") or {}
+    return (
+        int(usage.get("prompt_tokens") or 0),
+        int(usage.get("completion_tokens") or 0),
+    )
+
+
+async def _record_usage(
+    request: web.Request,
+    model: Model,
+    route_name: str,
+    operation: str,
+    prompt_tokens: int,
+    completion_tokens: int,
+    stream: bool,
+) -> None:
+    principal = request.get("principal")
+    user_id = principal.user.id if principal and principal.user else 0
+    try:
+        await ModelUsage.create(
+            ModelUsage(
+                user_id=user_id,
+                model_id=model.id,
+                route_name=route_name,
+                operation=operation,
+                prompt_tokens=prompt_tokens,
+                completion_tokens=completion_tokens,
+                total_tokens=prompt_tokens + completion_tokens,
+                stream=stream,
+            )
+        )
+    except Exception:
+        logger.exception("failed to record usage")
+
+
+def add_openai_routes(app: web.Application) -> None:
+    async def list_models(request: web.Request):
+        routes = await ModelRoute.filter()
+        names = [r.name for r in routes if r.enabled]
+        if not names:
+            names = [m.name for m in await Model.filter()]
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": n,
+                        "object": "model",
+                        "owned_by": "gpustack_tpu",
+                    }
+                    for n in sorted(set(names))
+                ],
+            }
+        )
+
+    async def proxy(request: web.Request):
+        operation = request.match_info["op"]
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        name = body.get("model")
+        if not name:
+            return json_error(400, "missing 'model'")
+        model = await _resolve_model(str(name))
+        if model is None:
+            return json_error(404, f"model {name!r} not found")
+        instance = await _pick_instance(model)
+        if instance is None:
+            return json_error(
+                503, f"no running instances for model {name!r}"
+            )
+        target = (
+            f"http://{instance.worker_ip or '127.0.0.1'}:{instance.port}"
+            f"/v1/{operation}"
+        )
+        stream = bool(body.get("stream"))
+        timeout = aiohttp.ClientTimeout(total=600)
+        session: aiohttp.ClientSession = app["proxy_session"]
+        try:
+            upstream = await session.post(
+                target, json=body, timeout=timeout
+            )
+        except aiohttp.ClientError as e:
+            return json_error(502, f"instance unreachable: {e}")
+
+        if not stream:
+            payload_bytes = await upstream.read()
+            try:
+                payload = json.loads(payload_bytes)
+                pt, ct = _extract_usage(payload)
+                if pt or ct:
+                    await _record_usage(
+                        request, model, str(name), operation, pt, ct, False
+                    )
+            except json.JSONDecodeError:
+                pass
+            return web.Response(
+                body=payload_bytes,
+                status=upstream.status,
+                content_type=upstream.content_type,
+            )
+
+        # SSE relay: forward chunks unbuffered; sniff usage from data lines.
+        resp = web.StreamResponse(
+            status=upstream.status,
+            headers={
+                "Content-Type": upstream.headers.get(
+                    "Content-Type", "text/event-stream"
+                ),
+                "Cache-Control": "no-cache",
+            },
+        )
+        await resp.prepare(request)
+        usage_tokens: List[int] = [0, 0]
+        buffer = b""
+        try:
+            async for chunk in upstream.content.iter_any():
+                await resp.write(chunk)
+                buffer += chunk
+                while b"\n" in buffer:
+                    line, buffer = buffer.split(b"\n", 1)
+                    if line.startswith(b"data: ") and line != b"data: [DONE]":
+                        try:
+                            payload = json.loads(line[6:])
+                            pt, ct = _extract_usage(payload)
+                            if pt or ct:
+                                usage_tokens = [pt, ct]
+                        except json.JSONDecodeError:
+                            pass
+        except (ConnectionResetError, aiohttp.ClientError):
+            logger.info("client or upstream dropped during stream relay")
+        finally:
+            upstream.release()
+        if usage_tokens[0] or usage_tokens[1]:
+            await _record_usage(
+                request, model, str(name), operation,
+                usage_tokens[0], usage_tokens[1], True,
+            )
+        return resp
+
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post(
+        "/v1/{op:(chat/completions|completions|embeddings)}", proxy
+    )

@@ -1,0 +1,10 @@
+"""Slice-aware scheduler (reference gpustack/scheduler re-designed for TPU).
+
+The schedulable unit is chips on an ICI slice; a placement is a mesh plan
+(SURVEY.md §2.10-2.11), not a GPU index set + engine flags.
+"""
+
+from gpustack_tpu.scheduler.calculator import evaluate_model
+from gpustack_tpu.scheduler.scheduler import Scheduler
+
+__all__ = ["Scheduler", "evaluate_model"]

@@ -1,0 +1,163 @@
+"""HBM resource estimation: model spec → chips + mesh plan + bytes.
+
+Replaces the reference's gguf-parser pipeline (reference
+gpustack/scheduler/calculator.py shells out to a Go binary for layer-wise
+VRAM estimates): on TPU the claim is weights + KV cache + activation
+headroom against HBM per chip, and the output is a mesh plan whose product
+is chips-per-replica.
+
+Weight/KV math comes from ModelConfig (exact parameter counts, attention-
+type-aware KV sizing — the reference's selector parses the same
+hyperparameters, base_candidate_selector.py:56-165). When a local
+checkpoint directory is present, the native ``model-meta`` tool (C++,
+native/) supplies exact safetensors tensor sizes instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+from typing import Optional
+
+from gpustack_tpu.models.config import (
+    ModelConfig,
+    PRESETS,
+    load_hf_config,
+)
+from gpustack_tpu.parallel.mesh import MeshPlan, plan_mesh
+from gpustack_tpu.schemas import ComputedResourceClaim, Model
+
+logger = logging.getLogger(__name__)
+
+# Fraction of per-chip HBM the engine may plan against (the rest covers
+# activations, XLA scratch, and fragmentation) — analogue of vLLM's
+# gpu-memory-utilization handled by the reference selector.
+HBM_UTILIZATION = 0.9
+
+
+class EvaluationError(Exception):
+    """Model cannot be evaluated (bad source, unknown architecture...)."""
+
+
+@dataclasses.dataclass
+class ModelEvaluation:
+    config: ModelConfig
+    weight_bytes: int
+    kv_cache_bytes: int
+    overhead_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return self.weight_bytes + self.kv_cache_bytes + self.overhead_bytes
+
+
+def resolve_model_config(model: Model) -> ModelConfig:
+    if model.preset:
+        if model.preset not in PRESETS:
+            raise EvaluationError(f"unknown preset {model.preset!r}")
+        return PRESETS[model.preset]
+    if model.local_path:
+        try:
+            return load_hf_config(model.local_path)
+        except (OSError, KeyError, ValueError) as e:
+            raise EvaluationError(
+                f"cannot read config from {model.local_path}: {e}"
+            )
+    if model.huggingface_repo_id:
+        # Zero-egress evaluation: the config must already be cached locally
+        # by a worker's model-file download; server-side we estimate once a
+        # ModelFile resolves. Until then, reject with a clear message.
+        raise EvaluationError(
+            "huggingface source requires the model file to be cached "
+            "locally before evaluation (no config available yet)"
+        )
+    raise EvaluationError("model has no source (preset/local_path/hf)")
+
+
+def evaluate_model(model: Model) -> ModelEvaluation:
+    cfg = resolve_model_config(model)
+    weight_bits = 8 if model.quantization == "int8" else 16
+    weight_bytes = cfg.weight_bytes(weight_bits)
+    kv_bytes = (
+        cfg.kv_cache_bytes_per_token(16) * model.max_seq_len * model.max_slots
+    )
+    # activation + runtime overhead: prefill attention scratch dominates;
+    # scale with seq len, floor at 256 MiB
+    overhead = max(
+        256 * 2**20,
+        int(2 * model.max_seq_len * cfg.hidden_size * 4 * 8),
+    )
+    return ModelEvaluation(
+        config=cfg,
+        weight_bytes=weight_bytes,
+        kv_cache_bytes=kv_bytes,
+        overhead_bytes=overhead,
+    )
+
+
+def chips_for_claim(
+    evaluation: ModelEvaluation,
+    hbm_per_chip: int,
+    max_chips: int,
+    long_context: bool = False,
+    explicit_plan: str = "",
+    explicit_chips: int = 0,
+) -> Optional[ComputedResourceClaim]:
+    """Pick chips-per-replica (power of two) and a mesh plan that fits.
+
+    Returns None when the model cannot fit on ``max_chips`` chips.
+    Mirrors the reference's candidate ladder (manual → 1 GPU → multi-GPU →
+    multi-worker, vllm_resource_fit_selector.py:315-341) but in chip space:
+    the smallest power-of-two chip count whose per-chip share fits HBM.
+    """
+    usable = int(hbm_per_chip * HBM_UTILIZATION)
+    if usable <= 0:
+        return None
+    cfg = evaluation.config
+
+    if explicit_plan:
+        plan = MeshPlan.parse(explicit_plan)
+        chips = plan.chips
+        per_chip = evaluation.total_bytes // chips
+        if chips <= max_chips and per_chip <= usable:
+            return ComputedResourceClaim(
+                chips=chips,
+                mesh_plan=str(plan),
+                hbm_bytes_per_chip=per_chip + _per_chip_overhead(evaluation, chips),
+                weight_bytes=evaluation.weight_bytes,
+                kv_cache_bytes=evaluation.kv_cache_bytes,
+            )
+        return None
+
+    start = explicit_chips or 1
+    chips = max(1, start)
+    while chips <= max_chips:
+        # weights and KV shard across chips; overhead replicates
+        per_chip = (
+            (evaluation.weight_bytes + evaluation.kv_cache_bytes) // chips
+            + evaluation.overhead_bytes
+        )
+        if per_chip <= usable:
+            plan = plan_mesh(
+                chips,
+                num_kv_heads=cfg.num_kv_heads,
+                num_experts=cfg.num_experts,
+                long_context=long_context,
+            )
+            return ComputedResourceClaim(
+                chips=chips,
+                mesh_plan=str(plan),
+                hbm_bytes_per_chip=per_chip,
+                weight_bytes=evaluation.weight_bytes,
+                kv_cache_bytes=evaluation.kv_cache_bytes,
+            )
+        if explicit_chips:
+            return None  # user pinned the count; it doesn't fit
+        chips *= 2
+    return None
+
+
+def _per_chip_overhead(evaluation: ModelEvaluation, chips: int) -> int:
+    return evaluation.overhead_bytes

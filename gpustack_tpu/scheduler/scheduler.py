@@ -1,0 +1,234 @@
+"""Scheduler loop: PENDING instances → evaluated → placed → SCHEDULED.
+
+Reference flow parity (gpustack/scheduler/scheduler.py:100-405): event-
+driven on instance creation + periodic full scan; per instance:
+ANALYZING (resource evaluation) → candidate build (filters → selector) →
+scoring → placement written onto the instance. Stuck ANALYZING/SCHEDULED
+instances are retried after a timeout (reference scheduler.py:261-298).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+from typing import Optional
+
+from gpustack_tpu.policies import (
+    build_candidates,
+    filter_workers,
+    score_candidates,
+)
+from gpustack_tpu.scheduler.calculator import (
+    EvaluationError,
+    chips_for_claim,
+    evaluate_model,
+)
+from gpustack_tpu.schemas import (
+    Model,
+    ModelFile,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+)
+from gpustack_tpu.server.bus import EventType
+
+logger = logging.getLogger(__name__)
+
+RESCHEDULE_STUCK_AFTER = 180.0  # reference scheduler.py:261-298 (3 min)
+
+
+class Scheduler:
+    def __init__(self, scan_interval: float = 30.0):
+        self.scan_interval = scan_interval
+        self._task: Optional[asyncio.Task] = None
+        self._scan_task: Optional[asyncio.Task] = None
+        self._queue: asyncio.Queue = asyncio.Queue()
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._watch(), name="sched-watch")
+        self._scan_task = asyncio.create_task(
+            self._periodic_scan(), name="sched-scan"
+        )
+
+    def stop(self) -> None:
+        for t in (self._task, self._scan_task):
+            if t:
+                t.cancel()
+
+    async def _watch(self) -> None:
+        while True:
+            agen = ModelInstance.subscribe(send_initial=True, heartbeat=30.0)
+            try:
+                async for event in agen:
+                    if event.type == EventType.RESYNC:
+                        break
+                    if event.type not in (
+                        EventType.CREATED, EventType.UPDATED
+                    ):
+                        continue
+                    data = event.data or {}
+                    if data.get("state") != ModelInstanceState.PENDING.value:
+                        continue
+                    # An ANALYZING→PENDING flip is our own "unschedulable"
+                    # backoff — retried by the periodic scan, not the watch
+                    # (otherwise this would spin hot).
+                    changes = event.changes or {}
+                    if changes.get("state", (None,))[0] == (
+                        ModelInstanceState.ANALYZING.value
+                    ):
+                        continue
+                    await self._schedule_one(event.id)
+            except asyncio.CancelledError:
+                await agen.aclose()
+                raise
+            finally:
+                await agen.aclose()
+
+    async def _periodic_scan(self) -> None:
+        while True:
+            await asyncio.sleep(self.scan_interval)
+            try:
+                await self._scan()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("scheduler scan failed")
+
+    async def _scan(self) -> None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        for inst in await ModelInstance.all():
+            if inst.state == ModelInstanceState.PENDING:
+                await self._schedule_one(inst.id)
+            elif inst.state in (
+                ModelInstanceState.ANALYZING,
+                ModelInstanceState.SCHEDULED,
+            ):
+                # stuck? (worker never picked it up / we crashed mid-flight)
+                try:
+                    updated = datetime.datetime.fromisoformat(
+                        inst.updated_at
+                    )
+                except ValueError:
+                    continue
+                if (now - updated).total_seconds() > RESCHEDULE_STUCK_AFTER:
+                    logger.warning(
+                        "instance %s stuck in %s; rescheduling",
+                        inst.name, inst.state.value,
+                    )
+                    await inst.update(
+                        state=ModelInstanceState.PENDING,
+                        worker_id=None,
+                        chip_indexes=[],
+                        subordinate_workers=[],
+                        state_message="rescheduled after timeout",
+                    )
+
+    # ------------------------------------------------------------------
+
+    async def _schedule_one(self, instance_id: int) -> None:
+        inst = await ModelInstance.get(instance_id)
+        if inst is None or inst.state != ModelInstanceState.PENDING:
+            return
+        model = await Model.get(inst.model_id)
+        if model is None:
+            await inst.update(
+                state=ModelInstanceState.ERROR,
+                state_message="model no longer exists",
+            )
+            return
+        await inst.update(state=ModelInstanceState.ANALYZING)
+
+        try:
+            evaluation = evaluate_model(model)
+        except EvaluationError as e:
+            await inst.update(
+                state=ModelInstanceState.ERROR, state_message=str(e)
+            )
+            return
+
+        workers = await Worker.all()
+        eligible, drop_reasons = filter_workers(workers, model)
+        if not eligible:
+            await self._unschedulable(
+                inst, f"no eligible workers ({'; '.join(drop_reasons[:4])})"
+            )
+            return
+
+        # chip budget: largest single worker, or whole slices when
+        # distributable
+        max_single = max(w.total_chips for w in eligible)
+        max_chips = max_single
+        if model.distributable:
+            domains = {}
+            for w in eligible:
+                sl = w.status.slice
+                if sl and sl.ici_domain:
+                    domains[sl.ici_domain] = (
+                        domains.get(sl.ici_domain, 0) + w.total_chips
+                    )
+            if domains:
+                max_chips = max(max_chips, max(domains.values()))
+
+        hbm = min(
+            (w.hbm_per_chip for w in eligible if w.hbm_per_chip), default=0
+        )
+        claim = chips_for_claim(
+            evaluation,
+            hbm_per_chip=hbm,
+            max_chips=max_chips,
+            long_context=model.max_seq_len >= 16384,
+            explicit_plan=model.mesh_plan,
+            explicit_chips=model.chips_per_replica,
+        )
+        if claim is None:
+            gib = evaluation.total_bytes / 2**30
+            await self._unschedulable(
+                inst,
+                f"model needs ~{gib:.1f} GiB; no fit within {max_chips} "
+                f"chips of {hbm / 2**30:.0f} GiB HBM",
+            )
+            return
+
+        instances = await ModelInstance.all()
+        candidates = build_candidates(model, claim, eligible, instances)
+        if not candidates:
+            await self._unschedulable(
+                inst,
+                f"needs {claim.chips} chips; no worker/slice has enough free",
+            )
+            return
+        model_files = await ModelFile.all()
+        best = score_candidates(candidates, model, instances, model_files)[0]
+
+        # multi-host: fix the jax.distributed rendezvous point on the
+        # leader (replaces the reference's Ray/TCP-store port plumbing,
+        # serve_manager.py:1456-1508)
+        coordinator = ""
+        if best.subordinates:
+            coordinator = (
+                f"{best.worker.ip or '127.0.0.1'}:{41000 + inst.id % 1000}"
+            )
+        await inst.update(
+            state=ModelInstanceState.SCHEDULED,
+            worker_id=best.worker.id,
+            worker_name=best.worker.name,
+            worker_ip=best.worker.ip,
+            chip_indexes=best.chip_indexes,
+            computed_resource_claim=claim,
+            subordinate_workers=best.subordinates,
+            coordinator_address=coordinator,
+            state_message="",
+        )
+        logger.info(
+            "scheduled %s onto %s chips=%s mesh=%s%s",
+            inst.name, best.worker.name, best.chip_indexes, claim.mesh_plan,
+            f" +{len(best.subordinates)} subordinate hosts"
+            if best.subordinates else "",
+        )
+
+    async def _unschedulable(self, inst: ModelInstance, msg: str) -> None:
+        logger.warning("instance %s unschedulable: %s", inst.name, msg)
+        await inst.update(
+            state=ModelInstanceState.PENDING, state_message=msg
+        )

@@ -1,0 +1,55 @@
+"""Typed state schemas (the reference's gpustack/schemas re-designed).
+
+Key divergence from the reference's GPU device model: the schedulable unit
+is a **TPU slice** — chips wired into an ICI mesh — not a set of
+independent GPUs (SURVEY.md §2.11). Workers report chip type, HBM per chip
+and slice topology; placements carry a mesh plan (dp/sp/ep/tp) instead of
+engine flag strings.
+"""
+
+from gpustack_tpu.schemas.clusters import Cluster, ClusterState
+from gpustack_tpu.schemas.workers import (
+    SliceTopology,
+    TPUChip,
+    Worker,
+    WorkerState,
+    WorkerStatus,
+)
+from gpustack_tpu.schemas.models import (
+    ComputedResourceClaim,
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    PlacementStrategy,
+    SubordinateWorker,
+)
+from gpustack_tpu.schemas.model_files import ModelFile, ModelFileState
+from gpustack_tpu.schemas.model_routes import ModelRoute, ModelRouteTarget
+from gpustack_tpu.schemas.users import ApiKey, User
+from gpustack_tpu.schemas.benchmarks import Benchmark, BenchmarkState
+from gpustack_tpu.schemas.inference_backends import InferenceBackend
+
+__all__ = [
+    "Cluster",
+    "ClusterState",
+    "TPUChip",
+    "SliceTopology",
+    "Worker",
+    "WorkerState",
+    "WorkerStatus",
+    "Model",
+    "ModelInstance",
+    "ModelInstanceState",
+    "ComputedResourceClaim",
+    "SubordinateWorker",
+    "PlacementStrategy",
+    "ModelFile",
+    "ModelFileState",
+    "ModelRoute",
+    "ModelRouteTarget",
+    "User",
+    "ApiKey",
+    "Benchmark",
+    "BenchmarkState",
+    "InferenceBackend",
+]

@@ -1,0 +1,52 @@
+"""Benchmark records (reference gpustack/schemas/benchmark.py:192-242 —
+metric fields match its recorded schema: RPS, TTFT, TPOT, ITL, tok/s)."""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+import pydantic
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class BenchmarkState(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    ERROR = "error"
+
+
+class BenchmarkMetrics(pydantic.BaseModel):
+    requests_per_second: float = 0.0
+    request_latency_ms: float = 0.0
+    ttft_ms_p50: float = 0.0
+    ttft_ms_mean: float = 0.0
+    tpot_ms_mean: float = 0.0
+    itl_ms_mean: float = 0.0
+    input_tok_per_s: float = 0.0
+    output_tok_per_s: float = 0.0
+    total_tok_per_s: float = 0.0
+    concurrency_mean: float = 0.0
+    error_count: int = 0
+
+
+@register_record
+class Benchmark(Record):
+    __kind__ = "benchmark"
+    __indexes__ = ("model_id", "state", "worker_id")
+
+    name: str = ""
+    model_id: int = 0
+    model_instance_id: int = 0
+    worker_id: int = 0
+    profile: str = "throughput"       # profiles_config analogue
+    input_len: int = 1024
+    output_len: int = 128
+    num_requests: int = 100
+    rate: float = 0.0                 # 0 = unlimited
+    state: BenchmarkState = BenchmarkState.PENDING
+    state_message: str = ""
+    metrics: Optional[BenchmarkMetrics] = None
+    raw_report: Dict = {}

@@ -1,0 +1,25 @@
+"""Cluster records (reference gpustack/schemas/clusters — a cluster groups
+workers and owns a registration token)."""
+
+from __future__ import annotations
+
+import enum
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class ClusterState(str, enum.Enum):
+    READY = "ready"
+    PROVISIONING = "provisioning"
+
+
+@register_record
+class Cluster(Record):
+    __kind__ = "cluster"
+    __indexes__ = ("name",)
+
+    name: str = ""
+    description: str = ""
+    state: ClusterState = ClusterState.READY
+    # hash of the registration token workers present when joining
+    registration_token_hash: str = ""

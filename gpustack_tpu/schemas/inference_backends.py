@@ -1,0 +1,37 @@
+"""InferenceBackend catalog records (reference
+gpustack/schemas/inference_backend.py + the built-in/community backend
+catalog reconciled by InferenceBackendController,
+server/controllers.py:1481-1634).
+
+On TPU the catalog maps backend name+version → launch template for a local
+engine process (command argv with placeholders) instead of a container
+image per CUDA arch."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import pydantic
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class BackendVersionConfig(pydantic.BaseModel):
+    version: str = "latest"
+    # argv template; {model_dir} {port} {mesh_plan} {max_seq_len}
+    # {max_slots} {served_name} placeholders are substituted at launch
+    command: List[str] = []
+    env: Dict[str, str] = {}
+    health_path: str = "/healthz"
+
+
+@register_record
+class InferenceBackend(Record):
+    __kind__ = "inference_backend"
+    __indexes__ = ("name",)
+
+    name: str = ""
+    description: str = ""
+    builtin: bool = False
+    versions: List[BackendVersionConfig] = []
+    default_version: str = "latest"

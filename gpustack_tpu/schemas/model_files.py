@@ -1,0 +1,34 @@
+"""ModelFile records: downloadable weight artifacts cached on workers
+(reference gpustack/schemas/model_files.py role)."""
+
+from __future__ import annotations
+
+import enum
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class ModelFileState(str, enum.Enum):
+    PENDING = "pending"
+    DOWNLOADING = "downloading"
+    READY = "ready"
+    ERROR = "error"
+
+
+@register_record
+class ModelFile(Record):
+    __kind__ = "model_file"
+    __indexes__ = ("worker_id", "state", "source_key")
+
+    # identity of the artifact: "hf:<repo>" or "local:<path>" or
+    # "preset:<name>"
+    source_key: str = ""
+    huggingface_repo_id: str = ""
+    local_path: str = ""
+    preset: str = ""
+    worker_id: int = 0
+    state: ModelFileState = ModelFileState.PENDING
+    state_message: str = ""
+    size_bytes: int = 0
+    downloaded_bytes: int = 0
+    resolved_path: str = ""           # where the worker stored it

@@ -1,0 +1,31 @@
+"""ModelRoute records: stable serving names → weighted targets.
+
+Reference: ModelRoute/ModelRouteTarget tables + weighted resolution
+(gpustack/schemas/model_routes.py:362,253; services.py:613
+``resolve_route_targets``). Targets embed inline here (document store)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import pydantic
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class ModelRouteTarget(pydantic.BaseModel):
+    model_id: int = 0
+    model_name: str = ""
+    weight: int = 100
+    # fallback ordering: lower = preferred; equal weights round-robin
+    priority: int = 0
+
+
+@register_record
+class ModelRoute(Record):
+    __kind__ = "model_route"
+    __indexes__ = ("name",)
+
+    name: str = ""                  # the public model name clients use
+    targets: List[ModelRouteTarget] = []
+    enabled: bool = True

@@ -1,0 +1,138 @@
+"""Model + ModelInstance records, with the instance state machine.
+
+State machine (mirrors reference gpustack/schemas/models.py:384-399):
+
+    PENDING → ANALYZING → SCHEDULED → DOWNLOADING → STARTING → RUNNING
+        ↘ ERROR (from any)      RUNNING → UNREACHABLE (worker lost)
+
+Placement on TPU is a **mesh plan** (dp/sp/ep/tp axis sizes whose product
+is chips-per-replica) rather than engine flags — the scheduler computes it,
+the worker passes it to the engine (SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+import pydantic
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class PlacementStrategy(str, enum.Enum):
+    SPREAD = "spread"      # reference default (schemas/models.py:230)
+    BINPACK = "binpack"
+
+
+class ModelInstanceState(str, enum.Enum):
+    PENDING = "pending"
+    ANALYZING = "analyzing"
+    SCHEDULED = "scheduled"
+    DOWNLOADING = "downloading"
+    STARTING = "starting"
+    RUNNING = "running"
+    ERROR = "error"
+    UNREACHABLE = "unreachable"
+
+
+@register_record
+class Model(Record):
+    __kind__ = "model"
+    __indexes__ = ("name", "cluster_id")
+
+    name: str = ""
+    description: str = ""
+    cluster_id: int = 0
+    # source: exactly one of preset (built-in config, hermetic), local_path,
+    # or huggingface repo id
+    preset: str = ""
+    local_path: str = ""
+    huggingface_repo_id: str = ""
+    replicas: int = 1
+    backend: str = "tpu-native"       # built-in engine | "custom"
+    backend_version: str = ""
+    backend_parameters: List[str] = []
+    env: Dict[str, str] = {}
+    categories: List[str] = []
+    placement_strategy: PlacementStrategy = PlacementStrategy.SPREAD
+    worker_selector: Dict[str, str] = {}
+    # parallelism: explicit mesh plan ("dp1xsp1xep1xtp4") or auto when empty
+    mesh_plan: str = ""
+    chips_per_replica: int = 0        # 0 = auto from HBM fit
+    max_seq_len: int = 2048
+    max_slots: int = 8                # continuous-batch width per replica
+    quantization: str = ""            # "" | "int8"
+    restart_on_error: bool = True
+    distributable: bool = True        # allow multi-host placement
+
+    def source_str(self) -> str:
+        return (
+            self.preset
+            or self.local_path
+            or self.huggingface_repo_id
+            or "?"
+        )
+
+
+class ComputedResourceClaim(pydantic.BaseModel):
+    """Scheduler output: what one replica consumes (reference analogue:
+    computed_resource_claim on ModelInstance)."""
+
+    chips: int = 1
+    mesh_plan: str = ""
+    hbm_bytes_per_chip: int = 0
+    weight_bytes: int = 0
+    kv_cache_bytes: int = 0
+
+
+class SubordinateWorker(pydantic.BaseModel):
+    """Follower host of a multi-host replica (reference
+    subordinate_workers, serve_manager.py:1306-1320). The leader runs the
+    JAX distributed coordinator; followers join via coordinator_address."""
+
+    worker_id: int = 0
+    worker_name: str = ""
+    chip_indexes: List[int] = []
+    process_index: int = 1
+
+
+@register_record
+class ModelInstance(Record):
+    __kind__ = "model_instance"
+    __indexes__ = ("model_id", "worker_id", "state", "name")
+
+    name: str = ""
+    model_id: int = 0
+    model_name: str = ""
+    cluster_id: int = 0
+    state: ModelInstanceState = ModelInstanceState.PENDING
+    state_message: str = ""
+    worker_id: Optional[int] = None
+    worker_name: str = ""
+    worker_ip: str = ""
+    chip_indexes: List[int] = []
+    port: int = 0
+    computed_resource_claim: Optional[ComputedResourceClaim] = None
+    subordinate_workers: List[SubordinateWorker] = []
+    coordinator_address: str = ""     # leader host:port for multi-host jax
+    restarts: int = 0
+    last_error: str = ""
+    pid: int = 0
+
+    def is_placed(self) -> bool:
+        return self.worker_id is not None
+
+    def placement_summary(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.worker_id,
+            "chips": self.chip_indexes,
+            "mesh": (
+                self.computed_resource_claim.mesh_plan
+                if self.computed_resource_claim
+                else ""
+            ),
+            "subordinates": [
+                s.worker_id for s in self.subordinate_workers
+            ],
+        }

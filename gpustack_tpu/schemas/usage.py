@@ -1,0 +1,22 @@
+"""Per-request token usage records (reference: ModelUsage rows written by
+ModelUsageMiddleware, gpustack/api/middlewares.py:226-307 + metered usage
+tables)."""
+
+from __future__ import annotations
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+@register_record
+class ModelUsage(Record):
+    __kind__ = "model_usage"
+    __indexes__ = ("user_id", "model_id", "route_name")
+
+    user_id: int = 0
+    model_id: int = 0
+    route_name: str = ""
+    operation: str = ""               # chat | completion | embedding
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+    stream: bool = False

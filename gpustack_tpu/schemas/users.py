@@ -1,0 +1,37 @@
+"""User + ApiKey records (reference gpustack/schemas/users.py,
+api_keys; API key format mirrors the reference's
+``<prefix>_<access>_<secret>`` split-credential scheme,
+gpustack/security.py API_KEY_PREFIX)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from gpustack_tpu.orm.record import Record, register_record
+
+API_KEY_PREFIX = "gtpu"
+
+
+@register_record
+class User(Record):
+    __kind__ = "user"
+    __indexes__ = ("username",)
+
+    username: str = ""
+    full_name: str = ""
+    password_hash: str = ""
+    is_admin: bool = False
+    require_password_change: bool = False
+
+
+@register_record
+class ApiKey(Record):
+    __kind__ = "api_key"
+    __indexes__ = ("user_id", "access_key")
+
+    name: str = ""
+    user_id: int = 0
+    access_key: str = ""
+    hashed_secret: str = ""
+    expires_at: str = ""              # "" = never
+    scopes: List[str] = ["management", "inference"]

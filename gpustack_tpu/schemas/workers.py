@@ -1,0 +1,100 @@
+"""Worker records with the TPU device model.
+
+The reference's Worker carries per-GPU VRAM/util entries (reference
+gpustack/schemas/workers.py:465); ours carries **chips + slice topology**:
+what matters for placement on TPU is whether a replica's mesh tiles onto the
+slice's ICI fabric, not per-device free-memory alone (SURVEY.md §2.11).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+import pydantic
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class WorkerState(str, enum.Enum):
+    NOT_READY = "not_ready"
+    READY = "ready"
+    UNREACHABLE = "unreachable"
+    DELETING = "deleting"
+
+
+class TPUChip(pydantic.BaseModel):
+    """One TPU chip on the worker host."""
+
+    index: int = 0
+    chip_type: str = "v5e"           # v4 | v5e | v5p | v6e
+    hbm_bytes: int = 16 * 2**30
+    hbm_used_bytes: int = 0
+    usable: bool = True
+
+
+class SliceTopology(pydantic.BaseModel):
+    """The ICI slice this worker belongs to.
+
+    ``topology`` is the physical mesh shape ("2x4", "4x4", "2x2x2"...);
+    multi-host slices share an ``ici_domain`` id, and each host knows its
+    ``host_index`` — the scheduler uses this to require complete-slice
+    placements for multi-host replicas (the TPU analogue of the
+    reference's multi-worker subordinate placement,
+    vllm_resource_fit_selector.py:315-341).
+    """
+
+    topology: str = ""               # e.g. "2x4" for v5e-8
+    chips_per_host: int = 0
+    num_hosts: int = 1
+    host_index: int = 0
+    ici_domain: str = ""             # slice identity shared across hosts
+
+    @property
+    def total_chips(self) -> int:
+        if not self.topology:
+            return self.chips_per_host
+        n = 1
+        for part in self.topology.split("x"):
+            n *= int(part)
+        return n
+
+
+class WorkerStatus(pydantic.BaseModel):
+    cpu_count: int = 0
+    memory_total_bytes: int = 0
+    memory_used_bytes: int = 0
+    chips: List[TPUChip] = []
+    slice: Optional[SliceTopology] = None
+    libtpu_version: str = ""
+    jax_version: str = ""
+    os: str = ""
+    kernel: str = ""
+    arch: str = ""
+
+
+@register_record
+class Worker(Record):
+    __kind__ = "worker"
+    __indexes__ = ("name", "cluster_id", "state")
+
+    name: str = ""
+    hostname: str = ""
+    ip: str = ""
+    port: int = 10150
+    cluster_id: int = 0
+    labels: Dict[str, str] = {}
+    state: WorkerState = WorkerState.NOT_READY
+    state_message: str = ""
+    status: WorkerStatus = WorkerStatus()
+    heartbeat_at: str = ""
+    worker_uuid: str = ""
+
+    @property
+    def total_chips(self) -> int:
+        return len([c for c in self.status.chips if c.usable])
+
+    @property
+    def hbm_per_chip(self) -> int:
+        chips = self.status.chips
+        return chips[0].hbm_bytes if chips else 0

@@ -1,0 +1,3 @@
+"""Server-side control plane: event bus, services, controllers, scheduler
+wiring, HTTP app — the reference's ``gpustack/server`` layer re-designed
+around an asyncio core (SURVEY.md §2.3)."""

@@ -1,0 +1,100 @@
+"""aiohttp application assembly (reference gpustack/server/app.py:26
+create_app with its middleware stack + router mounting)."""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import aiohttp
+from aiohttp import web
+
+from gpustack_tpu.api.middlewares import auth_middleware, timing_middleware
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.routes.auth_routes import (
+    add_auth_routes,
+    add_worker_facing_routes,
+)
+from gpustack_tpu.routes.crud import add_crud_routes, json_error
+from gpustack_tpu.routes.openai_proxy import add_openai_routes
+from gpustack_tpu.schemas import (
+    Benchmark,
+    Cluster,
+    InferenceBackend,
+    Model,
+    ModelFile,
+    ModelInstance,
+    ModelRoute,
+    User,
+    Worker,
+)
+from gpustack_tpu.schemas.usage import ModelUsage
+
+logger = logging.getLogger(__name__)
+
+
+def create_app(cfg: Config) -> web.Application:
+    app = web.Application(
+        middlewares=[auth_middleware, timing_middleware],
+        client_max_size=64 * 2**20,
+    )
+    app["config"] = cfg
+
+    async def healthz(request):
+        return web.json_response({"status": "ok"})
+
+    async def readyz(request):
+        return web.json_response({"status": "ready"})
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
+
+    add_auth_routes(app)
+    add_worker_facing_routes(app)
+    add_openai_routes(app)
+
+    # ---- management CRUD ------------------------------------------------
+
+    async def model_create_hook(request, obj: Model, body):
+        if not obj.name:
+            return json_error(400, "model name is required")
+        if await Model.first(name=obj.name):
+            return json_error(409, f"model {obj.name!r} already exists")
+        if not obj.cluster_id:
+            cluster = await Cluster.first()
+            if cluster:
+                obj.cluster_id = cluster.id
+        return None
+
+    async def user_create_hook(request, obj: User, body):
+        password = (body or {}).get("password", "")
+        if not obj.username:
+            return json_error(400, "username is required")
+        if await User.first(username=obj.username):
+            return json_error(409, "username taken")
+        if password:
+            obj.password_hash = auth_mod.hash_password(password)
+        return None
+
+    add_crud_routes(app, Model, "models", create_hook=model_create_hook)
+    add_crud_routes(app, ModelInstance, "model-instances", admin_write=False)
+    add_crud_routes(app, Worker, "workers")
+    add_crud_routes(app, Cluster, "clusters")
+    add_crud_routes(app, ModelRoute, "model-routes")
+    add_crud_routes(app, ModelFile, "model-files", admin_write=False)
+    add_crud_routes(app, User, "users", create_hook=user_create_hook)
+    add_crud_routes(app, Benchmark, "benchmarks")
+    add_crud_routes(app, InferenceBackend, "inference-backends")
+    add_crud_routes(app, ModelUsage, "model-usage", readonly=True)
+
+    # shared client session for the OpenAI proxy
+    async def on_startup(app: web.Application):
+        app["proxy_session"] = aiohttp.ClientSession()
+
+    async def on_cleanup(app: web.Application):
+        await app["proxy_session"].close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app

@@ -1,0 +1,180 @@
+"""In-process event bus: per-subscriber bounded queues with coalescing.
+
+Semantics carried over from the reference bus (reference
+gpustack/server/bus.py:53-199): per-subscriber bounded queue, UPDATED
+events coalesce by (kind, id) while queued, delivery order preserved.
+
+One deliberate divergence: the reference applies *blocking* backpressure to
+publishers when a subscriber's queue fills (reference bus.py:130-138 — a
+known bug-history hotspot). Here a slow subscriber instead overflows onto a
+RESYNC marker: its queue is cleared and it receives one RESYNC event,
+telling it to re-list from the DB (k8s watch-bookmark style). Publishers
+never block, and correctness folds into the re-list path every controller
+needs anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import Any, AsyncIterator, Dict, Optional, Set, Tuple
+
+
+class EventType(str, enum.Enum):
+    CREATED = "CREATED"
+    UPDATED = "UPDATED"
+    DELETED = "DELETED"
+    HEARTBEAT = "HEARTBEAT"
+    RESYNC = "RESYNC"
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str                       # record kind, e.g. "model_instance"
+    type: EventType
+    id: int = 0
+    data: Optional[Dict[str, Any]] = None
+    changes: Optional[Dict[str, Any]] = None   # field -> (old, new)
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "type": self.type.value,
+            "id": self.id,
+            "data": self.data,
+            "changes": self.changes,
+            "ts": self.ts,
+        }
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "Event":
+        return Event(
+            kind=d["kind"],
+            type=EventType(d["type"]),
+            id=d.get("id", 0),
+            data=d.get("data"),
+            changes=d.get("changes"),
+            ts=d.get("ts", 0.0),
+        )
+
+
+class Subscriber:
+    """Bounded event queue with UPDATED-coalescing and overflow→RESYNC."""
+
+    def __init__(
+        self, bus: "EventBus", kinds: Optional[Set[str]], max_size: int
+    ):
+        self._bus = bus
+        self.kinds = kinds
+        self.max_size = max_size
+        self._queue: deque = deque()
+        self._pending_updates: Dict[Tuple[str, int], Event] = {}
+        self._overflowed = False
+        self._waiter: Optional[asyncio.Future] = None
+        self.delivered = 0
+        self.coalesced = 0
+        self.resyncs = 0
+
+    # called by the bus (event-loop thread)
+    def _offer(self, event: Event) -> None:
+        if self.kinds is not None and event.kind not in self.kinds:
+            return
+        if event.type == EventType.UPDATED:
+            key = (event.kind, event.id)
+            pending = self._pending_updates.get(key)
+            if pending is not None:
+                # Coalesce in place: newest data, merged change keys,
+                # original queue position.
+                if pending.changes and event.changes:
+                    merged = dict(pending.changes)
+                    for f, (old, _new) in merged.items():
+                        if event.changes and f in event.changes:
+                            event.changes[f] = (old, event.changes[f][1])
+                    merged.update(event.changes or {})
+                    event.changes = merged
+                pending.data = event.data
+                pending.changes = event.changes
+                pending.ts = event.ts
+                self.coalesced += 1
+                return
+        if len(self._queue) >= self.max_size:
+            # Slow subscriber: drop everything, force a re-list.
+            self._queue.clear()
+            self._pending_updates.clear()
+            self._overflowed = True
+            self.resyncs += 1
+            self._wake()
+            return
+        self._queue.append(event)
+        if event.type == EventType.UPDATED:
+            self._pending_updates[(event.kind, event.id)] = event
+        self._wake()
+
+    def _wake(self) -> None:
+        if self._waiter is not None and not self._waiter.done():
+            self._waiter.set_result(None)
+
+    async def get(self, timeout: Optional[float] = None) -> Event:
+        """Next event; HEARTBEAT on timeout; RESYNC after overflow."""
+        while True:
+            if self._overflowed:
+                self._overflowed = False
+                return Event(kind="*", type=EventType.RESYNC)
+            if self._queue:
+                event = self._queue.popleft()
+                if event.type == EventType.UPDATED:
+                    self._pending_updates.pop(
+                        (event.kind, event.id), None
+                    )
+                self.delivered += 1
+                return event
+            self._waiter = asyncio.get_running_loop().create_future()
+            try:
+                await asyncio.wait_for(
+                    self._waiter, timeout=timeout
+                )
+            except asyncio.TimeoutError:
+                return Event(kind="*", type=EventType.HEARTBEAT)
+            finally:
+                self._waiter = None
+
+    async def __aiter__(self) -> AsyncIterator[Event]:
+        while True:
+            yield await self.get()
+
+    def close(self) -> None:
+        self._bus._subscribers.discard(self)
+
+
+class EventBus:
+    """Publish/subscribe hub. ``publish`` is sync and must run on the event
+    loop thread (DB layer publishes post-commit from the loop)."""
+
+    def __init__(self, default_queue_size: int = 1024):
+        self._subscribers: Set[Subscriber] = set()
+        self.default_queue_size = default_queue_size
+        self.published: Dict[Tuple[str, str], int] = {}
+
+    def subscribe(
+        self,
+        kinds: Optional[Set[str]] = None,
+        max_size: Optional[int] = None,
+    ) -> Subscriber:
+        sub = Subscriber(self, kinds, max_size or self.default_queue_size)
+        self._subscribers.add(sub)
+        return sub
+
+    def publish(self, event: Event) -> None:
+        key = (event.kind, event.type.value)
+        self.published[key] = self.published.get(key, 0) + 1
+        for sub in list(self._subscribers):
+            sub._offer(event)
+
+    def publish_threadsafe(
+        self, loop: asyncio.AbstractEventLoop, event: Event
+    ) -> None:
+        loop.call_soon_threadsafe(self.publish, event)

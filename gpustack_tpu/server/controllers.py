@@ -1,0 +1,220 @@
+"""Reconcile-on-event controllers (reference gpustack/server/controllers.py).
+
+Each controller consumes a watch stream (list+watch with RESYNC re-list —
+see server/bus.py) and converges actual state toward spec:
+
+- ModelController:    Model spec → N ModelInstances + a ModelRoute
+  (reference controllers.py:300-359 sync_replicas + route notify)
+- WorkerController:   lost workers → their instances UNREACHABLE
+  (reference controllers.py:1347)
+- WorkerSyncer:       heartbeat staleness → worker UNREACHABLE
+  (reference server/worker_syncer.py:15)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import logging
+from typing import Optional
+
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    ModelRoute,
+    ModelRouteTarget,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.bus import Event, EventType
+
+logger = logging.getLogger(__name__)
+
+
+class Controller:
+    """Base: consume a Record watch stream; re-list on RESYNC."""
+
+    kind = ""
+    record_cls = None
+
+    def __init__(self) -> None:
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(
+            self.run(), name=type(self).__name__
+        )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def run(self) -> None:
+        while True:
+            agen = self.record_cls.subscribe(
+                send_initial=True, heartbeat=30.0
+            )
+            try:
+                async for event in agen:
+                    if event.type == EventType.RESYNC:
+                        break  # restart generator → fresh list
+                    if event.type == EventType.HEARTBEAT:
+                        continue
+                    try:
+                        await self.handle(event)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        logger.exception(
+                            "%s failed handling %s %s",
+                            type(self).__name__, event.type, event.id,
+                        )
+            except asyncio.CancelledError:
+                await agen.aclose()
+                raise
+            finally:
+                await agen.aclose()
+
+    async def handle(self, event: Event) -> None:
+        raise NotImplementedError
+
+
+class ModelController(Controller):
+    record_cls = Model
+
+    async def handle(self, event: Event) -> None:
+        if event.type == EventType.DELETED:
+            for inst in await ModelInstance.filter(model_id=event.id):
+                await inst.delete()
+            route = await ModelRoute.first(name=event.data["name"])
+            if route is not None and any(
+                t.model_id == event.id for t in route.targets
+            ):
+                await route.delete()
+            return
+        model = await Model.get(event.id)
+        if model is None:
+            return
+        await self._sync_replicas(model)
+        await self._ensure_route(model)
+
+    async def _sync_replicas(self, model: Model) -> None:
+        instances = await ModelInstance.filter(model_id=model.id)
+        want = max(0, model.replicas)
+        if len(instances) < want:
+            used_names = {i.name for i in instances}
+            idx = 0
+            while len(instances) < want:
+                name = f"{model.name}-{idx}"
+                idx += 1
+                if name in used_names:
+                    continue
+                inst = await ModelInstance.create(
+                    ModelInstance(
+                        name=name,
+                        model_id=model.id,
+                        model_name=model.name,
+                        cluster_id=model.cluster_id,
+                        state=ModelInstanceState.PENDING,
+                    )
+                )
+                instances.append(inst)
+                logger.info("created instance %s", name)
+        elif len(instances) > want:
+            # retire non-running first, then newest
+            order = {
+                ModelInstanceState.RUNNING: 1,
+            }
+            doomed = sorted(
+                instances,
+                key=lambda i: (order.get(i.state, 0), -i.id),
+            )[: len(instances) - want]
+            for inst in doomed:
+                logger.info("retiring instance %s", inst.name)
+                await inst.delete()
+
+    async def _ensure_route(self, model: Model) -> None:
+        route = await ModelRoute.first(name=model.name)
+        target = ModelRouteTarget(
+            model_id=model.id, model_name=model.name, weight=100
+        )
+        if route is None:
+            await ModelRoute.create(
+                ModelRoute(name=model.name, targets=[target])
+            )
+        elif not any(t.model_id == model.id for t in route.targets):
+            await route.update(targets=route.targets + [target])
+
+
+class WorkerController(Controller):
+    record_cls = Worker
+
+    async def handle(self, event: Event) -> None:
+        if event.type == EventType.DELETED:
+            for inst in await ModelInstance.filter(worker_id=event.id):
+                await inst.delete()
+            return
+        if event.type != EventType.UPDATED or not event.changes:
+            return
+        state_change = event.changes.get("state")
+        if not state_change:
+            return
+        _, new = state_change
+        if new == WorkerState.UNREACHABLE.value:
+            for inst in await ModelInstance.filter(worker_id=event.id):
+                if inst.state == ModelInstanceState.RUNNING:
+                    await inst.update(
+                        state=ModelInstanceState.UNREACHABLE,
+                        state_message="worker unreachable",
+                    )
+        elif new == WorkerState.READY.value:
+            # instances recover via the worker's own state sync; nothing to
+            # do server-side (the worker re-reports actual health).
+            pass
+
+
+class WorkerSyncer:
+    """Flip workers to UNREACHABLE when heartbeats go stale."""
+
+    def __init__(self, stale_after: float = 45.0, interval: float = 15.0):
+        self.stale_after = stale_after
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run(), name="WorkerSyncer")
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("worker sync failed")
+            await asyncio.sleep(self.interval)
+
+    async def sync_once(self) -> None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        for worker in await Worker.filter(state=WorkerState.READY):
+            if not worker.heartbeat_at:
+                continue
+            try:
+                last = datetime.datetime.fromisoformat(worker.heartbeat_at)
+            except ValueError:
+                continue
+            age = (now - last).total_seconds()
+            if age > self.stale_after:
+                logger.warning(
+                    "worker %s heartbeat stale (%.0fs); marking unreachable",
+                    worker.name, age,
+                )
+                await worker.update(
+                    state=WorkerState.UNREACHABLE,
+                    state_message=f"no heartbeat for {age:.0f}s",
+                )

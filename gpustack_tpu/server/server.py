@@ -1,0 +1,170 @@
+"""Server bootstrap (reference gpustack/server/server.py:254 Server.start):
+migrations → data init (admin user, default cluster, backend catalog) →
+app → leader tasks (controllers, scheduler, syncer) → HTTP site →
+optional embedded worker.
+
+The embedded worker runs as an asyncio task in-process talking to
+localhost over HTTP — same contract as a remote worker (the reference
+spawns a multiprocessing.Process instead, cmd/start.py:736-755; our engine
+processes are the true process boundary)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+from typing import List, Optional
+
+from aiohttp import web
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database, run_migrations
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.scheduler.scheduler import Scheduler
+from gpustack_tpu.schemas import Cluster, InferenceBackend, User
+from gpustack_tpu.schemas.inference_backends import BackendVersionConfig
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.controllers import (
+    ModelController,
+    WorkerController,
+    WorkerSyncer,
+)
+
+logger = logging.getLogger(__name__)
+
+
+BUILTIN_BACKEND = InferenceBackend(
+    name="tpu-native",
+    description="Built-in JAX/XLA serving engine (gpustack_tpu.engine)",
+    builtin=True,
+    versions=[
+        BackendVersionConfig(
+            version="latest",
+            command=[
+                "{python}", "-m", "gpustack_tpu.engine.api_server",
+                "--port", "{port}",
+                "--served-name", "{served_name}",
+                "--max-seq-len", "{max_seq_len}",
+                "--max-slots", "{max_slots}",
+            ],
+            health_path="/healthz",
+        )
+    ],
+)
+
+
+class Server:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.db: Optional[Database] = None
+        self.bus = EventBus()
+        self._tasks: List = []
+        self._runner: Optional[web.AppRunner] = None
+        self._stop = asyncio.Event()
+        self.worker_agent = None
+
+    async def start(self) -> None:
+        cfg = self.cfg
+        self.db = Database(cfg.database_path)
+        run_migrations(self.db)
+        Record.bind(self.db, self.bus)
+        Record.create_all_tables(self.db)
+        await self._init_data()
+
+        app = create_app(cfg)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, cfg.host, cfg.port)
+
+        # leader-only tasks (LocalCoordinator: single server is always
+        # leader; distributed coordinators slot in here — reference
+        # server/server.py:1256-1339)
+        self.controllers = [ModelController(), WorkerController()]
+        for c in self.controllers:
+            c.start()
+        self.scheduler = Scheduler()
+        self.scheduler.start()
+        self.syncer = WorkerSyncer(
+            stale_after=cfg.heartbeat_interval * 4.5,
+            interval=cfg.heartbeat_interval,
+        )
+        self.syncer.start()
+
+        await site.start()
+        logger.info("server listening on %s:%d", cfg.host, cfg.port)
+
+        if not cfg.disable_worker:
+            from gpustack_tpu.worker.worker import WorkerAgent
+
+            worker_cfg = cfg.model_copy()
+            worker_cfg.server_url = f"http://127.0.0.1:{cfg.port}"
+            self.worker_agent = WorkerAgent(worker_cfg)
+            self._tasks.append(
+                asyncio.create_task(
+                    self.worker_agent.start(), name="embedded-worker"
+                )
+            )
+
+    async def run_forever(self) -> None:
+        await self.start()
+        await self._stop.wait()
+
+    async def stop(self) -> None:
+        if self.worker_agent:
+            await self.worker_agent.stop()
+        for c in getattr(self, "controllers", []):
+            c.stop()
+        if hasattr(self, "scheduler"):
+            self.scheduler.stop()
+        if hasattr(self, "syncer"):
+            self.syncer.stop()
+        for t in self._tasks:
+            t.cancel()
+        if self._runner:
+            await self._runner.cleanup()
+        if self.db:
+            self.db.close()
+        self._stop.set()
+
+    # ------------------------------------------------------------------
+
+    async def _init_data(self) -> None:
+        """Admin user, default cluster, builtin backend catalog (reference
+        server/server.py:714-1141 _init_data)."""
+        cfg = self.cfg
+        admin = await User.first(username="admin")
+        if admin is None:
+            password = cfg.bootstrap_password or secrets.token_urlsafe(12)
+            await User.create(
+                User(
+                    username="admin",
+                    is_admin=True,
+                    password_hash=auth_mod.hash_password(password),
+                    require_password_change=not cfg.bootstrap_password,
+                )
+            )
+            if not cfg.bootstrap_password:
+                logger.warning("generated admin password: %s", password)
+
+        cluster = await Cluster.first()
+        if cluster is None:
+            await Cluster.create(
+                Cluster(
+                    name="default",
+                    registration_token_hash=auth_mod.hash_secret(
+                        cfg.registration_token
+                    ),
+                )
+            )
+        else:
+            # keep the persisted token authoritative across restarts
+            expected = auth_mod.hash_secret(cfg.registration_token)
+            if cluster.registration_token_hash != expected:
+                await cluster.update(registration_token_hash=expected)
+
+        backend = await InferenceBackend.first(name="tpu-native")
+        if backend is None:
+            b = BUILTIN_BACKEND.model_copy(deep=True)
+            await InferenceBackend.create(b)

@@ -1,0 +1,2 @@
+"""Per-node worker agent (reference gpustack/worker): registration,
+status/heartbeat, and the serve manager that runs engine processes."""

@@ -1,0 +1,142 @@
+"""Engine backends: turn a scheduled instance into a launchable command.
+
+Reference analogue: worker/backends/* subclassing InferenceServer
+(base.py:150) — image/env/args resolution per engine. On TPU the launch
+unit is a local process (the engine owns the chips via libtpu), so a
+backend resolves an **argv + env**, not a container spec:
+
+- ``tpu-native``: the in-repo engine (gpustack_tpu.engine.api_server) with
+  mesh plan / quantization / context args derived from the placement.
+- ``custom``: any command template from the InferenceBackend catalog
+  (reference worker/backends/custom.py analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from gpustack_tpu.schemas import Model, ModelInstance
+from gpustack_tpu.schemas.inference_backends import (
+    BackendVersionConfig,
+    InferenceBackend,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def build_command(
+    model: Model,
+    instance: ModelInstance,
+    port: int,
+    backend: Optional[InferenceBackend],
+    force_platform: str = "",
+    process_index: int = 0,
+    chip_indexes: Optional[List[int]] = None,
+) -> Tuple[List[str], Dict[str, str]]:
+    """Resolve (argv, extra_env) for this instance.
+
+    ``process_index``/``chip_indexes`` select the leader (0, instance
+    chips) or a subordinate host's follower process of a multi-host
+    replica.
+    """
+    if model.backend in ("", "tpu-native"):
+        return _tpu_native_command(
+            model, instance, port, force_platform, process_index,
+            chip_indexes,
+        )
+    if backend is None:
+        raise ValueError(f"unknown backend {model.backend!r}")
+    version = model.backend_version or backend.default_version
+    vcfg = next(
+        (v for v in backend.versions if v.version == version), None
+    ) or (backend.versions[0] if backend.versions else None)
+    if vcfg is None:
+        raise ValueError(
+            f"backend {model.backend!r} has no launch configuration"
+        )
+    return _render(vcfg, model, instance, port)
+
+
+def _tpu_native_command(
+    model: Model,
+    instance: ModelInstance,
+    port: int,
+    force_platform: str,
+    process_index: int = 0,
+    chip_indexes: Optional[List[int]] = None,
+) -> Tuple[List[str], Dict[str, str]]:
+    argv = [
+        sys.executable, "-m", "gpustack_tpu.engine.api_server",
+        "--port", str(port),
+        "--served-name", model.name,
+        "--max-seq-len", str(model.max_seq_len),
+        "--max-slots", str(model.max_slots),
+    ]
+    if model.preset:
+        argv += ["--preset", model.preset]
+    elif model.local_path:
+        argv += ["--model-dir", model.local_path]
+    elif model.huggingface_repo_id:
+        # resolved_path is filled once the ModelFileManager cached it
+        raise ValueError("huggingface source requires a cached model file")
+    claim = instance.computed_resource_claim
+    if claim and claim.mesh_plan:
+        argv += ["--mesh-plan", claim.mesh_plan]
+    if model.quantization:
+        argv += ["--quantization", model.quantization]
+    argv += model.backend_parameters
+
+    env: Dict[str, str] = dict(model.env)
+    my_chips = (
+        chip_indexes if chip_indexes is not None else instance.chip_indexes
+    )
+    if my_chips:
+        # restrict the engine process to its assigned chips
+        env.setdefault(
+            "TPU_VISIBLE_CHIPS", ",".join(str(i) for i in my_chips)
+        )
+        env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "")
+    if force_platform:
+        env["GPUSTACK_TPU_PLATFORM"] = force_platform
+    if instance.coordinator_address:
+        # multi-host: jax.distributed rendezvous (replaces the reference's
+        # Ray bootstrap, worker/backends/vllm.py:258-328). The engine
+        # consumes these in api_server.build_engine_from_args.
+        env["GPUSTACK_TPU_COORDINATOR"] = instance.coordinator_address
+        env["GPUSTACK_TPU_NUM_PROCESSES"] = str(
+            1 + len(instance.subordinate_workers)
+        )
+        env.setdefault("GPUSTACK_TPU_PROCESS_ID", str(process_index))
+    return argv, env
+
+
+def _render(
+    vcfg: BackendVersionConfig,
+    model: Model,
+    instance: ModelInstance,
+    port: int,
+) -> Tuple[List[str], Dict[str, str]]:
+    claim = instance.computed_resource_claim
+    subst = {
+        "python": sys.executable,
+        "port": str(port),
+        "served_name": model.name,
+        "model_dir": model.local_path or "",
+        "preset": model.preset or "",
+        "mesh_plan": claim.mesh_plan if claim else "",
+        "max_seq_len": str(model.max_seq_len),
+        "max_slots": str(model.max_slots),
+    }
+
+    def sub(s: str) -> str:
+        for k, v in subst.items():
+            s = s.replace("{" + k + "}", v)
+        return s
+
+    argv = [sub(a) for a in vcfg.command] + model.backend_parameters
+    env = dict(vcfg.env)
+    env.update(model.env)
+    return argv, env

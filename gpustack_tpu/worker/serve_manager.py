@@ -1,0 +1,344 @@
+"""ServeManager: model-instance lifecycle on this worker.
+
+Reference parity (gpustack/worker/serve_manager.py:89): watch instance
+events → start engine processes for instances scheduled here → drive the
+state machine (SCHEDULED → STARTING → RUNNING), health-probe, persist
+logs, restart with backoff on crash, reap orphans.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import socket
+import time
+from typing import Dict, Optional, Set
+
+import aiohttp
+
+from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.config import Config
+from gpustack_tpu.schemas import Model, ModelInstance, ModelInstanceState
+from gpustack_tpu.schemas.inference_backends import InferenceBackend
+from gpustack_tpu.server.bus import Event, EventType
+from gpustack_tpu.worker.backends import build_command
+
+logger = logging.getLogger(__name__)
+
+HEALTH_TIMEOUT = 600.0        # engine startup budget (compile can be slow)
+HEALTH_INTERVAL = 2.0
+MAX_RESTARTS = 5
+
+
+class RunningInstance:
+    def __init__(self, instance_id: int, port: int):
+        self.instance_id = instance_id
+        self.port = port
+        self.process: Optional[asyncio.subprocess.Process] = None
+        self.monitor_task: Optional[asyncio.Task] = None
+        self.restarts = 0
+        self.stopping = False
+        self.is_leader = True
+
+
+class ServeManager:
+    def __init__(self, cfg: Config, client: ClientSet, worker_id: int):
+        self.cfg = cfg
+        self.client = client
+        self.worker_id = worker_id
+        self.running: Dict[int, RunningInstance] = {}
+        self.log_dir = os.path.join(cfg.data_dir, "instance-logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    # ---- event handling -------------------------------------------------
+
+    def _my_role(self, data: dict):
+        """(process_index, chip_indexes) when this worker participates in
+        the instance — 0 for the leader, >0 for a subordinate host of a
+        multi-host replica (reference serve_manager.py:1306-1320 follower
+        startup) — else None."""
+        if data.get("worker_id") == self.worker_id:
+            return 0, list(data.get("chip_indexes") or [])
+        for sub in data.get("subordinate_workers") or []:
+            if sub.get("worker_id") == self.worker_id:
+                return (
+                    int(sub.get("process_index", 1)),
+                    list(sub.get("chip_indexes") or []),
+                )
+        return None
+
+    async def handle_event(self, event: Event) -> None:
+        if event.type == EventType.RESYNC:
+            await self.reconcile()
+            return
+        if event.type == EventType.DELETED:
+            await self.stop_instance(event.id)
+            return
+        data = event.data or {}
+        role = self._my_role(data)
+        if role is None:
+            # instance moved away from us (reschedule): stop local copy
+            if event.id in self.running:
+                await self.stop_instance(event.id)
+            return
+        state = data.get("state")
+        if (
+            state == ModelInstanceState.SCHEDULED.value
+            and event.id not in self.running
+        ):
+            await self.start_instance(event.id)
+
+    async def reconcile(self) -> None:
+        """Converge local processes with the server's view (orphan reaping —
+        reference worker/workload_cleaner.py role)."""
+        try:
+            items = await self.client.list("model-instances")
+        except APIError:
+            logger.exception("reconcile list failed")
+            return
+        mine: Set[int] = set()
+        for item in items:
+            if self._my_role(item) is None:
+                continue
+            inst = ModelInstance.model_validate(item)
+            mine.add(inst.id)
+            if (
+                inst.state == ModelInstanceState.SCHEDULED
+                and inst.id not in self.running
+            ):
+                await self.start_instance(inst.id)
+        for iid in list(self.running):
+            if iid not in mine:
+                await self.stop_instance(iid)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    async def start_instance(self, instance_id: int) -> None:
+        try:
+            raw = await self.client.get("model-instances", instance_id)
+            inst = ModelInstance.model_validate(raw)
+            model = Model.model_validate(
+                await self.client.get("models", inst.model_id)
+            )
+        except APIError as e:
+            logger.warning("cannot fetch instance %d: %s", instance_id, e)
+            return
+        role = self._my_role(raw)
+        if role is None:
+            return
+        process_index, my_chips = role
+        is_leader = process_index == 0
+        backend = None
+        if model.backend not in ("", "tpu-native"):
+            backends = await self.client.list(
+                "inference-backends", name=model.backend
+            )
+            backend = (
+                InferenceBackend.model_validate(backends[0])
+                if backends else None
+            )
+        port = self._allocate_port()
+        try:
+            argv, extra_env = build_command(
+                model, inst, port, backend,
+                force_platform=self.cfg.force_platform,
+                process_index=process_index,
+                chip_indexes=my_chips,
+            )
+        except ValueError as e:
+            if is_leader:
+                await self._set_state(
+                    instance_id, ModelInstanceState.ERROR, str(e)
+                )
+            return
+
+        run = self.running.get(instance_id) or RunningInstance(
+            instance_id, port
+        )
+        run.port = port
+        run.is_leader = is_leader
+        self.running[instance_id] = run
+
+        env = dict(os.environ)
+        env.update(extra_env)
+        # the engine subprocess must be able to import gpustack_tpu even
+        # when the package isn't installed (repo checkout)
+        import gpustack_tpu
+
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(gpustack_tpu.__file__))
+        )
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        log_path = os.path.join(
+            self.log_dir, f"{inst.name}-{instance_id}.log"
+        )
+        logger.info(
+            "starting instance %s: %s (log %s)",
+            inst.name, " ".join(argv), log_path,
+        )
+        log_file = open(log_path, "ab")
+        try:
+            run.process = await asyncio.create_subprocess_exec(
+                *argv, env=env, stdout=log_file, stderr=log_file,
+                start_new_session=True,
+            )
+        except OSError as e:
+            log_file.close()
+            if is_leader:
+                await self._set_state(
+                    instance_id, ModelInstanceState.ERROR,
+                    f"failed to spawn engine: {e}",
+                )
+            return
+        finally:
+            if not log_file.closed:
+                log_file.close()
+
+        # followers report nothing: the leader's health probe is the
+        # instance's state (the engine blocks until all hosts rendezvous)
+        if is_leader:
+            await self._set_state(
+                instance_id, ModelInstanceState.STARTING, "",
+                port=port, pid=run.process.pid,
+            )
+        run.monitor_task = asyncio.create_task(
+            self._monitor(run, model), name=f"monitor-{instance_id}"
+        )
+
+    async def stop_instance(self, instance_id: int) -> None:
+        run = self.running.pop(instance_id, None)
+        if run is None:
+            return
+        run.stopping = True
+        if run.monitor_task:
+            run.monitor_task.cancel()
+        if run.process and run.process.returncode is None:
+            logger.info("terminating instance %d", instance_id)
+            try:
+                run.process.terminate()
+                try:
+                    await asyncio.wait_for(run.process.wait(), 10)
+                except asyncio.TimeoutError:
+                    run.process.kill()
+                    await run.process.wait()
+            except ProcessLookupError:
+                pass
+
+    async def stop_all(self) -> None:
+        for iid in list(self.running):
+            await self.stop_instance(iid)
+
+    # ---- monitoring -----------------------------------------------------
+
+    async def _monitor(self, run: RunningInstance, model: Model) -> None:
+        if run.is_leader:
+            healthy = await self._wait_healthy(run)
+            if run.stopping:
+                return
+            if healthy:
+                await self._set_state(
+                    run.instance_id, ModelInstanceState.RUNNING, ""
+                )
+            else:
+                if run.process and run.process.returncode is None:
+                    run.process.kill()
+                await self._crash(run, model, "engine failed health check")
+                return
+        # process exit watch
+        assert run.process is not None
+        code = await run.process.wait()
+        if run.stopping:
+            return
+        await self._crash(run, model, f"engine exited with code {code}")
+
+    async def _wait_healthy(self, run: RunningInstance) -> bool:
+        deadline = time.monotonic() + HEALTH_TIMEOUT
+        url = f"http://127.0.0.1:{run.port}/healthz"
+        async with aiohttp.ClientSession() as session:
+            while time.monotonic() < deadline and not run.stopping:
+                if run.process and run.process.returncode is not None:
+                    return False
+                try:
+                    async with session.get(
+                        url, timeout=aiohttp.ClientTimeout(total=3)
+                    ) as resp:
+                        if resp.status == 200:
+                            return True
+                except aiohttp.ClientError:
+                    pass
+                except asyncio.TimeoutError:
+                    pass
+                await asyncio.sleep(HEALTH_INTERVAL)
+        return False
+
+    async def _crash(
+        self, run: RunningInstance, model: Model, reason: str
+    ) -> None:
+        logger.warning("instance %d: %s", run.instance_id, reason)
+        restartable = (
+            model.restart_on_error and run.restarts < MAX_RESTARTS
+        )
+        if run.is_leader:
+            await self._set_state(
+                run.instance_id, ModelInstanceState.ERROR, reason
+            )
+        if not restartable:
+            self.running.pop(run.instance_id, None)
+            return
+        run.restarts += 1
+        backoff = min(60.0, 2.0 ** run.restarts)
+        logger.info(
+            "restarting instance %d in %.0fs (attempt %d/%d)",
+            run.instance_id, backoff, run.restarts, MAX_RESTARTS,
+        )
+        await asyncio.sleep(backoff)
+        if run.stopping or run.instance_id not in self.running:
+            return
+        if run.is_leader:
+            await self._set_state(
+                run.instance_id, ModelInstanceState.SCHEDULED,
+                f"restart {run.restarts}",
+            )
+        restarts = run.restarts
+        await self.start_instance(run.instance_id)
+        if run.instance_id in self.running:
+            self.running[run.instance_id].restarts = restarts
+
+    # ---- helpers --------------------------------------------------------
+
+    async def _set_state(
+        self,
+        instance_id: int,
+        state: ModelInstanceState,
+        message: str,
+        **extra,
+    ) -> None:
+        fields = {"state": state.value, "state_message": message, **extra}
+        if state == ModelInstanceState.ERROR:
+            fields["last_error"] = message
+        try:
+            await self.client.update(
+                "model-instances", instance_id, fields
+            )
+        except APIError as e:
+            logger.warning(
+                "failed to update instance %d state: %s", instance_id, e
+            )
+
+    def _allocate_port(self) -> int:
+        used = {r.port for r in self.running.values()}
+        base = self.cfg.engine_port_base
+        for offset in range(self.cfg.engine_port_range):
+            port = base + offset
+            if port in used:
+                continue
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                try:
+                    s.bind(("127.0.0.1", port))
+                except OSError:
+                    continue
+            return port
+        raise RuntimeError("no free engine ports")

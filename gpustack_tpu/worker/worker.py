@@ -1,0 +1,137 @@
+"""Worker agent: register → heartbeat/status loops → instance watch.
+
+Reference parity (gpustack/worker/worker.py:65): registration with retry
+(cluster token → server-issued worker token), heartbeat + status sync
+threads (async tasks here), instance event watch feeding the ServeManager.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import uuid
+from typing import List, Optional
+
+from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.config import Config
+from gpustack_tpu.detectors import create_detector
+from gpustack_tpu.worker.serve_manager import ServeManager
+
+logger = logging.getLogger(__name__)
+
+
+def _default_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class WorkerAgent:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.client: Optional[ClientSet] = None
+        self.worker_id = 0
+        self.worker_name = cfg.worker_name or socket.gethostname()
+        self.worker_uuid = uuid.uuid4().hex
+        self.detector = create_detector(cfg.fake_detector or None)
+        self.serve_manager: Optional[ServeManager] = None
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+    async def start(self) -> None:
+        await self._register_with_retry()
+        self.serve_manager = ServeManager(
+            self.cfg, self.client, self.worker_id
+        )
+        # push one status immediately so the scheduler sees chips
+        await self._post_status_once()
+        self._tasks = [
+            asyncio.create_task(self._heartbeat_loop(), name="wk-heartbeat"),
+            asyncio.create_task(self._status_loop(), name="wk-status"),
+            asyncio.create_task(self._watch_instances(), name="wk-watch"),
+        ]
+        logger.info(
+            "worker %s (id=%d) started", self.worker_name, self.worker_id
+        )
+
+    async def run_forever(self) -> None:
+        await self.start()
+        await asyncio.gather(*self._tasks)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        if self.serve_manager:
+            await self.serve_manager.stop_all()
+        if self.client:
+            await self.client.close()
+
+    # ---- registration ---------------------------------------------------
+
+    async def _register_with_retry(self) -> None:
+        anon = ClientSet(self.cfg.server_url)
+        delay = 2.0
+        while True:
+            try:
+                result = await anon.register_worker(
+                    {
+                        "registration_token": self.cfg.registration_token,
+                        "name": self.worker_name,
+                        "worker_uuid": self.worker_uuid,
+                        "ip": self.cfg.worker_ip or _default_ip(),
+                        "port": self.cfg.worker_port,
+                    }
+                )
+                break
+            except (APIError, OSError) as e:
+                logger.warning(
+                    "registration failed (%s); retrying in %.0fs", e, delay
+                )
+                await asyncio.sleep(delay)
+                delay = min(30.0, delay * 1.7)
+        await anon.close()
+        self.worker_id = result["worker_id"]
+        self.worker_name = result["name"]
+        self.client = ClientSet(self.cfg.server_url, result["token"])
+
+    # ---- loops ----------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stopping:
+            try:
+                await self.client.heartbeat(self.worker_id)
+            except (APIError, OSError) as e:
+                logger.warning("heartbeat failed: %s", e)
+            await asyncio.sleep(self.cfg.heartbeat_interval)
+
+    async def _status_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.cfg.status_interval)
+            await self._post_status_once()
+
+    async def _post_status_once(self) -> None:
+        try:
+            status = self.detector.detect()
+            await self.client.post_status(
+                self.worker_id, status.model_dump(mode="json")
+            )
+        except (APIError, OSError) as e:
+            logger.warning("status post failed: %s", e)
+        except Exception:
+            logger.exception("detector failed")
+
+    async def _watch_instances(self) -> None:
+        async for event in self.client.watch("model-instances"):
+            try:
+                await self.serve_manager.handle_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("serve manager failed on %s", event.type)

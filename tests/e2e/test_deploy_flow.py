@@ -1,0 +1,183 @@
+"""End-to-end: server + embedded worker + engine subprocess on CPU.
+
+The full reference core loop (SURVEY.md §3.2-3.3) hermetically: deploy a
+model via the management API → controller creates an instance → scheduler
+places it onto the (fake-detected v5e-8) worker → serve manager spawns a
+real engine process → OpenAI request proxied through the server answers.
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import aiohttp
+import pytest
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fixtures", "workers", "v5e_8.json",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_deploy_and_infer(tmp_path):
+    from gpustack_tpu.config import Config
+    from gpustack_tpu.server.server import Server
+
+    port = _free_port()
+    cfg = Config.load(
+        {
+            "host": "127.0.0.1",
+            "port": port,
+            "data_dir": str(tmp_path),
+            "registration_token": "e2e-token",
+            "bootstrap_password": "admin-e2e-pass",
+            "fake_detector": FIXTURE,
+            "force_platform": "cpu",
+            "heartbeat_interval": 1.0,
+            "status_interval": 2.0,
+        }
+    )
+
+    async def go():
+        server = Server(cfg)
+        await server.start()
+        # faster scheduling retries for the test
+        server.scheduler.scan_interval = 2.0
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                # login
+                async with http.post(
+                    f"{base}/auth/login",
+                    json={
+                        "username": "admin",
+                        "password": "admin-e2e-pass",
+                    },
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    token = (await r.json())["token"]
+                hdrs = {"Authorization": f"Bearer {token}"}
+
+                # unauthenticated management is rejected
+                async with http.get(f"{base}/v2/models") as r:
+                    assert r.status == 401
+
+                # wait for the embedded worker to register + report chips
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/workers", headers=hdrs
+                    ) as r:
+                        items = (await r.json())["items"]
+                    if items and items[0]["state"] == "ready" and (
+                        items[0]["status"]["chips"]
+                    ):
+                        break
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("worker never became ready")
+                assert len(items[0]["status"]["chips"]) == 8
+
+                # deploy the tiny preset
+                async with http.post(
+                    f"{base}/v2/models",
+                    headers=hdrs,
+                    json={
+                        "name": "tiny-chat",
+                        "preset": "tiny",
+                        "replicas": 1,
+                        "max_seq_len": 128,
+                        "max_slots": 2,
+                    },
+                ) as r:
+                    assert r.status == 201, await r.text()
+                    model = await r.json()
+
+                # instance goes PENDING → ... → RUNNING
+                deadline = time.time() + 300
+                state_seen = set()
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/model-instances", headers=hdrs
+                    ) as r:
+                        insts = (await r.json())["items"]
+                    if insts:
+                        state_seen.add(insts[0]["state"])
+                        if insts[0]["state"] == "running":
+                            break
+                        if insts[0]["state"] == "error":
+                            raise AssertionError(
+                                f"instance error: "
+                                f"{insts[0]['state_message']}"
+                            )
+                    await asyncio.sleep(1.0)
+                else:
+                    raise AssertionError(
+                        f"instance never ran; states seen: {state_seen}; "
+                        f"last: {insts}"
+                    )
+                inst = insts[0]
+                assert inst["worker_id"] == items[0]["id"]
+                assert inst["chip_indexes"] == [0]
+                assert inst["computed_resource_claim"]["mesh_plan"]
+
+                # chat through the server's OpenAI proxy
+                async with http.post(
+                    f"{base}/v1/chat/completions",
+                    headers=hdrs,
+                    json={
+                        "model": "tiny-chat",
+                        "messages": [
+                            {"role": "user", "content": "hello"}
+                        ],
+                        "max_tokens": 4,
+                        "temperature": 0,
+                    },
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["object"] == "chat.completion"
+                assert data["usage"]["completion_tokens"] >= 1
+
+                # /v1/models lists the route
+                async with http.get(
+                    f"{base}/v1/models", headers=hdrs
+                ) as r:
+                    names = [m["id"] for m in (await r.json())["data"]]
+                assert "tiny-chat" in names
+
+                # usage was recorded
+                async with http.get(
+                    f"{base}/v2/model-usage", headers=hdrs
+                ) as r:
+                    usage = (await r.json())["items"]
+                assert usage and usage[0]["total_tokens"] > 0
+
+                # scale to zero retires the instance
+                async with http.patch(
+                    f"{base}/v2/models/{model['id']}",
+                    headers=hdrs,
+                    json={"replicas": 0},
+                ) as r:
+                    assert r.status == 200
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/model-instances", headers=hdrs
+                    ) as r:
+                        if not (await r.json())["items"]:
+                            break
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("instance was not retired")
+        finally:
+            await server.stop()
+
+    asyncio.run(go())

@@ -1,0 +1,212 @@
+"""ORM + event bus semantics: CRUD, diffs, post-commit events, watch."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.bus import EventBus, EventType
+
+
+@pytest.fixture()
+def ctx():
+    db = Database(":memory:")
+    bus = EventBus()
+    Record.bind(db, bus)
+    Record.create_all_tables(db)
+    yield db, bus
+    db.close()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_crud_roundtrip(ctx):
+    async def go():
+        w = await Worker.create(Worker(name="w1", cluster_id=1))
+        assert w.id > 0 and w.created_at
+        got = await Worker.get(w.id)
+        assert got.name == "w1"
+        await got.update(state=WorkerState.READY)
+        fresh = await Worker.get(w.id)
+        assert fresh.state == WorkerState.READY
+        await fresh.delete()
+        assert await Worker.get(w.id) is None
+
+    run(go())
+
+
+def test_filter_indexed_and_python_fields(ctx):
+    async def go():
+        for i in range(5):
+            await ModelInstance.create(
+                ModelInstance(
+                    name=f"i{i}",
+                    model_id=1 + (i % 2),
+                    state=ModelInstanceState.PENDING,
+                )
+            )
+        # indexed filter
+        assert len(await ModelInstance.filter(model_id=1)) == 3
+        # enum value in indexed column
+        assert (
+            len(await ModelInstance.filter(state=ModelInstanceState.PENDING))
+            == 5
+        )
+        # python-side filter on non-indexed field
+        inst = await ModelInstance.first(name="i3")
+        await inst.update(restarts=7)
+        assert len(await ModelInstance.filter(restarts=7)) == 1
+        # pagination
+        page = await ModelInstance.filter(limit=2, offset=2)
+        assert [m.name for m in page] == ["i2", "i3"]
+
+    run(go())
+
+
+def test_update_publishes_changed_fields(ctx):
+    db, bus = ctx
+
+    async def go():
+        sub = bus.subscribe(kinds={"model_instance"})
+        inst = await ModelInstance.create(ModelInstance(name="x"))
+        ev = await sub.get(timeout=1)
+        assert ev.type == EventType.CREATED and ev.id == inst.id
+        await inst.update(
+            state=ModelInstanceState.SCHEDULED, worker_id=3
+        )
+        ev = await sub.get(timeout=1)
+        assert ev.type == EventType.UPDATED
+        assert ev.changes["state"] == ("pending", "scheduled")
+        assert ev.changes["worker_id"] == (None, 3)
+        # no-op update publishes nothing
+        await inst.update(worker_id=3)
+        ev = await sub.get(timeout=0.05)
+        assert ev.type == EventType.HEARTBEAT
+
+    run(go())
+
+
+def test_update_nonexistent_raises(ctx):
+    async def go():
+        m = Model(name="ghost")
+        m.id = 9999
+        with pytest.raises(KeyError):
+            await m.save()
+
+    run(go())
+
+
+def test_coalescing_updates(ctx):
+    db, bus = ctx
+
+    async def go():
+        inst = await ModelInstance.create(ModelInstance(name="c"))
+        sub = bus.subscribe(kinds={"model_instance"})
+        # three quick updates while nobody consumes -> one coalesced event
+        await inst.update(restarts=1)
+        await inst.update(restarts=2)
+        await inst.update(state=ModelInstanceState.ERROR)
+        ev = await sub.get(timeout=1)
+        assert ev.type == EventType.UPDATED
+        assert ev.data["restarts"] == 2
+        # merged change keys span all coalesced updates; restarts keeps
+        # the oldest old-value
+        assert ev.changes["restarts"] == (0, 2)
+        assert ev.changes["state"] == ("pending", "error")
+        assert sub.coalesced == 2
+        ev = await sub.get(timeout=0.05)
+        assert ev.type == EventType.HEARTBEAT
+
+    run(go())
+
+
+def test_overflow_forces_resync(ctx):
+    db, bus = ctx
+
+    async def go():
+        sub = bus.subscribe(kinds={"model"}, max_size=3)
+        for i in range(6):
+            await Model.create(Model(name=f"m{i}"))
+        types = [
+            (await sub.get(timeout=0.05)).type for _ in range(4)
+        ]
+        assert EventType.RESYNC in types
+
+    run(go())
+
+
+def test_subscribe_initial_list(ctx):
+    async def go():
+        await Worker.create(Worker(name="w1"))
+        await Worker.create(Worker(name="w2"))
+        seen = []
+        agen = Worker.subscribe(send_initial=True, heartbeat=0.05)
+        async for ev in agen:
+            if ev.type == EventType.HEARTBEAT:
+                break
+            seen.append(ev)
+        assert [e.data["name"] for e in seen] == ["w1", "w2"]
+        await agen.aclose()
+
+    run(go())
+
+
+def test_nested_pydantic_fields_roundtrip(ctx):
+    from gpustack_tpu.schemas import (
+        ComputedResourceClaim,
+        SliceTopology,
+        SubordinateWorker,
+        TPUChip,
+        WorkerStatus,
+    )
+
+    async def go():
+        w = await Worker.create(
+            Worker(
+                name="tpu-host",
+                status=WorkerStatus(
+                    chips=[TPUChip(index=i) for i in range(8)],
+                    slice=SliceTopology(
+                        topology="2x4", chips_per_host=8, ici_domain="s1"
+                    ),
+                ),
+            )
+        )
+        got = await Worker.get(w.id)
+        assert got.total_chips == 8
+        assert got.status.slice.total_chips == 8
+
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="i0",
+                computed_resource_claim=ComputedResourceClaim(
+                    chips=8, mesh_plan="dp1xsp1xep1xtp8"
+                ),
+                subordinate_workers=[SubordinateWorker(worker_id=2)],
+            )
+        )
+        got = await ModelInstance.get(inst.id)
+        assert got.computed_resource_claim.chips == 8
+        assert got.subordinate_workers[0].worker_id == 2
+
+    run(go())
+
+
+def test_migrations_table(ctx):
+    db, _ = ctx
+    from gpustack_tpu.orm.db import run_migrations
+
+    n = run_migrations(db)
+    assert n >= 0
+    # idempotent
+    assert run_migrations(db) == 0
