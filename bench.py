@@ -37,15 +37,15 @@ def build_engine(cfg_name: str, max_slots: int, max_seq_len: int):
 
     from gpustack_tpu.engine.engine import LLMEngine
     from gpustack_tpu.models.config import get_config
-    from gpustack_tpu.models.quant import quantize_params
-    from gpustack_tpu.models.transformer import init_params
+    from gpustack_tpu.models.quant import init_quantized_params
 
     cfg = get_config(cfg_name)
-    # Init + quantize on host CPU: bf16 8B (16 GB) must not touch the 16 GB
-    # chip; the int8 tree (~8 GB) is what ships to HBM.
+    # Direct int8 init on host CPU: the bf16 tree (16 GB for 8B) must not
+    # touch the 16 GB chip or burn minutes of host PRNG; the int8 tree
+    # (~8 GB) is what ships to HBM.
     cpu = jax.local_devices(backend="cpu")[0]
     with jax.default_device(cpu):
-        params = quantize_params(init_params(cfg, jax.random.key(0)))
+        params = init_quantized_params(cfg, seed=0)
     return LLMEngine(
         cfg, params, max_slots=max_slots, max_seq_len=max_seq_len
     )

@@ -1,0 +1,13 @@
+"""Benchmark subsystem: profiles + async load generator + worker manager
+(reference gpustack/worker/benchmark_manager.py + the guidellm-based
+benchmark-runner container, worker/benchmark/runner.py:149)."""
+
+from gpustack_tpu.benchmark.loadgen import LoadGenReport, run_load_test
+from gpustack_tpu.benchmark.profiles import PROFILES, BenchmarkProfile
+
+__all__ = [
+    "PROFILES",
+    "BenchmarkProfile",
+    "LoadGenReport",
+    "run_load_test",
+]

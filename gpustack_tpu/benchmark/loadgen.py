@@ -1,0 +1,211 @@
+"""Async OpenAI-endpoint load generator with streaming latency capture.
+
+In-process replacement for the reference's guidellm benchmark-runner
+container (reference worker/benchmark/runner.py:149; metrics parsed in
+worker/benchmark_manager.py:355-533): drives ``/v1/completions`` with
+streaming on, recording TTFT / TPOT / ITL / throughput per request, and
+reduces to the reference's recorded metrics schema
+(gpustack/schemas/benchmark.py:192-242).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import random
+import time
+from typing import List, Optional
+
+import aiohttp
+
+from gpustack_tpu.benchmark.profiles import BenchmarkProfile
+from gpustack_tpu.schemas.benchmarks import BenchmarkMetrics
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _RequestResult:
+    ok: bool = False
+    start: float = 0.0
+    first_token: float = 0.0
+    end: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    inter_token_gaps: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.first_token - self.start) * 1e3
+
+    @property
+    def latency_ms(self) -> float:
+        return (self.end - self.start) * 1e3
+
+    @property
+    def tpot_ms(self) -> float:
+        n = max(1, self.completion_tokens - 1)
+        return (self.end - self.first_token) * 1e3 / n
+
+
+@dataclasses.dataclass
+class LoadGenReport:
+    metrics: BenchmarkMetrics
+    results: List[_RequestResult]
+
+    def to_raw(self) -> dict:
+        return {
+            "requests": len(self.results),
+            "ok": sum(1 for r in self.results if r.ok),
+            "ttft_ms": [round(r.ttft_ms, 2) for r in self.results if r.ok],
+            "latency_ms": [
+                round(r.latency_ms, 2) for r in self.results if r.ok
+            ],
+        }
+
+
+def _make_prompt(input_len: int, rng: random.Random) -> str:
+    # ~1 token per word for HF tokenizers; byte tokenizer sees ~5x — both
+    # fine for load shaping (the reference's Random dataset is the analogue)
+    words = [
+        rng.choice(
+            ["alpha", "bravo", "delta", "omega", "tensor", "mesh", "chip"]
+        )
+        for _ in range(max(1, input_len))
+    ]
+    return " ".join(words)
+
+
+async def _one_request(
+    session: aiohttp.ClientSession,
+    url: str,
+    model: str,
+    profile: BenchmarkProfile,
+    rng: random.Random,
+    headers: Optional[dict] = None,
+) -> _RequestResult:
+    result = _RequestResult(start=time.monotonic())
+    body = {
+        "model": model,
+        "prompt": _make_prompt(profile.input_len, rng),
+        "max_tokens": profile.output_len,
+        "temperature": 1.0,
+        "stream": True,
+    }
+    last_token_at = None
+    try:
+        async with session.post(
+            url, json=body, headers=headers or {},
+            timeout=aiohttp.ClientTimeout(total=1800),
+        ) as resp:
+            if resp.status != 200:
+                logger.warning(
+                    "bench request failed: %d %s",
+                    resp.status, (await resp.text())[:200],
+                )
+                return result
+            async for raw_line in resp.content:
+                line = raw_line.strip()
+                if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                    continue
+                try:
+                    chunk = json.loads(line[6:])
+                except json.JSONDecodeError:
+                    continue
+                if "error" in chunk:
+                    logger.warning(
+                        "bench stream error: %s", chunk["error"]
+                    )
+                    return result
+                now = time.monotonic()
+                usage = chunk.get("usage")
+                if usage:
+                    result.prompt_tokens = usage.get("prompt_tokens", 0)
+                    result.completion_tokens = usage.get(
+                        "completion_tokens", 0
+                    )
+                choice = (chunk.get("choices") or [{}])[0]
+                if choice.get("text") or choice.get("delta", {}).get(
+                    "content"
+                ):
+                    if result.first_token == 0.0:
+                        result.first_token = now
+                    elif last_token_at is not None:
+                        result.inter_token_gaps.append(now - last_token_at)
+                    last_token_at = now
+        result.end = time.monotonic()
+        if result.first_token == 0.0:
+            result.first_token = result.end
+        result.ok = True
+    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        logger.warning("bench request error: %s", e)
+    return result
+
+
+async def run_load_test(
+    base_url: str,
+    model: str,
+    profile: BenchmarkProfile,
+    concurrency: int = 64,
+    headers: Optional[dict] = None,
+    seed: int = 0,
+) -> LoadGenReport:
+    """Drive the endpoint per the profile; returns reduced metrics.
+
+    rate == 0: all requests in flight immediately, bounded by
+    ``concurrency`` (throughput mode). rate > 0: open-loop Poisson-less
+    fixed-interval arrivals (the reference's guidellm constant-rate mode).
+    """
+    url = base_url.rstrip("/") + "/v1/completions"
+    rng = random.Random(seed)
+    results: List[_RequestResult] = []
+    sem = asyncio.Semaphore(concurrency)
+    t_start = time.monotonic()
+
+    async with aiohttp.ClientSession() as session:
+
+        async def worker(delay: float):
+            if delay > 0:
+                await asyncio.sleep(delay)
+            async with sem:
+                results.append(
+                    await _one_request(
+                        session, url, model, profile, rng, headers
+                    )
+                )
+
+        tasks = []
+        for i in range(profile.num_requests):
+            delay = (i / profile.rate) if profile.rate > 0 else 0.0
+            tasks.append(asyncio.create_task(worker(delay)))
+        await asyncio.gather(*tasks)
+
+    wall = max(1e-9, time.monotonic() - t_start)
+    ok = [r for r in results if r.ok]
+    errors = len(results) - len(ok)
+
+    def mean(xs: List[float]) -> float:
+        return sum(xs) / len(xs) if xs else 0.0
+
+    def p50(xs: List[float]) -> float:
+        return sorted(xs)[len(xs) // 2] if xs else 0.0
+
+    in_tok = sum(r.prompt_tokens for r in ok)
+    out_tok = sum(r.completion_tokens for r in ok)
+    all_gaps = [g for r in ok for g in r.inter_token_gaps]
+    metrics = BenchmarkMetrics(
+        requests_per_second=len(ok) / wall,
+        request_latency_ms=mean([r.latency_ms for r in ok]),
+        ttft_ms_p50=p50([r.ttft_ms for r in ok]),
+        ttft_ms_mean=mean([r.ttft_ms for r in ok]),
+        tpot_ms_mean=mean([r.tpot_ms for r in ok]),
+        itl_ms_mean=mean(all_gaps) * 1e3,
+        input_tok_per_s=in_tok / wall,
+        output_tok_per_s=out_tok / wall,
+        total_tok_per_s=(in_tok + out_tok) / wall,
+        concurrency_mean=min(concurrency, profile.num_requests),
+        error_count=errors,
+    )
+    return LoadGenReport(metrics=metrics, results=results)

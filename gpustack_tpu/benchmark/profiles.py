@@ -1,0 +1,46 @@
+"""Benchmark workload profiles.
+
+Mirrors the reference's profiles_config.yaml
+(gpustack/assets/profiles_config/profiles_config.yaml:2-57): Throughput
+1024/128 unlimited ×1000, Latency 128/128 @1rps, Long-Context 32000/100,
+Generation-Heavy 1000/2000, plus a hermetic smoke profile for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchmarkProfile:
+    name: str
+    input_len: int
+    output_len: int
+    num_requests: int
+    rate: float = 0.0          # requests/sec; 0 = unlimited (batch)
+    description: str = ""
+
+
+PROFILES: Dict[str, BenchmarkProfile] = {
+    "throughput": BenchmarkProfile(
+        "throughput", 1024, 128, 1000, 0.0,
+        "max throughput: long-in short-out, unlimited rate",
+    ),
+    "latency": BenchmarkProfile(
+        "latency", 128, 128, 100, 1.0,
+        "interactive latency at 1 rps",
+    ),
+    "long-context": BenchmarkProfile(
+        "long-context", 32000, 100, 100, 1.0,
+        "32k-token prompts",
+    ),
+    "generation-heavy": BenchmarkProfile(
+        "generation-heavy", 1000, 2000, 200, 1.0,
+        "long generations",
+    ),
+    "smoke": BenchmarkProfile(
+        "smoke", 32, 8, 6, 0.0,
+        "hermetic test profile",
+    ),
+}

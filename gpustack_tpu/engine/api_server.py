@@ -191,6 +191,16 @@ class OpenAIServer:
     async def _stream(
         self, request: web.Request, gen: GenRequest, chat: bool
     ) -> web.StreamResponse:
+        gen.stream = queue.Queue()
+        loop = asyncio.get_running_loop()
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        # submit before committing to a 200/SSE response: rejections must
+        # surface as real HTTP errors, not in-band stream events
+        try:
+            self.engine.submit(gen)
+        except ValueError as e:
+            return _error(400, str(e))
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -198,18 +208,6 @@ class OpenAIServer:
             }
         )
         await resp.prepare(request)
-        gen.stream = queue.Queue()
-        loop = asyncio.get_running_loop()
-        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
-        obj = "chat.completion.chunk" if chat else "text_completion"
-        try:
-            self.engine.submit(gen)
-        except ValueError as e:
-            await resp.write(
-                f"data: {json.dumps({'error': str(e)})}\n\n".encode()
-            )
-            await resp.write(b"data: [DONE]\n\n")
-            return resp
 
         if chat:
             first = {

@@ -118,21 +118,24 @@ def plan_mesh(
     """Auto-parallelism: pick a mesh shape for ``n_devices`` chips.
 
     Heuristic (serving-oriented):
-    - TP first, up to the KV-head count (beyond that TP replicates KV heads
-      and wastes HBM — mirrors the reference's head-divisibility checks,
-      base_candidate_selector.py:229-234).
-    - MoE models spend remaining factor on EP up to the expert count.
-    - ``long_context`` spends remaining factor on SP (context parallelism);
-      otherwise on DP (replica throughput).
+    - MoE models reserve up to half the factor for EP (expert dimension) —
+      an all-TP plan would replicate expert weights and starve HBM.
+    - TP up to the KV-head count (beyond that TP replicates KV heads and
+      wastes HBM — mirrors the reference's head-divisibility checks,
+      base_candidate_selector.py:229-234); under ``long_context`` TP is
+      capped at half the remaining factor so SP (context parallelism) gets
+      the rest.
+    - Any leftover goes to DP (replica throughput).
     """
     if n_devices <= 0 or n_devices & (n_devices - 1):
         raise ValueError(f"device count must be a power of two, got {n_devices}")
-    tp = _largest_pow2_divisor(num_kv_heads, n_devices)
-    rest = n_devices // tp
+    rest = n_devices
     ep = 1
     if num_experts:
-        ep = _largest_pow2_divisor(num_experts, rest)
+        ep = _largest_pow2_divisor(num_experts, max(1, rest // 2))
         rest //= ep
-    if long_context:
-        return MeshPlan(dp=1, sp=rest, ep=ep, tp=tp)
-    return MeshPlan(dp=rest, sp=1, ep=ep, tp=tp)
+    if long_context and rest >= 2:
+        tp = _largest_pow2_divisor(num_kv_heads, rest // 2)
+        return MeshPlan(dp=1, sp=rest // tp, ep=ep, tp=tp)
+    tp = _largest_pow2_divisor(num_kv_heads, rest)
+    return MeshPlan(dp=rest // tp, sp=1, ep=ep, tp=tp)

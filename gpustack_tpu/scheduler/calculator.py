@@ -80,6 +80,26 @@ def evaluate_model(model: Model) -> ModelEvaluation:
     cfg = resolve_model_config(model)
     weight_bits = 8 if model.quantization == "int8" else 16
     weight_bytes = cfg.weight_bytes(weight_bits)
+    if model.local_path:
+        # exact accounting from the native model-meta tool (checkpoint
+        # tensors on disk beat config-derived estimates)
+        from gpustack_tpu.utils.native import run_model_meta
+
+        meta = run_model_meta(model.local_path)
+        if meta and meta.get("total_bytes"):
+            disk_bytes = int(meta["total_bytes"])
+            if model.quantization == "int8":
+                # engine int8 quantization only shrinks 16/32-bit float
+                # tensors; already-quantized checkpoint bytes (GGUF Q*,
+                # int8 safetensors) load as-is
+                by_dtype = meta.get("bytes_by_dtype") or {}
+                wide = sum(
+                    v for k, v in by_dtype.items()
+                    if k in ("F16", "BF16", "F32", "F64")
+                )
+                narrow = disk_bytes - wide
+                disk_bytes = narrow + wide // 2 + wide // 256
+            weight_bytes = disk_bytes
     kv_bytes = (
         cfg.kv_cache_bytes_per_token(16) * model.max_seq_len * model.max_slots
     )

@@ -140,7 +140,11 @@ class Scheduler:
         await inst.update(state=ModelInstanceState.ANALYZING)
 
         try:
-            evaluation = evaluate_model(model)
+            # evaluate in an executor: it may shell out to model-meta on a
+            # large checkpoint dir — never block the control-plane loop
+            evaluation = await asyncio.get_running_loop().run_in_executor(
+                None, evaluate_model, model
+            )
         except EvaluationError as e:
             await inst.update(
                 state=ModelInstanceState.ERROR, state_message=str(e)

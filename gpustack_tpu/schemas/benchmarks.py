@@ -42,10 +42,11 @@ class Benchmark(Record):
     model_instance_id: int = 0
     worker_id: int = 0
     profile: str = "throughput"       # profiles_config analogue
-    input_len: int = 1024
-    output_len: int = 128
-    num_requests: int = 100
-    rate: float = 0.0                 # 0 = unlimited
+    # 0 = inherit from the profile
+    input_len: int = 0
+    output_len: int = 0
+    num_requests: int = 0
+    rate: float = 0.0                 # 0 = profile default / unlimited
     state: BenchmarkState = BenchmarkState.PENDING
     state_message: str = ""
     metrics: Optional[BenchmarkMetrics] = None

@@ -53,6 +53,36 @@ def create_app(cfg: Config) -> web.Application:
     add_auth_routes(app)
     add_worker_facing_routes(app)
     add_openai_routes(app)
+    from gpustack_tpu.server.exporter import add_metrics_route
+
+    add_metrics_route(app)
+
+    # instance log streaming through the worker's http server (reference
+    # routes/worker/logs.py path, proxied server-side)
+    async def instance_logs(request: web.Request):
+        inst = await ModelInstance.get(int(request.match_info["id"]))
+        if inst is None:
+            return json_error(404, "instance not found")
+        worker = await Worker.get(inst.worker_id or 0)
+        if worker is None:
+            return json_error(409, "instance is not placed on a worker")
+        tail = request.query.get("tail", "200")
+        url = (
+            f"http://{worker.ip}:{worker.port}"
+            f"/v2/instances/{inst.id}/logs?tail={tail}"
+        )
+        session = app["proxy_session"]
+        try:
+            async with session.get(
+                url, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                return web.Response(
+                    text=await resp.text(), status=resp.status
+                )
+        except aiohttp.ClientError as e:
+            return json_error(502, f"worker unreachable: {e}")
+
+    app.router.add_get("/v2/model-instances/{id:\\d+}/logs", instance_logs)
 
     # ---- management CRUD ------------------------------------------------
 
@@ -84,7 +114,27 @@ def create_app(cfg: Config) -> web.Application:
     add_crud_routes(app, ModelRoute, "model-routes")
     add_crud_routes(app, ModelFile, "model-files", admin_write=False)
     add_crud_routes(app, User, "users", create_hook=user_create_hook)
-    add_crud_routes(app, Benchmark, "benchmarks")
+    async def benchmark_create_hook(request, obj: Benchmark, body):
+        if await Model.get(obj.model_id) is None:
+            return json_error(
+                400, f"model {obj.model_id} does not exist"
+            )
+        # server-owned fields cannot be seeded by the client
+        from gpustack_tpu.schemas import BenchmarkState
+
+        obj.state = BenchmarkState.PENDING
+        obj.state_message = ""
+        obj.metrics = None
+        obj.raw_report = {}
+        obj.worker_id = 0
+        obj.model_instance_id = 0
+        return None
+
+    # workers update benchmark state/metrics with their worker tokens
+    add_crud_routes(
+        app, Benchmark, "benchmarks",
+        admin_write=False, create_hook=benchmark_create_hook,
+    )
     add_crud_routes(app, InferenceBackend, "inference-backends")
     add_crud_routes(app, ModelUsage, "model-usage", readonly=True)
 

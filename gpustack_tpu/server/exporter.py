@@ -1,0 +1,109 @@
+"""Server Prometheus exporter: DB-derived cluster gauges + bus counters.
+
+Reference parity: gpustack/exporter/exporter.py:32-56 (cluster/worker/model
+gauges recomputed on scrape with a small cache) + exporter/bus_metrics.py
+(bus publish counters)."""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from aiohttp import web
+
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    Worker,
+    WorkerState,
+)
+
+_CACHE_TTL = 3.0
+
+
+class ServerExporter:
+    def __init__(self, bus=None):
+        self._bus = bus
+        self._cache: str = ""
+        self._cached_at: float = 0.0
+
+    @property
+    def bus(self):
+        if self._bus is not None:
+            return self._bus
+        from gpustack_tpu.orm.record import Record
+
+        return Record.bus()
+
+    async def metrics_text(self) -> str:
+        now = time.monotonic()
+        if self._cache and now - self._cached_at < _CACHE_TTL:
+            return self._cache
+        lines: List[str] = []
+
+        workers = await Worker.all()
+        ready = [w for w in workers if w.state == WorkerState.READY]
+        total_chips = sum(w.total_chips for w in workers)
+        lines += [
+            "# TYPE gpustack_workers gauge",
+            f'gpustack_workers{{state="ready"}} {len(ready)}',
+            f'gpustack_workers{{state="other"}} {len(workers) - len(ready)}',
+            "# TYPE gpustack_tpu_chips_total gauge",
+            f"gpustack_tpu_chips_total {total_chips}",
+        ]
+
+        instances = await ModelInstance.all()
+        by_state: dict = {}
+        used_chips = 0
+        for inst in instances:
+            by_state[inst.state.value] = by_state.get(inst.state.value, 0) + 1
+            if inst.state.value in ("running", "starting", "scheduled"):
+                used_chips += len(inst.chip_indexes)
+                for sub in inst.subordinate_workers:
+                    used_chips += len(sub.chip_indexes)
+        lines.append("# TYPE gpustack_model_instances gauge")
+        for state, count in sorted(by_state.items()):
+            lines.append(
+                f'gpustack_model_instances{{state="{state}"}} {count}'
+            )
+        lines += [
+            "# TYPE gpustack_tpu_chips_used gauge",
+            f"gpustack_tpu_chips_used {used_chips}",
+            "# TYPE gpustack_models gauge",
+            f"gpustack_models {len(await Model.all())}",
+        ]
+
+        # SQL aggregate: the usage table grows one row per request; never
+        # materialize it for a scrape
+        from gpustack_tpu.orm.record import Record
+
+        rows = await Record.db().execute(
+            "SELECT COUNT(*) AS n, "
+            "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
+            "FROM model_usage"
+        )
+        lines += [
+            "# TYPE gpustack_usage_total_tokens counter",
+            f"gpustack_usage_total_tokens {int(rows[0]['tok'])}",
+            "# TYPE gpustack_usage_requests counter",
+            f"gpustack_usage_requests {int(rows[0]['n'])}",
+        ]
+
+        lines.append("# TYPE gpustack_bus_events_published counter")
+        for (kind, etype), count in sorted(self.bus.published.items()):
+            lines.append(
+                f'gpustack_bus_events_published{{kind="{kind}",'
+                f'type="{etype}"}} {count}'
+            )
+        self._cache = "\n".join(lines) + "\n"
+        self._cached_at = now
+        return self._cache
+
+
+def add_metrics_route(app: web.Application) -> None:
+    exporter = ServerExporter()
+
+    async def metrics(request: web.Request):
+        return web.Response(text=await exporter.metrics_text())
+
+    app.router.add_get("/metrics", metrics)

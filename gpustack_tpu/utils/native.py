@@ -1,0 +1,77 @@
+"""Bridges to the in-repo native (C++) tools under native/bin.
+
+model-meta: exact checkpoint byte accounting for the scheduler (replaces
+the reference's gguf-parser shell-outs, scheduler/calculator.py:550-566).
+sysinfo: host probe JSON (replaces the fastfetch dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def find_tool(name: str) -> Optional[str]:
+    """Locate a native tool: $GPUSTACK_TPU_NATIVE_BIN, repo build dir,
+    then PATH."""
+    override = os.environ.get("GPUSTACK_TPU_NATIVE_BIN")
+    candidates = []
+    if override:
+        candidates.append(os.path.join(override, name))
+    candidates.append(os.path.join(_REPO_ROOT, "native", "bin", name))
+    for path in candidates:
+        if os.path.isfile(path) and os.access(path, os.X_OK):
+            return path
+    from shutil import which
+
+    return which(name)
+
+
+def run_model_meta(target: str) -> Optional[Dict[str, Any]]:
+    """Run model-meta on a checkpoint dir/file; None when unavailable or
+    the target has no parseable checkpoint."""
+    tool = find_tool("model-meta")
+    if tool is None:
+        return None
+    try:
+        out = subprocess.run(
+            [tool, target], capture_output=True, timeout=60, check=False
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("model-meta failed: %s", e)
+        return None
+    if out.returncode != 0:
+        logger.debug(
+            "model-meta(%s) rc=%d: %s",
+            target, out.returncode, out.stderr.decode()[:200],
+        )
+        return None
+    try:
+        return json.loads(out.stdout)
+    except json.JSONDecodeError:
+        logger.warning("model-meta produced invalid JSON")
+        return None
+
+
+def run_sysinfo() -> Optional[Dict[str, Any]]:
+    tool = find_tool("sysinfo")
+    if tool is None:
+        return None
+    try:
+        out = subprocess.run(
+            [tool], capture_output=True, timeout=10, check=False
+        )
+        if out.returncode != 0:
+            return None
+        return json.loads(out.stdout)
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError):
+        return None

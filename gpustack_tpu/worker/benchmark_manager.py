@@ -1,0 +1,155 @@
+"""Worker-side benchmark execution.
+
+Reference parity (gpustack/worker/benchmark_manager.py:113-533): watch
+Benchmark records, run the load generator against a local running instance
+of the target model, parse the report into BenchmarkMetrics. The load
+generator is in-process (benchmark/loadgen.py) instead of a guidellm
+container.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Optional
+
+from gpustack_tpu.benchmark.loadgen import run_load_test
+from gpustack_tpu.benchmark.profiles import PROFILES, BenchmarkProfile
+from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.schemas import (
+    Benchmark,
+    BenchmarkState,
+    ModelInstance,
+    ModelInstanceState,
+)
+from gpustack_tpu.server.bus import Event, EventType
+
+logger = logging.getLogger(__name__)
+
+
+class BenchmarkManager:
+    RESCAN_INTERVAL = 20.0
+
+    def __init__(self, client: ClientSet, worker_id: int):
+        self.client = client
+        self.worker_id = worker_id
+        self._running: Optional[asyncio.Task] = None
+
+    async def handle_event(self, event: Event) -> None:
+        if event.type not in (EventType.CREATED, EventType.UPDATED):
+            return
+        data = event.data or {}
+        if data.get("state") != BenchmarkState.PENDING.value:
+            return
+        bench = Benchmark.model_validate(data)
+        bench.id = event.id
+        await self._maybe_start(bench)
+
+    async def rescan_loop(self) -> None:
+        """PENDING benchmarks dropped by the event path (busy worker,
+        instance not yet RUNNING) get retried here — the analogue of the
+        scheduler's periodic scan for stuck instances."""
+        while True:
+            await asyncio.sleep(self.RESCAN_INTERVAL)
+            try:
+                items = await self.client.list(
+                    "benchmarks", state=BenchmarkState.PENDING.value
+                )
+            except APIError:
+                continue
+            for item in items:
+                bench = Benchmark.model_validate(item)
+                await self._maybe_start(bench)
+
+    async def _maybe_start(self, bench: Benchmark) -> None:
+        if self._running and not self._running.done():
+            return  # one benchmark at a time per worker
+        instance = await self._local_instance(bench)
+        if instance is None:
+            return  # another worker hosts the model (or not RUNNING yet)
+        self._running = asyncio.create_task(
+            self._run(bench, instance), name=f"benchmark-{bench.id}"
+        )
+
+    async def _local_instance(
+        self, bench: Benchmark
+    ) -> Optional[ModelInstance]:
+        try:
+            items = await self.client.list(
+                "model-instances", model_id=bench.model_id
+            )
+        except APIError:
+            return None
+        for item in items:
+            inst = ModelInstance.model_validate(item)
+            if (
+                inst.worker_id == self.worker_id
+                and inst.state == ModelInstanceState.RUNNING
+                and inst.port
+            ):
+                return inst
+        return None
+
+    def _profile(self, bench: Benchmark) -> BenchmarkProfile:
+        base = PROFILES.get(bench.profile) or PROFILES["throughput"]
+        return dataclasses.replace(
+            base,
+            input_len=bench.input_len or base.input_len,
+            output_len=bench.output_len or base.output_len,
+            num_requests=bench.num_requests or base.num_requests,
+            rate=bench.rate if bench.rate else base.rate,
+        )
+
+    async def _run(self, bench: Benchmark, instance: ModelInstance) -> None:
+        profile = self._profile(bench)
+        try:
+            await self.client.update(
+                "benchmarks", bench.id,
+                {
+                    "state": BenchmarkState.RUNNING.value,
+                    "worker_id": self.worker_id,
+                    "model_instance_id": instance.id,
+                },
+            )
+            report = await run_load_test(
+                base_url=f"http://127.0.0.1:{instance.port}",
+                model=instance.model_name,
+                profile=profile,
+            )
+            failed = report.metrics.error_count >= profile.num_requests
+            await self.client.update(
+                "benchmarks", bench.id,
+                {
+                    "state": (
+                        BenchmarkState.ERROR.value
+                        if failed
+                        else BenchmarkState.COMPLETED.value
+                    ),
+                    "state_message": (
+                        "all requests failed" if failed else ""
+                    ),
+                    "metrics": report.metrics.model_dump(),
+                    "raw_report": report.to_raw(),
+                },
+            )
+            logger.info(
+                "benchmark %d done: %.1f out tok/s, ttft p50 %.0fms",
+                bench.id,
+                report.metrics.output_tok_per_s,
+                report.metrics.ttft_ms_p50,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.exception("benchmark %d failed", bench.id)
+            try:
+                await self.client.update(
+                    "benchmarks", bench.id,
+                    {
+                        "state": BenchmarkState.ERROR.value,
+                        "state_message": str(e),
+                    },
+                )
+            except APIError:
+                pass

@@ -1,0 +1,135 @@
+"""Worker HTTP server: health, node+engine metrics, instance logs.
+
+Reference parity: the worker's own FastAPI (reference
+worker/worker.py:332-413: logs/proxy routes) + MetricExporter
+(worker/exporter.py:76-171 node gauges; /metrics aggregated engine
+metrics via RuntimeMetricsAggregator, runtime_metrics_aggregator.py:48).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+TAIL_DEFAULT = 200
+TAIL_MAX = 5000
+
+
+class WorkerServer:
+    def __init__(self, agent) -> None:
+        self.agent = agent
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/healthz", self.healthz),
+                web.get("/metrics", self.metrics),
+                web.get(
+                    "/v2/instances/{id:\\d+}/logs", self.instance_logs
+                ),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self, host: str, port: int) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        logger.info("worker http listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        sm = self.agent.serve_manager
+        return web.json_response(
+            {
+                "status": "ok",
+                "worker_id": self.agent.worker_id,
+                "instances": sorted(sm.running) if sm else [],
+            }
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        status = self.agent.detector.detect()
+        lines = [
+            "# TYPE gpustack_worker_cpu_count gauge",
+            f"gpustack_worker_cpu_count {status.cpu_count}",
+            "# TYPE gpustack_worker_memory_total_bytes gauge",
+            f"gpustack_worker_memory_total_bytes "
+            f"{status.memory_total_bytes}",
+            "# TYPE gpustack_worker_memory_used_bytes gauge",
+            f"gpustack_worker_memory_used_bytes "
+            f"{status.memory_used_bytes}",
+            "# TYPE gpustack_worker_tpu_chips gauge",
+            f"gpustack_worker_tpu_chips {len(status.chips)}",
+        ]
+        for chip in status.chips:
+            lines.append(
+                f'gpustack_worker_tpu_hbm_bytes{{chip="{chip.index}",'
+                f'type="{chip.chip_type}"}} {chip.hbm_bytes}'
+            )
+        # aggregate engine metrics with instance labels (normalized
+        # engine-metric passthrough, reference /metrics/raw analogue)
+        sm = self.agent.serve_manager
+        if sm:
+            async with aiohttp.ClientSession() as session:
+                for iid, run in list(sm.running.items()):
+                    try:
+                        async with session.get(
+                            f"http://127.0.0.1:{run.port}/metrics",
+                            timeout=aiohttp.ClientTimeout(total=2),
+                        ) as resp:
+                            if resp.status != 200:
+                                continue
+                            body = await resp.text()
+                    except (aiohttp.ClientError, OSError):
+                        continue
+                    for line in body.splitlines():
+                        if line.startswith("#") or not line.strip():
+                            continue
+                        name, _, value = line.partition(" ")
+                        lines.append(
+                            f'{name}{{instance_id="{iid}"}} {value}'
+                        )
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def instance_logs(self, request: web.Request) -> web.Response:
+        sm = self.agent.serve_manager
+        if sm is None:
+            return web.json_response({"error": "not ready"}, status=503)
+        instance_id = int(request.match_info["id"])
+        try:
+            tail = min(
+                TAIL_MAX, int(request.query.get("tail", TAIL_DEFAULT))
+            )
+        except ValueError:
+            return web.json_response(
+                {"error": "tail must be an integer"}, status=400
+            )
+        # log files are named {instance_name}-{id}.log
+        match = None
+        for fname in os.listdir(sm.log_dir):
+            if fname.endswith(f"-{instance_id}.log"):
+                match = os.path.join(sm.log_dir, fname)
+                break
+        if match is None:
+            return web.json_response(
+                {"error": f"no logs for instance {instance_id}"}, status=404
+            )
+        with open(match, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 512 * 1024))
+            text = f.read().decode(errors="replace")
+        lines = text.splitlines()[-tail:]
+        return web.Response(text="\n".join(lines) + "\n")

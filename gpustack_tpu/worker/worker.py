@@ -49,12 +49,31 @@ class WorkerAgent:
         self.serve_manager = ServeManager(
             self.cfg, self.client, self.worker_id
         )
+        from gpustack_tpu.worker.benchmark_manager import BenchmarkManager
+        from gpustack_tpu.worker.server import WorkerServer
+
+        self.benchmark_manager = BenchmarkManager(
+            self.client, self.worker_id
+        )
+        self.http = WorkerServer(self)
+        try:
+            await self.http.start("0.0.0.0", self.cfg.worker_port)
+        except OSError as e:
+            logger.warning(
+                "worker http port %d unavailable (%s); logs/metrics "
+                "endpoints disabled", self.cfg.worker_port, e,
+            )
+            self.http = None
         # push one status immediately so the scheduler sees chips
         await self._post_status_once()
         self._tasks = [
             asyncio.create_task(self._heartbeat_loop(), name="wk-heartbeat"),
             asyncio.create_task(self._status_loop(), name="wk-status"),
             asyncio.create_task(self._watch_instances(), name="wk-watch"),
+            asyncio.create_task(self._watch_benchmarks(), name="wk-bench"),
+            asyncio.create_task(
+                self.benchmark_manager.rescan_loop(), name="wk-bench-rescan"
+            ),
         ]
         logger.info(
             "worker %s (id=%d) started", self.worker_name, self.worker_id
@@ -70,6 +89,8 @@ class WorkerAgent:
             t.cancel()
         if self.serve_manager:
             await self.serve_manager.stop_all()
+        if getattr(self, "http", None):
+            await self.http.stop()
         if self.client:
             await self.client.close()
 
@@ -135,3 +156,12 @@ class WorkerAgent:
                 raise
             except Exception:
                 logger.exception("serve manager failed on %s", event.type)
+
+    async def _watch_benchmarks(self) -> None:
+        async for event in self.client.watch("benchmarks"):
+            try:
+                await self.benchmark_manager.handle_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("benchmark manager failed on %s", event.type)

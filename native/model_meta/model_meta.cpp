@@ -1,0 +1,403 @@
+// model-meta: checkpoint metadata parser + HBM estimator CLI.
+//
+// The TPU-native replacement for the reference's gguf-parser Go binary
+// (reference gpustack/worker/tools_manager.py:19 downloads it;
+// scheduler/calculator.py:550-566 shells out for layer-wise VRAM
+// estimates). The scheduler shells out to this tool when a local
+// checkpoint directory exists, getting exact tensor sizes instead of
+// config-derived estimates.
+//
+// Supported formats:
+//   - safetensors: 8-byte LE header length + JSON header of
+//     {name: {dtype, shape, data_offsets}}
+//   - gguf (metadata only): magic "GGUF", version, tensor/kv counts and
+//     per-tensor dtype/shape records — enough for weight-byte accounting
+//
+// Usage:
+//   model-meta <model_dir | file.safetensors | file.gguf>
+//
+// Output: one JSON object on stdout:
+//   {"format": "...", "files": N, "tensors": N, "total_bytes": N,
+//    "params": N, "bytes_by_dtype": {...}, "max_layer_bytes": N}
+//
+// No third-party deps: the JSON subset emitted by safetensors writers is
+// parsed with a small recursive-descent parser below.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <map>
+#include <regex>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+struct TensorInfo {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  int64_t bytes = 0;
+};
+
+int64_t dtype_bits(const std::string &dt) {
+  if (dt == "F64" || dt == "I64" || dt == "U64") return 64;
+  if (dt == "F32" || dt == "I32" || dt == "U32") return 32;
+  if (dt == "F16" || dt == "BF16" || dt == "I16" || dt == "U16") return 16;
+  if (dt == "F8_E4M3" || dt == "F8_E5M2" || dt == "I8" || dt == "U8")
+    return 8;
+  if (dt == "BOOL") return 8;
+  if (dt == "F4" || dt == "I4" || dt == "U4") return 4;
+  return 16;  // conservative default
+}
+
+// ---- minimal JSON parser (objects/arrays/strings/numbers) ----------------
+
+struct JsonParser {
+  const char *p, *end;
+  explicit JsonParser(const std::string &s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool consume(char c) {
+    skip_ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+  std::string parse_string() {
+    skip_ws();
+    std::string out;
+    if (p >= end || *p != '"') return out;
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          default: out += *p;
+        }
+      } else {
+        out += *p;
+      }
+      ++p;
+    }
+    if (p < end) ++p;  // closing quote
+    return out;
+  }
+  double parse_number() {
+    skip_ws();
+    char *np = nullptr;
+    double v = strtod(p, &np);
+    p = np;
+    return v;
+  }
+  // skip any value (used for fields we don't care about)
+  void skip_value() {
+    skip_ws();
+    if (p >= end) return;
+    if (*p == '"') {
+      parse_string();
+    } else if (*p == '{') {
+      ++p;
+      skip_ws();
+      if (consume('}')) return;
+      do {
+        parse_string();
+        consume(':');
+        skip_value();
+      } while (consume(','));
+      consume('}');
+    } else if (*p == '[') {
+      ++p;
+      skip_ws();
+      if (consume(']')) return;
+      do {
+        skip_value();
+      } while (consume(','));
+      consume(']');
+    } else {
+      // number / true / false / null
+      while (p < end && *p != ',' && *p != '}' && *p != ']') ++p;
+    }
+  }
+};
+
+// ---- safetensors ---------------------------------------------------------
+
+bool parse_safetensors(const std::string &path,
+                       std::vector<TensorInfo> &tensors) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  uint64_t header_len = 0;
+  f.read(reinterpret_cast<char *>(&header_len), 8);
+  if (!f || header_len == 0 || header_len > (1ull << 31)) return false;
+  std::string header(header_len, '\0');
+  f.read(header.data(), header_len);
+  if (!f) return false;
+
+  JsonParser jp(header);
+  if (!jp.consume('{')) return false;
+  if (jp.consume('}')) return true;
+  do {
+    std::string name = jp.parse_string();
+    jp.consume(':');
+    if (name == "__metadata__") {
+      jp.skip_value();
+      continue;
+    }
+    TensorInfo ti;
+    ti.name = name;
+    if (!jp.consume('{')) return false;
+    if (!jp.consume('}')) {
+      do {
+        std::string key = jp.parse_string();
+        jp.consume(':');
+        if (key == "dtype") {
+          ti.dtype = jp.parse_string();
+        } else if (key == "shape") {
+          jp.consume('[');
+          jp.skip_ws();
+          if (*jp.p != ']') {
+            do {
+              ti.shape.push_back(
+                  static_cast<int64_t>(jp.parse_number()));
+            } while (jp.consume(','));
+          }
+          jp.consume(']');
+        } else if (key == "data_offsets") {
+          jp.consume('[');
+          int64_t begin = static_cast<int64_t>(jp.parse_number());
+          jp.consume(',');
+          int64_t fin = static_cast<int64_t>(jp.parse_number());
+          jp.consume(']');
+          ti.bytes = fin - begin;
+        } else {
+          jp.skip_value();
+        }
+      } while (jp.consume(','));
+      jp.consume('}');
+    }
+    if (ti.bytes == 0 && !ti.shape.empty()) {
+      int64_t n = 1;
+      for (int64_t d : ti.shape) n *= d;
+      ti.bytes = n * dtype_bits(ti.dtype) / 8;
+    }
+    tensors.push_back(std::move(ti));
+  } while (jp.consume(','));
+  return true;
+}
+
+// ---- gguf (metadata header only) ----------------------------------------
+
+struct GGUFReader {
+  std::ifstream f;
+  template <typename T> T rd() {
+    T v{};
+    f.read(reinterpret_cast<char *>(&v), sizeof(T));
+    return v;
+  }
+  std::string rd_str() {
+    uint64_t n = rd<uint64_t>();
+    if (n > (1u << 20)) return "";
+    std::string s(n, '\0');
+    f.read(s.data(), n);
+    return s;
+  }
+  void skip_value(uint32_t type);
+};
+
+void GGUFReader::skip_value(uint32_t type) {
+  switch (type) {
+    case 0: case 1: case 7: f.seekg(1, std::ios::cur); break;   // u8/i8/bool
+    case 2: case 3: f.seekg(2, std::ios::cur); break;           // u16/i16
+    case 4: case 5: case 6: f.seekg(4, std::ios::cur); break;   // u32/i32/f32
+    case 10: case 11: case 12: f.seekg(8, std::ios::cur); break;// u64/i64/f64
+    case 8: rd_str(); break;                                    // string
+    case 9: {                                                   // array
+      uint32_t elem_type = rd<uint32_t>();
+      uint64_t count = rd<uint64_t>();
+      for (uint64_t i = 0; i < count && f; ++i) skip_value(elem_type);
+      break;
+    }
+    default: f.setstate(std::ios::failbit);
+  }
+}
+
+// bits per element for common ggml quant types (id -> (bits, block))
+double gguf_type_bits(uint32_t t) {
+  switch (t) {
+    case 0: return 32;      // F32
+    case 1: return 16;      // F16
+    case 2: return 4.5;     // Q4_0
+    case 3: return 5;       // Q4_1
+    case 6: return 5.5;     // Q5_0
+    case 7: return 6;       // Q5_1
+    case 8: return 8.5;     // Q8_0
+    case 10: return 2.56;   // Q2_K
+    case 11: return 3.44;   // Q3_K
+    case 12: return 4.5;    // Q4_K
+    case 13: return 5.5;    // Q5_K
+    case 14: return 6.56;   // Q6_K
+    case 16: return 2.06;   // IQ2_XXS
+    case 30: return 16;     // BF16
+    default: return 8;
+  }
+}
+
+bool parse_gguf(const std::string &path, std::vector<TensorInfo> &tensors) {
+  GGUFReader r;
+  r.f.open(path, std::ios::binary);
+  if (!r.f) return false;
+  char magic[4];
+  r.f.read(magic, 4);
+  if (memcmp(magic, "GGUF", 4) != 0) return false;
+  uint32_t version = r.rd<uint32_t>();
+  if (version < 2 || version > 3) return false;
+  uint64_t n_tensors = r.rd<uint64_t>();
+  uint64_t n_kv = r.rd<uint64_t>();
+  for (uint64_t i = 0; i < n_kv && r.f; ++i) {
+    r.rd_str();                       // key
+    uint32_t type = r.rd<uint32_t>();
+    r.skip_value(type);
+  }
+  for (uint64_t i = 0; i < n_tensors && r.f; ++i) {
+    TensorInfo ti;
+    ti.name = r.rd_str();
+    uint32_t ndim = r.rd<uint32_t>();
+    int64_t n = 1;
+    for (uint32_t d = 0; d < ndim; ++d) {
+      int64_t dim = r.rd<uint64_t>();
+      ti.shape.push_back(dim);
+      n *= dim;
+    }
+    uint32_t type = r.rd<uint32_t>();
+    r.rd<uint64_t>();                 // offset
+    ti.dtype = "ggml_" + std::to_string(type);
+    ti.bytes = static_cast<int64_t>(n * gguf_type_bits(type) / 8.0);
+    tensors.push_back(std::move(ti));
+  }
+  return r.f.good();
+}
+
+// ---- aggregation ---------------------------------------------------------
+
+int64_t param_count(const TensorInfo &t) {
+  int64_t n = 1;
+  for (int64_t d : t.shape) n *= d;
+  return n;
+}
+
+// "model.layers.17.self_attn.q_proj.weight" -> 17, else -1
+int layer_index(const std::string &name) {
+  static const std::regex re(R"((?:^|\.)(?:layers|blk|h)\.(\d+)\.)");
+  std::smatch m;
+  if (std::regex_search(name, m, re)) return std::stoi(m[1]);
+  return -1;
+}
+
+std::string json_escape(const std::string &s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    out += c;
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: model-meta <model_dir|file>\n");
+    return 2;
+  }
+  std::string arg = argv[1];
+  std::vector<std::string> files;
+  struct stat st{};
+  if (stat(arg.c_str(), &st) != 0) {
+    fprintf(stderr, "model-meta: cannot stat %s\n", arg.c_str());
+    return 2;
+  }
+  if (S_ISDIR(st.st_mode)) {
+    DIR *d = opendir(arg.c_str());
+    if (!d) return 2;
+    while (dirent *e = readdir(d)) {
+      std::string n = e->d_name;
+      if (n.size() > 12 &&
+          n.compare(n.size() - 12, 12, ".safetensors") == 0)
+        files.push_back(arg + "/" + n);
+      else if (n.size() > 5 && n.compare(n.size() - 5, 5, ".gguf") == 0)
+        files.push_back(arg + "/" + n);
+    }
+    closedir(d);
+  } else {
+    files.push_back(arg);
+  }
+  if (files.empty()) {
+    fprintf(stderr, "model-meta: no checkpoint files in %s\n", arg.c_str());
+    return 1;
+  }
+
+  std::string format;
+  std::vector<TensorInfo> tensors;
+  for (const std::string &f : files) {
+    bool ok;
+    if (f.size() > 5 && f.compare(f.size() - 5, 5, ".gguf") == 0) {
+      ok = parse_gguf(f, tensors);
+      format = "gguf";
+    } else {
+      ok = parse_safetensors(f, tensors);
+      format = format.empty() ? "safetensors" : format;
+    }
+    if (!ok) {
+      fprintf(stderr, "model-meta: failed to parse %s\n", f.c_str());
+      return 1;
+    }
+  }
+
+  int64_t total_bytes = 0, params = 0;
+  std::map<std::string, int64_t> by_dtype;
+  std::map<int, int64_t> by_layer;
+  int64_t non_layer_bytes = 0;
+  for (const auto &t : tensors) {
+    total_bytes += t.bytes;
+    params += param_count(t);
+    by_dtype[t.dtype] += t.bytes;
+    int li = layer_index(t.name);
+    if (li >= 0)
+      by_layer[li] += t.bytes;
+    else
+      non_layer_bytes += t.bytes;
+  }
+  int64_t max_layer = 0;
+  for (auto &kv : by_layer) max_layer = std::max(max_layer, kv.second);
+
+  printf("{\"format\": \"%s\", \"files\": %zu, \"tensors\": %zu, "
+         "\"total_bytes\": %lld, \"params\": %lld, \"layers\": %zu, "
+         "\"max_layer_bytes\": %lld, \"non_layer_bytes\": %lld, "
+         "\"bytes_by_dtype\": {",
+         format.c_str(), files.size(), tensors.size(),
+         static_cast<long long>(total_bytes),
+         static_cast<long long>(params), by_layer.size(),
+         static_cast<long long>(max_layer),
+         static_cast<long long>(non_layer_bytes));
+  bool first = true;
+  for (auto &kv : by_dtype) {
+    printf("%s\"%s\": %lld", first ? "" : ", ",
+           json_escape(kv.first).c_str(),
+           static_cast<long long>(kv.second));
+    first = false;
+  }
+  printf("}}\n");
+  return 0;
+}

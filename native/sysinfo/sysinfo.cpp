@@ -1,0 +1,108 @@
+// sysinfo: host system probe with a stable JSON contract.
+//
+// Replaces the reference's fastfetch binary dependency (reference
+// gpustack/detectors/fastfetch/fastfetch.py wraps a downloaded C binary
+// for OS/CPU/memory/kernel detection; worker/tools_manager.py:19 fetches
+// it). Zero dependencies: reads /proc and uname directly.
+//
+// Output: one JSON object on stdout:
+//   {"hostname": ..., "os": ..., "kernel": ..., "arch": ...,
+//    "cpu_count": N, "cpu_model": ..., "memory_total_bytes": N,
+//    "memory_available_bytes": N, "uptime_seconds": N,
+//    "tpu_devices": N, "tpu_accelerator_type": ..., "tpu_topology": ...}
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <string>
+#include <sys/utsname.h>
+#include <thread>
+#include <unistd.h>
+
+namespace {
+
+std::string json_escape(const std::string &s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out += '\\';
+    if (c >= 0x20 || c == '\t') out += c;
+  }
+  return out;
+}
+
+long long meminfo_kb(const char *key) {
+  std::ifstream f("/proc/meminfo");
+  std::string line;
+  size_t keylen = strlen(key);
+  while (std::getline(f, line)) {
+    if (line.compare(0, keylen, key) == 0 && line[keylen] == ':') {
+      return atoll(line.c_str() + keylen + 1);
+    }
+  }
+  return 0;
+}
+
+std::string cpu_model() {
+  std::ifstream f("/proc/cpuinfo");
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.compare(0, 10, "model name") == 0) {
+      size_t pos = line.find(':');
+      if (pos != std::string::npos) {
+        size_t start = line.find_first_not_of(" \t", pos + 1);
+        return start == std::string::npos ? "" : line.substr(start);
+      }
+    }
+  }
+  return "";
+}
+
+double uptime_seconds() {
+  std::ifstream f("/proc/uptime");
+  double up = 0;
+  f >> up;
+  return up;
+}
+
+int count_tpu_devices() {
+  int n = 0;
+  if (DIR *d = opendir("/dev")) {
+    while (dirent *e = readdir(d)) {
+      if (strncmp(e->d_name, "accel", 5) == 0) ++n;
+    }
+    closedir(d);
+  }
+  return n;
+}
+
+std::string getenv_str(const char *name) {
+  const char *v = getenv(name);
+  return v ? v : "";
+}
+
+}  // namespace
+
+int main() {
+  utsname uts{};
+  uname(&uts);
+  char hostname[256] = {0};
+  gethostname(hostname, sizeof(hostname) - 1);
+
+  printf(
+      "{\"hostname\": \"%s\", \"os\": \"%s\", \"kernel\": \"%s\", "
+      "\"arch\": \"%s\", \"cpu_count\": %u, \"cpu_model\": \"%s\", "
+      "\"memory_total_bytes\": %lld, \"memory_available_bytes\": %lld, "
+      "\"uptime_seconds\": %.0f, \"tpu_devices\": %d, "
+      "\"tpu_accelerator_type\": \"%s\", \"tpu_topology\": \"%s\"}\n",
+      json_escape(hostname).c_str(), json_escape(uts.sysname).c_str(),
+      json_escape(uts.release).c_str(), json_escape(uts.machine).c_str(),
+      std::thread::hardware_concurrency(),
+      json_escape(cpu_model()).c_str(), meminfo_kb("MemTotal") * 1024,
+      meminfo_kb("MemAvailable") * 1024, uptime_seconds(),
+      count_tpu_devices(),
+      json_escape(getenv_str("TPU_ACCELERATOR_TYPE")).c_str(),
+      json_escape(getenv_str("TPU_TOPOLOGY")).c_str());
+  return 0;
+}

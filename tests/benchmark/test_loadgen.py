@@ -1,0 +1,55 @@
+"""Load generator against a live tiny engine over a real socket."""
+
+import asyncio
+
+import jax
+import pytest
+
+from gpustack_tpu.benchmark.loadgen import run_load_test
+from gpustack_tpu.benchmark.profiles import PROFILES
+from gpustack_tpu.engine.api_server import OpenAIServer
+from gpustack_tpu.engine.engine import LLMEngine
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(cfg, params, max_slots=2, max_seq_len=512)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_loadgen_smoke_profile(engine):
+    from aiohttp.test_utils import TestServer
+
+    server = OpenAIServer(engine, model_name="tiny-bench")
+
+    async def go():
+        ts = TestServer(server.app)
+        await ts.start_server()
+        try:
+            report = await run_load_test(
+                base_url=str(ts.make_url("")).rstrip("/"),
+                model="tiny-bench",
+                profile=PROFILES["smoke"],
+                concurrency=4,
+            )
+        finally:
+            await ts.close()
+        return report
+
+    report = asyncio.run(go())
+    m = report.metrics
+    assert m.error_count == 0, report.to_raw()
+    assert m.output_tok_per_s > 0, m
+    assert m.ttft_ms_p50 > 0
+    assert m.tpot_ms_mean >= 0
+    assert m.requests_per_second > 0
+    ok = [r for r in report.results if r.ok]
+    assert all(r.completion_tokens > 0 for r in ok), [
+        (r.prompt_tokens, r.completion_tokens) for r in ok
+    ]

@@ -93,7 +93,7 @@ def test_deploy_and_infer(tmp_path):
                         "name": "tiny-chat",
                         "preset": "tiny",
                         "replicas": 1,
-                        "max_seq_len": 128,
+                        "max_seq_len": 512,
                         "max_slots": 2,
                     },
                 ) as r:
@@ -159,6 +159,49 @@ def test_deploy_and_infer(tmp_path):
                 ) as r:
                     usage = (await r.json())["items"]
                 assert usage and usage[0]["total_tokens"] > 0
+
+                # run a smoke benchmark against the running instance
+                async with http.post(
+                    f"{base}/v2/benchmarks",
+                    headers=hdrs,
+                    json={
+                        "name": "bench-tiny",
+                        "model_id": model["id"],
+                        "profile": "smoke",
+                    },
+                ) as r:
+                    assert r.status == 201, await r.text()
+                    bench = await r.json()
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/benchmarks/{bench['id']}", headers=hdrs
+                    ) as r:
+                        bench = await r.json()
+                    if bench["state"] in ("completed", "error"):
+                        break
+                    await asyncio.sleep(1.0)
+                assert bench["state"] == "completed", bench
+                assert bench["metrics"]["output_tok_per_s"] > 0
+                assert bench["metrics"]["ttft_ms_p50"] > 0
+                assert bench["metrics"]["error_count"] == 0
+
+                # server prometheus metrics
+                async with http.get(f"{base}/metrics") as r:
+                    metrics_text = await r.text()
+                assert 'gpustack_model_instances{state="running"} 1' in (
+                    metrics_text
+                )
+                assert "gpustack_usage_total_tokens" in metrics_text
+
+                # instance logs proxied through server -> worker
+                async with http.get(
+                    f"{base}/v2/model-instances/{inst['id']}/logs",
+                    headers=hdrs,
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    logs = await r.text()
+                assert "Running on" in logs or "engine" in logs.lower()
 
                 # scale to zero retires the instance
                 async with http.patch(

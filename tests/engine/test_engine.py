@@ -224,6 +224,21 @@ def test_quantized_params_close_and_smaller():
     assert int(jnp.argmax(out[0, -1])) == int(jnp.argmax(ref[0, -1]))
 
 
+def test_init_quantized_params_matches_structure():
+    from gpustack_tpu.models.quant import init_quantized_params
+
+    cfg = get_config("tiny-moe")
+    ref = quantize_params(init_params(cfg, jax.random.key(0)))
+    fast = init_quantized_params(cfg, seed=0)
+    ref_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), ref)
+    fast_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), fast)
+    assert ref_shapes == fast_shapes
+    toks = jnp.asarray([[1, 2, 3]], jnp.int32)
+    pos = jnp.arange(3, dtype=jnp.int32)[None, :]
+    logits, _ = forward(fast, cfg, toks, pos)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_quantized_engine_generates():
     cfg = get_config("tiny")
     params = quantize_params(init_params(cfg, jax.random.key(0)))

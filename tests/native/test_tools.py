@@ -1,0 +1,143 @@
+"""Native C++ tool tests: build via make, exercise the JSON contracts."""
+
+import json
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.fixture(scope="module")
+def tools():
+    native = os.path.join(REPO_ROOT, "native")
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in environment")
+    subprocess.run(["make", "-C", native], check=True, capture_output=True)
+    return os.path.join(native, "bin")
+
+
+def test_sysinfo_contract(tools):
+    out = subprocess.run(
+        [os.path.join(tools, "sysinfo")], capture_output=True, check=True
+    )
+    data = json.loads(out.stdout)
+    assert data["os"] == "Linux"
+    assert data["cpu_count"] >= 1
+    assert data["memory_total_bytes"] > 2**30
+    assert "tpu_devices" in data
+
+
+def test_model_meta_safetensors(tools, tmp_path):
+    from safetensors.numpy import save_file
+
+    save_file(
+        {
+            "model.embed_tokens.weight": np.zeros((128, 32), np.float32),
+            "model.layers.0.self_attn.q_proj.weight": np.zeros(
+                (32, 32), np.float16
+            ),
+            "model.layers.1.mlp.gate_proj.weight": np.zeros(
+                (32, 64), np.float16
+            ),
+            "model.norm.weight": np.zeros((32,), np.float32),
+        },
+        str(tmp_path / "model.safetensors"),
+    )
+    out = subprocess.run(
+        [os.path.join(tools, "model-meta"), str(tmp_path)],
+        capture_output=True,
+        check=True,
+    )
+    data = json.loads(out.stdout)
+    assert data["format"] == "safetensors"
+    assert data["tensors"] == 4
+    assert data["layers"] == 2
+    expected = 128 * 32 * 4 + 32 * 32 * 2 + 32 * 64 * 2 + 32 * 4
+    assert data["total_bytes"] == expected
+    assert data["params"] == 128 * 32 + 32 * 32 + 32 * 64 + 32
+    assert data["bytes_by_dtype"]["F16"] == 32 * 32 * 2 + 32 * 64 * 2
+
+
+def test_model_meta_gguf(tools, tmp_path):
+    """Hand-crafted minimal GGUF v3 header with one F16 tensor."""
+    path = tmp_path / "m.gguf"
+    name = b"blk.0.attn_q.weight"
+    buf = b"GGUF"
+    buf += struct.pack("<I", 3)          # version
+    buf += struct.pack("<Q", 1)          # n_tensors
+    buf += struct.pack("<Q", 1)          # n_kv
+    # kv: "general.name" = string "test"
+    key = b"general.name"
+    buf += struct.pack("<Q", len(key)) + key
+    buf += struct.pack("<I", 8)          # type string
+    buf += struct.pack("<Q", 4) + b"test"
+    # tensor record
+    buf += struct.pack("<Q", len(name)) + name
+    buf += struct.pack("<I", 2)          # ndim
+    buf += struct.pack("<Q", 64) + struct.pack("<Q", 64)
+    buf += struct.pack("<I", 1)          # F16
+    buf += struct.pack("<Q", 0)          # offset
+    path.write_bytes(buf)
+    out = subprocess.run(
+        [os.path.join(tools, "model-meta"), str(path)],
+        capture_output=True,
+        check=True,
+    )
+    data = json.loads(out.stdout)
+    assert data["format"] == "gguf"
+    assert data["tensors"] == 1
+    assert data["params"] == 64 * 64
+    assert data["total_bytes"] == 64 * 64 * 2
+    assert data["layers"] == 1
+
+
+def test_model_meta_missing_dir(tools, tmp_path):
+    out = subprocess.run(
+        [os.path.join(tools, "model-meta"), str(tmp_path / "nope")],
+        capture_output=True,
+    )
+    assert out.returncode != 0
+
+
+def test_calculator_uses_native_meta(tools, tmp_path):
+    """evaluate_model picks exact on-disk bytes over config estimates."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from safetensors.numpy import save_file
+
+    from gpustack_tpu.models.config import get_config
+    from gpustack_tpu.scheduler.calculator import evaluate_model
+    from gpustack_tpu.schemas import Model
+
+    cfg = get_config("tiny")
+    # a fake checkpoint dir with a config.json + one small tensor
+    import json as _json
+
+    (tmp_path / "config.json").write_text(
+        _json.dumps(
+            {
+                "architectures": ["LlamaForCausalLM"],
+                "hidden_size": cfg.hidden_size,
+                "intermediate_size": cfg.intermediate_size,
+                "num_hidden_layers": cfg.num_layers,
+                "num_attention_heads": cfg.num_heads,
+                "num_key_value_heads": cfg.num_kv_heads,
+                "head_dim": cfg.head_dim,
+                "vocab_size": cfg.vocab_size,
+            }
+        )
+    )
+    save_file(
+        {"model.embed_tokens.weight": np.zeros((1000, 10), np.float16)},
+        str(tmp_path / "model.safetensors"),
+    )
+    ev = evaluate_model(Model(name="m", local_path=str(tmp_path)))
+    assert ev.weight_bytes == 1000 * 10 * 2

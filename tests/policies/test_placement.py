@@ -1,0 +1,156 @@
+"""Placement policies over fake TPU fleets: filters, candidates
+(single-worker + complete-slice multi-host), scorers.
+
+Mirrors the reference's selector test style: assemble a fleet from
+fixtures, assert exact placements (tests/policies/candidate_selectors/*,
+helper compare_candidates)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from utils.fleet import make_worker, v5e_8, v5e_32_host  # noqa: E402
+
+from gpustack_tpu.policies import (  # noqa: E402
+    build_candidates,
+    filter_workers,
+    score_candidates,
+    worker_allocatable_chips,
+)
+from gpustack_tpu.schemas import (  # noqa: E402
+    ComputedResourceClaim,
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    PlacementStrategy,
+    SubordinateWorker,
+    WorkerState,
+)
+
+
+def _claim(chips: int) -> ComputedResourceClaim:
+    return ComputedResourceClaim(chips=chips, mesh_plan=f"tp{chips}")
+
+
+def _placed(worker_id, chip_indexes, model_id=9, state=None):
+    inst = ModelInstance(
+        name=f"placed-{worker_id}-{chip_indexes[0]}",
+        model_id=model_id,
+        worker_id=worker_id,
+        chip_indexes=chip_indexes,
+        state=state or ModelInstanceState.RUNNING,
+    )
+    return inst
+
+
+def test_filters_drop_unready_mismatched():
+    model = Model(name="m", cluster_id=1, worker_selector={"pool": "a"})
+    fleet = [
+        v5e_8(1, labels={"pool": "a"}),
+        v5e_8(2, labels={"pool": "b"}),
+        v5e_8(3, labels={"pool": "a"}, state=WorkerState.UNREACHABLE),
+        make_worker(4, chips=0, labels={"pool": "a"}),
+        v5e_8(5, labels={"pool": "a"}, cluster_id=2),
+    ]
+    ok, reasons = filter_workers(fleet, model)
+    assert [w.id for w in ok] == [1]
+    assert len(reasons) == 4
+
+
+def test_allocatable_subtracts_claims():
+    w = v5e_8(1)
+    instances = [
+        _placed(1, [0, 1]),
+        _placed(1, [2], state=ModelInstanceState.SCHEDULED),
+        _placed(2, [0]),                     # other worker
+        ModelInstance(                       # ERROR doesn't claim
+            name="err", worker_id=1, chip_indexes=[5],
+            state=ModelInstanceState.ERROR,
+        ),
+    ]
+    assert worker_allocatable_chips(w, instances) == [3, 4, 5, 6, 7]
+
+
+def test_single_worker_candidates():
+    model = Model(name="m")
+    fleet = [v5e_8(1), v5e_8(2)]
+    instances = [_placed(1, [0, 1, 2, 3, 4, 5])]
+    cands = build_candidates(model, _claim(4), fleet, instances)
+    # worker 1 has only 2 free -> only worker 2 qualifies
+    assert len(cands) == 1
+    assert cands[0].worker.id == 2
+    assert cands[0].chip_indexes == [0, 1, 2, 3]
+
+
+def test_multihost_candidate_requires_whole_hosts():
+    model = Model(name="m", distributable=True)
+    fleet = [
+        v5e_32_host(1, 0),
+        v5e_32_host(2, 1),
+        v5e_32_host(3, 2),
+        v5e_32_host(4, 3),
+    ]
+    cands = build_candidates(model, _claim(16), fleet, [])
+    assert len(cands) == 1
+    cand = cands[0]
+    assert cand.worker.id == 1                      # host_index 0 leads
+    assert [s.worker_id for s in cand.subordinates] == [2]
+    assert cand.chip_indexes == list(range(8))
+    assert cand.subordinates[0].chip_indexes == list(range(8))
+
+    # a host with anything placed on it cannot join a multi-host replica
+    cands = build_candidates(
+        model, _claim(32), fleet, [_placed(3, [0])]
+    )
+    assert cands == []
+
+
+def test_multihost_disabled_when_not_distributable():
+    model = Model(name="m", distributable=False)
+    fleet = [v5e_32_host(1, 0), v5e_32_host(2, 1)]
+    assert build_candidates(model, _claim(16), fleet, []) == []
+
+
+def test_spread_prefers_emptier_worker():
+    model = Model(name="m", placement_strategy=PlacementStrategy.SPREAD)
+    fleet = [v5e_8(1), v5e_8(2)]
+    instances = [_placed(1, [0, 1, 2, 3])]
+    cands = build_candidates(model, _claim(2), fleet, instances)
+    best = score_candidates(cands, model, instances, [])[0]
+    assert best.worker.id == 2
+
+
+def test_binpack_prefers_fuller_worker():
+    model = Model(name="m", placement_strategy=PlacementStrategy.BINPACK)
+    fleet = [v5e_8(1), v5e_8(2)]
+    instances = [_placed(1, [0, 1, 2, 3])]
+    cands = build_candidates(model, _claim(2), fleet, instances)
+    best = score_candidates(cands, model, instances, [])[0]
+    assert best.worker.id == 1
+
+
+def test_spread_anti_affinity_same_model():
+    model = Model(name="m", placement_strategy=PlacementStrategy.SPREAD)
+    model.id = 7
+    fleet = [v5e_8(1), v5e_8(2)]
+    # equal utilization, but worker 1 already holds a replica of model 7
+    instances = [
+        _placed(1, [0], model_id=7),
+        _placed(2, [0], model_id=8),
+    ]
+    cands = build_candidates(model, _claim(2), fleet, instances)
+    best = score_candidates(cands, model, instances, [])[0]
+    assert best.worker.id == 2
+
+
+def test_subordinate_chips_count_against_allocatable():
+    w2 = v5e_32_host(2, 1)
+    inst = ModelInstance(
+        name="mh", worker_id=1, chip_indexes=list(range(8)),
+        state=ModelInstanceState.RUNNING,
+        subordinate_workers=[
+            SubordinateWorker(worker_id=2, chip_indexes=list(range(8)))
+        ],
+    )
+    assert worker_allocatable_chips(w2, [inst]) == []

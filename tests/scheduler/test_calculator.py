@@ -1,0 +1,114 @@
+"""HBM estimation + chips-per-replica ladder (replaces the reference's
+gguf-parser-driven estimate tests, tests/fixtures/estimates/**)."""
+
+import pytest
+
+from gpustack_tpu.scheduler.calculator import (
+    EvaluationError,
+    chips_for_claim,
+    evaluate_model,
+    resolve_model_config,
+)
+from gpustack_tpu.schemas import Model
+
+_GIB = 2**30
+
+
+def test_llama3_8b_bf16_needs_two_v5e_chips():
+    model = Model(
+        name="m", preset="llama3-8b", max_seq_len=2048, max_slots=8
+    )
+    ev = evaluate_model(model)
+    # 8.03B params * 2 bytes ≈ 16.06 GB = 14.96 GiB
+    assert 14.5 * _GIB < ev.weight_bytes < 15.5 * _GIB
+    claim = chips_for_claim(ev, hbm_per_chip=16 * _GIB, max_chips=8)
+    assert claim is not None
+    assert claim.chips == 2
+    assert "tp2" in claim.mesh_plan
+
+
+def test_llama3_8b_int8_fits_one_chip():
+    model = Model(
+        name="m", preset="llama3-8b", quantization="int8",
+        max_seq_len=2048, max_slots=8,
+    )
+    ev = evaluate_model(model)
+    claim = chips_for_claim(ev, hbm_per_chip=16 * _GIB, max_chips=8)
+    assert claim is not None and claim.chips == 1
+
+
+def test_llama3_70b_needs_multihost_on_v5e():
+    model = Model(
+        name="m", preset="llama3-70b", max_seq_len=2048, max_slots=8
+    )
+    ev = evaluate_model(model)
+    # no fit within one 8-chip host
+    assert chips_for_claim(ev, hbm_per_chip=16 * _GIB, max_chips=8) is None
+    claim = chips_for_claim(ev, hbm_per_chip=16 * _GIB, max_chips=32)
+    assert claim is not None
+    assert claim.chips == 16
+    assert "tp8" in claim.mesh_plan  # kv_heads=8 caps TP at 8
+
+
+def test_explicit_mesh_plan_respected():
+    model = Model(name="m", preset="llama3-8b", quantization="int8")
+    ev = evaluate_model(model)
+    claim = chips_for_claim(
+        ev, hbm_per_chip=16 * _GIB, max_chips=8,
+        explicit_plan="dp2xtp4",
+    )
+    assert claim is not None
+    assert claim.chips == 8
+    assert claim.mesh_plan == "dp2xsp1xep1xtp4"
+
+
+def test_explicit_chip_count_that_cannot_fit():
+    model = Model(name="m", preset="llama3-70b", max_seq_len=2048)
+    ev = evaluate_model(model)
+    assert (
+        chips_for_claim(
+            ev, hbm_per_chip=16 * _GIB, max_chips=32, explicit_chips=2
+        )
+        is None
+    )
+
+
+def test_moe_plan_uses_ep():
+    model = Model(
+        name="m", preset="mixtral-8x7b", quantization="int8",
+        max_seq_len=2048, max_slots=4,
+    )
+    ev = evaluate_model(model)
+    claim = chips_for_claim(ev, hbm_per_chip=95 * _GIB, max_chips=4)
+    assert claim is not None
+    assert claim.chips == 1  # ~47 GB int8 fits one v5p chip
+
+    claim = chips_for_claim(ev, hbm_per_chip=16 * _GIB, max_chips=8)
+    assert claim is not None and claim.chips == 4
+    assert "ep2" in claim.mesh_plan and "tp2" in claim.mesh_plan
+
+
+def test_long_context_plan_uses_sp():
+    model = Model(
+        name="m", preset="llama3-8b", quantization="int8",
+        max_seq_len=32768, max_slots=4,
+    )
+    ev = evaluate_model(model)
+    claim = chips_for_claim(
+        ev, hbm_per_chip=16 * _GIB, max_chips=8, long_context=True
+    )
+    assert claim is not None
+    # kv cache alone: 32k * 4 slots * 128 KiB/token = 16 GiB -> multi-chip
+    assert claim.chips >= 2
+    assert "sp" in claim.mesh_plan and "sp1" not in claim.mesh_plan
+
+
+def test_resolve_errors():
+    with pytest.raises(EvaluationError, match="unknown preset"):
+        resolve_model_config(Model(name="x", preset="nope"))
+    with pytest.raises(EvaluationError, match="no source"):
+        resolve_model_config(Model(name="x"))
+    with pytest.raises(EvaluationError, match="cached locally"):
+        resolve_model_config(
+            Model(name="x", huggingface_repo_id="meta/llama")
+        )

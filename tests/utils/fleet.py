@@ -1,0 +1,76 @@
+"""Fake TPU fleet builders (the reference's fixture doctrine: 40+ worker
+JSON fixtures assembled into clusters, tests/fixtures/workers/fixtures.py —
+here as programmatic builders over the TPU device model)."""
+
+from typing import List, Optional
+
+from gpustack_tpu.schemas import (
+    SliceTopology,
+    TPUChip,
+    Worker,
+    WorkerState,
+    WorkerStatus,
+)
+
+_GIB = 2**30
+
+
+def make_worker(
+    id: int,
+    name: str = "",
+    chips: int = 8,
+    hbm_gib: int = 16,
+    chip_type: str = "v5e",
+    state: WorkerState = WorkerState.READY,
+    labels: Optional[dict] = None,
+    ici_domain: str = "",
+    num_hosts: int = 1,
+    host_index: int = 0,
+    topology: str = "",
+    cluster_id: int = 1,
+) -> Worker:
+    w = Worker(
+        name=name or f"worker-{id}",
+        ip=f"10.0.0.{id}",
+        cluster_id=cluster_id,
+        state=state,
+        labels=labels or {},
+        status=WorkerStatus(
+            chips=[
+                TPUChip(
+                    index=i, chip_type=chip_type, hbm_bytes=hbm_gib * _GIB
+                )
+                for i in range(chips)
+            ],
+            slice=SliceTopology(
+                topology=topology,
+                chips_per_host=chips,
+                num_hosts=num_hosts,
+                host_index=host_index,
+                ici_domain=ici_domain,
+            ),
+        ),
+    )
+    w.id = id
+    return w
+
+
+def v5e_8(id: int, **kw) -> Worker:
+    return make_worker(id, chips=8, hbm_gib=16, topology="2x4", **kw)
+
+
+def v5e_32_host(id: int, host_index: int, domain: str = "s32") -> Worker:
+    """One host of a 4-host v5e-32 slice."""
+    return make_worker(
+        id,
+        chips=8,
+        hbm_gib=16,
+        topology="4x8",
+        num_hosts=4,
+        host_index=host_index,
+        ici_domain=domain,
+    )
+
+
+def v5p_host(id: int, **kw) -> Worker:
+    return make_worker(id, chips=4, hbm_gib=95, chip_type="v5p", **kw)
