@@ -51,6 +51,7 @@ class OpenAIServer:
                 web.get("/v1/models", self.models),
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/embeddings", self.embeddings),
                 web.get("/metrics", self.metrics),
             ]
         )
@@ -118,6 +119,62 @@ class OpenAIServer:
         except Exception as e:  # tokenizer/template errors are client errors
             return _error(400, f"chat template failed: {e}")
         return await self._run(request, body, prompt_ids, chat=True)
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        inputs = body.get("input")
+        if inputs is None:
+            return _error(400, "missing 'input'")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        # OpenAI also allows a bare token array / list of token arrays
+        if inputs and all(isinstance(x, int) for x in inputs):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not inputs:
+            return _error(400, "'input' must be a string or list")
+        batch_ids = []
+        total_tokens = 0
+        for item in inputs:
+            if isinstance(item, str):
+                ids = self.engine.tokenizer.encode(item)
+            elif isinstance(item, list) and all(
+                isinstance(t, int) for t in item
+            ):
+                ids = list(item)           # pre-tokenized input
+            else:
+                return _error(
+                    400,
+                    "'input' items must be strings or token-id arrays",
+                )
+            if not ids:
+                return _error(400, "'input' items must be non-empty")
+            batch_ids.append(ids)
+            total_tokens += len(ids)
+        loop = asyncio.get_running_loop()
+        try:
+            vecs = await loop.run_in_executor(
+                None, self.engine.embed, batch_ids
+            )
+        except ValueError as e:
+            return _error(400, str(e))
+        data = [
+            {"object": "embedding", "index": i, "embedding": vec}
+            for i, vec in enumerate(vecs)
+        ]
+        return web.json_response(
+            {
+                "object": "list",
+                "data": data,
+                "model": self.model_name,
+                "usage": {
+                    "prompt_tokens": total_tokens,
+                    "total_tokens": total_tokens,
+                },
+            }
+        )
 
     # ---- core -----------------------------------------------------------
 
@@ -321,6 +378,8 @@ def build_engine_from_args(args) -> LLMEngine:
         max_slots=args.max_slots,
         max_seq_len=args.max_seq_len,
         plan=plan,
+        speculative=args.speculative,
+        spec_tokens=args.spec_tokens,
     )
 
 
@@ -334,6 +393,8 @@ def main(argv=None) -> None:
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--quantization", choices=["", "int8"], default="")
+    p.add_argument("--speculative", choices=["", "ngram"], default="")
+    p.add_argument("--spec-tokens", type=int, default=4)
     p.add_argument("--mesh-plan", default="", help="e.g. dp1xsp1xep1xtp4")
     p.add_argument("--num-devices", type=int, default=0)
     args = p.parse_args(argv)

@@ -38,6 +38,57 @@ logger = logging.getLogger(__name__)
 _FETCH_LAG = 2  # decode steps in flight before the host inspects tokens
 
 
+def _ngram_propose(ctx: List[int], k: int, n: int = 2) -> List[int]:
+    """Propose up to k continuation tokens: find the latest earlier
+    occurrence of the context's final n-gram and replay what followed
+    (the reference exposes the same idea as vLLM's ngram speculative
+    mode via engine args, vllm.py:531). O(len) reference version — the
+    engine hot loop uses the incremental :class:`_NgramIndex`."""
+    if k <= 0 or len(ctx) < n + 1:
+        return []
+    key = tuple(ctx[-n:])
+    for i in range(len(ctx) - n - 1, -1, -1):
+        if tuple(ctx[i : i + n]) == key:
+            return list(ctx[i + n : i + n + k])
+    return []
+
+
+class _NgramIndex:
+    """Incremental 2-gram index: O(1) proposal lookup per decode step.
+
+    ``prev[g]`` is the end-index of the latest occurrence of 2-gram ``g``
+    *before* its most recent one — exactly what the proposer needs, since
+    the most recent occurrence of the context's final 2-gram is always the
+    context tail itself.
+    """
+
+    def __init__(self, ctx: List[int], n: int = 2):
+        self.n = n
+        self.ctx = list(ctx)
+        self.cur: Dict[tuple, int] = {}
+        self.prev: Dict[tuple, int] = {}
+        for end in range(n, len(self.ctx) + 1):
+            self._register(tuple(self.ctx[end - n : end]), end)
+
+    def _register(self, gram: tuple, end: int) -> None:
+        if gram in self.cur:
+            self.prev[gram] = self.cur[gram]
+        self.cur[gram] = end
+
+    def append(self, token: int) -> None:
+        self.ctx.append(token)
+        if len(self.ctx) >= self.n:
+            self._register(tuple(self.ctx[-self.n:]), len(self.ctx))
+
+    def propose(self, k: int) -> List[int]:
+        if k <= 0 or len(self.ctx) < self.n + 1:
+            return []
+        end = self.prev.get(tuple(self.ctx[-self.n:]))
+        if end is None:
+            return []
+        return self.ctx[end : end + k]
+
+
 @dataclasses.dataclass
 class GenRequest:
     """One generation request (already tokenized)."""
@@ -69,6 +120,7 @@ class GenRequest:
 @dataclasses.dataclass
 class _SlotInfo:
     request: GenRequest
+    ngram: Optional["_NgramIndex"] = None
     # Incremental detokenization state: undecoded token ids are buffered
     # until they decode cleanly (no dangling multibyte sequence), then the
     # text accumulates here — the tokenizer only ever decodes the small
@@ -93,6 +145,8 @@ class LLMEngine:
         plan=None,
         mesh=None,
         seed: int = 0,
+        speculative: str = "",       # "" | "ngram" (forces greedy decode)
+        spec_tokens: int = 4,        # proposals verified per spec step
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -113,6 +167,10 @@ class LLMEngine:
         self._id_counter = itertools.count()
         self._step_count = 0
         self._tokens_generated = 0
+        self.speculative = speculative
+        self.spec_tokens = max(2, spec_tokens)
+        self._spec_hits = 0
+        self._spec_steps = 0
 
     # ---- public API -----------------------------------------------------
 
@@ -120,6 +178,10 @@ class LLMEngine:
         if not req.request_id:
             req.request_id = f"req-{next(self._id_counter)}"
         req.submitted_at = time.time()
+        if self.speculative:
+            # speculative verification is greedy; sampling params are
+            # ignored in this mode (documented engine-level tradeoff)
+            req.temperature = 0.0
         if len(req.prompt_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens >= max_seq_len "
@@ -147,6 +209,30 @@ class LLMEngine:
         if self._thread:
             self._thread.join(timeout=30)
 
+    def embed(self, batch_prompt_ids: List[List[int]]) -> List[List[float]]:
+        """Mean-pooled, l2-normalized embeddings — one batched forward for
+        the whole request. Runs directly on the runner (jax dispatch is
+        thread-safe); sequence and batch dims are bucketed so jit
+        specializations stay bounded."""
+        for ids in batch_prompt_ids:
+            if len(ids) >= self.max_seq_len:
+                raise ValueError(
+                    f"input of {len(ids)} tokens >= max_seq_len "
+                    f"{self.max_seq_len}"
+                )
+        bucket = self.runner.bucket_for(
+            max(1, max(len(i) for i in batch_prompt_ids))
+        )
+        padded = [
+            list(ids) + [0] * (bucket - len(ids))
+            for ids in batch_prompt_ids
+        ]
+        lens = [len(ids) for ids in batch_prompt_ids]
+        vecs = self.runner.embed(padded, lens)
+        import numpy as _np
+
+        return _np.asarray(vecs).tolist()
+
     def health(self) -> Dict[str, Any]:
         return {
             "status": "ok",
@@ -156,6 +242,9 @@ class LLMEngine:
             "waiting": self._waiting.qsize(),
             "steps": self._step_count,
             "tokens_generated": self._tokens_generated,
+            "speculative": self.speculative,
+            "spec_steps": self._spec_steps,
+            "spec_extra_tokens": self._spec_hits,
         }
 
     # ---- scheduling loop ------------------------------------------------
@@ -222,34 +311,84 @@ class LLMEngine:
             req.temperature, req.top_k, req.top_p,
         )
         info = _SlotInfo(request=req)
+        if self.speculative == "ngram":
+            info.ngram = _NgramIndex(req.prompt_ids)
         self._slots[slot] = info
         self._deliver(slot, info, [first])
 
     def _decode_once(self) -> None:
-        self._key, step_key = jax.random.split(self._key)
-        self._state, sampled = self.runner.decode_step(self._state, step_key)
-        self._step_count += 1
         # Snapshot slot ownership at dispatch time: by the time this step's
         # tokens are fetched (lagged), a slot may have been retired and
         # re-used — the request_id check drops such stale tokens.
         owners = {
             s: info.request.request_id for s, info in self._slots.items()
         }
-        self._pending.append((sampled, owners))
+        if self.speculative == "ngram" and self._spec_safe():
+            proposals = self._build_proposals()
+            self._state, tokens, produced = self.runner.verify_step(
+                self._state, proposals
+            )
+            self._spec_steps += 1
+            self._pending.append(((tokens, produced), owners))
+        else:
+            self._key, step_key = jax.random.split(self._key)
+            self._state, sampled = self.runner.decode_step(
+                self._state, step_key
+            )
+            self._pending.append((sampled, owners))
+        self._step_count += 1
         if len(self._pending) > _FETCH_LAG:
             self._process_fetch(*self._pending.pop(0))
+
+    # ---- speculative decoding (greedy n-gram) -------------------------
+
+    def _spec_safe(self) -> bool:
+        """Spec steps write P KV slots contiguously; stay clear of the
+        cache end (host view lags by _FETCH_LAG steps, so add margin)."""
+        margin = self.spec_tokens * (_FETCH_LAG + 2)
+        for info in self._slots.values():
+            req = info.request
+            used = len(req.prompt_ids) + len(req.output_ids)
+            if used + margin >= self.max_seq_len:
+                return False
+        return True
+
+    def _build_proposals(self) -> np.ndarray:
+        """N-gram lookup on each slot's (lagged) context via the
+        incremental index — O(1) per slot per step."""
+        P = self.spec_tokens
+        proposals = np.zeros((self.max_slots, P), dtype=np.int32)
+        for slot, info in self._slots.items():
+            if info.ngram is None:
+                continue
+            prop = info.ngram.propose(P - 1)
+            if prop:
+                proposals[slot, : len(prop)] = prop
+        return proposals
 
     def _drain_pending(self) -> None:
         while self._pending:
             self._process_fetch(*self._pending.pop(0))
 
     def _process_fetch(self, sampled, owners: Dict[int, str]) -> None:
-        tokens = np.asarray(sampled)  # sync point (lagged)
+        if isinstance(sampled, tuple):        # speculative step
+            tok_arr, produced = (np.asarray(x) for x in sampled)
+        else:
+            tok_arr = np.asarray(sampled)[:, None]  # sync point (lagged)
+            produced = None
         for slot, owner_id in owners.items():
             info = self._slots.get(slot)
             if info is None or info.request.request_id != owner_id:
                 continue
-            self._deliver(slot, info, [int(tokens[slot])])
+            n = (
+                int(produced[slot]) if produced is not None
+                else tok_arr.shape[1]
+            )
+            if n <= 0:
+                continue
+            if produced is not None:
+                self._spec_hits += n - 1
+            self._deliver(slot, info, [int(t) for t in tok_arr[slot, :n]])
 
     def _deliver(self, slot: int, info: _SlotInfo, toks: List[int]) -> None:
         req = info.request
@@ -259,6 +398,8 @@ class LLMEngine:
                 req.output_ids.append(tok)
                 self._tokens_generated += 1
                 info.buffer_ids.append(tok)
+                if info.ngram is not None:
+                    info.ngram.append(tok)
                 if self._emit_text(info, final=False):
                     self._finish(slot, info, "stop")
                     return

@@ -114,6 +114,8 @@ class ModelRunner:
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefills: Dict[int, Any] = {}
         self._inserts: Dict[int, Any] = {}
+        self._embeds: Dict[int, Any] = {}
+        self._verifies: Dict[int, Any] = {}
 
     # -- state ------------------------------------------------------------
 
@@ -165,6 +167,52 @@ class ModelRunner:
             self._prefills[Tb] = fn
         tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
         return fn(self.params, tokens, jnp.int32(true_len))
+
+    # -- embeddings -------------------------------------------------------
+
+    def _embed_impl(self, params, tokens, true_lens):
+        """tokens [N, Tb], true_lens [N] -> l2-normalized mean-pooled
+        embeddings [N, D] (one batched forward for the whole request)."""
+        Tb = tokens.shape[1]
+        positions = jnp.broadcast_to(
+            jnp.arange(Tb, dtype=jnp.int32)[None, :], tokens.shape
+        )
+        hidden, _ = forward(
+            params, self.cfg, tokens, positions, return_hidden=True
+        )
+        mask = (
+            jnp.arange(Tb)[None, :] < true_lens[:, None]
+        )[..., None].astype(jnp.float32)
+        pooled = jnp.sum(hidden * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1.0
+        )
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-9)
+
+    def embed(self, batch_token_ids, true_lens) -> jax.Array:
+        """batch_token_ids: [N][Tb] (pre-padded to one bucket length)."""
+        Tb = len(batch_token_ids[0])
+        assert Tb in self.prefill_buckets, (Tb, self.prefill_buckets)
+        # bucket the batch dim too, bounding jit specializations
+        N = len(batch_token_ids)
+        Nb = 1
+        while Nb < N:
+            Nb *= 2
+        padded = list(batch_token_ids) + [
+            [0] * Tb for _ in range(Nb - N)
+        ]
+        lens = list(true_lens) + [0] * (Nb - N)
+        key = (Nb, Tb)
+        fn = self._embeds.get(key)
+        if fn is None:
+            fn = jax.jit(self._embed_impl)
+            self._embeds[key] = fn
+        out = fn(
+            self.params,
+            jnp.asarray(padded, jnp.int32),
+            jnp.asarray(lens, jnp.int32),
+        )
+        return out[:N]
 
     # -- insert -----------------------------------------------------------
 
@@ -233,3 +281,70 @@ class ModelRunner:
 
     def decode_step(self, state: DecodeState, key) -> Tuple[DecodeState, jax.Array]:
         return self._decode(self.params, state, key)
+
+    # -- speculative decoding (greedy n-gram verify) ----------------------
+
+    def _verify_impl(self, params, state, proposals):
+        """Greedy speculative verification.
+
+        proposals: [B, P]; the first P-1 entries are candidate
+        continuations (the last is padding so one jitted shape serves
+        propose-and-bonus). Feeds ``[last_token, p_0 .. p_{P-2}]`` (P
+        positions); per row the longest matching proposal prefix is
+        accepted plus one bonus token from the model's own argmax chain.
+        Returns ``(state', tokens [B, P], produced [B])`` where
+        ``tokens[b, :produced[b]]`` are the newly generated tokens
+        (1..P per active row, 0 for inactive).
+
+        Callers must guarantee every active row has
+        ``position + P < max_seq_len`` (the engine falls back to plain
+        decode near capacity) — the block KV write is contiguous.
+        """
+        B, P = proposals.shape
+        tokens = jnp.concatenate(
+            [state.last_tokens[:, None], proposals[:, :-1]], axis=1
+        )
+        positions = (
+            state.positions[:, None]
+            + jnp.arange(P, dtype=jnp.int32)[None, :]
+        )
+        logits, cache = forward(
+            params, self.cfg, tokens, positions, state.cache
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, P]
+        match = proposals[:, : P - 1] == greedy[:, : P - 1]
+        n_accept = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+        )                                                        # [B] 0..P-1
+        produced = jnp.where(state.active, n_accept + 1, 0)      # tokens out
+        new_last = jnp.take_along_axis(
+            greedy, n_accept[:, None], axis=1
+        )[:, 0]
+        next_tokens = jnp.where(state.active, new_last, state.last_tokens)
+        new_positions = jnp.where(
+            state.active,
+            jnp.minimum(state.positions + produced, self.max_seq_len - 1),
+            state.positions,
+        )
+        at_capacity = new_positions + 1 >= self.max_seq_len
+        return (
+            DecodeState(
+                cache=cache,
+                last_tokens=next_tokens,
+                positions=new_positions,
+                active=state.active & ~at_capacity,
+                sampling=state.sampling,
+            ),
+            greedy,
+            produced,
+        )
+
+    def verify_step(
+        self, state: DecodeState, proposals
+    ) -> Tuple[DecodeState, jax.Array, jax.Array]:
+        P = proposals.shape[1]
+        fn = self._verifies.get(P)
+        if fn is None:
+            fn = jax.jit(self._verify_impl, donate_argnums=(1,))
+            self._verifies[P] = fn
+        return fn(self.params, state, jnp.asarray(proposals, jnp.int32))

@@ -268,6 +268,7 @@ def forward(
     tokens: jax.Array,                # [B, T] int32
     positions: jax.Array,             # [B, T] int32 absolute positions
     cache: Optional[KVCache] = None,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Run the model.
 
@@ -357,6 +358,9 @@ def forward(
         new_cache = KVCache(k=k_new, v=v_new)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if return_hidden:
+        # embeddings path: final normalized hidden states, no LM head
+        return x.astype(jnp.float32), new_cache
     if cfg.tie_word_embeddings:
         logits = jnp.einsum("btd,vd->btv", x, params["embed"])
     else:

@@ -1,0 +1,10 @@
+"""TPU compute kernels and collective-aware ops.
+
+- ``ring_attention``: sequence-parallel attention over an ``sp`` mesh axis
+  (ICI ring via ppermute) — the long-context prefill path (SURVEY.md §5:
+  sequence scaling is a first-class scheduler-visible concern on TPU).
+"""
+
+from gpustack_tpu.ops.ring_attention import ring_attention, sharded_prefill_attention
+
+__all__ = ["ring_attention", "sharded_prefill_attention"]

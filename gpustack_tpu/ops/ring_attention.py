@@ -1,0 +1,140 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context prefill shards the sequence dimension across the ``sp`` mesh
+axis. Naive sharded attention would all-gather K/V (O(S) memory per chip);
+ring attention instead rotates K/V blocks around the ICI ring with
+``lax.ppermute`` while accumulating the softmax online (flash-attention
+style m/l/acc state), so per-chip memory stays O(S/sp) and the K/V
+transfer overlaps compute around the ring.
+
+This is the TPU-native replacement for the engine-internal context
+parallelism the reference delegates to its CUDA engines (reference
+carries ``--prefill-context-parallel-size`` through to vLLM,
+vllm_resource_fit_selector.py:118-148, but implements nothing itself).
+
+The math (online softmax with running max/normalizer) follows the
+blockwise-attention construction of Ring Attention
+(Liu et al., 2023) — no code was available to copy; implemented from the
+recurrence.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _block_attend_accum(q, k_blk, v_blk, mask, scale, m, l, acc):
+    """One ring step of online-softmax accumulation.
+
+    q: [B, Tq, Hkv, G, d]; k_blk/v_blk: [B, Tk, Hkv, d];
+    mask: [B, Tq, Tk] bool; m/l: [B, Hkv, G, Tq]; acc: like out.
+    """
+    scores = (
+        jnp.einsum("bthgd,bshd->bhgts", q, k_blk).astype(jnp.float32)
+        * scale
+    )
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    # fully-masked rows keep m_new == _NEG; exp(scores - m_new) would be 1
+    # there, so zero them explicitly
+    p = jnp.where(
+        scores <= _NEG / 2, 0.0, jnp.exp(scores - m_new[..., None])
+    )
+    correction = jnp.where(
+        m <= _NEG / 2, 0.0, jnp.exp(m - m_new)
+    )
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = (
+        acc * correction[..., None]
+        + jnp.einsum("bhgts,bshd->bhgtd", p, v_blk.astype(jnp.float32))
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,             # [B, Tq_local, Hkv, G, d]
+    k: jax.Array,             # [B, Tk_local, Hkv, d]
+    v: jax.Array,             # [B, Tk_local, Hkv, d]
+    q_positions: jax.Array,   # [B, Tq_local] absolute positions
+    k_positions: jax.Array,   # [B, Tk_local]
+    axis_name: str,
+    scale: float,
+) -> jax.Array:
+    """Causal GQA attention where sequence blocks live on ``axis_name``.
+
+    Must run inside shard_map (or an equivalent SPMD context) over a mesh
+    with ``axis_name``. Returns the local output block
+    [B, Tq_local, Hkv*G*d].
+    """
+    sp = lax.axis_size(axis_name)
+    B, Tq = q.shape[0], q.shape[1]
+    Hkv, G, d = q.shape[2], q.shape[3], q.shape[4]
+
+    m = jnp.full((B, Hkv, G, Tq), _NEG, jnp.float32)
+    l = jnp.zeros((B, Hkv, G, Tq), jnp.float32)
+    acc = jnp.zeros((B, Hkv, G, Tq, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc, k_blk, v_blk, k_pos = carry
+        mask = q_positions[:, :, None] >= k_pos[:, None, :]
+        m, l, acc = _block_attend_accum(
+            q, k_blk, v_blk, mask, scale, m, l, acc
+        )
+        # rotate K/V (and their positions) one hop around the ring
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_pos = lax.ppermute(k_pos, axis_name, perm)
+        return m, l, acc, k_blk, v_blk, k_pos
+
+    # the locally-created accumulators start device-invariant; mark them
+    # varying over every mesh axis the loop body's outputs vary over, so
+    # the scan carry types match (k/v/k_positions are already varying)
+    vma = jax.typeof(k).vma
+    m, l, acc = (
+        lax.pvary(x, tuple(ax for ax in vma if ax not in jax.typeof(x).vma))
+        for x in (m, l, acc)
+    )
+    m, l, acc, _, _, _ = lax.fori_loop(
+        0, sp, body, (m, l, acc, k, v, k_positions)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    # [B, Hkv, G, Tq, d] -> [B, Tq, Hkv*G*d]
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, Tq, Hkv * G * d)
+    return out.astype(q.dtype)
+
+
+def sharded_prefill_attention(
+    mesh: Mesh,
+    q: jax.Array,             # [B, T, Hkv, G, d] (global, seq-sharded)
+    k: jax.Array,             # [B, T, Hkv, d]
+    v: jax.Array,
+    positions: jax.Array,     # [B, T]
+    scale: float,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """shard_map wrapper: global seq-sharded tensors in, attention out.
+
+    Heads additionally shard over ``tp``; batch over ``dp``.
+    """
+    qkv_spec = P("dp", axis_name, "tp", None, None)
+    kv_spec = P("dp", axis_name, "tp", None)
+    pos_spec = P("dp", axis_name)
+    out_spec = P("dp", axis_name, "tp")
+
+    fn = functools.partial(ring_attention, axis_name=axis_name, scale=scale)
+    return jax.shard_map(
+        lambda q_, k_, v_, pq, pk: fn(q_, k_, v_, pq, pk),
+        mesh=mesh,
+        in_specs=(qkv_spec, kv_spec, kv_spec, pos_spec, pos_spec),
+        out_specs=out_spec,
+    )(q, k, v, positions, positions)

@@ -24,6 +24,7 @@ from typing import Optional
 from gpustack_tpu.models.config import (
     ModelConfig,
     PRESETS,
+    config_from_hf,
     load_hf_config,
 )
 from gpustack_tpu.parallel.mesh import MeshPlan, plan_mesh
@@ -66,13 +67,27 @@ def resolve_model_config(model: Model) -> ModelConfig:
                 f"cannot read config from {model.local_path}: {e}"
             )
     if model.huggingface_repo_id:
-        # Zero-egress evaluation: the config must already be cached locally
-        # by a worker's model-file download; server-side we estimate once a
-        # ModelFile resolves. Until then, reject with a clear message.
-        raise EvaluationError(
-            "huggingface source requires the model file to be cached "
-            "locally before evaluation (no config available yet)"
-        )
+        # Fetch just config.json (tiny; hf_hub caches it, so offline
+        # re-evaluation works once cached) — the reference does the same
+        # HF-config probing server-side (scheduler/evaluator.py HF rate
+        # limiter).
+        import json
+
+        try:
+            from huggingface_hub import hf_hub_download
+
+            path = hf_hub_download(
+                model.huggingface_repo_id, "config.json"
+            )
+            with open(path) as f:
+                return config_from_hf(
+                    json.load(f), name=model.huggingface_repo_id
+                )
+        except Exception as e:
+            raise EvaluationError(
+                f"cannot fetch config for "
+                f"{model.huggingface_repo_id!r}: {e}"
+            )
     raise EvaluationError("model has no source (preset/local_path/hf)")
 
 

@@ -63,6 +63,8 @@ class Model(Record):
     max_seq_len: int = 2048
     max_slots: int = 8                # continuous-batch width per replica
     quantization: str = ""            # "" | "int8"
+    speculative: str = ""             # "" | "ngram" (greedy-only mode)
+    spec_tokens: int = 4
     restart_on_error: bool = True
     distributable: bool = True        # allow multi-host placement
 

@@ -1,0 +1,92 @@
+"""Heartbeat soft file locks for cross-process download coordination
+(reference gpustack/utils/locks.py HeartbeatSoftFileLock semantics: a lock
+file whose mtime is refreshed while held; stale locks are stolen)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class SoftFileLock:
+    def __init__(
+        self,
+        path: str,
+        stale_after: float = 60.0,
+        heartbeat: float = 10.0,
+    ):
+        self.path = path
+        self.stale_after = stale_after
+        self.heartbeat = heartbeat
+        self._held = False
+        self._hb_task: Optional[asyncio.Task] = None
+
+    async def acquire(self, timeout: float = 3600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fd = os.open(
+                    self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                )
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                self._held = True
+                self._hb_task = asyncio.create_task(self._heartbeat_loop())
+                return
+            except FileExistsError:
+                try:
+                    st = os.stat(self.path)
+                except OSError:
+                    continue  # holder just released; retry immediately
+                age = time.time() - st.st_mtime
+                if age > self.stale_after:
+                    # Narrow the steal race: re-stat and only unlink if the
+                    # file is still the same stale one (a concurrent
+                    # stealer may have already replaced it with a fresh,
+                    # actively-heartbeated lock).
+                    try:
+                        st2 = os.stat(self.path)
+                        if (
+                            st2.st_ino == st.st_ino
+                            and st2.st_mtime == st.st_mtime
+                        ):
+                            logger.warning(
+                                "stealing stale lock %s (age %.0fs)",
+                                self.path, age,
+                            )
+                            os.unlink(self.path)
+                    except OSError:
+                        pass
+                    continue
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"could not acquire lock {self.path}")
+            await asyncio.sleep(1.0)
+
+    async def _heartbeat_loop(self) -> None:
+        while self._held:
+            await asyncio.sleep(self.heartbeat)
+            try:
+                os.utime(self.path)
+            except OSError:
+                return
+
+    def release(self) -> None:
+        self._held = False
+        if self._hb_task:
+            self._hb_task.cancel()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    async def __aenter__(self) -> "SoftFileLock":
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()

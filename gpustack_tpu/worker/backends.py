@@ -78,15 +78,23 @@ def _tpu_native_command(
     if model.preset:
         argv += ["--preset", model.preset]
     elif model.local_path:
+        # hf sources are resolved to a cache dir by the ModelFileManager
+        # before command build (serve_manager rewrites local_path)
         argv += ["--model-dir", model.local_path]
-    elif model.huggingface_repo_id:
-        # resolved_path is filled once the ModelFileManager cached it
-        raise ValueError("huggingface source requires a cached model file")
+    else:
+        raise ValueError(
+            "model has no resolved weight source (preset or local dir)"
+        )
     claim = instance.computed_resource_claim
     if claim and claim.mesh_plan:
         argv += ["--mesh-plan", claim.mesh_plan]
     if model.quantization:
         argv += ["--quantization", model.quantization]
+    if model.speculative:
+        argv += [
+            "--speculative", model.speculative,
+            "--spec-tokens", str(model.spec_tokens),
+        ]
     argv += model.backend_parameters
 
     env: Dict[str, str] = dict(model.env)

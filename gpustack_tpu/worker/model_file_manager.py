@@ -1,0 +1,158 @@
+"""Worker-side model file cache: download, resume, locks, records.
+
+Reference parity (gpustack/worker/model_file_manager.py:59,293 + the
+HF/ModelScope downloaders, worker/downloaders.py): resolve a model's
+weight source to a local directory, downloading into the worker cache
+under a soft file lock, reporting progress through ModelFile records.
+
+Downloaders are pluggable (constructor injection) so tests run hermetic
+under zero egress: the default uses huggingface_hub's snapshot_download
+(resume is built in — partial files are reused on retry).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import re
+from typing import Callable, Optional
+
+from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.config import Config
+from gpustack_tpu.schemas import Model, ModelFile, ModelFileState
+from gpustack_tpu.utils.locks import SoftFileLock
+
+logger = logging.getLogger(__name__)
+
+
+def _hf_snapshot_download(repo_id: str, target_dir: str) -> str:
+    """Default downloader: huggingface_hub snapshot (resumable)."""
+    from huggingface_hub import snapshot_download
+
+    return snapshot_download(
+        repo_id=repo_id,
+        local_dir=target_dir,
+        allow_patterns=[
+            "*.safetensors", "*.json", "*.model", "tokenizer*", "*.txt"
+        ],
+    )
+
+
+def _dir_size(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+class ModelFileManager:
+    def __init__(
+        self,
+        cfg: Config,
+        client: ClientSet,
+        worker_id: int,
+        downloader: Optional[Callable[[str, str], str]] = None,
+    ):
+        self.cfg = cfg
+        self.client = client
+        self.worker_id = worker_id
+        self.downloader = downloader or _hf_snapshot_download
+        self.models_dir = os.path.join(cfg.cache_dir, "models")
+        os.makedirs(self.models_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+
+    async def ensure_local(self, model: Model) -> str:
+        """Resolve the model's weights to a local directory, downloading
+        into the cache when needed. Raises on failure."""
+        if model.local_path:
+            if not os.path.exists(model.local_path):
+                raise FileNotFoundError(
+                    f"local_path {model.local_path} does not exist"
+                )
+            return model.local_path
+        if model.preset:
+            return ""  # built-in config; no files
+        if not model.huggingface_repo_id:
+            raise ValueError("model has no weight source")
+        return await self._ensure_hf(model.huggingface_repo_id)
+
+    async def _ensure_hf(self, repo_id: str) -> str:
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "--", repo_id)
+        target = os.path.join(self.models_dir, safe)
+        marker = target + ".complete"
+        if os.path.exists(marker):
+            return target
+        record = await self._record(repo_id)
+        lock = SoftFileLock(target + ".lock")
+        async with lock:
+            if os.path.exists(marker):  # raced another downloader
+                await self._update_record(
+                    record, state=ModelFileState.READY,
+                    resolved_path=target,
+                )
+                return target
+            await self._update_record(
+                record, state=ModelFileState.DOWNLOADING
+            )
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(
+                    None, self.downloader, repo_id, target
+                )
+            except Exception as e:
+                await self._update_record(
+                    record,
+                    state=ModelFileState.ERROR,
+                    state_message=str(e)[:500],
+                )
+                raise
+            with open(marker, "w") as f:
+                f.write("ok")
+            await self._update_record(
+                record,
+                state=ModelFileState.READY,
+                resolved_path=target,
+                size_bytes=_dir_size(target),
+                downloaded_bytes=_dir_size(target),
+            )
+        return target
+
+    # ------------------------------------------------------------------
+
+    async def _record(self, repo_id: str) -> Optional[dict]:
+        key = f"hf:{repo_id}"
+        try:
+            items = await self.client.list(
+                "model-files", source_key=key, worker_id=self.worker_id
+            )
+            if items:
+                return items[0]
+            return await self.client.create(
+                "model-files",
+                ModelFile(
+                    source_key=key,
+                    huggingface_repo_id=repo_id,
+                    worker_id=self.worker_id,
+                ).model_dump(mode="json"),
+            )
+        except APIError as e:
+            logger.warning("model-file record unavailable: %s", e)
+            return None
+
+    async def _update_record(self, record: Optional[dict], **fields) -> None:
+        if record is None:
+            return
+        payload = {
+            k: (v.value if hasattr(v, "value") else v)
+            for k, v in fields.items()
+        }
+        try:
+            await self.client.update("model-files", record["id"], payload)
+        except APIError as e:
+            logger.warning("model-file update failed: %s", e)

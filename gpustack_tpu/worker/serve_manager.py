@@ -50,6 +50,9 @@ class ServeManager:
         self.running: Dict[int, RunningInstance] = {}
         self.log_dir = os.path.join(cfg.data_dir, "instance-logs")
         os.makedirs(self.log_dir, exist_ok=True)
+        from gpustack_tpu.worker.model_file_manager import ModelFileManager
+
+        self.file_manager = ModelFileManager(cfg, client, worker_id)
 
     # ---- event handling -------------------------------------------------
 
@@ -87,7 +90,36 @@ class ServeManager:
             state == ModelInstanceState.SCHEDULED.value
             and event.id not in self.running
         ):
-            await self.start_instance(event.id)
+            self.spawn_start(event.id)
+
+    def spawn_start(self, instance_id: int) -> None:
+        """Run start_instance as its own task: downloads can take minutes
+        and must not block the instance-event loop (other instances'
+        stop/start events keep flowing)."""
+        if instance_id in self.running:
+            return
+        run = RunningInstance(instance_id, 0)
+        self.running[instance_id] = run
+
+        async def go():
+            try:
+                await self.start_instance(instance_id)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception(
+                    "start_instance %d failed", instance_id
+                )
+            finally:
+                # start_instance replaces the placeholder on success;
+                # a placeholder without a process means startup failed
+                current = self.running.get(instance_id)
+                if current is run and run.process is None:
+                    self.running.pop(instance_id, None)
+
+        run.monitor_task = asyncio.create_task(
+            go(), name=f"start-{instance_id}"
+        )
 
     async def reconcile(self) -> None:
         """Converge local processes with the server's view (orphan reaping —
@@ -107,7 +139,7 @@ class ServeManager:
                 inst.state == ModelInstanceState.SCHEDULED
                 and inst.id not in self.running
             ):
-                await self.start_instance(inst.id)
+                self.spawn_start(inst.id)
         for iid in list(self.running):
             if iid not in mine:
                 await self.stop_instance(iid)
@@ -129,6 +161,25 @@ class ServeManager:
             return
         process_index, my_chips = role
         is_leader = process_index == 0
+
+        # resolve weight files (download into the cache when hf-sourced;
+        # every participating host needs the files)
+        if model.huggingface_repo_id:
+            if is_leader:
+                await self._set_state(
+                    instance_id, ModelInstanceState.DOWNLOADING, ""
+                )
+            try:
+                resolved = await self.file_manager.ensure_local(model)
+            except Exception as e:
+                if is_leader:
+                    await self._set_state(
+                        instance_id, ModelInstanceState.ERROR,
+                        f"model download failed: {e}",
+                    )
+                return
+            model = model.model_copy(update={"local_path": resolved})
+
         backend = None
         if model.backend not in ("", "tpu-native"):
             backends = await self.client.list(

@@ -117,6 +117,35 @@ def test_streaming_chat(engine):
     _client_run(engine, go)
 
 
+def test_embeddings(engine):
+    async def go(client):
+        r = await client.post(
+            "/v1/embeddings", json={"input": ["hello", "world"]}
+        )
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert data["object"] == "list"
+        assert len(data["data"]) == 2
+        import numpy as np
+
+        v0 = np.asarray(data["data"][0]["embedding"])
+        v1 = np.asarray(data["data"][1]["embedding"])
+        assert v0.shape == (64,)           # tiny hidden size
+        assert abs(np.linalg.norm(v0) - 1.0) < 1e-3
+        assert not np.allclose(v0, v1)
+        # deterministic
+        r2 = await client.post("/v1/embeddings", json={"input": "hello"})
+        v0b = np.asarray((await r2.json())["data"][0]["embedding"])
+        np.testing.assert_allclose(v0, v0b, rtol=1e-5)
+        # errors
+        r = await client.post("/v1/embeddings", json={})
+        assert r.status == 400
+        r = await client.post("/v1/embeddings", json={"input": "x" * 600})
+        assert r.status == 400
+
+    _client_run(engine, go)
+
+
 def test_error_paths(engine):
     async def go(client):
         r = await client.post("/v1/completions", data=b"not json")

@@ -108,7 +108,7 @@ def test_resolve_errors():
         resolve_model_config(Model(name="x", preset="nope"))
     with pytest.raises(EvaluationError, match="no source"):
         resolve_model_config(Model(name="x"))
-    with pytest.raises(EvaluationError, match="cached locally"):
+    with pytest.raises(EvaluationError, match="cannot fetch config"):
         resolve_model_config(
             Model(name="x", huggingface_repo_id="meta/llama")
         )
