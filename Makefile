@@ -1,0 +1,17 @@
+.PHONY: test native bench smoke clean
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+smoke:
+	BENCH_SMOKE=1 python bench.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -48,6 +48,9 @@ class Config(pydantic.BaseModel):
     # observability
     enable_metrics: bool = True
 
+    # multi-server HA: TTL-lease leader election over the shared DB
+    ha: bool = False
+
     debug: bool = False
 
     # ---- derivation -----------------------------------------------------

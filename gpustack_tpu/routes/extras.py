@@ -1,0 +1,197 @@
+"""Extra management routes: catalog, deploy-time evaluation, usage and
+dashboard summaries.
+
+Reference parity: model catalog (server/catalog.py), evaluate_models
+deploy-time compatibility API (scheduler/evaluator.py:66), dashboard/usage
+aggregation endpoints (routes/dashboard.py, routes/usage.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from aiohttp import web
+
+from gpustack_tpu.routes.crud import json_error
+from gpustack_tpu.scheduler.calculator import (
+    EvaluationError,
+    chips_for_claim,
+    evaluate_model,
+)
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.catalog import get_catalog
+
+logger = logging.getLogger(__name__)
+
+
+def add_extra_routes(app: web.Application) -> None:
+    async def catalog(request: web.Request):
+        return web.json_response(
+            {"items": get_catalog(request.query.get("category", ""))}
+        )
+
+    async def evaluate(request: web.Request):
+        """Deploy-time compatibility check: would this model spec fit the
+        current fleet? (reference evaluator: evaluate_models)."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        try:
+            spec = Model.model_validate(body)
+        except Exception as e:
+            return json_error(400, f"invalid model spec: {e}")
+        loop = asyncio.get_running_loop()
+        try:
+            evaluation = await loop.run_in_executor(
+                None, evaluate_model, spec
+            )
+        except EvaluationError as e:
+            return web.json_response(
+                {"compatible": False, "reason": str(e)}
+            )
+        from gpustack_tpu.policies import filter_workers
+
+        workers, drop_reasons = filter_workers(await Worker.all(), spec)
+        if not workers:
+            return web.json_response(
+                {
+                    "compatible": False,
+                    "reason": (
+                        "no eligible workers"
+                        + (
+                            f" ({'; '.join(drop_reasons[:4])})"
+                            if drop_reasons else ""
+                        )
+                    ),
+                }
+            )
+        max_single = max(w.total_chips for w in workers)
+        domains = {}
+        for w in workers:
+            sl = w.status.slice
+            if sl and sl.ici_domain:
+                domains[sl.ici_domain] = (
+                    domains.get(sl.ici_domain, 0) + w.total_chips
+                )
+        max_chips = max(
+            [max_single] + (list(domains.values()) if spec.distributable else [])
+        )
+        hbm = min(w.hbm_per_chip for w in workers)
+        try:
+            claim = chips_for_claim(
+                evaluation,
+                hbm_per_chip=hbm,
+                max_chips=max_chips,
+                long_context=spec.max_seq_len >= 16384,
+                explicit_plan=spec.mesh_plan,
+                explicit_chips=spec.chips_per_replica,
+            )
+        except ValueError as e:      # malformed explicit mesh_plan
+            return json_error(400, str(e))
+        if claim is None:
+            return web.json_response(
+                {
+                    "compatible": False,
+                    "reason": (
+                        f"needs ~{evaluation.total_bytes / 2**30:.1f} GiB; "
+                        f"no fit within {max_chips} chips of "
+                        f"{hbm / 2**30:.0f} GiB HBM"
+                    ),
+                }
+            )
+        return web.json_response(
+            {
+                "compatible": True,
+                "claim": claim.model_dump(),
+                "weight_gib": round(evaluation.weight_bytes / 2**30, 2),
+                "kv_cache_gib": round(
+                    evaluation.kv_cache_bytes / 2**30, 2
+                ),
+                "multi_host": claim.chips > max_single,
+            }
+        )
+
+    async def usage_summary(request: web.Request):
+        """Aggregated token usage by model and user (dashboard feed)."""
+        from gpustack_tpu.orm.record import Record
+
+        rows = await Record.db().execute(
+            "SELECT route_name AS route, "
+            "COUNT(*) AS requests, "
+            "COALESCE(SUM(json_extract(data, '$.prompt_tokens')), 0) AS pt, "
+            "COALESCE(SUM(json_extract(data, '$.completion_tokens')), 0) "
+            "AS ct "
+            "FROM model_usage GROUP BY route_name ORDER BY requests DESC"
+        )
+        by_user = await Record.db().execute(
+            "SELECT user_id, COUNT(*) AS requests, "
+            "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
+            "FROM model_usage GROUP BY user_id"
+        )
+        return web.json_response(
+            {
+                "by_model": [
+                    {
+                        "route": r["route"],
+                        "requests": r["requests"],
+                        "prompt_tokens": int(r["pt"]),
+                        "completion_tokens": int(r["ct"]),
+                    }
+                    for r in rows
+                ],
+                "by_user": [
+                    {
+                        "user_id": r["user_id"],
+                        "requests": r["requests"],
+                        "total_tokens": int(r["tok"]),
+                    }
+                    for r in by_user
+                ],
+            }
+        )
+
+    async def dashboard(request: web.Request):
+        """Cluster overview (reference routes/dashboard.py)."""
+        workers = await Worker.all()
+        instances = await ModelInstance.all()
+        models = await Model.all()
+        total_chips = sum(w.total_chips for w in workers)
+        used_chips = 0
+        inst_states: dict = {}
+        for i in instances:
+            inst_states[i.state.value] = inst_states.get(i.state.value, 0) + 1
+            if i.state in (
+                ModelInstanceState.RUNNING,
+                ModelInstanceState.STARTING,
+                ModelInstanceState.SCHEDULED,
+            ):
+                used_chips += len(i.chip_indexes) + sum(
+                    len(s.chip_indexes) for s in i.subordinate_workers
+                )
+        return web.json_response(
+            {
+                "workers": {
+                    "total": len(workers),
+                    "ready": sum(
+                        1 for w in workers if w.state == WorkerState.READY
+                    ),
+                },
+                "chips": {"total": total_chips, "used": used_chips},
+                "models": len(models),
+                "instances": inst_states,
+            }
+        )
+
+    app.router.add_get("/v2/model-catalog", catalog)
+    app.router.add_post("/v2/models/evaluate", evaluate)
+    app.router.add_get("/v2/usage/summary", usage_summary)
+    app.router.add_get("/v2/dashboard", dashboard)

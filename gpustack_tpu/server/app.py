@@ -56,6 +56,9 @@ def create_app(cfg: Config) -> web.Application:
     from gpustack_tpu.server.exporter import add_metrics_route
 
     add_metrics_route(app)
+    from gpustack_tpu.routes.extras import add_extra_routes
+
+    add_extra_routes(app)
 
     # instance log streaming through the worker's http server (reference
     # routes/worker/logs.py path, proxied server-side)

@@ -1,0 +1,64 @@
+"""Built-in model catalog (reference gpustack/server/catalog.py:50
+init_model_catalog + assets catalog YAML): curated deployable models with
+suggested TPU configs, served at GET /v2/model-catalog."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+CATALOG: List[Dict[str, Any]] = [
+    {
+        "name": "Llama-3-8B-Instruct",
+        "preset": "llama3-8b",
+        "huggingface_repo_id": "meta-llama/Meta-Llama-3-8B-Instruct",
+        "categories": ["llm", "chat"],
+        "sizes": {"parameters_b": 8.0},
+        "suggested": {
+            "quantization": "int8",
+            "max_seq_len": 8192,
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
+    {
+        "name": "Llama-3-70B-Instruct",
+        "preset": "llama3-70b",
+        "huggingface_repo_id": "meta-llama/Meta-Llama-3-70B-Instruct",
+        "categories": ["llm", "chat"],
+        "sizes": {"parameters_b": 70.6},
+        "suggested": {
+            "quantization": "int8",
+            "max_seq_len": 8192,
+            "chips": {"v5e": 8, "v5p": 2},
+        },
+    },
+    {
+        "name": "Qwen2.5-7B-Instruct",
+        "preset": "qwen2.5-7b",
+        "huggingface_repo_id": "Qwen/Qwen2.5-7B-Instruct",
+        "categories": ["llm", "chat"],
+        "sizes": {"parameters_b": 7.6},
+        "suggested": {
+            "quantization": "int8",
+            "max_seq_len": 32768,
+            "chips": {"v5e": 2, "v5p": 1},
+        },
+    },
+    {
+        "name": "Mixtral-8x7B-Instruct",
+        "preset": "mixtral-8x7b",
+        "huggingface_repo_id": "mistralai/Mixtral-8x7B-Instruct-v0.1",
+        "categories": ["llm", "chat", "moe"],
+        "sizes": {"parameters_b": 46.7},
+        "suggested": {
+            "quantization": "int8",
+            "max_seq_len": 32768,
+            "chips": {"v5e": 4, "v5p": 1},
+        },
+    },
+]
+
+
+def get_catalog(category: str = "") -> List[Dict[str, Any]]:
+    if not category:
+        return CATALOG
+    return [m for m in CATALOG if category in m["categories"]]

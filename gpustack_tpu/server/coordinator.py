@@ -1,0 +1,184 @@
+"""HA coordinator: leader election + cross-instance event propagation.
+
+Reference parity (gpustack/server/coordinator/base.py:94 Coordinator ABC;
+local.py:17 LocalCoordinator; distributed impls ship as plugins,
+server/server.py:1166-1194; lost leadership exits the process,
+server/server.py:1296-1304).
+
+Single-server deployments use LocalCoordinator (always leader, in-process
+bus only). A distributed coordinator implements acquire/renew over a
+shared store (Postgres advisory locks, Redis leases) and republishes bus
+events across instances; leader-only tasks (scheduler, controllers)
+start/stop on leadership transitions.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import logging
+import os
+from typing import Awaitable, Callable, List, Optional
+
+from gpustack_tpu.server.bus import Event
+
+logger = logging.getLogger(__name__)
+
+
+class Coordinator(abc.ABC):
+    """Leadership + cross-instance pub/sub contract."""
+
+    @abc.abstractmethod
+    async def start(self) -> None:
+        """Begin participating (election loops, subscriptions)."""
+
+    @abc.abstractmethod
+    async def stop(self) -> None:
+        """Stop participating; release leadership if held."""
+
+    @property
+    @abc.abstractmethod
+    def is_leader(self) -> bool:
+        """Whether this instance currently holds leadership."""
+
+    @abc.abstractmethod
+    def on_leadership_change(
+        self, callback: Callable[[bool], Awaitable[None]]
+    ) -> None:
+        """Register a callback invoked with the new leadership state."""
+
+    @abc.abstractmethod
+    def publish_remote(self, event: Event) -> None:
+        """Propagate a bus event to peer server instances (id-only is
+        sufficient: receivers re-fetch from the shared DB — reference
+        server/bus.py:312-414 ChangeDetector pattern)."""
+
+
+class LocalCoordinator(Coordinator):
+    """Single-server: always leader, no peers."""
+
+    def __init__(self) -> None:
+        self._callbacks: List[Callable[[bool], Awaitable[None]]] = []
+        self._started = False
+
+    async def start(self) -> None:
+        self._started = True
+        for cb in self._callbacks:
+            await cb(True)
+
+    async def stop(self) -> None:
+        self._started = False
+
+    @property
+    def is_leader(self) -> bool:
+        return True
+
+    def on_leadership_change(
+        self, callback: Callable[[bool], Awaitable[None]]
+    ) -> None:
+        self._callbacks.append(callback)
+        if self._started:
+            asyncio.get_event_loop().create_task(callback(True))
+
+    def publish_remote(self, event: Event) -> None:
+        pass  # no peers
+
+
+class LeaseCoordinator(Coordinator):
+    """TTL-lease leader election over the shared sqlite/Postgres DB.
+
+    Multi-server HA without external dependencies: one row in a
+    ``leadership`` table holds (holder, expires_at); the leader renews at
+    ttl/3, followers try to acquire when the lease lapses. Losing a held
+    lease is fatal (reference semantics: os._exit so leader-only tasks
+    can't split-brain, server/server.py:1296-1304).
+    """
+
+    def __init__(self, db, identity: str = "", ttl: float = 15.0):
+        import secrets
+        import socket
+
+        self.db = db
+        # hostname + random suffix: pids collide across containers (every
+        # process is pid 1), which would let a stale leader renew against
+        # its successor's row and split-brain
+        self.identity = identity or (
+            f"{socket.gethostname()}-{os.getpid()}-"
+            f"{secrets.token_hex(4)}"
+        )
+        self.ttl = ttl
+        self._leader = False
+        self._callbacks: List[Callable[[bool], Awaitable[None]]] = []
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await self.db.execute(
+            "CREATE TABLE IF NOT EXISTS leadership ("
+            "id INTEGER PRIMARY KEY CHECK (id = 1), "
+            "holder TEXT, expires_at REAL)"
+        )
+        self._task = asyncio.create_task(self._loop(), name="coordinator")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+        if self._leader:
+            await self.db.execute(
+                "DELETE FROM leadership WHERE holder = ?", (self.identity,)
+            )
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def on_leadership_change(
+        self, callback: Callable[[bool], Awaitable[None]]
+    ) -> None:
+        self._callbacks.append(callback)
+
+    def publish_remote(self, event: Event) -> None:
+        # same-DB deployments see each other's state via the DB; watch
+        # streams re-list on RESYNC. Cross-instance low-latency event
+        # fan-out (Redis/PG LISTEN) slots in here.
+        pass
+
+    async def _loop(self) -> None:
+        import time
+
+        while True:
+            try:
+                now = time.time()
+                if self._leader:
+                    rows = await self.db.execute(
+                        "UPDATE leadership SET expires_at = ? "
+                        "WHERE id = 1 AND holder = ? RETURNING holder",
+                        (now + self.ttl, self.identity),
+                    )
+                    if not rows:
+                        # lease lost while held: fatal, never split-brain
+                        logger.error(
+                            "leadership lease lost; exiting (reference "
+                            "semantics: os._exit on lost lease)"
+                        )
+                        os._exit(1)
+                else:
+                    rows = await self.db.execute(
+                        "INSERT INTO leadership (id, holder, expires_at) "
+                        "VALUES (1, ?, ?) "
+                        "ON CONFLICT(id) DO UPDATE SET "
+                        "holder = excluded.holder, "
+                        "expires_at = excluded.expires_at "
+                        "WHERE leadership.expires_at < ? "
+                        "RETURNING holder",
+                        (self.identity, now + self.ttl, now),
+                    )
+                    if rows and rows[0]["holder"] == self.identity:
+                        logger.info("acquired leadership")
+                        self._leader = True
+                        for cb in self._callbacks:
+                            await cb(True)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("coordinator iteration failed")
+            await asyncio.sleep(self.ttl / 3)

@@ -71,26 +71,45 @@ class Server:
         run_migrations(self.db)
         Record.bind(self.db, self.bus)
         Record.create_all_tables(self.db)
-        await self._init_data()
+        if not cfg.ha:
+            # HA: bootstrap writes are leader-only (racing get-or-create
+            # on a shared DB would duplicate the admin user/cluster)
+            await self._init_data()
 
         app = create_app(cfg)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, cfg.host, cfg.port)
 
-        # leader-only tasks (LocalCoordinator: single server is always
-        # leader; distributed coordinators slot in here — reference
-        # server/server.py:1256-1339)
+        # leader-only tasks gate on the coordinator (reference
+        # server/server.py:1256-1339): LocalCoordinator for single-server,
+        # LeaseCoordinator for shared-DB HA
+        from gpustack_tpu.server.coordinator import (
+            LeaseCoordinator,
+            LocalCoordinator,
+        )
+
+        self.coordinator = (
+            LeaseCoordinator(self.db) if cfg.ha else LocalCoordinator()
+        )
         self.controllers = [ModelController(), WorkerController()]
-        for c in self.controllers:
-            c.start()
         self.scheduler = Scheduler()
-        self.scheduler.start()
         self.syncer = WorkerSyncer(
             stale_after=cfg.heartbeat_interval * 4.5,
             interval=cfg.heartbeat_interval,
         )
-        self.syncer.start()
+
+        async def on_leadership(leading: bool) -> None:
+            if leading:
+                if cfg.ha:
+                    await self._init_data()
+                for c in self.controllers:
+                    c.start()
+                self.scheduler.start()
+                self.syncer.start()
+
+        self.coordinator.on_leadership_change(on_leadership)
+        await self.coordinator.start()
 
         await site.start()
         logger.info("server listening on %s:%d", cfg.host, cfg.port)
@@ -114,6 +133,8 @@ class Server:
     async def stop(self) -> None:
         if self.worker_agent:
             await self.worker_agent.stop()
+        if hasattr(self, "coordinator"):
+            await self.coordinator.stop()
         for c in getattr(self, "controllers", []):
             c.stop()
         if hasattr(self, "scheduler"):

@@ -1,0 +1,152 @@
+"""Catalog / evaluate / usage / dashboard routes over a live server app."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    SliceTopology,
+    TPUChip,
+    User,
+    Worker,
+    WorkerState,
+    WorkerStatus,
+)
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    db = Database(":memory:")
+    bus = EventBus()
+    Record.bind(db, bus)
+    Record.create_all_tables(db)
+    cfg = Config.load({"data_dir": str(tmp_path)})
+    yield cfg
+    db.close()
+
+
+def _client_run(cfg, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        # admin user + session token
+        user = await User.create(
+            User(
+                username="admin",
+                is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        token = auth_mod.issue_session_token(user, cfg.jwt_secret)
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(
+                client, {"Authorization": f"Bearer {token}"}
+            )
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+async def _add_v5e8_worker():
+    await Worker.create(
+        Worker(
+            name="w1",
+            state=WorkerState.READY,
+            status=WorkerStatus(
+                chips=[
+                    TPUChip(index=i, hbm_bytes=16 * 2**30)
+                    for i in range(8)
+                ],
+                slice=SliceTopology(topology="2x4", chips_per_host=8),
+            ),
+        )
+    )
+
+
+def test_catalog(ctx):
+    async def go(client, hdrs):
+        r = await client.get("/v2/model-catalog", headers=hdrs)
+        assert r.status == 200
+        items = (await r.json())["items"]
+        assert any(m["preset"] == "llama3-8b" for m in items)
+        r = await client.get(
+            "/v2/model-catalog?category=moe", headers=hdrs
+        )
+        assert all(
+            "moe" in m["categories"] for m in (await r.json())["items"]
+        )
+
+    _client_run(ctx, go)
+
+
+def test_evaluate_fit_and_misfit(ctx):
+    async def go(client, hdrs):
+        await _add_v5e8_worker()
+        r = await client.post(
+            "/v2/models/evaluate",
+            headers=hdrs,
+            json={
+                "name": "e", "preset": "llama3-8b",
+                "quantization": "int8",
+            },
+        )
+        data = await r.json()
+        assert data["compatible"] is True
+        assert data["claim"]["chips"] == 1
+
+        r = await client.post(
+            "/v2/models/evaluate",
+            headers=hdrs,
+            json={"name": "e", "preset": "llama3-70b"},
+        )
+        data = await r.json()
+        assert data["compatible"] is False
+        assert "no fit" in data["reason"]
+
+        r = await client.post(
+            "/v2/models/evaluate",
+            headers=hdrs,
+            json={"name": "e", "preset": "not-a-model"},
+        )
+        data = await r.json()
+        assert data["compatible"] is False
+        assert "unknown preset" in data["reason"]
+
+    _client_run(ctx, go)
+
+
+def test_usage_summary_and_dashboard(ctx):
+    async def go(client, hdrs):
+        await _add_v5e8_worker()
+        for i in range(3):
+            await ModelUsage.create(
+                ModelUsage(
+                    user_id=1, model_id=1, route_name="m1",
+                    prompt_tokens=10, completion_tokens=5,
+                    total_tokens=15,
+                )
+            )
+        r = await client.get("/v2/usage/summary", headers=hdrs)
+        data = await r.json()
+        assert data["by_model"][0]["route"] == "m1"
+        assert data["by_model"][0]["requests"] == 3
+        assert data["by_model"][0]["completion_tokens"] == 15
+        assert data["by_user"][0]["total_tokens"] == 45
+
+        r = await client.get("/v2/dashboard", headers=hdrs)
+        data = await r.json()
+        assert data["workers"] == {"total": 1, "ready": 1}
+        assert data["chips"]["total"] == 8
+
+    _client_run(ctx, go)

@@ -1,0 +1,54 @@
+"""Lease coordinator: single leader, failover after expiry."""
+
+import asyncio
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.server.coordinator import LeaseCoordinator, LocalCoordinator
+
+
+def test_local_coordinator_always_leader():
+    async def go():
+        c = LocalCoordinator()
+        fired = []
+
+        async def cb(leading):
+            fired.append(leading)
+
+        c.on_leadership_change(cb)
+        await c.start()
+        assert c.is_leader
+        assert fired == [True]
+        await c.stop()
+
+    asyncio.run(go())
+
+
+def test_lease_coordinator_single_leader_and_failover():
+    async def go():
+        db = Database(":memory:")
+        a = LeaseCoordinator(db, identity="a", ttl=0.6)
+        b = LeaseCoordinator(db, identity="b", ttl=0.6)
+        events = []
+
+        async def cb_a(leading):
+            events.append(("a", leading))
+
+        async def cb_b(leading):
+            events.append(("b", leading))
+
+        a.on_leadership_change(cb_a)
+        b.on_leadership_change(cb_b)
+        await a.start()
+        await asyncio.sleep(0.3)
+        await b.start()
+        await asyncio.sleep(0.5)
+        assert a.is_leader and not b.is_leader
+        # leader goes away; follower takes over after the lease lapses
+        await a.stop()
+        await asyncio.sleep(1.5)
+        assert b.is_leader
+        assert ("a", True) in events and ("b", True) in events
+        await b.stop()
+        db.close()
+
+    asyncio.run(go())
