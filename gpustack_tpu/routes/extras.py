@@ -121,8 +121,17 @@ def add_extra_routes(app: web.Application) -> None:
         )
 
     async def usage_summary(request: web.Request):
-        """Aggregated token usage by model and user (dashboard feed)."""
+        """Aggregated token usage by model and user (dashboard feed).
+
+        Admins see every user; other users see only their own row;
+        worker/system tokens are rejected."""
         from gpustack_tpu.orm.record import Record
+
+        principal = request.get("principal")
+        if principal is None or (
+            principal.kind != "user" and not principal.is_admin
+        ):
+            return json_error(403, "user token required")
 
         rows = await Record.db().execute(
             "SELECT route_name AS route, "
@@ -132,10 +141,16 @@ def add_extra_routes(app: web.Application) -> None:
             "AS ct "
             "FROM model_usage GROUP BY route_name ORDER BY requests DESC"
         )
+        user_where = ""
+        user_params: list = []
+        if not principal.is_admin:
+            user_where = " WHERE user_id = ?"
+            user_params = [principal.user.id]
         by_user = await Record.db().execute(
             "SELECT user_id, COUNT(*) AS requests, "
             "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
-            "FROM model_usage GROUP BY user_id"
+            f"FROM model_usage{user_where} GROUP BY user_id",
+            user_params,
         )
         return web.json_response(
             {
@@ -164,16 +179,15 @@ def add_extra_routes(app: web.Application) -> None:
         workers = await Worker.all()
         instances = await ModelInstance.all()
         models = await Model.all()
+        from gpustack_tpu.policies.allocatable import CLAIMING_STATES
+
         total_chips = sum(w.total_chips for w in workers)
         used_chips = 0
         inst_states: dict = {}
         for i in instances:
             inst_states[i.state.value] = inst_states.get(i.state.value, 0) + 1
-            if i.state in (
-                ModelInstanceState.RUNNING,
-                ModelInstanceState.STARTING,
-                ModelInstanceState.SCHEDULED,
-            ):
+            # same accounting the scheduler uses (policies/allocatable.py)
+            if i.state in CLAIMING_STATES:
                 used_chips += len(i.chip_indexes) + sum(
                     len(s.chip_indexes) for s in i.subordinate_workers
                 )

@@ -81,6 +81,15 @@ class Subscriber:
 
     # called by the bus (event-loop thread)
     def _offer(self, event: Event) -> None:
+        if event.type == EventType.RESYNC:
+            # broadcast re-list marker (e.g. HA followers poll-refresh):
+            # bypasses kind filtering
+            self._queue.clear()
+            self._pending_updates.clear()
+            self._overflowed = True
+            self.resyncs += 1
+            self._wake()
+            return
         if self.kinds is not None and event.kind not in self.kinds:
             return
         if event.type == EventType.UPDATED:

@@ -94,11 +94,12 @@ class LeaseCoordinator(Coordinator):
     can't split-brain, server/server.py:1296-1304).
     """
 
-    def __init__(self, db, identity: str = "", ttl: float = 15.0):
+    def __init__(self, db, identity: str = "", ttl: float = 15.0, bus=None):
         import secrets
         import socket
 
         self.db = db
+        self.bus = bus
         # hostname + random suffix: pids collide across containers (every
         # process is pid 1), which would let a stale leader renew against
         # its successor's row and split-brain
@@ -177,6 +178,20 @@ class LeaseCoordinator(Coordinator):
                         self._leader = True
                         for cb in self._callbacks:
                             await cb(True)
+                    elif self.bus is not None:
+                        # follower: the leader's writes land in the shared
+                        # DB but not on this instance's in-process bus —
+                        # force local watchers to re-list every cycle
+                        # (poll-based propagation; low-latency fan-out via
+                        # PG LISTEN/Redis slots into publish_remote later)
+                        from gpustack_tpu.server.bus import (
+                            Event as _Event,
+                            EventType as _EventType,
+                        )
+
+                        self.bus.publish(
+                            _Event(kind="*", type=_EventType.RESYNC)
+                        )
             except asyncio.CancelledError:
                 raise
             except Exception:

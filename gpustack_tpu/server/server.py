@@ -90,7 +90,8 @@ class Server:
         )
 
         self.coordinator = (
-            LeaseCoordinator(self.db) if cfg.ha else LocalCoordinator()
+            LeaseCoordinator(self.db, bus=self.bus)
+            if cfg.ha else LocalCoordinator()
         )
         self.controllers = [ModelController(), WorkerController()]
         self.scheduler = Scheduler()

@@ -135,10 +135,38 @@ class ServeManager:
                 continue
             inst = ModelInstance.model_validate(item)
             mine.add(inst.id)
+            role = self._my_role(item)
+            is_leader = role is not None and role[0] == 0
             if (
                 inst.state == ModelInstanceState.SCHEDULED
                 and inst.id not in self.running
             ):
+                self.spawn_start(inst.id)
+            elif (
+                is_leader
+                and inst.state
+                in (
+                    ModelInstanceState.STARTING,
+                    ModelInstanceState.RUNNING,
+                    ModelInstanceState.DOWNLOADING,
+                )
+                and inst.id not in self.running
+            ):
+                # DB says alive but no local process (agent restarted, or
+                # the engine was reaped as an orphan): re-drive through the
+                # state machine (reference sync_model_instances_state,
+                # serve_manager.py:244). Leader-only: a follower losing its
+                # process surfaces as the leader engine's collective
+                # failure, and the leader's crash-restart re-SCHEDULEs the
+                # whole replica (followers then respawn on that event).
+                logger.warning(
+                    "instance %s is %s with no local engine; restarting",
+                    inst.name, inst.state.value,
+                )
+                await self._set_state(
+                    inst.id, ModelInstanceState.SCHEDULED,
+                    "engine process lost; restarting",
+                )
                 self.spawn_start(inst.id)
         for iid in list(self.running):
             if iid not in mine:
@@ -236,6 +264,16 @@ class ServeManager:
                 *argv, env=env, stdout=log_file, stderr=log_file,
                 start_new_session=True,
             )
+            import json as _json
+
+            with open(self._pidfile(instance_id), "w") as pf:
+                # record an argv fingerprint so the reaper can verify the
+                # pid wasn't recycled to an unrelated process
+                pf.write(
+                    _json.dumps(
+                        {"pid": run.process.pid, "argv": argv[:4]}
+                    )
+                )
         except OSError as e:
             log_file.close()
             if is_leader:
@@ -259,8 +297,80 @@ class ServeManager:
             self._monitor(run, model), name=f"monitor-{instance_id}"
         )
 
+    def _pidfile(self, instance_id: int) -> str:
+        return os.path.join(self.log_dir, f"{instance_id}.pid")
+
+    def reap_orphans(self) -> int:
+        """Kill engine processes left behind by a previous agent run (the
+        reference's workload cleaner role, worker/workload_cleaner.py):
+        engines outlive a hard-killed agent because they run in their own
+        session; pidfiles (pid + argv fingerprint) identify them across
+        restarts. Blocks briefly until reaped pids exit so respawned
+        engines don't race the old ones for the TPU device lock."""
+        import json as _json
+        import time as _time
+
+        reaped_pids = []
+        for fname in os.listdir(self.log_dir):
+            if not fname.endswith(".pid"):
+                continue
+            path = os.path.join(self.log_dir, fname)
+            try:
+                with open(path) as f:
+                    raw = f.read().strip()
+                rec = (
+                    _json.loads(raw)
+                    if raw.startswith("{")
+                    else {"pid": int(raw), "argv": []}
+                )
+                pid = int(rec["pid"])
+            except (OSError, ValueError, KeyError):
+                os.unlink(path)
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline") as f:
+                    cmdline = f.read()
+            except OSError:
+                os.unlink(path)       # process already gone
+                continue
+            fingerprint = rec.get("argv") or ["gpustack_tpu", "api_server"]
+            if all(tok in cmdline for tok in fingerprint):
+                logger.warning("reaping orphan engine pid %d", pid)
+                try:
+                    os.kill(pid, 15)
+                    reaped_pids.append(pid)
+                except OSError:
+                    pass
+                os.unlink(path)
+            else:
+                # pid recycled to an unrelated process: never kill it, and
+                # keep the file out of future scans
+                logger.warning(
+                    "pidfile %s points at unrelated pid %d; skipping",
+                    fname, pid,
+                )
+                os.unlink(path)
+        # wait for exits (engines must release TPU devices before any
+        # respawn); escalate to SIGKILL at the deadline
+        deadline = _time.monotonic() + 10.0
+        for pid in reaped_pids:
+            while _time.monotonic() < deadline and os.path.exists(
+                f"/proc/{pid}"
+            ):
+                _time.sleep(0.2)
+            if os.path.exists(f"/proc/{pid}"):
+                try:
+                    os.kill(pid, 9)
+                except OSError:
+                    pass
+        return len(reaped_pids)
+
     async def stop_instance(self, instance_id: int) -> None:
         run = self.running.pop(instance_id, None)
+        try:
+            os.unlink(self._pidfile(instance_id))
+        except OSError:
+            pass
         if run is None:
             return
         run.stopping = True

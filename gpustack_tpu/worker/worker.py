@@ -38,17 +38,40 @@ class WorkerAgent:
         self.client: Optional[ClientSet] = None
         self.worker_id = 0
         self.worker_name = cfg.worker_name or socket.gethostname()
-        self.worker_uuid = uuid.uuid4().hex
+        self.worker_uuid = self._load_or_create_uuid()
         self.detector = create_detector(cfg.fake_detector or None)
         self.serve_manager: Optional[ServeManager] = None
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
+
+    def _load_or_create_uuid(self) -> str:
+        """Stable worker identity across restarts: a fresh uuid per boot
+        would make re-registration collide on the worker name forever
+        (server keeps the old record)."""
+        import os
+
+        path = os.path.join(self.cfg.data_dir, "worker_uuid")
+        try:
+            with open(path) as f:
+                value = f.read().strip()
+            if value:
+                return value
+        except OSError:
+            pass
+        value = uuid.uuid4().hex
+        try:
+            with open(path, "w") as f:
+                f.write(value)
+        except OSError:
+            logger.warning("cannot persist worker uuid at %s", path)
+        return value
 
     async def start(self) -> None:
         await self._register_with_retry()
         self.serve_manager = ServeManager(
             self.cfg, self.client, self.worker_id
         )
+        self.serve_manager.reap_orphans()
         from gpustack_tpu.worker.benchmark_manager import BenchmarkManager
         from gpustack_tpu.worker.server import WorkerServer
 
@@ -66,6 +89,9 @@ class WorkerAgent:
             self.http = None
         # push one status immediately so the scheduler sees chips
         await self._post_status_once()
+        # converge with the server's view (restart recovery: zombie
+        # RUNNING records, orphan stops) before the watch stream starts
+        await self.serve_manager.reconcile()
         self._tasks = [
             asyncio.create_task(self._heartbeat_loop(), name="wk-heartbeat"),
             asyncio.create_task(self._status_loop(), name="wk-status"),

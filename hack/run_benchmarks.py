@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""API-driven benchmark matrix: deploy → bench per profile → scale to zero.
+
+Reference analogue: hack/perf/run_model_benchmark.py (drives the full
+matrix over the HTTP API — deploy, benchmark, collect, scale-to-zero).
+
+Usage:
+  python hack/run_benchmarks.py --server http://localhost:10150 \
+      --username admin --password ... \
+      --model-spec '{"name":"llama3-8b","preset":"llama3-8b","quantization":"int8"}' \
+      --profiles throughput latency
+
+Prints one JSON document with all collected metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import aiohttp
+
+
+async def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--server", default="http://127.0.0.1:10150")
+    p.add_argument("--username", default="admin")
+    p.add_argument("--password", required=True)
+    p.add_argument("--model-spec", required=True, help="JSON model body")
+    p.add_argument("--profiles", nargs="+", default=["throughput"])
+    p.add_argument("--keep", action="store_true",
+                   help="skip scale-to-zero at the end")
+    p.add_argument("--deploy-timeout", type=float, default=1800)
+    p.add_argument("--bench-timeout", type=float, default=3600)
+    args = p.parse_args()
+
+    spec = json.loads(args.model_spec)
+    results = {"model": spec.get("name"), "profiles": {}}
+
+    async with aiohttp.ClientSession(args.server) as http:
+        async with http.post(
+            "/auth/login",
+            json={"username": args.username, "password": args.password},
+        ) as r:
+            if r.status != 200:
+                print(await r.text(), file=sys.stderr)
+                return 1
+            hdrs = {
+                "Authorization": f"Bearer {(await r.json())['token']}"
+            }
+
+        # deploy (idempotent: reuse an existing model of the same name)
+        async with http.get(
+            f"/v2/models?name={spec['name']}", headers=hdrs
+        ) as r:
+            items = (await r.json())["items"]
+        if items:
+            model = items[0]
+            if model["replicas"] < 1:
+                async with http.patch(
+                    f"/v2/models/{model['id']}", headers=hdrs,
+                    json={"replicas": 1},
+                ) as r:
+                    assert r.status == 200, await r.text()
+        else:
+            async with http.post(
+                "/v2/models", headers=hdrs, json=spec
+            ) as r:
+                if r.status != 201:
+                    print(await r.text(), file=sys.stderr)
+                    return 1
+                model = await r.json()
+
+        # wait running
+        deadline = time.time() + args.deploy_timeout
+        while time.time() < deadline:
+            async with http.get(
+                f"/v2/model-instances?model_id={model['id']}",
+                headers=hdrs,
+            ) as r:
+                insts = (await r.json())["items"]
+            states = [i["state"] for i in insts]
+            if "running" in states:
+                break
+            if "error" in states:
+                print(f"deploy failed: {insts}", file=sys.stderr)
+                return 1
+            await asyncio.sleep(3)
+        else:
+            print("deploy timed out", file=sys.stderr)
+            return 1
+
+        # benchmarks, sequentially per profile
+        for profile in args.profiles:
+            async with http.post(
+                "/v2/benchmarks", headers=hdrs,
+                json={
+                    "name": f"{spec['name']}-{profile}",
+                    "model_id": model["id"],
+                    "profile": profile,
+                },
+            ) as r:
+                if r.status != 201:
+                    print(await r.text(), file=sys.stderr)
+                    return 1
+                bench = await r.json()
+            deadline = time.time() + args.bench_timeout
+            while time.time() < deadline:
+                async with http.get(
+                    f"/v2/benchmarks/{bench['id']}", headers=hdrs
+                ) as r:
+                    bench = await r.json()
+                if bench["state"] in ("completed", "error"):
+                    break
+                await asyncio.sleep(5)
+            results["profiles"][profile] = {
+                "state": bench["state"],
+                "metrics": bench.get("metrics"),
+                "message": bench.get("state_message", ""),
+            }
+
+        if not args.keep:
+            async with http.patch(
+                f"/v2/models/{model['id']}", headers=hdrs,
+                json={"replicas": 0},
+            ) as r:
+                pass
+
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -1,0 +1,96 @@
+"""Auth primitives: passwords, JWTs, API keys, principals."""
+
+import asyncio
+import time
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import ApiKey, User
+from gpustack_tpu.server.bus import EventBus
+
+import pytest
+
+
+def test_password_hash_roundtrip():
+    h = auth_mod.hash_password("s3cret")
+    assert auth_mod.verify_password("s3cret", h)
+    assert not auth_mod.verify_password("wrong", h)
+    assert not auth_mod.verify_password("s3cret", "garbage")
+    # unique salts
+    assert h != auth_mod.hash_password("s3cret")
+
+
+def test_jwt_roundtrip_and_tamper():
+    token = auth_mod.jwt_encode(
+        {"sub": 1, "exp": int(time.time()) + 60}, "k1"
+    )
+    assert auth_mod.jwt_decode(token, "k1")["sub"] == 1
+    assert auth_mod.jwt_decode(token, "k2") is None          # wrong key
+    h, b, s = token.split(".")
+    assert auth_mod.jwt_decode(f"{h}.{b}x.{s}", "k1") is None  # tampered
+    expired = auth_mod.jwt_encode(
+        {"sub": 1, "exp": int(time.time()) - 10}, "k1"
+    )
+    assert auth_mod.jwt_decode(expired, "k1") is None
+
+
+def test_api_key_format():
+    full, access, hashed = auth_mod.generate_api_key()
+    parsed = auth_mod.parse_api_key(full)
+    assert parsed is not None
+    acc, secret = parsed
+    assert acc == access
+    assert auth_mod.hash_secret(secret) == hashed
+    assert auth_mod.parse_api_key("not_a_key") is None
+    assert auth_mod.parse_api_key("gtpu_onlyonepart") is None
+
+
+@pytest.fixture()
+def ctx():
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield db
+    db.close()
+
+
+def test_authenticate_paths(ctx):
+    async def go():
+        user = await User.create(
+            User(username="u1", password_hash=auth_mod.hash_password("x"))
+        )
+        # session JWT
+        token = auth_mod.issue_session_token(user, "sec")
+        p = await auth_mod.authenticate(token, "sec")
+        assert p.kind == "user" and p.user.username == "u1"
+        assert not p.is_admin
+        # api key
+        full, access, hashed = auth_mod.generate_api_key()
+        await ApiKey.create(
+            ApiKey(
+                user_id=user.id, access_key=access, hashed_secret=hashed,
+                scopes=["inference"],
+            )
+        )
+        p = await auth_mod.authenticate(full, "sec")
+        assert p.has_scope("inference") and not p.has_scope("management")
+        # wrong secret
+        bad = full[:-4] + "zzzz"
+        assert await auth_mod.authenticate(bad, "sec") is None
+        # expired key
+        full2, access2, hashed2 = auth_mod.generate_api_key()
+        await ApiKey.create(
+            ApiKey(
+                user_id=user.id, access_key=access2,
+                hashed_secret=hashed2,
+                expires_at="2000-01-01T00:00:00+00:00",
+            )
+        )
+        assert await auth_mod.authenticate(full2, "sec") is None
+        # worker token
+        wt = auth_mod.issue_worker_token(7, "sec")
+        p = await auth_mod.authenticate(wt, "sec")
+        assert p.kind == "worker" and p.worker_id == 7
+
+    asyncio.run(go())

@@ -1,0 +1,122 @@
+"""Scheduler loop over the ORM: placement writes, unschedulable backoff,
+stuck-instance rescheduling, multi-host placement."""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+from utils.fleet import v5e_8, v5e_32_host  # noqa: E402
+
+from gpustack_tpu.orm.db import Database  # noqa: E402
+from gpustack_tpu.orm.record import Record  # noqa: E402
+from gpustack_tpu.scheduler.scheduler import Scheduler  # noqa: E402
+from gpustack_tpu.schemas import (  # noqa: E402
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+)
+from gpustack_tpu.server.bus import EventBus  # noqa: E402
+
+
+@pytest.fixture()
+def ctx():
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield db
+    db.close()
+
+
+async def _add_worker(w: Worker) -> Worker:
+    w.id = 0
+    return await Worker.create(w)
+
+
+def test_schedule_one_places_instance(ctx):
+    async def go():
+        await _add_worker(v5e_8(0))
+        model = await Model.create(
+            Model(name="m", preset="llama3-8b", quantization="int8")
+        )
+        inst = await ModelInstance.create(
+            ModelInstance(name="m-0", model_id=model.id)
+        )
+        sched = Scheduler()
+        await sched._schedule_one(inst.id)
+        inst = await ModelInstance.get(inst.id)
+        assert inst.state == ModelInstanceState.SCHEDULED
+        assert inst.worker_id is not None
+        assert inst.chip_indexes == [0]
+        assert inst.computed_resource_claim.chips == 1
+
+    asyncio.run(go())
+
+
+def test_schedule_unschedulable_backs_off(ctx):
+    async def go():
+        await _add_worker(v5e_8(0))
+        model = await Model.create(Model(name="m", preset="llama3-70b"))
+        inst = await ModelInstance.create(
+            ModelInstance(name="m-0", model_id=model.id)
+        )
+        sched = Scheduler()
+        await sched._schedule_one(inst.id)
+        inst = await ModelInstance.get(inst.id)
+        assert inst.state == ModelInstanceState.PENDING
+        assert "no fit" in inst.state_message
+
+    asyncio.run(go())
+
+
+def test_schedule_multihost_writes_subordinates(ctx):
+    async def go():
+        for hid in range(4):
+            await _add_worker(v5e_32_host(0, hid))
+        model = await Model.create(Model(name="m", preset="llama3-70b"))
+        inst = await ModelInstance.create(
+            ModelInstance(name="m-0", model_id=model.id)
+        )
+        sched = Scheduler()
+        await sched._schedule_one(inst.id)
+        inst = await ModelInstance.get(inst.id)
+        assert inst.state == ModelInstanceState.SCHEDULED
+        assert inst.computed_resource_claim.chips == 16
+        assert len(inst.subordinate_workers) == 1
+        assert inst.coordinator_address       # jax rendezvous assigned
+        assert "tp8" in inst.computed_resource_claim.mesh_plan
+
+    asyncio.run(go())
+
+
+def test_stuck_instance_rescheduled(ctx):
+    async def go():
+        await _add_worker(v5e_8(0))
+        model = await Model.create(Model(name="m", preset="tiny"))
+        inst = await ModelInstance.create(
+            ModelInstance(name="m-0", model_id=model.id)
+        )
+        # simulate a placement that never progressed, long ago
+        await inst.update(
+            state=ModelInstanceState.SCHEDULED, worker_id=1,
+            chip_indexes=[0],
+        )
+        inst.updated_at = "2020-01-01T00:00:00+00:00"
+        await inst.save()
+        sched = Scheduler()
+        await sched._scan()
+        inst = await ModelInstance.get(inst.id)
+        # reset to PENDING by the scan... and then immediately picked up
+        # again by _scan's own pending pass or left pending
+        assert inst.state in (
+            ModelInstanceState.PENDING, ModelInstanceState.SCHEDULED
+        )
+        assert (
+            inst.state_message == "rescheduled after timeout"
+            or inst.state == ModelInstanceState.SCHEDULED
+        )
+
+    asyncio.run(go())

@@ -1,0 +1,62 @@
+"""Orphan engine reaping across agent restarts (pidfile-based)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.worker.serve_manager import ServeManager
+
+
+class _NullClient:
+    pass
+
+
+def test_reap_orphans(tmp_path):
+    cfg = Config.load({"data_dir": str(tmp_path)})
+    sm = ServeManager(cfg, _NullClient(), worker_id=1)
+
+    # a fake orphan that *looks like* an engine process
+    orphan = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import time\n"
+            "# gpustack_tpu.engine.api_server lookalike cmdline marker\n"
+            "time.sleep(300)",
+            "gpustack_tpu.engine.api_server-marker",
+        ],
+        start_new_session=True,
+    )
+    with open(sm._pidfile(41), "w") as f:
+        f.write(str(orphan.pid))
+    # a stale pidfile whose process is gone
+    with open(sm._pidfile(42), "w") as f:
+        f.write("999999")
+    # a pidfile pointing at a non-engine process (must NOT be killed)
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(300)"],
+        start_new_session=True,
+    )
+    with open(sm._pidfile(43), "w") as f:
+        f.write(str(bystander.pid))
+
+    try:
+        reaped = sm.reap_orphans()
+        assert reaped == 1
+        # orphan got SIGTERM
+        deadline = time.time() + 10
+        while time.time() < deadline and orphan.poll() is None:
+            time.sleep(0.1)
+        assert orphan.poll() is not None
+        # bystander survived
+        assert bystander.poll() is None
+        # all pidfiles cleaned up
+        assert not [
+            f for f in os.listdir(sm.log_dir) if f.endswith(".pid")
+        ]
+    finally:
+        for p in (orphan, bystander):
+            if p.poll() is None:
+                os.kill(p.pid, signal.SIGKILL)
