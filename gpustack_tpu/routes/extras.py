@@ -133,13 +133,21 @@ def add_extra_routes(app: web.Application) -> None:
         ):
             return json_error(403, "user token required")
 
+        model_where = ""
+        model_params: list = []
+        if not principal.is_admin:
+            # non-admins see only their own usage in every section
+            model_where = " WHERE user_id = ?"
+            model_params = [principal.user.id]
         rows = await Record.db().execute(
             "SELECT route_name AS route, "
             "COUNT(*) AS requests, "
             "COALESCE(SUM(json_extract(data, '$.prompt_tokens')), 0) AS pt, "
             "COALESCE(SUM(json_extract(data, '$.completion_tokens')), 0) "
             "AS ct "
-            "FROM model_usage GROUP BY route_name ORDER BY requests DESC"
+            f"FROM model_usage{model_where} "
+            "GROUP BY route_name ORDER BY requests DESC",
+            model_params,
         )
         user_where = ""
         user_params: list = []

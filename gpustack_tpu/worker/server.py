@@ -32,6 +32,7 @@ class WorkerServer:
                 web.get(
                     "/v2/instances/{id:\\d+}/logs", self.instance_logs
                 ),
+                web.get("/v2/filesystem/probe", self.filesystem_probe),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -102,6 +103,74 @@ class WorkerServer:
                             f'{name}{{instance_id="{iid}"}} {value}'
                         )
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def filesystem_probe(self, request: web.Request) -> web.Response:
+        """Probe a worker-local model path for the scheduler/evaluator
+        (reference routes/worker/filesystem.py: remote filesystem checks
+        for scheduling + config probing).
+
+        Deliberately narrow: only paths under the worker's model roots
+        (cache dir + GPUSTACK_TPU_MODEL_ROOTS) are probe-able — the
+        worker port carries no auth, so this must not be a filesystem
+        oracle — and only ``config.json`` content is ever returned.
+        """
+        import glob as _glob
+        import json as _json
+
+        path = request.query.get("path", "")
+        if not path or not os.path.isabs(path):
+            return web.json_response(
+                {"error": "absolute 'path' query param required"},
+                status=400,
+            )
+        real = os.path.realpath(path)
+        roots = [os.path.realpath(self.agent.cfg.cache_dir)]
+        roots += [
+            os.path.realpath(r)
+            for r in os.environ.get(
+                "GPUSTACK_TPU_MODEL_ROOTS", ""
+            ).split(":")
+            if r
+        ]
+        if not any(
+            real == root or real.startswith(root + os.sep)
+            for root in roots
+        ):
+            return web.json_response(
+                {
+                    "error": (
+                        "path outside configured model roots (cache dir "
+                        "or GPUSTACK_TPU_MODEL_ROOTS)"
+                    )
+                },
+                status=403,
+            )
+        path = real
+        result = {
+            "path": path,
+            "exists": os.path.isdir(path),
+            "safetensors_files": 0,
+            "gguf_files": 0,
+            "total_bytes": 0,
+            "config": None,
+        }
+        if result["exists"]:
+            escaped = _glob.escape(path)
+            st = _glob.glob(os.path.join(escaped, "*.safetensors"))
+            gg = _glob.glob(os.path.join(escaped, "*.gguf"))
+            result["safetensors_files"] = len(st)
+            result["gguf_files"] = len(gg)
+            result["total_bytes"] = sum(
+                os.path.getsize(f) for f in st + gg if os.path.exists(f)
+            )
+            cfg_path = os.path.join(path, "config.json")
+            if os.path.exists(cfg_path):
+                try:
+                    with open(cfg_path) as f:
+                        result["config"] = _json.load(f)
+                except (OSError, _json.JSONDecodeError) as e:
+                    result["config_error"] = str(e)
+        return web.json_response(result)
 
     async def instance_logs(self, request: web.Request) -> web.Response:
         sm = self.agent.serve_manager

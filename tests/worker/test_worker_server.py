@@ -1,0 +1,85 @@
+"""Worker HTTP server: filesystem probe contract."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.detectors import create_detector
+from gpustack_tpu.worker.server import WorkerServer
+
+
+class _FakeAgent:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.worker_id = 1
+        self.detector = create_detector()
+        self.serve_manager = None
+
+
+def _run(cfg, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = WorkerServer(_FakeAgent(cfg))
+
+    async def run():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_filesystem_probe(tmp_path, monkeypatch):
+    from safetensors.numpy import save_file
+
+    cfg = Config.load({"data_dir": str(tmp_path / "data")})
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    # the probe only serves paths under configured model roots
+    monkeypatch.setenv("GPUSTACK_TPU_MODEL_ROOTS", str(model_dir))
+    (model_dir / "config.json").write_text(
+        json.dumps({"hidden_size": 64})
+    )
+    save_file(
+        {"w": np.zeros((8, 8), np.float16)},
+        str(model_dir / "model.safetensors"),
+    )
+
+    async def go(client):
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": str(model_dir)}
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["exists"] is True
+        assert data["safetensors_files"] == 1
+        assert data["total_bytes"] > 0
+        assert data["config"]["hidden_size"] == 64
+
+        r = await client.get(
+            "/v2/filesystem/probe",
+            params={"path": str(model_dir / "nope")},
+        )
+        assert (await r.json())["exists"] is False
+
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": "relative/x"}
+        )
+        assert r.status == 400
+
+        # outside model roots: refused, no oracle
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": "/etc"}
+        )
+        assert r.status == 403
+
+        # healthz works without a serve manager
+        r = await client.get("/healthz")
+        assert (await r.json())["status"] == "ok"
+
+    _run(cfg, go)
