@@ -7,4 +7,20 @@
 
 from gpustack_tpu.ops.ring_attention import ring_attention, sharded_prefill_attention
 
-__all__ = ["ring_attention", "sharded_prefill_attention"]
+__all__ = [
+    "flash_attention_prefill",
+    "ring_attention",
+    "sharded_prefill_attention",
+]
+
+
+def __getattr__(name):
+    # lazy: the pallas import chain is only paid when the (gated) kernel
+    # is actually requested
+    if name == "flash_attention_prefill":
+        from gpustack_tpu.ops.flash_attention import (
+            flash_attention_prefill,
+        )
+
+        return flash_attention_prefill
+    raise AttributeError(name)

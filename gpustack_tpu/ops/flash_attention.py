@@ -1,0 +1,136 @@
+"""Pallas TPU flash-attention kernel for causal prefill.
+
+Blocked online-softmax attention: each program owns one (batch, q-head,
+q-block) tile, streams K/V blocks from VMEM, and never materializes the
+[T, S] score matrix in HBM — the prefill attention scratch (134 MB for a
+1024-token bucket at 8B scale via the XLA path) collapses to
+O(BLOCK_Q × BLOCK_K).
+
+Status: correctness-verified in interpret mode (hermetic CPU tests);
+enabling it as the engine's prefill path is gated until it can be
+profiled against XLA's fused attention on real chips (wiring flag:
+``GPUSTACK_TPU_FLASH``). Written from the flash-attention recurrence.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, seq_k: int):
+    """One (batch, q-head, q-block) tile; streams K/V in BLOCK_K chunks."""
+    qb = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)          # [BQ, d]
+    bq = q.shape[0]
+    d = q.shape[1]
+
+    q_idx = qb * BLOCK_Q + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(
+            jnp.float32
+        )                                         # [BK, d]
+        v_blk = v_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :].astype(
+            jnp.float32
+        )
+        s = jax.lax.dot_general(
+            q, k_blk,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                 # [BQ, BK]
+        k_idx = kb * BLOCK_K + lax.broadcasted_iota(
+            jnp.int32, (1, BLOCK_K), 1
+        )
+        mask = (k_idx <= q_idx) & (k_idx < seq_k)
+        s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - m_new[:, None]))
+        corr = jnp.where(m <= _NEG / 2, 0.0, jnp.exp(m - m_new))
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    n_kb = pl.cdiv(seq_k, BLOCK_K)
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def flash_attention_prefill(
+    q: jax.Array,       # [B, T, Hq, d]
+    k: jax.Array,       # [B, S, Hkv, d]
+    v: jax.Array,       # [B, S, Hkv, d]
+    scale: float,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal GQA prefill attention (positions 0..T-1). Returns
+    [B, T, Hq*d]. T and S are padded to block multiples internally."""
+    B, T, Hq, d = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if Hq % Hkv != 0:
+        raise ValueError(
+            f"q heads ({Hq}) must be a multiple of kv heads ({Hkv})"
+        )
+    G = Hq // Hkv
+    # This version holds one head's full K/V in VMEM; bound it loudly
+    # instead of failing opaquely at compile time. Long-context prefill
+    # uses ring attention / the XLA path until the k-blocked grid variant
+    # lands (round-2 upgrade).
+    s_pad_bytes = 2 * (-(-S // BLOCK_K) * BLOCK_K) * d * k.dtype.itemsize
+    if s_pad_bytes > 8 * 2**20:
+        raise ValueError(
+            f"sequence too long for the VMEM-resident K/V layout "
+            f"({s_pad_bytes // 2**20} MiB > 8 MiB); use ring attention "
+            f"or the XLA attention path for this length"
+        )
+
+    # head-major layout for blocking; pad seq dims to block multiples
+    qt = jnp.transpose(q, (0, 2, 1, 3))          # [B, Hq, T, d]
+    kt = jnp.transpose(k, (0, 2, 1, 3))          # [B, Hkv, S, d]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    T_pad = -(-T // BLOCK_Q) * BLOCK_Q
+    S_pad = -(-S // BLOCK_K) * BLOCK_K
+    qt = jnp.pad(qt, ((0, 0), (0, 0), (0, T_pad - T), (0, 0)))
+    kt = jnp.pad(kt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+    vt = jnp.pad(vt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+
+    grid = (B, Hq, T_pad // BLOCK_Q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, seq_k=S),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T_pad, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, d), lambda b, h, qb: (b, h, qb, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, S_pad, d), lambda b, h, qb, G=G: (b, h // G, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, S_pad, d), lambda b, h, qb, G=G: (b, h // G, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, d), lambda b, h, qb: (b, h, qb, 0)
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    out = jnp.transpose(out[:, :, :T, :], (0, 2, 1, 3))  # [B, T, Hq, d]
+    return out.reshape(B, T, Hq * d)

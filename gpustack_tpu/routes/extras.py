@@ -133,32 +133,24 @@ def add_extra_routes(app: web.Application) -> None:
         ):
             return json_error(403, "user token required")
 
-        model_where = ""
-        model_params: list = []
-        if not principal.is_admin:
-            # non-admins see only their own usage in every section
-            model_where = " WHERE user_id = ?"
-            model_params = [principal.user.id]
+        # non-admins see only their own usage in every section
+        where = "" if principal.is_admin else " WHERE user_id = ?"
+        params: list = [] if principal.is_admin else [principal.user.id]
         rows = await Record.db().execute(
             "SELECT route_name AS route, "
             "COUNT(*) AS requests, "
             "COALESCE(SUM(json_extract(data, '$.prompt_tokens')), 0) AS pt, "
             "COALESCE(SUM(json_extract(data, '$.completion_tokens')), 0) "
             "AS ct "
-            f"FROM model_usage{model_where} "
+            f"FROM model_usage{where} "
             "GROUP BY route_name ORDER BY requests DESC",
-            model_params,
+            params,
         )
-        user_where = ""
-        user_params: list = []
-        if not principal.is_admin:
-            user_where = " WHERE user_id = ?"
-            user_params = [principal.user.id]
         by_user = await Record.db().execute(
             "SELECT user_id, COUNT(*) AS requests, "
             "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
-            f"FROM model_usage{user_where} GROUP BY user_id",
-            user_params,
+            f"FROM model_usage{where} GROUP BY user_id",
+            params,
         )
         return web.json_response(
             {

@@ -164,12 +164,21 @@ class WorkerServer:
                 os.path.getsize(f) for f in st + gg if os.path.exists(f)
             )
             cfg_path = os.path.join(path, "config.json")
-            if os.path.exists(cfg_path):
+            # re-resolve: a symlinked config.json inside an allowed root
+            # must not read files outside the roots
+            cfg_real = os.path.realpath(cfg_path)
+            cfg_allowed = any(
+                cfg_real == root or cfg_real.startswith(root + os.sep)
+                for root in roots
+            )
+            if os.path.exists(cfg_path) and cfg_allowed:
                 try:
-                    with open(cfg_path) as f:
+                    with open(cfg_real) as f:
                         result["config"] = _json.load(f)
                 except (OSError, _json.JSONDecodeError) as e:
                     result["config_error"] = str(e)
+            elif os.path.exists(cfg_path):
+                result["config_error"] = "config.json escapes model roots"
         return web.json_response(result)
 
     async def instance_logs(self, request: web.Request) -> web.Response:
