@@ -18,12 +18,36 @@ Prints ONE JSON line:
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_OUT_TPS_PER_CHIP = 189.0  # Qwen3-8B, 910B x8: 1512.21/8
+
+
+def tpu_available(timeout: float = 90.0) -> bool:
+    """Probe the TPU backend in a throwaway subprocess.
+
+    A wedged TPU tunnel can hang ``jax.devices()`` indefinitely or fail
+    backend init with a hard error; either must degrade this bench to a
+    structured CPU result, not an rc!=0 crash. The probe runs out of
+    process so a hang can't take the bench down with it.
+    """
+    code = (
+        "import jax; ds = jax.devices(); "
+        "assert any(d.platform != 'cpu' for d in ds), ds"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
 
 PROMPT_LEN = 1000      # pads into the 1024 prefill bucket
 OUTPUT_LEN = 128
@@ -52,11 +76,20 @@ def build_engine(cfg_name: str, max_slots: int, max_seq_len: int):
 
 
 def main() -> None:
+    on_tpu = tpu_available()
+    if not on_tpu:
+        # Force the CPU platform BEFORE any backend init (env vars don't
+        # beat a sitecustomize that set jax_platforms via jax.config) and
+        # shrink to smoke size: an 8B forward on a 1-core host is useless.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     import numpy as np
 
     from gpustack_tpu.engine.engine import GenRequest
 
-    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    smoke = (not on_tpu) or os.environ.get("BENCH_SMOKE") == "1"
     cfg_name = "tiny" if smoke else "llama3-8b"
     prompt_len = 56 if smoke else PROMPT_LEN
     output_len = 16 if smoke else OUTPUT_LEN
@@ -122,6 +155,7 @@ def main() -> None:
                     "p50_ttft_ms": round(p50_ttft, 1),
                     "platform": jax.default_backend(),
                     "device": str(jax.devices()[0]),
+                    "tpu_unavailable": not on_tpu,
                 },
             }
         )

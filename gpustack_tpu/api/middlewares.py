@@ -6,6 +6,7 @@ Reference analogue: the FastAPI dependency chain ``get_current_user``
 from __future__ import annotations
 
 import logging
+import re
 import time
 
 from aiohttp import web
@@ -22,6 +23,35 @@ PUBLIC_PATHS = {
     "/v2/workers/register",
     "/metrics",
 }
+
+# Worker tokens are confined to the routes the agent actually needs
+# (reference confines worker credentials to worker endpoints — a
+# compromised worker must not be able to read users/usage or mutate other
+# workers' resources). Everything else on /v2 is denied for kind=worker;
+# per-record ownership is enforced again inside the CRUD write guard.
+_WORKER_ROUTE_ALLOWLIST = (
+    ("POST", re.compile(r"^/v2/workers/\d+/(status|heartbeat)$")),
+    # reads + watch streams the agent's reconcile loops depend on
+    ("GET", re.compile(
+        r"^/v2/(models|model-instances|model-files|benchmarks|"
+        r"inference-backends|workers)(/\d+)?$"
+    )),
+    # instance/file/benchmark state reporting (ownership-guarded in crud)
+    ("POST", re.compile(r"^/v2/model-files$")),
+    ("PUT", re.compile(
+        r"^/v2/(model-instances|model-files|benchmarks)/\d+$"
+    )),
+    ("PATCH", re.compile(
+        r"^/v2/(model-instances|model-files|benchmarks)/\d+$"
+    )),
+)
+
+
+def worker_route_allowed(method: str, path: str) -> bool:
+    return any(
+        method == m and rx.match(path)
+        for m, rx in _WORKER_ROUTE_ALLOWLIST
+    )
 
 
 def _extract_token(request: web.Request) -> str:
@@ -54,6 +84,12 @@ async def auth_middleware(request: web.Request, handler):
         if not principal.has_scope("management"):
             return web.json_response(
                 {"error": "token lacks management scope"}, status=403
+            )
+    if path.startswith("/v2/") and principal.kind == "worker":
+        if not worker_route_allowed(request.method, path):
+            return web.json_response(
+                {"error": "worker tokens cannot access this route"},
+                status=403,
             )
     request["principal"] = principal
     return await handler(request)

@@ -183,11 +183,19 @@ def init_quantized_params(cfg, seed: int = 0):
         layers["w_down"] = qw((L, f, d), f, "w_down")
 
     params = {
-        "embed": qw((cfg.vocab_size, d), 2500, "embed"),  # ~0.02 scale
         "layers": layers,
         "final_norm": ones(d),
     }
-    if not cfg.tie_word_embeddings:
+    if cfg.tie_word_embeddings:
+        # Tied models contract embed.T at the LM head (transformer.forward
+        # uses a raw einsum there) — keep embed bf16, matching
+        # quantize_params' tied-embedding rule above.
+        params["embed"] = jnp.asarray(
+            rng.standard_normal((cfg.vocab_size, d), dtype=np.float32)
+            * 0.02
+        ).astype(jnp.bfloat16)
+    else:
+        params["embed"] = qw((cfg.vocab_size, d), 2500, "embed")  # ~0.02
         params["lm_head"] = qw((d, cfg.vocab_size), d, "lm_head")
     return params
 

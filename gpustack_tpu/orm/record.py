@@ -53,7 +53,10 @@ class Record(pydantic.BaseModel):
     """Base record. Subclasses set ``__kind__`` and optional ``__indexes__``
     (field names extracted into SQL columns for indexed filtering)."""
 
-    model_config = pydantic.ConfigDict(validate_assignment=False)
+    # validate_assignment so update(state="error") coerces wire strings
+    # back to enum/nested-model types — without it state fields type-drift
+    # into raw strings after any HTTP PATCH round-trip.
+    model_config = pydantic.ConfigDict(validate_assignment=True)
 
     __kind__: ClassVar[str] = ""
     __indexes__: ClassVar[Tuple[str, ...]] = ()

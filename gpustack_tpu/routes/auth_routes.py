@@ -207,6 +207,8 @@ def add_worker_facing_routes(app: web.Application) -> None:
         if principal is None:
             return json_error(403, "worker token required")
         worker_id = int(request.match_info["id"])
+        if principal.kind == "worker" and principal.worker_id != worker_id:
+            return json_error(403, "token does not match worker")
         worker = await Worker.get(worker_id)
         if worker is None:
             return json_error(404, "worker not found")

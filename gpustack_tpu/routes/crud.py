@@ -36,6 +36,24 @@ def require_admin(request: web.Request) -> Optional[web.Response]:
     return None
 
 
+def default_worker_owns(principal, obj, new_fields) -> bool:
+    """A worker owns records that are unassigned (claimable) or its own.
+
+    ``obj`` is None for creates; ``new_fields`` is the incoming field dict
+    (None for deletes). Resources with stricter semantics (model
+    instances) pass their own checker to add per-field restrictions.
+    """
+    if obj is not None and getattr(obj, "worker_id", 0) not in (
+        None, 0, principal.worker_id
+    ):
+        return False
+    if new_fields and new_fields.get("worker_id") not in (
+        None, 0, principal.worker_id
+    ):
+        return False
+    return True
+
+
 def add_crud_routes(
     app: web.Application,
     cls: Type[Record],
@@ -46,10 +64,59 @@ def add_crud_routes(
     delete_hook: Optional[Callable] = None,
     readonly: bool = False,
     admin_write: bool = True,
+    worker_write: bool = False,
+    admin_read: bool = False,
+    redact: tuple = (),
+    worker_owns: Callable = default_worker_owns,
 ) -> None:
+    """Mount list/get/watch/create/update/delete for one Record type.
+
+    Write access (reference confines mutation to admins and each worker's
+    own records — routes/routes.py admin routers + worker auth):
+      - ``admin_write=True`` (default): creates/updates/deletes require an
+        admin (or system) principal.
+      - ``worker_write=True``: additionally let WORKER principals write,
+        but only records they own per ``worker_owns`` (unassigned records
+        are claimable — the benchmark/model-file claim pattern), and they
+        can never assign a record to a different worker.
+    Read access: ``admin_read=True`` restricts list/get/watch to admins
+    (user records). ``redact`` strips fields (e.g. password_hash) from
+    every serialized response including watch payloads.
+    """
     base = f"/v2/{path}"
 
+    def dump(obj: Record) -> dict:
+        data = obj.model_dump(mode="json")
+        for field in redact:
+            data.pop(field, None)
+        return data
+
+    def check_read(request: web.Request) -> Optional[web.Response]:
+        if admin_read and (err := require_admin(request)):
+            return err
+        return None
+
+    def check_write(
+        request: web.Request, existing, new_fields: Optional[dict]
+    ) -> Optional[web.Response]:
+        principal = request.get("principal")
+        if principal is None:
+            return json_error(401, "authentication required")
+        if not admin_write and not worker_write:
+            return None
+        if principal.is_admin:
+            return None
+        if worker_write and principal.kind == "worker":
+            if not worker_owns(principal, existing, new_fields):
+                return json_error(
+                    403, f"worker token may not write this {path} record"
+                )
+            return None
+        return json_error(403, "admin privileges required")
+
     async def list_or_watch(request: web.Request):
+        if err := check_read(request):
+            return err
         if request.query.get("watch") in ("true", "1"):
             return await watch(request)
         filters = {}
@@ -67,7 +134,7 @@ def add_crud_routes(
         total = await cls.count(**filters)
         return web.json_response(
             {
-                "items": [i.model_dump(mode="json") for i in items],
+                "items": [dump(i) for i in items],
                 "pagination": {
                     "total": total,
                     "limit": limit,
@@ -84,8 +151,19 @@ def add_crud_routes(
         agen = cls.subscribe(send_initial=True, heartbeat=15.0)
         try:
             async for event in agen:
+                wire = event.to_wire()
+                if redact:
+                    # to_wire aliases the Event's own dicts and the bus
+                    # hands one Event to every subscriber — copy before
+                    # popping or redaction corrupts other subscribers.
+                    for key in ("data", "changes"):
+                        if isinstance(wire.get(key), dict):
+                            wire[key] = {
+                                k: v for k, v in wire[key].items()
+                                if k not in redact
+                            }
                 await resp.write(
-                    (json.dumps(event.to_wire()) + "\n").encode()
+                    (json.dumps(wire) + "\n").encode()
                 )
         except (ConnectionResetError, asyncio.CancelledError):
             pass
@@ -94,14 +172,14 @@ def add_crud_routes(
         return resp
 
     async def get_one(request: web.Request):
+        if err := check_read(request):
+            return err
         obj = await cls.get(int(request.match_info["id"]))
         if obj is None:
             return json_error(404, f"{path} not found")
-        return web.json_response(obj.model_dump(mode="json"))
+        return web.json_response(dump(obj))
 
     async def create(request: web.Request):
-        if admin_write and (err := require_admin(request)):
-            return err
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -110,16 +188,20 @@ def add_crud_routes(
             obj = cls.model_validate(body)
         except pydantic.ValidationError as e:
             return json_error(400, str(e))
+        if err := check_write(request, None, body):
+            return err
         obj.id = 0
         if create_hook:
             err = await create_hook(request, obj, body)
             if err is not None:
                 return err
         await cls.create(obj)
-        return web.json_response(obj.model_dump(mode="json"), status=201)
+        return web.json_response(dump(obj), status=201)
 
     async def update(request: web.Request):
-        if admin_write and (err := require_admin(request)):
+        # role-gate before the fetch: a 404-vs-403 difference would give
+        # unauthorized principals an id-existence oracle
+        if err := check_write(request, None, None):
             return err
         obj = await cls.get(int(request.match_info["id"]))
         if obj is None:
@@ -132,6 +214,8 @@ def add_crud_routes(
             k: v for k, v in body.items()
             if k in cls.model_fields and k not in ("id", "created_at")
         }
+        if err := check_write(request, obj, fields):
+            return err
         # validate merged doc before persisting
         merged = obj.model_dump()
         merged.update(fields)
@@ -146,14 +230,16 @@ def add_crud_routes(
         await obj.update(
             **{k: getattr(validated, k) for k in fields}
         )
-        return web.json_response(obj.model_dump(mode="json"))
+        return web.json_response(dump(obj))
 
     async def delete(request: web.Request):
-        if admin_write and (err := require_admin(request)):
+        if err := check_write(request, None, None):
             return err
         obj = await cls.get(int(request.match_info["id"]))
         if obj is None:
             return json_error(404, f"{path} not found")
+        if err := check_write(request, obj, None):
+            return err
         if delete_hook:
             err = await delete_hook(request, obj)
             if err is not None:

@@ -110,13 +110,52 @@ def create_app(cfg: Config) -> web.Application:
             obj.password_hash = auth_mod.hash_password(password)
         return None
 
+    # Placement is written by the scheduler in-process; a worker that could
+    # rewrite it (or worker_ip/port) would redirect all proxy traffic for
+    # the model to an address of its choosing.
+    INSTANCE_PLACEMENT_FIELDS = frozenset(
+        {
+            "worker_id", "worker_name", "worker_ip", "chip_indexes",
+            "computed_resource_claim", "subordinate_workers",
+            "model_id", "model_name", "cluster_id", "name",
+        }
+    )
+    # Runtime endpoint fields only the leading (placed-on) worker reports.
+    INSTANCE_LEADER_FIELDS = frozenset(
+        {"port", "coordinator_address", "pid"}
+    )
+
+    def instance_worker_owns(principal, inst, new_fields) -> bool:
+        if inst is None:
+            # role gate (fields None) passes; creates (fields set) are the
+            # controller's job, never a worker's
+            return new_fields is None
+        touched = set(new_fields or ())
+        if touched & INSTANCE_PLACEMENT_FIELDS:
+            return False
+        if inst.worker_id == principal.worker_id:
+            return True
+        is_subordinate = any(
+            s.worker_id == principal.worker_id
+            for s in inst.subordinate_workers
+        )
+        # followers report state only — endpoint fields are leader-owned
+        return is_subordinate and not (touched & INSTANCE_LEADER_FIELDS)
+
     add_crud_routes(app, Model, "models", create_hook=model_create_hook)
-    add_crud_routes(app, ModelInstance, "model-instances", admin_write=False)
+    add_crud_routes(
+        app, ModelInstance, "model-instances",
+        worker_write=True, worker_owns=instance_worker_owns,
+    )
     add_crud_routes(app, Worker, "workers")
     add_crud_routes(app, Cluster, "clusters")
     add_crud_routes(app, ModelRoute, "model-routes")
-    add_crud_routes(app, ModelFile, "model-files", admin_write=False)
-    add_crud_routes(app, User, "users", create_hook=user_create_hook)
+    add_crud_routes(app, ModelFile, "model-files", worker_write=True)
+    add_crud_routes(
+        app, User, "users",
+        create_hook=user_create_hook,
+        admin_read=True, redact=("password_hash",),
+    )
     async def benchmark_create_hook(request, obj: Benchmark, body):
         if await Model.get(obj.model_id) is None:
             return json_error(
@@ -136,10 +175,14 @@ def create_app(cfg: Config) -> web.Application:
     # workers update benchmark state/metrics with their worker tokens
     add_crud_routes(
         app, Benchmark, "benchmarks",
-        admin_write=False, create_hook=benchmark_create_hook,
+        worker_write=True, create_hook=benchmark_create_hook,
     )
     add_crud_routes(app, InferenceBackend, "inference-backends")
-    add_crud_routes(app, ModelUsage, "model-usage", readonly=True)
+    # per-user usage rows: /v2/usage/summary already scopes non-admins to
+    # their own usage (extras.py); raw rows are admin-only to match.
+    add_crud_routes(
+        app, ModelUsage, "model-usage", readonly=True, admin_read=True
+    )
 
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):

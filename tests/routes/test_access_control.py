@@ -1,0 +1,281 @@
+"""Access-control hardening over the live server app.
+
+Covers the reference's access model (admin-only user records, worker
+credentials confined to worker endpoints, per-worker record ownership —
+reference routes/routes.py admin routers, api/auth.py worker_auth):
+  - /v2/users reads are admin-only and never serialize password_hash
+  - /v2/model-usage raw rows are admin-only
+  - model-instance writes require admin or the owning worker's token
+  - worker tokens are denied outside their route allowlist
+  - heartbeat/status identity is pinned to the token's worker id
+"""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.schemas.models import SubordinateWorker
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+def run_app(cfg, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        admin = await User.create(
+            User(
+                username="admin",
+                is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        plain = await User.create(
+            User(
+                username="joe",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        w1 = await Worker.create(
+            Worker(name="w1", state=WorkerState.READY)
+        )
+        w2 = await Worker.create(
+            Worker(name="w2", state=WorkerState.READY)
+        )
+        tokens = {
+            "admin": auth_mod.issue_session_token(admin, cfg.jwt_secret),
+            "user": auth_mod.issue_session_token(plain, cfg.jwt_secret),
+            "w1": auth_mod.issue_worker_token(w1.id, cfg.jwt_secret),
+            "w2": auth_mod.issue_worker_token(w2.id, cfg.jwt_secret),
+        }
+        hdrs = {
+            k: {"Authorization": f"Bearer {v}"} for k, v in tokens.items()
+        }
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client, hdrs, (w1, w2))
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_user_records_admin_only_and_redacted(cfg):
+    async def go(client, hdrs, workers):
+        r = await client.get("/v2/users", headers=hdrs["user"])
+        assert r.status == 403
+        r = await client.get("/v2/users", headers=hdrs["w1"])
+        assert r.status == 403  # worker allowlist
+        r = await client.get("/v2/users", headers=hdrs["admin"])
+        assert r.status == 200
+        items = (await r.json())["items"]
+        assert items and all("password_hash" not in u for u in items)
+        r = await client.get(
+            f"/v2/users/{items[0]['id']}", headers=hdrs["admin"]
+        )
+        assert "password_hash" not in await r.json()
+
+    run_app(cfg, go)
+
+
+def test_model_usage_admin_only(cfg):
+    async def go(client, hdrs, workers):
+        await ModelUsage.create(
+            ModelUsage(user_id=2, model_id=1, prompt_tokens=5)
+        )
+        r = await client.get("/v2/model-usage", headers=hdrs["user"])
+        assert r.status == 403
+        r = await client.get("/v2/model-usage", headers=hdrs["w1"])
+        assert r.status == 403
+        r = await client.get("/v2/model-usage", headers=hdrs["admin"])
+        assert r.status == 200
+
+    run_app(cfg, go)
+
+
+def test_instance_writes_require_admin_or_owner(cfg):
+    async def go(client, hdrs, workers):
+        w1, w2 = workers
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="m-0", model_id=1, worker_id=w1.id, port=9000
+            )
+        )
+        # non-admin user: denied (the round-1 hijack vector)
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"worker_ip": "6.6.6.6", "state": "running"},
+            headers=hdrs["user"],
+        )
+        assert r.status == 403
+        # other worker: denied
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"state": "running"},
+            headers=hdrs["w2"],
+        )
+        assert r.status == 403
+        # owning worker: allowed
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"state": "running"},
+            headers=hdrs["w1"],
+        )
+        assert r.status == 200
+        # owning worker cannot hand the instance to another worker
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"worker_id": w2.id},
+            headers=hdrs["w1"],
+        )
+        assert r.status == 403
+        # ... nor rewrite its own placement/endpoint address (hijack)
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"worker_ip": "203.0.113.9"},
+            headers=hdrs["w1"],
+        )
+        assert r.status == 403
+        # workers cannot create instances at all
+        r = await client.post(
+            "/v2/model-instances",
+            json={"name": "rogue", "model_id": 1},
+            headers=hdrs["w1"],
+        )
+        assert r.status in (403, 405)
+        # admin: allowed
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"state_message": "ok"},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 200
+
+    run_app(cfg, go)
+
+
+def test_subordinate_worker_may_update_instance(cfg):
+    async def go(client, hdrs, workers):
+        w1, w2 = workers
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="m-0",
+                model_id=1,
+                worker_id=w1.id,
+                subordinate_workers=[
+                    SubordinateWorker(worker_id=w2.id, process_index=1)
+                ],
+            )
+        )
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"state_message": "follower up"},
+            headers=hdrs["w2"],
+        )
+        assert r.status == 200
+        # followers may not touch leader-owned endpoint fields
+        r = await client.put(
+            f"/v2/model-instances/{inst.id}",
+            json={"port": 1234},
+            headers=hdrs["w2"],
+        )
+        assert r.status == 403
+        # non-admin users get 403 for missing ids too (no id oracle)
+        r = await client.put(
+            "/v2/model-instances/999999",
+            json={"state_message": "x"},
+            headers=hdrs["user"],
+        )
+        assert r.status == 403
+
+    run_app(cfg, go)
+
+
+def test_worker_route_allowlist(cfg):
+    async def go(client, hdrs, workers):
+        w1, _ = workers
+        # allowed reads
+        for path in ("/v2/models", "/v2/model-instances", "/v2/workers"):
+            r = await client.get(path, headers=hdrs["w1"])
+            assert r.status == 200, path
+        # denied resources
+        for path in ("/v2/clusters", "/v2/model-routes", "/v2/usage/summary"):
+            r = await client.get(path, headers=hdrs["w1"])
+            assert r.status == 403, path
+        # worker cannot create models
+        r = await client.post(
+            "/v2/models", json={"name": "evil"}, headers=hdrs["w1"]
+        )
+        assert r.status == 403
+        # worker cannot mutate workers table directly
+        r = await client.put(
+            f"/v2/workers/{w1.id}", json={"name": "x"}, headers=hdrs["w1"]
+        )
+        assert r.status == 403
+
+    run_app(cfg, go)
+
+
+def test_heartbeat_identity_pinned(cfg):
+    async def go(client, hdrs, workers):
+        w1, w2 = workers
+        r = await client.post(
+            f"/v2/workers/{w2.id}/heartbeat", json={}, headers=hdrs["w1"]
+        )
+        assert r.status == 403
+        r = await client.post(
+            f"/v2/workers/{w1.id}/heartbeat", json={}, headers=hdrs["w1"]
+        )
+        assert r.status == 200
+        r = await client.post(
+            f"/v2/workers/{w2.id}/status",
+            json={"status": {}},
+            headers=hdrs["w1"],
+        )
+        assert r.status == 403
+
+    run_app(cfg, go)
+
+
+def test_users_watch_redacts_password_hash(cfg):
+    async def go(client, hdrs, workers):
+        import json as jsonlib
+
+        async with client.get(
+            "/v2/users?watch=true", headers=hdrs["admin"]
+        ) as resp:
+            assert resp.status == 200
+            # initial snapshot events must not leak hashes
+            seen = 0
+            async for line in resp.content:
+                event = jsonlib.loads(line)
+                if event["type"] in ("CREATED", "UPDATED"):
+                    assert "password_hash" not in (event.get("data") or {})
+                    seen += 1
+                if seen >= 2:
+                    break
+
+    run_app(cfg, go)
