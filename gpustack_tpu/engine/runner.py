@@ -78,6 +78,21 @@ class ModelRunner:
         self.mesh = mesh or make_mesh(self.plan)
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
+        # Context-parallel serving: with sp > 1 the KV cache lives
+        # seq-sharded over sp for the whole generation; prefill runs ring
+        # attention, decode/verify the pmax/psum merge (ops/ring_attention).
+        self.sp_mode = self.plan.sp > 1
+        if self.sp_mode:
+            if self.plan.dp != 1:
+                raise ValueError(
+                    "sp>1 serving requires dp=1 (one sequence-sharded "
+                    f"replica); got plan {self.plan}"
+                )
+            if max_seq_len % self.plan.sp:
+                raise ValueError(
+                    f"max_seq_len {max_seq_len} must divide evenly over "
+                    f"sp={self.plan.sp}"
+                )
         if not prefill_buckets:
             b, buckets = 32, []
             while b < max_seq_len:
@@ -85,6 +100,14 @@ class ModelRunner:
                 b *= 2
             buckets.append(max_seq_len)
             prefill_buckets = tuple(buckets)
+        if self.sp_mode:
+            prefill_buckets = tuple(
+                b for b in prefill_buckets if b % self.plan.sp == 0
+            )
+            if not prefill_buckets:
+                raise ValueError(
+                    f"no prefill bucket divides over sp={self.plan.sp}"
+                )
         self.prefill_buckets = tuple(sorted(set(prefill_buckets)))
 
         specs = param_pspecs(params, train=False)
@@ -108,7 +131,9 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, (QuantW, P)),
         )
 
-        self._cache_sharding = NamedSharding(self.mesh, cache_pspec())
+        self._cache_sharding = NamedSharding(
+            self.mesh, cache_pspec(long_context=self.sp_mode)
+        )
         self._slot_sharding = NamedSharding(self.mesh, P(None))
 
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
@@ -147,12 +172,39 @@ class ModelRunner:
             f"{self.prefill_buckets[-1]}"
         )
 
-    def _prefill_impl(self, params, tokens, true_len):
+    def attn_impl_for(self, bucket: int) -> str:
+        """Prefill attention kernel per bucket.
+
+        ``GPUSTACK_TPU_FLASH``: ``1`` forces the pallas flash kernel,
+        ``0`` forces the XLA einsum path, unset = auto — flash on TPU for
+        buckets >= 1024 (where the XLA path's [B, H, T, S] fp32 score
+        tensor starts to dominate prefill HBM traffic; at 32k it simply
+        does not fit). On CPU the compiled kernel is unavailable, so auto
+        always picks XLA there (interpret mode is test-only — ~100x
+        slower).
+        """
+        import os
+
+        if self.sp_mode:
+            return "ring"
+        knob = os.environ.get("GPUSTACK_TPU_FLASH", "")
+        if knob == "1":
+            return "flash"
+        if knob == "0":
+            return "xla"
+        on_tpu = jax.default_backend() == "tpu"
+        return "flash" if (on_tpu and bucket >= 1024) else "xla"
+
+    def _prefill_impl(self, params, tokens, true_len, *, attn_impl="xla"):
         """tokens [1, Tb]; returns (last_logits [V], k, v [L, Tb, H, hd])."""
         Tb = tokens.shape[1]
         cache = KVCache.create(self.cfg, 1, Tb)
         positions = jnp.arange(Tb, dtype=jnp.int32)[None, :]
-        logits, cache = forward(params, self.cfg, tokens, positions, cache)
+        logits, cache = forward(
+            params, self.cfg, tokens, positions, cache,
+            attn_impl=attn_impl,
+            mesh=self.mesh if attn_impl == "ring" else None,
+        )
         last = jnp.take(logits[0], true_len - 1, axis=0)
         return last, cache.k[:, 0], cache.v[:, 0]
 
@@ -163,7 +215,9 @@ class ModelRunner:
         assert Tb in self.prefill_buckets, (Tb, self.prefill_buckets)
         fn = self._prefills.get(Tb)
         if fn is None:
-            fn = jax.jit(self._prefill_impl)
+            fn = jax.jit(
+                partial(self._prefill_impl, attn_impl=self.attn_impl_for(Tb))
+            )
             self._prefills[Tb] = fn
         tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
         return fn(self.params, tokens, jnp.int32(true_len))
@@ -257,7 +311,11 @@ class ModelRunner:
     def _decode_impl(self, params, state, key):
         tokens = state.last_tokens[:, None]
         positions = state.positions[:, None]
-        logits, cache = forward(params, self.cfg, tokens, positions, state.cache)
+        logits, cache = forward(
+            params, self.cfg, tokens, positions, state.cache,
+            attn_impl="ring" if self.sp_mode else "xla",
+            mesh=self.mesh if self.sp_mode else None,
+        )
         sampled = sample(logits[:, 0], state.sampling, key)
         # Inactive slots keep feeding their last token at a frozen position;
         # their cache writes are confined to their own rows and invisible
@@ -309,7 +367,9 @@ class ModelRunner:
             + jnp.arange(P, dtype=jnp.int32)[None, :]
         )
         logits, cache = forward(
-            params, self.cfg, tokens, positions, state.cache
+            params, self.cfg, tokens, positions, state.cache,
+            attn_impl="ring" if self.sp_mode else "xla",
+            mesh=self.mesh if self.sp_mode else None,
         )
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, P]
         match = proposals[:, : P - 1] == greedy[:, : P - 1]

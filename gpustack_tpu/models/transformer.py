@@ -269,6 +269,8 @@ def forward(
     positions: jax.Array,             # [B, T] int32 absolute positions
     cache: Optional[KVCache] = None,
     return_hidden: bool = False,
+    attn_impl: str = "xla",
+    mesh=None,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Run the model.
 
@@ -278,6 +280,24 @@ def forward(
     a prefill step, ``T == 1`` a decode step — same code path, different jit
     specialization.
 
+    ``attn_impl`` selects the prefill attention kernel: ``"xla"`` (einsum
+    scores, fine for short prompts), ``"flash"`` (pallas blocked
+    online-softmax — no [T, S] score tensor; required for long-context
+    prefill), or ``"flash_interpret"`` (same kernel in interpret mode, for
+    hermetic CPU tests). Flash applies to the prefill-from-zero cache path
+    (T > 1, cache sized to the bucket); decode and the cacheless paths
+    always use XLA attention.
+
+    ``attn_impl="ring"`` (requires ``mesh`` with an ``sp`` axis) is the
+    sequence-parallel serving path: prefill attention runs as ring
+    attention over sp-sharded activations and the KV cache STAYS sharded
+    over sp for the whole generation — decode/verify steps attend over the
+    sharded cache with an exact pmax/psum online-softmax merge
+    (ops/ring_attention.py). This is context parallelism as a first-class
+    engine mode, not an arg passthrough (reference carries
+    --prefill-context-parallel-size to vLLM and implements nothing:
+    vllm_resource_fit_selector.py:118-148).
+
     Returns ``(logits [B, T, vocab] fp32, updated cache or None)``.
     """
     B, T = tokens.shape
@@ -285,6 +305,19 @@ def forward(
     x = _embed_lookup(params["embed"], tokens, dtype)
     sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
     scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    use_flash = (
+        attn_impl in ("flash", "flash_interpret")
+        and cache is not None
+        and T > 1
+        and cache.max_len == T
+        and not cfg.sliding_window
+    )
+    use_ring = attn_impl == "ring" and cache is not None
+    if use_ring and (mesh is None or cfg.sliding_window):
+        raise ValueError(
+            "attn_impl='ring' needs a mesh and no sliding window"
+        )
 
     if cache is None:
         # mask[b, t, s] — query t attends key s (both in-window positions)
@@ -330,7 +363,41 @@ def forward(
 
             new_k = jax.vmap(write)(k_cache_l, k, positions[:, 0])
             new_v = jax.vmap(write)(v_cache_l, v, positions[:, 0])
-            attn = _attend(q, new_k, new_v, mask, scale)
+            if use_ring:
+                from gpustack_tpu.ops.ring_attention import (
+                    sharded_prefill_attention,
+                    sp_cache_attention,
+                )
+
+                if T > 1 and cache.max_len == T:
+                    # prefill-from-zero: ring attention over the
+                    # sp-sharded step K/V (== the whole written cache)
+                    attn = sharded_prefill_attention(
+                        mesh, q, k, v, positions, scale
+                    )
+                else:
+                    # decode / verify: exact attention over the
+                    # sp-sharded resident cache
+                    attn = sp_cache_attention(
+                        mesh, q, new_k, new_v, positions, scale
+                    )
+            elif use_flash:
+                # prefill-from-zero: q rows are positions 0..T-1 against
+                # the freshly written cache — exactly the kernel's causal
+                # contract (kernel masks pad keys via seq_k)
+                from gpustack_tpu.ops.flash_attention import (
+                    flash_attention_prefill,
+                )
+
+                attn = flash_attention_prefill(
+                    q.reshape(B, T, cfg.num_heads, cfg.head_dim),
+                    new_k,
+                    new_v,
+                    scale,
+                    interpret=attn_impl == "flash_interpret",
+                )
+            else:
+                attn = _attend(q, new_k, new_v, mask, scale)
 
         x_mid = x_in + _mm("btq,qd->btd", attn, lp["wo"])
 

@@ -113,6 +113,64 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def sp_cache_attention(
+    mesh: Mesh,
+    q: jax.Array,             # [B, T, Hkv, G, d] (T small: decode/verify)
+    k: jax.Array,             # [B, S, Hkv, d] seq-sharded over ``sp``
+    v: jax.Array,
+    positions: jax.Array,     # [B, T] absolute query positions
+    scale: float,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Decode/verify attention over a sequence-sharded KV cache.
+
+    Each sp shard scores its local cache segment (absolute cache position =
+    shard_index * S_local + local index) and the partial softmaxes combine
+    exactly via a pmax/psum online-softmax merge — per-chip memory stays
+    O(S/sp) and no all-gather of the cache ever happens. This is what makes
+    the decode side of context parallelism work: prefill shards the
+    sequence with ring attention, and the resident KV cache stays sharded
+    for the whole generation. Returns [B, T, Hkv*G*d], replicated over sp.
+    """
+
+    def local(q_, k_, v_, pos_):
+        B, T = q_.shape[0], q_.shape[1]
+        S_loc = k_.shape[1]
+        idx = lax.axis_index(axis_name)
+        cache_pos = idx * S_loc + jnp.arange(S_loc, dtype=jnp.int32)
+        mask = cache_pos[None, None, :] <= pos_[:, :, None]  # [B, T, S_loc]
+        scores = (
+            jnp.einsum("bthgd,bshd->bhgts", q_, k_).astype(jnp.float32)
+            * scale
+        )
+        scores = jnp.where(mask[:, None, None, :, :], scores, _NEG)
+        m_loc = jnp.max(scores, axis=-1)                   # [B, Hkv, G, T]
+        p = jnp.where(
+            scores <= _NEG / 2, 0.0, jnp.exp(scores - m_loc[..., None])
+        )
+        l_loc = jnp.sum(p, axis=-1)
+        acc = jnp.einsum("bhgts,bshd->bhgtd", p, v_.astype(jnp.float32))
+        m_all = lax.pmax(m_loc, axis_name)
+        c = jnp.where(m_loc <= _NEG / 2, 0.0, jnp.exp(m_loc - m_all))
+        l_all = lax.psum(l_loc * c, axis_name)
+        acc_all = lax.psum(acc * c[..., None], axis_name)
+        out = acc_all / jnp.maximum(l_all, 1e-30)[..., None]
+        out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, T, -1)
+        return out.astype(q_.dtype)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None, "tp", None, None),
+            P("dp", axis_name, "tp", None),
+            P("dp", axis_name, "tp", None),
+            P("dp", None),
+        ),
+        out_specs=P("dp", None, "tp"),
+    )(q, k, v, positions)
+
+
 def sharded_prefill_attention(
     mesh: Mesh,
     q: jax.Array,             # [B, T, Hkv, G, d] (global, seq-sharded)

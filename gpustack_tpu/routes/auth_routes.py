@@ -191,9 +191,14 @@ def add_worker_facing_routes(app: web.Application) -> None:
             body = await request.json()
         except json.JSONDecodeError:
             return json_error(400, "invalid JSON body")
+        import pydantic
+
         from gpustack_tpu.schemas.workers import WorkerStatus
 
-        status = WorkerStatus.model_validate(body.get("status") or {})
+        try:
+            status = WorkerStatus.model_validate(body.get("status") or {})
+        except pydantic.ValidationError as e:
+            return json_error(400, f"invalid worker status: {e}")
         await worker.update(
             status=status,
             state=WorkerState.READY,

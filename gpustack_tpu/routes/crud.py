@@ -180,6 +180,10 @@ def add_crud_routes(
         return web.json_response(dump(obj))
 
     async def create(request: web.Request):
+        # role-gate before parsing: unauthorized principals get a uniform
+        # 403, never validation-error detail on attacker-controlled input
+        if err := check_write(request, None, None):
+            return err
         try:
             body = await request.json()
         except json.JSONDecodeError:
