@@ -31,6 +31,7 @@ PUBLIC_PATHS = {
 # per-record ownership is enforced again inside the CRUD write guard.
 _WORKER_ROUTE_ALLOWLIST = (
     ("POST", re.compile(r"^/v2/workers/\d+/(status|heartbeat)$")),
+    ("GET", re.compile(r"^/v2/tunnel$")),
     # reads + watch streams the agent's reconcile loops depend on
     ("GET", re.compile(
         r"^/v2/(models|model-instances|model-files|benchmarks|"

@@ -35,6 +35,7 @@ class Config(pydantic.BaseModel):
     worker_name: str = ""
     worker_ip: str = ""
     worker_port: int = 10151
+    tunnel: bool = False              # NAT'd worker: serve via WS tunnel
     cache_dir: str = ""               # model file cache
     heartbeat_interval: float = 10.0
     status_interval: float = 30.0

@@ -137,6 +137,10 @@ def add_auth_routes(app: web.Application) -> None:
         existing = await Worker.first(name=name)
         if existing is not None and existing.worker_uuid != worker_uuid:
             return json_error(409, f"worker name {name!r} already taken")
+        # rotated on every (re-)registration; see Worker.proxy_secret
+        import secrets as _secrets
+
+        proxy_secret = _secrets.token_urlsafe(24)
         if existing is None:
             existing = await Worker.create(
                 Worker(
@@ -146,18 +150,25 @@ def add_auth_routes(app: web.Application) -> None:
                     ip=body.get("ip", request.remote or ""),
                     port=int(body.get("port", 10151)),
                     state=WorkerState.NOT_READY,
+                    proxy_secret=proxy_secret,
                 )
             )
         else:
             await existing.update(
                 ip=body.get("ip", existing.ip),
                 port=int(body.get("port", existing.port)),
+                proxy_secret=proxy_secret,
             )
         worker_token = auth_mod.issue_worker_token(
             existing.id, cfg.jwt_secret
         )
         return web.json_response(
-            {"worker_id": existing.id, "token": worker_token, "name": name}
+            {
+                "worker_id": existing.id,
+                "token": worker_token,
+                "name": name,
+                "proxy_secret": proxy_secret,
+            }
         )
 
     app.router.add_post("/auth/login", login)

@@ -23,6 +23,7 @@ from gpustack_tpu.schemas import (
     ModelInstance,
     ModelInstanceState,
     ModelRoute,
+    Worker,
 )
 from gpustack_tpu.schemas.usage import ModelUsage
 
@@ -132,16 +133,24 @@ def add_openai_routes(app: web.Application) -> None:
             return json_error(
                 503, f"no running instances for model {name!r}"
             )
-        target = (
-            f"http://{instance.worker_ip or '127.0.0.1'}:{instance.port}"
-            f"/v1/{operation}"
-        )
+        # All data-plane traffic flows through the worker's authenticated
+        # reverse proxy (or its tunnel): engines bind to 127.0.0.1 and the
+        # bare engine port is never dialed (reference
+        # routes/worker/proxy.py:200; round-1 direct dialing was an
+        # unauthenticated bypass of the entire auth layer).
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        worker = await Worker.get(instance.worker_id or 0)
+        if worker is None:
+            return json_error(
+                503, f"instance for {name!r} has no placed worker"
+            )
         stream = bool(body.get("stream"))
-        timeout = aiohttp.ClientTimeout(total=600)
-        session: aiohttp.ClientSession = app["proxy_session"]
         try:
-            upstream = await session.post(
-                target, json=body, timeout=timeout
+            upstream = await worker_fetch(
+                app, worker, "POST",
+                f"/proxy/instances/{instance.id}/v1/{operation}",
+                json_body=body,
             )
         except aiohttp.ClientError as e:
             return json_error(502, f"instance unreachable: {e}")

@@ -89,6 +89,12 @@ class Worker(Record):
     status: WorkerStatus = WorkerStatus()
     heartbeat_at: str = ""
     worker_uuid: str = ""
+    # Per-worker shared secret authenticating server→worker requests
+    # (proxy, logs, probes). Generated at registration, returned to the
+    # worker exactly once, REDACTED from every API serialization — only
+    # the server's in-process proxy path reads it (reference
+    # websocket_proxy/authenticator.py HMAC-auth role).
+    proxy_secret: str = ""
 
     @property
     def total_chips(self) -> int:

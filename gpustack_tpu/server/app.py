@@ -53,6 +53,9 @@ def create_app(cfg: Config) -> web.Application:
     add_auth_routes(app)
     add_worker_facing_routes(app)
     add_openai_routes(app)
+    from gpustack_tpu.tunnel.server import add_tunnel_route
+
+    add_tunnel_route(app)
     from gpustack_tpu.server.exporter import add_metrics_route
 
     add_metrics_route(app)
@@ -63,6 +66,8 @@ def create_app(cfg: Config) -> web.Application:
     # instance log streaming through the worker's http server (reference
     # routes/worker/logs.py path, proxied server-side)
     async def instance_logs(request: web.Request):
+        from gpustack_tpu.server.worker_request import worker_fetch
+
         inst = await ModelInstance.get(int(request.match_info["id"]))
         if inst is None:
             return json_error(404, "instance not found")
@@ -70,18 +75,17 @@ def create_app(cfg: Config) -> web.Application:
         if worker is None:
             return json_error(409, "instance is not placed on a worker")
         tail = request.query.get("tail", "200")
-        url = (
-            f"http://{worker.ip}:{worker.port}"
-            f"/v2/instances/{inst.id}/logs?tail={tail}"
-        )
-        session = app["proxy_session"]
         try:
-            async with session.get(
-                url, timeout=aiohttp.ClientTimeout(total=10)
-            ) as resp:
-                return web.Response(
-                    text=await resp.text(), status=resp.status
-                )
+            resp = await worker_fetch(
+                app, worker, "GET",
+                f"/v2/instances/{inst.id}/logs?tail={tail}",
+                timeout=10,
+            )
+            body = await resp.read()
+            resp.release()
+            return web.Response(
+                text=body.decode(errors="replace"), status=resp.status
+            )
         except aiohttp.ClientError as e:
             return json_error(502, f"worker unreachable: {e}")
 
@@ -147,7 +151,7 @@ def create_app(cfg: Config) -> web.Application:
         app, ModelInstance, "model-instances",
         worker_write=True, worker_owns=instance_worker_owns,
     )
-    add_crud_routes(app, Worker, "workers")
+    add_crud_routes(app, Worker, "workers", redact=("proxy_secret",))
     add_crud_routes(app, Cluster, "clusters")
     add_crud_routes(app, ModelRoute, "model-routes")
     add_crud_routes(app, ModelFile, "model-files", worker_write=True)

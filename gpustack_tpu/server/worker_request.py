@@ -1,0 +1,87 @@
+"""Unified server→worker HTTP: direct dial or tunnel, always authenticated.
+
+Reference parity: server/worker_request.py:153,214 (direct vs
+tunnel-proxied request helpers). Every server→worker request carries the
+worker's proxy secret as a bearer token — the worker's HTTP server
+rejects anything else, which closes the round-1 hole where engine ports
+answered unauthenticated inference to anyone who could reach them.
+"""
+
+from __future__ import annotations
+
+import json as jsonlib
+from typing import Any, Dict, Optional
+
+import aiohttp
+from aiohttp import web
+
+from gpustack_tpu.schemas import Worker
+
+
+class DirectResponse:
+    """aiohttp response pass-through with the tunnel adapter's surface."""
+
+    def __init__(self, resp: aiohttp.ClientResponse):
+        self._resp = resp
+        self.status = resp.status
+        self.headers = resp.headers
+
+    @property
+    def content_type(self) -> str:
+        return self._resp.content_type
+
+    @property
+    def content(self):
+        return self._resp.content
+
+    async def read(self) -> bytes:
+        return await self._resp.read()
+
+    def release(self) -> None:
+        self._resp.release()
+
+
+async def worker_fetch(
+    app: web.Application,
+    worker: Worker,
+    method: str,
+    path: str,
+    *,
+    json_body: Optional[Dict[str, Any]] = None,
+    timeout: float = 600.0,
+):
+    """Send an authenticated request to a worker; returns a response
+    adapter (.status/.headers/.content.iter_any()/.read()/.release()).
+
+    Prefers the worker's tunnel when connected (NAT'd workers have no
+    other path); otherwise dials ``worker.ip:worker.port`` directly.
+    Raises ``aiohttp.ClientError`` when neither path works.
+    """
+    headers = {}
+    if worker.proxy_secret:
+        headers["Authorization"] = f"Bearer {worker.proxy_secret}"
+    body = b""
+    if json_body is not None:
+        body = jsonlib.dumps(json_body).encode()
+        headers["Content-Type"] = "application/json"
+
+    hub = app.get("tunnel_hub")
+    session = hub.get(worker.id) if hub else None
+    if session is not None:
+        return await session.request(
+            method, path, headers, body, timeout=timeout
+        )
+
+    if not worker.ip:
+        raise aiohttp.ClientError(
+            f"worker {worker.id} has no address and no tunnel"
+        )
+    url = f"http://{worker.ip}:{worker.port}{path}"
+    resp = await app["proxy_session"].request(
+        method,
+        url,
+        data=body or None,
+        headers=headers,
+        timeout=aiohttp.ClientTimeout(total=timeout),
+    )
+    return DirectResponse(resp)

@@ -1,0 +1,13 @@
+"""WebSocket tunnel for NAT'd workers.
+
+The worker dials OUT to the server and keeps one authenticated WebSocket
+open; the server multiplexes HTTP requests to that worker over it
+(reference websocket_proxy/: proxy_server.py:337 HTTPSProxyServer,
+message.py:11 framed protocol — redesigned here as msgpack frames over
+aiohttp WS instead of a CONNECT-style TCP proxy, because the only traffic
+that must cross the tunnel is worker-API HTTP, not arbitrary TCP).
+"""
+
+from gpustack_tpu.tunnel.protocol import Frame, decode_frame, encode_frame
+
+__all__ = ["Frame", "decode_frame", "encode_frame"]

@@ -1,0 +1,136 @@
+"""Worker side of the tunnel: dial out, serve multiplexed HTTP.
+
+The client holds one WS to the server (reconnecting with backoff) and
+executes each ``req`` frame against the worker's own local HTTP server
+(127.0.0.1:worker_port — the same authenticated surface a directly-dialed
+request would hit, so the tunnel grants nothing extra). Responses stream
+back as ``res``/``dat``/``end`` frames; concurrent streams are
+independent tasks (reference websocket_proxy/message_client.py role).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+import aiohttp
+
+from gpustack_tpu.tunnel.protocol import Frame, decode_frame, encode_frame
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 64 * 1024
+
+
+class TunnelClient:
+    def __init__(
+        self,
+        server_url: str,
+        token: str,
+        local_port: int,
+        reconnect_delay: float = 3.0,
+    ):
+        self.server_url = server_url.rstrip("/")
+        self.token = token
+        self.local_port = local_port
+        self.reconnect_delay = reconnect_delay
+        self._tasks: Dict[int, asyncio.Task] = {}
+        self._stopping = False
+        self.connected = asyncio.Event()
+
+    async def run_forever(self) -> None:
+        while not self._stopping:
+            try:
+                await self._run_once()
+            except asyncio.CancelledError:
+                raise
+            except (aiohttp.ClientError, OSError) as e:
+                logger.warning("tunnel dropped: %s; reconnecting", e)
+            self.connected.clear()
+            await asyncio.sleep(self.reconnect_delay)
+
+    async def _run_once(self) -> None:
+        ws_url = self.server_url + "/v2/tunnel"
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(
+                ws_url,
+                headers={"Authorization": f"Bearer {self.token}"},
+                heartbeat=30.0,
+            ) as ws:
+                self.connected.set()
+                logger.info("tunnel established to %s", ws_url)
+                local = aiohttp.ClientSession()
+                try:
+                    async for msg in ws:
+                        if msg.type != aiohttp.WSMsgType.BINARY:
+                            continue
+                        try:
+                            frame = decode_frame(msg.data)
+                        except ValueError as e:
+                            logger.warning("bad tunnel frame: %s", e)
+                            continue
+                        if frame.kind == "req":
+                            self._tasks[frame.sid] = asyncio.create_task(
+                                self._serve(ws, local, frame)
+                            )
+                        elif frame.kind == "can":
+                            task = self._tasks.pop(frame.sid, None)
+                            if task is not None:
+                                task.cancel()
+                finally:
+                    for task in self._tasks.values():
+                        task.cancel()
+                    self._tasks.clear()
+                    await local.close()
+
+    async def _serve(
+        self,
+        ws,
+        local: aiohttp.ClientSession,
+        frame: Frame,
+    ) -> None:
+        sid = frame.sid
+        d = frame.data
+        url = f"http://127.0.0.1:{self.local_port}{d.get('path', '/')}"
+        try:
+            async with local.request(
+                str(d.get("method", "GET")),
+                url,
+                headers={
+                    str(k): str(v)
+                    for k, v in (d.get("headers") or {}).items()
+                },
+                data=d.get("body") or None,
+                timeout=aiohttp.ClientTimeout(total=600),
+            ) as resp:
+                await ws.send_bytes(
+                    encode_frame(
+                        Frame(
+                            sid, "res",
+                            {
+                                "status": resp.status,
+                                "headers": dict(resp.headers),
+                            },
+                        )
+                    )
+                )
+                async for chunk in resp.content.iter_chunked(CHUNK):
+                    await ws.send_bytes(
+                        encode_frame(Frame(sid, "dat", {"chunk": chunk}))
+                    )
+                await ws.send_bytes(encode_frame(Frame(sid, "end", {})))
+        except asyncio.CancelledError:
+            raise
+        except (aiohttp.ClientError, OSError, ConnectionError) as e:
+            try:
+                await ws.send_bytes(
+                    encode_frame(Frame(sid, "err", {"message": str(e)}))
+                )
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            self._tasks.pop(sid, None)
+
+    def stop(self) -> None:
+        self._stopping = True

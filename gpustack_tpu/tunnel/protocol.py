@@ -1,0 +1,54 @@
+"""Tunnel wire protocol: msgpack-framed multiplexed HTTP.
+
+One WebSocket carries many concurrent HTTP exchanges, each identified by a
+server-allocated stream id (reference websocket_proxy/message.py:11 framed
+protocol v1 role). Frames are msgpack arrays ``[sid, kind, data]``:
+
+  server → worker
+    ``req``  {method, path, headers, body}   open a stream
+    ``can``  {}                              cancel a stream
+
+  worker → server
+    ``res``  {status, headers}               response head
+    ``dat``  {chunk}                         response body chunk
+    ``end``  {}                              response complete
+    ``err``  {message}                       stream failed
+
+Bodies and chunks are raw bytes (msgpack bin). Protocol version is
+negotiated by the WS path (/v2/tunnel == v1); unknown kinds are ignored so
+minor versions stay compatible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import msgpack
+
+KINDS = ("req", "res", "dat", "end", "err", "can")
+
+
+@dataclasses.dataclass
+class Frame:
+    sid: int
+    kind: str
+    data: Dict[str, Any]
+
+
+def encode_frame(frame: Frame) -> bytes:
+    if frame.kind not in KINDS:
+        raise ValueError(f"unknown frame kind {frame.kind!r}")
+    return msgpack.packb(
+        [frame.sid, frame.kind, frame.data], use_bin_type=True
+    )
+
+
+def decode_frame(raw: bytes) -> Frame:
+    try:
+        sid, kind, data = msgpack.unpackb(raw, raw=False)
+    except (ValueError, msgpack.exceptions.ExtraData) as e:
+        raise ValueError(f"malformed tunnel frame: {e}") from e
+    if not isinstance(sid, int) or not isinstance(data, dict):
+        raise ValueError("malformed tunnel frame structure")
+    return Frame(sid=sid, kind=str(kind), data=data)

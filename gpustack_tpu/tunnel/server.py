@@ -1,0 +1,249 @@
+"""Server side of the worker tunnel: session registry + request mux.
+
+``TunnelHub`` owns one ``TunnelSession`` per connected worker (keyed by
+the worker principal's id — the WS endpoint is worker-token
+authenticated, so a worker can only register a tunnel as itself). The
+server's worker-request helper (server/worker_request.py) transparently
+prefers the tunnel when one is connected, so NAT'd workers — unreachable
+by direct dial — serve inference, logs, and probes exactly like
+directly-reachable ones (reference websocket_proxy/proxy_server.py:337).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import AsyncIterator, Dict, Optional, Tuple
+
+import aiohttp
+from aiohttp import web
+
+from gpustack_tpu.tunnel.protocol import Frame, decode_frame, encode_frame
+
+logger = logging.getLogger(__name__)
+
+RESPONSE_HEAD_TIMEOUT = 30.0
+STREAM_IDLE_TIMEOUT = 600.0
+# Per-stream buffer bound: a client reading slower than the engine emits
+# gets its stream terminated at this depth instead of growing server
+# memory without limit (64 KiB chunks × 1024 ≈ 64 MiB worst case).
+# A credit-based flow-control scheme is the planned upgrade.
+STREAM_QUEUE_MAX = 1024
+
+
+class TunnelResponse:
+    """Response adapter matching the aiohttp surface the proxies use
+    (.status/.headers/.content_type/.read()/.content.iter_any()/.release())."""
+
+    def __init__(
+        self, session: "TunnelSession", sid: int,
+        status: int, headers: Dict[str, str],
+        idle_timeout: float = STREAM_IDLE_TIMEOUT,
+    ):
+        self._session = session
+        self._sid = sid
+        self._idle_timeout = idle_timeout
+        self.status = status
+        self.headers = headers
+
+    @property
+    def content_type(self) -> str:
+        return (
+            self.headers.get("Content-Type", "application/octet-stream")
+            .split(";")[0]
+            .strip()
+        )
+
+    @property
+    def content(self) -> "TunnelResponse":
+        return self
+
+    async def iter_any(self) -> AsyncIterator[bytes]:
+        queue = self._session.streams.get(self._sid)
+        while queue is not None:
+            try:
+                frame = await asyncio.wait_for(
+                    queue.get(), self._idle_timeout
+                )
+            except asyncio.TimeoutError:
+                # map to the error type every caller already handles
+                self._session.close_stream(self._sid, cancel=True)
+                raise aiohttp.ClientError(
+                    f"tunnel stream idle for {self._idle_timeout}s"
+                )
+            if frame.kind == "dat":
+                yield frame.data.get("chunk", b"")
+            elif frame.kind == "end":
+                self._session.streams.pop(self._sid, None)
+                return
+            elif frame.kind == "err":
+                self._session.streams.pop(self._sid, None)
+                raise aiohttp.ClientError(
+                    f"tunnel stream error: {frame.data.get('message')}"
+                )
+
+    async def read(self) -> bytes:
+        chunks = []
+        async for chunk in self.iter_any():
+            chunks.append(chunk)
+        return b"".join(chunks)
+
+    def release(self) -> None:
+        self._session.close_stream(self._sid, cancel=True)
+
+
+class TunnelSession:
+    def __init__(self, worker_id: int, ws: web.WebSocketResponse):
+        self.worker_id = worker_id
+        self.ws = ws
+        self.streams: Dict[int, asyncio.Queue] = {}
+        self._sids = itertools.count(1)
+
+    async def read_loop(self) -> None:
+        async for msg in self.ws:
+            if msg.type != aiohttp.WSMsgType.BINARY:
+                continue
+            try:
+                frame = decode_frame(msg.data)
+            except ValueError as e:
+                logger.warning(
+                    "worker %d sent bad frame: %s", self.worker_id, e
+                )
+                continue
+            queue = self.streams.get(frame.sid)
+            if queue is not None:
+                try:
+                    queue.put_nowait(frame)
+                except asyncio.QueueFull:
+                    # consumer too slow: terminate this stream, keep the
+                    # tunnel and its other streams healthy
+                    logger.warning(
+                        "tunnel stream %d overflow (worker %d); dropping",
+                        frame.sid, self.worker_id,
+                    )
+                    try:
+                        queue.get_nowait()  # make room for the error
+                        queue.put_nowait(
+                            Frame(
+                                frame.sid, "err",
+                                {"message": "stream overflow"},
+                            )
+                        )
+                    except (asyncio.QueueEmpty, asyncio.QueueFull):
+                        pass
+                    self.close_stream(frame.sid, cancel=True)
+        # connection closed: fail all in-flight streams
+        for sid in list(self.streams):
+            queue = self.streams.get(sid)
+            if queue is not None:
+                try:
+                    queue.put_nowait(
+                        Frame(
+                            sid, "err", {"message": "tunnel disconnected"}
+                        )
+                    )
+                except asyncio.QueueFull:
+                    pass
+        self.streams.clear()
+
+    def close_stream(self, sid: int, cancel: bool = False) -> None:
+        self.streams.pop(sid, None)
+        if cancel and not self.ws.closed:
+            asyncio.ensure_future(
+                self.ws.send_bytes(encode_frame(Frame(sid, "can", {})))
+            )
+
+    async def request(
+        self,
+        method: str,
+        path: str,
+        headers: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+        timeout: Optional[float] = None,
+    ) -> TunnelResponse:
+        head_timeout = min(RESPONSE_HEAD_TIMEOUT, timeout or 1e9)
+        idle_timeout = min(STREAM_IDLE_TIMEOUT, timeout or 1e9)
+        sid = next(self._sids)
+        queue: asyncio.Queue = asyncio.Queue(STREAM_QUEUE_MAX)
+        self.streams[sid] = queue
+        try:
+            await self.ws.send_bytes(
+                encode_frame(
+                    Frame(
+                        sid, "req",
+                        {
+                            "method": method,
+                            "path": path,
+                            "headers": dict(headers or {}),
+                            "body": body,
+                        },
+                    )
+                )
+            )
+            frame = await asyncio.wait_for(queue.get(), head_timeout)
+        except (asyncio.TimeoutError, ConnectionError) as e:
+            self.streams.pop(sid, None)
+            raise aiohttp.ClientError(f"tunnel request failed: {e}")
+        if frame.kind == "err":
+            self.streams.pop(sid, None)
+            raise aiohttp.ClientError(
+                f"tunnel upstream error: {frame.data.get('message')}"
+            )
+        if frame.kind != "res":
+            self.streams.pop(sid, None)
+            raise aiohttp.ClientError(
+                f"tunnel protocol violation: first frame {frame.kind!r}"
+            )
+        return TunnelResponse(
+            self, sid,
+            int(frame.data.get("status", 502)),
+            {str(k): str(v) for k, v in
+             (frame.data.get("headers") or {}).items()},
+            idle_timeout=idle_timeout,
+        )
+
+
+class TunnelHub:
+    def __init__(self) -> None:
+        self.sessions: Dict[int, TunnelSession] = {}
+
+    def connected(self, worker_id: int) -> bool:
+        session = self.sessions.get(worker_id)
+        return session is not None and not session.ws.closed
+
+    def get(self, worker_id: int) -> Optional[TunnelSession]:
+        session = self.sessions.get(worker_id)
+        if session is None or session.ws.closed:
+            return None
+        return session
+
+    async def handle_ws(self, request: web.Request) -> web.StreamResponse:
+        principal = request.get("principal")
+        if principal is None or principal.kind != "worker":
+            return web.json_response(
+                {"error": "worker token required"}, status=403
+            )
+        worker_id = principal.worker_id
+        ws = web.WebSocketResponse(heartbeat=30.0)
+        await ws.prepare(request)
+        session = TunnelSession(worker_id, ws)
+        old = self.sessions.get(worker_id)
+        self.sessions[worker_id] = session
+        if old is not None and not old.ws.closed:
+            await old.ws.close()
+        logger.info("worker %d tunnel connected", worker_id)
+        try:
+            await session.read_loop()
+        finally:
+            if self.sessions.get(worker_id) is session:
+                del self.sessions[worker_id]
+            logger.info("worker %d tunnel disconnected", worker_id)
+        return ws
+
+
+def add_tunnel_route(app: web.Application) -> TunnelHub:
+    hub = TunnelHub()
+    app["tunnel_hub"] = hub
+    app.router.add_get("/v2/tunnel", hub.handle_ws)
+    return hub

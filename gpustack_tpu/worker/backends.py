@@ -70,6 +70,10 @@ def _tpu_native_command(
 ) -> Tuple[List[str], Dict[str, str]]:
     argv = [
         sys.executable, "-m", "gpustack_tpu.engine.api_server",
+        # loopback only: the engine HTTP port carries no auth; all ingress
+        # goes through the worker's authenticated reverse proxy
+        # (worker/server.py instance_proxy)
+        "--host", "127.0.0.1",
         "--port", str(port),
         "--served-name", model.name,
         "--max-seq-len", str(model.max_seq_len),

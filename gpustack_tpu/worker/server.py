@@ -22,9 +22,13 @@ TAIL_MAX = 5000
 
 
 class WorkerServer:
+    # no secret material flows through these; everything else requires
+    # the per-worker proxy secret issued at registration
+    PUBLIC_PATHS = {"/healthz", "/metrics"}
+
     def __init__(self, agent) -> None:
         self.agent = agent
-        self.app = web.Application()
+        self.app = web.Application(middlewares=[self._auth_middleware])
         self.app.add_routes(
             [
                 web.get("/healthz", self.healthz),
@@ -33,9 +37,90 @@ class WorkerServer:
                     "/v2/instances/{id:\\d+}/logs", self.instance_logs
                 ),
                 web.get("/v2/filesystem/probe", self.filesystem_probe),
+                web.route(
+                    "*",
+                    "/proxy/instances/{id:\\d+}/{tail:.*}",
+                    self.instance_proxy,
+                ),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
+        # long-lived pool for the hot proxy path — per-request sessions
+        # would pay connect+teardown per completion
+        self._proxy_session: Optional[aiohttp.ClientSession] = None
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        """Server→worker auth: bearer must equal this worker's proxy
+        secret (reference confines the worker API behind worker auth,
+        routes/worker/proxy.py; round 1 left these ports open)."""
+        import hmac as _hmac
+
+        if request.path in self.PUBLIC_PATHS:
+            return await handler(request)
+        secret = getattr(self.agent, "proxy_secret", "")
+        authz = request.headers.get("Authorization", "")
+        token = authz[7:] if authz.startswith("Bearer ") else ""
+        if not secret or not token or not _hmac.compare_digest(
+            token, secret
+        ):
+            return web.json_response(
+                {"error": "worker proxy authentication required"},
+                status=401,
+            )
+        return await handler(request)
+
+    async def instance_proxy(self, request: web.Request) -> web.StreamResponse:
+        """Authenticated reverse proxy to a local engine instance
+        (reference routes/worker/proxy.py:200 model-name→port middleware;
+        here instance-id→port — the server already resolved the model).
+        Engines bind to 127.0.0.1, so this is the only way in."""
+        sm = self.agent.serve_manager
+        if sm is None:
+            return web.json_response({"error": "not ready"}, status=503)
+        instance_id = int(request.match_info["id"])
+        run = sm.running.get(instance_id)
+        if run is None or not run.port:
+            return web.json_response(
+                {"error": f"instance {instance_id} not running here"},
+                status=404,
+            )
+        tail = request.match_info["tail"]
+        qs = f"?{request.query_string}" if request.query_string else ""
+        url = f"http://127.0.0.1:{run.port}/{tail}{qs}"
+        body = await request.read()
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() in ("content-type", "accept")
+        }
+        if self._proxy_session is None or self._proxy_session.closed:
+            self._proxy_session = aiohttp.ClientSession()
+        try:
+            async with self._proxy_session.request(
+                request.method,
+                url,
+                data=body or None,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=600),
+            ) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={
+                        "Content-Type": upstream.headers.get(
+                            "Content-Type", "application/json"
+                        ),
+                        "Cache-Control": "no-cache",
+                    },
+                )
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, OSError) as e:
+            return web.json_response(
+                {"error": f"engine unreachable: {e}"}, status=502
+            )
 
     async def start(self, host: str, port: int) -> None:
         self._runner = web.AppRunner(self.app)
@@ -45,6 +130,8 @@ class WorkerServer:
         logger.info("worker http listening on %s:%d", host, port)
 
     async def stop(self) -> None:
+        if self._proxy_session and not self._proxy_session.closed:
+            await self._proxy_session.close()
         if self._runner:
             await self._runner.cleanup()
 

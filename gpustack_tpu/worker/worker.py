@@ -79,14 +79,10 @@ class WorkerAgent:
             self.client, self.worker_id
         )
         self.http = WorkerServer(self)
-        try:
-            await self.http.start("0.0.0.0", self.cfg.worker_port)
-        except OSError as e:
-            logger.warning(
-                "worker http port %d unavailable (%s); logs/metrics "
-                "endpoints disabled", self.cfg.worker_port, e,
-            )
-            self.http = None
+        # The worker HTTP server is the sole inference ingress (engines
+        # bind to loopback) — failing to bind is a total outage, not a
+        # degradation; die loudly so the supervisor restarts us.
+        await self.http.start("0.0.0.0", self.cfg.worker_port)
         # push one status immediately so the scheduler sees chips
         await self._post_status_once()
         # converge with the server's view (restart recovery: zombie
@@ -101,6 +97,20 @@ class WorkerAgent:
                 self.benchmark_manager.rescan_loop(), name="wk-bench-rescan"
             ),
         ]
+        if self.cfg.tunnel:
+            # NAT'd deployment: dial out and serve over the tunnel
+            from gpustack_tpu.tunnel.client import TunnelClient
+
+            self.tunnel_client = TunnelClient(
+                self.cfg.server_url,
+                self._worker_token,
+                self.cfg.worker_port,
+            )
+            self._tasks.append(
+                asyncio.create_task(
+                    self.tunnel_client.run_forever(), name="wk-tunnel"
+                )
+            )
         logger.info(
             "worker %s (id=%d) started", self.worker_name, self.worker_id
         )
@@ -146,6 +156,8 @@ class WorkerAgent:
         await anon.close()
         self.worker_id = result["worker_id"]
         self.worker_name = result["name"]
+        self.proxy_secret = result.get("proxy_secret", "")
+        self._worker_token = result["token"]
         self.client = ClientSet(self.cfg.server_url, result["token"])
 
     # ---- loops ----------------------------------------------------------

@@ -16,6 +16,10 @@ class _FakeAgent:
         self.worker_id = 1
         self.detector = create_detector()
         self.serve_manager = None
+        self.proxy_secret = "test-proxy-secret"
+
+
+AUTH = {"Authorization": "Bearer test-proxy-secret"}
 
 
 def _run(cfg, coro_fn):
@@ -51,8 +55,19 @@ def test_filesystem_probe(tmp_path, monkeypatch):
     )
 
     async def go(client):
+        # no/bad auth: rejected before any filesystem access
         r = await client.get(
             "/v2/filesystem/probe", params={"path": str(model_dir)}
+        )
+        assert r.status == 401
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": str(model_dir)},
+            headers={"Authorization": "Bearer wrong"},
+        )
+        assert r.status == 401
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": str(model_dir)},
+            headers=AUTH,
         )
         assert r.status == 200
         data = await r.json()
@@ -63,18 +78,19 @@ def test_filesystem_probe(tmp_path, monkeypatch):
 
         r = await client.get(
             "/v2/filesystem/probe",
-            params={"path": str(model_dir / "nope")},
+            params={"path": str(model_dir / "nope")}, headers=AUTH,
         )
         assert (await r.json())["exists"] is False
 
         r = await client.get(
-            "/v2/filesystem/probe", params={"path": "relative/x"}
+            "/v2/filesystem/probe", params={"path": "relative/x"},
+            headers=AUTH,
         )
         assert r.status == 400
 
         # outside model roots: refused, no oracle
         r = await client.get(
-            "/v2/filesystem/probe", params={"path": "/etc"}
+            "/v2/filesystem/probe", params={"path": "/etc"}, headers=AUTH,
         )
         assert r.status == 403
 
@@ -83,3 +99,64 @@ def test_filesystem_probe(tmp_path, monkeypatch):
         assert (await r.json())["status"] == "ok"
 
     _run(cfg, go)
+
+
+def test_instance_proxy_forwards_to_local_engine(tmp_path):
+    """The authenticated reverse proxy relays to the local engine port —
+    the only ingress path now that engines bind to 127.0.0.1."""
+    import socket
+    import types
+
+    from aiohttp import web as _web
+
+    cfg = Config.load({"data_dir": str(tmp_path / "data")})
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        # fake engine on a loopback port
+        engine = _web.Application()
+
+        async def completions(request):
+            body = await request.json()
+            return _web.json_response({"echo": body["x"]})
+
+        engine.router.add_post("/v1/chat/completions", completions)
+        runner = _web.AppRunner(engine)
+        await runner.setup()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        await _web.TCPSite(runner, "127.0.0.1", port).start()
+
+        agent = _FakeAgent(cfg)
+        agent.serve_manager = types.SimpleNamespace(
+            running={7: types.SimpleNamespace(port=port)}
+        )
+        server = WorkerServer(agent)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            # wrong auth → 401, engine never consulted
+            r = await client.post(
+                "/proxy/instances/7/v1/chat/completions", json={"x": 1}
+            )
+            assert r.status == 401
+            # authenticated → relayed
+            r = await client.post(
+                "/proxy/instances/7/v1/chat/completions",
+                json={"x": 42}, headers=AUTH,
+            )
+            assert r.status == 200
+            assert (await r.json())["echo"] == 42
+            # unknown instance → 404
+            r = await client.post(
+                "/proxy/instances/9/v1/chat/completions",
+                json={}, headers=AUTH,
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+            await runner.cleanup()
+
+    asyncio.run(go())
