@@ -4,8 +4,9 @@ TPU-native selector (replaces the reference's per-backend VRAM-fit
 selectors, gpustack/policies/candidate_selectors/): a replica needs
 ``claim.chips`` chips. Candidates:
 
-1. single-worker: any READY worker with >= chips free (chips taken in
-   index order — contiguous on the host's ICI).
+1. single-worker: any READY worker with a free, aligned, contiguous ICI
+   sub-grid of the needed size (policies/topology.py — index-order
+   fallback only when the detector reported no topology).
 2. multi-host: when no single worker fits and the model is distributable,
    workers sharing an ``ici_domain`` (one TPU slice spanning hosts)
    combine — leader + subordinate workers, each contributing whole hosts.
@@ -58,15 +59,20 @@ def build_candidates(
     }
     chips_needed = claim.chips
 
+    from gpustack_tpu.policies.topology import allocate_subslice
+
     singles: List[Candidate] = []
     for w in workers:
-        if len(free[w.id]) >= chips_needed:
+        sl = w.status.slice
+        chips = allocate_subslice(
+            sl.topology if sl else "",
+            w.total_chips,
+            free[w.id],
+            chips_needed,
+        )
+        if chips is not None:
             singles.append(
-                Candidate(
-                    worker=w,
-                    chip_indexes=free[w.id][:chips_needed],
-                    claim=claim,
-                )
+                Candidate(worker=w, chip_indexes=chips, claim=claim)
             )
     if singles:
         return singles

@@ -1,0 +1,154 @@
+"""ICI topology math: which chip sets form a valid sub-slice?
+
+TPU chips in a host/slice form a physical 2D/3D ICI mesh. A replica's
+chips must be a *contiguous, aligned sub-grid* of that mesh — an
+arbitrary set of free chip indexes (round-1 behavior: free chips in index
+order) may have no ICI path between members and will either fail to
+initialize or silently route collectives through PCIe/host.
+
+Shapes follow the platform's supported partitions (the same ladder GKE
+exposes as accelerator topologies):
+
+- 2D (v5e/v6e "RxC"): square ``n x n`` and oblong ``n x 2n`` sub-grids —
+  for a v5e-8 host (2x4) that is 1x1=1, 2x2=4, 2x4=8: chip counts
+  {1, 4, 8}, matching SURVEY §7.5.
+- 3D (v4/v5p "XxYxZ"): single chip, full box, and even sub-boxes (every
+  dimension 1 or an even divisor) — v4's torus wraps only on even
+  boundaries.
+- 1D ("N") and unknown topologies: any power-of-two prefix (degenerate
+  ring; also the fallback when a detector reports no topology).
+
+Alignment: a sub-grid of shape (a, b) may start only at offsets that are
+multiples of (a, b). This keeps concurrent allocations tileable — two
+2x2 replicas on a 2x4 host land at columns 0 and 2, never overlapping an
+unaligned middle placement that would strand the remaining chips.
+
+Reference analogue: the per-backend GPU selectors treat devices as an
+unordered set (gpustack/policies/candidate_selectors/); slice topology is
+the TPU-native replacement for that model.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence, Set, Tuple
+
+Dims = Tuple[int, ...]
+
+
+def parse_topology(s: str) -> Optional[Dims]:
+    """'2x4' -> (2, 4); '2x2x2' -> (2, 2, 2); '' / garbage -> None."""
+    if not s:
+        return None
+    try:
+        dims = tuple(int(p) for p in s.lower().split("x"))
+    except ValueError:
+        return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    return dims
+
+
+def allowed_subshapes(dims: Dims) -> List[Dims]:
+    """Valid sub-grid shapes for a host/slice mesh, largest first."""
+    shapes: Set[Dims] = {tuple(1 for _ in dims), dims}
+    if len(dims) == 2:
+        rows, cols = dims
+        n = 1
+        while n <= rows and n <= cols:
+            if rows % n == 0 and cols % n == 0:
+                shapes.add((n, n))
+            # oblong n x 2n only from n >= 2 (the platform ladder has
+            # 2x4, 4x8, 8x16 — but no 1x2: a single ICI row is not a
+            # supported partition)
+            if n >= 2 and rows % n == 0 and cols % (2 * n) == 0:
+                shapes.add((n, 2 * n))
+            if n >= 2 and cols % n == 0 and rows % (2 * n) == 0:
+                shapes.add((2 * n, n))
+            n *= 2
+    elif len(dims) == 3:
+        for sub in itertools.product(
+            *[[d for d in _even_divisors(dim)] for dim in dims]
+        ):
+            shapes.add(sub)
+    else:  # 1D: power-of-two prefixes
+        n = 1
+        while n <= dims[0]:
+            if dims[0] % n == 0:
+                shapes.add((n,))
+            n *= 2
+    return sorted(shapes, key=lambda s: (-_count(s), s))
+
+
+def _even_divisors(dim: int) -> List[int]:
+    return [d for d in range(1, dim + 1) if dim % d == 0 and (d == 1 or d % 2 == 0)]
+
+
+def _count(shape: Dims) -> int:
+    out = 1
+    for d in shape:
+        out *= d
+    return out
+
+
+def tileable_counts(topology: str, total_chips: int) -> Set[int]:
+    """Chip counts placeable on this topology. Fallback for unknown
+    topologies: powers of two up to total_chips."""
+    dims = parse_topology(topology)
+    if dims is None or _count(dims) != total_chips:
+        out, n = set(), 1
+        while n <= total_chips:
+            out.add(n)
+            n *= 2
+        return out
+    return {_count(s) for s in allowed_subshapes(dims)}
+
+
+def _index(coord: Dims, dims: Dims) -> int:
+    """Row-major chip index of a coordinate."""
+    idx = 0
+    for c, d in zip(coord, dims):
+        idx = idx * d + c
+    return idx
+
+
+def allocate_subslice(
+    topology: str,
+    total_chips: int,
+    free: Sequence[int],
+    chips_needed: int,
+) -> Optional[List[int]]:
+    """Pick a contiguous aligned sub-grid of ``chips_needed`` free chips.
+
+    Returns chip indexes (row-major over the topology) or None when no
+    aligned free sub-grid of an allowed shape exists — including when
+    enough chips are free but fragmented or the count doesn't tile.
+    """
+    free_set = set(free)
+    if chips_needed <= 0 or len(free_set) < chips_needed:
+        return None
+    dims = parse_topology(topology)
+    if dims is None or _count(dims) != total_chips:
+        # no topology info: index order (degenerate ring assumption)
+        ordered = sorted(free_set)
+        return ordered[:chips_needed]
+
+    for shape in allowed_subshapes(dims):
+        if _count(shape) != chips_needed:
+            continue
+        # aligned offsets: multiples of the shape per dimension
+        offset_ranges = [
+            range(0, dim, s) for dim, s in zip(dims, shape)
+        ]
+        for origin in itertools.product(*offset_ranges):
+            cells = [
+                _index(
+                    tuple(o + c for o, c in zip(origin, cell)), dims
+                )
+                for cell in itertools.product(
+                    *[range(s) for s in shape]
+                )
+            ]
+            if all(i in free_set for i in cells):
+                return sorted(cells)
+    return None

@@ -74,16 +74,11 @@ def add_extra_routes(app: web.Application) -> None:
                     ),
                 }
             )
+        from gpustack_tpu.scheduler.calculator import fleet_chip_budget
+
         max_single = max(w.total_chips for w in workers)
-        domains = {}
-        for w in workers:
-            sl = w.status.slice
-            if sl and sl.ici_domain:
-                domains[sl.ici_domain] = (
-                    domains.get(sl.ici_domain, 0) + w.total_chips
-                )
-        max_chips = max(
-            [max_single] + (list(domains.values()) if spec.distributable else [])
+        max_chips, allowed_counts = fleet_chip_budget(
+            workers, spec.distributable
         )
         hbm = min(w.hbm_per_chip for w in workers)
         try:
@@ -94,6 +89,7 @@ def add_extra_routes(app: web.Application) -> None:
                 long_context=spec.max_seq_len >= 16384,
                 explicit_plan=spec.mesh_plan,
                 explicit_chips=spec.chips_per_replica,
+                allowed_counts=allowed_counts,
             )
         except ValueError as e:      # malformed explicit mesh_plan
             return json_error(400, str(e))

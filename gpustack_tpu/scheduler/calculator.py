@@ -132,6 +132,47 @@ def evaluate_model(model: Model) -> ModelEvaluation:
     )
 
 
+def fleet_chip_budget(workers, distributable: bool):
+    """(max_chips, allowed_counts) for a filtered fleet.
+
+    ``allowed_counts`` = per-worker ICI-tileable sub-slice sizes
+    (policies/topology) plus, for distributable models, power-of-two
+    whole-host multiples across a slice (plan_mesh only factors
+    power-of-two device counts, so a 3-host 24-chip placement is not
+    claimable even though the hosts exist). Shared by the scheduler and
+    the /evaluate API so the preview claim always matches what placement
+    would actually do.
+    """
+    from gpustack_tpu.policies.topology import tileable_counts
+
+    max_single = max(w.total_chips for w in workers)
+    max_chips = max_single
+    allowed: set = set()
+    for w in workers:
+        sl = w.status.slice
+        allowed |= tileable_counts(
+            sl.topology if sl else "", w.total_chips
+        )
+    if distributable:
+        domains: dict = {}
+        for w in workers:
+            sl = w.status.slice
+            if sl and sl.ici_domain:
+                domains[sl.ici_domain] = (
+                    domains.get(sl.ici_domain, 0) + w.total_chips
+                )
+        if domains:
+            max_chips = max(max_chips, max(domains.values()))
+        for w in workers:
+            sl = w.status.slice
+            if sl and sl.ici_domain and w.total_chips:
+                n = w.total_chips * 2
+                while n <= max_chips:
+                    allowed.add(n)
+                    n *= 2
+    return max_chips, allowed
+
+
 def chips_for_claim(
     evaluation: ModelEvaluation,
     hbm_per_chip: int,
@@ -139,6 +180,7 @@ def chips_for_claim(
     long_context: bool = False,
     explicit_plan: str = "",
     explicit_chips: int = 0,
+    allowed_counts: Optional[set] = None,
 ) -> Optional[ComputedResourceClaim]:
     """Pick chips-per-replica (power of two) and a mesh plan that fits.
 
@@ -146,6 +188,12 @@ def chips_for_claim(
     Mirrors the reference's candidate ladder (manual → 1 GPU → multi-GPU →
     multi-worker, vllm_resource_fit_selector.py:315-341) but in chip space:
     the smallest power-of-two chip count whose per-chip share fits HBM.
+
+    ``allowed_counts`` (from policies/topology.tileable_counts over the
+    eligible fleet) restricts the ladder to chip counts that actually
+    tile some worker's ICI mesh — a 2-chip claim on a 2x4 v5e host is
+    unplaceable and must be bumped to 4, not discovered to be
+    unschedulable later.
     """
     usable = int(hbm_per_chip * HBM_UTILIZATION)
     if usable <= 0:
@@ -169,6 +217,13 @@ def chips_for_claim(
     start = explicit_chips or 1
     chips = max(1, start)
     while chips <= max_chips:
+        if (
+            allowed_counts is not None
+            and chips not in allowed_counts
+            and not explicit_chips
+        ):
+            chips *= 2
+            continue
         # weights and KV shard across chips; overhead replicates
         per_chip = (
             (evaluation.weight_bytes + evaluation.kv_cache_bytes) // chips

@@ -37,6 +37,35 @@ logger = logging.getLogger(__name__)
 
 RESCHEDULE_STUCK_AFTER = 180.0  # reference scheduler.py:261-298 (3 min)
 
+# jax.distributed coordinator port band (reference port-band logic:
+# serve_manager.py:1456-1508)
+COORDINATOR_PORT_BASE = 41000
+COORDINATOR_PORT_RANGE = 2048
+
+
+def pick_coordinator_port(
+    instances, leader_worker_id: int, exclude_instance_id: int
+) -> int:
+    """Lowest band port not claimed by another instance on this leader.
+
+    Returns 0 when the band is exhausted. The leader host additionally
+    bind-probes the chosen port before spawning (serve_manager) — this
+    function fences only DB-known claims.
+    """
+    used = {
+        int(i.coordinator_address.rsplit(":", 1)[1])
+        for i in instances
+        if i.coordinator_address
+        and i.worker_id == leader_worker_id
+        and i.id != exclude_instance_id
+    }
+    for p in range(
+        COORDINATOR_PORT_BASE, COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE
+    ):
+        if p not in used:
+            return p
+    return 0
+
 
 class Scheduler:
     def __init__(self, scan_interval: float = 30.0):
@@ -44,6 +73,11 @@ class Scheduler:
         self._task: Optional[asyncio.Task] = None
         self._scan_task: Optional[asyncio.Task] = None
         self._queue: asyncio.Queue = asyncio.Queue()
+        # serialize placements: the watch task and periodic scan both call
+        # _schedule_one; unserialized, two multi-host placements on one
+        # leader could read the same instance snapshot and pick the same
+        # coordinator port
+        self._place_lock = asyncio.Lock()
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._watch(), name="sched-watch")
@@ -127,6 +161,10 @@ class Scheduler:
     # ------------------------------------------------------------------
 
     async def _schedule_one(self, instance_id: int) -> None:
+        async with self._place_lock:
+            await self._schedule_one_locked(instance_id)
+
+    async def _schedule_one_locked(self, instance_id: int) -> None:
         inst = await ModelInstance.get(instance_id)
         if inst is None or inst.state != ModelInstanceState.PENDING:
             return
@@ -160,19 +198,12 @@ class Scheduler:
             return
 
         # chip budget: largest single worker, or whole slices when
-        # distributable
-        max_single = max(w.total_chips for w in eligible)
-        max_chips = max_single
-        if model.distributable:
-            domains = {}
-            for w in eligible:
-                sl = w.status.slice
-                if sl and sl.ici_domain:
-                    domains[sl.ici_domain] = (
-                        domains.get(sl.ici_domain, 0) + w.total_chips
-                    )
-            if domains:
-                max_chips = max(max_chips, max(domains.values()))
+        # distributable (shared with the /evaluate API)
+        from gpustack_tpu.scheduler.calculator import fleet_chip_budget
+
+        max_chips, allowed_counts = fleet_chip_budget(
+            eligible, model.distributable
+        )
 
         hbm = min(
             (w.hbm_per_chip for w in eligible if w.hbm_per_chip), default=0
@@ -184,6 +215,7 @@ class Scheduler:
             long_context=model.max_seq_len >= 16384,
             explicit_plan=model.mesh_plan,
             explicit_chips=model.chips_per_replica,
+            allowed_counts=allowed_counts,
         )
         if claim is None:
             gib = evaluation.total_bytes / 2**30
@@ -199,7 +231,9 @@ class Scheduler:
         if not candidates:
             await self._unschedulable(
                 inst,
-                f"needs {claim.chips} chips; no worker/slice has enough free",
+                f"needs {claim.chips} chips; no worker has a free aligned "
+                f"ICI sub-slice of that size (free chips may be "
+                f"fragmented or the count may not tile the topology)",
             )
             return
         model_files = await ModelFile.all()
@@ -207,12 +241,23 @@ class Scheduler:
 
         # multi-host: fix the jax.distributed rendezvous point on the
         # leader (replaces the reference's Ray/TCP-store port plumbing,
-        # serve_manager.py:1456-1508)
+        # serve_manager.py:1456-1508). Ports come from a fenced band with
+        # DB-known collisions excluded — id % 1000 would collide across
+        # 1000 instances; the leader additionally bind-probes before
+        # spawning (serve_manager).
         coordinator = ""
         if best.subordinates:
-            coordinator = (
-                f"{best.worker.ip or '127.0.0.1'}:{41000 + inst.id % 1000}"
+            port = pick_coordinator_port(
+                instances, best.worker.id, inst.id
             )
+            if not port:
+                await self._unschedulable(
+                    inst,
+                    "no free coordinator ports on leader "
+                    f"{best.worker.name}",
+                )
+                return
+            coordinator = f"{best.worker.ip or '127.0.0.1'}:{port}"
         await inst.update(
             state=ModelInstanceState.SCHEDULED,
             worker_id=best.worker.id,

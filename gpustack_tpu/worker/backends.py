@@ -113,6 +113,22 @@ def _tpu_native_command(
         env.setdefault("TPU_CHIPS_PER_PROCESS_BOUNDS", "")
     if force_platform:
         env["GPUSTACK_TPU_PLATFORM"] = force_platform
+        if force_platform == "cpu":
+            # hermetic runs: the CPU backend must expose as many virtual
+            # devices as this process's chip assignment so the mesh plan
+            # tiles (mirrors tests/conftest.py)
+            import re as _re
+
+            claim = instance.computed_resource_claim
+            n_local = len(my_chips) or (claim.chips if claim else 1)
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", "")),
+            )
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={n_local}"
+            ).strip()
     if instance.coordinator_address:
         # multi-host: jax.distributed rendezvous (replaces the reference's
         # Ray bootstrap, worker/backends/vllm.py:258-328). The engine

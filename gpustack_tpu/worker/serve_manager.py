@@ -232,6 +232,30 @@ class ServeManager:
                 )
             return
 
+        # multi-host leader: fence the jax.distributed coordinator port
+        # before spawning — the scheduler avoids DB-known collisions but
+        # only the leader host can see ports taken by unrelated processes
+        # (reference port-band probing, serve_manager.py:1456-1508)
+        if is_leader and inst.coordinator_address:
+            coord_port = int(inst.coordinator_address.rsplit(":", 1)[1])
+            with socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            ) as probe:
+                # SO_REUSEADDR: TIME_WAIT remnants of a crashed leader's
+                # coordinator must not fail the restart path
+                probe.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                )
+                try:
+                    probe.bind(("0.0.0.0", coord_port))
+                except OSError as e:
+                    await self._set_state(
+                        instance_id,
+                        ModelInstanceState.ERROR,
+                        f"coordinator port {coord_port} unavailable: {e}",
+                    )
+                    return
+
         run = self.running.get(instance_id) or RunningInstance(
             instance_id, port
         )

