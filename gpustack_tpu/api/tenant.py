@@ -1,0 +1,42 @@
+"""Tenant scoping: which models can this principal see and use?
+
+Reference parity: gpustack/api/tenant.py TenantContext — org membership
+filters both the management API (model listings) and the inference path
+(model resolution in the OpenAI proxy). Admin and system principals see
+everything; worker principals see everything (they must serve any model
+placed on them); plain users see unscoped models (org_id=0) plus models
+of orgs they belong to.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from gpustack_tpu.schemas import Model, OrgMember
+
+
+async def accessible_org_ids(principal) -> Optional[Set[int]]:
+    """Org ids the principal may access; None = unrestricted."""
+    if principal is None:
+        return set()
+    if principal.is_admin or principal.kind in ("worker", "system"):
+        return None
+    if principal.user is None:
+        return set()
+    members = await OrgMember.filter(user_id=principal.user.id)
+    return {m.org_id for m in members}
+
+
+async def model_accessible(principal, model: Model) -> bool:
+    if model.org_id == 0:
+        return True
+    orgs = await accessible_org_ids(principal)
+    return orgs is None or model.org_id in orgs
+
+
+async def visible_models(principal, models):
+    """Filter a model list down to what the principal may see."""
+    orgs = await accessible_org_ids(principal)
+    if orgs is None:
+        return list(models)
+    return [m for m in models if m.org_id == 0 or m.org_id in orgs]

@@ -68,6 +68,7 @@ def add_crud_routes(
     admin_read: bool = False,
     redact: tuple = (),
     worker_owns: Callable = default_worker_owns,
+    visible: Optional[Callable] = None,
 ) -> None:
     """Mount list/get/watch/create/update/delete for one Record type.
 
@@ -81,7 +82,10 @@ def add_crud_routes(
         can never assign a record to a different worker.
     Read access: ``admin_read=True`` restricts list/get/watch to admins
     (user records). ``redact`` strips fields (e.g. password_hash) from
-    every serialized response including watch payloads.
+    every serialized response including watch payloads. ``visible`` is an
+    optional ``async (request, obj) -> bool`` tenancy filter applied to
+    list/get and to watch events that carry data (reference TenantContext
+    role, api/tenant.py).
     """
     base = f"/v2/{path}"
 
@@ -130,8 +134,22 @@ def add_crud_routes(
             offset = int(request.query.get("offset", 0))
         except ValueError:
             return json_error(400, "limit/offset must be integers")
-        items = await cls.filter(limit=limit, offset=offset, **filters)
-        total = await cls.count(**filters)
+        if visible is None:
+            items = await cls.filter(
+                limit=limit, offset=offset, **filters
+            )
+            total = await cls.count(**filters)
+        else:
+            # tenancy filter BEFORE pagination: pages must be full and
+            # total must count only what this principal can see (a global
+            # total would leak the number of hidden cross-tenant records)
+            all_items = await cls.filter(limit=None, **filters)
+            kept = []
+            for item in all_items:
+                if await visible(request, item):
+                    kept.append(item)
+            total = len(kept)
+            items = kept[offset:offset + limit]
         return web.json_response(
             {
                 "items": [dump(i) for i in items],
@@ -151,6 +169,18 @@ def add_crud_routes(
         agen = cls.subscribe(send_initial=True, heartbeat=15.0)
         try:
             async for event in agen:
+                if (
+                    visible is not None
+                    and isinstance(event.data, dict)
+                ):
+                    try:
+                        obj = cls.model_validate(event.data)
+                    except pydantic.ValidationError:
+                        # fail CLOSED: an unparseable payload must not
+                        # bypass the tenancy filter
+                        continue
+                    if not await visible(request, obj):
+                        continue
                 wire = event.to_wire()
                 if redact:
                     # to_wire aliases the Event's own dicts and the bus
@@ -176,6 +206,9 @@ def add_crud_routes(
             return err
         obj = await cls.get(int(request.match_info["id"]))
         if obj is None:
+            return json_error(404, f"{path} not found")
+        if visible is not None and not await visible(request, obj):
+            # same 404 as nonexistence: no id oracle across tenants
             return json_error(404, f"{path} not found")
         return web.json_response(dump(obj))
 

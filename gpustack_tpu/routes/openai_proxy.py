@@ -98,10 +98,33 @@ async def _record_usage(
 
 def add_openai_routes(app: web.Application) -> None:
     async def list_models(request: web.Request):
-        routes = await ModelRoute.filter()
-        names = [r.name for r in routes if r.enabled]
-        if not names:
-            names = [m.name for m in await Model.filter()]
+        from gpustack_tpu.api.tenant import accessible_org_ids
+
+        principal = request.get("principal")
+        orgs = await accessible_org_ids(principal)  # None = unrestricted
+
+        def ok(m: Model) -> bool:
+            return orgs is None or m.org_id == 0 or m.org_id in orgs
+
+        models = {m.id: m for m in await Model.filter(limit=None)}
+        enabled_routes = [
+            r for r in await ModelRoute.filter() if r.enabled
+        ]
+        if enabled_routes:
+            # operator curates names via routes; a route is listed when
+            # any target is accessible to this principal
+            names = [
+                r.name
+                for r in enabled_routes
+                if any(
+                    (m := models.get(t.model_id)) and ok(m)
+                    for t in r.targets
+                )
+            ]
+        else:
+            # no routes configured at all: raw model names (pre-tenancy
+            # behavior, scoped)
+            names = [m.name for m in models.values() if ok(m)]
         return web.json_response(
             {
                 "object": "list",
@@ -127,6 +150,12 @@ def add_openai_routes(app: web.Application) -> None:
             return json_error(400, "missing 'model'")
         model = await _resolve_model(str(name))
         if model is None:
+            return json_error(404, f"model {name!r} not found")
+        # tenancy: an org-scoped model is invisible (404, not 403 — no
+        # name oracle) outside its org (reference api/tenant.py)
+        from gpustack_tpu.api.tenant import model_accessible
+
+        if not await model_accessible(request.get("principal"), model):
             return json_error(404, f"model {name!r} not found")
         instance = await _pick_instance(model)
         if instance is None:

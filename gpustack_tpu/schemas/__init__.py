@@ -26,6 +26,7 @@ from gpustack_tpu.schemas.models import (
 from gpustack_tpu.schemas.model_files import ModelFile, ModelFileState
 from gpustack_tpu.schemas.model_routes import ModelRoute, ModelRouteTarget
 from gpustack_tpu.schemas.users import ApiKey, User
+from gpustack_tpu.schemas.orgs import Org, OrgMember, OrgRole
 from gpustack_tpu.schemas.benchmarks import Benchmark, BenchmarkState
 from gpustack_tpu.schemas.inference_backends import InferenceBackend
 
@@ -49,6 +50,9 @@ __all__ = [
     "ModelRouteTarget",
     "User",
     "ApiKey",
+    "Org",
+    "OrgMember",
+    "OrgRole",
     "Benchmark",
     "BenchmarkState",
     "InferenceBackend",

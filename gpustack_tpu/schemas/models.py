@@ -44,6 +44,10 @@ class Model(Record):
     name: str = ""
     description: str = ""
     cluster_id: int = 0
+    # tenancy: 0 = unscoped (visible to every authenticated principal —
+    # the single-tenant default); nonzero = only members of that org and
+    # admins see or infer against it (schemas/orgs.py)
+    org_id: int = 0
     # source: exactly one of preset (built-in config, hermetic), local_path,
     # or huggingface repo id
     preset: str = ""

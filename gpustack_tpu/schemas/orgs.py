@@ -1,0 +1,41 @@
+"""Multi-tenancy: organizations + memberships + model access scoping.
+
+Reference parity (gpustack/schemas/principals.py orgs/roles,
+api/tenant.py TenantContext filtering, routes/routes.py:265-330 org
+routers) — compressed to the load-bearing core: orgs own models; users
+belong to orgs with a role; non-admin visibility of models (and
+inference against them) is limited to orgs the user belongs to, with
+org_id=0 meaning "unscoped" (single-tenant default — clusters that never
+create an org behave exactly as before).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class OrgRole(str, enum.Enum):
+    OWNER = "owner"
+    ADMIN = "admin"
+    MEMBER = "member"
+
+
+@register_record
+class Org(Record):
+    __kind__ = "org"
+    __indexes__ = ("name",)
+
+    name: str = ""
+    description: str = ""
+
+
+@register_record
+class OrgMember(Record):
+    __kind__ = "org_member"
+    __indexes__ = ("org_id", "user_id")
+
+    org_id: int = 0
+    user_id: int = 0
+    role: OrgRole = OrgRole.MEMBER

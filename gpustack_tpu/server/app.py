@@ -26,6 +26,8 @@ from gpustack_tpu.schemas import (
     ModelFile,
     ModelInstance,
     ModelRoute,
+    Org,
+    OrgMember,
     User,
     Worker,
 )
@@ -146,7 +148,69 @@ def create_app(cfg: Config) -> web.Application:
         # followers report state only — endpoint fields are leader-owned
         return is_subordinate and not (touched & INSTANCE_LEADER_FIELDS)
 
-    add_crud_routes(app, Model, "models", create_hook=model_create_hook)
+    from gpustack_tpu.api.tenant import accessible_org_ids, model_accessible
+
+    async def model_visible(request, obj: Model) -> bool:
+        return await model_accessible(request.get("principal"), obj)
+
+    async def model_org_check(request, obj: Model, fields):
+        org_id = (
+            fields.get("org_id", obj.org_id)
+            if isinstance(fields, dict) else obj.org_id
+        )
+        if org_id and await Org.get(org_id) is None:
+            return json_error(400, f"org {org_id} does not exist")
+        return None
+
+    async def model_create_and_org_hook(request, obj: Model, body):
+        if err := await model_create_hook(request, obj, body):
+            return err
+        return await model_org_check(request, obj, body)
+
+    add_crud_routes(
+        app, Model, "models",
+        create_hook=model_create_and_org_hook,
+        update_hook=model_org_check,
+        visible=model_visible,
+    )
+
+    # orgs: non-admins see only orgs they belong to; members likewise
+    async def org_visible(request, obj: Org) -> bool:
+        orgs = await accessible_org_ids(request.get("principal"))
+        return orgs is None or obj.id in orgs
+
+    async def org_member_visible(request, obj: OrgMember) -> bool:
+        orgs = await accessible_org_ids(request.get("principal"))
+        return orgs is None or obj.org_id in orgs
+
+    async def org_delete_hook(request, obj: Org):
+        if await Model.first(org_id=obj.id):
+            return json_error(
+                409, "org still owns models; reassign or delete them first"
+            )
+        for m in await OrgMember.filter(org_id=obj.id, limit=10**6):
+            await m.delete()
+        return None
+
+    add_crud_routes(
+        app, Org, "orgs",
+        visible=org_visible, delete_hook=org_delete_hook,
+    )
+
+    async def org_member_create_hook(request, obj: OrgMember, body):
+        if await Org.get(obj.org_id) is None:
+            return json_error(400, f"org {obj.org_id} does not exist")
+        if await User.get(obj.user_id) is None:
+            return json_error(400, f"user {obj.user_id} does not exist")
+        if await OrgMember.first(org_id=obj.org_id, user_id=obj.user_id):
+            return json_error(409, "already a member")
+        return None
+
+    add_crud_routes(
+        app, OrgMember, "org-members",
+        create_hook=org_member_create_hook,
+        visible=org_member_visible,
+    )
     add_crud_routes(
         app, ModelInstance, "model-instances",
         worker_write=True, worker_owns=instance_worker_owns,
