@@ -1,0 +1,197 @@
+"""Audio transcription server: OpenAI ``/v1/audio/transcriptions``.
+
+The VoxBox role of the reference (worker/backends/vox_box.py:23 — audio
+models served behind the same OpenAI surface). One process owns a
+Whisper-class model (models/whisper.py); requests carry WAV audio as
+multipart form data; transcription runs encode + jitted greedy decode on
+the accelerator. Launched by the worker's serve manager exactly like the
+LLM engine (worker/backends.py picks this entrypoint for audio-category
+models) and fronted by the same authenticated worker proxy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import time
+import uuid
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+
+class AudioEngine:
+    """Owns model params + a serialized transcription executor."""
+
+    def __init__(self, cfg, params, model_dir: str = ""):
+        self.cfg = cfg
+        self.params = params
+        self.model_dir = model_dir
+        self.tokenizer = self._load_tokenizer(model_dir)
+        self._lock = asyncio.Lock()
+        self.requests = 0
+        self.audio_seconds = 0.0
+
+    @staticmethod
+    def _load_tokenizer(model_dir: str):
+        if model_dir:
+            try:
+                from transformers import AutoTokenizer
+
+                return AutoTokenizer.from_pretrained(model_dir)
+            except Exception:
+                logger.warning(
+                    "no HF tokenizer in %s; using byte fallback", model_dir
+                )
+        from gpustack_tpu.engine.tokenizer import ByteTokenizer
+
+        return ByteTokenizer()
+
+    async def transcribe(self, wav_bytes: bytes) -> dict:
+        from gpustack_tpu.models.audio import decode_wav, features_for_model
+        from gpustack_tpu.models.whisper import greedy_transcribe
+
+        audio = decode_wav(wav_bytes)
+        mel = features_for_model(audio, self.cfg)
+        start = time.monotonic()
+        # one transcription at a time per process: decode is a tight
+        # jitted loop; concurrency comes from replicas
+        async with self._lock:
+            ids = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: greedy_transcribe(self.params, self.cfg, mel),
+            )
+        text = self.tokenizer.decode(ids)
+        self.requests += 1
+        self.audio_seconds += len(audio) / 16000.0
+        return {
+            "text": text,
+            "duration_s": round(len(audio) / 16000.0, 2),
+            "latency_ms": round((time.monotonic() - start) * 1e3, 1),
+        }
+
+
+class AudioServer:
+    def __init__(self, engine: AudioEngine, model_name: str = ""):
+        self.engine = engine
+        self.model_name = model_name or engine.cfg.name
+        self.app = web.Application(client_max_size=256 * 2**20)
+        self.app.add_routes(
+            [
+                web.post(
+                    "/v1/audio/transcriptions", self.transcriptions
+                ),
+                web.get("/healthz", self.healthz),
+                web.get("/metrics", self.metrics),
+            ]
+        )
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "ok",
+                "model": self.model_name,
+                "modality": "audio",
+                "requests": self.engine.requests,
+            }
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=(
+                "# TYPE gpustack_tpu_audio_requests_total counter\n"
+                f"gpustack_tpu_audio_requests_total {self.engine.requests}\n"
+                "# TYPE gpustack_tpu_audio_seconds_total counter\n"
+                f"gpustack_tpu_audio_seconds_total "
+                f"{self.engine.audio_seconds:.2f}\n"
+            )
+        )
+
+    async def transcriptions(self, request: web.Request) -> web.Response:
+        if not request.content_type.startswith("multipart/"):
+            return web.json_response(
+                {"error": "multipart/form-data with a 'file' part required"},
+                status=400,
+            )
+        wav = None
+        fmt = "json"
+        async for part in await request.multipart():
+            if part.name == "file":
+                wav = await part.read(decode=False)
+            elif part.name == "response_format":
+                fmt = (await part.text()).strip() or "json"
+        if not wav:
+            return web.json_response(
+                {"error": "missing 'file' part"}, status=400
+            )
+        import wave as _wave
+
+        try:
+            result = await self.engine.transcribe(wav)
+        except (ValueError, _wave.Error, EOFError) as e:
+            return web.json_response(
+                {"error": f"invalid audio: {e}"}, status=400
+            )
+        if fmt == "text":
+            return web.Response(text=result["text"])
+        return web.json_response(
+            {
+                "id": f"transcr-{uuid.uuid4().hex[:12]}",
+                "object": "audio.transcription",
+                "model": self.model_name,
+                **result,
+            }
+        )
+
+
+def build_audio_engine_from_args(args) -> AudioEngine:
+    forced = os.environ.get("GPUSTACK_TPU_PLATFORM")
+    import jax
+
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    from gpustack_tpu.models.whisper import (
+        WHISPER_PRESETS,
+        config_from_hf_whisper,
+        init_whisper_params,
+    )
+
+    if args.model_dir:
+        with open(os.path.join(args.model_dir, "config.json")) as f:
+            cfg = config_from_hf_whisper(json.load(f))
+        from gpustack_tpu.engine.weights import load_whisper_params
+
+        params = load_whisper_params(cfg, args.model_dir)
+    else:
+        cfg = WHISPER_PRESETS[args.preset]
+        params = init_whisper_params(cfg, jax.random.key(0))
+    return AudioEngine(cfg, params, model_dir=args.model_dir)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("gpustack-tpu audio server")
+    p.add_argument("--model-dir", default="")
+    p.add_argument("--preset", default="whisper-large-v3")
+    p.add_argument("--served-name", default="")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9000)
+    # accepted for launcher compatibility; unused by the audio engine
+    p.add_argument("--max-slots", type=int, default=1)
+    p.add_argument("--max-seq-len", type=int, default=448)
+    p.add_argument("--quantization", default="")
+    p.add_argument("--mesh-plan", default="")
+    args, _ = p.parse_known_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    engine = build_audio_engine_from_args(args)
+    server = AudioServer(engine, model_name=args.served_name or None)
+    web.run_app(server.app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

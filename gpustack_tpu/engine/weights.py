@@ -37,27 +37,39 @@ def _to_jnp(t, dtype=jnp.bfloat16) -> jax.Array:
     return jnp.asarray(t.numpy()).astype(dtype)
 
 
-def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
-    """Load *.safetensors from a local HF model dir into our param tree."""
+def _read_safetensors(model_dir: str) -> Dict[str, Any]:
+    """All tensors from a local HF model dir, keyed by checkpoint name."""
     from safetensors import safe_open
 
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no .safetensors files in {model_dir}")
-
     tensors: Dict[str, Any] = {}
     for f in files:
         with safe_open(f, framework="pt") as st:
             for name in st.keys():
                 tensors[name] = st.get_tensor(name)
+    return tensors
 
-    L = cfg.num_layers
+
+def _taker(tensors: Dict[str, Any]):
+    """take(name, transpose) popping from ``tensors`` — shared by the LM
+    and Whisper loaders so dtype/transpose handling can't drift."""
 
     def take(name: str, transpose: bool = False) -> jax.Array:
         t = tensors.pop(name)
         if transpose:
             t = t.T
         return _to_jnp(t)
+
+    return take
+
+
+def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
+    """Load *.safetensors from a local HF model dir into our param tree."""
+    tensors = _read_safetensors(model_dir)
+    L = cfg.num_layers
+    take = _taker(tensors)
 
     def stack(fmt: str, transpose: bool = False) -> jax.Array:
         return jnp.stack([take(fmt.format(i), transpose) for i in range(L)])
@@ -116,6 +128,85 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
             params["lm_head"] = params["embed"].T
     if tensors:
         logger.warning("unused checkpoint tensors: %s", sorted(tensors)[:8])
+    return params
+
+
+def load_whisper_params(cfg, model_dir: str):
+    """Load an HF Whisper safetensors checkpoint into the
+    models/whisper.py param tree (falls back to random init when no
+    checkpoint is present — same contract as load_or_init_params)."""
+    from gpustack_tpu.models.whisper import init_whisper_params
+
+    try:
+        tensors = _read_safetensors(model_dir)
+    except FileNotFoundError:
+        logger.warning(
+            "no whisper checkpoint at %r — random init", model_dir
+        )
+        return init_whisper_params(cfg, jax.random.key(0))
+    take = _taker(tensors)
+
+    def stack(side: str, L: int, fmt: str, transpose=False) -> jax.Array:
+        return jnp.stack(
+            [
+                take(f"model.{side}.layers.{i}.{fmt}", transpose)
+                for i in range(L)
+            ]
+        )
+
+    def attn_block(side: str, L: int, prefix: str, out: dict, tag: str):
+        out[f"{tag}wq"] = stack(side, L, f"{prefix}.q_proj.weight", True)
+        out[f"{tag}bq"] = stack(side, L, f"{prefix}.q_proj.bias")
+        out[f"{tag}wk"] = stack(side, L, f"{prefix}.k_proj.weight", True)
+        out[f"{tag}wv"] = stack(side, L, f"{prefix}.v_proj.weight", True)
+        out[f"{tag}bv"] = stack(side, L, f"{prefix}.v_proj.bias")
+        out[f"{tag}wo"] = stack(side, L, f"{prefix}.out_proj.weight", True)
+        out[f"{tag}bo"] = stack(side, L, f"{prefix}.out_proj.bias")
+
+    def layer_group(side: str, L: int) -> dict:
+        out = {
+            "ln1": stack(side, L, "self_attn_layer_norm.weight"),
+            "ln1_b": stack(side, L, "self_attn_layer_norm.bias"),
+            "ln2": stack(side, L, "final_layer_norm.weight"),
+            "ln2_b": stack(side, L, "final_layer_norm.bias"),
+            "w_up": stack(side, L, "fc1.weight", True),
+            "b_up": stack(side, L, "fc1.bias"),
+            "w_down": stack(side, L, "fc2.weight", True),
+            "b_down": stack(side, L, "fc2.bias"),
+        }
+        attn_block(side, L, "self_attn", out, "")
+        if side == "decoder":
+            out["lnx"] = stack(side, L, "encoder_attn_layer_norm.weight")
+            out["lnx_b"] = stack(side, L, "encoder_attn_layer_norm.bias")
+            attn_block(side, L, "encoder_attn", out, "x")
+        return out
+
+    params = {
+        # HF conv weights are [out, in, k] — ours are [k, in, out]
+        "conv1": jnp.transpose(
+            _to_jnp(tensors.pop("model.encoder.conv1.weight")), (2, 1, 0)
+        ),
+        "conv1_b": take("model.encoder.conv1.bias"),
+        "conv2": jnp.transpose(
+            _to_jnp(tensors.pop("model.encoder.conv2.weight")), (2, 1, 0)
+        ),
+        "conv2_b": take("model.encoder.conv2.bias"),
+        "enc_layers": layer_group("encoder", cfg.encoder_layers),
+        "enc_ln": take("model.encoder.layer_norm.weight"),
+        "enc_ln_b": take("model.encoder.layer_norm.bias"),
+        "tok_embed": take("model.decoder.embed_tokens.weight"),
+        "pos_embed": take("model.decoder.embed_positions.weight"),
+        "dec_layers": layer_group("decoder", cfg.decoder_layers),
+        "dec_ln": take("model.decoder.layer_norm.weight"),
+        "dec_ln_b": take("model.decoder.layer_norm.bias"),
+    }
+    # encoder position embeddings are fixed sinusoids (recomputed)
+    tensors.pop("model.encoder.embed_positions.weight", None)
+    tensors.pop("proj_out.weight", None)  # tied to tok_embed
+    if tensors:
+        logger.warning(
+            "unused whisper tensors: %s", sorted(tensors)[:8]
+        )
     return params
 
 

@@ -34,6 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--fake-detector", default=None)
     start.add_argument("--force-platform", default=None)
     start.add_argument("--debug", action="store_true", default=None)
+    start.add_argument(
+        "--ha", action="store_true", default=None,
+        help="multi-server HA: lease-based leader election over the "
+        "shared database",
+    )
+    start.add_argument("--database-path", default=None)
 
     sub.add_parser("version", help="print version")
 

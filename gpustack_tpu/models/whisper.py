@@ -1,0 +1,388 @@
+"""Functional Whisper-class speech-to-text model (encoder-decoder).
+
+The audio modality of the framework (reference serves audio through the
+VoxBox backend, worker/backends/vox_box.py:23; BASELINE config 5 pairs
+Whisper-large-v3 with SDXL). TPU-first design mirrors the LM core
+(models/transformer.py): per-layer weights stacked on a leading [L] axis
+with ``lax.scan`` over blocks, static shapes (mel input padded to
+``max_source_positions * 2`` frames, decode loop jitted one step at a
+time over a preallocated KV cache), bf16 matmuls with fp32
+softmax/normalization.
+
+Architecture follows the published Whisper design (conv frontend →
+sinusoidal positions → pre-LN transformer encoder; decoder with causal
+self-attention + cross-attention, tied output embedding). Weights load
+from HF safetensors checkpoints via the same weight-mapping approach as
+the LM engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    name: str = "whisper"
+    vocab_size: int = 51866
+    num_mel_bins: int = 128
+    d_model: int = 1280
+    encoder_layers: int = 32
+    decoder_layers: int = 32
+    num_heads: int = 20
+    max_source_positions: int = 1500   # encoder frames after conv stride 2
+    max_target_positions: int = 448
+    eos_token_id: int = 50257
+    decoder_start_token_id: int = 50258
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    # calculator-facing surface (scheduler/calculator.py treats audio
+    # models through the same claim math; whisper shards poorly and fits
+    # one chip, so the mesh planner is pinned to tp=1 via num_kv_heads)
+    @property
+    def num_kv_heads(self) -> int:
+        return 1
+
+    @property
+    def num_experts(self) -> int:
+        return 0
+
+    def kv_cache_bytes_per_token(self, bits: int = 16) -> int:
+        # decoder self-attn K+V per position (cross-attn K/V is per
+        # request, amortized into overhead)
+        return self.decoder_layers * 2 * self.d_model * bits // 8
+
+    def param_count(self) -> int:
+        d, v = self.d_model, self.vocab_size
+        enc = self.encoder_layers * (4 * d * d + 8 * d * d)   # attn + mlp
+        dec = self.decoder_layers * (8 * d * d + 8 * d * d)   # self+cross+mlp
+        embed = v * d + self.max_target_positions * d
+        conv = 3 * self.num_mel_bins * d + 3 * d * d
+        return enc + dec + embed + conv
+
+    def weight_bytes(self, bits: int = 16) -> int:
+        return self.param_count() * bits // 8
+
+
+WHISPER_PRESETS: Dict[str, WhisperConfig] = {
+    "whisper-large-v3": WhisperConfig(name="whisper-large-v3"),
+    "whisper-small": WhisperConfig(
+        name="whisper-small",
+        vocab_size=51865,
+        num_mel_bins=80,
+        d_model=768,
+        encoder_layers=12,
+        decoder_layers=12,
+        num_heads=12,
+    ),
+    "tiny-whisper": WhisperConfig(
+        name="tiny-whisper",
+        vocab_size=384,
+        num_mel_bins=16,
+        d_model=64,
+        encoder_layers=2,
+        decoder_layers=2,
+        num_heads=4,
+        max_source_positions=32,
+        max_target_positions=32,
+        eos_token_id=1,
+        decoder_start_token_id=2,
+    ),
+}
+
+
+def config_from_hf_whisper(cfg: Dict[str, Any], name: str = "") -> WhisperConfig:
+    """Map an HF WhisperConfig dict (config.json) onto WhisperConfig."""
+    return WhisperConfig(
+        name=name or cfg.get("_name_or_path", "whisper"),
+        vocab_size=cfg["vocab_size"],
+        num_mel_bins=cfg.get("num_mel_bins", 80),
+        d_model=cfg["d_model"],
+        encoder_layers=cfg["encoder_layers"],
+        decoder_layers=cfg["decoder_layers"],
+        num_heads=cfg["encoder_attention_heads"],
+        max_source_positions=cfg.get("max_source_positions", 1500),
+        max_target_positions=cfg.get("max_target_positions", 448),
+        eos_token_id=cfg.get("eos_token_id", 50257),
+        decoder_start_token_id=cfg.get("decoder_start_token_id", 50258),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_whisper_params(
+    cfg: WhisperConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> Params:
+    d = cfg.d_model
+    keys = iter(jax.random.split(key, 24))
+
+    def w(k, *shape, scale=None):
+        scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
+        return (
+            jax.random.normal(k, shape, jnp.float32) * scale
+        ).astype(dtype)
+
+    def enc_layers(L):
+        return {
+            "ln1": jnp.ones((L, d), dtype),
+            "ln1_b": jnp.zeros((L, d), dtype),
+            "wq": w(next(keys), L, d, d),
+            "bq": jnp.zeros((L, d), dtype),
+            "wk": w(next(keys), L, d, d),
+            "wv": w(next(keys), L, d, d),
+            "bv": jnp.zeros((L, d), dtype),
+            "wo": w(next(keys), L, d, d),
+            "bo": jnp.zeros((L, d), dtype),
+            "ln2": jnp.ones((L, d), dtype),
+            "ln2_b": jnp.zeros((L, d), dtype),
+            "w_up": w(next(keys), L, d, 4 * d),
+            "b_up": jnp.zeros((L, 4 * d), dtype),
+            "w_down": w(next(keys), L, 4 * d, d, scale=1 / math.sqrt(4 * d)),
+            "b_down": jnp.zeros((L, d), dtype),
+        }
+
+    dec = enc_layers(cfg.decoder_layers)
+    dec.update(
+        {
+            "lnx": jnp.ones((cfg.decoder_layers, d), dtype),
+            "lnx_b": jnp.zeros((cfg.decoder_layers, d), dtype),
+            "xwq": w(next(keys), cfg.decoder_layers, d, d),
+            "xbq": jnp.zeros((cfg.decoder_layers, d), dtype),
+            "xwk": w(next(keys), cfg.decoder_layers, d, d),
+            "xwv": w(next(keys), cfg.decoder_layers, d, d),
+            "xbv": jnp.zeros((cfg.decoder_layers, d), dtype),
+            "xwo": w(next(keys), cfg.decoder_layers, d, d),
+            "xbo": jnp.zeros((cfg.decoder_layers, d), dtype),
+        }
+    )
+
+    return {
+        "conv1": w(next(keys), 3, cfg.num_mel_bins, d),
+        "conv1_b": jnp.zeros((d,), dtype),
+        "conv2": w(next(keys), 3, d, d),
+        "conv2_b": jnp.zeros((d,), dtype),
+        "enc_layers": enc_layers(cfg.encoder_layers),
+        "enc_ln": jnp.ones((d,), dtype),
+        "enc_ln_b": jnp.zeros((d,), dtype),
+        "tok_embed": w(next(keys), cfg.vocab_size, d, scale=0.02),
+        "pos_embed": w(next(keys), cfg.max_target_positions, d, scale=0.02),
+        "dec_layers": dec,
+        "dec_ln": jnp.ones((d,), dtype),
+        "dec_ln_b": jnp.zeros((d,), dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _heads(x, n):  # [B, T, D] -> [B, T, H, hd]
+    B, T, D = x.shape
+    return x.reshape(B, T, n, D // n)
+
+
+def _mha(q, k, v, scale, causal_mask=None):
+    """q/k/v: [B, T, H, hd]; fp32 softmax; returns [B, Tq, D]."""
+    scores = (
+        jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    )
+    if causal_mask is not None:
+        scores = jnp.where(causal_mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", weights, v)
+    B, T = out.shape[0], out.shape[1]
+    return out.reshape(B, T, -1)
+
+
+def sinusoids(length: int, channels: int) -> jax.Array:
+    """Whisper's fixed sinusoidal encoder positions."""
+    log_timescale = math.log(10000) / (channels // 2 - 1)
+    inv = jnp.exp(-log_timescale * jnp.arange(channels // 2))
+    scaled = jnp.arange(length)[:, None] * inv[None, :]
+    return jnp.concatenate([jnp.sin(scaled), jnp.cos(scaled)], axis=1)
+
+
+def _conv1d(x, w, b, stride: int):
+    """x [B, T, Cin], w [K, Cin, Cout] — SAME padding, like Whisper's
+    torch Conv1d(kernel=3, padding=1)."""
+    return (
+        lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(stride,),
+            padding=((1, 1),),
+            dimension_numbers=("NHC", "HIO", "NHC"),
+        )
+        + b
+    )
+
+
+def encode(params: Params, cfg: WhisperConfig, mel: jax.Array) -> jax.Array:
+    """mel [B, frames, n_mels] (frames = 2 * max_source_positions) ->
+    encoder states [B, max_source_positions, D]."""
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    x = mel.astype(dtype)
+    x = jax.nn.gelu(_conv1d(x, params["conv1"], params["conv1_b"], 1))
+    x = jax.nn.gelu(_conv1d(x, params["conv2"], params["conv2_b"], 2))
+    x = x + sinusoids(x.shape[1], cfg.d_model).astype(dtype)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def block(x_in, lp):
+        h = _ln(x_in, lp["ln1"], lp["ln1_b"])
+        q = _heads(h @ lp["wq"] + lp["bq"], cfg.num_heads)
+        k = _heads(h @ lp["wk"], cfg.num_heads)
+        v = _heads(h @ lp["wv"] + lp["bv"], cfg.num_heads)
+        x_mid = x_in + _mha(q, k, v, scale) @ lp["wo"] + lp["bo"]
+        h2 = _ln(x_mid, lp["ln2"], lp["ln2_b"])
+        mlp = jax.nn.gelu(h2 @ lp["w_up"] + lp["b_up"])
+        return x_mid + mlp @ lp["w_down"] + lp["b_down"], None
+
+    x, _ = lax.scan(block, x, params["enc_layers"])
+    return _ln(x, params["enc_ln"], params["enc_ln_b"])
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecCache:
+    """Decoder KV cache: self-attn K/V [L, B, S, H, hd]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @staticmethod
+    def create(cfg: WhisperConfig, batch: int, dtype=jnp.bfloat16):
+        shape = (
+            cfg.decoder_layers, batch, cfg.max_target_positions,
+            cfg.num_heads, cfg.head_dim,
+        )
+        return DecCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def cross_kv(
+    params: Params, cfg: WhisperConfig, enc_states: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Project encoder states to per-layer cross-attn K/V ONCE per
+    utterance ([L, B, S_enc, H, hd] each) — recomputing them inside every
+    decode step would redo L x 2 projections over 1500 positions per
+    generated token."""
+    dl = params["dec_layers"]
+
+    def proj(enc, wk, wv, bv):
+        k = _heads(enc @ wk, cfg.num_heads)
+        v = _heads(enc @ wv + bv, cfg.num_heads)
+        return k, v
+
+    return jax.vmap(proj, in_axes=(None, 0, 0, 0))(
+        enc_states, dl["xwk"], dl["xwv"], dl["xbv"]
+    )
+
+
+def decode_step(
+    params: Params,
+    cfg: WhisperConfig,
+    tokens: jax.Array,      # [B, 1] int32
+    position: jax.Array,    # scalar int32
+    xk: jax.Array,          # [L, B, S_enc, H, hd] from cross_kv
+    xv: jax.Array,
+    cache: DecCache,
+) -> Tuple[jax.Array, DecCache]:
+    """One decode step; returns (logits [B, vocab], cache')."""
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    B = tokens.shape[0]
+    x = jnp.take(params["tok_embed"], tokens[:, 0], axis=0).astype(dtype)
+    x = x + params["pos_embed"][position].astype(dtype)
+    x = x[:, None, :]                                     # [B, 1, D]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    S = cfg.max_target_positions
+    mask = (jnp.arange(S)[None, None, None, :] <= position)
+
+    def block(x_in, scanned):
+        lp, k_cache_l, v_cache_l, xk_l, xv_l = scanned
+        h = _ln(x_in, lp["ln1"], lp["ln1_b"])
+        q = _heads(h @ lp["wq"] + lp["bq"], cfg.num_heads)
+        k = _heads(h @ lp["wk"], cfg.num_heads)
+        v = _heads(h @ lp["wv"] + lp["bv"], cfg.num_heads)
+        new_k = lax.dynamic_update_slice(
+            k_cache_l, k, (0, position, 0, 0)
+        )
+        new_v = lax.dynamic_update_slice(
+            v_cache_l, v, (0, position, 0, 0)
+        )
+        x_mid = x_in + _mha(q, new_k, new_v, scale, mask) @ lp["wo"] + lp["bo"]
+        hx = _ln(x_mid, lp["lnx"], lp["lnx_b"])
+        xq = _heads(hx @ lp["xwq"] + lp["xbq"], cfg.num_heads)
+        x_mid = x_mid + _mha(xq, xk_l, xv_l, scale) @ lp["xwo"] + lp["xbo"]
+        h2 = _ln(x_mid, lp["ln2"], lp["ln2_b"])
+        mlp = jax.nn.gelu(h2 @ lp["w_up"] + lp["b_up"])
+        return x_mid + mlp @ lp["w_down"] + lp["b_down"], (new_k, new_v)
+
+    x, (k_new, v_new) = lax.scan(
+        block, x, (params["dec_layers"], cache.k, cache.v, xk, xv)
+    )
+    x = _ln(x, params["dec_ln"], params["dec_ln_b"])
+    logits = jnp.einsum("btd,vd->btv", x, params["tok_embed"])
+    return logits[:, 0].astype(jnp.float32), DecCache(k_new, v_new)
+
+
+def greedy_transcribe(
+    params: Params,
+    cfg: WhisperConfig,
+    mel: np.ndarray,        # [frames, n_mels]
+    prompt_ids: Tuple[int, ...] = (),
+    max_tokens: int = 0,
+) -> list:
+    """Greedy decode one utterance; returns generated token ids."""
+    max_tokens = max_tokens or cfg.max_target_positions
+    enc = jax.jit(encode, static_argnums=1)(
+        params, cfg, jnp.asarray(mel)[None]
+    )
+    xk, xv = jax.jit(cross_kv, static_argnums=1)(params, cfg, enc)
+    step = jax.jit(decode_step, static_argnums=1)
+    cache = DecCache.create(cfg, 1)
+    ids = [cfg.decoder_start_token_id, *prompt_ids]
+    out = []
+    # feed the forced prompt, then generate
+    pos = 0
+    token = ids[0]
+    for pos in range(
+        min(cfg.max_target_positions - 1, len(ids) - 1 + max_tokens)
+    ):
+        logits, cache = step(
+            params, cfg,
+            jnp.asarray([[token]], jnp.int32),
+            jnp.int32(pos),
+            xk, xv,
+            cache,
+        )
+        if pos + 1 < len(ids):
+            token = ids[pos + 1]        # forced prompt token
+            continue
+        token = int(jnp.argmax(logits[0]))
+        if token == cfg.eos_token_id:
+            break
+        out.append(token)
+    return out

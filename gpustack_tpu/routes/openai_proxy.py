@@ -96,6 +96,32 @@ async def _record_usage(
         logger.exception("failed to record usage")
 
 
+async def _resolve_target(request: web.Request, name: str):
+    """name → (model, instance, worker) or an error response.
+
+    Shared by the JSON and audio proxies: tenancy denial is a 404
+    indistinguishable from nonexistence; no instance / no worker is 503.
+    """
+    from gpustack_tpu.api.tenant import model_accessible
+
+    model = await _resolve_model(name)
+    if model is None or not await model_accessible(
+        request.get("principal"), model
+    ):
+        return None, json_error(404, f"model {name!r} not found")
+    instance = await _pick_instance(model)
+    if instance is None:
+        return None, json_error(
+            503, f"no running instances for model {name!r}"
+        )
+    worker = await Worker.get(instance.worker_id or 0)
+    if worker is None:
+        return None, json_error(
+            503, f"instance for {name!r} has no placed worker"
+        )
+    return (model, instance, worker), None
+
+
 def add_openai_routes(app: web.Application) -> None:
     async def list_models(request: web.Request):
         from gpustack_tpu.api.tenant import accessible_org_ids
@@ -148,20 +174,10 @@ def add_openai_routes(app: web.Application) -> None:
         name = body.get("model")
         if not name:
             return json_error(400, "missing 'model'")
-        model = await _resolve_model(str(name))
-        if model is None:
-            return json_error(404, f"model {name!r} not found")
-        # tenancy: an org-scoped model is invisible (404, not 403 — no
-        # name oracle) outside its org (reference api/tenant.py)
-        from gpustack_tpu.api.tenant import model_accessible
-
-        if not await model_accessible(request.get("principal"), model):
-            return json_error(404, f"model {name!r} not found")
-        instance = await _pick_instance(model)
-        if instance is None:
-            return json_error(
-                503, f"no running instances for model {name!r}"
-            )
+        target, err = await _resolve_target(request, str(name))
+        if err is not None:
+            return err
+        model, instance, worker = target
         # All data-plane traffic flows through the worker's authenticated
         # reverse proxy (or its tunnel): engines bind to 127.0.0.1 and the
         # bare engine port is never dialed (reference
@@ -169,11 +185,6 @@ def add_openai_routes(app: web.Application) -> None:
         # unauthenticated bypass of the entire auth layer).
         from gpustack_tpu.server.worker_request import worker_fetch
 
-        worker = await Worker.get(instance.worker_id or 0)
-        if worker is None:
-            return json_error(
-                503, f"instance for {name!r} has no placed worker"
-            )
         stream = bool(body.get("stream"))
         try:
             upstream = await worker_fetch(
@@ -239,7 +250,83 @@ def add_openai_routes(app: web.Application) -> None:
             )
         return resp
 
+    async def audio_proxy(request: web.Request):
+        """/v1/audio/transcriptions: multipart relay to an audio-model
+        instance (reference openai endpoint registry covers audio,
+        gateway/utils.py; served by the VoxBox-role audio engine)."""
+        import uuid as _uuid
+
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        if not request.content_type.startswith("multipart/"):
+            return json_error(400, "multipart/form-data required")
+        wav = b""
+        name = ""
+        fields = {}
+        async for part in await request.multipart():
+            if part.name == "file":
+                wav = await part.read(decode=False)
+            elif part.name == "model":
+                name = (await part.text()).strip()
+            elif part.name:
+                fields[part.name] = await part.text()
+        if not name:
+            return json_error(400, "missing 'model' form field")
+        if not wav:
+            return json_error(400, "missing 'file' form field")
+        target, err = await _resolve_target(request, name)
+        if err is not None:
+            return err
+        model, instance, worker = target
+
+        # rebuild the multipart body for the upstream hop
+        boundary = f"gpustack{_uuid.uuid4().hex}"
+        parts = [
+            (
+                f"--{boundary}\r\n"
+                'Content-Disposition: form-data; name="file"; '
+                'filename="audio.wav"\r\n'
+                "Content-Type: audio/wav\r\n\r\n"
+            ).encode()
+            + wav
+            + b"\r\n"
+        ]
+        for k, v in fields.items():
+            parts.append(
+                (
+                    f"--{boundary}\r\n"
+                    f'Content-Disposition: form-data; name="{k}"\r\n\r\n'
+                    f"{v}\r\n"
+                ).encode()
+            )
+        parts.append(f"--{boundary}--\r\n".encode())
+        try:
+            upstream = await worker_fetch(
+                app, worker, "POST",
+                f"/proxy/instances/{instance.id}/v1/audio/transcriptions",
+                raw_body=b"".join(parts),
+                content_type=(
+                    f"multipart/form-data; boundary={boundary}"
+                ),
+            )
+        except aiohttp.ClientError as e:
+            return json_error(502, f"instance unreachable: {e}")
+        payload = await upstream.read()
+        upstream.release()
+        if upstream.status == 200:
+            # usage row per transcription: token fields are zero (audio
+            # has no token accounting); request counts/metering still flow
+            await _record_usage(
+                request, model, name, "audio/transcriptions", 0, 0, False
+            )
+        return web.Response(
+            body=payload,
+            status=upstream.status,
+            content_type=upstream.content_type,
+        )
+
     app.router.add_get("/v1/models", list_models)
     app.router.add_post(
         "/v1/{op:(chat/completions|completions|embeddings)}", proxy
     )
+    app.router.add_post("/v1/audio/transcriptions", audio_proxy)

@@ -54,13 +54,28 @@ class ModelEvaluation:
         return self.weight_bytes + self.kv_cache_bytes + self.overhead_bytes
 
 
-def resolve_model_config(model: Model) -> ModelConfig:
+def resolve_model_config(model: Model):
+    from gpustack_tpu.models.whisper import (
+        WHISPER_PRESETS,
+        config_from_hf_whisper,
+    )
+
     if model.preset:
+        if model.preset in WHISPER_PRESETS:
+            return WHISPER_PRESETS[model.preset]
         if model.preset not in PRESETS:
             raise EvaluationError(f"unknown preset {model.preset!r}")
         return PRESETS[model.preset]
     if model.local_path:
         try:
+            import json as _json
+
+            with open(
+                os.path.join(model.local_path, "config.json")
+            ) as f:
+                raw = _json.load(f)
+            if raw.get("model_type") == "whisper":
+                return config_from_hf_whisper(raw, name=model.name)
             return load_hf_config(model.local_path)
         except (OSError, KeyError, ValueError) as e:
             raise EvaluationError(
@@ -119,10 +134,11 @@ def evaluate_model(model: Model) -> ModelEvaluation:
         cfg.kv_cache_bytes_per_token(16) * model.max_seq_len * model.max_slots
     )
     # activation + runtime overhead: prefill attention scratch dominates;
-    # scale with seq len, floor at 256 MiB
+    # scale with seq len, floor at 256 MiB (audio configs use d_model)
+    hidden = getattr(cfg, "hidden_size", 0) or cfg.d_model
     overhead = max(
         256 * 2**20,
-        int(2 * model.max_seq_len * cfg.hidden_size * 4 * 8),
+        int(2 * model.max_seq_len * hidden * 4 * 8),
     )
     return ModelEvaluation(
         config=cfg,

@@ -112,12 +112,26 @@ class Scheduler:
                         ModelInstanceState.ANALYZING.value
                     ):
                         continue
-                    await self._schedule_one(event.id)
+                    await self._schedule_one_logged(event.id)
             except asyncio.CancelledError:
                 await agen.aclose()
                 raise
             finally:
                 await agen.aclose()
+
+    async def _schedule_one_logged(self, instance_id: int) -> None:
+        """A placement bug must mark ONE instance ERROR — never kill the
+        watch task silently (which would freeze all future scheduling)."""
+        try:
+            await self._schedule_one(instance_id)
+        except Exception as e:
+            logger.exception("scheduling instance %d failed", instance_id)
+            inst = await ModelInstance.get(instance_id)
+            if inst is not None:
+                await inst.update(
+                    state=ModelInstanceState.ERROR,
+                    state_message=f"scheduler error: {e}",
+                )
 
     async def _periodic_scan(self) -> None:
         while True:
@@ -133,7 +147,7 @@ class Scheduler:
         now = datetime.datetime.now(datetime.timezone.utc)
         for inst in await ModelInstance.all():
             if inst.state == ModelInstanceState.PENDING:
-                await self._schedule_one(inst.id)
+                await self._schedule_one_logged(inst.id)
             elif inst.state in (
                 ModelInstanceState.ANALYZING,
                 ModelInstanceState.SCHEDULED,

@@ -44,7 +44,11 @@ def create_app(cfg: Config) -> web.Application:
     app["config"] = cfg
 
     async def healthz(request):
-        return web.json_response({"status": "ok"})
+        payload = {"status": "ok"}
+        coordinator = app.get("coordinator")
+        if coordinator is not None:
+            payload["leader"] = coordinator.is_leader
+        return web.json_response(payload)
 
     async def readyz(request):
         return web.json_response({"status": "ready"})

@@ -55,6 +55,28 @@ CATALOG: List[Dict[str, Any]] = [
             "chips": {"v5e": 4, "v5p": 1},
         },
     },
+    {
+        "name": "Whisper-Large-v3",
+        "preset": "whisper-large-v3",
+        "huggingface_repo_id": "openai/whisper-large-v3",
+        "categories": ["audio", "speech-to-text"],
+        "sizes": {"parameters_b": 1.5},
+        "suggested": {
+            "max_seq_len": 448,
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
+    {
+        "name": "Whisper-Small",
+        "preset": "whisper-small",
+        "huggingface_repo_id": "openai/whisper-small",
+        "categories": ["audio", "speech-to-text"],
+        "sizes": {"parameters_b": 0.24},
+        "suggested": {
+            "max_seq_len": 448,
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
 ]
 
 

@@ -94,12 +94,18 @@ class LeaseCoordinator(Coordinator):
     can't split-brain, server/server.py:1296-1304).
     """
 
-    def __init__(self, db, identity: str = "", ttl: float = 15.0, bus=None):
+    def __init__(
+        self, db, identity: str = "", ttl: float = 0.0, bus=None
+    ):
         import secrets
         import socket
 
         self.db = db
         self.bus = bus
+        if not ttl:
+            # operational knob (reference envs/__init__.py pattern);
+            # e2e failover tests shrink it to keep wall-clock sane
+            ttl = float(os.environ.get("GPUSTACK_TPU_HA_TTL", "15"))
         # hostname + random suffix: pids collide across containers (every
         # process is pid 1), which would let a stale leader renew against
         # its successor's row and split-brain

@@ -111,6 +111,7 @@ class Server:
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
+        app["coordinator"] = self.coordinator
 
         await site.start()
         logger.info("server listening on %s:%d", cfg.host, cfg.port)

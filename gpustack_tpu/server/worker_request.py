@@ -48,6 +48,8 @@ async def worker_fetch(
     path: str,
     *,
     json_body: Optional[Dict[str, Any]] = None,
+    raw_body: bytes = b"",
+    content_type: str = "",
     timeout: float = 600.0,
 ):
     """Send an authenticated request to a worker; returns a response
@@ -64,6 +66,10 @@ async def worker_fetch(
     if json_body is not None:
         body = jsonlib.dumps(json_body).encode()
         headers["Content-Type"] = "application/json"
+    elif raw_body:
+        body = raw_body
+        if content_type:
+            headers["Content-Type"] = content_type
 
     hub = app.get("tunnel_hub")
     session = hub.get(worker.id) if hub else None

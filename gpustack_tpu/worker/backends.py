@@ -60,6 +60,28 @@ def build_command(
     return _render(vcfg, model, instance, port)
 
 
+def _is_audio_model(model: Model) -> bool:
+    """Key off the RESOLVED architecture, matching the scheduler's
+    detection (calculator.resolve_model_config) — a local-path whisper
+    checkpoint without a user-supplied 'audio' category must still launch
+    the audio engine, not crash-loop under the LLM server."""
+    from gpustack_tpu.models.whisper import WHISPER_PRESETS
+
+    if "audio" in model.categories or model.preset in WHISPER_PRESETS:
+        return True
+    if model.local_path:
+        import json as _json
+
+        try:
+            with open(
+                os.path.join(model.local_path, "config.json")
+            ) as f:
+                return _json.load(f).get("model_type") == "whisper"
+        except (OSError, ValueError):
+            return False
+    return False
+
+
 def _tpu_native_command(
     model: Model,
     instance: ModelInstance,
@@ -68,8 +90,13 @@ def _tpu_native_command(
     process_index: int = 0,
     chip_indexes: Optional[List[int]] = None,
 ) -> Tuple[List[str], Dict[str, str]]:
+    module = (
+        "gpustack_tpu.engine.audio_server"
+        if _is_audio_model(model)
+        else "gpustack_tpu.engine.api_server"
+    )
     argv = [
-        sys.executable, "-m", "gpustack_tpu.engine.api_server",
+        sys.executable, "-m", module,
         # loopback only: the engine HTTP port carries no auth; all ingress
         # goes through the worker's authenticated reverse proxy
         # (worker/server.py instance_proxy)

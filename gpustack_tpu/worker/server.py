@@ -28,7 +28,13 @@ class WorkerServer:
 
     def __init__(self, agent) -> None:
         self.agent = agent
-        self.app = web.Application(middlewares=[self._auth_middleware])
+        # body cap must dominate the hops it relays for (server app: 64
+        # MiB, audio engine: 256 MiB) — the default 1 MiB would 413 every
+        # real audio upload at this middle hop
+        self.app = web.Application(
+            middlewares=[self._auth_middleware],
+            client_max_size=256 * 2**20,
+        )
         self.app.add_routes(
             [
                 web.get("/healthz", self.healthz),

@@ -1,0 +1,116 @@
+"""Audio transcription API server over the tiny whisper model."""
+
+import asyncio
+import io
+import wave
+
+import numpy as np
+import pytest
+
+
+def _wav_bytes(seconds=0.3):
+    rate = 16000
+    t = np.arange(int(seconds * rate)) / rate
+    x = (np.sin(2 * np.pi * 330 * t) * 0.4 * 32767).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as wf:
+        wf.setnchannels(1)
+        wf.setsampwidth(2)
+        wf.setframerate(rate)
+        wf.writeframes(x.tobytes())
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from gpustack_tpu.models.whisper import (
+        WHISPER_PRESETS,
+        init_whisper_params,
+    )
+
+    cfg = WHISPER_PRESETS["tiny-whisper"]
+    return cfg, init_whisper_params(cfg, jax.random.key(0))
+
+
+def _run(model, coro_fn):
+    """aiohttp apps bind to one loop — build the server inside each
+    test's asyncio.run loop, sharing only cfg+params across tests."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.engine.audio_server import AudioEngine, AudioServer
+
+    cfg, params = model
+
+    async def run():
+        server = AudioServer(
+            AudioEngine(cfg, params), model_name="tiny-audio"
+        )
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_transcription_roundtrip(model):
+    import aiohttp
+
+    async def go(client):
+        form = aiohttp.FormData()
+        form.add_field(
+            "file", _wav_bytes(), filename="a.wav",
+            content_type="audio/wav",
+        )
+        form.add_field("model", "tiny-audio")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "audio.transcription"
+        assert data["model"] == "tiny-audio"
+        assert isinstance(data["text"], str)
+        assert data["duration_s"] > 0
+
+        # text response format
+        form = aiohttp.FormData()
+        form.add_field("file", _wav_bytes(), filename="a.wav")
+        form.add_field("response_format", "text")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 200
+        assert (r.headers["Content-Type"]).startswith("text/")
+
+        # health + metrics
+        r = await client.get("/healthz")
+        data = await r.json()
+        assert data["modality"] == "audio" and data["requests"] == 2
+        r = await client.get("/metrics")
+        assert "gpustack_tpu_audio_requests_total 2" in await r.text()
+
+    _run(model, go)
+
+
+def test_transcription_rejects_bad_input(model):
+    import aiohttp
+
+    async def go(client):
+        r = await client.post(
+            "/v1/audio/transcriptions", json={"nope": 1}
+        )
+        assert r.status == 400
+        form = aiohttp.FormData()
+        form.add_field("model", "tiny-audio")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+        form = aiohttp.FormData()
+        form.add_field(
+            "file", b"not-a-wav", filename="a.wav",
+            content_type="audio/wav",
+        )
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+
+    _run(model, go)

@@ -1,0 +1,136 @@
+"""Whisper-class audio model: features, encoder/decoder, greedy decode.
+
+Hermetic (tiny-whisper preset, random weights, synthetic audio) — same
+doctrine as the LM tests. The reference serves audio via VoxBox
+(worker/backends/vox_box.py:23); this is our in-repo replacement.
+"""
+
+import io
+import wave
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpustack_tpu.models.audio import (
+    SAMPLE_RATE,
+    decode_wav,
+    features_for_model,
+    log_mel,
+    mel_filterbank,
+)
+from gpustack_tpu.models.whisper import (
+    WHISPER_PRESETS,
+    DecCache,
+    config_from_hf_whisper,
+    cross_kv,
+    decode_step,
+    encode,
+    greedy_transcribe,
+    init_whisper_params,
+)
+
+
+def _wav_bytes(seconds=0.5, freq=440.0, rate=SAMPLE_RATE, width=2):
+    t = np.arange(int(seconds * rate)) / rate
+    x = (np.sin(2 * np.pi * freq * t) * 0.5 * 32767).astype(np.int16)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as wf:
+        wf.setnchannels(1)
+        wf.setsampwidth(width)
+        wf.setframerate(rate)
+        wf.writeframes(x.tobytes())
+    return buf.getvalue()
+
+
+def test_wav_decode_and_resample():
+    audio = decode_wav(_wav_bytes())
+    assert audio.dtype == np.float32
+    assert abs(len(audio) - SAMPLE_RATE // 2) < 10
+    assert np.abs(audio).max() <= 1.0
+    # 8 kHz input resamples up to 16 kHz
+    audio8 = decode_wav(_wav_bytes(rate=8000))
+    assert abs(len(audio8) - SAMPLE_RATE // 2) < 10
+
+
+def test_log_mel_shape_and_range():
+    audio = decode_wav(_wav_bytes(seconds=1.0))
+    mel = log_mel(audio, n_mels=16, chunk_seconds=2)
+    assert mel.shape[1] == 16
+    assert np.isfinite(mel).all()
+    fb = mel_filterbank(16)
+    assert fb.shape == (16, 201)
+    assert (fb >= 0).all()
+
+
+def test_encoder_shapes():
+    cfg = WHISPER_PRESETS["tiny-whisper"]
+    params = init_whisper_params(cfg, jax.random.key(0))
+    audio = decode_wav(_wav_bytes())
+    mel = features_for_model(audio, cfg)
+    assert mel.shape == (cfg.max_source_positions * 2, cfg.num_mel_bins)
+    enc = encode(params, cfg, jnp.asarray(mel)[None])
+    assert enc.shape == (1, cfg.max_source_positions, cfg.d_model)
+    assert jnp.isfinite(enc.astype(jnp.float32)).all()
+
+
+def test_greedy_transcribe_deterministic():
+    cfg = WHISPER_PRESETS["tiny-whisper"]
+    params = init_whisper_params(cfg, jax.random.key(0))
+    audio = decode_wav(_wav_bytes())
+    mel = features_for_model(audio, cfg)
+    a = greedy_transcribe(params, cfg, mel, max_tokens=8)
+    b = greedy_transcribe(params, cfg, mel, max_tokens=8)
+    assert a == b
+    assert len(a) <= 8
+    assert all(0 <= t < cfg.vocab_size for t in a)
+
+
+def test_decode_step_cache_is_consistent():
+    """Two steps through the cache == positions 0,1 of a causal decode."""
+    cfg = WHISPER_PRESETS["tiny-whisper"]
+    params = init_whisper_params(cfg, jax.random.key(1))
+    enc = jnp.zeros((1, cfg.max_source_positions, cfg.d_model), jnp.bfloat16)
+    xk, xv = cross_kv(params, cfg, enc)
+    cache = DecCache.create(cfg, 1)
+    l0, cache = decode_step(
+        params, cfg, jnp.asarray([[5]], jnp.int32), jnp.int32(0), xk, xv,
+        cache,
+    )
+    l1, cache = decode_step(
+        params, cfg, jnp.asarray([[7]], jnp.int32), jnp.int32(1), xk, xv,
+        cache,
+    )
+    assert l0.shape == (1, cfg.vocab_size)
+    assert not jnp.allclose(l0, l1)  # position/token actually matter
+    # replay with a fresh cache must be bit-identical
+    cache2 = DecCache.create(cfg, 1)
+    m0, cache2 = decode_step(
+        params, cfg, jnp.asarray([[5]], jnp.int32), jnp.int32(0), xk, xv,
+        cache2,
+    )
+    m1, _ = decode_step(
+        params, cfg, jnp.asarray([[7]], jnp.int32), jnp.int32(1), xk, xv,
+        cache2,
+    )
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(m1))
+
+
+def test_hf_config_mapping():
+    cfg = config_from_hf_whisper(
+        {
+            "vocab_size": 51866,
+            "num_mel_bins": 128,
+            "d_model": 1280,
+            "encoder_layers": 32,
+            "decoder_layers": 32,
+            "encoder_attention_heads": 20,
+            "max_source_positions": 1500,
+        },
+        name="large-v3",
+    )
+    assert cfg.d_model == 1280 and cfg.num_mel_bins == 128
+    assert cfg.head_dim == 64
+    # calculator surface
+    assert cfg.num_kv_heads == 1 and cfg.num_experts == 0
+    assert cfg.weight_bytes(16) > 10**9  # ~1.5B params in bf16
