@@ -371,6 +371,18 @@ def build_engine_from_args(args) -> LLMEngine:
     if args.quantization == "int8":
         params = quantize_params(params)
 
+    draft_cfg = draft_params = None
+    if args.speculative == "draft":
+        source = getattr(args, "draft_source", "")
+        if not source:
+            raise ValueError("--speculative draft needs --draft-source")
+        if os.path.isdir(source):
+            draft_cfg = load_hf_config(source)
+            draft_params = load_or_init_params(draft_cfg, source, seed=0)
+        else:
+            draft_cfg = get_config(source)
+            draft_params = load_or_init_params(draft_cfg, None, seed=0)
+
     return LLMEngine(
         cfg,
         params,
@@ -380,6 +392,8 @@ def build_engine_from_args(args) -> LLMEngine:
         plan=plan,
         speculative=args.speculative,
         spec_tokens=args.spec_tokens,
+        draft_cfg=draft_cfg,
+        draft_params=draft_params,
     )
 
 
@@ -393,8 +407,15 @@ def main(argv=None) -> None:
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--quantization", choices=["", "int8"], default="")
-    p.add_argument("--speculative", choices=["", "ngram"], default="")
+    p.add_argument(
+        "--speculative", choices=["", "ngram", "draft"], default=""
+    )
     p.add_argument("--spec-tokens", type=int, default=4)
+    p.add_argument(
+        "--draft-source", default="",
+        help="draft model for speculative=draft: preset name or local "
+        "checkpoint dir",
+    )
     p.add_argument("--mesh-plan", default="", help="e.g. dp1xsp1xep1xtp4")
     p.add_argument("--num-devices", type=int, default=0)
     args = p.parse_args(argv)

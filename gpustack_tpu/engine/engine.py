@@ -121,6 +121,8 @@ class GenRequest:
 class _SlotInfo:
     request: GenRequest
     ngram: Optional["_NgramIndex"] = None
+    # draft mode: delivered tokens not yet ingested into the draft cache
+    pending_draft: List[int] = dataclasses.field(default_factory=list)
     # Incremental detokenization state: undecoded token ids are buffered
     # until they decode cleanly (no dangling multibyte sequence), then the
     # text accumulates here — the tokenizer only ever decodes the small
@@ -145,8 +147,10 @@ class LLMEngine:
         plan=None,
         mesh=None,
         seed: int = 0,
-        speculative: str = "",       # "" | "ngram" (forces greedy decode)
+        speculative: str = "",       # ""|"ngram"|"draft" (forces greedy)
         spec_tokens: int = 4,        # proposals verified per spec step
+        draft_cfg=None,              # draft model config (speculative=draft)
+        draft_params=None,
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -171,6 +175,25 @@ class LLMEngine:
         self.spec_tokens = max(2, spec_tokens)
         self._spec_hits = 0
         self._spec_steps = 0
+        self._spec_proposed = 0   # slots x (spec_tokens-1) across steps
+        # Draft-model speculation (EAGLE-class role; reference surfaces
+        # EAGLE3/MTP/ngram as vLLM args, worker/backends/vllm.py:531): a
+        # small proposer model runs its own slot-aligned DecodeState;
+        # delivered tokens are block-ingested into its cache (catch-up),
+        # it proposes spec_tokens-1 greedy continuations, and the target
+        # verifies — output is bit-identical to plain greedy decode.
+        self.draft_runner = None
+        self._draft_state = None
+        if speculative == "draft":
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "speculative='draft' needs draft_cfg/draft_params"
+                )
+            self.draft_runner = ModelRunner(
+                draft_cfg, draft_params,
+                max_slots=max_slots, max_seq_len=max_seq_len,
+            )
+            self._draft_state = self.draft_runner.new_state()
 
     # ---- public API -----------------------------------------------------
 
@@ -245,6 +268,14 @@ class LLMEngine:
             "speculative": self.speculative,
             "spec_steps": self._spec_steps,
             "spec_extra_tokens": self._spec_hits,
+            # accepted proposals / proposals made (1.0 = every proposal
+            # of every slot accepted)
+            "spec_acceptance_rate": round(
+                self._spec_hits / max(1, self._spec_proposed), 4
+            ),
+            "draft_model": (
+                self.draft_runner.cfg.name if self.draft_runner else ""
+            ),
         }
 
     # ---- scheduling loop ------------------------------------------------
@@ -313,22 +344,54 @@ class LLMEngine:
         info = _SlotInfo(request=req)
         if self.speculative == "ngram":
             info.ngram = _NgramIndex(req.prompt_ids)
+        elif self.draft_runner is not None:
+            # mirror the slot on the draft: prefill + insert (greedy)
+            dk_bucket = self.draft_runner.bucket_for(max(1, len(ids)))
+            d_padded = list(ids) + [0] * (dk_bucket - len(ids))
+            _, dk, dv = self.draft_runner.prefill(d_padded, len(ids))
+            self._draft_state = self.draft_runner.insert(
+                self._draft_state, dk, dv, slot, len(ids), first,
+                0.0, 0, 1.0,
+            )
         self._slots[slot] = info
         self._deliver(slot, info, [first])
+        if self.draft_runner is not None and slot in self._slots:
+            # `first` is already the draft's pending last token (set at
+            # insert); queueing it again would double-feed it
+            self._slots[slot].pending_draft.clear()
 
     def _decode_once(self) -> None:
+        if self.draft_runner is not None and self._spec_safe():
+            # Drain the fetch pipeline first: a draft chain must continue
+            # the target's ACTUAL last token — proposing from a lagged
+            # context misaligns the whole chain and collapses acceptance
+            # (the ngram proposer tolerates lag; a sequential draft does
+            # not). One host sync per spec step, amortized over up to
+            # spec_tokens generated tokens.
+            self._drain_pending()
         # Snapshot slot ownership at dispatch time: by the time this step's
         # tokens are fetched (lagged), a slot may have been retired and
         # re-used — the request_id check drops such stale tokens.
         owners = {
             s: info.request.request_id for s, info in self._slots.items()
         }
+        if not owners:
+            return
         if self.speculative == "ngram" and self._spec_safe():
             proposals = self._build_proposals()
             self._state, tokens, produced = self.runner.verify_step(
                 self._state, proposals
             )
             self._spec_steps += 1
+            self._spec_proposed += len(owners) * (self.spec_tokens - 1)
+            self._pending.append(((tokens, produced), owners))
+        elif self.draft_runner is not None and self._spec_safe():
+            proposals = self._draft_propose()
+            self._state, tokens, produced = self.runner.verify_step(
+                self._state, proposals
+            )
+            self._spec_steps += 1
+            self._spec_proposed += len(owners) * (self.spec_tokens - 1)
             self._pending.append(((tokens, produced), owners))
         else:
             self._key, step_key = jax.random.split(self._key)
@@ -366,6 +429,55 @@ class LLMEngine:
                 proposals[slot, : len(prop)] = prop
         return proposals
 
+    def _draft_propose(self) -> np.ndarray:
+        """Draft-model proposals [B, spec_tokens].
+
+        1. catch-up: block-ingest each slot's delivered-but-uningested
+           tokens into the draft cache (one jitted forward),
+        2. propose: spec_tokens-1 greedy draft decode steps,
+        3. rewind: restore the draft's positions/last_tokens — the
+           speculative cache entries sit above the restored positions and
+           are invisible until genuinely accepted tokens overwrite them.
+
+        The draft sees the host's (fetch-lagged) view of each sequence —
+        like the ngram proposer, this affects acceptance rate only; the
+        target's verify step guarantees greedy-exact output.
+        """
+        P = self.spec_tokens
+        ingest_width = max(
+            (len(i.pending_draft) for i in self._slots.values()),
+            default=0,
+        )
+        if ingest_width:
+            # bound jit specializations: pad the block to the next power
+            # of two, ingest at most 2P per step (leftover stays queued)
+            ingest_width = min(ingest_width, 2 * P)
+            width = 1
+            while width < ingest_width:
+                width *= 2
+            block = np.zeros((self.max_slots, width), np.int32)
+            counts = np.zeros((self.max_slots,), np.int32)
+            for slot, info in self._slots.items():
+                take = info.pending_draft[:width]
+                info.pending_draft = info.pending_draft[len(take):]
+                block[slot, : len(take)] = take
+                counts[slot] = len(take)
+            self._draft_state = self.draft_runner.ingest_step(
+                self._draft_state, block, counts
+            )
+        snap = self.draft_runner.snapshot_sequence(self._draft_state)
+        proposals = np.zeros((self.max_slots, P), np.int32)
+        key = jax.random.key(0)  # draft sampling is greedy; key unused
+        for j in range(P - 1):
+            self._draft_state, sampled = self.draft_runner.decode_step(
+                self._draft_state, key
+            )
+            proposals[:, j] = np.asarray(sampled)
+        self._draft_state = self.draft_runner.restore_sequence(
+            self._draft_state, snap
+        )
+        return proposals
+
     def _drain_pending(self) -> None:
         while self._pending:
             self._process_fetch(*self._pending.pop(0))
@@ -400,6 +512,8 @@ class LLMEngine:
                 info.buffer_ids.append(tok)
                 if info.ngram is not None:
                     info.ngram.append(tok)
+                if self.draft_runner is not None:
+                    info.pending_draft.append(tok)
                 if self._emit_text(info, final=False):
                     self._finish(slot, info, "stop")
                     return
@@ -461,6 +575,10 @@ class LLMEngine:
         req.output_text = info.text
         req.finished_at = time.time()
         self._state = self.runner.deactivate(self._state, slot)
+        if self.draft_runner is not None:
+            self._draft_state = self.draft_runner.deactivate(
+                self._draft_state, slot
+            )
         del self._slots[slot]
         self._free.append(slot)
         if req.stream is not None:

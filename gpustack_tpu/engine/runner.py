@@ -141,6 +141,7 @@ class ModelRunner:
         self._inserts: Dict[int, Any] = {}
         self._embeds: Dict[int, Any] = {}
         self._verifies: Dict[int, Any] = {}
+        self._ingests: Dict[int, Any] = {}
 
     # -- state ------------------------------------------------------------
 
@@ -339,6 +340,76 @@ class ModelRunner:
 
     def decode_step(self, state: DecodeState, key) -> Tuple[DecodeState, jax.Array]:
         return self._decode(self.params, state, key)
+
+    # -- draft-model support ---------------------------------------------
+
+    def _ingest_impl(self, params, state, tokens, counts):
+        """Ingest already-accepted tokens into the cache (draft-model
+        catch-up). State invariant matches decode/verify: ``(pos, last)``
+        with KV complete below ``pos`` and ``last`` not yet fed — so the
+        block fed is ``[last, tokens[0..P-2]]`` (the verify feeding
+        pattern), after which ``pos += counts`` and ``last`` becomes each
+        row's final ingested token. Rows with count 0 keep (pos, last);
+        pad positions land above the new position and stay invisible
+        through the causal mask until genuinely overwritten.
+        """
+        B, P = tokens.shape
+        fed = jnp.concatenate(
+            [state.last_tokens[:, None], tokens[:, : P - 1]], axis=1
+        )
+        positions = (
+            state.positions[:, None]
+            + jnp.arange(P, dtype=jnp.int32)[None, :]
+        )
+        _, cache = forward(
+            params, self.cfg, fed, positions, state.cache,
+            attn_impl="ring" if self.sp_mode else "xla",
+            mesh=self.mesh if self.sp_mode else None,
+        )
+        has_any = counts > 0
+        last_idx = jnp.maximum(counts - 1, 0)
+        new_last = jnp.take_along_axis(
+            tokens, last_idx[:, None], axis=1
+        )[:, 0]
+        return DecodeState(
+            cache=cache,
+            last_tokens=jnp.where(has_any, new_last, state.last_tokens),
+            positions=jnp.minimum(
+                state.positions + counts, self.max_seq_len - 1
+            ),
+            active=state.active,
+            sampling=state.sampling,
+        )
+
+    def ingest_step(self, state: DecodeState, tokens, counts) -> DecodeState:
+        """tokens [B, P] int32 (pad arbitrary), counts [B] int32."""
+        import numpy as np
+
+        P = np.asarray(tokens).shape[1]
+        fn = self._ingests.get(P)
+        if fn is None:
+            fn = jax.jit(self._ingest_impl, donate_argnums=(1,))
+            self._ingests[P] = fn
+        return fn(
+            self.params,
+            state,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(counts, jnp.int32),
+        )
+
+    def snapshot_sequence(self, state: DecodeState):
+        """(positions, last_tokens) device snapshot — restore after a
+        speculative proposal run to rewind the draft's sequence state
+        (cache entries above the restored positions are masked out).
+        COPIES: the decode steps in between donate the state, which would
+        invalidate aliased buffers."""
+        return jnp.array(state.positions), jnp.array(state.last_tokens)
+
+    def restore_sequence(self, state: DecodeState, snap) -> DecodeState:
+        positions, last_tokens = snap
+        return dataclasses.replace(
+            state, positions=positions, last_tokens=last_tokens
+        )
 
     # -- speculative decoding (greedy n-gram verify) ----------------------
 

@@ -67,8 +67,11 @@ class Model(Record):
     max_seq_len: int = 2048
     max_slots: int = 8                # continuous-batch width per replica
     quantization: str = ""            # "" | "int8"
-    speculative: str = ""             # "" | "ngram" (greedy-only mode)
+    speculative: str = ""             # "" | "ngram" | "draft" (greedy-only)
     spec_tokens: int = 4
+    # draft-model speculation (EAGLE-class role, reference vllm.py:531):
+    # preset name or local checkpoint dir of the small proposer model
+    draft_source: str = ""
     restart_on_error: bool = True
     distributable: bool = True        # allow multi-host placement
 

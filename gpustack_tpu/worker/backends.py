@@ -122,10 +122,20 @@ def _tpu_native_command(
     if model.quantization:
         argv += ["--quantization", model.quantization]
     if model.speculative:
+        if model.speculative == "draft" and not model.draft_source:
+            # fail fast at command build — an engine that dies at startup
+            # would crash-loop under restart_on_error with the cause
+            # buried in instance logs
+            raise ValueError(
+                "speculative='draft' requires draft_source "
+                "(preset name or local checkpoint dir)"
+            )
         argv += [
             "--speculative", model.speculative,
             "--spec-tokens", str(model.spec_tokens),
         ]
+        if model.draft_source:
+            argv += ["--draft-source", model.draft_source]
     argv += model.backend_parameters
 
     env: Dict[str, str] = dict(model.env)

@@ -80,3 +80,57 @@ def test_speculative_matches_plain_greedy(shared):
     assert health["spec_steps"] > 0
     # tiny random models often repeat, so proposals should land sometimes
     assert health["spec_extra_tokens"] >= 0
+
+
+def _run_draft(cfg, params, draft_cfg, draft_params, prompts, n):
+    eng = LLMEngine(
+        cfg, params, max_slots=4, max_seq_len=256,
+        speculative="draft", spec_tokens=4,
+        draft_cfg=draft_cfg, draft_params=draft_params,
+    )
+    eng.start()
+    try:
+        reqs = [
+            eng.submit(
+                GenRequest(prompt_ids=p, max_tokens=n, temperature=0.0)
+            )
+            for p in prompts
+        ]
+        for r in reqs:
+            assert r.done.wait(180), r.request_id
+        return [r.output_ids for r in reqs], eng.health()
+    finally:
+        eng.stop()
+
+
+def test_draft_speculative_matches_plain_greedy(shared):
+    """Draft-model speculation (EAGLE-class role) must be bit-identical
+    to plain greedy, regardless of the draft's quality."""
+    cfg, params = shared
+    # a DIFFERENT random model as draft: proposals mostly rejected —
+    # correctness must not depend on acceptance
+    draft_params = init_params(cfg, jax.random.key(42))
+    prompts = [
+        [5, 6, 7, 5, 6, 7, 5, 6],
+        [1, 2, 3, 4, 5, 6],
+        [9, 9, 9, 9],
+    ]
+    plain, _ = _run(cfg, params, "", prompts, 20)
+    spec, health = _run_draft(
+        cfg, params, cfg, draft_params, prompts, 20
+    )
+    assert spec == plain
+    assert health["spec_steps"] > 0
+    assert health["draft_model"] == cfg.name
+    assert 0.0 <= health["spec_acceptance_rate"] <= 1.0
+
+
+def test_perfect_draft_accepts_everything(shared):
+    """Draft == target: every proposal chain verifies, acceptance ~1."""
+    cfg, params = shared
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8]]
+    plain, _ = _run(cfg, params, "", prompts, 24)
+    spec, health = _run_draft(cfg, params, cfg, params, prompts, 24)
+    assert spec == plain
+    # the draft IS the target: after warmup nearly all proposals land
+    assert health["spec_acceptance_rate"] > 0.5, health
