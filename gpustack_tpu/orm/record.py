@@ -221,6 +221,21 @@ class Record(pydantic.BaseModel):
         return await cls.filter()
 
     @classmethod
+    async def filter_created_before(
+        cls: Type[T], cutoff_iso: str, limit: Optional[int] = None
+    ) -> List[T]:
+        """Rows with created_at < cutoff — an indexed-range SQL query
+        (archival sweeps must not materialize the whole hot table)."""
+        sql = (
+            f"SELECT * FROM {cls.__kind__} WHERE created_at < ? "
+            f"ORDER BY id"
+        )
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = await cls.db().execute(sql, [cutoff_iso])
+        return [cls._from_row(r) for r in rows]
+
+    @classmethod
     async def first(cls: Type[T], **conds: Any) -> Optional[T]:
         items = await cls.filter(limit=1, **conds)
         return items[0] if items else None
@@ -257,14 +272,18 @@ class Record(pydantic.BaseModel):
         cls = type(self)
         idx_sets = "".join(f", {f} = ?" for f in cls.__indexes__)
         data = self.model_dump_json(exclude={"id"})
+        # created_at is both a document field and a real SQL column (range
+        # queries index it); keep the column in sync on every save
         params = (
-            [data, self.updated_at] + self._index_values() + [self.id]
+            [data, self.updated_at, self.created_at]
+            + self._index_values()
+            + [self.id]
         )
 
         def go(conn):
             cur = conn.execute(
-                f"UPDATE {cls.__kind__} SET data = ?, updated_at = ?"
-                f"{idx_sets} WHERE id = ?",
+                f"UPDATE {cls.__kind__} SET data = ?, updated_at = ?, "
+                f"created_at = ?{idx_sets} WHERE id = ?",
                 params,
             )
             conn.commit()

@@ -210,12 +210,18 @@ def add_worker_facing_routes(app: web.Application) -> None:
             status = WorkerStatus.model_validate(body.get("status") or {})
         except pydantic.ValidationError as e:
             return json_error(400, f"invalid worker status: {e}")
-        await worker.update(
-            status=status,
-            state=WorkerState.READY,
-            state_message="",
-            heartbeat_at=auth_mod.time_iso_now(),
-        )
+        buffer = request.app.get("status_buffer")
+        if buffer is not None:
+            # batched DB writes (reference worker_status_buffer.py);
+            # state transitions flush through immediately
+            await buffer.put(worker, status, auth_mod.time_iso_now())
+        else:
+            await worker.update(
+                status=status,
+                state=WorkerState.READY,
+                state_message="",
+                heartbeat_at=auth_mod.time_iso_now(),
+            )
         return web.json_response({"ok": True})
 
     async def heartbeat(request: web.Request):

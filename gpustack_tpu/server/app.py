@@ -81,19 +81,43 @@ def create_app(cfg: Config) -> web.Application:
         if worker is None:
             return json_error(409, "instance is not placed on a worker")
         tail = request.query.get("tail", "200")
+        follow = request.query.get("follow") in ("1", "true")
+        path = f"/v2/instances/{inst.id}/logs?tail={tail}"
+        if follow:
+            path += "&follow=1"
         try:
             resp = await worker_fetch(
-                app, worker, "GET",
-                f"/v2/instances/{inst.id}/logs?tail={tail}",
-                timeout=10,
-            )
-            body = await resp.read()
-            resp.release()
-            return web.Response(
-                text=body.decode(errors="replace"), status=resp.status
+                app, worker, "GET", path,
+                timeout=3600 if follow else 10,
             )
         except aiohttp.ClientError as e:
             return json_error(502, f"worker unreachable: {e}")
+        if not follow:
+            try:
+                body = await resp.read()
+            except aiohttp.ClientError as e:
+                return json_error(502, f"worker unreachable: {e}")
+            finally:
+                resp.release()
+            return web.Response(
+                text=body.decode(errors="replace"), status=resp.status
+            )
+        out = web.StreamResponse(
+            status=resp.status,
+            headers={
+                "Content-Type": "text/plain; charset=utf-8",
+                "Cache-Control": "no-cache",
+            },
+        )
+        await out.prepare(request)
+        try:
+            async for chunk in resp.content.iter_any():
+                await out.write(chunk)
+        except (ConnectionResetError, aiohttp.ClientError):
+            pass
+        finally:
+            resp.release()
+        return out
 
     app.router.add_get("/v2/model-instances/{id:\\d+}/logs", instance_logs)
 

@@ -1,0 +1,223 @@
+"""Server-side collectors: worker-status write buffering + usage archival.
+
+Reference parity:
+- ``WorkerStatusBuffer`` — server/worker_status_buffer.py: status POSTs
+  land in memory and a single flush loop batches them to the DB (direct
+  per-POST writes are fine at 3 workers, not at 300). State TRANSITIONS
+  (NOT_READY→READY) flush immediately so deploys stay snappy; steady-state
+  refreshes batch.
+- ``UsageArchiver`` — server/usage_archiver.py + TableArchiver: hot
+  ``model_usage`` rows older than the retention window aggregate into
+  daily ``usage_archive`` rows and are deleted (hot→cold archival keeps
+  the request-rate table bounded).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from gpustack_tpu.orm.record import Record, register_record
+from gpustack_tpu.schemas import Worker, WorkerState
+from gpustack_tpu.schemas.usage import ModelUsage
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerStatusBuffer:
+    def __init__(self, flush_interval: float = 2.0):
+        self.flush_interval = flush_interval
+        # worker_id -> (status, heartbeat_at)
+        self._pending: Dict[int, Tuple[object, str]] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    async def put(self, worker: Worker, status, heartbeat_at: str) -> None:
+        """Buffer a status refresh; flush immediately on a state
+        transition (a worker coming READY unblocks scheduling)."""
+        if worker.state != WorkerState.READY:
+            await worker.update(
+                status=status,
+                state=WorkerState.READY,
+                state_message="",
+                heartbeat_at=heartbeat_at,
+            )
+            self._pending.pop(worker.id, None)
+            return
+        self._pending[worker.id] = (status, heartbeat_at)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._loop(), name="status-buffer"
+            )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.flush_interval)
+            try:
+                await self.flush()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("status buffer flush failed")
+
+    async def flush(self) -> int:
+        pending, self._pending = self._pending, {}
+        flushed = 0
+        for worker_id, (status, heartbeat_at) in pending.items():
+            worker = await Worker.get(worker_id)
+            if worker is None:
+                continue
+            # guard against the snapshot race: a write-through update
+            # (state transition) or a newer heartbeat may have landed
+            # after this entry was buffered — never regress it
+            if worker.state != WorkerState.READY:
+                continue
+            if worker.heartbeat_at and worker.heartbeat_at >= heartbeat_at:
+                continue
+            await worker.update(
+                status=status, heartbeat_at=heartbeat_at
+            )
+            flushed += 1
+        return flushed
+
+
+@register_record
+class UsageArchive(Record):
+    """Daily cold aggregate of model usage (reference metered-usage
+    archival tables)."""
+
+    __kind__ = "usage_archive"
+    __indexes__ = ("day", "model_id", "user_id")
+
+    day: str = ""              # YYYY-MM-DD
+    model_id: int = 0
+    user_id: int = 0
+    operation: str = ""
+    requests: int = 0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class UsageArchiver:
+    def __init__(
+        self,
+        retention_days: float = 7.0,
+        interval: float = 3600.0,
+    ):
+        self.retention_days = retention_days
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._loop(), name="usage-archiver"
+            )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.archive_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("usage archival failed")
+            await asyncio.sleep(self.interval)
+
+    BATCH = 10_000
+
+    async def archive_once(self) -> int:
+        """Aggregate hot rows older than retention into daily archive
+        rows; delete the hot rows. Returns rows archived.
+
+        Hot rows come from an indexed created_at range query in bounded
+        batches — never a full-table scan. Per bucket, hot rows are
+        deleted BEFORE the aggregate upsert: a crash between the two
+        loses at most one bucket's increment, whereas aggregate-first
+        would double-count every bucket on the post-crash rerun
+        (duplicated metering is worse than a bounded gap).
+        """
+        import datetime
+
+        cutoff = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(days=self.retention_days)
+        ).isoformat()
+        total = 0
+        while True:
+            old = await ModelUsage.filter_created_before(
+                cutoff, limit=self.BATCH
+            )
+            if not old:
+                break
+            buckets: Dict[
+                Tuple[str, int, int, str],
+                Tuple[Dict[str, int], list],
+            ] = {}
+            for u in old:
+                day = u.created_at[:10]
+                key = (day, u.model_id, u.user_id, u.operation)
+                agg, rows = buckets.setdefault(
+                    key,
+                    (
+                        {
+                            "requests": 0, "prompt_tokens": 0,
+                            "completion_tokens": 0, "total_tokens": 0,
+                        },
+                        [],
+                    ),
+                )
+                agg["requests"] += 1
+                agg["prompt_tokens"] += u.prompt_tokens
+                agg["completion_tokens"] += u.completion_tokens
+                agg["total_tokens"] += u.total_tokens
+                rows.append(u)
+            for (day, model_id, user_id, operation), (
+                agg, rows,
+            ) in buckets.items():
+                for u in rows:
+                    await u.delete()
+                existing = await UsageArchive.first(
+                    day=day, model_id=model_id, user_id=user_id,
+                    operation=operation,
+                )
+                if existing is not None:
+                    await existing.update(
+                        requests=existing.requests + agg["requests"],
+                        prompt_tokens=(
+                            existing.prompt_tokens + agg["prompt_tokens"]
+                        ),
+                        completion_tokens=(
+                            existing.completion_tokens
+                            + agg["completion_tokens"]
+                        ),
+                        total_tokens=(
+                            existing.total_tokens + agg["total_tokens"]
+                        ),
+                    )
+                else:
+                    await UsageArchive.create(
+                        UsageArchive(
+                            day=day, model_id=model_id, user_id=user_id,
+                            operation=operation, **agg,
+                        )
+                    )
+            total += len(old)
+            logger.info(
+                "archived %d usage rows into %d daily aggregates",
+                len(old), len(buckets),
+            )
+        return total

@@ -100,6 +100,16 @@ class Server:
             interval=cfg.heartbeat_interval,
         )
 
+        from gpustack_tpu.server.collectors import (
+            UsageArchiver,
+            WorkerStatusBuffer,
+        )
+
+        self.status_buffer = WorkerStatusBuffer()
+        self.status_buffer.start()
+        app["status_buffer"] = self.status_buffer
+        self.usage_archiver = UsageArchiver()
+
         async def on_leadership(leading: bool) -> None:
             if leading:
                 if cfg.ha:
@@ -108,6 +118,7 @@ class Server:
                     c.start()
                 self.scheduler.start()
                 self.syncer.start()
+                self.usage_archiver.start()
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
@@ -143,6 +154,10 @@ class Server:
             self.scheduler.stop()
         if hasattr(self, "syncer"):
             self.syncer.stop()
+        if hasattr(self, "status_buffer"):
+            self.status_buffer.stop()
+        if hasattr(self, "usage_archiver"):
+            self.usage_archiver.stop()
         for t in self._tasks:
             t.cancel()
         if self._runner:

@@ -1,0 +1,116 @@
+"""Engine-metric normalization: per-engine names → ``gpustack_tpu:*``.
+
+Reference parity: RuntimeMetricsAggregator + assets/metrics_config/
+metrics_config.yaml (runtime_metrics_aggregator.py:48) — every engine's
+native metric names map onto one normalized namespace so dashboards and
+alerts survive backend swaps. In-repo engines are covered exactly;
+vLLM/SGLang names cover ``custom`` backends running those servers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, Optional, Tuple
+
+NORMALIZED_PREFIX = "gpustack_tpu:"
+
+METRIC_MAP: Dict[str, str] = {
+    # in-repo LLM engine (engine/api_server.py)
+    "gpustack_engine_slots_used": "gpustack_tpu:requests_running",
+    "gpustack_engine_slots_total": "gpustack_tpu:slots_total",
+    "gpustack_engine_waiting": "gpustack_tpu:requests_waiting",
+    "gpustack_engine_decode_steps_total": "gpustack_tpu:decode_steps_total",
+    "gpustack_engine_tokens_generated_total":
+        "gpustack_tpu:generation_tokens_total",
+    # in-repo audio engine (engine/audio_server.py)
+    "gpustack_tpu_audio_requests_total": "gpustack_tpu:audio_requests_total",
+    "gpustack_tpu_audio_seconds_total": "gpustack_tpu:audio_seconds_total",
+    # vLLM-style engines behind the custom backend (reference
+    # metrics_config.yaml vllm section)
+    "vllm:num_requests_running": "gpustack_tpu:requests_running",
+    "vllm:num_requests_waiting": "gpustack_tpu:requests_waiting",
+    "vllm:prompt_tokens_total": "gpustack_tpu:prompt_tokens_total",
+    "vllm:generation_tokens_total": "gpustack_tpu:generation_tokens_total",
+    "vllm:gpu_cache_usage_perc": "gpustack_tpu:kv_cache_usage_ratio",
+    "vllm:time_to_first_token_seconds": "gpustack_tpu:ttft_seconds",
+    "vllm:time_per_output_token_seconds": "gpustack_tpu:tpot_seconds",
+    # SGLang names (reference metrics_config.yaml sglang section)
+    "sglang:num_running_reqs": "gpustack_tpu:requests_running",
+    "sglang:num_queue_reqs": "gpustack_tpu:requests_waiting",
+    "sglang:prompt_tokens_total": "gpustack_tpu:prompt_tokens_total",
+    "sglang:generation_tokens_total":
+        "gpustack_tpu:generation_tokens_total",
+    "sglang:token_usage": "gpustack_tpu:kv_cache_usage_ratio",
+}
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>\S+)"
+)
+
+
+def parse_metric_line(
+    line: str,
+) -> Optional[Tuple[str, Dict[str, str], str]]:
+    """'name{a="b"} 1.5' -> (name, {a: b}, '1.5'); None for non-samples."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    m = _LINE.match(line)
+    if not m:
+        return None
+    labels: Dict[str, str] = {}
+    raw = m.group("labels")
+    if raw:
+        for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', raw):
+            labels[part[0]] = part[1]
+    return m.group("name"), labels, m.group("value")
+
+
+def _fmt(name: str, labels: Dict[str, str], value: str) -> str:
+    if labels:
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+def normalize_engine_metrics(
+    body: str, extra_labels: Dict[str, str]
+) -> Iterator[str]:
+    """Engine /metrics text -> normalized sample lines (mapped names
+    only), with ``extra_labels`` (instance_id, model) merged in."""
+    for line in body.splitlines():
+        parsed = parse_metric_line(line)
+        if parsed is None:
+            continue
+        name, labels, value = parsed
+        mapped = METRIC_MAP.get(name)
+        if mapped is None:
+            # histograms sample as <name>_bucket/_sum/_count — map the
+            # base name and carry the suffix over
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    base = METRIC_MAP.get(name[: -len(suffix)])
+                    if base is not None:
+                        mapped = base + suffix
+                    break
+        if mapped is None:
+            continue
+        labels.update(extra_labels)
+        yield _fmt(mapped, labels, value)
+
+
+def raw_engine_metrics(
+    body: str, extra_labels: Dict[str, str]
+) -> Iterator[str]:
+    """Raw passthrough with labels merged (reference /metrics/raw)."""
+    for line in body.splitlines():
+        parsed = parse_metric_line(line)
+        if parsed is None:
+            continue
+        name, labels, value = parsed
+        labels.update(extra_labels)
+        yield _fmt(name, labels, value)

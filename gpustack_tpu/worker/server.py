@@ -24,7 +24,7 @@ TAIL_MAX = 5000
 class WorkerServer:
     # no secret material flows through these; everything else requires
     # the per-worker proxy secret issued at registration
-    PUBLIC_PATHS = {"/healthz", "/metrics"}
+    PUBLIC_PATHS = {"/healthz", "/metrics", "/metrics/raw"}
 
     def __init__(self, agent) -> None:
         self.agent = agent
@@ -39,6 +39,7 @@ class WorkerServer:
             [
                 web.get("/healthz", self.healthz),
                 web.get("/metrics", self.metrics),
+                web.get("/metrics/raw", self.metrics_raw),
                 web.get(
                     "/v2/instances/{id:\\d+}/logs", self.instance_logs
                 ),
@@ -172,30 +173,50 @@ class WorkerServer:
                 f'gpustack_worker_tpu_hbm_bytes{{chip="{chip.index}",'
                 f'type="{chip.chip_type}"}} {chip.hbm_bytes}'
             )
-        # aggregate engine metrics with instance labels (normalized
-        # engine-metric passthrough, reference /metrics/raw analogue)
-        sm = self.agent.serve_manager
-        if sm:
-            async with aiohttp.ClientSession() as session:
-                for iid, run in list(sm.running.items()):
-                    try:
-                        async with session.get(
-                            f"http://127.0.0.1:{run.port}/metrics",
-                            timeout=aiohttp.ClientTimeout(total=2),
-                        ) as resp:
-                            if resp.status != 200:
-                                continue
-                            body = await resp.text()
-                    except (aiohttp.ClientError, OSError):
-                        continue
-                    for line in body.splitlines():
-                        if line.startswith("#") or not line.strip():
-                            continue
-                        name, _, value = line.partition(" ")
-                        lines.append(
-                            f'{name}{{instance_id="{iid}"}} {value}'
-                        )
+        # normalized engine metrics: per-engine names mapped onto the
+        # gpustack_tpu:* namespace (reference RuntimeMetricsAggregator +
+        # metrics_config.yaml)
+        from gpustack_tpu.worker.metrics_map import (
+            normalize_engine_metrics,
+        )
+
+        for iid, body in await self._scrape_engines():
+            lines.extend(
+                normalize_engine_metrics(
+                    body, {"instance_id": str(iid)}
+                )
+            )
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def metrics_raw(self, request: web.Request) -> web.Response:
+        """Unmapped engine metrics passthrough (reference /metrics/raw)."""
+        from gpustack_tpu.worker.metrics_map import raw_engine_metrics
+
+        lines = []
+        for iid, body in await self._scrape_engines():
+            lines.extend(
+                raw_engine_metrics(body, {"instance_id": str(iid)})
+            )
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def _scrape_engines(self):
+        sm = self.agent.serve_manager
+        out = []
+        if not sm:
+            return out
+        async with aiohttp.ClientSession() as session:
+            for iid, run in list(sm.running.items()):
+                try:
+                    async with session.get(
+                        f"http://127.0.0.1:{run.port}/metrics",
+                        timeout=aiohttp.ClientTimeout(total=2),
+                    ) as resp:
+                        if resp.status != 200:
+                            continue
+                        out.append((iid, await resp.text()))
+                except (aiohttp.ClientError, OSError):
+                    continue
+        return out
 
     async def filesystem_probe(self, request: web.Request) -> web.Response:
         """Probe a worker-local model path for the scheduler/evaluator
@@ -303,4 +324,39 @@ class WorkerServer:
             f.seek(max(0, size - 512 * 1024))
             text = f.read().decode(errors="replace")
         lines = text.splitlines()[-tail:]
-        return web.Response(text="\n".join(lines) + "\n")
+        body = "\n".join(lines) + "\n"
+        if request.query.get("follow") not in ("1", "true"):
+            return web.Response(text=body)
+
+        # follow mode (reference routes/worker/logs.py tail+follow):
+        # stream the tail, then poll the file for appended bytes until
+        # the client disconnects or the instance's log goes away
+        import asyncio as _asyncio
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/plain; charset=utf-8",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+        await resp.write(body.encode())
+        offset = size
+        try:
+            while True:
+                await _asyncio.sleep(0.5)
+                try:
+                    new_size = os.path.getsize(match)
+                except OSError:
+                    break  # rotated/removed
+                if new_size < offset:
+                    offset = 0  # truncated: restart from head
+                if new_size > offset:
+                    with open(match, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(512 * 1024)
+                    offset += len(chunk)
+                    await resp.write(chunk)
+        except (ConnectionResetError, _asyncio.CancelledError):
+            pass
+        return resp

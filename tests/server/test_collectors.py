@@ -1,0 +1,138 @@
+"""Status buffer, usage archiver, and metric normalization."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import Worker, WorkerState, WorkerStatus
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.collectors import (
+    UsageArchive,
+    UsageArchiver,
+    WorkerStatusBuffer,
+)
+from gpustack_tpu.worker.metrics_map import (
+    normalize_engine_metrics,
+    parse_metric_line,
+    raw_engine_metrics,
+)
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def test_metric_line_parsing():
+    assert parse_metric_line("foo 1.5") == ("foo", {}, "1.5")
+    name, labels, value = parse_metric_line(
+        'vllm:prompt_tokens_total{model="m1",id="2"} 42'
+    )
+    assert name == "vllm:prompt_tokens_total"
+    assert labels == {"model": "m1", "id": "2"}
+    assert parse_metric_line("# HELP foo bar") is None
+    assert parse_metric_line("") is None
+
+
+def test_normalization_maps_known_names():
+    body = (
+        "# TYPE gpustack_engine_tokens_generated_total counter\n"
+        "gpustack_engine_tokens_generated_total 100\n"
+        'vllm:num_requests_running{engine="0"} 3\n'
+        "some_unknown_metric 7\n"
+    )
+    out = list(
+        normalize_engine_metrics(body, {"instance_id": "5"})
+    )
+    assert (
+        'gpustack_tpu:generation_tokens_total{instance_id="5"} 100' in out
+    )
+    assert (
+        'gpustack_tpu:requests_running{engine="0",instance_id="5"} 3'
+        in out
+    )
+    # unknown names are excluded from the normalized view...
+    assert not any("some_unknown_metric" in line for line in out)
+    # ...but present in the raw passthrough
+    raw = list(raw_engine_metrics(body, {"instance_id": "5"}))
+    assert 'some_unknown_metric{instance_id="5"} 7' in raw
+
+
+def test_status_buffer_batches_and_flushes_transitions(db):
+    async def go():
+        buffer = WorkerStatusBuffer(flush_interval=999)
+        w = await Worker.create(
+            Worker(name="w1", state=WorkerState.NOT_READY)
+        )
+        # transition NOT_READY -> READY flushes immediately
+        await buffer.put(w, WorkerStatus(), "t1")
+        w = await Worker.get(w.id)
+        assert w.state == WorkerState.READY
+        assert w.heartbeat_at == "t1"
+        # steady-state refresh buffers (no DB write yet)
+        await buffer.put(w, WorkerStatus(), "t2")
+        assert (await Worker.get(w.id)).heartbeat_at == "t1"
+        flushed = await buffer.flush()
+        assert flushed == 1
+        assert (await Worker.get(w.id)).heartbeat_at == "t2"
+        # flush drains: second flush is a no-op
+        assert await buffer.flush() == 0
+
+    asyncio.run(go())
+
+
+def test_usage_archiver_aggregates_and_deletes(db):
+    async def go():
+        old_ts = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(days=10)
+        ).isoformat()
+        for i in range(5):
+            u = await ModelUsage.create(
+                ModelUsage(
+                    user_id=1, model_id=2, operation="chat/completions",
+                    prompt_tokens=10, completion_tokens=5,
+                    total_tokens=15,
+                )
+            )
+            # backdate (created_at is set by the ORM)
+            await u.update(created_at=old_ts)
+        fresh = await ModelUsage.create(
+            ModelUsage(user_id=1, model_id=2, prompt_tokens=1)
+        )
+
+        archiver = UsageArchiver(retention_days=7)
+        archived = await archiver.archive_once()
+        assert archived == 5
+        # hot table keeps only the fresh row
+        remaining = await ModelUsage.filter(limit=None)
+        assert [u.id for u in remaining] == [fresh.id]
+        # cold aggregate carries the totals
+        rows = await UsageArchive.filter(limit=None)
+        assert len(rows) == 1
+        assert rows[0].requests == 5
+        assert rows[0].total_tokens == 75
+        assert rows[0].day == old_ts[:10]
+        # idempotent: nothing left to archive
+        assert await archiver.archive_once() == 0
+        # a second batch for the same day merges into the same row
+        u = await ModelUsage.create(
+            ModelUsage(
+                user_id=1, model_id=2, operation="chat/completions",
+                total_tokens=15,
+            )
+        )
+        await u.update(created_at=old_ts)
+        await archiver.archive_once()
+        rows = await UsageArchive.filter(limit=None)
+        assert len(rows) == 1 and rows[0].requests == 6
+
+    asyncio.run(go())

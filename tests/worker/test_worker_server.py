@@ -160,3 +160,49 @@ def test_instance_proxy_forwards_to_local_engine(tmp_path):
             await runner.cleanup()
 
     asyncio.run(go())
+
+
+def test_log_follow_streams_appended_lines(tmp_path):
+    import types
+
+    cfg = Config.load({"data_dir": str(tmp_path / "data")})
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir()
+    log_path = log_dir / "m-3.log"
+    log_path.write_text("line1\nline2\n")
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        agent = _FakeAgent(cfg)
+        agent.serve_manager = types.SimpleNamespace(
+            running={}, log_dir=str(log_dir)
+        )
+        server = WorkerServer(agent)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            # plain tail
+            r = await client.get(
+                "/v2/instances/3/logs?tail=1", headers=AUTH
+            )
+            assert (await r.text()).strip() == "line2"
+
+            # follow: new lines appended after the request streams out
+            resp = await client.get(
+                "/v2/instances/3/logs?tail=1&follow=1", headers=AUTH
+            )
+            assert resp.status == 200
+            first = await resp.content.read(6)
+            assert first == b"line2\n"
+            with open(log_path, "a") as f:
+                f.write("line3\n")
+            chunk = await asyncio.wait_for(
+                resp.content.read(6), timeout=10
+            )
+            assert chunk == b"line3\n"
+            resp.close()
+        finally:
+            await client.close()
+
+    asyncio.run(go())
