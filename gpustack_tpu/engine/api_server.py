@@ -394,6 +394,7 @@ def build_engine_from_args(args) -> LLMEngine:
         spec_tokens=args.spec_tokens,
         draft_cfg=draft_cfg,
         draft_params=draft_params,
+        host_kv_cache_mb=getattr(args, "host_kv_cache_mb", 0),
     )
 
 
@@ -418,6 +419,10 @@ def main(argv=None) -> None:
     )
     p.add_argument("--mesh-plan", default="", help="e.g. dp1xsp1xep1xtp4")
     p.add_argument("--num-devices", type=int, default=0)
+    p.add_argument(
+        "--host-kv-cache-mb", type=int, default=0,
+        help="host-RAM prefill KV cache budget (extended-KV-cache role)",
+    )
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)

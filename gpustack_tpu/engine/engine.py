@@ -151,6 +151,7 @@ class LLMEngine:
         spec_tokens: int = 4,        # proposals verified per spec step
         draft_cfg=None,              # draft model config (speculative=draft)
         draft_params=None,
+        host_kv_cache_mb: int = 0,   # >0: host-RAM prefill KV cache
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -182,6 +183,20 @@ class LLMEngine:
         # delivered tokens are block-ingested into its cache (catch-up),
         # it proposes spec_tokens-1 greedy continuations, and the target
         # verifies — output is bit-identical to plain greedy decode.
+        self.host_kv_cache = None
+        self._kv_copy_pool = None
+        if host_kv_cache_mb > 0:
+            import concurrent.futures
+
+            from gpustack_tpu.engine.kv_host_cache import HostKVCache
+
+            self.host_kv_cache = HostKVCache(host_kv_cache_mb * 2**20)
+            # device→host KV copies run off-thread: a synchronous PCIe
+            # pull of a whole bucket's KV would stall the scheduler
+            # thread (and every decoding slot) on each prefill miss
+            self._kv_copy_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kv-copy"
+            )
         self.draft_runner = None
         self._draft_state = None
         if speculative == "draft":
@@ -276,6 +291,15 @@ class LLMEngine:
             "draft_model": (
                 self.draft_runner.cfg.name if self.draft_runner else ""
             ),
+            "kv_cache_hits": (
+                self.host_kv_cache.hits if self.host_kv_cache else 0
+            ),
+            "kv_cache_misses": (
+                self.host_kv_cache.misses if self.host_kv_cache else 0
+            ),
+            "kv_cache_host_bytes": (
+                self.host_kv_cache.bytes_used if self.host_kv_cache else 0
+            ),
         }
 
     # ---- scheduling loop ------------------------------------------------
@@ -320,7 +344,41 @@ class LLMEngine:
         ids = req.prompt_ids
         bucket = self.runner.bucket_for(max(1, len(ids)))
         padded = list(ids) + [0] * (bucket - len(ids))
-        last_logits, k, v = self.runner.prefill(padded, len(ids))
+        cache_key = None
+        cached = None
+        if self.host_kv_cache is not None:
+            cache_key = self.host_kv_cache.key(bucket, padded, len(ids))
+            cached = self.host_kv_cache.get(cache_key)
+        if cached is not None:
+            # host→HBM re-upload beats redoing the prefill FLOPs
+            last_np, k_np, v_np = cached
+            last_logits = jnp.asarray(last_np)
+            k = jnp.asarray(k_np)
+            v = jnp.asarray(v_np)
+        else:
+            last_logits, k, v = self.runner.prefill(padded, len(ids))
+            if self.host_kv_cache is not None:
+                def copy_to_host(
+                    key=cache_key, logits=last_logits, k_=k, v_=v
+                ):
+                    try:
+                        self.host_kv_cache.put(
+                            key,
+                            (
+                                np.asarray(logits),
+                                np.asarray(k_),
+                                np.asarray(v_),
+                            ),
+                        )
+                    except RuntimeError as e:
+                        # non-addressable shards (defensive: backends
+                        # gates multi-host off already)
+                        logger.warning(
+                            "disabling host KV cache: %s", e
+                        )
+                        self.host_kv_cache = None
+
+                self._kv_copy_pool.submit(copy_to_host)
         # First generated token: same device sampler as decode, one row —
         # one sampling semantics for the whole sequence, seeded by the
         # engine's key.

@@ -1,0 +1,77 @@
+"""Host-RAM prefill KV cache: the extended-KV-cache role on TPU.
+
+Reference parity: first-class ``ExtendedKVCacheConfig`` wired into vLLM's
+LMCache env/args (schemas/models.py:111-122, worker/backends/vllm.py:
+418-436,822-840). On TPU the analogous lever is spilling prefill KV over
+PCIe into host RAM: a repeated prompt (system prompts, retried requests,
+agent loops) skips its entire prefill — the dominant FLOPs cost for long
+prompts — and re-uploads cached K/V instead.
+
+v1 granularity is the whole padded prompt bucket (exact-match). Prefix-
+granular reuse (continue prefill from a cached prefix) needs
+prefill-from-offset in the runner and is the planned upgrade.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+def _prompt_key(bucket: int, prompt_ids, true_len: int) -> str:
+    h = hashlib.sha256()
+    h.update(f"{bucket}:{true_len}:".encode())
+    h.update(np.asarray(prompt_ids, np.int32).tobytes())
+    return h.hexdigest()
+
+
+class HostKVCache:
+    """Byte-bounded LRU of host-resident prefill results."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lru: "OrderedDict[str, Tuple[Any, ...]]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(bucket: int, prompt_ids, true_len: int) -> str:
+        return _prompt_key(bucket, prompt_ids, true_len)
+
+    def get(self, key: str) -> Optional[Tuple[Any, ...]]:
+        with self._lock:
+            entry = self._lru.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def put(self, key: str, arrays: Tuple[Any, ...]) -> None:
+        size = sum(a.nbytes for a in arrays)
+        if size > self.max_bytes:
+            return  # single entry larger than the whole budget
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return
+            self._lru[key] = arrays
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= sum(a.nbytes for a in evicted)
+
+    @property
+    def entries(self) -> int:
+        return len(self._lru)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes

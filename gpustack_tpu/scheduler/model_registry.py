@@ -1,0 +1,37 @@
+"""Model registry: resolved architecture → categories.
+
+Reference parity: scheduler/model_registry.py detect_model_type (476 LoC
+of per-architecture tables) — compressed to the signals our engine
+actually dispatches on. Categories drive backend selection (audio vs LLM
+engine), catalog filtering, and UI grouping; users can still override by
+setting categories explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from gpustack_tpu.schemas import Model
+
+
+def detect_categories(model: Model) -> List[str]:
+    """Best-effort categories from the model's resolved config; empty
+    list when the source cannot be resolved (leave user input alone)."""
+    from gpustack_tpu.models.whisper import WhisperConfig
+    from gpustack_tpu.scheduler.calculator import (
+        EvaluationError,
+        resolve_model_config,
+    )
+
+    try:
+        cfg = resolve_model_config(model)
+    except EvaluationError:
+        return []
+    if isinstance(cfg, WhisperConfig):
+        return ["audio", "speech-to-text"]
+    out = ["llm"]
+    if getattr(cfg, "num_experts", 0):
+        out.append("moe")
+    if getattr(cfg, "max_position_embeddings", 0) >= 32768:
+        out.append("long-context")
+    return out

@@ -72,6 +72,9 @@ class Model(Record):
     # draft-model speculation (EAGLE-class role, reference vllm.py:531):
     # preset name or local checkpoint dir of the small proposer model
     draft_source: str = ""
+    # extended KV cache (LMCache role, reference schemas/models.py:111-122
+    # + vllm.py:418-436): host-RAM prefill-KV budget in MiB; 0 = off
+    host_kv_cache_mb: int = 0
     restart_on_error: bool = True
     distributable: bool = True        # allow multi-host placement
 

@@ -132,6 +132,16 @@ def create_app(cfg: Config) -> web.Application:
             cluster = await Cluster.first()
             if cluster:
                 obj.cluster_id = cluster.id
+        if not obj.categories:
+            # architecture auto-detection (reference model_registry.py)
+            import asyncio as _asyncio
+
+            from gpustack_tpu.scheduler.model_registry import (
+                detect_categories,
+            )
+
+            obj.categories = await _asyncio.get_running_loop(
+            ).run_in_executor(None, detect_categories, obj)
         return None
 
     async def user_create_hook(request, obj: User, body):

@@ -109,6 +109,10 @@ class Server:
         self.status_buffer.start()
         app["status_buffer"] = self.status_buffer
         self.usage_archiver = UsageArchiver()
+        from gpustack_tpu.server.update_check import UpdateChecker
+
+        self.update_checker = UpdateChecker()
+        self.update_checker.start()  # no-op without GPUSTACK_TPU_UPDATE_URL
 
         async def on_leadership(leading: bool) -> None:
             if leading:
@@ -158,6 +162,8 @@ class Server:
             self.status_buffer.stop()
         if hasattr(self, "usage_archiver"):
             self.usage_archiver.stop()
+        if hasattr(self, "update_checker"):
+            self.update_checker.stop()
         for t in self._tasks:
             t.cancel()
         if self._runner:

@@ -1,0 +1,80 @@
+"""Periodic update check (reference server/update_check.py).
+
+Disabled by default in zero-egress deployments: set
+``GPUSTACK_TPU_UPDATE_URL`` to a JSON endpoint returning
+``{"latest": "x.y.z"}``. Failures only log — an update check must never
+affect serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+import aiohttp
+
+from gpustack_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+
+def _newer(latest: str, current: str) -> bool:
+    def parse(v: str):
+        parts = v.strip().lstrip("v").split(".")
+        if not parts or not all(p.isdigit() for p in parts):
+            raise ValueError(f"non-numeric version {v!r}")
+        nums = [int(p) for p in parts]
+        # zero-pad so '1.2' == '1.2.0' (silent truncation would report
+        # phantom updates forever)
+        return tuple(nums + [0] * (3 - len(nums)))
+
+    try:
+        return parse(latest) > parse(current)
+    except ValueError:
+        return False
+
+
+class UpdateChecker:
+    def __init__(self, interval: float = 24 * 3600.0):
+        self.url = os.environ.get("GPUSTACK_TPU_UPDATE_URL", "")
+        self.interval = interval
+        self.latest: str = ""
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self.url and self._task is None:
+            self._task = asyncio.create_task(
+                self._loop(), name="update-check"
+            )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.check_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.debug("update check failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    async def check_once(self) -> Optional[str]:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                self.url, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                data = await resp.json()
+        latest = str(data.get("latest", ""))
+        if latest and _newer(latest, __version__):
+            self.latest = latest
+            logger.info(
+                "a newer gpustack_tpu release is available: %s "
+                "(running %s)", latest, __version__,
+            )
+        return latest or None

@@ -121,6 +121,10 @@ def _tpu_native_command(
         argv += ["--mesh-plan", claim.mesh_plan]
     if model.quantization:
         argv += ["--quantization", model.quantization]
+    if model.host_kv_cache_mb and not instance.coordinator_address:
+        # single-host only: on multi-host meshes the prefill K/V spans
+        # non-addressable devices and cannot be pulled to one host's RAM
+        argv += ["--host-kv-cache-mb", str(model.host_kv_cache_mb)]
     if model.speculative:
         if model.speculative == "draft" and not model.draft_source:
             # fail fast at command build — an engine that dies at startup

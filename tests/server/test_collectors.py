@@ -136,3 +136,30 @@ def test_usage_archiver_aggregates_and_deletes(db):
         assert len(rows) == 1 and rows[0].requests == 6
 
     asyncio.run(go())
+
+
+def test_update_checker_version_compare():
+    from gpustack_tpu.server.update_check import _newer
+
+    assert _newer("1.2.0", "1.1.9")
+    assert not _newer("1.1.0", "1.1.0")
+    assert not _newer("0.9", "1.0")
+    assert _newer("v2.0.0", "1.9.9")
+    assert not _newer("garbage", "1.0.0")
+    # zero-padding: '1.2' == '1.2.0', no phantom update
+    assert not _newer("1.2.0", "1.2")
+    assert not _newer("2.0.0-rc1", "1.9")  # non-numeric: rejected
+
+
+def test_detect_categories(db):
+    from gpustack_tpu.scheduler.model_registry import detect_categories
+    from gpustack_tpu.schemas import Model
+
+    assert detect_categories(Model(preset="tiny-whisper")) == [
+        "audio", "speech-to-text",
+    ]
+    assert detect_categories(Model(preset="tiny")) == ["llm"]
+    cats = detect_categories(Model(preset="mixtral-8x7b"))
+    assert "moe" in cats and "llm" in cats
+    # unresolvable source: leave user input alone
+    assert detect_categories(Model(preset="nope")) == []
