@@ -368,6 +368,11 @@ def build_engine_from_args(args) -> LLMEngine:
     from gpustack_tpu.engine.weights import load_or_init_params
 
     params = load_or_init_params(cfg, args.model_dir, seed=0)
+    if getattr(args, "lora", None):
+        # merge BEFORE quantization: deltas apply to bf16 base weights
+        from gpustack_tpu.engine.weights import merge_lora_adapters
+
+        params = merge_lora_adapters(cfg, params, args.lora)
     if args.quantization == "int8":
         params = quantize_params(params)
 
@@ -422,6 +427,11 @@ def main(argv=None) -> None:
     p.add_argument(
         "--host-kv-cache-mb", type=int, default=0,
         help="host-RAM prefill KV cache budget (extended-KV-cache role)",
+    )
+    p.add_argument(
+        "--lora", action="append", default=[],
+        help="PEFT LoRA adapter dir merged into the base weights "
+        "(repeatable)",
     )
     args = p.parse_args(argv)
 

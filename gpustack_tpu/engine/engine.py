@@ -346,9 +346,11 @@ class LLMEngine:
         padded = list(ids) + [0] * (bucket - len(ids))
         cache_key = None
         cached = None
-        if self.host_kv_cache is not None:
-            cache_key = self.host_kv_cache.key(bucket, padded, len(ids))
-            cached = self.host_kv_cache.get(cache_key)
+        # local read: the copy worker may null host_kv_cache concurrently
+        kv_cache = self.host_kv_cache
+        if kv_cache is not None:
+            cache_key = kv_cache.key(bucket, padded, len(ids))
+            cached = kv_cache.get(cache_key)
         if cached is not None:
             # host→HBM re-upload beats redoing the prefill FLOPs
             last_np, k_np, v_np = cached
@@ -357,12 +359,13 @@ class LLMEngine:
             v = jnp.asarray(v_np)
         else:
             last_logits, k, v = self.runner.prefill(padded, len(ids))
-            if self.host_kv_cache is not None:
+            if kv_cache is not None:
                 def copy_to_host(
-                    key=cache_key, logits=last_logits, k_=k, v_=v
+                    key=cache_key, logits=last_logits, k_=k, v_=v,
+                    kv_cache=kv_cache,
                 ):
                     try:
-                        self.host_kv_cache.put(
+                        kv_cache.put(
                             key,
                             (
                                 np.asarray(logits),

@@ -210,6 +210,99 @@ def load_whisper_params(cfg, model_dir: str):
     return params
 
 
+# HF PEFT module name -> our stacked layer param (torch Linear weights
+# are [out, in]; ours are transposed [in, out], so the merged delta is
+# (B @ A).T == A.T @ B.T)
+_LORA_MODULES = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def merge_lora_adapters(cfg, params: Dict[str, Any], adapter_dirs):
+    """Merge PEFT LoRA adapters into the base weights: W' = W + s·BA.
+
+    Merged-at-load serving (the TPU-friendly LoRA shape: zero runtime
+    overhead, one instance per adapter set — reference serves LoRA via
+    engine flags + per-adapter ModelRoutes, server/lora_model_routes.py).
+    Must run BEFORE int8 quantization. Returns the mutated param tree.
+    """
+    import json as _json
+    import re as _re
+
+    for adapter_dir in adapter_dirs:
+        cfg_path = os.path.join(adapter_dir, "adapter_config.json")
+        scale = 1.0
+        try:
+            with open(cfg_path) as f:
+                acfg = _json.load(f)
+            r = int(acfg.get("r", 0)) or 1
+            alpha = float(acfg.get("lora_alpha", r))
+            if acfg.get("use_rslora"):
+                scale = alpha / (r ** 0.5)   # rsLoRA: alpha / sqrt(r)
+            else:
+                scale = alpha / r
+        except (OSError, ValueError):
+            logger.warning(
+                "no adapter_config.json in %s; using scale 1.0",
+                adapter_dir,
+            )
+        tensors = _read_safetensors(adapter_dir)
+        pat = _re.compile(
+            r"layers\.(\d+)\.(?:self_attn|mlp)\.(\w+)\.lora_A\.weight$"
+        )
+        merged = 0
+        for name in sorted(tensors):
+            m = pat.search(name)
+            if m is None:
+                continue
+            layer_idx = int(m.group(1))
+            module = m.group(2)
+            ours = _LORA_MODULES.get(module)
+            if ours is None or ours not in params["layers"]:
+                logger.warning(
+                    "skipping LoRA target %s (unsupported module)", name
+                )
+                continue
+            if layer_idx >= cfg.num_layers:
+                # JAX scatter would silently drop the OOB update — a
+                # half-applied adapter must be an error, not a mystery
+                raise ValueError(
+                    f"adapter {adapter_dir} targets layer {layer_idx} "
+                    f"but the model has {cfg.num_layers} layers"
+                )
+            b_name = name.replace("lora_A", "lora_B")
+            if b_name not in tensors:
+                raise ValueError(
+                    f"adapter {adapter_dir} is missing {b_name} "
+                    f"(truncated checkpoint?)"
+                )
+            # keep fp32 through the delta matmul — routing through the
+            # default bf16 load dtype would cost ~8 mantissa bits twice
+            a = _to_jnp(tensors[name], jnp.float32)
+            b = _to_jnp(tensors[b_name], jnp.float32)
+            delta = (a.T @ b.T) * scale                 # [in, out]
+            base = params["layers"][ours]
+            params["layers"][ours] = base.at[layer_idx].add(
+                delta.astype(base.dtype)
+            )
+            merged += 1
+        logger.info(
+            "merged %d LoRA deltas from %s (scale %.3f)",
+            merged, adapter_dir, scale,
+        )
+        if merged == 0:
+            raise ValueError(
+                f"adapter {adapter_dir} matched no mergeable weights"
+            )
+    return params
+
+
 def load_or_init_params(
     cfg: ModelConfig, model_dir: Optional[str], seed: int = 0
 ) -> Dict[str, Any]:

@@ -1,0 +1,76 @@
+"""Plugin/extension system (reference gpustack/extension.py:57-78).
+
+Plugins extend the server without forking it: mount routers, register
+async tasks, supply an HA coordinator. Discovery is module-path based via
+``GPUSTACK_TPU_PLUGINS=pkg.mod1,pkg.mod2`` (the reference uses the
+``gpustack.plugins`` entry-point group; entry points require installed
+distributions, while a module list also covers in-tree/ad-hoc plugins —
+both resolve to "import something and find Plugin subclasses").
+
+Each listed module is imported and every ``Plugin`` subclass defined in
+it is instantiated once.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import logging
+import os
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+PLUGINS_ENV = "GPUSTACK_TPU_PLUGINS"
+
+
+class Plugin:
+    """Base class: override any subset of the hooks."""
+
+    name: str = ""
+
+    def setup_app(self, app, cfg) -> None:
+        """Mount routes / middlewares on the aiohttp application."""
+
+    def tasks(self, app, cfg) -> List:
+        """Coroutines started with the server and cancelled on stop."""
+        return []
+
+    def coordinator(self, cfg):
+        """Return a Coordinator instance to replace the default, or
+        None (reference: plugins supply distributed coordinators,
+        server/server.py:1166-1194)."""
+        return None
+
+
+def iter_plugin_classes(spec: Optional[str] = None):
+    spec = spec if spec is not None else os.environ.get(PLUGINS_ENV, "")
+    for module_path in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            module = importlib.import_module(module_path)
+        except ImportError as e:
+            logger.error("plugin module %r failed to import: %s",
+                         module_path, e)
+            continue
+        for _, obj in inspect.getmembers(module, inspect.isclass):
+            if (
+                issubclass(obj, Plugin)
+                and obj is not Plugin
+                and obj.__module__ == module.__name__
+            ):
+                yield obj
+
+
+def load_plugins(spec: Optional[str] = None) -> List[Plugin]:
+    plugins: List[Plugin] = []
+    for cls in iter_plugin_classes(spec):
+        try:
+            plugin = cls()
+            plugins.append(plugin)
+            logger.info(
+                "loaded plugin %s (%s)",
+                plugin.name or cls.__name__, cls.__module__,
+            )
+        except Exception:
+            logger.exception("plugin %s failed to initialize", cls)
+    return plugins

@@ -290,11 +290,49 @@ def create_app(cfg: Config) -> web.Application:
         app, ModelUsage, "model-usage", readonly=True, admin_read=True
     )
 
+    # plugins mount last: they may override nothing but can add routes
+    # (reference server/app.py:88 plugin load)
+    from gpustack_tpu.extension import load_plugins
+
+    app["plugins"] = load_plugins()
+    for plugin in app["plugins"]:
+        try:
+            plugin.setup_app(app, cfg)
+        except Exception:
+            logger.exception(
+                "plugin %s setup failed", plugin.name or type(plugin)
+            )
+
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):
+        import asyncio as _asyncio
+
         app["proxy_session"] = aiohttp.ClientSession()
+        app["plugin_tasks"] = []
+        for plugin in app["plugins"]:
+            try:
+                coros = plugin.tasks(app, cfg)
+            except Exception:
+                # one faulty plugin must not abort server startup (same
+                # tolerance as load/setup)
+                logger.exception(
+                    "plugin %s tasks() failed",
+                    plugin.name or type(plugin),
+                )
+                continue
+            for coro in coros:
+                app["plugin_tasks"].append(_asyncio.create_task(coro))
 
     async def on_cleanup(app: web.Application):
+        import asyncio as _asyncio
+
+        tasks = app.get("plugin_tasks", [])
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            # cancellation must be delivered before the loop closes —
+            # plugin finally blocks run here
+            await _asyncio.gather(*tasks, return_exceptions=True)
         await app["proxy_session"].close()
 
     app.on_startup.append(on_startup)

@@ -89,7 +89,20 @@ class Server:
             LocalCoordinator,
         )
 
-        self.coordinator = (
+        # a plugin may supply the coordinator (reference: distributed
+        # coordinators ship as plugins, server/server.py:1166-1194)
+        plugin_coordinator = None
+        for plugin in app.get("plugins", []):
+            try:
+                plugin_coordinator = plugin.coordinator(cfg)
+            except Exception:
+                logger.exception(
+                    "plugin %s coordinator() failed",
+                    plugin.name or type(plugin),
+                )
+            if plugin_coordinator is not None:
+                break
+        self.coordinator = plugin_coordinator or (
             LeaseCoordinator(self.db, bus=self.bus)
             if cfg.ha else LocalCoordinator()
         )

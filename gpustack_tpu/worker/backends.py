@@ -121,6 +121,8 @@ def _tpu_native_command(
         argv += ["--mesh-plan", claim.mesh_plan]
     if model.quantization:
         argv += ["--quantization", model.quantization]
+    for adapter in model.lora_adapters:
+        argv += ["--lora", adapter]
     if model.host_kv_cache_mb and not instance.coordinator_address:
         # single-host only: on multi-host meshes the prefill K/V spans
         # non-addressable devices and cannot be pulled to one host's RAM

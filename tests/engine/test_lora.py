@@ -1,0 +1,97 @@
+"""LoRA adapter merging: PEFT checkpoint → merged base weights."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpustack_tpu.engine.weights import merge_lora_adapters
+from gpustack_tpu.models import forward, init_params
+from gpustack_tpu.models.config import get_config
+
+
+def _write_adapter(tmp_path, cfg, r=4, alpha=8, layers=(0,)):
+    """Synthetic PEFT adapter targeting q_proj/down_proj."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    tensors = {}
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    for i in layers:
+        prefix = f"base_model.model.model.layers.{i}"
+        # torch convention: lora_A [r, in], lora_B [out, r]
+        tensors[f"{prefix}.self_attn.q_proj.lora_A.weight"] = (
+            rng.standard_normal((r, d)).astype(np.float32) * 0.01
+        )
+        tensors[f"{prefix}.self_attn.q_proj.lora_B.weight"] = (
+            rng.standard_normal((cfg.q_dim, r)).astype(np.float32) * 0.01
+        )
+        tensors[f"{prefix}.mlp.down_proj.lora_A.weight"] = (
+            rng.standard_normal((r, f)).astype(np.float32) * 0.01
+        )
+        tensors[f"{prefix}.mlp.down_proj.lora_B.weight"] = (
+            rng.standard_normal((d, r)).astype(np.float32) * 0.01
+        )
+    adapter = tmp_path / "adapter"
+    adapter.mkdir()
+    save_file(tensors, str(adapter / "adapter_model.safetensors"))
+    (adapter / "adapter_config.json").write_text(
+        json.dumps({"r": r, "lora_alpha": alpha})
+    )
+    return adapter, tensors
+
+
+def test_merge_applies_exact_delta(tmp_path):
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    base_wq = np.asarray(params["layers"]["wq"][0], np.float32).copy()
+    adapter, tensors = _write_adapter(tmp_path, cfg, r=4, alpha=8)
+
+    merge_lora_adapters(cfg, params, [str(adapter)])
+
+    a = tensors[
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight"
+    ]
+    b = tensors[
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"
+    ]
+    want = base_wq + (a.T @ b.T) * (8 / 4)
+    got = np.asarray(params["layers"]["wq"][0], np.float32)
+    # fp32 delta math: only the final bf16 cast rounds
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=5e-3)
+    # untouched layer stays bit-identical
+    # (layer 1 had no adapter weights)
+    assert params["layers"]["wq"].shape[0] == cfg.num_layers
+
+
+def test_merged_model_changes_output_and_runs(tmp_path):
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    toks = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+    logits_base, _ = forward(params, cfg, toks, pos)
+
+    adapter, _ = _write_adapter(tmp_path, cfg, layers=(0, 1))
+    merge_lora_adapters(cfg, params, [str(adapter)])
+    logits_lora, _ = forward(params, cfg, toks, pos)
+    assert not np.allclose(
+        np.asarray(logits_base), np.asarray(logits_lora)
+    )
+    assert np.isfinite(np.asarray(logits_lora)).all()
+
+
+def test_merge_rejects_useless_adapter(tmp_path):
+    from safetensors.numpy import save_file
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    adapter = tmp_path / "bad"
+    adapter.mkdir()
+    save_file(
+        {"unrelated.weight": np.zeros((2, 2), np.float32)},
+        str(adapter / "adapter_model.safetensors"),
+    )
+    with pytest.raises(ValueError, match="no mergeable"):
+        merge_lora_adapters(cfg, params, [str(adapter)])

@@ -1,0 +1,98 @@
+"""Plugin/extension system: discovery, app mounting, background tasks."""
+
+import asyncio
+import sys
+import types
+
+import pytest
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.extension import Plugin, load_plugins
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.server.bus import EventBus
+
+PLUGIN_SRC = '''
+from aiohttp import web
+
+from gpustack_tpu.extension import Plugin
+
+
+class HelloPlugin(Plugin):
+    name = "hello"
+
+    def setup_app(self, app, cfg):
+        async def hello(request):
+            return web.json_response({"plugin": "hello"})
+
+        app.router.add_get("/plugins/hello", hello)
+
+    def tasks(self, app, cfg):
+        async def beat():
+            app["hello_beats"] = 0
+            while True:
+                app["hello_beats"] += 1
+                import asyncio
+                await asyncio.sleep(0.05)
+
+        return [beat()]
+'''
+
+
+@pytest.fixture()
+def plugin_module():
+    module = types.ModuleType("_test_hello_plugin")
+    exec(PLUGIN_SRC, module.__dict__)
+    module.__name__ = "_test_hello_plugin"
+    # fix class __module__ so discovery accepts it
+    module.HelloPlugin.__module__ = "_test_hello_plugin"
+    sys.modules["_test_hello_plugin"] = module
+    yield module
+    del sys.modules["_test_hello_plugin"]
+
+
+def test_discovery_and_error_tolerance(plugin_module):
+    plugins = load_plugins("_test_hello_plugin")
+    assert len(plugins) == 1 and plugins[0].name == "hello"
+    # bogus modules are skipped, not fatal
+    assert load_plugins("no.such.module,_test_hello_plugin")
+    assert load_plugins("") == []
+
+
+def test_plugin_mounts_routes_and_tasks(plugin_module, tmp_path,
+                                        monkeypatch):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.server.app import create_app
+
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    monkeypatch.setenv("GPUSTACK_TPU_PLUGINS", "_test_hello_plugin")
+    cfg = Config.load({"data_dir": str(tmp_path)})
+
+    async def go():
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # plugin route is public? no — auth middleware applies; the
+            # route exists but unauthenticated access gets 401
+            r = await client.get("/plugins/hello")
+            assert r.status == 401
+            # background task runs
+            await asyncio.sleep(0.2)
+            assert app.get("hello_beats", 0) >= 1
+        finally:
+            await client.close()
+
+    go_result = asyncio.run(go())
+    db.close()
+    return go_result
+
+
+def test_plugin_base_hooks_are_noops():
+    p = Plugin()
+    p.setup_app(None, None)
+    assert p.tasks(None, None) == []
+    assert p.coordinator(None) is None
