@@ -20,6 +20,8 @@ PUBLIC_PATHS = {
     "/healthz",
     "/readyz",
     "/auth/login",
+    "/auth/oidc/login",
+    "/auth/oidc/callback",
     "/v2/workers/register",
     "/metrics",
 }

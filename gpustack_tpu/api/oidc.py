@@ -1,0 +1,221 @@
+"""OIDC single sign-on: authorization-code flow (reference routes/auth.py
+OIDC section of the 1,415-LoC SSO module; SAML/CAS are round-3).
+
+Flow: ``/auth/oidc/login`` redirects to the issuer's authorization
+endpoint with an HMAC-signed state (CSRF); ``/auth/oidc/callback``
+exchanges the code at the token endpoint (client-secret auth over TLS),
+verifies the returned id_token — RS256 against the issuer's JWKS via
+``cryptography``, or HS256 with the client secret — maps claims to a
+local user (auto-provisioned on first login), and issues the normal
+session JWT.
+
+Discovery (``/.well-known/openid-configuration``) and JWKS are fetched
+lazily and cached per process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+STATE_TTL = 600.0
+
+# reuse the auth module's padding-sensitive base64url helpers
+from gpustack_tpu.api.auth import _b64 as _b64url  # noqa: E402
+from gpustack_tpu.api.auth import _unb64 as _unb64url  # noqa: E402
+
+NONCE_COOKIE = "gpustack_tpu_oidc_nonce"
+
+
+def make_state(secret: str, nonce: str) -> str:
+    """State bound to a per-browser nonce (set as a short-lived cookie at
+    login): an attacker cannot splice their own authorization code into a
+    victim's callback, because the victim's browser lacks the matching
+    nonce cookie (login-CSRF / session fixation defense)."""
+    ts = str(int(time.time()))
+    sig = hmac.new(
+        secret.encode(), f"oidc:{ts}:{nonce}".encode(), hashlib.sha256
+    ).hexdigest()[:32]
+    return f"{ts}.{sig}"
+
+
+def check_state(state: str, secret: str, nonce: str) -> bool:
+    try:
+        ts, sig = state.split(".")
+        expect = hmac.new(
+            secret.encode(), f"oidc:{ts}:{nonce}".encode(),
+            hashlib.sha256,
+        ).hexdigest()[:32]
+        return (
+            hmac.compare_digest(sig, expect)
+            and time.time() - float(ts) < STATE_TTL
+        )
+    except (ValueError, TypeError):
+        return False
+
+
+class OIDCProvider:
+    def __init__(
+        self,
+        issuer: str,
+        client_id: str,
+        client_secret: str,
+        session: Optional[aiohttp.ClientSession] = None,
+    ):
+        self.issuer = issuer.rstrip("/")
+        self.client_id = client_id
+        self.client_secret = client_secret
+        # shared pooled session (per-request sessions are an aiohttp
+        # antipattern — token exchange runs on every SSO login)
+        self._session = session
+        self._discovery: Optional[Dict[str, Any]] = None
+        self._jwks: Optional[Dict[str, Any]] = None
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def discovery(self) -> Dict[str, Any]:
+        if self._discovery is None:
+            url = self.issuer + "/.well-known/openid-configuration"
+            async with self._http().get(
+                url, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                resp.raise_for_status()
+                self._discovery = await resp.json()
+        return self._discovery
+
+    async def jwks(self, refresh: bool = False) -> Dict[str, Any]:
+        if self._jwks is None or refresh:
+            disc = await self.discovery()
+            async with self._http().get(
+                disc["jwks_uri"],
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as resp:
+                resp.raise_for_status()
+                self._jwks = await resp.json()
+        return self._jwks
+
+    async def auth_url(self, redirect_uri: str, state: str) -> str:
+        from urllib.parse import urlencode
+
+        disc = await self.discovery()
+        query = urlencode(
+            {
+                "response_type": "code",
+                "client_id": self.client_id,
+                "redirect_uri": redirect_uri,
+                "scope": "openid profile email",
+                "state": state,
+            }
+        )
+        return f"{disc['authorization_endpoint']}?{query}"
+
+    async def exchange_code(
+        self, code: str, redirect_uri: str
+    ) -> Dict[str, Any]:
+        disc = await self.discovery()
+        async with self._http().post(
+            disc["token_endpoint"],
+            data={
+                "grant_type": "authorization_code",
+                "code": code,
+                "redirect_uri": redirect_uri,
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+            },
+            timeout=aiohttp.ClientTimeout(total=15),
+        ) as resp:
+            body = await resp.json()
+            if resp.status != 200:
+                raise ValueError(f"token exchange failed: {body}")
+            return body
+
+    async def verify_id_token(self, token: str) -> Dict[str, Any]:
+        """Verify signature + iss/aud/exp; returns the claims."""
+        try:
+            header_b64, body_b64, sig_b64 = token.split(".")
+            header = json.loads(_unb64url(header_b64))
+            claims = json.loads(_unb64url(body_b64))
+        except (ValueError, json.JSONDecodeError) as e:
+            raise ValueError(f"malformed id_token: {e}")
+        signing = f"{header_b64}.{body_b64}".encode()
+        sig = _unb64url(sig_b64)
+        alg = header.get("alg")
+        if alg == "HS256":
+            expect = hmac.new(
+                self.client_secret.encode(), signing, hashlib.sha256
+            ).digest()
+            if not hmac.compare_digest(expect, sig):
+                raise ValueError("id_token HS256 signature mismatch")
+        elif alg == "RS256":
+            await self._verify_rs256(header, signing, sig)
+        else:
+            raise ValueError(f"unsupported id_token alg {alg!r}")
+        if claims.get("iss", "").rstrip("/") != self.issuer:
+            raise ValueError("id_token issuer mismatch")
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if self.client_id not in auds:
+            raise ValueError("id_token audience mismatch")
+        if claims.get("exp", 0) < time.time():
+            raise ValueError("id_token expired")
+        return claims
+
+    async def _verify_rs256(
+        self, header: Dict[str, Any], signing: bytes, sig: bytes
+    ) -> None:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import (
+            padding,
+            rsa,
+        )
+
+        kid = header.get("kid")
+
+        def find(keys):
+            return next(
+                (
+                    k for k in keys
+                    if k.get("kty") == "RSA"
+                    and (kid is None or k.get("kid") == kid)
+                ),
+                None,
+            )
+
+        jwk = find((await self.jwks()).get("keys", []))
+        if jwk is None:
+            # IdPs rotate signing keys (daily at some providers): one
+            # refetch on kid miss, or SSO breaks until a server restart
+            jwk = find(
+                (await self.jwks(refresh=True)).get("keys", [])
+            )
+        if jwk is None:
+            raise ValueError(f"no RSA JWK for kid {kid!r}")
+        n = int.from_bytes(_unb64url(jwk["n"]), "big")
+        e = int.from_bytes(_unb64url(jwk["e"]), "big")
+        public_key = rsa.RSAPublicNumbers(e, n).public_key()
+        try:
+            public_key.verify(
+                sig, signing, padding.PKCS1v15(), hashes.SHA256()
+            )
+        except Exception:
+            raise ValueError("id_token RS256 signature mismatch")
+
+
+def claims_to_username(claims: Dict[str, Any]) -> str:
+    return str(
+        claims.get("preferred_username")
+        or claims.get("email")
+        or claims.get("sub")
+        or ""
+    )

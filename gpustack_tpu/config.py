@@ -52,6 +52,14 @@ class Config(pydantic.BaseModel):
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False
 
+    # OIDC SSO (reference routes/auth.py; flags cmd/start.py:370-512)
+    oidc_issuer: str = ""
+    oidc_client_id: str = ""
+    oidc_client_secret: str = ""
+    # external base URL for the OIDC redirect_uri (defaults to the
+    # request's own host)
+    external_url: str = ""
+
     debug: bool = False
 
     # ---- derivation -----------------------------------------------------

@@ -48,7 +48,9 @@ def iter_plugin_classes(spec: Optional[str] = None):
     for module_path in filter(None, (p.strip() for p in spec.split(","))):
         try:
             module = importlib.import_module(module_path)
-        except ImportError as e:
+        except Exception as e:
+            # any import-time failure (not just ImportError): one broken
+            # plugin must never abort server startup
             logger.error("plugin module %r failed to import: %s",
                          module_path, e)
             continue

@@ -12,6 +12,7 @@ import json
 import logging
 import uuid
 
+import aiohttp
 from aiohttp import web
 
 from gpustack_tpu.api import auth as auth_mod
@@ -171,10 +172,109 @@ def add_auth_routes(app: web.Application) -> None:
             }
         )
 
+    # ---- OIDC SSO ------------------------------------------------------
+
+    def _oidc_provider():
+        from gpustack_tpu.api.oidc import OIDCProvider
+
+        if not (cfg.oidc_issuer and cfg.oidc_client_id):
+            return None
+        provider = app.get("_oidc_provider")
+        if provider is None:
+            provider = OIDCProvider(
+                cfg.oidc_issuer,
+                cfg.oidc_client_id,
+                cfg.oidc_client_secret,
+            )
+            app["_oidc_provider"] = provider
+        return provider
+
+    def _redirect_uri(request: web.Request) -> str:
+        base = cfg.external_url.rstrip("/") or (
+            f"{request.scheme}://{request.host}"
+        )
+        return f"{base}/auth/oidc/callback"
+
+    async def oidc_login(request: web.Request):
+        import secrets as _secrets
+
+        from gpustack_tpu.api import oidc as oidc_mod
+
+        provider = _oidc_provider()
+        if provider is None:
+            return json_error(404, "OIDC is not configured")
+        # per-browser nonce cookie binds the state to THIS browser
+        # (login-CSRF defense — see oidc.make_state)
+        nonce = _secrets.token_urlsafe(16)
+        state = oidc_mod.make_state(cfg.jwt_secret, nonce)
+        try:
+            url = await provider.auth_url(_redirect_uri(request), state)
+        except Exception as e:
+            return json_error(502, f"OIDC issuer unreachable: {e}")
+        resp = web.HTTPFound(url)
+        resp.set_cookie(
+            oidc_mod.NONCE_COOKIE, nonce,
+            max_age=int(oidc_mod.STATE_TTL),
+            httponly=True, samesite="Lax",
+        )
+        return resp
+
+    async def oidc_callback(request: web.Request):
+        from gpustack_tpu.api import oidc as oidc_mod
+
+        provider = _oidc_provider()
+        if provider is None:
+            return json_error(404, "OIDC is not configured")
+        state = request.query.get("state", "")
+        nonce = request.cookies.get(oidc_mod.NONCE_COOKIE, "")
+        if not nonce or not oidc_mod.check_state(
+            state, cfg.jwt_secret, nonce
+        ):
+            return json_error(403, "invalid or expired OIDC state")
+        code = request.query.get("code", "")
+        if not code:
+            return json_error(400, "missing authorization code")
+        try:
+            tokens = await provider.exchange_code(
+                code, _redirect_uri(request)
+            )
+            claims = await provider.verify_id_token(
+                tokens.get("id_token", "")
+            )
+        except (ValueError, aiohttp.ClientError) as e:
+            return json_error(403, f"OIDC login failed: {e}")
+        username = oidc_mod.claims_to_username(claims)
+        if not username:
+            return json_error(403, "id_token carries no usable identity")
+        user = await User.first(username=username)
+        if user is None:
+            # JIT provisioning: SSO users authenticate only via the IdP
+            # (unusable random password hash)
+            import secrets as _secrets
+
+            user = await User.create(
+                User(
+                    username=username,
+                    full_name=str(claims.get("name", "")),
+                    password_hash=auth_mod.hash_password(
+                        _secrets.token_urlsafe(24)
+                    ),
+                )
+            )
+        token = auth_mod.issue_session_token(user, cfg.jwt_secret)
+        resp = web.HTTPFound("/")
+        resp.set_cookie(
+            SESSION_COOKIE, token, httponly=True, samesite="Lax"
+        )
+        resp.del_cookie(oidc_mod.NONCE_COOKIE)
+        return resp
+
     app.router.add_post("/auth/login", login)
     app.router.add_post("/auth/logout", logout)
     app.router.add_get("/auth/me", me)
     app.router.add_post("/auth/change-password", change_password)
+    app.router.add_get("/auth/oidc/login", oidc_login)
+    app.router.add_get("/auth/oidc/callback", oidc_callback)
     app.router.add_post("/v2/api-keys", create_api_key)
     app.router.add_post("/v2/workers/register", register_worker)
 

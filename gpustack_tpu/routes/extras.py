@@ -201,7 +201,40 @@ def add_extra_routes(app: web.Application) -> None:
             }
         )
 
+    async def cluster_manifests(request: web.Request):
+        """Ready-to-apply K8s join bundle for this cluster (reference
+        routes/clusters.py get_cluster_manifests; admin-only — it embeds
+        the registration token)."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.schemas import Cluster
+        from gpustack_tpu.server.k8s import render_manifests
+
+        if err := require_admin(request):
+            return err
+        cluster = await Cluster.get(int(request.match_info["id"]))
+        if cluster is None:
+            return json_error(404, "cluster not found")
+        cfg = request.app["config"]
+        server_url = cfg.external_url.rstrip("/") or (
+            f"{request.scheme}://{request.host}"
+        )
+        yaml_text = render_manifests(
+            server_url,
+            cfg.registration_token,
+            tpu_accelerator=request.query.get(
+                "accelerator", "tpu-v5-lite-podslice"
+            ),
+            worker_port=cfg.worker_port,
+            tunnel=request.query.get("tunnel") in ("1", "true"),
+        )
+        return web.Response(
+            text=yaml_text, content_type="application/yaml"
+        )
+
     app.router.add_get("/v2/model-catalog", catalog)
     app.router.add_post("/v2/models/evaluate", evaluate)
     app.router.add_get("/v2/usage/summary", usage_summary)
     app.router.add_get("/v2/dashboard", dashboard)
+    app.router.add_get(
+        "/v2/clusters/{id:\\d+}/manifests", cluster_manifests
+    )

@@ -1,0 +1,100 @@
+"""K8s worker-join manifests (reference k8s/manifest_template.py +
+routes/clusters.py get_cluster_manifests).
+
+``GET /v2/clusters/{id}/manifests`` renders a ready-to-apply YAML bundle
+that joins TPU nodes to this cluster: a namespace, a secret holding the
+cluster registration token, and a DaemonSet running the worker agent on
+TPU nodes (selected by the standard ``cloud.google.com/gke-tpu-*``
+labels, hostNetwork so ICI/DCN addressing matches the node).
+"""
+
+from __future__ import annotations
+
+import jinja2
+
+TEMPLATE = jinja2.Template(
+    """\
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: {{ namespace }}
+---
+apiVersion: v1
+kind: Secret
+metadata:
+  name: gpustack-tpu-registration
+  namespace: {{ namespace }}
+type: Opaque
+stringData:
+  registration-token: "{{ registration_token }}"
+---
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: gpustack-tpu-worker
+  namespace: {{ namespace }}
+spec:
+  selector:
+    matchLabels:
+      app: gpustack-tpu-worker
+  template:
+    metadata:
+      labels:
+        app: gpustack-tpu-worker
+    spec:
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: "{{ tpu_accelerator }}"
+      hostNetwork: true
+      dnsPolicy: ClusterFirstWithHostNet
+      containers:
+        - name: worker
+          image: "{{ image }}"
+          args:
+            - start
+            - --server-url={{ server_url }}
+            - --worker-port={{ worker_port }}
+{%- if tunnel %}
+            - --tunnel
+{%- endif %}
+          env:
+            - name: GPUSTACK_TPU_REGISTRATION_TOKEN
+              valueFrom:
+                secretKeyRef:
+                  name: gpustack-tpu-registration
+                  key: registration-token
+          ports:
+            - containerPort: {{ worker_port }}
+              name: worker-http
+          securityContext:
+            privileged: true   # /dev/accel* TPU device access
+          volumeMounts:
+            - name: models
+              mountPath: /var/lib/gpustack-tpu
+      volumes:
+        - name: models
+          hostPath:
+            path: /var/lib/gpustack-tpu
+            type: DirectoryOrCreate
+"""
+)
+
+
+def render_manifests(
+    server_url: str,
+    registration_token: str,
+    *,
+    namespace: str = "gpustack-tpu",
+    image: str = "gpustack/gpustack-tpu:latest",
+    tpu_accelerator: str = "tpu-v5-lite-podslice",
+    worker_port: int = 10151,
+    tunnel: bool = False,
+) -> str:
+    return TEMPLATE.render(
+        server_url=server_url,
+        registration_token=registration_token,
+        namespace=namespace,
+        image=image,
+        tpu_accelerator=tpu_accelerator,
+        worker_port=worker_port,
+        tunnel=tunnel,
+    )

@@ -150,3 +150,24 @@ def test_usage_summary_and_dashboard(ctx):
         assert data["chips"]["total"] == 8
 
     _client_run(ctx, go)
+
+
+def test_cluster_manifests(ctx):
+    async def go(client, hdrs):
+        from gpustack_tpu.schemas import Cluster
+
+        cluster = await Cluster.create(
+            Cluster(name="c1", registration_token_hash="x")
+        )
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/manifests?tunnel=1", headers=hdrs
+        )
+        assert r.status == 200
+        text = await r.text()
+        assert "kind: DaemonSet" in text
+        assert "--tunnel" in text
+        assert "gke-tpu-accelerator" in text
+        # embeds the registration token -> admin only
+        assert ctx.registration_token in text
+
+    _client_run(ctx, go)

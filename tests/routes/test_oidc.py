@@ -1,0 +1,292 @@
+"""OIDC SSO: full authorization-code flow against a mock IdP.
+
+The mock IdP is a real local aiohttp app implementing discovery,
+authorize, token, and JWKS endpoints; id_tokens are HS256-signed with the
+client secret (RS256/JWKS verification is unit-tested separately below
+with a real RSA keypair via ``cryptography``).
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.parse
+
+import pytest
+from aiohttp import web
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.api.oidc import (
+    OIDCProvider,
+    check_state,
+    claims_to_username,
+    make_state,
+)
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import User
+from gpustack_tpu.server.bus import EventBus
+
+CLIENT_ID = "gpustack-tpu"
+CLIENT_SECRET = "s3cret-client"
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _hs256_token(claims: dict, secret: str) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = _b64url(json.dumps(claims).encode())
+    sig = _b64url(
+        hmac.new(
+            secret.encode(), f"{header}.{body}".encode(), hashlib.sha256
+        ).digest()
+    )
+    return f"{header}.{body}.{sig}"
+
+
+def _mock_idp(issuer_holder: dict) -> web.Application:
+    idp = web.Application()
+    codes = {}
+
+    async def discovery(request):
+        issuer = issuer_holder["url"]
+        return web.json_response(
+            {
+                "issuer": issuer,
+                "authorization_endpoint": f"{issuer}/authorize",
+                "token_endpoint": f"{issuer}/token",
+                "jwks_uri": f"{issuer}/jwks",
+            }
+        )
+
+    async def authorize(request):
+        # auto-approve: bounce straight back with a code
+        code = "code-abc123"
+        codes[code] = {
+            "sub": "user-1",
+            "preferred_username": "sso-jane",
+            "name": "Jane Doe",
+        }
+        redirect = request.query["redirect_uri"]
+        state = request.query["state"]
+        raise web.HTTPFound(
+            f"{redirect}?code={code}&state={urllib.parse.quote(state)}"
+        )
+
+    async def token(request):
+        form = await request.post()
+        if form["client_secret"] != CLIENT_SECRET:
+            return web.json_response(
+                {"error": "invalid_client"}, status=401
+            )
+        claims = codes.pop(form["code"], None)
+        if claims is None:
+            return web.json_response(
+                {"error": "invalid_grant"}, status=400
+            )
+        claims = {
+            **claims,
+            "iss": issuer_holder["url"],
+            "aud": CLIENT_ID,
+            "exp": int(time.time()) + 300,
+        }
+        return web.json_response(
+            {
+                "access_token": "at",
+                "id_token": _hs256_token(claims, CLIENT_SECRET),
+                "token_type": "Bearer",
+            }
+        )
+
+    async def jwks(request):
+        return web.json_response({"keys": []})
+
+    idp.router.add_get(
+        "/.well-known/openid-configuration", discovery
+    )
+    idp.router.add_get("/authorize", authorize)
+    idp.router.add_post("/token", token)
+    idp.router.add_get("/jwks", jwks)
+    return idp
+
+
+def test_state_roundtrip():
+    s = make_state("k", "nonce1")
+    assert check_state(s, "k", "nonce1")
+    assert not check_state(s, "other", "nonce1")
+    assert not check_state(s, "k", "nonce2")   # wrong browser
+    assert not check_state("garbage", "k", "nonce1")
+    old = f"{int(time.time()) - 9999}.x"
+    assert not check_state(old, "k", "nonce1")
+
+
+def test_claims_to_username():
+    assert claims_to_username({"preferred_username": "a"}) == "a"
+    assert claims_to_username({"email": "b@x"}) == "b@x"
+    assert claims_to_username({"sub": "c"}) == "c"
+    assert claims_to_username({}) == ""
+
+
+def test_full_oidc_flow(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.server.app import create_app
+
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+
+    async def go():
+        issuer_holder = {}
+        idp_client = TestClient(TestServer(_mock_idp(issuer_holder)))
+        await idp_client.start_server()
+        issuer_holder["url"] = str(idp_client.make_url("")).rstrip("/")
+
+        cfg = Config.load(
+            {
+                "data_dir": str(tmp_path),
+                "oidc_issuer": issuer_holder["url"],
+                "oidc_client_id": CLIENT_ID,
+                "oidc_client_secret": CLIENT_SECRET,
+            }
+        )
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # 1. login kicks off the redirect to the IdP
+            r = await client.get(
+                "/auth/oidc/login", allow_redirects=False
+            )
+            assert r.status == 302, await r.text()
+            auth_url = r.headers["Location"]
+            assert auth_url.startswith(issuer_holder["url"])
+
+            # 2. "user" visits the IdP, which bounces back with a code
+            q = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(auth_url).query
+            )
+            r = await idp_client.get(
+                "/authorize",
+                params={
+                    "redirect_uri": q["redirect_uri"][0],
+                    "state": q["state"][0],
+                },
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            cb = urllib.parse.urlsplit(r.headers["Location"])
+            cb_q = urllib.parse.parse_qs(cb.query)
+
+            # 3. callback: exchanges code, verifies token, sets session
+            r = await client.get(
+                "/auth/oidc/callback",
+                params={
+                    "code": cb_q["code"][0],
+                    "state": cb_q["state"][0],
+                },
+                allow_redirects=False,
+            )
+            assert r.status == 302, await r.text()
+            cookie = r.cookies.get("gpustack_tpu_session")
+            assert cookie is not None
+
+            # user was JIT-provisioned, session works
+            user = await User.first(username="sso-jane")
+            assert user is not None and not user.is_admin
+            r = await client.get(
+                "/auth/me",
+                headers={"Authorization": f"Bearer {cookie.value}"},
+            )
+            assert (await r.json())["username"] == "sso-jane"
+
+            # tampered state is rejected
+            r = await client.get(
+                "/auth/oidc/callback",
+                params={"code": "x", "state": "0.bad"},
+                allow_redirects=False,
+            )
+            assert r.status == 403
+            # a state without the browser's nonce cookie is rejected
+            # (login-CSRF defense)
+            client.session.cookie_jar.clear()
+            r = await client.get(
+                "/auth/oidc/callback",
+                params={"code": cb_q["code"][0], "state": cb_q["state"][0]},
+                allow_redirects=False,
+            )
+            assert r.status == 403
+
+            # second login reuses the same user (no duplicates)
+            assert len(await User.filter(username="sso-jane")) == 1
+        finally:
+            await client.close()
+            await idp_client.close()
+
+    asyncio.run(go())
+    db.close()
+
+
+def test_rs256_verification():
+    """Real RSA keypair: good signature verifies, bad one rejects."""
+    from cryptography.hazmat.primitives.asymmetric import (
+        padding,
+        rsa,
+    )
+    from cryptography.hazmat.primitives import hashes
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    provider = OIDCProvider("https://idp.example", CLIENT_ID, "")
+    n_bytes = pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")
+    provider._jwks = {
+        "keys": [
+            {
+                "kty": "RSA",
+                "kid": "k1",
+                "n": _b64url(n_bytes),
+                "e": _b64url(pub.e.to_bytes(3, "big")),
+            }
+        ]
+    }
+    claims = {
+        "iss": "https://idp.example",
+        "aud": CLIENT_ID,
+        "exp": int(time.time()) + 60,
+        "sub": "u1",
+    }
+    header = _b64url(
+        json.dumps({"alg": "RS256", "kid": "k1"}).encode()
+    )
+    body = _b64url(json.dumps(claims).encode())
+    sig = key.sign(
+        f"{header}.{body}".encode(),
+        padding.PKCS1v15(),
+        hashes.SHA256(),
+    )
+    token = f"{header}.{body}.{_b64url(sig)}"
+
+    out = asyncio.run(provider.verify_id_token(token))
+    assert out["sub"] == "u1"
+
+    tampered = f"{header}.{body}x.{_b64url(sig)}"
+    with pytest.raises(ValueError):
+        asyncio.run(provider.verify_id_token(tampered))
+    # wrong audience
+    claims_bad = dict(claims, aud="someone-else")
+    body2 = _b64url(json.dumps(claims_bad).encode())
+    sig2 = key.sign(
+        f"{header}.{body2}".encode(),
+        padding.PKCS1v15(),
+        hashes.SHA256(),
+    )
+    with pytest.raises(ValueError, match="audience"):
+        asyncio.run(
+            provider.verify_id_token(f"{header}.{body2}.{_b64url(sig2)}")
+        )
