@@ -52,6 +52,7 @@ class OpenAIServer:
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/embeddings", self.embeddings),
+                web.post("/v1/rerank", self.rerank),
                 web.get("/metrics", self.metrics),
             ]
         )
@@ -119,6 +120,78 @@ class OpenAIServer:
         except Exception as e:  # tokenizer/template errors are client errors
             return _error(400, f"chat template failed: {e}")
         return await self._run(request, body, prompt_ids, chat=True)
+
+    async def rerank(self, request: web.Request) -> web.Response:
+        """Jina/Cohere-style rerank: query + documents → ranked scores.
+
+        v1 scoring is embedding cosine similarity (bi-encoder) over the
+        served model's pooled representations — the reference exposes
+        rerank through its engine registry (gateway/utils.py
+        openai_model_prefixes); a cross-encoder head is the planned
+        upgrade for dedicated reranker checkpoints.
+        """
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        query = body.get("query")
+        documents = body.get("documents")
+        if not isinstance(query, str) or not query:
+            return _error(400, "missing 'query'")
+        if not isinstance(documents, list) or not documents or not all(
+            isinstance(d, str) for d in documents
+        ):
+            return _error(400, "'documents' must be non-empty strings")
+        try:
+            top_n = int(body.get("top_n") or len(documents))
+        except (TypeError, ValueError):
+            return _error(400, "'top_n' must be an integer")
+        if top_n <= 0:
+            return _error(400, "'top_n' must be positive")
+        loop = asyncio.get_running_loop()
+
+        def encode_and_embed():
+            # tokenization stays off the event loop too: hundreds of
+            # long documents would stall every other request
+            batch = [self.engine.tokenizer.encode(query)] + [
+                self.engine.tokenizer.encode(d) for d in documents
+            ]
+            if any(not ids for ids in batch):
+                raise ValueError(
+                    "query/documents must tokenize non-empty"
+                )
+            return batch, self.engine.embed(batch)
+
+        try:
+            batch, vecs = await loop.run_in_executor(
+                None, encode_and_embed
+            )
+        except ValueError as e:
+            return _error(400, str(e))
+        import numpy as _np
+
+        q = _np.asarray(vecs[0])
+        docs = _np.asarray(vecs[1:])
+        # embed() l2-normalizes, so dot == cosine
+        scores = docs @ q
+        order = _np.argsort(-scores)[:top_n]
+        return web.json_response(
+            {
+                "model": self.model_name,
+                "object": "rerank",
+                "results": [
+                    {
+                        "index": int(i),
+                        "relevance_score": float(scores[i]),
+                        "document": {"text": documents[int(i)]},
+                    }
+                    for i in order
+                ],
+                "usage": {
+                    "total_tokens": sum(len(ids) for ids in batch)
+                },
+            }
+        )
 
     async def embeddings(self, request: web.Request) -> web.Response:
         try:

@@ -62,10 +62,13 @@ async def _pick_instance(model: Model) -> Optional[ModelInstance]:
 
 def _extract_usage(payload: dict) -> Tuple[int, int]:
     usage = payload.get("usage") or {}
-    return (
-        int(usage.get("prompt_tokens") or 0),
-        int(usage.get("completion_tokens") or 0),
-    )
+    pt = int(usage.get("prompt_tokens") or 0)
+    ct = int(usage.get("completion_tokens") or 0)
+    if not pt and not ct:
+        # rerank/embeddings-style responses report only total_tokens;
+        # account them as prompt-side so metering still sees the traffic
+        pt = int(usage.get("total_tokens") or 0)
+    return pt, ct
 
 
 async def _record_usage(
@@ -327,6 +330,7 @@ def add_openai_routes(app: web.Application) -> None:
 
     app.router.add_get("/v1/models", list_models)
     app.router.add_post(
-        "/v1/{op:(chat/completions|completions|embeddings)}", proxy
+        "/v1/{op:(chat/completions|completions|embeddings|rerank)}",
+        proxy,
     )
     app.router.add_post("/v1/audio/transcriptions", audio_proxy)

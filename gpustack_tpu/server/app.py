@@ -289,6 +289,23 @@ def create_app(cfg: Config) -> web.Application:
     add_crud_routes(
         app, ModelUsage, "model-usage", readonly=True, admin_read=True
     )
+    from gpustack_tpu.server.collectors import (
+        ResourceEvent,
+        SystemLoad,
+        UsageArchive,
+    )
+
+    add_crud_routes(
+        app, ResourceEvent, "resource-events",
+        readonly=True, admin_read=True,
+    )
+    add_crud_routes(
+        app, SystemLoad, "system-load", readonly=True, admin_read=True
+    )
+    add_crud_routes(
+        app, UsageArchive, "usage-archive",
+        readonly=True, admin_read=True,
+    )
 
     # plugins mount last: they may override nothing but can add routes
     # (reference server/app.py:88 plugin load)

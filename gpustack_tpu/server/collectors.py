@@ -25,12 +25,58 @@ from gpustack_tpu.schemas.usage import ModelUsage
 logger = logging.getLogger(__name__)
 
 
-class WorkerStatusBuffer:
+class BackgroundTask:
+    """start/stop + run-loop-with-exception-logging shared by every
+    collector (one place to fix lifecycle semantics, not four)."""
+
+    task_name = "background-task"
+
+    def __init__(self) -> None:
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._run(), name=self.task_name
+            )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        raise NotImplementedError
+
+
+class PeriodicTask(BackgroundTask):
+    """BackgroundTask ticking ``tick()`` every ``interval`` seconds."""
+
+    def __init__(self, interval: float):
+        super().__init__()
+        self.interval = interval
+
+    async def tick(self) -> None:
+        raise NotImplementedError
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("%s iteration failed", self.task_name)
+            await asyncio.sleep(self.interval)
+
+
+class WorkerStatusBuffer(PeriodicTask):
+    task_name = "status-buffer"
+
     def __init__(self, flush_interval: float = 2.0):
-        self.flush_interval = flush_interval
+        super().__init__(flush_interval)
         # worker_id -> (status, heartbeat_at)
         self._pending: Dict[int, Tuple[object, str]] = {}
-        self._task: Optional[asyncio.Task] = None
 
     async def put(self, worker: Worker, status, heartbeat_at: str) -> None:
         """Buffer a status refresh; flush immediately on a state
@@ -46,26 +92,8 @@ class WorkerStatusBuffer:
             return
         self._pending[worker.id] = (status, heartbeat_at)
 
-    def start(self) -> None:
-        if self._task is None:
-            self._task = asyncio.create_task(
-                self._loop(), name="status-buffer"
-            )
-
-    def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
-
-    async def _loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.flush_interval)
-            try:
-                await self.flush()
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                logger.exception("status buffer flush failed")
+    async def tick(self) -> None:
+        await self.flush()
 
     async def flush(self) -> int:
         pending, self._pending = self._pending, {}
@@ -89,6 +117,170 @@ class WorkerStatusBuffer:
 
 
 @register_record
+class ResourceEvent(Record):
+    """Lifecycle audit row (reference resource_events table +
+    ResourceEventLogger, server/server.py:505-559): who/what changed
+    state, kept as a queryable history separate from logs."""
+
+    __kind__ = "resource_event"
+    __indexes__ = ("kind", "resource_id")
+
+    kind: str = ""           # "model_instance" | "worker" | ...
+    resource_id: int = 0
+    name: str = ""
+    event: str = ""          # e.g. "state: scheduled -> running"
+    detail: str = ""
+
+
+class ResourceEventLogger(BackgroundTask):
+    """Bus subscriber turning state transitions into ResourceEvent rows."""
+
+    task_name = "resource-events"
+    WATCHED = ("model_instance", "worker")
+    RETENTION_DAYS = 30.0
+
+    async def _run(self) -> None:
+        from gpustack_tpu.orm.record import Record as _Record
+        from gpustack_tpu.server.bus import EventType
+
+        subscriber = _Record.bus().subscribe(kinds=set(self.WATCHED))
+        try:
+            while True:
+                event = await subscriber.get()
+                try:
+                    if event.type == EventType.RESYNC:
+                        # bus overflow: the audit trail must show the
+                        # gap, not silently skip transitions
+                        await ResourceEvent.create(
+                            ResourceEvent(
+                                kind=event.kind or "*",
+                                event="resync (events may be missing)",
+                            )
+                        )
+                        continue
+                    if event.type not in (
+                        EventType.CREATED, EventType.UPDATED,
+                        EventType.DELETED,
+                    ):
+                        continue
+                    await self.record(event)
+                except Exception:
+                    logger.exception("resource event write failed")
+        finally:
+            subscriber.close()
+
+    @staticmethod
+    async def record(event) -> None:
+        data = event.data or {}
+        changes = event.changes or {}
+        if event.type.value == "DELETED":
+            text = "deleted"
+        elif event.type.value == "CREATED":
+            text = f"created (state: {data.get('state', '')})"
+        elif "state" in changes:
+            old, new = changes["state"]
+            text = f"state: {old} -> {new}"
+        else:
+            return  # non-state updates are noise, not lifecycle
+        await ResourceEvent.create(
+            ResourceEvent(
+                kind=event.kind,
+                resource_id=event.id,
+                name=str(data.get("name", "")),
+                event=text,
+                detail=str(data.get("state_message", ""))[:500],
+            )
+        )
+
+    @classmethod
+    async def prune(cls) -> int:
+        """Delete events past retention (called by SystemLoadCollector's
+        periodic tick — one pruning heartbeat covers both tables)."""
+        return await _prune_old(ResourceEvent, cls.RETENTION_DAYS)
+
+
+async def _prune_old(record_cls, retention_days: float) -> int:
+    import datetime
+
+    cutoff = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(days=retention_days)
+    ).isoformat()
+    deleted = 0
+    while True:
+        old = await record_cls.filter_created_before(cutoff, limit=1000)
+        if not old:
+            return deleted
+        for row in old:
+            await row.delete()
+        deleted += len(old)
+
+
+@register_record
+class SystemLoad(Record):
+    """Periodic fleet-load sample (reference SystemLoadCollector,
+    server/system_load.py): dashboard history without re-aggregating the
+    live workers table."""
+
+    __kind__ = "system_load"
+    __indexes__ = ()
+
+    workers_total: int = 0
+    workers_ready: int = 0
+    chips_total: int = 0
+    chips_allocated: int = 0
+    memory_used_bytes: int = 0
+    memory_total_bytes: int = 0
+
+
+class SystemLoadCollector(PeriodicTask):
+    task_name = "system-load"
+    RETENTION_DAYS = 7.0
+
+    def __init__(self, interval: float = 60.0):
+        super().__init__(interval)
+
+    async def tick(self) -> None:
+        await self.collect_once()
+        await _prune_old(SystemLoad, self.RETENTION_DAYS)
+        await ResourceEventLogger.prune()
+
+    async def collect_once(self) -> SystemLoad:
+        from gpustack_tpu.policies.allocatable import CLAIMING_STATES
+        from gpustack_tpu.schemas import ModelInstance
+
+        workers = await Worker.filter(limit=None)
+        # same claiming-state filter as the scheduler's allocatable math:
+        # an ERROR instance's chips are free, not allocated
+        instances = [
+            i for i in await ModelInstance.filter(limit=None)
+            if i.state in CLAIMING_STATES
+        ]
+        allocated = sum(
+            len(i.chip_indexes or []) for i in instances
+        ) + sum(
+            len(s.chip_indexes or [])
+            for i in instances
+            for s in i.subordinate_workers
+        )
+        sample = SystemLoad(
+            workers_total=len(workers),
+            workers_ready=sum(
+                1 for w in workers if w.state == WorkerState.READY
+            ),
+            chips_total=sum(w.total_chips for w in workers),
+            chips_allocated=allocated,
+            memory_used_bytes=sum(
+                w.status.memory_used_bytes for w in workers
+            ),
+            memory_total_bytes=sum(
+                w.status.memory_total_bytes for w in workers
+            ),
+        )
+        return await SystemLoad.create(sample)
+
+
+@register_record
 class UsageArchive(Record):
     """Daily cold aggregate of model usage (reference metered-usage
     archival tables)."""
@@ -106,36 +298,19 @@ class UsageArchive(Record):
     total_tokens: int = 0
 
 
-class UsageArchiver:
+class UsageArchiver(PeriodicTask):
+    task_name = "usage-archiver"
+
     def __init__(
         self,
         retention_days: float = 7.0,
         interval: float = 3600.0,
     ):
+        super().__init__(interval)
         self.retention_days = retention_days
-        self.interval = interval
-        self._task: Optional[asyncio.Task] = None
 
-    def start(self) -> None:
-        if self._task is None:
-            self._task = asyncio.create_task(
-                self._loop(), name="usage-archiver"
-            )
-
-    def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
-            self._task = None
-
-    async def _loop(self) -> None:
-        while True:
-            try:
-                await self.archive_once()
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                logger.exception("usage archival failed")
-            await asyncio.sleep(self.interval)
+    async def tick(self) -> None:
+        await self.archive_once()
 
     BATCH = 10_000
 

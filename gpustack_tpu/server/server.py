@@ -69,6 +69,10 @@ class Server:
         cfg = self.cfg
         self.db = Database(cfg.database_path)
         run_migrations(self.db)
+        # record classes register at module import; collector-owned
+        # tables (resource_event, system_load, usage_archive) must be
+        # registered BEFORE create_all_tables or they silently miss
+        import gpustack_tpu.server.collectors  # noqa: F401
         Record.bind(self.db, self.bus)
         Record.create_all_tables(self.db)
         if not cfg.ha:
@@ -114,6 +118,8 @@ class Server:
         )
 
         from gpustack_tpu.server.collectors import (
+            ResourceEventLogger,
+            SystemLoadCollector,
             UsageArchiver,
             WorkerStatusBuffer,
         )
@@ -122,6 +128,8 @@ class Server:
         self.status_buffer.start()
         app["status_buffer"] = self.status_buffer
         self.usage_archiver = UsageArchiver()
+        self.resource_events = ResourceEventLogger()
+        self.system_load = SystemLoadCollector()
         from gpustack_tpu.server.update_check import UpdateChecker
 
         self.update_checker = UpdateChecker()
@@ -136,6 +144,8 @@ class Server:
                 self.scheduler.start()
                 self.syncer.start()
                 self.usage_archiver.start()
+                self.resource_events.start()
+                self.system_load.start()
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
@@ -177,6 +187,10 @@ class Server:
             self.usage_archiver.stop()
         if hasattr(self, "update_checker"):
             self.update_checker.stop()
+        if hasattr(self, "resource_events"):
+            self.resource_events.stop()
+        if hasattr(self, "system_load"):
+            self.system_load.stop()
         for t in self._tasks:
             t.cancel()
         if self._runner:

@@ -164,3 +164,35 @@ def test_error_paths(engine):
         assert "max_seq_len" in (await r.json())["error"]["message"]
 
     _client_run(engine, go)
+
+
+def test_rerank_endpoint(engine):
+    async def go(client):
+        r = await client.post(
+            "/v1/rerank",
+            json={
+                "query": "hello world",
+                "documents": [
+                    "hello world greetings",
+                    "completely different text about turtles",
+                    "hello world again",
+                ],
+                "top_n": 2,
+            },
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "rerank"
+        assert len(data["results"]) == 2
+        scores = [x["relevance_score"] for x in data["results"]]
+        assert scores == sorted(scores, reverse=True)
+        assert all(-1.01 <= s <= 1.01 for s in scores)
+        # bad requests
+        r = await client.post("/v1/rerank", json={"query": "x"})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/rerank", json={"query": "", "documents": ["a"]}
+        )
+        assert r.status == 400
+
+    _client_run(engine, go)

@@ -163,3 +163,82 @@ def test_detect_categories(db):
     assert "moe" in cats and "llm" in cats
     # unresolvable source: leave user input alone
     assert detect_categories(Model(preset="nope")) == []
+
+
+def test_resource_event_logger_records_transitions(db):
+    from gpustack_tpu.server.bus import Event, EventType
+    from gpustack_tpu.server.collectors import (
+        ResourceEvent,
+        ResourceEventLogger,
+    )
+
+    async def go():
+        await ResourceEventLogger.record(
+            Event(
+                kind="model_instance", type=EventType.CREATED, id=1,
+                data={"name": "m-0", "state": "pending"},
+            )
+        )
+        await ResourceEventLogger.record(
+            Event(
+                kind="model_instance", type=EventType.UPDATED, id=1,
+                data={"name": "m-0", "state": "running"},
+                changes={"state": ("scheduled", "running")},
+            )
+        )
+        # non-state updates are not logged
+        await ResourceEventLogger.record(
+            Event(
+                kind="model_instance", type=EventType.UPDATED, id=1,
+                data={"name": "m-0"},
+                changes={"heartbeat_at": ("a", "b")},
+            )
+        )
+        rows = await ResourceEvent.filter(limit=None)
+        assert len(rows) == 2
+        assert rows[0].event.startswith("created")
+        assert rows[1].event == "state: scheduled -> running"
+
+    asyncio.run(go())
+
+
+def test_system_load_collector_snapshot(db):
+    from gpustack_tpu.schemas import ModelInstance, TPUChip
+    from gpustack_tpu.server.collectors import SystemLoadCollector
+
+    async def go():
+        await Worker.create(
+            Worker(
+                name="w1", state=WorkerState.READY,
+                status=WorkerStatus(
+                    chips=[
+                        TPUChip(index=i, hbm_bytes=16 * 2**30)
+                        for i in range(8)
+                    ],
+                    memory_total_bytes=100,
+                    memory_used_bytes=40,
+                ),
+            )
+        )
+        from gpustack_tpu.schemas import ModelInstanceState
+
+        await ModelInstance.create(
+            ModelInstance(
+                name="i1", worker_id=1, chip_indexes=[0, 1],
+                state=ModelInstanceState.RUNNING,
+            )
+        )
+        # ERROR instances do not count as allocated (scheduler parity)
+        await ModelInstance.create(
+            ModelInstance(
+                name="i2", worker_id=1, chip_indexes=[2, 3],
+                state=ModelInstanceState.ERROR,
+            )
+        )
+        sample = await SystemLoadCollector().collect_once()
+        assert sample.workers_total == 1 and sample.workers_ready == 1
+        assert sample.chips_total == 8
+        assert sample.chips_allocated == 2
+        assert sample.memory_used_bytes == 40
+
+    asyncio.run(go())
