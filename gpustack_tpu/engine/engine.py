@@ -297,6 +297,10 @@ class LLMEngine:
             "kv_cache_misses": (
                 self.host_kv_cache.misses if self.host_kv_cache else 0
             ),
+            "kv_cache_prefix_hits": (
+                self.host_kv_cache.prefix_hits
+                if self.host_kv_cache else 0
+            ),
             "kv_cache_host_bytes": (
                 self.host_kv_cache.bytes_used if self.host_kv_cache else 0
             ),
@@ -358,20 +362,53 @@ class LLMEngine:
             k = jnp.asarray(k_np)
             v = jnp.asarray(v_np)
         else:
-            last_logits, k, v = self.runner.prefill(padded, len(ids))
+            prefix = (
+                kv_cache.find_longest_prefix(ids)
+                if kv_cache is not None else None
+            )
+            use_prefix = False
+            if prefix is not None:
+                (_, pk, pv), plen = prefix
+                suffix = ids[plen:]
+                sb = self.runner.bucket_for(len(suffix))
+                # cache bounds contract: the suffix BLOCK (bucketed) must
+                # fit above the prefix — dynamic_update_slice clamps
+                # out-of-range writes and would silently corrupt the tail
+                use_prefix = plen + sb <= self.max_seq_len
+            if use_prefix:
+                # prefix reuse: upload the cached prefix KV, prefill
+                # only the suffix from that offset. Counted here, not in
+                # the lookup — a match the bounds guard rejected saved
+                # nothing.
+                kv_cache.prefix_hits += 1
+                total_bucket = self.runner.bucket_for(plen + sb)
+                suffix_padded = list(suffix) + [0] * (sb - len(suffix))
+                last_logits, k, v = self.runner.prefill_with_prefix(
+                    pk, pv, plen, suffix_padded, len(suffix),
+                    total_bucket,
+                )
+            else:
+                last_logits, k, v = self.runner.prefill(padded, len(ids))
             if kv_cache is not None:
                 def copy_to_host(
                     key=cache_key, logits=last_logits, k_=k, v_=v,
-                    kv_cache=kv_cache,
+                    kv_cache=kv_cache, prompt=tuple(ids),
+                    store_bucket=bucket,
                 ):
                     try:
+                        # trim to the prompt's own bucket: the prefix
+                        # path returns total_bucket-wide arrays, and a
+                        # wider-than-bucket_for(prompt) entry would break
+                        # the Pb <= total_bucket invariant on later reuse
+                        # (and waste host bytes)
                         kv_cache.put(
                             key,
                             (
                                 np.asarray(logits),
-                                np.asarray(k_),
-                                np.asarray(v_),
+                                np.asarray(k_[:, :store_bucket]),
+                                np.asarray(v_[:, :store_bucket]),
                             ),
+                            prompt_ids=prompt,
                         )
                     except RuntimeError as e:
                         # non-addressable shards (defensive: backends

@@ -30,15 +30,26 @@ def _prompt_key(bucket: int, prompt_ids, true_len: int) -> str:
 
 
 class HostKVCache:
-    """Byte-bounded LRU of host-resident prefill results."""
+    """Byte-bounded LRU of host-resident prefill results.
+
+    Each entry optionally records its true prompt tokens, enabling
+    PREFIX reuse: a new prompt that extends a cached one re-uploads the
+    cached K/V and prefills only the suffix (prefill-from-offset in the
+    runner) — the LMCache-style long-context lever for shared system
+    prompts and agent loops.
+    """
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
-        self._lru: "OrderedDict[str, Tuple[Any, ...]]" = OrderedDict()
+        # key -> (arrays, prompt_ids tuple or None)
+        self._lru: "OrderedDict[str, Tuple[Tuple[Any, ...], Any]]" = (
+            OrderedDict()
+        )
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.prefix_hits = 0
 
     @staticmethod
     def key(bucket: int, prompt_ids, true_len: int) -> str:
@@ -52,9 +63,44 @@ class HostKVCache:
                 return None
             self._lru.move_to_end(key)
             self.hits += 1
-            return entry
+            return entry[0]
 
-    def put(self, key: str, arrays: Tuple[Any, ...]) -> None:
+    def find_longest_prefix(
+        self, prompt_ids, min_len: int = 32
+    ) -> Optional[Tuple[Tuple[Any, ...], int]]:
+        """Cached entry whose TRUE prompt is the longest proper prefix
+        of ``prompt_ids`` (>= min_len tokens); returns (arrays, plen).
+        The caller counts a prefix hit only when it actually USES the
+        match (bounds guards may still reject it)."""
+        prompt = tuple(prompt_ids)
+        # snapshot under the lock, compare outside: the token-by-token
+        # comparisons are O(entries x plen) and must not stall the
+        # scheduler thread against the copy worker
+        with self._lock:
+            candidates = [
+                (key, arrays, entry_prompt)
+                for key, (arrays, entry_prompt) in self._lru.items()
+                if entry_prompt is not None
+                and min_len <= len(entry_prompt) < len(prompt)
+            ]
+        best = None
+        best_key = None
+        best_len = min_len - 1
+        for key, arrays, entry_prompt in candidates:
+            plen = len(entry_prompt)
+            if plen > best_len and prompt[:plen] == entry_prompt:
+                best, best_key, best_len = (arrays, plen), key, plen
+        if best_key is not None:
+            with self._lock:
+                if best_key in self._lru:
+                    # refresh recency: a hot shared prefix hit only via
+                    # extension must not be the first eviction victim
+                    self._lru.move_to_end(best_key)
+        return best
+
+    def put(
+        self, key: str, arrays: Tuple[Any, ...], prompt_ids=None
+    ) -> None:
         size = sum(a.nbytes for a in arrays)
         if size > self.max_bytes:
             return  # single entry larger than the whole budget
@@ -62,10 +108,13 @@ class HostKVCache:
             if key in self._lru:
                 self._lru.move_to_end(key)
                 return
-            self._lru[key] = arrays
+            self._lru[key] = (
+                arrays,
+                tuple(prompt_ids) if prompt_ids is not None else None,
+            )
             self._bytes += size
             while self._bytes > self.max_bytes and self._lru:
-                _, evicted = self._lru.popitem(last=False)
+                _, (evicted, _) = self._lru.popitem(last=False)
                 self._bytes -= sum(a.nbytes for a in evicted)
 
     @property

@@ -142,6 +142,7 @@ class ModelRunner:
         self._embeds: Dict[int, Any] = {}
         self._verifies: Dict[int, Any] = {}
         self._ingests: Dict[int, Any] = {}
+        self._prefix_prefills: Dict[Tuple[int, int, int], Any] = {}
 
     # -- state ------------------------------------------------------------
 
@@ -222,6 +223,67 @@ class ModelRunner:
             self._prefills[Tb] = fn
         tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
         return fn(self.params, tokens, jnp.int32(true_len))
+
+    def _prefix_prefill_impl(
+        self, params, prefix_k, prefix_v, prefix_len, tokens, true_len,
+        *, total_bucket, attn_impl="xla",
+    ):
+        """Continue prefill from a cached prefix (prefix-granular host
+        KV cache): seed the scratch cache with the prefix K/V, run the
+        suffix at absolute positions ``prefix_len + j``. Pad slots the
+        prefix carried above ``prefix_len`` are overwritten by the
+        suffix's own writes before any query can attend them (same
+        invisible-pad argument as bucketed prefill).
+
+        prefix_k/v: [L, Pb, H, hd]; tokens: [1, Tsb];
+        returns (last_logits [V], k, v [L, total_bucket, H, hd]).
+        """
+        Pb = prefix_k.shape[1]
+        cache = KVCache.create(self.cfg, 1, total_bucket)
+        cache = KVCache(
+            k=cache.k.at[:, 0, :Pb].set(prefix_k),
+            v=cache.v.at[:, 0, :Pb].set(prefix_v),
+        )
+        Tsb = tokens.shape[1]
+        positions = (
+            prefix_len + jnp.arange(Tsb, dtype=jnp.int32)
+        )[None, :]
+        logits, cache = forward(
+            params, self.cfg, tokens, positions, cache,
+            attn_impl=attn_impl,
+            mesh=self.mesh if attn_impl == "ring" else None,
+        )
+        last = jnp.take(logits[0], true_len - 1, axis=0)
+        return last, cache.k[:, 0], cache.v[:, 0]
+
+    def prefill_with_prefix(
+        self, prefix_k, prefix_v, prefix_len: int,
+        suffix_ids, suffix_true_len: int, total_bucket: int,
+    ):
+        """suffix_ids must be pre-padded to a prefill bucket."""
+        Pb = prefix_k.shape[1]
+        Tsb = len(suffix_ids)
+        key = (Pb, Tsb, total_bucket)
+        fn = self._prefix_prefills.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    self._prefix_prefill_impl,
+                    total_bucket=total_bucket,
+                    attn_impl="ring" if self.sp_mode else "xla",
+                )
+            )
+            self._prefix_prefills[key] = fn
+        tokens = jnp.asarray(suffix_ids, jnp.int32)[None, :]
+        return fn(
+            self.params,
+            jnp.asarray(prefix_k),
+            jnp.asarray(prefix_v),
+            jnp.int32(prefix_len),
+            tokens,
+            # logits cover the suffix only
+            jnp.int32(suffix_true_len),
+        )
 
     # -- embeddings -------------------------------------------------------
 

@@ -82,3 +82,81 @@ def test_engine_kv_cache_hit_is_output_identical(shared):
         assert eng.health()["kv_cache_misses"] == 2
     finally:
         eng.stop()
+
+
+def test_prefix_prefill_matches_full_prefill(shared):
+    """Runner-level: continue-from-prefix == prefill of the whole
+    prompt, on the logits that matter and the true cache region."""
+    from gpustack_tpu.engine.runner import ModelRunner
+
+    cfg, params = shared
+    runner = ModelRunner(cfg, params, max_slots=2, max_seq_len=128)
+    prefix = [5, 17, 42, 99, 7, 23, 81, 3] * 5       # 40 tokens
+    suffix = [9, 4, 33]
+    full = prefix + suffix
+
+    fb = runner.bucket_for(len(full))
+    full_padded = list(full) + [0] * (fb - len(full))
+    last_full, k_full, v_full = runner.prefill(full_padded, len(full))
+
+    pb = runner.bucket_for(len(prefix))
+    pref_padded = list(prefix) + [0] * (pb - len(prefix))
+    _, pk, pv = runner.prefill(pref_padded, len(prefix))
+
+    sb = runner.bucket_for(len(suffix))
+    suf_padded = list(suffix) + [0] * (sb - len(suffix))
+    # total bucket must cover prefix + suffix BLOCK (bounds contract)
+    tb = runner.bucket_for(len(prefix) + sb)
+    last_pre, k_pre, v_pre = runner.prefill_with_prefix(
+        np.asarray(pk), np.asarray(pv), len(prefix),
+        suf_padded, len(suffix), tb,
+    )
+    np.testing.assert_allclose(
+        np.asarray(last_pre), np.asarray(last_full),
+        rtol=2e-2, atol=2e-2,
+    )
+    # KV over the true token range matches
+    np.testing.assert_allclose(
+        np.asarray(k_pre[:, : len(full)], np.float32),
+        np.asarray(k_full[:, : len(full)], np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_engine_prefix_reuse_is_output_identical(shared):
+    cfg, params = shared
+    prefix = [5, 17, 42, 99, 7, 23, 81, 3] * 5
+    extended = prefix + [9, 4, 33, 7]
+
+    def gen(eng, prompt):
+        return eng.generate(
+            GenRequest(prompt_ids=prompt, max_tokens=6, temperature=0.0),
+            timeout=180,
+        ).output_ids
+
+    # reference: no cache at all
+    plain = LLMEngine(cfg, params, max_slots=2, max_seq_len=128)
+    plain.start()
+    try:
+        want = gen(plain, extended)
+    finally:
+        plain.stop()
+
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=128, host_kv_cache_mb=64
+    )
+    eng.start()
+    try:
+        gen(eng, prefix)                      # seeds the cache
+        import time as _time
+
+        for _ in range(100):
+            if eng.health()["kv_cache_host_bytes"] > 0:
+                break
+            _time.sleep(0.1)
+        got = gen(eng, extended)              # prefix hit
+        h = eng.health()
+        assert h["kv_cache_prefix_hits"] == 1, h
+        assert got == want
+    finally:
+        eng.stop()
