@@ -372,9 +372,22 @@ class LLMEngine:
                 suffix = ids[plen:]
                 sb = self.runner.bucket_for(len(suffix))
                 # cache bounds contract: the suffix BLOCK (bucketed) must
-                # fit above the prefix — dynamic_update_slice clamps
-                # out-of-range writes and would silently corrupt the tail
-                use_prefix = plen + sb <= self.max_seq_len
+                # fit above the prefix within a REAL bucket —
+                # dynamic_update_slice clamps out-of-range writes and
+                # would silently corrupt the tail
+                use_prefix = (
+                    plen + sb <= self.runner.prefill_buckets[-1]
+                )
+                # flash-bucket prompts keep the plain prefill path: the
+                # offset variant runs XLA attention, which is exactly
+                # what flash exists to avoid at those lengths
+                if (
+                    use_prefix
+                    and self.runner.attn_impl_for(
+                        self.runner.bucket_for(plen + sb)
+                    ) == "flash"
+                ):
+                    use_prefix = False
             if use_prefix:
                 # prefix reuse: upload the cached prefix KV, prefill
                 # only the suffix from that offset. Counted here, not in

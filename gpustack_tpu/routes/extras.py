@@ -32,6 +32,27 @@ from gpustack_tpu.server.catalog import get_catalog
 logger = logging.getLogger(__name__)
 
 
+from gpustack_tpu.utils.cache import locked_cached
+
+
+@locked_cached(ttl=60.0)
+async def _evaluate_cached(spec_json: str):
+    """One evaluation per distinct spec per minute, concurrent callers
+    coalesced (reference evaluator.py:56-62 TTL cache + rate limiter).
+    Negative results cache too — a broken HF repo id polled by a UI must
+    not re-probe the network every second. Returns ("ok", evaluation) or
+    ("err", reason)."""
+    spec = Model.model_validate(json.loads(spec_json))
+    loop = asyncio.get_running_loop()
+    try:
+        evaluation = await loop.run_in_executor(
+            None, evaluate_model, spec
+        )
+        return ("ok", evaluation)
+    except EvaluationError as e:
+        return ("err", str(e))
+
+
 def add_extra_routes(app: web.Application) -> None:
     async def catalog(request: web.Request):
         return web.json_response(
@@ -49,14 +70,25 @@ def add_extra_routes(app: web.Application) -> None:
             spec = Model.model_validate(body)
         except Exception as e:
             return json_error(400, f"invalid model spec: {e}")
-        loop = asyncio.get_running_loop()
-        try:
-            evaluation = await loop.run_in_executor(
-                None, evaluate_model, spec
-            )
-        except EvaluationError as e:
+        # key carries exactly the Model fields evaluation reads, under
+        # their real names — the cached helper re-validates a Model from
+        # this json
+        cache_key = json.dumps(
+            {
+                "name": spec.name,
+                "preset": spec.preset,
+                "local_path": spec.local_path,
+                "huggingface_repo_id": spec.huggingface_repo_id,
+                "quantization": spec.quantization,
+                "max_seq_len": spec.max_seq_len,
+                "max_slots": spec.max_slots,
+            },
+            sort_keys=True,
+        )
+        status, evaluation = await _evaluate_cached(cache_key)
+        if status == "err":
             return web.json_response(
-                {"compatible": False, "reason": str(e)}
+                {"compatible": False, "reason": evaluation}
             )
         from gpustack_tpu.policies import filter_workers
 

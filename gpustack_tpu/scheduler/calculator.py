@@ -106,6 +106,10 @@ def resolve_model_config(model: Model):
     raise EvaluationError("model has no source (preset/local_path/hf)")
 
 
+from gpustack_tpu.utils.profiling import timed
+
+
+@timed(threshold_s=5.0, name="scheduler.evaluate_model")
 def evaluate_model(model: Model) -> ModelEvaluation:
     cfg = resolve_model_config(model)
     weight_bits = 8 if model.quantization == "int8" else 16

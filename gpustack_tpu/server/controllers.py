@@ -83,6 +83,26 @@ class Controller:
 class ModelController(Controller):
     record_cls = Model
 
+    def __init__(self) -> None:
+        super().__init__()
+        from gpustack_tpu.utils.workqueue import WorkQueue
+
+        # reconciles run through a coalescing work queue (reference
+        # server/workqueue.py): a burst of updates to one model collapses
+        # to a single reconcile, and a failed reconcile retries with
+        # exponential backoff instead of being dropped
+        self._queue = WorkQueue(
+            self._reconcile, name="model-reconcile"
+        )
+
+    def start(self) -> None:
+        super().start()
+        self._queue.start()
+
+    def stop(self) -> None:
+        super().stop()
+        self._queue.stop()
+
     async def handle(self, event: Event) -> None:
         if event.type == EventType.DELETED:
             for inst in await ModelInstance.filter(model_id=event.id):
@@ -93,7 +113,10 @@ class ModelController(Controller):
             ):
                 await route.delete()
             return
-        model = await Model.get(event.id)
+        self._queue.add(event.id)
+
+    async def _reconcile(self, model_id: int) -> None:
+        model = await Model.get(model_id)
         if model is None:
             return
         await self._sync_replicas(model)

@@ -31,10 +31,16 @@ class TunnelClient:
         local_port: int,
         reconnect_delay: float = 3.0,
     ):
+        from gpustack_tpu.utils.workqueue import ExponentialBackoff
+
         self.server_url = server_url.rstrip("/")
         self.token = token
         self.local_port = local_port
-        self.reconnect_delay = reconnect_delay
+        # exponential reconnect backoff: a down server must not be
+        # hammered at a fixed cadence by every NAT'd worker at once
+        self._backoff = ExponentialBackoff(
+            base=reconnect_delay, cap=60.0
+        )
         self._tasks: Dict[int, asyncio.Task] = {}
         self._stopping = False
         self.connected = asyncio.Event()
@@ -48,7 +54,7 @@ class TunnelClient:
             except (aiohttp.ClientError, OSError) as e:
                 logger.warning("tunnel dropped: %s; reconnecting", e)
             self.connected.clear()
-            await asyncio.sleep(self.reconnect_delay)
+            await asyncio.sleep(self._backoff.next_delay("ws"))
 
     async def _run_once(self) -> None:
         ws_url = self.server_url + "/v2/tunnel"
@@ -59,6 +65,7 @@ class TunnelClient:
                 heartbeat=30.0,
             ) as ws:
                 self.connected.set()
+                self._backoff.reset("ws")
                 logger.info("tunnel established to %s", ws_url)
                 local = aiohttp.ClientSession()
                 try:

@@ -1,0 +1,84 @@
+"""TTL cache + ``locked_cached`` decorator (reference server/cache.py
+TTL cache + locked_cached: expensive lookups computed once per TTL with
+concurrent callers coalesced onto one in-flight computation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import time
+from typing import Any, Awaitable, Callable, Dict, Hashable, Optional, Tuple
+
+
+class TTLCache:
+    def __init__(self, ttl: float = 30.0, max_entries: int = 1024):
+        self.ttl = ttl
+        self.max_entries = max_entries
+        self._data: Dict[Hashable, Tuple[float, Any]] = {}
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        entry = self._data.get(key)
+        if entry is None:
+            return None
+        expires, value = entry
+        if time.monotonic() >= expires:
+            del self._data[key]
+            return None
+        return value
+
+    def set(self, key: Hashable, value: Any) -> None:
+        if len(self._data) >= self.max_entries:
+            # drop expired first; then oldest-expiring
+            now = time.monotonic()
+            for k in [
+                k for k, (exp, _) in self._data.items() if exp <= now
+            ]:
+                del self._data[k]
+            while len(self._data) >= self.max_entries:
+                oldest = min(
+                    self._data, key=lambda k: self._data[k][0]
+                )
+                del self._data[oldest]
+        self._data[key] = (time.monotonic() + self.ttl, value)
+
+    def invalidate(self, key: Hashable = None) -> None:
+        if key is None:
+            self._data.clear()
+        else:
+            self._data.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+def locked_cached(ttl: float = 30.0, max_entries: int = 1024):
+    """Async memoization with TTL; concurrent callers for the same key
+    share ONE in-flight computation (a thundering herd of identical
+    expensive lookups — catalog fetches, HF config probes — collapses to
+    a single call)."""
+
+    def decorator(fn: Callable[..., Awaitable[Any]]):
+        cache = TTLCache(ttl=ttl, max_entries=max_entries)
+        locks: Dict[Hashable, asyncio.Lock] = {}
+
+        @functools.wraps(fn)
+        async def wrapper(*args, **kwargs):
+            key = (args, tuple(sorted(kwargs.items())))
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+            lock = locks.setdefault(key, asyncio.Lock())
+            async with lock:
+                hit = cache.get(key)          # filled while we waited?
+                if hit is not None:
+                    return hit
+                value = await fn(*args, **kwargs)
+                if value is not None:
+                    cache.set(key, value)
+                return value
+
+        wrapper.cache = cache
+        return wrapper
+
+    return decorator

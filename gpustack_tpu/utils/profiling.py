@@ -1,0 +1,87 @@
+"""Slow-call tracing (reference utils/profiling.py time_decorator +
+the per-minute DB query counter, server/init_db.py::get_query_count).
+
+``timed`` logs any call slower than its threshold; ``CallStats``
+accumulates per-name counters a /metrics exporter or debug endpoint can
+read.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from typing import Dict
+
+logger = logging.getLogger(__name__)
+
+
+class CallStats:
+    """Thread-safe per-name call counters (count, total seconds, max)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            s = self._stats.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            s["count"] += 1
+            s["total_s"] += seconds
+            s["max_s"] = max(s["max_s"], seconds)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+
+STATS = CallStats()
+
+
+def timed(threshold_s: float = 1.0, name: str = ""):
+    """Decorator (sync or async): record call stats; warn when a call
+    exceeds ``threshold_s``."""
+
+    def decorator(fn):
+        label = name or f"{fn.__module__}.{fn.__qualname__}"
+
+        def finish(start: float) -> None:
+            elapsed = time.monotonic() - start
+            STATS.record(label, elapsed)
+            if elapsed > threshold_s:
+                logger.warning(
+                    "slow call: %s took %.2fs (threshold %.2fs)",
+                    label, elapsed, threshold_s,
+                )
+
+        if _is_coroutine(fn):
+            @functools.wraps(fn)
+            async def async_wrapper(*args, **kwargs):
+                start = time.monotonic()
+                try:
+                    return await fn(*args, **kwargs)
+                finally:
+                    finish(start)
+
+            return async_wrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            start = time.monotonic()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                finish(start)
+
+        return wrapper
+
+    return decorator
+
+
+def _is_coroutine(fn) -> bool:
+    import asyncio
+
+    return asyncio.iscoroutinefunction(fn)

@@ -1,0 +1,122 @@
+"""Coalescing work queue + exponential backoff.
+
+Reference parity: server/workqueue.py (WorkQueue at :130 +
+ExponentialBackoff) — reconcilers enqueue keys, duplicate keys coalesce
+while queued, failed items re-enqueue with capped exponential delay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Awaitable, Callable, Dict, Hashable, Optional, Set
+
+logger = logging.getLogger(__name__)
+
+
+class ExponentialBackoff:
+    """Per-key capped exponential backoff with jitter."""
+
+    def __init__(
+        self,
+        base: float = 1.0,
+        cap: float = 300.0,
+        jitter: float = 0.1,
+    ):
+        self.base = base
+        self.cap = cap
+        self.jitter = jitter
+        self._failures: Dict[Hashable, int] = {}
+
+    def next_delay(self, key: Hashable) -> float:
+        n = self._failures.get(key, 0)
+        self._failures[key] = n + 1
+        delay = min(self.cap, self.base * (2 ** n))
+        return delay * (1 + random.uniform(-self.jitter, self.jitter))
+
+    def reset(self, key: Hashable) -> None:
+        self._failures.pop(key, None)
+
+    def failures(self, key: Hashable) -> int:
+        return self._failures.get(key, 0)
+
+
+class WorkQueue:
+    """Keys in, handler out; duplicates coalesce while queued.
+
+    ``add(key)`` is idempotent while the key waits; a key re-added
+    during its own processing is processed again afterwards (level
+    triggering, not edge). Handler failures re-enqueue the key after an
+    ExponentialBackoff delay; success resets the key's backoff.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[Hashable], Awaitable[None]],
+        *,
+        backoff: Optional[ExponentialBackoff] = None,
+        name: str = "workqueue",
+    ):
+        self.handler = handler
+        self.backoff = backoff or ExponentialBackoff()
+        self.name = name
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queued: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._dirty: Set[Hashable] = set()
+        self._task: Optional[asyncio.Task] = None
+        self.processed = 0
+        self.retried = 0
+
+    def add(self, key: Hashable) -> None:
+        if key in self._processing:
+            # level-triggered: reprocess after the current run finishes
+            self._dirty.add(key)
+            return
+        if key in self._queued:
+            return
+        self._queued.add(key)
+        self._queue.put_nowait(key)
+
+    def __len__(self) -> int:
+        return len(self._queued)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._loop(), name=self.name
+            )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            key = await self._queue.get()
+            self._queued.discard(key)
+            self._processing.add(key)
+            try:
+                await self.handler(key)
+                self.backoff.reset(key)
+                self.processed += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                delay = self.backoff.next_delay(key)
+                self.retried += 1
+                logger.exception(
+                    "%s: handler failed for %r; retry in %.1fs",
+                    self.name, key, delay,
+                )
+                asyncio.get_running_loop().call_later(
+                    delay, self.add, key
+                )
+            finally:
+                self._processing.discard(key)
+                if key in self._dirty:
+                    self._dirty.discard(key)
+                    self.add(key)

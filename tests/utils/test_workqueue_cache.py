@@ -1,0 +1,146 @@
+"""Work queue coalescing/backoff, TTL cache, slow-call stats."""
+
+import asyncio
+import time
+
+import pytest
+
+from gpustack_tpu.utils.cache import TTLCache, locked_cached
+from gpustack_tpu.utils.profiling import CallStats, timed
+from gpustack_tpu.utils.workqueue import ExponentialBackoff, WorkQueue
+
+
+def test_backoff_grows_and_resets():
+    b = ExponentialBackoff(base=1.0, cap=8.0, jitter=0.0)
+    assert b.next_delay("k") == 1.0
+    assert b.next_delay("k") == 2.0
+    assert b.next_delay("k") == 4.0
+    assert b.next_delay("k") == 8.0
+    assert b.next_delay("k") == 8.0  # capped
+    b.reset("k")
+    assert b.next_delay("k") == 1.0
+    # independent keys
+    assert b.next_delay("other") == 1.0
+
+
+def test_workqueue_coalesces_and_retries():
+    async def go():
+        seen = []
+        fail_once = {"x"}
+
+        async def handler(key):
+            seen.append(key)
+            if key in fail_once:
+                fail_once.discard(key)
+                raise RuntimeError("boom")
+
+        q = WorkQueue(
+            handler,
+            backoff=ExponentialBackoff(base=0.05, jitter=0.0),
+        )
+        q.start()
+        try:
+            # duplicates coalesce while queued
+            q.add("a")
+            q.add("a")
+            q.add("a")
+            q.add("x")
+            await asyncio.sleep(0.3)
+            assert seen.count("a") == 1
+            # x failed once, then retried after backoff
+            assert seen.count("x") == 2
+            assert q.processed == 2 and q.retried == 1
+        finally:
+            q.stop()
+
+    asyncio.run(go())
+
+
+def test_workqueue_level_triggered_readd():
+    async def go():
+        seen = []
+        gate = asyncio.Event()
+
+        async def handler(key):
+            seen.append(key)
+            if len(seen) == 1:
+                gate.set()
+                await asyncio.sleep(0.1)
+
+        q = WorkQueue(handler)
+        q.start()
+        try:
+            q.add("k")
+            await gate.wait()
+            q.add("k")  # re-added DURING processing → runs again after
+            await asyncio.sleep(0.4)
+            assert seen == ["k", "k"]
+        finally:
+            q.stop()
+
+    asyncio.run(go())
+
+
+def test_ttl_cache_expiry_and_bound():
+    c = TTLCache(ttl=0.05, max_entries=3)
+    c.set("a", 1)
+    assert c.get("a") == 1
+    time.sleep(0.06)
+    assert c.get("a") is None
+    for i in range(5):
+        c.set(i, i)
+    assert len(c) <= 3
+    c.set("z", 9)
+    c.invalidate("z")
+    assert c.get("z") is None
+
+
+def test_locked_cached_coalesces_concurrent_calls():
+    async def go():
+        calls = []
+
+        @locked_cached(ttl=10.0)
+        async def expensive(x):
+            calls.append(x)
+            await asyncio.sleep(0.05)
+            return x * 2
+
+        out = await asyncio.gather(*(expensive(3) for _ in range(5)))
+        assert out == [6] * 5
+        assert calls == [3]          # one in-flight computation
+        assert await expensive(4) == 8
+        assert calls == [3, 4]
+        expensive.cache.invalidate()
+        await expensive(3)
+        assert calls == [3, 4, 3]
+
+    asyncio.run(go())
+
+
+def test_timed_records_stats():
+    stats = CallStats()
+
+    @timed(threshold_s=99, name="fast_fn")
+    def fast():
+        return 42
+
+    from gpustack_tpu.utils import profiling
+
+    old = profiling.STATS
+    profiling.STATS = stats
+    try:
+        assert fast() == 42
+        assert fast() == 42
+        snap = stats.snapshot()
+        assert snap["fast_fn"]["count"] == 2
+        assert snap["fast_fn"]["max_s"] >= 0
+    finally:
+        profiling.STATS = old
+
+
+def test_timed_async():
+    @timed(threshold_s=99, name="async_fn")
+    async def afn():
+        return "ok"
+
+    assert asyncio.run(afn()) == "ok"
