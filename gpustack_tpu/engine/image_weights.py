@@ -1,0 +1,290 @@
+"""Diffusers-format checkpoint loading → functional diffusion params.
+
+Maps a local diffusers directory layout (``unet/``, ``vae/``,
+``text_encoder/``, optionally ``text_encoder_2/`` — each holding
+``*.safetensors``) onto the param tree of models/diffusion.py. Torch
+conventions are converted at load: linear weights [out, in] → [in, out],
+conv kernels OIHW → HWIO (our convs are NHWC). 1×1-conv projections
+(SD 1.x ``proj_in``/``proj_out``, VAE attention q/k/v) collapse to
+linears.
+
+Reference parity: the reference pulls diffusion models through VoxBox
+containers (worker/backends/vox_box.py:23); here the checkpoint loads
+straight into the in-repo JAX pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from gpustack_tpu.engine.weights import _read_safetensors, _to_jnp
+from gpustack_tpu.models.diffusion import DiffusionConfig
+
+Params = Dict[str, Any]
+
+
+def _lin(tensors, name):
+    """torch linear weight -> [in, out]."""
+    return _to_jnp(tensors.pop(name).T)
+
+
+def _vec(tensors, name):
+    return _to_jnp(tensors.pop(name), dtype=jnp.float32)
+
+
+def _convw(tensors, name):
+    """torch conv OIHW -> HWIO; 1x1 convs stay 4-D (conv2d handles them)."""
+    t = tensors.pop(name)
+    return _to_jnp(t.permute(2, 3, 1, 0))
+
+
+def _proj(tensors, name):
+    """proj that may be a linear [O, I] or a 1x1 conv [O, I, 1, 1] ->
+    [in, out] linear."""
+    t = tensors.pop(name)
+    if t.ndim == 4:
+        t = t[:, :, 0, 0]
+    return _to_jnp(t.T)
+
+
+def _load_clip(tensors, layers: int, prefix: str = "text_model",
+               projection: str = "") -> Params:
+    def stack(fmt: str, linear: bool = True):
+        parts = []
+        for i in range(layers):
+            t = tensors.pop(fmt.format(i=i))
+            parts.append(_to_jnp(t.T if linear else t, dtype=jnp.float32))
+        return jnp.stack(parts)
+
+    p = {
+        "tok_emb": _to_jnp(
+            tensors.pop(f"{prefix}.embeddings.token_embedding.weight")
+        ),
+        "pos_emb": _to_jnp(
+            tensors.pop(f"{prefix}.embeddings.position_embedding.weight")
+        ),
+        "layers": {
+            "ln1_g": stack(f"{prefix}.encoder.layers.{{i}}.layer_norm1.weight", False),
+            "ln1_b": stack(f"{prefix}.encoder.layers.{{i}}.layer_norm1.bias", False),
+            "wq": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.q_proj.weight"),
+            "bq": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.q_proj.bias", False),
+            "wk": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.k_proj.weight"),
+            "bk": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.k_proj.bias", False),
+            "wv": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.v_proj.weight"),
+            "bv": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.v_proj.bias", False),
+            "wo": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.out_proj.weight"),
+            "bo": stack(f"{prefix}.encoder.layers.{{i}}.self_attn.out_proj.bias", False),
+            "ln2_g": stack(f"{prefix}.encoder.layers.{{i}}.layer_norm2.weight", False),
+            "ln2_b": stack(f"{prefix}.encoder.layers.{{i}}.layer_norm2.bias", False),
+            "w1": stack(f"{prefix}.encoder.layers.{{i}}.mlp.fc1.weight"),
+            "b1": stack(f"{prefix}.encoder.layers.{{i}}.mlp.fc1.bias", False),
+            "w2": stack(f"{prefix}.encoder.layers.{{i}}.mlp.fc2.weight"),
+            "b2": stack(f"{prefix}.encoder.layers.{{i}}.mlp.fc2.bias", False),
+        },
+        "lnf_g": _vec(tensors, f"{prefix}.final_layer_norm.weight"),
+        "lnf_b": _vec(tensors, f"{prefix}.final_layer_norm.bias"),
+    }
+    if projection and projection in tensors:
+        p["proj"] = _lin(tensors, projection)
+    return p
+
+
+def _load_res(tensors, prefix: str, has_temb: bool = True) -> Params:
+    p = {
+        "norm1_g": _vec(tensors, f"{prefix}.norm1.weight"),
+        "norm1_b": _vec(tensors, f"{prefix}.norm1.bias"),
+        "conv1_w": _convw(tensors, f"{prefix}.conv1.weight"),
+        "conv1_b": _vec(tensors, f"{prefix}.conv1.bias"),
+        "norm2_g": _vec(tensors, f"{prefix}.norm2.weight"),
+        "norm2_b": _vec(tensors, f"{prefix}.norm2.bias"),
+        "conv2_w": _convw(tensors, f"{prefix}.conv2.weight"),
+        "conv2_b": _vec(tensors, f"{prefix}.conv2.bias"),
+    }
+    if has_temb and f"{prefix}.time_emb_proj.weight" in tensors:
+        p["temb_w"] = _lin(tensors, f"{prefix}.time_emb_proj.weight")
+        p["temb_b"] = _vec(tensors, f"{prefix}.time_emb_proj.bias")
+    if f"{prefix}.conv_shortcut.weight" in tensors:
+        p["skip_w"] = _proj(tensors, f"{prefix}.conv_shortcut.weight")
+        p["skip_b"] = _vec(tensors, f"{prefix}.conv_shortcut.bias")
+    return p
+
+
+def _load_spatial(tensors, prefix: str, depth: int) -> Params:
+    blocks = []
+    for k in range(depth):
+        bp = f"{prefix}.transformer_blocks.{k}"
+        blocks.append({
+            "ln1_g": _vec(tensors, f"{bp}.norm1.weight"),
+            "ln1_b": _vec(tensors, f"{bp}.norm1.bias"),
+            "attn1_q": _lin(tensors, f"{bp}.attn1.to_q.weight"),
+            "attn1_k": _lin(tensors, f"{bp}.attn1.to_k.weight"),
+            "attn1_v": _lin(tensors, f"{bp}.attn1.to_v.weight"),
+            "attn1_o": _lin(tensors, f"{bp}.attn1.to_out.0.weight"),
+            "attn1_ob": _vec(tensors, f"{bp}.attn1.to_out.0.bias"),
+            "ln2_g": _vec(tensors, f"{bp}.norm2.weight"),
+            "ln2_b": _vec(tensors, f"{bp}.norm2.bias"),
+            "attn2_q": _lin(tensors, f"{bp}.attn2.to_q.weight"),
+            "attn2_k": _lin(tensors, f"{bp}.attn2.to_k.weight"),
+            "attn2_v": _lin(tensors, f"{bp}.attn2.to_v.weight"),
+            "attn2_o": _lin(tensors, f"{bp}.attn2.to_out.0.weight"),
+            "attn2_ob": _vec(tensors, f"{bp}.attn2.to_out.0.bias"),
+            "ln3_g": _vec(tensors, f"{bp}.norm3.weight"),
+            "ln3_b": _vec(tensors, f"{bp}.norm3.bias"),
+            "ff_w1": _lin(tensors, f"{bp}.ff.net.0.proj.weight"),
+            "ff_b1": _vec(tensors, f"{bp}.ff.net.0.proj.bias"),
+            "ff_w2": _lin(tensors, f"{bp}.ff.net.2.weight"),
+            "ff_b2": _vec(tensors, f"{bp}.ff.net.2.bias"),
+        })
+    return {
+        "norm_g": _vec(tensors, f"{prefix}.norm.weight"),
+        "norm_b": _vec(tensors, f"{prefix}.norm.bias"),
+        "proj_in_w": _proj(tensors, f"{prefix}.proj_in.weight"),
+        "proj_in_b": _vec(tensors, f"{prefix}.proj_in.bias"),
+        "blocks": blocks,
+        "proj_out_w": _proj(tensors, f"{prefix}.proj_out.weight"),
+        "proj_out_b": _vec(tensors, f"{prefix}.proj_out.bias"),
+    }
+
+
+def load_diffusion_params(cfg: DiffusionConfig, model_dir: str) -> Params:
+    """Load a diffusers-format local checkpoint dir into the param tree."""
+    params: Params = {}
+
+    text_dir = os.path.join(model_dir, "text_encoder")
+    tensors = _read_safetensors(text_dir)
+    params["text"] = _load_clip(tensors, cfg.text_layers)
+    if cfg.text2_dim:
+        tensors = _read_safetensors(os.path.join(model_dir, "text_encoder_2"))
+        params["text2"] = _load_clip(
+            tensors, cfg.text2_layers, projection="text_projection.weight"
+        )
+
+    t = _read_safetensors(os.path.join(model_dir, "unet"))
+
+    def depth_for(level: int) -> int:
+        return cfg.transformer_depth[
+            min(level, len(cfg.transformer_depth) - 1)
+        ]
+
+    unet: Params = {
+        "time_w1": _lin(t, "time_embedding.linear_1.weight").astype(jnp.float32),
+        "time_b1": _vec(t, "time_embedding.linear_1.bias"),
+        "time_w2": _lin(t, "time_embedding.linear_2.weight").astype(jnp.float32),
+        "time_b2": _vec(t, "time_embedding.linear_2.bias"),
+        "conv_in_w": _convw(t, "conv_in.weight"),
+        "conv_in_b": _vec(t, "conv_in.bias"),
+    }
+    if cfg.addition_embed:
+        unet["add_w1"] = _lin(t, "add_embedding.linear_1.weight").astype(jnp.float32)
+        unet["add_b1"] = _vec(t, "add_embedding.linear_1.bias")
+        unet["add_w2"] = _lin(t, "add_embedding.linear_2.weight").astype(jnp.float32)
+        unet["add_b2"] = _vec(t, "add_embedding.linear_2.bias")
+
+    down = []
+    for level in range(len(cfg.channel_mult)):
+        has_attn = level in cfg.attn_levels
+        lv: Params = {"res": [], "attn": [] if has_attn else None, "down": None}
+        for j in range(cfg.num_res_blocks):
+            lv["res"].append(
+                _load_res(t, f"down_blocks.{level}.resnets.{j}")
+            )
+            if has_attn:
+                lv["attn"].append(
+                    _load_spatial(
+                        t, f"down_blocks.{level}.attentions.{j}",
+                        depth_for(level),
+                    )
+                )
+        dkey = f"down_blocks.{level}.downsamplers.0.conv.weight"
+        if dkey in t:
+            lv["down"] = {
+                "w": _convw(t, dkey),
+                "b": _vec(t, f"down_blocks.{level}.downsamplers.0.conv.bias"),
+            }
+        down.append(lv)
+    unet["down"] = down
+
+    unet["mid"] = {
+        "res1": _load_res(t, "mid_block.resnets.0"),
+        "attn": _load_spatial(
+            t, "mid_block.attentions.0", depth_for(len(cfg.channel_mult) - 1)
+        ),
+        "res2": _load_res(t, "mid_block.resnets.1"),
+    }
+
+    up = []
+    for ui in range(len(cfg.channel_mult)):
+        level = len(cfg.channel_mult) - 1 - ui
+        has_attn = level in cfg.attn_levels
+        lv = {"res": [], "attn": [] if has_attn else None, "up": None}
+        for j in range(cfg.num_res_blocks + 1):
+            lv["res"].append(_load_res(t, f"up_blocks.{ui}.resnets.{j}"))
+            if has_attn:
+                lv["attn"].append(
+                    _load_spatial(
+                        t, f"up_blocks.{ui}.attentions.{j}", depth_for(level)
+                    )
+                )
+        ukey = f"up_blocks.{ui}.upsamplers.0.conv.weight"
+        if ukey in t:
+            lv["up"] = {
+                "w": _convw(t, ukey),
+                "b": _vec(t, f"up_blocks.{ui}.upsamplers.0.conv.bias"),
+            }
+        up.append(lv)
+    unet["up"] = up
+    unet["norm_out_g"] = _vec(t, "conv_norm_out.weight")
+    unet["norm_out_b"] = _vec(t, "conv_norm_out.bias")
+    unet["conv_out_w"] = _convw(t, "conv_out.weight")
+    unet["conv_out_b"] = _vec(t, "conv_out.bias")
+    params["unet"] = unet
+
+    t = _read_safetensors(os.path.join(model_dir, "vae"))
+    vae: Params = {
+        "post_quant_w": _proj(t, "post_quant_conv.weight"),
+        "post_quant_b": _vec(t, "post_quant_conv.bias"),
+        "conv_in_w": _convw(t, "decoder.conv_in.weight"),
+        "conv_in_b": _vec(t, "decoder.conv_in.bias"),
+        "mid": {
+            "res1": _load_res(t, "decoder.mid_block.resnets.0", has_temb=False),
+            "attn": {
+                "norm_g": _vec(t, "decoder.mid_block.attentions.0.group_norm.weight"),
+                "norm_b": _vec(t, "decoder.mid_block.attentions.0.group_norm.bias"),
+                "q_w": _proj(t, "decoder.mid_block.attentions.0.to_q.weight"),
+                "q_b": _vec(t, "decoder.mid_block.attentions.0.to_q.bias"),
+                "k_w": _proj(t, "decoder.mid_block.attentions.0.to_k.weight"),
+                "k_b": _vec(t, "decoder.mid_block.attentions.0.to_k.bias"),
+                "v_w": _proj(t, "decoder.mid_block.attentions.0.to_v.weight"),
+                "v_b": _vec(t, "decoder.mid_block.attentions.0.to_v.bias"),
+                "o_w": _proj(t, "decoder.mid_block.attentions.0.to_out.0.weight"),
+                "o_b": _vec(t, "decoder.mid_block.attentions.0.to_out.0.bias"),
+            },
+            "res2": _load_res(t, "decoder.mid_block.resnets.1", has_temb=False),
+        },
+    }
+    vup = []
+    for ui in range(len(cfg.vae_channel_mult)):
+        lv = {"res": [], "up": None}
+        for j in range(cfg.vae_res_blocks + 1):
+            lv["res"].append(
+                _load_res(
+                    t, f"decoder.up_blocks.{ui}.resnets.{j}", has_temb=False
+                )
+            )
+        ukey = f"decoder.up_blocks.{ui}.upsamplers.0.conv.weight"
+        if ukey in t:
+            lv["up"] = {
+                "w": _convw(t, ukey),
+                "b": _vec(t, f"decoder.up_blocks.{ui}.upsamplers.0.conv.bias"),
+            }
+        vup.append(lv)
+    vae["up"] = vup
+    vae["norm_out_g"] = _vec(t, "decoder.conv_norm_out.weight")
+    vae["norm_out_b"] = _vec(t, "decoder.conv_norm_out.bias")
+    vae["conv_out_w"] = _convw(t, "decoder.conv_out.weight")
+    vae["conv_out_b"] = _vec(t, "decoder.conv_out.bias")
+    params["vae"] = vae
+    return params

@@ -207,6 +207,15 @@ def add_openai_routes(app: web.Application) -> None:
                     await _record_usage(
                         request, model, str(name), operation, pt, ct, False
                     )
+                elif (
+                    operation == "images/generations"
+                    and upstream.status == 200
+                ):
+                    # image generations have no token accounting; meter
+                    # the request itself (audio does the same)
+                    await _record_usage(
+                        request, model, str(name), operation, 0, 0, False
+                    )
             except json.JSONDecodeError:
                 pass
             return web.Response(
@@ -330,7 +339,8 @@ def add_openai_routes(app: web.Application) -> None:
 
     app.router.add_get("/v1/models", list_models)
     app.router.add_post(
-        "/v1/{op:(chat/completions|completions|embeddings|rerank)}",
+        "/v1/{op:(chat/completions|completions|embeddings|rerank"
+        "|images/generations)}",
         proxy,
     )
     app.router.add_post("/v1/audio/transcriptions", audio_proxy)

@@ -55,6 +55,10 @@ class ModelEvaluation:
 
 
 def resolve_model_config(model: Model):
+    from gpustack_tpu.models.diffusion import (
+        DIFFUSION_PRESETS,
+        config_from_diffusers,
+    )
     from gpustack_tpu.models.whisper import (
         WHISPER_PRESETS,
         config_from_hf_whisper,
@@ -63,6 +67,8 @@ def resolve_model_config(model: Model):
     if model.preset:
         if model.preset in WHISPER_PRESETS:
             return WHISPER_PRESETS[model.preset]
+        if model.preset in DIFFUSION_PRESETS:
+            return DIFFUSION_PRESETS[model.preset]
         if model.preset not in PRESETS:
             raise EvaluationError(f"unknown preset {model.preset!r}")
         return PRESETS[model.preset]
@@ -70,6 +76,13 @@ def resolve_model_config(model: Model):
         try:
             import json as _json
 
+            if os.path.exists(
+                os.path.join(model.local_path, "model_index.json")
+            ):
+                # diffusers-format layout = image pipeline
+                return config_from_diffusers(
+                    model.local_path, name=model.name
+                )
             with open(
                 os.path.join(model.local_path, "config.json")
             ) as f:

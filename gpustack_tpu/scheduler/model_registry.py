@@ -17,6 +17,7 @@ from gpustack_tpu.schemas import Model
 def detect_categories(model: Model) -> List[str]:
     """Best-effort categories from the model's resolved config; empty
     list when the source cannot be resolved (leave user input alone)."""
+    from gpustack_tpu.models.diffusion import DiffusionConfig
     from gpustack_tpu.models.whisper import WhisperConfig
     from gpustack_tpu.scheduler.calculator import (
         EvaluationError,
@@ -29,6 +30,8 @@ def detect_categories(model: Model) -> List[str]:
         return []
     if isinstance(cfg, WhisperConfig):
         return ["audio", "speech-to-text"]
+    if isinstance(cfg, DiffusionConfig):
+        return ["image", "text-to-image"]
     out = ["llm"]
     if getattr(cfg, "num_experts", 0):
         out.append("moe")

@@ -77,6 +77,26 @@ CATALOG: List[Dict[str, Any]] = [
             "chips": {"v5e": 1, "v5p": 1},
         },
     },
+    {
+        "name": "Stable-Diffusion-XL",
+        "preset": "sdxl-shaped",
+        "huggingface_repo_id": "stabilityai/stable-diffusion-xl-base-1.0",
+        "categories": ["image", "text-to-image"],
+        "sizes": {"parameters_b": 3.5},
+        "suggested": {
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
+    {
+        "name": "Stable-Diffusion-1.5",
+        "preset": "sd15-shaped",
+        "huggingface_repo_id": "stable-diffusion-v1-5/stable-diffusion-v1-5",
+        "categories": ["image", "text-to-image"],
+        "sizes": {"parameters_b": 1.0},
+        "suggested": {
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
 ]
 
 

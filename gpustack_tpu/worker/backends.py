@@ -82,6 +82,22 @@ def _is_audio_model(model: Model) -> bool:
     return False
 
 
+def _is_image_model(model: Model) -> bool:
+    """Diffusion checkpoints are diffusers-format directories with a
+    model_index.json (no top-level config.json), so detection keys off
+    that layout — matching the scheduler's resolution
+    (calculator.resolve_model_config)."""
+    from gpustack_tpu.models.diffusion import DIFFUSION_PRESETS
+
+    if "image" in model.categories or model.preset in DIFFUSION_PRESETS:
+        return True
+    if model.local_path:
+        return os.path.exists(
+            os.path.join(model.local_path, "model_index.json")
+        )
+    return False
+
+
 def _tpu_native_command(
     model: Model,
     instance: ModelInstance,
@@ -90,11 +106,12 @@ def _tpu_native_command(
     process_index: int = 0,
     chip_indexes: Optional[List[int]] = None,
 ) -> Tuple[List[str], Dict[str, str]]:
-    module = (
-        "gpustack_tpu.engine.audio_server"
-        if _is_audio_model(model)
-        else "gpustack_tpu.engine.api_server"
-    )
+    if _is_audio_model(model):
+        module = "gpustack_tpu.engine.audio_server"
+    elif _is_image_model(model):
+        module = "gpustack_tpu.engine.image_server"
+    else:
+        module = "gpustack_tpu.engine.api_server"
     argv = [
         sys.executable, "-m", module,
         # loopback only: the engine HTTP port carries no auth; all ingress

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Microbench: does XLA fuse int8→bf16 dequant into the decode matmul?
+
+Times a decode-shaped matmul [B, d] @ [d, f] under different weight
+representations. If the convert fuses, int8 should be ~2x faster than
+bf16 (half the HBM bytes); if XLA materializes the bf16 weight, int8
+becomes ~2-3x SLOWER. Prints one JSON line per variant with achieved
+GB/s over the weight bytes.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(fn, *args, iters=30, warmup=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    d, f = 4096, 14336
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, d)), jnp.bfloat16)
+    w_bf16 = jnp.asarray(rng.standard_normal((d, f)), jnp.bfloat16)
+    q = jnp.asarray(rng.integers(-127, 128, (d, f), dtype=np.int8))
+    s = jnp.asarray(np.full((f,), 0.01), jnp.bfloat16)
+
+    variants = {
+        "bf16": jax.jit(lambda x, w: x @ w),
+        "int8_convert_then_mm": jax.jit(
+            lambda x, q, s: (x @ q.astype(jnp.bfloat16)) * s
+        ),
+        "int8_dot_general_mixed": jax.jit(
+            lambda x, q, s: jax.lax.dot_general(
+                x, q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.bfloat16) * s
+        ),
+        "int8_int_dot": jax.jit(
+            # int8 x int8 dot with int32 accum: quantize activations too
+            lambda x, q, s: jax.lax.dot_general(
+                jnp.clip(jnp.round(x * 16.0), -127, 127).astype(jnp.int8),
+                q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.bfloat16) * (s / 16.0)
+        ),
+    }
+    for name, fn in variants.items():
+        args = (x, w_bf16) if name == "bf16" else (x, q, s)
+        try:
+            dt = bench(fn, *args)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"variant": name, "error": str(e)[:160]}))
+            continue
+        wbytes = (d * f * 2) if name == "bf16" else (d * f)
+        print(json.dumps({
+            "variant": name, "B": B,
+            "us": round(dt * 1e6, 1),
+            "weight_GBps": round(wbytes / dt / 1e9, 1),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Scan-over-layers decode-shaped microbench: [L, d, f] weight stacks,
+B-row activations — the real memory-traffic pattern of decode. Reports
+per-pass time and effective weight GB/s for bf16 vs int8 variants."""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def bench(fn, *args, iters=10, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    L, d, f = 32, 4096, 14336
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, d)), jnp.bfloat16)
+    qs = jnp.asarray(rng.integers(-127, 128, (L, d, f), dtype=np.int8))
+    ss = jnp.asarray(np.full((L, f), 0.01), jnp.bfloat16)
+    qs_back = jnp.asarray(rng.integers(-127, 128, (L, f, d), dtype=np.int8))
+    ss_back = jnp.asarray(np.full((L, d), 0.01), jnp.bfloat16)
+    ws = qs.astype(jnp.bfloat16) * 0.01
+    ws_back = qs_back.astype(jnp.bfloat16) * 0.01
+
+    @jax.jit
+    def scan_bf16(x, ws, ws_back):
+        def body(h, w2):
+            w, wb = w2
+            mid = h @ w
+            return (mid @ wb).astype(h.dtype), None
+        out, _ = lax.scan(body, x, (ws, ws_back))
+        return out
+
+    @jax.jit
+    def scan_int8(x, qs, ss, qs_back, ss_back):
+        def body(h, lw):
+            q, s, qb, sb = lw
+            mid = (h @ q.astype(h.dtype)) * s
+            return ((mid @ qb.astype(h.dtype)) * sb).astype(h.dtype), None
+        out, _ = lax.scan(body, x, (qs, ss, qs_back, ss_back))
+        return out
+
+    int8_bytes = qs.size + qs_back.size
+    bf16_bytes = 2 * int8_bytes
+    dt = bench(scan_bf16, x, ws, ws_back)
+    print(json.dumps({
+        "variant": "scan_bf16", "B": B, "ms": round(dt * 1e3, 2),
+        "weight_GBps": round(bf16_bytes / dt / 1e9, 1),
+    }))
+    dt = bench(scan_int8, x, qs, ss, qs_back, ss_back)
+    print(json.dumps({
+        "variant": "scan_int8", "B": B, "ms": round(dt * 1e3, 2),
+        "weight_GBps": round(int8_bytes / dt / 1e9, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""On-chip microbenchmarks: prefill (xla vs flash) and decode step.
+
+Times the engine's actual jitted entry points on the flagship config so
+perf work targets the real bottleneck instead of guesses. Run on a host
+with a live TPU:
+
+    python hack/profile_onchip.py [config] [--buckets 512,1024,2048]
+
+Prints one JSON line per measurement.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("config", nargs="?", default="llama3-8b")
+    ap.add_argument("--buckets", default="512,1024,2048")
+    ap.add_argument("--slots", default="8,16,24,32")
+    ap.add_argument("--max-seq-len", type=int, default=1280)
+    ap.add_argument("--skip-flash", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gpustack_tpu.engine.runner import ModelRunner
+    from gpustack_tpu.models.config import get_config
+    from gpustack_tpu.models.quant import init_quantized_params
+
+    cfg = get_config(args.config)
+    cpu = jax.local_devices(backend="cpu")[0]
+    t0 = time.perf_counter()
+    with jax.default_device(cpu):
+        params = init_quantized_params(cfg, seed=0)
+    print(json.dumps({"stage": "init_params", "s": round(time.perf_counter() - t0, 1)}))
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    slot_counts = [int(s) for s in args.slots.split(",")]
+
+    for n_slots in slot_counts:
+        t0 = time.perf_counter()
+        runner = ModelRunner(
+            cfg, params, max_slots=n_slots, max_seq_len=args.max_seq_len,
+            prefill_buckets=(64,) + buckets + (args.max_seq_len,),
+        )
+        state = runner.new_state()
+        key = jax.random.key(0)
+        # Activate every slot so decode does real work: one small prefill,
+        # inserted into every slot.
+        last, k, v = runner.prefill([1] * 64, 64)
+        first = int(jnp.argmax(last))
+        for s in range(n_slots):
+            state = runner.insert(state, k, v, s, 64, first, 0.0, 0, 1.0)
+        # decode_step donates the state — thread it through the loop
+        for _ in range(3):
+            state, toks = runner.decode_step(state, key)
+        jax.block_until_ready(toks)
+        iters = 20
+        t_bench = time.perf_counter()
+        for _ in range(iters):
+            state, toks = runner.decode_step(state, key)
+        jax.block_until_ready(toks)
+        dt = (time.perf_counter() - t_bench) / iters
+        print(json.dumps({
+            "stage": "decode_step", "slots": n_slots,
+            "ms": round(dt * 1e3, 2),
+            "tok_per_s": round(n_slots / dt, 1),
+            "setup_s": round(time.perf_counter() - t0, 1),
+        }))
+        del runner, state
+        if n_slots != slot_counts[-1]:
+            continue
+
+        # prefill timings on the largest-slot runner config
+        runner = ModelRunner(
+            cfg, params, max_slots=n_slots, max_seq_len=args.max_seq_len,
+            prefill_buckets=(64,) + buckets + (args.max_seq_len,),
+        )
+        impls = ["xla"] if args.skip_flash else ["xla", "flash"]
+        for impl in impls:
+            os.environ["GPUSTACK_TPU_FLASH"] = "1" if impl == "flash" else "0"
+            runner._prefills.clear()
+            for b in buckets:
+                try:
+                    dt = timeit(
+                        lambda: runner.prefill([1] * b, b), iters=3, warmup=1
+                    )
+                except Exception as e:  # noqa: BLE001
+                    print(json.dumps({
+                        "stage": "prefill", "impl": impl, "bucket": b,
+                        "error": str(e)[:200],
+                    }))
+                    continue
+                print(json.dumps({
+                    "stage": "prefill", "impl": impl, "bucket": b,
+                    "ms": round(dt * 1e3, 1),
+                    "prompt_tok_per_s": round(b / dt, 0),
+                }))
+        os.environ.pop("GPUSTACK_TPU_FLASH", None)
+
+
+if __name__ == "__main__":
+    main()

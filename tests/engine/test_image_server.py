@@ -1,0 +1,144 @@
+"""Image generation API server over the tiny diffusion model."""
+
+import asyncio
+import base64
+import io
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+
+    from gpustack_tpu.models.diffusion import (
+        DIFFUSION_PRESETS,
+        init_diffusion_params,
+    )
+
+    cfg = DIFFUSION_PRESETS["tiny-diffusion"]
+    return cfg, init_diffusion_params(cfg, jax.random.key(0))
+
+
+def _run(model, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.engine.image_server import ImageEngine, ImageServer
+
+    cfg, params = model
+
+    async def run():
+        server = ImageServer(
+            ImageEngine(cfg, params), model_name="tiny-image"
+        )
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_generation_roundtrip(model):
+    async def go(client):
+        resp = await client.post(
+            "/v1/images/generations",
+            json={
+                "prompt": "a TPU pod at sunset",
+                "n": 2,
+                "steps": 2,
+                "seed": 7,
+            },
+        )
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+
+    payload = _run(model, go)
+    assert len(payload["data"]) == 2
+    png = base64.b64decode(payload["data"][0]["b64_json"])
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(png))
+    cfg = model[0]
+    assert img.size == (cfg.image_size, cfg.image_size)
+    assert np.asarray(img).shape[-1] == 3
+
+
+def test_same_seed_same_image(model):
+    async def go(client):
+        out = []
+        for _ in range(2):
+            resp = await client.post(
+                "/v1/images/generations",
+                json={"prompt": "determinism", "steps": 2, "seed": 123},
+            )
+            assert resp.status == 200
+            out.append(await resp.json())
+        return out
+
+    a, b = _run(model, go)
+    assert a["data"][0]["b64_json"] == b["data"][0]["b64_json"]
+
+
+def test_validation_errors(model):
+    async def go(client):
+        missing = await client.post("/v1/images/generations", json={})
+        bad_size = await client.post(
+            "/v1/images/generations",
+            json={"prompt": "x", "size": "123x123"},
+        )
+        bad_json = await client.post(
+            "/v1/images/generations", data=b"{not json"
+        )
+        return missing.status, bad_size.status, bad_json.status
+
+    assert _run(model, go) == (400, 400, 400)
+
+
+def test_healthz_and_metrics(model):
+    async def go(client):
+        await client.post(
+            "/v1/images/generations",
+            json={"prompt": "x", "steps": 1, "seed": 1},
+        )
+        h = await (await client.get("/healthz")).json()
+        m = await (await client.get("/metrics")).text()
+        return h, m
+
+    h, m = _run(model, go)
+    assert h["modality"] == "image"
+    assert h["requests"] == 1
+    assert "gpustack_tpu_images_generated_total 1" in m
+
+
+def test_backend_dispatch_picks_image_server(tmp_path):
+    """Category/layout detection routes diffusers checkpoints to the
+    image engine (worker/backends.py)."""
+    import json as _json
+
+    from gpustack_tpu.schemas import Model, ModelInstance
+    from gpustack_tpu.worker.backends import build_command
+
+    model = Model(
+        id=1, name="sd", preset="sd15-shaped", max_seq_len=77, max_slots=1
+    )
+    argv, _ = build_command(
+        model, ModelInstance(id=1, model_id=1), 9000, None
+    )
+    assert "gpustack_tpu.engine.image_server" in argv
+
+    # diffusers directory layout (no category, no preset)
+    root = tmp_path / "ckpt"
+    root.mkdir()
+    (root / "model_index.json").write_text(_json.dumps({}))
+    model2 = Model(
+        id=2, name="sd-local", local_path=str(root),
+        max_seq_len=77, max_slots=1,
+    )
+    argv2, _ = build_command(
+        model2, ModelInstance(id=2, model_id=2), 9000, None
+    )
+    assert "gpustack_tpu.engine.image_server" in argv2

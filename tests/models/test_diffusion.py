@@ -1,0 +1,362 @@
+"""Latent-diffusion pipeline: sampling, SDXL conditioning, checkpoint
+round-trip through a synthetic diffusers-format directory."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpustack_tpu.models.diffusion import (
+    DIFFUSION_PRESETS,
+    DiffusionConfig,
+    config_from_diffusers,
+    init_diffusion_params,
+    sample_images,
+)
+
+TINY = DIFFUSION_PRESETS["tiny-diffusion"]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_diffusion_params(TINY, jax.random.key(0))
+
+
+def test_sample_shapes_and_range(tiny_params):
+    toks = jnp.ones((2, TINY.max_text_len), jnp.int32)
+    imgs = sample_images(
+        tiny_params, TINY, jax.random.key(1), toks,
+        jnp.zeros_like(toks), steps=3, guidance=2.0,
+    )
+    assert imgs.shape == (2, TINY.image_size, TINY.image_size, 3)
+    assert float(imgs.min()) >= 0.0 and float(imgs.max()) <= 1.0
+    assert np.isfinite(np.asarray(imgs)).all()
+
+
+def test_sampling_is_deterministic_per_seed(tiny_params):
+    toks = jnp.ones((1, TINY.max_text_len), jnp.int32)
+    a = sample_images(
+        tiny_params, TINY, jax.random.key(7), toks,
+        jnp.zeros_like(toks), steps=2,
+    )
+    b = sample_images(
+        tiny_params, TINY, jax.random.key(7), toks,
+        jnp.zeros_like(toks), steps=2,
+    )
+    c = sample_images(
+        tiny_params, TINY, jax.random.key(8), toks,
+        jnp.zeros_like(toks), steps=2,
+    )
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_sdxl_style_conditioning_path():
+    """Dual text encoders + pooled/time-id additive embedding."""
+    cfg = dataclasses.replace(
+        TINY,
+        name="tiny-sdxl",
+        context_dim=TINY.text_dim + 24,
+        text2_dim=24,
+        text2_layers=2,
+        text2_heads=2,
+        text2_projection_dim=24,
+        addition_embed=True,
+        addition_time_embed_dim=8,
+    )
+    params = init_diffusion_params(cfg, jax.random.key(0))
+    toks = jnp.ones((1, cfg.max_text_len), jnp.int32)
+    imgs = sample_images(
+        params, cfg, jax.random.key(1), toks, jnp.zeros_like(toks),
+        steps=2, guidance=3.0,
+    )
+    assert imgs.shape == (1, cfg.image_size, cfg.image_size, 3)
+    assert np.isfinite(np.asarray(imgs)).all()
+
+
+# ---------------------------------------------------------------------------
+# diffusers-format round trip
+
+
+def _t(arr):
+    import torch
+
+    return torch.from_numpy(
+        np.ascontiguousarray(np.asarray(arr, dtype=np.float32))
+    ).contiguous()
+
+
+def _conv_t(w):
+    """our HWIO -> torch OIHW."""
+    return _t(np.transpose(np.asarray(w), (3, 2, 0, 1)))
+
+
+def _lin_t(w):
+    return _t(np.asarray(w).T)
+
+
+def _export_clip(p, prefix="text_model", projection=""):
+    out = {
+        f"{prefix}.embeddings.token_embedding.weight": _t(p["tok_emb"]),
+        f"{prefix}.embeddings.position_embedding.weight": _t(p["pos_emb"]),
+        f"{prefix}.final_layer_norm.weight": _t(p["lnf_g"]),
+        f"{prefix}.final_layer_norm.bias": _t(p["lnf_b"]),
+    }
+    L = p["layers"]["wq"].shape[0]
+    names = {
+        "wq": "self_attn.q_proj.weight", "bq": "self_attn.q_proj.bias",
+        "wk": "self_attn.k_proj.weight", "bk": "self_attn.k_proj.bias",
+        "wv": "self_attn.v_proj.weight", "bv": "self_attn.v_proj.bias",
+        "wo": "self_attn.out_proj.weight", "bo": "self_attn.out_proj.bias",
+        "ln1_g": "layer_norm1.weight", "ln1_b": "layer_norm1.bias",
+        "ln2_g": "layer_norm2.weight", "ln2_b": "layer_norm2.bias",
+        "w1": "mlp.fc1.weight", "b1": "mlp.fc1.bias",
+        "w2": "mlp.fc2.weight", "b2": "mlp.fc2.bias",
+    }
+    for i in range(L):
+        for ours, theirs in names.items():
+            v = p["layers"][ours][i]
+            t = _lin_t(v) if v.ndim == 2 else _t(v)
+            out[f"{prefix}.encoder.layers.{i}.{theirs}"] = t
+    if projection:
+        out["text_projection.weight"] = _lin_t(p["proj"])
+    return out
+
+
+def _export_res(p, prefix):
+    out = {
+        f"{prefix}.norm1.weight": _t(p["norm1_g"]),
+        f"{prefix}.norm1.bias": _t(p["norm1_b"]),
+        f"{prefix}.conv1.weight": _conv_t(p["conv1_w"]),
+        f"{prefix}.conv1.bias": _t(p["conv1_b"]),
+        f"{prefix}.norm2.weight": _t(p["norm2_g"]),
+        f"{prefix}.norm2.bias": _t(p["norm2_b"]),
+        f"{prefix}.conv2.weight": _conv_t(p["conv2_w"]),
+        f"{prefix}.conv2.bias": _t(p["conv2_b"]),
+    }
+    if "temb_w" in p:
+        out[f"{prefix}.time_emb_proj.weight"] = _lin_t(p["temb_w"])
+        out[f"{prefix}.time_emb_proj.bias"] = _t(p["temb_b"])
+    if "skip_w" in p:
+        # export as a 1x1 conv to exercise the loader's squeeze path
+        w = np.asarray(p["skip_w"]).T[:, :, None, None]
+        out[f"{prefix}.conv_shortcut.weight"] = _t(w)
+        out[f"{prefix}.conv_shortcut.bias"] = _t(p["skip_b"])
+    return out
+
+
+def _export_spatial(p, prefix):
+    out = {
+        f"{prefix}.norm.weight": _t(p["norm_g"]),
+        f"{prefix}.norm.bias": _t(p["norm_b"]),
+        f"{prefix}.proj_in.weight": _lin_t(p["proj_in_w"]),
+        f"{prefix}.proj_in.bias": _t(p["proj_in_b"]),
+        f"{prefix}.proj_out.weight": _lin_t(p["proj_out_w"]),
+        f"{prefix}.proj_out.bias": _t(p["proj_out_b"]),
+    }
+    for k, bp in enumerate(p["blocks"]):
+        b = f"{prefix}.transformer_blocks.{k}"
+        out.update({
+            f"{b}.norm1.weight": _t(bp["ln1_g"]),
+            f"{b}.norm1.bias": _t(bp["ln1_b"]),
+            f"{b}.attn1.to_q.weight": _lin_t(bp["attn1_q"]),
+            f"{b}.attn1.to_k.weight": _lin_t(bp["attn1_k"]),
+            f"{b}.attn1.to_v.weight": _lin_t(bp["attn1_v"]),
+            f"{b}.attn1.to_out.0.weight": _lin_t(bp["attn1_o"]),
+            f"{b}.attn1.to_out.0.bias": _t(bp["attn1_ob"]),
+            f"{b}.norm2.weight": _t(bp["ln2_g"]),
+            f"{b}.norm2.bias": _t(bp["ln2_b"]),
+            f"{b}.attn2.to_q.weight": _lin_t(bp["attn2_q"]),
+            f"{b}.attn2.to_k.weight": _lin_t(bp["attn2_k"]),
+            f"{b}.attn2.to_v.weight": _lin_t(bp["attn2_v"]),
+            f"{b}.attn2.to_out.0.weight": _lin_t(bp["attn2_o"]),
+            f"{b}.attn2.to_out.0.bias": _t(bp["attn2_ob"]),
+            f"{b}.norm3.weight": _t(bp["ln3_g"]),
+            f"{b}.norm3.bias": _t(bp["ln3_b"]),
+            f"{b}.ff.net.0.proj.weight": _lin_t(bp["ff_w1"]),
+            f"{b}.ff.net.0.proj.bias": _t(bp["ff_b1"]),
+            f"{b}.ff.net.2.weight": _lin_t(bp["ff_w2"]),
+            f"{b}.ff.net.2.bias": _t(bp["ff_b2"]),
+        })
+    return out
+
+
+def write_diffusers_checkpoint(cfg: DiffusionConfig, params, root: str):
+    """Export our param tree as a diffusers-format directory (the inverse
+    of engine/image_weights.load_diffusion_params)."""
+    from safetensors.torch import save_file
+
+    def save(sub, tensors, config):
+        os.makedirs(os.path.join(root, sub), exist_ok=True)
+        save_file(
+            tensors,
+            os.path.join(root, sub, "diffusion_pytorch_model.safetensors"),
+        )
+        with open(os.path.join(root, sub, "config.json"), "w") as f:
+            json.dump(config, f)
+
+    with open(os.path.join(root, "model_index.json"), "w") as f:
+        json.dump({"_class_name": "StableDiffusionPipeline"}, f)
+
+    save(
+        "text_encoder", _export_clip(params["text"]),
+        {
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.text_dim,
+            "num_hidden_layers": cfg.text_layers,
+            "num_attention_heads": cfg.text_heads,
+            "max_position_embeddings": cfg.max_text_len,
+            "hidden_act": cfg.text_act,
+        },
+    )
+
+    unet = params["unet"]
+    t = {
+        "time_embedding.linear_1.weight": _lin_t(unet["time_w1"]),
+        "time_embedding.linear_1.bias": _t(unet["time_b1"]),
+        "time_embedding.linear_2.weight": _lin_t(unet["time_w2"]),
+        "time_embedding.linear_2.bias": _t(unet["time_b2"]),
+        "conv_in.weight": _conv_t(unet["conv_in_w"]),
+        "conv_in.bias": _t(unet["conv_in_b"]),
+        "conv_norm_out.weight": _t(unet["norm_out_g"]),
+        "conv_norm_out.bias": _t(unet["norm_out_b"]),
+        "conv_out.weight": _conv_t(unet["conv_out_w"]),
+        "conv_out.bias": _t(unet["conv_out_b"]),
+    }
+    for level, lv in enumerate(unet["down"]):
+        for j, rp in enumerate(lv["res"]):
+            t.update(_export_res(rp, f"down_blocks.{level}.resnets.{j}"))
+            if lv["attn"] is not None:
+                t.update(_export_spatial(
+                    lv["attn"][j], f"down_blocks.{level}.attentions.{j}"
+                ))
+        if lv["down"] is not None:
+            t[f"down_blocks.{level}.downsamplers.0.conv.weight"] = \
+                _conv_t(lv["down"]["w"])
+            t[f"down_blocks.{level}.downsamplers.0.conv.bias"] = \
+                _t(lv["down"]["b"])
+    t.update(_export_res(unet["mid"]["res1"], "mid_block.resnets.0"))
+    t.update(_export_spatial(unet["mid"]["attn"], "mid_block.attentions.0"))
+    t.update(_export_res(unet["mid"]["res2"], "mid_block.resnets.1"))
+    for ui, lv in enumerate(unet["up"]):
+        for j, rp in enumerate(lv["res"]):
+            t.update(_export_res(rp, f"up_blocks.{ui}.resnets.{j}"))
+            if lv["attn"] is not None:
+                t.update(_export_spatial(
+                    lv["attn"][j], f"up_blocks.{ui}.attentions.{j}"
+                ))
+        if lv["up"] is not None:
+            t[f"up_blocks.{ui}.upsamplers.0.conv.weight"] = \
+                _conv_t(lv["up"]["w"])
+            t[f"up_blocks.{ui}.upsamplers.0.conv.bias"] = _t(lv["up"]["b"])
+    base = cfg.model_channels
+    save(
+        "unet", t,
+        {
+            "in_channels": cfg.latent_channels,
+            "sample_size": cfg.latent_size,
+            "block_out_channels": [base * m for m in cfg.channel_mult],
+            "layers_per_block": cfg.num_res_blocks,
+            "down_block_types": [
+                "CrossAttnDownBlock2D" if i in cfg.attn_levels
+                else "DownBlock2D"
+                for i in range(len(cfg.channel_mult))
+            ],
+            "transformer_layers_per_block": list(cfg.transformer_depth),
+            "attention_head_dim": 8,
+            "cross_attention_dim": cfg.context_dim,
+        },
+    )
+
+    vae = params["vae"]
+    t = {
+        "post_quant_conv.weight": _t(
+            np.asarray(vae["post_quant_w"]).T[:, :, None, None]
+        ),
+        "post_quant_conv.bias": _t(vae["post_quant_b"]),
+        "decoder.conv_in.weight": _conv_t(vae["conv_in_w"]),
+        "decoder.conv_in.bias": _t(vae["conv_in_b"]),
+        "decoder.conv_norm_out.weight": _t(vae["norm_out_g"]),
+        "decoder.conv_norm_out.bias": _t(vae["norm_out_b"]),
+        "decoder.conv_out.weight": _conv_t(vae["conv_out_w"]),
+        "decoder.conv_out.bias": _t(vae["conv_out_b"]),
+    }
+    t.update(_export_res(vae["mid"]["res1"], "decoder.mid_block.resnets.0"))
+    t.update(_export_res(vae["mid"]["res2"], "decoder.mid_block.resnets.1"))
+    at = vae["mid"]["attn"]
+    t.update({
+        "decoder.mid_block.attentions.0.group_norm.weight": _t(at["norm_g"]),
+        "decoder.mid_block.attentions.0.group_norm.bias": _t(at["norm_b"]),
+        "decoder.mid_block.attentions.0.to_q.weight": _lin_t(at["q_w"]),
+        "decoder.mid_block.attentions.0.to_q.bias": _t(at["q_b"]),
+        "decoder.mid_block.attentions.0.to_k.weight": _lin_t(at["k_w"]),
+        "decoder.mid_block.attentions.0.to_k.bias": _t(at["k_b"]),
+        "decoder.mid_block.attentions.0.to_v.weight": _lin_t(at["v_w"]),
+        "decoder.mid_block.attentions.0.to_v.bias": _t(at["v_b"]),
+        "decoder.mid_block.attentions.0.to_out.0.weight": _lin_t(at["o_w"]),
+        "decoder.mid_block.attentions.0.to_out.0.bias": _t(at["o_b"]),
+    })
+    for ui, lv in enumerate(vae["up"]):
+        for j, rp in enumerate(lv["res"]):
+            t.update(_export_res(rp, f"decoder.up_blocks.{ui}.resnets.{j}"))
+        if lv["up"] is not None:
+            t[f"decoder.up_blocks.{ui}.upsamplers.0.conv.weight"] = \
+                _conv_t(lv["up"]["w"])
+            t[f"decoder.up_blocks.{ui}.upsamplers.0.conv.bias"] = \
+                _t(lv["up"]["b"])
+    save(
+        "vae", t,
+        {
+            "block_out_channels": [
+                cfg.vae_channels * m for m in cfg.vae_channel_mult
+            ],
+            "layers_per_block": cfg.vae_res_blocks,
+            "scaling_factor": cfg.scaling_factor,
+        },
+    )
+
+
+def test_diffusers_checkpoint_roundtrip(tmp_path, tiny_params):
+    from gpustack_tpu.engine.image_weights import load_diffusion_params
+
+    root = str(tmp_path / "ckpt")
+    os.makedirs(root)
+    write_diffusers_checkpoint(TINY, tiny_params, root)
+
+    cfg = config_from_diffusers(root, name="tiny-roundtrip")
+    assert cfg.model_channels == TINY.model_channels
+    assert cfg.channel_mult == TINY.channel_mult
+    assert cfg.attn_levels == TINY.attn_levels
+    assert cfg.context_dim == TINY.context_dim
+    assert cfg.vae_scale_factor == TINY.vae_scale_factor
+    assert cfg.image_size == TINY.image_size
+
+    loaded = load_diffusion_params(cfg, root)
+    ref_leaves = jax.tree.leaves(tiny_params)
+    got_leaves = jax.tree.leaves(loaded)
+    assert jax.tree.structure(tiny_params) == jax.tree.structure(loaded)
+    for ref, got in zip(ref_leaves, got_leaves):
+        assert ref.shape == got.shape
+        np.testing.assert_allclose(
+            np.asarray(ref, np.float32), np.asarray(got, np.float32),
+            rtol=1e-2, atol=1e-3,
+        )
+
+    # loaded params must actually sample
+    toks = jnp.ones((1, cfg.max_text_len), jnp.int32)
+    imgs = sample_images(
+        loaded, cfg, jax.random.key(0), toks, jnp.zeros_like(toks), steps=2
+    )
+    assert np.isfinite(np.asarray(imgs)).all()
+
+
+def test_param_count_matches_init(tiny_params):
+    actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tiny_params))
+    est = TINY.param_count()
+    # biases/norms are excluded from the estimate; matmul/conv weights
+    # dominate, so the estimate must land within 20%
+    assert abs(est - actual) / actual < 0.2, (est, actual)
