@@ -1,0 +1,15 @@
+"""Cloud worker provisioning (reference gpustack/cloud_providers/ +
+WorkerProvisioningController, server/controllers.py:2346-2630).
+
+Lazy exports: provider implementations pull in aiohttp only when used.
+"""
+
+from gpustack_tpu.cloud.providers import (  # noqa: F401
+    CloudInstance,
+    CloudInstanceCreate,
+    CloudProvider,
+    FakeProvider,
+    InstanceState,
+    TpuVmProvider,
+    get_provider,
+)

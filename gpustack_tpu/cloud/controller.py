@@ -1,0 +1,333 @@
+"""WorkerPoolController: reconcile worker pools against cloud providers.
+
+Reference parity: WorkerProvisioningController (server/controllers.py:
+2346-2630) — creates provider instances for provisioning workers, waits
+for boot, injects bootstrap user-data; deletion tears the instance down.
+Shape here follows the repo's controller pattern (server/controllers.py):
+a Record watch feeding a coalescing WorkQueue, so a burst of pool edits
+collapses to one reconcile and API failures retry with backoff.
+
+Reconcile invariants:
+- desired = pool.replicas; actual = CloudWorker rows in non-FAILED,
+  non-DELETING states. Scale up creates rows first (DB is truth), then
+  instances; a crash between the two is healed by the next reconcile
+  (row with empty external_id → create retried by name, which providers
+  treat as idempotent identity).
+- Scale down prefers workers that never joined, then newest.
+- State sync: CloudWorker rows poll provider state through the same
+  queue (periodic rescan) — RUNNING instances whose agent registered get
+  linked to the Worker row by name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from gpustack_tpu.cloud.providers import InstanceState, get_provider
+from gpustack_tpu.cloud.user_data import render_user_data
+from gpustack_tpu.schemas import (
+    CloudWorker,
+    CloudWorkerState,
+    Worker,
+    WorkerPool,
+)
+from gpustack_tpu.server.controllers import Controller
+from gpustack_tpu.server.bus import Event, EventType
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerPoolController(Controller):
+    record_cls = WorkerPool
+
+    def __init__(self, server_url: str, registration_token: str,
+                 rescan_s: float = 30.0) -> None:
+        super().__init__()
+        from gpustack_tpu.utils.workqueue import WorkQueue
+
+        self.server_url = server_url
+        self.registration_token = registration_token
+        self.rescan_s = rescan_s
+        self._queue = WorkQueue(self._reconcile, name="pool-reconcile")
+        self._rescan_task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        super().start()
+        self._queue.start()
+        self._rescan_task = asyncio.create_task(
+            self._rescan_loop(), name="pool-rescan"
+        )
+
+    def stop(self) -> None:
+        super().stop()
+        self._queue.stop()
+        if self._rescan_task:
+            self._rescan_task.cancel()
+
+    async def _rescan_loop(self) -> None:
+        # instance boot progress isn't event-driven — poll every pool,
+        # and sweep rows whose pool vanished without a DELETED event
+        # (crash/leadership change between pool delete and teardown)
+        while True:
+            await asyncio.sleep(self.rescan_s)
+            try:
+                pools = await WorkerPool.filter(limit=None)
+                for pool in pools:
+                    self._queue.add(pool.id)
+                pool_ids = {p.id for p in pools}
+                if any(
+                    cw.pool_id not in pool_ids
+                    for cw in await CloudWorker.filter(limit=None)
+                ):
+                    self._queue.add(0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("pool rescan failed")
+
+    async def handle(self, event: Event) -> None:
+        if event.type == EventType.DELETED:
+            # pool gone: tear its cloud workers down via the orphan
+            # sweep (rows carry their own provider snapshot, so the
+            # instances are deletable without the pool row)
+            for cw in await CloudWorker.filter(pool_id=event.id):
+                await cw.update(state=CloudWorkerState.DELETING)
+            self._queue.add(0)
+            return
+        self._queue.add(event.id)
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def _reconcile(self, pool_id: int) -> None:
+        if pool_id == 0:
+            await self._sweep_orphans()
+            return
+        pool = await WorkerPool.get(pool_id)
+        if pool is None:
+            await self._sweep_orphans()
+            return
+        if pool.paused:
+            return
+        provider = get_provider(pool.provider, dict(pool.provider_config))
+        rows = await CloudWorker.filter(pool_id=pool.id)
+        await self._sync_states(provider, rows)
+
+        live = [
+            r for r in rows
+            if r.state not in (
+                CloudWorkerState.FAILED, CloudWorkerState.DELETING
+            )
+        ]
+        want = max(0, pool.replicas)
+        if len(live) < want:
+            # Recycle FAILED rows first: a persistent provider outage
+            # must retry the SAME row, not mint a new permanently-FAILED
+            # row per backoff attempt (unbounded table growth).
+            for cw in sorted(
+                (r for r in rows if r.state == CloudWorkerState.FAILED),
+                key=lambda r: r.id,
+            ):
+                if len(live) >= want:
+                    break
+                await cw.update(
+                    state=CloudWorkerState.CREATING,
+                    state_message="",
+                    external_id="",
+                    worker_id=0,
+                    ip_address="",
+                    # refresh the snapshot: a config fix is the usual
+                    # reason the retry can now succeed
+                    provider=pool.provider,
+                    provider_config=dict(pool.provider_config),
+                )
+                live.append(cw)
+                await self._ensure_instance(provider, pool, cw)
+            used = {r.name for r in rows}
+            idx = 0
+            while len(live) < want:
+                name = f"{pool.name}-{idx}"
+                idx += 1
+                if name in used:
+                    continue
+                cw = await CloudWorker.create(
+                    CloudWorker(
+                        name=name,
+                        pool_id=pool.id,
+                        cluster_id=pool.cluster_id,
+                        state=CloudWorkerState.CREATING,
+                        provider=pool.provider,
+                        provider_config=dict(pool.provider_config),
+                    )
+                )
+                live.append(cw)
+                await self._ensure_instance(provider, pool, cw)
+        elif len(live) > want:
+            # prefer tearing down never-joined workers, then newest
+            doomed = sorted(
+                live, key=lambda r: (bool(r.worker_id), -r.id)
+            )[: len(live) - want]
+            for cw in doomed:
+                await cw.update(state=CloudWorkerState.DELETING)
+
+        # retries for rows that exist but never got an instance
+        for cw in live:
+            if not cw.external_id:
+                await self._ensure_instance(provider, pool, cw)
+
+        # process deletions
+        for cw in await CloudWorker.filter(
+            pool_id=pool.id, state=CloudWorkerState.DELETING
+        ):
+            await self._delete_cloud_worker(provider, cw)
+
+    def _resolve_server_url(self) -> str:
+        """The URL baked into VM boot configs must be dialable from the
+        provider network. ``advertised_url`` wins; a bind-all host falls
+        back to this host's primary outbound IP (UDP-connect trick —
+        nothing is sent)."""
+        from urllib.parse import urlsplit
+
+        url = self.server_url
+        host = urlsplit(url).hostname or ""
+        if host not in ("", "0.0.0.0", "127.0.0.1", "localhost", "::"):
+            return url
+        import socket
+
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("10.255.255.255", 1))
+                ip = s.getsockname()[0]
+        except OSError:
+            raise RuntimeError(
+                f"server URL {url!r} is not dialable from a cloud VM and "
+                "no primary IP could be detected — set --advertised-url"
+            )
+        port = urlsplit(url).port or 10150
+        return f"http://{ip}:{port}"
+
+    async def _ensure_instance(self, provider, pool: WorkerPool,
+                               cw: CloudWorker) -> None:
+        from gpustack_tpu.cloud.providers import CloudInstanceCreate
+
+        try:
+            server_url = self._resolve_server_url()
+        except RuntimeError as e:
+            await cw.update(
+                state=CloudWorkerState.FAILED, state_message=str(e)
+            )
+            raise
+        user_data = render_user_data(
+            server_url,
+            self.registration_token,
+            cw.name,
+            cluster_id=pool.cluster_id,
+        )
+        try:
+            external_id = await provider.create_instance(
+                CloudInstanceCreate(
+                    name=cw.name,
+                    instance_type=pool.instance_type,
+                    image=pool.image,
+                    user_data=user_data,
+                    labels=dict(pool.labels),
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — any provider/API error
+            logger.warning("create %s failed: %s", cw.name, e)
+            await cw.update(
+                state=CloudWorkerState.FAILED,
+                state_message=f"create failed: {e}",
+            )
+            raise  # workqueue backoff retries the reconcile
+        await cw.update(
+            external_id=external_id,
+            state=CloudWorkerState.STARTING,
+            state_message="",
+        )
+        logger.info("provisioned %s as %s", cw.name, external_id)
+
+    async def _sync_states(self, provider, rows) -> None:
+        for cw in rows:
+            if not cw.external_id or cw.state in (
+                CloudWorkerState.DELETING, CloudWorkerState.FAILED
+            ):
+                continue
+            inst = await provider.get_instance(cw.external_id)
+            if inst is None:
+                await cw.update(
+                    state=CloudWorkerState.FAILED,
+                    state_message="instance disappeared from provider",
+                )
+                continue
+            if inst.state == InstanceState.RUNNING:
+                updates = {}
+                if cw.state != CloudWorkerState.RUNNING:
+                    updates["state"] = CloudWorkerState.RUNNING
+                if inst.ip_address and inst.ip_address != cw.ip_address:
+                    updates["ip_address"] = inst.ip_address
+                if not cw.worker_id:
+                    worker = await Worker.first(name=cw.name)
+                    if worker is not None:
+                        updates["worker_id"] = worker.id
+                if updates:
+                    await cw.update(**updates)
+            elif inst.state in (
+                InstanceState.FAILED, InstanceState.TERMINATED
+            ):
+                await cw.update(
+                    state=CloudWorkerState.FAILED,
+                    state_message=inst.error or f"instance {inst.state}",
+                )
+
+    async def _delete_cloud_worker(self, provider, cw: CloudWorker) -> None:
+        if cw.external_id:
+            try:
+                await provider.delete_instance(cw.external_id)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("delete %s failed: %s", cw.name, e)
+                raise  # retried via workqueue backoff
+        if cw.worker_id:
+            worker = await Worker.get(cw.worker_id)
+            if worker is not None:
+                await worker.delete()
+        await cw.delete()
+        logger.info("deprovisioned %s", cw.name)
+
+    async def _sweep_orphans(self) -> None:
+        """Tear down rows whose pool no longer exists. Each row carries
+        its own provider snapshot, so the instances are deleted at the
+        provider — a deleted pool must not leak running (billed) VMs."""
+        pools = {p.id for p in await WorkerPool.filter(limit=None)}
+        for cw in await CloudWorker.filter(limit=None):
+            if cw.pool_id in pools:
+                continue
+            if cw.provider:
+                try:
+                    provider = get_provider(
+                        cw.provider, dict(cw.provider_config)
+                    )
+                    await self._delete_cloud_worker(provider, cw)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "orphan teardown of %s failed (%s); will retry "
+                        "on next sweep", cw.name, e,
+                    )
+                    if cw.state != CloudWorkerState.DELETING:
+                        await cw.update(
+                            state=CloudWorkerState.DELETING
+                        )
+                    continue
+            # legacy row without a snapshot: all we can do is log
+            if cw.worker_id:
+                worker = await Worker.get(cw.worker_id)
+                if worker is not None:
+                    await worker.delete()
+            await cw.delete()
+            logger.warning(
+                "pool for %s deleted and no provider snapshot on the "
+                "row; removed record — reap instance %s manually",
+                cw.name, cw.external_id or "(none)",
+            )

@@ -1,0 +1,300 @@
+"""Cloud providers: create/inspect/delete accelerator VMs for worker pools.
+
+Reference parity: cloud_providers/abstract.py:51-69 defines the provider
+client ABC (create_instance / delete_instance / get_instance / wait_*)
+with a DigitalOcean implementation. The TPU-native equivalent provisions
+**TPU VMs** (the GCP TPU API's queued-resource/node model) instead of
+GPU droplets:
+
+- ``TpuVmProvider`` — drives the ``tpu.googleapis.com`` v2 REST surface
+  (create node with accelerator type + runtime version + cloud-init
+  metadata, poll state, delete). Auth comes from the VM metadata server
+  (when running on GCP) or a user-supplied OAuth token in the pool's
+  provider config — no SDK dependency.
+- ``FakeProvider`` — deterministic in-memory provider for tests and
+  air-gapped demos: instances advance CREATING → RUNNING on a timer.
+
+SSH-key management is deliberately absent: TPU VMs take SSH keys and
+startup behavior through instance metadata, so worker bootstrap rides
+``user_data`` (cloud/user_data.py) instead of an SSH provisioning hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import logging
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class InstanceState(str, enum.Enum):
+    CREATING = "creating"
+    RUNNING = "running"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+    UNKNOWN = "unknown"
+
+
+@dataclasses.dataclass
+class CloudInstanceCreate:
+    name: str
+    instance_type: str = ""        # accelerator type, e.g. "v5litepod-8"
+    region: str = ""               # zone, e.g. "us-central1-a"
+    image: str = ""                # runtime version, e.g. "tpu-ubuntu2204-base"
+    user_data: str = ""            # cloud-init / startup script
+    labels: Optional[Dict[str, str]] = None
+
+
+@dataclasses.dataclass
+class CloudInstance:
+    name: str
+    external_id: str = ""
+    state: InstanceState = InstanceState.UNKNOWN
+    ip_address: str = ""
+    error: str = ""
+
+
+class CloudProvider(ABC):
+    """Provider lifecycle: create → poll get_instance → delete."""
+
+    name = ""
+
+    @abstractmethod
+    async def create_instance(self, spec: CloudInstanceCreate) -> str:
+        """Create; returns the provider's external id. Raises on API error."""
+
+    @abstractmethod
+    async def get_instance(self, external_id: str) -> Optional[CloudInstance]:
+        """None when the instance does not exist (deleted / never created)."""
+
+    @abstractmethod
+    async def delete_instance(self, external_id: str) -> None:
+        """Idempotent: deleting a nonexistent instance is a no-op."""
+
+    async def wait_for_state(
+        self,
+        external_id: str,
+        want: InstanceState,
+        backoff: float = 5.0,
+        limit: int = 60,
+    ) -> CloudInstance:
+        for _ in range(limit):
+            inst = await self.get_instance(external_id)
+            if inst is not None and inst.state == want:
+                return inst
+            await asyncio.sleep(backoff)
+        raise TimeoutError(
+            f"instance {external_id} did not reach {want} "
+            f"within {backoff * limit:.0f}s"
+        )
+
+
+class FakeProvider(CloudProvider):
+    """In-memory provider: CREATING → RUNNING after ``startup_s``.
+
+    Class-level registry so the controller and tests can share state
+    across provider instantiations (get_provider returns fresh objects).
+    """
+
+    name = "fake"
+    _instances: Dict[str, CloudInstance] = {}
+    _created_at: Dict[str, float] = {}
+    startup_s: float = 0.0
+    fail_creates: bool = False
+
+    def __init__(self, config: Optional[dict] = None) -> None:
+        cfg = config or {}
+        if "startup_s" in cfg:
+            type(self).startup_s = float(cfg["startup_s"])
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instances.clear()
+        cls._created_at.clear()
+        cls.startup_s = 0.0
+        cls.fail_creates = False
+
+    async def create_instance(self, spec: CloudInstanceCreate) -> str:
+        if type(self).fail_creates:
+            raise RuntimeError("fake provider: create_instance failing")
+        external_id = f"fake-{spec.name}"
+        self._instances[external_id] = CloudInstance(
+            name=spec.name,
+            external_id=external_id,
+            state=InstanceState.CREATING,
+            ip_address="",
+        )
+        self._created_at[external_id] = time.monotonic()
+        return external_id
+
+    async def get_instance(self, external_id: str) -> Optional[CloudInstance]:
+        inst = self._instances.get(external_id)
+        if inst is None:
+            return None
+        if (
+            inst.state == InstanceState.CREATING
+            and time.monotonic() - self._created_at[external_id]
+            >= type(self).startup_s
+        ):
+            inst.state = InstanceState.RUNNING
+            inst.ip_address = f"10.0.0.{(hash(external_id) % 250) + 1}"
+        return inst
+
+    async def delete_instance(self, external_id: str) -> None:
+        self._instances.pop(external_id, None)
+        self._created_at.pop(external_id, None)
+
+
+class TpuVmProvider(CloudProvider):
+    """GCP TPU VM provider over the v2 REST API (no SDK).
+
+    Pool ``provider_config``:
+      project, zone, runtime_version (default tpu-ubuntu2204-base),
+      network (optional), access_token (optional — otherwise the GCE
+      metadata server supplies one), api_base (test override).
+
+    The TPU API's node name is the instance identity; external_id =
+    ``projects/{p}/locations/{z}/nodes/{name}``.
+    """
+
+    name = "tpu-vm"
+    _STATE_MAP = {
+        "CREATING": InstanceState.CREATING,
+        "STARTING": InstanceState.CREATING,
+        "READY": InstanceState.RUNNING,
+        "RESTARTING": InstanceState.CREATING,
+        "STOPPING": InstanceState.STOPPING,
+        "STOPPED": InstanceState.STOPPED,
+        "DELETING": InstanceState.STOPPING,
+        "TERMINATED": InstanceState.TERMINATED,
+        "PREEMPTED": InstanceState.TERMINATED,
+        "FAILED": InstanceState.FAILED,
+    }
+
+    def __init__(self, config: Optional[dict] = None) -> None:
+        cfg = config or {}
+        self.project = cfg.get("project", "")
+        self.zone = cfg.get("zone", "")
+        self.runtime_version = cfg.get(
+            "runtime_version", "tpu-ubuntu2204-base"
+        )
+        self.network = cfg.get("network", "")
+        self._token = cfg.get("access_token", "")
+        self.api_base = cfg.get(
+            "api_base", "https://tpu.googleapis.com/v2"
+        )
+        if not self.project or not self.zone:
+            raise ValueError(
+                "tpu-vm provider requires 'project' and 'zone' in "
+                "provider_config"
+            )
+
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    async def _access_token(self) -> str:
+        if self._token:
+            return self._token
+        import aiohttp
+
+        # GCE metadata server (available on GCP VMs)
+        url = (
+            "http://metadata.google.internal/computeMetadata/v1/"
+            "instance/service-accounts/default/token"
+        )
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                url,
+                headers={"Metadata-Flavor": "Google"},
+                timeout=aiohttp.ClientTimeout(total=5),
+            ) as r:
+                r.raise_for_status()
+                return (await r.json())["access_token"]
+
+    async def _request(
+        self, method: str, path: str, json_body: Optional[dict] = None,
+        params: Optional[dict] = None,
+    ):
+        import aiohttp
+
+        token = await self._access_token()
+        async with aiohttp.ClientSession() as s:
+            async with s.request(
+                method,
+                f"{self.api_base}/{path}",
+                json=json_body,
+                params=params,
+                headers={"Authorization": f"Bearer {token}"},
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as r:
+                if r.status == 404:
+                    return None
+                body = await r.json(content_type=None)
+                if r.status >= 400:
+                    raise RuntimeError(
+                        f"TPU API {method} {path} -> {r.status}: "
+                        f"{body.get('error', {}).get('message', body)}"
+                    )
+                return body
+
+    async def create_instance(self, spec: CloudInstanceCreate) -> str:
+        node = {
+            "acceleratorType": spec.instance_type,
+            "runtimeVersion": spec.image or self.runtime_version,
+            "metadata": {"user-data": spec.user_data},
+            "labels": spec.labels or {},
+        }
+        if self.network:
+            node["networkConfig"] = {"network": self.network}
+        await self._request(
+            "POST", f"{self._parent}/nodes",
+            json_body=node, params={"nodeId": spec.name},
+        )
+        return f"{self._parent}/nodes/{spec.name}"
+
+    async def get_instance(self, external_id: str) -> Optional[CloudInstance]:
+        body = await self._request("GET", external_id)
+        if body is None:
+            return None
+        endpoints = body.get("networkEndpoints") or []
+        ip = ""
+        if endpoints:
+            access = endpoints[0].get("accessConfig") or {}
+            ip = access.get("externalIp") or endpoints[0].get("ipAddress", "")
+        return CloudInstance(
+            name=body.get("name", external_id).rsplit("/", 1)[-1],
+            external_id=external_id,
+            state=self._STATE_MAP.get(
+                body.get("state", ""), InstanceState.UNKNOWN
+            ),
+            ip_address=ip,
+            error=(body.get("health") or "")
+            if body.get("state") == "FAILED" else "",
+        )
+
+    async def delete_instance(self, external_id: str) -> None:
+        await self._request("DELETE", external_id)
+
+
+_PROVIDERS = {
+    FakeProvider.name: FakeProvider,
+    TpuVmProvider.name: TpuVmProvider,
+}
+
+
+def get_provider(name: str, config: Optional[dict] = None) -> CloudProvider:
+    cls = _PROVIDERS.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown cloud provider {name!r} "
+            f"(available: {sorted(_PROVIDERS)})"
+        )
+    return cls(config)

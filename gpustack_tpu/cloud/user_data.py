@@ -1,0 +1,60 @@
+"""Cloud-init user-data for provisioned TPU worker VMs.
+
+Reference parity: cloud_providers/user_data.py renders a cloud-config
+that writes the worker's config file and a post-boot systemd unit
+launching the worker container. The TPU VM runtime images ship Python
+directly, so the unit runs the worker agent as a process (pip-installed
+wheel or baked image path) instead of docker-in-docker.
+"""
+
+from __future__ import annotations
+
+_TEMPLATE = """#cloud-config
+write_files:
+  - path: /var/lib/gpustack-tpu/config.yaml
+    permissions: '0600'
+    content: |
+      server_url: "{server_url}"
+      registration_token: "{token}"
+      worker_name: "{worker_name}"
+      cluster_id: {cluster_id}
+  - path: /etc/systemd/system/gpustack-tpu-worker.service
+    permissions: '0644'
+    content: |
+      [Unit]
+      Description=gpustack-tpu worker agent
+      After=network-online.target
+      Wants=network-online.target
+
+      [Service]
+      Restart=always
+      RestartSec=5
+      ExecStart={python} -m gpustack_tpu start \\
+        --config /var/lib/gpustack-tpu/config.yaml \\
+        --server-url {server_url}
+
+      [Install]
+      WantedBy=multi-user.target
+runcmd:
+  - systemctl daemon-reload
+  - systemctl enable --now gpustack-tpu-worker.service
+"""
+
+
+def render_user_data(
+    server_url: str,
+    token: str,
+    worker_name: str,
+    cluster_id: int = 0,
+    python: str = "/usr/bin/python3",
+) -> str:
+    for v in (server_url, token, worker_name):
+        if '"' in v or "\n" in v:
+            raise ValueError(f"unsafe value for cloud-config: {v!r}")
+    return _TEMPLATE.format(
+        server_url=server_url,
+        token=token,
+        worker_name=worker_name,
+        cluster_id=cluster_id,
+        python=python,
+    )

@@ -30,6 +30,9 @@ class Config(pydantic.BaseModel):
     jwt_secret: str = ""              # auto-generated + persisted when empty
     bootstrap_password: str = ""      # admin password; random when empty
     registration_token: str = ""      # cluster join token; random when empty
+    # externally-reachable server URL — embedded in provisioned cloud
+    # workers' bootstrap config (0.0.0.0 isn't dialable from a VM)
+    advertised_url: str = ""
 
     # worker
     worker_name: str = ""

@@ -652,8 +652,14 @@ def sample_images(
     lh, lw = height // cfg.vae_scale_factor, width // cfg.vae_scale_factor
     B = cond_tokens.shape[0]
 
-    context_c, _, pooled_c = encode_text(params, cfg, cond_tokens)
-    context_u, _, pooled_u = encode_text(params, cfg, uncond_tokens)
+    last_c, pen1_c, pooled_c = encode_text(params, cfg, cond_tokens)
+    last_u, pen1_u, pooled_u = encode_text(params, cfg, uncond_tokens)
+    # SD1.x conditions on encoder-1's final-LN output; SDXL was trained
+    # on the PENULTIMATE hidden states of BOTH encoders (diffusers feeds
+    # hidden_states[-2] for each) — using `last` for encoder 1 there
+    # degrades every SDXL generation.
+    context_c = pen1_c if cfg.text2_dim else last_c
+    context_u = pen1_u if cfg.text2_dim else last_u
     added = None
     if cfg.text2_dim:
         ct2 = cond_tokens2 if cond_tokens2 is not None else cond_tokens

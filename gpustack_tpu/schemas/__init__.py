@@ -29,6 +29,11 @@ from gpustack_tpu.schemas.users import ApiKey, User
 from gpustack_tpu.schemas.orgs import Org, OrgMember, OrgRole
 from gpustack_tpu.schemas.benchmarks import Benchmark, BenchmarkState
 from gpustack_tpu.schemas.inference_backends import InferenceBackend
+from gpustack_tpu.schemas.worker_pools import (
+    CloudWorker,
+    CloudWorkerState,
+    WorkerPool,
+)
 
 __all__ = [
     "Cluster",
@@ -56,4 +61,7 @@ __all__ = [
     "Benchmark",
     "BenchmarkState",
     "InferenceBackend",
+    "WorkerPool",
+    "CloudWorker",
+    "CloudWorkerState",
 ]

@@ -1,0 +1,66 @@
+"""Worker pools + provisioned cloud workers.
+
+Reference parity: worker pools with cloud-provider configs drive
+WorkerProvisioningController (reference server/controllers.py:2346-2630,
+cloud_providers/). A pool declares "N workers of TPU shape X via provider
+P"; the controller reconciles desired vs actual by creating/deleting
+cloud instances. The VM's worker agent then joins the cluster through
+normal registration (the CloudWorker row links the instance to the
+eventual Worker row by name).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class CloudWorkerState(str, enum.Enum):
+    CREATING = "creating"       # provider create issued / pending
+    STARTING = "starting"       # instance exists, not RUNNING yet
+    RUNNING = "running"         # VM up; agent join pending or done
+    FAILED = "failed"           # create/boot failed (kept for diagnosis)
+    DELETING = "deleting"       # scale-down: provider delete issued
+
+
+@register_record
+class WorkerPool(Record):
+    __kind__ = "worker_pool"
+    __indexes__ = ("name", "cluster_id")
+
+    name: str = ""
+    cluster_id: int = 0
+    provider: str = "tpu-vm"            # cloud/providers.py registry name
+    # provider-specific settings (tpu-vm: project/zone/runtime_version/
+    # network/access_token); secrets here are admin-only — worker-pool
+    # routes are admin_read (see server/app.py)
+    provider_config: Dict[str, str] = {}
+    instance_type: str = "v5litepod-8"  # accelerator type
+    image: str = ""                     # runtime version override
+    replicas: int = 0
+    labels: Dict[str, str] = {}
+    paused: bool = False                # stop reconciling (debugging)
+
+
+@register_record
+class CloudWorker(Record):
+    __kind__ = "cloud_worker"
+    __indexes__ = ("pool_id", "name", "state")
+
+    name: str = ""                      # == provisioned VM + Worker name
+    pool_id: int = 0
+    cluster_id: int = 0
+    external_id: str = ""               # provider instance identity
+    state: CloudWorkerState = CloudWorkerState.CREATING
+    state_message: str = ""
+    ip_address: str = ""
+    worker_id: int = 0                  # Worker row once the agent joins
+    # Snapshot of the pool's provider identity at creation time, so
+    # teardown stays possible after the pool row is gone (pool deleted,
+    # leadership change, crash between delete and sweep) — otherwise the
+    # provider instance would keep running (and billing) unreachable.
+    # Holds credentials → REDACTED from API serializations (app.py).
+    provider: str = ""
+    provider_config: Dict[str, str] = {}

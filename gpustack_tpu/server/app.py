@@ -284,6 +284,37 @@ def create_app(cfg: Config) -> web.Application:
         worker_write=True, create_hook=benchmark_create_hook,
     )
     add_crud_routes(app, InferenceBackend, "inference-backends")
+
+    async def worker_pool_create_hook(request, obj, body):
+        from gpustack_tpu.cloud.providers import _PROVIDERS
+
+        if not obj.name:
+            return json_error(400, "pool name is required")
+        if obj.provider not in _PROVIDERS:
+            return json_error(
+                400,
+                f"unknown provider {obj.provider!r} "
+                f"(available: {sorted(_PROVIDERS)})",
+            )
+        from gpustack_tpu.schemas import WorkerPool as _WP
+
+        if await _WP.first(name=obj.name):
+            return json_error(409, f"pool {obj.name!r} already exists")
+        return None
+
+    from gpustack_tpu.schemas import CloudWorker, WorkerPool
+
+    # provider_config may hold credentials → admin-only reads
+    add_crud_routes(
+        app, WorkerPool, "worker-pools",
+        create_hook=worker_pool_create_hook, admin_read=True,
+    )
+    # lifecycle rows are controller-owned: read-only over the API; the
+    # provider snapshot can carry credentials
+    add_crud_routes(
+        app, CloudWorker, "cloud-workers",
+        readonly=True, admin_read=True, redact=("provider_config",),
+    )
     # per-user usage rows: /v2/usage/summary already scopes non-admins to
     # their own usage (extras.py); raw rows are admin-only to match.
     add_crud_routes(

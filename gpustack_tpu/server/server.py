@@ -110,7 +110,17 @@ class Server:
             LeaseCoordinator(self.db, bus=self.bus)
             if cfg.ha else LocalCoordinator()
         )
-        self.controllers = [ModelController(), WorkerController()]
+        from gpustack_tpu.cloud.controller import WorkerPoolController
+
+        self.controllers = [
+            ModelController(),
+            WorkerController(),
+            WorkerPoolController(
+                server_url=cfg.advertised_url
+                or f"http://{cfg.host}:{cfg.port}",
+                registration_token=cfg.registration_token,
+            ),
+        ]
         self.scheduler = Scheduler()
         self.syncer = WorkerSyncer(
             stale_after=cfg.heartbeat_interval * 4.5,

@@ -1,0 +1,231 @@
+"""Cloud providers + WorkerPoolController reconcile."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.cloud.providers import (
+    CloudInstanceCreate,
+    FakeProvider,
+    InstanceState,
+    TpuVmProvider,
+    get_provider,
+)
+from gpustack_tpu.cloud.user_data import render_user_data
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    CloudWorker,
+    CloudWorkerState,
+    Worker,
+    WorkerPool,
+)
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def db():
+    FakeProvider.reset()
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    FakeProvider.reset()
+    database.close()
+
+
+def test_fake_provider_lifecycle():
+    async def go():
+        p = get_provider("fake")
+        eid = await p.create_instance(
+            CloudInstanceCreate(name="w0", instance_type="v5litepod-8")
+        )
+        inst = await p.get_instance(eid)
+        assert inst.state == InstanceState.RUNNING  # startup_s = 0
+        assert inst.ip_address
+        await p.delete_instance(eid)
+        assert await p.get_instance(eid) is None
+        await p.delete_instance(eid)  # idempotent
+
+    asyncio.run(go())
+
+
+def test_unknown_provider_rejected():
+    with pytest.raises(ValueError, match="unknown cloud provider"):
+        get_provider("droplets")
+
+
+def test_tpu_vm_provider_requires_project_zone():
+    with pytest.raises(ValueError, match="project"):
+        TpuVmProvider({"zone": "us-central1-a"})
+
+
+def test_user_data_contains_join_material():
+    ud = render_user_data(
+        "http://10.1.2.3:10150", "tok123", "pool-0", cluster_id=3
+    )
+    assert ud.startswith("#cloud-config")
+    assert 'server_url: "http://10.1.2.3:10150"' in ud
+    assert 'registration_token: "tok123"' in ud
+    assert 'worker_name: "pool-0"' in ud
+    assert "gpustack-tpu-worker.service" in ud
+    with pytest.raises(ValueError):
+        render_user_data('x"x', "t", "w")
+
+
+def _controller():
+    from gpustack_tpu.cloud.controller import WorkerPoolController
+
+    return WorkerPoolController(
+        server_url="http://server:10150", registration_token="tok",
+        rescan_s=3600,
+    )
+
+
+def test_reconcile_scales_up_and_links_workers(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-a", provider="fake", replicas=2)
+        )
+        await ctl._reconcile(pool.id)
+        rows = await CloudWorker.filter(pool_id=pool.id)
+        assert len(rows) == 2
+        assert all(r.external_id for r in rows)
+        assert all(r.state == CloudWorkerState.STARTING for r in rows)
+
+        # agent for pool-a-0 registers; next reconcile links + marks RUNNING
+        w = await Worker.create(Worker(name="pool-a-0"))
+        await ctl._reconcile(pool.id)
+        row0 = await CloudWorker.first(name="pool-a-0")
+        assert row0.state == CloudWorkerState.RUNNING
+        assert row0.worker_id == w.id
+        assert row0.ip_address
+
+    asyncio.run(go())
+
+
+def test_reconcile_scales_down_prefers_unjoined(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-b", provider="fake", replicas=3)
+        )
+        await ctl._reconcile(pool.id)
+        # join only pool-b-1
+        w = await Worker.create(Worker(name="pool-b-1"))
+        await ctl._reconcile(pool.id)
+
+        await pool.update(replicas=1)
+        await ctl._reconcile(pool.id)
+        rows = await CloudWorker.filter(pool_id=pool.id)
+        assert len(rows) == 1
+        assert rows[0].name == "pool-b-1"     # the joined one survives
+        assert await Worker.get(w.id) is not None
+
+        # provider instances for the doomed rows are gone
+        p = FakeProvider()
+        assert await p.get_instance("fake-pool-b-0") is None
+        assert await p.get_instance("fake-pool-b-2") is None
+
+    asyncio.run(go())
+
+
+def test_scale_to_zero_deletes_joined_worker(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-c", provider="fake", replicas=1)
+        )
+        await ctl._reconcile(pool.id)
+        w = await Worker.create(Worker(name="pool-c-0"))
+        await ctl._reconcile(pool.id)
+        await pool.update(replicas=0)
+        await ctl._reconcile(pool.id)
+        assert await CloudWorker.filter(pool_id=pool.id) == []
+        assert await Worker.get(w.id) is None
+
+    asyncio.run(go())
+
+
+def test_failed_create_marks_row_and_retries(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-d", provider="fake", replicas=1)
+        )
+        FakeProvider.fail_creates = True
+        with pytest.raises(RuntimeError):
+            await ctl._reconcile(pool.id)
+        row = (await CloudWorker.filter(pool_id=pool.id))[0]
+        assert row.state == CloudWorkerState.FAILED
+        assert "create failed" in row.state_message
+
+        # provider heals; the next reconcile replaces the failed row
+        FakeProvider.fail_creates = False
+        await ctl._reconcile(pool.id)
+        rows = await CloudWorker.filter(pool_id=pool.id)
+        live = [r for r in rows if r.state == CloudWorkerState.STARTING]
+        assert len(live) == 1
+
+    asyncio.run(go())
+
+
+def test_paused_pool_is_left_alone(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(
+                name="pool-e", provider="fake", replicas=2, paused=True
+            )
+        )
+        await ctl._reconcile(pool.id)
+        assert await CloudWorker.filter(pool_id=pool.id) == []
+
+    asyncio.run(go())
+
+
+def test_pool_delete_tears_down_instances(db):
+    """Deleting a pool must delete the provider instances (rows carry a
+    provider snapshot so teardown works without the pool row)."""
+
+    async def go():
+        from gpustack_tpu.server.bus import Event, EventType
+
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-g", provider="fake", replicas=2)
+        )
+        await ctl._reconcile(pool.id)
+        assert len(FakeProvider._instances) == 2
+        pool_id = pool.id
+        await pool.delete()
+        await ctl.handle(
+            Event(type=EventType.DELETED, kind="worker_pool", id=pool_id)
+        )
+        await ctl._reconcile(0)   # orphan sweep (queued by handle)
+        assert FakeProvider._instances == {}
+        assert await CloudWorker.filter(limit=None) == []
+
+    asyncio.run(go())
+
+
+def test_instance_disappearing_marks_failed(db):
+    async def go():
+        ctl = _controller()
+        pool = await WorkerPool.create(
+            WorkerPool(name="pool-f", provider="fake", replicas=1)
+        )
+        await ctl._reconcile(pool.id)
+        # instance vanishes behind our back
+        await FakeProvider().delete_instance("fake-pool-f-0")
+        await ctl._reconcile(pool.id)
+        # the row is marked FAILED by state sync, then recycled in the
+        # same reconcile: same name, fresh instance, no row growth
+        rows = await CloudWorker.filter(pool_id=pool.id)
+        assert len(rows) == 1
+        assert rows[0].name == "pool-f-0"
+        assert rows[0].state == CloudWorkerState.STARTING
+        assert await FakeProvider().get_instance("fake-pool-f-0")
+
+    asyncio.run(go())
