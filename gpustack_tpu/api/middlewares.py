@@ -37,15 +37,17 @@ _WORKER_ROUTE_ALLOWLIST = (
     # reads + watch streams the agent's reconcile loops depend on
     ("GET", re.compile(
         r"^/v2/(models|model-instances|model-files|benchmarks|"
-        r"inference-backends|workers)(/\d+)?$"
+        r"inference-backends|workers|dev-instances)(/\d+)?$"
     )),
     # instance/file/benchmark state reporting (ownership-guarded in crud)
     ("POST", re.compile(r"^/v2/model-files$")),
     ("PUT", re.compile(
-        r"^/v2/(model-instances|model-files|benchmarks)/\d+$"
+        r"^/v2/(model-instances|model-files|benchmarks|dev-instances)"
+        r"/\d+$"
     )),
     ("PATCH", re.compile(
-        r"^/v2/(model-instances|model-files|benchmarks)/\d+$"
+        r"^/v2/(model-instances|model-files|benchmarks|dev-instances)"
+        r"/\d+$"
     )),
 )
 

@@ -171,9 +171,14 @@ class WorkerPoolController(Controller):
             for cw in doomed:
                 await cw.update(state=CloudWorkerState.DELETING)
 
-        # retries for rows that exist but never got an instance
+        # retries for rows that exist but never got an instance — skip
+        # rows the scale-down pass above just doomed (update() mutates in
+        # place, so their state is visible here); resurrecting one would
+        # provision a VM that the DELETING sweep no longer sees
         for cw in live:
-            if not cw.external_id:
+            if not cw.external_id and cw.state not in (
+                CloudWorkerState.DELETING, CloudWorkerState.FAILED
+            ):
                 await self._ensure_instance(provider, pool, cw)
 
         # process deletions

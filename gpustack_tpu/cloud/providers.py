@@ -178,6 +178,12 @@ class TpuVmProvider(CloudProvider):
         "FAILED": InstanceState.FAILED,
     }
 
+    # metadata-server tokens are shared per process (they're per-VM, not
+    # per-pool); cached until near expiry
+    _cached_token: str = ""
+    _token_expiry: float = 0.0
+    _session = None
+
     def __init__(self, config: Optional[dict] = None) -> None:
         cfg = config or {}
         self.project = cfg.get("project", "")
@@ -200,9 +206,20 @@ class TpuVmProvider(CloudProvider):
     def _parent(self) -> str:
         return f"projects/{self.project}/locations/{self.zone}"
 
+    @classmethod
+    def _http(cls):
+        import aiohttp
+
+        if cls._session is None or cls._session.closed:
+            cls._session = aiohttp.ClientSession()
+        return cls._session
+
     async def _access_token(self) -> str:
         if self._token:
             return self._token
+        cls = type(self)
+        if cls._cached_token and time.monotonic() < cls._token_expiry:
+            return cls._cached_token
         import aiohttp
 
         # GCE metadata server (available on GCP VMs)
@@ -210,14 +227,19 @@ class TpuVmProvider(CloudProvider):
             "http://metadata.google.internal/computeMetadata/v1/"
             "instance/service-accounts/default/token"
         )
-        async with aiohttp.ClientSession() as s:
-            async with s.get(
-                url,
-                headers={"Metadata-Flavor": "Google"},
-                timeout=aiohttp.ClientTimeout(total=5),
-            ) as r:
-                r.raise_for_status()
-                return (await r.json())["access_token"]
+        async with self._http().get(
+            url,
+            headers={"Metadata-Flavor": "Google"},
+            timeout=aiohttp.ClientTimeout(total=5),
+        ) as r:
+            r.raise_for_status()
+            body = await r.json()
+        cls._cached_token = body["access_token"]
+        # refresh with 5 min of slack
+        cls._token_expiry = time.monotonic() + max(
+            60.0, float(body.get("expires_in", 3600)) - 300.0
+        )
+        return cls._cached_token
 
     async def _request(
         self, method: str, path: str, json_body: Optional[dict] = None,
@@ -226,24 +248,26 @@ class TpuVmProvider(CloudProvider):
         import aiohttp
 
         token = await self._access_token()
-        async with aiohttp.ClientSession() as s:
-            async with s.request(
-                method,
-                f"{self.api_base}/{path}",
-                json=json_body,
-                params=params,
-                headers={"Authorization": f"Bearer {token}"},
-                timeout=aiohttp.ClientTimeout(total=30),
-            ) as r:
-                if r.status == 404:
-                    return None
-                body = await r.json(content_type=None)
-                if r.status >= 400:
-                    raise RuntimeError(
-                        f"TPU API {method} {path} -> {r.status}: "
-                        f"{body.get('error', {}).get('message', body)}"
-                    )
-                return body
+        async with self._http().request(
+            method,
+            f"{self.api_base}/{path}",
+            json=json_body,
+            params=params,
+            headers={"Authorization": f"Bearer {token}"},
+            timeout=aiohttp.ClientTimeout(total=30),
+        ) as r:
+            # 404 means "no such instance" only for lookups/deletes; a
+            # 404 on create is a real error (bad project/zone, API not
+            # enabled) and must surface, not read as success
+            if r.status == 404 and method in ("GET", "DELETE"):
+                return None
+            body = await r.json(content_type=None)
+            if r.status >= 400:
+                raise RuntimeError(
+                    f"TPU API {method} {path} -> {r.status}: "
+                    f"{body.get('error', {}).get('message', body)}"
+                )
+            return body
 
     async def create_instance(self, spec: CloudInstanceCreate) -> str:
         node = {

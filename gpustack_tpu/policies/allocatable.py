@@ -1,12 +1,21 @@
 """Allocatable accounting: free chips per worker = detected − claimed by
 placed instances (reference gpustack/policies/utils.py
-get_worker_allocatable_resource: total − reserved − Σ claims)."""
+get_worker_allocatable_resource: total − reserved − Σ claims).
+
+Claims come from BOTH model instances and dev instances (reference
+gpu_instances also consume scheduled capacity) — callers pass one mixed
+iterable; states are judged per record type.
+"""
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Set
+from typing import Iterable, List, Set
 
-from gpustack_tpu.schemas import ModelInstance, ModelInstanceState, Worker
+from gpustack_tpu.schemas import (
+    DevInstanceState,
+    ModelInstanceState,
+    Worker,
+)
 
 # States whose placements count against capacity.
 CLAIMING_STATES = {
@@ -16,14 +25,27 @@ CLAIMING_STATES = {
     ModelInstanceState.RUNNING,
     ModelInstanceState.UNREACHABLE,   # the worker may come back; hold chips
 }
+DEV_CLAIMING_STATES = {
+    DevInstanceState.SCHEDULED,
+    DevInstanceState.STARTING,
+    DevInstanceState.RUNNING,
+}
+
+
+def _is_claiming(inst) -> bool:
+    if isinstance(inst.state, ModelInstanceState):
+        return inst.state in CLAIMING_STATES
+    if isinstance(inst.state, DevInstanceState):
+        return inst.state in DEV_CLAIMING_STATES
+    return False
 
 
 def claimed_chip_indexes(
-    worker_id: int, instances: Iterable[ModelInstance]
+    worker_id: int, instances: Iterable
 ) -> Set[int]:
     used: Set[int] = set()
     for inst in instances:
-        if inst.state not in CLAIMING_STATES:
+        if not _is_claiming(inst):
             continue
         if inst.worker_id == worker_id:
             used.update(inst.chip_indexes)
@@ -34,7 +56,7 @@ def claimed_chip_indexes(
 
 
 def worker_allocatable_chips(
-    worker: Worker, instances: Iterable[ModelInstance]
+    worker: Worker, instances: Iterable
 ) -> List[int]:
     """Free (usable, unclaimed) chip indexes on this worker, sorted."""
     used = claimed_chip_indexes(worker.id, instances)

@@ -25,6 +25,8 @@ from gpustack_tpu.scheduler.calculator import (
     evaluate_model,
 )
 from gpustack_tpu.schemas import (
+    DevInstance,
+    DevInstanceState,
     Model,
     ModelFile,
     ModelInstance,
@@ -72,6 +74,7 @@ class Scheduler:
         self.scan_interval = scan_interval
         self._task: Optional[asyncio.Task] = None
         self._scan_task: Optional[asyncio.Task] = None
+        self._dev_task: Optional[asyncio.Task] = None
         self._queue: asyncio.Queue = asyncio.Queue()
         # serialize placements: the watch task and periodic scan both call
         # _schedule_one; unserialized, two multi-host placements on one
@@ -84,9 +87,12 @@ class Scheduler:
         self._scan_task = asyncio.create_task(
             self._periodic_scan(), name="sched-scan"
         )
+        self._dev_task = asyncio.create_task(
+            self._watch_dev(), name="sched-watch-dev"
+        )
 
     def stop(self) -> None:
-        for t in (self._task, self._scan_task):
+        for t in (self._task, self._scan_task, self._dev_task):
             if t:
                 t.cancel()
 
@@ -145,6 +151,10 @@ class Scheduler:
 
     async def _scan(self) -> None:
         now = datetime.datetime.now(datetime.timezone.utc)
+        for dev in await DevInstance.filter(
+            state=DevInstanceState.PENDING
+        ):
+            await self._schedule_dev_logged(dev.id)
         for inst in await ModelInstance.all():
             if inst.state == ModelInstanceState.PENDING:
                 await self._schedule_one_logged(inst.id)
@@ -241,7 +251,10 @@ class Scheduler:
             return
 
         instances = await ModelInstance.all()
-        candidates = build_candidates(model, claim, eligible, instances)
+        # dev instances hold chips too (reference gpu_instances consume
+        # scheduled capacity alongside model workloads)
+        claims = list(instances) + list(await DevInstance.all())
+        candidates = build_candidates(model, claim, eligible, claims)
         if not candidates:
             await self._unschedulable(
                 inst,
@@ -294,4 +307,92 @@ class Scheduler:
         logger.warning("instance %s unschedulable: %s", inst.name, msg)
         await inst.update(
             state=ModelInstanceState.PENDING, state_message=msg
+        )
+
+    # -- dev instances (reference gpu_instances placement role) ----------
+
+    async def _watch_dev(self) -> None:
+        while True:
+            agen = DevInstance.subscribe(send_initial=True, heartbeat=30.0)
+            try:
+                async for event in agen:
+                    if event.type == EventType.RESYNC:
+                        break
+                    if event.type not in (
+                        EventType.CREATED, EventType.UPDATED
+                    ):
+                        continue
+                    data = event.data or {}
+                    if data.get("state") != DevInstanceState.PENDING.value:
+                        continue
+                    await self._schedule_dev_logged(event.id)
+            except asyncio.CancelledError:
+                await agen.aclose()
+                raise
+            finally:
+                await agen.aclose()
+
+    async def _schedule_dev_logged(self, dev_id: int) -> None:
+        try:
+            async with self._place_lock:
+                await self._schedule_dev_locked(dev_id)
+        except Exception as e:
+            logger.exception("scheduling dev instance %d failed", dev_id)
+            dev = await DevInstance.get(dev_id)
+            if dev is not None:
+                await dev.update(
+                    state=DevInstanceState.ERROR,
+                    state_message=f"scheduler error: {e}",
+                )
+
+    async def _schedule_dev_locked(self, dev_id: int) -> None:
+        from gpustack_tpu.policies.allocatable import (
+            worker_allocatable_chips,
+        )
+        from gpustack_tpu.policies.topology import allocate_subslice
+        from gpustack_tpu.schemas import WorkerState
+
+        dev = await DevInstance.get(dev_id)
+        if dev is None or dev.state != DevInstanceState.PENDING:
+            return
+        claims = list(await ModelInstance.all()) + list(
+            await DevInstance.all()
+        )
+        best = None
+        best_free = -1
+        for w in await Worker.all():
+            if w.state != WorkerState.READY:
+                continue
+            if dev.cluster_id and w.cluster_id != dev.cluster_id:
+                continue
+            free = worker_allocatable_chips(w, claims)
+            sl = w.status.slice
+            chips = allocate_subslice(
+                sl.topology if sl else "",
+                w.total_chips,
+                free,
+                dev.chips,
+            )
+            # spread: prefer the worker with the most free chips left
+            if chips is not None and len(free) > best_free:
+                best, best_free = (w, chips), len(free)
+        if best is None:
+            await dev.update(
+                state_message=(
+                    f"no worker has a free aligned {dev.chips}-chip "
+                    "sub-slice; retried on the next scan"
+                )
+            )
+            return
+        worker, chips = best
+        await dev.update(
+            state=DevInstanceState.SCHEDULED,
+            worker_id=worker.id,
+            worker_name=worker.name,
+            chip_indexes=chips,
+            state_message="",
+        )
+        logger.info(
+            "scheduled dev instance %s onto %s chips=%s",
+            dev.name, worker.name, chips,
         )

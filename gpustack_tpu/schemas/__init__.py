@@ -34,6 +34,10 @@ from gpustack_tpu.schemas.worker_pools import (
     CloudWorkerState,
     WorkerPool,
 )
+from gpustack_tpu.schemas.dev_instances import (
+    DevInstance,
+    DevInstanceState,
+)
 
 __all__ = [
     "Cluster",
@@ -64,4 +68,6 @@ __all__ = [
     "WorkerPool",
     "CloudWorker",
     "CloudWorkerState",
+    "DevInstance",
+    "DevInstanceState",
 ]

@@ -1,0 +1,60 @@
+"""Dev instances: chip-reserving interactive workspaces on workers.
+
+Reference parity: gpustack/gpu_instances/ (2,441 LoC) provides SSH-able
+GPU dev containers on K8s via the gpustack-operator. The TPU-native
+equivalent reserves whole chips on a worker host and runs a long-lived
+holder process with ``TPU_VISIBLE_CHIPS`` scoped to the reservation;
+interactive access is **remote exec through the worker's authenticated
+proxy** (POST /v2/dev-instances/{id}/exec) rather than an SSH pod —
+TPU VM hosts already carry SSH, what the cluster manager adds is chip
+reservation + a placed execution context.
+
+Lifecycle: PENDING → (scheduler places) SCHEDULED → (worker dev manager
+spawns) RUNNING; DELETED records stop the process and free the chips.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class DevInstanceState(str, enum.Enum):
+    PENDING = "pending"
+    SCHEDULED = "scheduled"
+    STARTING = "starting"
+    RUNNING = "running"
+    ERROR = "error"
+
+
+@register_record
+class DevInstance(Record):
+    __kind__ = "dev_instance"
+    __indexes__ = ("name", "worker_id", "state")
+
+    name: str = ""
+    cluster_id: int = 0
+    user_id: int = 0                 # creator (exec is admin-or-owner)
+    chips: int = 1                   # reserved chip count
+    labels: Dict[str, str] = {}
+    env: Dict[str, str] = {}         # extra env for the workspace
+    # command for the holder process; empty = built-in idle holder.
+    # The process defines the workspace's lifetime (like the reference
+    # instance's pod) — exec'd commands run beside it with the same env.
+    command: List[str] = []
+    state: DevInstanceState = DevInstanceState.PENDING
+    state_message: str = ""
+    # placement (scheduler-owned)
+    worker_id: int = 0
+    worker_name: str = ""
+    chip_indexes: List[int] = []
+    # runtime (worker-owned)
+    pid: int = 0
+
+    @property
+    def subordinate_workers(self) -> list:
+        # allocatable accounting walks subordinates on claims; dev
+        # instances are single-host by design
+        return []

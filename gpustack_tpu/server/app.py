@@ -315,6 +315,90 @@ def create_app(cfg: Config) -> web.Application:
         app, CloudWorker, "cloud-workers",
         readonly=True, admin_read=True, redact=("provider_config",),
     )
+
+    # -- dev instances (reference gpu_instances role) ---------------------
+    from gpustack_tpu.schemas import DevInstance, DevInstanceState
+
+    DEV_PLACEMENT_FIELDS = frozenset(
+        {"worker_id", "worker_name", "chip_indexes", "chips",
+         "name", "cluster_id", "user_id", "command", "env"}
+    )
+
+    def dev_worker_owns(principal, dev, new_fields) -> bool:
+        if dev is None:
+            return new_fields is None  # workers never create these
+        if set(new_fields or ()) & DEV_PLACEMENT_FIELDS:
+            return False
+        return dev.worker_id == principal.worker_id
+
+    async def dev_create_hook(request, obj: DevInstance, body):
+        if not obj.name:
+            return json_error(400, "dev instance name is required")
+        if await DevInstance.first(name=obj.name):
+            return json_error(409, f"dev instance {obj.name!r} exists")
+        if obj.chips < 1:
+            return json_error(400, "chips must be >= 1")
+        # server-owned fields can't be seeded by the client
+        obj.state = DevInstanceState.PENDING
+        obj.state_message = ""
+        obj.worker_id = 0
+        obj.worker_name = ""
+        obj.chip_indexes = []
+        obj.pid = 0
+        principal = request.get("principal")
+        if principal is not None and principal.user is not None:
+            obj.user_id = principal.user.id
+        return None
+
+    add_crud_routes(
+        app, DevInstance, "dev-instances",
+        create_hook=dev_create_hook,
+        worker_write=True, worker_owns=dev_worker_owns,
+    )
+
+    async def dev_exec(request: web.Request) -> web.Response:
+        """Exec inside a dev instance, relayed through the owning
+        worker's authenticated proxy. Admin or the instance's creator."""
+        principal = request.get("principal")
+        dev = await DevInstance.get(int(request.match_info["id"]))
+        if dev is None:
+            return json_error(404, "dev instance not found")
+        is_owner = bool(
+            principal and principal.user
+            and principal.user.id == dev.user_id
+        )
+        if not (principal and principal.is_admin or is_owner):
+            return json_error(403, "admin or instance owner required")
+        if dev.state != DevInstanceState.RUNNING:
+            return json_error(
+                409, f"dev instance is {dev.state.value}, not running"
+            )
+        worker = await Worker.get(dev.worker_id)
+        if worker is None:
+            return json_error(503, "owning worker not found")
+        try:
+            body = await request.json()
+        except ValueError:
+            return json_error(400, "invalid JSON")
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        try:
+            upstream = await worker_fetch(
+                app, worker, "POST",
+                f"/v2/dev-instances/{dev.id}/exec",
+                json_body=body,
+            )
+        except aiohttp.ClientError as e:
+            return json_error(502, f"worker unreachable: {e}")
+        payload = await upstream.read()
+        upstream.release()
+        return web.Response(
+            body=payload,
+            status=upstream.status,
+            content_type=upstream.content_type,
+        )
+
+    app.router.add_post("/v2/dev-instances/{id:\\d+}/exec", dev_exec)
     # per-user usage rows: /v2/usage/summary already scopes non-admins to
     # their own usage (extras.py); raw rows are admin-only to match.
     add_crud_routes(

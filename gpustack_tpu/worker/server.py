@@ -44,6 +44,9 @@ class WorkerServer:
                     "/v2/instances/{id:\\d+}/logs", self.instance_logs
                 ),
                 web.get("/v2/filesystem/probe", self.filesystem_probe),
+                web.post(
+                    "/v2/dev-instances/{id:\\d+}/exec", self.dev_exec
+                ),
                 web.route(
                     "*",
                     "/proxy/instances/{id:\\d+}/{tail:.*}",
@@ -293,6 +296,41 @@ class WorkerServer:
                     result["config_error"] = str(e)
             elif os.path.exists(cfg_path):
                 result["config_error"] = "config.json escapes model roots"
+        return web.json_response(result)
+
+    async def dev_exec(self, request: web.Request) -> web.Response:
+        """Run a command in a dev instance's environment (the TPU-native
+        access path of the reference's SSH-able gpu_instances — chips
+        scoped via TPU_VISIBLE_CHIPS, auth via the worker proxy secret,
+        reached only through the server's authorized exec route)."""
+        dm = getattr(self.agent, "dev_manager", None)
+        if dm is None:
+            return web.json_response({"error": "not ready"}, status=503)
+        dev_id = int(request.match_info["id"])
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response(
+                {"error": "invalid JSON"}, status=400
+            )
+        argv = body.get("cmd")
+        if not isinstance(argv, list) or not argv or not all(
+            isinstance(a, str) for a in argv
+        ):
+            return web.json_response(
+                {"error": "'cmd' must be a non-empty list of strings"},
+                status=400,
+            )
+        try:
+            timeout = min(float(body.get("timeout", 60.0)), 600.0)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "bad 'timeout'"}, status=400
+            )
+        try:
+            result = await dm.exec(dev_id, argv, timeout=timeout)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
         return web.json_response(result)
 
     async def instance_logs(self, request: web.Request) -> web.Response:

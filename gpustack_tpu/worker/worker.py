@@ -78,6 +78,12 @@ class WorkerAgent:
         self.benchmark_manager = BenchmarkManager(
             self.client, self.worker_id
         )
+        from gpustack_tpu.worker.dev_manager import DevManager
+
+        self.dev_manager = DevManager(
+            self.cfg, self.client, self.worker_id
+        )
+        self.dev_manager.reap_orphans()
         self.http = WorkerServer(self)
         # The worker HTTP server is the sole inference ingress (engines
         # bind to loopback) — failing to bind is a total outage, not a
@@ -88,11 +94,15 @@ class WorkerAgent:
         # converge with the server's view (restart recovery: zombie
         # RUNNING records, orphan stops) before the watch stream starts
         await self.serve_manager.reconcile()
+        await self.dev_manager.reconcile()
         self._tasks = [
             asyncio.create_task(self._heartbeat_loop(), name="wk-heartbeat"),
             asyncio.create_task(self._status_loop(), name="wk-status"),
             asyncio.create_task(self._watch_instances(), name="wk-watch"),
             asyncio.create_task(self._watch_benchmarks(), name="wk-bench"),
+            asyncio.create_task(
+                self._watch_dev_instances(), name="wk-dev"
+            ),
             asyncio.create_task(
                 self.benchmark_manager.rescan_loop(), name="wk-bench-rescan"
             ),
@@ -125,6 +135,8 @@ class WorkerAgent:
             t.cancel()
         if self.serve_manager:
             await self.serve_manager.stop_all()
+        if getattr(self, "dev_manager", None):
+            await self.dev_manager.stop_all()
         if getattr(self, "http", None):
             await self.http.stop()
         if self.client:
@@ -203,3 +215,12 @@ class WorkerAgent:
                 raise
             except Exception:
                 logger.exception("benchmark manager failed on %s", event.type)
+
+    async def _watch_dev_instances(self) -> None:
+        async for event in self.client.watch("dev-instances"):
+            try:
+                await self.dev_manager.handle_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("dev manager failed on %s", event.type)

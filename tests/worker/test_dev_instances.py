@@ -1,0 +1,263 @@
+"""Dev instances: placement, holder lifecycle, exec, chip accounting."""
+
+import asyncio
+import sys
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.policies.allocatable import worker_allocatable_chips
+from gpustack_tpu.schemas import (
+    DevInstance,
+    DevInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def _worker(name="w0", chips=8, topology="2x4"):
+    from gpustack_tpu.schemas import SliceTopology, TPUChip, WorkerStatus
+
+    return Worker(
+        name=name,
+        state=WorkerState.READY,
+        status=WorkerStatus(
+            chips=[TPUChip(index=i) for i in range(chips)],
+            slice=SliceTopology(
+                topology=topology, chips_per_host=chips
+            ),
+        ),
+    )
+
+
+def test_dev_instance_claims_chips(db):
+    async def go():
+        w = await Worker.create(_worker())
+        dev = await DevInstance.create(
+            DevInstance(
+                name="d0", chips=4, state=DevInstanceState.RUNNING,
+                worker_id=w.id, chip_indexes=[0, 1, 2, 3],
+            )
+        )
+        free = worker_allocatable_chips(w, [dev])
+        assert free == [4, 5, 6, 7]
+        # non-claiming states free the chips
+        await dev.update(state=DevInstanceState.ERROR)
+        dev = await DevInstance.get(dev.id)
+        assert worker_allocatable_chips(w, [dev]) == list(range(8))
+
+    asyncio.run(go())
+
+
+def test_scheduler_places_dev_instance(db):
+    from gpustack_tpu.scheduler.scheduler import Scheduler
+
+    async def go():
+        await Worker.create(_worker("w0"))
+        w1 = await Worker.create(_worker("w1"))
+        # w1 busier: a running dev instance holding 4 chips
+        await DevInstance.create(
+            DevInstance(
+                name="busy", chips=4, state=DevInstanceState.RUNNING,
+                worker_id=w1.id, chip_indexes=[0, 1, 2, 3],
+            )
+        )
+        dev = await DevInstance.create(
+            DevInstance(name="d1", chips=4)
+        )
+        sched = Scheduler()
+        await sched._schedule_dev_logged(dev.id)
+        dev = await DevInstance.get(dev.id)
+        assert dev.state == DevInstanceState.SCHEDULED
+        assert dev.worker_name == "w0"       # spread to the freer worker
+        assert len(dev.chip_indexes) == 4
+
+    asyncio.run(go())
+
+
+def test_scheduler_rejects_untileable_count(db):
+    from gpustack_tpu.scheduler.scheduler import Scheduler
+
+    async def go():
+        await Worker.create(_worker())
+        # 3 chips don't tile a 2x4 ICI mesh (1/4/8 only)
+        dev = await DevInstance.create(DevInstance(name="d2", chips=3))
+        sched = Scheduler()
+        await sched._schedule_dev_logged(dev.id)
+        dev = await DevInstance.get(dev.id)
+        assert dev.state == DevInstanceState.PENDING
+        assert "sub-slice" in dev.state_message
+
+    asyncio.run(go())
+
+
+def test_scheduler_avoids_double_booking(db):
+    from gpustack_tpu.scheduler.scheduler import Scheduler
+
+    async def go():
+        w = await Worker.create(_worker())
+        await DevInstance.create(
+            DevInstance(
+                name="hold", chips=8, state=DevInstanceState.RUNNING,
+                worker_id=w.id, chip_indexes=list(range(8)),
+            )
+        )
+        dev = await DevInstance.create(DevInstance(name="d3", chips=4))
+        sched = Scheduler()
+        await sched._schedule_dev_logged(dev.id)
+        dev = await DevInstance.get(dev.id)
+        assert dev.state == DevInstanceState.PENDING
+
+    asyncio.run(go())
+
+
+class _FakeClient:
+    """Stub of ClientSet for DevManager unit tests."""
+
+    def __init__(self, records):
+        self.records = {r.id: r for r in records}
+        self.updates = []
+
+    async def list(self, kind):
+        return [r.model_dump(mode="json") for r in self.records.values()]
+
+    async def get(self, kind, rid):
+        return self.records[rid].model_dump(mode="json")
+
+    async def update(self, kind, rid, fields):
+        self.updates.append((rid, dict(fields)))
+        r = self.records.get(rid)
+        if r is not None:
+            for k, v in fields.items():
+                setattr(r, k, v if k != "state" else DevInstanceState(v))
+
+
+class _Cfg:
+    def __init__(self, tmp):
+        self.data_dir = str(tmp)
+
+
+def test_dev_manager_lifecycle_and_exec(tmp_path):
+    from gpustack_tpu.worker.dev_manager import DevManager
+
+    dev = DevInstance(
+        id=1, name="dm0", chips=2, worker_id=7,
+        state=DevInstanceState.SCHEDULED,
+        chip_indexes=[2, 3],
+        env={"DEV_MARKER": "yes"},
+    )
+    client = _FakeClient([dev])
+
+    async def go():
+        dm = DevManager(_Cfg(tmp_path), client, worker_id=7)
+        await dm.start_instance(1)
+        assert 1 in dm.running
+        run = dm.running[1]
+        assert run.proc.poll() is None          # holder alive
+        assert run.env["TPU_VISIBLE_CHIPS"] == "2,3"
+        states = [f.get("state") for _, f in client.updates]
+        assert states[-1] == "running"
+        assert client.updates[-1][1]["pid"] == run.proc.pid
+
+        out = await dm.exec(
+            1,
+            [sys.executable, "-c",
+             "import os; print(os.environ['DEV_MARKER'], "
+             "os.environ['TPU_VISIBLE_CHIPS'])"],
+        )
+        assert out["rc"] == 0
+        assert out["stdout"].strip() == "yes 2,3"
+
+        with pytest.raises(KeyError):
+            await dm.exec(99, ["true"])
+
+        await dm.stop_instance(1)
+        assert 1 not in dm.running
+        assert run.proc.poll() is not None      # holder gone
+
+    asyncio.run(go())
+
+
+def test_dev_manager_reports_holder_crash(tmp_path):
+    from gpustack_tpu.worker.dev_manager import DevManager
+
+    dev = DevInstance(
+        id=2, name="dm1", chips=1, worker_id=7,
+        state=DevInstanceState.SCHEDULED,
+        command=[sys.executable, "-c", "import sys; sys.exit(3)"],
+    )
+    client = _FakeClient([dev])
+
+    async def go():
+        dm = DevManager(_Cfg(tmp_path), client, worker_id=7)
+        await dm.start_instance(2)
+        for _ in range(100):
+            if client.updates and client.updates[-1][1].get(
+                "state"
+            ) == "error":
+                break
+            await asyncio.sleep(0.1)
+        last = client.updates[-1][1]
+        assert last["state"] == "error"
+        assert "rc=3" in last["state_message"]
+        assert 2 not in dm.running
+
+    asyncio.run(go())
+
+
+def test_dev_manager_reaps_orphans_across_restart(tmp_path):
+    """A holder surviving an agent crash is killed by the next agent's
+    startup reap (pid + argv fingerprint), so reconcile can't double-run
+    the workspace command."""
+    from gpustack_tpu.worker.dev_manager import DevManager
+
+    dev = DevInstance(
+        id=5, name="dm3", chips=1, worker_id=7,
+        state=DevInstanceState.SCHEDULED,
+    )
+    client = _FakeClient([dev])
+
+    async def go():
+        dm = DevManager(_Cfg(tmp_path), client, worker_id=7)
+        await dm.start_instance(5)
+        orphan = dm.running[5].proc
+        dm.running.clear()             # simulate agent crash (no stop)
+
+        dm2 = DevManager(_Cfg(tmp_path), client, worker_id=7)
+        reaped = dm2.reap_orphans()
+        assert reaped == 1
+        assert orphan.poll() is not None
+
+    asyncio.run(go())
+
+
+def test_dev_manager_reconcile_stops_unassigned(tmp_path):
+    from gpustack_tpu.worker.dev_manager import DevManager
+
+    dev = DevInstance(
+        id=3, name="dm2", chips=1, worker_id=7,
+        state=DevInstanceState.SCHEDULED,
+    )
+    client = _FakeClient([dev])
+
+    async def go():
+        dm = DevManager(_Cfg(tmp_path), client, worker_id=7)
+        await dm.reconcile()
+        assert 3 in dm.running
+        # record reassigned to another worker → reconcile stops it
+        client.records[3].worker_id = 99
+        await dm.reconcile()
+        assert 3 not in dm.running
+
+    asyncio.run(go())
