@@ -1,0 +1,88 @@
+"""CAS (Central Authentication Service) client: login redirect + ticket
+validation.
+
+Reference parity: routes/auth.py CAS flow. Protocol v2/v3
+``serviceValidate``: the browser returns from the CAS server with a
+service ticket; we validate it server-to-server and read the username
+from the XML envelope. XML parsing is entity/network-hardened.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from typing import Any, Dict
+
+import aiohttp
+from lxml import etree
+
+CAS_NS = {"cas": "http://www.yale.edu/tp/cas"}
+_PARSER = etree.XMLParser(
+    resolve_entities=False, no_network=True, huge_tree=False
+)
+
+
+class CASError(ValueError):
+    pass
+
+
+class CASProvider:
+    def __init__(self, cas_url: str) -> None:
+        self.cas_url = cas_url.rstrip("/")
+        self._session = None   # lazy long-lived pool (one per provider)
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    def login_url(self, service: str) -> str:
+        return (
+            f"{self.cas_url}/login?"
+            + urllib.parse.urlencode({"service": service})
+        )
+
+    async def validate(self, ticket: str, service: str) -> Dict[str, Any]:
+        """serviceValidate; returns {"user": ..., "attributes": {...}}."""
+        url = (
+            f"{self.cas_url}/serviceValidate?"
+            + urllib.parse.urlencode(
+                {"ticket": ticket, "service": service}
+            )
+        )
+        async with self._http().get(
+            url, timeout=aiohttp.ClientTimeout(total=10)
+        ) as r:
+            if r.status != 200:
+                raise CASError(
+                    f"CAS serviceValidate HTTP {r.status}"
+                )
+            body = await r.read()
+        try:
+            root = etree.fromstring(body, parser=_PARSER)
+        except etree.XMLSyntaxError as e:
+            raise CASError(f"malformed CAS response: {e}")
+        failure = root.find("cas:authenticationFailure", CAS_NS)
+        if failure is not None:
+            raise CASError(
+                f"CAS rejected ticket: {failure.get('code', '')} "
+                f"{(failure.text or '').strip()}"
+            )
+        success = root.find("cas:authenticationSuccess", CAS_NS)
+        if success is None:
+            raise CASError("CAS response carries no success element")
+        user = success.findtext(
+            "cas:user", default="", namespaces=CAS_NS
+        ).strip()
+        if not user:
+            raise CASError("CAS success carries no user")
+        attributes: Dict[str, Any] = {}
+        attrs = success.find("cas:attributes", CAS_NS)
+        if attrs is not None:
+            for child in attrs:
+                tag = etree.QName(child).localname
+                attributes[tag] = (child.text or "").strip()
+        return {"user": user, "attributes": attributes}

@@ -22,6 +22,10 @@ PUBLIC_PATHS = {
     "/auth/login",
     "/auth/oidc/login",
     "/auth/oidc/callback",
+    "/auth/saml/login",
+    "/auth/saml/acs",
+    "/auth/cas/login",
+    "/auth/cas/callback",
     "/v2/workers/register",
     "/metrics",
 }

@@ -1,0 +1,383 @@
+"""SAML 2.0 service provider: SP-initiated redirect login + POST ACS.
+
+Reference parity: routes/auth.py SAML flow (python3-saml there). Here the
+SP is self-contained on lxml + cryptography:
+
+- ``authn_request_url`` — AuthnRequest via the HTTP-Redirect binding
+  (deflate → b64 → query param).
+- ``verify_response`` — full XML-DSig check of the POSTed SAMLResponse:
+  exclusive-c14n SignedInfo, enveloped-signature + exclusive-c14n
+  reference digest, RSA-SHA256 (SHA-1 rejected), signing cert PINNED
+  from server config (KeyInfo in the message is never trusted), then
+  Conditions window + audience restriction.
+
+XML parsing is hardened: entity resolution and network access disabled
+(XXE), and the signed-reference lookup only honors the assertion/response
+elements' own IDs (no id-attribute spoofing via unsigned wrappers).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import secrets
+import urllib.parse
+import zlib
+from typing import Any, Dict
+
+from lxml import etree
+
+NSMAP = {
+    "samlp": "urn:oasis:names:tc:SAML:2.0:protocol",
+    "saml": "urn:oasis:names:tc:SAML:2.0:assertion",
+    "ds": "http://www.w3.org/2000/09/xmldsig#",
+}
+RSA_SHA256 = "http://www.w3.org/2001/04/xmldsig-more#rsa-sha256"
+SHA256 = "http://www.w3.org/2001/04/xmlenc#sha256"
+ENVELOPED = "http://www.w3.org/2000/09/xmldsig#enveloped-signature"
+EXC_C14N = "http://www.w3.org/2001/10/xml-exc-c14n#"
+
+_PARSER = etree.XMLParser(
+    resolve_entities=False, no_network=True, remove_comments=False,
+    huge_tree=False,
+)
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _parse_saml_time(s: str) -> datetime.datetime:
+    s = s.strip()
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    return datetime.datetime.fromisoformat(s)
+
+
+class SAMLError(ValueError):
+    pass
+
+
+class SAMLProvider:
+    def __init__(
+        self,
+        idp_sso_url: str,
+        idp_cert_pem: str,
+        sp_entity_id: str,
+        clock_skew_s: float = 90.0,
+    ) -> None:
+        self.idp_sso_url = idp_sso_url
+        self.sp_entity_id = sp_entity_id
+        self.clock_skew = datetime.timedelta(seconds=clock_skew_s)
+        self._public_key = self._load_cert(idp_cert_pem)
+        # one-time-use ledger: assertion IDs consumed within their
+        # validity window — a captured signed response must not mint a
+        # second session (replay defense alongside InResponseTo)
+        self._seen_assertions: Dict[str, float] = {}
+
+    @staticmethod
+    def _load_cert(pem: str):
+        from cryptography import x509
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        text = pem.strip()
+        if not text.startswith("-----"):
+            with open(text) as f:
+                text = f.read()
+        cert = x509.load_pem_x509_certificate(text.encode())
+        key = cert.public_key()
+        if not isinstance(key, rsa.RSAPublicKey):
+            raise SAMLError("IdP certificate must carry an RSA key")
+        return key
+
+    # -- AuthnRequest (HTTP-Redirect binding) -----------------------------
+
+    def authn_request_url(
+        self, acs_url: str, relay_state: str
+    ) -> "tuple[str, str]":
+        """Returns (redirect_url, request_id). The caller must remember
+        the request id (browser-bound cookie) and pass it to
+        ``verify_response`` — the assertion's InResponseTo has to match,
+        or a response captured from another login replays."""
+        req_id = "_" + secrets.token_hex(16)
+        issue_instant = _utcnow().strftime("%Y-%m-%dT%H:%M:%SZ")
+        xml = (
+            f'<samlp:AuthnRequest xmlns:samlp="{NSMAP["samlp"]}" '
+            f'xmlns:saml="{NSMAP["saml"]}" ID="{req_id}" Version="2.0" '
+            f'IssueInstant="{issue_instant}" '
+            f'ProtocolBinding="urn:oasis:names:tc:SAML:2.0:bindings:'
+            f'HTTP-POST" '
+            f'AssertionConsumerServiceURL="{acs_url}">'
+            f"<saml:Issuer>{self.sp_entity_id}</saml:Issuer>"
+            f"</samlp:AuthnRequest>"
+        )
+        deflated = zlib.compress(xml.encode())[2:-4]  # raw DEFLATE
+        query = urllib.parse.urlencode(
+            {
+                "SAMLRequest": base64.b64encode(deflated).decode(),
+                "RelayState": relay_state,
+            }
+        )
+        sep = "&" if "?" in self.idp_sso_url else "?"
+        return f"{self.idp_sso_url}{sep}{query}", req_id
+
+    # -- Response verification (HTTP-POST binding) ------------------------
+
+    def verify_response(
+        self,
+        saml_response_b64: str,
+        request_id: str = "",
+        acs_url: str = "",
+    ) -> Dict[str, Any]:
+        """Validate the POSTed SAMLResponse; returns
+        {"name_id": ..., "attributes": {...}}.
+
+        ``request_id``: the AuthnRequest ID this browser initiated —
+        the response's InResponseTo must match (replay/mix-up defense).
+        ``acs_url``: checked against SubjectConfirmationData Recipient
+        when the IdP includes one.
+        """
+        try:
+            raw = base64.b64decode(saml_response_b64, validate=True)
+        except Exception:
+            raise SAMLError("SAMLResponse is not valid base64")
+        try:
+            root = etree.fromstring(raw, parser=_PARSER)
+        except etree.XMLSyntaxError as e:
+            raise SAMLError(f"malformed XML: {e}")
+
+        status = root.find(
+            ".//samlp:StatusCode", NSMAP
+        )
+        if status is None or not status.get("Value", "").endswith(
+            ":Success"
+        ):
+            raise SAMLError(
+                "IdP status "
+                f"{status.get('Value') if status is not None else 'absent'}"
+            )
+
+        assertion = root.find("saml:Assertion", NSMAP)
+        if assertion is None:
+            raise SAMLError(
+                "no bare Assertion (encrypted assertions unsupported)"
+            )
+
+        # signature may envelop the Response or the Assertion; at least
+        # one must verify, and it must cover the element we consume
+        verified = False
+        for scope in (root, assertion):
+            sig = scope.find("ds:Signature", NSMAP)
+            if sig is not None:
+                self._verify_signature(scope, sig)
+                verified = True
+                break
+        if not verified:
+            raise SAMLError("response carries no signature")
+
+        self._check_conditions(assertion)
+        self._check_subject_confirmation(
+            assertion, request_id, acs_url
+        )
+        if request_id:
+            irt = root.get("InResponseTo", "") or assertion.get(
+                "InResponseTo", ""
+            )
+            # some IdPs put InResponseTo only on SubjectConfirmationData
+            scd = assertion.find(
+                "saml:Subject/saml:SubjectConfirmation/"
+                "saml:SubjectConfirmationData", NSMAP,
+            )
+            if not irt and scd is not None:
+                irt = scd.get("InResponseTo", "")
+            if irt != request_id:
+                raise SAMLError(
+                    "InResponseTo does not match this browser's "
+                    "AuthnRequest"
+                )
+        self._consume_assertion_id(assertion)
+
+        name_id = assertion.findtext(
+            "saml:Subject/saml:NameID", default="", namespaces=NSMAP
+        ).strip()
+        attributes: Dict[str, Any] = {}
+        for attr in assertion.findall(
+            "saml:AttributeStatement/saml:Attribute", NSMAP
+        ):
+            values = [
+                (v.text or "").strip()
+                for v in attr.findall("saml:AttributeValue", NSMAP)
+            ]
+            name = attr.get("Name", "")
+            if name:
+                attributes[name] = (
+                    values[0] if len(values) == 1 else values
+                )
+        if not name_id and not attributes:
+            raise SAMLError("assertion carries no identity")
+        return {"name_id": name_id, "attributes": attributes}
+
+    # -- XML-DSig ----------------------------------------------------------
+
+    def _verify_signature(self, scope, sig) -> None:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        signed_info = sig.find("ds:SignedInfo", NSMAP)
+        if signed_info is None:
+            raise SAMLError("signature missing SignedInfo")
+        sig_method = signed_info.find(
+            "ds:SignatureMethod", NSMAP
+        )
+        if sig_method is None or sig_method.get(
+            "Algorithm"
+        ) != RSA_SHA256:
+            raise SAMLError(
+                "unsupported signature algorithm (only RSA-SHA256)"
+            )
+        ref = signed_info.find("ds:Reference", NSMAP)
+        if ref is None:
+            raise SAMLError("signature missing Reference")
+        uri = ref.get("URI", "")
+        if not uri.startswith("#"):
+            raise SAMLError("only same-document references supported")
+        if uri[1:] != scope.get("ID", ""):
+            # the signature must cover the element it envelops — a
+            # reference to some other id would let an attacker wrap a
+            # signed assertion beside an unsigned one
+            raise SAMLError("signature reference does not cover scope")
+        digest_method = ref.find("ds:DigestMethod", NSMAP)
+        if digest_method is None or digest_method.get(
+            "Algorithm"
+        ) != SHA256:
+            raise SAMLError("unsupported digest algorithm (only SHA-256)")
+        transforms = [
+            t.get("Algorithm")
+            for t in ref.findall("ds:Transforms/ds:Transform", NSMAP)
+        ]
+        if not set(transforms) <= {ENVELOPED, EXC_C14N}:
+            raise SAMLError(f"unsupported transforms {transforms}")
+
+        # reference digest: element minus its enveloped Signature,
+        # exclusive c14n
+        import copy
+
+        scope_copy = copy.deepcopy(scope)
+        sig_copy = scope_copy.find("ds:Signature", NSMAP)
+        if sig_copy is not None:
+            scope_copy.remove(sig_copy)
+        digest_input = etree.tostring(
+            scope_copy, method="c14n", exclusive=True, with_comments=False
+        )
+        import hashlib
+
+        digest = hashlib.sha256(digest_input).digest()
+        want = base64.b64decode(
+            ref.findtext("ds:DigestValue", default="", namespaces=NSMAP)
+        )
+        if digest != want:
+            raise SAMLError("reference digest mismatch")
+
+        # SignedInfo signature
+        si_c14n = etree.tostring(
+            signed_info, method="c14n", exclusive=True, with_comments=False
+        )
+        sig_value = base64.b64decode(
+            sig.findtext(
+                "ds:SignatureValue", default="", namespaces=NSMAP
+            )
+        )
+        try:
+            self._public_key.verify(
+                sig_value, si_c14n, padding.PKCS1v15(), hashes.SHA256()
+            )
+        except InvalidSignature:
+            raise SAMLError("signature verification failed")
+
+    @staticmethod
+    def _parse_time_or_raise(s: str) -> datetime.datetime:
+        # parse-only try scope: SAMLError subclasses ValueError, so the
+        # validity checks themselves must sit OUTSIDE any
+        # except-ValueError, or "assertion expired" gets re-wrapped as a
+        # misleading "bad timestamp" error
+        try:
+            t = _parse_saml_time(s)
+        except ValueError as e:
+            raise SAMLError(f"bad condition timestamp {s!r}: {e}")
+        if t.tzinfo is None:
+            # SAML timestamps are UTC; a missing designator must not
+            # blow up the aware-vs-naive comparison
+            t = t.replace(tzinfo=datetime.timezone.utc)
+        return t
+
+    def _check_conditions(self, assertion) -> None:
+        cond = assertion.find("saml:Conditions", NSMAP)
+        now = _utcnow()
+        if cond is not None:
+            nb = cond.get("NotBefore")
+            na = cond.get("NotOnOrAfter")
+            if nb and now + self.clock_skew < self._parse_time_or_raise(
+                nb
+            ):
+                raise SAMLError("assertion not yet valid")
+            if na and now - self.clock_skew >= self._parse_time_or_raise(
+                na
+            ):
+                raise SAMLError("assertion expired")
+            audiences = [
+                (a.text or "").strip()
+                for a in cond.findall(
+                    "saml:AudienceRestriction/saml:Audience", NSMAP
+                )
+            ]
+            if audiences and self.sp_entity_id not in audiences:
+                raise SAMLError("assertion audience mismatch")
+
+    def _check_subject_confirmation(
+        self, assertion, request_id: str, acs_url: str
+    ) -> None:
+        scd = assertion.find(
+            "saml:Subject/saml:SubjectConfirmation/"
+            "saml:SubjectConfirmationData", NSMAP,
+        )
+        if scd is None:
+            return
+        now = _utcnow()
+        na = scd.get("NotOnOrAfter")
+        if na and now - self.clock_skew >= self._parse_time_or_raise(na):
+            raise SAMLError("subject confirmation expired")
+        recipient = scd.get("Recipient", "")
+        if acs_url and recipient and recipient != acs_url:
+            raise SAMLError("subject confirmation recipient mismatch")
+
+    def _consume_assertion_id(self, assertion) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        # prune expired entries (window: validity + skew, capped 1h)
+        for aid, exp in list(self._seen_assertions.items()):
+            if exp < now:
+                del self._seen_assertions[aid]
+        aid = assertion.get("ID", "")
+        if not aid:
+            raise SAMLError("assertion has no ID")
+        if aid in self._seen_assertions:
+            raise SAMLError("assertion already consumed (replay)")
+        self._seen_assertions[aid] = now + 3600.0
+
+
+def claims_to_username(result: Dict[str, Any]) -> str:
+    """NameID first; common email/uid attributes as fallback."""
+    if result.get("name_id"):
+        return str(result["name_id"])
+    attrs = result.get("attributes", {})
+    for key in (
+        "email", "mail", "uid",
+        "urn:oid:0.9.2342.19200300.100.1.3",   # mail
+        "urn:oid:0.9.2342.19200300.100.1.1",   # uid
+    ):
+        v = attrs.get(key)
+        if v:
+            return v if isinstance(v, str) else v[0]
+    return ""

@@ -59,6 +59,13 @@ class Config(pydantic.BaseModel):
     oidc_issuer: str = ""
     oidc_client_id: str = ""
     oidc_client_secret: str = ""
+    # SAML SP (reference routes/auth.py SAML flow): IdP SSO redirect URL,
+    # IdP signing cert (PEM text or file path), our SP entity id
+    saml_idp_sso_url: str = ""
+    saml_idp_cert: str = ""
+    saml_sp_entity_id: str = ""
+    # CAS server base URL, e.g. https://cas.example.edu/cas
+    cas_url: str = ""
     # external base URL for the OIDC redirect_uri (defaults to the
     # request's own host)
     external_url: str = ""

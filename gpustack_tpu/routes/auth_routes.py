@@ -24,6 +24,9 @@ logger = logging.getLogger(__name__)
 SESSION_COOKIE = "gpustack_tpu_session"
 
 
+SAML_REQ_COOKIE = "gpustack_saml_req"
+
+
 def add_auth_routes(app: web.Application) -> None:
     cfg = app["config"]
 
@@ -241,21 +244,32 @@ def add_auth_routes(app: web.Application) -> None:
             claims = await provider.verify_id_token(
                 tokens.get("id_token", "")
             )
-        except (ValueError, aiohttp.ClientError) as e:
+        except (
+            ValueError, aiohttp.ClientError, TimeoutError, OSError
+        ) as e:
             return json_error(403, f"OIDC login failed: {e}")
         username = oidc_mod.claims_to_username(claims)
         if not username:
             return json_error(403, "id_token carries no usable identity")
+        resp = await _sso_session(
+            username, str(claims.get("name", ""))
+        )
+        resp.del_cookie(oidc_mod.NONCE_COOKIE)
+        return resp
+
+    async def _sso_session(
+        username: str, full_name: str = ""
+    ) -> web.Response:
+        """Shared SSO tail (OIDC/SAML/CAS): JIT-provision the user with
+        an unusable random password hash, set the session cookie."""
         user = await User.first(username=username)
         if user is None:
-            # JIT provisioning: SSO users authenticate only via the IdP
-            # (unusable random password hash)
             import secrets as _secrets
 
             user = await User.create(
                 User(
                     username=username,
-                    full_name=str(claims.get("name", "")),
+                    full_name=full_name,
                     password_hash=auth_mod.hash_password(
                         _secrets.token_urlsafe(24)
                     ),
@@ -266,6 +280,185 @@ def add_auth_routes(app: web.Application) -> None:
         resp.set_cookie(
             SESSION_COOKIE, token, httponly=True, samesite="Lax"
         )
+        return resp
+
+    # ---- SAML SSO ------------------------------------------------------
+
+    def _saml_provider():
+        from gpustack_tpu.api.saml import SAMLProvider
+
+        if not (cfg.saml_idp_sso_url and cfg.saml_idp_cert):
+            return None
+        provider = app.get("_saml_provider")
+        if provider is None:
+            provider = SAMLProvider(
+                cfg.saml_idp_sso_url,
+                cfg.saml_idp_cert,
+                cfg.saml_sp_entity_id
+                or cfg.external_url
+                or "gpustack-tpu",
+            )
+            app["_saml_provider"] = provider
+        return provider
+
+    def _acs_url(request: web.Request) -> str:
+        base = cfg.external_url.rstrip("/") or (
+            f"{request.scheme}://{request.host}"
+        )
+        return f"{base}/auth/saml/acs"
+
+    async def saml_login(request: web.Request):
+        import secrets as _secrets
+
+        from gpustack_tpu.api import oidc as oidc_mod
+
+        provider = _saml_provider()
+        if provider is None:
+            return json_error(404, "SAML is not configured")
+        # RelayState doubles as the browser-bound CSRF state (the same
+        # HMAC-nonce scheme as the OIDC flow)
+        nonce = _secrets.token_urlsafe(16)
+        relay = oidc_mod.make_state(cfg.jwt_secret, nonce)
+        url, req_id = provider.authn_request_url(
+            _acs_url(request), relay
+        )
+        resp = web.HTTPFound(url)
+        resp.set_cookie(
+            oidc_mod.NONCE_COOKIE, nonce,
+            max_age=int(oidc_mod.STATE_TTL),
+            httponly=True, samesite="Lax",
+        )
+        # the ACS requires the response's InResponseTo to name THIS
+        # browser's AuthnRequest — a signed response captured from any
+        # other login cannot be replayed here
+        resp.set_cookie(
+            SAML_REQ_COOKIE, req_id,
+            max_age=int(oidc_mod.STATE_TTL),
+            httponly=True, samesite="Lax",
+        )
+        return resp
+
+    async def saml_acs(request: web.Request):
+        from gpustack_tpu.api import oidc as oidc_mod
+        from gpustack_tpu.api import saml as saml_mod
+
+        provider = _saml_provider()
+        if provider is None:
+            return json_error(404, "SAML is not configured")
+        form = await request.post()
+        relay = str(form.get("RelayState", ""))
+        nonce = request.cookies.get(oidc_mod.NONCE_COOKIE, "")
+        if not nonce or not oidc_mod.check_state(
+            relay, cfg.jwt_secret, nonce
+        ):
+            return json_error(403, "invalid or expired SAML state")
+        req_id = request.cookies.get(SAML_REQ_COOKIE, "")
+        if not req_id:
+            return json_error(403, "missing SAML request binding")
+        try:
+            result = provider.verify_response(
+                str(form.get("SAMLResponse", "")),
+                request_id=req_id,
+                acs_url=_acs_url(request),
+            )
+        except saml_mod.SAMLError as e:
+            return json_error(403, f"SAML login failed: {e}")
+        username = saml_mod.claims_to_username(result)
+        if not username:
+            return json_error(403, "assertion carries no usable identity")
+        attrs = result.get("attributes", {})
+        full = attrs.get("displayName") or attrs.get("cn") or ""
+        resp = await _sso_session(
+            username, full if isinstance(full, str) else full[0]
+        )
+        resp.del_cookie(oidc_mod.NONCE_COOKIE)
+        resp.del_cookie(SAML_REQ_COOKIE)
+        return resp
+
+    # ---- CAS SSO -------------------------------------------------------
+
+    def _cas_provider():
+        from gpustack_tpu.api.cas import CASProvider
+
+        if not cfg.cas_url:
+            return None
+        provider = app.get("_cas_provider")
+        if provider is None:
+            provider = CASProvider(cfg.cas_url)
+            app["_cas_provider"] = provider
+
+            async def _close_cas(app):
+                await provider.close()
+
+            app.on_cleanup.append(_close_cas)
+        return provider
+
+    def _cas_service(request: web.Request, state: str) -> str:
+        import urllib.parse as _up
+
+        base = cfg.external_url.rstrip("/") or (
+            f"{request.scheme}://{request.host}"
+        )
+        # the state rides in the service URL: CAS validates tickets
+        # against the exact service string, so the callback reconstructs
+        # the same URL from its own query
+        return (
+            f"{base}/auth/cas/callback?"
+            + _up.urlencode({"state": state})
+        )
+
+    async def cas_login(request: web.Request):
+        import secrets as _secrets
+
+        from gpustack_tpu.api import oidc as oidc_mod
+
+        provider = _cas_provider()
+        if provider is None:
+            return json_error(404, "CAS is not configured")
+        # browser-bound state, same scheme as OIDC/SAML — without it a
+        # victim could be logged into an attacker's account (login CSRF)
+        nonce = _secrets.token_urlsafe(16)
+        state = oidc_mod.make_state(cfg.jwt_secret, nonce)
+        resp = web.HTTPFound(
+            provider.login_url(_cas_service(request, state))
+        )
+        resp.set_cookie(
+            oidc_mod.NONCE_COOKIE, nonce,
+            max_age=int(oidc_mod.STATE_TTL),
+            httponly=True, samesite="Lax",
+        )
+        return resp
+
+    async def cas_callback(request: web.Request):
+        from gpustack_tpu.api import oidc as oidc_mod
+        from gpustack_tpu.api.cas import CASError
+
+        provider = _cas_provider()
+        if provider is None:
+            return json_error(404, "CAS is not configured")
+        state = request.query.get("state", "")
+        nonce = request.cookies.get(oidc_mod.NONCE_COOKIE, "")
+        if not nonce or not oidc_mod.check_state(
+            state, cfg.jwt_secret, nonce
+        ):
+            return json_error(403, "invalid or expired CAS state")
+        ticket = request.query.get("ticket", "")
+        if not ticket:
+            return json_error(400, "missing CAS ticket")
+        try:
+            result = await provider.validate(
+                ticket, _cas_service(request, state)
+            )
+        except (
+            CASError, aiohttp.ClientError, TimeoutError, OSError
+        ) as e:
+            # TimeoutError: aiohttp's total-timeout on body reads is the
+            # builtin (an OSError), NOT a ClientError subclass
+            return json_error(403, f"CAS login failed: {e}")
+        resp = await _sso_session(
+            result["user"],
+            str(result.get("attributes", {}).get("displayName", "")),
+        )
         resp.del_cookie(oidc_mod.NONCE_COOKIE)
         return resp
 
@@ -275,6 +468,10 @@ def add_auth_routes(app: web.Application) -> None:
     app.router.add_post("/auth/change-password", change_password)
     app.router.add_get("/auth/oidc/login", oidc_login)
     app.router.add_get("/auth/oidc/callback", oidc_callback)
+    app.router.add_get("/auth/saml/login", saml_login)
+    app.router.add_post("/auth/saml/acs", saml_acs)
+    app.router.add_get("/auth/cas/login", cas_login)
+    app.router.add_get("/auth/cas/callback", cas_callback)
     app.router.add_post("/v2/api-keys", create_api_key)
     app.router.add_post("/v2/workers/register", register_worker)
 

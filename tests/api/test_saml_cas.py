@@ -1,0 +1,358 @@
+"""SAML XML-DSig verification (real RSA keypair, self-built signed
+responses) and CAS ticket validation against a mock CAS server."""
+
+import asyncio
+import base64
+import datetime
+import hashlib
+import urllib.parse
+import zlib
+
+import pytest
+from lxml import etree
+
+from gpustack_tpu.api.saml import (
+    NSMAP,
+    SAMLError,
+    SAMLProvider,
+    claims_to_username,
+)
+
+SP_ENTITY = "https://sp.example.com"
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "idp.example.com")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .sign(key, hashes.SHA256())
+    )
+    pem = cert.public_bytes(serialization.Encoding.PEM).decode()
+    return key, pem
+
+
+def _times(offset_nb=-300, offset_na=300):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    fmt = "%Y-%m-%dT%H:%M:%SZ"
+    return (
+        (now + datetime.timedelta(seconds=offset_nb)).strftime(fmt),
+        (now + datetime.timedelta(seconds=offset_na)).strftime(fmt),
+    )
+
+
+_ASSERTION_SEQ = [0]
+
+
+def _build_response(
+    key,
+    name_id="alice@example.com",
+    audience=SP_ENTITY,
+    sign_ref_id=None,
+    offset_na=300,
+    attributes=(),
+    sig_alg="http://www.w3.org/2001/04/xmldsig-more#rsa-sha256",
+    tamper_after_sign=False,
+    in_response_to="",
+    assertion_id="",
+):
+    """A minimal signed SAML Response (assertion-level signature)."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    nb, na = _times(offset_na=offset_na)
+    if not assertion_id:
+        _ASSERTION_SEQ[0] += 1
+        assertion_id = f"_assertion{_ASSERTION_SEQ[0]}"
+    irt = (
+        f' InResponseTo="{in_response_to}"' if in_response_to else ""
+    )
+    attrs_xml = "".join(
+        f'<saml:Attribute Name="{k}">'
+        f"<saml:AttributeValue>{v}</saml:AttributeValue>"
+        f"</saml:Attribute>"
+        for k, v in attributes
+    )
+    assertion_xml = (
+        f'<saml:Assertion xmlns:saml="{NSMAP["saml"]}" '
+        f'ID="{assertion_id}" Version="2.0" IssueInstant="{nb}"{irt}>'
+        f"<saml:Issuer>https://idp.example.com</saml:Issuer>"
+        f"<saml:Subject><saml:NameID>{name_id}</saml:NameID>"
+        f"</saml:Subject>"
+        f'<saml:Conditions NotBefore="{nb}" NotOnOrAfter="{na}">'
+        f"<saml:AudienceRestriction><saml:Audience>{audience}"
+        f"</saml:Audience></saml:AudienceRestriction></saml:Conditions>"
+        + (
+            f"<saml:AttributeStatement>{attrs_xml}"
+            f"</saml:AttributeStatement>"
+            if attrs_xml else ""
+        )
+        + "</saml:Assertion>"
+    )
+    assertion = etree.fromstring(assertion_xml)
+    digest = hashlib.sha256(
+        etree.tostring(
+            assertion, method="c14n", exclusive=True, with_comments=False
+        )
+    ).digest()
+
+    ref_id = sign_ref_id or assertion_id
+    signed_info_xml = (
+        f'<ds:SignedInfo xmlns:ds="{NSMAP["ds"]}">'
+        f'<ds:CanonicalizationMethod Algorithm='
+        f'"http://www.w3.org/2001/10/xml-exc-c14n#"/>'
+        f'<ds:SignatureMethod Algorithm="{sig_alg}"/>'
+        f'<ds:Reference URI="#{ref_id}"><ds:Transforms>'
+        f'<ds:Transform Algorithm='
+        f'"http://www.w3.org/2000/09/xmldsig#enveloped-signature"/>'
+        f'<ds:Transform Algorithm='
+        f'"http://www.w3.org/2001/10/xml-exc-c14n#"/>'
+        f"</ds:Transforms>"
+        f'<ds:DigestMethod Algorithm='
+        f'"http://www.w3.org/2001/04/xmlenc#sha256"/>'
+        f"<ds:DigestValue>{base64.b64encode(digest).decode()}"
+        f"</ds:DigestValue></ds:Reference></ds:SignedInfo>"
+    )
+    signed_info = etree.fromstring(signed_info_xml)
+    si_c14n = etree.tostring(
+        signed_info, method="c14n", exclusive=True, with_comments=False
+    )
+    sig_value = key.sign(
+        si_c14n, padding.PKCS1v15(), hashes.SHA256()
+    )
+    signature_xml = (
+        f'<ds:Signature xmlns:ds="{NSMAP["ds"]}">'
+        + signed_info_xml
+        + f"<ds:SignatureValue>"
+        f"{base64.b64encode(sig_value).decode()}</ds:SignatureValue>"
+        f"</ds:Signature>"
+    )
+    # insert signature after Issuer (schema position)
+    assertion.insert(1, etree.fromstring(signature_xml))
+    if tamper_after_sign:
+        assertion.find("saml:Subject/saml:NameID", NSMAP).text = (
+            "mallory@example.com"
+        )
+
+    response = etree.fromstring(
+        f'<samlp:Response xmlns:samlp="{NSMAP["samlp"]}" '
+        f'xmlns:saml="{NSMAP["saml"]}" ID="_resp1" Version="2.0">'
+        f"<samlp:Status><samlp:StatusCode "
+        f'Value="urn:oasis:names:tc:SAML:2.0:status:Success"/>'
+        f"</samlp:Status></samlp:Response>"
+    )
+    response.append(assertion)
+    return base64.b64encode(etree.tostring(response)).decode()
+
+
+def _provider(pem):
+    return SAMLProvider(
+        "https://idp.example.com/sso", pem, SP_ENTITY
+    )
+
+
+def test_valid_response_verifies(keypair):
+    key, pem = keypair
+    b64 = _build_response(
+        key,
+        attributes=(("displayName", "Alice A"), ("email", "a@e.com")),
+    )
+    result = _provider(pem).verify_response(b64)
+    assert result["name_id"] == "alice@example.com"
+    assert result["attributes"]["displayName"] == "Alice A"
+    assert claims_to_username(result) == "alice@example.com"
+
+
+def test_tampered_assertion_rejected(keypair):
+    key, pem = keypair
+    b64 = _build_response(key, tamper_after_sign=True)
+    with pytest.raises(SAMLError, match="digest mismatch"):
+        _provider(pem).verify_response(b64)
+
+
+def test_wrong_key_rejected(keypair):
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    _, pem = keypair
+    other = rsa.generate_private_key(
+        public_exponent=65537, key_size=2048
+    )
+    b64 = _build_response(other)
+    with pytest.raises(SAMLError, match="signature verification failed"):
+        _provider(pem).verify_response(b64)
+
+
+def test_expired_assertion_rejected(keypair):
+    key, pem = keypair
+    b64 = _build_response(key, offset_na=-3600)
+    with pytest.raises(SAMLError, match="expired"):
+        _provider(pem).verify_response(b64)
+
+
+def test_wrong_audience_rejected(keypair):
+    key, pem = keypair
+    b64 = _build_response(key, audience="https://other-sp.example.com")
+    with pytest.raises(SAMLError, match="audience"):
+        _provider(pem).verify_response(b64)
+
+
+def test_signature_over_other_id_rejected(keypair):
+    """Signature wrapping: a signature referencing some other element id
+    must not authenticate this assertion."""
+    key, pem = keypair
+    b64 = _build_response(key, sign_ref_id="_resp1")
+    with pytest.raises(SAMLError, match="does not cover"):
+        _provider(pem).verify_response(b64)
+
+
+def test_sha1_signature_rejected(keypair):
+    key, pem = keypair
+    b64 = _build_response(
+        key,
+        sig_alg="http://www.w3.org/2000/09/xmldsig#rsa-sha1",
+    )
+    with pytest.raises(SAMLError, match="only RSA-SHA256"):
+        _provider(pem).verify_response(b64)
+
+
+def test_unsigned_response_rejected(keypair):
+    key, pem = keypair
+    b64 = _build_response(key)
+    root = etree.fromstring(base64.b64decode(b64))
+    assertion = root.find("saml:Assertion", NSMAP)
+    assertion.remove(assertion.find("ds:Signature", NSMAP))
+    naked = base64.b64encode(etree.tostring(root)).decode()
+    with pytest.raises(SAMLError, match="no signature"):
+        _provider(pem).verify_response(naked)
+
+
+def test_replayed_assertion_rejected(keypair):
+    """One provider instance must refuse the same signed response twice
+    (captured-response replay within the validity window)."""
+    key, pem = keypair
+    provider = _provider(pem)
+    b64 = _build_response(key)
+    assert provider.verify_response(b64)["name_id"]
+    with pytest.raises(SAMLError, match="already consumed"):
+        provider.verify_response(b64)
+
+
+def test_in_response_to_binding(keypair):
+    key, pem = keypair
+    provider = _provider(pem)
+    good = _build_response(key, in_response_to="_req42")
+    result = provider.verify_response(good, request_id="_req42")
+    assert result["name_id"] == "alice@example.com"
+    # a response for a DIFFERENT AuthnRequest must not authenticate
+    other = _build_response(key, in_response_to="_someone_elses")
+    with pytest.raises(SAMLError, match="InResponseTo"):
+        provider.verify_response(other, request_id="_req42")
+    # and one carrying no InResponseTo at all is equally rejected when a
+    # request binding is expected
+    bare = _build_response(key)
+    with pytest.raises(SAMLError, match="InResponseTo"):
+        provider.verify_response(bare, request_id="_req42")
+
+
+def test_authn_request_url_roundtrips(keypair):
+    _, pem = keypair
+    url, req_id = _provider(pem).authn_request_url(
+        "https://sp.example.com/auth/saml/acs", "relay123"
+    )
+    assert req_id.startswith("_") and len(req_id) == 33
+    assert url.startswith("https://idp.example.com/sso?")
+    q = urllib.parse.parse_qs(urllib.parse.urlsplit(url).query)
+    assert q["RelayState"] == ["relay123"]
+    xml = zlib.decompress(
+        base64.b64decode(q["SAMLRequest"][0]), wbits=-15
+    )
+    req = etree.fromstring(xml)
+    assert req.get("AssertionConsumerServiceURL") == (
+        "https://sp.example.com/auth/saml/acs"
+    )
+    assert SP_ENTITY in xml.decode()
+
+
+# ---------------------------------------------------------------------------
+# CAS
+
+
+def test_cas_validate_against_mock_server():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.api.cas import CASError, CASProvider
+
+    async def service_validate(request):
+        ticket = request.query.get("ticket", "")
+        service = request.query.get("service", "")
+        if ticket == "ST-ok" and service == "https://sp/cb":
+            return web.Response(
+                text=(
+                    '<cas:serviceResponse '
+                    'xmlns:cas="http://www.yale.edu/tp/cas">'
+                    "<cas:authenticationSuccess>"
+                    "<cas:user>carol</cas:user>"
+                    "<cas:attributes>"
+                    "<cas:displayName>Carol C</cas:displayName>"
+                    "</cas:attributes>"
+                    "</cas:authenticationSuccess>"
+                    "</cas:serviceResponse>"
+                ),
+                content_type="text/xml",
+            )
+        return web.Response(
+            text=(
+                '<cas:serviceResponse '
+                'xmlns:cas="http://www.yale.edu/tp/cas">'
+                '<cas:authenticationFailure code="INVALID_TICKET">'
+                "ticket not recognized</cas:authenticationFailure>"
+                "</cas:serviceResponse>"
+            ),
+            content_type="text/xml",
+        )
+
+    async def go():
+        app = web.Application()
+        app.router.add_get("/cas/serviceValidate", service_validate)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            base = str(client.make_url("/cas"))
+            provider = CASProvider(base)
+            result = await provider.validate("ST-ok", "https://sp/cb")
+            assert result["user"] == "carol"
+            assert result["attributes"]["displayName"] == "Carol C"
+            with pytest.raises(CASError, match="INVALID_TICKET"):
+                await provider.validate("ST-bad", "https://sp/cb")
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_cas_login_url():
+    from gpustack_tpu.api.cas import CASProvider
+
+    url = CASProvider("https://cas.example.edu/cas/").login_url(
+        "https://sp/auth/cas/callback"
+    )
+    assert url == (
+        "https://cas.example.edu/cas/login?service="
+        "https%3A%2F%2Fsp%2Fauth%2Fcas%2Fcallback"
+    )
