@@ -180,16 +180,23 @@ class SAMLProvider:
             assertion, request_id, acs_url
         )
         if request_id:
-            irt = root.get("InResponseTo", "") or assertion.get(
-                "InResponseTo", ""
-            )
-            # some IdPs put InResponseTo only on SubjectConfirmationData
+            # Precedence matters: when the signature envelops only the
+            # Assertion, the Response root's InResponseTo is UNSIGNED —
+            # an attacker could rewrite it to their own request id. The
+            # SubjectConfirmationData inside the signed assertion wins;
+            # the root attribute is only a fallback for IdPs that omit
+            # it there.
             scd = assertion.find(
                 "saml:Subject/saml:SubjectConfirmation/"
                 "saml:SubjectConfirmationData", NSMAP,
             )
-            if not irt and scd is not None:
+            irt = ""
+            if scd is not None:
                 irt = scd.get("InResponseTo", "")
+            if not irt:
+                irt = assertion.get("InResponseTo", "") or root.get(
+                    "InResponseTo", ""
+                )
             if irt != request_id:
                 raise SAMLError(
                     "InResponseTo does not match this browser's "

@@ -66,6 +66,9 @@ class Config(pydantic.BaseModel):
     saml_sp_entity_id: str = ""
     # CAS server base URL, e.g. https://cas.example.edu/cas
     cas_url: str = ""
+    # community backend catalog: local JSON path or HTTPS URL
+    # (server/backend_catalog.py); empty = sync disabled
+    backend_catalog_url: str = ""
     # external base URL for the OIDC redirect_uri (defaults to the
     # request's own host)
     external_url: str = ""

@@ -323,10 +323,14 @@ def add_auth_routes(app: web.Application) -> None:
             _acs_url(request), relay
         )
         resp = web.HTTPFound(url)
+        # The ACS is reached by a CROSS-SITE top-level POST from the IdP
+        # — SameSite=Lax cookies are withheld on cross-site POSTs, which
+        # would 403 every SAML login. SameSite=None requires Secure;
+        # browsers accept Secure cookies on http://localhost (dev).
         resp.set_cookie(
             oidc_mod.NONCE_COOKIE, nonce,
             max_age=int(oidc_mod.STATE_TTL),
-            httponly=True, samesite="Lax",
+            httponly=True, samesite="None", secure=True,
         )
         # the ACS requires the response's InResponseTo to name THIS
         # browser's AuthnRequest — a signed response captured from any
@@ -334,7 +338,7 @@ def add_auth_routes(app: web.Application) -> None:
         resp.set_cookie(
             SAML_REQ_COOKIE, req_id,
             max_age=int(oidc_mod.STATE_TTL),
-            httponly=True, samesite="Lax",
+            httponly=True, samesite="None", secure=True,
         )
         return resp
 
@@ -384,14 +388,19 @@ def add_auth_routes(app: web.Application) -> None:
             return None
         provider = app.get("_cas_provider")
         if provider is None:
+            # created here, BEFORE the app freezes (a request-time
+            # on_cleanup.append raises "Cannot modify frozen list")
             provider = CASProvider(cfg.cas_url)
             app["_cas_provider"] = provider
-
-            async def _close_cas(app):
-                await provider.close()
-
-            app.on_cleanup.append(_close_cas)
         return provider
+
+    if cfg.cas_url:
+        _cas_provider()
+
+        async def _close_cas(app):
+            await app["_cas_provider"].close()
+
+        app.on_cleanup.append(_close_cas)
 
     def _cas_service(request: web.Request, state: str) -> str:
         import urllib.parse as _up

@@ -116,7 +116,50 @@ def resolve_model_config(model: Model):
                 f"cannot fetch config for "
                 f"{model.huggingface_repo_id!r}: {e}"
             )
-    raise EvaluationError("model has no source (preset/local_path/hf)")
+    if model.model_scope_model_id:
+        raw = _modelscope_config_cached(model.model_scope_model_id)
+        if raw.get("model_type") == "whisper":
+            return config_from_hf_whisper(raw, name=model.name)
+        return config_from_hf(raw, name=model.model_scope_model_id)
+    raise EvaluationError(
+        "model has no source (preset/local_path/hf/modelscope)"
+    )
+
+
+def _modelscope_config_cached(model_id: str) -> dict:
+    """config.json for a ModelScope model, disk-cached like the HF
+    branch (hf_hub_download caches): repeat evaluations don't re-hit the
+    network, and offline re-evaluation keeps working once cached."""
+    import json as _json
+    import re as _re
+
+    safe = _re.sub(r"[^A-Za-z0-9_.-]", "--", model_id)
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", "gpustack_tpu", "ms-configs"
+    )
+    cache = os.path.join(cache_dir, safe + ".json")
+    if os.path.exists(cache):
+        try:
+            with open(cache) as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            pass
+    from gpustack_tpu.worker.downloaders import modelscope_fetch_config
+
+    try:
+        raw = modelscope_fetch_config(model_id)
+    except Exception as e:
+        raise EvaluationError(
+            f"cannot fetch config for {model_id!r}: {e}"
+        )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(cache + ".tmp", "w") as f:
+            _json.dump(raw, f)
+        os.replace(cache + ".tmp", cache)
+    except OSError:
+        pass
+    return raw
 
 
 from gpustack_tpu.utils.profiling import timed

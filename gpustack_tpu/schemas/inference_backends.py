@@ -33,5 +33,9 @@ class InferenceBackend(Record):
     name: str = ""
     description: str = ""
     builtin: bool = False
+    # True = created/owned by the community-catalog sync
+    # (server/backend_catalog.py); operator rows stay False and the sync
+    # never touches them
+    managed: bool = False
     versions: List[BackendVersionConfig] = []
     default_version: str = "latest"

@@ -20,10 +20,11 @@ class ModelFile(Record):
     __kind__ = "model_file"
     __indexes__ = ("worker_id", "state", "source_key")
 
-    # identity of the artifact: "hf:<repo>" or "local:<path>" or
-    # "preset:<name>"
+    # identity of the artifact: "hf:<repo>", "ms:<modelscope id>",
+    # "local:<path>" or "preset:<name>"
     source_key: str = ""
     huggingface_repo_id: str = ""
+    model_scope_model_id: str = ""
     local_path: str = ""
     preset: str = ""
     worker_id: int = 0

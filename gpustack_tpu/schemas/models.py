@@ -49,10 +49,12 @@ class Model(Record):
     # admins see or infer against it (schemas/orgs.py)
     org_id: int = 0
     # source: exactly one of preset (built-in config, hermetic), local_path,
-    # or huggingface repo id
+    # huggingface repo id, or modelscope model id (reference
+    # schemas/models.py ModelSource: huggingface | model_scope | local)
     preset: str = ""
     local_path: str = ""
     huggingface_repo_id: str = ""
+    model_scope_model_id: str = ""
     replicas: int = 1
     backend: str = "tpu-native"       # built-in engine | "custom"
     backend_version: str = ""
@@ -87,6 +89,7 @@ class Model(Record):
             self.preset
             or self.local_path
             or self.huggingface_repo_id
+            or self.model_scope_model_id
             or "?"
         )
 

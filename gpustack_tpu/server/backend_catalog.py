@@ -1,0 +1,158 @@
+"""Community backend-catalog sync.
+
+Reference parity: InferenceBackendController reconciles the built-in +
+community backend catalog into DB rows (reference
+server/controllers.py:1481-1634, gpustack-runner catalog role). Here a
+leader task loads a catalog document (local file or HTTPS URL —
+``backend_catalog_url`` config / ``GPUSTACK_TPU_BACKEND_CATALOG``) and
+upserts InferenceBackend rows:
+
+- rows it creates are stamped ``managed=True`` and tracked: edits in the
+  catalog update them, removal from the catalog deletes them;
+- operator-created rows (managed=False) are NEVER touched — the catalog
+  cannot clobber local customizations;
+- the builtin ``tpu-native`` backend is seeded elsewhere (server.py) and
+  ignored by the sync.
+
+Catalog document shape::
+
+    {"backends": [{"name": ..., "description": ...,
+                   "default_version": ...,
+                   "versions": [{"version": ..., "command": [...],
+                                 "env": {...}, "health_path": ...}]}]}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+from gpustack_tpu.schemas import InferenceBackend
+from gpustack_tpu.schemas.inference_backends import BackendVersionConfig
+
+logger = logging.getLogger(__name__)
+
+
+def parse_catalog(doc: Dict[str, Any]) -> List[InferenceBackend]:
+    out = []
+    for entry in doc.get("backends", []):
+        name = str(entry.get("name", "")).strip()
+        if not name:
+            continue
+        versions = [
+            BackendVersionConfig(
+                version=str(v.get("version", "latest")),
+                command=[str(c) for c in v.get("command", [])],
+                env={
+                    str(k): str(val)
+                    for k, val in (v.get("env") or {}).items()
+                },
+                health_path=str(v.get("health_path", "/healthz")),
+            )
+            for v in entry.get("versions", [])
+        ]
+        if not versions:
+            continue
+        out.append(
+            InferenceBackend(
+                name=name,
+                description=str(entry.get("description", "")),
+                versions=versions,
+                default_version=str(
+                    entry.get(
+                        "default_version", versions[0].version
+                    )
+                ),
+                managed=True,
+            )
+        )
+    return out
+
+
+class BackendCatalogSync:
+    def __init__(self, source: str, interval_s: float = 1800.0) -> None:
+        self.source = source
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if not self.source:
+            return
+        self._task = asyncio.create_task(
+            self._loop(), name="backend-catalog-sync"
+        )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("backend catalog sync failed")
+            await asyncio.sleep(self.interval_s)
+
+    async def _fetch(self) -> Dict[str, Any]:
+        if self.source.startswith(("http://", "https://")):
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    self.source,
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as r:
+                    r.raise_for_status()
+                    return await r.json(content_type=None)
+        path = os.path.expanduser(self.source)
+        loop = asyncio.get_running_loop()
+
+        def read():
+            with open(path) as f:
+                return json.load(f)
+
+        return await loop.run_in_executor(None, read)
+
+    async def sync_once(self) -> Dict[str, int]:
+        doc = await self._fetch()
+        wanted = {b.name: b for b in parse_catalog(doc)}
+        stats = {"created": 0, "updated": 0, "deleted": 0, "skipped": 0}
+        existing = {
+            b.name: b for b in await InferenceBackend.filter(limit=None)
+        }
+        for name, b in wanted.items():
+            cur = existing.get(name)
+            if cur is None:
+                await InferenceBackend.create(b)
+                stats["created"] += 1
+            elif not cur.managed or cur.builtin:
+                # operator-owned or builtin: hands off
+                stats["skipped"] += 1
+            else:
+                new_versions = [
+                    v.model_dump() for v in b.versions
+                ]
+                if (
+                    [v.model_dump() for v in cur.versions]
+                    != new_versions
+                    or cur.default_version != b.default_version
+                    or cur.description != b.description
+                ):
+                    await cur.update(
+                        versions=b.versions,
+                        default_version=b.default_version,
+                        description=b.description,
+                    )
+                    stats["updated"] += 1
+        for name, cur in existing.items():
+            if cur.managed and not cur.builtin and name not in wanted:
+                await cur.delete()
+                stats["deleted"] += 1
+        logger.info("backend catalog sync: %s", stats)
+        return stats

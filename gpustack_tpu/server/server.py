@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import secrets
 from typing import List, Optional
 
@@ -145,6 +146,13 @@ class Server:
         self.update_checker = UpdateChecker()
         self.update_checker.start()  # no-op without GPUSTACK_TPU_UPDATE_URL
 
+        from gpustack_tpu.server.backend_catalog import BackendCatalogSync
+
+        self.backend_catalog = BackendCatalogSync(
+            cfg.backend_catalog_url
+            or os.environ.get("GPUSTACK_TPU_BACKEND_CATALOG", "")
+        )
+
         async def on_leadership(leading: bool) -> None:
             if leading:
                 if cfg.ha:
@@ -156,6 +164,7 @@ class Server:
                 self.usage_archiver.start()
                 self.resource_events.start()
                 self.system_load.start()
+                self.backend_catalog.start()
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
@@ -197,6 +206,8 @@ class Server:
             self.usage_archiver.stop()
         if hasattr(self, "update_checker"):
             self.update_checker.stop()
+        if hasattr(self, "backend_catalog"):
+            self.backend_catalog.stop()
         if hasattr(self, "resource_events"):
             self.resource_events.stop()
         if hasattr(self, "system_load"):

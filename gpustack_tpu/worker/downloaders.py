@@ -1,0 +1,190 @@
+"""ModelScope downloader: snapshot a model repo over the public HTTP API.
+
+Reference parity: worker/downloaders.py ModelScopeDownloader (the
+``modelscope`` SDK there). This one is SDK-free — two endpoints:
+
+- file list:  GET {base}/api/v1/models/{id}/repo/files
+                  ?Revision={rev}&Recursive=true
+- file bytes: GET {base}/api/v1/models/{id}/repo
+                  ?FilePath={path}&Revision={rev}
+
+Downloads stream to ``<name>.part`` with HTTP-Range resume, then rename —
+a killed worker resumes instead of restarting, and a completed file is
+never half-visible. ``base_url`` is injectable so tests run against a
+local fixture server (zero egress).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import os
+import urllib.parse
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+MODELSCOPE_BASE = "https://modelscope.cn"
+DEFAULT_PATTERNS = (
+    "*.safetensors", "*.json", "*.model", "tokenizer*", "*.txt",
+    "*.gguf",
+)
+CHUNK = 1 << 20
+
+
+def _matches(path: str, patterns) -> bool:
+    name = path.rsplit("/", 1)[-1]
+    return any(
+        fnmatch.fnmatch(name, p) or fnmatch.fnmatch(path, p)
+        for p in patterns
+    )
+
+
+def modelscope_list_files(
+    model_id: str,
+    revision: str = "master",
+    base_url: str = MODELSCOPE_BASE,
+) -> List[Dict]:
+    """[{"Path": ..., "Size": ...}, ...] for the repo's blobs."""
+    import requests
+
+    url = (
+        f"{base_url}/api/v1/models/{model_id}/repo/files?"
+        + urllib.parse.urlencode(
+            {"Revision": revision, "Recursive": "true"}
+        )
+    )
+    r = requests.get(url, timeout=30)
+    r.raise_for_status()
+    body = r.json()
+    if body.get("Code") not in (None, 200):
+        raise RuntimeError(
+            f"modelscope file list failed: {body.get('Message', body)}"
+        )
+    files = (body.get("Data") or {}).get("Files") or []
+    return [
+        f for f in files
+        if f.get("Type") != "tree" and f.get("Path")
+    ]
+
+
+def _download_file(
+    session,
+    url: str,
+    dest: str,
+    expected_size: Optional[int] = None,
+) -> None:
+    part = dest + ".part"
+    offset = os.path.getsize(part) if os.path.exists(part) else 0
+    headers = {}
+    if offset:
+        headers["Range"] = f"bytes={offset}-"
+    with session.get(
+        url, headers=headers, stream=True, timeout=60
+    ) as r:
+        if offset and r.status_code == 200:
+            # server ignored the Range; start over
+            offset = 0
+        elif offset and r.status_code == 416:
+            # Range past EOF: complete ONLY if the size checks out — a
+            # shrunk upstream file or oversized stale .part must not be
+            # published as a finished weight file
+            if expected_size is not None and offset != expected_size:
+                os.unlink(part)
+                raise IOError(
+                    f"{dest}: stale partial download ({offset} bytes, "
+                    f"expected {expected_size}); removed — retry will "
+                    "start clean"
+                )
+            os.replace(part, dest)
+            return
+        else:
+            r.raise_for_status()
+        mode = "ab" if offset else "wb"
+        with open(part, mode) as f:
+            for chunk in r.iter_content(CHUNK):
+                f.write(chunk)
+    if expected_size is not None:
+        got = os.path.getsize(part)
+        if got != expected_size:
+            raise IOError(
+                f"{dest}: size mismatch after download "
+                f"({got} != {expected_size}); keeping .part for resume"
+            )
+    os.replace(part, dest)
+
+
+def modelscope_snapshot_download(
+    model_id: str,
+    target_dir: str,
+    revision: str = "master",
+    base_url: str = MODELSCOPE_BASE,
+    allow_patterns=DEFAULT_PATTERNS,
+    progress_cb=None,
+) -> str:
+    """Download matching repo files into ``target_dir``; resumable,
+    idempotent (existing complete files are skipped)."""
+    import requests
+
+    files = [
+        f for f in modelscope_list_files(
+            model_id, revision=revision, base_url=base_url
+        )
+        if _matches(f["Path"], allow_patterns)
+    ]
+    if not files:
+        raise FileNotFoundError(
+            f"modelscope repo {model_id!r} has no files matching "
+            f"{list(allow_patterns)}"
+        )
+    os.makedirs(target_dir, exist_ok=True)
+    done_bytes = 0
+    with requests.Session() as session:
+        for f in files:
+            rel = f["Path"].lstrip("/")
+            if ".." in rel.split("/"):
+                raise ValueError(f"refusing path {rel!r}")
+            dest = os.path.join(target_dir, rel)
+            os.makedirs(os.path.dirname(dest) or target_dir, exist_ok=True)
+            size = f.get("Size")
+            if (
+                os.path.exists(dest)
+                and size is not None
+                and os.path.getsize(dest) == size
+            ):
+                done_bytes += size
+                continue
+            url = (
+                f"{base_url}/api/v1/models/{model_id}/repo?"
+                + urllib.parse.urlencode(
+                    {"FilePath": f["Path"], "Revision": revision}
+                )
+            )
+            logger.info("modelscope: downloading %s", rel)
+            _download_file(session, url, dest, expected_size=size)
+            done_bytes += size or os.path.getsize(dest)
+            if progress_cb is not None:
+                progress_cb(done_bytes)
+    return target_dir
+
+
+def modelscope_fetch_config(
+    model_id: str,
+    revision: str = "master",
+    base_url: str = MODELSCOPE_BASE,
+) -> dict:
+    """Just config.json (scheduler evaluation; mirrors the HF
+    config-only probe in scheduler/calculator.py)."""
+    import json
+
+    import requests
+
+    url = (
+        f"{base_url}/api/v1/models/{model_id}/repo?"
+        + urllib.parse.urlencode(
+            {"FilePath": "config.json", "Revision": revision}
+        )
+    )
+    r = requests.get(url, timeout=30)
+    r.raise_for_status()
+    return json.loads(r.content)

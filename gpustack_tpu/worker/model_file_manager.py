@@ -78,17 +78,39 @@ class ModelFileManager:
             return model.local_path
         if model.preset:
             return ""  # built-in config; no files
-        if not model.huggingface_repo_id:
-            raise ValueError("model has no weight source")
-        return await self._ensure_hf(model.huggingface_repo_id)
+        if model.huggingface_repo_id:
+            return await self._ensure_remote(
+                "hf", model.huggingface_repo_id
+            )
+        if model.model_scope_model_id:
+            return await self._ensure_remote(
+                "ms", model.model_scope_model_id
+            )
+        raise ValueError("model has no weight source")
 
-    async def _ensure_hf(self, repo_id: str) -> str:
-        safe = re.sub(r"[^A-Za-z0-9_.-]", "--", repo_id)
-        target = os.path.join(self.models_dir, safe)
+    def _download(self, scheme: str, repo_id: str, target: str) -> str:
+        if scheme == "ms":
+            from gpustack_tpu.worker.downloaders import (
+                modelscope_snapshot_download,
+            )
+
+            return modelscope_snapshot_download(repo_id, target)
+        return self.downloader(repo_id, target)
+
+    async def _ensure_remote(self, scheme: str, repo_id: str) -> str:
+        base = re.sub(r"[^A-Za-z0-9_.-]", "--", repo_id)
+        target = os.path.join(self.models_dir, f"{scheme}--{base}")
         marker = target + ".complete"
         if os.path.exists(marker):
             return target
-        record = await self._record(repo_id)
+        if scheme == "hf":
+            # pre-scheme-prefix cache layout: completed downloads lived
+            # at models_dir/<safe-repo>; honor them rather than pulling
+            # hundreds of GB again after an upgrade
+            legacy = os.path.join(self.models_dir, base)
+            if os.path.exists(legacy + ".complete"):
+                return legacy
+        record = await self._record(scheme, repo_id)
         lock = SoftFileLock(target + ".lock")
         async with lock:
             if os.path.exists(marker):  # raced another downloader
@@ -103,7 +125,7 @@ class ModelFileManager:
             loop = asyncio.get_running_loop()
             try:
                 await loop.run_in_executor(
-                    None, self.downloader, repo_id, target
+                    None, self._download, scheme, repo_id, target
                 )
             except Exception as e:
                 await self._update_record(
@@ -125,20 +147,22 @@ class ModelFileManager:
 
     # ------------------------------------------------------------------
 
-    async def _record(self, repo_id: str) -> Optional[dict]:
-        key = f"hf:{repo_id}"
+    async def _record(self, scheme: str, repo_id: str) -> Optional[dict]:
+        key = f"{scheme}:{repo_id}"
         try:
             items = await self.client.list(
                 "model-files", source_key=key, worker_id=self.worker_id
             )
             if items:
                 return items[0]
+            fields = {"hf": "huggingface_repo_id",
+                      "ms": "model_scope_model_id"}
             return await self.client.create(
                 "model-files",
                 ModelFile(
                     source_key=key,
-                    huggingface_repo_id=repo_id,
                     worker_id=self.worker_id,
+                    **{fields[scheme]: repo_id},
                 ).model_dump(mode="json"),
             )
         except APIError as e:

@@ -53,6 +53,28 @@ class ServeManager:
         from gpustack_tpu.worker.model_file_manager import ModelFileManager
 
         self.file_manager = ModelFileManager(cfg, client, worker_id)
+        # backend catalog cache, kept warm by the agent's
+        # inference-backends watch (reference InferenceBackendManager
+        # caches via watch instead of fetching per start)
+        self.backends_cache: Dict[str, InferenceBackend] = {}
+
+    def handle_backend_event(self, event: Event) -> None:
+        if event.type == EventType.RESYNC:
+            self.backends_cache.clear()   # fall back to per-start fetch
+            return
+        data = event.data or {}
+        name = data.get("name", "")
+        if not name:
+            return
+        if event.type == EventType.DELETED:
+            self.backends_cache.pop(name, None)
+        else:
+            try:
+                self.backends_cache[name] = (
+                    InferenceBackend.model_validate(data)
+                )
+            except ValueError:
+                logger.warning("bad backend payload for %r", name)
 
     # ---- event handling -------------------------------------------------
 
@@ -210,13 +232,17 @@ class ServeManager:
 
         backend = None
         if model.backend not in ("", "tpu-native"):
-            backends = await self.client.list(
-                "inference-backends", name=model.backend
-            )
-            backend = (
-                InferenceBackend.model_validate(backends[0])
-                if backends else None
-            )
+            backend = self.backends_cache.get(model.backend)
+            if backend is None:   # cache cold (startup/RESYNC)
+                backends = await self.client.list(
+                    "inference-backends", name=model.backend
+                )
+                backend = (
+                    InferenceBackend.model_validate(backends[0])
+                    if backends else None
+                )
+                if backend is not None:
+                    self.backends_cache[model.backend] = backend
         port = self._allocate_port()
         try:
             argv, extra_env = build_command(

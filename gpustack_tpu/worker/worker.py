@@ -104,6 +104,9 @@ class WorkerAgent:
                 self._watch_dev_instances(), name="wk-dev"
             ),
             asyncio.create_task(
+                self._watch_backends(), name="wk-backends"
+            ),
+            asyncio.create_task(
                 self.benchmark_manager.rescan_loop(), name="wk-bench-rescan"
             ),
         ]
@@ -224,3 +227,12 @@ class WorkerAgent:
                 raise
             except Exception:
                 logger.exception("dev manager failed on %s", event.type)
+
+    async def _watch_backends(self) -> None:
+        async for event in self.client.watch("inference-backends"):
+            try:
+                self.serve_manager.handle_backend_event(event)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("backend cache failed on %s", event.type)

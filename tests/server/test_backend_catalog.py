@@ -1,0 +1,140 @@
+"""Community backend-catalog sync: upsert semantics + ownership rules."""
+
+import asyncio
+import json
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import InferenceBackend
+from gpustack_tpu.schemas.inference_backends import BackendVersionConfig
+from gpustack_tpu.server.backend_catalog import (
+    BackendCatalogSync,
+    parse_catalog,
+)
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+CATALOG = {
+    "backends": [
+        {
+            "name": "community-engine",
+            "description": "a community backend",
+            "default_version": "1.2",
+            "versions": [
+                {
+                    "version": "1.2",
+                    "command": ["{python}", "-m", "engine", "--port",
+                                "{port}"],
+                    "env": {"FOO": "1"},
+                },
+                {"version": "1.1", "command": ["old"]},
+            ],
+        },
+        {"name": "", "versions": [{"command": ["x"]}]},        # dropped
+        {"name": "no-versions"},                               # dropped
+    ]
+}
+
+
+def test_parse_catalog_drops_invalid_entries():
+    out = parse_catalog(CATALOG)
+    assert [b.name for b in out] == ["community-engine"]
+    assert out[0].managed is True
+    assert out[0].default_version == "1.2"
+    assert len(out[0].versions) == 2
+
+
+def _sync(tmp_path, doc):
+    path = tmp_path / "catalog.json"
+    path.write_text(json.dumps(doc))
+    return BackendCatalogSync(str(path))
+
+
+def test_sync_creates_updates_deletes(db, tmp_path):
+    async def go():
+        sync = _sync(tmp_path, CATALOG)
+        stats = await sync.sync_once()
+        assert stats["created"] == 1
+        row = await InferenceBackend.first(name="community-engine")
+        assert row.managed and row.default_version == "1.2"
+
+        # catalog edit → update
+        doc = json.loads(json.dumps(CATALOG))
+        doc["backends"][0]["default_version"] = "1.1"
+        stats = await _sync(tmp_path, doc).sync_once()
+        assert stats["updated"] == 1
+        row = await InferenceBackend.first(name="community-engine")
+        assert row.default_version == "1.1"
+
+        # unchanged catalog → no-op
+        stats = await _sync(tmp_path, doc).sync_once()
+        assert stats["updated"] == 0 and stats["created"] == 0
+
+        # removal from the catalog deletes the managed row
+        stats = await _sync(tmp_path, {"backends": []}).sync_once()
+        assert stats["deleted"] == 1
+        assert await InferenceBackend.first(
+            name="community-engine"
+        ) is None
+
+    asyncio.run(go())
+
+
+def test_sync_never_touches_operator_rows(db, tmp_path):
+    async def go():
+        await InferenceBackend.create(
+            InferenceBackend(
+                name="community-engine",
+                description="operator-customized",
+                managed=False,
+                versions=[
+                    BackendVersionConfig(
+                        version="local", command=["mine"]
+                    )
+                ],
+                default_version="local",
+            )
+        )
+        stats = await _sync(tmp_path, CATALOG).sync_once()
+        assert stats["skipped"] == 1
+        row = await InferenceBackend.first(name="community-engine")
+        assert row.description == "operator-customized"
+        assert row.default_version == "local"
+
+        # and operator rows absent from the catalog are never deleted
+        stats = await _sync(tmp_path, {"backends": []}).sync_once()
+        assert stats["deleted"] == 0
+        assert await InferenceBackend.first(
+            name="community-engine"
+        ) is not None
+
+    asyncio.run(go())
+
+
+def test_builtin_rows_are_skipped(db, tmp_path):
+    async def go():
+        await InferenceBackend.create(
+            InferenceBackend(
+                name="community-engine", builtin=True, managed=True,
+                versions=[
+                    BackendVersionConfig(version="v", command=["x"])
+                ],
+            )
+        )
+        stats = await _sync(tmp_path, CATALOG).sync_once()
+        assert stats["skipped"] == 1
+        stats = await _sync(tmp_path, {"backends": []}).sync_once()
+        assert stats["deleted"] == 0
+
+    asyncio.run(go())

@@ -1,0 +1,225 @@
+"""ModelScope downloader against a local mock of the repo API."""
+
+import asyncio
+import json
+import os
+import urllib.parse
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from gpustack_tpu.worker.downloaders import (
+    modelscope_fetch_config,
+    modelscope_list_files,
+    modelscope_snapshot_download,
+)
+
+FILES = {
+    "config.json": json.dumps({"model_type": "llama"}).encode(),
+    "model.safetensors": b"\x00" * 4096 + b"WEIGHTS" + b"\x01" * 4096,
+    "tokenizer.json": b'{"tok": true}',
+    "README.md": b"# not downloaded",
+}
+
+
+def _mock_app(seen_ranges):
+    app = web.Application()
+
+    async def list_files(request):
+        return web.json_response({
+            "Code": 200,
+            "Data": {
+                "Files": [
+                    {"Path": name, "Size": len(data), "Type": "blob"}
+                    for name, data in FILES.items()
+                ]
+                + [{"Path": "subdir", "Type": "tree"}]
+            },
+        })
+
+    async def get_file(request):
+        path = request.query.get("FilePath", "")
+        data = FILES.get(path)
+        if data is None:
+            return web.json_response(
+                {"Code": 404, "Message": "no such file"}, status=404
+            )
+        rng = request.headers.get("Range", "")
+        seen_ranges.append((path, rng))
+        if rng.startswith("bytes="):
+            start = int(rng[6:].rstrip("-"))
+            if start >= len(data):
+                return web.Response(status=416)
+            return web.Response(
+                body=data[start:], status=206,
+                headers={"Content-Range":
+                         f"bytes {start}-{len(data)-1}/{len(data)}"},
+            )
+        return web.Response(body=data)
+
+    app.router.add_get(
+        "/api/v1/models/{org}/{name}/repo/files", list_files
+    )
+    app.router.add_get("/api/v1/models/{org}/{name}/repo", get_file)
+    return app
+
+
+@pytest.fixture()
+def mock_server():
+    holder = {}
+    seen_ranges = []
+
+    async def start():
+        client = TestClient(TestServer(_mock_app(seen_ranges)))
+        await client.start_server()
+        holder["client"] = client
+        holder["base"] = str(client.make_url("")).rstrip("/")
+
+    async def stop():
+        await holder["client"].close()
+
+    holder["start"] = start
+    holder["stop"] = stop
+    holder["ranges"] = seen_ranges
+    return holder
+
+
+def _run_with_server(mock_server, sync_fn):
+    """Run the blocking downloader in an executor while the mock server's
+    loop keeps serving."""
+
+    async def go():
+        await mock_server["start"]()
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, sync_fn, mock_server["base"]
+            )
+        finally:
+            await mock_server["stop"]()
+
+    return asyncio.run(go())
+
+
+def test_snapshot_download_filters_and_writes(mock_server, tmp_path):
+    target = str(tmp_path / "snap")
+
+    def dl(base):
+        return modelscope_snapshot_download(
+            "org/model", target, base_url=base
+        )
+
+    out = _run_with_server(mock_server, dl)
+    assert out == target
+    assert sorted(os.listdir(target)) == [
+        "config.json", "model.safetensors", "tokenizer.json"
+    ]  # README.md filtered out
+    with open(os.path.join(target, "model.safetensors"), "rb") as f:
+        assert f.read() == FILES["model.safetensors"]
+    # idempotent: second run downloads nothing new
+    n_ranges = len(mock_server["ranges"])
+
+    def dl2(base):
+        return modelscope_snapshot_download(
+            "org/model", target, base_url=base
+        )
+
+    _run_with_server(mock_server, dl2)
+
+
+def test_download_resumes_from_part_file(mock_server, tmp_path):
+    target = str(tmp_path / "snap")
+    os.makedirs(target)
+    # simulate a killed download: first 1000 bytes already on disk
+    data = FILES["model.safetensors"]
+    with open(os.path.join(target, "model.safetensors.part"), "wb") as f:
+        f.write(data[:1000])
+
+    def dl(base):
+        return modelscope_snapshot_download(
+            "org/model", target, base_url=base,
+            allow_patterns=("*.safetensors",),
+        )
+
+    _run_with_server(mock_server, dl)
+    with open(os.path.join(target, "model.safetensors"), "rb") as f:
+        assert f.read() == data
+    assert ("model.safetensors", "bytes=1000-") in mock_server["ranges"]
+
+
+def test_list_files_excludes_trees(mock_server):
+    def ls(base):
+        return modelscope_list_files("org/model", base_url=base)
+
+    files = _run_with_server(mock_server, ls)
+    assert {f["Path"] for f in files} == set(FILES)
+
+
+def test_fetch_config(mock_server):
+    def fc(base):
+        return modelscope_fetch_config("org/model", base_url=base)
+
+    cfg = _run_with_server(mock_server, fc)
+    assert cfg == {"model_type": "llama"}
+
+
+def test_traversal_path_rejected(tmp_path, monkeypatch):
+    import gpustack_tpu.worker.downloaders as dl
+
+    monkeypatch.setattr(
+        dl, "modelscope_list_files",
+        lambda *a, **k: [{"Path": "../evil.json", "Size": 1}],
+    )
+    with pytest.raises(ValueError, match="refusing path"):
+        dl.modelscope_snapshot_download(
+            "org/model", str(tmp_path / "x"), base_url="http://unused"
+        )
+
+
+def test_file_manager_routes_modelscope(tmp_path, monkeypatch):
+    """ensure_local dispatches ms: sources through the modelscope
+    downloader and records source_key ms:<id>."""
+    import gpustack_tpu.worker.downloaders as dl
+    from gpustack_tpu.config import Config
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.worker.model_file_manager import ModelFileManager
+
+    calls = []
+
+    def fake_snapshot(model_id, target, **kw):
+        calls.append(model_id)
+        os.makedirs(target, exist_ok=True)
+        with open(os.path.join(target, "config.json"), "w") as f:
+            f.write("{}")
+        return target
+
+    monkeypatch.setattr(dl, "modelscope_snapshot_download", fake_snapshot)
+
+    class _NullClient:
+        async def list(self, *a, **k):
+            raise_err()
+
+        async def create(self, *a, **k):
+            raise_err()
+
+        async def update(self, *a, **k):
+            raise_err()
+
+    def raise_err():
+        from gpustack_tpu.client.client import APIError
+
+        raise APIError(503, "offline")
+
+    cfg = Config.load({
+        "data_dir": str(tmp_path), "cache_dir": str(tmp_path / "cache"),
+        "server_url": "http://unused",
+    })
+    mgr = ModelFileManager(cfg, _NullClient(), worker_id=1)
+    model = Model(name="m", model_scope_model_id="org/model")
+    path = asyncio.run(mgr.ensure_local(model))
+    assert calls == ["org/model"]
+    assert os.path.basename(path).startswith("ms--")
+    assert os.path.exists(os.path.join(path, "config.json"))
+    # cached: second call doesn't re-download
+    path2 = asyncio.run(mgr.ensure_local(model))
+    assert path2 == path and calls == ["org/model"]
