@@ -34,6 +34,16 @@ async def model_accessible(principal, model: Model) -> bool:
     return orgs is None or model.org_id in orgs
 
 
+async def org_scoped_accessible(principal, obj) -> bool:
+    """Generic org-scope check for any record with an ``org_id`` field
+    (models, external providers, ...): unscoped records (org_id=0) are
+    visible to everyone; scoped ones to members/admin/system only."""
+    if obj.org_id == 0:
+        return True
+    orgs = await accessible_org_ids(principal)
+    return orgs is None or obj.org_id in orgs
+
+
 async def visible_models(principal, models):
     """Filter a model list down to what the principal may see."""
     orgs = await accessible_org_ids(principal)

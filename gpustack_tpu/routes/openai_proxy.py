@@ -22,6 +22,7 @@ from gpustack_tpu.schemas import (
     Model,
     ModelInstance,
     ModelInstanceState,
+    ModelProvider,
     ModelRoute,
     Worker,
 )
@@ -32,8 +33,41 @@ logger = logging.getLogger(__name__)
 _rr_counters: Dict[int, itertools.count] = {}
 
 
-async def _resolve_model(name: str) -> Optional[Model]:
-    """Route name → weighted target model, else direct model name."""
+class ProviderTarget:
+    """A route target resolved to an external provider dial.
+
+    Reference: ModelRouteTarget.provider_id → Higress ai-proxy upstream
+    (schemas/model_provider.py); here the in-process gateway dials the
+    provider's OpenAI-compatible API directly.
+    """
+
+    def __init__(self, provider: ModelProvider, upstream_model: str):
+        self.provider = provider
+        self.upstream_model = upstream_model
+
+
+async def _target_record(t, name: str):
+    """One route target → Model | ProviderTarget | None (dead target)."""
+    if t.provider_id:
+        provider = await ModelProvider.get(t.provider_id)
+        if provider is None or not provider.enabled:
+            return None
+        upstream = t.provider_model or name
+        if provider.models and upstream not in provider.models:
+            return None
+        return ProviderTarget(provider, upstream)
+    return await Model.get(t.model_id)
+
+
+async def _resolve_model(name: str):
+    """Route name → weighted target (local Model or ProviderTarget).
+
+    A dead chosen target (provider disabled/deleted, allowlist miss,
+    model deleted) falls back to the route's remaining targets in
+    priority order instead of failing the request — the reference's
+    fallback semantics on ModelRouteTarget.priority. Falls back to a
+    direct model-name lookup when no route matches.
+    """
     route = await ModelRoute.first(name=name)
     if route is not None and route.enabled and route.targets:
         targets = route.targets
@@ -46,7 +80,15 @@ async def _resolve_model(name: str) -> Optional[Model]:
             if pick <= acc:
                 chosen = t
                 break
-        return await Model.get(chosen.model_id)
+        ordered = [chosen] + sorted(
+            (t for t in targets if t is not chosen),
+            key=lambda t: t.priority,
+        )
+        for t in ordered:
+            resolved = await _target_record(t, name)
+            if resolved is not None:
+                return resolved
+        return None
     return await Model.first(name=name)
 
 
@@ -73,12 +115,13 @@ def _extract_usage(payload: dict) -> Tuple[int, int]:
 
 async def _record_usage(
     request: web.Request,
-    model: Model,
+    model_id: int,
     route_name: str,
     operation: str,
     prompt_tokens: int,
     completion_tokens: int,
     stream: bool,
+    provider_id: int = 0,
 ) -> None:
     principal = request.get("principal")
     user_id = principal.user.id if principal and principal.user else 0
@@ -86,7 +129,8 @@ async def _record_usage(
         await ModelUsage.create(
             ModelUsage(
                 user_id=user_id,
-                model_id=model.id,
+                model_id=model_id,
+                provider_id=provider_id,
                 route_name=route_name,
                 operation=operation,
                 prompt_tokens=prompt_tokens,
@@ -99,15 +143,65 @@ async def _record_usage(
         logger.exception("failed to record usage")
 
 
+async def _provider_fetch(
+    app: web.Application,
+    provider: ModelProvider,
+    operation: str,
+    body: Optional[dict] = None,
+    *,
+    raw_body: bytes = b"",
+    content_type: str = "",
+):
+    """Dial an external provider's OpenAI-compatible endpoint.
+
+    The provider's credential is attached server-side — clients never see
+    it (reference: ai-proxy wasm injects tokens at the gateway hop).
+    ``extra_headers`` wins over the derived Bearer header so custom auth
+    schemes can fully replace it. ``timeout_s`` bounds connect +
+    inactivity, NOT total stream duration — a long SSE generation must
+    not be cut off mid-stream by a total-time budget.
+    """
+    headers = {
+        "Content-Type": content_type or "application/json"
+    }
+    if provider.api_key:
+        headers["Authorization"] = f"Bearer {provider.api_key}"
+    headers.update(provider.extra_headers)
+    url = f"{provider.base_url.rstrip('/')}/{operation}"
+    resp = await app["proxy_session"].request(
+        "POST",
+        url,
+        data=raw_body if raw_body else json.dumps(body).encode(),
+        headers=headers,
+        timeout=aiohttp.ClientTimeout(
+            total=None,
+            connect=30,
+            sock_read=provider.timeout_s or 120,
+        ),
+    )
+    from gpustack_tpu.server.worker_request import DirectResponse
+
+    return DirectResponse(resp)
+
+
 async def _resolve_target(request: web.Request, name: str):
-    """name → (model, instance, worker) or an error response.
+    """name → (model, instance, worker) | ProviderTarget, or an error.
 
     Shared by the JSON and audio proxies: tenancy denial is a 404
     indistinguishable from nonexistence; no instance / no worker is 503.
     """
     from gpustack_tpu.api.tenant import model_accessible
 
-    model = await _resolve_model(name)
+    from gpustack_tpu.api.tenant import org_scoped_accessible
+
+    resolved = await _resolve_model(name)
+    if isinstance(resolved, ProviderTarget):
+        if not await org_scoped_accessible(
+            request.get("principal"), resolved.provider
+        ):
+            return None, json_error(404, f"model {name!r} not found")
+        return resolved, None
+    model = resolved
     if model is None or not await model_accessible(
         request.get("principal"), model
     ):
@@ -136,17 +230,36 @@ def add_openai_routes(app: web.Application) -> None:
             return orgs is None or m.org_id == 0 or m.org_id in orgs
 
         models = {m.id: m for m in await Model.filter(limit=None)}
+        providers = {
+            p.id: p
+            for p in await ModelProvider.filter(limit=None)
+            if p.enabled
+        }
+
+        def ok_provider(t, route_name: str) -> bool:
+            p = providers.get(t.provider_id)
+            if p is None or not (
+                orgs is None or p.org_id == 0 or p.org_id in orgs
+            ):
+                return False
+            # don't advertise a name the allowlist would 404 at call time
+            upstream = t.provider_model or route_name
+            return not p.models or upstream in p.models
+
         enabled_routes = [
             r for r in await ModelRoute.filter() if r.enabled
         ]
         if enabled_routes:
             # operator curates names via routes; a route is listed when
-            # any target is accessible to this principal
+            # any target (local model or external provider) is accessible
+            # to this principal
             names = [
                 r.name
                 for r in enabled_routes
                 if any(
-                    (m := models.get(t.model_id)) and ok(m)
+                    ok_provider(t, r.name)
+                    if t.provider_id
+                    else ((m := models.get(t.model_id)) and ok(m))
                     for t in r.targets
                 )
             ]
@@ -180,23 +293,37 @@ def add_openai_routes(app: web.Application) -> None:
         target, err = await _resolve_target(request, str(name))
         if err is not None:
             return err
-        model, instance, worker = target
-        # All data-plane traffic flows through the worker's authenticated
-        # reverse proxy (or its tunnel): engines bind to 127.0.0.1 and the
-        # bare engine port is never dialed (reference
-        # routes/worker/proxy.py:200; round-1 direct dialing was an
-        # unauthenticated bypass of the entire auth layer).
-        from gpustack_tpu.server.worker_request import worker_fetch
-
         stream = bool(body.get("stream"))
-        try:
-            upstream = await worker_fetch(
-                app, worker, "POST",
-                f"/proxy/instances/{instance.id}/v1/{operation}",
-                json_body=body,
-            )
-        except aiohttp.ClientError as e:
-            return json_error(502, f"instance unreachable: {e}")
+        if isinstance(target, ProviderTarget):
+            # external-provider hop: server-side dial with the provider's
+            # credential; usage is metered against the provider
+            model_id, provider_id = 0, target.provider.id
+            outbody = dict(body)
+            outbody["model"] = target.upstream_model
+            try:
+                upstream = await _provider_fetch(
+                    app, target.provider, operation, outbody
+                )
+            except aiohttp.ClientError as e:
+                return json_error(502, f"provider unreachable: {e}")
+        else:
+            model, instance, worker = target
+            model_id, provider_id = model.id, 0
+            # All data-plane traffic flows through the worker's
+            # authenticated reverse proxy (or its tunnel): engines bind to
+            # 127.0.0.1 and the bare engine port is never dialed (reference
+            # routes/worker/proxy.py:200; round-1 direct dialing was an
+            # unauthenticated bypass of the entire auth layer).
+            from gpustack_tpu.server.worker_request import worker_fetch
+
+            try:
+                upstream = await worker_fetch(
+                    app, worker, "POST",
+                    f"/proxy/instances/{instance.id}/v1/{operation}",
+                    json_body=body,
+                )
+            except aiohttp.ClientError as e:
+                return json_error(502, f"instance unreachable: {e}")
 
         if not stream:
             payload_bytes = await upstream.read()
@@ -205,7 +332,8 @@ def add_openai_routes(app: web.Application) -> None:
                 pt, ct = _extract_usage(payload)
                 if pt or ct:
                     await _record_usage(
-                        request, model, str(name), operation, pt, ct, False
+                        request, model_id, str(name), operation,
+                        pt, ct, False, provider_id=provider_id,
                     )
                 elif (
                     operation == "images/generations"
@@ -214,7 +342,8 @@ def add_openai_routes(app: web.Application) -> None:
                     # image generations have no token accounting; meter
                     # the request itself (audio does the same)
                     await _record_usage(
-                        request, model, str(name), operation, 0, 0, False
+                        request, model_id, str(name), operation,
+                        0, 0, False, provider_id=provider_id,
                     )
             except json.JSONDecodeError:
                 pass
@@ -257,8 +386,9 @@ def add_openai_routes(app: web.Application) -> None:
             upstream.release()
         if usage_tokens[0] or usage_tokens[1]:
             await _record_usage(
-                request, model, str(name), operation,
+                request, model_id, str(name), operation,
                 usage_tokens[0], usage_tokens[1], True,
+                provider_id=provider_id,
             )
         return resp
 
@@ -289,7 +419,13 @@ def add_openai_routes(app: web.Application) -> None:
         target, err = await _resolve_target(request, name)
         if err is not None:
             return err
-        model, instance, worker = target
+        if isinstance(target, ProviderTarget):
+            model_id, provider_id = 0, target.provider.id
+            # the upstream needs the provider's model name as a form field
+            fields["model"] = target.upstream_model
+        else:
+            model, instance, worker = target
+            model_id, provider_id = model.id, 0
 
         # rebuild the multipart body for the upstream hop
         boundary = f"gpustack{_uuid.uuid4().hex}"
@@ -312,15 +448,22 @@ def add_openai_routes(app: web.Application) -> None:
                 ).encode()
             )
         parts.append(f"--{boundary}--\r\n".encode())
+        raw = b"".join(parts)
+        ctype = f"multipart/form-data; boundary={boundary}"
         try:
-            upstream = await worker_fetch(
-                app, worker, "POST",
-                f"/proxy/instances/{instance.id}/v1/audio/transcriptions",
-                raw_body=b"".join(parts),
-                content_type=(
-                    f"multipart/form-data; boundary={boundary}"
-                ),
-            )
+            if isinstance(target, ProviderTarget):
+                upstream = await _provider_fetch(
+                    app, target.provider, "audio/transcriptions",
+                    raw_body=raw, content_type=ctype,
+                )
+            else:
+                upstream = await worker_fetch(
+                    app, worker, "POST",
+                    f"/proxy/instances/{instance.id}"
+                    "/v1/audio/transcriptions",
+                    raw_body=raw,
+                    content_type=ctype,
+                )
         except aiohttp.ClientError as e:
             return json_error(502, f"instance unreachable: {e}")
         payload = await upstream.read()
@@ -329,7 +472,8 @@ def add_openai_routes(app: web.Application) -> None:
             # usage row per transcription: token fields are zero (audio
             # has no token accounting); request counts/metering still flow
             await _record_usage(
-                request, model, name, "audio/transcriptions", 0, 0, False
+                request, model_id, name, "audio/transcriptions",
+                0, 0, False, provider_id=provider_id,
             )
         return web.Response(
             body=payload,

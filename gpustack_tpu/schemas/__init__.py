@@ -25,6 +25,10 @@ from gpustack_tpu.schemas.models import (
 )
 from gpustack_tpu.schemas.model_files import ModelFile, ModelFileState
 from gpustack_tpu.schemas.model_routes import ModelRoute, ModelRouteTarget
+from gpustack_tpu.schemas.model_providers import (
+    ModelProvider,
+    ModelProviderState,
+)
 from gpustack_tpu.schemas.users import ApiKey, User
 from gpustack_tpu.schemas.orgs import Org, OrgMember, OrgRole
 from gpustack_tpu.schemas.benchmarks import Benchmark, BenchmarkState
@@ -57,6 +61,8 @@ __all__ = [
     "ModelFileState",
     "ModelRoute",
     "ModelRouteTarget",
+    "ModelProvider",
+    "ModelProviderState",
     "User",
     "ApiKey",
     "Org",

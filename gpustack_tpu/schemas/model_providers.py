@@ -1,0 +1,54 @@
+"""External model providers: route targets backed by third-party APIs.
+
+Reference parity: gpustack/schemas/model_provider.py (ModelProvider table,
+org-owned, masked API tokens) + server/controllers.py:2779
+(ModelProviderController). The reference programs Higress's ai-proxy wasm
+plugin with ~30 provider dialects; our gateway is in-process, so we carry
+the one dialect that subsumes nearly all of them — OpenAI-compatible HTTP —
+plus per-provider base_url/headers so any OpenAI-speaking vendor (OpenAI,
+DeepSeek, Fireworks, Together, vLLM, …) plugs in without a wasm layer.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class ModelProviderState(str, enum.Enum):
+    UNKNOWN = "unknown"            # never probed
+    ACTIVE = "active"              # last probe succeeded
+    UNREACHABLE = "unreachable"    # last probe failed
+
+
+@register_record
+class ModelProvider(Record):
+    __kind__ = "model_provider"
+    __indexes__ = ("name", "org_id")
+
+    name: str = ""
+    # Dialect marker. "openai" is the built-in; other values are allowed
+    # and treated identically on the wire (the field exists so operators
+    # and future dialect handlers can discriminate).
+    kind: str = "openai"
+    # Base URL up to and including the API version prefix, e.g.
+    # "https://api.openai.com/v1" — operations are appended verbatim
+    # ("/chat/completions", "/embeddings", ...).
+    base_url: str = ""
+    # Bearer credential; never serialized by the API layer (redacted the
+    # way user password_hash is — reference masks tokens as sha256).
+    api_key: str = ""
+    extra_headers: Dict[str, str] = {}
+    timeout_s: int = 120
+    enabled: bool = True
+    # Owning org; 0 = platform-wide (usable by every org's routes).
+    org_id: int = 0
+    # Optional allowlist of upstream model names; empty = pass anything.
+    models: List[str] = []
+
+    state: ModelProviderState = ModelProviderState.UNKNOWN
+    state_message: str = ""
+    # Model ids reported by the provider's /models at last probe.
+    discovered_models: List[str] = []

@@ -19,6 +19,11 @@ class ModelRouteTarget(pydantic.BaseModel):
     weight: int = 100
     # fallback ordering: lower = preferred; equal weights round-robin
     priority: int = 0
+    # External-provider targets (reference ModelRouteTarget.provider_id):
+    # provider_id != 0 makes this target dial the ModelProvider's API with
+    # ``provider_model`` as the upstream model name; model_id is ignored.
+    provider_id: int = 0
+    provider_model: str = ""
 
 
 @register_record

@@ -14,6 +14,8 @@ class ModelUsage(Record):
 
     user_id: int = 0
     model_id: int = 0
+    # external-provider requests carry the provider id (model_id = 0)
+    provider_id: int = 0
     route_name: str = ""
     operation: str = ""               # chat | completion | embedding
     prompt_tokens: int = 0

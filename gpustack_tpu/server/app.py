@@ -25,6 +25,7 @@ from gpustack_tpu.schemas import (
     Model,
     ModelFile,
     ModelInstance,
+    ModelProvider,
     ModelRoute,
     Org,
     OrgMember,
@@ -284,6 +285,46 @@ def create_app(cfg: Config) -> web.Application:
         worker_write=True, create_hook=benchmark_create_hook,
     )
     add_crud_routes(app, InferenceBackend, "inference-backends")
+
+    async def provider_visible(request, obj) -> bool:
+        from gpustack_tpu.api.tenant import org_scoped_accessible
+
+        return await org_scoped_accessible(request.get("principal"), obj)
+
+    async def provider_check(name, base_url, org_id, existing_id):
+        if not name:
+            return json_error(400, "provider name is required")
+        if not str(base_url).startswith(("http://", "https://")):
+            return json_error(400, "base_url must be http(s)")
+        dup = await ModelProvider.first(name=name, org_id=org_id)
+        if dup is not None and dup.id != existing_id:
+            return json_error(
+                409, f"provider {name!r} already exists in this org"
+            )
+        return None
+
+    async def provider_create_hook(request, obj, body):
+        return await provider_check(obj.name, obj.base_url, obj.org_id, 0)
+
+    async def provider_update_hook(request, obj, fields):
+        # the same invariants hold on update (name/base_url/org moves);
+        # obj is pre-update here, so check the effective merged values
+        return await provider_check(
+            fields.get("name", obj.name),
+            fields.get("base_url", obj.base_url),
+            fields.get("org_id", obj.org_id),
+            obj.id,
+        )
+
+    # External model providers (reference schemas/model_provider.py):
+    # admin-managed; api_key write-only (never serialized, watch included)
+    add_crud_routes(
+        app, ModelProvider, "model-providers",
+        create_hook=provider_create_hook,
+        update_hook=provider_update_hook,
+        visible=provider_visible,
+        redact=("api_key",),
+    )
 
     async def worker_pool_create_hook(request, obj, body):
         from gpustack_tpu.cloud.providers import _PROVIDERS

@@ -22,6 +22,8 @@ from gpustack_tpu.schemas import (
     Model,
     ModelInstance,
     ModelInstanceState,
+    ModelProvider,
+    ModelProviderState,
     ModelRoute,
     ModelRouteTarget,
     Worker,
@@ -168,6 +170,76 @@ class ModelController(Controller):
             )
         elif not any(t.model_id == model.id for t in route.targets):
             await route.update(targets=route.targets + [target])
+
+
+class ModelProviderController(Controller):
+    """Probe external providers and keep their state/model list fresh.
+
+    Reference: ModelProviderController (controllers.py:2779) reprograms
+    the Higress ai-proxy on provider changes; with an in-process gateway
+    there is nothing to reprogram, so the controller's remaining job is
+    liveness: GET {base_url}/models with the provider's credential on
+    CREATE/UPDATE, record reachability + the advertised model ids.
+    """
+
+    record_cls = ModelProvider
+
+    probe_timeout = 15.0
+
+    async def handle(self, event: Event) -> None:
+        if event.type == EventType.DELETED:
+            return
+        if event.type == EventType.UPDATED and event.changes and not (
+            {"base_url", "api_key", "extra_headers", "enabled"}
+            & set(event.changes)
+        ):
+            return  # state-only writes (incl. our own) don't re-probe
+        provider = await ModelProvider.get(event.id)
+        if provider is None or not provider.enabled:
+            return
+        await self.probe(provider)
+
+    async def probe(self, provider) -> None:
+        import aiohttp
+
+        headers = dict(provider.extra_headers)
+        if provider.api_key:
+            headers["Authorization"] = f"Bearer {provider.api_key}"
+        url = f"{provider.base_url.rstrip('/')}/models"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    url,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=self.probe_timeout),
+                ) as resp:
+                    body = await resp.json(content_type=None)
+                    ok = resp.status == 200
+                    status = resp.status
+        except Exception as e:
+            await provider.update(
+                state=ModelProviderState.UNREACHABLE,
+                state_message=str(e)[:200],
+            )
+            return
+        if not ok:
+            await provider.update(
+                state=ModelProviderState.UNREACHABLE,
+                state_message=f"/models returned HTTP {status}",
+            )
+            return
+        names = []
+        if isinstance(body, dict):
+            names = [
+                str(m.get("id"))
+                for m in body.get("data") or []
+                if isinstance(m, dict) and m.get("id")
+            ]
+        await provider.update(
+            state=ModelProviderState.ACTIVE,
+            state_message="",
+            discovered_models=sorted(names),
+        )
 
 
 class WorkerController(Controller):

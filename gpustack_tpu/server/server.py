@@ -29,6 +29,7 @@ from gpustack_tpu.server.app import create_app
 from gpustack_tpu.server.bus import EventBus
 from gpustack_tpu.server.controllers import (
     ModelController,
+    ModelProviderController,
     WorkerController,
     WorkerSyncer,
 )
@@ -115,6 +116,7 @@ class Server:
 
         self.controllers = [
             ModelController(),
+            ModelProviderController(),
             WorkerController(),
             WorkerPoolController(
                 server_url=cfg.advertised_url

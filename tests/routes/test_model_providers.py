@@ -1,0 +1,424 @@
+"""External model providers: CRUD, proxy dial, probe, tenancy.
+
+Reference parity: ModelProvider table (schemas/model_provider.py) + route
+targets with provider_id, credentials injected at the gateway hop and
+never shown to clients.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    ModelProvider,
+    ModelProviderState,
+    ModelRoute,
+    ModelRouteTarget,
+    Org,
+    OrgMember,
+    User,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+def make_fake_upstream(seen):
+    """An OpenAI-compatible upstream that records what it receives."""
+
+    async def chat(request: web.Request):
+        seen["auth"] = request.headers.get("Authorization", "")
+        seen["body"] = await request.json()
+        if seen["body"].get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            chunk = {
+                "choices": [{"delta": {"content": "hi"}}],
+                "usage": {"prompt_tokens": 7, "completion_tokens": 3},
+            }
+            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        return web.json_response(
+            {
+                "choices": [{"message": {"content": "pong"}}],
+                "usage": {"prompt_tokens": 5, "completion_tokens": 2},
+            }
+        )
+
+    async def models(request: web.Request):
+        seen["models_auth"] = request.headers.get("Authorization", "")
+        return web.json_response(
+            {"object": "list", "data": [{"id": "gpt-x"}, {"id": "gpt-y"}]}
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_get("/v1/models", models)
+    return app
+
+
+def run_env(cfg, coro_fn):
+    async def run():
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        alice = await User.create(
+            User(username="alice", password_hash=auth_mod.hash_password("pw"))
+        )
+        hdrs = {
+            name: {
+                "Authorization": "Bearer "
+                + auth_mod.issue_session_token(u, cfg.jwt_secret)
+            }
+            for name, u in (("admin", admin), ("alice", alice))
+        }
+        seen = {}
+        upstream = TestServer(make_fake_upstream(seen))
+        await upstream.start_server()
+        client = TestClient(TestServer(create_app(cfg)))
+        await client.start_server()
+        try:
+            base_url = f"http://127.0.0.1:{upstream.port}/v1"
+            return await coro_fn(client, hdrs, base_url, seen)
+        finally:
+            await client.close()
+            await upstream.close()
+
+    return asyncio.run(run())
+
+
+def test_provider_crud_redacts_api_key(cfg):
+    async def go(client, hdrs, base_url, seen):
+        r = await client.post(
+            "/v2/model-providers",
+            json={
+                "name": "openai",
+                "base_url": base_url,
+                "api_key": "sk-secret",
+            },
+            headers=hdrs["admin"],
+        )
+        assert r.status == 201, await r.text()
+        created = await r.json()
+        assert "api_key" not in created
+
+        r = await client.get(
+            f"/v2/model-providers/{created['id']}", headers=hdrs["admin"]
+        )
+        assert "api_key" not in await r.json()
+
+        # non-admin cannot create
+        r = await client.post(
+            "/v2/model-providers",
+            json={"name": "rogue", "base_url": base_url},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 403
+
+        # invalid base_url rejected
+        r = await client.post(
+            "/v2/model-providers",
+            json={"name": "bad", "base_url": "ftp://x"},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 400
+
+        # duplicate name within the same org rejected
+        r = await client.post(
+            "/v2/model-providers",
+            json={"name": "openai", "base_url": base_url},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 409
+
+        # updates enforce the same invariants (no bypass via PATCH)
+        r = await client.post(
+            "/v2/model-providers",
+            json={"name": "second", "base_url": base_url},
+            headers=hdrs["admin"],
+        )
+        second = await r.json()
+        r = await client.patch(
+            f"/v2/model-providers/{second['id']}",
+            json={"base_url": "ftp://x"},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 400
+        r = await client.patch(
+            f"/v2/model-providers/{second['id']}",
+            json={"name": "openai"},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 409
+
+    run_env(cfg, go)
+
+
+def test_route_falls_back_past_dead_provider_target(cfg):
+    async def go(client, hdrs, base_url, seen):
+        dead = await ModelProvider.create(
+            ModelProvider(name="dead", base_url=base_url, enabled=False)
+        )
+        live = await ModelProvider.create(
+            ModelProvider(name="live", base_url=base_url)
+        )
+        # the weighted pick always lands on the dead target (weight 100
+        # vs 0); resolution must fall back to the live one by priority
+        await ModelRoute.create(
+            ModelRoute(
+                name="ha-alias",
+                targets=[
+                    ModelRouteTarget(
+                        provider_id=dead.id, provider_model="gpt-x",
+                        weight=100, priority=0,
+                    ),
+                    ModelRouteTarget(
+                        provider_id=live.id, provider_model="gpt-x",
+                        weight=0, priority=5,
+                    ),
+                ],
+            )
+        )
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "ha-alias", "messages": []},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 200, await r.text()
+        assert seen["body"]["model"] == "gpt-x"
+
+    run_env(cfg, go)
+
+
+def test_listing_respects_provider_allowlist(cfg):
+    async def go(client, hdrs, base_url, seen):
+        p = await ModelProvider.create(
+            ModelProvider(name="p", base_url=base_url, models=["gpt-y"])
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="never-works",
+                targets=[
+                    ModelRouteTarget(provider_id=p.id, provider_model="gpt-x")
+                ],
+            )
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="works",
+                targets=[
+                    ModelRouteTarget(provider_id=p.id, provider_model="gpt-y")
+                ],
+            )
+        )
+        r = await client.get("/v1/models", headers=hdrs["alice"])
+        ids = {m["id"] for m in (await r.json())["data"]}
+        assert "works" in ids and "never-works" not in ids
+
+    run_env(cfg, go)
+
+
+def test_proxy_dials_provider_with_credential(cfg):
+    async def go(client, hdrs, base_url, seen):
+        provider = await ModelProvider.create(
+            ModelProvider(
+                name="openai", base_url=base_url, api_key="sk-secret"
+            )
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="gpt-alias",
+                targets=[
+                    ModelRouteTarget(
+                        provider_id=provider.id, provider_model="gpt-x"
+                    )
+                ],
+            )
+        )
+
+        # listed under the route's public name
+        r = await client.get("/v1/models", headers=hdrs["alice"])
+        ids = {m["id"] for m in (await r.json())["data"]}
+        assert "gpt-alias" in ids
+
+        # non-stream: upstream model name rewritten, key attached
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "gpt-alias", "messages": []},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 200, await r.text()
+        payload = await r.json()
+        assert payload["choices"][0]["message"]["content"] == "pong"
+        assert seen["auth"] == "Bearer sk-secret"
+        assert seen["body"]["model"] == "gpt-x"
+
+        # usage row metered against the provider
+        from gpustack_tpu.schemas.usage import ModelUsage
+
+        rows = await ModelUsage.filter(provider_id=provider.id)
+        assert len(rows) == 1
+        assert rows[0].prompt_tokens == 5
+        assert rows[0].completion_tokens == 2
+        assert rows[0].model_id == 0
+
+        # streaming relay
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "gpt-alias", "messages": [], "stream": True},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 200
+        text = (await r.read()).decode()
+        assert "data: [DONE]" in text
+        rows = await ModelUsage.filter(provider_id=provider.id)
+        assert len(rows) == 2
+        assert {r_.stream for r_ in rows} == {False, True}
+
+    run_env(cfg, go)
+
+
+def test_provider_allowlist_and_disabled(cfg):
+    async def go(client, hdrs, base_url, seen):
+        provider = await ModelProvider.create(
+            ModelProvider(
+                name="openai", base_url=base_url, models=["gpt-y"]
+            )
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="blocked",
+                targets=[
+                    ModelRouteTarget(
+                        provider_id=provider.id, provider_model="gpt-x"
+                    )
+                ],
+            )
+        )
+        # upstream model not in the provider allowlist → 404
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "blocked", "messages": []},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 404
+
+        ok = await ModelProvider.create(
+            ModelProvider(name="p2", base_url=base_url, enabled=False)
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="off",
+                targets=[ModelRouteTarget(provider_id=ok.id)],
+            )
+        )
+        # disabled provider → 404
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "off", "messages": []},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 404
+
+    run_env(cfg, go)
+
+
+def test_provider_org_scoping(cfg):
+    async def go(client, hdrs, base_url, seen):
+        org_b = await Org.create(Org(name="org-b"))
+        provider = await ModelProvider.create(
+            ModelProvider(
+                name="b-provider", base_url=base_url, org_id=org_b.id
+            )
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="b-ext",
+                targets=[
+                    ModelRouteTarget(
+                        provider_id=provider.id, provider_model="gpt-x"
+                    )
+                ],
+            )
+        )
+        # alice is not in org B: 404 on inference, invisible in listings
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "b-ext", "messages": []},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 404
+        r = await client.get("/v1/models", headers=hdrs["alice"])
+        ids = {m["id"] for m in (await r.json())["data"]}
+        assert "b-ext" not in ids
+        r = await client.get("/v2/model-providers", headers=hdrs["alice"])
+        assert (await r.json())["items"] == []
+
+        # a member of org B gets both
+        bob = await User.create(
+            User(username="bob", password_hash=auth_mod.hash_password("pw"))
+        )
+        await OrgMember.create(OrgMember(org_id=org_b.id, user_id=bob.id))
+        bob_hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(bob, cfg.jwt_secret)
+        }
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "b-ext", "messages": []},
+            headers=bob_hdrs,
+        )
+        assert r.status == 200
+
+    run_env(cfg, go)
+
+
+def test_provider_controller_probe(cfg):
+    async def go(client, hdrs, base_url, seen):
+        from gpustack_tpu.server.controllers import ModelProviderController
+
+        ctrl = ModelProviderController()
+        good = await ModelProvider.create(
+            ModelProvider(
+                name="good", base_url=base_url, api_key="sk-probe"
+            )
+        )
+        await ctrl.probe(good)
+        good = await ModelProvider.get(good.id)
+        assert good.state == ModelProviderState.ACTIVE
+        assert good.discovered_models == ["gpt-x", "gpt-y"]
+        assert seen["models_auth"] == "Bearer sk-probe"
+
+        bad = await ModelProvider.create(
+            ModelProvider(
+                name="bad", base_url="http://127.0.0.1:1/v1"
+            )
+        )
+        ctrl.probe_timeout = 2.0
+        await ctrl.probe(bad)
+        bad = await ModelProvider.get(bad.id)
+        assert bad.state == ModelProviderState.UNREACHABLE
+        assert bad.state_message
+
+    run_env(cfg, go)
