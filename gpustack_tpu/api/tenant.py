@@ -27,13 +27,6 @@ async def accessible_org_ids(principal) -> Optional[Set[int]]:
     return {m.org_id for m in members}
 
 
-async def model_accessible(principal, model: Model) -> bool:
-    if model.org_id == 0:
-        return True
-    orgs = await accessible_org_ids(principal)
-    return orgs is None or model.org_id in orgs
-
-
 async def org_scoped_accessible(principal, obj) -> bool:
     """Generic org-scope check for any record with an ``org_id`` field
     (models, external providers, ...): unscoped records (org_id=0) are
@@ -42,6 +35,10 @@ async def org_scoped_accessible(principal, obj) -> bool:
         return True
     orgs = await accessible_org_ids(principal)
     return orgs is None or obj.org_id in orgs
+
+
+async def model_accessible(principal, model: Model) -> bool:
+    return await org_scoped_accessible(principal, model)
 
 
 async def visible_models(principal, models):

@@ -465,7 +465,12 @@ def add_openai_routes(app: web.Application) -> None:
                     content_type=ctype,
                 )
         except aiohttp.ClientError as e:
-            return json_error(502, f"instance unreachable: {e}")
+            kind = (
+                "provider"
+                if isinstance(target, ProviderTarget)
+                else "instance"
+            )
+            return json_error(502, f"{kind} unreachable: {e}")
         payload = await upstream.read()
         upstream.release()
         if upstream.status == 200:

@@ -25,7 +25,6 @@ from gpustack_tpu.models.config import (
     ModelConfig,
     PRESETS,
     config_from_hf,
-    load_hf_config,
 )
 from gpustack_tpu.parallel.mesh import MeshPlan, plan_mesh
 from gpustack_tpu.schemas import ComputedResourceClaim, Model
@@ -54,7 +53,63 @@ class ModelEvaluation:
         return self.weight_bytes + self.kv_cache_bytes + self.overhead_bytes
 
 
-def resolve_model_config(model: Model):
+def resolve_raw_config(model: Model) -> Optional[dict]:
+    """Raw HF-style ``config.json`` dict for the model's source, or None
+    when the source has no such file (presets; diffusers layouts, whose
+    ``model_index.json`` is handled by ``resolve_model_config``).
+
+    Network sources are disk-cached (hf_hub cache / the ModelScope
+    config cache), so callers may use this freely on every reconcile.
+    """
+    if model.preset:
+        return None
+    if model.local_path:
+        if os.path.exists(
+            os.path.join(model.local_path, "model_index.json")
+        ):
+            return None
+        import json as _json
+
+        try:
+            with open(
+                os.path.join(model.local_path, "config.json")
+            ) as f:
+                return _json.load(f)
+        except (OSError, ValueError) as e:
+            raise EvaluationError(
+                f"cannot read config from {model.local_path}: {e}"
+            )
+    if model.huggingface_repo_id:
+        # Fetch just config.json (tiny; hf_hub caches it, so offline
+        # re-evaluation works once cached) — the reference does the same
+        # HF-config probing server-side (scheduler/evaluator.py HF rate
+        # limiter).
+        import json as _json
+
+        try:
+            from huggingface_hub import hf_hub_download
+
+            path = hf_hub_download(
+                model.huggingface_repo_id, "config.json"
+            )
+            with open(path) as f:
+                return _json.load(f)
+        except Exception as e:
+            raise EvaluationError(
+                f"cannot fetch config for "
+                f"{model.huggingface_repo_id!r}: {e}"
+            )
+    if model.model_scope_model_id:
+        return _modelscope_config_cached(model.model_scope_model_id)
+    raise EvaluationError(
+        "model has no source (preset/local_path/hf/modelscope)"
+    )
+
+
+def resolve_model_config(model: Model, raw: Optional[dict] = None):
+    """Model spec → engine config. ``raw`` lets callers that already
+    fetched the raw config dict (model_registry.detect_categories) skip
+    a second source resolution."""
     from gpustack_tpu.models.diffusion import (
         DIFFUSION_PRESETS,
         config_from_diffusers,
@@ -72,58 +127,25 @@ def resolve_model_config(model: Model):
         if model.preset not in PRESETS:
             raise EvaluationError(f"unknown preset {model.preset!r}")
         return PRESETS[model.preset]
-    if model.local_path:
-        try:
-            import json as _json
-
-            if os.path.exists(
-                os.path.join(model.local_path, "model_index.json")
-            ):
-                # diffusers-format layout = image pipeline
-                return config_from_diffusers(
-                    model.local_path, name=model.name
-                )
-            with open(
-                os.path.join(model.local_path, "config.json")
-            ) as f:
-                raw = _json.load(f)
-            if raw.get("model_type") == "whisper":
-                return config_from_hf_whisper(raw, name=model.name)
-            return load_hf_config(model.local_path)
-        except (OSError, KeyError, ValueError) as e:
-            raise EvaluationError(
-                f"cannot read config from {model.local_path}: {e}"
-            )
-    if model.huggingface_repo_id:
-        # Fetch just config.json (tiny; hf_hub caches it, so offline
-        # re-evaluation works once cached) — the reference does the same
-        # HF-config probing server-side (scheduler/evaluator.py HF rate
-        # limiter).
-        import json
-
-        try:
-            from huggingface_hub import hf_hub_download
-
-            path = hf_hub_download(
-                model.huggingface_repo_id, "config.json"
-            )
-            with open(path) as f:
-                return config_from_hf(
-                    json.load(f), name=model.huggingface_repo_id
-                )
-        except Exception as e:
-            raise EvaluationError(
-                f"cannot fetch config for "
-                f"{model.huggingface_repo_id!r}: {e}"
-            )
-    if model.model_scope_model_id:
-        raw = _modelscope_config_cached(model.model_scope_model_id)
-        if raw.get("model_type") == "whisper":
-            return config_from_hf_whisper(raw, name=model.name)
-        return config_from_hf(raw, name=model.model_scope_model_id)
-    raise EvaluationError(
-        "model has no source (preset/local_path/hf/modelscope)"
+    if raw is None:
+        raw = resolve_raw_config(model)
+    if raw is None:
+        # diffusers-format layout = image pipeline
+        return config_from_diffusers(model.local_path, name=model.name)
+    name = (
+        model.huggingface_repo_id
+        or model.model_scope_model_id
+        or model.name
+        or os.path.basename(str(model.local_path).rstrip("/"))
     )
+    try:
+        if raw.get("model_type") == "whisper":
+            return config_from_hf_whisper(raw, name=model.name or name)
+        return config_from_hf(raw, name=name)
+    except (KeyError, ValueError) as e:
+        raise EvaluationError(
+            f"unsupported model config for {name!r}: {e}"
+        )
 
 
 def _modelscope_config_cached(model_id: str) -> dict:

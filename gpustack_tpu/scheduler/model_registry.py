@@ -1,38 +1,141 @@
 """Model registry: resolved architecture → categories.
 
-Reference parity: scheduler/model_registry.py detect_model_type (476 LoC
-of per-architecture tables) — compressed to the signals our engine
-actually dispatches on. Categories drive backend selection (audio vs LLM
-engine), catalog filtering, and UI grouping; users can still override by
-setting categories explicitly.
+Reference parity: scheduler/model_registry.py detect_model_type — the
+reference pins ~500 architecture names copied from the vLLM registry;
+we classify structurally instead (HF architecture-string conventions +
+config hints), with small exception sets where the conventions collide.
+Categories drive backend selection (audio vs image vs LLM engine),
+catalog filtering, and UI grouping; users can still override by setting
+categories explicitly.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from gpustack_tpu.schemas import Model
 
+# Encoder families whose exports are embedding models even without an
+# "Embedding" marker in the class name.
+_ENCODER_FAMILIES = (
+    "Bert",            # BertModel, ModernBertModel, NomicBertModel, ...
+    "Roberta",
+    "Electra",
+    "MPNet",
+    "Deberta",
+    "MiniLM",
+    "Gte",
+    "Jina",
+    "CLIP",            # CLIPModel text/vision embedders
+)
+
+# "*Model" exports that are decoder LLM entries, not embedding encoders
+# (the reference's text-generation table lists these explicitly).
+_CAUSAL_MODEL_EXCEPTIONS = {
+    "ChatGLMModel",
+    "AquilaModel",
+}
+
+_TTS_MARKERS = ("TextToSpeech", "Tts", "TTS", "Vits", "Bark", "CosyVoice")
+
+_IMAGE_MARKERS = (
+    "StableDiffusion", "Flux", "PixArt", "Sana", "Lumina", "Kandinsky",
+)
+
+_MULTIMODAL_MARKERS = (
+    "VLForConditionalGeneration",
+    "VLChatModel",
+    "Llava",
+    "InternVL",
+    "Vision2Seq",
+    "Idefics",
+    "Paligemma",
+    "Phi3V",
+    "Pixtral",
+)
+
+
+def classify_architectures(
+    architectures: List[str], model_type: str = ""
+) -> List[str]:
+    """HF ``architectures`` + ``model_type`` → category list.
+
+    Returns [] when nothing matches (caller decides the fallback).
+    Mirrors reference detect_model_type/is_multimodal_model
+    (scheduler/model_registry.py:439,463) without its copied tables.
+    """
+    archs = [a for a in (architectures or []) if a]
+    if model_type == "whisper" or any("Whisper" in a for a in archs):
+        return ["audio", "speech-to-text"]
+    if model_type in ("vits", "bark") or any(
+        m in a for a in archs for m in _TTS_MARKERS
+    ):
+        return ["audio", "text-to-speech"]
+    if any(m in a for a in archs for m in _IMAGE_MARKERS):
+        return ["image", "text-to-image"]
+    for a in archs:
+        # cross-encoders ship as sequence classifiers
+        if a.endswith("ForSequenceClassification") or "Rerank" in a:
+            return ["reranker"]
+    # multimodal chat models before the embedding pass: several end in
+    # "Model" (InternVLChatModel) and would hit its catch-all
+    if any(m in a for a in archs for m in _MULTIMODAL_MARKERS):
+        return ["llm", "multimodal"]
+    for a in archs:
+        if "Embedding" in a or a.endswith("ForMaskedLM"):
+            return ["embedding"]
+        if any(f in a for f in _ENCODER_FAMILIES):
+            return ["embedding"]
+        # decoder-as-encoder exports: Qwen2Model, LlamaModel, MistralModel
+        # — the headless variant of a causal family is an embedder
+        if a.endswith("Model") and a not in _CAUSAL_MODEL_EXCEPTIONS:
+            return ["embedding"]
+    for a in archs:
+        if a in _CAUSAL_MODEL_EXCEPTIONS or a.endswith(
+            ("ForCausalLM", "ForConditionalGeneration", "LMHeadModel")
+        ):
+            return ["llm"]
+    return []
+
 
 def detect_categories(model: Model) -> List[str]:
-    """Best-effort categories from the model's resolved config; empty
-    list when the source cannot be resolved (leave user input alone)."""
+    """Best-effort categories from the model's source; empty list when
+    the source cannot be resolved (leave user input alone).
+
+    Architecture strings are the primary signal (they classify even
+    checkpoints our engine can't serve yet); the resolved config adds
+    engine-level tags (moe / long-context) and covers presets.
+    """
     from gpustack_tpu.models.diffusion import DiffusionConfig
     from gpustack_tpu.models.whisper import WhisperConfig
     from gpustack_tpu.scheduler.calculator import (
         EvaluationError,
         resolve_model_config,
+        resolve_raw_config,
     )
 
+    raw: Optional[dict] = None
     try:
-        cfg = resolve_model_config(model)
+        raw = resolve_raw_config(model)
     except EvaluationError:
         return []
+    cats: List[str] = []
+    if raw is not None:
+        cats = classify_architectures(
+            raw.get("architectures") or [], raw.get("model_type") or ""
+        )
+        if cats and cats[0] != "llm":
+            return cats
+
+    try:
+        cfg = resolve_model_config(model, raw=raw)
+    except EvaluationError:
+        return cats
     if isinstance(cfg, WhisperConfig):
         return ["audio", "speech-to-text"]
     if isinstance(cfg, DiffusionConfig):
         return ["image", "text-to-image"]
-    out = ["llm"]
+    out = cats or ["llm"]
     if getattr(cfg, "num_experts", 0):
         out.append("moe")
     if getattr(cfg, "max_position_embeddings", 0) >= 32768:

@@ -1,0 +1,108 @@
+"""Architecture → category classification (reference
+scheduler/model_registry.py detect_model_type / is_multimodal_model)."""
+
+import json
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import Model
+from gpustack_tpu.scheduler.model_registry import (
+    classify_architectures,
+    detect_categories,
+)
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def db():
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield db
+    db.close()
+
+
+@pytest.mark.parametrize(
+    "archs,model_type,want",
+    [
+        (["LlamaForCausalLM"], "llama", ["llm"]),
+        (["Qwen2ForCausalLM"], "qwen2", ["llm"]),
+        (["ChatGLMModel"], "chatglm", ["llm"]),
+        (["WhisperForConditionalGeneration"], "whisper",
+         ["audio", "speech-to-text"]),
+        ([], "whisper", ["audio", "speech-to-text"]),
+        (["VitsModel"], "vits", ["audio", "text-to-speech"]),
+        (["BarkModel"], "bark", ["audio", "text-to-speech"]),
+        (["StableDiffusionXLPipeline"], "", ["image", "text-to-image"]),
+        (["FluxPipeline"], "", ["image", "text-to-image"]),
+        (["BertModel"], "bert", ["embedding"]),
+        (["XLMRobertaModel"], "xlm-roberta", ["embedding"]),
+        (["ModernBertModel"], "modernbert", ["embedding"]),
+        (["Qwen2Model"], "qwen2", ["embedding"]),      # headless export
+        (["MistralModel"], "mistral", ["embedding"]),
+        (["Qwen3ForSequenceClassification"], "qwen3", ["reranker"]),
+        (["XLMRobertaForSequenceClassification"], "xlm-roberta",
+         ["reranker"]),
+        (["LlavaForConditionalGeneration"], "llava",
+         ["llm", "multimodal"]),
+        (["Qwen2VLForConditionalGeneration"], "qwen2_vl",
+         ["llm", "multimodal"]),
+        (["SomethingUnheardOf"], "", []),
+        ([], "", []),
+    ],
+)
+def test_classify_architectures(archs, model_type, want):
+    assert classify_architectures(archs, model_type) == want
+
+
+def test_detect_categories_from_local_config(db, tmp_path):
+    # an embedding checkpoint our LLM engine can't serve still classifies
+    d = tmp_path / "bge"
+    d.mkdir()
+    (d / "config.json").write_text(
+        json.dumps(
+            {
+                "architectures": ["BertModel"],
+                "model_type": "bert",
+                "hidden_size": 384,
+                "num_attention_heads": 12,
+                "num_hidden_layers": 6,
+                "vocab_size": 30522,
+            }
+        )
+    )
+    assert detect_categories(Model(local_path=str(d))) == ["embedding"]
+
+
+def test_detect_categories_llm_with_tags(db, tmp_path):
+    d = tmp_path / "moe"
+    d.mkdir()
+    (d / "config.json").write_text(
+        json.dumps(
+            {
+                "architectures": ["MixtralForCausalLM"],
+                "model_type": "mixtral",
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "num_hidden_layers": 2,
+                "vocab_size": 1024,
+                "num_local_experts": 4,
+                "num_experts_per_tok": 2,
+                "max_position_embeddings": 65536,
+            }
+        )
+    )
+    cats = detect_categories(Model(local_path=str(d)))
+    assert cats == ["llm", "moe", "long-context"]
+
+
+def test_detect_categories_presets_still_work(db):
+    assert detect_categories(Model(preset="tiny")) == ["llm"]
+    assert detect_categories(Model(preset="tiny-whisper")) == [
+        "audio", "speech-to-text",
+    ]
+    assert detect_categories(Model(preset="nope")) == []
