@@ -86,6 +86,9 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
         layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
         layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
         layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+    if cfg.qk_norm:
+        layers["q_norm"] = stack("model.layers.{}.self_attn.q_norm.weight")
+        layers["k_norm"] = stack("model.layers.{}.self_attn.k_norm.weight")
     if cfg.is_moe:
         layers["router"] = stack(
             "model.layers.{}.block_sparse_moe.gate.weight", True

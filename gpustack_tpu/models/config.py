@@ -40,6 +40,7 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     qkv_bias: bool = False          # Qwen2-style attention bias
+    qk_norm: bool = False           # Qwen3-style per-head q/k RMSNorm
     max_position_embeddings: int = 8192
     sliding_window: int = 0         # 0 = full attention
     # MoE (Mixtral / Qwen-MoE class); num_experts == 0 means dense MLP.
@@ -92,6 +93,8 @@ class ModelConfig:
         attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
         if self.qkv_bias:
             attn += self.q_dim + 2 * self.kv_dim
+        if self.qk_norm:
+            attn += 2 * self.head_dim
         if self.is_moe:
             mlp = d * self.num_experts + self.num_experts * (
                 3 * d * self.moe_intermediate_size
@@ -141,6 +144,9 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=cfg.get("tie_word_embeddings", False),
         qkv_bias="Qwen2" in arch and not cfg.get("no_bias", False),
+        # Qwen3 (dense + MoE) replaces attention bias with per-head
+        # q/k RMSNorm (Qwen3ForCausalLM / Qwen3MoeForCausalLM)
+        qk_norm="Qwen3" in arch,
         max_position_embeddings=cfg.get("max_position_embeddings", 8192),
         sliding_window=cfg.get("sliding_window") or 0,
         num_experts=num_experts,
@@ -204,6 +210,21 @@ PRESETS: Dict[str, ModelConfig] = {
         tie_word_embeddings=False,
         max_position_embeddings=32768,
     ),
+    # BASELINE anchor family: the reference's closest published 8B number
+    # is Qwen3-8B (docs/performance-lab/qwen3-8b/910b.md:95-98).
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151936,
+        hidden_size=4096,
+        intermediate_size=12288,
+        num_layers=36,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        qk_norm=True,
+        max_position_embeddings=40960,
+    ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",
         vocab_size=32000,
@@ -230,6 +251,19 @@ PRESETS: Dict[str, ModelConfig] = {
         num_kv_heads=2,
         head_dim=16,
         rope_theta=10000.0,
+        max_position_embeddings=256,
+    ),
+    "tiny-qwen3": ModelConfig(
+        name="tiny-qwen3",
+        vocab_size=264,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        qk_norm=True,
         max_position_embeddings=256,
     ),
     "tiny-moe": ModelConfig(

@@ -166,6 +166,9 @@ def init_quantized_params(cfg, seed: int = 0):
         layers["bq"] = zeros(L, cfg.q_dim)
         layers["bk"] = zeros(L, cfg.kv_dim)
         layers["bv"] = zeros(L, cfg.kv_dim)
+    if cfg.qk_norm:
+        layers["q_norm"] = ones(L, cfg.head_dim)
+        layers["k_norm"] = ones(L, cfg.head_dim)
     if cfg.is_moe:
         fm, E = cfg.moe_intermediate_size, cfg.num_experts
         layers["router"] = (

@@ -119,6 +119,9 @@ def init_params(
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
         layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), dtype)
     if cfg.is_moe:
         fm, E = cfg.moe_intermediate_size, cfg.num_experts
         layers["router"] = w(next(keys), L, d, E)
@@ -344,12 +347,16 @@ def forward(
         v = _mm("btd,dk->btk", h, lp["wv"])
         if cfg.qkv_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = q.reshape(B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim)
+        q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(
-            q.reshape(B, T, cfg.num_heads, cfg.head_dim), sin, cos
-        ).reshape(B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim)
+        if cfg.qk_norm:
+            # Qwen3: per-head RMSNorm on q/k BEFORE RoPE (HF convention)
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = apply_rope(q, sin, cos).reshape(
+            B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim
+        )
         k = apply_rope(k, sin, cos)
 
         if cache is None:
