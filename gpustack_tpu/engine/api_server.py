@@ -473,6 +473,7 @@ def build_engine_from_args(args) -> LLMEngine:
         draft_cfg=draft_cfg,
         draft_params=draft_params,
         host_kv_cache_mb=getattr(args, "host_kv_cache_mb", 0),
+        prefill_chunk=getattr(args, "prefill_chunk", 0),
     )
 
 
@@ -485,6 +486,11 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=9000)
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="chunked prefill: process prompts in chunks of this many "
+        "tokens, interleaving decode between chunks (0 = off)",
+    )
     p.add_argument("--quantization", choices=["", "int8"], default="")
     p.add_argument(
         "--speculative", choices=["", "ngram", "draft"], default=""

@@ -118,6 +118,18 @@ class GenRequest:
 
 
 @dataclasses.dataclass
+class _ChunkJob:
+    """An in-progress chunked prefill occupying a slot (not yet decoding)."""
+
+    req: "GenRequest"
+    ids: List[int]
+    done: int = 0            # tokens prefilled so far
+    last: Any = None         # last-position logits of the latest chunk
+    k: Any = None            # accumulated KV [L, bucket, H, hd]
+    v: Any = None
+
+
+@dataclasses.dataclass
 class _SlotInfo:
     request: GenRequest
     ngram: Optional["_NgramIndex"] = None
@@ -152,6 +164,7 @@ class LLMEngine:
         draft_cfg=None,              # draft model config (speculative=draft)
         draft_params=None,
         host_kv_cache_mb: int = 0,   # >0: host-RAM prefill KV cache
+        prefill_chunk: int = 0,      # >0: chunked prefill (tokens/chunk)
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -172,6 +185,24 @@ class LLMEngine:
         self._id_counter = itertools.count()
         self._step_count = 0
         self._tokens_generated = 0
+        # Chunked prefill (vLLM's enable-chunked-prefill role): prompts
+        # longer than the chunk are prefilled chunk-by-chunk with a
+        # decode step interleaved between chunks, so one long prompt
+        # can't stall token cadence for every running slot. Chunks ride
+        # the prefix-continuation jit path (prefill_with_prefix), so
+        # each chunk's cost is one bucketed forward, never O(S^2) over
+        # the whole prompt at once.
+        self.prefill_chunk = 0
+        if prefill_chunk > 0:
+            # snap to a real bucket so chunk steps hit stable jit keys
+            # (rounding UP — the effective chunk may exceed the request);
+            # clamp to the top bucket: a chunk >= every possible prompt
+            # makes chunking a no-op instead of a startup crash
+            top = self.runner.prefill_buckets[-1]
+            self.prefill_chunk = self.runner.bucket_for(
+                min(prefill_chunk, top)
+            )
+        self._chunk_jobs: Dict[int, _ChunkJob] = {}
         self.speculative = speculative
         self.spec_tokens = max(2, spec_tokens)
         self._spec_hits = 0
@@ -317,15 +348,95 @@ class LLMEngine:
     def step(self) -> bool:
         """One scheduling iteration. Returns False when fully idle."""
         admitted = self._admit()
+        # at most one prefill chunk per step: decode cadence for running
+        # slots is bounded by one chunk's latency, not a whole prompt's
+        progressed = self._advance_chunk()
         if self._slots:
             self._decode_once()
             return True
-        if admitted:
+        if admitted or progressed or self._chunk_jobs:
             return True
         # Nothing active: drain any lagging fetches so finished requests
         # complete deterministically.
         self._drain_pending()
         return not self._waiting.empty()
+
+    def _plan_chunk_job(self, req: GenRequest, ids) -> "Optional[_ChunkJob]":
+        """Chunk schedule for a long prompt, seeded from the host KV
+        cache's longest prefix when one fits. Returns None when any
+        continuation would overflow the top bucket (possible with
+        non-power-of-two max_seq_len shapes) — the caller then falls
+        back to one-shot prefill, which always fits."""
+        import jax.numpy as jnp
+
+        top = self.runner.prefill_buckets[-1]
+
+        def fits(start: int) -> bool:
+            # every continuation writes its suffix block at
+            # [start, start + sb); dynamic_update_slice CLAMPS
+            # out-of-range starts, so overflow = silent corruption —
+            # same bounds contract as the one-shot prefix path
+            while start < len(ids):
+                n = min(self.prefill_chunk, len(ids) - start)
+                sb = self.runner.bucket_for(n)
+                if start and start + sb > top:
+                    return False
+                start += n
+            return True
+
+        kv_cache = self.host_kv_cache
+        if kv_cache is not None:
+            prefix = kv_cache.find_longest_prefix(ids)
+            if prefix is not None:
+                (_, pk, pv), plen = prefix
+                if fits(plen):
+                    kv_cache.prefix_hits += 1
+                    return _ChunkJob(
+                        req=req, ids=list(ids), done=plen,
+                        k=jnp.asarray(pk), v=jnp.asarray(pv),
+                    )
+        if fits(0):
+            return _ChunkJob(req=req, ids=list(ids))
+        return None
+
+    def _advance_chunk(self) -> bool:
+        """Run ONE chunk of the oldest in-progress chunked prefill."""
+        if not self._chunk_jobs:
+            return False
+        slot = next(iter(self._chunk_jobs))
+        job = self._chunk_jobs[slot]
+        start = job.done
+        chunk = job.ids[start : start + self.prefill_chunk]
+        if start == 0:
+            b = self.runner.bucket_for(len(chunk))
+            padded = list(chunk) + [0] * (b - len(chunk))
+            job.last, job.k, job.v = self.runner.prefill(
+                padded, len(chunk)
+            )
+        else:
+            sb = self.runner.bucket_for(len(chunk))
+            total_bucket = self.runner.bucket_for(start + sb)
+            padded = list(chunk) + [0] * (sb - len(chunk))
+            job.last, job.k, job.v = self.runner.prefill_with_prefix(
+                job.k, job.v, start, padded, len(chunk), total_bucket
+            )
+        job.done += len(chunk)
+        if job.done >= len(job.ids):
+            del self._chunk_jobs[slot]
+            ids = job.ids
+            bucket = self.runner.bucket_for(len(ids))
+            # chunk continuation widths round to the same bucket as a
+            # one-shot prefill would; trim defensively before store
+            if self.host_kv_cache is not None:
+                padded_full = list(ids) + [0] * (bucket - len(ids))
+                key = self.host_kv_cache.key(
+                    bucket, padded_full, len(ids)
+                )
+                self._store_host_kv(
+                    key, job.last, job.k, job.v, ids, bucket
+                )
+            self._finalize_start(slot, job.req, job.last, job.k, job.v)
+        return True
 
     # admit as many waiting requests as there are free slots
     def _admit(self) -> bool:
@@ -343,8 +454,6 @@ class LLMEngine:
     def _start_request(self, slot: int, req: GenRequest) -> None:
         import jax.numpy as jnp
 
-        from gpustack_tpu.engine.sampling import SamplingState, sample
-
         ids = req.prompt_ids
         bucket = self.runner.bucket_for(max(1, len(ids)))
         padded = list(ids) + [0] * (bucket - len(ids))
@@ -361,6 +470,15 @@ class LLMEngine:
             last_logits = jnp.asarray(last_np)
             k = jnp.asarray(k_np)
             v = jnp.asarray(v_np)
+        elif (
+            self.prefill_chunk
+            and len(ids) > self.prefill_chunk
+            and (job := self._plan_chunk_job(req, ids)) is not None
+        ):
+            # long prompt: prefill in chunks, one per scheduler step
+            # (the step loop interleaves decode between chunks)
+            self._chunk_jobs[slot] = job
+            return
         else:
             prefix = (
                 kv_cache.find_longest_prefix(ids)
@@ -375,19 +493,11 @@ class LLMEngine:
                 # fit above the prefix within a REAL bucket —
                 # dynamic_update_slice clamps out-of-range writes and
                 # would silently corrupt the tail
+                # (the continuation runs flash with q_offset at flash-
+                # sized totals, so no bucket class is excluded anymore)
                 use_prefix = (
                     plen + sb <= self.runner.prefill_buckets[-1]
                 )
-                # flash-bucket prompts keep the plain prefill path: the
-                # offset variant runs XLA attention, which is exactly
-                # what flash exists to avoid at those lengths
-                if (
-                    use_prefix
-                    and self.runner.attn_impl_for(
-                        self.runner.bucket_for(plen + sb)
-                    ) == "flash"
-                ):
-                    use_prefix = False
             if use_prefix:
                 # prefix reuse: upload the cached prefix KV, prefill
                 # only the suffix from that offset. Counted here, not in
@@ -403,35 +513,63 @@ class LLMEngine:
             else:
                 last_logits, k, v = self.runner.prefill(padded, len(ids))
             if kv_cache is not None:
-                def copy_to_host(
-                    key=cache_key, logits=last_logits, k_=k, v_=v,
-                    kv_cache=kv_cache, prompt=tuple(ids),
-                    store_bucket=bucket,
-                ):
-                    try:
-                        # trim to the prompt's own bucket: the prefix
-                        # path returns total_bucket-wide arrays, and a
-                        # wider-than-bucket_for(prompt) entry would break
-                        # the Pb <= total_bucket invariant on later reuse
-                        # (and waste host bytes)
-                        kv_cache.put(
-                            key,
-                            (
-                                np.asarray(logits),
-                                np.asarray(k_[:, :store_bucket]),
-                                np.asarray(v_[:, :store_bucket]),
-                            ),
-                            prompt_ids=prompt,
-                        )
-                    except RuntimeError as e:
-                        # non-addressable shards (defensive: backends
-                        # gates multi-host off already)
-                        logger.warning(
-                            "disabling host KV cache: %s", e
-                        )
-                        self.host_kv_cache = None
+                self._store_host_kv(cache_key, last_logits, k, v, ids, bucket)
+        self._finalize_start(slot, req, last_logits, k, v)
 
-                self._kv_copy_pool.submit(copy_to_host)
+    def _store_host_kv(
+        self, cache_key, last_logits, k, v, ids, store_bucket: int
+    ) -> None:
+        """Queue an async device→host copy of a finished prefill's KV."""
+        kv_cache = self.host_kv_cache
+        if kv_cache is None or self._kv_copy_pool is None:
+            return
+
+        def copy_to_host(
+            key=cache_key, logits=last_logits, k_=k, v_=v,
+            kv_cache=kv_cache, prompt=tuple(ids),
+            store_bucket=store_bucket,
+        ):
+            try:
+                # trim to the prompt's own bucket: the prefix
+                # path returns total_bucket-wide arrays, and a
+                # wider-than-bucket_for(prompt) entry would break
+                # the Pb <= total_bucket invariant on later reuse
+                # (and waste host bytes)
+                kv_cache.put(
+                    key,
+                    (
+                        np.asarray(logits),
+                        np.asarray(k_[:, :store_bucket]),
+                        np.asarray(v_[:, :store_bucket]),
+                    ),
+                    prompt_ids=prompt,
+                )
+            except RuntimeError as e:
+                # non-addressable shards (defensive: backends
+                # gates multi-host off already)
+                logger.warning(
+                    "disabling host KV cache: %s", e
+                )
+                self.host_kv_cache = None
+
+        try:
+            self._kv_copy_pool.submit(copy_to_host)
+        except RuntimeError:
+            # pool shut down (engine stopping) — skip the store; the
+            # cache is an optimization, never required for correctness
+            pass
+
+    def _finalize_start(
+        self, slot: int, req: GenRequest, last_logits, k, v
+    ) -> None:
+        """Insert a finished prefill into the decode state and deliver
+        the first token (shared by the one-shot, cached and chunked
+        prefill paths)."""
+        import jax.numpy as jnp
+
+        from gpustack_tpu.engine.sampling import SamplingState, sample
+
+        ids = req.prompt_ids
         # First generated token: same device sampler as decode, one row —
         # one sampling semantics for the whole sequence, seeded by the
         # engine's key.

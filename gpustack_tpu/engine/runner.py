@@ -192,6 +192,9 @@ class ModelRunner:
         knob = os.environ.get("GPUSTACK_TPU_FLASH", "")
         if knob == "1":
             return "flash"
+        if knob == "interpret":
+            # test hook: exercise the pallas kernel hermetically on CPU
+            return "flash_interpret"
         if knob == "0":
             return "xla"
         on_tpu = jax.default_backend() == "tpu"
@@ -266,11 +269,15 @@ class ModelRunner:
         key = (Pb, Tsb, total_bucket)
         fn = self._prefix_prefills.get(key)
         if fn is None:
+            # continuation attention kernel follows the TOTAL width:
+            # a 512-token chunk against a 32k cache is exactly the
+            # [T, S] blow-up flash exists to avoid (q_offset shifts the
+            # kernel's causal diagonal)
             fn = jax.jit(
                 partial(
                     self._prefix_prefill_impl,
                     total_bucket=total_bucket,
-                    attn_impl="ring" if self.sp_mode else "xla",
+                    attn_impl=self.attn_impl_for(total_bucket),
                 )
             )
             self._prefix_prefills[key] = fn

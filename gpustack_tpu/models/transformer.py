@@ -85,8 +85,14 @@ class KVCache:
 
     @staticmethod
     def create(
-        cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+        cfg: ModelConfig, batch: int, max_len: int, dtype=None
     ) -> "KVCache":
+        if dtype is None:
+            # follow the model's compute dtype: K/V written by forward
+            # must match the buffer (dynamic_update_slice is dtype-strict)
+            dtype = (
+                jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+            )
         shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
         return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
@@ -313,7 +319,7 @@ def forward(
         attn_impl in ("flash", "flash_interpret")
         and cache is not None
         and T > 1
-        and cache.max_len == T
+        and cache.max_len >= T
         and not cfg.sliding_window
     )
     use_ring = attn_impl == "ring" and cache is not None
@@ -389,9 +395,13 @@ def forward(
                         mesh, q, new_k, new_v, positions, scale
                     )
             elif use_flash:
-                # prefill-from-zero: q rows are positions 0..T-1 against
-                # the freshly written cache — exactly the kernel's causal
-                # contract (kernel masks pad keys via seq_k)
+                # prefill (from zero or from a chunk/prefix offset):
+                # q rows sit at positions offset..offset+T-1 against the
+                # freshly written cache; the kernel's q_offset shifts the
+                # causal diagonal (all batch rows share one offset — the
+                # engine's prefill paths are B=1; pad keys masked via
+                # seq_k, pad/garbage cache rows above the last query
+                # position are causally invisible)
                 from gpustack_tpu.ops.flash_attention import (
                     flash_attention_prefill,
                 )
@@ -402,6 +412,7 @@ def forward(
                     new_v,
                     scale,
                     interpret=attn_impl == "flash_interpret",
+                    q_offset=positions[0, 0],
                 )
             else:
                 attn = _attend(q, new_k, new_v, mask, scale)

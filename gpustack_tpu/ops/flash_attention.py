@@ -37,10 +37,16 @@ _NEG = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, scale: float, seq_k: int, n_kb: int,
 ):
-    """Grid point = one (batch, q-head, q-block, k-block) tile."""
+    """Grid point = one (batch, q-head, q-block, k-block) tile.
+
+    ``off_ref`` (SMEM scalar) is the absolute position of q row 0 —
+    zero for prefill-from-scratch; the prefix length for chunked-prefill
+    continuation steps, whose queries sit at positions offset..offset+T-1
+    against a cache of offset+T keys.
+    """
     qb = pl.program_id(2)
     kb = pl.program_id(3)
 
@@ -50,11 +56,12 @@ def _flash_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    off = off_ref[0]
     q_start = qb * BLOCK_Q
     k_start = kb * BLOCK_K
 
-    # causal: skip k-blocks entirely above the diagonal
-    @pl.when(k_start <= q_start + BLOCK_Q - 1)
+    # causal: skip k-blocks entirely above the (offset) diagonal
+    @pl.when(k_start <= off + q_start + BLOCK_Q - 1)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32) * scale   # [BQ, d]
         k = k_ref[0, 0].astype(jnp.float32)           # [BK, d]
@@ -64,7 +71,7 @@ def _flash_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                             # [BQ, BK]
-        q_idx = q_start + lax.broadcasted_iota(
+        q_idx = off + q_start + lax.broadcasted_iota(
             jnp.int32, s.shape, 0
         )
         k_idx = k_start + lax.broadcasted_iota(
@@ -102,11 +109,14 @@ def flash_attention_prefill(
     v: jax.Array,       # [B, S, Hkv, d]
     scale: float,
     interpret: bool = False,
+    q_offset=0,
 ) -> jax.Array:
-    """Causal GQA prefill attention (q positions 0..T-1 against k
-    positions 0..S-1, with keys at index >= S... masked via ``seq_k``).
-    Returns [B, T, Hq*d]. T and S are padded to block multiples
-    internally; any sequence length fits (VMEM use is O(block))."""
+    """Causal GQA prefill attention (q positions q_offset..q_offset+T-1
+    against k positions 0..S-1, with keys at index >= S masked via
+    ``seq_k``). ``q_offset`` (traced scalar) supports chunked-prefill
+    continuation: every batch row shares the one offset. Returns
+    [B, T, Hq*d]. T and S are padded to block multiples internally; any
+    sequence length fits (VMEM use is O(block))."""
     B, T, Hq, d = q.shape
     S, Hkv = k.shape[1], k.shape[2]
     if Hq % Hkv != 0:
@@ -127,6 +137,7 @@ def flash_attention_prefill(
 
     n_kb = S_pad // BLOCK_K
     grid = (B, Hq, T_pad // BLOCK_Q, n_kb)
+    off = jnp.asarray(q_offset, jnp.int32).reshape(1)
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, scale=scale, seq_k=S, n_kb=n_kb
@@ -134,6 +145,7 @@ def flash_attention_prefill(
         out_shape=jax.ShapeDtypeStruct((B, Hq, T_pad, d), q.dtype),
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
                 (1, 1, BLOCK_Q, d), lambda b, h, qb, kb: (b, h, qb, 0)
             ),
@@ -155,6 +167,6 @@ def flash_attention_prefill(
             pltpu.VMEM((BLOCK_Q, d), jnp.float32),        # accumulator
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(off, qt, kt, vt)
     out = jnp.transpose(out[:, :, :T, :], (0, 2, 1, 3))  # [B, T, Hq, d]
     return out.reshape(B, T, Hq * d)

@@ -77,6 +77,10 @@ class Model(Record):
     # extended KV cache (LMCache role, reference schemas/models.py:111-122
     # + vllm.py:418-436): host-RAM prefill-KV budget in MiB; 0 = off
     host_kv_cache_mb: int = 0
+    # >0: chunked prefill — prompts longer than this many tokens prefill
+    # in chunks with decode steps interleaved (vLLM enable-chunked-prefill
+    # role; bounds long-prompt impact on running slots' token cadence)
+    prefill_chunk: int = 0
     # LoRA adapters merged into the base weights at load (reference
     # lora_model_routes.py role; merged-at-load is the TPU-friendly
     # shape — zero runtime overhead, one instance per adapter set)

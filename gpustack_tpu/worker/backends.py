@@ -140,6 +140,8 @@ def _tpu_native_command(
         argv += ["--quantization", model.quantization]
     for adapter in model.lora_adapters:
         argv += ["--lora", adapter]
+    if model.prefill_chunk:
+        argv += ["--prefill-chunk", str(model.prefill_chunk)]
     if model.host_kv_cache_mb and not instance.coordinator_address:
         # single-host only: on multi-host meshes the prefill K/V spans
         # non-addressable devices and cannot be pulled to one host's RAM

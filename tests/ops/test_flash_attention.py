@@ -50,3 +50,51 @@ def test_flash_bf16_inputs():
     out = flash_attention_prefill(q, k, v, d ** -0.5, interpret=True)
     assert out.dtype == jnp.bfloat16
     assert jnp.isfinite(out.astype(jnp.float32)).all()
+
+
+@pytest.mark.parametrize("B,T,S,off,Hq,Hkv,d", [
+    (1, 128, 512, 256, 4, 2, 64),    # mid-cache chunk
+    (1, 100, 512, 384, 2, 1, 64),    # non-block T, chunk ends mid-cache
+    (1, 128, 128, 0, 2, 2, 64),      # offset 0 == original contract
+])
+def test_flash_q_offset_matches_reference(B, T, S, off, Hq, Hkv, d):
+    """Chunked-prefill continuation: q rows at positions off..off+T-1
+    against a cache of S keys (keys above the causal line are garbage
+    the mask must hide)."""
+    ks = jax.random.split(jax.random.key(2), 3)
+    G = Hq // Hkv
+    q = jax.random.normal(ks[0], (B, T, Hq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    q_pos = off + jnp.arange(T, dtype=jnp.int32)
+    mask = jnp.broadcast_to(
+        jnp.arange(S)[None, None, :] <= q_pos[None, :, None], (B, T, S)
+    )
+    ref = _attend(q.reshape(B, T, Hkv, G, d), k, v, mask, scale)
+
+    out = flash_attention_prefill(
+        q, k, v, scale, interpret=True, q_offset=off
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_q_offset_is_traced_not_specialized():
+    """Different offsets reuse one compiled kernel (offset rides SMEM,
+    not the jit cache key)."""
+    B, T, S, Hq, Hkv, d = 1, 128, 256, 2, 2, 64
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, T, Hq, d), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, d), jnp.float32)
+    o1 = flash_attention_prefill(
+        q, k, v, 0.125, interpret=True, q_offset=jnp.int32(0)
+    )
+    o2 = flash_attention_prefill(
+        q, k, v, 0.125, interpret=True, q_offset=jnp.int32(128)
+    )
+    # offset widens the visible key range → outputs must differ
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
