@@ -426,12 +426,12 @@ class LLMEngine:
             ids = job.ids
             bucket = self.runner.bucket_for(len(ids))
             # chunk continuation widths round to the same bucket as a
-            # one-shot prefill would; trim defensively before store
-            if self.host_kv_cache is not None:
+            # one-shot prefill would; trim defensively before store.
+            # snapshot: the copy worker may null host_kv_cache concurrently
+            kv_cache = self.host_kv_cache
+            if kv_cache is not None:
                 padded_full = list(ids) + [0] * (bucket - len(ids))
-                key = self.host_kv_cache.key(
-                    bucket, padded_full, len(ids)
-                )
+                key = kv_cache.key(bucket, padded_full, len(ids))
                 self._store_host_kv(
                     key, job.last, job.k, job.v, ids, bucket
                 )

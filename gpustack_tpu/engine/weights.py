@@ -90,8 +90,24 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
         layers["q_norm"] = stack("model.layers.{}.self_attn.q_norm.weight")
         layers["k_norm"] = stack("model.layers.{}.self_attn.k_norm.weight")
     if cfg.is_moe:
+        # Two HF MoE naming schemes: Mixtral
+        # (block_sparse_moe.gate / experts.{e}.w1|w2|w3) and Qwen-MoE
+        # (mlp.gate / experts.{e}.gate_proj|down_proj|up_proj)
+        if "model.layers.0.block_sparse_moe.gate.weight" in tensors:
+            block, wg, wd, wu = "block_sparse_moe", "w1", "w2", "w3"
+        else:
+            block, wg, wd, wu = "mlp", "gate_proj", "down_proj", "up_proj"
+            if any("shared_expert" in name for name in tensors):
+                # Qwen2-MoE-style shared experts contribute to every
+                # token's MLP output; silently dropping them would serve
+                # wrong logits — fail loudly until the block supports them
+                raise ValueError(
+                    "checkpoint has shared-expert weights "
+                    "(Qwen2-MoE style), which this engine does not "
+                    "implement yet"
+                )
         layers["router"] = stack(
-            "model.layers.{}.block_sparse_moe.gate.weight", True
+            "model.layers.{}." + block + ".gate.weight", True
         )
         E = cfg.num_experts
 
@@ -100,9 +116,9 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
                 jnp.stack([
                     _to_jnp(
                         tensors.pop(
-                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                            f"model.layers.{i}.{block}.experts.{e}.{w}.weight"
                         ).T if transpose else tensors.pop(
-                            f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                            f"model.layers.{i}.{block}.experts.{e}.{w}.weight"
                         )
                     )
                     for e in range(E)
@@ -110,9 +126,9 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
                 for i in range(L)
             ])
 
-        layers["we_gate"] = stack_experts("w1", True)
-        layers["we_down"] = stack_experts("w2", True)
-        layers["we_up"] = stack_experts("w3", True)
+        layers["we_gate"] = stack_experts(wg, True)
+        layers["we_down"] = stack_experts(wd, True)
+        layers["we_up"] = stack_experts(wu, True)
     else:
         layers["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight", True)
         layers["w_up"] = stack("model.layers.{}.mlp.up_proj.weight", True)

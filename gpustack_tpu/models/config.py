@@ -225,6 +225,23 @@ PRESETS: Dict[str, ModelConfig] = {
         qk_norm=True,
         max_position_embeddings=40960,
     ),
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151936,
+        hidden_size=2048,
+        intermediate_size=6144,
+        num_layers=48,
+        num_heads=32,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        qk_norm=True,
+        num_experts=128,
+        num_experts_per_tok=8,
+        moe_intermediate_size=768,
+        norm_topk_prob=True,
+        max_position_embeddings=40960,
+    ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",
         vocab_size=32000,
