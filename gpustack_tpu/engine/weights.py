@@ -76,12 +76,28 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
 
     layers: Dict[str, Any] = {
         "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
-        "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight"),
         "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
         "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
         "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
     }
+    if cfg.post_norms:
+        # gemma sandwich norms: HF post_attention_layernorm is the
+        # POST-attention norm here, and the pre-MLP norm has its own name
+        layers["post_attn_norm"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight"
+        )
+        layers["mlp_norm"] = stack(
+            "model.layers.{}.pre_feedforward_layernorm.weight"
+        )
+        layers["post_mlp_norm"] = stack(
+            "model.layers.{}.post_feedforward_layernorm.weight"
+        )
+    else:
+        # llama-family: HF post_attention_layernorm IS the pre-MLP norm
+        layers["mlp_norm"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight"
+        )
     if cfg.qkv_bias:
         layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
         layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")

@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +43,19 @@ class ModelConfig:
     qk_norm: bool = False           # Qwen3-style per-head q/k RMSNorm
     max_position_embeddings: int = 8192
     sliding_window: int = 0         # 0 = full attention
+    # ---- Gemma-family knobs (Gemma2/Gemma3 text) ----
+    hidden_act: str = "silu"        # "gelu_tanh" for gemma
+    norm_delta_gain: bool = False   # RMSNorm gain stored as (1 + w)
+    embed_scale: bool = False       # scale embeddings by sqrt(hidden)
+    post_norms: bool = False        # sandwich post-attn/post-mlp norms
+    query_pre_attn_scalar: float = 0.0  # 0 = scale by 1/sqrt(head_dim)
+    attn_logit_softcap: float = 0.0     # 0 = no softcapping
+    final_logit_softcap: float = 0.0
+    # per-layer sliding flags (True = sliding_attention); None = use the
+    # global sliding_window for every layer (Mistral-style)
+    layer_sliding: Optional[Tuple[bool, ...]] = None
+    # rope theta for sliding layers (gemma3 local attention); 0 = shared
+    rope_local_theta: float = 0.0
     # MoE (Mixtral / Qwen-MoE class); num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 0
@@ -82,6 +95,9 @@ class ModelConfig:
         if self.is_moe:
             assert self.num_experts_per_tok > 0
             assert self.moe_intermediate_size > 0
+        if self.layer_sliding is not None:
+            assert len(self.layer_sliding) == self.num_layers
+            assert self.sliding_window > 0
         return self
 
     # ---- memory accounting (used by scheduler + engine sizing) ----
@@ -101,7 +117,7 @@ class ModelConfig:
             )
         else:
             mlp = 3 * d * self.intermediate_size
-        norms = 2 * d
+        norms = (4 if self.post_norms else 2) * d
         per_layer = attn + mlp + norms
         return embed + lm_head + self.num_layers * per_layer + d
 
@@ -130,6 +146,28 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         or cfg.get("num_experts")         # Qwen2-MoE
         or 0
     )
+    # Gemma2/Gemma3 text: (1+w) norms, scaled embeddings, sandwich
+    # norms, gelu-tanh MLP, softcapping (gemma2), alternating
+    # sliding/full layers, dual rope thetas (gemma3)
+    gemma = "Gemma2" in arch or "Gemma3" in arch
+    layer_types = cfg.get("layer_types")
+    layer_sliding = (
+        tuple(t == "sliding_attention" for t in layer_types)
+        if gemma and layer_types
+        else None
+    )
+    if gemma and layer_sliding is None:
+        # original-release hub configs serialize no layer_types; derive
+        # the pattern the way transformers does — gemma3:
+        # sliding_window_pattern (every Nth layer is global), gemma2:
+        # alternating starting sliding at layer 0
+        L = cfg["num_hidden_layers"]
+        pat = (
+            int(cfg.get("sliding_window_pattern") or 6)
+            if "Gemma3" in arch
+            else 2
+        )
+        layer_sliding = tuple(bool((i + 1) % pat) for i in range(L))
     return ModelConfig(
         name=name,
         vocab_size=cfg["vocab_size"],
@@ -144,11 +182,27 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=cfg.get("tie_word_embeddings", False),
         qkv_bias="Qwen2" in arch and not cfg.get("no_bias", False),
-        # Qwen3 (dense + MoE) replaces attention bias with per-head
-        # q/k RMSNorm (Qwen3ForCausalLM / Qwen3MoeForCausalLM)
-        qk_norm="Qwen3" in arch,
+        # Qwen3 (dense + MoE) and Gemma3 replace attention bias with
+        # per-head q/k RMSNorm
+        qk_norm="Qwen3" in arch or "Gemma3" in arch,
         max_position_embeddings=cfg.get("max_position_embeddings", 8192),
         sliding_window=cfg.get("sliding_window") or 0,
+        hidden_act=(
+            "gelu_tanh"
+            if cfg.get("hidden_activation") == "gelu_pytorch_tanh"
+            or cfg.get("hidden_act") == "gelu_pytorch_tanh"
+            else "silu"
+        ),
+        norm_delta_gain=gemma,
+        embed_scale=gemma,
+        post_norms=gemma,
+        query_pre_attn_scalar=(
+            float(cfg.get("query_pre_attn_scalar") or 0) if gemma else 0.0
+        ),
+        attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0),
+        final_logit_softcap=float(cfg.get("final_logit_softcapping") or 0),
+        layer_sliding=layer_sliding,
+        rope_local_theta=float(cfg.get("rope_local_base_freq") or 0),
         num_experts=num_experts,
         num_experts_per_tok=cfg.get("num_experts_per_tok", 0),
         moe_intermediate_size=(
@@ -241,6 +295,29 @@ PRESETS: Dict[str, ModelConfig] = {
         moe_intermediate_size=768,
         norm_topk_prob=True,
         max_position_embeddings=40960,
+    ),
+    "gemma2-9b": ModelConfig(
+        name="gemma2-9b",
+        vocab_size=256000,
+        hidden_size=3584,
+        intermediate_size=14336,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        norm_delta_gain=True,
+        embed_scale=True,
+        post_norms=True,
+        query_pre_attn_scalar=256.0,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        sliding_window=4096,
+        layer_sliding=tuple(i % 2 == 0 for i in range(42)),
+        max_position_embeddings=8192,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

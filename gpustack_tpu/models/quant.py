@@ -154,9 +154,10 @@ def init_quantized_params(cfg, seed: int = 0):
     ones = lambda *shape: jnp.ones(shape, jnp.bfloat16)  # noqa: E731
     zeros = lambda *shape: jnp.zeros(shape, jnp.bfloat16)  # noqa: E731
 
+    gain = zeros if cfg.norm_delta_gain else ones  # gemma: delta gains
     layers = {
-        "attn_norm": ones(L, d),
-        "mlp_norm": ones(L, d),
+        "attn_norm": gain(L, d),
+        "mlp_norm": gain(L, d),
         "wq": qw((L, d, cfg.q_dim), d, "wq"),
         "wk": qw((L, d, cfg.kv_dim), d, "wk"),
         "wv": qw((L, d, cfg.kv_dim), d, "wv"),
@@ -167,8 +168,13 @@ def init_quantized_params(cfg, seed: int = 0):
         layers["bk"] = zeros(L, cfg.kv_dim)
         layers["bv"] = zeros(L, cfg.kv_dim)
     if cfg.qk_norm:
-        layers["q_norm"] = ones(L, cfg.head_dim)
-        layers["k_norm"] = ones(L, cfg.head_dim)
+        norm_init = zeros if cfg.norm_delta_gain else ones
+        layers["q_norm"] = norm_init(L, cfg.head_dim)
+        layers["k_norm"] = norm_init(L, cfg.head_dim)
+    if cfg.post_norms:
+        norm_init = zeros if cfg.norm_delta_gain else ones
+        layers["post_attn_norm"] = norm_init(L, d)
+        layers["post_mlp_norm"] = norm_init(L, d)
     if cfg.is_moe:
         fm, E = cfg.moe_intermediate_size, cfg.num_experts
         layers["router"] = (
@@ -187,7 +193,7 @@ def init_quantized_params(cfg, seed: int = 0):
 
     params = {
         "layers": layers,
-        "final_norm": ones(d),
+        "final_norm": gain(d),
     }
     if cfg.tie_word_embeddings:
         # Tied models contract embed.T at the LM head (transformer.forward

@@ -125,9 +125,18 @@ def init_params(
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
         layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.norm_delta_gain:
+        # gemma stores norm gains as deltas: zero == identity gain
+        for name in ("attn_norm", "mlp_norm"):
+            layers[name] = jnp.zeros((L, d), dtype)
     if cfg.qk_norm:
-        layers["q_norm"] = jnp.ones((L, cfg.head_dim), dtype)
-        layers["k_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+        init = jnp.zeros if cfg.norm_delta_gain else jnp.ones
+        layers["q_norm"] = init((L, cfg.head_dim), dtype)
+        layers["k_norm"] = init((L, cfg.head_dim), dtype)
+    if cfg.post_norms:
+        init = jnp.zeros if cfg.norm_delta_gain else jnp.ones
+        layers["post_attn_norm"] = init((L, d), dtype)
+        layers["post_mlp_norm"] = init((L, d), dtype)
     if cfg.is_moe:
         fm, E = cfg.moe_intermediate_size, cfg.num_experts
         layers["router"] = w(next(keys), L, d, E)
@@ -142,7 +151,9 @@ def init_params(
     params: Params = {
         "embed": w(next(keys), cfg.vocab_size, d, scale=0.02),
         "layers": layers,
-        "final_norm": jnp.ones((d,), dtype),
+        "final_norm": (
+            jnp.zeros if cfg.norm_delta_gain else jnp.ones
+        )((d,), dtype),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), d, cfg.vocab_size)
@@ -154,18 +165,29 @@ def init_params(
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, w: jax.Array, eps: float, delta_gain: bool = False
+) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
+    n = xf * lax.rsqrt(var + eps)
+    if delta_gain:
+        # gemma convention: stored weight is a delta on a unit gain,
+        # multiplied in fp32 before the downcast
+        return (n * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+    return n.astype(x.dtype) * w
+
+
+def _inv_freq(theta: float, head_dim: int) -> jax.Array:
+    half = head_dim // 2
+    return 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
 
 
 def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
     """Inverse RoPE frequencies with HF-compatible llama3/linear scaling."""
-    half = cfg.head_dim // 2
-    inv = 1.0 / (
-        cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
-    )
+    inv = _inv_freq(cfg.rope_theta, cfg.head_dim)
     rs = cfg.rope_scaling or {}
     rope_type = rs.get("rope_type") or rs.get("type")
     if rope_type == "linear":
@@ -212,9 +234,13 @@ def _attend(
     v: jax.Array,      # [B, S, Hkv, hd]
     mask: jax.Array,   # [B, T, S] bool (True = attend)
     scale: float,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Grouped-query attention; fp32 softmax; returns [B, T, Hkv*G*hd]."""
     scores = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32) * scale
+    if softcap:
+        # gemma2 attention-logit softcapping, applied before the mask
+        scores = softcap * jnp.tanh(scores / softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhgts,bshd->bthgd", weights, v)
@@ -312,8 +338,20 @@ def forward(
     B, T = tokens.shape
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     x = _embed_lookup(params["embed"], tokens, dtype)
+    if cfg.embed_scale:
+        # gemma: embeddings scaled by sqrt(d); HF casts the normalizer
+        # to the compute dtype before multiplying
+        x = x * jnp.asarray(math.sqrt(cfg.hidden_size)).astype(dtype)
     sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    if cfg.rope_local_theta:
+        # gemma3: sliding layers rotate with a separate, unscaled theta
+        sin_loc, cos_loc = rope_sin_cos(
+            positions, _inv_freq(cfg.rope_local_theta, cfg.head_dim)
+        )
+    else:
+        sin_loc, cos_loc = sin, cos
+    scale = 1.0 / math.sqrt(cfg.query_pre_attn_scalar or cfg.head_dim)
+    hetero = cfg.layer_sliding is not None
 
     use_flash = (
         attn_impl in ("flash", "flash_interpret")
@@ -321,33 +359,58 @@ def forward(
         and T > 1
         and cache.max_len >= T
         and not cfg.sliding_window
+        and not cfg.attn_logit_softcap
     )
     use_ring = attn_impl == "ring" and cache is not None
-    if use_ring and (mesh is None or cfg.sliding_window):
+    if use_ring and (
+        mesh is None or cfg.sliding_window or cfg.attn_logit_softcap
+    ):
         raise ValueError(
-            "attn_impl='ring' needs a mesh and no sliding window"
+            "attn_impl='ring' needs a mesh, no sliding window and no "
+            "attention softcapping"
         )
 
+    # mask[b, t, s] — query t attends key s
     if cache is None:
-        # mask[b, t, s] — query t attends key s (both in-window positions)
-        mask = positions[:, :, None] >= positions[:, None, :]
-        if cfg.sliding_window:
-            mask &= (
-                positions[:, :, None] - positions[:, None, :]
-            ) < cfg.sliding_window
-        key_sin, key_cos = sin, cos
+        causal = positions[:, :, None] >= positions[:, None, :]
+        delta = positions[:, :, None] - positions[:, None, :]
     else:
         S = cache.max_len
         cache_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = cache_pos[None, None, :] <= positions[:, :, None]
-        if cfg.sliding_window:
-            mask &= (
-                positions[:, :, None] - cache_pos[None, None, :]
-            ) < cfg.sliding_window
+        causal = cache_pos[None, None, :] <= positions[:, :, None]
+        delta = positions[:, :, None] - cache_pos[None, None, :]
+    if hetero:
+        # gemma-style alternating layers: both masks exist, each layer
+        # picks one inside the scan by its slide flag
+        mask_full = causal
+        mask_slide = causal & (delta < cfg.sliding_window)
+        mask = None
+    elif cfg.sliding_window:
+        mask = causal & (delta < cfg.sliding_window)
+    else:
+        mask = causal
+    slide_flags = (
+        jnp.asarray(cfg.layer_sliding, jnp.bool_)
+        if hetero
+        else jnp.zeros((cfg.num_layers,), jnp.bool_)
+    )
+    act = (
+        jax.nn.silu
+        if cfg.hidden_act == "silu"
+        else lambda z: jax.nn.gelu(z, approximate=True)
+    )
 
     def block(x_in: jax.Array, scanned):
-        lp, k_cache_l, v_cache_l = scanned
-        h = rms_norm(x_in, lp["attn_norm"], cfg.rms_norm_eps)
+        lp, k_cache_l, v_cache_l, slide_flag = scanned
+        if hetero:
+            mask_l = jnp.where(slide_flag, mask_slide, mask_full)
+            sin_b = jnp.where(slide_flag, sin_loc, sin)
+            cos_b = jnp.where(slide_flag, cos_loc, cos)
+        else:
+            mask_l, sin_b, cos_b = mask, sin, cos
+        h = rms_norm(
+            x_in, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+        )
         q = _mm("btd,dq->btq", h, lp["wq"])
         k = _mm("btd,dk->btk", h, lp["wk"])
         v = _mm("btd,dk->btk", h, lp["wv"])
@@ -357,16 +420,22 @@ def forward(
         k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         if cfg.qk_norm:
-            # Qwen3: per-head RMSNorm on q/k BEFORE RoPE (HF convention)
-            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-        q = apply_rope(q, sin, cos).reshape(
+            # Qwen3/Gemma3: per-head RMSNorm on q/k BEFORE RoPE
+            q = rms_norm(
+                q, lp["q_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+            )
+            k = rms_norm(
+                k, lp["k_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+            )
+        q = apply_rope(q, sin_b, cos_b).reshape(
             B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim
         )
-        k = apply_rope(k, sin, cos)
+        k = apply_rope(k, sin_b, cos_b)
 
         if cache is None:
-            attn = _attend(q, k, v, mask, scale)
+            attn = _attend(
+                q, k, v, mask_l, scale, cfg.attn_logit_softcap
+            )
             new_k, new_v = k_cache_l, v_cache_l
         else:
             # Write this step's K/V into the cache at each row's start
@@ -415,11 +484,22 @@ def forward(
                     q_offset=positions[0, 0],
                 )
             else:
-                attn = _attend(q, new_k, new_v, mask, scale)
+                attn = _attend(
+                    q, new_k, new_v, mask_l, scale,
+                    cfg.attn_logit_softcap,
+                )
 
-        x_mid = x_in + _mm("btq,qd->btd", attn, lp["wo"])
+        attn_out = _mm("btq,qd->btd", attn, lp["wo"])
+        if cfg.post_norms:
+            attn_out = rms_norm(
+                attn_out, lp["post_attn_norm"], cfg.rms_norm_eps,
+                cfg.norm_delta_gain,
+            )
+        x_mid = x_in + attn_out
 
-        h2 = rms_norm(x_mid, lp["mlp_norm"], cfg.rms_norm_eps)
+        h2 = rms_norm(
+            x_mid, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+        )
         if cfg.is_moe:
             mlp = _moe_mlp(
                 h2, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"],
@@ -428,21 +508,30 @@ def forward(
         else:
             g = _mm("btd,df->btf", h2, lp["w_gate"])
             u = _mm("btd,df->btf", h2, lp["w_up"])
-            mlp = _mm("btf,fd->btd", jax.nn.silu(g) * u, lp["w_down"])
+            mlp = _mm("btf,fd->btd", act(g) * u, lp["w_down"])
+        if cfg.post_norms:
+            mlp = rms_norm(
+                mlp, lp["post_mlp_norm"], cfg.rms_norm_eps,
+                cfg.norm_delta_gain,
+            )
         return x_mid + mlp, (new_k, new_v)
 
     if cache is None:
         L = cfg.num_layers
         dummy = jnp.zeros((L, B, 0, cfg.num_kv_heads, cfg.head_dim), dtype)
-        x, _ = lax.scan(block, x, (params["layers"], dummy, dummy))
+        x, _ = lax.scan(
+            block, x, (params["layers"], dummy, dummy, slide_flags)
+        )
         new_cache = None
     else:
         x, (k_new, v_new) = lax.scan(
-            block, x, (params["layers"], cache.k, cache.v)
+            block, x, (params["layers"], cache.k, cache.v, slide_flags)
         )
         new_cache = KVCache(k=k_new, v=v_new)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(
+        x, params["final_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+    )
     if return_hidden:
         # embeddings path: final normalized hidden states, no LM head
         return x.astype(jnp.float32), new_cache
@@ -450,4 +539,8 @@ def forward(
         logits = jnp.einsum("btd,vd->btv", x, params["embed"])
     else:
         logits = _mm("btd,dv->btv", x, params["lm_head"])
-    return logits.astype(jnp.float32), new_cache
+    logits = logits.astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits, new_cache

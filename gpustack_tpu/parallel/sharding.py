@@ -37,9 +37,12 @@ def _layer_rules(train: bool) -> Dict[str, P]:
         "bq": P(None, AXIS_TP),
         "bk": P(None, AXIS_TP),
         "bv": P(None, AXIS_TP),
-        # per-head-dim q/k norms (Qwen3) are tiny: replicate
+        # per-head-dim q/k norms (Qwen3/Gemma3) are tiny: replicate
         "q_norm": P(None, None),
         "k_norm": P(None, None),
+        # gemma sandwich norms: replicated like the other norm gains
+        "post_attn_norm": P(None, None),
+        "post_mlp_norm": P(None, None),
         "w_gate": P(None, fsdp, AXIS_TP),
         "w_up": P(None, fsdp, AXIS_TP),
         "w_down": P(None, AXIS_TP, fsdp),
