@@ -197,8 +197,9 @@ class ModelRunner:
             return "flash_interpret"
         if knob == "0":
             return "xla"
-        on_tpu = jax.default_backend() == "tpu"
-        return "flash" if (on_tpu and bucket >= 1024) else "xla"
+        from gpustack_tpu.utils.platform import is_tpu_backend
+
+        return "flash" if (is_tpu_backend() and bucket >= 1024) else "xla"
 
     def _prefill_impl(self, params, tokens, true_len, *, attn_impl="xla"):
         """tokens [1, Tb]; returns (last_logits [V], k, v [L, Tb, H, hd])."""

@@ -148,15 +148,21 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
     )
     # Gemma2/Gemma3 text: (1+w) norms, scaled embeddings, sandwich
     # norms, gelu-tanh MLP, softcapping (gemma2), alternating
-    # sliding/full layers, dual rope thetas (gemma3)
-    gemma = "Gemma2" in arch or "Gemma3" in arch
+    # sliding/full layers, dual rope thetas (gemma3).  Gemma1
+    # ("GemmaForCausalLM") shares the (1+w)-norm and sqrt(d)
+    # embed-scale conventions but has no post-norms / softcap /
+    # sliding layers — it must still take the gemma norm path or it
+    # serves silently-wrong logits.
+    gemma2plus = "Gemma2" in arch or "Gemma3" in arch
+    gemma1 = arch == "GemmaForCausalLM"
+    gemma = gemma2plus or gemma1
     layer_types = cfg.get("layer_types")
     layer_sliding = (
         tuple(t == "sliding_attention" for t in layer_types)
-        if gemma and layer_types
+        if gemma2plus and layer_types
         else None
     )
-    if gemma and layer_sliding is None:
+    if gemma2plus and layer_sliding is None:
         # original-release hub configs serialize no layer_types; derive
         # the pattern the way transformers does — gemma3:
         # sliding_window_pattern (every Nth layer is global), gemma2:
@@ -191,13 +197,16 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
             "gelu_tanh"
             if cfg.get("hidden_activation") == "gelu_pytorch_tanh"
             or cfg.get("hidden_act") == "gelu_pytorch_tanh"
+            # original gemma1 hub configs say "gelu" but the released
+            # weights were trained with the tanh approximation
+            or (gemma and cfg.get("hidden_act") in (None, "gelu"))
             else "silu"
         ),
         norm_delta_gain=gemma,
         embed_scale=gemma,
-        post_norms=gemma,
+        post_norms=gemma2plus,
         query_pre_attn_scalar=(
-            float(cfg.get("query_pre_attn_scalar") or 0) if gemma else 0.0
+            float(cfg.get("query_pre_attn_scalar") or 0) if gemma2plus else 0.0
         ),
         attn_logit_softcap=float(cfg.get("attn_logit_softcapping") or 0),
         final_logit_softcap=float(cfg.get("final_logit_softcapping") or 0),

@@ -209,6 +209,92 @@ def init_quantized_params(cfg, seed: int = 0):
     return params
 
 
+def init_quantized_params_on_device(cfg, seed: int = 0):
+    """Same tree as :func:`init_quantized_params`, generated on-accelerator.
+
+    Under a remote / tunneled TPU (or any bandwidth-constrained
+    host↔device link) materializing ~8 GB of int8 weights host-side and
+    shipping them through the link dominates bench startup by minutes;
+    one jitted PRNG program generates them in HBM directly. The tree and
+    statistics match the host variant (absmax-quantized normal init).
+    """
+    import math
+
+    d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+
+    def qw(key, shape, fan_in, name):
+        # int32 draw then narrow: jax.random.randint's int8 path is not
+        # supported on all backends; XLA fuses the convert.
+        q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int32).astype(
+            jnp.int8
+        )
+        axes = _CONTRACT_AXES[name]
+        if name in _STACKED:
+            axes = tuple(a + 1 for a in axes)
+        s_shape = tuple(n for i, n in enumerate(shape) if i not in axes)
+        s = jnp.full(
+            s_shape, 3.0 / math.sqrt(fan_in) / 127.0, jnp.bfloat16
+        )
+        return QuantW(q=q, s=s)
+
+    def build(key):
+        ones = lambda *shape: jnp.ones(shape, jnp.bfloat16)  # noqa: E731
+        zeros = lambda *shape: jnp.zeros(shape, jnp.bfloat16)  # noqa: E731
+        keys = iter(jax.random.split(key, 16))
+        gain = zeros if cfg.norm_delta_gain else ones
+        layers = {
+            "attn_norm": gain(L, d),
+            "mlp_norm": gain(L, d),
+            "wq": qw(next(keys), (L, d, cfg.q_dim), d, "wq"),
+            "wk": qw(next(keys), (L, d, cfg.kv_dim), d, "wk"),
+            "wv": qw(next(keys), (L, d, cfg.kv_dim), d, "wv"),
+            "wo": qw(next(keys), (L, cfg.q_dim, d), cfg.q_dim, "wo"),
+        }
+        if cfg.qkv_bias:
+            layers["bq"] = zeros(L, cfg.q_dim)
+            layers["bk"] = zeros(L, cfg.kv_dim)
+            layers["bv"] = zeros(L, cfg.kv_dim)
+        if cfg.qk_norm:
+            norm_init = zeros if cfg.norm_delta_gain else ones
+            layers["q_norm"] = norm_init(L, cfg.head_dim)
+            layers["k_norm"] = norm_init(L, cfg.head_dim)
+        if cfg.post_norms:
+            norm_init = zeros if cfg.norm_delta_gain else ones
+            layers["post_attn_norm"] = norm_init(L, d)
+            layers["post_mlp_norm"] = norm_init(L, d)
+        if cfg.is_moe:
+            fm, E = cfg.moe_intermediate_size, cfg.num_experts
+            layers["router"] = (
+                jax.random.normal(next(keys), (L, d, E), jnp.float32)
+                / math.sqrt(d)
+            ).astype(jnp.bfloat16)
+            layers["we_gate"] = qw(next(keys), (L, E, d, fm), d, "we_gate")
+            layers["we_up"] = qw(next(keys), (L, E, d, fm), d, "we_up")
+            layers["we_down"] = qw(next(keys), (L, E, fm, d), fm, "we_down")
+        else:
+            layers["w_gate"] = qw(next(keys), (L, d, f), d, "w_gate")
+            layers["w_up"] = qw(next(keys), (L, d, f), d, "w_up")
+            layers["w_down"] = qw(next(keys), (L, f, d), f, "w_down")
+        params = {"layers": layers, "final_norm": gain(d)}
+        if cfg.tie_word_embeddings:
+            params["embed"] = (
+                jax.random.normal(
+                    next(keys), (cfg.vocab_size, d), jnp.float32
+                )
+                * 0.02
+            ).astype(jnp.bfloat16)
+        else:
+            params["embed"] = qw(
+                next(keys), (cfg.vocab_size, d), 2500, "embed"
+            )
+            params["lm_head"] = qw(
+                next(keys), (d, cfg.vocab_size), d, "lm_head"
+            )
+        return params
+
+    return jax.jit(build)(jax.random.key(seed))
+
+
 def dequantize(name: str, w, stacked: Optional[bool] = None) -> jax.Array:
     """Reference dequantization (tests / debugging). ``name`` identifies the
     weight's contraction layout; ``stacked`` overrides the [L]-axis default

@@ -294,12 +294,24 @@ def add_openai_routes(app: web.Application) -> None:
         if err is not None:
             return err
         stream = bool(body.get("stream"))
+        suppress_usage_chunk = False
         if isinstance(target, ProviderTarget):
             # external-provider hop: server-side dial with the provider's
             # credential; usage is metered against the provider
             model_id, provider_id = 0, target.provider.id
             outbody = dict(body)
             outbody["model"] = target.upstream_model
+            if stream and operation in ("chat/completions", "completions"):
+                # most OpenAI-compatible providers only emit a usage
+                # block in SSE when stream_options.include_usage is set;
+                # without it provider-metered streaming traffic records
+                # zero usage.  Inject it, and strip the trailing
+                # usage-only chunk unless the client asked for it.
+                so = dict(outbody.get("stream_options") or {})
+                if not so.get("include_usage"):
+                    so["include_usage"] = True
+                    outbody["stream_options"] = so
+                    suppress_usage_chunk = True
             try:
                 upstream = await _provider_fetch(
                     app, target.provider, operation, outbody
@@ -366,20 +378,35 @@ def add_openai_routes(app: web.Application) -> None:
         await resp.prepare(request)
         usage_tokens: List[int] = [0, 0]
         buffer = b""
+        skip_blank = False  # swallow the blank line after a dropped event
         try:
             async for chunk in upstream.content.iter_any():
-                await resp.write(chunk)
                 buffer += chunk
                 while b"\n" in buffer:
                     line, buffer = buffer.split(b"\n", 1)
-                    if line.startswith(b"data: ") and line != b"data: [DONE]":
+                    forward = True
+                    if skip_blank and not line.strip():
+                        skip_blank = False
+                        forward = False
+                    elif line.startswith(b"data: ") and line != b"data: [DONE]":
                         try:
                             payload = json.loads(line[6:])
                             pt, ct = _extract_usage(payload)
                             if pt or ct:
                                 usage_tokens = [pt, ct]
+                                if suppress_usage_chunk and not payload.get(
+                                    "choices"
+                                ):
+                                    # usage-only chunk we solicited; the
+                                    # client never asked for it
+                                    forward = False
+                                    skip_blank = True
                         except json.JSONDecodeError:
                             pass
+                    if forward:
+                        await resp.write(line + b"\n")
+            if buffer:
+                await resp.write(buffer)
         except (ConnectionResetError, aiohttp.ClientError):
             logger.info("client or upstream dropped during stream relay")
         finally:

@@ -212,8 +212,14 @@ def evaluate_model(model: Model) -> ModelEvaluation:
                 narrow = disk_bytes - wide
                 disk_bytes = narrow + wide // 2 + wide // 256
             weight_bytes = disk_bytes
+    # KV buffers follow the model's compute dtype: KVCache.create
+    # allocates bf16 only for dtype == "bfloat16" and fp32 for anything
+    # else, so mirror that exact rule or fp32 deployments undercount 2x
+    kv_bits = 16 if getattr(cfg, "dtype", "bfloat16") == "bfloat16" else 32
     kv_bytes = (
-        cfg.kv_cache_bytes_per_token(16) * model.max_seq_len * model.max_slots
+        cfg.kv_cache_bytes_per_token(kv_bits)
+        * model.max_seq_len
+        * model.max_slots
     )
     # activation + runtime overhead: prefill attention scratch dominates;
     # scale with seq len, floor at 256 MiB (audio configs use d_model)

@@ -36,6 +36,21 @@ _CAUSAL_MODEL_EXCEPTIONS = {
     "AquilaModel",
 }
 
+# Decoder families whose headless "*Model" exports (LlamaModel,
+# Qwen2Model, ...) are conventionally embedding checkpoints (gte-Qwen2,
+# e5-mistral).  The heuristic is restricted to these stems so an
+# unrecognized "<New>Model" arch falls through to [] instead of being
+# silently steered to the embedding backend.
+_HEADLESS_EMBED_FAMILIES = (
+    "Llama",
+    "Qwen",
+    "Mistral",
+    "Gemma",
+    "Phi",
+    "InternLM",
+    "Starcoder",
+)
+
 _TTS_MARKERS = ("TextToSpeech", "Tts", "TTS", "Vits", "Bark", "CosyVoice")
 
 _IMAGE_MARKERS = (
@@ -87,8 +102,13 @@ def classify_architectures(
         if any(f in a for f in _ENCODER_FAMILIES):
             return ["embedding"]
         # decoder-as-encoder exports: Qwen2Model, LlamaModel, MistralModel
-        # — the headless variant of a causal family is an embedder
-        if a.endswith("Model") and a not in _CAUSAL_MODEL_EXCEPTIONS:
+        # — the headless variant of a known causal family is an embedder;
+        # unknown "*Model" names fall through (caller keeps user category)
+        if (
+            a.endswith("Model")
+            and a not in _CAUSAL_MODEL_EXCEPTIONS
+            and any(f in a for f in _HEADLESS_EMBED_FAMILIES)
+        ):
             return ["embedding"]
     for a in archs:
         if a in _CAUSAL_MODEL_EXCEPTIONS or a.endswith(

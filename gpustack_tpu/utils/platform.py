@@ -1,0 +1,41 @@
+"""Accelerator platform detection.
+
+JAX can expose a TPU under a platform name other than ``"tpu"`` — a
+remote/tunneled PJRT plugin registers its own name while aliasing MLIR
+lowering to the TPU rules, so ``jax.default_backend()`` returns the
+plugin's name even though Pallas-TPU kernels, bf16 MXU matmuls, and TPU
+memory behavior all apply. Kernel selection must treat those platforms
+as TPU or the flash path silently degrades to the XLA fallback.
+
+The reference keys the analogous decision off its per-vendor backend
+classes (gpustack/worker/backends/*); here one predicate serves every
+call site.
+"""
+
+from __future__ import annotations
+
+# Platform names that compile through the TPU lowering path.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend executes on a TPU (directly or
+    via a proxying PJRT plugin). Initializes the backend on first call."""
+    import jax
+
+    try:
+        if jax.default_backend() in _TPU_PLATFORMS:
+            return True
+        return any(d.platform in _TPU_PLATFORMS for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def tpu_chip_count() -> int:
+    """Number of visible TPU chips (0 when running on CPU)."""
+    import jax
+
+    try:
+        return sum(1 for d in jax.devices() if d.platform in _TPU_PLATFORMS)
+    except RuntimeError:
+        return 0

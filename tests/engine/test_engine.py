@@ -239,6 +239,28 @@ def test_init_quantized_params_matches_structure():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_init_quantized_params_on_device_matches_structure():
+    """The on-device (jitted PRNG) init used by bench.py on tunneled
+    TPUs must produce the exact tree/shape/dtype layout of the host
+    init, and a forward pass over it must be finite."""
+    from gpustack_tpu.models.quant import (
+        init_quantized_params,
+        init_quantized_params_on_device,
+    )
+
+    for preset in ("tiny", "tiny-moe"):
+        cfg = get_config(preset)
+        host = init_quantized_params(cfg, seed=0)
+        dev = init_quantized_params_on_device(cfg, seed=0)
+        host_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), host)
+        dev_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), dev)
+        assert host_shapes == dev_shapes, preset
+        toks = jnp.asarray([[1, 2, 3]], jnp.int32)
+        pos = jnp.arange(3, dtype=jnp.int32)[None, :]
+        logits, _ = forward(dev, cfg, toks, pos)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_quantized_engine_generates():
     cfg = get_config("tiny")
     params = quantize_params(init_params(cfg, jax.random.key(0)))

@@ -104,6 +104,45 @@ def gemma3_checkpoint(tmp_path_factory):
     return model, str(d)
 
 
+@pytest.fixture(scope="module")
+def gemma1_checkpoint(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    torch.manual_seed(0)
+    hf_cfg = tfm.GemmaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        attention_dropout=0.0,
+        hidden_act="gelu",   # original hub configs; weights use tanh gelu
+    )
+    model = tfm.GemmaForCausalLM(hf_cfg).eval()
+    d = tmp_path_factory.mktemp("gemma1")
+    model.save_pretrained(d, safe_serialization=True)
+    return model, str(d)
+
+
+def test_gemma1_logits_match_transformers(gemma1_checkpoint):
+    """Gemma1 (GemmaForCausalLM) takes the (1+w)-norm + sqrt(d)
+    embed-scale path WITHOUT gemma2's post-norms/softcaps — silently
+    loading it llama-style produces wrong logits (round-2 advisor)."""
+    model, model_dir = gemma1_checkpoint
+    cfg, params = _load_ours(model_dir)
+    assert cfg.norm_delta_gain and cfg.embed_scale
+    assert not cfg.post_norms
+    assert cfg.attn_logit_softcap == 0.0
+    assert cfg.hidden_act == "gelu_tanh"
+    assert cfg.layer_sliding is None
+    _compare(model, cfg, params)
+
+
 def test_gemma2_logits_match_transformers(gemma2_checkpoint):
     model, model_dir = gemma2_checkpoint
     cfg, params = _load_ours(model_dir)
