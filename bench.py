@@ -17,6 +17,11 @@ Env knobs:
   BENCH_MODEL=<preset>                           (default llama3-8b)
   BENCH_SMOKE=1      force the tiny CPU smoke
   BENCH_ATTEMPTS=N   TPU probe attempts (default 3)
+  BENCH_KILL_HOLDERS=0  never SIGKILL other plugin-holding processes.
+      Default is on because this bench runs headless in a dedicated
+      container where any other plugin-mapped process is a wedged
+      leftover of an earlier run; set 0 on any host with live serving
+      engines you care about.
 
 TPU acquisition is *diagnosed*, never silently degraded: the probe runs
 in throwaway subprocesses with captured stderr, checks whether the
@@ -186,6 +191,10 @@ PROFILES = {
     "latency": dict(
         prompt_len=2000, output_len=128, num_requests=8,
         max_slots=1, max_seq_len=2304, prefill_chunk=0,
+        # closed loop: one request in flight at a time, so ttft_ms is
+        # actual time-to-first-token, not queue wait behind other
+        # requests sharing the slot
+        closed_loop=True,
     ),
 }
 
@@ -220,8 +229,14 @@ def main() -> None:
         # Keep the TPU platform primary but expose host CPU for staging
         # (token id buffers, sampling state) — must happen before the
         # first in-process backend init.
-        if os.environ.get("JAX_PLATFORMS") == "axon":
-            os.environ["JAX_PLATFORMS"] = "axon,cpu"
+        from gpustack_tpu.utils.platform import TPU_PLATFORMS
+
+        plats = os.environ.get("JAX_PLATFORMS", "")
+        names = [p for p in plats.split(",") if p]
+        if names and "cpu" not in names and all(
+            p in TPU_PLATFORMS for p in names
+        ):
+            os.environ["JAX_PLATFORMS"] = plats + ",cpu"
     else:
         import jax
 
@@ -282,11 +297,21 @@ def main() -> None:
 
     reqs = [make_req() for _ in range(prof["num_requests"])]
     t0 = time.time()
-    for r in reqs:
-        engine.submit(r)
-    for r in reqs:
-        if not r.done.wait(7200):
-            raise TimeoutError(f"bench request {r.request_id} unfinished")
+    if prof.get("closed_loop"):
+        for r in reqs:
+            engine.submit(r)
+            if not r.done.wait(7200):
+                raise TimeoutError(
+                    f"bench request {r.request_id} unfinished"
+                )
+    else:
+        for r in reqs:
+            engine.submit(r)
+        for r in reqs:
+            if not r.done.wait(7200):
+                raise TimeoutError(
+                    f"bench request {r.request_id} unfinished"
+                )
     wall = time.time() - t0
     engine.stop()
 

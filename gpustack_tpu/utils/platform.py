@@ -15,7 +15,8 @@ call site.
 from __future__ import annotations
 
 # Platform names that compile through the TPU lowering path.
-_TPU_PLATFORMS = ("tpu", "axon")
+TPU_PLATFORMS = ("tpu", "axon")
+_TPU_PLATFORMS = TPU_PLATFORMS  # back-compat alias
 
 
 def is_tpu_backend() -> bool:
