@@ -1,7 +1,16 @@
-.PHONY: test native bench smoke clean
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean
 
 test:
 	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m fast
+
+test-engine:
+	python -m pytest tests/ -q -m engine
+
+test-e2e:
+	python -m pytest tests/ -q -m e2e
 
 native:
 	$(MAKE) -C native

@@ -296,22 +296,20 @@ def main() -> None:
     engine.generate(make_req(), timeout=3600)
 
     reqs = [make_req() for _ in range(prof["num_requests"])]
+    closed_loop = bool(prof.get("closed_loop"))
+
+    def wait_done(r):
+        if not r.done.wait(7200):
+            raise TimeoutError(f"bench request {r.request_id} unfinished")
+
     t0 = time.time()
-    if prof.get("closed_loop"):
+    for r in reqs:
+        engine.submit(r)
+        if closed_loop:
+            wait_done(r)
+    if not closed_loop:
         for r in reqs:
-            engine.submit(r)
-            if not r.done.wait(7200):
-                raise TimeoutError(
-                    f"bench request {r.request_id} unfinished"
-                )
-    else:
-        for r in reqs:
-            engine.submit(r)
-        for r in reqs:
-            if not r.done.wait(7200):
-                raise TimeoutError(
-                    f"bench request {r.request_id} unfinished"
-                )
+            wait_done(r)
     wall = time.time() - t0
     engine.stop()
 

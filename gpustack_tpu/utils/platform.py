@@ -16,7 +16,6 @@ from __future__ import annotations
 
 # Platform names that compile through the TPU lowering path.
 TPU_PLATFORMS = ("tpu", "axon")
-_TPU_PLATFORMS = TPU_PLATFORMS  # back-compat alias
 
 
 def is_tpu_backend() -> bool:
@@ -25,9 +24,9 @@ def is_tpu_backend() -> bool:
     import jax
 
     try:
-        if jax.default_backend() in _TPU_PLATFORMS:
+        if jax.default_backend() in TPU_PLATFORMS:
             return True
-        return any(d.platform in _TPU_PLATFORMS for d in jax.devices())
+        return any(d.platform in TPU_PLATFORMS for d in jax.devices())
     except RuntimeError:
         return False
 
@@ -37,6 +36,6 @@ def tpu_chip_count() -> int:
     import jax
 
     try:
-        return sum(1 for d in jax.devices() if d.platform in _TPU_PLATFORMS)
+        return sum(1 for d in jax.devices() if d.platform in TPU_PLATFORMS)
     except RuntimeError:
         return 0

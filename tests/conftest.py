@@ -27,3 +27,33 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Test tiers (reference keeps pytest markers, pytest.ini:1-3; our split):
+# directory => marker, so `make test-fast` gives a <2min signal while the
+# full suite stays the merge gate.
+# ---------------------------------------------------------------------------
+_TIER_BY_DIR = {
+    "e2e": "e2e",
+    "engine": "engine",
+    "models": "engine",
+    "ops": "engine",
+    "parallel": "engine",
+    "benchmark": "engine",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    tests_root = os.path.dirname(os.path.abspath(__file__))
+    for item in items:
+        try:
+            rel = item.path.relative_to(tests_root)
+            sub = rel.parts[0] if len(rel.parts) > 1 else ""
+        except ValueError:
+            sub = ""
+        item.add_marker(
+            getattr(pytest.mark, _TIER_BY_DIR.get(sub, "fast"))
+        )
