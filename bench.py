@@ -73,18 +73,28 @@ def _relay_listening(timeout: float = 1.0):
 
 
 # Only processes whose cmdline matches one of these are ever killed as
-# "stale holders" — our own bench/test/watch entrypoints. A live serving
-# engine (gpustack_tpu start / api_server) never matches, so a busy chip
-# can fail the probe without the bench shooting the process legitimately
-# holding it.
-_OURS = ("bench.py", "tpu_watch", "profile_onchip", "microbench", "pytest",
+# "stale holders" — our own bench entrypoints. A live serving engine
+# (gpustack_tpu start / api_server) or a pytest run never matches, so a
+# busy chip can fail the probe without the bench shooting the process
+# legitimately holding it.
+_OURS = ("bench.py", "tpu_watch", "profile_onchip", "microbench",
          "run_benchmarks")
+# "stale" also means OLD: a holder younger than this is presumed to be a
+# live run that simply has the chip right now — back off, don't shoot.
+_STALE_AGE_S = 900.0
+
+
+def _proc_age_s(pid: str) -> float:
+    try:
+        return time.time() - os.stat(f"/proc/{pid}").st_mtime
+    except OSError:
+        return 0.0
 
 
 def _stale_chip_holders():
     """PIDs (not us) with the TPU PJRT plugin mapped whose cmdline looks
-    like one of our own bench/test entrypoints — an earlier probe or
-    watch run that wedged while holding the chip claim."""
+    like one of our own bench entrypoints AND that have been alive long
+    past a normal run — an earlier probe that wedged holding the claim."""
     holders = []
     me = os.getpid()
     for ent in os.listdir("/proc"):
@@ -97,6 +107,8 @@ def _stale_chip_holders():
             with open(f"/proc/{ent}/cmdline") as f:
                 cmd = f.read().replace("\0", " ").strip()[:160]
             if not any(tag in cmd for tag in _OURS):
+                continue
+            if _proc_age_s(ent) < _STALE_AGE_S:
                 continue
             holders.append({"pid": int(ent), "cmd": cmd})
         except OSError:
@@ -160,16 +172,28 @@ PERSIST_PATH = os.path.join(
 )
 
 
-def load_persisted_run():
-    """Best in-round TPU run persisted by hack/tpu_watch.py, or None."""
+# A persisted run older than this is from a previous round (rounds are
+# ~12h) and measured older code — never emit it as this round's artifact.
+_PERSIST_TTL_S = 14 * 3600.0
+
+
+def load_persisted_run(profile=None):
+    """Best in-round TPU run persisted by an earlier bench invocation
+    (e.g. via hack/tpu_watch.py), or None. Stale records (previous
+    round) and profile mismatches don't count."""
     try:
         with open(PERSIST_PATH) as f:
             rec = json.load(f)
-        if rec.get("detail", {}).get("platform") not in (None, "cpu"):
-            return rec
-    except (OSError, json.JSONDecodeError):
-        pass
-    return None
+        detail = rec.get("detail", {})
+        if detail.get("platform") in (None, "cpu"):
+            return None
+        if time.time() - float(detail.get("persisted_at", 0)) > _PERSIST_TTL_S:
+            return None
+        if profile is not None and detail.get("profile") != profile:
+            return None
+        return rec
+    except (OSError, json.JSONDecodeError, TypeError, ValueError):
+        return None
 
 
 def _wait_for_relay(diag):
@@ -177,7 +201,8 @@ def _wait_for_relay(diag):
     round on one instant TCP probe (a momentary relay outage at
     bench-time cost round 3 its perf artifact). Every poll is logged.
     Window shrinks when a persisted TPU run exists as a fallback."""
-    default_wait = 900.0 if load_persisted_run() is None else 120.0
+    profile = os.environ.get("BENCH_PROFILE", "throughput")
+    default_wait = 900.0 if load_persisted_run(profile) is None else 120.0
     wait_s = float(os.environ.get("BENCH_RELAY_WAIT_S", default_wait))
     polls = []
     t0 = time.time()
@@ -206,21 +231,26 @@ def acquire_tpu():
     if os.environ.get("BENCH_SMOKE") == "1":
         diag["skipped"] = "BENCH_SMOKE=1"
         return False, diag
-    relay_up = _wait_for_relay(diag)
+    relay_up = bool(_relay_listening())
     if not relay_up:
-        diag["relay_hint"] = (
-            "tunnel relay not listening on 127.0.0.1:8082/8083 within the "
-            "wait window — TPU almost certainly unreachable"
-        )
-        # Absent relay is a strong hint, not a hard gate (a
-        # directly-attached TPU has no relay): still run ONE short probe
-        # before declaring the TPU unreachable.
+        # Absent relay is a strong hint, not a hard gate: a
+        # directly-attached TPU has no relay at all, and waiting 15
+        # minutes for one that will never appear would be dead time on
+        # every such run. One short probe FIRST settles the
+        # direct-attach case; only then commit to the relay wait.
         ok, info = _probe_once(90.0)
-        diag["attempts"] = [info]
+        diag["pre_wait_probe"] = info
         if ok:
             diag["verdict"] = "tpu up (no relay — directly attached)"
             return True, diag
-        diag["verdict"] = "tpu unreachable (no relay; one probe failed)"
+        relay_up = _wait_for_relay(diag)
+    else:
+        diag["relay_ports_up"] = _relay_listening()
+    if not relay_up:
+        diag["verdict"] = (
+            "tpu unreachable (no relay within the wait window; "
+            "direct probe failed)"
+        )
         return False, diag
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     timeouts = [240.0] + [120.0] * max(0, attempts - 1)
@@ -307,7 +337,9 @@ def main() -> None:
                            "tpu_diag": diag},
             }))
             sys.exit(3)
-        persisted = load_persisted_run()
+        persisted = load_persisted_run(
+            os.environ.get("BENCH_PROFILE", "throughput")
+        )
         if persisted:
             # Live TPU unreachable right now, but the in-round watcher
             # captured a real TPU run earlier — that run IS the round's
@@ -457,8 +489,19 @@ def main() -> None:
     if on_tpu and profile_name == "throughput":
         # Persist a real TPU throughput run so a later bench invocation
         # (or the end-of-round driver run) can fall back to it if the
-        # relay is down at that moment. Keep the best number.
-        prev = load_persisted_run()
+        # relay is down at that moment. Keep the best number within the
+        # round; the TTL in load_persisted_run keeps a previous round's
+        # record (older code) from masking this round.
+        result["detail"]["persisted_at"] = time.time()
+        try:
+            result["detail"]["commit"] = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+        except (OSError, subprocess.SubprocessError):
+            pass
+        prev = load_persisted_run("throughput")
         if prev is None or float(prev.get("value", 0)) < value:
             tmp = PERSIST_PATH + ".tmp"
             with open(tmp, "w") as f:

@@ -30,6 +30,9 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--bootstrap-password", default=None)
     start.add_argument("--worker-name", default=None)
     start.add_argument("--worker-ip", default=None)
+    start.add_argument("--worker-port", type=int, default=None,
+                       help="worker HTTP port (0 = ephemeral; the worker "
+                       "registers whatever port it actually bound)")
     start.add_argument("--disable-worker", action="store_true", default=None)
     start.add_argument("--fake-detector", default=None)
     start.add_argument("--force-platform", default=None)

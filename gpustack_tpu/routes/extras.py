@@ -256,7 +256,10 @@ def add_extra_routes(app: web.Application) -> None:
             tpu_accelerator=request.query.get(
                 "accelerator", "tpu-v5-lite-podslice"
             ),
-            worker_port=cfg.worker_port,
+            # worker_port=0 means "ephemeral" for the LOCAL embedded
+            # worker; a k8s pod needs a concrete containerPort, so the
+            # manifest falls back to the fixed default.
+            worker_port=cfg.worker_port or 10151,
             tunnel=request.query.get("tunnel") in ("1", "true"),
         )
         return web.Response(

@@ -49,6 +49,13 @@ def create_app(cfg: Config) -> web.Application:
         coordinator = app.get("coordinator")
         if coordinator is not None:
             payload["leader"] = coordinator.is_leader
+        # A dead embedded worker means this node can't serve anything —
+        # surface it here instead of leaving the worker row silently
+        # not_ready (the round-3 failure mode).
+        worker_error = app.get("embedded_worker_error")
+        if worker_error:
+            payload["status"] = "degraded"
+            payload["embedded_worker_error"] = worker_error
         return web.json_response(payload)
 
     async def readyz(request):

@@ -181,11 +181,28 @@ class Server:
             worker_cfg = cfg.model_copy()
             worker_cfg.server_url = f"http://127.0.0.1:{cfg.port}"
             self.worker_agent = WorkerAgent(worker_cfg)
-            self._tasks.append(
-                asyncio.create_task(
-                    self.worker_agent.start(), name="embedded-worker"
-                )
+            worker_task = asyncio.create_task(
+                self.worker_agent.start(), name="embedded-worker"
             )
+
+            def _on_worker_done(t: asyncio.Task) -> None:
+                # An embedded worker that dies at startup (e.g. its HTTP
+                # port is already taken) must be LOUD: round-3 postmortem
+                # was an entire e2e tier red with zero diagnostics
+                # because this task swallowed its exception. Log it and
+                # flip /healthz to degraded so operators and tests see it.
+                if t.cancelled():
+                    return
+                exc = t.exception()
+                if exc is not None:
+                    logger.error(
+                        "embedded worker died during startup: %s", exc,
+                        exc_info=exc,
+                    )
+                    app["embedded_worker_error"] = repr(exc)
+
+            worker_task.add_done_callback(_on_worker_done)
+            self._tasks.append(worker_task)
 
     async def run_forever(self) -> None:
         await self.start()

@@ -132,12 +132,20 @@ class WorkerServer:
                 {"error": f"engine unreachable: {e}"}, status=502
             )
 
-    async def start(self, host: str, port: int) -> None:
+    async def start(self, host: str, port: int) -> int:
+        """Bind and return the actual port (``port=0`` binds ephemeral —
+        the caller registers whatever the kernel handed out, so two
+        workers on one host can never fight over a fixed port)."""
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()
-        logger.info("worker http listening on %s:%d", host, port)
+        bound = port
+        for sock in site._server.sockets:  # noqa: SLF001 (aiohttp has no API)
+            bound = sock.getsockname()[1]
+            break
+        logger.info("worker http listening on %s:%d", host, bound)
+        return bound
 
     async def stop(self) -> None:
         if self._proxy_session and not self._proxy_session.closed:

@@ -41,6 +41,7 @@ class WorkerAgent:
         self.worker_uuid = self._load_or_create_uuid()
         self.detector = create_detector(cfg.fake_detector or None)
         self.serve_manager: Optional[ServeManager] = None
+        self.bound_port = 0  # actual HTTP port once bound (worker_port=0 ⇒ ephemeral)
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
@@ -67,13 +68,33 @@ class WorkerAgent:
         return value
 
     async def start(self) -> None:
+        from gpustack_tpu.worker.server import WorkerServer
+
+        self.http = WorkerServer(self)
+        # Bind BEFORE registering: the worker HTTP server is the sole
+        # inference ingress (engines bind to loopback), so failing to
+        # bind is a total outage — die loudly here rather than register
+        # a worker the server can never dial. Binding first also lets
+        # worker_port=0 mean "ephemeral": registration below carries the
+        # port the kernel actually handed out. (Round 3 postmortem: a
+        # stale process holding the fixed port killed the embedded
+        # worker with zero diagnostics.)
+        try:
+            self.bound_port = await self.http.start(
+                "0.0.0.0", self.cfg.worker_port
+            )
+        except OSError as e:
+            raise RuntimeError(
+                f"worker HTTP server cannot bind port "
+                f"{self.cfg.worker_port}: {e} — another process holds it; "
+                f"set --worker-port 0 for an ephemeral port"
+            ) from e
         await self._register_with_retry()
         self.serve_manager = ServeManager(
             self.cfg, self.client, self.worker_id
         )
         self.serve_manager.reap_orphans()
         from gpustack_tpu.worker.benchmark_manager import BenchmarkManager
-        from gpustack_tpu.worker.server import WorkerServer
 
         self.benchmark_manager = BenchmarkManager(
             self.client, self.worker_id
@@ -84,11 +105,6 @@ class WorkerAgent:
             self.cfg, self.client, self.worker_id
         )
         self.dev_manager.reap_orphans()
-        self.http = WorkerServer(self)
-        # The worker HTTP server is the sole inference ingress (engines
-        # bind to loopback) — failing to bind is a total outage, not a
-        # degradation; die loudly so the supervisor restarts us.
-        await self.http.start("0.0.0.0", self.cfg.worker_port)
         # push one status immediately so the scheduler sees chips
         await self._post_status_once()
         # converge with the server's view (restart recovery: zombie
@@ -117,7 +133,7 @@ class WorkerAgent:
             self.tunnel_client = TunnelClient(
                 self.cfg.server_url,
                 self._worker_token,
-                self.cfg.worker_port,
+                self.bound_port or self.cfg.worker_port,
             )
             self._tasks.append(
                 asyncio.create_task(
@@ -158,7 +174,7 @@ class WorkerAgent:
                         "name": self.worker_name,
                         "worker_uuid": self.worker_uuid,
                         "ip": self.cfg.worker_ip or _default_ip(),
-                        "port": self.cfg.worker_port,
+                        "port": self.bound_port or self.cfg.worker_port,
                     }
                 )
                 break

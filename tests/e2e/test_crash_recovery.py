@@ -46,6 +46,7 @@ def _spawn_server(port, data_dir):
             "--bootstrap-password", "crash-pass",
             "--fake-detector", FIXTURE,
             "--force-platform", "cpu",
+            "--worker-port", "0",
         ],
         env=env,
         stdout=subprocess.DEVNULL,

@@ -42,6 +42,9 @@ def test_deploy_and_infer(tmp_path):
             "force_platform": "cpu",
             "heartbeat_interval": 1.0,
             "status_interval": 2.0,
+            # ephemeral: a stale process on the fixed default port must
+            # never be able to kill this tier again
+            "worker_port": 0,
         }
     )
 

@@ -1,0 +1,134 @@
+"""Worker startup failure visibility + ephemeral port registration.
+
+Round-3 postmortem: a stale process holding the fixed worker port
+(10151) killed the embedded worker silently — the task swallowed its
+exception, /healthz stayed green, and the whole e2e tier went red with
+zero diagnostics. These tests pin the two fixes: (a) a bind failure is
+LOUD (logged + /healthz degraded), (b) worker_port=0 binds an ephemeral
+port and registers the real one (reference surfaces worker startup
+errors via worker status; gpustack/worker/worker.py registration flow).
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import aiohttp
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fixtures", "workers", "v5e_8.json",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cfg(tmp_path, server_port, worker_port):
+    from gpustack_tpu.config import Config
+
+    return Config.load(
+        {
+            "host": "127.0.0.1",
+            "port": server_port,
+            "data_dir": str(tmp_path),
+            "registration_token": "wkport-token",
+            "bootstrap_password": "wkport-pass",
+            "fake_detector": FIXTURE,
+            "force_platform": "cpu",
+            "heartbeat_interval": 1.0,
+            "status_interval": 2.0,
+            "worker_port": worker_port,
+        }
+    )
+
+
+def test_occupied_worker_port_fails_loud(tmp_path):
+    from gpustack_tpu.server.server import Server
+
+    server_port = _free_port()
+    # hold a port open so the embedded worker's bind must fail
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("0.0.0.0", 0))
+    blocker.listen(1)
+    taken_port = blocker.getsockname()[1]
+
+    async def go():
+        server = Server(_cfg(tmp_path, server_port, taken_port))
+        await server.start()
+        try:
+            base = f"http://127.0.0.1:{server_port}"
+            deadline = time.time() + 30
+            async with aiohttp.ClientSession() as http:
+                while time.time() < deadline:
+                    async with http.get(f"{base}/healthz") as r:
+                        health = await r.json()
+                    if health["status"] == "degraded":
+                        break
+                    await asyncio.sleep(0.3)
+                else:
+                    raise AssertionError(
+                        f"healthz never flipped degraded: {health}"
+                    )
+            err = health["embedded_worker_error"]
+            assert "bind" in err and str(taken_port) in err, err
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(go())
+    finally:
+        blocker.close()
+
+
+def test_ephemeral_worker_port_registers_real_port(tmp_path):
+    from gpustack_tpu.server.server import Server
+
+    server_port = _free_port()
+
+    async def go():
+        server = Server(_cfg(tmp_path, server_port, 0))
+        await server.start()
+        try:
+            base = f"http://127.0.0.1:{server_port}"
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    f"{base}/auth/login",
+                    json={"username": "admin", "password": "wkport-pass"},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    token = (await r.json())["token"]
+                hdrs = {"Authorization": f"Bearer {token}"}
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/workers", headers=hdrs
+                    ) as r:
+                        items = (await r.json())["items"]
+                    if items and items[0]["state"] == "ready":
+                        break
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("worker never became ready")
+                worker = items[0]
+                # registration carried the kernel-assigned port, not 0
+                # and not the (unbound) fixed default
+                assert worker["port"] > 0
+                assert worker["port"] == server.worker_agent.bound_port
+                # the registered port is actually dialable
+                async with http.get(
+                    f"http://127.0.0.1:{worker['port']}/healthz"
+                ) as r:
+                    assert r.status == 200
+                # healthz stays green
+                async with http.get(f"{base}/healthz") as r:
+                    assert (await r.json())["status"] == "ok"
+        finally:
+            await server.stop()
+
+    asyncio.run(go())
