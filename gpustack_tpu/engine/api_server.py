@@ -2,9 +2,10 @@
 
 Endpoint parity with the engine-level API surface the reference proxies to
 (reference gpustack/routes/openai.py registers chat/completions/embeddings
-prefixes; the engine containers serve them): ``/v1/completions``,
-``/v1/chat/completions`` (+SSE streaming), ``/v1/models``, ``/healthz``,
-``/metrics``.
+prefixes and relays the full parameter surface — tools, logprobs, n,
+response_format, seed — to the backend engines, openai.py:185-313):
+``/v1/completions``, ``/v1/chat/completions`` (+SSE streaming),
+``/v1/models``, ``/healthz``, ``/metrics``.
 
 Runs as a standalone process per model instance — the unit the worker's
 serve manager launches and health-probes (reference
@@ -21,20 +22,83 @@ import os
 import queue
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from aiohttp import web
 
 from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+from gpustack_tpu.engine.openai_tools import (
+    JSON_MODE_INSTRUCTION,
+    ToolCallHoldback,
+    forced_function,
+    parse_tool_calls,
+)
 
 logger = logging.getLogger(__name__)
 
+# Reported when the request set ``seed``: OpenAI pairs seeded determinism
+# with a fingerprint identifying the backend configuration.
+SYSTEM_FINGERPRINT = "fp_gpustack_tpu"
+MAX_N = 8          # parallel choices per request (each takes a slot)
+MAX_TOP_LOGPROBS = 20
 
-def _usage(req: GenRequest) -> Dict[str, int]:
+
+def _usage(reqs) -> Dict[str, int]:
+    if isinstance(reqs, GenRequest):
+        reqs = [reqs]
+    # n>1 choices share one prompt: bill prompt tokens once (OpenAI
+    # semantics), completions per choice
+    pt = len(reqs[0].prompt_ids) if reqs else 0
+    ct = sum(len(r.output_ids) for r in reqs)
     return {
-        "prompt_tokens": len(req.prompt_ids),
-        "completion_tokens": len(req.output_ids),
-        "total_tokens": len(req.prompt_ids) + len(req.output_ids),
+        "prompt_tokens": pt,
+        "completion_tokens": ct,
+        "total_tokens": pt + ct,
+    }
+
+
+def _token_entry(tokenizer, tid: int, lp: float) -> Dict[str, Any]:
+    text = tokenizer.decode([tid])
+    return {
+        "token": text,
+        "logprob": lp,
+        "bytes": list(text.encode("utf-8")),
+    }
+
+
+def _chat_logprobs(req: GenRequest, tokenizer) -> Dict[str, Any]:
+    """OpenAI chat logprobs shape: choices[].logprobs.content[]."""
+    content = []
+    k = req.top_logprobs
+    for tid, lp, tops in zip(
+        req.output_ids, req.output_logprobs, req.output_top_logprobs
+    ):
+        entry = _token_entry(tokenizer, tid, lp)
+        entry["top_logprobs"] = [
+            _token_entry(tokenizer, i, p) for i, p in tops[:k]
+        ]
+        content.append(entry)
+    return {"content": content}
+
+
+def _completion_logprobs(req: GenRequest, tokenizer, k: int) -> Dict[str, Any]:
+    """Legacy completions logprobs shape: tokens/token_logprobs/
+    top_logprobs/text_offset arrays."""
+    tokens, offsets = [], []
+    off = 0
+    for tid in req.output_ids:
+        text = tokenizer.decode([tid])
+        tokens.append(text)
+        offsets.append(off)
+        off += len(text)
+    return {
+        "tokens": tokens,
+        "token_logprobs": list(req.output_logprobs),
+        "top_logprobs": [
+            {tokenizer.decode([i]): p for i, p in tops[:k]}
+            for tops in req.output_top_logprobs
+        ],
+        "text_offset": offsets,
     }
 
 
@@ -115,11 +179,51 @@ class OpenAIServer:
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             return _error(400, "missing 'messages'")
+
+        tools = body.get("tools") or []
+        tool_choice = body.get("tool_choice", "auto")
+        tools_active = bool(tools) and tool_choice != "none"
+        msgs = list(messages)
+
+        # tool_choice forcing rides an extra system instruction so it
+        # works uniformly across template-native and fallback rendering
+        if tools_active:
+            forced = forced_function(tool_choice)
+            if forced:
+                msgs.append({
+                    "role": "system",
+                    "content": f'You MUST call the function "{forced}".',
+                })
+            elif tool_choice == "required":
+                msgs.append({
+                    "role": "system",
+                    "content": "You MUST call one of the available functions.",
+                })
+
+        rf = body.get("response_format") or {}
+        json_mode = isinstance(rf, dict) and rf.get("type") in (
+            "json_object", "json_schema"
+        )
+        if json_mode:
+            instruction = JSON_MODE_INSTRUCTION
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if schema:
+                instruction += (
+                    " The object must conform to this JSON schema: "
+                    + json.dumps(schema)
+                )
+            msgs.append({"role": "system", "content": instruction})
+
         try:
-            prompt_ids = self.engine.tokenizer.apply_chat_template(messages)
+            prompt_ids = self.engine.tokenizer.apply_chat_template(
+                msgs, tools=tools if tools_active else None
+            )
         except Exception as e:  # tokenizer/template errors are client errors
             return _error(400, f"chat template failed: {e}")
-        return await self._run(request, body, prompt_ids, chat=True)
+        return await self._run(
+            request, body, prompt_ids, chat=True,
+            tools_active=tools_active, json_mode=json_mode,
+        )
 
     async def rerank(self, request: web.Request) -> web.Response:
         """Jina/Cohere-style rerank: query + documents → ranked scores.
@@ -251,7 +355,10 @@ class OpenAIServer:
 
     # ---- core -----------------------------------------------------------
 
-    def _gen_request(self, body: Dict[str, Any], prompt_ids) -> GenRequest:
+    def _gen_request(
+        self, body: Dict[str, Any], prompt_ids, *,
+        chat: bool = True, json_mode: bool = False,
+    ) -> GenRequest:
         stop = body.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
@@ -261,74 +368,152 @@ class OpenAIServer:
         )
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        seed = body.get("seed")
+        if seed is not None:
+            seed = int(seed)
+        # chat: logprobs is a bool + top_logprobs count; legacy
+        # completions: logprobs is the alternatives count itself
+        if chat:
+            want_logprobs = bool(body.get("logprobs"))
+            top_lp = int(body.get("top_logprobs") or 0)
+        else:
+            raw = body.get("logprobs")
+            want_logprobs = raw is not None and raw is not False
+            top_lp = int(raw or 0) if not isinstance(raw, bool) else 0
+        if top_lp < 0 or top_lp > MAX_TOP_LOGPROBS:
+            raise ValueError(
+                f"top_logprobs must be 0..{MAX_TOP_LOGPROBS}, got {top_lp}"
+            )
+        if body.get("temperature") is None:
+            # A speculative deployment is greedy-only; the OpenAI default
+            # of 1.0 would reject every request that simply leaves
+            # temperature unset. Explicitly-set temperatures still reach
+            # the engine and get its clear rejection.
+            temperature = (
+                0.0 if getattr(self.engine, "speculative", "") else 1.0
+            )
+        else:
+            temperature = float(body.get("temperature"))
         return GenRequest(
             prompt_ids=prompt_ids,
             max_tokens=max_tokens,
-            temperature=float(
-                1.0 if body.get("temperature") is None
-                else body.get("temperature")
-            ),
+            temperature=temperature,
             top_k=int(body.get("top_k") or 0),
             top_p=float(body.get("top_p") or 1.0),
+            seed=seed,
             stop_texts=stop_texts,
+            logprobs=want_logprobs,
+            top_logprobs=top_lp,
+            json_mode=json_mode,
             request_id=str(uuid.uuid4()),
         )
 
+    def _make_gens(
+        self, body: Dict[str, Any], prompt_ids, chat: bool, json_mode: bool
+    ) -> List[GenRequest]:
+        n = int(body.get("n") or 1)
+        if n < 1 or n > MAX_N:
+            raise ValueError(f"n must be 1..{MAX_N}, got {n}")
+        gens = []
+        for i in range(n):
+            gen = self._gen_request(
+                body, list(prompt_ids), chat=chat, json_mode=json_mode
+            )
+            if gen.seed is not None and i > 0:
+                # per-choice seeds must differ or every choice is the
+                # same sequence; derive deterministically from the base
+                gen.seed = gen.seed + i
+            gens.append(gen)
+        return gens
+
+    def _finish_reason(self, gen: GenRequest, had_tool_calls: bool) -> str:
+        return "tool_calls" if had_tool_calls else gen.finish_reason
+
     async def _run(
-        self, request: web.Request, body: Dict[str, Any], prompt_ids, chat: bool
+        self, request: web.Request, body: Dict[str, Any], prompt_ids,
+        chat: bool, tools_active: bool = False, json_mode: bool = False,
     ) -> web.StreamResponse:
         try:
-            gen = self._gen_request(body, prompt_ids)
+            gens = self._make_gens(body, prompt_ids, chat, json_mode)
         except (TypeError, ValueError) as e:
             return _error(400, f"bad sampling params: {e}")
         if body.get("stream"):
-            return await self._stream(request, gen, chat)
+            return await self._stream(request, gens, chat, tools_active)
         loop = asyncio.get_running_loop()
         try:
-            self.engine.submit(gen)
+            for gen in gens:
+                self.engine.submit(gen)
         except ValueError as e:
             return _error(400, str(e))
-        await loop.run_in_executor(None, gen.done.wait, 600)
-        if not gen.done.is_set():
-            return _error(504, "generation timed out")
-        text = gen.output_text
-        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
-        if chat:
-            choice = {
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": gen.finish_reason,
-            }
-            obj = "chat.completion"
-        else:
-            choice = {
-                "index": 0,
-                "text": text,
-                "finish_reason": gen.finish_reason,
-            }
-            obj = "text_completion"
-        return web.json_response(
-            {
-                "id": rid,
-                "object": obj,
-                "created": int(time.time()),
-                "model": self.model_name,
-                "choices": [choice],
-                "usage": _usage(gen),
-            }
-        )
+        deadline = loop.time() + 600
+        for gen in gens:
+            remaining = max(0.1, deadline - loop.time())
+            await loop.run_in_executor(None, gen.done.wait, remaining)
+            if not gen.done.is_set():
+                return _error(504, "generation timed out")
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
+        choices = []
+        for i, gen in enumerate(gens):
+            text = gen.output_text
+            if chat:
+                tool_calls: List[Dict[str, Any]] = []
+                content: Optional[str] = text
+                if tools_active:
+                    content, tool_calls = parse_tool_calls(text)
+                    content = content or None
+                message: Dict[str, Any] = {
+                    "role": "assistant", "content": content,
+                }
+                if tool_calls:
+                    message["tool_calls"] = tool_calls
+                choice = {
+                    "index": i,
+                    "message": message,
+                    "finish_reason": self._finish_reason(
+                        gen, bool(tool_calls)
+                    ),
+                }
+                if gen.logprobs:
+                    choice["logprobs"] = _chat_logprobs(
+                        gen, self.engine.tokenizer
+                    )
+            else:
+                choice = {
+                    "index": i,
+                    "text": text,
+                    "finish_reason": gen.finish_reason,
+                }
+                if gen.logprobs:
+                    choice["logprobs"] = _completion_logprobs(
+                        gen, self.engine.tokenizer, gen.top_logprobs
+                    )
+            choices.append(choice)
+        payload = {
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": self.model_name,
+            "choices": choices,
+            "usage": _usage(gens),
+        }
+        if gens[0].seed is not None:
+            payload["system_fingerprint"] = SYSTEM_FINGERPRINT
+        return web.json_response(payload)
 
     async def _stream(
-        self, request: web.Request, gen: GenRequest, chat: bool
+        self, request: web.Request, gens: List[GenRequest], chat: bool,
+        tools_active: bool = False,
     ) -> web.StreamResponse:
-        gen.stream = queue.Queue()
         loop = asyncio.get_running_loop()
-        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gen.request_id}"
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
         obj = "chat.completion.chunk" if chat else "text_completion"
+        for gen in gens:
+            gen.stream = queue.Queue()
         # submit before committing to a 200/SSE response: rejections must
         # surface as real HTTP errors, not in-band stream events
         try:
-            self.engine.submit(gen)
+            for gen in gens:
+                self.engine.submit(gen)
         except ValueError as e:
             return _error(400, str(e))
         resp = web.StreamResponse(
@@ -339,45 +524,116 @@ class OpenAIServer:
         )
         await resp.prepare(request)
 
-        if chat:
-            first = {
-                "id": rid, "object": obj, "created": int(time.time()),
-                "model": self.model_name,
-                "choices": [{
-                    "index": 0,
-                    "delta": {"role": "assistant", "content": ""},
-                    "finish_reason": None,
-                }],
-            }
-            await resp.write(f"data: {json.dumps(first)}\n\n".encode())
-
-        while True:
-            item = await loop.run_in_executor(None, gen.stream.get)
-            if item is None:
-                break
-            _tok, piece = item
-            delta = (
-                {"delta": {"content": piece}} if chat else {"text": piece}
+        def chunk_for(index: int, delta_or_text, finish=None) -> dict:
+            body_ = (
+                {"delta": delta_or_text} if chat
+                else {"text": delta_or_text}
             )
-            chunk = {
+            payload = {
                 "id": rid, "object": obj, "created": int(time.time()),
                 "model": self.model_name,
-                "choices": [{"index": 0, **delta, "finish_reason": None}],
+                "choices": [{"index": index, **body_,
+                             "finish_reason": finish}],
             }
-            await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
-        final = {
-            "id": rid, "object": obj, "created": int(time.time()),
-            "model": self.model_name,
-            "choices": [
-                {
-                    "index": 0,
-                    **({"delta": {}} if chat else {"text": ""}),
-                    "finish_reason": gen.finish_reason,
-                }
-            ],
-            "usage": _usage(gen),
-        }
-        await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            if gens[0].seed is not None:
+                payload["system_fingerprint"] = SYSTEM_FINGERPRINT
+            return payload
+
+        async def write(payload: dict) -> None:
+            await resp.write(f"data: {json.dumps(payload)}\n\n".encode())
+
+        if chat:
+            for i in range(len(gens)):
+                await write(chunk_for(
+                    i, {"role": "assistant", "content": ""}
+                ))
+
+        # merge the per-choice token queues into one ordered SSE stream
+        merged: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i: int, gen: GenRequest) -> None:
+            while True:
+                item = await loop.run_in_executor(None, gen.stream.get)
+                await merged.put((i, item))
+                if item is None:
+                    return
+
+        pumps = [
+            asyncio.ensure_future(pump(i, g)) for i, g in enumerate(gens)
+        ]
+        holdbacks = [
+            ToolCallHoldback() if (chat and tools_active) else None
+            for _ in gens
+        ]
+        try:
+            open_streams = len(gens)
+            while open_streams:
+                i, item = await merged.get()
+                if item is None:
+                    open_streams -= 1
+                    continue
+                _tok, piece = item
+                hb = holdbacks[i]
+                if hb is not None:
+                    piece = hb.filter(piece)
+                if piece:
+                    await write(chunk_for(
+                        i, {"content": piece} if chat else piece
+                    ))
+        finally:
+            for p in pumps:
+                p.cancel()
+
+        for i, gen in enumerate(gens):
+            had_calls = False
+            hb = holdbacks[i]
+            if hb is not None:
+                if hb.in_call:
+                    # parse only the HELD region: the text before the
+                    # block already streamed, so re-parsing the full
+                    # output would duplicate it. Unparseable blocks and
+                    # content after the call come back as held_content —
+                    # nothing the model produced is ever dropped.
+                    held_content, calls = parse_tool_calls(hb.pending)
+                    if calls:
+                        had_calls = True
+                        # whole-call deltas: one chunk per call carrying
+                        # the full name+arguments (incremental argument
+                        # streaming is a non-goal; clients accumulate by
+                        # index)
+                        await write(chunk_for(i, {
+                            "tool_calls": [
+                                {
+                                    "index": ci,
+                                    "id": c["id"],
+                                    "type": "function",
+                                    "function": c["function"],
+                                }
+                                for ci, c in enumerate(calls)
+                            ]
+                        }))
+                    if held_content:
+                        await write(chunk_for(i, {"content": held_content}))
+                else:
+                    tail = hb.flush()
+                    if tail:
+                        await write(chunk_for(i, {"content": tail}))
+            final = chunk_for(
+                i, {} if chat else "",
+                self._finish_reason(gen, had_calls),
+            )
+            if gen.logprobs:
+                # streaming logprobs ride the final chunk (per-piece
+                # logprobs would need token-aligned streaming)
+                final["choices"][0]["logprobs"] = (
+                    _chat_logprobs(gen, self.engine.tokenizer) if chat
+                    else _completion_logprobs(
+                        gen, self.engine.tokenizer, gen.top_logprobs
+                    )
+                )
+            if i == len(gens) - 1:
+                final["usage"] = _usage(gens)
+            await write(final)
         await resp.write(b"data: [DONE]\n\n")
         return resp
 

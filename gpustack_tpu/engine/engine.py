@@ -98,14 +98,24 @@ class GenRequest:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    seed: Optional[int] = None             # OpenAI 'seed': deterministic replay
     stop_ids: Tuple[int, ...] = ()
     stop_texts: Tuple[str, ...] = ()       # OpenAI 'stop' strings
+    logprobs: bool = False                 # collect per-token logprobs
+    top_logprobs: int = 0                  # alternatives per position (<= 20)
+    json_mode: bool = False                # stop after one complete JSON value
     stream: Optional[queue.Queue] = None   # receives (token_id, text_piece)
     request_id: str = ""
 
     # filled by the engine
     output_ids: List[int] = dataclasses.field(default_factory=list)
     output_text: str = ""                  # stop-truncated decoded text
+    # aligned with output_ids when logprobs: per-token logprob and
+    # [(token_id, logprob)] alternatives
+    output_logprobs: List[float] = dataclasses.field(default_factory=list)
+    output_top_logprobs: List[List[Tuple[int, float]]] = dataclasses.field(
+        default_factory=list
+    )
     finish_reason: str = ""
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     submitted_at: float = 0.0
@@ -142,6 +152,9 @@ class _SlotInfo:
     buffer_ids: List[int] = dataclasses.field(default_factory=list)
     text: str = ""            # decoded text (post stop-truncation)
     emitted: int = 0          # chars of ``text`` already streamed
+    # JSON mode: incremental end-of-value scanner + chars already scanned
+    json_scan: Optional[Any] = None
+    json_scanned: int = 0
 
 
 class LLMEngine:
@@ -248,9 +261,21 @@ class LLMEngine:
             req.request_id = f"req-{next(self._id_counter)}"
         req.submitted_at = time.time()
         if self.speculative:
-            # speculative verification is greedy; sampling params are
-            # ignored in this mode (documented engine-level tradeoff)
-            req.temperature = 0.0
+            # Speculative verification is greedy and produces no sampled
+            # distribution — REJECT incompatible requests instead of
+            # silently changing their sampling semantics (round-3 trap:
+            # temperature was zeroed with no signal to the API user).
+            if req.temperature > 0:
+                raise ValueError(
+                    "this deployment runs speculative decoding, which is "
+                    "greedy-only; set temperature=0 (or deploy without "
+                    "--speculative) to use sampling"
+                )
+            if req.logprobs:
+                raise ValueError(
+                    "logprobs are unavailable under speculative decoding "
+                    "(verification produces no per-token distribution)"
+                )
         if len(req.prompt_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_ids)} tokens >= max_seq_len "
@@ -572,25 +597,49 @@ class LLMEngine:
         ids = req.prompt_ids
         # First generated token: same device sampler as decode, one row —
         # one sampling semantics for the whole sequence, seeded by the
-        # engine's key.
+        # engine's key (or the request's own seed).
         self._key, first_key = jax.random.split(self._key)
-        first = int(
-            sample(
-                last_logits[None, :],
-                SamplingState(
-                    temperature=jnp.asarray([req.temperature], jnp.float32),
-                    top_k=jnp.asarray([req.top_k], jnp.int32),
-                    top_p=jnp.asarray([req.top_p], jnp.float32),
-                ),
-                first_key,
-            )[0]
+        seed = 0 if req.seed is None else int(req.seed) & 0xFFFFFFFF
+        toks, tok_lp, top_ids, top_lps = sample(
+            last_logits[None, :],
+            SamplingState(
+                temperature=jnp.asarray([req.temperature], jnp.float32),
+                top_k=jnp.asarray([req.top_k], jnp.int32),
+                top_p=jnp.asarray([req.top_p], jnp.float32),
+                seed=jnp.asarray([seed], jnp.uint32),
+                seeded=jnp.asarray([req.seed is not None], jnp.bool_),
+            ),
+            first_key,
+            # seeded rows draw noise from fold_in(seed, position); decode
+            # samples token 2 at position len(ids) (pre-increment), so the
+            # first token uses len(ids)-1 to keep every draw's stream
+            # unique — a collision would replay identical gumbel noise on
+            # two consecutive, similarly-distributed steps
+            positions=jnp.asarray([len(ids) - 1], jnp.int32),
         )
+        first = int(toks[0])
+        first_lps = None
+        if req.logprobs:
+            first_lps = [(
+                float(tok_lp[0]),
+                [
+                    (int(i), float(lp))
+                    for i, lp in zip(
+                        np.asarray(top_ids[0]), np.asarray(top_lps[0])
+                    )
+                ],
+            )]
         req.first_token_at = time.time()
         self._state = self.runner.insert(
             self._state, k, v, slot, len(ids), first,
             req.temperature, req.top_k, req.top_p,
+            seed, req.seed is not None,
         )
         info = _SlotInfo(request=req)
+        if req.json_mode:
+            from gpustack_tpu.engine.openai_tools import JsonScanner
+
+            info.json_scan = JsonScanner()
         if self.speculative == "ngram":
             info.ngram = _NgramIndex(req.prompt_ids)
         elif self.draft_runner is not None:
@@ -603,7 +652,7 @@ class LLMEngine:
                 0.0, 0, 1.0,
             )
         self._slots[slot] = info
-        self._deliver(slot, info, [first])
+        self._deliver(slot, info, [first], first_lps)
         if self.draft_runner is not None and slot in self._slots:
             # `first` is already the draft's pending last token (set at
             # insert); queueing it again would double-feed it
@@ -633,7 +682,7 @@ class LLMEngine:
             )
             self._spec_steps += 1
             self._spec_proposed += len(owners) * (self.spec_tokens - 1)
-            self._pending.append(((tokens, produced), owners))
+            self._pending.append((("spec", (tokens, produced)), owners))
         elif self.draft_runner is not None and self._spec_safe():
             proposals = self._draft_propose()
             self._state, tokens, produced = self.runner.verify_step(
@@ -641,13 +690,13 @@ class LLMEngine:
             )
             self._spec_steps += 1
             self._spec_proposed += len(owners) * (self.spec_tokens - 1)
-            self._pending.append(((tokens, produced), owners))
+            self._pending.append((("spec", (tokens, produced)), owners))
         else:
             self._key, step_key = jax.random.split(self._key)
-            self._state, sampled = self.runner.decode_step(
+            self._state, out = self.runner.decode_step(
                 self._state, step_key
             )
-            self._pending.append((sampled, owners))
+            self._pending.append((("decode", out), owners))
         self._step_count += 1
         if len(self._pending) > _FETCH_LAG:
             self._process_fetch(*self._pending.pop(0))
@@ -718,10 +767,10 @@ class LLMEngine:
         proposals = np.zeros((self.max_slots, P), np.int32)
         key = jax.random.key(0)  # draft sampling is greedy; key unused
         for j in range(P - 1):
-            self._draft_state, sampled = self.draft_runner.decode_step(
+            self._draft_state, out = self.draft_runner.decode_step(
                 self._draft_state, key
             )
-            proposals[:, j] = np.asarray(sampled)
+            proposals[:, j] = np.asarray(out[0])
         self._draft_state = self.draft_runner.restore_sequence(
             self._draft_state, snap
         )
@@ -731,12 +780,18 @@ class LLMEngine:
         while self._pending:
             self._process_fetch(*self._pending.pop(0))
 
-    def _process_fetch(self, sampled, owners: Dict[int, str]) -> None:
-        if isinstance(sampled, tuple):        # speculative step
-            tok_arr, produced = (np.asarray(x) for x in sampled)
+    def _process_fetch(self, out, owners: Dict[int, str]) -> None:
+        kind, payload = out
+        lp_arr = top_ids_arr = top_lps_arr = None
+        if kind == "spec":
+            tok_arr, produced = (np.asarray(x) for x in payload)
         else:
-            tok_arr = np.asarray(sampled)[:, None]  # sync point (lagged)
+            tokens, tok_lp, top_ids, top_lps = payload
+            tok_arr = np.asarray(tokens)[:, None]   # sync point (lagged)
             produced = None
+            lp_arr = np.asarray(tok_lp)
+            top_ids_arr = np.asarray(top_ids)
+            top_lps_arr = np.asarray(top_lps)
         for slot, owner_id in owners.items():
             info = self._slots.get(slot)
             if info is None or info.request.request_id != owner_id:
@@ -749,14 +804,32 @@ class LLMEngine:
                 continue
             if produced is not None:
                 self._spec_hits += n - 1
-            self._deliver(slot, info, [int(t) for t in tok_arr[slot, :n]])
+            lps = None
+            if lp_arr is not None and info.request.logprobs:
+                lps = [(
+                    float(lp_arr[slot]),
+                    [
+                        (int(i), float(lp))
+                        for i, lp in zip(top_ids_arr[slot], top_lps_arr[slot])
+                    ],
+                )]
+            self._deliver(
+                slot, info, [int(t) for t in tok_arr[slot, :n]], lps
+            )
 
-    def _deliver(self, slot: int, info: _SlotInfo, toks: List[int]) -> None:
+    def _deliver(
+        self, slot: int, info: _SlotInfo, toks: List[int], lps=None
+    ) -> None:
+        """Deliver newly generated tokens (``lps``: optional aligned list
+        of (token_logprob, [(id, logprob) alternatives]))."""
         req = info.request
-        for tok in toks:
+        for j, tok in enumerate(toks):
             is_eos = tok in self.tokenizer.eos_ids or tok in req.stop_ids
             if not is_eos:
                 req.output_ids.append(tok)
+                if lps is not None and j < len(lps):
+                    req.output_logprobs.append(lps[j][0])
+                    req.output_top_logprobs.append(lps[j][1])
                 self._tokens_generated += 1
                 info.buffer_ids.append(tok)
                 if info.ngram is not None:
@@ -787,6 +860,17 @@ class LLMEngine:
             if final or not piece.endswith("�"):
                 info.text += piece
                 info.buffer_ids.clear()
+        # JSON mode: the first complete top-level JSON value ends the
+        # request — scan only the newly decoded chars (incremental state
+        # lives in the scanner), truncate any tail past the closing
+        # bracket, flush, stop.
+        if info.json_scan is not None and len(info.text) > info.json_scanned:
+            rel = info.json_scan.feed(info.text[info.json_scanned:])
+            if rel != -1:
+                info.text = info.text[: info.json_scanned + rel]
+                self._push(info, info.text[info.emitted:])
+                return True
+            info.json_scanned = len(info.text)
         unemitted = info.text[info.emitted:]
         # Stop-string search: hold-back guarantees no stop can straddle the
         # emitted boundary, so searching the unemitted tail is complete.

@@ -159,6 +159,8 @@ class ModelRunner:
                     self._slot_sharding,
                     self._slot_sharding,
                     self._slot_sharding,
+                    self._slot_sharding,
+                    self._slot_sharding,
                 ),
             ),
         )
@@ -343,7 +345,7 @@ class ModelRunner:
 
     def _insert_impl(
         self, state, k, v, slot, true_len, first_token,
-        temperature, top_k, top_p,
+        temperature, top_k, top_p, seed, seeded,
     ):
         Tb = k.shape[1]
         cache = state.cache
@@ -354,12 +356,15 @@ class ModelRunner:
             last_tokens=state.last_tokens.at[slot].set(first_token),
             positions=state.positions.at[slot].set(true_len),
             active=state.active.at[slot].set(True),
-            sampling=state.sampling.set_slot(slot, temperature, top_k, top_p),
+            sampling=state.sampling.set_slot(
+                slot, temperature, top_k, top_p, seed, seeded
+            ),
         )
 
     def insert(
         self, state: DecodeState, k, v, slot: int, true_len: int,
         first_token: int, temperature: float, top_k: int, top_p: float,
+        seed: int = 0, seeded: bool = False,
     ) -> DecodeState:
         Tb = k.shape[1]
         fn = self._inserts.get(Tb)
@@ -370,6 +375,7 @@ class ModelRunner:
             state, k, v, jnp.int32(slot), jnp.int32(true_len),
             jnp.int32(first_token), jnp.float32(temperature),
             jnp.int32(top_k), jnp.float32(top_p),
+            jnp.uint32(seed), jnp.bool_(seeded),
         )
 
     def deactivate(self, state: DecodeState, slot: int) -> DecodeState:
@@ -387,7 +393,9 @@ class ModelRunner:
             attn_impl="ring" if self.sp_mode else "xla",
             mesh=self.mesh if self.sp_mode else None,
         )
-        sampled = sample(logits[:, 0], state.sampling, key)
+        sampled, tok_lp, top_ids, top_lps = sample(
+            logits[:, 0], state.sampling, key, state.positions
+        )
         # Inactive slots keep feeding their last token at a frozen position;
         # their cache writes are confined to their own rows and invisible
         # through the causal mask of any future tenant.
@@ -405,10 +413,13 @@ class ModelRunner:
                 active=state.active & ~at_capacity,
                 sampling=state.sampling,
             ),
-            sampled,
+            (sampled, tok_lp, top_ids, top_lps),
         )
 
-    def decode_step(self, state: DecodeState, key) -> Tuple[DecodeState, jax.Array]:
+    def decode_step(self, state: DecodeState, key):
+        """One decode step. Returns ``(state', (tokens [B], token_logprob
+        [B], top_ids [B, TOPLP], top_logprobs [B, TOPLP]))`` — the
+        logprob extras ride the same device round-trip as the tokens."""
         return self._decode(self.params, state, key)
 
     # -- draft-model support ---------------------------------------------

@@ -2,8 +2,16 @@
 
 All sampling state is per-slot arrays of shape ``[B]`` so one jitted
 ``sample`` call serves a heterogeneous continuous batch (each request may
-carry its own temperature/top-k/top-p, as OpenAI API params allow) without
-re-specialization — static shapes, no host branching.
+carry its own temperature/top-k/top-p/seed, as OpenAI API params allow)
+without re-specialization — static shapes, no host branching.
+
+Besides the sampled token, :func:`sample` returns the sampled token's
+logprob and the top-``TOPLP`` (id, logprob) candidates — the data the
+OpenAI ``logprobs``/``top_logprobs`` response fields need (reference
+proxies vLLM's logprobs surface, gpustack/routes/openai.py). They come
+almost free: the sampler already ranks the top-``CAND`` logits, so the
+only extra work is one logsumexp for normalization — no second
+full-vocab sort.
 """
 
 from __future__ import annotations
@@ -21,11 +29,17 @@ class SamplingState:
 
     ``temperature == 0`` selects greedy decoding for that slot.
     ``top_k == 0`` / ``top_p == 1`` disable the respective filters.
+    ``seeded`` rows draw noise from ``fold_in(seed, position)`` instead of
+    the engine's step key, so a request that sets OpenAI's ``seed`` param
+    replays identically (given the same context) — the engine-global key
+    never enters a seeded row's path.
     """
 
     temperature: jax.Array  # f32 [B]
     top_k: jax.Array        # i32 [B]
     top_p: jax.Array        # f32 [B]
+    seed: jax.Array         # u32 [B]
+    seeded: jax.Array       # bool [B]
 
     @staticmethod
     def create(batch: int) -> "SamplingState":
@@ -33,13 +47,19 @@ class SamplingState:
             temperature=jnp.zeros((batch,), jnp.float32),
             top_k=jnp.zeros((batch,), jnp.int32),
             top_p=jnp.ones((batch,), jnp.float32),
+            seed=jnp.zeros((batch,), jnp.uint32),
+            seeded=jnp.zeros((batch,), jnp.bool_),
         )
 
-    def set_slot(self, slot, temperature, top_k, top_p) -> "SamplingState":
+    def set_slot(
+        self, slot, temperature, top_k, top_p, seed=0, seeded=False
+    ) -> "SamplingState":
         return SamplingState(
             temperature=self.temperature.at[slot].set(temperature),
             top_k=self.top_k.at[slot].set(top_k),
             top_p=self.top_p.at[slot].set(top_p),
+            seed=self.seed.at[slot].set(seed),
+            seeded=self.seeded.at[slot].set(seeded),
         )
 
 
@@ -50,18 +70,46 @@ class SamplingState:
 # sampling is truncated to the top-64 tail (the standard serving-engine
 # tradeoff).
 CAND = 64
+# Top-logprob candidates returned per step (OpenAI caps top_logprobs at 20).
+TOPLP = 20
+
+
+def _row_keys(state: SamplingState, positions: jax.Array, key: jax.Array):
+    """Per-row PRNG keys: seeded rows derive from (seed, position) only —
+    deterministic replay; unseeded rows derive from the step key + row
+    index so concurrent identical prompts (OpenAI ``n>1``) diverge."""
+    B = positions.shape[0]
+    root = jax.random.key(0)
+
+    def seeded_key(seed, pos):
+        return jax.random.key_data(
+            jax.random.fold_in(jax.random.fold_in(root, seed), pos)
+        )
+
+    def step_key(row):
+        return jax.random.key_data(jax.random.fold_in(key, row))
+
+    seeded_kd = jax.vmap(seeded_key)(state.seed, positions)
+    step_kd = jax.vmap(step_key)(jnp.arange(B, dtype=jnp.uint32))
+    kd = jnp.where(state.seeded[:, None], seeded_kd, step_kd)
+    return kd
 
 
 def sample(
     logits: jax.Array,       # [B, V] f32
     state: SamplingState,
     key: jax.Array,
-) -> jax.Array:
-    """Sample one token per row honoring per-row temperature/top-k/top-p."""
+    positions: jax.Array | None = None,  # i32 [B]; required for seeded rows
+):
+    """Sample one token per row honoring per-row temperature/top-k/top-p
+    and per-row seeds.
+
+    Returns ``(tokens i32[B], token_logprob f32[B], top_ids i32[B, TOPLP],
+    top_logprobs f32[B, TOPLP])``.
+    """
     B, V = logits.shape
     n = min(CAND, V)
     top_logits, top_idx = jax.lax.top_k(logits, n)   # [B, n] descending
-    greedy = top_idx[:, 0]
 
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
     scaled = top_logits / temp
@@ -78,6 +126,29 @@ def sample(
     keep = (cum - probs) < state.top_p[:, None]
     masked = jnp.where(keep, masked, -jnp.inf)
 
-    choice = jax.random.categorical(key, masked, axis=-1)   # [B] in [0, n)
-    sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
-    return jnp.where(state.temperature > 0, sampled, greedy).astype(jnp.int32)
+    if positions is None:
+        positions = jnp.zeros((B,), jnp.int32)
+    kd = _row_keys(state, positions, key)
+    noise = jax.vmap(
+        lambda kdata: jax.random.gumbel(
+            jax.random.wrap_key_data(kdata), (n,)
+        )
+    )(kd)
+    # categorical(key, logits) == argmax(logits + gumbel(key)); the
+    # per-row formulation lets seeded rows keep private noise streams.
+    choice = jnp.argmax(masked + noise, axis=-1)        # [B] in [0, n)
+    choice = jnp.where(state.temperature > 0, choice, 0)
+    tokens = jnp.take_along_axis(
+        top_idx, choice[:, None], axis=1
+    )[:, 0].astype(jnp.int32)
+
+    # Exact logprobs: top-n logits are the true top-n of the full vocab,
+    # so normalizing them against the full logsumexp gives exact values.
+    lse = jax.nn.logsumexp(logits, axis=-1)             # [B]
+    token_logprob = (
+        jnp.take_along_axis(top_logits, choice[:, None], axis=1)[:, 0] - lse
+    )
+    m = min(TOPLP, n)
+    top_ids = top_idx[:, :m]
+    top_logprobs = top_logits[:, :m] - lse[:, None]
+    return tokens, token_logprob, top_ids, top_logprobs

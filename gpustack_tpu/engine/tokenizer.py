@@ -27,9 +27,13 @@ class ByteTokenizer:
             i - 1 for i in ids if 0 < i < 257
         ).decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: List[dict]) -> List[int]:
+    def apply_chat_template(
+        self, messages: List[dict], tools: Optional[List[dict]] = None,
+    ) -> List[int]:
+        messages = _inject_tools_fallback(messages, tools)
         text = "".join(
-            f"<{m['role']}>{m['content']}</{m['role']}>" for m in messages
+            f"<{m['role']}>{_content_text(m)}</{m['role']}>"
+            for m in messages
         ) + "<assistant>"
         return self.encode(text)
 
@@ -53,10 +57,48 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: List[dict]) -> List[int]:
+    def apply_chat_template(
+        self, messages: List[dict], tools: Optional[List[dict]] = None,
+    ) -> List[int]:
+        if tools:
+            # Llama-3 / Qwen / Gemma ship chat templates that render
+            # function schemas natively via the ``tools=`` kwarg; fall
+            # back to an injected system block for templates that don't
+            # (uniform with the hermetic byte tokenizer).
+            try:
+                return self._tok.apply_chat_template(
+                    messages, tools=tools,
+                    add_generation_prompt=True, tokenize=True,
+                )
+            except (TypeError, ValueError, KeyError):
+                messages = _inject_tools_fallback(messages, tools)
         return self._tok.apply_chat_template(
             messages, add_generation_prompt=True, tokenize=True
         )
+
+
+def _content_text(message: dict) -> str:
+    """Flatten OpenAI content (string or content-part list) to text."""
+    content = message.get("content", "")
+    if isinstance(content, list):
+        return "".join(
+            p.get("text", "") for p in content
+            if isinstance(p, dict) and p.get("type") == "text"
+        )
+    return str(content or "")
+
+
+def _inject_tools_fallback(
+    messages: List[dict], tools: Optional[List[dict]]
+) -> List[dict]:
+    """Prepend a system block describing the functions (for tokenizers
+    whose chat template can't take ``tools=``)."""
+    if not tools:
+        return messages
+    from gpustack_tpu.engine.openai_tools import tools_system_block
+
+    block = tools_system_block(tools, None)
+    return [{"role": "system", "content": block}] + list(messages)
 
 
 def load_tokenizer(model_dir: Optional[str]):

@@ -394,13 +394,17 @@ def add_openai_routes(app: web.Application) -> None:
                             pt, ct = _extract_usage(payload)
                             if pt or ct:
                                 usage_tokens = [pt, ct]
-                                if suppress_usage_chunk and not payload.get(
-                                    "choices"
-                                ):
-                                    # usage-only chunk we solicited; the
-                                    # client never asked for it
-                                    forward = False
-                                    skip_blank = True
+                            # the strip decision is independent of the
+                            # counts: a zero-token usage-only chunk we
+                            # solicited must not leak to a client that
+                            # never asked for include_usage
+                            if (
+                                suppress_usage_chunk
+                                and "usage" in payload
+                                and not payload.get("choices")
+                            ):
+                                forward = False
+                                skip_blank = True
                         except json.JSONDecodeError:
                             pass
                     if forward:

@@ -74,12 +74,12 @@ def main():
             state = runner.insert(state, k, v, s, 64, first, 0.0, 0, 1.0)
         # decode_step donates the state — thread it through the loop
         for _ in range(3):
-            state, toks = runner.decode_step(state, key)
+            state, (toks, *_lp) = runner.decode_step(state, key)
         jax.block_until_ready(toks)
         iters = 20
         t_bench = time.perf_counter()
         for _ in range(iters):
-            state, toks = runner.decode_step(state, key)
+            state, (toks, *_lp) = runner.decode_step(state, key)
         jax.block_until_ready(toks)
         dt = (time.perf_counter() - t_bench) / iters
         print(json.dumps({

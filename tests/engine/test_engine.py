@@ -185,10 +185,20 @@ def test_sampling_greedy_and_filters():
         temperature=jnp.asarray([0.0, 1.0], jnp.float32),
         top_k=jnp.asarray([0, 1], jnp.int32),
         top_p=jnp.asarray([1.0, 1.0], jnp.float32),
+        seed=jnp.zeros((2,), jnp.uint32),
+        seeded=jnp.zeros((2,), jnp.bool_),
     )
-    toks = sample(logits, st, jax.random.key(0))
+    toks, tok_lp, top_ids, top_lps = sample(logits, st, jax.random.key(0))
     assert int(toks[0]) == 2            # greedy row
     assert int(toks[1]) == 0            # top_k=1 forces argmax
+    # logprob extras: sampled-token logprob matches its rank entry and
+    # candidates are sorted descending
+    lp = np.asarray(top_lps)
+    assert np.all(np.diff(lp, axis=1) <= 1e-6)
+    assert int(top_ids[0, 0]) == 2
+    assert abs(float(tok_lp[0]) - float(lp[0, 0])) < 1e-5
+    # exact normalization: softmax over the full row sums the top-4 to 1
+    assert abs(np.exp(lp[0]).sum() - 1.0) < 1e-4
 
 
 def test_sampling_top_p_excludes_tail():
@@ -198,10 +208,31 @@ def test_sampling_top_p_excludes_tail():
         temperature=jnp.ones((8,), jnp.float32),
         top_k=jnp.zeros((8,), jnp.int32),
         top_p=jnp.full((8,), 0.5, jnp.float32),
+        seed=jnp.zeros((8,), jnp.uint32),
+        seeded=jnp.zeros((8,), jnp.bool_),
     )
     for seed in range(5):
-        toks = sample(logits, st, jax.random.key(seed))
+        toks, *_ = sample(logits, st, jax.random.key(seed))
         assert np.all(np.asarray(toks) == 0)
+
+
+def test_sampling_seeded_rows_replay():
+    logits = jnp.asarray([[2.0, 1.9, 1.8, 1.7]] * 4, jnp.float32)
+    st = SamplingState(
+        temperature=jnp.ones((4,), jnp.float32),
+        top_k=jnp.zeros((4,), jnp.int32),
+        top_p=jnp.ones((4,), jnp.float32),
+        seed=jnp.asarray([7, 7, 8, 8], jnp.uint32),
+        seeded=jnp.ones((4,), jnp.bool_),
+    )
+    pos = jnp.asarray([3, 3, 3, 9], jnp.int32)
+    # seeded rows ignore the step key entirely: different keys, same draw
+    a, *_ = sample(logits, st, jax.random.key(0), pos)
+    b, *_ = sample(logits, st, jax.random.key(123), pos)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    # same (seed, position) -> same token; row 3 differs in position so
+    # it draws from a different stream than row 2
+    assert int(a[0]) == int(a[1])
 
 
 def test_quantized_params_close_and_smaller():

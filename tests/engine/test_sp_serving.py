@@ -41,8 +41,8 @@ def _greedy_tokens(runner: ModelRunner, prompt, n_steps: int):
     key = jax.random.key(0)
     for _ in range(n_steps - 1):
         key, sub = jax.random.split(key)
-        state, sampled = runner.decode_step(state, sub)
-        out.append(int(sampled[0]))
+        state, out_step = runner.decode_step(state, sub)
+        out.append(int(out_step[0][0]))
     return out
 
 
