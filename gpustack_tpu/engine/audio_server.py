@@ -25,12 +25,18 @@ logger = logging.getLogger(__name__)
 
 
 class AudioEngine:
-    """Owns model params + a serialized transcription executor."""
+    """Owns model params + a serialized synthesis/transcription executor.
 
-    def __init__(self, cfg, params, model_dir: str = ""):
+    One process serves one audio model: STT (Whisper-class,
+    ``modality="stt"``) or TTS (FastSpeech-class, ``modality="tts"``) —
+    together covering the reference's VoxBox role
+    (worker/backends/vox_box.py:23 does both)."""
+
+    def __init__(self, cfg, params, model_dir: str = "", modality: str = "stt"):
         self.cfg = cfg
         self.params = params
         self.model_dir = model_dir
+        self.modality = modality
         self.tokenizer = self._load_tokenizer(model_dir)
         self._lock = asyncio.Lock()
         self.requests = 0
@@ -74,6 +80,31 @@ class AudioEngine:
             "latency_ms": round((time.monotonic() - start) * 1e3, 1),
         }
 
+    async def speak(
+        self, text: str, voice: str = "", speed: float = 1.0
+    ) -> bytes:
+        """Text → WAV bytes via the jitted synth + host Griffin-Lim."""
+        from gpustack_tpu.models.tts import (
+            pcm_to_wav_bytes,
+            synthesize,
+            voice_index,
+        )
+
+        ids = self.tokenizer.encode(text)
+        if not ids:
+            raise ValueError("input text is empty")
+        async with self._lock:
+            audio = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: synthesize(
+                    self.params, self.cfg, ids,
+                    voice=voice_index(voice, self.cfg), speed=speed,
+                ),
+            )
+        self.requests += 1
+        self.audio_seconds += len(audio) / self.cfg.sample_rate
+        return pcm_to_wav_bytes(audio, self.cfg.sample_rate)
+
 
 class AudioServer:
     def __init__(self, engine: AudioEngine, model_name: str = ""):
@@ -85,6 +116,7 @@ class AudioServer:
                 web.post(
                     "/v1/audio/transcriptions", self.transcriptions
                 ),
+                web.post("/v1/audio/speech", self.speech),
                 web.get("/healthz", self.healthz),
                 web.get("/metrics", self.metrics),
             ]
@@ -95,10 +127,60 @@ class AudioServer:
             {
                 "status": "ok",
                 "model": self.model_name,
-                "modality": "audio",
+                "modality": f"audio/{self.engine.modality}",
                 "requests": self.engine.requests,
             }
         )
+
+    async def speech(self, request: web.Request) -> web.Response:
+        """OpenAI ``/v1/audio/speech``: JSON {input, voice, speed} → WAV
+        bytes (reference VoxBox serves TTS on the same path)."""
+        if self.engine.modality != "tts":
+            return web.json_response(
+                {"error": f"model {self.model_name} is not a TTS model"},
+                status=400,
+            )
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"error": "invalid JSON body"}, status=400
+            )
+        text = body.get("input")
+        if not isinstance(text, str) or not text.strip():
+            return web.json_response(
+                {"error": "missing 'input'"}, status=400
+            )
+        fmt = body.get("response_format") or "wav"
+        if fmt not in ("wav", "pcm"):
+            return web.json_response(
+                {"error": f"unsupported response_format {fmt!r}; this "
+                 "engine produces wav/pcm"}, status=400
+            )
+        speed = body.get("speed")
+        if speed is None:
+            speed = 1.0
+        if isinstance(speed, bool) or not isinstance(speed, (int, float)):
+            return web.json_response(
+                {"error": "'speed' must be a number"}, status=400
+            )
+        if not 0.25 <= speed <= 4.0:
+            return web.json_response(
+                {"error": "'speed' must be between 0.25 and 4.0"},
+                status=400,
+            )
+        try:
+            wav = await self.engine.speak(
+                text, voice=str(body.get("voice") or ""), speed=speed
+            )
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if fmt == "pcm":
+            # strip the 44-byte RIFF header: raw 16-bit mono PCM
+            return web.Response(
+                body=wav[44:], content_type="application/octet-stream"
+            )
+        return web.Response(body=wav, content_type="audio/wav")
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(
@@ -112,6 +194,11 @@ class AudioServer:
         )
 
     async def transcriptions(self, request: web.Request) -> web.Response:
+        if self.engine.modality != "stt":
+            return web.json_response(
+                {"error": f"model {self.model_name} is not an STT model"},
+                status=400,
+            )
         if not request.content_type.startswith("multipart/"):
             return web.json_response(
                 {"error": "multipart/form-data with a 'file' part required"},
@@ -155,6 +242,7 @@ def build_audio_engine_from_args(args) -> AudioEngine:
     if forced:
         jax.config.update("jax_platforms", forced)
 
+    from gpustack_tpu.models.tts import TTS_PRESETS, init_tts_params
     from gpustack_tpu.models.whisper import (
         WHISPER_PRESETS,
         config_from_hf_whisper,
@@ -163,14 +251,32 @@ def build_audio_engine_from_args(args) -> AudioEngine:
 
     if args.model_dir:
         with open(os.path.join(args.model_dir, "config.json")) as f:
-            cfg = config_from_hf_whisper(json.load(f))
+            hf_cfg = json.load(f)
+        if hf_cfg.get("model_type") in ("tts", "fastspeech"):
+            # our own checkpoint format for the in-repo TTS: config.json
+            # names a preset; params load from a .npz next to it
+            from gpustack_tpu.engine.weights import load_npz_params
+
+            cfg = TTS_PRESETS[hf_cfg.get("preset", "tts-base")]
+            params = load_npz_params(
+                os.path.join(args.model_dir, "params.npz"),
+                lambda: init_tts_params(cfg, jax.random.key(0)),
+            )
+            return AudioEngine(
+                cfg, params, model_dir=args.model_dir, modality="tts"
+            )
+        cfg = config_from_hf_whisper(hf_cfg)
         from gpustack_tpu.engine.weights import load_whisper_params
 
         params = load_whisper_params(cfg, args.model_dir)
-    else:
-        cfg = WHISPER_PRESETS[args.preset]
-        params = init_whisper_params(cfg, jax.random.key(0))
-    return AudioEngine(cfg, params, model_dir=args.model_dir)
+        return AudioEngine(cfg, params, model_dir=args.model_dir)
+    if args.preset in TTS_PRESETS:
+        cfg = TTS_PRESETS[args.preset]
+        params = init_tts_params(cfg, jax.random.key(0))
+        return AudioEngine(cfg, params, modality="tts")
+    cfg = WHISPER_PRESETS[args.preset]
+    params = init_whisper_params(cfg, jax.random.key(0))
+    return AudioEngine(cfg, params)
 
 
 def main(argv=None) -> None:

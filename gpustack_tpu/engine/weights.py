@@ -166,6 +166,28 @@ def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
     return params
 
 
+def load_npz_params(path: str, init_fn):
+    """Load a flat-or-nested param tree saved as .npz ('/'-joined keys),
+    falling back to ``init_fn()`` when no file exists — the checkpoint
+    format for in-repo models without an HF counterpart (e.g. TTS)."""
+    import numpy as np
+
+    try:
+        with np.load(path) as z:
+            flat = {k: jnp.asarray(z[k]) for k in z.files}
+    except OSError:
+        logger.warning("no checkpoint at %r — random init", path)
+        return init_fn()
+    tree: dict = {}
+    for key, value in flat.items():
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return tree
+
+
 def load_whisper_params(cfg, model_dir: str):
     """Load an HF Whisper safetensors checkpoint into the
     models/whisper.py param tree (falls back to random init when no

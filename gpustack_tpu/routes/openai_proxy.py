@@ -517,6 +517,57 @@ def add_openai_routes(app: web.Application) -> None:
             content_type=upstream.content_type,
         )
 
+    async def speech_proxy(request: web.Request):
+        """/v1/audio/speech: JSON relay to a TTS-model instance; the
+        response is audio bytes, not JSON (reference VoxBox TTS role,
+        worker/backends/vox_box.py:23)."""
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return json_error(400, "invalid JSON body")
+        name = (body.get("model") or "").strip()
+        if not name:
+            return json_error(400, "missing 'model'")
+        target, err = await _resolve_target(request, name)
+        if err is not None:
+            return err
+        try:
+            if isinstance(target, ProviderTarget):
+                body["model"] = target.upstream_model
+                upstream = await _provider_fetch(
+                    app, target.provider, "audio/speech", body
+                )
+                model_id, provider_id = 0, target.provider.id
+            else:
+                model, instance, worker = target
+                model_id, provider_id = model.id, 0
+                upstream = await worker_fetch(
+                    app, worker, "POST",
+                    f"/proxy/instances/{instance.id}/v1/audio/speech",
+                    json_body=body,
+                )
+        except aiohttp.ClientError as e:
+            kind = (
+                "provider"
+                if isinstance(target, ProviderTarget)
+                else "instance"
+            )
+            return json_error(502, f"{kind} unreachable: {e}")
+        payload = await upstream.read()
+        upstream.release()
+        if upstream.status == 200:
+            await _record_usage(
+                request, model_id, name, "audio/speech",
+                0, 0, False, provider_id=provider_id,
+            )
+        return web.Response(
+            body=payload,
+            status=upstream.status,
+            content_type=upstream.content_type,
+        )
+
     app.router.add_get("/v1/models", list_models)
     app.router.add_post(
         "/v1/{op:(chat/completions|completions|embeddings|rerank"
@@ -524,3 +575,4 @@ def add_openai_routes(app: web.Application) -> None:
         proxy,
     )
     app.router.add_post("/v1/audio/transcriptions", audio_proxy)
+    app.router.add_post("/v1/audio/speech", speech_proxy)

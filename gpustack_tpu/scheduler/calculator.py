@@ -119,9 +119,13 @@ def resolve_model_config(model: Model, raw: Optional[dict] = None):
         config_from_hf_whisper,
     )
 
+    from gpustack_tpu.models.tts import TTS_PRESETS
+
     if model.preset:
         if model.preset in WHISPER_PRESETS:
             return WHISPER_PRESETS[model.preset]
+        if model.preset in TTS_PRESETS:
+            return TTS_PRESETS[model.preset]
         if model.preset in DIFFUSION_PRESETS:
             return DIFFUSION_PRESETS[model.preset]
         if model.preset not in PRESETS:
@@ -141,6 +145,13 @@ def resolve_model_config(model: Model, raw: Optional[dict] = None):
     try:
         if raw.get("model_type") == "whisper":
             return config_from_hf_whisper(raw, name=model.name or name)
+        if raw.get("model_type") in ("tts", "fastspeech"):
+            # in-repo TTS checkpoint format: config.json names a preset
+            # (same contract as build_audio_engine_from_args)
+            preset = raw.get("preset", "tts-base")
+            if preset not in TTS_PRESETS:
+                raise EvaluationError(f"unknown TTS preset {preset!r}")
+            return TTS_PRESETS[preset]
         return config_from_hf(raw, name=name)
     except (KeyError, ValueError) as e:
         raise EvaluationError(

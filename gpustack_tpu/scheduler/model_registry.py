@@ -153,6 +153,10 @@ def detect_categories(model: Model) -> List[str]:
         return cats
     if isinstance(cfg, WhisperConfig):
         return ["audio", "speech-to-text"]
+    from gpustack_tpu.models.tts import TTSConfig
+
+    if isinstance(cfg, TTSConfig):
+        return ["audio", "text-to-speech"]
     if isinstance(cfg, DiffusionConfig):
         return ["image", "text-to-image"]
     out = cats or ["llm"]

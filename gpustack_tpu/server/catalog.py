@@ -78,6 +78,15 @@ CATALOG: List[Dict[str, Any]] = [
         },
     },
     {
+        "name": "TTS-Base",
+        "preset": "tts-base",
+        "categories": ["audio", "text-to-speech"],
+        "sizes": {"parameters_b": 0.007},
+        "suggested": {
+            "chips": {"v5e": 1, "v5p": 1},
+        },
+    },
+    {
         "name": "Stable-Diffusion-XL",
         "preset": "sdxl-shaped",
         "huggingface_repo_id": "stabilityai/stable-diffusion-xl-base-1.0",

@@ -65,9 +65,14 @@ def _is_audio_model(model: Model) -> bool:
     detection (calculator.resolve_model_config) — a local-path whisper
     checkpoint without a user-supplied 'audio' category must still launch
     the audio engine, not crash-loop under the LLM server."""
+    from gpustack_tpu.models.tts import TTS_PRESETS
     from gpustack_tpu.models.whisper import WHISPER_PRESETS
 
-    if "audio" in model.categories or model.preset in WHISPER_PRESETS:
+    if (
+        "audio" in model.categories
+        or model.preset in WHISPER_PRESETS
+        or model.preset in TTS_PRESETS
+    ):
         return True
     if model.local_path:
         import json as _json
@@ -76,7 +81,9 @@ def _is_audio_model(model: Model) -> bool:
             with open(
                 os.path.join(model.local_path, "config.json")
             ) as f:
-                return _json.load(f).get("model_type") == "whisper"
+                return _json.load(f).get("model_type") in (
+                    "whisper", "tts", "fastspeech"
+                )
         except (OSError, ValueError):
             return False
     return False

@@ -86,7 +86,7 @@ def test_transcription_roundtrip(model):
         # health + metrics
         r = await client.get("/healthz")
         data = await r.json()
-        assert data["modality"] == "audio" and data["requests"] == 2
+        assert data["modality"] == "audio/stt" and data["requests"] == 2
         r = await client.get("/metrics")
         assert "gpustack_tpu_audio_requests_total 2" in await r.text()
 
@@ -112,5 +112,106 @@ def test_transcription_rejects_bad_input(model):
         )
         r = await client.post("/v1/audio/transcriptions", data=form)
         assert r.status == 400
+        # STT engine refuses the TTS route with a clear error
+        r = await client.post("/v1/audio/speech", json={"input": "hi"})
+        assert r.status == 400
+        assert "not a TTS model" in (await r.json())["error"]
 
     _run(model, go)
+
+
+# ---------------------------------------------------------------------------
+# TTS (/v1/audio/speech) — reference VoxBox serves both halves
+# (worker/backends/vox_box.py:23)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tts_model():
+    import jax
+
+    from gpustack_tpu.models.tts import TTS_PRESETS, init_tts_params
+
+    cfg = TTS_PRESETS["tiny-tts"]
+    return cfg, init_tts_params(cfg, jax.random.key(0))
+
+
+def _run_tts(tts_model, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.engine.audio_server import AudioEngine, AudioServer
+
+    cfg, params = tts_model
+
+    async def run():
+        server = AudioServer(
+            AudioEngine(cfg, params, modality="tts"),
+            model_name="tiny-tts",
+        )
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_speech_roundtrip(tts_model):
+    async def go(client):
+        r = await client.post(
+            "/v1/audio/speech",
+            json={"model": "tiny-tts", "input": "hello world",
+                  "voice": "alloy"},
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "audio/wav"
+        data = await r.read()
+        with wave.open(io.BytesIO(data)) as wf:
+            assert wf.getnchannels() == 1
+            assert wf.getsampwidth() == 2
+            assert wf.getnframes() > 0
+            rate = wf.getframerate()
+        cfg, _ = tts_model
+        assert rate == cfg.sample_rate
+
+        # raw pcm format
+        r = await client.post(
+            "/v1/audio/speech",
+            json={"input": "hello", "response_format": "pcm"},
+        )
+        assert r.status == 200
+        pcm = await r.read()
+        assert len(pcm) > 0 and len(pcm) % 2 == 0
+
+        r = await client.get("/healthz")
+        h = await r.json()
+        assert h["modality"] == "audio/tts" and h["requests"] == 2
+
+    _run_tts(tts_model, go)
+
+
+def test_speech_rejects_bad_input(tts_model):
+    async def go(client):
+        r = await client.post("/v1/audio/speech", json={})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/audio/speech", json={"input": "x", "speed": "fast"}
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/audio/speech",
+            json={"input": "x", "response_format": "opus"},
+        )
+        assert r.status == 400
+        # TTS engine refuses the STT route
+        import aiohttp
+
+        form = aiohttp.FormData()
+        form.add_field("file", _wav_bytes(), filename="a.wav")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+        assert "not an STT model" in (await r.json())["error"]
+
+    _run_tts(tts_model, go)

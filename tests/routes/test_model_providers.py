@@ -69,8 +69,15 @@ def make_fake_upstream(seen):
             {"object": "list", "data": [{"id": "gpt-x"}, {"id": "gpt-y"}]}
         )
 
+    async def speech(request: web.Request):
+        seen["speech_body"] = await request.json()
+        return web.Response(
+            body=b"RIFFfakewav", content_type="audio/wav"
+        )
+
     app = web.Application()
     app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/v1/audio/speech", speech)
     app.router.add_get("/v1/models", models)
     return app
 
@@ -207,6 +214,46 @@ def test_route_falls_back_past_dead_provider_target(cfg):
         )
         assert r.status == 200, await r.text()
         assert seen["body"]["model"] == "gpt-x"
+
+    run_env(cfg, go)
+
+
+def test_speech_proxy_relays_audio_bytes(cfg):
+    """/v1/audio/speech proxies to a TTS target and relays the audio
+    bytes (reference VoxBox TTS role behind the gateway)."""
+
+    async def go(client, hdrs, base_url, seen):
+        p = await ModelProvider.create(
+            ModelProvider(name="voices", base_url=base_url)
+        )
+        await ModelRoute.create(
+            ModelRoute(
+                name="tts-alias",
+                targets=[
+                    ModelRouteTarget(
+                        provider_id=p.id, provider_model="tts-upstream"
+                    )
+                ],
+            )
+        )
+        r = await client.post(
+            "/v1/audio/speech",
+            json={"model": "tts-alias", "input": "hello", "voice": "nova"},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 200, await r.text()
+        assert r.headers["Content-Type"] == "audio/wav"
+        assert await r.read() == b"RIFFfakewav"
+        # the upstream saw its own model name, not the alias
+        assert seen["speech_body"]["model"] == "tts-upstream"
+        assert seen["speech_body"]["input"] == "hello"
+
+        # missing model -> 400
+        r = await client.post(
+            "/v1/audio/speech", json={"input": "x"},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 400
 
     run_env(cfg, go)
 
