@@ -185,6 +185,21 @@ class OpenAIServer:
         tools_active = bool(tools) and tool_choice != "none"
         msgs = list(messages)
 
+        has_images = any(
+            isinstance(m.get("content"), list)
+            and any(
+                isinstance(p, dict) and p.get("type") == "image_url"
+                for p in m["content"]
+            )
+            for m in msgs
+        )
+        vision = getattr(self.engine, "vision", None)
+        if has_images and vision is None:
+            return _error(
+                400,
+                f"model {self.model_name!r} does not accept image input",
+            )
+
         # tool_choice forcing rides an extra system instruction so it
         # works uniformly across template-native and fallback rendering
         if tools_active:
@@ -214,15 +229,40 @@ class OpenAIServer:
                 )
             msgs.append({"role": "system", "content": instruction})
 
-        try:
-            prompt_ids = self.engine.tokenizer.apply_chat_template(
-                msgs, tools=tools if tools_active else None
-            )
-        except Exception as e:  # tokenizer/template errors are client errors
-            return _error(400, f"chat template failed: {e}")
+        embeds_override = None
+        if has_images:
+            from gpustack_tpu.engine.tokenizer import _inject_tools_fallback
+            from gpustack_tpu.models.vlm import build_mm_prompt
+
+            # the multimodal template can't take the tools= kwarg, so the
+            # function schemas ride the same system-block fallback the
+            # text path uses for non-template tokenizers
+            if tools_active:
+                msgs = _inject_tools_fallback(msgs, tools)
+            loop = asyncio.get_running_loop()
+            try:
+                # PIL decode + (first-call) jit compile + ViT forward are
+                # seconds of work — off the event loop, like TTS synthesis
+                prompt_ids, embeds, mask = await loop.run_in_executor(
+                    None,
+                    lambda: build_mm_prompt(
+                        self.engine.tokenizer, msgs, vision
+                    ),
+                )
+            except ValueError as e:
+                return _error(400, str(e))
+            embeds_override = (embeds, mask)
+        else:
+            try:
+                prompt_ids = self.engine.tokenizer.apply_chat_template(
+                    msgs, tools=tools if tools_active else None
+                )
+            except Exception as e:  # tokenizer/template errors: client's
+                return _error(400, f"chat template failed: {e}")
         return await self._run(
             request, body, prompt_ids, chat=True,
             tools_active=tools_active, json_mode=json_mode,
+            embeds_override=embeds_override,
         )
 
     async def rerank(self, request: web.Request) -> web.Response:
@@ -409,7 +449,8 @@ class OpenAIServer:
         )
 
     def _make_gens(
-        self, body: Dict[str, Any], prompt_ids, chat: bool, json_mode: bool
+        self, body: Dict[str, Any], prompt_ids, chat: bool, json_mode: bool,
+        embeds_override=None,
     ) -> List[GenRequest]:
         n = int(body.get("n") or 1)
         if n < 1 or n > MAX_N:
@@ -419,6 +460,7 @@ class OpenAIServer:
             gen = self._gen_request(
                 body, list(prompt_ids), chat=chat, json_mode=json_mode
             )
+            gen.embeds_override = embeds_override
             if gen.seed is not None and i > 0:
                 # per-choice seeds must differ or every choice is the
                 # same sequence; derive deterministically from the base
@@ -432,9 +474,12 @@ class OpenAIServer:
     async def _run(
         self, request: web.Request, body: Dict[str, Any], prompt_ids,
         chat: bool, tools_active: bool = False, json_mode: bool = False,
+        embeds_override=None,
     ) -> web.StreamResponse:
         try:
-            gens = self._make_gens(body, prompt_ids, chat, json_mode)
+            gens = self._make_gens(
+                body, prompt_ids, chat, json_mode, embeds_override
+            )
         except (TypeError, ValueError) as e:
             return _error(400, f"bad sampling params: {e}")
         if body.get("stream"):
@@ -678,10 +723,17 @@ def build_engine_from_args(args) -> LLMEngine:
     from gpustack_tpu.models import init_params
     from gpustack_tpu.models.config import get_config, load_hf_config
     from gpustack_tpu.models.quant import quantize_params
+    from gpustack_tpu.models.vlm import VLM_PRESETS, get_vlm_config
     from gpustack_tpu.parallel.mesh import MeshPlan, plan_mesh
 
+    vlm_cfg = None
     if args.model_dir:
         cfg = load_hf_config(args.model_dir)
+    elif args.preset in VLM_PRESETS:
+        # vision-language preset: the language half runs in the normal
+        # engine; the tower+projector attach as engine.vision below
+        vlm_cfg = get_vlm_config(args.preset)
+        cfg = vlm_cfg.language
     else:
         cfg = get_config(args.preset)
 
@@ -717,7 +769,7 @@ def build_engine_from_args(args) -> LLMEngine:
             draft_cfg = get_config(source)
             draft_params = load_or_init_params(draft_cfg, None, seed=0)
 
-    return LLMEngine(
+    engine = LLMEngine(
         cfg,
         params,
         model_dir=args.model_dir,
@@ -731,6 +783,13 @@ def build_engine_from_args(args) -> LLMEngine:
         host_kv_cache_mb=getattr(args, "host_kv_cache_mb", 0),
         prefill_chunk=getattr(args, "prefill_chunk", 0),
     )
+    if vlm_cfg is not None:
+        from gpustack_tpu.models.vlm import VisionBundle, init_vision_params
+
+        engine.vision = VisionBundle(
+            vlm_cfg, init_vision_params(vlm_cfg, jax.random.key(1))
+        )
+    return engine
 
 
 def main(argv=None) -> None:

@@ -104,6 +104,8 @@ class GenRequest:
     logprobs: bool = False                 # collect per-token logprobs
     top_logprobs: int = 0                  # alternatives per position (<= 20)
     json_mode: bool = False                # stop after one complete JSON value
+    # VLM: (embeds [T, D] f32, mask [T] bool) overriding placeholder rows
+    embeds_override: Optional[Tuple[Any, Any]] = None
     stream: Optional[queue.Queue] = None   # receives (token_id, text_piece)
     request_id: str = ""
 
@@ -275,6 +277,11 @@ class LLMEngine:
                 raise ValueError(
                     "logprobs are unavailable under speculative decoding "
                     "(verification produces no per-token distribution)"
+                )
+            if req.embeds_override is not None:
+                raise ValueError(
+                    "image inputs are unavailable under speculative "
+                    "decoding (the draft model has no vision tower)"
                 )
         if len(req.prompt_ids) >= self.max_seq_len:
             raise ValueError(
@@ -482,6 +489,21 @@ class LLMEngine:
         ids = req.prompt_ids
         bucket = self.runner.bucket_for(max(1, len(ids)))
         padded = list(ids) + [0] * (bucket - len(ids))
+        if req.embeds_override is not None:
+            # VLM prompt: placeholder ids alias across different images,
+            # so the token-keyed host KV cache and chunked prefill don't
+            # apply — one fused prefill with the embedding override
+            embeds, mask = req.embeds_override
+            pad_rows = bucket - len(ids)
+            embeds = np.pad(
+                np.asarray(embeds, np.float32), ((0, pad_rows), (0, 0))
+            )
+            mask = np.pad(np.asarray(mask, bool), (0, pad_rows))
+            last_logits, k, v = self.runner.prefill_with_embeds(
+                padded, len(ids), embeds, mask
+            )
+            self._finalize_start(slot, req, last_logits, k, v)
+            return
         cache_key = None
         cached = None
         # local read: the copy worker may null host_kv_cache concurrently

@@ -138,6 +138,7 @@ class ModelRunner:
 
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefills: Dict[int, Any] = {}
+        self._prefill_embeds: Dict[int, Any] = {}
         self._inserts: Dict[int, Any] = {}
         self._embeds: Dict[int, Any] = {}
         self._verifies: Dict[int, Any] = {}
@@ -229,6 +230,45 @@ class ModelRunner:
             self._prefills[Tb] = fn
         tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
         return fn(self.params, tokens, jnp.int32(true_len))
+
+    def _prefill_embeds_impl(
+        self, params, tokens, true_len, embeds, mask, *, attn_impl="xla"
+    ):
+        """Prefill with vision-token splicing (models/vlm.py): embedding
+        rows where ``mask`` is set are overridden by ``embeds``."""
+        Tb = tokens.shape[1]
+        cache = KVCache.create(self.cfg, 1, Tb)
+        positions = jnp.arange(Tb, dtype=jnp.int32)[None, :]
+        logits, cache = forward(
+            params, self.cfg, tokens, positions, cache,
+            attn_impl=attn_impl,
+            mesh=self.mesh if attn_impl == "ring" else None,
+            embeds_override=(embeds, mask),
+        )
+        last = jnp.take(logits[0], true_len - 1, axis=0)
+        return last, cache.k[:, 0], cache.v[:, 0]
+
+    def prefill_with_embeds(
+        self, token_ids, true_len: int, embeds, mask
+    ):
+        """Like :meth:`prefill` but with per-token embedding overrides
+        (``embeds`` [Tb, D], ``mask`` [Tb] bool, both bucket-padded)."""
+        Tb = len(token_ids)
+        assert Tb in self.prefill_buckets, (Tb, self.prefill_buckets)
+        fn = self._prefill_embeds.get(Tb)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    self._prefill_embeds_impl,
+                    attn_impl=self.attn_impl_for(Tb),
+                )
+            )
+            self._prefill_embeds[Tb] = fn
+        tokens = jnp.asarray(token_ids, jnp.int32)[None, :]
+        return fn(
+            self.params, tokens, jnp.int32(true_len),
+            jnp.asarray(embeds)[None, :], jnp.asarray(mask, bool)[None, :],
+        )
 
     def _prefix_prefill_impl(
         self, params, prefix_k, prefix_v, prefix_len, tokens, true_len,

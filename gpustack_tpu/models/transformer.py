@@ -306,6 +306,7 @@ def forward(
     return_hidden: bool = False,
     attn_impl: str = "xla",
     mesh=None,
+    embeds_override: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Run the model.
 
@@ -338,6 +339,12 @@ def forward(
     B, T = tokens.shape
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     x = _embed_lookup(params["embed"], tokens, dtype)
+    if embeds_override is not None:
+        # VLM token splicing: rows flagged by the mask (image
+        # placeholders) take projected vision embeddings instead of the
+        # vocab row (models/vlm.py build_mm_prompt)
+        ov, ov_mask = embeds_override
+        x = jnp.where(ov_mask[..., None], ov.astype(dtype), x)
     if cfg.embed_scale:
         # gemma: embeddings scaled by sqrt(d); HF casts the normalizer
         # to the compute dtype before multiplying

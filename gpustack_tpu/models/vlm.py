@@ -1,0 +1,271 @@
+"""Vision-language serving: ViT tower + projector + token splicing.
+
+Reference parity: the reference schedules and serves VLMs (vision-head
+divisibility checks,
+policies/candidate_selectors/base_candidate_selector.py:229-234; vLLM
+consumes ``image_url`` content parts). Here the LLaVA-class recipe is
+implemented TPU-first:
+
+  image [S, S, 3] ── patchify (one reshape; stride-free) ──► ViT
+  (non-causal transformer, jitted, static patch count) ──► projector
+  (2-layer MLP into the language dim) ──► spliced into the prompt's
+  embedding sequence at placeholder positions; the language model's
+  prefill runs ONE fused program with the override applied after
+  embedding lookup (models/transformer.py forward(embeds_override=...)).
+
+Everything is static-shape: image size, patch count and the per-image
+token run are fixed by the config, so the prefill hits the same bucket
+ladder as text-only requests.
+
+Images arrive as ``data:`` URLs (base64) only — this is a zero-egress
+deployment; remote http(s) image URLs are rejected at the API layer.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpustack_tpu.models.config import ModelConfig, get_config
+
+Params = Dict[str, Any]
+
+# ByteTokenizer id 257 is BOS/reserved (engine/tokenizer.py) — reused as
+# the image-placeholder id so hermetic VLM configs need no vocab change.
+IMAGE_PLACEHOLDER_ID = 257
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 64
+    patch_size: int = 8
+    dim: int = 64
+    layers: int = 2
+    heads: int = 2
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+@dataclasses.dataclass(frozen=True)
+class VLMConfig:
+    name: str
+    language: ModelConfig
+    vision: VisionConfig
+
+    @property
+    def n_image_tokens(self) -> int:
+        return self.vision.n_patches
+
+
+def _tiny_vlm() -> VLMConfig:
+    return VLMConfig(
+        name="tiny-vlm",
+        language=get_config("tiny"),
+        vision=VisionConfig(),
+    )
+
+
+VLM_PRESETS = {"tiny-vlm": _tiny_vlm}
+
+
+def get_vlm_config(preset: str) -> VLMConfig:
+    return VLM_PRESETS[preset]()
+
+
+def init_vision_params(cfg: VLMConfig, key: jax.Array) -> Params:
+    """Vision tower + projector params (language params live separately
+    in the LLM engine's own tree)."""
+    v = cfg.vision
+    keys = iter(jax.random.split(key, 16))
+
+    def w(*shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-1]))
+        return (
+            jax.random.normal(next(keys), shape, jnp.float32) * scale
+        ).astype(jnp.bfloat16)
+
+    D = v.dim
+    patch_dim = 3 * v.patch_size * v.patch_size
+    lm_dim = cfg.language.hidden_size
+    return {
+        "patch_proj": w(patch_dim, D),
+        "pos_emb": w(v.n_patches, D, scale=0.02),
+        "blocks": {
+            "wq": w(v.layers, D, D),
+            "wk": w(v.layers, D, D),
+            "wv": w(v.layers, D, D),
+            "wo": w(v.layers, D, D),
+            "w1": w(v.layers, D, 4 * D),
+            "w2": w(v.layers, 4 * D, D),
+            "ln1": jnp.ones((v.layers, D), jnp.float32),
+            "ln2": jnp.ones((v.layers, D), jnp.float32),
+        },
+        "proj_w1": w(D, lm_dim),
+        "proj_w2": w(lm_dim, lm_dim),
+    }
+
+
+def _rms(x, g, eps=1e-6):
+    n = x.astype(jnp.float32)
+    n = n * jax.lax.rsqrt(jnp.mean(n * n, -1, keepdims=True) + eps)
+    return (n * g).astype(x.dtype)
+
+
+def encode_image(
+    params: Params, cfg: VLMConfig, image: jax.Array
+) -> jax.Array:
+    """image [S, S, 3] float in [0, 1] → [n_patches, lm_dim] bf16."""
+    v = cfg.vision
+    p = v.patch_size
+    g = v.image_size // p
+    # patchify without convs: [g, p, g, p, 3] -> [g*g, p*p*3]
+    x = image.reshape(g, p, g, p, 3).transpose(0, 2, 1, 3, 4)
+    x = x.reshape(v.n_patches, p * p * 3).astype(jnp.bfloat16)
+    x = (x * 2.0 - 1.0) @ params["patch_proj"] + params["pos_emb"]
+
+    nh, hd = v.heads, v.head_dim
+    scale = 1.0 / np.sqrt(hd)
+
+    def layer(x, wts):
+        h = _rms(x, wts["ln1"])
+        q = (h @ wts["wq"]).reshape(-1, nh, hd)
+        k = (h @ wts["wk"]).reshape(-1, nh, hd)
+        val = (h @ wts["wv"]).reshape(-1, nh, hd)
+        att = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        att = jax.nn.softmax(att.astype(jnp.float32), -1).astype(x.dtype)
+        o = jnp.einsum("hqk,khd->qhd", att, val).reshape(-1, v.dim)
+        x = x + o @ wts["wo"]
+        h = _rms(x, wts["ln2"])
+        x = x + jax.nn.gelu(h @ wts["w1"]) @ wts["w2"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["blocks"])
+    # LLaVA-style 2-layer MLP projector into the language dim
+    y = jax.nn.gelu(x @ params["proj_w1"]) @ params["proj_w2"]
+    return y
+
+
+class VisionBundle:
+    """What the API server needs to serve image content parts: the tower
+    params + a jitted encoder + preprocessing."""
+
+    def __init__(self, cfg: VLMConfig, params: Params):
+        self.cfg = cfg
+        self.params = params
+        self._encode = jax.jit(
+            lambda p, img: encode_image(p, cfg, img)
+        )
+
+    @property
+    def n_image_tokens(self) -> int:
+        return self.cfg.n_image_tokens
+
+    def preprocess(self, image_bytes: bytes) -> np.ndarray:
+        """Decode + resize to the tower's square input, float [0, 1]."""
+        from PIL import Image
+
+        try:
+            img = Image.open(io.BytesIO(image_bytes)).convert("RGB")
+        except Exception as e:
+            # PIL raises UnidentifiedImageError/OSError on garbage bytes;
+            # normalize to ValueError so the API layer returns 400, not 500
+            raise ValueError(f"cannot decode image: {e}") from e
+        s = self.cfg.vision.image_size
+        img = img.resize((s, s))
+        return np.asarray(img, np.float32) / 255.0
+
+    def encode(self, image_bytes: bytes) -> np.ndarray:
+        emb = self._encode(
+            self.params, jnp.asarray(self.preprocess(image_bytes))
+        )
+        return np.asarray(emb, np.float32)
+
+
+def decode_data_url(url: str) -> bytes:
+    """``data:image/...;base64,...`` → raw image bytes. Anything else is
+    rejected: this is a zero-egress deployment, the engine never dials
+    out for remote images."""
+    if not url.startswith("data:"):
+        raise ValueError(
+            "only data: image URLs are supported (zero-egress deployment "
+            "— inline the image as base64)"
+        )
+    header, _, payload = url.partition(",")
+    if not payload or "base64" not in header:
+        raise ValueError("malformed data URL (expected ';base64,' payload)")
+    try:
+        return base64.b64decode(payload, validate=True)
+    except Exception as e:
+        raise ValueError(f"invalid base64 image payload: {e}") from e
+
+
+def build_mm_prompt(
+    tokenizer,
+    messages: List[dict],
+    bundle: VisionBundle,
+) -> Tuple[List[int], np.ndarray, np.ndarray]:
+    """OpenAI messages with content parts → (prompt_ids, embeds [T, D],
+    mask [T]).
+
+    Text parts tokenize normally; each ``image_url`` part becomes a run
+    of ``n_image_tokens`` placeholder ids whose embedding rows are
+    overridden with the projected patch embeddings. The surrounding chat
+    scaffolding mirrors the tokenizer's text-only template so text-only
+    and multimodal prompts share a format.
+    """
+    n_img = bundle.n_image_tokens
+    ids: List[int] = []
+    embeds: List[Optional[np.ndarray]] = []   # aligned per-token rows
+
+    def add_text(text: str) -> None:
+        toks = tokenizer.encode(text)
+        ids.extend(toks)
+        embeds.extend([None] * len(toks))
+
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        add_text(f"<{role}>")
+        if isinstance(content, list):
+            for part in content:
+                if not isinstance(part, dict):
+                    raise ValueError(
+                        "content parts must be objects with a 'type'"
+                    )
+                ptype = part.get("type")
+                if ptype == "text":
+                    add_text(part.get("text", ""))
+                elif ptype == "image_url":
+                    url = (part.get("image_url") or {}).get("url", "")
+                    img_embeds = bundle.encode(decode_data_url(url))
+                    ids.extend([IMAGE_PLACEHOLDER_ID] * n_img)
+                    embeds.extend(list(img_embeds))
+                else:
+                    raise ValueError(
+                        f"unsupported content part type {ptype!r}"
+                    )
+        else:
+            add_text(str(content or ""))
+        add_text(f"</{role}>")
+    add_text("<assistant>")
+
+    lm_dim = bundle.cfg.language.hidden_size
+    embed_arr = np.zeros((len(ids), lm_dim), np.float32)
+    mask = np.zeros((len(ids),), bool)
+    for i, row in enumerate(embeds):
+        if row is not None:
+            embed_arr[i] = row
+            mask[i] = True
+    return ids, embed_arr, mask

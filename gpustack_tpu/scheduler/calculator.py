@@ -120,12 +120,17 @@ def resolve_model_config(model: Model, raw: Optional[dict] = None):
     )
 
     from gpustack_tpu.models.tts import TTS_PRESETS
+    from gpustack_tpu.models.vlm import VLM_PRESETS, get_vlm_config
 
     if model.preset:
         if model.preset in WHISPER_PRESETS:
             return WHISPER_PRESETS[model.preset]
         if model.preset in TTS_PRESETS:
             return TTS_PRESETS[model.preset]
+        if model.preset in VLM_PRESETS:
+            # placement math runs on the language half (the tower is a
+            # rounding error next to the LLM weights + KV cache)
+            return get_vlm_config(model.preset).language
         if model.preset in DIFFUSION_PRESETS:
             return DIFFUSION_PRESETS[model.preset]
         if model.preset not in PRESETS:

@@ -134,6 +134,10 @@ def detect_categories(model: Model) -> List[str]:
         resolve_raw_config,
     )
 
+    from gpustack_tpu.models.vlm import VLM_PRESETS
+
+    if model.preset in VLM_PRESETS:
+        return ["llm", "multimodal"]
     raw: Optional[dict] = None
     try:
         raw = resolve_raw_config(model)

@@ -1,0 +1,255 @@
+"""VLM serving: image_url content parts through the chat API.
+
+Reference parity: the reference schedules VLMs (vision-head checks,
+policies/candidate_selectors/base_candidate_selector.py:229-234) and its
+engines consume OpenAI image_url parts. Hermetic: tiny-vlm (tiny LLM +
+2-layer ViT) on random weights — under test is the splicing contract
+(image content changes the model's output; text around images is
+preserved; zero-egress URL policy), not caption quality.
+"""
+
+import asyncio
+import base64
+import io
+
+import numpy as np
+import pytest
+
+from gpustack_tpu.models.vlm import (
+    IMAGE_PLACEHOLDER_ID,
+    VisionBundle,
+    build_mm_prompt,
+    decode_data_url,
+    get_vlm_config,
+    init_vision_params,
+)
+
+
+def _png_data_url(color=(255, 0, 0), size=16) -> str:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (size, size), color).save(buf, format="PNG")
+    b64 = base64.b64encode(buf.getvalue()).decode()
+    return f"data:image/png;base64,{b64}"
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    import jax
+
+    cfg = get_vlm_config("tiny-vlm")
+    return VisionBundle(cfg, init_vision_params(cfg, jax.random.key(1)))
+
+
+def test_decode_data_url_rejects_remote():
+    with pytest.raises(ValueError, match="zero-egress"):
+        decode_data_url("https://example.com/cat.png")
+    with pytest.raises(ValueError):
+        decode_data_url("data:image/png;base64,!!!notb64!!!")
+
+
+def test_encode_image_shapes(bundle):
+    emb = bundle.encode(decode_data_url(_png_data_url()))
+    assert emb.shape == (
+        bundle.n_image_tokens, bundle.cfg.language.hidden_size
+    )
+    assert np.all(np.isfinite(emb))
+    # different images -> different embeddings
+    emb2 = bundle.encode(decode_data_url(_png_data_url((0, 0, 255))))
+    assert not np.allclose(emb, emb2)
+
+
+def test_build_mm_prompt_splices_placeholders(bundle):
+    from gpustack_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    messages = [{
+        "role": "user",
+        "content": [
+            {"type": "text", "text": "what is "},
+            {"type": "image_url", "image_url": {"url": _png_data_url()}},
+            {"type": "text", "text": "?"},
+        ],
+    }]
+    ids, embeds, mask = build_mm_prompt(tok, messages, bundle)
+    n_img = bundle.n_image_tokens
+    assert sum(1 for i in ids if i == IMAGE_PLACEHOLDER_ID) == n_img
+    assert mask.sum() == n_img
+    assert embeds.shape == (len(ids), bundle.cfg.language.hidden_size)
+    # mask rows align exactly with placeholder ids
+    for i, tid in enumerate(ids):
+        assert mask[i] == (tid == IMAGE_PLACEHOLDER_ID)
+    # surrounding text is intact
+    text_ids = [t for t in ids if t != IMAGE_PLACEHOLDER_ID]
+    assert tok.decode(text_ids) == "<user>what is ?</user><assistant>"
+
+
+@pytest.fixture(scope="module")
+def vlm_engine():
+    import jax
+
+    from gpustack_tpu.engine.engine import LLMEngine
+    from gpustack_tpu.engine.tokenizer import ByteTokenizer
+    from gpustack_tpu.models import init_params
+
+    cfg = get_vlm_config("tiny-vlm")
+    params = init_params(cfg.language, jax.random.key(0))
+    engine = LLMEngine(
+        cfg.language, params, tokenizer=ByteTokenizer(),
+        max_slots=2, max_seq_len=512,
+    )
+    engine.vision = VisionBundle(
+        cfg, init_vision_params(cfg, jax.random.key(1))
+    )
+    engine.start()
+    yield engine
+    engine.stop()
+
+
+def test_image_content_changes_output(vlm_engine):
+    """The spliced vision embeddings must actually reach the model: the
+    same text with different images produces different greedy tokens
+    (and both differ from masked-off placeholder rows)."""
+    from gpustack_tpu.engine.engine import GenRequest
+
+    def gen_for(url):
+        msgs = [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe "},
+                {"type": "image_url", "image_url": {"url": url}},
+            ],
+        }]
+        ids, embeds, mask = build_mm_prompt(
+            vlm_engine.tokenizer, msgs, vlm_engine.vision
+        )
+        req = GenRequest(
+            prompt_ids=ids, max_tokens=12, temperature=0.0,
+            embeds_override=(embeds, mask), stop_ids=(),
+        )
+        vlm_engine.generate(req, timeout=300)
+        return req.output_ids
+
+    red = gen_for(_png_data_url((255, 0, 0)))
+    blue = gen_for(_png_data_url((0, 0, 255)))
+    assert len(red) == 12 and len(blue) == 12
+    assert red != blue
+
+
+def _post(engine, model_name, path, body):
+    """Fresh OpenAIServer per call: aiohttp apps bind to one loop and
+    asyncio.run creates a new loop each time."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.engine.api_server import OpenAIServer
+
+    async def run():
+        server = OpenAIServer(engine, model_name=model_name)
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post(path, json=body)
+            return resp.status, await resp.json()
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_chat_api_accepts_image_parts(vlm_engine):
+    status, data = _post(vlm_engine, "tiny-vlm", "/v1/chat/completions", {
+        "model": "tiny-vlm",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "what color?"},
+                {"type": "image_url",
+                 "image_url": {"url": _png_data_url()}},
+            ],
+        }],
+        "max_tokens": 4, "temperature": 0,
+    })
+    assert status == 200, data
+    assert data["choices"][0]["message"]["content"] is not None
+    assert data["usage"]["prompt_tokens"] > vlm_engine.vision.n_image_tokens
+
+    # remote URLs are rejected with the zero-egress explanation
+    status, data = _post(vlm_engine, "tiny-vlm", "/v1/chat/completions", {
+        "model": "tiny-vlm",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "image_url",
+                 "image_url": {"url": "https://x.test/cat.png"}},
+            ],
+        }],
+    })
+    assert status == 400
+    assert "zero-egress" in data["error"]["message"]
+
+    # garbage base64-of-not-an-image -> clean 400, not a 500
+    garbage = "data:image/png;base64," + base64.b64encode(
+        b"not an image at all"
+    ).decode()
+    status, data = _post(vlm_engine, "tiny-vlm", "/v1/chat/completions", {
+        "model": "tiny-vlm",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "image_url", "image_url": {"url": garbage}},
+            ],
+        }],
+    })
+    assert status == 400
+    assert "cannot decode image" in data["error"]["message"]
+
+    # stray non-dict content part -> clean 400
+    status, data = _post(vlm_engine, "tiny-vlm", "/v1/chat/completions", {
+        "model": "tiny-vlm",
+        "messages": [{
+            "role": "user",
+            "content": [
+                "stray string",
+                {"type": "image_url",
+                 "image_url": {"url": _png_data_url()}},
+            ],
+        }],
+    })
+    assert status == 400
+
+
+def test_text_only_model_rejects_images():
+    import jax
+
+    from gpustack_tpu.engine.engine import LLMEngine
+    from gpustack_tpu.engine.tokenizer import ByteTokenizer
+    from gpustack_tpu.models import init_params
+    from gpustack_tpu.models.config import get_config
+
+    cfg = get_config("tiny")
+    engine = LLMEngine(
+        cfg, init_params(cfg, jax.random.key(0)),
+        tokenizer=ByteTokenizer(), max_slots=1, max_seq_len=128,
+    )
+    # no engine.start(): the request must be rejected before submission
+    status, data = _post(engine, "tiny", "/v1/chat/completions", {
+        "model": "tiny",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "image_url",
+                 "image_url": {"url": _png_data_url()}},
+            ],
+        }],
+    })
+    assert status == 400
+    assert "does not accept image input" in data["error"]["message"]
+
+
+def test_calculator_resolves_vlm_preset():
+    from gpustack_tpu.scheduler.calculator import resolve_model_config
+    from gpustack_tpu.schemas.models import Model
+
+    cfg = resolve_model_config(Model(name="v", preset="tiny-vlm"))
+    assert cfg.name == "tiny"          # language half drives placement
