@@ -125,7 +125,10 @@ class OpenAIServer:
     # ---- endpoints ------------------------------------------------------
 
     async def healthz(self, request: web.Request) -> web.Response:
-        return web.json_response(self.engine.health())
+        health = self.engine.health()
+        return web.json_response(
+            health, status=200 if health["status"] == "ok" else 503
+        )
 
     async def models(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -789,6 +792,38 @@ def build_engine_from_args(args) -> LLMEngine:
         engine.vision = VisionBundle(
             vlm_cfg, init_vision_params(vlm_cfg, jax.random.key(1))
         )
+
+    # Multi-host replica: multi-controller JAX is SPMD, so the leader
+    # broadcasts every device op and followers replay it
+    # (engine/multihost.py). Wired here, after the engine owns its
+    # runner, so the engine itself stays topology-agnostic.
+    n_procs = int(os.environ.get("GPUSTACK_TPU_NUM_PROCESSES", "1"))
+    if n_procs > 1:
+        if getattr(engine, "vision", None) is not None:
+            # the vision encode runs leader-only and its spliced-prefill
+            # op is not in the broadcast vocabulary — image requests on
+            # a multi-host replica would kill the scheduling loop
+            logger.warning(
+                "vision tower disabled: VLM serving is single-host only"
+            )
+            engine.vision = None
+        from gpustack_tpu.engine.multihost import (
+            BroadcastingRunner,
+            CommandLeader,
+            FollowerLoop,
+        )
+
+        cmd_addr = os.environ["GPUSTACK_TPU_CMD_ADDRESS"]
+        proc_id = int(os.environ.get("GPUSTACK_TPU_PROCESS_ID", "0"))
+        if proc_id == 0:
+            leader = CommandLeader(
+                int(cmd_addr.rsplit(":", 1)[1]), n_procs - 1
+            )
+            engine.runner = BroadcastingRunner(engine.runner, leader)
+        else:
+            engine.follower_loop = FollowerLoop(
+                engine.runner, cmd_addr, state=engine._state
+            )
     return engine
 
 
@@ -831,8 +866,34 @@ def main(argv=None) -> None:
 
     logging.basicConfig(level=logging.INFO)
     engine = build_engine_from_args(args)
-    engine.start()
+    follower = getattr(engine, "follower_loop", None)
+    if follower is not None:
+        # follower host of a multi-host replica: no scheduling loop —
+        # replay the leader's op stream; the HTTP surface stays up for
+        # liveness but receives no inference traffic (the server proxies
+        # to the leader's port only)
+        follower.start()
+    else:
+        engine.start()
     server = OpenAIServer(engine, model_name=args.served_name or None)
+
+    async def on_startup(app):
+        async def watchdog():
+            # a dead scheduling loop is terminal for this process: exit
+            # so the serve manager's process-exit watch drives the
+            # crash/restart state machine (a 503 healthz alone is only
+            # checked during startup)
+            while True:
+                await asyncio.sleep(2.0)
+                if getattr(engine, "_fatal", ""):
+                    logging.getLogger(__name__).error(
+                        "terminating: %s", engine._fatal
+                    )
+                    os._exit(13)
+
+        app["engine_watchdog"] = asyncio.create_task(watchdog())
+
+    server.app.on_startup.append(on_startup)
     web.run_app(server.app, host=args.host, port=args.port)
 
 

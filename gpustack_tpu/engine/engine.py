@@ -196,6 +196,7 @@ class LLMEngine:
         self._key = jax.random.key(seed)
         self._pending: List[Tuple[Any, Dict[int, int]]] = []
         self._running = False
+        self._fatal = ""            # set when the scheduling loop dies
         self._thread: Optional[threading.Thread] = None
         self._id_counter = itertools.count()
         self._step_count = 0
@@ -259,6 +260,8 @@ class LLMEngine:
     # ---- public API -----------------------------------------------------
 
     def submit(self, req: GenRequest) -> GenRequest:
+        if self._fatal:
+            raise ValueError(f"engine is down: {self._fatal}")
         if not req.request_id:
             req.request_id = f"req-{next(self._id_counter)}"
         req.submitted_at = time.time()
@@ -336,7 +339,8 @@ class LLMEngine:
 
     def health(self) -> Dict[str, Any]:
         return {
-            "status": "ok",
+            "status": "error" if self._fatal else "ok",
+            "error": self._fatal,
             "model": self.cfg.name,
             "slots_total": self.max_slots,
             "slots_used": self.max_slots - len(self._free),
@@ -373,9 +377,40 @@ class LLMEngine:
 
     def _loop(self) -> None:
         while self._running:
-            busy = self.step()
+            try:
+                busy = self.step()
+            except Exception as e:
+                # A dead scheduling thread must be LOUD and terminal, not
+                # a silent hang: fail every in-flight and queued request
+                # and flip health so the serve manager's probe tears the
+                # instance down (e.g. a multi-host follower that never
+                # connected — engine/multihost.py raises after its
+                # connect window).
+                logger.exception("engine scheduling loop died")
+                self._fatal = f"engine loop died: {e}"
+                self._fail_all_requests(str(e))
+                return
             if not busy:
                 time.sleep(0.002)
+
+    def _fail_all_requests(self, message: str) -> None:
+        for info in list(self._slots.values()):
+            req = info.request
+            req.finish_reason = "error"
+            req.output_text = info.text
+            if req.stream is not None:
+                req.stream.put(None)
+            req.done.set()
+        self._slots.clear()
+        while not self._waiting.empty():
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.finish_reason = "error"
+            if req.stream is not None:
+                req.stream.put(None)
+            req.done.set()
 
     def step(self) -> bool:
         """One scheduling iteration. Returns False when fully idle."""
@@ -612,32 +647,19 @@ class LLMEngine:
         """Insert a finished prefill into the decode state and deliver
         the first token (shared by the one-shot, cached and chunked
         prefill paths)."""
-        import jax.numpy as jnp
-
-        from gpustack_tpu.engine.sampling import SamplingState, sample
-
         ids = req.prompt_ids
-        # First generated token: same device sampler as decode, one row —
-        # one sampling semantics for the whole sequence, seeded by the
-        # engine's key (or the request's own seed).
+        # First generated token through the runner's device sampler
+        # (multi-host followers replay the same call). Seeded rows draw
+        # noise from fold_in(seed, position); decode samples token 2 at
+        # position len(ids) (pre-increment), so the first token uses
+        # len(ids)-1 to keep every draw's stream unique — a collision
+        # would replay identical gumbel noise on two consecutive,
+        # similarly-distributed steps.
         self._key, first_key = jax.random.split(self._key)
         seed = 0 if req.seed is None else int(req.seed) & 0xFFFFFFFF
-        toks, tok_lp, top_ids, top_lps = sample(
-            last_logits[None, :],
-            SamplingState(
-                temperature=jnp.asarray([req.temperature], jnp.float32),
-                top_k=jnp.asarray([req.top_k], jnp.int32),
-                top_p=jnp.asarray([req.top_p], jnp.float32),
-                seed=jnp.asarray([seed], jnp.uint32),
-                seeded=jnp.asarray([req.seed is not None], jnp.bool_),
-            ),
-            first_key,
-            # seeded rows draw noise from fold_in(seed, position); decode
-            # samples token 2 at position len(ids) (pre-increment), so the
-            # first token uses len(ids)-1 to keep every draw's stream
-            # unique — a collision would replay identical gumbel noise on
-            # two consecutive, similarly-distributed steps
-            positions=jnp.asarray([len(ids) - 1], jnp.int32),
+        toks, tok_lp, top_ids, top_lps = self.runner.sample_first(
+            last_logits, req.temperature, req.top_k, req.top_p,
+            seed, req.seed is not None, len(ids) - 1, first_key,
         )
         first = int(toks[0])
         first_lps = None

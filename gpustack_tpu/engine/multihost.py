@@ -1,0 +1,319 @@
+"""Multi-host serving: leader→follower command broadcast.
+
+Multi-controller JAX is SPMD: every process of a ``jax.distributed``
+cluster must execute the SAME jitted programs in the SAME order or the
+collectives hang. But only the leader's API server receives requests —
+so the leader broadcasts each device-op it is about to run (prefill,
+first-token sample, insert, decode, deactivate) over a TCP command
+channel, and follower processes replay the identical call sequence on
+their own runner. This is the role Ray's driver/worker actors play for
+the reference's multinode vLLM (reference worker/backends/vllm.py:
+258-328 bootstraps Ray for exactly this); here it is ~200 lines of
+stdlib sockets + ndjson because the op vocabulary is tiny.
+
+Determinism contract:
+- PRNG keys ride the wire as raw ``jax.random.key_data`` — followers
+  never derive keys themselves, so leader/follower sampling programs
+  see bit-identical key inputs.
+- Device arrays never ride the wire. A follower's ``prefill`` output is
+  registered locally and consumed by its next ``insert`` — the engine's
+  scheduling loop is single-threaded, so prefill→insert order is stable.
+- Features whose host round-trips would diverge across processes
+  (host KV cache, chunked prefill, speculative decoding, embeddings,
+  VLM overrides) are disabled at command build for multi-host
+  placements (worker/backends.py) and rejected here defensively.
+
+The channel binds ``coordinator_port + 1`` on the leader host (the
+scheduler allocates coordinator ports in even-aligned pairs so the +1 is
+fenced too).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+_CONNECT_TIMEOUT_S = 600.0   # follower hosts may still be downloading
+
+
+def _key_data_list(key) -> List[int]:
+    import numpy as np
+
+    return np.asarray(jax.random.key_data(key)).astype("uint32").tolist()
+
+
+def _key_from_list(data: List[int]):
+    return jax.random.wrap_key_data(jnp.asarray(data, jnp.uint32))
+
+
+class CommandLeader:
+    """Leader side: accepts follower connections, broadcasts op lines."""
+
+    def __init__(self, port: int, n_followers: int, host: str = "0.0.0.0"):
+        self.n_followers = n_followers
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(n_followers)
+        threading.Thread(
+            target=self._accept_loop, name="mh-accept", daemon=True
+        ).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                if len(self._conns) >= self.n_followers:
+                    self._ready.set()
+                    return
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            logger.info("follower connected from %s", addr)
+            with self._lock:
+                self._conns.append(conn)
+                if len(self._conns) >= self.n_followers:
+                    self._ready.set()
+
+    def broadcast(self, op: Dict[str, Any]) -> None:
+        """Send one op to every follower; blocks until all are connected
+        (ops before rendezvous would be lost, and the collectives they
+        guard would hang anyway)."""
+        if not self._ready.wait(_CONNECT_TIMEOUT_S):
+            raise RuntimeError(
+                f"only {len(self._conns)}/{self.n_followers} follower "
+                "hosts connected to the command channel"
+            )
+        line = (json.dumps(op) + "\n").encode()
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    conn.sendall(line)
+                except OSError as e:
+                    # the dead follower's absence will surface as this
+                    # process's collectives failing; the serve manager's
+                    # health monitor handles the teardown
+                    logger.error("follower send failed: %s", e)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class BroadcastingRunner:
+    """Wraps the leader's ModelRunner: every replayable device op is
+    broadcast to the followers before running locally."""
+
+    def __init__(self, runner, leader: CommandLeader):
+        self._runner = runner
+        self._leader = leader
+
+    def __getattr__(self, name):
+        # everything not explicitly wrapped delegates (bucket_for,
+        # mesh, new_state, prefill_buckets, ...)
+        return getattr(self._runner, name)
+
+    # -- wrapped ops ------------------------------------------------------
+
+    def prefill(self, token_ids, true_len: int):
+        self._leader.broadcast({
+            "op": "prefill",
+            "ids": [int(t) for t in token_ids],
+            "true_len": int(true_len),
+        })
+        return self._runner.prefill(token_ids, true_len)
+
+    def sample_first(
+        self, last_logits, temperature, top_k, top_p, seed, seeded,
+        position, key,
+    ):
+        self._leader.broadcast({
+            "op": "sample_first",
+            "temperature": float(temperature), "top_k": int(top_k),
+            "top_p": float(top_p), "seed": int(seed),
+            "seeded": bool(seeded), "position": int(position),
+            "key": _key_data_list(key),
+        })
+        return self._runner.sample_first(
+            last_logits, temperature, top_k, top_p, seed, seeded,
+            position, key,
+        )
+
+    def insert(
+        self, state, k, v, slot, true_len, first_token,
+        temperature, top_k, top_p, seed=0, seeded=False,
+    ):
+        self._leader.broadcast({
+            "op": "insert", "slot": int(slot), "true_len": int(true_len),
+            "first_token": int(first_token),
+            "temperature": float(temperature), "top_k": int(top_k),
+            "top_p": float(top_p), "seed": int(seed),
+            "seeded": bool(seeded),
+        })
+        return self._runner.insert(
+            state, k, v, slot, true_len, first_token,
+            temperature, top_k, top_p, seed, seeded,
+        )
+
+    def decode_step(self, state, key):
+        self._leader.broadcast(
+            {"op": "decode", "key": _key_data_list(key)}
+        )
+        return self._runner.decode_step(state, key)
+
+    def deactivate(self, state, slot: int):
+        self._leader.broadcast({"op": "deactivate", "slot": int(slot)})
+        return self._runner.deactivate(state, slot)
+
+    # -- single-host-only features (disabled at command build; defensive)
+
+    def _unsupported(self, what: str):
+        # ValueError: API handlers translate it to a clean 400 (e.g. an
+        # embeddings request against a multi-host chat replica) instead
+        # of a 500/loop-death
+        raise ValueError(
+            f"{what} is not supported on multi-host replicas "
+            "(disabled at command build — worker/backends.py)"
+        )
+
+    def prefill_with_prefix(self, *a, **kw):
+        self._unsupported("prefix-cache prefill")
+
+    def prefill_with_embeds(self, *a, **kw):
+        self._unsupported("vision-token prefill")
+
+    def verify_step(self, *a, **kw):
+        self._unsupported("speculative decoding")
+
+    def ingest_step(self, *a, **kw):
+        self._unsupported("draft ingestion")
+
+    def embed(self, *a, **kw):
+        self._unsupported("embeddings")
+
+
+class FollowerLoop:
+    """Follower side: replay the leader's op stream on the local runner.
+
+    Runs in its own thread; the follower process's API server stays up
+    for liveness but receives no inference traffic (the server proxies
+    only to the leader's port)."""
+
+    def __init__(self, runner, cmd_address: str, state):
+        self.runner = runner
+        self.cmd_address = cmd_address
+        # REUSE the engine's already-created DecodeState: device_put over
+        # a global mesh is a collective (it allgathers a shape/sharding
+        # consistency check), so creating a second state here — a call
+        # the leader never makes — would deadlock the whole replica at
+        # startup. Leader and follower must perform identical sequences
+        # of collective-bearing calls from process start.
+        self.state = state
+        self._reg: Optional[tuple] = None    # latest (last, k, v) prefill
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.ops_applied = 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name="mh-follower", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _connect(self) -> socket.socket:
+        host, port = self.cmd_address.rsplit(":", 1)
+        deadline = time.monotonic() + _CONNECT_TIMEOUT_S
+        while True:
+            try:
+                sock = socket.create_connection((host, int(port)), 5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # the 5s connect timeout must NOT persist into recv() —
+                # an idle serving replica legitimately sends no commands
+                # for long stretches; use a poll-sized timeout so the
+                # loop can check _stop between reads
+                sock.settimeout(2.0)
+                return sock
+            except OSError:
+                if time.monotonic() > deadline or self._stop.is_set():
+                    raise
+                time.sleep(1.0)
+
+    def run(self) -> None:
+        sock = self._connect()
+        logger.info("connected to leader command channel %s",
+                    self.cmd_address)
+        buf = b""
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = sock.recv(1 << 16)
+                except TimeoutError:
+                    continue          # idle is normal; re-check _stop
+                if not chunk:
+                    logger.warning("leader command channel closed")
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        self._apply(json.loads(line))
+        except OSError as e:
+            logger.error("command channel error: %s", e)
+        finally:
+            sock.close()
+
+    def _apply(self, op: Dict[str, Any]) -> None:
+        kind = op["op"]
+        r = self.runner
+        if kind == "prefill":
+            self._reg = r.prefill(op["ids"], op["true_len"])
+        elif kind == "sample_first":
+            assert self._reg is not None, "sample_first before prefill"
+            r.sample_first(
+                self._reg[0], op["temperature"], op["top_k"], op["top_p"],
+                op["seed"], op["seeded"], op["position"],
+                _key_from_list(op["key"]),
+            )
+        elif kind == "insert":
+            assert self._reg is not None, "insert before prefill"
+            _, k, v = self._reg
+            self.state = r.insert(
+                self.state, k, v, op["slot"], op["true_len"],
+                op["first_token"], op["temperature"], op["top_k"],
+                op["top_p"], op["seed"], op["seeded"],
+            )
+        elif kind == "decode":
+            self.state, _ = r.decode_step(
+                self.state, _key_from_list(op["key"])
+            )
+        elif kind == "deactivate":
+            self.state = r.deactivate(self.state, op["slot"])
+        else:
+            logger.warning("unknown multihost op %r", kind)
+            return
+        self.ops_applied += 1

@@ -139,6 +139,7 @@ class ModelRunner:
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefills: Dict[int, Any] = {}
         self._prefill_embeds: Dict[int, Any] = {}
+        self._sample_first: Optional[Any] = None
         self._inserts: Dict[int, Any] = {}
         self._embeds: Dict[int, Any] = {}
         self._verifies: Dict[int, Any] = {}
@@ -436,6 +437,15 @@ class ModelRunner:
         sampled, tok_lp, top_ids, top_lps = sample(
             logits[:, 0], state.sampling, key, state.positions
         )
+        # the host reads these every step; on a multi-host mesh an
+        # unconstrained output can land dp/tp-sharded and span
+        # non-addressable devices — force replication (an allgather over
+        # a few hundred bytes)
+        rep = NamedSharding(self.mesh, P())
+        sampled, tok_lp, top_ids, top_lps = (
+            jax.lax.with_sharding_constraint(x, rep)
+            for x in (sampled, tok_lp, top_ids, top_lps)
+        )
         # Inactive slots keep feeding their last token at a frozen position;
         # their cache writes are confined to their own rows and invisible
         # through the causal mask of any future tenant.
@@ -461,6 +471,38 @@ class ModelRunner:
         [B], top_ids [B, TOPLP], top_logprobs [B, TOPLP]))`` — the
         logprob extras ride the same device round-trip as the tokens."""
         return self._decode(self.params, state, key)
+
+    def _sample_first_impl(
+        self, last_logits, temperature, top_k, top_p, seed, seeded,
+        position, key,
+    ):
+        st = SamplingState(
+            temperature=temperature[None], top_k=top_k[None],
+            top_p=top_p[None], seed=seed[None], seeded=seeded[None],
+        )
+        outs = sample(last_logits[None, :], st, key, position[None])
+        # host-read outputs must be replicated on multi-host meshes
+        rep = NamedSharding(self.mesh, P())
+        return tuple(
+            jax.lax.with_sharding_constraint(x, rep) for x in outs
+        )
+
+    def sample_first(
+        self, last_logits, temperature, top_k, top_p, seed, seeded,
+        position, key,
+    ):
+        """Sample the first generated token from a prefill's last-position
+        logits — one row through the same device sampler as decode, so
+        the whole sequence shares one sampling semantics. A runner method
+        (not engine-inline) so multi-host followers can replay it
+        (engine/multihost.py)."""
+        if self._sample_first is None:
+            self._sample_first = jax.jit(self._sample_first_impl)
+        return self._sample_first(
+            last_logits, jnp.float32(temperature), jnp.int32(top_k),
+            jnp.float32(top_p), jnp.uint32(seed), jnp.bool_(seeded),
+            jnp.int32(position), key,
+        )
 
     # -- draft-model support ---------------------------------------------
 

@@ -165,9 +165,17 @@ def run_migrations(db: Database) -> int:
         try:
             for version, desc, fn in pending:
                 fn(conn)
+                # timestamp computed host-side: datetime('now') is
+                # sqlite-only (PG spells it NOW()); a Python value keeps
+                # the statement dialect-generic
+                import datetime as _dt
+
                 conn.execute(
-                    "INSERT INTO schema_version VALUES (?, ?, datetime('now'))",
-                    (version, desc),
+                    "INSERT INTO schema_version VALUES (?, ?, ?)",
+                    (
+                        version, desc,
+                        _dt.datetime.now(_dt.timezone.utc).isoformat(),
+                    ),
                 )
                 conn.commit()
                 logger.info("applied migration %d: %s", version, desc)

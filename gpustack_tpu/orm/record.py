@@ -28,6 +28,15 @@ import pydantic
 from gpustack_tpu.orm.db import Database
 from gpustack_tpu.server.bus import Event, EventBus, EventType
 
+# Per-dialect autoincrement primary key — the single DDL divergence
+# across the backends the reference supports (its alembic migrations
+# target sqlite/postgres/mysql, gpustack/server/db.py).
+PK_CLAUSE = {
+    "sqlite": "id INTEGER PRIMARY KEY AUTOINCREMENT",
+    "postgres": "id BIGSERIAL PRIMARY KEY",
+    "mysql": "id BIGINT PRIMARY KEY AUTO_INCREMENT",
+}
+
 logger = logging.getLogger(__name__)
 
 T = TypeVar("T", bound="Record")
@@ -87,16 +96,22 @@ class Record(pydantic.BaseModel):
         return Record._bus
 
     # ---- schema ---------------------------------------------------------
+    # The autoincrement primary key is the ONE piece of DDL that differs
+    # across the dialects the reference supports (gpustack/server/db.py:
+    # sqlite/postgres/mysql); everything else this ORM emits is
+    # driver-generic SQL — mechanically enforced by
+    # tests/orm/test_dialect_conformance.py, which traces every statement
+    # the control plane issues and rejects dialect-specific constructs.
 
     @classmethod
-    def _create_table_sql(cls) -> List[str]:
+    def _create_table_sql(cls, dialect: str = "sqlite") -> List[str]:
         cols = ", ".join(
             f"{f} TEXT" for f in cls.__indexes__
         )
         cols = (", " + cols) if cols else ""
         stmts = [
             f"CREATE TABLE IF NOT EXISTS {cls.__kind__} ("
-            f"id INTEGER PRIMARY KEY AUTOINCREMENT, data TEXT NOT NULL, "
+            f"{PK_CLAUSE[dialect]}, data TEXT NOT NULL, "
             f"created_at TEXT, updated_at TEXT{cols})"
         ]
         for f in cls.__indexes__:

@@ -48,23 +48,30 @@ COORDINATOR_PORT_RANGE = 2048
 def pick_coordinator_port(
     instances, leader_worker_id: int, exclude_instance_id: int
 ) -> int:
-    """Lowest band port not claimed by another instance on this leader.
+    """Lowest even-aligned band port whose PAIR is not claimed by another
+    instance on this leader. Ports are allocated in pairs: ``p`` is the
+    jax.distributed coordinator, ``p + 1`` the leader→follower command
+    channel (engine/multihost.py) — pairing fences both with one claim.
 
     Returns 0 when the band is exhausted. The leader host additionally
-    bind-probes the chosen port before spawning (serve_manager) — this
+    bind-probes both ports before spawning (serve_manager) — this
     function fences only DB-known claims.
     """
-    used = {
-        int(i.coordinator_address.rsplit(":", 1)[1])
-        for i in instances
-        if i.coordinator_address
-        and i.worker_id == leader_worker_id
-        and i.id != exclude_instance_id
-    }
+    used = set()
+    for i in instances:
+        if (
+            i.coordinator_address
+            and i.worker_id == leader_worker_id
+            and i.id != exclude_instance_id
+        ):
+            p = int(i.coordinator_address.rsplit(":", 1)[1])
+            used.update((p, p + 1))
     for p in range(
-        COORDINATOR_PORT_BASE, COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE
+        COORDINATOR_PORT_BASE,
+        COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE,
+        2,
     ):
-        if p not in used:
+        if p not in used and p + 1 not in used:
             return p
     return 0
 

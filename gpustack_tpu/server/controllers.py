@@ -100,10 +100,44 @@ class ModelController(Controller):
     def start(self) -> None:
         super().start()
         self._queue.start()
+        # Also watch INSTANCE deletions: an instance can disappear
+        # outside any model update (user delete; subordinate-worker loss
+        # tearing down a multi-host replica) and replica sync must
+        # recreate it — model events alone never fire for those.
+        self._inst_task = asyncio.create_task(
+            self._watch_instance_deletes(), name="model-inst-watch"
+        )
 
     def stop(self) -> None:
         super().stop()
         self._queue.stop()
+        if getattr(self, "_inst_task", None):
+            self._inst_task.cancel()
+
+    async def _watch_instance_deletes(self) -> None:
+        while True:
+            try:
+                agen = ModelInstance.subscribe(heartbeat=30.0)
+                try:
+                    async for event in agen:
+                        if event.type == EventType.RESYNC:
+                            break
+                        if (
+                            event.type == EventType.DELETED
+                            and event.data
+                            and event.data.get("model_id")
+                        ):
+                            self._queue.add(int(event.data["model_id"]))
+                finally:
+                    await agen.aclose()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # one transient subscribe/DB error must not silently
+                # disable replica recreation for the rest of the
+                # server's life
+                logger.exception("instance-delete watch failed; retrying")
+                await asyncio.sleep(2.0)
 
     async def handle(self, event: Event) -> None:
         if event.type == EventType.DELETED:
@@ -258,11 +292,43 @@ class WorkerController(Controller):
         _, new = state_change
         if new == WorkerState.UNREACHABLE.value:
             for inst in await ModelInstance.filter(worker_id=event.id):
-                if inst.state == ModelInstanceState.RUNNING:
+                if inst.state != ModelInstanceState.RUNNING:
+                    continue
+                if inst.subordinate_workers:
+                    # multi-host replica that lost its LEADER: followers
+                    # cannot function alone and UNREACHABLE is not
+                    # covered by stuck-reschedule — tear down so replica
+                    # sync recreates and reschedules (freeing the
+                    # surviving hosts' chips)
+                    logger.warning(
+                        "instance %s lost its leader worker %d; tearing "
+                        "down for reschedule", inst.name, event.id,
+                    )
+                    await inst.delete()
+                else:
                     await inst.update(
                         state=ModelInstanceState.UNREACHABLE,
                         state_message="worker unreachable",
                     )
+            # A multi-host replica with this worker as a SUBORDINATE
+            # cannot function (its collectives span the dead host) and
+            # cannot recover in place — tear the instance down; the
+            # DELETED event stops the leader/sibling engines and the
+            # ModelController's replica sync creates a fresh instance to
+            # reschedule (reference role: Ray-cluster member loss fails
+            # the whole vLLM multinode replica).
+            for inst in await ModelInstance.all():
+                if inst.worker_id == event.id:
+                    continue
+                if any(
+                    sub.worker_id == event.id
+                    for sub in inst.subordinate_workers
+                ):
+                    logger.warning(
+                        "instance %s lost subordinate worker %d; tearing "
+                        "down for reschedule", inst.name, event.id,
+                    )
+                    await inst.delete()
         elif new == WorkerState.READY.value:
             # instances recover via the worker's own state sync; nothing to
             # do server-side (the worker re-reports actual health).

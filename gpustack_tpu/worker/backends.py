@@ -147,13 +147,22 @@ def _tpu_native_command(
         argv += ["--quantization", model.quantization]
     for adapter in model.lora_adapters:
         argv += ["--lora", adapter]
-    if model.prefill_chunk:
+    multi_host = bool(instance.coordinator_address)
+    if model.prefill_chunk and not multi_host:
+        # single-host only: chunked prefill's host-side chunk scheduling
+        # would have to be replayed op-for-op on follower hosts
+        # (engine/multihost.py keeps the broadcast vocabulary minimal)
         argv += ["--prefill-chunk", str(model.prefill_chunk)]
-    if model.host_kv_cache_mb and not instance.coordinator_address:
+    if model.host_kv_cache_mb and not multi_host:
         # single-host only: on multi-host meshes the prefill K/V spans
         # non-addressable devices and cannot be pulled to one host's RAM
         argv += ["--host-kv-cache-mb", str(model.host_kv_cache_mb)]
-    if model.speculative:
+    if multi_host and model.speculative:
+        logger.warning(
+            "model %s: speculative decoding is single-host only; "
+            "serving the multi-host replica without it", model.name,
+        )
+    elif model.speculative:
         if model.speculative == "draft" and not model.draft_source:
             # fail fast at command build — an engine that dies at startup
             # would crash-loop under restart_on_error with the cause
@@ -201,8 +210,12 @@ def _tpu_native_command(
     if instance.coordinator_address:
         # multi-host: jax.distributed rendezvous (replaces the reference's
         # Ray bootstrap, worker/backends/vllm.py:258-328). The engine
-        # consumes these in api_server.build_engine_from_args.
+        # consumes these in api_server.build_engine_from_args. The
+        # leader→follower command channel (engine/multihost.py) rides
+        # coordinator_port + 1 — fenced as a pair by the scheduler.
+        host, _, cport = instance.coordinator_address.rpartition(":")
         env["GPUSTACK_TPU_COORDINATOR"] = instance.coordinator_address
+        env["GPUSTACK_TPU_CMD_ADDRESS"] = f"{host}:{int(cport) + 1}"
         env["GPUSTACK_TPU_NUM_PROCESSES"] = str(
             1 + len(instance.subordinate_workers)
         )

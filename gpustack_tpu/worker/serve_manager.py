@@ -264,23 +264,27 @@ class ServeManager:
         # (reference port-band probing, serve_manager.py:1456-1508)
         if is_leader and inst.coordinator_address:
             coord_port = int(inst.coordinator_address.rsplit(":", 1)[1])
-            with socket.socket(
-                socket.AF_INET, socket.SOCK_STREAM
-            ) as probe:
-                # SO_REUSEADDR: TIME_WAIT remnants of a crashed leader's
-                # coordinator must not fail the restart path
-                probe.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
-                )
-                try:
-                    probe.bind(("0.0.0.0", coord_port))
-                except OSError as e:
-                    await self._set_state(
-                        instance_id,
-                        ModelInstanceState.ERROR,
-                        f"coordinator port {coord_port} unavailable: {e}",
+            # probe the pair: coordinator + command channel (+1,
+            # engine/multihost.py) — both must be free on this host
+            for probe_port in (coord_port, coord_port + 1):
+                with socket.socket(
+                    socket.AF_INET, socket.SOCK_STREAM
+                ) as probe:
+                    # SO_REUSEADDR: TIME_WAIT remnants of a crashed
+                    # leader's coordinator must not fail the restart path
+                    probe.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
                     )
-                    return
+                    try:
+                        probe.bind(("0.0.0.0", probe_port))
+                    except OSError as e:
+                        await self._set_state(
+                            instance_id,
+                            ModelInstanceState.ERROR,
+                            f"coordinator port {probe_port} "
+                            f"unavailable: {e}",
+                        )
+                        return
 
         run = self.running.get(instance_id) or RunningInstance(
             instance_id, port
