@@ -251,6 +251,21 @@ class Record(pydantic.BaseModel):
         return [cls._from_row(r) for r in rows]
 
     @classmethod
+    async def filter_created_after(
+        cls: Type[T], cutoff_iso: str, limit: Optional[int] = None
+    ) -> List[T]:
+        """Rows with created_at >= cutoff, oldest first (dashboard
+        time-series reads)."""
+        sql = (
+            f"SELECT * FROM {cls.__kind__} WHERE created_at >= ? "
+            f"ORDER BY created_at"
+        )
+        if limit is not None:
+            sql += f" LIMIT {int(limit)}"
+        rows = await cls.db().execute(sql, [cutoff_iso])
+        return [cls._from_row(r) for r in rows]
+
+    @classmethod
     async def first(cls: Type[T], **conds: Any) -> Optional[T]:
         items = await cls.filter(limit=1, **conds)
         return items[0] if items else None

@@ -1,0 +1,37 @@
+"""Dialect-aware SQL fragments.
+
+Like PK_CLAUSE (orm/record.py), these are the ONLY places dialect-specific
+spellings may live; query code composes them instead of hardcoding
+sqlite-isms. tests/orm/test_dialect_conformance.py enforces this two
+ways: the ORM statement trace rejects hardcoded constructs, and a source
+scan asserts ``json_extract`` appears nowhere outside this module.
+"""
+
+from __future__ import annotations
+
+_JSON_NUM = {
+    # sqlite: json1 extract; numeric affinity handles SUM/ORDER
+    "sqlite": "json_extract({col}, '$.{field}')",
+    # postgres: jsonb text accessor + explicit numeric cast
+    "postgres": "(({col})::jsonb ->> '{field}')::numeric",
+    # mysql: unquoted extract; implicit numeric coercion in aggregates
+    "mysql": "JSON_UNQUOTE(JSON_EXTRACT({col}, '$.{field}'))",
+}
+
+_JSON_TEXT = {
+    "sqlite": "json_extract({col}, '$.{field}')",
+    "postgres": "(({col})::jsonb ->> '{field}')",
+    "mysql": "JSON_UNQUOTE(JSON_EXTRACT({col}, '$.{field}'))",
+}
+
+DIALECTS = tuple(_JSON_NUM)
+
+
+def json_num(field: str, col: str = "data", dialect: str = "sqlite") -> str:
+    """Numeric JSON field accessor for aggregates (SUM/ORDER BY)."""
+    return _JSON_NUM[dialect].format(col=col, field=field)
+
+
+def json_text(field: str, col: str = "data", dialect: str = "sqlite") -> str:
+    """Textual JSON field accessor."""
+    return _JSON_TEXT[dialect].format(col=col, field=field)

@@ -14,6 +14,7 @@ import logging
 
 from aiohttp import web
 
+from gpustack_tpu.orm.sql import json_num, json_text
 from gpustack_tpu.routes.crud import json_error
 from gpustack_tpu.scheduler.calculator import (
     EvaluationError,
@@ -155,29 +156,25 @@ def add_extra_routes(app: web.Application) -> None:
         worker/system tokens are rejected."""
         from gpustack_tpu.orm.record import Record
 
-        principal = request.get("principal")
-        if principal is None or (
-            principal.kind != "user" and not principal.is_admin
-        ):
-            return json_error(403, "user token required")
-
-        # non-admins see only their own usage in every section
-        where = "" if principal.is_admin else " WHERE user_id = ?"
-        params: list = [] if principal.is_admin else [principal.user.id]
+        # shared admin/user visibility rule (same helper as the series
+        # and top-N endpoints — one place to change scoping semantics)
+        scope, params, err = _principal_scope(request)
+        if err is not None:
+            return err
         rows = await Record.db().execute(
             "SELECT route_name AS route, "
             "COUNT(*) AS requests, "
-            "COALESCE(SUM(json_extract(data, '$.prompt_tokens')), 0) AS pt, "
-            "COALESCE(SUM(json_extract(data, '$.completion_tokens')), 0) "
+            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) AS pt, "
+            f"COALESCE(SUM({json_num('completion_tokens')}), 0) "
             "AS ct "
-            f"FROM model_usage{where} "
+            f"FROM model_usage WHERE 1=1{scope} "
             "GROUP BY route_name ORDER BY requests DESC",
             params,
         )
         by_user = await Record.db().execute(
             "SELECT user_id, COUNT(*) AS requests, "
-            "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
-            f"FROM model_usage{where} GROUP BY user_id",
+            f"COALESCE(SUM({json_num('total_tokens')}), 0) AS tok "
+            f"FROM model_usage WHERE 1=1{scope} GROUP BY user_id",
             params,
         )
         return web.json_response(
@@ -266,10 +263,206 @@ def add_extra_routes(app: web.Application) -> None:
             text=yaml_text, content_type="application/yaml"
         )
 
+    # ---- dashboard depth (reference routes/dashboard.py 741 LoC,
+    # usage.py 1,179 LoC, resource_usage.py 1,412 LoC: time-series,
+    # per-entity breakdowns, top-N) ------------------------------------
+
+    def _principal_scope(request):
+        """(where-fragment, params, err) applying per-user visibility."""
+        principal = request.get("principal")
+        if principal is None or (
+            principal.kind != "user" and not principal.is_admin
+        ):
+            return "", [], json_error(403, "user token required")
+        if principal.is_admin:
+            return "", [], None
+        return " AND user_id = ?", [principal.user.id], None
+
+    def _window(request, default_hours=24, max_hours=24 * 90):
+        try:
+            hours = float(request.query.get("hours", default_hours))
+        except ValueError:
+            return None, json_error(400, "'hours' must be a number")
+        if not 0 < hours <= max_hours:
+            return None, json_error(
+                400, f"'hours' must be in (0, {max_hours}]"
+            )
+        import datetime as _dt
+
+        cutoff = (
+            _dt.datetime.now(_dt.timezone.utc)
+            - _dt.timedelta(hours=hours)
+        ).isoformat()
+        return cutoff, None
+
+    async def usage_series(request: web.Request):
+        """Token/request time series, bucketed by hour or day, optional
+        per-route split (reference usage.py get_model_usage series)."""
+        from gpustack_tpu.orm.record import Record
+
+        scope, params, err = _principal_scope(request)
+        if err is not None:
+            return err
+        cutoff, err = _window(request)
+        if err is not None:
+            return err
+        bucket = request.query.get("bucket", "hour")
+        if bucket not in ("hour", "day"):
+            return json_error(400, "'bucket' must be hour|day")
+        # ISO timestamps bucket by prefix: 13 chars = YYYY-MM-DDTHH,
+        # 10 = YYYY-MM-DD (SUBSTR is dialect-generic)
+        width = 13 if bucket == "hour" else 10
+        route = request.query.get("route", "")
+        route_clause = " AND route_name = ?" if route else ""
+        q = (
+            f"SELECT SUBSTR(created_at, 1, {width}) AS ts, "
+            "route_name AS route, COUNT(*) AS requests, "
+            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) "
+            "AS pt, "
+            f"COALESCE(SUM({json_num('completion_tokens')}), 0)"
+            " AS ct "
+            "FROM model_usage WHERE created_at >= ?"
+            f"{scope}{route_clause} "
+            "GROUP BY ts, route_name ORDER BY ts"
+        )
+        rows = await Record.db().execute(
+            q, [cutoff] + params + ([route] if route else [])
+        )
+        return web.json_response({
+            "bucket": bucket,
+            "series": [
+                {
+                    "ts": r["ts"],
+                    "route": r["route"],
+                    "requests": r["requests"],
+                    "prompt_tokens": int(r["pt"]),
+                    "completion_tokens": int(r["ct"]),
+                    "total_tokens": int(r["pt"]) + int(r["ct"]),
+                }
+                for r in rows
+            ],
+        })
+
+    async def top_models(request: web.Request):
+        """Top-N routes by total tokens over the window (reference
+        dashboard.py get_top_models)."""
+        from gpustack_tpu.orm.record import Record
+
+        scope, params, err = _principal_scope(request)
+        if err is not None:
+            return err
+        cutoff, err = _window(request)
+        if err is not None:
+            return err
+        try:
+            limit = int(request.query.get("limit", 10))
+        except ValueError:
+            return json_error(400, "'limit' must be an integer")
+        limit = max(1, min(100, limit))
+        rows = await Record.db().execute(
+            "SELECT route_name AS route, COUNT(*) AS requests, "
+            f"COALESCE(SUM({json_num('total_tokens')}), 0) "
+            "AS tok, "
+            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) "
+            "AS pt, "
+            f"COALESCE(SUM({json_num('completion_tokens')}), 0)"
+            " AS ct "
+            "FROM model_usage WHERE created_at >= ?"
+            f"{scope} "
+            "GROUP BY route_name ORDER BY tok DESC LIMIT ?",
+            [cutoff] + params + [limit],
+        )
+        return web.json_response({
+            "items": [
+                {
+                    "route": r["route"],
+                    "requests": r["requests"],
+                    "total_tokens": int(r["tok"]),
+                    "prompt_tokens": int(r["pt"]),
+                    "completion_tokens": int(r["ct"]),
+                }
+                for r in rows
+            ],
+        })
+
+    async def usage_by_user(request: web.Request):
+        """Per-user×operation breakdown over the window (admin-only —
+        reference usage.py per-user tables)."""
+        from gpustack_tpu.orm.record import Record
+        from gpustack_tpu.routes.crud import require_admin
+
+        err = require_admin(request)
+        if err is not None:
+            return err
+        cutoff, err = _window(request)
+        if err is not None:
+            return err
+        rows = await Record.db().execute(
+            "SELECT user_id, "
+            f"{json_num('operation')} AS op, "
+            "COUNT(*) AS requests, "
+            f"COALESCE(SUM({json_num('total_tokens')}), 0) "
+            "AS tok "
+            "FROM model_usage WHERE created_at >= ? "
+            "GROUP BY user_id, op ORDER BY tok DESC",
+            [cutoff],
+        )
+        return web.json_response({
+            "items": [
+                {
+                    # index columns are stored TEXT; normalize for clients
+                    "user_id": int(r["user_id"] or 0),
+                    "operation": r["op"] or "",
+                    "requests": r["requests"],
+                    "total_tokens": int(r["tok"]),
+                }
+                for r in rows
+            ],
+        })
+
+    async def worker_history(request: web.Request):
+        """Fleet utilization time series from SystemLoad snapshots
+        (reference resource_usage.py / system_load history; admin)."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.server.collectors import SystemLoad
+
+        err = require_admin(request)
+        if err is not None:
+            return err
+        cutoff, err = _window(request)
+        if err is not None:
+            return err
+        # bound the response: a 90-day window over 60s samples is ~130k
+        # rows — fetch a capped range and stride-sample to <=500 points
+        samples = await SystemLoad.filter_created_after(
+            cutoff, limit=20000
+        )
+        if len(samples) > 500:
+            stride = len(samples) // 500 + 1
+            samples = samples[::stride]
+        return web.json_response({
+            "series": [
+                {
+                    "ts": s.created_at,
+                    "workers_total": s.workers_total,
+                    "workers_ready": s.workers_ready,
+                    "chips_total": s.chips_total,
+                    "chips_allocated": s.chips_allocated,
+                    "memory_used_bytes": s.memory_used_bytes,
+                    "memory_total_bytes": s.memory_total_bytes,
+                }
+                for s in samples
+            ],
+        })
+
     app.router.add_get("/v2/model-catalog", catalog)
     app.router.add_post("/v2/models/evaluate", evaluate)
     app.router.add_get("/v2/usage/summary", usage_summary)
+    app.router.add_get("/v2/usage/series", usage_series)
+    app.router.add_get("/v2/usage/by-user", usage_by_user)
     app.router.add_get("/v2/dashboard", dashboard)
+    app.router.add_get("/v2/dashboard/top-models", top_models)
+    app.router.add_get("/v2/dashboard/worker-history", worker_history)
     app.router.add_get(
         "/v2/clusters/{id:\\d+}/manifests", cluster_manifests
     )

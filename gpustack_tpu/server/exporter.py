@@ -79,7 +79,7 @@ class ServerExporter:
 
         rows = await Record.db().execute(
             "SELECT COUNT(*) AS n, "
-            "COALESCE(SUM(json_extract(data, '$.total_tokens')), 0) AS tok "
+            f"COALESCE(SUM({json_num('total_tokens')}), 0) AS tok "
             "FROM model_usage"
         )
         lines += [

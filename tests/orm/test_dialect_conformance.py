@@ -109,6 +109,48 @@ def test_control_plane_sql_is_dialect_generic(traced_db):
     )
 
 
+def test_json_accessor_covers_reference_dialects():
+    """JSON field access (dashboard/usage/exporter SQL) goes through the
+    per-dialect helpers — never a hardcoded json_extract."""
+    from gpustack_tpu.orm.sql import DIALECTS, json_num, json_text
+
+    assert set(DIALECTS) == {"sqlite", "postgres", "mysql"}
+    assert json_num("total_tokens") == (
+        "json_extract(data, '$.total_tokens')"
+    )
+    assert "::jsonb" in json_num("x", dialect="postgres")
+    assert "::numeric" in json_num("x", dialect="postgres")
+    assert "JSON_EXTRACT" in json_num("x", dialect="mysql")
+    assert json_text("op", dialect="postgres").endswith("'op')")
+
+
+def test_no_hardcoded_json_extract_in_sources():
+    """Source scan: route/exporter SQL must compose orm/sql.py helpers
+    (the runtime trace can't see route SQL, so this closes that gap)."""
+    import os
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "gpustack_tpu",
+    )
+    offenders = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if path.endswith(os.path.join("orm", "sql.py")):
+                continue
+            with open(path) as f:
+                if "json_extract(" in f.read():
+                    offenders.append(os.path.relpath(path, root))
+    assert not offenders, (
+        f"hardcoded json_extract in {offenders}; use orm/sql.py helpers"
+    )
+
+
 def test_pk_clause_covers_reference_dialects():
     assert set(PK_CLAUSE) == {"sqlite", "postgres", "mysql"}
     # each spelling is self-consistent with its dialect

@@ -152,6 +152,138 @@ def test_usage_summary_and_dashboard(ctx):
     _client_run(ctx, go)
 
 
+def test_dashboard_series_and_top_models(ctx):
+    """Time-series + top-N + per-user + worker-history depth (reference
+    routes/dashboard.py + usage.py + resource_usage.py aggregations)."""
+
+    async def go(client, hdrs):
+        import datetime as dt
+
+        await _add_v5e8_worker()
+        # pin created_at: wall-clock rows straddling an hour boundary
+        # would split into two buckets and flake the exact-sum asserts
+        now = dt.datetime.now(dt.timezone.utc)
+        ts = now.replace(minute=30, second=0).isoformat()
+        # usage spread across two routes and two users
+        for i in range(4):
+            await ModelUsage.create(ModelUsage(
+                user_id=1, model_id=1, route_name="chat-a",
+                operation="chat", prompt_tokens=100,
+                completion_tokens=20, total_tokens=120,
+                created_at=ts,
+            ))
+        for i in range(2):
+            await ModelUsage.create(ModelUsage(
+                user_id=2, model_id=2, route_name="embed-b",
+                operation="embedding", prompt_tokens=50,
+                completion_tokens=0, total_tokens=50,
+                created_at=ts,
+            ))
+
+        # hourly series: every row landed "now", so exactly one bucket
+        # per route with correct sums
+        r = await client.get("/v2/usage/series?hours=2", headers=hdrs)
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert data["bucket"] == "hour"
+        by_route = {s["route"]: s for s in data["series"]}
+        assert by_route["chat-a"]["requests"] == 4
+        assert by_route["chat-a"]["prompt_tokens"] == 400
+        assert by_route["chat-a"]["total_tokens"] == 480
+        assert by_route["embed-b"]["requests"] == 2
+        assert len(by_route["chat-a"]["ts"]) == 13   # YYYY-MM-DDTHH
+
+        # day buckets + route filter
+        r = await client.get(
+            "/v2/usage/series?hours=24&bucket=day&route=embed-b",
+            headers=hdrs,
+        )
+        data = await r.json()
+        assert [s["route"] for s in data["series"]] == ["embed-b"]
+        assert len(data["series"][0]["ts"]) == 10    # YYYY-MM-DD
+
+        # top models ranked by tokens
+        r = await client.get(
+            "/v2/dashboard/top-models?hours=24&limit=1", headers=hdrs
+        )
+        data = await r.json()
+        assert len(data["items"]) == 1
+        assert data["items"][0]["route"] == "chat-a"
+        assert data["items"][0]["total_tokens"] == 480
+
+        # per-user breakdown (admin)
+        r = await client.get("/v2/usage/by-user", headers=hdrs)
+        data = await r.json()
+        got = {
+            (i["user_id"], i["operation"]): i["total_tokens"]
+            for i in data["items"]
+        }
+        assert got[(1, "chat")] == 480
+        assert got[(2, "embedding")] == 100
+
+        # worker utilization history from SystemLoad snapshots
+        from gpustack_tpu.server.collectors import SystemLoadCollector
+
+        await SystemLoadCollector().collect_once()
+        r = await client.get(
+            "/v2/dashboard/worker-history?hours=1", headers=hdrs
+        )
+        data = await r.json()
+        assert len(data["series"]) == 1
+        assert data["series"][0]["chips_total"] == 8
+        assert data["series"][0]["workers_ready"] == 1
+
+        # bad params rejected
+        r = await client.get("/v2/usage/series?hours=0", headers=hdrs)
+        assert r.status == 400
+        r = await client.get(
+            "/v2/usage/series?bucket=minute", headers=hdrs
+        )
+        assert r.status == 400
+
+    _client_run(ctx, go)
+
+
+def test_dashboard_series_scoped_to_non_admin(ctx):
+    """Non-admin callers see only their own usage in series/top-N."""
+
+    async def go(client, hdrs):
+        alice = await User.create(
+            User(
+                username="alice",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        atoken = auth_mod.issue_session_token(alice, ctx.jwt_secret)
+        ahdrs = {"Authorization": f"Bearer {atoken}"}
+        await ModelUsage.create(ModelUsage(
+            user_id=alice.id, route_name="mine",
+            prompt_tokens=7, completion_tokens=3, total_tokens=10,
+        ))
+        await ModelUsage.create(ModelUsage(
+            user_id=alice.id + 100, route_name="theirs",
+            prompt_tokens=70, completion_tokens=30, total_tokens=100,
+        ))
+
+        r = await client.get("/v2/usage/series", headers=ahdrs)
+        data = await r.json()
+        assert [s["route"] for s in data["series"]] == ["mine"]
+
+        r = await client.get("/v2/dashboard/top-models", headers=ahdrs)
+        data = await r.json()
+        assert [i["route"] for i in data["items"]] == ["mine"]
+
+        # admin-only surfaces refuse
+        r = await client.get("/v2/usage/by-user", headers=ahdrs)
+        assert r.status == 403
+        r = await client.get(
+            "/v2/dashboard/worker-history", headers=ahdrs
+        )
+        assert r.status == 403
+
+    _client_run(ctx, go)
+
+
 def test_cluster_manifests(ctx):
     async def go(client, hdrs):
         from gpustack_tpu.schemas import Cluster
