@@ -252,13 +252,16 @@ class Record(pydantic.BaseModel):
 
     @classmethod
     async def filter_created_after(
-        cls: Type[T], cutoff_iso: str, limit: Optional[int] = None
+        cls: Type[T], cutoff_iso: str, limit: Optional[int] = None,
+        newest_first: bool = False,
     ) -> List[T]:
         """Rows with created_at >= cutoff, oldest first (dashboard
-        time-series reads)."""
+        time-series reads). ``newest_first`` flips the order so a LIMIT
+        keeps the most RECENT rows of a large window."""
+        order = "DESC" if newest_first else "ASC"
         sql = (
             f"SELECT * FROM {cls.__kind__} WHERE created_at >= ? "
-            f"ORDER BY created_at"
+            f"ORDER BY created_at {order}"
         )
         if limit is not None:
             sql += f" LIMIT {int(limit)}"

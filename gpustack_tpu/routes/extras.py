@@ -399,7 +399,7 @@ def add_extra_routes(app: web.Application) -> None:
             return err
         rows = await Record.db().execute(
             "SELECT user_id, "
-            f"{json_num('operation')} AS op, "
+            f"{json_text('operation')} AS op, "
             "COUNT(*) AS requests, "
             f"COALESCE(SUM({json_num('total_tokens')}), 0) "
             "AS tok "
@@ -433,10 +433,12 @@ def add_extra_routes(app: web.Application) -> None:
         if err is not None:
             return err
         # bound the response: a 90-day window over 60s samples is ~130k
-        # rows — fetch a capped range and stride-sample to <=500 points
+        # rows — keep the NEWEST rows of the window (a dashboard without
+        # current data is useless), then stride-sample to <=500 points
         samples = await SystemLoad.filter_created_after(
-            cutoff, limit=20000
+            cutoff, limit=20000, newest_first=True
         )
+        samples.reverse()            # chronological for the client
         if len(samples) > 500:
             stride = len(samples) // 500 + 1
             samples = samples[::stride]

@@ -70,7 +70,13 @@ async def _resolve_model(name: str):
     """
     route = await ModelRoute.first(name=name)
     if route is not None and route.enabled and route.targets:
-        targets = route.targets
+        # fast path: skip targets the RouteTargetController marked
+        # unavailable (no probe needed); if EVERY target is marked
+        # down, fall back to the full list — the controller's view may
+        # lag an instance that just came up
+        targets = [
+            t for t in route.targets if t.state != "unavailable"
+        ] or route.targets
         total = sum(max(t.weight, 0) for t in targets) or len(targets)
         pick = random.uniform(0, total)
         acc = 0.0

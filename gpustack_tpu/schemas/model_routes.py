@@ -24,6 +24,12 @@ class ModelRouteTarget(pydantic.BaseModel):
     # ``provider_model`` as the upstream model name; model_id is ignored.
     provider_id: int = 0
     provider_model: str = ""
+    # Health synced by RouteTargetController (reference
+    # ModelRouteTargetController._sync_state: ACTIVE when the backing
+    # model has ready replicas / the provider is reachable): resolution
+    # skips "unavailable" targets on the fast path; "unknown" (never
+    # synced) is treated as eligible.
+    state: str = "unknown"          # unknown | active | unavailable
 
 
 @register_record

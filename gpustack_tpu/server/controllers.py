@@ -143,11 +143,19 @@ class ModelController(Controller):
         if event.type == EventType.DELETED:
             for inst in await ModelInstance.filter(model_id=event.id):
                 await inst.delete()
-            route = await ModelRoute.first(name=event.data["name"])
-            if route is not None and any(
-                t.model_id == event.id for t in route.targets
-            ):
-                await route.delete()
+            # drop every route this model backed: its own name AND any
+            # LoRA alias routes (reference deletes lora child routes with
+            # the base model)
+            for route in await ModelRoute.all():
+                if any(t.model_id == event.id for t in route.targets):
+                    remaining = [
+                        t for t in route.targets
+                        if t.model_id != event.id
+                    ]
+                    if remaining:
+                        await route.update(targets=remaining)
+                    else:
+                        await route.delete()
             return
         self._queue.add(event.id)
 
@@ -204,6 +212,61 @@ class ModelController(Controller):
             )
         elif not any(t.model_id == model.id for t in route.targets):
             await route.update(targets=route.targets + [target])
+        await self._ensure_lora_routes(model)
+
+    async def _ensure_lora_routes(self, model: Model) -> None:
+        """One route alias per LoRA adapter: clients can request the
+        adapter by name, OpenAI-style (reference
+        server/lora_model_routes.py create_lora_model_routes — one
+        ModelRoute+Target per lora_list entry, idempotent, cross-model
+        name conflicts rejected). Divergence, documented: this engine
+        merges adapters at load (engine/weights.py), so every alias of a
+        deployment serves the same merged weights — the alias surface
+        exists for API compatibility, not per-request adapter switching."""
+        import os as _os
+
+        def alias_for(adapter: str) -> str:
+            return _os.path.basename(str(adapter).rstrip("/")) or adapter
+
+        wanted = {
+            f"{model.name}:{alias_for(a)}" for a in model.lora_adapters
+        }
+        # reconcile removals: an adapter dropped from the model must take
+        # its alias route with it (creation alone would leak stale
+        # aliases until model deletion)
+        prefix = f"{model.name}:"
+        for route in await ModelRoute.all():
+            if (
+                route.name.startswith(prefix)
+                and route.name not in wanted
+                and all(t.model_id == model.id for t in route.targets)
+            ):
+                logger.info("removing stale LoRA route %r", route.name)
+                await route.delete()
+        for adapter in model.lora_adapters:
+            route_name = f"{model.name}:{alias_for(adapter)}"
+            existing = await ModelRoute.first(name=route_name)
+            if existing is not None:
+                if any(
+                    t.model_id == model.id for t in existing.targets
+                ):
+                    continue     # already ours — idempotent
+                logger.error(
+                    "LoRA route name %r conflicts with an existing route "
+                    "not owned by model %s; skipping alias",
+                    route_name, model.name,
+                )
+                continue
+            await ModelRoute.create(ModelRoute(
+                name=route_name,
+                targets=[ModelRouteTarget(
+                    model_id=model.id, model_name=model.name, weight=100
+                )],
+            ))
+            logger.info(
+                "created LoRA route %r -> model %s", route_name,
+                model.name,
+            )
 
 
 class ModelProviderController(Controller):
@@ -274,6 +337,111 @@ class ModelProviderController(Controller):
             state_message="",
             discovered_models=sorted(names),
         )
+
+
+class RouteTargetController(Controller):
+    """Sync ModelRouteTarget health from instance/provider state
+    (reference ModelRouteTargetController._sync_state,
+    server/controllers.py:2946-3030: a target is ACTIVE when its model
+    has ready replicas or its provider is enabled; resolution then skips
+    unavailable targets without probing them)."""
+
+    record_cls = ModelInstance
+
+    def start(self) -> None:
+        super().start()
+        self._provider_task = asyncio.create_task(
+            self._watch_providers(), name="route-target-providers"
+        )
+
+    def stop(self) -> None:
+        super().stop()
+        if getattr(self, "_provider_task", None):
+            self._provider_task.cancel()
+
+    async def handle(self, event: Event) -> None:
+        data = event.data or {}
+        model_id = int(data.get("model_id") or 0)
+        if not model_id:
+            return
+        if event.type == EventType.UPDATED and not (
+            event.changes and "state" in event.changes
+        ):
+            return
+        await self.sync_model_targets(model_id)
+
+    async def sync_model_targets(self, model_id: int) -> None:
+        running = await ModelInstance.filter(
+            model_id=model_id, state=ModelInstanceState.RUNNING
+        )
+        state = "active" if running else "unavailable"
+        for route_id in [r.id for r in await ModelRoute.all()]:
+            # re-fetch right before writing: Record.save overwrites the
+            # whole document, so a list snapshot taken before the awaits
+            # could clobber a target another controller just appended
+            route = await ModelRoute.get(route_id)
+            if route is None:
+                continue
+            # copies, not in-place mutation: Record.update diffs old vs
+            # new and a mutated shared list compares equal to itself
+            changed = False
+            new_targets = []
+            for t in route.targets:
+                if t.provider_id == 0 and t.model_id == model_id and (
+                    t.state != state
+                ):
+                    t = t.model_copy(update={"state": state})
+                    changed = True
+                new_targets.append(t)
+            if changed:
+                await route.update(targets=new_targets)
+
+    async def _watch_providers(self) -> None:
+        while True:
+            try:
+                agen = ModelProvider.subscribe(heartbeat=30.0)
+                try:
+                    async for event in agen:
+                        if event.type == EventType.RESYNC:
+                            break
+                        if event.type == EventType.HEARTBEAT:
+                            continue
+                        await self._sync_provider_targets(event)
+                finally:
+                    await agen.aclose()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("provider target sync failed; retrying")
+                await asyncio.sleep(2.0)
+
+    async def _sync_provider_targets(self, event: Event) -> None:
+        pid = event.id
+        if event.type == EventType.DELETED:
+            state = "unavailable"
+        else:
+            provider = await ModelProvider.get(pid)
+            if provider is None:
+                return
+            state = (
+                "active"
+                if provider.enabled
+                and provider.state != ModelProviderState.UNREACHABLE
+                else "unavailable"
+            )
+        for route_id in [r.id for r in await ModelRoute.all()]:
+            route = await ModelRoute.get(route_id)
+            if route is None:
+                continue
+            changed = False
+            new_targets = []
+            for t in route.targets:
+                if t.provider_id == pid and t.state != state:
+                    t = t.model_copy(update={"state": state})
+                    changed = True
+                new_targets.append(t)
+            if changed:
+                await route.update(targets=new_targets)
 
 
 class WorkerController(Controller):

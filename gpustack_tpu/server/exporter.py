@@ -11,6 +11,7 @@ from typing import List
 
 from aiohttp import web
 
+from gpustack_tpu.orm.sql import json_num
 from gpustack_tpu.schemas import (
     Model,
     ModelInstance,

@@ -114,9 +114,12 @@ class Server:
         )
         from gpustack_tpu.cloud.controller import WorkerPoolController
 
+        from gpustack_tpu.server.controllers import RouteTargetController
+
         self.controllers = [
             ModelController(),
             ModelProviderController(),
+            RouteTargetController(),
             WorkerController(),
             WorkerPoolController(
                 server_url=cfg.advertised_url

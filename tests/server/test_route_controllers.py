@@ -1,0 +1,185 @@
+"""Route-target health sync + LoRA auto-routes.
+
+Reference parity: ModelRouteTargetController._sync_state (controllers.py:
+2946-3030 — target ACTIVE iff the backing model has ready replicas /
+the provider is live) and server/lora_model_routes.py (one route alias
+per LoRA adapter, idempotent, cross-model conflicts rejected).
+"""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    ModelProvider,
+    ModelRoute,
+    ModelRouteTarget,
+)
+from gpustack_tpu.server.bus import Event, EventBus, EventType
+from gpustack_tpu.server.controllers import (
+    ModelController,
+    RouteTargetController,
+)
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def test_target_state_follows_instance_state(db):
+    async def go():
+        model = await Model.create(Model(name="m", preset="tiny"))
+        await ModelRoute.create(ModelRoute(
+            name="alias",
+            targets=[ModelRouteTarget(model_id=model.id, model_name="m")],
+        ))
+        ctrl = RouteTargetController()
+
+        inst = await ModelInstance.create(ModelInstance(
+            name="m-0", model_id=model.id,
+            state=ModelInstanceState.RUNNING,
+        ))
+        await ctrl.sync_model_targets(model.id)
+        route = await ModelRoute.first(name="alias")
+        assert route.targets[0].state == "active"
+
+        await inst.update(state=ModelInstanceState.ERROR)
+        await ctrl.sync_model_targets(model.id)
+        route = await ModelRoute.first(name="alias")
+        assert route.targets[0].state == "unavailable"
+
+        # event plumbing: a state-change event triggers the same sync
+        await inst.update(state=ModelInstanceState.RUNNING)
+        await ctrl.handle(Event(
+            kind="model_instance",
+            type=EventType.UPDATED, id=inst.id,
+            data={"model_id": model.id},
+            changes={"state": ("error", "running")},
+        ))
+        route = await ModelRoute.first(name="alias")
+        assert route.targets[0].state == "active"
+
+    asyncio.run(go())
+
+
+def test_provider_target_state_follows_provider(db):
+    async def go():
+        p = await ModelProvider.create(
+            ModelProvider(name="ext", base_url="http://x.test/v1")
+        )
+        await ModelRoute.create(ModelRoute(
+            name="ext-alias",
+            targets=[ModelRouteTarget(
+                provider_id=p.id, provider_model="gpt-x"
+            )],
+        ))
+        ctrl = RouteTargetController()
+        await ctrl._sync_provider_targets(Event(
+            kind="model_provider",
+            type=EventType.UPDATED, id=p.id, data={}
+        ))
+        route = await ModelRoute.first(name="ext-alias")
+        assert route.targets[0].state == "active"
+
+        await p.update(enabled=False)
+        await ctrl._sync_provider_targets(Event(
+            kind="model_provider",
+            type=EventType.UPDATED, id=p.id, data={}
+        ))
+        route = await ModelRoute.first(name="ext-alias")
+        assert route.targets[0].state == "unavailable"
+
+        await ctrl._sync_provider_targets(Event(
+            kind="model_provider",
+            type=EventType.DELETED, id=p.id, data={}
+        ))
+        route = await ModelRoute.first(name="ext-alias")
+        assert route.targets[0].state == "unavailable"
+
+    asyncio.run(go())
+
+
+def test_resolution_skips_unavailable_targets(db):
+    """The weighted pick never lands on a target marked unavailable
+    (unless every target is marked down — then it degrades to probing)."""
+    from gpustack_tpu.routes.openai_proxy import _resolve_model
+
+    async def go():
+        live = await Model.create(Model(name="live", preset="tiny"))
+        dead = await Model.create(Model(name="dead", preset="tiny"))
+        await ModelRoute.create(ModelRoute(
+            name="ha",
+            targets=[
+                ModelRouteTarget(
+                    model_id=dead.id, model_name="dead",
+                    weight=100, state="unavailable",
+                ),
+                ModelRouteTarget(
+                    model_id=live.id, model_name="live",
+                    weight=0, priority=5, state="active",
+                ),
+            ],
+        ))
+        for _ in range(6):
+            resolved = await _resolve_model("ha")
+            assert resolved is not None and resolved.name == "live"
+
+    asyncio.run(go())
+
+
+def test_lora_auto_routes(db):
+    async def go():
+        ctrl = ModelController()
+        model = await Model.create(Model(
+            name="base", preset="tiny",
+            lora_adapters=["/adapters/style-a", "/adapters/style-b/"],
+        ))
+        await ctrl._ensure_route(model)
+        for alias in ("base:style-a", "base:style-b"):
+            route = await ModelRoute.first(name=alias)
+            assert route is not None, alias
+            assert route.targets[0].model_id == model.id
+        # idempotent: re-ensure does not duplicate
+        await ctrl._ensure_route(model)
+        assert len(await ModelRoute.filter(name="base:style-a")) == 1
+
+        # cross-model conflict: another model may not steal the alias
+        other = await Model.create(Model(
+            name="other", preset="tiny", lora_adapters=["/x/style-a"],
+        ))
+        # the conflicting alias would be other:style-a (no clash) — force
+        # a real clash by naming the model so its alias collides
+        clash = await Model.create(Model(
+            name="base", preset="tiny", lora_adapters=["/y/style-a"],
+        ))
+        await ctrl._ensure_route(clash)
+        route = await ModelRoute.first(name="base:style-a")
+        # still owned by the original model
+        assert route.targets[0].model_id == model.id
+
+        # dropping an adapter removes its alias on the next reconcile
+        await model.update(lora_adapters=["/adapters/style-a"])
+        await ctrl._ensure_route(await Model.get(model.id))
+        assert await ModelRoute.first(name="base:style-b") is None
+        assert await ModelRoute.first(name="base:style-a") is not None
+
+        # deleting the base model removes its alias routes too
+        await ctrl.handle(Event(
+            kind="model",
+            type=EventType.DELETED, id=model.id,
+            data={"name": "base"},
+        ))
+        assert await ModelRoute.first(name="base:style-a") is None
+        assert await ModelRoute.first(name="base:style-b") is None
+
+    asyncio.run(go())
