@@ -441,7 +441,9 @@ def add_extra_routes(app: web.Application) -> None:
         samples.reverse()            # chronological for the client
         if len(samples) > 500:
             stride = len(samples) // 500 + 1
-            samples = samples[::stride]
+            # anchor the stride on the NEWEST sample (dashboards read
+            # the last point as "current"), not the oldest
+            samples = samples[::-1][::stride][::-1]
         return web.json_response({
             "series": [
                 {
@@ -457,6 +459,133 @@ def add_extra_routes(app: web.Application) -> None:
             ],
         })
 
+    # Runtime-updatable config fields (reference reload-config whitelist,
+    # cmd/reload_config.py + utils/config.py WHITELIST_CONFIG_FIELDS):
+    # only fields that are safe to change on a LIVE server — no listen
+    # addresses, no secrets persisted elsewhere, no worker identity.
+    RELOADABLE_FIELDS = (
+        "debug",             # flips the root log level immediately
+        "advertised_url",    # embedded in provisioned worker bootstrap
+        "external_url",      # rendered into k8s manifests
+        "registration_token",  # join-token rotation without restart
+    )
+
+    async def reload_config(request: web.Request):
+        """Apply whitelisted config fields to the live server (reference
+        reload-config server endpoint). Admin only; GET lists the
+        whitelist, POST {field: value, ...} applies."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        err = require_admin(request)
+        if err is not None:
+            return err
+        cfg = request.app["config"]
+        if request.method == "GET":
+            return web.json_response({
+                "reloadable": list(RELOADABLE_FIELDS),
+                "current": {
+                    f: getattr(cfg, f) for f in RELOADABLE_FIELDS
+                    if f != "registration_token"   # never echo secrets
+                },
+            })
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        if not isinstance(body, dict) or not body:
+            return json_error(400, "body must be {field: value, ...}")
+        rejected = [k for k in body if k not in RELOADABLE_FIELDS]
+        if rejected:
+            return json_error(
+                400,
+                f"not runtime-reloadable: {sorted(rejected)}; "
+                f"allowed: {list(RELOADABLE_FIELDS)}",
+            )
+        # coerce EVERYTHING first, apply after: a bad value for a later
+        # key must not leave earlier keys half-applied
+        coerced_all = {}
+        for key, value in body.items():
+            field = type(cfg).model_fields[key]
+            try:
+                coerced_all[key] = pydantic_coerce(
+                    field.annotation, value
+                )
+            except (TypeError, ValueError) as e:
+                return json_error(400, f"bad value for {key!r}: {e}")
+        applied = {}
+        for key, coerced in coerced_all.items():
+            setattr(cfg, key, coerced)
+            applied[key] = (
+                "<set>" if key == "registration_token" else coerced
+            )
+        if "debug" in body:
+            import logging as _logging
+
+            _logging.getLogger().setLevel(
+                _logging.DEBUG if cfg.debug else _logging.INFO
+            )
+        if "registration_token" in coerced_all:
+            await _propagate_registration_token(
+                request.app, coerced_all["registration_token"]
+            )
+        if "advertised_url" in coerced_all:
+            _propagate_advertised_url(
+                request.app, coerced_all["advertised_url"]
+            )
+        logger.info("config reloaded: %s", applied)
+        return web.json_response({"applied": applied})
+
+    async def _propagate_registration_token(app, token: str) -> None:
+        """Rotation must reach every consumer of the token, not just the
+        cfg object: worker-join validation checks the cluster row's hash
+        (api/auth_routes.py), and the worker-pool controller bootstraps
+        provisioned VMs with its own copy."""
+        from gpustack_tpu.api.auth import hash_secret
+        from gpustack_tpu.schemas import Cluster
+
+        for cluster in await Cluster.filter(name="default"):
+            await cluster.update(
+                registration_token_hash=hash_secret(token)
+            )
+        for ctrl in app.get("controllers", []):
+            if hasattr(ctrl, "registration_token"):
+                ctrl.registration_token = token
+        # persist so a restart keeps the rotated token instead of
+        # resurrecting the old one from the data dir. (A deployment that
+        # passes --registration-token explicitly re-wins on restart by
+        # design — the flag is the operator's source of truth there.)
+        cfg = app["config"]
+        try:
+            import os as _os
+
+            path = _os.path.join(cfg.data_dir, "registration_token")
+            with open(path, "w") as f:
+                f.write(token)
+        except OSError:
+            logger.warning("could not persist rotated token")
+
+    def _propagate_advertised_url(app, url: str) -> None:
+        for ctrl in app.get("controllers", []):
+            if hasattr(ctrl, "server_url"):
+                ctrl.server_url = url
+
+    def pydantic_coerce(annotation, value):
+        if annotation is bool:
+            if isinstance(value, bool):
+                return value
+            if str(value).lower() in ("1", "true", "yes", "on"):
+                return True
+            if str(value).lower() in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"not a boolean: {value!r}")
+        if annotation is int:
+            return int(value)
+        if annotation is float:
+            return float(value)
+        return str(value)
+
+    app.router.add_get("/v2/config/reload", reload_config)
+    app.router.add_post("/v2/config/reload", reload_config)
     app.router.add_get("/v2/model-catalog", catalog)
     app.router.add_post("/v2/models/evaluate", evaluate)
     app.router.add_get("/v2/usage/summary", usage_summary)

@@ -40,9 +40,12 @@ logger = logging.getLogger(__name__)
 RESCHEDULE_STUCK_AFTER = 180.0  # reference scheduler.py:261-298 (3 min)
 
 # jax.distributed coordinator port band (reference port-band logic:
-# serve_manager.py:1456-1508)
+# serve_manager.py:1456-1508). Ports are claimed in PAIRS (coordinator +
+# command channel), so the band holds RANGE/2 concurrent multi-host
+# instances per leader — 4096 keeps the 2000-instance headroom the
+# uniqueness test pins.
 COORDINATOR_PORT_BASE = 41000
-COORDINATOR_PORT_RANGE = 2048
+COORDINATOR_PORT_RANGE = 4096
 
 
 def pick_coordinator_port(

@@ -143,6 +143,9 @@ class Server:
         self.status_buffer = WorkerStatusBuffer()
         self.status_buffer.start()
         app["status_buffer"] = self.status_buffer
+        # reload-config propagates rotated tokens/URLs into controllers
+        # that copied them at construction (routes/extras.py)
+        app["controllers"] = self.controllers
         self.usage_archiver = UsageArchiver()
         self.resource_events = ResourceEventLogger()
         self.system_load = SystemLoadCollector()

@@ -97,13 +97,22 @@ def test_coordinator_ports_unique_across_2000_instances():
             )
         )
     ports = {
-        i.coordinator_address.rsplit(":", 1)[1] for i in instances
+        int(i.coordinator_address.rsplit(":", 1)[1]) for i in instances
     }
     assert len(ports) == 2000
+    # pair allocation: each claim owns (p, p+1) for the coordinator and
+    # the leader->follower command channel (engine/multihost.py) — the
+    # pairs must be disjoint across all 2000 claims
+    claimed = set()
+    for p in ports:
+        assert p % 2 == 0
+        assert p not in claimed and p + 1 not in claimed
+        claimed.update((p, p + 1))
 
 
 def test_coordinator_ports_per_leader_band():
-    # different leaders may reuse ports; same leader may not
+    # different leaders may reuse ports; same leader may not — and the
+    # claimed PAIR (p, p+1) is fenced, so the next pick skips to p+2
     instances = [
         ModelInstance(
             id=1, worker_id=1,
@@ -111,7 +120,7 @@ def test_coordinator_ports_per_leader_band():
         )
     ]
     assert (
-        pick_coordinator_port(instances, 1, 99) == COORDINATOR_PORT_BASE + 1
+        pick_coordinator_port(instances, 1, 99) == COORDINATOR_PORT_BASE + 2
     )
     assert pick_coordinator_port(instances, 2, 99) == COORDINATOR_PORT_BASE
 

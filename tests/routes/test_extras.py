@@ -284,6 +284,63 @@ def test_dashboard_series_scoped_to_non_admin(ctx):
     _client_run(ctx, go)
 
 
+def test_reload_config_endpoint(ctx):
+    """Runtime config reload: whitelist enforced, secrets never echoed,
+    applied values visible to later reads (reference reload-config)."""
+
+    async def go(client, hdrs):
+        r = await client.get("/v2/config/reload", headers=hdrs)
+        assert r.status == 200
+        data = await r.json()
+        assert "registration_token" in data["reloadable"]
+        assert "registration_token" not in data["current"]
+
+        r = await client.post(
+            "/v2/config/reload", headers=hdrs,
+            json={"advertised_url": "http://x:1", "debug": "true"},
+        )
+        assert r.status == 200, await r.text()
+        applied = (await r.json())["applied"]
+        assert applied == {"advertised_url": "http://x:1", "debug": True}
+        assert ctx.advertised_url == "http://x:1"   # live config object
+        assert ctx.debug is True
+
+        # non-whitelisted fields rejected atomically
+        r = await client.post(
+            "/v2/config/reload", headers=hdrs,
+            json={"port": 9, "debug": "false"},
+        )
+        assert r.status == 400
+        assert ctx.debug is True                    # nothing applied
+
+        # bad value types rejected
+        r = await client.post(
+            "/v2/config/reload", headers=hdrs, json={"debug": "maybe"}
+        )
+        assert r.status == 400
+
+    _client_run(ctx, go)
+
+
+def test_reload_config_requires_admin(ctx):
+    async def go(client, hdrs):
+        alice = await User.create(
+            User(
+                username="alice",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        atoken = auth_mod.issue_session_token(alice, ctx.jwt_secret)
+        r = await client.post(
+            "/v2/config/reload",
+            headers={"Authorization": f"Bearer {atoken}"},
+            json={"debug": True},
+        )
+        assert r.status == 403
+
+    _client_run(ctx, go)
+
+
 def test_cluster_manifests(ctx):
     async def go(client, hdrs):
         from gpustack_tpu.schemas import Cluster
