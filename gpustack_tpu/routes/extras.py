@@ -594,6 +594,45 @@ def add_extra_routes(app: web.Application) -> None:
     app.router.add_get("/v2/dashboard", dashboard)
     app.router.add_get("/v2/dashboard/top-models", top_models)
     app.router.add_get("/v2/dashboard/worker-history", worker_history)
+    async def gateway_config(request: web.Request):
+        """Ready-to-apply L7 front config (nginx/envoy) for this server
+        (the reference's embedded Higress gateway role at the L7 layer —
+        server/gateway.py explains the divergence). Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.server.gateway import (
+            FLAVORS,
+            render_gateway_config,
+        )
+
+        err = require_admin(request)
+        if err is not None:
+            return err
+        from gpustack_tpu.schemas import Cluster
+
+        cluster = await Cluster.get(int(request.match_info["id"]))
+        if cluster is None:
+            return json_error(404, "cluster not found")
+        flavor = request.query.get("flavor", "nginx")
+        if flavor not in FLAVORS:
+            return json_error(
+                400, f"'flavor' must be one of {list(FLAVORS)}"
+            )
+        cfg = request.app["config"]
+        host = request.query.get("upstream_host") or (
+            "127.0.0.1" if cfg.host in ("0.0.0.0", "::") else cfg.host
+        )
+        try:
+            text = render_gateway_config(
+                flavor, host, cfg.port,
+                server_name=request.query.get("server_name", "_"),
+            )
+        except ValueError as e:
+            return json_error(400, str(e))
+        return web.Response(text=text, content_type="text/plain")
+
     app.router.add_get(
         "/v2/clusters/{id:\\d+}/manifests", cluster_manifests
+    )
+    app.router.add_get(
+        "/v2/clusters/{id:\\d+}/gateway-config", gateway_config
     )

@@ -341,6 +341,80 @@ def test_reload_config_requires_admin(ctx):
     _client_run(ctx, go)
 
 
+def test_gateway_config_rendering(ctx):
+    """L7 front configs for nginx/envoy (reference Higress gateway role
+    at L7: TLS, websocket upgrade for the tunnel, SSE-safe buffering)."""
+
+    async def go(client, hdrs):
+        from gpustack_tpu.schemas import Cluster
+
+        cluster = await Cluster.create(
+            Cluster(name="gw", registration_token_hash="x")
+        )
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config", headers=hdrs
+        )
+        assert r.status == 200
+        text = await r.text()
+        assert "proxy_buffering off" in text        # SSE-safe
+        assert 'Connection "upgrade"' in text       # tunnel websockets
+        assert "client_max_body_size 256m" in text  # audio uploads
+        assert f":{ctx.port}" in text
+
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config?flavor=envoy"
+            "&server_name=ai.example.com",
+            headers=hdrs,
+        )
+        text = await r.text()
+        assert "upgrade_type: websocket" in text
+        assert "ai.example.com" in text
+        import yaml
+
+        yaml.safe_load(text)                        # valid YAML
+
+        # default server_name renders each flavor's own catch-all
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config?flavor=envoy",
+            headers=hdrs,
+        )
+        assert 'domains: ["*"]' in await r.text()
+
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config?flavor=haproxy",
+            headers=hdrs,
+        )
+        assert r.status == 400
+        # injection-shaped names rejected, not interpolated
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config?"
+            "server_name=a%22b",
+            headers=hdrs,
+        )
+        assert r.status == 400
+        # unknown cluster 404s like the manifests endpoint
+        r = await client.get(
+            "/v2/clusters/999999/gateway-config", headers=hdrs
+        )
+        assert r.status == 404
+
+        # admin only
+        alice = await User.create(
+            User(
+                username="al2",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        atoken = auth_mod.issue_session_token(alice, ctx.jwt_secret)
+        r = await client.get(
+            f"/v2/clusters/{cluster.id}/gateway-config",
+            headers={"Authorization": f"Bearer {atoken}"},
+        )
+        assert r.status == 403
+
+    _client_run(ctx, go)
+
+
 def test_cluster_manifests(ctx):
     async def go(client, hdrs):
         from gpustack_tpu.schemas import Cluster
