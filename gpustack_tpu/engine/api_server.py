@@ -731,7 +731,25 @@ def build_engine_from_args(args) -> LLMEngine:
 
     vlm_cfg = None
     if args.model_dir:
-        cfg = load_hf_config(args.model_dir)
+        import glob as _glob
+
+        from gpustack_tpu.engine.gguf import config_from_gguf, gguf_file_in
+
+        # same precedence as load_or_init_params: safetensors first, so
+        # config and weights always come from the SAME checkpoint in a
+        # mixed directory
+        has_safetensors = _glob.glob(
+            os.path.join(args.model_dir, "*.safetensors")
+        )
+        gguf_path = None if has_safetensors else gguf_file_in(
+            args.model_dir
+        )
+        if gguf_path:
+            cfg = config_from_gguf(
+                gguf_path, name=args.served_name or ""
+            )
+        else:
+            cfg = load_hf_config(args.model_dir)
     elif args.preset in VLM_PRESETS:
         # vision-language preset: the language half runs in the normal
         # engine; the tower+projector attach as engine.vision below

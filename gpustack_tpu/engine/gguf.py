@@ -1,0 +1,508 @@
+"""GGUF checkpoint loading: parse + dequantize into the HF tensor names
+the existing loader already maps.
+
+Reference parity: the reference serves GGUF checkpoints through
+llama-box/llama.cpp and sizes them with gguf-parser (SURVEY §2.9; the
+native C++ ``model-meta`` tool already covers the sizing half). This
+module covers the SERVING half TPU-first: instead of a CPU/GPU GGML
+runtime, GGUF tensors are dequantized to bf16 at load and run through
+the same jitted transformer as safetensors checkpoints (optionally
+re-quantized to int8 weight-only for the MXU path).
+
+Format: GGUF v2/v3 (little-endian) — header, typed metadata KV section,
+tensor info table, aligned data section. Quantizations supported:
+F32/F16/BF16 passthrough, Q8_0, Q4_0, Q4_1 (covers the common K-less
+exports); K-quants raise a clear error naming the tensor.
+
+Tokenizer: a ``tokenizer.json`` sidecar next to the .gguf wins (exact
+HF tokenization). Without one, the GGUF's embedded vocab drives exact
+DECODING (SentencePiece ``▁``/byte-token conventions) and greedy
+longest-match ENCODING — a documented approximation: merges are not
+replayed, so token boundaries can differ from the original BPE on rare
+strings.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+GGUF_MAGIC = 0x46554747      # "GGUF" little-endian
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32, _T_F32, _T_BOOL = range(8)
+_T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+    _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_BOOL: "<?",
+    _T_U64: "<Q", _T_I64: "<q", _T_F64: "<d",
+}
+
+# ggml tensor types (subset)
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q8_0 = 8
+GGML_BF16 = 30
+
+_TYPE_NAMES = {
+    0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
+    8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K", 12: "Q4_K",
+    13: "Q5_K", 14: "Q6_K", 15: "Q8_K", 30: "BF16",
+}
+
+
+class _Reader:
+    def __init__(self, data: memoryview):
+        self.data = data
+        self.pos = 0
+
+    def scalar(self, vtype: int):
+        fmt = _SCALAR_FMT[vtype]
+        size = struct.calcsize(fmt)
+        (value,) = struct.unpack_from(fmt, self.data, self.pos)
+        self.pos += size
+        return value
+
+    def string(self) -> str:
+        n = self.scalar(_T_U64)
+        raw = bytes(self.data[self.pos: self.pos + n])
+        self.pos += n
+        return raw.decode("utf-8", errors="replace")
+
+    def value(self, vtype: int):
+        if vtype == _T_STRING:
+            return self.string()
+        if vtype == _T_ARRAY:
+            etype = self.scalar(_T_U32)
+            count = self.scalar(_T_U64)
+            return [self.value(etype) for _ in range(count)]
+        return self.scalar(vtype)
+
+
+def read_gguf(
+    path: str,
+) -> Tuple[Dict[str, Any], List[Tuple[str, tuple, int, int]], int, Any]:
+    """Parse a GGUF file → (metadata, tensor_infos, data_start, raw).
+
+    tensor_infos entries are (name, numpy_shape, ggml_type, offset);
+    GGUF stores dims fastest-varying-first, so the numpy shape is the
+    reverse. ``raw`` is an mmap-backed buffer: metadata-only callers
+    (config, tokenizer) touch header pages only, and weight loads page
+    tensor data in lazily instead of slurping a multi-GB file three
+    times at startup.
+    """
+    import mmap
+
+    with open(path, "rb") as f:
+        try:
+            raw = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError):
+            raw = f.read()           # empty/special files: plain read
+    mv = memoryview(raw)
+    try:
+        magic, version = struct.unpack_from("<II", mv, 0)
+        if magic != GGUF_MAGIC:
+            raise ValueError(f"{path!r} is not a GGUF file")
+        if version not in (2, 3):
+            raise ValueError(f"unsupported GGUF version {version}")
+        n_tensors, n_kv = struct.unpack_from("<QQ", mv, 8)
+        r = _Reader(mv)
+        r.pos = 24
+        metadata: Dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = r.string()
+            vtype = r.scalar(_T_U32)
+            metadata[key] = r.value(vtype)
+        infos = []
+        for _ in range(n_tensors):
+            name = r.string()
+            n_dims = r.scalar(_T_U32)
+            dims = [r.scalar(_T_U64) for _ in range(n_dims)]
+            ggml_type = r.scalar(_T_U32)
+            offset = r.scalar(_T_U64)
+            infos.append(
+                (name, tuple(reversed(dims)), ggml_type, offset)
+            )
+    except struct.error as e:
+        # truncated/corrupt file: surface as ValueError so every caller's
+        # fallback path (ByteTokenizer, EvaluationError) engages
+        raise ValueError(f"corrupt GGUF file {path!r}: {e}") from e
+    split = int(metadata.get("split.count", 1) or 1)
+    if split > 1:
+        raise ValueError(
+            f"{path!r} is part of a {split}-file split GGUF; merge it "
+            "first (gguf-split --merge)"
+        )
+    align = int(metadata.get("general.alignment", 32))
+    data_start = (r.pos + align - 1) // align * align
+    return metadata, infos, data_start, raw
+
+
+def _dequantize(
+    name: str, blob: np.ndarray, shape: tuple, ggml_type: int
+) -> np.ndarray:
+    n = int(np.prod(shape))
+    if ggml_type == GGML_F32:
+        return blob.view(np.float32)[:n].reshape(shape)
+    if ggml_type == GGML_F16:
+        return blob.view(np.float16)[:n].astype(np.float32).reshape(shape)
+    if ggml_type == GGML_BF16:
+        u32 = blob.view(np.uint16)[:n].astype(np.uint32) << 16
+        return u32.view(np.float32).reshape(shape)
+    if ggml_type == GGML_Q8_0:
+        # blocks of 32: f16 scale + 32×int8
+        blocks = blob.reshape(-1, 34)
+        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        q = blocks[:, 2:].view(np.int8).astype(np.float32)
+        return (q * d).reshape(shape)[:n].reshape(shape)
+    if ggml_type in (GGML_Q4_0, GGML_Q4_1):
+        bs = 18 if ggml_type == GGML_Q4_0 else 20
+        blocks = blob.reshape(-1, bs)
+        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        qs = blocks[:, bs - 16:]
+        lo = (qs & 0x0F).astype(np.float32)
+        hi = (qs >> 4).astype(np.float32)
+        q = np.concatenate([lo, hi], axis=1)          # [blocks, 32]
+        if ggml_type == GGML_Q4_0:
+            vals = (q - 8.0) * d
+        else:
+            m = blocks[:, 2:4].copy().view(np.float16).astype(np.float32)
+            vals = q * d + m
+        return vals.reshape(-1)[:n].reshape(shape)
+    raise ValueError(
+        f"GGUF tensor {name!r} uses unsupported quantization "
+        f"{_TYPE_NAMES.get(ggml_type, ggml_type)}; supported: F32/F16/"
+        "BF16/Q8_0/Q4_0/Q4_1 (re-export without K-quants)"
+    )
+
+
+def _type_bytes(shape: tuple, ggml_type: int) -> int:
+    n = int(np.prod(shape))
+    if ggml_type == GGML_F32:
+        return n * 4
+    if ggml_type in (GGML_F16, GGML_BF16):
+        return n * 2
+    if ggml_type == GGML_Q8_0:
+        return n // 32 * 34
+    if ggml_type == GGML_Q4_0:
+        return n // 32 * 18
+    if ggml_type == GGML_Q4_1:
+        return n // 32 * 20
+    raise ValueError(f"unsupported ggml type {ggml_type}")
+
+
+# llama.cpp tensor names → the HF names the existing loader maps
+# (engine/weights.py load_hf_checkpoint)
+_NAME_MAP = {
+    "token_embd.weight": "model.embed_tokens.weight",
+    "output_norm.weight": "model.norm.weight",
+    "output.weight": "lm_head.weight",
+}
+_BLK_MAP = {
+    "attn_norm.weight": "input_layernorm.weight",
+    "attn_q.weight": "self_attn.q_proj.weight",
+    "attn_k.weight": "self_attn.k_proj.weight",
+    "attn_v.weight": "self_attn.v_proj.weight",
+    "attn_output.weight": "self_attn.o_proj.weight",
+    "attn_q.bias": "self_attn.q_proj.bias",
+    "attn_k.bias": "self_attn.k_proj.bias",
+    "attn_v.bias": "self_attn.v_proj.bias",
+    "attn_q_norm.weight": "self_attn.q_norm.weight",
+    "attn_k_norm.weight": "self_attn.k_norm.weight",
+    "ffn_norm.weight": "post_attention_layernorm.weight",
+    "ffn_gate.weight": "mlp.gate_proj.weight",
+    "ffn_up.weight": "mlp.up_proj.weight",
+    "ffn_down.weight": "mlp.down_proj.weight",
+}
+_SKIP = ("rope_freqs.weight", "rope_factors.weight")
+
+
+def _map_name(name: str) -> Optional[str]:
+    if name in _NAME_MAP:
+        return _NAME_MAP[name]
+    if name in _SKIP:
+        return None
+    if name.startswith("blk."):
+        _, layer, rest = name.split(".", 2)
+        if rest in _BLK_MAP:
+            return f"model.layers.{layer}.{_BLK_MAP[rest]}"
+        if "exps" in rest or "ffn_gate_inp" in rest:
+            raise ValueError(
+                "GGUF MoE checkpoints are not supported yet "
+                f"(tensor {name!r}); use the safetensors export"
+            )
+    logger.warning("ignoring unrecognized GGUF tensor %r", name)
+    return None
+
+
+def _reverse_llama_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Undo convert_hf_to_gguf's rotary permutation of q/k weights.
+
+    llama-arch exports interleave head rows for GGML's rotary layout;
+    this engine applies HF rotate_half RoPE, so the permutation must be
+    reversed on load (the same fix transformers' own GGUF loader
+    applies) — without it every real llama/mistral .gguf serves
+    garbage attention."""
+    out = w.shape[0]
+    dim = out // n_head // 2
+    return (
+        w.reshape(n_head, dim, 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+def load_gguf_tensors(path: str) -> Dict[str, Any]:
+    """GGUF file → {hf_name: torch tensor} for load_hf_checkpoint's
+    mapping machinery. llama.cpp 2-D weights are [out, in] after dim
+    reversal — the same layout as torch linear weights, so the existing
+    transpose-on-load convention applies unchanged."""
+    import torch
+
+    metadata, infos, data_start, raw = read_gguf(path)
+    buf = np.frombuffer(raw, np.uint8)
+    arch = metadata.get("general.architecture", "llama")
+    n_head = int(metadata.get(f"{arch}.attention.head_count", 0))
+    n_kv = int(
+        metadata.get(f"{arch}.attention.head_count_kv", n_head)
+    )
+    tensors: Dict[str, Any] = {}
+    for name, shape, ggml_type, offset in infos:
+        hf_name = _map_name(name)
+        if hf_name is None:
+            continue
+        start = data_start + offset
+        blob = buf[start: start + _type_bytes(shape, ggml_type)]
+        arr = _dequantize(name, blob, shape, ggml_type).copy()
+        if arch == "llama" and n_head:
+            # only llama-arch exports permute q/k (qwen2/gemma don't)
+            if name.endswith("attn_q.weight"):
+                arr = _reverse_llama_permute(arr, n_head)
+            elif name.endswith("attn_k.weight"):
+                arr = _reverse_llama_permute(arr, n_kv)
+        tensors[hf_name] = torch.from_numpy(arr)
+    return tensors
+
+
+def gguf_file_in(model_dir: str) -> Optional[str]:
+    """The .gguf file for a model source: the path itself, or the first
+    .gguf in the directory (read_gguf rejects split files via
+    ``split.count`` with a clear merge instruction)."""
+    if model_dir and model_dir.endswith(".gguf"):
+        return model_dir if os.path.exists(model_dir) else None
+    if model_dir and os.path.isdir(model_dir):
+        files = sorted(
+            f for f in os.listdir(model_dir) if f.endswith(".gguf")
+        )
+        if files:
+            return os.path.join(model_dir, files[0])
+    return None
+
+
+def config_from_gguf(path: str, name: str = ""):
+    """GGUF metadata → ModelConfig (reference role: gguf-parser's
+    architecture extraction feeding the scheduler)."""
+    from gpustack_tpu.models.config import ModelConfig
+
+    metadata, infos, _, _ = read_gguf(path)
+    arch = metadata.get("general.architecture", "llama")
+
+    def md(key: str, default=None):
+        return metadata.get(f"{arch}.{key}", default)
+
+    hidden = int(md("embedding_length", 0))
+    heads = int(md("attention.head_count", 0))
+    if not hidden or not heads:
+        raise ValueError(
+            f"GGUF {path!r} lacks {arch}.embedding_length/"
+            "attention.head_count metadata"
+        )
+    kv_heads = int(md("attention.head_count_kv", heads))
+    vocab = int(md("vocab_size", 0)) or len(
+        metadata.get("tokenizer.ggml.tokens", [])
+    )
+    if not vocab:
+        vocab = next(
+            (
+                int(shape[0]) for tname, shape, _t, _o in infos
+                if tname == "token_embd.weight"
+            ),
+            32000,
+        )
+    tensor_names = {t[0] for t in infos}
+    return ModelConfig(
+        name=name or os.path.basename(path),
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=int(md("feed_forward_length", 4 * hidden)),
+        num_layers=int(md("block_count", 1)),
+        num_heads=heads,
+        num_kv_heads=kv_heads,
+        head_dim=int(md("attention.key_length", hidden // heads)),
+        rope_theta=float(md("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(md("attention.layer_norm_rms_epsilon", 1e-5)),
+        max_position_embeddings=int(md("context_length", 8192)),
+        tie_word_embeddings="output.weight" not in tensor_names,
+        qkv_bias="blk.0.attn_q.bias" in tensor_names,
+        qk_norm="blk.0.attn_q_norm.weight" in tensor_names,
+    )
+
+
+def _gpt2_byte_tables():
+    """OpenAI's bytes↔unicode bijection (gpt2 BPE vocab encoding)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(0xA1, 0xAD))
+        + list(range(0xAE, 0x100))
+    )
+    cs = list(bs)
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    byte_to_uni = {b: chr(c) for b, c in zip(bs, cs)}
+    uni_to_byte = {chr(c): b for b, c in zip(bs, cs)}
+    return byte_to_uni, uni_to_byte
+
+
+class GGUFVocabTokenizer:
+    """Tokenizer from the GGUF's embedded vocab.
+
+    Two vocab conventions are handled per ``tokenizer.ggml.model``:
+    SentencePiece (``llama``: ``▁`` word boundary, ``<0xNN>`` byte
+    tokens) and gpt2-style BPE (``gpt2``: byte↔unicode mapped pieces,
+    ``Ġ`` spaces — Llama-3/Qwen exports). Decoding is exact for both.
+    Encoding is greedy longest-match over the vocab — NOT a merge-order
+    BPE replay, so boundaries can differ from the original tokenizer on
+    rare strings (a tokenizer.json sidecar gives exact encoding;
+    engine/tokenizer.py prefers it)."""
+
+    def __init__(self, metadata: Dict[str, Any]):
+        self.tokens: List[str] = metadata["tokenizer.ggml.tokens"]
+        self.model = metadata.get("tokenizer.ggml.model", "llama")
+        self.vocab_size = len(self.tokens)
+        eos = int(metadata.get("tokenizer.ggml.eos_token_id", 2))
+        bos = metadata.get("tokenizer.ggml.bos_token_id")
+        self.bos_id = int(bos) if bos is not None else None
+        self.eos_ids = (eos,)
+        self._index = {t: i for i, t in enumerate(self.tokens)}
+        self._max_len = max((len(t) for t in self.tokens), default=1)
+        self._b2u, self._u2b = _gpt2_byte_tables()
+
+    @classmethod
+    def from_file(cls, path: str) -> "GGUFVocabTokenizer":
+        metadata, _, _, _ = read_gguf(path)
+        if "tokenizer.ggml.tokens" not in metadata:
+            raise ValueError(f"GGUF {path!r} embeds no tokenizer vocab")
+        return cls(metadata)
+
+    def encode(self, text: str) -> List[int]:
+        if self.model == "gpt2":
+            # gpt2 vocabs store pieces in the byte→unicode mapping;
+            # transform the text the same way, then longest-match
+            piece_text = "".join(
+                self._b2u[b] for b in text.encode("utf-8")
+            )
+        else:
+            piece_text = "▁" + text.replace(" ", "▁")
+        ids: List[int] = []
+        if self.bos_id is not None:
+            ids.append(self.bos_id)
+        i = 0
+        while i < len(piece_text):
+            match = None
+            for ln in range(
+                min(self._max_len, len(piece_text) - i), 0, -1
+            ):
+                cand = piece_text[i: i + ln]
+                tid = self._index.get(cand)
+                if tid is not None:
+                    match = (tid, ln)
+                    break
+            if match is None:
+                # fall back to byte tokens for unknown chars; the word
+                # boundary marker is OUR insertion — as bytes it must be
+                # the space it stands for, not literal '▁'
+                ch = " " if piece_text[i] == "▁" else piece_text[i]
+                for b in ch.encode("utf-8"):
+                    tid = self._index.get(f"<0x{b:02X}>")
+                    if tid is not None:
+                        ids.append(tid)
+                i += 1
+                continue
+            ids.append(match[0])
+            i += match[1]
+        return ids
+
+    def apply_chat_template(
+        self, messages: List[dict], tools: Optional[List[dict]] = None,
+    ) -> List[int]:
+        """Generic role-tag template (same shape as the hermetic byte
+        tokenizer's): a GGUF file carries no jinja chat template, so
+        serving uses the neutral format rather than guessing a family's."""
+        from gpustack_tpu.engine.tokenizer import (
+            _content_text,
+            _inject_tools_fallback,
+        )
+
+        messages = _inject_tools_fallback(messages, tools)
+        text = "".join(
+            f"<{m['role']}>{_content_text(m)}</{m['role']}>"
+            for m in messages
+        ) + "<assistant>"
+        return self.encode(text)
+
+    def decode(self, ids) -> str:
+        if self.model == "gpt2":
+            # reverse the byte↔unicode bijection over concatenated pieces
+            byte_out = bytearray()
+            for tid in ids:
+                if not 0 <= int(tid) < self.vocab_size:
+                    continue
+                tok = self.tokens[int(tid)]
+                if tok.startswith("<|") and tok.endswith("|>"):
+                    continue         # control tokens render as nothing
+                for ch in tok:
+                    b = self._u2b.get(ch)
+                    if b is None:
+                        byte_out.extend(ch.encode("utf-8"))
+                    else:
+                        byte_out.append(b)
+            return byte_out.decode("utf-8", errors="replace")
+        out: List[str] = []
+        byte_buf: List[int] = []
+
+        def flush_bytes():
+            if byte_buf:
+                out.append(
+                    bytes(byte_buf).decode("utf-8", errors="replace")
+                )
+                byte_buf.clear()
+
+        for tid in ids:
+            if not 0 <= int(tid) < self.vocab_size:
+                continue
+            tok = self.tokens[int(tid)]
+            if (
+                len(tok) == 6
+                and tok.startswith("<0x")
+                and tok.endswith(">")
+            ):
+                byte_buf.append(int(tok[3:5], 16))
+                continue
+            flush_bytes()
+            if tok.startswith("<") and tok.endswith(">"):
+                continue             # control tokens render as nothing
+            out.append(tok.replace("▁", " "))
+        flush_bytes()
+        text = "".join(out)
+        return text[1:] if text.startswith(" ") else text

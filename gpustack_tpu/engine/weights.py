@@ -68,6 +68,23 @@ def _taker(tensors: Dict[str, Any]):
 def load_hf_checkpoint(cfg: ModelConfig, model_dir: str) -> Dict[str, Any]:
     """Load *.safetensors from a local HF model dir into our param tree."""
     tensors = _read_safetensors(model_dir)
+    return build_lm_params(cfg, tensors)
+
+
+def load_gguf_checkpoint(cfg: ModelConfig, gguf_path: str) -> Dict[str, Any]:
+    """Load a GGUF checkpoint: dequantize to the HF tensor names
+    (engine/gguf.py), then reuse the exact same mapping as safetensors —
+    one param-tree builder, two on-disk formats."""
+    from gpustack_tpu.engine.gguf import load_gguf_tensors
+
+    tensors = load_gguf_tensors(gguf_path)
+    return build_lm_params(cfg, tensors)
+
+
+def build_lm_params(
+    cfg: ModelConfig, tensors: Dict[str, Any]
+) -> Dict[str, Any]:
+    """HF-named tensors → the stacked functional param tree."""
     L = cfg.num_layers
     take = _taker(tensors)
 
@@ -366,6 +383,13 @@ def load_or_init_params(
     if model_dir and glob.glob(os.path.join(model_dir, "*.safetensors")):
         logger.info("loading checkpoint from %s", model_dir)
         return load_hf_checkpoint(cfg, model_dir)
+    if model_dir:
+        from gpustack_tpu.engine.gguf import gguf_file_in
+
+        gguf_path = gguf_file_in(model_dir)
+        if gguf_path:
+            logger.info("loading GGUF checkpoint from %s", gguf_path)
+            return load_gguf_checkpoint(cfg, gguf_path)
     logger.warning(
         "no checkpoint at %r — initializing random weights for %s",
         model_dir, cfg.name,

@@ -139,6 +139,14 @@ def resolve_model_config(model: Model, raw: Optional[dict] = None):
     if raw is None:
         raw = resolve_raw_config(model)
     if raw is None:
+        from gpustack_tpu.engine.gguf import config_from_gguf, gguf_file_in
+
+        gguf_path = gguf_file_in(model.local_path or "")
+        if gguf_path:
+            try:
+                return config_from_gguf(gguf_path, name=model.name)
+            except ValueError as e:
+                raise EvaluationError(str(e))
         # diffusers-format layout = image pipeline
         return config_from_diffusers(model.local_path, name=model.name)
     name = (

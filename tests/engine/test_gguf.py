@@ -1,0 +1,375 @@
+"""GGUF checkpoint loading: parse, dequantize, config, tokenizer, serve.
+
+Reference parity: the reference serves GGUF through llama-box and sizes
+it with gguf-parser (SURVEY §2.9); here GGUF is a first-class weight
+SOURCE for the TPU engine — dequantized at load into the same jitted
+transformer as safetensors. Hermetic: a tiny GGUF file is written
+in-test (v3 format, quantized blocks constructed per spec).
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from gpustack_tpu.engine.gguf import (
+    GGUFVocabTokenizer,
+    config_from_gguf,
+    gguf_file_in,
+    load_gguf_tensors,
+    read_gguf,
+)
+
+# ---------------------------------------------------------------------------
+# minimal GGUF v3 writer (test-only)
+# ---------------------------------------------------------------------------
+
+_T_U32, _T_F32, _T_STRING, _T_ARRAY, _T_U64 = 4, 6, 8, 9, 10
+
+GGML_F32, GGML_F16, GGML_Q4_0, GGML_Q8_0 = 0, 1, 2, 8
+
+
+def _kv_bytes(key: str, value) -> bytes:
+    def s(text: str) -> bytes:
+        raw = text.encode()
+        return struct.pack("<Q", len(raw)) + raw
+
+    out = s(key)
+    if isinstance(value, str):
+        out += struct.pack("<I", _T_STRING) + s(value)
+    elif isinstance(value, float):
+        out += struct.pack("<If", _T_F32, value)
+    elif isinstance(value, int):
+        out += struct.pack("<II", _T_U32, value)
+    elif isinstance(value, list) and all(
+        isinstance(v, str) for v in value
+    ):
+        out += struct.pack("<I", _T_ARRAY)
+        out += struct.pack("<IQ", _T_STRING, len(value))
+        for v in value:
+            out += s(v)
+    else:
+        raise TypeError(type(value))
+    return out
+
+
+def _quantize_q8_0(arr: np.ndarray) -> bytes:
+    flat = arr.reshape(-1, 32).astype(np.float32)
+    out = b""
+    for block in flat:
+        d = float(np.max(np.abs(block))) / 127.0 or 1e-8
+        q = np.clip(np.round(block / d), -127, 127).astype(np.int8)
+        out += np.float16(d).tobytes() + q.tobytes()
+    return out
+
+
+def _quantize_q4_0(arr: np.ndarray) -> bytes:
+    flat = arr.reshape(-1, 32).astype(np.float32)
+    out = b""
+    for block in flat:
+        d = float(np.max(np.abs(block))) / 8.0 or 1e-8
+        q = np.clip(np.round(block / d) + 8, 0, 15).astype(np.uint8)
+        packed = (q[:16] | (q[16:] << 4)).astype(np.uint8)
+        out += np.float16(d).tobytes() + packed.tobytes()
+    return out
+
+
+def write_gguf(path, metadata, tensors):
+    """tensors: {name: (np.ndarray f32, ggml_type)}."""
+    header = struct.pack(
+        "<IIQQ", 0x46554747, 3, len(tensors), len(metadata)
+    )
+    body = b"".join(_kv_bytes(k, v) for k, v in metadata.items())
+
+    blobs, infos = [], []
+    offset = 0
+    for name, (arr, gtype) in tensors.items():
+        if gtype == GGML_F32:
+            blob = arr.astype(np.float32).tobytes()
+        elif gtype == GGML_F16:
+            blob = arr.astype(np.float16).tobytes()
+        elif gtype == GGML_Q8_0:
+            blob = _quantize_q8_0(arr)
+        elif gtype == GGML_Q4_0:
+            blob = _quantize_q4_0(arr)
+        else:
+            raise ValueError(gtype)
+        nb = name.encode()
+        dims = list(reversed(arr.shape))     # ggml order
+        infos.append(
+            struct.pack("<Q", len(nb)) + nb
+            + struct.pack("<I", len(dims))
+            + b"".join(struct.pack("<Q", d) for d in dims)
+            + struct.pack("<IQ", gtype, offset)
+        )
+        blobs.append(blob)
+        offset += (len(blob) + 31) // 32 * 32
+    head = header + body + b"".join(infos)
+    pad = (-len(head)) % 32
+    data = b""
+    for blob in blobs:
+        data += blob + b"\x00" * ((-len(blob)) % 32)
+    with open(path, "wb") as f:
+        f.write(head + b"\x00" * pad + data)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a tiny llama-arch GGUF
+# ---------------------------------------------------------------------------
+
+V, D, I, L, H, KV, HD = 264, 64, 128, 2, 4, 2, 16
+
+
+def _llama_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """convert_hf_to_gguf's rotary permutation of q/k for llama arch."""
+    return (
+        w.reshape(n_head, 2, w.shape[0] // n_head // 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+def _tiny_gguf(path, quantized=False):
+    rng = np.random.default_rng(7)
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    tensors = {
+        "token_embd.weight": (w(V, D), GGML_F32),
+        "output_norm.weight": (np.ones(D, np.float32), GGML_F32),
+        "output.weight": (w(V, D), GGML_F16),
+    }
+    for i in range(L):
+        qt = GGML_Q8_0 if quantized else GGML_F32
+        wq, wk = w(H * HD, D), w(KV * HD, D)
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": (np.ones(D, np.float32), GGML_F32),
+            f"blk.{i}.attn_q.weight": (wq, qt),
+            f"blk.{i}.attn_k.weight": (wk, qt),
+            f"blk.{i}.attn_v.weight": (w(KV * HD, D), GGML_F32),
+            f"blk.{i}.attn_output.weight": (w(D, H * HD), GGML_F32),
+            f"blk.{i}.ffn_norm.weight": (np.ones(D, np.float32), GGML_F32),
+            f"blk.{i}.ffn_gate.weight": (
+                w(I, D), GGML_Q4_0 if quantized else GGML_F32
+            ),
+            f"blk.{i}.ffn_up.weight": (w(I, D), GGML_F32),
+            f"blk.{i}.ffn_down.weight": (w(D, I), GGML_F32),
+        })
+    vocab = (
+        ["<unk>", "<s>", "</s>"]
+        + [f"<0x{b:02X}>" for b in range(256)]
+        + ["▁hello", "▁world", "lo", "▁he"]
+    )
+    metadata = {
+        "general.architecture": "llama",
+        "general.alignment": 32,
+        "llama.block_count": L,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": KV,
+        "llama.context_length": 256,
+        "llama.rope.freq_base": 10000.0,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.vocab_size": V,
+        "tokenizer.ggml.tokens": vocab,
+        "tokenizer.ggml.eos_token_id": 2,
+        "tokenizer.ggml.bos_token_id": 1,
+    }
+    # the FILE carries llama.cpp's rotary permutation on q/k (what a
+    # real llama-arch export contains); ``tensors`` returns the
+    # UNPERMUTED values — exactly what the loader must reconstruct
+    on_disk = dict(tensors)
+    for key, (arr, gtype) in tensors.items():
+        if key.endswith("attn_q.weight"):
+            on_disk[key] = (_llama_permute(arr, H), gtype)
+        elif key.endswith("attn_k.weight"):
+            on_disk[key] = (_llama_permute(arr, KV), gtype)
+    write_gguf(path, metadata, on_disk)
+    return tensors
+
+
+def test_parse_and_dequantize_roundtrip(tmp_path):
+    path = str(tmp_path / "tiny.gguf")
+    written = _tiny_gguf(path)
+    metadata, infos, _, _ = read_gguf(path)
+    assert metadata["general.architecture"] == "llama"
+    assert len(infos) == len(written)
+    tensors = load_gguf_tensors(path)
+    got = tensors["model.layers.0.self_attn.q_proj.weight"].numpy()
+    np.testing.assert_allclose(
+        got, written["blk.0.attn_q.weight"][0], atol=1e-6
+    )
+    # f16 tensor within half precision
+    got = tensors["lm_head.weight"].numpy()
+    np.testing.assert_allclose(
+        got, written["output.weight"][0], atol=2e-3
+    )
+
+
+def test_quantized_tensors_dequantize_within_block_error(tmp_path):
+    path = str(tmp_path / "q.gguf")
+    written = _tiny_gguf(path, quantized=True)
+    tensors = load_gguf_tensors(path)
+    q8 = tensors["model.layers.0.self_attn.q_proj.weight"].numpy()
+    ref = written["blk.0.attn_q.weight"][0]
+    # Q8_0: per-block absmax/127 step
+    assert np.max(np.abs(q8 - ref)) < np.max(np.abs(ref)) / 100
+    q4 = tensors["model.layers.0.mlp.gate_proj.weight"].numpy()
+    ref4 = written["blk.0.ffn_gate.weight"][0]
+    assert np.max(np.abs(q4 - ref4)) < np.max(np.abs(ref4)) / 6
+
+
+def test_config_from_gguf(tmp_path):
+    path = str(tmp_path / "cfg.gguf")
+    _tiny_gguf(path)
+    cfg = config_from_gguf(path, name="g")
+    assert cfg.num_layers == L and cfg.hidden_size == D
+    assert cfg.num_heads == H and cfg.num_kv_heads == KV
+    assert cfg.vocab_size == V
+    assert cfg.tie_word_embeddings is False      # output.weight present
+    assert cfg.qkv_bias is False
+    assert gguf_file_in(str(tmp_path)) == path
+
+
+def test_vocab_tokenizer_roundtrip(tmp_path):
+    path = str(tmp_path / "tok.gguf")
+    _tiny_gguf(path)
+    tok = GGUFVocabTokenizer.from_file(path)
+    ids = tok.encode("hello world")
+    assert ids[0] == 1                            # bos
+    assert tok.decode(ids) == "hello world"
+    # byte fallback for chars not in vocab
+    assert tok.decode(tok.encode("héllo")) == "héllo"
+    assert tok.eos_ids == (2,)
+    # chat serving needs a template (GGUF carries no jinja; the neutral
+    # role-tag shape is used)
+    ids2 = tok.apply_chat_template(
+        [{"role": "user", "content": "hello"}]
+    )
+    assert "hello" in tok.decode(ids2)
+
+
+def test_gpt2_vocab_roundtrip(tmp_path):
+    """Llama-3/Qwen exports use gpt2-style byte-unicode vocabs (Ġ
+    spaces, no <0xNN> tokens) — decode must reverse the mapping."""
+    path = str(tmp_path / "g2.gguf")
+    vocab = ["<|end|>", "hello", "Ġworld", "Ġhe", "llo", "h", "Ġ"]
+    # every single-byte unicode-mapped char so the byte fallback works
+    from gpustack_tpu.engine.gguf import _gpt2_byte_tables
+
+    b2u, _ = _gpt2_byte_tables()
+    vocab += sorted(set(b2u.values()) - set(vocab))
+    write_gguf(path, {
+        "general.architecture": "llama",
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": vocab,
+        "tokenizer.ggml.eos_token_id": 0,
+    }, {})
+    tok = GGUFVocabTokenizer.from_file(path)
+    assert tok.model == "gpt2"
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    assert tok.decode(tok.encode("héllo wörld")) == "héllo wörld"
+
+
+def test_corrupt_gguf_is_valueerror(tmp_path):
+    path = str(tmp_path / "bad.gguf")
+    good = str(tmp_path / "good.gguf")
+    _tiny_gguf(good)
+    with open(good, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:100])          # truncated mid-metadata
+    with pytest.raises(ValueError, match="corrupt"):
+        read_gguf(path)
+    # tokenizer loading falls back instead of crashing engine startup
+    from gpustack_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+
+    bad_dir = tmp_path / "baddir"
+    bad_dir.mkdir()
+    os.rename(path, str(bad_dir / "bad.gguf"))
+    assert isinstance(load_tokenizer(str(bad_dir)), ByteTokenizer)
+
+
+def test_engine_serves_gguf(tmp_path):
+    """End-to-end: a GGUF dir builds an engine whose greedy tokens match
+    an engine built from the identical dequantized tensors."""
+    import torch
+
+    from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+    from gpustack_tpu.engine.weights import (
+        build_lm_params,
+        load_or_init_params,
+    )
+
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    path = str(model_dir / "tiny.gguf")
+    written = _tiny_gguf(path)
+    cfg = config_from_gguf(path, name="gguf-tiny")
+    params = load_or_init_params(cfg, str(model_dir))
+
+    # reference params from the same numeric tensors via the HF path
+    hf_named = {}
+    remap = {
+        "token_embd.weight": "model.embed_tokens.weight",
+        "output_norm.weight": "model.norm.weight",
+        "output.weight": "lm_head.weight",
+    }
+    blk = {
+        "attn_norm": "input_layernorm.weight",
+        "attn_q": "self_attn.q_proj.weight",
+        "attn_k": "self_attn.k_proj.weight",
+        "attn_v": "self_attn.v_proj.weight",
+        "attn_output": "self_attn.o_proj.weight",
+        "ffn_norm": "post_attention_layernorm.weight",
+        "ffn_gate": "mlp.gate_proj.weight",
+        "ffn_up": "mlp.up_proj.weight",
+        "ffn_down": "mlp.down_proj.weight",
+    }
+    for name, (arr, _t) in written.items():
+        if name in remap:
+            hf_named[remap[name]] = torch.from_numpy(arr)
+        else:
+            _, i, rest = name.split(".", 2)
+            key = rest.rsplit(".", 1)[0]
+            hf_named[f"model.layers.{i}.{blk[key]}"] = torch.from_numpy(
+                arr
+            )
+    # f16 output.weight loses precision on disk; mirror that
+    hf_named["lm_head.weight"] = torch.from_numpy(
+        written["output.weight"][0].astype(np.float16).astype(np.float32)
+    )
+    ref_params = build_lm_params(cfg, hf_named)
+
+    def greedy(p):
+        eng = LLMEngine(cfg, p, max_slots=1, max_seq_len=128)
+        eng.start()
+        try:
+            req = eng.generate(
+                GenRequest(
+                    prompt_ids=[5, 9, 33, 7], max_tokens=6,
+                    temperature=0.0, stop_ids=(),
+                ),
+                timeout=600,
+            )
+            return req.output_ids
+        finally:
+            eng.stop()
+
+    assert greedy(params) == greedy(ref_params)
+
+
+def test_unsupported_quant_is_loud(tmp_path):
+    path = str(tmp_path / "k.gguf")
+    arr = np.zeros((32,), np.float32)
+    # forge a Q4_K (type 12) tensor info with a fake blob
+    write_gguf(path, {"general.architecture": "llama"}, {})
+    # hand-craft: simpler to assert via _dequantize directly
+    from gpustack_tpu.engine.gguf import _dequantize
+
+    with pytest.raises(ValueError, match="Q4_K"):
+        _dequantize("t", np.zeros(144, np.uint8), (256,), 12)
