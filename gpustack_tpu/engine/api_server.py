@@ -414,6 +414,13 @@ class OpenAIServer:
         seed = body.get("seed")
         if seed is not None:
             seed = int(seed)
+        logit_bias = body.get("logit_bias")
+        if logit_bias is not None:
+            if not isinstance(logit_bias, dict):
+                raise ValueError("logit_bias must be {token_id: bias}")
+            logit_bias = {
+                int(k): float(v) for k, v in logit_bias.items()
+            }
         # chat: logprobs is a bool + top_logprobs count; legacy
         # completions: logprobs is the alternatives count itself
         if chat:
@@ -444,6 +451,7 @@ class OpenAIServer:
             top_k=int(body.get("top_k") or 0),
             top_p=float(body.get("top_p") or 1.0),
             seed=seed,
+            logit_bias=logit_bias,
             stop_texts=stop_texts,
             logprobs=want_logprobs,
             top_logprobs=top_lp,
@@ -731,23 +739,14 @@ def build_engine_from_args(args) -> LLMEngine:
 
     vlm_cfg = None
     if args.model_dir:
-        import glob as _glob
+        from gpustack_tpu.engine.gguf import config_from_gguf
+        from gpustack_tpu.engine.weights import checkpoint_source
 
-        from gpustack_tpu.engine.gguf import config_from_gguf, gguf_file_in
-
-        # same precedence as load_or_init_params: safetensors first, so
-        # config and weights always come from the SAME checkpoint in a
-        # mixed directory
-        has_safetensors = _glob.glob(
-            os.path.join(args.model_dir, "*.safetensors")
-        )
-        gguf_path = None if has_safetensors else gguf_file_in(
-            args.model_dir
-        )
-        if gguf_path:
-            cfg = config_from_gguf(
-                gguf_path, name=args.served_name or ""
-            )
+        # shared precedence helper: config and weights always come from
+        # the SAME checkpoint in a mixed directory
+        kind, path = checkpoint_source(args.model_dir)
+        if kind == "gguf":
+            cfg = config_from_gguf(path, name=args.served_name or "")
         else:
             cfg = load_hf_config(args.model_dir)
     elif args.preset in VLM_PRESETS:

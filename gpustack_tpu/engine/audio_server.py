@@ -57,19 +57,42 @@ class AudioEngine:
 
         return ByteTokenizer()
 
-    async def transcribe(self, wav_bytes: bytes) -> dict:
+    def _task_prompt_ids(self, task: str) -> tuple:
+        """Whisper task conditioning: force the ``<|translate|>`` token
+        after start-of-transcript for X→English translation (reference
+        VoxBox serves /v1/audio/translations through the same model).
+        Tokenizers without whisper task tokens (hermetic byte fallback)
+        condition nothing — transcription behavior."""
+        if task != "translate":
+            return ()
+        convert = getattr(
+            getattr(self.tokenizer, "_tok", None),
+            "convert_tokens_to_ids", None,
+        )
+        if convert is None:
+            return ()
+        tid = convert("<|translate|>")
+        unk = getattr(self.tokenizer._tok, "unk_token_id", None)
+        if tid is None or tid == unk:
+            return ()
+        return (tid,)
+
+    async def transcribe(self, wav_bytes: bytes, task: str = "transcribe") -> dict:
         from gpustack_tpu.models.audio import decode_wav, features_for_model
         from gpustack_tpu.models.whisper import greedy_transcribe
 
         audio = decode_wav(wav_bytes)
         mel = features_for_model(audio, self.cfg)
+        prompt_ids = self._task_prompt_ids(task)
         start = time.monotonic()
         # one transcription at a time per process: decode is a tight
         # jitted loop; concurrency comes from replicas
         async with self._lock:
             ids = await asyncio.get_event_loop().run_in_executor(
                 None,
-                lambda: greedy_transcribe(self.params, self.cfg, mel),
+                lambda: greedy_transcribe(
+                    self.params, self.cfg, mel, prompt_ids=prompt_ids
+                ),
             )
         text = self.tokenizer.decode(ids)
         self.requests += 1
@@ -115,6 +138,9 @@ class AudioServer:
             [
                 web.post(
                     "/v1/audio/transcriptions", self.transcriptions
+                ),
+                web.post(
+                    "/v1/audio/translations", self.transcriptions
                 ),
                 web.post("/v1/audio/speech", self.speech),
                 web.get("/healthz", self.healthz),
@@ -217,8 +243,12 @@ class AudioServer:
             )
         import wave as _wave
 
+        task = (
+            "translate" if request.path.endswith("/translations")
+            else "transcribe"
+        )
         try:
-            result = await self.engine.transcribe(wav)
+            result = await self.engine.transcribe(wav, task=task)
         except (ValueError, _wave.Error, EOFError) as e:
             return web.json_response(
                 {"error": f"invalid audio: {e}"}, status=400
@@ -228,7 +258,10 @@ class AudioServer:
         return web.json_response(
             {
                 "id": f"transcr-{uuid.uuid4().hex[:12]}",
-                "object": "audio.transcription",
+                "object": (
+                    "audio.translation" if task == "translate"
+                    else "audio.transcription"
+                ),
                 "model": self.model_name,
                 **result,
             }

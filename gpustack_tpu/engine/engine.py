@@ -99,6 +99,7 @@ class GenRequest:
     top_k: int = 0
     top_p: float = 1.0
     seed: Optional[int] = None             # OpenAI 'seed': deterministic replay
+    logit_bias: Optional[Dict[int, float]] = None   # token id -> bias
     stop_ids: Tuple[int, ...] = ()
     stop_texts: Tuple[str, ...] = ()       # OpenAI 'stop' strings
     logprobs: bool = False                 # collect per-token logprobs
@@ -285,6 +286,29 @@ class LLMEngine:
                 raise ValueError(
                     "image inputs are unavailable under speculative "
                     "decoding (the draft model has no vision tower)"
+                )
+            if req.logit_bias:
+                raise ValueError(
+                    "logit_bias is unavailable under speculative "
+                    "decoding (verification argmaxes raw logits; the "
+                    "bias would silently stop applying after the "
+                    "first token)"
+                )
+        if req.logit_bias:
+            from gpustack_tpu.engine.sampling import MAX_BIAS
+
+            if len(req.logit_bias) > MAX_BIAS:
+                raise ValueError(
+                    f"logit_bias supports at most {MAX_BIAS} entries "
+                    f"(got {len(req.logit_bias)})"
+                )
+            bad = [
+                t for t in req.logit_bias
+                if not 0 <= int(t) < self.cfg.vocab_size
+            ]
+            if bad:
+                raise ValueError(
+                    f"logit_bias token ids out of range: {bad[:5]}"
                 )
         if len(req.prompt_ids) >= self.max_seq_len:
             raise ValueError(
@@ -660,6 +684,7 @@ class LLMEngine:
         toks, tok_lp, top_ids, top_lps = self.runner.sample_first(
             last_logits, req.temperature, req.top_k, req.top_p,
             seed, req.seed is not None, len(ids) - 1, first_key,
+            logit_bias=req.logit_bias,
         )
         first = int(toks[0])
         first_lps = None
@@ -677,7 +702,7 @@ class LLMEngine:
         self._state = self.runner.insert(
             self._state, k, v, slot, len(ids), first,
             req.temperature, req.top_k, req.top_p,
-            seed, req.seed is not None,
+            seed, req.seed is not None, req.logit_bias,
         )
         info = _SlotInfo(request=req)
         if req.json_mode:

@@ -147,7 +147,7 @@ class BroadcastingRunner:
 
     def sample_first(
         self, last_logits, temperature, top_k, top_p, seed, seeded,
-        position, key,
+        position, key, logit_bias=None,
     ):
         self._leader.broadcast({
             "op": "sample_first",
@@ -155,15 +155,19 @@ class BroadcastingRunner:
             "top_p": float(top_p), "seed": int(seed),
             "seeded": bool(seeded), "position": int(position),
             "key": _key_data_list(key),
+            "logit_bias": (
+                {str(k): float(v) for k, v in logit_bias.items()}
+                if logit_bias else None
+            ),
         })
         return self._runner.sample_first(
             last_logits, temperature, top_k, top_p, seed, seeded,
-            position, key,
+            position, key, logit_bias,
         )
 
     def insert(
         self, state, k, v, slot, true_len, first_token,
-        temperature, top_k, top_p, seed=0, seeded=False,
+        temperature, top_k, top_p, seed=0, seeded=False, logit_bias=None,
     ):
         self._leader.broadcast({
             "op": "insert", "slot": int(slot), "true_len": int(true_len),
@@ -171,10 +175,14 @@ class BroadcastingRunner:
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p), "seed": int(seed),
             "seeded": bool(seeded),
+            "logit_bias": (
+                {str(k): float(v) for k, v in logit_bias.items()}
+                if logit_bias else None
+            ),
         })
         return self._runner.insert(
             state, k, v, slot, true_len, first_token,
-            temperature, top_k, top_p, seed, seeded,
+            temperature, top_k, top_p, seed, seeded, logit_bias,
         )
 
     def decode_step(self, state, key):
@@ -290,6 +298,12 @@ class FollowerLoop:
     def _apply(self, op: Dict[str, Any]) -> None:
         kind = op["op"]
         r = self.runner
+        def bias_of(op):
+            raw = op.get("logit_bias")
+            return (
+                {int(k): float(v) for k, v in raw.items()} if raw else None
+            )
+
         if kind == "prefill":
             self._reg = r.prefill(op["ids"], op["true_len"])
         elif kind == "sample_first":
@@ -297,7 +311,7 @@ class FollowerLoop:
             r.sample_first(
                 self._reg[0], op["temperature"], op["top_k"], op["top_p"],
                 op["seed"], op["seeded"], op["position"],
-                _key_from_list(op["key"]),
+                _key_from_list(op["key"]), bias_of(op),
             )
         elif kind == "insert":
             assert self._reg is not None, "insert before prefill"
@@ -305,7 +319,7 @@ class FollowerLoop:
             self.state = r.insert(
                 self.state, k, v, op["slot"], op["true_len"],
                 op["first_token"], op["temperature"], op["top_k"],
-                op["top_p"], op["seed"], op["seeded"],
+                op["top_p"], op["seed"], op["seeded"], bias_of(op),
             )
         elif kind == "decode":
             self.state, _ = r.decode_step(

@@ -30,12 +30,28 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from gpustack_tpu.engine.sampling import SamplingState, sample
+from gpustack_tpu.engine.sampling import (
+    MAX_BIAS,
+    SamplingState,
+    sample,
+)
 from gpustack_tpu.models.config import ModelConfig
+from gpustack_tpu.models.quant import QuantW, quant_pspecs
 from gpustack_tpu.models.transformer import KVCache, forward
 from gpustack_tpu.parallel.mesh import MeshPlan, make_mesh
 from gpustack_tpu.parallel.sharding import cache_pspec, param_pspecs
-from gpustack_tpu.models.quant import QuantW, quant_pspecs
+
+
+def bias_arrays(logit_bias):
+    """{token_id: bias} → fixed-width (ids i32[MAX_BIAS], vals
+    f32[MAX_BIAS]) arrays (-1 = unused slot)."""
+    ids = [-1] * MAX_BIAS
+    vals = [0.0] * MAX_BIAS
+    if logit_bias:
+        for j, (tid, bias) in enumerate(list(logit_bias.items())[:MAX_BIAS]):
+            ids[j] = int(tid)
+            vals[j] = float(bias)
+    return jnp.asarray(ids, jnp.int32), jnp.asarray(vals, jnp.float32)
 
 
 @jax.tree_util.register_dataclass
@@ -158,11 +174,7 @@ class ModelRunner:
                 positions=self._slot_sharding,
                 active=self._slot_sharding,
                 sampling=SamplingState(
-                    self._slot_sharding,
-                    self._slot_sharding,
-                    self._slot_sharding,
-                    self._slot_sharding,
-                    self._slot_sharding,
+                    *([self._slot_sharding] * 7),
                 ),
             ),
         )
@@ -386,7 +398,7 @@ class ModelRunner:
 
     def _insert_impl(
         self, state, k, v, slot, true_len, first_token,
-        temperature, top_k, top_p, seed, seeded,
+        temperature, top_k, top_p, seed, seeded, bias_ids, bias_vals,
     ):
         Tb = k.shape[1]
         cache = state.cache
@@ -398,25 +410,28 @@ class ModelRunner:
             positions=state.positions.at[slot].set(true_len),
             active=state.active.at[slot].set(True),
             sampling=state.sampling.set_slot(
-                slot, temperature, top_k, top_p, seed, seeded
+                slot, temperature, top_k, top_p, seed, seeded,
+                bias_ids, bias_vals,
             ),
         )
 
     def insert(
         self, state: DecodeState, k, v, slot: int, true_len: int,
         first_token: int, temperature: float, top_k: int, top_p: float,
-        seed: int = 0, seeded: bool = False,
+        seed: int = 0, seeded: bool = False, logit_bias=None,
     ) -> DecodeState:
         Tb = k.shape[1]
         fn = self._inserts.get(Tb)
         if fn is None:
             fn = jax.jit(self._insert_impl, donate_argnums=(0,))
             self._inserts[Tb] = fn
+        bias_ids, bias_vals = bias_arrays(logit_bias)
         return fn(
             state, k, v, jnp.int32(slot), jnp.int32(true_len),
             jnp.int32(first_token), jnp.float32(temperature),
             jnp.int32(top_k), jnp.float32(top_p),
             jnp.uint32(seed), jnp.bool_(seeded),
+            bias_ids, bias_vals,
         )
 
     def deactivate(self, state: DecodeState, slot: int) -> DecodeState:
@@ -474,11 +489,12 @@ class ModelRunner:
 
     def _sample_first_impl(
         self, last_logits, temperature, top_k, top_p, seed, seeded,
-        position, key,
+        position, key, bias_ids, bias_vals,
     ):
         st = SamplingState(
             temperature=temperature[None], top_k=top_k[None],
             top_p=top_p[None], seed=seed[None], seeded=seeded[None],
+            bias_ids=bias_ids[None], bias_vals=bias_vals[None],
         )
         outs = sample(last_logits[None, :], st, key, position[None])
         # host-read outputs must be replicated on multi-host meshes
@@ -489,7 +505,7 @@ class ModelRunner:
 
     def sample_first(
         self, last_logits, temperature, top_k, top_p, seed, seeded,
-        position, key,
+        position, key, logit_bias=None,
     ):
         """Sample the first generated token from a prefill's last-position
         logits — one row through the same device sampler as decode, so
@@ -498,10 +514,11 @@ class ModelRunner:
         (engine/multihost.py)."""
         if self._sample_first is None:
             self._sample_first = jax.jit(self._sample_first_impl)
+        bias_ids, bias_vals = bias_arrays(logit_bias)
         return self._sample_first(
             last_logits, jnp.float32(temperature), jnp.int32(top_k),
             jnp.float32(top_p), jnp.uint32(seed), jnp.bool_(seeded),
-            jnp.int32(position), key,
+            jnp.int32(position), key, bias_ids, bias_vals,
         )
 
     # -- draft-model support ---------------------------------------------

@@ -40,6 +40,8 @@ class SamplingState:
     top_p: jax.Array        # f32 [B]
     seed: jax.Array         # u32 [B]
     seeded: jax.Array       # bool [B]
+    bias_ids: jax.Array     # i32 [B, MAX_BIAS] (-1 = unused slot)
+    bias_vals: jax.Array    # f32 [B, MAX_BIAS]
 
     @staticmethod
     def create(batch: int) -> "SamplingState":
@@ -49,17 +51,26 @@ class SamplingState:
             top_p=jnp.ones((batch,), jnp.float32),
             seed=jnp.zeros((batch,), jnp.uint32),
             seeded=jnp.zeros((batch,), jnp.bool_),
+            bias_ids=jnp.full((batch, MAX_BIAS), -1, jnp.int32),
+            bias_vals=jnp.zeros((batch, MAX_BIAS), jnp.float32),
         )
 
     def set_slot(
-        self, slot, temperature, top_k, top_p, seed=0, seeded=False
+        self, slot, temperature, top_k, top_p, seed=0, seeded=False,
+        bias_ids=None, bias_vals=None,
     ) -> "SamplingState":
+        if bias_ids is None:
+            bias_ids = jnp.full((MAX_BIAS,), -1, jnp.int32)
+        if bias_vals is None:
+            bias_vals = jnp.zeros((MAX_BIAS,), jnp.float32)
         return SamplingState(
             temperature=self.temperature.at[slot].set(temperature),
             top_k=self.top_k.at[slot].set(top_k),
             top_p=self.top_p.at[slot].set(top_p),
             seed=self.seed.at[slot].set(seed),
             seeded=self.seeded.at[slot].set(seeded),
+            bias_ids=self.bias_ids.at[slot].set(bias_ids),
+            bias_vals=self.bias_vals.at[slot].set(bias_vals),
         )
 
 
@@ -72,6 +83,10 @@ class SamplingState:
 CAND = 64
 # Top-logprob candidates returned per step (OpenAI caps top_logprobs at 20).
 TOPLP = 20
+# logit_bias entries per request. Applied to the FULL logits before the
+# top-k rank (exact semantics — a +bias can promote a token from outside
+# the candidate window, a -100 ban always lands).
+MAX_BIAS = 64
 
 
 def _row_keys(state: SamplingState, positions: jax.Array, key: jax.Array):
@@ -108,6 +123,14 @@ def sample(
     top_logprobs f32[B, TOPLP])``.
     """
     B, V = logits.shape
+    # logit_bias before ranking: scatter-add the sparse per-row biases
+    # (unused slots carry id -1 / value 0 → clipped no-op add at col 0)
+    valid = state.bias_ids >= 0
+    bias_cols = jnp.clip(state.bias_ids, 0, V - 1)
+    bias_vals = jnp.where(valid, state.bias_vals, 0.0)
+    logits = logits.at[
+        jnp.arange(B)[:, None], bias_cols
+    ].add(bias_vals)
     n = min(CAND, V)
     top_logits, top_idx = jax.lax.top_k(logits, n)   # [B, n] descending
 

@@ -377,19 +377,32 @@ def merge_lora_adapters(cfg, params: Dict[str, Any], adapter_dirs):
     return params
 
 
-def load_or_init_params(
-    cfg: ModelConfig, model_dir: Optional[str], seed: int = 0
-) -> Dict[str, Any]:
+def checkpoint_source(model_dir: Optional[str]):
+    """(kind, path) for a model source: ("safetensors", dir),
+    ("gguf", file) or ("none", None). The ONE place format precedence
+    lives — config resolution and weight loading must always pick the
+    same checkpoint in a mixed directory."""
     if model_dir and glob.glob(os.path.join(model_dir, "*.safetensors")):
-        logger.info("loading checkpoint from %s", model_dir)
-        return load_hf_checkpoint(cfg, model_dir)
+        return "safetensors", model_dir
     if model_dir:
         from gpustack_tpu.engine.gguf import gguf_file_in
 
         gguf_path = gguf_file_in(model_dir)
         if gguf_path:
-            logger.info("loading GGUF checkpoint from %s", gguf_path)
-            return load_gguf_checkpoint(cfg, gguf_path)
+            return "gguf", gguf_path
+    return "none", None
+
+
+def load_or_init_params(
+    cfg: ModelConfig, model_dir: Optional[str], seed: int = 0
+) -> Dict[str, Any]:
+    kind, path = checkpoint_source(model_dir)
+    if kind == "safetensors":
+        logger.info("loading checkpoint from %s", path)
+        return load_hf_checkpoint(cfg, path)
+    if kind == "gguf":
+        logger.info("loading GGUF checkpoint from %s", path)
+        return load_gguf_checkpoint(cfg, path)
     logger.warning(
         "no checkpoint at %r — initializing random weights for %s",
         model_dir, cfg.name,

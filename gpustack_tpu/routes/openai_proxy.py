@@ -430,9 +430,10 @@ def add_openai_routes(app: web.Application) -> None:
         return resp
 
     async def audio_proxy(request: web.Request):
-        """/v1/audio/transcriptions: multipart relay to an audio-model
-        instance (reference openai endpoint registry covers audio,
-        gateway/utils.py; served by the VoxBox-role audio engine)."""
+        """/v1/audio/transcriptions and /v1/audio/translations:
+        multipart relay to an audio-model instance (reference openai
+        endpoint registry covers audio, gateway/utils.py; served by the
+        VoxBox-role audio engine)."""
         import uuid as _uuid
 
         from gpustack_tpu.server.worker_request import worker_fetch
@@ -488,16 +489,16 @@ def add_openai_routes(app: web.Application) -> None:
         raw = b"".join(parts)
         ctype = f"multipart/form-data; boundary={boundary}"
         try:
+            op = request.path.removeprefix("/v1/")   # audio/<task>s
             if isinstance(target, ProviderTarget):
                 upstream = await _provider_fetch(
-                    app, target.provider, "audio/transcriptions",
+                    app, target.provider, op,
                     raw_body=raw, content_type=ctype,
                 )
             else:
                 upstream = await worker_fetch(
                     app, worker, "POST",
-                    f"/proxy/instances/{instance.id}"
-                    "/v1/audio/transcriptions",
+                    f"/proxy/instances/{instance.id}/v1/{op}",
                     raw_body=raw,
                     content_type=ctype,
                 )
@@ -514,7 +515,7 @@ def add_openai_routes(app: web.Application) -> None:
             # usage row per transcription: token fields are zero (audio
             # has no token accounting); request counts/metering still flow
             await _record_usage(
-                request, model_id, name, "audio/transcriptions",
+                request, model_id, name, op,
                 0, 0, False, provider_id=provider_id,
             )
         return web.Response(
@@ -581,4 +582,5 @@ def add_openai_routes(app: web.Application) -> None:
         proxy,
     )
     app.router.add_post("/v1/audio/transcriptions", audio_proxy)
+    app.router.add_post("/v1/audio/translations", audio_proxy)
     app.router.add_post("/v1/audio/speech", speech_proxy)

@@ -120,6 +120,26 @@ def test_transcription_rejects_bad_input(model):
     _run(model, go)
 
 
+def test_translations_route(model):
+    """X→English translation rides the same whisper model with task
+    conditioning (reference VoxBox serves /v1/audio/translations)."""
+    import aiohttp
+
+    async def go(client):
+        form = aiohttp.FormData()
+        form.add_field(
+            "file", _wav_bytes(), filename="a.wav",
+            content_type="audio/wav",
+        )
+        r = await client.post("/v1/audio/translations", data=form)
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "audio.translation"
+        assert isinstance(data["text"], str)
+
+    _run(model, go)
+
+
 # ---------------------------------------------------------------------------
 # TTS (/v1/audio/speech) — reference VoxBox serves both halves
 # (worker/backends/vox_box.py:23)

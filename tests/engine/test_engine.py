@@ -187,6 +187,8 @@ def test_sampling_greedy_and_filters():
         top_p=jnp.asarray([1.0, 1.0], jnp.float32),
         seed=jnp.zeros((2,), jnp.uint32),
         seeded=jnp.zeros((2,), jnp.bool_),
+        bias_ids=jnp.full((2, 64), -1, jnp.int32),
+        bias_vals=jnp.zeros((2, 64), jnp.float32),
     )
     toks, tok_lp, top_ids, top_lps = sample(logits, st, jax.random.key(0))
     assert int(toks[0]) == 2            # greedy row
@@ -210,6 +212,8 @@ def test_sampling_top_p_excludes_tail():
         top_p=jnp.full((8,), 0.5, jnp.float32),
         seed=jnp.zeros((8,), jnp.uint32),
         seeded=jnp.zeros((8,), jnp.bool_),
+        bias_ids=jnp.full((8, 64), -1, jnp.int32),
+        bias_vals=jnp.zeros((8, 64), jnp.float32),
     )
     for seed in range(5):
         toks, *_ = sample(logits, st, jax.random.key(seed))
@@ -224,6 +228,8 @@ def test_sampling_seeded_rows_replay():
         top_p=jnp.ones((4,), jnp.float32),
         seed=jnp.asarray([7, 7, 8, 8], jnp.uint32),
         seeded=jnp.ones((4,), jnp.bool_),
+        bias_ids=jnp.full((4, 64), -1, jnp.int32),
+        bias_vals=jnp.zeros((4, 64), jnp.float32),
     )
     pos = jnp.asarray([3, 3, 3, 9], jnp.int32)
     # seeded rows ignore the step key entirely: different keys, same draw

@@ -251,6 +251,80 @@ def test_json_mode_accepted(tiny_server):
     assert data["choices"][0]["finish_reason"] in ("stop", "length")
 
 
+def test_logit_bias_bans_and_forces(tiny_server):
+    """Exact logit_bias: bias lands on the FULL logits before the top-k
+    rank, so +100 forces any token and -100 always bans (vLLM-exact
+    semantics the reference proxies; gpustack/routes/openai.py)."""
+    import jax
+
+    from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+    from gpustack_tpu.models import init_params
+    from gpustack_tpu.models.config import get_config
+
+    cfg = get_config("tiny")
+    engine = LLMEngine(
+        cfg, init_params(cfg, jax.random.key(0)),
+        max_slots=2, max_seq_len=128,
+    )
+    engine.start()
+    try:
+        def run(bias):
+            req = GenRequest(
+                prompt_ids=[5, 9, 33], max_tokens=4, temperature=0.0,
+                stop_ids=(), logit_bias=bias,
+            )
+            engine.generate(req, timeout=300)
+            return req.output_ids
+
+        base = run(None)
+        # +100 dominates every logit: the forced token is generated at
+        # every step
+        forced = run({7: 100.0})
+        assert forced == [7, 7, 7, 7]
+        # -100 bans the baseline greedy first token
+        banned = run({base[0]: -100.0})
+        assert banned[0] != base[0]
+        # too many entries / out-of-range ids rejected loudly
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="out of range"):
+            engine.submit(GenRequest(
+                prompt_ids=[1], logit_bias={999999: 1.0}
+            ))
+        with _pytest.raises(ValueError, match="at most"):
+            engine.submit(GenRequest(
+                prompt_ids=[1],
+                logit_bias={i: 1.0 for i in range(100)},
+            ))
+    finally:
+        engine.stop()
+
+    # API plumbing: accepted and applied through HTTP
+    status, data = asyncio.run(_post(
+        tiny_server, "/v1/chat/completions",
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": 2, "temperature": 0,
+            "logit_bias": {"7": 100},
+            "logprobs": True, "top_logprobs": 1,
+        },
+    ))
+    assert status == 200, data
+    for entry in data["choices"][0]["logprobs"]["content"]:
+        # +100 bias makes the forced token carry ~all probability mass
+        assert entry["logprob"] > -0.01
+    status, err = asyncio.run(_post(
+        tiny_server, "/v1/chat/completions",
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "x"}],
+            "logit_bias": {"999999": 5},
+        },
+    ))
+    assert status == 400
+
+
 def test_bad_params_rejected(tiny_server):
     status, _ = asyncio.run(_post(
         tiny_server, "/v1/chat/completions",
