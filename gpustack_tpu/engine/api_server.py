@@ -159,6 +159,21 @@ class OpenAIServer:
             "# TYPE gpustack_engine_tokens_generated_total counter",
             f"gpustack_engine_tokens_generated_total {h['tokens_generated']}",
         ]
+        # request-latency histograms (vLLM's ttft/tpot observability
+        # parity — the reference normalizes these into its dashboards,
+        # metrics_config.yaml)
+        for name, hist in (
+            ("gpustack_engine_ttft_seconds", self.engine.ttft_hist),
+            ("gpustack_engine_tpot_seconds", self.engine.tpot_hist),
+            ("gpustack_engine_e2e_seconds", self.engine.e2e_hist),
+        ):
+            cum, total, count = hist.snapshot()
+            lines.append(f"# TYPE {name} histogram")
+            for ub, c in cum:
+                le = "+Inf" if ub == float("inf") else repr(ub)
+                lines.append(f'{name}_bucket{{le="{le}"}} {c}')
+            lines.append(f"{name}_sum {total:.6f}")
+            lines.append(f"{name}_count {count}")
         return web.Response(text="\n".join(lines) + "\n")
 
     async def completions(self, request: web.Request) -> web.StreamResponse:

@@ -38,6 +38,52 @@ logger = logging.getLogger(__name__)
 _FETCH_LAG = 2  # decode steps in flight before the host inspects tokens
 
 
+class LatencyHistogram:
+    """Fixed-bucket Prometheus-style histogram (counts are cumulative
+    per bucket at render time, kept simple here as per-bucket tallies).
+
+    The reference normalizes vLLM's ttft/tpot histograms into its
+    dashboard pipeline (metrics_config.yaml); the in-repo engine emits
+    the same shapes natively."""
+
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)       # upper bounds, seconds
+        self.counts = [0] * (len(self.buckets) + 1)   # +Inf tail
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        # bucket BEFORE count: snapshot() reads count first, so a racing
+        # scrape can under-report count but never show count > +Inf
+        # bucket (which would corrupt histogram_quantile)
+        self.total += value
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.count += 1
+
+    def snapshot(self):
+        """[(le, cumulative_count)], sum, count — count read first (see
+        observe) and clamped to the +Inf bucket so the exposition always
+        satisfies count <= bucket{le=\"+Inf\"}."""
+        count = self.count
+        cum, out = 0, []
+        for ub, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append((ub, cum))
+        inf = cum + self.counts[-1]
+        out.append((float("inf"), inf))
+        return out, self.total, min(count, inf)
+
+
+TTFT_BUCKETS_S = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+TPOT_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5)
+E2E_BUCKETS_S = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
 def _ngram_propose(ctx: List[int], k: int, n: int = 2) -> List[int]:
     """Propose up to k continuation tokens: find the latest earlier
     occurrence of the context's final n-gram and replay what followed
@@ -202,6 +248,9 @@ class LLMEngine:
         self._id_counter = itertools.count()
         self._step_count = 0
         self._tokens_generated = 0
+        self.ttft_hist = LatencyHistogram(TTFT_BUCKETS_S)
+        self.tpot_hist = LatencyHistogram(TPOT_BUCKETS_S)
+        self.e2e_hist = LatencyHistogram(E2E_BUCKETS_S)
         # Chunked prefill (vLLM's enable-chunked-prefill role): prompts
         # longer than the chunk are prefilled chunk-by-chunk with a
         # decode step interleaved between chunks, so one long prompt
@@ -976,6 +1025,14 @@ class LLMEngine:
         req.finish_reason = reason
         req.output_text = info.text
         req.finished_at = time.time()
+        if req.first_token_at and req.submitted_at:
+            self.ttft_hist.observe(req.first_token_at - req.submitted_at)
+            self.e2e_hist.observe(req.finished_at - req.submitted_at)
+            if len(req.output_ids) > 1:
+                self.tpot_hist.observe(
+                    (req.finished_at - req.first_token_at)
+                    / (len(req.output_ids) - 1)
+                )
         self._state = self.runner.deactivate(self._state, slot)
         if self.draft_runner is not None:
             self._draft_state = self.draft_runner.deactivate(

@@ -22,6 +22,9 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_engine_decode_steps_total": "gpustack_tpu:decode_steps_total",
     "gpustack_engine_tokens_generated_total":
         "gpustack_tpu:generation_tokens_total",
+    "gpustack_engine_ttft_seconds": "gpustack_tpu:ttft_seconds",
+    "gpustack_engine_tpot_seconds": "gpustack_tpu:tpot_seconds",
+    "gpustack_engine_e2e_seconds": "gpustack_tpu:e2e_request_seconds",
     # in-repo audio engine (engine/audio_server.py)
     "gpustack_tpu_audio_requests_total": "gpustack_tpu:audio_requests_total",
     "gpustack_tpu_audio_seconds_total": "gpustack_tpu:audio_seconds_total",

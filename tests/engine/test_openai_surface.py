@@ -234,6 +234,51 @@ def test_seed_determinism(tiny_server):
     )
 
 
+def test_latency_histograms_in_metrics(tiny_server):
+    """/metrics exposes ttft/tpot/e2e histograms after requests run
+    (vLLM observability parity; normalized by worker/metrics_map.py)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        server = tiny_server()
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3, "temperature": 0,
+            })
+            assert r.status == 200
+            r = await client.get("/metrics")
+            text = await r.text()
+        finally:
+            await client.close()
+        assert "gpustack_engine_ttft_seconds_bucket" in text
+        assert 'le="+Inf"' in text
+        # the request we just ran is counted, buckets are cumulative
+        import re
+
+        count = int(re.search(
+            r"gpustack_engine_ttft_seconds_count (\d+)", text
+        ).group(1))
+        assert count >= 1
+        inf_count = int(re.search(
+            r'gpustack_engine_ttft_seconds_bucket\{le="\+Inf"\} (\d+)',
+            text,
+        ).group(1))
+        assert inf_count == count
+        # normalization maps the histogram family
+        from gpustack_tpu.worker.metrics_map import (
+            normalize_engine_metrics,
+        )
+
+        normalized = "\n".join(normalize_engine_metrics(text, {}))
+        assert "gpustack_tpu:ttft_seconds_bucket" in normalized
+
+    asyncio.run(go())
+
+
 def test_json_mode_accepted(tiny_server):
     status, data = asyncio.run(_post(
         tiny_server, "/v1/chat/completions",
