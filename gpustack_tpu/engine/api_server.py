@@ -237,15 +237,42 @@ class OpenAIServer:
         json_mode = isinstance(rf, dict) and rf.get("type") in (
             "json_object", "json_schema"
         )
+        schema = None
         if json_mode:
             instruction = JSON_MODE_INSTRUCTION
             schema = (rf.get("json_schema") or {}).get("schema")
             if schema:
+                # a broken schema is a client error: reject now instead
+                # of burning two generations that can only fail
+                import jsonschema
+
+                try:
+                    jsonschema.validators.validator_for(
+                        schema
+                    ).check_schema(schema)
+                except jsonschema.SchemaError as e:
+                    return _error(400, f"invalid json_schema: {e.message}")
                 instruction += (
                     " The object must conform to this JSON schema: "
                     + json.dumps(schema)
                 )
             msgs.append({"role": "system", "content": instruction})
+
+        def reencode_with_feedback(attempt_text: str, error: str):
+            """Retry prompt for schema-validation failure: the failed
+            attempt + the validator's error, re-templated."""
+            retry_msgs = msgs + [
+                {"role": "assistant", "content": attempt_text},
+                {
+                    "role": "system",
+                    "content": (
+                        "Your JSON failed schema validation: "
+                        f"{error[:400]}. Respond again with ONLY a "
+                        "corrected JSON object."
+                    ),
+                },
+            ]
+            return self.engine.tokenizer.apply_chat_template(retry_msgs)
 
         embeds_override = None
         if has_images:
@@ -281,6 +308,7 @@ class OpenAIServer:
             request, body, prompt_ids, chat=True,
             tools_active=tools_active, json_mode=json_mode,
             embeds_override=embeds_override,
+            schema=schema, reencode=reencode_with_feedback,
         )
 
     async def rerank(self, request: web.Request) -> web.Response:
@@ -497,10 +525,60 @@ class OpenAIServer:
     def _finish_reason(self, gen: GenRequest, had_tool_calls: bool) -> str:
         return "tool_calls" if had_tool_calls else gen.finish_reason
 
+    async def _validate_schema(
+        self, body, gen: GenRequest, schema, reencode, loop,
+        remaining_s: float, allow_retry: bool,
+    ):
+        """Validate a completed generation against the request's JSON
+        schema; one guided retry on failure (the failed attempt + the
+        validator's error re-enter the prompt). Returns (winning
+        GenRequest, ``passed``/``failed: ...`` verdict, retry-or-None —
+        the retry rides back for usage accounting).
+
+        Divergence from the reference's vLLM backends (which enforce
+        schemas with token-level grammars): this is validate-and-retry —
+        the verdict is ALWAYS reported on the non-streaming choice so a
+        failure can't pass silently (streams skip validation and say
+        so)."""
+        import jsonschema
+
+        def verdict_of(text):
+            try:
+                jsonschema.validate(json.loads(text), schema)
+                return "passed"
+            except json.JSONDecodeError as e:
+                return f"failed: not valid JSON ({e})"
+            except jsonschema.ValidationError as e:
+                return f"failed: {e.message}"
+
+        verdict = verdict_of(gen.output_text)
+        if verdict == "passed" or not allow_retry or remaining_s < 30:
+            return gen, verdict, None
+        try:
+            # reencode runs a chat template; some family templates
+            # reject assistant→system sequences — a failed retry
+            # RENDERING must degrade to the original verdict, not a 500
+            retry_ids = reencode(gen.output_text, verdict)
+            retry = self._gen_request(
+                body, retry_ids, chat=True, json_mode=True
+            )
+            self.engine.submit(retry)
+            await loop.run_in_executor(
+                None, retry.done.wait, remaining_s
+            )
+        except Exception as e:
+            logger.warning("schema retry not possible: %s", e)
+            return gen, verdict, None
+        if not retry.done.is_set():
+            # the orphan finishes at max_tokens on its own; bounded
+            logger.warning("schema retry timed out; keeping original")
+            return gen, verdict, retry
+        return retry, verdict_of(retry.output_text), retry
+
     async def _run(
         self, request: web.Request, body: Dict[str, Any], prompt_ids,
         chat: bool, tools_active: bool = False, json_mode: bool = False,
-        embeds_override=None,
+        embeds_override=None, schema=None, reencode=None,
     ) -> web.StreamResponse:
         try:
             gens = self._make_gens(
@@ -509,7 +587,10 @@ class OpenAIServer:
         except (TypeError, ValueError) as e:
             return _error(400, f"bad sampling params: {e}")
         if body.get("stream"):
-            return await self._stream(request, gens, chat, tools_active)
+            return await self._stream(
+                request, gens, chat, tools_active,
+                schema_active=schema is not None,
+            )
         loop = asyncio.get_running_loop()
         try:
             for gen in gens:
@@ -523,6 +604,28 @@ class OpenAIServer:
             if not gen.done.is_set():
                 return _error(504, "generation timed out")
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
+        # usage is billed on what the CLIENT sent + everything actually
+        # generated (incl. discarded schema-retry attempts) — a swapped
+        # gen must not rewrite prompt_tokens or vanish output tokens
+        usage = _usage(gens)
+        verdicts: List[Optional[str]] = [None] * len(gens)
+        if chat and schema is not None and reencode is not None:
+            for i in range(len(gens)):
+                # multimodal retries would drop the images (the retry
+                # prompt re-templates without the vision path): validate
+                # only, never retry
+                allow_retry = (
+                    len(gens) == 1 and embeds_override is None
+                )
+                gens[i], verdicts[i], retry = (
+                    await self._validate_schema(
+                        body, gens[i], schema, reencode, loop,
+                        max(0.0, deadline - loop.time()), allow_retry,
+                    )
+                )
+                if retry is not None:
+                    usage["completion_tokens"] += len(retry.output_ids)
+                    usage["total_tokens"] += len(retry.output_ids)
         choices = []
         for i, gen in enumerate(gens):
             text = gen.output_text
@@ -548,6 +651,10 @@ class OpenAIServer:
                     choice["logprobs"] = _chat_logprobs(
                         gen, self.engine.tokenizer
                     )
+                if verdicts[i] is not None:
+                    # always reported: schema conformance is validated,
+                    # not grammar-guaranteed (see _validate_schema)
+                    choice["x_schema_validation"] = verdicts[i]
             else:
                 choice = {
                     "index": i,
@@ -565,7 +672,7 @@ class OpenAIServer:
             "created": int(time.time()),
             "model": self.model_name,
             "choices": choices,
-            "usage": _usage(gens),
+            "usage": usage,
         }
         if gens[0].seed is not None:
             payload["system_fingerprint"] = SYSTEM_FINGERPRINT
@@ -573,7 +680,7 @@ class OpenAIServer:
 
     async def _stream(
         self, request: web.Request, gens: List[GenRequest], chat: bool,
-        tools_active: bool = False,
+        tools_active: bool = False, schema_active: bool = False,
     ) -> web.StreamResponse:
         loop = asyncio.get_running_loop()
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
@@ -693,6 +800,12 @@ class OpenAIServer:
                 i, {} if chat else "",
                 self._finish_reason(gen, had_calls),
             )
+            if schema_active:
+                # streams can't be validated retro-actively; say so
+                # instead of implying conformance
+                final["choices"][0]["x_schema_validation"] = (
+                    "skipped (stream)"
+                )
             if gen.logprobs:
                 # streaming logprobs ride the final chunk (per-piece
                 # logprobs would need token-aligned streaming)

@@ -447,6 +447,115 @@ TOOLS = [{
 }]
 
 
+def test_json_schema_validated_and_reported():
+    """response_format json_schema: output is validated (jsonschema) and
+    the verdict always rides the choice; valid output passes."""
+    server = _scripted_server('{"name": "SF", "temp": 18}')
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"}, "temp": {"type": "number"},
+        },
+        "required": ["name", "temp"],
+    }
+    status, data = asyncio.run(_post(
+        server, "/v1/chat/completions",
+        {
+            "model": "scripted",
+            "messages": [{"role": "user", "content": "weather json"}],
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"name": "weather", "schema": schema},
+            },
+        },
+    ))
+    assert status == 200, data
+    assert data["choices"][0]["x_schema_validation"] == "passed"
+
+
+def test_json_schema_failure_retries_and_flags():
+    """Invalid output triggers ONE guided retry; a still-invalid result
+    is flagged, never silently passed (the scripted engine always emits
+    the same wrong object, so the retry must also fail)."""
+    engine = ScriptedEngine('{"name": "SF"}')      # missing 'temp'
+    submits = []
+    orig = engine.submit
+    engine.submit = lambda gen: (submits.append(1), orig(gen))[1]
+    from gpustack_tpu.engine.api_server import OpenAIServer
+
+    schema = {
+        "type": "object",
+        "required": ["name", "temp"],
+    }
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        server = OpenAIServer(engine, model_name="scripted")
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.post("/v1/chat/completions", json={
+                "model": "scripted",
+                "messages": [{"role": "user", "content": "x"}],
+                "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {"name": "w", "schema": schema},
+                },
+            })
+            return resp.status, await resp.json()
+        finally:
+            await client.close()
+
+    status, data = asyncio.run(go())
+    assert status == 200
+    assert len(submits) == 2                        # original + 1 retry
+    verdict = data["choices"][0]["x_schema_validation"]
+    assert verdict.startswith("failed:")
+    assert "temp" in verdict
+    # retry tokens are billed: completion covers BOTH attempts
+    one_attempt = len(engine.tokenizer.encode('{"name": "SF"}'))
+    assert data["usage"]["completion_tokens"] == 2 * one_attempt
+
+
+def test_json_schema_bad_schema_rejected_without_generating():
+    server = _scripted_server("anything")
+    status, data = asyncio.run(_post(
+        server, "/v1/chat/completions",
+        {
+            "model": "scripted",
+            "messages": [{"role": "user", "content": "x"}],
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {
+                    "name": "w",
+                    "schema": {"type": "not-a-real-type"},
+                },
+            },
+        },
+    ))
+    assert status == 400
+    assert "invalid json_schema" in data["error"]["message"]
+
+
+def test_json_schema_stream_marks_skipped():
+    server = _scripted_server('{"a": 1}', ['{"a": 1}'])
+    chunks = asyncio.run(_stream_chunks(server, {
+        "model": "scripted", "stream": True,
+        "messages": [{"role": "user", "content": "x"}],
+        "response_format": {
+            "type": "json_schema",
+            "json_schema": {"name": "w", "schema": {"type": "object"}},
+        },
+    }))
+    finals = [
+        c for c in chunks if c["choices"][0]["finish_reason"] is not None
+    ]
+    assert finals[-1]["choices"][0]["x_schema_validation"] == (
+        "skipped (stream)"
+    )
+
+
 def test_tool_call_roundtrip():
     server = _scripted_server(
         '<tool_call>{"name": "get_weather", "arguments": '
