@@ -246,6 +246,10 @@ class OpenAIServer:
                 # of burning two generations that can only fail
                 import jsonschema
 
+                if not isinstance(schema, (dict, bool)):
+                    return _error(
+                        400, "json_schema.schema must be an object"
+                    )
                 try:
                     jsonschema.validators.validator_for(
                         schema
@@ -416,6 +420,19 @@ class OpenAIServer:
                 return _error(400, "'input' items must be non-empty")
             batch_ids.append(ids)
             total_tokens += len(ids)
+        dimensions = body.get("dimensions")
+        if dimensions is not None:
+            if isinstance(dimensions, bool) or not isinstance(
+                dimensions, int
+            ):
+                return _error(400, "'dimensions' must be an integer")
+            if dimensions < 1:
+                return _error(400, "'dimensions' must be positive")
+        encoding_format = body.get("encoding_format", "float")
+        if encoding_format not in ("float", "base64"):
+            return _error(
+                400, "'encoding_format' must be float or base64"
+            )
         loop = asyncio.get_running_loop()
         try:
             vecs = await loop.run_in_executor(
@@ -423,8 +440,36 @@ class OpenAIServer:
             )
         except ValueError as e:
             return _error(400, str(e))
+        if dimensions is not None:
+            if dimensions > len(vecs[0]):
+                return _error(
+                    400,
+                    f"'dimensions' {dimensions} exceeds the model's "
+                    f"embedding size {len(vecs[0])}",
+                )
+            # matryoshka-style truncation + renormalize (OpenAI
+            # 'dimensions' semantics; vLLM does the same)
+            import math
+
+            def shrink(vec):
+                cut = vec[:dimensions]
+                norm = math.sqrt(sum(x * x for x in cut)) or 1.0
+                return [x / norm for x in cut]
+
+            vecs = [shrink(v) for v in vecs]
+
+        def render(vec):
+            if encoding_format == "base64":
+                import base64
+                import struct
+
+                return base64.b64encode(
+                    struct.pack(f"<{len(vec)}f", *vec)
+                ).decode()
+            return vec
+
         data = [
-            {"object": "embedding", "index": i, "embedding": vec}
+            {"object": "embedding", "index": i, "embedding": render(vec)}
             for i, vec in enumerate(vecs)
         ]
         return web.json_response(
@@ -611,11 +656,21 @@ class OpenAIServer:
         verdicts: List[Optional[str]] = [None] * len(gens)
         if chat and schema is not None and reencode is not None:
             for i in range(len(gens)):
+                # a tool-call turn is not a schema violation: the JSON
+                # contract applies to the final content answer, not to
+                # tool-call markup — skip validation entirely
+                if tools_active and parse_tool_calls(
+                    gens[i].output_text
+                )[1]:
+                    continue
                 # multimodal retries would drop the images (the retry
-                # prompt re-templates without the vision path): validate
-                # only, never retry
+                # prompt re-templates without the vision path), and a
+                # length-truncated attempt would only truncate again:
+                # validate only, never retry, in those cases
                 allow_retry = (
-                    len(gens) == 1 and embeds_override is None
+                    len(gens) == 1
+                    and embeds_override is None
+                    and gens[i].finish_reason != "length"
                 )
                 gens[i], verdicts[i], retry = (
                     await self._validate_schema(

@@ -57,33 +57,63 @@ class AudioEngine:
 
         return ByteTokenizer()
 
-    def _task_prompt_ids(self, task: str) -> tuple:
-        """Whisper task conditioning: force the ``<|translate|>`` token
-        after start-of-transcript for X→English translation (reference
-        VoxBox serves /v1/audio/translations through the same model).
-        Tokenizers without whisper task tokens (hermetic byte fallback)
-        condition nothing — transcription behavior."""
-        if task != "translate":
-            return ()
+    def _task_prompt_ids(self, task: str, language: str = "") -> tuple:
+        """Whisper task/language conditioning: force ``<|xx|>`` (the
+        OpenAI ``language`` form field, ISO 639-1) and ``<|translate|>``
+        tokens after start-of-transcript (reference VoxBox serves both
+        /v1/audio endpoints through the same model). Tokenizers without
+        whisper task tokens (hermetic byte fallback) condition nothing."""
         convert = getattr(
             getattr(self.tokenizer, "_tok", None),
             "convert_tokens_to_ids", None,
         )
         if convert is None:
+            if language:
+                raise ValueError(
+                    f"this model's tokenizer has no language tokens; "
+                    f"cannot honor language={language!r}"
+                )
             return ()
-        tid = convert("<|translate|>")
         unk = getattr(self.tokenizer._tok, "unk_token_id", None)
-        if tid is None or tid == unk:
-            return ()
-        return (tid,)
 
-    async def transcribe(self, wav_bytes: bytes, task: str = "transcribe") -> dict:
+        def tid_of(token: str):
+            tid = convert(token)
+            return tid if tid is not None and tid != unk else None
+
+        ids = []
+        if language:
+            lang_tid = tid_of(f"<|{language.lower()}|>")
+            if lang_tid is None:
+                # an unhonorable hint must not be silently dropped —
+                # the client would believe it was applied
+                raise ValueError(
+                    f"unsupported language {language!r} (ISO 639-1 "
+                    "code the model's tokenizer knows, e.g. 'en')"
+                )
+            ids.append(lang_tid)
+        if task == "translate":
+            tr = tid_of("<|translate|>")
+            if tr is not None:
+                ids.append(tr)
+        elif ids:
+            # whisper's canonical conditioning is sot→language→task:
+            # with a forced language the task token must be forced too,
+            # or greedy decode may pick <|translate|> on its own
+            tr = tid_of("<|transcribe|>")
+            if tr is not None:
+                ids.append(tr)
+        return tuple(ids)
+
+    async def transcribe(
+        self, wav_bytes: bytes, task: str = "transcribe",
+        language: str = "",
+    ) -> dict:
         from gpustack_tpu.models.audio import decode_wav, features_for_model
         from gpustack_tpu.models.whisper import greedy_transcribe
 
         audio = decode_wav(wav_bytes)
         mel = features_for_model(audio, self.cfg)
-        prompt_ids = self._task_prompt_ids(task)
+        prompt_ids = self._task_prompt_ids(task, language)
         start = time.monotonic()
         # one transcription at a time per process: decode is a tight
         # jitted loop; concurrency comes from replicas
@@ -232,11 +262,14 @@ class AudioServer:
             )
         wav = None
         fmt = "json"
+        language = ""
         async for part in await request.multipart():
             if part.name == "file":
                 wav = await part.read(decode=False)
             elif part.name == "response_format":
                 fmt = (await part.text()).strip() or "json"
+            elif part.name == "language":
+                language = (await part.text()).strip()
         if not wav:
             return web.json_response(
                 {"error": "missing 'file' part"}, status=400
@@ -248,8 +281,14 @@ class AudioServer:
             else "transcribe"
         )
         try:
-            result = await self.engine.transcribe(wav, task=task)
-        except (ValueError, _wave.Error, EOFError) as e:
+            result = await self.engine.transcribe(
+                wav, task=task, language=language
+            )
+        except ValueError as e:
+            # covers undecodable audio AND unhonorable language hints —
+            # the exception message says which
+            return web.json_response({"error": str(e)}, status=400)
+        except (_wave.Error, EOFError) as e:
             return web.json_response(
                 {"error": f"invalid audio: {e}"}, status=400
             )

@@ -137,6 +137,15 @@ def test_translations_route(model):
         assert data["object"] == "audio.translation"
         assert isinstance(data["text"], str)
 
+        # an unhonorable language hint is a loud 400, never a silent
+        # drop (hermetic byte tokenizer has no language tokens)
+        form = aiohttp.FormData()
+        form.add_field("file", _wav_bytes(), filename="a.wav")
+        form.add_field("language", "fr")
+        r = await client.post("/v1/audio/transcriptions", data=form)
+        assert r.status == 400
+        assert "language" in (await r.json())["error"]
+
     _run(model, go)
 
 

@@ -202,6 +202,54 @@ def test_completions_legacy_logprobs(tiny_server):
     assert lp["text_offset"][0] == 0
 
 
+def test_embeddings_dimensions_and_base64(tiny_server):
+    """OpenAI 'dimensions' (matryoshka truncate + renormalize) and
+    'encoding_format: base64'."""
+    import base64
+    import math
+    import struct
+
+    status, full = asyncio.run(_post(
+        tiny_server, "/v1/embeddings",
+        {"model": "tiny", "input": "hello"},
+    ))
+    assert status == 200, full
+    full_vec = full["data"][0]["embedding"]
+
+    status, cut = asyncio.run(_post(
+        tiny_server, "/v1/embeddings",
+        {"model": "tiny", "input": "hello", "dimensions": 8},
+    ))
+    vec = cut["data"][0]["embedding"]
+    assert len(vec) == 8
+    assert abs(math.sqrt(sum(x * x for x in vec)) - 1.0) < 1e-5
+    # truncation of the SAME embedding (direction preserved)
+    norm = math.sqrt(sum(x * x for x in full_vec[:8]))
+    for a, b in zip(vec, full_vec[:8]):
+        assert abs(a - b / norm) < 1e-5
+
+    status, b64 = asyncio.run(_post(
+        tiny_server, "/v1/embeddings",
+        {"model": "tiny", "input": "hello",
+         "encoding_format": "base64"},
+    ))
+    raw = base64.b64decode(b64["data"][0]["embedding"])
+    decoded = struct.unpack(f"<{len(raw) // 4}f", raw)
+    for a, b in zip(decoded, full_vec):
+        assert abs(a - b) < 1e-6
+
+    status, _ = asyncio.run(_post(
+        tiny_server, "/v1/embeddings",
+        {"model": "tiny", "input": "x", "dimensions": 10_000},
+    ))
+    assert status == 400
+    status, _ = asyncio.run(_post(
+        tiny_server, "/v1/embeddings",
+        {"model": "tiny", "input": "x", "encoding_format": "int8"},
+    ))
+    assert status == 400
+
+
 def test_n_choices(tiny_server):
     status, data = asyncio.run(_post(
         tiny_server, "/v1/chat/completions",
