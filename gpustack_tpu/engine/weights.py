@@ -84,94 +84,189 @@ def load_gguf_checkpoint(cfg: ModelConfig, gguf_path: str) -> Dict[str, Any]:
 def build_lm_params(
     cfg: ModelConfig, tensors: Dict[str, Any]
 ) -> Dict[str, Any]:
-    """HF-named tensors → the stacked functional param tree."""
+    """HF-named tensors → the stacked functional param tree.
+
+    DeepSeek checkpoints split into a dense prefix stack
+    (``first_k_dense`` layers) + a MoE remainder — forward scans them
+    back-to-back (models/transformer.py)."""
     L = cfg.num_layers
     take = _taker(tensors)
+    kd = cfg.first_k_dense if cfg.is_moe else 0
 
-    def stack(fmt: str, transpose: bool = False) -> jax.Array:
-        return jnp.stack([take(fmt.format(i), transpose) for i in range(L)])
+    def build_range(rng, moe: bool) -> Dict[str, Any]:
+        def stack(fmt: str, transpose: bool = False) -> jax.Array:
+            return jnp.stack(
+                [take(fmt.format(i), transpose) for i in rng]
+            )
 
-    layers: Dict[str, Any] = {
-        "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
-        "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
-        "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
-        "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
-        "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
-    }
-    if cfg.post_norms:
-        # gemma sandwich norms: HF post_attention_layernorm is the
-        # POST-attention norm here, and the pre-MLP norm has its own name
-        layers["post_attn_norm"] = stack(
-            "model.layers.{}.post_attention_layernorm.weight"
-        )
-        layers["mlp_norm"] = stack(
-            "model.layers.{}.pre_feedforward_layernorm.weight"
-        )
-        layers["post_mlp_norm"] = stack(
-            "model.layers.{}.post_feedforward_layernorm.weight"
-        )
-    else:
-        # llama-family: HF post_attention_layernorm IS the pre-MLP norm
-        layers["mlp_norm"] = stack(
-            "model.layers.{}.post_attention_layernorm.weight"
-        )
-    if cfg.qkv_bias:
-        layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
-        layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
-        layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
-    if cfg.qk_norm:
-        layers["q_norm"] = stack("model.layers.{}.self_attn.q_norm.weight")
-        layers["k_norm"] = stack("model.layers.{}.self_attn.k_norm.weight")
-    if cfg.is_moe:
-        # Two HF MoE naming schemes: Mixtral
-        # (block_sparse_moe.gate / experts.{e}.w1|w2|w3) and Qwen-MoE
-        # (mlp.gate / experts.{e}.gate_proj|down_proj|up_proj)
-        if "model.layers.0.block_sparse_moe.gate.weight" in tensors:
-            block, wg, wd, wu = "block_sparse_moe", "w1", "w2", "w3"
-        else:
-            block, wg, wd, wu = "mlp", "gate_proj", "down_proj", "up_proj"
-            if any("shared_expert" in name for name in tensors):
-                # Qwen2-MoE-style shared experts contribute to every
-                # token's MLP output; silently dropping them would serve
-                # wrong logits — fail loudly until the block supports them
-                raise ValueError(
-                    "checkpoint has shared-expert weights "
-                    "(Qwen2-MoE style), which this engine does not "
-                    "implement yet"
+        layers: Dict[str, Any] = {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight"),
+        }
+        if cfg.is_mla:
+            # DeepSeek MLA projections (decompressed serving)
+            if cfg.q_lora_rank:
+                layers["wq_a"] = stack(
+                    "model.layers.{}.self_attn.q_a_proj.weight", True
                 )
-        layers["router"] = stack(
-            "model.layers.{}." + block + ".gate.weight", True
-        )
-        E = cfg.num_experts
-
-        def stack_experts(w: str, transpose: bool) -> jax.Array:
-            return jnp.stack([
-                jnp.stack([
+                layers["q_a_norm"] = stack(
+                    "model.layers.{}.self_attn.q_a_layernorm.weight"
+                )
+                layers["wq_b"] = stack(
+                    "model.layers.{}.self_attn.q_b_proj.weight", True
+                )
+            else:
+                layers["wq"] = stack(
+                    "model.layers.{}.self_attn.q_proj.weight", True
+                )
+            layers["wkv_a"] = stack(
+                "model.layers.{}.self_attn.kv_a_proj_with_mqa.weight",
+                True,
+            )
+            layers["kv_a_norm"] = stack(
+                "model.layers.{}.self_attn.kv_a_layernorm.weight"
+            )
+            layers["wkv_b"] = stack(
+                "model.layers.{}.self_attn.kv_b_proj.weight", True
+            )
+            layers["wo"] = stack(
+                "model.layers.{}.self_attn.o_proj.weight", True
+            )
+        else:
+            layers["wq"] = stack(
+                "model.layers.{}.self_attn.q_proj.weight", True
+            )
+            layers["wk"] = stack(
+                "model.layers.{}.self_attn.k_proj.weight", True
+            )
+            layers["wv"] = stack(
+                "model.layers.{}.self_attn.v_proj.weight", True
+            )
+            layers["wo"] = stack(
+                "model.layers.{}.self_attn.o_proj.weight", True
+            )
+        if cfg.post_norms:
+            # gemma sandwich norms: HF post_attention_layernorm is the
+            # POST-attention norm; the pre-MLP norm has its own name
+            layers["post_attn_norm"] = stack(
+                "model.layers.{}.post_attention_layernorm.weight"
+            )
+            layers["mlp_norm"] = stack(
+                "model.layers.{}.pre_feedforward_layernorm.weight"
+            )
+            layers["post_mlp_norm"] = stack(
+                "model.layers.{}.post_feedforward_layernorm.weight"
+            )
+        else:
+            # llama-family: post_attention_layernorm IS the pre-MLP norm
+            layers["mlp_norm"] = stack(
+                "model.layers.{}.post_attention_layernorm.weight"
+            )
+        if cfg.qkv_bias:
+            layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
+            layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
+            layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+        if cfg.qk_norm:
+            layers["q_norm"] = stack(
+                "model.layers.{}.self_attn.q_norm.weight"
+            )
+            layers["k_norm"] = stack(
+                "model.layers.{}.self_attn.k_norm.weight"
+            )
+        if moe:
+            # Three HF MoE naming schemes: Mixtral (block_sparse_moe /
+            # w1|w2|w3), Qwen-MoE and DeepSeek (mlp.gate /
+            # experts.{e}.gate_proj|down_proj|up_proj)
+            if "model.layers.0.block_sparse_moe.gate.weight" in tensors:
+                block, wg, wd, wu = (
+                    "block_sparse_moe", "w1", "w2", "w3"
+                )
+            else:
+                block, wg, wd, wu = (
+                    "mlp", "gate_proj", "down_proj", "up_proj"
+                )
+                if not cfg.shared_expert_intermediate_size and any(
+                    "shared_expert" in name for name in tensors
+                ):
+                    # Qwen2-MoE-style shared experts contribute to every
+                    # token's MLP output; silently dropping them would
+                    # serve wrong logits — fail loudly (DeepSeek shared
+                    # experts ARE supported via the config fields)
+                    raise ValueError(
+                        "checkpoint has shared-expert weights "
+                        "(Qwen2-MoE style), which this engine does not "
+                        "implement yet"
+                    )
+            layers["router"] = stack(
+                "model.layers.{}." + block + ".gate.weight", True
+            )
+            if cfg.moe_scoring == "sigmoid":
+                # fp32 on purpose: the correction bias tie-breaks expert
+                # SELECTION (checkpoints store it fp32); bf16 rounding
+                # could flip top-k picks on finely-balanced experts
+                layers["router_bias"] = jnp.stack([
                     _to_jnp(
                         tensors.pop(
-                            f"model.layers.{i}.{block}.experts.{e}.{w}.weight"
-                        ).T if transpose else tensors.pop(
-                            f"model.layers.{i}.{block}.experts.{e}.{w}.weight"
-                        )
+                            f"model.layers.{i}.{block}"
+                            ".gate.e_score_correction_bias"
+                        ),
+                        jnp.float32,
                     )
-                    for e in range(E)
+                    for i in rng
                 ])
-                for i in range(L)
-            ])
+            E = cfg.num_experts
 
-        layers["we_gate"] = stack_experts(wg, True)
-        layers["we_down"] = stack_experts(wd, True)
-        layers["we_up"] = stack_experts(wu, True)
-    else:
-        layers["w_gate"] = stack("model.layers.{}.mlp.gate_proj.weight", True)
-        layers["w_up"] = stack("model.layers.{}.mlp.up_proj.weight", True)
-        layers["w_down"] = stack("model.layers.{}.mlp.down_proj.weight", True)
+            def stack_experts(w: str, transpose: bool) -> jax.Array:
+                return jnp.stack([
+                    jnp.stack([
+                        _to_jnp(
+                            tensors.pop(
+                                f"model.layers.{i}.{block}"
+                                f".experts.{e}.{w}.weight"
+                            ).T if transpose else tensors.pop(
+                                f"model.layers.{i}.{block}"
+                                f".experts.{e}.{w}.weight"
+                            )
+                        )
+                        for e in range(E)
+                    ])
+                    for i in rng
+                ])
+
+            layers["we_gate"] = stack_experts(wg, True)
+            layers["we_down"] = stack_experts(wd, True)
+            layers["we_up"] = stack_experts(wu, True)
+            if cfg.shared_expert_intermediate_size:
+                layers["ws_gate"] = stack(
+                    "model.layers.{}.mlp.shared_experts"
+                    ".gate_proj.weight", True,
+                )
+                layers["ws_up"] = stack(
+                    "model.layers.{}.mlp.shared_experts"
+                    ".up_proj.weight", True,
+                )
+                layers["ws_down"] = stack(
+                    "model.layers.{}.mlp.shared_experts"
+                    ".down_proj.weight", True,
+                )
+        else:
+            layers["w_gate"] = stack(
+                "model.layers.{}.mlp.gate_proj.weight", True
+            )
+            layers["w_up"] = stack(
+                "model.layers.{}.mlp.up_proj.weight", True
+            )
+            layers["w_down"] = stack(
+                "model.layers.{}.mlp.down_proj.weight", True
+            )
+        return layers
 
     params: Dict[str, Any] = {
         "embed": take("model.embed_tokens.weight"),
-        "layers": layers,
+        "layers": build_range(range(kd, L), cfg.is_moe),
         "final_norm": take("model.norm.weight"),
     }
+    if kd:
+        params["dense_layers"] = build_range(range(kd), False)
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in tensors:
             params["lm_head"] = take("lm_head.weight", True)
@@ -338,11 +433,6 @@ def merge_lora_adapters(cfg, params: Dict[str, Any], adapter_dirs):
             layer_idx = int(m.group(1))
             module = m.group(2)
             ours = _LORA_MODULES.get(module)
-            if ours is None or ours not in params["layers"]:
-                logger.warning(
-                    "skipping LoRA target %s (unsupported module)", name
-                )
-                continue
             if layer_idx >= cfg.num_layers:
                 # JAX scatter would silently drop the OOB update — a
                 # half-applied adapter must be an error, not a mystery
@@ -350,6 +440,23 @@ def merge_lora_adapters(cfg, params: Dict[str, Any], adapter_dirs):
                     f"adapter {adapter_dir} targets layer {layer_idx} "
                     f"but the model has {cfg.num_layers} layers"
                 )
+            # heterogeneous stacks (DeepSeek first_k_dense): absolute HF
+            # layer i lives in the dense prefix when i < kd, else at
+            # offset i - kd in the MoE stack — indexing the MoE stack
+            # with the absolute i would merge into the WRONG layer
+            kd = (
+                len(next(iter(params["dense_layers"].values())))
+                if "dense_layers" in params else 0
+            )
+            if layer_idx < kd:
+                stack_key, stack_idx = "dense_layers", layer_idx
+            else:
+                stack_key, stack_idx = "layers", layer_idx - kd
+            if ours is None or ours not in params[stack_key]:
+                logger.warning(
+                    "skipping LoRA target %s (unsupported module)", name
+                )
+                continue
             b_name = name.replace("lora_A", "lora_B")
             if b_name not in tensors:
                 raise ValueError(
@@ -361,8 +468,8 @@ def merge_lora_adapters(cfg, params: Dict[str, Any], adapter_dirs):
             a = _to_jnp(tensors[name], jnp.float32)
             b = _to_jnp(tensors[b_name], jnp.float32)
             delta = (a.T @ b.T) * scale                 # [in, out]
-            base = params["layers"][ours]
-            params["layers"][ours] = base.at[layer_idx].add(
+            base = params[stack_key][ours]
+            params[stack_key][ours] = base.at[stack_idx].add(
                 delta.astype(base.dtype)
             )
             merged += 1

@@ -61,6 +61,28 @@ class ModelConfig:
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
+    # ---- DeepSeek-family knobs ----
+    # MLA (multi-head latent attention, DeepSeek-V2/V3): kv_lora_rank>0
+    # switches the attention block to compressed-latent projections. The
+    # engine serves the DECOMPRESSED form: per-head K/V are materialized
+    # (head_dim = qk_nope + qk_rope, num_kv_heads = num_heads) so the
+    # existing cache/flash/ring machinery applies unchanged; v (width
+    # v_head_dim) is zero-padded to head_dim in the cache and sliced
+    # before o_proj. Trades cache bytes for zero structural divergence.
+    q_lora_rank: int = 0            # 0 = direct q projection
+    kv_lora_rank: int = 0           # >0 = MLA
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # DeepSeek MoE: shared experts run on every token alongside routed
+    # ones; routed outputs scale by routed_scaling_factor. The first
+    # first_k_dense layers use a dense MLP (v2/v3 checkpoints ship 1).
+    n_shared_experts: int = 0
+    shared_expert_intermediate_size: int = 0
+    routed_scaling_factor: float = 1.0
+    first_k_dense: int = 0
+    # "softmax" (v2) | "sigmoid" (v3: score + e_score_correction_bias)
+    moe_scoring: str = "softmax"
     dtype: str = "bfloat16"
 
     # ---- derived ----
@@ -79,6 +101,10 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
 
     @property
     def attention_type(self) -> str:
@@ -106,20 +132,50 @@ class ModelConfig:
         d, v = self.hidden_size, self.vocab_size
         embed = v * d
         lm_head = 0 if self.tie_word_embeddings else d * v
-        attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
-        if self.qkv_bias:
-            attn += self.q_dim + 2 * self.kv_dim
-        if self.qk_norm:
-            attn += 2 * self.head_dim
+        if self.is_mla:
+            qk_dim = self.qk_nope_head_dim + self.qk_rope_head_dim
+            if self.q_lora_rank:
+                attn = (
+                    d * self.q_lora_rank
+                    + self.q_lora_rank * self.num_heads * qk_dim
+                    + self.q_lora_rank
+                )
+            else:
+                attn = d * self.num_heads * qk_dim
+            attn += (
+                d * (self.kv_lora_rank + self.qk_rope_head_dim)
+                + self.kv_lora_rank * self.num_heads
+                * (self.qk_nope_head_dim + self.v_head_dim)
+                + self.kv_lora_rank
+                + self.num_heads * self.v_head_dim * d
+            )
+        else:
+            attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
+            if self.qkv_bias:
+                attn += self.q_dim + 2 * self.kv_dim
+            if self.qk_norm:
+                attn += 2 * self.head_dim
         if self.is_moe:
             mlp = d * self.num_experts + self.num_experts * (
                 3 * d * self.moe_intermediate_size
             )
+            if self.shared_expert_intermediate_size:
+                mlp += 3 * d * self.shared_expert_intermediate_size
+            if self.moe_scoring == "sigmoid":
+                mlp += self.num_experts     # e_score_correction_bias
         else:
             mlp = 3 * d * self.intermediate_size
         norms = (4 if self.post_norms else 2) * d
         per_layer = attn + mlp + norms
-        return embed + lm_head + self.num_layers * per_layer + d
+        dense_delta = 0
+        if self.is_moe and self.first_k_dense:
+            dense_delta = self.first_k_dense * (
+                3 * d * self.intermediate_size - mlp
+            )
+        return (
+            embed + lm_head + self.num_layers * per_layer
+            + dense_delta + d
+        )
 
     def weight_bytes(self, bits: int = 16) -> int:
         return self.param_count() * bits // 8
@@ -144,8 +200,24 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
     num_experts = (
         cfg.get("num_local_experts")      # Mixtral
         or cfg.get("num_experts")         # Qwen2-MoE
+        or cfg.get("n_routed_experts")    # DeepSeek-V2/V3
         or 0
     )
+    deepseek = "Deepseek" in arch
+    mla = deepseek and int(cfg.get("kv_lora_rank") or 0) > 0
+    if mla:
+        qk_nope = int(cfg.get("qk_nope_head_dim") or 0)
+        qk_rope = int(cfg.get("qk_rope_head_dim") or 0)
+        # decompressed MLA: the cache is per-head over the full qk dim
+        head_dim = qk_nope + qk_rope
+    if deepseek and int(cfg.get("n_group") or 1) > 1:
+        # group-limited expert routing selects a DIFFERENT expert set
+        # than plain top-k — serving it ungrouped would be silently
+        # wrong logits, for any topk_method
+        raise ValueError(
+            "DeepSeek group-limited routing (n_group>1) is not "
+            "supported yet; serve a checkpoint with n_group=1"
+        )
     # Gemma2/Gemma3 text: (1+w) norms, scaled embeddings, sandwich
     # norms, gelu-tanh MLP, softcapping (gemma2), alternating
     # sliding/full layers, dual rope thetas (gemma3).  Gemma1
@@ -181,7 +253,11 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         intermediate_size=cfg.get("intermediate_size", 4 * hidden),
         num_layers=cfg["num_hidden_layers"],
         num_heads=heads,
-        num_kv_heads=cfg.get("num_key_value_heads", heads),
+        # decompressed MLA materializes per-head K/V: MHA cache shape
+        num_kv_heads=(
+            heads if mla
+            else cfg.get("num_key_value_heads", heads)
+        ),
         head_dim=head_dim,
         rope_theta=cfg.get("rope_theta", 10000.0),
         rope_scaling=cfg.get("rope_scaling"),
@@ -219,6 +295,36 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
             or (cfg.get("intermediate_size", 0) if num_experts else 0)
         ),
         norm_topk_prob=cfg.get("norm_topk_prob", True),
+        q_lora_rank=int(cfg.get("q_lora_rank") or 0) if deepseek else 0,
+        kv_lora_rank=int(cfg.get("kv_lora_rank") or 0) if deepseek else 0,
+        qk_nope_head_dim=(
+            int(cfg.get("qk_nope_head_dim") or 0) if deepseek else 0
+        ),
+        qk_rope_head_dim=(
+            int(cfg.get("qk_rope_head_dim") or 0) if deepseek else 0
+        ),
+        v_head_dim=int(cfg.get("v_head_dim") or 0) if deepseek else 0,
+        n_shared_experts=(
+            int(cfg.get("n_shared_experts") or 0) if deepseek else 0
+        ),
+        shared_expert_intermediate_size=(
+            int(cfg.get("n_shared_experts") or 0)
+            * int(cfg.get("moe_intermediate_size") or 0)
+            if deepseek else 0
+        ),
+        routed_scaling_factor=(
+            float(cfg.get("routed_scaling_factor") or 1.0)
+            if deepseek else 1.0
+        ),
+        first_k_dense=(
+            int(cfg.get("first_k_dense_replace") or 0)
+            if deepseek and num_experts else 0
+        ),
+        moe_scoring=(
+            "sigmoid"
+            if deepseek and cfg.get("scoring_func") == "sigmoid"
+            else "softmax"
+        ),
     ).validate()
 
 
@@ -342,6 +448,40 @@ PRESETS: Dict[str, ModelConfig] = {
         num_experts_per_tok=2,
         moe_intermediate_size=14336,
         max_position_embeddings=32768,
+    ),
+    # DeepSeek-V2-Lite (deepseek-ai/DeepSeek-V2-Lite): MLA + DeepSeek
+    # MoE, served decompressed (see the MLA notes on ModelConfig)
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        hidden_size=2048,
+        intermediate_size=10944,
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=192,                 # qk_nope + qk_rope
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,            # hub config.json value
+        # the shipped YaRN scaling (hub config.json rope_scaling)
+        rope_scaling={
+            "type": "yarn", "factor": 40,
+            "beta_fast": 32, "beta_slow": 1,
+            "mscale": 0.707, "mscale_all_dim": 0.707,
+            "original_max_position_embeddings": 4096,
+        },
+        max_position_embeddings=163840,
+        num_experts=64,
+        num_experts_per_tok=6,
+        moe_intermediate_size=1408,
+        norm_topk_prob=False,
+        kv_lora_rank=512,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        n_shared_experts=2,
+        shared_expert_intermediate_size=2816,
+        routed_scaling_factor=1.0,
+        first_k_dense=1,
     ),
     # Hermetic-test configs (run everywhere, compile in seconds).
     "tiny": ModelConfig(

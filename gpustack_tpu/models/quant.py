@@ -52,11 +52,18 @@ _CONTRACT_AXES: Dict[str, tuple] = {
     "w_down": (0,),                        # [f, d] contracts f
     "we_gate": (1,), "we_up": (1,),        # [E, d, f] contract d
     "we_down": (1,),                       # [E, f, d] contract f
+    # DeepSeek MLA projections + shared experts (the tiny rank-sized
+    # norms and router bias stay unquantized like other small leaves)
+    "wq_a": (0,), "wq_b": (0,),
+    "wkv_a": (0,), "wkv_b": (0,),
+    "ws_gate": (0,), "ws_up": (0,), "ws_down": (0,),
 }
 # Layer-stacked leaves carry a leading [L] axis not present at use time.
 _STACKED = {
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
     "we_gate", "we_up", "we_down",
+    "wq_a", "wq_b", "wkv_a", "wkv_b",
+    "ws_gate", "ws_up", "ws_down",
 }
 
 
@@ -81,7 +88,7 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     tie = "lm_head" not in params
     for k, v in params.items():
-        if k == "layers":
+        if k in ("layers", "dense_layers"):
             out[k] = {
                 lk: _quantize_leaf(lk, lv) if lk in _CONTRACT_AXES else lv
                 for lk, lv in v.items()

@@ -113,14 +113,38 @@ def init_params(
         scale = scale if scale is not None else 1.0 / math.sqrt(shape[-2])
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
-    layers: Dict[str, jax.Array] = {
-        "attn_norm": jnp.ones((L, d), dtype),
-        "wq": w(next(keys), L, d, cfg.q_dim),
-        "wk": w(next(keys), L, d, cfg.kv_dim),
-        "wv": w(next(keys), L, d, cfg.kv_dim),
-        "wo": w(next(keys), L, cfg.q_dim, d),
-        "mlp_norm": jnp.ones((L, d), dtype),
-    }
+    if cfg.is_mla:
+        qk = cfg.head_dim
+        layers: Dict[str, jax.Array] = {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wkv_a": w(
+                next(keys), L, d, cfg.kv_lora_rank + cfg.qk_rope_head_dim
+            ),
+            "kv_a_norm": jnp.ones((L, cfg.kv_lora_rank), dtype),
+            "wkv_b": w(
+                next(keys), L, cfg.kv_lora_rank,
+                cfg.num_heads * (cfg.qk_nope_head_dim + cfg.v_head_dim),
+            ),
+            "wo": w(next(keys), L, cfg.num_heads * cfg.v_head_dim, d),
+            "mlp_norm": jnp.ones((L, d), dtype),
+        }
+        if cfg.q_lora_rank:
+            layers["wq_a"] = w(next(keys), L, d, cfg.q_lora_rank)
+            layers["q_a_norm"] = jnp.ones((L, cfg.q_lora_rank), dtype)
+            layers["wq_b"] = w(
+                next(keys), L, cfg.q_lora_rank, cfg.num_heads * qk
+            )
+        else:
+            layers["wq"] = w(next(keys), L, d, cfg.num_heads * qk)
+    else:
+        layers = {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": w(next(keys), L, d, cfg.q_dim),
+            "wk": w(next(keys), L, d, cfg.kv_dim),
+            "wv": w(next(keys), L, d, cfg.kv_dim),
+            "wo": w(next(keys), L, cfg.q_dim, d),
+            "mlp_norm": jnp.ones((L, d), dtype),
+        }
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
@@ -143,6 +167,13 @@ def init_params(
         layers["we_gate"] = w(next(keys), L, E, d, fm)
         layers["we_up"] = w(next(keys), L, E, d, fm)
         layers["we_down"] = w(next(keys), L, E, fm, d, scale=1.0 / math.sqrt(fm))
+        if cfg.shared_expert_intermediate_size:
+            fs = cfg.shared_expert_intermediate_size
+            layers["ws_gate"] = w(next(keys), L, d, fs)
+            layers["ws_up"] = w(next(keys), L, d, fs)
+            layers["ws_down"] = w(next(keys), L, fs, d)
+        if cfg.moe_scoring == "sigmoid":
+            layers["router_bias"] = jnp.zeros((L, E), jnp.float32)
     else:
         layers["w_gate"] = w(next(keys), L, d, f)
         layers["w_up"] = w(next(keys), L, d, f)
@@ -155,6 +186,22 @@ def init_params(
             jnp.zeros if cfg.norm_delta_gain else jnp.ones
         )((d,), dtype),
     }
+    if cfg.is_moe and cfg.first_k_dense:
+        # split the stacked tree: a dense prefix stack (own MLP shapes)
+        # + the MoE remainder (forward scans them back-to-back)
+        kd = cfg.first_k_dense
+        moe_keys = (
+            "router", "we_gate", "we_up", "we_down",
+            "ws_gate", "ws_up", "ws_down", "router_bias",
+        )
+        dense: Dict[str, jax.Array] = {
+            k: v[:kd] for k, v in layers.items() if k not in moe_keys
+        }
+        dense["w_gate"] = w(next(keys), kd, d, f)
+        dense["w_up"] = w(next(keys), kd, d, f)
+        dense["w_down"] = w(next(keys), kd, f, d)
+        params["dense_layers"] = dense
+        params["layers"] = {k: v[kd:] for k, v in layers.items()}
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), d, cfg.vocab_size)
     return params
@@ -211,6 +258,63 @@ def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
     return inv
 
 
+def yarn_inv_freq(
+    theta: float, dim: int, rs: Dict[str, Any]
+) -> Tuple[jax.Array, float]:
+    """YaRN NTK scaling (HF _compute_yarn_parameters semantics):
+    interpolated and extrapolated frequency tables blended over a linear
+    ramp between the beta correction dims; returns (inv_freq,
+    attention_factor) — the factor scales sin/cos, which squares into
+    the attention scores exactly like HF's freqs_cis scaling."""
+    factor = float(rs["factor"])
+    beta_fast = float(rs.get("beta_fast") or 32)
+    beta_slow = float(rs.get("beta_slow") or 1)
+    orig = int(
+        rs.get("original_max_position_embeddings") or 4096
+    )
+    mscale = rs.get("mscale")
+    mscale_all = rs.get("mscale_all_dim")
+    attention_factor = rs.get("attention_factor")
+
+    def get_mscale(scale, m=1.0):
+        if scale <= 1:
+            return 1.0
+        return 0.1 * m * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all:
+            attention_factor = get_mscale(factor, mscale) / get_mscale(
+                factor, mscale_all
+            )
+        else:
+            attention_factor = get_mscale(factor)
+
+    def correction_dim(n_rot):
+        return (
+            dim * math.log(orig / (n_rot * 2 * math.pi))
+        ) / (2 * math.log(theta))
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = jnp.clip(
+        (jnp.arange(dim // 2, dtype=jnp.float32) - low) / (high - low),
+        0.0, 1.0,
+    )
+    extrapolation_factor = 1.0 - ramp
+    pos_freqs = theta ** (
+        jnp.arange(0, dim, 2, dtype=jnp.float32) / dim
+    )
+    inv_extra = 1.0 / pos_freqs
+    inv_interp = 1.0 / (factor * pos_freqs)
+    inv = (
+        inv_interp * (1 - extrapolation_factor)
+        + inv_extra * extrapolation_factor
+    )
+    return inv, float(attention_factor)
+
+
 def rope_sin_cos(
     positions: jax.Array, inv_freq: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
@@ -226,6 +330,21 @@ def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
     sin = sin[:, :, None, :].astype(x.dtype)
     cos = cos[:, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def apply_rope_interleaved(
+    x: jax.Array, sin: jax.Array, cos: jax.Array
+) -> jax.Array:
+    """Interleaved-pair (complex) convention — DeepSeek's decoupled rope
+    parts rotate (x[2i], x[2i+1]) pairs (transformers
+    modeling_deepseek_v2.apply_rotary_emb via view_as_complex), NOT
+    rotate_half. x: [B, T, H, d], sin/cos: [B, T, d/2]."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    sin = sin[:, :, None, :].astype(x.dtype)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    o1 = x1 * cos - x2 * sin
+    o2 = x1 * sin + x2 * cos
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
 
 
 def _attend(
@@ -255,6 +374,8 @@ def _moe_mlp(
     we_up: jax.Array,       # [E, D, Fm]
     we_down: jax.Array,     # [E, Fm, D]
     cfg: ModelConfig,
+    router_bias=None,       # [E] sigmoid-selection bias (DeepSeek-V3)
+    shared=None,            # (ws_gate, ws_up, ws_down) shared experts
 ) -> jax.Array:
     """Mixtral-style top-k MoE, dense-dispatch formulation.
 
@@ -268,15 +389,21 @@ def _moe_mlp(
     """
     # Router math in fp32: top-k selection must not flip on bf16 rounding
     # (which differs between sharded and unsharded contraction orders).
-    gates = jax.nn.softmax(
-        jnp.einsum(
-            "btd,de->bte",
-            x.astype(jnp.float32),
-            router_w.astype(jnp.float32),
-        ),
-        axis=-1,
+    logits = jnp.einsum(
+        "btd,de->bte",
+        x.astype(jnp.float32),
+        router_w.astype(jnp.float32),
     )
-    top_w, top_idx = lax.top_k(gates, cfg.num_experts_per_tok)
+    if cfg.moe_scoring == "sigmoid":
+        # DeepSeek-V3: sigmoid scores; SELECTION adds the learned
+        # correction bias, the combine WEIGHTS use the raw scores
+        scores = jax.nn.sigmoid(logits)
+        sel = scores + (router_bias if router_bias is not None else 0.0)
+        _, top_idx = lax.top_k(sel, cfg.num_experts_per_tok)
+        top_w = jnp.take_along_axis(scores, top_idx, axis=-1)
+    else:
+        gates = jax.nn.softmax(logits, axis=-1)
+        top_w, top_idx = lax.top_k(gates, cfg.num_experts_per_tok)
     if cfg.norm_topk_prob:
         top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
     # Scatter top-k weights back to a dense [B, T, E] combine tensor.
@@ -289,7 +416,19 @@ def _moe_mlp(
     u = _mm("btd,edf->btef", x, we_up)
     h = jax.nn.silu(g) * u
     y = _mm("btef,efd->bted", h, we_down)
-    return jnp.einsum("bted,bte->btd", y, combine)
+    out = jnp.einsum("bted,bte->btd", y, combine)
+    if cfg.routed_scaling_factor != 1.0:
+        out = out * jnp.asarray(
+            cfg.routed_scaling_factor, out.dtype
+        )
+    if shared is not None:
+        # DeepSeek shared experts: a dense MLP every token passes
+        # through, added to the routed output
+        ws_gate, ws_up, ws_down = shared
+        sg = _mm("btd,df->btf", x, ws_gate)
+        su = _mm("btd,df->btf", x, ws_up)
+        out = out + _mm("btf,fd->btd", jax.nn.silu(sg) * su, ws_down)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -350,6 +489,22 @@ def forward(
         # to the compute dtype before multiplying
         x = x * jnp.asarray(math.sqrt(cfg.hidden_size)).astype(dtype)
     sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
+    if cfg.is_mla:
+        # decoupled rope: only the qk_rope part rotates, with its own
+        # frequency table (interleaved-pair convention); DeepSeek ships
+        # YaRN scaling whose attention factor rides the sin/cos tables
+        rs = cfg.rope_scaling or {}
+        if (rs.get("rope_type") or rs.get("type")) == "yarn":
+            mla_inv, att_factor = yarn_inv_freq(
+                cfg.rope_theta, cfg.qk_rope_head_dim, rs
+            )
+        else:
+            mla_inv = _inv_freq(cfg.rope_theta, cfg.qk_rope_head_dim)
+            att_factor = 1.0
+        mla_sin, mla_cos = rope_sin_cos(positions, mla_inv)
+        if att_factor != 1.0:
+            mla_sin = mla_sin * att_factor
+            mla_cos = mla_cos * att_factor
     if cfg.rope_local_theta:
         # gemma3: sliding layers rotate with a separate, unscaled theta
         sin_loc, cos_loc = rope_sin_cos(
@@ -407,7 +562,7 @@ def forward(
         else lambda z: jax.nn.gelu(z, approximate=True)
     )
 
-    def block(x_in: jax.Array, scanned):
+    def block(x_in: jax.Array, scanned, moe_layer: bool):
         lp, k_cache_l, v_cache_l, slide_flag = scanned
         if hetero:
             mask_l = jnp.where(slide_flag, mask_slide, mask_full)
@@ -418,26 +573,77 @@ def forward(
         h = rms_norm(
             x_in, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
         )
-        q = _mm("btd,dq->btq", h, lp["wq"])
-        k = _mm("btd,dk->btk", h, lp["wk"])
-        v = _mm("btd,dk->btk", h, lp["wv"])
-        if cfg.qkv_bias:
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        if cfg.qk_norm:
-            # Qwen3/Gemma3: per-head RMSNorm on q/k BEFORE RoPE
-            q = rms_norm(
-                q, lp["q_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+        if cfg.is_mla:
+            # DeepSeek MLA, served decompressed: latent down-projections
+            # + per-head up-projections materialize full K/V (head_dim =
+            # qk_nope + qk_rope); v (v_head_dim wide) zero-pads to
+            # head_dim so one cache layout serves every family.
+            nope, rope_d = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+            if cfg.q_lora_rank:
+                q_c = rms_norm(
+                    _mm("btd,dr->btr", h, lp["wq_a"]),
+                    lp["q_a_norm"], cfg.rms_norm_eps, False,
+                )
+                q = _mm("btr,rq->btq", q_c, lp["wq_b"])
+            else:
+                q = _mm("btd,dq->btq", h, lp["wq"])
+            q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+            q_nope, q_pe = q[..., :nope], q[..., nope:]
+            kv_a = _mm("btd,dr->btr", h, lp["wkv_a"])
+            c_kv = kv_a[..., : cfg.kv_lora_rank]
+            k_pe = kv_a[..., cfg.kv_lora_rank:]
+            c_kv = rms_norm(
+                c_kv, lp["kv_a_norm"], cfg.rms_norm_eps, False
             )
-            k = rms_norm(
-                k, lp["k_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
+            kv = _mm("btr,rq->btq", c_kv, lp["wkv_b"]).reshape(
+                B, T, cfg.num_heads, nope + cfg.v_head_dim
             )
-        q = apply_rope(q, sin_b, cos_b).reshape(
-            B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim
-        )
-        k = apply_rope(k, sin_b, cos_b)
+            k_nope, v_small = kv[..., :nope], kv[..., nope:]
+            q_pe = apply_rope_interleaved(q_pe, mla_sin, mla_cos)
+            k_pe = apply_rope_interleaved(
+                k_pe[:, :, None, :], mla_sin, mla_cos
+            )
+            k_pe = jnp.broadcast_to(
+                k_pe, (B, T, cfg.num_heads, rope_d)
+            )
+            k = jnp.concatenate([k_nope, k_pe], axis=-1)
+            v = jnp.concatenate(
+                [
+                    v_small,
+                    jnp.zeros(
+                        (B, T, cfg.num_heads,
+                         cfg.head_dim - cfg.v_head_dim),
+                        v_small.dtype,
+                    ),
+                ],
+                axis=-1,
+            )
+            q = jnp.concatenate([q_nope, q_pe], axis=-1).reshape(
+                B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim
+            )
+        else:
+            q = _mm("btd,dq->btq", h, lp["wq"])
+            k = _mm("btd,dk->btk", h, lp["wk"])
+            v = _mm("btd,dk->btk", h, lp["wv"])
+            if cfg.qkv_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+            k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:
+                # Qwen3/Gemma3: per-head RMSNorm on q/k BEFORE RoPE
+                q = rms_norm(
+                    q, lp["q_norm"], cfg.rms_norm_eps,
+                    cfg.norm_delta_gain,
+                )
+                k = rms_norm(
+                    k, lp["k_norm"], cfg.rms_norm_eps,
+                    cfg.norm_delta_gain,
+                )
+            q = apply_rope(q, sin_b, cos_b).reshape(
+                B, T, cfg.num_kv_heads, cfg.group_size, cfg.head_dim
+            )
+            k = apply_rope(k, sin_b, cos_b)
 
         if cache is None:
             attn = _attend(
@@ -496,6 +702,14 @@ def forward(
                     cfg.attn_logit_softcap,
                 )
 
+        if cfg.is_mla:
+            # drop the zero-padded v tail before o_proj (which expects
+            # num_heads * v_head_dim inputs)
+            attn = attn.reshape(
+                B, T, cfg.num_heads, cfg.head_dim
+            )[..., : cfg.v_head_dim].reshape(
+                B, T, cfg.num_heads * cfg.v_head_dim
+            )
         attn_out = _mm("btq,qd->btd", attn, lp["wo"])
         if cfg.post_norms:
             attn_out = rms_norm(
@@ -507,10 +721,15 @@ def forward(
         h2 = rms_norm(
             x_mid, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_delta_gain
         )
-        if cfg.is_moe:
+        if moe_layer:
             mlp = _moe_mlp(
                 h2, lp["router"], lp["we_gate"], lp["we_up"], lp["we_down"],
                 cfg,
+                router_bias=lp.get("router_bias"),
+                shared=(
+                    (lp["ws_gate"], lp["ws_up"], lp["ws_down"])
+                    if "ws_gate" in lp else None
+                ),
             )
         else:
             g = _mm("btd,df->btf", h2, lp["w_gate"])
@@ -523,17 +742,52 @@ def forward(
             )
         return x_mid + mlp, (new_k, new_v)
 
+    # DeepSeek ships heterogeneous stacks: the first first_k_dense
+    # layers use a dense MLP, the rest MoE — structurally different
+    # params can't share one lax.scan, so the stacks run back-to-back
+    # over split slices of the same cache.
+    kd = (
+        len(next(iter(params["dense_layers"].values())))
+        if "dense_layers" in params else 0
+    )
+
+    def run_stack(x, stack, k_c, v_c, flags, moe_layer):
+        from functools import partial as _partial
+
+        return lax.scan(
+            _partial(block, moe_layer=moe_layer),
+            x, (stack, k_c, v_c, flags),
+        )
+
     if cache is None:
         L = cfg.num_layers
-        dummy = jnp.zeros((L, B, 0, cfg.num_kv_heads, cfg.head_dim), dtype)
-        x, _ = lax.scan(
-            block, x, (params["layers"], dummy, dummy, slide_flags)
+        def dummy(n):
+            return jnp.zeros(
+                (n, B, 0, cfg.num_kv_heads, cfg.head_dim), dtype
+            )
+        if kd:
+            x, _ = run_stack(
+                x, params["dense_layers"], dummy(kd), dummy(kd),
+                slide_flags[:kd], False,
+            )
+        x, _ = run_stack(
+            x, params["layers"], dummy(L - kd), dummy(L - kd),
+            slide_flags[kd:], cfg.is_moe,
         )
         new_cache = None
     else:
-        x, (k_new, v_new) = lax.scan(
-            block, x, (params["layers"], cache.k, cache.v, slide_flags)
+        if kd:
+            x, (k_d, v_d) = run_stack(
+                x, params["dense_layers"], cache.k[:kd], cache.v[:kd],
+                slide_flags[:kd], False,
+            )
+        x, (k_new, v_new) = run_stack(
+            x, params["layers"], cache.k[kd:], cache.v[kd:],
+            slide_flags[kd:], cfg.is_moe,
         )
+        if kd:
+            k_new = jnp.concatenate([k_d, k_new], axis=0)
+            v_new = jnp.concatenate([v_d, v_new], axis=0)
         new_cache = KVCache(k=k_new, v=v_new)
 
     x = rms_norm(

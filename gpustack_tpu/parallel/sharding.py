@@ -50,6 +50,19 @@ def _layer_rules(train: bool) -> Dict[str, P]:
         "we_gate": P(None, AXIS_EP, fsdp, AXIS_TP),
         "we_up": P(None, AXIS_EP, fsdp, AXIS_TP),
         "we_down": P(None, AXIS_EP, AXIS_TP, fsdp),
+        # DeepSeek MLA: down-projections are small (rank-sized) —
+        # replicate; up-projections shard their head-concat dim over tp
+        "wq_a": P(None, fsdp, None),
+        "q_a_norm": P(None, None),
+        "wq_b": P(None, None, AXIS_TP),
+        "wkv_a": P(None, fsdp, None),
+        "kv_a_norm": P(None, None),
+        "wkv_b": P(None, None, AXIS_TP),
+        # DeepSeek shared experts: dense-MLP-shaped, same sharding
+        "ws_gate": P(None, fsdp, AXIS_TP),
+        "ws_up": P(None, fsdp, AXIS_TP),
+        "ws_down": P(None, AXIS_TP, fsdp),
+        "router_bias": P(None, None),
     }
 
 
@@ -62,6 +75,11 @@ def param_pspecs(params: Dict[str, Any], train: bool = False) -> Dict[str, Any]:
         "final_norm": P(None),
         "layers": {k: rules[k] for k in params["layers"]},
     }
+    if "dense_layers" in params:
+        # DeepSeek first_k_dense prefix stack (models/transformer.py)
+        specs["dense_layers"] = {
+            k: rules[k] for k in params["dense_layers"]
+        }
     if "lm_head" in params:
         specs["lm_head"] = P(fsdp, AXIS_TP)
     return specs

@@ -78,6 +78,18 @@ CATALOG: List[Dict[str, Any]] = [
         },
     },
     {
+        "name": "DeepSeek-V2-Lite",
+        "preset": "deepseek-v2-lite",
+        "huggingface_repo_id": "deepseek-ai/DeepSeek-V2-Lite",
+        "categories": ["llm", "chat", "moe"],
+        "sizes": {"parameters_b": 15.7},
+        "suggested": {
+            "quantization": "int8",
+            "max_seq_len": 32768,
+            "chips": {"v5e": 2, "v5p": 1},
+        },
+    },
+    {
         "name": "TTS-Base",
         "preset": "tts-base",
         "categories": ["audio", "text-to-speech"],
