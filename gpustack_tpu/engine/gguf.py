@@ -313,6 +313,14 @@ def config_from_gguf(path: str, name: str = ""):
 
     metadata, infos, _, _ = read_gguf(path)
     arch = metadata.get("general.architecture", "llama")
+    if arch.startswith("deepseek"):
+        # llama.cpp's deepseek2 export uses MLA-specific tensor names
+        # and its own cache layout; the mapping here doesn't cover it
+        raise ValueError(
+            f"GGUF arch {arch!r} is not supported; serve DeepSeek from "
+            "the safetensors checkpoint (MLA is natively supported "
+            "there)"
+        )
 
     def md(key: str, default=None):
         return metadata.get(f"{arch}.{key}", default)
