@@ -187,14 +187,11 @@ def build_lm_params(
                 if not cfg.shared_expert_intermediate_size and any(
                     "shared_expert" in name for name in tensors
                 ):
-                    # Qwen2-MoE-style shared experts contribute to every
-                    # token's MLP output; silently dropping them would
-                    # serve wrong logits — fail loudly (DeepSeek shared
-                    # experts ARE supported via the config fields)
+                    # shared-expert tensors with no config support would
+                    # be silently dropped -> wrong logits; fail loudly
                     raise ValueError(
-                        "checkpoint has shared-expert weights "
-                        "(Qwen2-MoE style), which this engine does not "
-                        "implement yet"
+                        "checkpoint has shared-expert weights but the "
+                        "config declares no shared expert width"
                     )
             layers["router"] = stack(
                 "model.layers.{}." + block + ".gate.weight", True
@@ -236,18 +233,29 @@ def build_lm_params(
             layers["we_down"] = stack_experts(wd, True)
             layers["we_up"] = stack_experts(wu, True)
             if cfg.shared_expert_intermediate_size:
+                # DeepSeek: mlp.shared_experts.* (plural, ungated);
+                # Qwen2-MoE: mlp.shared_expert.* + shared_expert_gate
+                se = (
+                    "shared_expert" if cfg.shared_expert_gated
+                    else "shared_experts"
+                )
                 layers["ws_gate"] = stack(
-                    "model.layers.{}.mlp.shared_experts"
-                    ".gate_proj.weight", True,
+                    "model.layers.{}.mlp." + se + ".gate_proj.weight",
+                    True,
                 )
                 layers["ws_up"] = stack(
-                    "model.layers.{}.mlp.shared_experts"
-                    ".up_proj.weight", True,
+                    "model.layers.{}.mlp." + se + ".up_proj.weight",
+                    True,
                 )
                 layers["ws_down"] = stack(
-                    "model.layers.{}.mlp.shared_experts"
-                    ".down_proj.weight", True,
+                    "model.layers.{}.mlp." + se + ".down_proj.weight",
+                    True,
                 )
+                if cfg.shared_expert_gated:
+                    layers["shared_gate"] = stack(
+                        "model.layers.{}.mlp.shared_expert_gate.weight",
+                        True,
+                    )
         else:
             layers["w_gate"] = stack(
                 "model.layers.{}.mlp.gate_proj.weight", True

@@ -79,6 +79,9 @@ class ModelConfig:
     # first_k_dense layers use a dense MLP (v2/v3 checkpoints ship 1).
     n_shared_experts: int = 0
     shared_expert_intermediate_size: int = 0
+    # Qwen2-MoE: the shared expert's output is gated by
+    # sigmoid(x @ gate); DeepSeek adds it ungated
+    shared_expert_gated: bool = False
     routed_scaling_factor: float = 1.0
     first_k_dense: int = 0
     # "softmax" (v2) | "sigmoid" (v3: score + e_score_correction_bias)
@@ -305,13 +308,17 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         ),
         v_head_dim=int(cfg.get("v_head_dim") or 0) if deepseek else 0,
         n_shared_experts=(
-            int(cfg.get("n_shared_experts") or 0) if deepseek else 0
+            int(cfg.get("n_shared_experts") or 0) if deepseek
+            else (1 if cfg.get("shared_expert_intermediate_size") else 0)
         ),
         shared_expert_intermediate_size=(
             int(cfg.get("n_shared_experts") or 0)
             * int(cfg.get("moe_intermediate_size") or 0)
-            if deepseek else 0
+            if deepseek
+            # Qwen2-MoE: explicit width key
+            else int(cfg.get("shared_expert_intermediate_size") or 0)
         ),
+        shared_expert_gated="Qwen2Moe" in arch,
         routed_scaling_factor=(
             float(cfg.get("routed_scaling_factor") or 1.0)
             if deepseek else 1.0

@@ -172,6 +172,8 @@ def init_params(
             layers["ws_gate"] = w(next(keys), L, d, fs)
             layers["ws_up"] = w(next(keys), L, d, fs)
             layers["ws_down"] = w(next(keys), L, fs, d)
+            if cfg.shared_expert_gated:
+                layers["shared_gate"] = w(next(keys), L, d, 1)
         if cfg.moe_scoring == "sigmoid":
             layers["router_bias"] = jnp.zeros((L, E), jnp.float32)
     else:
@@ -192,7 +194,8 @@ def init_params(
         kd = cfg.first_k_dense
         moe_keys = (
             "router", "we_gate", "we_up", "we_down",
-            "ws_gate", "ws_up", "ws_down", "router_bias",
+            "ws_gate", "ws_up", "ws_down", "shared_gate",
+            "router_bias",
         )
         dense: Dict[str, jax.Array] = {
             k: v[:kd] for k, v in layers.items() if k not in moe_keys
@@ -375,7 +378,7 @@ def _moe_mlp(
     we_down: jax.Array,     # [E, Fm, D]
     cfg: ModelConfig,
     router_bias=None,       # [E] sigmoid-selection bias (DeepSeek-V3)
-    shared=None,            # (ws_gate, ws_up, ws_down) shared experts
+    shared=None,            # (ws_gate, ws_up, ws_down, gate_w|None)
 ) -> jax.Array:
     """Mixtral-style top-k MoE, dense-dispatch formulation.
 
@@ -422,12 +425,18 @@ def _moe_mlp(
             cfg.routed_scaling_factor, out.dtype
         )
     if shared is not None:
-        # DeepSeek shared experts: a dense MLP every token passes
-        # through, added to the routed output
-        ws_gate, ws_up, ws_down = shared
+        # Shared experts: a dense MLP every token passes through, added
+        # to the routed output — ungated (DeepSeek) or gated by
+        # sigmoid(x @ g) (Qwen2-MoE)
+        ws_gate, ws_up, ws_down, gate_w = shared
         sg = _mm("btd,df->btf", x, ws_gate)
         su = _mm("btd,df->btf", x, ws_up)
-        out = out + _mm("btf,fd->btd", jax.nn.silu(sg) * su, ws_down)
+        shared_out = _mm("btf,fd->btd", jax.nn.silu(sg) * su, ws_down)
+        if gate_w is not None:
+            shared_out = shared_out * jax.nn.sigmoid(
+                _mm("btd,dg->btg", x, gate_w)
+            )
+        out = out + shared_out
     return out
 
 
@@ -727,7 +736,10 @@ def forward(
                 cfg,
                 router_bias=lp.get("router_bias"),
                 shared=(
-                    (lp["ws_gate"], lp["ws_up"], lp["ws_down"])
+                    (
+                        lp["ws_gate"], lp["ws_up"], lp["ws_down"],
+                        lp.get("shared_gate"),
+                    )
                     if "ws_gate" in lp else None
                 ),
             )

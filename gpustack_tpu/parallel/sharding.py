@@ -62,6 +62,7 @@ def _layer_rules(train: bool) -> Dict[str, P]:
         "ws_gate": P(None, fsdp, AXIS_TP),
         "ws_up": P(None, fsdp, AXIS_TP),
         "ws_down": P(None, AXIS_TP, fsdp),
+        "shared_gate": P(None, None, None),
         "router_bias": P(None, None),
     }
 
