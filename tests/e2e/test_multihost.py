@@ -194,7 +194,9 @@ def test_multihost_serve_and_follower_loss(tmp_path):
                         "max_tokens": 4,
                         "temperature": 0,
                     },
-                    timeout=aiohttp.ClientTimeout(total=180),
+                    # first-request budget covers cold jit compiles in
+                    # BOTH engine processes on a loaded 1-core box
+                    timeout=aiohttp.ClientTimeout(total=420),
                 ) as r:
                     assert r.status == 200, await r.text()
                     data = await r.json()
