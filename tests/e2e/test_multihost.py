@@ -39,11 +39,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_worker(server_port, data_dir, fixture, name):
+def _spawn_worker(server_port, data_dir, fixture, name, port_base=40000):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["GPUSTACK_TPU_HEARTBEAT_INTERVAL"] = "1.0"
     env["GPUSTACK_TPU_STATUS_INTERVAL"] = "2.0"
+    # DISJOINT engine-port bands per worker: on real deployments each
+    # worker is its own host, but both e2e workers share localhost —
+    # identical bands race the probe-then-bind window and an engine can
+    # die at bind (recoverable via restart, but it flakes the test)
+    env["GPUSTACK_TPU_ENGINE_PORT_BASE"] = str(port_base)
     return subprocess.Popen(
         [
             sys.executable, "-m", "gpustack_tpu", "start",
@@ -109,10 +114,12 @@ def test_multihost_serve_and_follower_loss(tmp_path):
         workers = []
         try:
             workers.append(_spawn_worker(
-                server_port, dirs[0], "v4_8_host0.json", "host0"
+                server_port, dirs[0], "v4_8_host0.json", "host0",
+                port_base=40000,
             ))
             workers.append(_spawn_worker(
-                server_port, dirs[1], "v4_8_host1.json", "host1"
+                server_port, dirs[1], "v4_8_host1.json", "host1",
+                port_base=46000,
             ))
             async with aiohttp.ClientSession() as http:
                 async with http.post(
