@@ -237,6 +237,10 @@ def test_dev_manager_reaps_orphans_across_restart(tmp_path):
         dm2 = DevManager(_Cfg(tmp_path), client, worker_id=7)
         reaped = dm2.reap_orphans()
         assert reaped == 1
+        # the reaper's own grace window can expire under heavy box load
+        # while the SIGTERM is still being delivered — wait for the
+        # exit here instead of asserting instantaneous death
+        orphan.wait(timeout=60)
         assert orphan.poll() is not None
 
     asyncio.run(go())
