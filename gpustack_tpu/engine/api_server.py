@@ -972,11 +972,24 @@ def build_engine_from_args(args) -> LLMEngine:
             draft_cfg = get_config(source)
             draft_params = load_or_init_params(draft_cfg, None, seed=0)
 
+    # the decode batch is dp-sharded, so the slot count must be a
+    # multiple of the mesh's dp degree; round capacity UP rather than
+    # crash in device_put when the auto-planner picks dp > max_slots
+    # (e.g. a small --max-slots on a many-chip host)
+    max_slots = args.max_slots
+    if max_slots % plan.dp:
+        rounded = (max_slots // plan.dp + 1) * plan.dp
+        logger.warning(
+            "max_slots=%d not divisible by mesh dp=%d; rounding up to %d",
+            max_slots, plan.dp, rounded,
+        )
+        max_slots = rounded
+
     engine = LLMEngine(
         cfg,
         params,
         model_dir=args.model_dir,
-        max_slots=args.max_slots,
+        max_slots=max_slots,
         max_seq_len=args.max_seq_len,
         plan=plan,
         speculative=args.speculative,

@@ -11,8 +11,10 @@ re-quantized to int8 weight-only for the MXU path).
 
 Format: GGUF v2/v3 (little-endian) — header, typed metadata KV section,
 tensor info table, aligned data section. Quantizations supported:
-F32/F16/BF16 passthrough, Q8_0, Q4_0, Q4_1 (covers the common K-less
-exports); K-quants raise a clear error naming the tensor.
+F32/F16/BF16 passthrough, Q8_0, Q4_0/Q4_1, Q5_0/Q5_1, and the K-quant
+super-block formats Q2_K/Q3_K/Q4_K/Q5_K/Q6_K (what real-world Q4_K_M /
+Q5_K_M / Q6_K checkpoints ship). gguf-split multi-file checkpoints are
+resolved via ``split.count`` metadata (gguf_shard_paths).
 
 Tokenizer: a ``tokenizer.json`` sidecar next to the .gguf wins (exact
 HF tokenization). Without one, the GGUF's embedded vocab drives exact
@@ -48,13 +50,25 @@ _SCALAR_FMT = {
 # ggml tensor types (subset)
 GGML_F32, GGML_F16 = 0, 1
 GGML_Q4_0, GGML_Q4_1 = 2, 3
+GGML_Q5_0, GGML_Q5_1 = 6, 7
 GGML_Q8_0 = 8
+GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 10, 11, 12, 13, 14
 GGML_BF16 = 30
 
 _TYPE_NAMES = {
     0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
     8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K", 12: "Q4_K",
     13: "Q5_K", 14: "Q6_K", 15: "Q8_K", 30: "BF16",
+}
+
+# bytes per block for each supported quantized type (block = 32 elements
+# for the _0/_1 formats, 256 for K-quant super-blocks)
+_BLOCK_BYTES = {
+    GGML_Q4_0: (32, 18), GGML_Q4_1: (32, 20),
+    GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24),
+    GGML_Q8_0: (32, 34),
+    GGML_Q2_K: (256, 84), GGML_Q3_K: (256, 110), GGML_Q4_K: (256, 144),
+    GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
 }
 
 
@@ -134,20 +148,170 @@ def read_gguf(
         # truncated/corrupt file: surface as ValueError so every caller's
         # fallback path (ByteTokenizer, EvaluationError) engages
         raise ValueError(f"corrupt GGUF file {path!r}: {e}") from e
-    split = int(metadata.get("split.count", 1) or 1)
-    if split > 1:
-        raise ValueError(
-            f"{path!r} is part of a {split}-file split GGUF; merge it "
-            "first (gguf-split --merge)"
-        )
     align = int(metadata.get("general.alignment", 32))
     data_start = (r.pos + align - 1) // align * align
     return metadata, infos, data_start, raw
 
 
+def _f16(blocks: np.ndarray, a: int) -> np.ndarray:
+    """Column-pair [a:a+2] of a [N, bytes] uint8 block array as f32
+    scales, shape [N, 1]."""
+    return blocks[:, a: a + 2].copy().view(np.float16).astype(np.float32)
+
+
+def _k_scale_min(scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte 6-bit scale/min table shared by Q4_K/Q5_K
+    (ggml get_scale_min_k4): 8 sub-block (scale, min) pairs, the high 2
+    bits of entries 4..7 spilled into the top bits of bytes 0..7."""
+    n = scales.shape[0]
+    sc = np.empty((n, 8), np.float32)
+    mn = np.empty((n, 8), np.float32)
+    for j in range(4):
+        sc[:, j] = scales[:, j] & 63
+        mn[:, j] = scales[:, j + 4] & 63
+    for j in range(4, 8):
+        sc[:, j] = (scales[:, j + 4] & 0x0F) | ((scales[:, j - 4] >> 6) << 4)
+        mn[:, j] = (scales[:, j + 4] >> 4) | ((scales[:, j] >> 6) << 4)
+    return sc, mn
+
+
+def _dequant_q2_k(blocks: np.ndarray) -> np.ndarray:
+    n = blocks.shape[0]
+    scales = blocks[:, 0:16]
+    qs = blocks[:, 16:80]
+    d, dmin = _f16(blocks, 80), _f16(blocks, 82)
+    out = np.empty((n, 256), np.float32)
+    for half in range(2):                       # 128-element halves
+        q = qs[:, 32 * half: 32 * half + 32]
+        for j in range(4):                      # 2-bit planes
+            for sub in range(2):                # 16-element sub-blocks
+                s = scales[:, 8 * half + 2 * j + sub: 8 * half + 2 * j + sub + 1]
+                dl = d * (s & 0x0F)
+                ml = dmin * (s >> 4)
+                vals = (q[:, 16 * sub: 16 * sub + 16] >> (2 * j)) & 3
+                out[:, 128 * half + 32 * j + 16 * sub:
+                     128 * half + 32 * j + 16 * sub + 16] = dl * vals - ml
+    return out
+
+
+def _dequant_q3_k(blocks: np.ndarray) -> np.ndarray:
+    n = blocks.shape[0]
+    hmask = blocks[:, 0:32]
+    qs = blocks[:, 32:96]
+    raw_sc = blocks[:, 96:108]
+    d = _f16(blocks, 108)
+    # 6-bit scales: low 4 bits in bytes 0..7, high 2 bits packed in 8..11
+    sc = np.empty((n, 16), np.float32)
+    for j in range(16):
+        if j < 8:
+            lo = raw_sc[:, j] & 0x0F
+        else:
+            lo = raw_sc[:, j - 8] >> 4
+        hi = (raw_sc[:, 8 + j % 4] >> (2 * (j // 4))) & 3
+        sc[:, j] = (lo | (hi << 4)).astype(np.int8) - 32
+    out = np.empty((n, 256), np.float32)
+    is_ = 0
+    for half in range(2):
+        q = qs[:, 32 * half: 32 * half + 32]
+        for j in range(4):
+            m = 1 << (4 * half + j)
+            for sub in range(2):
+                dl = d[:, 0] * sc[:, is_]
+                is_ += 1
+                qsub = ((q[:, 16 * sub: 16 * sub + 16] >> (2 * j)) & 3
+                        ).astype(np.int8)
+                hm = hmask[:, 16 * sub: 16 * sub + 16]
+                qsub = qsub - np.where((hm & m) != 0, 0, 4).astype(np.int8)
+                out[:, 128 * half + 32 * j + 16 * sub:
+                     128 * half + 32 * j + 16 * sub + 16] = (
+                    dl[:, None] * qsub
+                )
+    return out
+
+
+def _dequant_q4_k(blocks: np.ndarray) -> np.ndarray:
+    d, dmin = _f16(blocks, 0), _f16(blocks, 2)
+    sc, mn = _k_scale_min(blocks[:, 4:16])
+    qs = blocks[:, 16:144]
+    out = np.empty((blocks.shape[0], 256), np.float32)
+    for c in range(4):                          # 64-element chunks
+        q = qs[:, 32 * c: 32 * c + 32]
+        out[:, 64 * c: 64 * c + 32] = (
+            d * sc[:, [2 * c]] * (q & 0x0F) - dmin * mn[:, [2 * c]]
+        )
+        out[:, 64 * c + 32: 64 * c + 64] = (
+            d * sc[:, [2 * c + 1]] * (q >> 4) - dmin * mn[:, [2 * c + 1]]
+        )
+    return out
+
+
+def _dequant_q5_k(blocks: np.ndarray) -> np.ndarray:
+    d, dmin = _f16(blocks, 0), _f16(blocks, 2)
+    sc, mn = _k_scale_min(blocks[:, 4:16])
+    qh = blocks[:, 16:48]
+    qs = blocks[:, 48:176]
+    out = np.empty((blocks.shape[0], 256), np.float32)
+    for c in range(4):
+        ql = qs[:, 32 * c: 32 * c + 32]
+        h1 = ((qh >> (2 * c)) & 1) * 16
+        h2 = ((qh >> (2 * c + 1)) & 1) * 16
+        out[:, 64 * c: 64 * c + 32] = (
+            d * sc[:, [2 * c]] * ((ql & 0x0F) + h1)
+            - dmin * mn[:, [2 * c]]
+        )
+        out[:, 64 * c + 32: 64 * c + 64] = (
+            d * sc[:, [2 * c + 1]] * ((ql >> 4) + h2)
+            - dmin * mn[:, [2 * c + 1]]
+        )
+    return out
+
+
+def _dequant_q6_k(blocks: np.ndarray) -> np.ndarray:
+    n = blocks.shape[0]
+    ql = blocks[:, 0:128]
+    qh = blocks[:, 128:192]
+    sc = blocks[:, 192:208].view(np.int8).astype(np.float32)
+    d = _f16(blocks, 208)
+    out = np.empty((n, 256), np.float32)
+
+    def rep(s, i0):
+        return np.repeat(s[:, i0: i0 + 2], 16, axis=1)   # sc[l//16 + i0]
+
+    for half in range(2):                       # 128-element halves
+        qlh = ql[:, 64 * half: 64 * half + 64]
+        qhh = qh[:, 32 * half: 32 * half + 32]
+        s = sc[:, 8 * half: 8 * half + 8]
+        q1 = ((qlh[:, :32] & 0x0F) | (((qhh >> 0) & 3) << 4)).astype(
+            np.int8
+        ) - 32
+        q2 = ((qlh[:, 32:] & 0x0F) | (((qhh >> 2) & 3) << 4)).astype(
+            np.int8
+        ) - 32
+        q3 = ((qlh[:, :32] >> 4) | (((qhh >> 4) & 3) << 4)).astype(
+            np.int8
+        ) - 32
+        q4 = ((qlh[:, 32:] >> 4) | (((qhh >> 6) & 3) << 4)).astype(
+            np.int8
+        ) - 32
+        base = 128 * half
+        out[:, base: base + 32] = d * rep(s, 0) * q1
+        out[:, base + 32: base + 64] = d * rep(s, 2) * q2
+        out[:, base + 64: base + 96] = d * rep(s, 4) * q3
+        out[:, base + 96: base + 128] = d * rep(s, 6) * q4
+    return out
+
+
 def _dequantize(
     name: str, blob: np.ndarray, shape: tuple, ggml_type: int
 ) -> np.ndarray:
+    """Dequantize one tensor's raw bytes → f32 array of ``shape``.
+
+    K-quant super-block layouts follow ggml-quants.c (dequantize_row_*):
+    256-element super-blocks with 6-bit (Q4_K/Q5_K), 4+2-bit packed
+    (Q3_K), 4-bit (Q2_K), or int8 (Q6_K) sub-block scales. These are the
+    formats real-world GGUF checkpoints actually ship (Q4_K_M et al.) —
+    reference role: llama-box serves them via ggml, here they load into
+    the same jitted TPU transformer as safetensors."""
     n = int(np.prod(shape))
     if ggml_type == GGML_F32:
         return blob.view(np.float32)[:n].reshape(shape)
@@ -159,13 +323,13 @@ def _dequantize(
     if ggml_type == GGML_Q8_0:
         # blocks of 32: f16 scale + 32×int8
         blocks = blob.reshape(-1, 34)
-        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        d = _f16(blocks, 0)
         q = blocks[:, 2:].view(np.int8).astype(np.float32)
-        return (q * d).reshape(shape)[:n].reshape(shape)
+        return (q * d).reshape(-1)[:n].reshape(shape)
     if ggml_type in (GGML_Q4_0, GGML_Q4_1):
         bs = 18 if ggml_type == GGML_Q4_0 else 20
         blocks = blob.reshape(-1, bs)
-        d = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+        d = _f16(blocks, 0)
         qs = blocks[:, bs - 16:]
         lo = (qs & 0x0F).astype(np.float32)
         hi = (qs >> 4).astype(np.float32)
@@ -173,13 +337,42 @@ def _dequantize(
         if ggml_type == GGML_Q4_0:
             vals = (q - 8.0) * d
         else:
-            m = blocks[:, 2:4].copy().view(np.float16).astype(np.float32)
+            m = _f16(blocks, 2)
             vals = q * d + m
+        return vals.reshape(-1)[:n].reshape(shape)
+    if ggml_type in (GGML_Q5_0, GGML_Q5_1):
+        bs = 22 if ggml_type == GGML_Q5_0 else 24
+        blocks = blob.reshape(-1, bs)
+        d = _f16(blocks, 0)
+        qh = (
+            blocks[:, bs - 20: bs - 16].copy().view(np.uint32)
+            .astype(np.uint64)
+        )
+        qs = blocks[:, bs - 16:]
+        bits = (qh[:, 0:1] >> np.arange(32, dtype=np.uint64)) & 1
+        lo = (qs & 0x0F) | (bits[:, :16] << 4).astype(np.uint8)
+        hi = (qs >> 4) | (bits[:, 16:] << 4).astype(np.uint8)
+        q = np.concatenate([lo, hi], axis=1).astype(np.float32)
+        if ggml_type == GGML_Q5_0:
+            vals = (q - 16.0) * d
+        else:
+            vals = q * d + _f16(blocks, 2)
+        return vals.reshape(-1)[:n].reshape(shape)
+    kdeq = {
+        GGML_Q2_K: _dequant_q2_k,
+        GGML_Q3_K: _dequant_q3_k,
+        GGML_Q4_K: _dequant_q4_k,
+        GGML_Q5_K: _dequant_q5_k,
+        GGML_Q6_K: _dequant_q6_k,
+    }.get(ggml_type)
+    if kdeq is not None:
+        _, bs = _BLOCK_BYTES[ggml_type]
+        vals = kdeq(blob.reshape(-1, bs))
         return vals.reshape(-1)[:n].reshape(shape)
     raise ValueError(
         f"GGUF tensor {name!r} uses unsupported quantization "
         f"{_TYPE_NAMES.get(ggml_type, ggml_type)}; supported: F32/F16/"
-        "BF16/Q8_0/Q4_0/Q4_1 (re-export without K-quants)"
+        "BF16/Q8_0/Q4_0/Q4_1/Q5_0/Q5_1/Q2_K/Q3_K/Q4_K/Q5_K/Q6_K"
     )
 
 
@@ -189,12 +382,9 @@ def _type_bytes(shape: tuple, ggml_type: int) -> int:
         return n * 4
     if ggml_type in (GGML_F16, GGML_BF16):
         return n * 2
-    if ggml_type == GGML_Q8_0:
-        return n // 32 * 34
-    if ggml_type == GGML_Q4_0:
-        return n // 32 * 18
-    if ggml_type == GGML_Q4_1:
-        return n // 32 * 20
+    if ggml_type in _BLOCK_BYTES:
+        elems, nbytes = _BLOCK_BYTES[ggml_type]
+        return (n + elems - 1) // elems * nbytes
     raise ValueError(f"unsupported ggml type {ggml_type}")
 
 
@@ -259,42 +449,108 @@ def _reverse_llama_permute(w: np.ndarray, n_head: int) -> np.ndarray:
     )
 
 
+def gguf_shard_paths(
+    path: str, first_parse=None
+) -> List[str]:
+    """All files of a (possibly split) GGUF checkpoint, first shard
+    first.
+
+    gguf-split writes ``split.count``/``split.no`` metadata and names
+    shards ``<base>-00001-of-0000N.gguf``; every shard must be present
+    (reference role: gguf-parser resolves splits the same way for
+    sizing). A checkpoint without split metadata is its own single
+    shard. ``first_parse`` lets callers that already read ``path``
+    (read_gguf result tuple) avoid a second full metadata parse — the
+    KV section can embed a 100k+-entry tokenizer vocab."""
+    import re
+
+    metadata = (first_parse or read_gguf(path))[0]
+    count = int(metadata.get("split.count", 1) or 1)
+    if count <= 1:
+        return [path]
+    m = re.search(r"-(\d{5})-of-(\d{5})\.gguf$", path)
+    if not m:
+        raise ValueError(
+            f"{path!r} declares split.count={count} but is not named "
+            "like gguf-split output (<base>-00001-of-0000N.gguf)"
+        )
+    base = path[: m.start()]
+    total = int(m.group(2))
+    if total != count:
+        raise ValueError(
+            f"{path!r}: filename says {total} shards, metadata says "
+            f"{count}"
+        )
+    shards = [
+        f"{base}-{i + 1:05d}-of-{total:05d}.gguf" for i in range(total)
+    ]
+    missing = [s for s in shards if not os.path.exists(s)]
+    if missing:
+        raise ValueError(
+            f"split GGUF is missing shard(s): {missing}"
+        )
+    return shards
+
+
+def _tensor_data(
+    name: str, shape: tuple, ggml_type: int, offset: int,
+    data_start: int, raw,
+) -> np.ndarray:
+    """One tensor's dequantized f32 data from a parsed shard."""
+    buf = np.frombuffer(raw, np.uint8)
+    start = data_start + offset
+    blob = buf[start: start + _type_bytes(shape, ggml_type)]
+    return _dequantize(name, blob, shape, ggml_type)
+
+
 def load_gguf_tensors(path: str) -> Dict[str, Any]:
-    """GGUF file → {hf_name: torch tensor} for load_hf_checkpoint's
-    mapping machinery. llama.cpp 2-D weights are [out, in] after dim
-    reversal — the same layout as torch linear weights, so the existing
-    transpose-on-load convention applies unchanged."""
+    """GGUF file (or first shard of a split checkpoint) → {hf_name:
+    torch tensor} for load_hf_checkpoint's mapping machinery. llama.cpp
+    2-D weights are [out, in] after dim reversal — the same layout as
+    torch linear weights, so the existing transpose-on-load convention
+    applies unchanged.
+
+    Model metadata (arch, head counts) comes from shard 1 ONLY:
+    gguf-split writes the model KV section just there, so per-shard
+    metadata reads would silently skip the llama q/k un-permute for
+    tensors living in later shards."""
     import torch
 
-    metadata, infos, data_start, raw = read_gguf(path)
-    buf = np.frombuffer(raw, np.uint8)
+    first = read_gguf(path)
+    shards = gguf_shard_paths(path, first_parse=first)
+    metadata = first[0]
     arch = metadata.get("general.architecture", "llama")
     n_head = int(metadata.get(f"{arch}.attention.head_count", 0))
     n_kv = int(
         metadata.get(f"{arch}.attention.head_count_kv", n_head)
     )
     tensors: Dict[str, Any] = {}
-    for name, shape, ggml_type, offset in infos:
-        hf_name = _map_name(name)
-        if hf_name is None:
-            continue
-        start = data_start + offset
-        blob = buf[start: start + _type_bytes(shape, ggml_type)]
-        arr = _dequantize(name, blob, shape, ggml_type).copy()
-        if arch == "llama" and n_head:
-            # only llama-arch exports permute q/k (qwen2/gemma don't)
-            if name.endswith("attn_q.weight"):
-                arr = _reverse_llama_permute(arr, n_head)
-            elif name.endswith("attn_k.weight"):
-                arr = _reverse_llama_permute(arr, n_kv)
-        tensors[hf_name] = torch.from_numpy(arr)
+    for shard in shards:
+        _, infos, data_start, raw = (
+            first if shard == path else read_gguf(shard)
+        )
+        for name, shape, ggml_type, offset in infos:
+            hf_name = _map_name(name)
+            if hf_name is None:
+                continue
+            arr = _tensor_data(
+                name, shape, ggml_type, offset, data_start, raw
+            ).copy()
+            if arch == "llama" and n_head:
+                # only llama-arch exports permute q/k (qwen2/gemma don't)
+                if name.endswith("attn_q.weight"):
+                    arr = _reverse_llama_permute(arr, n_head)
+                elif name.endswith("attn_k.weight"):
+                    arr = _reverse_llama_permute(arr, n_kv)
+            tensors[hf_name] = torch.from_numpy(arr)
     return tensors
 
 
 def gguf_file_in(model_dir: str) -> Optional[str]:
     """The .gguf file for a model source: the path itself, or the first
-    .gguf in the directory (read_gguf rejects split files via
-    ``split.count`` with a clear merge instruction)."""
+    .gguf in the directory (for gguf-split checkpoints the sorted order
+    puts the -00001-of-N shard first; gguf_shard_paths resolves the
+    rest)."""
     if model_dir and model_dir.endswith(".gguf"):
         return model_dir if os.path.exists(model_dir) else None
     if model_dir and os.path.isdir(model_dir):
@@ -308,10 +564,28 @@ def gguf_file_in(model_dir: str) -> Optional[str]:
 
 def config_from_gguf(path: str, name: str = ""):
     """GGUF metadata → ModelConfig (reference role: gguf-parser's
-    architecture extraction feeding the scheduler)."""
+    architecture extraction feeding the scheduler).
+
+    Rope scaling is carried two ways by llama.cpp exports and both are
+    honored: ``{arch}.rope.scaling.*`` metadata (linear/yarn), and the
+    ``rope_freqs.weight`` tensor (Llama-3.1-class llama3 scaling, shipped
+    as precomputed per-frequency divisors instead of metadata). Ignoring
+    either would serve long prompts with unscaled RoPE — silently wrong
+    beyond the original context window — while advertising the scaled
+    ``context_length``."""
     from gpustack_tpu.models.config import ModelConfig
 
-    metadata, infos, _, _ = read_gguf(path)
+    first = read_gguf(path)
+    shards = gguf_shard_paths(path, first_parse=first)
+    metadata, infos, data_start, raw = first
+    # tensor presence checks (tied embeddings, bias, rope_freqs) must see
+    # the WHOLE checkpoint; gguf-split puts full metadata in shard 1 but
+    # spreads tensors across every shard
+    shard_infos = {shards[0]: (infos, data_start, raw)}
+    for extra in shards[1:]:
+        m2, i2, ds2, raw2 = read_gguf(extra)
+        shard_infos[extra] = (i2, ds2, raw2)
+        infos = infos + i2
     arch = metadata.get("general.architecture", "llama")
     if arch.startswith("deepseek"):
         # llama.cpp's deepseek2 export uses MLA-specific tensor names
@@ -345,6 +619,49 @@ def config_from_gguf(path: str, name: str = ""):
             32000,
         )
     tensor_names = {t[0] for t in infos}
+
+    rope_scaling = None
+    rs_type = md("rope.scaling.type")
+    if rs_type == "linear":
+        rope_scaling = {
+            "rope_type": "linear",
+            "factor": float(md("rope.scaling.factor", 1.0)),
+        }
+    elif rs_type == "yarn":
+        rope_scaling = {
+            "rope_type": "yarn",
+            "factor": float(md("rope.scaling.factor", 1.0)),
+            "original_max_position_embeddings": int(
+                md("rope.scaling.original_context_length", 0)
+                or md("context_length", 4096)
+            ),
+        }
+    elif rs_type not in (None, "none"):
+        raise ValueError(
+            f"GGUF {path!r} declares unsupported rope scaling type "
+            f"{rs_type!r}"
+        )
+    if "rope_freqs.weight" in tensor_names:
+        # Llama-3.1-style exports: the blended llama3 divisors ship as a
+        # tensor; load them now (always F32, head_dim/2 floats) so the
+        # rope tables divide by them (transformer.rope_params)
+        for spath, (s_infos, s_start, s_raw) in shard_infos.items():
+            hit = next(
+                (t for t in s_infos if t[0] == "rope_freqs.weight"), None
+            )
+            if hit is None:
+                continue
+            tname, shape, ggml_type, offset = hit
+            factors = _tensor_data(
+                tname, shape, ggml_type, offset, s_start, s_raw
+            )
+            rope_scaling = dict(rope_scaling or {})
+            rope_scaling.setdefault("rope_type", "llama3")
+            rope_scaling["factors"] = [
+                float(x) for x in np.asarray(factors).reshape(-1)
+            ]
+            break
+
     return ModelConfig(
         name=name or os.path.basename(path),
         vocab_size=vocab,
@@ -355,6 +672,7 @@ def config_from_gguf(path: str, name: str = ""):
         num_kv_heads=kv_heads,
         head_dim=int(md("attention.key_length", hidden // heads)),
         rope_theta=float(md("rope.freq_base", 10000.0)),
+        rope_scaling=rope_scaling,
         rms_norm_eps=float(md("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position_embeddings=int(md("context_length", 8192)),
         tie_word_embeddings="output.weight" not in tensor_names,

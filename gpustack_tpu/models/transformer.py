@@ -235,11 +235,28 @@ def _inv_freq(theta: float, head_dim: int) -> jax.Array:
     )
 
 
-def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
-    """Inverse RoPE frequencies with HF-compatible llama3/linear scaling."""
-    inv = _inv_freq(cfg.rope_theta, cfg.head_dim)
+def rope_params(cfg: ModelConfig) -> Tuple[jax.Array, float]:
+    """(inv_freq, attention_factor) for the main RoPE path.
+
+    Handles HF llama3/linear/yarn scaling plus GGUF ``rope_freqs.weight``
+    exports: llama.cpp ships the blended llama3 divisors as a precomputed
+    per-frequency tensor instead of metadata (convert_hf_to_gguf
+    generate_extra_tensors), surfaced here as ``rs["factors"]`` — those
+    divisors are authoritative over the formula when present.
+    attention_factor scales sin/cos (squaring into scores), matching HF's
+    ``attention_scaling`` on the rotary embedding; it is 1.0 for
+    non-yarn types."""
     rs = cfg.rope_scaling or {}
     rope_type = rs.get("rope_type") or rs.get("type")
+    factors = rs.get("factors")
+    inv = _inv_freq(cfg.rope_theta, cfg.head_dim)
+    if rope_type == "yarn":
+        yarn_inv, att = yarn_inv_freq(cfg.rope_theta, cfg.head_dim, rs)
+        if factors is not None:
+            return inv / jnp.asarray(factors, jnp.float32), att
+        return yarn_inv, att
+    if factors is not None:
+        return inv / jnp.asarray(factors, jnp.float32), 1.0
     if rope_type == "linear":
         inv = inv / rs["factor"]
     elif rope_type == "llama3":
@@ -258,7 +275,26 @@ def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
             inv / factor,
             jnp.where(wavelen < orig / high, inv, interpolated),
         )
-    return inv
+    elif rope_type not in (None, "default"):
+        raise ValueError(
+            f"unsupported rope_scaling type {rope_type!r} (supported: "
+            "default/linear/llama3/yarn/gguf rope_freqs)"
+        )
+    return inv, 1.0
+
+
+def rope_inv_freq(cfg: ModelConfig) -> jax.Array:
+    """Inverse RoPE frequencies with HF-compatible scaling (see
+    rope_params; this back-compat wrapper drops the attention factor)."""
+    return rope_params(cfg)[0]
+
+
+def yarn_get_mscale(scale: float, m: float = 1.0) -> float:
+    """DeepSeek's yarn_get_mscale (modeling_deepseek_v2): attention
+    magnitude correction for YaRN-interpolated rope."""
+    if scale <= 1:
+        return 1.0
+    return 0.1 * m * math.log(scale) + 1.0
 
 
 def yarn_inv_freq(
@@ -279,18 +315,13 @@ def yarn_inv_freq(
     mscale_all = rs.get("mscale_all_dim")
     attention_factor = rs.get("attention_factor")
 
-    def get_mscale(scale, m=1.0):
-        if scale <= 1:
-            return 1.0
-        return 0.1 * m * math.log(scale) + 1.0
-
     if attention_factor is None:
         if mscale and mscale_all:
-            attention_factor = get_mscale(factor, mscale) / get_mscale(
-                factor, mscale_all
-            )
+            attention_factor = yarn_get_mscale(
+                factor, mscale
+            ) / yarn_get_mscale(factor, mscale_all)
         else:
-            attention_factor = get_mscale(factor)
+            attention_factor = yarn_get_mscale(factor)
 
     def correction_dim(n_rot):
         return (
@@ -497,7 +528,13 @@ def forward(
         # gemma: embeddings scaled by sqrt(d); HF casts the normalizer
         # to the compute dtype before multiplying
         x = x * jnp.asarray(math.sqrt(cfg.hidden_size)).astype(dtype)
-    sin, cos = rope_sin_cos(positions, rope_inv_freq(cfg))
+    main_inv, main_att_factor = rope_params(cfg)
+    sin, cos = rope_sin_cos(positions, main_inv)
+    if main_att_factor != 1.0:
+        # yarn on the standard attention path (Qwen/Llama long-context
+        # configs): HF's attention_scaling rides cos/sin
+        sin = sin * main_att_factor
+        cos = cos * main_att_factor
     if cfg.is_mla:
         # decoupled rope: only the qk_rope part rotates, with its own
         # frequency table (interleaved-pair convention); DeepSeek ships
@@ -522,6 +559,23 @@ def forward(
     else:
         sin_loc, cos_loc = sin, cos
     scale = 1.0 / math.sqrt(cfg.query_pre_attn_scalar or cfg.head_dim)
+    if cfg.is_mla:
+        # DeepSeek YaRN applies a SECOND magnitude correction beyond the
+        # sin/cos attention_factor: HF/vLLM multiply the softmax scale by
+        # yarn_get_mscale(factor, mscale_all_dim)^2 (modeling_deepseek_v2
+        # DeepseekV2Attention.__init__; vLLM deepseek_v2.py). For the
+        # shipped V2/V3 configs mscale == mscale_all_dim, so the sin/cos
+        # factor is 1.0 and THIS term carries the whole correction
+        # (~1.59x for V2-Lite's factor=40, mscale_all_dim=0.707).
+        rs_ = cfg.rope_scaling or {}
+        if (
+            (rs_.get("rope_type") or rs_.get("type")) == "yarn"
+            and rs_.get("mscale_all_dim")
+        ):
+            m_ = yarn_get_mscale(
+                float(rs_["factor"]), float(rs_["mscale_all_dim"])
+            )
+            scale = scale * m_ * m_
     hetero = cfg.layer_sliding is not None
 
     use_flash = (

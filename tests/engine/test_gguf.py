@@ -93,6 +93,10 @@ def write_gguf(path, metadata, tensors):
             blob = _quantize_q8_0(arr)
         elif gtype == GGML_Q4_0:
             blob = _quantize_q4_0(arr)
+        elif gtype == 12:                  # Q4_K
+            blob = _quantize_q4_k(arr)
+        elif gtype == 14:                  # Q6_K
+            blob = _quantize_q6_k(arr)
         else:
             raise ValueError(gtype)
         nb = name.encode()
@@ -364,12 +368,597 @@ def test_engine_serves_gguf(tmp_path):
 
 
 def test_unsupported_quant_is_loud(tmp_path):
-    path = str(tmp_path / "k.gguf")
-    arr = np.zeros((32,), np.float32)
-    # forge a Q4_K (type 12) tensor info with a fake blob
-    write_gguf(path, {"general.architecture": "llama"}, {})
-    # hand-craft: simpler to assert via _dequantize directly
     from gpustack_tpu.engine.gguf import _dequantize
 
-    with pytest.raises(ValueError, match="Q4_K"):
-        _dequantize("t", np.zeros(144, np.uint8), (256,), 12)
+    # IQ2_XXS (type 16) is not supported; the error names the type
+    with pytest.raises(ValueError, match="16"):
+        _dequantize("t", np.zeros(144, np.uint8), (256,), 16)
+
+
+# ---------------------------------------------------------------------------
+# K-quants: vectorized dequant vs scalar transliterations of
+# ggml-quants.c dequantize_row_* (the authoritative layouts)
+# ---------------------------------------------------------------------------
+
+
+def _scale_min_k4(j, scales):
+    if j < 4:
+        return scales[j] & 63, scales[j + 4] & 63
+    d = (scales[j + 4] & 0xF) | ((scales[j - 4] >> 6) << 4)
+    m = (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4)
+    return d, m
+
+
+def _ref_q4_k(block):
+    d = np.frombuffer(block[0:2], np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[2:4], np.float16)[0].astype(np.float32)
+    scales, qs = block[4:16], block[16:144]
+    y = np.zeros(256, np.float32)
+    yi, is_, qoff = 0, 0, 0
+    for _j in range(0, 256, 64):
+        sc, m = _scale_min_k4(is_, scales)
+        d1, m1 = d * sc, dmin * m
+        sc, m = _scale_min_k4(is_ + 1, scales)
+        d2, m2 = d * sc, dmin * m
+        for l in range(32):
+            y[yi] = d1 * (qs[qoff + l] & 0xF) - m1
+            yi += 1
+        for l in range(32):
+            y[yi] = d2 * (qs[qoff + l] >> 4) - m2
+            yi += 1
+        qoff += 32
+        is_ += 2
+    return y
+
+
+def _ref_q5_k(block):
+    d = np.frombuffer(block[0:2], np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[2:4], np.float16)[0].astype(np.float32)
+    scales, qh, ql = block[4:16], block[16:48], block[48:176]
+    y = np.zeros(256, np.float32)
+    yi, is_, qoff = 0, 0, 0
+    u1, u2 = 1, 2
+    for _j in range(0, 256, 64):
+        sc, m = _scale_min_k4(is_, scales)
+        d1, m1 = d * sc, dmin * m
+        sc, m = _scale_min_k4(is_ + 1, scales)
+        d2, m2 = d * sc, dmin * m
+        for l in range(32):
+            h = 16 if (qh[l] & u1) else 0
+            y[yi] = d1 * ((ql[qoff + l] & 0xF) + h) - m1
+            yi += 1
+        for l in range(32):
+            h = 16 if (qh[l] & u2) else 0
+            y[yi] = d2 * ((ql[qoff + l] >> 4) + h) - m2
+            yi += 1
+        qoff += 32
+        is_ += 2
+        u1 <<= 2
+        u2 <<= 2
+    return y
+
+
+def _ref_q6_k(block):
+    ql, qh = block[0:128], block[128:192]
+    sc = block[192:208].view(np.int8)
+    d = np.frombuffer(block[208:210].tobytes(), np.float16)[0].astype(
+        np.float32
+    )
+    y = np.zeros(256, np.float32)
+    for n in range(0, 256, 128):
+        lo, ho, so = n // 2, n // 4, n // 16
+        for l in range(32):
+            is_ = l // 16
+            q1 = int((ql[lo + l] & 0xF) | (((qh[ho + l] >> 0) & 3) << 4))
+            q2 = int(
+                (ql[lo + l + 32] & 0xF) | (((qh[ho + l] >> 2) & 3) << 4)
+            )
+            q3 = int((ql[lo + l] >> 4) | (((qh[ho + l] >> 4) & 3) << 4))
+            q4 = int(
+                (ql[lo + l + 32] >> 4) | (((qh[ho + l] >> 6) & 3) << 4)
+            )
+            y[n + l] = d * sc[so + is_] * (q1 - 32)
+            y[n + l + 32] = d * sc[so + is_ + 2] * (q2 - 32)
+            y[n + l + 64] = d * sc[so + is_ + 4] * (q3 - 32)
+            y[n + l + 96] = d * sc[so + is_ + 6] * (q4 - 32)
+    return y
+
+
+def _ref_q2_k(block):
+    scales, qs = block[0:16], block[16:80]
+    d = np.frombuffer(block[80:82], np.float16)[0].astype(np.float32)
+    dmin = np.frombuffer(block[82:84], np.float16)[0].astype(np.float32)
+    y = np.zeros(256, np.float32)
+    yi, is_, qoff = 0, 0, 0
+    for _n in range(0, 256, 128):
+        shift = 0
+        for _j in range(4):
+            sc = scales[is_]
+            is_ += 1
+            dl, ml = d * (sc & 0xF), dmin * (sc >> 4)
+            for l in range(16):
+                y[yi] = dl * ((qs[qoff + l] >> shift) & 3) - ml
+                yi += 1
+            sc = scales[is_]
+            is_ += 1
+            dl, ml = d * (sc & 0xF), dmin * (sc >> 4)
+            for l in range(16):
+                y[yi] = dl * ((qs[qoff + l + 16] >> shift) & 3) - ml
+                yi += 1
+            shift += 2
+        qoff += 32
+    return y
+
+
+def _ref_q3_k(block):
+    hmask, qs, raw_sc = block[0:32], block[32:96], block[96:108]
+    d_all = np.frombuffer(block[108:110], np.float16)[0].astype(
+        np.float32
+    )
+    # ggml unpacks via the aux[] uint32 mask dance; transliterate it
+    aux = list(np.frombuffer(raw_sc.tobytes(), np.uint32))
+    km1, km2 = 0x03030303, 0x0F0F0F0F
+    tmp = aux[2]
+    out_aux = [
+        (aux[0] & km2) | (((tmp >> 0) & km1) << 4),
+        (aux[1] & km2) | (((tmp >> 2) & km1) << 4),
+        ((aux[0] >> 4) & km2) | (((tmp >> 4) & km1) << 4),
+        ((aux[1] >> 4) & km2) | (((tmp >> 6) & km1) << 4),
+    ]
+    scales = np.array(out_aux, np.uint32).view(np.int8)
+    y = np.zeros(256, np.float32)
+    yi, is_, qoff, m = 0, 0, 0, 1
+    for _n in range(0, 256, 128):
+        shift = 0
+        for _j in range(4):
+            dl = d_all * (scales[is_] - 32)
+            is_ += 1
+            for l in range(16):
+                val = int((qs[qoff + l] >> shift) & 3)
+                if not (hmask[l] & m):
+                    val -= 4
+                y[yi] = dl * val
+                yi += 1
+            dl = d_all * (scales[is_] - 32)
+            is_ += 1
+            for l in range(16):
+                val = int((qs[qoff + l + 16] >> shift) & 3)
+                if not (hmask[l + 16] & m):
+                    val -= 4
+                y[yi] = dl * val
+                yi += 1
+            shift += 2
+            m <<= 1
+        qoff += 32
+    return y
+
+
+def _ref_q5_0(block):
+    d = np.frombuffer(block[0:2], np.float16)[0].astype(np.float32)
+    qh = int(np.frombuffer(block[2:6].tobytes(), np.uint32)[0])
+    qs = block[6:22]
+    y = np.zeros(32, np.float32)
+    for j in range(16):
+        x0 = int((qs[j] & 0x0F) | (((qh >> j) & 1) << 4)) - 16
+        x1 = int((qs[j] >> 4) | (((qh >> (j + 16)) & 1) << 4)) - 16
+        y[j] = x0 * d
+        y[j + 16] = x1 * d
+    return y
+
+
+def _ref_q5_1(block):
+    d = np.frombuffer(block[0:2], np.float16)[0].astype(np.float32)
+    m = np.frombuffer(block[2:4], np.float16)[0].astype(np.float32)
+    qh = int(np.frombuffer(block[4:8].tobytes(), np.uint32)[0])
+    qs = block[8:24]
+    y = np.zeros(32, np.float32)
+    for j in range(16):
+        x0 = int((qs[j] & 0x0F) | (((qh >> j) & 1) << 4))
+        x1 = int((qs[j] >> 4) | (((qh >> (j + 16)) & 1) << 4))
+        y[j] = x0 * d + m
+        y[j + 16] = x1 * d + m
+    return y
+
+
+def _rand_blocks(rng, n, nbytes, f16_at):
+    """Random valid blocks: random q/scale bytes, controlled f16 scale
+    fields (random bytes can encode NaN/Inf f16s)."""
+    blocks = rng.integers(0, 256, (n, nbytes), dtype=np.uint8)
+    for col in f16_at:
+        vals = rng.uniform(-0.1, 0.1, n).astype(np.float16)
+        blocks[:, col: col + 2] = vals[:, None].view(np.uint8)
+    return blocks
+
+
+@pytest.mark.parametrize("gtype,nbytes,f16_at,ref", [
+    (10, 84, (80, 82), _ref_q2_k),
+    (11, 110, (108,), _ref_q3_k),
+    (12, 144, (0, 2), _ref_q4_k),
+    (13, 176, (0, 2), _ref_q5_k),
+    (14, 210, (208,), _ref_q6_k),
+    (6, 22, (0,), _ref_q5_0),
+    (7, 24, (0, 2), _ref_q5_1),
+])
+def test_kquant_dequant_matches_ggml_reference(gtype, nbytes, f16_at, ref):
+    from gpustack_tpu.engine.gguf import _dequantize
+
+    rng = np.random.default_rng(gtype)
+    n = 8
+    elems = 32 if gtype in (6, 7) else 256
+    blocks = _rand_blocks(rng, n, nbytes, f16_at)
+    got = _dequantize(
+        "t", blocks.reshape(-1), (n * elems,), gtype
+    ).reshape(n, elems)
+    want = np.stack([ref(blocks[i]) for i in range(n)])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# K-quant file round-trip: quantize → write → load → serve tolerance
+# ---------------------------------------------------------------------------
+
+GGML_Q4_K, GGML_Q6_K = 12, 14
+
+
+def _pack_k_scales(sc, mn):
+    """Inverse of get_scale_min_k4: 8 six-bit (scale, min) pairs → 12B."""
+    out = np.zeros(12, np.uint8)
+    for j in range(4):
+        out[j] = (sc[j] & 63) | ((sc[j + 4] >> 4) << 6)
+        out[j + 4] = (mn[j] & 63) | ((mn[j + 4] >> 4) << 6)
+        out[j + 8] = (sc[j + 4] & 0xF) | ((mn[j + 4] & 0xF) << 4)
+    return out
+
+
+def _quantize_q4_k(arr: np.ndarray) -> bytes:
+    out = b""
+    for block in arr.reshape(-1, 256).astype(np.float32):
+        subs = block.reshape(8, 32)
+        vmin = np.minimum(subs.min(axis=1), 0.0)
+        vmax = np.maximum(subs.max(axis=1), 0.0)
+        sc_f = (vmax - vmin) / 15.0
+        mn_f = -vmin
+        d = float(sc_f.max()) / 63.0 or 1e-8
+        dmin = float(mn_f.max()) / 63.0 or 1e-8
+        d16, dmin16 = np.float16(d), np.float16(dmin)
+        d, dmin = float(d16), float(dmin16)
+        sc = np.clip(np.round(sc_f / d), 0, 63).astype(np.uint8)
+        mn = np.clip(np.round(mn_f / dmin), 0, 63).astype(np.uint8)
+        q = np.zeros((8, 32), np.uint8)
+        for j in range(8):
+            step = d * sc[j] or 1e-8
+            q[j] = np.clip(
+                np.round((subs[j] + dmin * mn[j]) / step), 0, 15
+            )
+        qs = np.zeros(128, np.uint8)
+        for c in range(4):
+            qs[32 * c: 32 * c + 32] = q[2 * c] | (q[2 * c + 1] << 4)
+        out += (
+            d16.tobytes() + dmin16.tobytes()
+            + _pack_k_scales(sc, mn).tobytes() + qs.tobytes()
+        )
+    return out
+
+
+def _quantize_q6_k(arr: np.ndarray) -> bytes:
+    out = b""
+    for block in arr.reshape(-1, 256).astype(np.float32):
+        subs = block.reshape(16, 16)
+        s_f = np.abs(subs).max(axis=1) / 31.0
+        d = float(np.float16(s_f.max() / 127.0 or 1e-8))
+        sc = np.clip(np.round(s_f / (d or 1e-8)), -128, 127).astype(
+            np.int8
+        )
+        q = np.zeros((16, 16), np.int32)
+        for j in range(16):
+            step = d * int(sc[j]) or 1e-8
+            q[j] = np.clip(np.round(subs[j] / step), -32, 31)
+        q6 = (q.reshape(256) + 32).astype(np.uint8)   # 6-bit 0..63
+        ql = np.zeros(128, np.uint8)
+        qh = np.zeros(64, np.uint8)
+        for half in range(2):
+            v = q6[128 * half: 128 * half + 128]
+            v1, v2, v3, v4 = v[:32], v[32:64], v[64:96], v[96:128]
+            ql[64 * half: 64 * half + 32] = (v1 & 0xF) | ((v3 & 0xF) << 4)
+            ql[64 * half + 32: 64 * half + 64] = (
+                (v2 & 0xF) | ((v4 & 0xF) << 4)
+            )
+            qh[32 * half: 32 * half + 32] = (
+                (v1 >> 4) | ((v2 >> 4) << 2)
+                | ((v3 >> 4) << 4) | ((v4 >> 4) << 6)
+            )
+        out += (
+            ql.tobytes() + qh.tobytes() + sc.tobytes()
+            + np.float16(d).tobytes()
+        )
+    return out
+
+
+def test_q4k_q6k_file_roundtrip_within_tolerance(tmp_path):
+    """A Q4_K/Q6_K export of the tiny model loads and its logits track
+    the F32 weights within quantization tolerance (verdict r4 #2)."""
+    from gpustack_tpu.engine.gguf import _dequantize
+
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((4, 256)).astype(np.float32) * 0.1
+    q4 = np.frombuffer(_quantize_q4_k(w), np.uint8)
+    deq = _dequantize("t", q4, w.shape, GGML_Q4_K)
+    assert np.max(np.abs(deq - w)) < 0.05          # ~4-bit step
+    q6 = np.frombuffer(_quantize_q6_k(w), np.uint8)
+    deq6 = _dequantize("t", q6, w.shape, GGML_Q6_K)
+    assert np.max(np.abs(deq6 - w)) < 0.012        # ~6-bit step
+    assert np.mean(np.abs(deq6 - w)) < np.mean(np.abs(deq - w))
+
+
+def test_engine_serves_q4k_gguf(tmp_path):
+    """Full path: a Q4_K-quantized GGUF loads through load_gguf_tensors
+    and the model's logits stay close to the F32 weights'."""
+    import jax.numpy as jnp
+
+    from gpustack_tpu.engine.weights import load_or_init_params
+    from gpustack_tpu.models import forward
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    path = str(model_dir / "q4k.gguf")
+    written = _tiny_gguf_kquant(path)
+    cfg = config_from_gguf(path, name="q4k")
+    params = load_or_init_params(cfg, str(model_dir))
+
+    # f32 oracle via the same writer without quantization
+    f32_dir = tmp_path / "f"
+    f32_dir.mkdir()
+    f32_path = str(f32_dir / "f32.gguf")
+    _tiny_gguf(f32_path)
+    params_f32 = load_or_init_params(cfg, str(f32_dir))
+
+    toks = jnp.asarray([[5, 9, 33, 7]], jnp.int32)
+    pos = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    lq, _ = forward(params, cfg, toks, pos)
+    lf, _ = forward(params_f32, cfg, toks, pos)
+    # same architecture, quantized weights: logits correlate strongly
+    a, b = np.asarray(lq).ravel(), np.asarray(lf).ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert corr > 0.98
+    assert written  # fixture exercised
+
+
+def _tiny_gguf_kquant(path):
+    """The _tiny_gguf model with attention/MLP weights in Q4_K/Q6_K
+    (dims here are multiples of 256 where quantized)."""
+    rng = np.random.default_rng(7)
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32) * 0.05
+
+    tensors = {
+        "token_embd.weight": (w(V, D), GGML_F32),
+        "output_norm.weight": (np.ones(D, np.float32), GGML_F32),
+        "output.weight": (w(V, D), GGML_F16),
+    }
+    for i in range(L):
+        wq, wk = w(H * HD, D), w(KV * HD, D)
+        tensors.update({
+            f"blk.{i}.attn_norm.weight": (np.ones(D, np.float32), GGML_F32),
+            f"blk.{i}.attn_q.weight": (wq, GGML_Q4_K),
+            f"blk.{i}.attn_k.weight": (wk, GGML_Q6_K),
+            f"blk.{i}.attn_v.weight": (w(KV * HD, D), GGML_F32),
+            f"blk.{i}.attn_output.weight": (w(D, H * HD), GGML_F32),
+            f"blk.{i}.ffn_norm.weight": (np.ones(D, np.float32), GGML_F32),
+            f"blk.{i}.ffn_gate.weight": (w(I, D), GGML_Q4_K),
+            f"blk.{i}.ffn_up.weight": (w(I, D), GGML_F32),
+            f"blk.{i}.ffn_down.weight": (w(D, I), GGML_F32),
+        })
+    vocab = (
+        ["<unk>", "<s>", "</s>"]
+        + [f"<0x{b:02X}>" for b in range(256)]
+        + ["▁hello", "▁world", "lo", "▁he"]
+    )
+    metadata = {
+        "general.architecture": "llama",
+        "general.alignment": 32,
+        "llama.block_count": L,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": KV,
+        "llama.context_length": 256,
+        "llama.rope.freq_base": 10000.0,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.vocab_size": V,
+        "tokenizer.ggml.tokens": vocab,
+        "tokenizer.ggml.eos_token_id": 2,
+        "tokenizer.ggml.bos_token_id": 1,
+    }
+    on_disk = dict(tensors)
+    for key, (arr, gtype) in tensors.items():
+        if key.endswith("attn_q.weight"):
+            on_disk[key] = (_llama_permute(arr, H), gtype)
+        elif key.endswith("attn_k.weight"):
+            on_disk[key] = (_llama_permute(arr, KV), gtype)
+    write_gguf(path, metadata, on_disk)
+    return tensors
+
+
+# ---------------------------------------------------------------------------
+# split-file checkpoints (gguf-split layout)
+# ---------------------------------------------------------------------------
+
+
+def _split_tiny_gguf(tmp_path):
+    """Write the tiny model as a 2-shard gguf-split checkpoint."""
+    written = {}
+    full = str(tmp_path / "whole.gguf")
+    written = _tiny_gguf(full)
+    names = list(written)
+    half = len(names) // 2
+    base_meta = {
+        "general.architecture": "llama",
+        "general.alignment": 32,
+        "llama.block_count": L,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": KV,
+        "llama.context_length": 256,
+        "llama.rope.freq_base": 10000.0,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.vocab_size": V,
+        "tokenizer.ggml.tokens": ["<unk>", "<s>", "</s>"],
+        "tokenizer.ggml.eos_token_id": 2,
+        "split.count": 2,
+        "split.no": 0,
+    }
+    # re-read the on-disk (permuted) tensors so shards carry exactly
+    # what a straight file-split would
+    from gpustack_tpu.engine.gguf import (
+        _dequantize as _dq,
+        _type_bytes as _tb,
+        read_gguf as _rg,
+    )
+
+    _, infos, data_start, raw = _rg(full)
+    buf = np.frombuffer(raw, np.uint8)
+    disk = {}
+    for name, shape, gtype, offset in infos:
+        start = data_start + offset
+        disk[name] = (
+            _dq(name, buf[start: start + _tb(shape, gtype)], shape,
+                gtype).copy(),
+            GGML_F32,
+        )
+    p1 = str(tmp_path / "tiny-00001-of-00002.gguf")
+    p2 = str(tmp_path / "tiny-00002-of-00002.gguf")
+    write_gguf(p1, base_meta, {n: disk[n] for n in names[:half]})
+    meta2 = {
+        "general.architecture": "llama",
+        "split.count": 2, "split.no": 1,
+    }
+    write_gguf(p2, meta2, {n: disk[n] for n in names[half:]})
+    os.remove(full)
+    return p1, p2, written
+
+
+def test_split_gguf_loads_all_shards(tmp_path):
+    from gpustack_tpu.engine.gguf import gguf_shard_paths
+
+    p1, p2, written = _split_tiny_gguf(tmp_path)
+    assert gguf_shard_paths(p1) == [p1, p2]
+    tensors = load_gguf_tensors(p1)
+    # tensors from BOTH shards present (writer splits mid-list)
+    assert "model.embed_tokens.weight" in tensors
+    assert f"model.layers.{L-1}.mlp.down_proj.weight" in tensors
+    got = tensors[f"model.layers.{L-1}.mlp.down_proj.weight"].numpy()
+    np.testing.assert_allclose(
+        got, written[f"blk.{L-1}.ffn_down.weight"][0], atol=1e-6
+    )
+    # the llama q/k un-permute must apply to tensors in LATER shards
+    # too, whose own metadata (per gguf-split) carries no head_count —
+    # arch metadata comes from shard 1 only
+    got_q = tensors[f"model.layers.{L-1}.self_attn.q_proj.weight"].numpy()
+    np.testing.assert_allclose(
+        got_q, written[f"blk.{L-1}.attn_q.weight"][0], atol=1e-6
+    )
+    # config sees whole-checkpoint tensor presence across both shards
+    # (output.weight present → untied embeddings)
+    cfg = config_from_gguf(p1)
+    assert cfg.tie_word_embeddings is False
+    # directory resolution picks shard 1 first
+    assert gguf_file_in(str(tmp_path)) == p1
+
+
+def test_split_gguf_missing_shard_is_loud(tmp_path):
+    from gpustack_tpu.engine.gguf import gguf_shard_paths
+
+    p1, p2, _ = _split_tiny_gguf(tmp_path)
+    os.remove(p2)
+    with pytest.raises(ValueError, match="missing shard"):
+        gguf_shard_paths(p1)
+
+
+# ---------------------------------------------------------------------------
+# rope scaling metadata (advisor r4: ignoring it serves long prompts
+# with unscaled RoPE — silently wrong)
+# ---------------------------------------------------------------------------
+
+
+def test_gguf_yarn_metadata_reaches_config(tmp_path):
+    path2 = str(tmp_path / "yarn2.gguf")
+    rng = np.random.default_rng(0)
+    write_gguf(path2, {
+        "general.architecture": "llama",
+        "llama.block_count": 1,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.context_length": 4096,
+        "llama.rope.scaling.type": "yarn",
+        "llama.rope.scaling.factor": 8.0,
+        "llama.rope.scaling.original_context_length": 512,
+    }, {"token_embd.weight": (
+        rng.standard_normal((V, D)).astype(np.float32), GGML_F32)})
+    cfg = config_from_gguf(path2)
+    assert cfg.rope_scaling == {
+        "rope_type": "yarn", "factor": 8.0,
+        "original_max_position_embeddings": 512,
+    }
+    # the transformer accepts it (attention factor > 1 for factor > 1)
+    from gpustack_tpu.models.transformer import rope_params
+
+    inv, att = rope_params(cfg)
+    assert att > 1.0
+
+
+def test_gguf_rope_freqs_tensor_reaches_config(tmp_path):
+    """Llama-3.1-class exports carry rope scaling as a rope_freqs.weight
+    divisor tensor; the config must pick it up and rope_params must
+    divide by it."""
+    path = str(tmp_path / "l31.gguf")
+    rng = np.random.default_rng(1)
+    factors = np.linspace(1.0, 8.0, HD // 2).astype(np.float32)
+    write_gguf(path, {
+        "general.architecture": "llama",
+        "llama.block_count": 1,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.attention.head_count_kv": KV,
+        "llama.context_length": 131072,
+        "llama.rope.freq_base": 500000.0,
+    }, {
+        "token_embd.weight": (
+            rng.standard_normal((V, D)).astype(np.float32), GGML_F32),
+        "rope_freqs.weight": (factors, GGML_F32),
+    })
+    cfg = config_from_gguf(path)
+    assert cfg.rope_scaling is not None
+    np.testing.assert_allclose(cfg.rope_scaling["factors"], factors)
+
+    from gpustack_tpu.models.transformer import _inv_freq, rope_params
+
+    inv, att = rope_params(cfg)
+    base = np.asarray(_inv_freq(cfg.rope_theta, cfg.head_dim))
+    np.testing.assert_allclose(
+        np.asarray(inv), base / factors, rtol=1e-6
+    )
+    assert att == 1.0
+    # weight loading still skips the factors tensor
+    tensors = load_gguf_tensors(path)
+    assert "rope_freqs.weight" not in tensors
+
+
+def test_gguf_unknown_rope_scaling_rejected(tmp_path):
+    path = str(tmp_path / "bad_rope.gguf")
+    rng = np.random.default_rng(2)
+    write_gguf(path, {
+        "general.architecture": "llama",
+        "llama.block_count": 1,
+        "llama.embedding_length": D,
+        "llama.feed_forward_length": I,
+        "llama.attention.head_count": H,
+        "llama.context_length": 4096,
+        "llama.rope.scaling.type": "su",
+    }, {"token_embd.weight": (
+        rng.standard_normal((V, D)).astype(np.float32), GGML_F32)})
+    with pytest.raises(ValueError, match="rope scaling"):
+        config_from_gguf(path)

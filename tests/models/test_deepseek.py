@@ -162,13 +162,21 @@ def test_deepseek_v3_logits_match_transformers(v3_checkpoint):
 
 @pytest.fixture(scope="module")
 def yarn_checkpoint(tmp_path_factory):
-    """V2 with the YaRN scaling real DeepSeek checkpoints ship —
-    mscale != mscale_all_dim so the attention factor is exercised."""
+    """V3 with the YaRN scaling real DeepSeek checkpoints ship.
+
+    The oracle is DeepseekV3 (not V2): transformers' integrated V2 port
+    omits the original code's softmax-scale correction
+    (yarn_get_mscale(factor, mscale_all_dim)^2 — modeling_deepseek_v2
+    remote code / vLLM deepseek_v2.py), while its V3 port applies it
+    (modeling_deepseek_v3 DeepseekV3Attention.__init__). We follow the
+    original/vLLM behavior for BOTH families, so V3 is the family where
+    an HF parity check is meaningful. mscale != mscale_all_dim so the
+    sin/cos attention factor is exercised too."""
     torch = pytest.importorskip("torch")
     tfm = pytest.importorskip("transformers")
 
     torch.manual_seed(2)
-    hf_cfg = tfm.DeepseekV2Config(
+    hf_cfg = tfm.DeepseekV3Config(
         vocab_size=128,
         hidden_size=32,
         intermediate_size=64,
@@ -185,8 +193,12 @@ def yarn_checkpoint(tmp_path_factory):
         qk_nope_head_dim=8,
         v_head_dim=8,
         num_experts_per_tok=2,
+        n_group=1,
+        topk_group=1,
         first_k_dense_replace=0,
         norm_topk_prob=False,
+        scoring_func="sigmoid",
+        topk_method="noaux_tc",
         max_position_embeddings=640,
         rope_theta=10000.0,
         rope_scaling={
@@ -202,7 +214,7 @@ def yarn_checkpoint(tmp_path_factory):
         attention_dropout=0.0,
         attention_bias=False,
     )
-    model = tfm.DeepseekV2ForCausalLM(hf_cfg).eval()
+    model = tfm.DeepseekV3ForCausalLM(hf_cfg).eval()
     d = tmp_path_factory.mktemp("dsyarn")
     model.save_pretrained(d, safe_serialization=True)
     return model, str(d)
@@ -210,7 +222,8 @@ def yarn_checkpoint(tmp_path_factory):
 
 def test_deepseek_yarn_rope_matches_transformers(yarn_checkpoint):
     """Positions PAST the original context window: yarn frequency
-    blending + the mscale attention factor must both match HF."""
+    blending + the mscale attention factor + the mscale^2 softmax-scale
+    correction must all match HF's V3 port."""
     torch = pytest.importorskip("torch")
     model, model_dir = yarn_checkpoint
     # 8 tokens starting deep past original_max_position_embeddings=64
@@ -244,6 +257,18 @@ def test_deepseek_yarn_rope_matches_transformers(yarn_checkpoint):
     np.testing.assert_allclose(
         np.asarray(ours), ref, atol=5e-3, rtol=2e-2
     )
+
+
+def test_yarn_mscale_softmax_correction_value():
+    """The V2/V3 shipped configs (factor=40, mscale_all_dim=0.707) imply
+    a ~1.59x softmax-scale correction; pin the math so a regression back
+    to HF-V2's missing-correction behavior is loud."""
+    from gpustack_tpu.models.transformer import yarn_get_mscale
+
+    m = yarn_get_mscale(40.0, 0.707)
+    np.testing.assert_allclose(m * m, 1.5896, rtol=1e-3)
+    # below the original window no correction applies
+    assert yarn_get_mscale(0.5, 0.707) == 1.0
 
 
 def test_group_routing_rejected():
