@@ -783,10 +783,24 @@ class OpenAIServer:
 
         # merge the per-choice token queues into one ordered SSE stream
         merged: asyncio.Queue = asyncio.Queue()
+        _empty = object()
+
+        def _bounded_get(gen: GenRequest):
+            # a plain .get() would pin its executor thread until the
+            # engine produces a token — uncancellable after a client
+            # disconnect; bound it so threads notice the abort promptly
+            try:
+                return gen.stream.get(timeout=0.5)
+            except queue.Empty:
+                return _empty
 
         async def pump(i: int, gen: GenRequest) -> None:
             while True:
-                item = await loop.run_in_executor(None, gen.stream.get)
+                item = await loop.run_in_executor(None, _bounded_get, gen)
+                if item is _empty:
+                    if gen.aborted.is_set():
+                        return
+                    continue
                 await merged.put((i, item))
                 if item is None:
                     return
@@ -814,6 +828,14 @@ class OpenAIServer:
                         i, {"content": piece} if chat else piece
                     ))
         finally:
+            # On a client disconnect resp.write raises mid-loop; abort
+            # the in-flight generations so the engine frees the slots at
+            # its next delivery instead of decoding to max_tokens for
+            # nobody. (Completed requests are already finished — setting
+            # the flag then is a no-op.) The bounded queue.get above lets
+            # the executor threads drain within ~0.5 s.
+            for gen in gens:
+                gen.abort()
             for p in pumps:
                 p.cancel()
 

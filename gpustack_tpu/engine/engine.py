@@ -167,9 +167,18 @@ class GenRequest:
     )
     finish_reason: str = ""
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    # client gone (SSE disconnect, proxy timeout): the engine stops
+    # generating for this request at its next delivery instead of
+    # burning the slot to max_tokens (advisor r4)
+    aborted: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
     submitted_at: float = 0.0
     first_token_at: float = 0.0
     finished_at: float = 0.0
+
+    def abort(self) -> None:
+        self.aborted.set()
 
     @property
     def ttft_ms(self) -> float:
@@ -545,6 +554,12 @@ class LLMEngine:
             return False
         slot = next(iter(self._chunk_jobs))
         job = self._chunk_jobs[slot]
+        if job.req.aborted.is_set():
+            # abandon the remaining chunks; the slot never activated
+            del self._chunk_jobs[slot]
+            self._free.append(slot)
+            self._finish_aborted(job.req)
+            return True
         start = job.done
         chunk = job.ids[start : start + self.prefill_chunk]
         if start == 0:
@@ -586,10 +601,23 @@ class LLMEngine:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 break
+            if req.aborted.is_set():
+                # client gone while queued: never spend a prefill on it
+                self._finish_aborted(req)
+                continue
             slot = self._free.pop(0)
             self._start_request(slot, req)
             admitted = True
         return admitted
+
+    def _finish_aborted(self, req: GenRequest) -> None:
+        """Terminal bookkeeping for a request aborted before it owned a
+        slot (queued, or mid-chunked-prefill)."""
+        req.finish_reason = "abort"
+        req.finished_at = time.time()
+        if req.stream is not None:
+            req.stream.put(None)
+        req.done.set()
 
     def _start_request(self, slot: int, req: GenRequest) -> None:
         import jax.numpy as jnp
@@ -941,6 +969,11 @@ class LLMEngine:
         """Deliver newly generated tokens (``lps``: optional aligned list
         of (token_logprob, [(id, logprob) alternatives]))."""
         req = info.request
+        if req.aborted.is_set():
+            # client disconnected mid-generation: free the slot now
+            # instead of decoding to max_tokens for nobody
+            self._finish(slot, info, "abort")
+            return
         for j, tok in enumerate(toks):
             is_eos = tok in self.tokenizer.eos_ids or tok in req.stop_ids
             if not is_eos:

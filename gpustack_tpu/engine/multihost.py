@@ -55,11 +55,38 @@ def _key_from_list(data: List[int]):
     return jax.random.wrap_key_data(jnp.asarray(data, jnp.uint32))
 
 
-class CommandLeader:
-    """Leader side: accepts follower connections, broadcasts op lines."""
+def channel_token() -> str:
+    """Shared command-channel auth token for this replica.
 
-    def __init__(self, port: int, n_followers: int, host: str = "0.0.0.0"):
+    GPUSTACK_TPU_CMD_TOKEN is injected into every process of a
+    multi-host placement by the worker (worker/backends.py) — leader and
+    followers therefore derive the SAME value with no extra rendezvous.
+    Empty means auth is disabled (hand-launched processes without the
+    env; the e2e tests always set it)."""
+    import os
+
+    return os.environ.get("GPUSTACK_TPU_CMD_TOKEN", "")
+
+
+class CommandLeader:
+    """Leader side: accepts follower connections, broadcasts op lines.
+
+    Connections must open with ``AUTH <token>\\n`` (advisor r4: the
+    channel carries every request's prompt token ids, and an
+    unauthenticated early connection could permanently consume a
+    follower slot, wedging the replica until the broadcast timeout).
+    Failed handshakes are closed WITHOUT counting toward n_followers and
+    the accept loop keeps going, so a port-scanner can't starve the real
+    followers out of the rendezvous."""
+
+    _HANDSHAKE_TIMEOUT_S = 10.0
+
+    def __init__(
+        self, port: int, n_followers: int, host: str = "0.0.0.0",
+        token: Optional[str] = None,
+    ):
         self.n_followers = n_followers
+        self.token = channel_token() if token is None else token
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
         self._ready = threading.Event()
@@ -71,22 +98,54 @@ class CommandLeader:
             target=self._accept_loop, name="mh-accept", daemon=True
         ).start()
 
+    def _handshake(self, conn: socket.socket, addr) -> None:
+        """Admit ``conn`` iff its first line is the right AUTH; runs in
+        its own thread so a stalled client can't block the accept loop."""
+        try:
+            conn.settimeout(self._HANDSHAKE_TIMEOUT_S)
+            buf = b""
+            while b"\n" not in buf and len(buf) < 512:
+                chunk = conn.recv(256)
+                if not chunk:
+                    break
+                buf += chunk
+            line = buf.split(b"\n", 1)[0].decode(errors="replace").strip()
+            # .strip() both sides: with auth disabled (empty token) the
+            # follower sends "AUTH \n" which strips to "AUTH"
+            if line != f"AUTH {self.token}".strip():
+                logger.warning(
+                    "rejecting command-channel connection from %s "
+                    "(bad handshake)", addr,
+                )
+                conn.close()
+                return
+            conn.settimeout(None)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        logger.info("follower connected from %s", addr)
+        with self._lock:
+            if len(self._conns) >= self.n_followers:
+                conn.close()            # late duplicate
+                return
+            self._conns.append(conn)
+            if len(self._conns) >= self.n_followers:
+                self._ready.set()
+
     def _accept_loop(self) -> None:
-        while True:
-            with self._lock:
-                if len(self._conns) >= self.n_followers:
-                    self._ready.set()
-                    return
+        while not self._ready.is_set():
             try:
                 conn, addr = self._srv.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            logger.info("follower connected from %s", addr)
-            with self._lock:
-                self._conns.append(conn)
-                if len(self._conns) >= self.n_followers:
-                    self._ready.set()
+            threading.Thread(
+                target=self._handshake, args=(conn, addr),
+                name="mh-handshake", daemon=True,
+            ).start()
 
     def broadcast(self, op: Dict[str, Any]) -> None:
         """Send one op to every follower; blocks until all are connected
@@ -229,9 +288,13 @@ class FollowerLoop:
     for liveness but receives no inference traffic (the server proxies
     only to the leader's port)."""
 
-    def __init__(self, runner, cmd_address: str, state):
+    def __init__(
+        self, runner, cmd_address: str, state,
+        token: Optional[str] = None,
+    ):
         self.runner = runner
         self.cmd_address = cmd_address
+        self.token = channel_token() if token is None else token
         # REUSE the engine's already-created DecodeState: device_put over
         # a global mesh is a collective (it allgathers a shape/sharding
         # consistency check), so creating a second state here — a call
@@ -260,6 +323,7 @@ class FollowerLoop:
             try:
                 sock = socket.create_connection((host, int(port)), 5.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(f"AUTH {self.token}\n".encode())
                 # the 5s connect timeout must NOT persist into recv() —
                 # an idle serving replica legitimately sends no commands
                 # for long stretches; use a poll-sized timeout so the

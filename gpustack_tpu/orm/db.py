@@ -21,8 +21,13 @@ logger = logging.getLogger(__name__)
 class Database:
     """One sqlite file (or ':memory:') + a writer thread + migrations."""
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", dialect: str = "sqlite"):
+        from gpustack_tpu.orm.sql import DIALECTS
+
+        if dialect not in DIALECTS:
+            raise ValueError(f"unknown SQL dialect {dialect!r}")
         self.path = path
+        self.dialect = dialect
         self._work: "queue.Queue[Optional[Tuple[Callable, asyncio.Future, asyncio.AbstractEventLoop]]]" = (
             queue.Queue()
         )
@@ -64,6 +69,23 @@ class Database:
     def _set_exc(fut: asyncio.Future, exc: Exception) -> None:
         if not fut.cancelled():
             fut.set_exception(exc)
+
+    # ---- dialect-bound SQL fragments ------------------------------------
+    # Query code MUST use these (not orm.sql's module functions with
+    # their sqlite default) so the active connection's dialect reaches
+    # every call site — advisor r4: the default-dialect shortcut left
+    # the abstraction unwired and a postgres/mysql deployment's usage
+    # queries would all mis-spell.
+
+    def json_num(self, field: str, col: str = "data") -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.json_num(field, col, self.dialect)
+
+    def json_text(self, field: str, col: str = "data") -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.json_text(field, col, self.dialect)
 
     # ---- async API ------------------------------------------------------
 

@@ -14,7 +14,6 @@ import logging
 
 from aiohttp import web
 
-from gpustack_tpu.orm.sql import json_num, json_text
 from gpustack_tpu.routes.crud import json_error
 from gpustack_tpu.scheduler.calculator import (
     EvaluationError,
@@ -161,19 +160,20 @@ def add_extra_routes(app: web.Application) -> None:
         scope, params, err = _principal_scope(request)
         if err is not None:
             return err
-        rows = await Record.db().execute(
+        db = Record.db()
+        rows = await db.execute(
             "SELECT route_name AS route, "
             "COUNT(*) AS requests, "
-            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) AS pt, "
-            f"COALESCE(SUM({json_num('completion_tokens')}), 0) "
+            f"COALESCE(SUM({db.json_num('prompt_tokens')}), 0) AS pt, "
+            f"COALESCE(SUM({db.json_num('completion_tokens')}), 0) "
             "AS ct "
             f"FROM model_usage WHERE 1=1{scope} "
             "GROUP BY route_name ORDER BY requests DESC",
             params,
         )
-        by_user = await Record.db().execute(
+        by_user = await db.execute(
             "SELECT user_id, COUNT(*) AS requests, "
-            f"COALESCE(SUM({json_num('total_tokens')}), 0) AS tok "
+            f"COALESCE(SUM({db.json_num('total_tokens')}), 0) AS tok "
             f"FROM model_usage WHERE 1=1{scope} GROUP BY user_id",
             params,
         )
@@ -314,18 +314,19 @@ def add_extra_routes(app: web.Application) -> None:
         width = 13 if bucket == "hour" else 10
         route = request.query.get("route", "")
         route_clause = " AND route_name = ?" if route else ""
+        db = Record.db()
         q = (
             f"SELECT SUBSTR(created_at, 1, {width}) AS ts, "
             "route_name AS route, COUNT(*) AS requests, "
-            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) "
+            f"COALESCE(SUM({db.json_num('prompt_tokens')}), 0) "
             "AS pt, "
-            f"COALESCE(SUM({json_num('completion_tokens')}), 0)"
+            f"COALESCE(SUM({db.json_num('completion_tokens')}), 0)"
             " AS ct "
             "FROM model_usage WHERE created_at >= ?"
             f"{scope}{route_clause} "
             "GROUP BY ts, route_name ORDER BY ts"
         )
-        rows = await Record.db().execute(
+        rows = await db.execute(
             q, [cutoff] + params + ([route] if route else [])
         )
         return web.json_response({
@@ -359,13 +360,14 @@ def add_extra_routes(app: web.Application) -> None:
         except ValueError:
             return json_error(400, "'limit' must be an integer")
         limit = max(1, min(100, limit))
-        rows = await Record.db().execute(
+        db = Record.db()
+        rows = await db.execute(
             "SELECT route_name AS route, COUNT(*) AS requests, "
-            f"COALESCE(SUM({json_num('total_tokens')}), 0) "
+            f"COALESCE(SUM({db.json_num('total_tokens')}), 0) "
             "AS tok, "
-            f"COALESCE(SUM({json_num('prompt_tokens')}), 0) "
+            f"COALESCE(SUM({db.json_num('prompt_tokens')}), 0) "
             "AS pt, "
-            f"COALESCE(SUM({json_num('completion_tokens')}), 0)"
+            f"COALESCE(SUM({db.json_num('completion_tokens')}), 0)"
             " AS ct "
             "FROM model_usage WHERE created_at >= ?"
             f"{scope} "
@@ -397,11 +399,12 @@ def add_extra_routes(app: web.Application) -> None:
         cutoff, err = _window(request)
         if err is not None:
             return err
-        rows = await Record.db().execute(
+        db = Record.db()
+        rows = await db.execute(
             "SELECT user_id, "
-            f"{json_text('operation')} AS op, "
+            f"{db.json_text('operation')} AS op, "
             "COUNT(*) AS requests, "
-            f"COALESCE(SUM({json_num('total_tokens')}), 0) "
+            f"COALESCE(SUM({db.json_num('total_tokens')}), 0) "
             "AS tok "
             "FROM model_usage WHERE created_at >= ? "
             "GROUP BY user_id, op ORDER BY tok DESC",

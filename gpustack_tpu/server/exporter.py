@@ -11,7 +11,6 @@ from typing import List
 
 from aiohttp import web
 
-from gpustack_tpu.orm.sql import json_num
 from gpustack_tpu.schemas import (
     Model,
     ModelInstance,
@@ -78,9 +77,10 @@ class ServerExporter:
         # materialize it for a scrape
         from gpustack_tpu.orm.record import Record
 
-        rows = await Record.db().execute(
+        db = Record.db()
+        rows = await db.execute(
             "SELECT COUNT(*) AS n, "
-            f"COALESCE(SUM({json_num('total_tokens')}), 0) AS tok "
+            f"COALESCE(SUM({db.json_num('total_tokens')}), 0) AS tok "
             "FROM model_usage"
         )
         lines += [

@@ -35,17 +35,20 @@ def build_command(
     force_platform: str = "",
     process_index: int = 0,
     chip_indexes: Optional[List[int]] = None,
+    cluster_secret: str = "",
 ) -> Tuple[List[str], Dict[str, str]]:
     """Resolve (argv, extra_env) for this instance.
 
     ``process_index``/``chip_indexes`` select the leader (0, instance
     chips) or a subordinate host's follower process of a multi-host
-    replica.
+    replica. ``cluster_secret`` (the cluster registration token — shared
+    by every worker, unknown to API users and outsiders) keys the
+    derived multi-host command-channel auth token.
     """
     if model.backend in ("", "tpu-native"):
         return _tpu_native_command(
             model, instance, port, force_platform, process_index,
-            chip_indexes,
+            chip_indexes, cluster_secret,
         )
     if backend is None:
         raise ValueError(f"unknown backend {model.backend!r}")
@@ -112,6 +115,7 @@ def _tpu_native_command(
     force_platform: str,
     process_index: int = 0,
     chip_indexes: Optional[List[int]] = None,
+    cluster_secret: str = "",
 ) -> Tuple[List[str], Dict[str, str]]:
     if _is_audio_model(model):
         module = "gpustack_tpu.engine.audio_server"
@@ -216,6 +220,22 @@ def _tpu_native_command(
         host, _, cport = instance.coordinator_address.rpartition(":")
         env["GPUSTACK_TPU_COORDINATOR"] = instance.coordinator_address
         env["GPUSTACK_TPU_CMD_ADDRESS"] = f"{host}:{int(cport) + 1}"
+        # command-channel auth (engine/multihost.py channel_token):
+        # every worker of the placement derives the same value locally —
+        # no extra secret distribution — and the derivation is KEYED by
+        # the cluster registration token, which API users and outsiders
+        # never see, so the token is not computable from public instance
+        # metadata (instance ids are small integers, the channel port is
+        # coordinator+1 — both guessable on their own)
+        import hashlib as _hashlib
+
+        env.setdefault(
+            "GPUSTACK_TPU_CMD_TOKEN",
+            _hashlib.sha256(
+                f"{cluster_secret}:{instance.id}:"
+                f"{instance.coordinator_address}".encode()
+            ).hexdigest()[:32],
+        )
         env["GPUSTACK_TPU_NUM_PROCESSES"] = str(
             1 + len(instance.subordinate_workers)
         )

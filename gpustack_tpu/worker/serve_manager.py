@@ -250,6 +250,7 @@ class ServeManager:
                 force_platform=self.cfg.force_platform,
                 process_index=process_index,
                 chip_indexes=my_chips,
+                cluster_secret=self.cfg.registration_token,
             )
         except ValueError as e:
             if is_leader:

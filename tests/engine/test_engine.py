@@ -311,3 +311,48 @@ def test_quantized_engine_generates():
         assert len(req.output_ids) >= 1
     finally:
         eng.stop()
+
+
+def test_abort_frees_slot_mid_generation(engine):
+    """A client-side abort (SSE disconnect) terminates the request at
+    the engine's next delivery instead of decoding to max_tokens
+    (advisor r4): the slot frees and the stream gets its sentinel."""
+    import time as _time
+
+    q = queue.Queue()
+    req = engine.submit(GenRequest(
+        prompt_ids=[3, 9, 27], max_tokens=40, temperature=0.0,
+        stop_ids=(), stream=q,
+    ))
+    # wait for generation to actually start
+    first = q.get(timeout=120)
+    assert first is not None
+    req.abort()
+    assert req.done.wait(60), "aborted request never finished"
+    assert req.finish_reason == "abort"
+    assert len(req.output_ids) < 40
+    # the sentinel still arrives so pumps unblock
+    deadline = _time.time() + 30
+    saw_sentinel = False
+    while _time.time() < deadline:
+        item = q.get(timeout=30)
+        if item is None:
+            saw_sentinel = True
+            break
+    assert saw_sentinel
+    # slot is free again: a fresh request completes
+    req2 = engine.generate(
+        GenRequest(prompt_ids=[5, 1], max_tokens=2, temperature=0.0),
+        timeout=120,
+    )
+    assert len(req2.output_ids) >= 1
+
+
+def test_abort_while_queued_never_prefills(engine):
+    """Aborting before admission skips the slot entirely."""
+    req = GenRequest(prompt_ids=[8, 8, 8], max_tokens=4, temperature=0.0)
+    req.abort()
+    engine.submit(req)
+    assert req.done.wait(60)
+    assert req.finish_reason == "abort"
+    assert req.output_ids == []

@@ -1,0 +1,128 @@
+"""Multi-host command channel: auth handshake + rendezvous hygiene.
+
+Advisor r4 (medium): the channel carries every request's prompt token
+ids and an unauthenticated early connection could permanently consume a
+follower slot, so connects must open with ``AUTH <token>`` and failed
+handshakes must neither receive the op stream nor count toward the
+follower rendezvous. Socket-level tests — no jax device work.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from gpustack_tpu.engine.multihost import CommandLeader, channel_token
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _connect(port: int) -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port), 5.0)
+    s.settimeout(5.0)
+    return s
+
+
+def test_bad_handshake_does_not_consume_follower_slot():
+    port = _free_port()
+    leader = CommandLeader(port, n_followers=1, token="sekrit")
+    try:
+        # rogue connects first and speaks garbage — must be rejected
+        rogue = _connect(port)
+        rogue.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        # rejected connections see EOF (leader closes)
+        assert rogue.recv(64) == b""
+        rogue.close()
+
+        # the real follower still completes the rendezvous
+        real = _connect(port)
+        real.sendall(b"AUTH sekrit\n")
+        assert leader._ready.wait(10), "follower never admitted"
+
+        leader.broadcast({"op": "decode", "key": [1, 2]})
+        line = b""
+        while not line.endswith(b"\n"):
+            chunk = real.recv(1 << 12)
+            assert chunk, "channel closed before op arrived"
+            line += chunk
+        assert json.loads(line)["op"] == "decode"
+        real.close()
+    finally:
+        leader.close()
+
+
+def test_wrong_token_rejected_silent_timeout_rejected():
+    port = _free_port()
+    leader = CommandLeader(port, n_followers=1, token="right")
+    leader._HANDSHAKE_TIMEOUT_S = 1.0
+    try:
+        wrong = _connect(port)
+        wrong.sendall(b"AUTH wrong\n")
+        assert wrong.recv(64) == b""        # closed on us
+        wrong.close()
+
+        # a connection that never speaks is dropped after the handshake
+        # timeout rather than holding the accept slot forever
+        silent = _connect(port)
+        t0 = time.time()
+        assert silent.recv(64) == b""
+        assert time.time() - t0 < 10
+        silent.close()
+
+        assert not leader._ready.is_set()
+        ok = _connect(port)
+        ok.sendall(b"AUTH right\n")
+        assert leader._ready.wait(10)
+        ok.close()
+    finally:
+        leader.close()
+
+
+def test_broadcast_times_out_without_followers(monkeypatch):
+    import gpustack_tpu.engine.multihost as mh
+
+    monkeypatch.setattr(mh, "_CONNECT_TIMEOUT_S", 0.5)
+    port = _free_port()
+    leader = CommandLeader(port, n_followers=1, token="t")
+    try:
+        with pytest.raises(RuntimeError, match="follower"):
+            leader.broadcast({"op": "decode", "key": [0, 0]})
+    finally:
+        leader.close()
+
+
+def test_channel_token_from_env(monkeypatch):
+    monkeypatch.setenv("GPUSTACK_TPU_CMD_TOKEN", "abc123")
+    assert channel_token() == "abc123"
+    monkeypatch.delenv("GPUSTACK_TPU_CMD_TOKEN")
+    assert channel_token() == ""
+
+
+def test_backend_command_injects_derived_token():
+    """worker/backends.py derives the same token in every process of a
+    multi-host placement (leader and follower workers run the same
+    code on the same instance row)."""
+    from gpustack_tpu.schemas.models import Model, ModelInstance
+    from gpustack_tpu.worker.backends import build_command
+
+    model = Model(
+        id=1, name="m", preset="tiny", max_seq_len=256, max_slots=4,
+    )
+    inst = ModelInstance(
+        id=7, model_id=1, name="m-0",
+        coordinator_address="10.0.0.5:9200",
+        subordinate_workers=[{"worker_id": 2}],
+    )
+    _, env_leader = build_command(model, inst, port=12345, backend=None,
+                                  process_index=0)
+    _, env_follower = build_command(model, inst, port=12399, backend=None,
+                                    process_index=1)
+    tok = env_leader.get("GPUSTACK_TPU_CMD_TOKEN")
+    assert tok and len(tok) >= 16
+    assert env_follower.get("GPUSTACK_TPU_CMD_TOKEN") == tok

@@ -151,6 +151,49 @@ def test_no_hardcoded_json_extract_in_sources():
     )
 
 
+def test_query_code_uses_dialect_bound_accessors():
+    """Advisor r4: call sites must go through Database.json_num/
+    json_text (bound to the live connection's dialect), never the
+    orm.sql module functions whose default pins sqlite — otherwise the
+    dialect abstraction exists but is never wired and a postgres/mysql
+    deployment mis-spells every usage query. Only orm/db.py (the
+    binding) and orm/sql.py (the definition) may touch the module
+    functions."""
+    import os
+    import re
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "gpustack_tpu",
+    )
+    allowed = {
+        os.path.join("orm", "sql.py"), os.path.join("orm", "db.py"),
+    }
+    pat = re.compile(r"(?<!\.)\b(?:json_num|json_text)\s*\(")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in allowed:
+                continue
+            with open(path) as f:
+                src = f.read()
+            if (
+                "from gpustack_tpu.orm.sql import" in src
+                and ("json_num" in src or "json_text" in src)
+            ) or pat.search(src):
+                offenders.append(rel)
+    assert not offenders, (
+        f"unbound json accessor in {offenders}; use "
+        "Record.db().json_num/json_text"
+    )
+
+
 def test_pk_clause_covers_reference_dialects():
     assert set(PK_CLAUSE) == {"sqlite", "postgres", "mysql"}
     # each spelling is self-consistent with its dialect
