@@ -1,0 +1,173 @@
+"""Minimal EXTERNAL OpenAI-compatible engine for backend orchestration.
+
+This process stands in for the third-party engines the reference
+orchestrates (vLLM / SGLang / llama-box — reference
+worker/backends/base.py:150 and custom.py:24): it is launched from an
+InferenceBackend catalog command template through the SAME ServeManager
+path a real external binary would be, and speaks the contract that path
+assumes:
+
+- readiness endpoint at ``/health`` (deliberately NOT /healthz — proves
+  the catalog's ``health_path`` is honored, like vLLM's /health),
+- ``/v1/chat/completions`` + ``/v1/completions`` (stream and non-stream),
+- ``/v1/models``,
+- Prometheus ``/metrics`` using vLLM's metric names so the worker's
+  runtime-metrics normalization (worker/metrics_map.py) has something
+  real to map.
+
+It generates deterministic text (echo-ish) with no model weights, so the
+e2e can assert content flowed through the proxy without caring about
+quality. Fast startup is a feature: crash-restart tests measure the
+manager, not a model load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+START = time.time()
+STATS = {"requests": 0, "prompt_tokens": 0, "generation_tokens": 0}
+
+
+def _gen_text(prompt: str, max_tokens: int) -> str:
+    words = (prompt.strip() or "ok").split()
+    out = []
+    i = 0
+    while len(out) < max(1, min(max_tokens, 64)):
+        out.append(words[i % len(words)])
+        i += 1
+    return "stub: " + " ".join(out)
+
+
+def _usage(prompt: str, text: str) -> dict:
+    pt, ct = len(prompt.split()), len(text.split())
+    STATS["requests"] += 1
+    STATS["prompt_tokens"] += pt
+    STATS["generation_tokens"] += ct
+    return {
+        "prompt_tokens": pt,
+        "completion_tokens": ct,
+        "total_tokens": pt + ct,
+    }
+
+
+def build_app(served_name: str, fail_health_after: float = 0.0) -> web.Application:
+    app = web.Application()
+
+    async def health(_request):
+        if fail_health_after and time.time() - START > fail_health_after:
+            return web.json_response({"status": "failing"}, status=503)
+        return web.json_response({"status": "ok"})
+
+    async def models(_request):
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": served_name, "object": "model",
+                      "owned_by": "stub"}],
+        })
+
+    async def chat(request: web.Request):
+        body = await request.json()
+        prompt = " ".join(
+            str(m.get("content", "")) for m in body.get("messages", [])
+        )
+        text = _gen_text(prompt, int(body.get("max_tokens", 16)))
+        usage = _usage(prompt, text)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for piece in text.split(" "):
+                chunk = {
+                    "id": rid, "object": "chat.completion.chunk",
+                    "model": served_name,
+                    "choices": [{"index": 0,
+                                 "delta": {"content": piece + " "},
+                                 "finish_reason": None}],
+                }
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode()
+                )
+                await asyncio.sleep(0)
+            done = {
+                "id": rid, "object": "chat.completion.chunk",
+                "model": served_name,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "stop"}],
+                "usage": usage,
+            }
+            await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        return web.json_response({
+            "id": rid, "object": "chat.completion",
+            "created": int(time.time()), "model": served_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "stop",
+            }],
+            "usage": usage,
+        })
+
+    async def completions(request: web.Request):
+        body = await request.json()
+        prompt = str(body.get("prompt", ""))
+        text = _gen_text(prompt, int(body.get("max_tokens", 16)))
+        return web.json_response({
+            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+            "object": "text_completion",
+            "created": int(time.time()), "model": served_name,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": "stop"}],
+            "usage": _usage(prompt, text),
+        })
+
+    async def metrics(_request):
+        # vLLM metric names → exercised by worker/metrics_map.py
+        lines = [
+            "# TYPE vllm:num_requests_running gauge",
+            "vllm:num_requests_running 0",
+            "# TYPE vllm:prompt_tokens_total counter",
+            f"vllm:prompt_tokens_total {STATS['prompt_tokens']}",
+            "# TYPE vllm:generation_tokens_total counter",
+            f"vllm:generation_tokens_total {STATS['generation_tokens']}",
+            "# TYPE vllm:request_success_total counter",
+            f"vllm:request_success_total {STATS['requests']}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("stub external engine")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--served-name", default="stub-model")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument(
+        "--fail-health-after", type=float, default=0.0,
+        help="seconds after which /health flips 503 (crash-path tests)",
+    )
+    args = p.parse_args(argv)
+    web.run_app(
+        build_app(args.served_name, args.fail_health_after),
+        host=args.host, port=args.port, print=None,
+    )
+
+
+if __name__ == "__main__":
+    main()

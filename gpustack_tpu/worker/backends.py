@@ -52,15 +52,35 @@ def build_command(
         )
     if backend is None:
         raise ValueError(f"unknown backend {model.backend!r}")
-    version = model.backend_version or backend.default_version
-    vcfg = next(
-        (v for v in backend.versions if v.version == version), None
-    ) or (backend.versions[0] if backend.versions else None)
+    vcfg = resolve_version_config(model, backend)
     if vcfg is None:
         raise ValueError(
             f"backend {model.backend!r} has no launch configuration"
         )
     return _render(vcfg, model, instance, port)
+
+
+def resolve_version_config(
+    model: Model, backend: Optional[InferenceBackend]
+) -> Optional[BackendVersionConfig]:
+    """The launch configuration build_command would use (None for the
+    in-repo engine)."""
+    if model.backend in ("", "tpu-native") or backend is None:
+        return None
+    version = model.backend_version or backend.default_version
+    return next(
+        (v for v in backend.versions if v.version == version), None
+    ) or (backend.versions[0] if backend.versions else None)
+
+
+def health_path_for(
+    model: Model, backend: Optional[InferenceBackend]
+) -> str:
+    """Readiness endpoint for this instance's engine: external backends
+    declare theirs (vLLM serves /health, not /healthz) in the catalog
+    row; the in-repo engines all serve /healthz."""
+    vcfg = resolve_version_config(model, backend)
+    return (vcfg.health_path if vcfg else "") or "/healthz"
 
 
 def _is_audio_model(model: Model) -> bool:

@@ -22,7 +22,7 @@ from gpustack_tpu.config import Config
 from gpustack_tpu.schemas import Model, ModelInstance, ModelInstanceState
 from gpustack_tpu.schemas.inference_backends import InferenceBackend
 from gpustack_tpu.server.bus import Event, EventType
-from gpustack_tpu.worker.backends import build_command
+from gpustack_tpu.worker.backends import build_command, health_path_for
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +40,9 @@ class RunningInstance:
         self.restarts = 0
         self.stopping = False
         self.is_leader = True
+        # external engines declare their own readiness endpoint (vLLM
+        # uses /health) via BackendVersionConfig.health_path
+        self.health_path = "/healthz"
 
 
 class ServeManager:
@@ -292,6 +295,7 @@ class ServeManager:
         )
         run.port = port
         run.is_leader = is_leader
+        run.health_path = health_path_for(model, backend)
         self.running[instance_id] = run
 
         env = dict(os.environ)
@@ -472,7 +476,7 @@ class ServeManager:
 
     async def _wait_healthy(self, run: RunningInstance) -> bool:
         deadline = time.monotonic() + HEALTH_TIMEOUT
-        url = f"http://127.0.0.1:{run.port}/healthz"
+        url = f"http://127.0.0.1:{run.port}{run.health_path}"
         async with aiohttp.ClientSession() as session:
             while time.monotonic() < deadline and not run.stopping:
                 if run.process and run.process.returncode is not None:
@@ -517,6 +521,7 @@ class ServeManager:
             await self._set_state(
                 run.instance_id, ModelInstanceState.SCHEDULED,
                 f"restart {run.restarts}",
+                restarts=run.restarts,
             )
         restarts = run.restarts
         await self.start_instance(run.instance_id)

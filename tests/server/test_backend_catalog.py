@@ -138,3 +138,29 @@ def test_builtin_rows_are_skipped(db, tmp_path):
         assert stats["deleted"] == 0
 
     asyncio.run(go())
+
+
+def test_shipped_catalog_parses_and_covers_stub():
+    """The in-repo assets/backend-catalog.json must stay loadable and
+    keep the stub-openai entry the orchestration e2e deploys from
+    (tests/e2e/test_custom_backend.py)."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "gpustack_tpu", "assets", "backend-catalog.json",
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    backends = {b.name: b for b in parse_catalog(doc)}
+    assert {"stub-openai", "vllm-tpu", "jetstream"} <= set(backends)
+    stub = backends["stub-openai"]
+    assert stub.versions[0].health_path == "/health"
+    # the command template launches the in-tree stub module with the
+    # substitution placeholders the renderer provides
+    cmd = " ".join(stub.versions[0].command)
+    assert "gpustack_tpu.testing.stub_engine" in cmd
+    assert "{port}" in cmd and "{served_name}" in cmd
