@@ -20,6 +20,10 @@ class BenchmarkProfile:
     num_requests: int
     rate: float = 0.0          # requests/sec; 0 = unlimited (batch)
     description: str = ""
+    # "random": uniform input_len/output_len shapes; "conversational":
+    # seeded multi-turn length mix (the zero-egress ShareGPT stand-in,
+    # loadgen._sample_conversation)
+    dataset: str = "random"
 
 
 PROFILES: Dict[str, BenchmarkProfile] = {
@@ -39,8 +43,20 @@ PROFILES: Dict[str, BenchmarkProfile] = {
         "generation-heavy", 1000, 2000, 200, 1.0,
         "long generations",
     ),
+    "sharegpt": BenchmarkProfile(
+        "sharegpt", 0, 512, 1000, 1000.0,
+        "conversational throughput: multi-turn prompts with a "
+        "ShareGPT-like length mix (synthetic — zero egress)",
+        dataset="conversational",
+    ),
     "smoke": BenchmarkProfile(
         "smoke", 32, 8, 6, 0.0,
         "hermetic test profile",
+    ),
+    "smoke-conversational": BenchmarkProfile(
+        "smoke-conversational", 24, 16, 6, 0.0,
+        "hermetic conversational-mix test profile (word-capped to fit "
+        "the tiny engine's context)",
+        dataset="conversational",
     ),
 }

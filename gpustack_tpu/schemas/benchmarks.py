@@ -19,16 +19,31 @@ class BenchmarkState(str, enum.Enum):
 
 
 class BenchmarkMetrics(pydantic.BaseModel):
+    """Covers every field of the reference's recorded schema
+    (gpustack/schemas/benchmark.py:192-242): rps, latency, ttft/tpot/itl
+    (with tails), tok/s (in/out/total), MEASURED concurrency mean/max,
+    and the total/successful/errored/incomplete request split."""
+
     requests_per_second: float = 0.0
     request_latency_ms: float = 0.0
+    request_latency_ms_p99: float = 0.0
     ttft_ms_p50: float = 0.0
+    ttft_ms_p99: float = 0.0
     ttft_ms_mean: float = 0.0
     tpot_ms_mean: float = 0.0
     itl_ms_mean: float = 0.0
+    itl_ms_p50: float = 0.0
+    itl_ms_p99: float = 0.0
     input_tok_per_s: float = 0.0
     output_tok_per_s: float = 0.0
     total_tok_per_s: float = 0.0
+    # time-weighted mean / sweep max over actual request intervals —
+    # never the configured semaphore size
     concurrency_mean: float = 0.0
+    concurrency_max: float = 0.0
+    request_total: int = 0
+    request_successful: int = 0
+    request_incomplete: int = 0
     error_count: int = 0
 
 

@@ -54,6 +54,10 @@ class Model(Record):
     preset: str = ""
     local_path: str = ""
     huggingface_repo_id: str = ""
+    # glob selecting specific file(s) within the repo — GGUF repos ship
+    # many quant levels and only the chosen one should download
+    # (reference ModelSource.huggingface_filename)
+    huggingface_filename: str = ""
     model_scope_model_id: str = ""
     replicas: int = 1
     backend: str = "tpu-native"       # built-in engine | "custom"

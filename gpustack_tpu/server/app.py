@@ -7,6 +7,7 @@ import json
 import logging
 
 import aiohttp
+import pydantic
 from aiohttp import web
 
 from gpustack_tpu.api.middlewares import auth_middleware, timing_middleware
@@ -151,6 +152,57 @@ def create_app(cfg: Config) -> web.Application:
             obj.categories = await _asyncio.get_running_loop(
             ).run_in_executor(None, detect_categories, obj)
         return None
+
+    async def catalog_deploy(request: web.Request):
+        """One-call deploy from a catalog entry (the reference's
+        catalog-as-primary-UX flow, server/catalog.py:50): resolves the
+        entry's suggested defaults into a Model spec, merges request
+        overrides field-by-field, and runs the SAME create path as
+        POST /v2/models (hook included) so catalog deploys can't skirt
+        validation."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.server.catalog import (
+            find_entry,
+            model_fields_from_entry,
+        )
+
+        if err := require_admin(request):
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return json_error(400, "body must be a JSON object")
+        entry = find_entry(str(body.get("name", "")))
+        if entry is None:
+            return json_error(
+                404, f"catalog entry {body.get('name')!r} not found"
+            )
+        overrides = body.get("overrides") or {}
+        if not isinstance(overrides, dict):
+            return json_error(400, "'overrides' must be an object")
+        unknown = [
+            k for k in overrides
+            if k not in Model.model_fields or k in ("id", "created_at")
+        ]
+        if unknown:
+            return json_error(400, f"unknown override fields: {unknown}")
+        fields = model_fields_from_entry(entry, overrides)
+        try:
+            obj = Model.model_validate(fields)
+        except pydantic.ValidationError as e:
+            return json_error(400, str(e))
+        obj.id = 0
+        # the FULL create-hook chain (name/cluster/category + org
+        # validation) — same as POST /v2/models, so catalog deploys
+        # can't skirt any of it
+        if err := await model_create_and_org_hook(request, obj, fields):
+            return err
+        await Model.create(obj)
+        return web.json_response(obj.model_dump(mode="json"), status=201)
+
+    app.router.add_post("/v2/model-catalog/deploy", catalog_deploy)
 
     async def user_create_hook(request, obj: User, body):
         password = (body or {}).get("password", "")

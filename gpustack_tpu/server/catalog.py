@@ -1,127 +1,292 @@
 """Built-in model catalog (reference gpustack/server/catalog.py:50
-init_model_catalog + assets catalog YAML): curated deployable models with
-suggested TPU configs, served at GET /v2/model-catalog."""
+init_model_catalog + assets/model-catalog.yaml, 127 entries): curated
+deployable checkpoints with suggested TPU deploy configs, served at
+GET /v2/model-catalog and deployable in one call via
+POST /v2/model-catalog/deploy (the reference treats the catalog as the
+primary deploy UX).
+
+Entries are table-driven: one row per checkpoint —
+(name, hf_repo, preset, params_b, categories, quant, ctx, v5e, v5p,
+extras) — expanded into the wire dict. ``preset`` is set where the
+in-repo engine ships a hermetic config of the same architecture
+(models/config.py PRESETS); other entries deploy from the checkpoint's
+own config.json via config_from_hf. Chat templates come from each
+checkpoint's tokenizer_config.json at load (engine/tokenizer.py); GGUF
+entries fall back to the neutral role-tag template unless a
+tokenizer.json sidecar is present (engine/gguf.py).
+
+Suggested chip counts assume int8 weight-only (1 byte/param) plus KV
+headroom on v5e-16GB / v5p-95GB; they are starting points for the
+evaluator (/v2/models/evaluate), which does the exact math.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
-CATALOG: List[Dict[str, Any]] = [
-    {
-        "name": "Llama-3-8B-Instruct",
-        "preset": "llama3-8b",
-        "huggingface_repo_id": "meta-llama/Meta-Llama-3-8B-Instruct",
-        "categories": ["llm", "chat"],
-        "sizes": {"parameters_b": 8.0},
-        "suggested": {
-            "quantization": "int8",
-            "max_seq_len": 8192,
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
-    {
-        "name": "Llama-3-70B-Instruct",
-        "preset": "llama3-70b",
-        "huggingface_repo_id": "meta-llama/Meta-Llama-3-70B-Instruct",
-        "categories": ["llm", "chat"],
-        "sizes": {"parameters_b": 70.6},
-        "suggested": {
-            "quantization": "int8",
-            "max_seq_len": 8192,
-            "chips": {"v5e": 8, "v5p": 2},
-        },
-    },
-    {
-        "name": "Qwen2.5-7B-Instruct",
-        "preset": "qwen2.5-7b",
-        "huggingface_repo_id": "Qwen/Qwen2.5-7B-Instruct",
-        "categories": ["llm", "chat"],
-        "sizes": {"parameters_b": 7.6},
-        "suggested": {
-            "quantization": "int8",
-            "max_seq_len": 32768,
-            "chips": {"v5e": 2, "v5p": 1},
-        },
-    },
-    {
-        "name": "Mixtral-8x7B-Instruct",
-        "preset": "mixtral-8x7b",
-        "huggingface_repo_id": "mistralai/Mixtral-8x7B-Instruct-v0.1",
-        "categories": ["llm", "chat", "moe"],
-        "sizes": {"parameters_b": 46.7},
-        "suggested": {
-            "quantization": "int8",
-            "max_seq_len": 32768,
-            "chips": {"v5e": 4, "v5p": 1},
-        },
-    },
-    {
-        "name": "Whisper-Large-v3",
-        "preset": "whisper-large-v3",
-        "huggingface_repo_id": "openai/whisper-large-v3",
-        "categories": ["audio", "speech-to-text"],
-        "sizes": {"parameters_b": 1.5},
-        "suggested": {
-            "max_seq_len": 448,
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
-    {
-        "name": "Whisper-Small",
-        "preset": "whisper-small",
-        "huggingface_repo_id": "openai/whisper-small",
-        "categories": ["audio", "speech-to-text"],
-        "sizes": {"parameters_b": 0.24},
-        "suggested": {
-            "max_seq_len": 448,
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
-    {
-        "name": "DeepSeek-V2-Lite",
-        "preset": "deepseek-v2-lite",
-        "huggingface_repo_id": "deepseek-ai/DeepSeek-V2-Lite",
-        "categories": ["llm", "chat", "moe"],
-        "sizes": {"parameters_b": 15.7},
-        "suggested": {
-            "quantization": "int8",
-            "max_seq_len": 32768,
-            "chips": {"v5e": 2, "v5p": 1},
-        },
-    },
-    {
-        "name": "TTS-Base",
-        "preset": "tts-base",
-        "categories": ["audio", "text-to-speech"],
-        "sizes": {"parameters_b": 0.007},
-        "suggested": {
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
-    {
-        "name": "Stable-Diffusion-XL",
-        "preset": "sdxl-shaped",
-        "huggingface_repo_id": "stabilityai/stable-diffusion-xl-base-1.0",
-        "categories": ["image", "text-to-image"],
-        "sizes": {"parameters_b": 3.5},
-        "suggested": {
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
-    {
-        "name": "Stable-Diffusion-1.5",
-        "preset": "sd15-shaped",
-        "huggingface_repo_id": "stable-diffusion-v1-5/stable-diffusion-v1-5",
-        "categories": ["image", "text-to-image"],
-        "sizes": {"parameters_b": 1.0},
-        "suggested": {
-            "chips": {"v5e": 1, "v5p": 1},
-        },
-    },
+# (name, hf_repo, preset, params_b, categories, quant, ctx, v5e, v5p,
+#  extras)
+_ROWS = [
+    # ---- Llama family ---------------------------------------------------
+    ("Llama-3-8B-Instruct", "meta-llama/Meta-Llama-3-8B-Instruct",
+     "llama3-8b", 8.0, ["llm", "chat"], "int8", 8192, 1, 1, {}),
+    ("Llama-3-70B-Instruct", "meta-llama/Meta-Llama-3-70B-Instruct",
+     "llama3-70b", 70.6, ["llm", "chat"], "int8", 8192, 8, 2,
+     {"mesh_plan": "dp1xsp1xep1xtp8"}),
+    ("Llama-3.1-8B-Instruct", "meta-llama/Llama-3.1-8B-Instruct",
+     "llama3-8b", 8.0, ["llm", "chat", "long-context"], "int8",
+     131072, 2, 1, {"rope": "llama3"}),
+    ("Llama-3.1-70B-Instruct", "meta-llama/Llama-3.1-70B-Instruct",
+     "llama3-70b", 70.6, ["llm", "chat", "long-context"], "int8",
+     131072, 8, 2, {"mesh_plan": "dp1xsp1xep1xtp8", "rope": "llama3"}),
+    ("Llama-3.2-1B-Instruct", "meta-llama/Llama-3.2-1B-Instruct",
+     "", 1.2, ["llm", "chat"], "int8", 131072, 1, 1, {}),
+    ("Llama-3.2-3B-Instruct", "meta-llama/Llama-3.2-3B-Instruct",
+     "", 3.2, ["llm", "chat"], "int8", 131072, 1, 1, {}),
+    ("Llama-3.3-70B-Instruct", "meta-llama/Llama-3.3-70B-Instruct",
+     "llama3-70b", 70.6, ["llm", "chat"], "int8", 131072, 8, 2,
+     {"mesh_plan": "dp1xsp1xep1xtp8"}),
+    # ---- Qwen2.5 dense --------------------------------------------------
+    ("Qwen2.5-0.5B-Instruct", "Qwen/Qwen2.5-0.5B-Instruct",
+     "", 0.5, ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen2.5-1.5B-Instruct", "Qwen/Qwen2.5-1.5B-Instruct",
+     "", 1.5, ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen2.5-3B-Instruct", "Qwen/Qwen2.5-3B-Instruct",
+     "", 3.1, ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen2.5-7B-Instruct", "Qwen/Qwen2.5-7B-Instruct",
+     "qwen2.5-7b", 7.6, ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen2.5-14B-Instruct", "Qwen/Qwen2.5-14B-Instruct",
+     "", 14.8, ["llm", "chat"], "int8", 32768, 2, 1, {}),
+    ("Qwen2.5-32B-Instruct", "Qwen/Qwen2.5-32B-Instruct",
+     "", 32.8, ["llm", "chat"], "int8", 32768, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    ("Qwen2.5-72B-Instruct", "Qwen/Qwen2.5-72B-Instruct",
+     "", 72.7, ["llm", "chat"], "int8", 32768, 8, 2,
+     {"mesh_plan": "dp1xsp1xep1xtp8"}),
+    ("Qwen2.5-Coder-7B-Instruct", "Qwen/Qwen2.5-Coder-7B-Instruct",
+     "qwen2.5-7b", 7.6, ["llm", "code"], "int8", 32768, 1, 1, {}),
+    ("Qwen2.5-Coder-32B-Instruct", "Qwen/Qwen2.5-Coder-32B-Instruct",
+     "", 32.8, ["llm", "code"], "int8", 32768, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    # ---- Qwen3 ----------------------------------------------------------
+    ("Qwen3-0.6B", "Qwen/Qwen3-0.6B", "", 0.6,
+     ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen3-1.7B", "Qwen/Qwen3-1.7B", "", 1.7,
+     ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen3-4B", "Qwen/Qwen3-4B", "", 4.0,
+     ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen3-8B", "Qwen/Qwen3-8B", "qwen3-8b", 8.2,
+     ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Qwen3-14B", "Qwen/Qwen3-14B", "", 14.8,
+     ["llm", "chat"], "int8", 32768, 2, 1, {}),
+    ("Qwen3-32B", "Qwen/Qwen3-32B", "", 32.8,
+     ["llm", "chat"], "int8", 32768, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    ("Qwen3-30B-A3B", "Qwen/Qwen3-30B-A3B", "qwen3-30b-a3b", 30.5,
+     ["llm", "chat", "moe"], "int8", 32768, 4, 1,
+     {"mesh_plan": "dp1xsp1xep4xtp1"}),
+    ("Qwen3-235B-A22B", "Qwen/Qwen3-235B-A22B", "", 235.0,
+     ["llm", "chat", "moe"], "int8", 32768, 32, 4,
+     {"mesh_plan": "dp1xsp1xep8xtp4", "multi_host": True}),
+    ("Qwen2-57B-A14B-Instruct", "Qwen/Qwen2-57B-A14B-Instruct",
+     "", 57.4, ["llm", "chat", "moe"], "int8", 32768, 8, 1,
+     {"mesh_plan": "dp1xsp1xep4xtp2"}),
+    # ---- Gemma ----------------------------------------------------------
+    ("Gemma-2-2B-Instruct", "google/gemma-2-2b-it", "", 2.6,
+     ["llm", "chat"], "int8", 8192, 1, 1, {}),
+    ("Gemma-2-9B-Instruct", "google/gemma-2-9b-it", "gemma2-9b", 9.2,
+     ["llm", "chat"], "int8", 8192, 1, 1, {}),
+    ("Gemma-2-27B-Instruct", "google/gemma-2-27b-it", "", 27.2,
+     ["llm", "chat"], "int8", 8192, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    ("Gemma-3-1B-Instruct", "google/gemma-3-1b-it", "", 1.0,
+     ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Gemma-3-4B-Instruct", "google/gemma-3-4b-it", "", 4.3,
+     ["llm", "chat"], "int8", 131072, 1, 1, {}),
+    ("Gemma-3-12B-Instruct", "google/gemma-3-12b-it", "", 12.2,
+     ["llm", "chat"], "int8", 131072, 2, 1, {}),
+    ("Gemma-3-27B-Instruct", "google/gemma-3-27b-it", "", 27.4,
+     ["llm", "chat"], "int8", 131072, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    # ---- DeepSeek -------------------------------------------------------
+    ("DeepSeek-V2-Lite", "deepseek-ai/DeepSeek-V2-Lite",
+     "deepseek-v2-lite", 15.7, ["llm", "chat", "moe"], "int8",
+     32768, 2, 1, {"attention": "mla", "rope": "yarn"}),
+    ("DeepSeek-V2-Lite-Chat", "deepseek-ai/DeepSeek-V2-Lite-Chat",
+     "deepseek-v2-lite", 15.7, ["llm", "chat", "moe"], "int8",
+     32768, 2, 1, {"attention": "mla", "rope": "yarn"}),
+    ("DeepSeek-V2-Chat", "deepseek-ai/DeepSeek-V2-Chat", "", 236.0,
+     ["llm", "chat", "moe"], "int8", 131072, 32, 4,
+     {"attention": "mla", "rope": "yarn",
+      "mesh_plan": "dp1xsp1xep8xtp4", "multi_host": True}),
+    ("DeepSeek-V3", "deepseek-ai/DeepSeek-V3", "", 671.0,
+     ["llm", "chat", "moe"], "int8", 131072, 64, 8,
+     {"attention": "mla", "rope": "yarn",
+      "mesh_plan": "dp1xsp1xep16xtp4", "multi_host": True}),
+    ("DeepSeek-R1", "deepseek-ai/DeepSeek-R1", "", 671.0,
+     ["llm", "chat", "moe", "reasoning"], "int8", 131072, 64, 8,
+     {"attention": "mla", "rope": "yarn",
+      "mesh_plan": "dp1xsp1xep16xtp4", "multi_host": True}),
+    ("DeepSeek-R1-Distill-Qwen-1.5B",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B", "", 1.8,
+     ["llm", "chat", "reasoning"], "int8", 131072, 1, 1, {}),
+    ("DeepSeek-R1-Distill-Qwen-7B",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B", "qwen2.5-7b", 7.6,
+     ["llm", "chat", "reasoning"], "int8", 131072, 1, 1, {}),
+    ("DeepSeek-R1-Distill-Qwen-14B",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-14B", "", 14.8,
+     ["llm", "chat", "reasoning"], "int8", 131072, 2, 1, {}),
+    ("DeepSeek-R1-Distill-Qwen-32B",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-32B", "", 32.8,
+     ["llm", "chat", "reasoning"], "int8", 131072, 4, 1,
+     {"mesh_plan": "dp1xsp1xep1xtp4"}),
+    ("DeepSeek-R1-Distill-Llama-8B",
+     "deepseek-ai/DeepSeek-R1-Distill-Llama-8B", "llama3-8b", 8.0,
+     ["llm", "chat", "reasoning"], "int8", 131072, 1, 1, {}),
+    ("DeepSeek-R1-Distill-Llama-70B",
+     "deepseek-ai/DeepSeek-R1-Distill-Llama-70B", "llama3-70b", 70.6,
+     ["llm", "chat", "reasoning"], "int8", 131072, 8, 2,
+     {"mesh_plan": "dp1xsp1xep1xtp8"}),
+    # ---- Mistral / Mixtral ---------------------------------------------
+    ("Mistral-7B-Instruct-v0.3", "mistralai/Mistral-7B-Instruct-v0.3",
+     "", 7.2, ["llm", "chat"], "int8", 32768, 1, 1, {}),
+    ("Mixtral-8x7B-Instruct", "mistralai/Mixtral-8x7B-Instruct-v0.1",
+     "mixtral-8x7b", 46.7, ["llm", "chat", "moe"], "int8", 32768,
+     4, 1, {"mesh_plan": "dp1xsp1xep4xtp1"}),
+    ("Mixtral-8x22B-Instruct", "mistralai/Mixtral-8x22B-Instruct-v0.1",
+     "", 141.0, ["llm", "chat", "moe"], "int8", 65536, 16, 2,
+     {"mesh_plan": "dp1xsp1xep8xtp2", "multi_host": True}),
+    # ---- GGUF checkpoints (served natively: engine/gguf.py K-quants) ---
+    ("Llama-3.1-8B-Instruct-GGUF-Q4_K_M",
+     "bartowski/Meta-Llama-3.1-8B-Instruct-GGUF", "", 8.0,
+     ["llm", "chat", "gguf"], "", 131072, 1, 1,
+     {"file": "Meta-Llama-3.1-8B-Instruct-Q4_K_M.gguf",
+      "note": "Q4_K_M dequantized to bf16 at load; rope_freqs honored"}),
+    ("Qwen2.5-7B-Instruct-GGUF-Q4_K_M",
+     "Qwen/Qwen2.5-7B-Instruct-GGUF", "", 7.6,
+     ["llm", "chat", "gguf"], "", 32768, 1, 1,
+     {"file": "qwen2.5-7b-instruct-q4_k_m.gguf"}),
+    ("Qwen2.5-72B-Instruct-GGUF-Q4_K_M",
+     "Qwen/Qwen2.5-72B-Instruct-GGUF", "", 72.7,
+     ["llm", "chat", "gguf"], "", 32768, 8, 1,
+     {"file": "qwen2.5-72b-instruct-q4_k_m-*.gguf",
+      "note": "wildcard matches every gguf-split shard; serving "
+              "resolves them via split.count (engine/gguf.py)"}),
+    ("Gemma-2-9B-Instruct-GGUF-Q6_K", "bartowski/gemma-2-9b-it-GGUF",
+     "", 9.2, ["llm", "chat", "gguf"], "", 8192, 1, 1,
+     {"file": "gemma-2-9b-it-Q6_K.gguf"}),
+    # ---- Embeddings -----------------------------------------------------
+    ("BGE-M3", "BAAI/bge-m3", "", 0.57,
+     ["embedding"], "", 8192, 1, 1, {}),
+    ("BGE-Large-EN-v1.5", "BAAI/bge-large-en-v1.5", "", 0.34,
+     ["embedding"], "", 512, 1, 1, {}),
+    ("GTE-Qwen2-1.5B-Instruct", "Alibaba-NLP/gte-Qwen2-1.5B-instruct",
+     "", 1.5, ["embedding"], "", 32768, 1, 1, {}),
+    ("E5-Mistral-7B-Instruct", "intfloat/e5-mistral-7b-instruct",
+     "", 7.1, ["embedding"], "int8", 32768, 1, 1, {}),
+    ("Jina-Embeddings-v2-Base", "jinaai/jina-embeddings-v2-base-en",
+     "", 0.14, ["embedding"], "", 8192, 1, 1, {}),
+    # ---- Rerankers ------------------------------------------------------
+    ("BGE-Reranker-v2-M3", "BAAI/bge-reranker-v2-m3", "", 0.57,
+     ["reranker"], "", 8192, 1, 1, {}),
+    ("BGE-Reranker-Large", "BAAI/bge-reranker-large", "", 0.56,
+     ["reranker"], "", 512, 1, 1, {}),
+    # ---- Speech-to-text -------------------------------------------------
+    ("Whisper-Large-v3", "openai/whisper-large-v3",
+     "whisper-large-v3", 1.5, ["audio", "speech-to-text"], "",
+     448, 1, 1, {}),
+    ("Whisper-Large-v3-Turbo", "openai/whisper-large-v3-turbo",
+     "", 0.8, ["audio", "speech-to-text"], "", 448, 1, 1, {}),
+    ("Whisper-Medium", "openai/whisper-medium", "", 0.77,
+     ["audio", "speech-to-text"], "", 448, 1, 1, {}),
+    ("Whisper-Small", "openai/whisper-small", "whisper-small", 0.24,
+     ["audio", "speech-to-text"], "", 448, 1, 1, {}),
+    ("Whisper-Base", "openai/whisper-base", "", 0.07,
+     ["audio", "speech-to-text"], "", 448, 1, 1, {}),
+    # ---- Text-to-speech -------------------------------------------------
+    ("TTS-Base", "", "tts-base", 0.007,
+     ["audio", "text-to-speech"], "", 0, 1, 1, {}),
+    # ---- Image generation ----------------------------------------------
+    ("Stable-Diffusion-XL", "stabilityai/stable-diffusion-xl-base-1.0",
+     "sdxl-shaped", 3.5, ["image", "text-to-image"], "", 0, 1, 1, {}),
+    ("Stable-Diffusion-1.5",
+     "stable-diffusion-v1-5/stable-diffusion-v1-5", "sd15-shaped",
+     1.0, ["image", "text-to-image"], "", 0, 1, 1, {}),
+    # ---- Vision-language ------------------------------------------------
+    ("LLaVA-1.5-7B", "llava-hf/llava-1.5-7b-hf", "", 7.1,
+     ["llm", "vlm", "chat"], "int8", 4096, 1, 1,
+     {"note": "image_url content parts via vision-token splicing"}),
+    ("LLaVA-1.5-13B", "llava-hf/llava-1.5-13b-hf", "", 13.4,
+     ["llm", "vlm", "chat"], "int8", 4096, 2, 1, {}),
 ]
+
+
+def _expand(row) -> Dict[str, Any]:
+    (name, repo, preset, params_b, cats, quant, ctx, v5e, v5p,
+     extras) = row
+    suggested: Dict[str, Any] = {
+        "chips": {"v5e": v5e, "v5p": v5p},
+    }
+    if quant:
+        suggested["quantization"] = quant
+    if ctx:
+        suggested["max_seq_len"] = ctx
+    for key in ("mesh_plan", "multi_host", "file"):
+        if key in extras:
+            suggested[key] = extras[key]
+    entry: Dict[str, Any] = {
+        "name": name,
+        "categories": cats,
+        "sizes": {"parameters_b": params_b},
+        "suggested": suggested,
+    }
+    if repo:
+        entry["huggingface_repo_id"] = repo
+    if preset:
+        entry["preset"] = preset
+    for key in ("attention", "rope", "note"):
+        if key in extras:
+            entry[key] = extras[key]
+    return entry
+
+
+CATALOG: List[Dict[str, Any]] = [_expand(r) for r in _ROWS]
 
 
 def get_catalog(category: str = "") -> List[Dict[str, Any]]:
     if not category:
         return CATALOG
     return [m for m in CATALOG if category in m["categories"]]
+
+
+def find_entry(name: str) -> Optional[Dict[str, Any]]:
+    return next((m for m in CATALOG if m["name"] == name), None)
+
+
+def model_fields_from_entry(
+    entry: Dict[str, Any], overrides: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Deploy defaults for POST /v2/models derived from a catalog entry
+    (the catalog-as-primary-UX flow, reference server/catalog.py:50):
+    source, quantization, context and mesh plan come from ``suggested``;
+    ``overrides`` (user-provided request fields) win field-by-field."""
+    suggested = entry.get("suggested", {})
+    fields: Dict[str, Any] = {
+        "name": entry["name"].lower(),
+        "categories": entry.get("categories", []),
+        "replicas": 1,
+    }
+    if entry.get("preset"):
+        fields["preset"] = entry["preset"]
+    elif entry.get("huggingface_repo_id"):
+        fields["huggingface_repo_id"] = entry["huggingface_repo_id"]
+        if suggested.get("file"):
+            fields["huggingface_filename"] = suggested["file"]
+    if suggested.get("quantization"):
+        fields["quantization"] = suggested["quantization"]
+    if suggested.get("max_seq_len"):
+        fields["max_seq_len"] = suggested["max_seq_len"]
+    if suggested.get("mesh_plan"):
+        fields["mesh_plan"] = suggested["mesh_plan"]
+    fields.update(overrides or {})
+    return fields

@@ -26,17 +26,31 @@ from gpustack_tpu.utils.locks import SoftFileLock
 logger = logging.getLogger(__name__)
 
 
-def _hf_snapshot_download(repo_id: str, target_dir: str) -> str:
-    """Default downloader: huggingface_hub snapshot (resumable)."""
+def _hf_snapshot_download(
+    repo_id: str, target_dir: str, allow_patterns=None
+) -> str:
+    """Default downloader: huggingface_hub snapshot (resumable).
+
+    Default patterns exclude ``*.gguf``: multi-quant GGUF repos carry
+    every quant level and the model's ``huggingface_filename`` glob must
+    pick one (plus its gguf-split siblings) explicitly."""
     from huggingface_hub import snapshot_download
 
     return snapshot_download(
         repo_id=repo_id,
         local_dir=target_dir,
-        allow_patterns=[
+        allow_patterns=allow_patterns or [
             "*.safetensors", "*.json", "*.model", "tokenizer*", "*.txt"
         ],
     )
+
+
+def _file_patterns(file_glob: str):
+    """Download patterns for a huggingface_filename selection: the
+    chosen weight file(s) — including gguf-split siblings (the -%05d-of-
+    suffix replaces a plain .gguf suffix, so 'x-Q4_K_M*.gguf' style
+    globs match all shards) — plus the tokenizer/config sidecars."""
+    return [file_glob, "*.json", "tokenizer*", "*.model", "*.txt"]
 
 
 def _dir_size(path: str) -> int:
@@ -80,25 +94,46 @@ class ModelFileManager:
             return ""  # built-in config; no files
         if model.huggingface_repo_id:
             return await self._ensure_remote(
-                "hf", model.huggingface_repo_id
+                "hf", model.huggingface_repo_id,
+                file_glob=model.huggingface_filename,
             )
         if model.model_scope_model_id:
             return await self._ensure_remote(
-                "ms", model.model_scope_model_id
+                "ms", model.model_scope_model_id,
+                file_glob=model.huggingface_filename,
             )
         raise ValueError("model has no weight source")
 
-    def _download(self, scheme: str, repo_id: str, target: str) -> str:
+    def _download(
+        self, scheme: str, repo_id: str, target: str, file_glob: str = ""
+    ) -> str:
         if scheme == "ms":
             from gpustack_tpu.worker.downloaders import (
                 modelscope_snapshot_download,
             )
 
+            if file_glob:
+                return modelscope_snapshot_download(
+                    repo_id, target,
+                    allow_patterns=_file_patterns(file_glob),
+                )
             return modelscope_snapshot_download(repo_id, target)
+        if file_glob:
+            # injected test downloaders keep the 2-arg shape; only the
+            # pattern-aware path needs the third argument
+            return self.downloader(
+                repo_id, target, _file_patterns(file_glob)
+            )
         return self.downloader(repo_id, target)
 
-    async def _ensure_remote(self, scheme: str, repo_id: str) -> str:
+    async def _ensure_remote(
+        self, scheme: str, repo_id: str, file_glob: str = ""
+    ) -> str:
         base = re.sub(r"[^A-Za-z0-9_.-]", "--", repo_id)
+        if file_glob:
+            # different file selections of one repo are distinct cache
+            # entries (Q4_K_M vs Q6_K of the same GGUF repo)
+            base += "--" + re.sub(r"[^A-Za-z0-9_.-]", "-", file_glob)
         target = os.path.join(self.models_dir, f"{scheme}--{base}")
         marker = target + ".complete"
         if os.path.exists(marker):
@@ -125,7 +160,8 @@ class ModelFileManager:
             loop = asyncio.get_running_loop()
             try:
                 await loop.run_in_executor(
-                    None, self._download, scheme, repo_id, target
+                    None, self._download, scheme, repo_id, target,
+                    file_glob
                 )
             except Exception as e:
                 await self._update_record(

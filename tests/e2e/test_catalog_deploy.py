@@ -1,0 +1,122 @@
+"""Deploy FROM the catalog, end-to-end (verdict r4 #6 + weak #7).
+
+The reference treats the catalog as the primary deploy UX
+(server/catalog.py:50); here POST /v2/model-catalog/deploy resolves a
+catalog entry's suggested defaults into a Model and the normal
+controller → scheduler → serve-manager pipeline takes it to RUNNING —
+then the served modality endpoint answers through the server proxy.
+Uses the TTS-Base entry (the smallest real catalog model: the audio
+engine boots it in seconds on CPU).
+"""
+
+import asyncio
+import os
+import socket
+import time
+
+import aiohttp
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fixtures", "workers", "v5e_8.json",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_catalog_deploy_to_running(tmp_path):
+    from gpustack_tpu.config import Config
+    from gpustack_tpu.server.server import Server
+
+    port = _free_port()
+    cfg = Config.load(
+        {
+            "host": "127.0.0.1",
+            "port": port,
+            "data_dir": str(tmp_path),
+            "registration_token": "cat-token",
+            "bootstrap_password": "cat-pass",
+            "fake_detector": FIXTURE,
+            "force_platform": "cpu",
+            "heartbeat_interval": 1.0,
+            "status_interval": 2.0,
+            "worker_port": 0,
+        }
+    )
+
+    async def go():
+        server = Server(cfg)
+        await server.start()
+        server.scheduler.scan_interval = 2.0
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    f"{base}/auth/login",
+                    json={"username": "admin", "password": "cat-pass"},
+                ) as r:
+                    token = (await r.json())["token"]
+                hdrs = {"Authorization": f"Bearer {token}"}
+
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/workers", headers=hdrs
+                    ) as r:
+                        workers = (await r.json())["items"]
+                    if workers and workers[0]["state"] == "ready" and (
+                        workers[0]["status"]["chips"]
+                    ):
+                        break
+                    await asyncio.sleep(0.5)
+                else:
+                    raise AssertionError("worker never ready")
+
+                # the one-call catalog deploy
+                async with http.post(
+                    f"{base}/v2/model-catalog/deploy",
+                    headers=hdrs,
+                    json={"name": "TTS-Base"},
+                ) as r:
+                    assert r.status == 201, await r.text()
+                    model = await r.json()
+                assert model["preset"] == "tts-base"
+                assert model["replicas"] == 1
+
+                deadline = time.time() + 240
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/model-instances", headers=hdrs
+                    ) as r:
+                        insts = (await r.json())["items"]
+                    if insts and insts[0]["state"] == "running":
+                        break
+                    if insts and insts[0]["state"] == "error":
+                        raise AssertionError(
+                            f"error: {insts[0]['state_message']}"
+                        )
+                    await asyncio.sleep(1.0)
+                else:
+                    raise AssertionError(f"never RUNNING: {insts}")
+
+                # the deployed modality serves through the proxy
+                async with http.post(
+                    f"{base}/v1/audio/speech",
+                    headers=hdrs,
+                    json={
+                        "model": model["name"],
+                        "input": "catalog deploy works",
+                        "response_format": "wav",
+                    },
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    audio = await r.read()
+                assert audio[:4] == b"RIFF" and len(audio) > 1000
+        finally:
+            await server.stop()
+
+    asyncio.run(go())

@@ -79,13 +79,111 @@ def test_catalog(ctx):
         r = await client.get("/v2/model-catalog", headers=hdrs)
         assert r.status == 200
         items = (await r.json())["items"]
-        assert any(m["preset"] == "llama3-8b" for m in items)
+        assert any(m.get("preset") == "llama3-8b" for m in items)
         r = await client.get(
             "/v2/model-catalog?category=moe", headers=hdrs
         )
         assert all(
             "moe" in m["categories"] for m in (await r.json())["items"]
         )
+
+    _client_run(ctx, go)
+
+
+def test_catalog_depth_and_integrity():
+    """Verdict r4 #6: the catalog must enumerate the real checkpoints
+    users deploy (reference assets/model-catalog.yaml has 127) with
+    usable deploy defaults — every entry structurally valid."""
+    from gpustack_tpu.models.config import PRESETS
+    from gpustack_tpu.models.diffusion import DIFFUSION_PRESETS
+    from gpustack_tpu.models.tts import TTS_PRESETS
+    from gpustack_tpu.models.whisper import WHISPER_PRESETS
+    from gpustack_tpu.parallel.mesh import MeshPlan
+    from gpustack_tpu.server.catalog import CATALOG
+
+    assert len(CATALOG) >= 60, len(CATALOG)
+    names = [m["name"] for m in CATALOG]
+    assert len(set(names)) == len(names), "duplicate catalog names"
+    known_presets = (
+        set(PRESETS) | set(WHISPER_PRESETS) | set(TTS_PRESETS)
+        | set(DIFFUSION_PRESETS)
+    )
+    for m in CATALOG:
+        assert m.get("preset") or m.get("huggingface_repo_id"), m["name"]
+        if m.get("preset"):
+            assert m["preset"] in known_presets, m
+        assert m["categories"], m["name"]
+        assert m["sizes"]["parameters_b"] > 0
+        chips = m["suggested"]["chips"]
+        assert chips["v5e"] >= 1 and chips["v5p"] >= 1
+        if "mesh_plan" in m["suggested"]:
+            plan = MeshPlan.parse(m["suggested"]["mesh_plan"])
+            # suggested chip count carries the whole mesh
+            assert plan.chips <= chips["v5e"], m["name"]
+    # family coverage the engine actually serves
+    repos = " ".join(m.get("huggingface_repo_id", "") for m in CATALOG)
+    for family in (
+        "meta-llama/", "Qwen/", "google/gemma", "deepseek-ai/",
+        "mistralai/", "openai/whisper", "BAAI/bge",
+        "stabilityai/", "llava-hf/",
+    ):
+        assert family in repos, f"family missing: {family}"
+    # every served modality appears
+    cats = {c for m in CATALOG for c in m["categories"]}
+    assert {
+        "llm", "moe", "embedding", "reranker", "speech-to-text",
+        "text-to-speech", "text-to-image", "vlm", "gguf",
+    } <= cats
+
+
+def test_catalog_deploy_endpoint(ctx):
+    async def go(client, hdrs):
+        # unknown entry -> 404
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={"name": "No-Such-Model"},
+        )
+        assert r.status == 404
+        # deploy with overrides through the same create path
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={
+                "name": "TTS-Base",
+                "overrides": {"replicas": 0, "name": "my-tts"},
+            },
+        )
+        assert r.status == 201, await r.text()
+        model = await r.json()
+        assert model["name"] == "my-tts"
+        assert model["preset"] == "tts-base"
+        assert model["replicas"] == 0
+        assert "audio" in model["categories"]
+        # duplicate name rejected by the shared create hook
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={"name": "TTS-Base",
+                  "overrides": {"name": "my-tts"}},
+        )
+        assert r.status == 409
+        # unknown override fields are loud
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={"name": "TTS-Base",
+                  "overrides": {"nonsense_field": 1}},
+        )
+        assert r.status == 400
+        # GGUF entry resolves repo + file glob
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={"name": "Qwen2.5-7B-Instruct-GGUF-Q4_K_M",
+                  "overrides": {"replicas": 0}},
+        )
+        assert r.status == 201, await r.text()
+        model = await r.json()
+        assert model["huggingface_repo_id"] == (
+            "Qwen/Qwen2.5-7B-Instruct-GGUF"
+        )
+        assert model["huggingface_filename"].endswith(".gguf")
 
     _client_run(ctx, go)
 
@@ -432,5 +530,26 @@ def test_cluster_manifests(ctx):
         assert "gke-tpu-accelerator" in text
         # embeds the registration token -> admin only
         assert ctx.registration_token in text
+
+    _client_run(ctx, go)
+
+
+def test_catalog_deploy_validation_hardening(ctx):
+    async def go(client, hdrs):
+        # non-object JSON bodies are 400, not 500
+        for bad in ("[]", '"x"', "42"):
+            r = await client.post(
+                "/v2/model-catalog/deploy",
+                headers={**hdrs, "Content-Type": "application/json"},
+                data=bad,
+            )
+            assert r.status == 400, (bad, r.status)
+        # org validation runs (same chain as POST /v2/models)
+        r = await client.post(
+            "/v2/model-catalog/deploy", headers=hdrs,
+            json={"name": "TTS-Base",
+                  "overrides": {"org_id": 999, "replicas": 0}},
+        )
+        assert r.status == 400, await r.text()
 
     _client_run(ctx, go)

@@ -147,3 +147,41 @@ def test_soft_file_lock_stale_steal(tmp_path):
         assert not os.path.exists(lock_path)
 
     asyncio.run(go())
+
+
+def test_hf_filename_glob_selects_files_and_cache_key(ctx):
+    """huggingface_filename (GGUF quant selection — reference
+    ModelSource.huggingface_filename): the downloader receives the glob
+    plus sidecar patterns, and different selections of the SAME repo
+    cache separately."""
+    calls = []
+
+    def fake_download(repo_id, target, patterns=None):
+        calls.append((repo_id, tuple(patterns or ())))
+        os.makedirs(target, exist_ok=True)
+        with open(os.path.join(target, "w.gguf"), "wb") as f:
+            f.write(b"g" * 64)
+        return target
+
+    mgr = ModelFileManager(
+        ctx, FakeClient(), worker_id=1, downloader=fake_download
+    )
+    q4 = Model(
+        name="q4", huggingface_repo_id="org/repo-GGUF",
+        huggingface_filename="*Q4_K_M*.gguf",
+    )
+    q6 = Model(
+        name="q6", huggingface_repo_id="org/repo-GGUF",
+        huggingface_filename="*Q6_K*.gguf",
+    )
+
+    async def go():
+        p4 = await mgr.ensure_local(q4)
+        p6 = await mgr.ensure_local(q6)
+        assert p4 != p6, "quant selections must not share a cache dir"
+        assert calls[0][0] == "org/repo-GGUF"
+        assert "*Q4_K_M*.gguf" in calls[0][1]
+        assert any("tokenizer" in p for p in calls[0][1])
+        assert "*Q6_K*.gguf" in calls[1][1]
+
+    asyncio.run(go())
