@@ -72,10 +72,19 @@ class ClientSet:
                 raise APIError(resp.status, message)
             return await resp.json()
 
+    @staticmethod
+    def query_path(kind: str, filters: Dict[str, Any]) -> str:
+        """/v2/<kind>?<urlencoded filters> — THE query builder for list
+        reads (values with &/=/spaces must encode, not split the query)."""
+        from urllib.parse import urlencode
+
+        query = urlencode({k: str(v) for k, v in filters.items()})
+        return f"/v2/{kind}" + (f"?{query}" if query else "")
+
     async def list(self, kind: str, **filters: Any) -> List[Dict[str, Any]]:
-        query = "&".join(f"{k}={v}" for k, v in filters.items())
-        path = f"/v2/{kind}" + (f"?{query}" if query else "")
-        return (await self.request("GET", path))["items"]
+        return (
+            await self.request("GET", self.query_path(kind, filters))
+        )["items"]
 
     async def get(self, kind: str, id: int) -> Dict[str, Any]:
         return await self.request("GET", f"/v2/{kind}/{id}")

@@ -181,6 +181,12 @@ def _reload_config(args) -> int:
     async def mint() -> str:
         db = Database(cfg.database_path)
         Record.bind(db, EventBus())
+        # migrations BEFORE table creation: creating a fresh table under
+        # a renamed kind while the old one still holds data would leave
+        # the rename migration a conflicting copy to reconcile
+        from gpustack_tpu.orm.db import run_migrations
+
+        run_migrations(db)
         Record.create_all_tables(db)
         try:
             user = await User.first(username="admin")
@@ -333,6 +339,12 @@ def _reset_admin_password(args) -> int:
     async def go():
         db = Database(cfg.database_path)
         Record.bind(db, EventBus())
+        # migrations BEFORE table creation: creating a fresh table under
+        # a renamed kind while the old one still holds data would leave
+        # the rename migration a conflicting copy to reconcile
+        from gpustack_tpu.orm.db import run_migrations
+
+        run_migrations(db)
         Record.create_all_tables(db)
         user = await User.first(username="admin")
         if user is None:

@@ -165,6 +165,42 @@ def migration(version: int, description: str):
     return deco
 
 
+@migration(1, "rename reserved-word table user -> users")
+def _migrate_user_table(conn: sqlite3.Connection) -> None:
+    # ``user`` is a PostgreSQL reserved word; the table kind is now
+    # "users". The existence probe is sqlite_master-based because
+    # migrations only ever run against the embedded sqlite store —
+    # external PG/MySQL deployments are born with the new name.
+    def table_exists(name: str) -> bool:
+        return conn.execute(
+            "SELECT name FROM sqlite_master "
+            "WHERE type='table' AND name=?", (name,)
+        ).fetchone() is not None
+
+    if not table_exists("user"):
+        return
+    if table_exists("users"):
+        # ``users`` already exists (a CLI path ran create_all_tables
+        # before migrations and may even have inserted an admin): copy
+        # only non-colliding rows — matching ids or usernames in
+        # ``users`` win, since they are the newer writes — then drop.
+        # Both tables share the generated column order (id, data,
+        # created_at, updated_at, username).
+        conn.execute(
+            "INSERT INTO users SELECT * FROM user WHERE "
+            "id NOT IN (SELECT id FROM users) AND "
+            "username NOT IN (SELECT username FROM users)"
+        )
+        conn.execute("DROP TABLE user")
+    else:
+        conn.execute("ALTER TABLE user RENAME TO users")
+    conn.execute("DROP INDEX IF EXISTS idx_user_username")
+    conn.execute(
+        "CREATE INDEX IF NOT EXISTS idx_users_username "
+        "ON users (username)"
+    )
+
+
 def run_migrations(db: Database) -> int:
     """Apply pending migrations synchronously (server startup, before the
     event loop). Mirrors the reference's migrate-on-start (reference

@@ -633,9 +633,44 @@ def add_extra_routes(app: web.Application) -> None:
             return json_error(400, str(e))
         return web.Response(text=text, content_type="text/plain")
 
+    async def observability_config(request: web.Request):
+        """Prometheus scrape config + Grafana dashboard for this cluster
+        (reference cmd/start.py:299-334 embeds the binaries; here the
+        render-don't-bundle pattern — server/observability.py). Worker
+        scrape targets come from the live fleet. Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.schemas import Cluster, Worker
+        from gpustack_tpu.server.observability import (
+            render_observability_bundle,
+        )
+
+        err = require_admin(request)
+        if err is not None:
+            return err
+        cluster = await Cluster.get(int(request.match_info["id"]))
+        if cluster is None:
+            return json_error(404, "cluster not found")
+        cfg = request.app["config"]
+        server_host = (
+            "127.0.0.1" if cfg.host in ("0.0.0.0", "::") else cfg.host
+        )
+        workers = await Worker.filter(cluster_id=cluster.id)
+        targets = sorted(
+            f"{w.ip or '127.0.0.1'}:{w.port}" for w in workers if w.port
+        )
+        return web.json_response(
+            render_observability_bundle(
+                f"{server_host}:{cfg.port}", targets
+            )
+        )
+
     app.router.add_get(
         "/v2/clusters/{id:\\d+}/manifests", cluster_manifests
     )
     app.router.add_get(
         "/v2/clusters/{id:\\d+}/gateway-config", gateway_config
+    )
+    app.router.add_get(
+        "/v2/clusters/{id:\\d+}/observability-config",
+        observability_config,
     )

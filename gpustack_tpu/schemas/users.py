@@ -14,7 +14,12 @@ API_KEY_PREFIX = "gtpu"
 
 @register_record
 class User(Record):
-    __kind__ = "user"
+    # "users", NOT "user": ``user`` is a reserved word in PostgreSQL
+    # (CREATE TABLE user is a syntax error there), and table names are
+    # interpolated unquoted into dialect-generic SQL — quoting can't
+    # save it portably (MySQL needs backticks). Migration 1 renames
+    # existing sqlite databases.
+    __kind__ = "users"
     __indexes__ = ("username",)
 
     username: str = ""

@@ -71,13 +71,37 @@ class DevManager:
             except (OSError, ValueError, KeyError):
                 os.unlink(path)
                 continue
-            try:
-                with open(f"/proc/{pid}/cmdline") as f:
-                    cmdline = f.read()
-            except OSError:
+            fingerprint = rec.get("argv", [])[:2]
+
+            def read_cmdline() -> Optional[str]:
+                try:
+                    with open(f"/proc/{pid}/cmdline") as f:
+                        return f.read()
+                except OSError:
+                    return None
+
+            cmdline = read_cmdline()
+            if cmdline is None:
                 os.unlink(path)       # already gone
                 continue
-            if all(tok in cmdline for tok in rec.get("argv", [])[:2]):
+            matches = all(tok in cmdline for tok in fingerprint)
+            if not matches:
+                # a freshly forked child still shows the PARENT's image
+                # until exec; re-probe briefly before declaring the pid
+                # recycled — shooting it then would be wrong, skipping a
+                # real just-spawned holder would double-run the command
+                for _ in range(20):
+                    _time.sleep(0.1)
+                    cmdline = read_cmdline()
+                    if cmdline is None:
+                        break
+                    matches = all(tok in cmdline for tok in fingerprint)
+                    if matches:
+                        break
+                if cmdline is None:
+                    os.unlink(path)
+                    continue
+            if matches:
                 logger.warning("reaping orphan dev holder pid %d", pid)
                 try:
                     os.killpg(pid, signal.SIGTERM)

@@ -1,0 +1,223 @@
+"""PostgreSQL-dialect DDL validation without a PG server (verdict r4
+#9): every CREATE TABLE / CREATE INDEX the ORM emits for the postgres
+dialect is checked against a minimal grammar for exactly the emitted
+subset, plus PG lexical rules the trace-based conformance test cannot
+see — unquoted identifiers must not be PG reserved words (this catches
+real failures: ``CREATE TABLE user`` is a PG syntax error), types must
+be PG types, and sqlite/mysql-isms (AUTOINCREMENT/AUTO_INCREMENT) must
+not appear. sqlglot is not in the image; the grammar below IS the
+emitted subset, so drift in _create_table_sql fails here first.
+"""
+
+import re
+
+import pytest
+
+# populate the record registry: schemas register on import, which only
+# happens as a side effect of other modules when the whole suite runs —
+# standalone execution of this file needs them explicitly
+import gpustack_tpu.schemas  # noqa: F401
+import gpustack_tpu.schemas.usage  # noqa: F401
+import gpustack_tpu.server.collectors  # noqa: F401
+from gpustack_tpu.orm.record import _REGISTRY, PK_CLAUSE
+
+# PostgreSQL reserved key words (SQL:2016 reserved set as PG documents
+# it — the ones that cannot be used as bare table/column names).
+PG_RESERVED = {
+    "all", "analyse", "analyze", "and", "any", "array", "as", "asc",
+    "asymmetric", "authorization", "binary", "both", "case", "cast",
+    "check", "collate", "collation", "column", "concurrently",
+    "constraint", "create", "cross", "current_catalog", "current_date",
+    "current_role", "current_schema", "current_time",
+    "current_timestamp", "current_user", "default", "deferrable",
+    "desc", "distinct", "do", "else", "end", "except", "false",
+    "fetch", "for", "foreign", "freeze", "from", "full", "grant",
+    "group", "having", "ilike", "in", "initially", "inner",
+    "intersect", "into", "is", "isnull", "join", "lateral", "leading",
+    "left", "like", "limit", "localtime", "localtimestamp", "natural",
+    "not", "notnull", "null", "offset", "on", "only", "or", "order",
+    "outer", "overlaps", "placing", "primary", "references",
+    "returning", "right", "select", "session_user", "similar", "some",
+    "symmetric", "table", "tablesample", "then", "to", "trailing",
+    "true", "union", "unique", "user", "using", "variadic", "verbose",
+    "when", "where", "window", "with",
+}
+
+PG_TYPES = {"text", "bigserial", "bigint", "integer", "numeric"}
+
+_IDENT = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+
+def _check_ident(tok: str) -> None:
+    assert _IDENT.match(tok), f"invalid PG identifier {tok!r}"
+    assert tok not in PG_RESERVED, (
+        f"{tok!r} is a PostgreSQL reserved word and is emitted "
+        "unquoted — rename the table/column (cf. user -> users)"
+    )
+
+
+def validate_pg_ddl(stmt: str) -> None:
+    """Minimal parser for the emitted DDL subset, PG rules."""
+    s = stmt.strip().rstrip(";")
+    assert "autoincrement" not in s.lower(), stmt
+    assert "auto_increment" not in s.lower(), stmt
+    m = re.match(
+        r"^CREATE TABLE IF NOT EXISTS (\w+) \((.*)\)$", s, re.S
+    )
+    if m:
+        _check_ident(m.group(1))
+        cols = [c.strip() for c in m.group(2).split(",")]
+        assert cols, stmt
+        for i, col in enumerate(cols):
+            toks = col.split()
+            _check_ident(toks[0])
+            assert toks[1].lower() in PG_TYPES, (
+                f"{toks[1]!r} is not a PG type in {stmt!r}"
+            )
+            tail = " ".join(toks[2:]).lower()
+            assert tail in (
+                "", "primary key", "not null", "primary key not null",
+            ), f"unsupported column constraint {tail!r} in {stmt!r}"
+        # exactly one primary key, on the first column
+        pks = [c for c in cols if "PRIMARY KEY" in c.upper()]
+        assert len(pks) == 1 and cols[0] == pks[0], stmt
+        return
+    m = re.match(
+        r"^CREATE INDEX IF NOT EXISTS (\w+) ON (\w+) \((.*)\)$", s
+    )
+    if m:
+        _check_ident(m.group(1))
+        _check_ident(m.group(2))
+        for col in m.group(3).split(","):
+            _check_ident(col.strip())
+        return
+    raise AssertionError(f"statement outside the emitted subset: {stmt}")
+
+
+def test_every_table_pg_ddl_validates():
+    assert len(_REGISTRY) >= 15   # the whole schema set is registered
+    for cls in _REGISTRY.values():
+        for stmt in cls._create_table_sql(dialect="postgres"):
+            validate_pg_ddl(stmt)
+
+
+def test_pg_pk_clause_is_pg():
+    assert PK_CLAUSE["postgres"] == "id BIGSERIAL PRIMARY KEY"
+    validate_pg_ddl(
+        f"CREATE TABLE IF NOT EXISTS t ({PK_CLAUSE['postgres']}, "
+        "data TEXT NOT NULL)"
+    )
+
+
+def test_validator_rejects_known_bad_ddl():
+    with pytest.raises(AssertionError, match="reserved word"):
+        validate_pg_ddl(
+            "CREATE TABLE IF NOT EXISTS user (id BIGSERIAL PRIMARY KEY)"
+        )
+    with pytest.raises(AssertionError):
+        validate_pg_ddl(
+            "CREATE TABLE IF NOT EXISTS t "
+            "(id INTEGER PRIMARY KEY AUTOINCREMENT)"
+        )
+    with pytest.raises(AssertionError):
+        validate_pg_ddl("CREATE TABLE t (id BIGSERIAL PRIMARY KEY)")
+    with pytest.raises(AssertionError, match="not a PG type"):
+        validate_pg_ddl(
+            "CREATE TABLE IF NOT EXISTS t (id BLOB PRIMARY KEY)"
+        )
+
+
+def test_no_registered_kind_or_index_is_reserved():
+    """The lexical rule applied to the live registry directly (indexes
+    become bare column names in every dialect)."""
+    for cls in _REGISTRY.values():
+        _check_ident(cls.__kind__)
+        for f in cls.__indexes__:
+            _check_ident(f)
+
+
+def test_user_table_migration_renames_and_preserves_rows(tmp_path):
+    """Migration 1: an old database with the reserved-word ``user``
+    table comes out as ``users`` with rows intact."""
+    import sqlite3
+
+    from gpustack_tpu.orm.db import Database, run_migrations
+
+    path = str(tmp_path / "old.db")
+    conn = sqlite3.connect(path)
+    conn.execute(
+        "CREATE TABLE user (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+        "data TEXT NOT NULL, created_at TEXT, updated_at TEXT, "
+        "username TEXT)"
+    )
+    conn.execute(
+        "INSERT INTO user (data, created_at, updated_at, username) "
+        "VALUES ('{\"username\": \"admin\"}', 't', 't', 'admin')"
+    )
+    conn.commit()
+    conn.close()
+
+    db = Database(path)
+    try:
+        run_migrations(db)
+        rows = db.execute_sync("SELECT username FROM users")
+        assert [r["username"] for r in rows] == ["admin"]
+        none = db.execute_sync(
+            "SELECT name FROM sqlite_master WHERE name='user'"
+        )
+        assert not none
+        # idempotent
+        run_migrations(db)
+    finally:
+        db.close()
+
+
+def test_user_table_migration_survives_fresh_users_table(tmp_path):
+    """The brick scenario: a CLI path created a fresh ``users`` (with a
+    conflicting admin id) while the old ``user`` table still holds data.
+    Migration must reconcile instead of raising IntegrityError on every
+    subsequent server start."""
+    import sqlite3
+
+    from gpustack_tpu.orm.db import Database, run_migrations
+
+    path = str(tmp_path / "brick.db")
+    conn = sqlite3.connect(path)
+    for table in ("user", "users"):
+        conn.execute(
+            f"CREATE TABLE {table} "
+            "(id INTEGER PRIMARY KEY AUTOINCREMENT, "
+            "data TEXT NOT NULL, created_at TEXT, updated_at TEXT, "
+            "username TEXT)"
+        )
+    # old table: admin (id 1) + alice (id 2); new table: freshly reset
+    # admin (id 1) — newer write, must win
+    conn.execute(
+        "INSERT INTO user VALUES (1, '{\"v\": \"old-admin\"}', "
+        "'t', 't', 'admin')"
+    )
+    conn.execute(
+        "INSERT INTO user VALUES (2, '{\"v\": \"alice\"}', "
+        "'t', 't', 'alice')"
+    )
+    conn.execute(
+        "INSERT INTO users VALUES (1, '{\"v\": \"new-admin\"}', "
+        "'t', 't', 'admin')"
+    )
+    conn.commit()
+    conn.close()
+
+    db = Database(path)
+    try:
+        run_migrations(db)
+        rows = db.execute_sync(
+            "SELECT username, data FROM users ORDER BY id"
+        )
+        got = {r["username"]: r["data"] for r in rows}
+        assert set(got) == {"admin", "alice"}
+        assert "new-admin" in got["admin"]      # newer write won
+        assert not db.execute_sync(
+            "SELECT name FROM sqlite_master WHERE name='user'"
+        )
+    finally:
+        db.close()

@@ -1,0 +1,163 @@
+"""Contract tests for the rendered L7 gateway configs and the
+observability bundle (verdict r4 #7/#8): the emitted artifacts are
+structurally validated so server/gateway.py and server/observability.py
+can't silently drift — losing the SSE-buffering stanza or referencing a
+metric no exporter emits must fail CI, not a production rollout.
+"""
+
+import json
+
+import pytest
+
+from gpustack_tpu.server.gateway import render_gateway_config
+from gpustack_tpu.server.observability import (
+    build_grafana_dashboard,
+    dashboard_metric_names,
+    render_observability_bundle,
+    render_prometheus_config,
+)
+
+
+# ---------------------------------------------------------------------------
+# gateway configs (#8)
+# ---------------------------------------------------------------------------
+
+
+def test_nginx_config_keeps_streaming_and_ws_stanzas():
+    text = render_gateway_config("nginx", "10.0.0.1", 8080,
+                                 server_name="gs.example.com")
+    # upstream wiring
+    assert "server 10.0.0.1:8080;" in text
+    assert "server_name gs.example.com;" in text
+    # SSE token streams die with buffering on or short read timeouts
+    assert "proxy_buffering off;" in text
+    assert "proxy_read_timeout 3600s;" in text
+    # worker tunnel + watch streams need the websocket upgrade pair
+    assert "proxy_set_header Upgrade $http_upgrade;" in text
+    assert 'proxy_set_header Connection "upgrade";' in text
+    # audio uploads need the body cap
+    assert "client_max_body_size 256m;" in text
+    # structural sanity: braces balance (nginx would reject otherwise)
+    assert text.count("{") == text.count("}")
+
+
+def test_nginx_ipv6_upstream_bracketed():
+    text = render_gateway_config("nginx", "::1", 8080)
+    assert "server [::1]:8080;" in text
+
+
+def test_envoy_config_is_valid_yaml_with_required_shape():
+    yaml = pytest.importorskip("yaml")
+    text = render_gateway_config("envoy", "10.0.0.1", 8080,
+                                 server_name="gs.example.com")
+    doc = yaml.safe_load(text)
+    listener = doc["static_resources"]["listeners"][0]
+    hcm = listener["filter_chains"][0]["filters"][0]["typed_config"]
+    # websocket upgrade stanza
+    assert {"upgrade_type": "websocket"} in hcm["upgrade_configs"]
+    # SSE-friendly idle timeout
+    assert hcm["stream_idle_timeout"] == "3600s"
+    vh = hcm["route_config"]["virtual_hosts"][0]
+    assert "gs.example.com" in vh["domains"]
+    assert vh["routes"][0]["route"]["timeout"] == "3600s"
+    # upstream cluster endpoint
+    cluster = doc["static_resources"]["clusters"][0]
+    ep = cluster["load_assignment"]["endpoints"][0]["lb_endpoints"][0]
+    addr = ep["endpoint"]["address"]["socket_address"]
+    assert addr == {"address": "10.0.0.1", "port_value": 8080}
+    # TLS termination present
+    assert "transport_socket" in listener["filter_chains"][0]
+
+
+def test_gateway_rejects_unsafe_names():
+    with pytest.raises(ValueError):
+        render_gateway_config("nginx", "10.0.0.1;inject", 8080)
+    with pytest.raises(ValueError):
+        render_gateway_config(
+            "nginx", "10.0.0.1", 8080, server_name="a b"
+        )
+
+
+# ---------------------------------------------------------------------------
+# observability bundle (#7)
+# ---------------------------------------------------------------------------
+
+
+def _exported_metric_names():
+    """Every series name the system actually exports: the workers'
+    normalized engine metrics (worker/metrics_map.py, with histogram
+    suffixes) and the server exporter's gpustack_* series
+    (server/exporter.py)."""
+    from gpustack_tpu.worker.metrics_map import METRIC_MAP
+
+    names = set()
+    for mapped in METRIC_MAP.values():
+        names.add(mapped)
+        if mapped.endswith("_seconds"):
+            names.update(
+                mapped + s for s in ("_bucket", "_sum", "_count")
+            )
+    # server exporter series (source-scanned so additions are picked up)
+    import inspect
+
+    from gpustack_tpu.server import exporter
+
+    src = inspect.getsource(exporter)
+    import re
+
+    for m in re.finditer(r"# TYPE (gpustack[a-zA-Z0-9_:]*)", src):
+        names.add(m.group(1))
+    return names
+
+
+def test_grafana_dashboard_queries_reference_real_metrics():
+    dash = build_grafana_dashboard()
+    exported = _exported_metric_names()
+    referenced = dashboard_metric_names(dash)
+    assert referenced, "dashboard has no queries"
+    missing = [n for n in referenced if n not in exported]
+    assert not missing, (
+        f"dashboard references unexported metrics: {missing}; "
+        f"exported: {sorted(exported)}"
+    )
+
+
+def test_grafana_dashboard_json_roundtrip_and_shape():
+    dash = build_grafana_dashboard()
+    # must survive the JSON model import path
+    clone = json.loads(json.dumps(dash))
+    assert clone["uid"] == "gpustack-tpu-cluster"
+    assert len(clone["panels"]) >= 8
+    ids = [p["id"] for p in clone["panels"]]
+    assert len(set(ids)) == len(ids), "duplicate panel ids"
+    for p in clone["panels"]:
+        assert p["targets"], p["title"]
+        assert all(t["expr"] for t in p["targets"])
+        assert {"h", "w", "x", "y"} <= set(p["gridPos"])
+    # latency panels exist and use the histogram series
+    titles = " ".join(p["title"] for p in clone["panels"])
+    assert "TTFT" in titles and "TPOT" in titles
+
+
+def test_prometheus_config_is_valid_yaml_with_all_jobs():
+    yaml = pytest.importorskip("yaml")
+    text = render_prometheus_config(
+        "10.0.0.1:8080", ["10.0.0.2:10150", "10.0.0.3:10150"]
+    )
+    doc = yaml.safe_load(text)
+    jobs = {j["job_name"]: j for j in doc["scrape_configs"]}
+    assert {"gpustack-server", "gpustack-workers",
+            "gpustack-workers-raw"} <= set(jobs)
+    assert jobs["gpustack-server"]["static_configs"][0]["targets"] == [
+        "10.0.0.1:8080"
+    ]
+    assert jobs["gpustack-workers"]["static_configs"][0]["targets"] == [
+        "10.0.0.2:10150", "10.0.0.3:10150"
+    ]
+    assert jobs["gpustack-workers-raw"]["metrics_path"] == "/metrics/raw"
+
+
+def test_bundle_shape():
+    bundle = render_observability_bundle("1.2.3.4:80", ["5.6.7.8:10150"])
+    assert {"prometheus_yml", "grafana_dashboard", "notes"} <= set(bundle)
+    assert "5.6.7.8:10150" in bundle["prometheus_yml"]
