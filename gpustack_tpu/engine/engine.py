@@ -558,21 +558,35 @@ class LLMEngine:
             # abandon the remaining chunks; the slot never activated
             del self._chunk_jobs[slot]
             self._free.append(slot)
+            abort_op = getattr(self.runner, "chunk_abort", None)
+            if abort_op is not None and job.done > 0:
+                # multi-host: followers drop their chunk register too,
+                # or the aborted prompt's partial K/V stays pinned in
+                # device memory until the next chunked job
+                abort_op()
             self._finish_aborted(job.req)
             return True
         start = job.done
         chunk = job.ids[start : start + self.prefill_chunk]
+        # chunk-specific runner entry points exist on the multi-host
+        # BroadcastingRunner (separate follower register + no device
+        # arrays on the wire); the single-host runner serves both roles
+        # with its plain methods
+        r = self.runner
         if start == 0:
-            b = self.runner.bucket_for(len(chunk))
+            b = r.bucket_for(len(chunk))
             padded = list(chunk) + [0] * (b - len(chunk))
-            job.last, job.k, job.v = self.runner.prefill(
-                padded, len(chunk)
-            )
+            fn = getattr(r, "prefill_chunk", None) or r.prefill
+            job.last, job.k, job.v = fn(padded, len(chunk))
         else:
-            sb = self.runner.bucket_for(len(chunk))
-            total_bucket = self.runner.bucket_for(start + sb)
+            sb = r.bucket_for(len(chunk))
+            total_bucket = r.bucket_for(start + sb)
             padded = list(chunk) + [0] * (sb - len(chunk))
-            job.last, job.k, job.v = self.runner.prefill_with_prefix(
+            fn = (
+                getattr(r, "prefill_continue_chunk", None)
+                or r.prefill_with_prefix
+            )
+            job.last, job.k, job.v = fn(
                 job.k, job.v, start, padded, len(chunk), total_bucket
             )
         job.done += len(chunk)
@@ -590,6 +604,11 @@ class LLMEngine:
                 self._store_host_kv(
                     key, job.last, job.k, job.v, ids, bucket
                 )
+            commit = getattr(self.runner, "chunk_commit", None)
+            if commit is not None:
+                # multi-host: followers promote their chunk register so
+                # the sample_first/insert pair replays the right arrays
+                commit()
             self._finalize_start(slot, job.req, job.last, job.k, job.v)
         return True
 

@@ -18,10 +18,15 @@ Determinism contract:
 - Device arrays never ride the wire. A follower's ``prefill`` output is
   registered locally and consumed by its next ``insert`` — the engine's
   scheduling loop is single-threaded, so prefill→insert order is stable.
-- Features whose host round-trips would diverge across processes
-  (host KV cache, chunked prefill, speculative decoding, embeddings,
-  VLM overrides) are disabled at command build for multi-host
-  placements (worker/backends.py) and rejected here defensively.
+- Chunked prefill IS supported multi-host: the chunk schedule is
+  deterministic host-side arithmetic, so chunk_start/chunk_continue/
+  chunk_commit ops replay it with a dedicated follower register (no
+  device arrays on the wire).
+- Features whose host round-trips genuinely diverge across processes
+  (host KV cache — leader-RAM contents with a nondeterministic async
+  copy worker; speculative decoding; embeddings; VLM overrides) are
+  disabled at command build for multi-host placements
+  (worker/backends.py) and rejected here defensively.
 
 The channel binds ``coordinator_port + 1`` on the leader host (the
 scheduler allocates coordinator ports in even-aligned pairs so the +1 is
@@ -250,6 +255,48 @@ class BroadcastingRunner:
         )
         return self._runner.decode_step(state, key)
 
+    # -- chunked prefill (engine._advance_chunk) --------------------------
+    # Chunk ops keep their own follower register so one-shot prefills
+    # admitted BETWEEN chunks (the scheduling loop interleaves decode
+    # and admission with chunk advancement) can't clobber the
+    # in-progress job's accumulated K/V. Only device-free arguments ride
+    # the wire — the follower's continuation consumes ITS OWN previous
+    # chunk's arrays, which are bit-identical by replay determinism.
+
+    def prefill_chunk(self, token_ids, true_len: int):
+        self._leader.broadcast({
+            "op": "chunk_start",
+            "ids": [int(t) for t in token_ids],
+            "true_len": int(true_len),
+        })
+        return self._runner.prefill(token_ids, true_len)
+
+    def prefill_continue_chunk(
+        self, k, v, start: int, token_ids, true_len: int,
+        total_bucket: int,
+    ):
+        self._leader.broadcast({
+            "op": "chunk_continue",
+            "start": int(start),
+            "ids": [int(t) for t in token_ids],
+            "true_len": int(true_len),
+            "total_bucket": int(total_bucket),
+        })
+        return self._runner.prefill_with_prefix(
+            k, v, start, token_ids, true_len, total_bucket
+        )
+
+    def chunk_commit(self) -> None:
+        """Completed chunk job: the follower promotes its chunk register
+        to the insert register so the following sample_first/insert pair
+        replays against the right arrays."""
+        self._leader.broadcast({"op": "chunk_commit"})
+
+    def chunk_abort(self) -> None:
+        """Abandoned chunk job (client abort): followers drop their
+        chunk register so the partial K/V doesn't stay pinned in HBM."""
+        self._leader.broadcast({"op": "chunk_abort"})
+
     def deactivate(self, state, slot: int):
         self._leader.broadcast({"op": "deactivate", "slot": int(slot)})
         return self._runner.deactivate(state, slot)
@@ -303,6 +350,9 @@ class FollowerLoop:
         # of collective-bearing calls from process start.
         self.state = state
         self._reg: Optional[tuple] = None    # latest (last, k, v) prefill
+        # in-progress chunked prefill's (last, k, v) — separate from
+        # _reg so interleaved one-shot prefills can't clobber it
+        self._chunk_reg: Optional[tuple] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.ops_applied = 0
@@ -370,6 +420,25 @@ class FollowerLoop:
 
         if kind == "prefill":
             self._reg = r.prefill(op["ids"], op["true_len"])
+        elif kind == "chunk_start":
+            self._chunk_reg = r.prefill(op["ids"], op["true_len"])
+        elif kind == "chunk_continue":
+            assert self._chunk_reg is not None, (
+                "chunk_continue before chunk_start"
+            )
+            _, k, v = self._chunk_reg
+            self._chunk_reg = r.prefill_with_prefix(
+                k, v, op["start"], op["ids"], op["true_len"],
+                op["total_bucket"],
+            )
+        elif kind == "chunk_commit":
+            assert self._chunk_reg is not None, (
+                "chunk_commit before chunk_start"
+            )
+            self._reg = self._chunk_reg
+            self._chunk_reg = None
+        elif kind == "chunk_abort":
+            self._chunk_reg = None
         elif kind == "sample_first":
             assert self._reg is not None, "sample_first before prefill"
             r.sample_first(

@@ -181,16 +181,38 @@ def _migrate_user_table(conn: sqlite3.Connection) -> None:
         return
     if table_exists("users"):
         # ``users`` already exists (a CLI path ran create_all_tables
-        # before migrations and may even have inserted an admin): copy
-        # only non-colliding rows — matching ids or usernames in
-        # ``users`` win, since they are the newer writes — then drop.
-        # Both tables share the generated column order (id, data,
-        # created_at, updated_at, username).
+        # before migrations and may even have inserted an admin).
+        # Reconcile WITHOUT losing accounts: same-username rows in
+        # ``users`` win (newer writes); other old rows keep their id
+        # when it's free, else re-insert under a fresh id (logged —
+        # records referencing the old id, e.g. api keys, need the
+        # operator's attention). Both tables share the generated column
+        # order (id, data, created_at, updated_at, username).
         conn.execute(
             "INSERT INTO users SELECT * FROM user WHERE "
             "id NOT IN (SELECT id FROM users) AND "
             "username NOT IN (SELECT username FROM users)"
         )
+        remapped = conn.execute(
+            "SELECT id, username FROM user WHERE "
+            "id IN (SELECT id FROM users) AND "
+            "username NOT IN (SELECT username FROM users)"
+        ).fetchall()
+        if remapped:
+            conn.execute(
+                "INSERT INTO users (data, created_at, updated_at, "
+                "username) SELECT data, created_at, updated_at, "
+                "username FROM user WHERE "
+                "id IN (SELECT id FROM users) AND "
+                "username NOT IN (SELECT username FROM users)"
+            )
+            logger.warning(
+                "user->users migration re-inserted %d user(s) under "
+                "fresh ids (old id taken): %s — records referencing "
+                "the old user id must be reviewed",
+                len(remapped),
+                ", ".join(f"{r[1]} (was id {r[0]})" for r in remapped),
+            )
         conn.execute("DROP TABLE user")
     else:
         conn.execute("ALTER TABLE user RENAME TO users")

@@ -644,6 +644,8 @@ def add_extra_routes(app: web.Application) -> None:
             render_observability_bundle,
         )
 
+        from gpustack_tpu.server.observability import hostport
+
         err = require_admin(request)
         if err is not None:
             return err
@@ -651,16 +653,20 @@ def add_extra_routes(app: web.Application) -> None:
         if cluster is None:
             return json_error(404, "cluster not found")
         cfg = request.app["config"]
-        server_host = (
+        # ?server_host= override (same contract as gateway-config's
+        # upstream_host): Prometheus usually runs on another machine,
+        # where a 127.0.0.1 fallback would scrape ITSELF
+        server_host = request.query.get("server_host") or (
             "127.0.0.1" if cfg.host in ("0.0.0.0", "::") else cfg.host
         )
         workers = await Worker.filter(cluster_id=cluster.id)
         targets = sorted(
-            f"{w.ip or '127.0.0.1'}:{w.port}" for w in workers if w.port
+            hostport(w.ip or "127.0.0.1", w.port)
+            for w in workers if w.port
         )
         return web.json_response(
             render_observability_bundle(
-                f"{server_host}:{cfg.port}", targets
+                hostport(server_host, cfg.port), targets
             )
         )
 

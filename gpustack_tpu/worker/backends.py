@@ -172,10 +172,12 @@ def _tpu_native_command(
     for adapter in model.lora_adapters:
         argv += ["--lora", adapter]
     multi_host = bool(instance.coordinator_address)
-    if model.prefill_chunk and not multi_host:
-        # single-host only: chunked prefill's host-side chunk scheduling
-        # would have to be replayed op-for-op on follower hosts
-        # (engine/multihost.py keeps the broadcast vocabulary minimal)
+    if model.prefill_chunk:
+        # multi-host too: the chunk schedule replays op-for-op on
+        # follower hosts via the chunk_start/chunk_continue/chunk_commit
+        # broadcast vocabulary (engine/multihost.py) — long prompts on
+        # the placements that need chunking most (70B-class multi-host)
+        # no longer lose it
         argv += ["--prefill-chunk", str(model.prefill_chunk)]
     if model.host_kv_cache_mb and not multi_host:
         # single-host only: on multi-host meshes the prefill K/V spans

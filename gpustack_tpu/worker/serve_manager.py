@@ -550,11 +550,27 @@ class ServeManager:
             )
 
     def _allocate_port(self) -> int:
+        from gpustack_tpu.scheduler.scheduler import (
+            COORDINATOR_PORT_BASE,
+            COORDINATOR_PORT_RANGE,
+        )
+
         used = {r.port for r in self.running.values()}
         base = self.cfg.engine_port_base
+        coord_band = range(
+            COORDINATOR_PORT_BASE,
+            COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE,
+        )
         for offset in range(self.cfg.engine_port_range):
             port = base + offset
             if port in used:
+                continue
+            if port in coord_band:
+                # a misconfigured engine_port_base overlapping the
+                # scheduler's coordinator band would brick multi-host
+                # placements subtly (the engine API server binds the
+                # port its own jax.distributed coordinator needs —
+                # first startup works, every restart collides)
                 continue
             with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
                 try:
@@ -562,4 +578,10 @@ class ServeManager:
                 except OSError:
                     continue
             return port
-        raise RuntimeError("no free engine ports")
+        raise RuntimeError(
+            "no free engine ports (band "
+            f"{base}..{base + self.cfg.engine_port_range}; note the "
+            f"coordinator band {COORDINATOR_PORT_BASE}.."
+            f"{COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE} is "
+            "excluded)"
+        )

@@ -93,7 +93,13 @@ class WorkerAgent:
         self.serve_manager = ServeManager(
             self.cfg, self.client, self.worker_id
         )
-        self.serve_manager.reap_orphans()
+        # reaps block on /proc probes and grace waits — keep them off
+        # the event loop so /healthz and registration stay responsive
+        # during startup cleanup after a crash
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.serve_manager.reap_orphans
+        )
         from gpustack_tpu.worker.benchmark_manager import BenchmarkManager
 
         self.benchmark_manager = BenchmarkManager(
@@ -104,7 +110,7 @@ class WorkerAgent:
         self.dev_manager = DevManager(
             self.cfg, self.client, self.worker_id
         )
-        self.dev_manager.reap_orphans()
+        await loop.run_in_executor(None, self.dev_manager.reap_orphans)
         # push one status immediately so the scheduler sees chips
         await self._post_status_once()
         # converge with the server's view (restart recovery: zombie

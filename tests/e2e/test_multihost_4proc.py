@@ -1,0 +1,240 @@
+"""Four-process multi-host serving e2e WITH chunked prefill
+(verdict r4 #5): one replica over 4 worker hosts (2 chips each — the
+8-chip v4 slice split four ways), engines rendezvous over
+jax.distributed, the leader broadcasts ops — including the
+chunk_start/chunk_continue/chunk_commit vocabulary — to THREE
+followers, and a long-prompt completion (forced through chunked
+prefill by the model's prefill_chunk) flows through the server proxy.
+
+The 2-process e2e (test_multihost.py) covers follower-loss teardown;
+this one proves the wider fan-out shape and the multihost chunked
+prefill path end-to-end. Budgets are generous: five jit-compiling
+processes share one CPU.
+"""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import aiohttp
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "workers")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_worker(server_port, data_dir, fixture, name, port_base):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["GPUSTACK_TPU_HEARTBEAT_INTERVAL"] = "1.0"
+    env["GPUSTACK_TPU_STATUS_INTERVAL"] = "2.0"
+    env["GPUSTACK_TPU_ENGINE_PORT_BASE"] = str(port_base)
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "gpustack_tpu", "start",
+            "--server-url", f"http://127.0.0.1:{server_port}",
+            "--data-dir", data_dir,
+            "--registration-token", "mh4-token",
+            "--fake-detector", os.path.join(FIXTURES, fixture),
+            "--force-platform", "cpu",
+            "--worker-port", "0",
+            "--worker-name", name,
+        ],
+        env=env,
+        stdout=open(os.path.join(data_dir, "agent.log"), "ab"),
+        stderr=subprocess.STDOUT,
+    )
+
+
+def test_four_process_replica_with_chunked_prefill(tmp_path):
+    from gpustack_tpu.config import Config
+    from gpustack_tpu.server.server import Server
+
+    server_port = _free_port()
+    cfg = Config.load(
+        {
+            "host": "127.0.0.1",
+            "port": server_port,
+            "data_dir": str(tmp_path / "server"),
+            "registration_token": "mh4-token",
+            "bootstrap_password": "mh4-pass",
+            "disable_worker": True,
+            "heartbeat_interval": 1.0,
+        }
+    )
+    dirs = [str(tmp_path / f"w{i}") for i in range(4)]
+    for d in dirs:
+        os.makedirs(d)
+
+    async def go():
+        server = Server(cfg)
+        await server.start()
+        server.scheduler.scan_interval = 2.0
+        base = f"http://127.0.0.1:{server_port}"
+        workers = []
+        try:
+            for i in range(4):
+                workers.append(_spawn_worker(
+                    server_port, dirs[i], f"v4_8_quarter{i}.json",
+                    f"host{i}", port_base=40000 + 3000 * i,
+                ))
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    f"{base}/auth/login",
+                    json={"username": "admin", "password": "mh4-pass"},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    token = (await r.json())["token"]
+                hdrs = {"Authorization": f"Bearer {token}"}
+
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    async with http.get(
+                        f"{base}/v2/workers", headers=hdrs
+                    ) as r:
+                        items = (await r.json())["items"]
+                    ready = [
+                        w for w in items
+                        if w["state"] == "ready" and w["status"]["chips"]
+                    ]
+                    if len(ready) == 4:
+                        break
+                    await asyncio.sleep(1.0)
+                else:
+                    raise AssertionError(f"4 workers never ready: {items}")
+
+                # one replica over all 8 chips = 4 hosts; prefill_chunk
+                # forces the chunk broadcast vocabulary on real prompts
+                async with http.post(
+                    f"{base}/v2/models",
+                    headers=hdrs,
+                    json={
+                        "name": "mh4-tiny",
+                        "preset": "tiny",
+                        "replicas": 1,
+                        "chips_per_replica": 8,
+                        "max_seq_len": 512,
+                        "max_slots": 8,
+                        "prefill_chunk": 32,
+                    },
+                ) as r:
+                    assert r.status == 201, await r.text()
+
+                inst = await _wait_instance(
+                    http, base, hdrs,
+                    lambda i: i["state"] in (
+                        "scheduled", "starting", "downloading", "running"
+                    ),
+                    90, "instance never scheduled",
+                )
+                assert len(inst["subordinate_workers"]) == 3, inst
+                assert inst["coordinator_address"], inst
+
+                inst = await _wait_instance(
+                    http, base, hdrs,
+                    lambda i: i["state"] == "running",
+                    600, "4-process replica never RUNNING",
+                    fail_state="error",
+                )
+
+                # a LONG prompt (> prefill_chunk after tokenization)
+                # through the proxy: served via chunked prefill
+                # broadcast to 3 followers
+                # ~30 words ≈ 240 byte-tokens: > prefill_chunk (32) so
+                # the chunk path runs, < max_seq_len (512) so it fits
+                long_text = " ".join(f"word{i}" for i in range(30))
+                async with http.post(
+                    f"{base}/v1/chat/completions",
+                    headers=hdrs,
+                    json={
+                        "model": "mh4-tiny",
+                        "messages": [
+                            {"role": "user", "content": long_text}
+                        ],
+                        "max_tokens": 4,
+                        "temperature": 0,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=600),
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["usage"]["completion_tokens"] >= 1
+                assert data["usage"]["prompt_tokens"] > 32
+
+                # a second, short request proves the replica stayed
+                # healthy after the chunked path (follower registers
+                # promoted correctly — a desync would hang collectives)
+                async with http.post(
+                    f"{base}/v1/chat/completions",
+                    headers=hdrs,
+                    json={
+                        "model": "mh4-tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4,
+                        "temperature": 0,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=300),
+                ) as r:
+                    assert r.status == 200, await r.text()
+        finally:
+            for w in workers:
+                if w.poll() is None:
+                    w.send_signal(signal.SIGKILL)
+            for d in dirs:
+                _kill_engines_under(d)
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def _kill_engines_under(data_dir) -> int:
+    import json as _json
+
+    killed = 0
+    log_dir = os.path.join(data_dir, "instance-logs")
+    if not os.path.isdir(log_dir):
+        return 0
+    for fname in os.listdir(log_dir):
+        if not fname.endswith(".pid"):
+            continue
+        try:
+            with open(os.path.join(log_dir, fname)) as f:
+                pid = int(_json.loads(f.read())["pid"])
+            os.kill(pid, signal.SIGKILL)
+            killed += 1
+        except (OSError, ValueError, KeyError):
+            continue
+    return killed
+
+
+async def _wait_instance(
+    http, base, hdrs, pred, budget_s, fail_msg, fail_state=None
+):
+    deadline = time.time() + budget_s
+    last = None
+    while time.time() < deadline:
+        async with http.get(
+            f"{base}/v2/model-instances", headers=hdrs
+        ) as r:
+            items = (await r.json())["items"]
+        if items:
+            last = items[0]
+            if pred(last):
+                return last
+            if fail_state and last["state"] == fail_state:
+                raise AssertionError(
+                    f"instance errored: {last['state_message']}"
+                )
+        await asyncio.sleep(1.5)
+    raise AssertionError(f"{fail_msg}; last: {last}")

@@ -190,15 +190,21 @@ def test_user_table_migration_survives_fresh_users_table(tmp_path):
             "data TEXT NOT NULL, created_at TEXT, updated_at TEXT, "
             "username TEXT)"
         )
-    # old table: admin (id 1) + alice (id 2); new table: freshly reset
-    # admin (id 1) — newer write, must win
+    # old table: alice (id 1 — COLLIDES with the fresh admin's id),
+    # old-admin (id 3), bob (id 7 — free). new table: freshly reset
+    # admin (id 1) — newer write, must win for 'admin'; alice must
+    # survive under a fresh id, never be dropped.
     conn.execute(
-        "INSERT INTO user VALUES (1, '{\"v\": \"old-admin\"}', "
+        "INSERT INTO user VALUES (1, '{\"v\": \"alice\"}', "
+        "'t', 't', 'alice')"
+    )
+    conn.execute(
+        "INSERT INTO user VALUES (3, '{\"v\": \"old-admin\"}', "
         "'t', 't', 'admin')"
     )
     conn.execute(
-        "INSERT INTO user VALUES (2, '{\"v\": \"alice\"}', "
-        "'t', 't', 'alice')"
+        "INSERT INTO user VALUES (7, '{\"v\": \"bob\"}', "
+        "'t', 't', 'bob')"
     )
     conn.execute(
         "INSERT INTO users VALUES (1, '{\"v\": \"new-admin\"}', "
@@ -211,11 +217,14 @@ def test_user_table_migration_survives_fresh_users_table(tmp_path):
     try:
         run_migrations(db)
         rows = db.execute_sync(
-            "SELECT username, data FROM users ORDER BY id"
+            "SELECT id, username, data FROM users ORDER BY id"
         )
-        got = {r["username"]: r["data"] for r in rows}
-        assert set(got) == {"admin", "alice"}
-        assert "new-admin" in got["admin"]      # newer write won
+        got = {r["username"]: (r["id"], r["data"]) for r in rows}
+        assert set(got) == {"admin", "alice", "bob"}
+        assert "new-admin" in got["admin"][1]   # newer write won
+        assert got["admin"][0] == 1
+        assert got["bob"][0] == 7               # free id preserved
+        assert got["alice"][0] not in (1,)      # remapped, not dropped
         assert not db.execute_sync(
             "SELECT name FROM sqlite_master WHERE name='user'"
         )

@@ -161,3 +161,11 @@ def test_bundle_shape():
     bundle = render_observability_bundle("1.2.3.4:80", ["5.6.7.8:10150"])
     assert {"prometheus_yml", "grafana_dashboard", "notes"} <= set(bundle)
     assert "5.6.7.8:10150" in bundle["prometheus_yml"]
+
+
+def test_prometheus_targets_bracket_ipv6():
+    from gpustack_tpu.server.observability import hostport
+
+    assert hostport("fd00::2", 10150) == "[fd00::2]:10150"
+    assert hostport("10.0.0.1", 80) == "10.0.0.1:80"
+    assert hostport("[fd00::2]", 80) == "[fd00::2]:80"
