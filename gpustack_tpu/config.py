@@ -69,6 +69,11 @@ class Config(pydantic.BaseModel):
     # community backend catalog: local JSON path or HTTPS URL
     # (server/backend_catalog.py); empty = sync disabled
     backend_catalog_url: str = ""
+    # multi-server tunnel federation (tunnel/federation.py — reference
+    # websocket_proxy peers): [{name, url, token, cidrs: [...]}, ...];
+    # worker-bound requests whose worker IP longest-prefix-matches a
+    # peer's CIDR are forwarded to that peer
+    federation_peers: list = []
     # external base URL for the OIDC redirect_uri (defaults to the
     # request's own host)
     external_url: str = ""

@@ -12,6 +12,7 @@ import asyncio
 import json
 import logging
 
+import aiohttp
 from aiohttp import web
 
 from gpustack_tpu.routes.crud import json_error
@@ -680,3 +681,129 @@ def add_extra_routes(app: web.Application) -> None:
         "/v2/clusters/{id:\\d+}/observability-config",
         observability_config,
     )
+
+    # ---- multi-server tunnel federation (tunnel/federation.py;
+    # reference websocket_proxy/main.py peers + patricia_trie routing)
+
+    async def federation_peers(request: web.Request):
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
+        reg = request.app["federation"]
+        return web.json_response(
+            {"items": [p.to_public() for p in reg.peers()]}
+        )
+
+    async def federation_peer_upsert(request: web.Request):
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.tunnel.federation import FederationPeer
+
+        if err := require_admin(request):
+            return err
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return json_error(400, "body must be a JSON object")
+        name = str(body.get("name", "")).strip()
+        url = str(body.get("url", "")).strip()
+        cidrs = body.get("cidrs", [])
+        if not name or not url or not isinstance(cidrs, list):
+            return json_error(
+                400, "'name', 'url' and 'cidrs' (list) are required"
+            )
+        peer = FederationPeer(
+            name, url, str(body.get("token", "")),
+            [str(c) for c in cidrs],
+        )
+        try:
+            request.app["federation"].upsert(peer)
+        except ValueError as e:
+            return json_error(400, f"invalid CIDR: {e}")
+        return web.json_response(peer.to_public(), status=201)
+
+    async def federation_peer_delete(request: web.Request):
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
+        if not request.app["federation"].remove(
+            request.match_info["name"]
+        ):
+            return json_error(404, "peer not found")
+        return web.json_response({"deleted": True})
+
+    async def federation_forward(request: web.Request):
+        """Peer-side hop: replay a worker-bound request through THIS
+        server's own worker path (tunnel or direct). Loop-protected —
+        a forwarded request never re-federates."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.schemas import Worker
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        if err := require_admin(request):
+            return err
+        if request.headers.get("X-GPUStack-Federated") != "1":
+            # the hop marker is mandatory protocol surface: it is how a
+            # peer knows this request already federated once, and it
+            # backs the allow_federation=False guard below
+            return json_error(
+                400, "not a federation hop (X-GPUStack-Federated "
+                "header missing)"
+            )
+        worker_ip = request.headers.get("X-GPUStack-Worker-Ip", "")
+        worker_port = request.headers.get("X-GPUStack-Worker-Port", "")
+        method = request.headers.get("X-GPUStack-Forward-Method", "GET")
+        path = request.headers.get("X-GPUStack-Forward-Path", "")
+        if not worker_ip or not path.startswith("/"):
+            return json_error(
+                400,
+                "X-GPUStack-Worker-Ip and X-GPUStack-Forward-Path "
+                "headers are required",
+            )
+        # ip AND port: multi-worker hosts share an IP across workers
+        # with distinct ports/secrets/tunnels
+        lookup = {"ip": worker_ip}
+        if worker_port.isdigit():
+            lookup["port"] = int(worker_port)
+        worker = await Worker.first(**lookup)
+        if worker is None:
+            return json_error(
+                502,
+                f"no worker at {worker_ip}:{worker_port or '*'} on "
+                "this server",
+            )
+        body = await request.read()
+        try:
+            resp = await worker_fetch(
+                request.app, worker, method, path,
+                raw_body=body,
+                content_type=request.headers.get("Content-Type", ""),
+                allow_federation=False,     # never hop twice
+            )
+        except aiohttp.ClientError as e:
+            return json_error(502, f"worker unreachable via peer: {e}")
+        out = web.StreamResponse(status=resp.status)
+        # stamp: this response came from the WORKER path, not the
+        # peer's own control plane — the originating server keys the
+        # hop-failed-vs-worker-answered decision off it
+        out.headers["X-GPUStack-Forwarded"] = "1"
+        ct = resp.content_type
+        if ct:
+            out.content_type = ct
+        await out.prepare(request)
+        try:
+            async for chunk in resp.content.iter_any():
+                await out.write(chunk)
+        finally:
+            resp.release()
+        return out
+
+    app.router.add_get("/v2/federation/peers", federation_peers)
+    app.router.add_post("/v2/federation/peers", federation_peer_upsert)
+    app.router.add_delete(
+        "/v2/federation/peers/{name}", federation_peer_delete
+    )
+    app.router.add_post("/v2/federation/forward", federation_forward)

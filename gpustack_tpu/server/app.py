@@ -535,6 +535,14 @@ def create_app(cfg: Config) -> web.Application:
                 "plugin %s setup failed", plugin.name or type(plugin)
             )
 
+    # multi-server tunnel federation registry (tunnel/federation.py):
+    # config-seeded, runtime-adjustable via /v2/federation/peers
+    from gpustack_tpu.tunnel.federation import FederationRegistry
+
+    app["federation"] = FederationRegistry.from_config(
+        cfg.federation_peers
+    )
+
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):
         import asyncio as _asyncio

@@ -51,13 +51,18 @@ async def worker_fetch(
     raw_body: bytes = b"",
     content_type: str = "",
     timeout: float = 600.0,
+    allow_federation: bool = True,
 ):
     """Send an authenticated request to a worker; returns a response
     adapter (.status/.headers/.content.iter_any()/.read()/.release()).
 
-    Prefers the worker's tunnel when connected (NAT'd workers have no
-    other path); otherwise dials ``worker.ip:worker.port`` directly.
-    Raises ``aiohttp.ClientError`` when neither path works.
+    Route order: the worker's LOCAL tunnel when connected (NAT'd workers
+    have no other path) → a federation peer whose registered CIDR
+    longest-prefix-matches the worker's IP (multi-server deployments,
+    tunnel/federation.py — the hop the reference's distributed
+    websocket proxy performs) → direct dial of ``worker.ip:worker.port``.
+    ``allow_federation=False`` is the loop guard used by the peer-side
+    forward handler. Raises ``aiohttp.ClientError`` when no path works.
     """
     headers = {}
     if worker.proxy_secret:
@@ -77,6 +82,26 @@ async def worker_fetch(
         return await session.request(
             method, path, headers, body, timeout=timeout
         )
+
+    federation = app.get("federation")
+    if allow_federation and federation is not None and worker.ip:
+        peer = federation.route(worker.ip)
+        if peer is not None:
+            from gpustack_tpu.tunnel.federation import forward_via_peer
+
+            resp, err = await forward_via_peer(
+                app["proxy_session"], peer, worker, method, path,
+                headers, body, timeout,
+            )
+            if resp is not None:
+                return resp
+            # a dead/misconfigured peer must not make a
+            # directly-dialable worker unreachable — fall through
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "federation hop failed (%s); trying direct dial", err
+            )
 
     if not worker.ip:
         raise aiohttp.ClientError(

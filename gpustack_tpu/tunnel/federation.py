@@ -1,0 +1,192 @@
+"""Multi-server tunnel federation: route worker-bound traffic to the
+peer server that actually holds the worker's tunnel.
+
+Reference role: the distributed websocket-proxy deployment
+(reference websocket_proxy/main.py:57 RegisterPeerRequest +
+patricia_trie.py) — several server instances each terminate tunnels for
+a subnet of workers, and a request landing on the wrong instance is
+forwarded to the peer whose registered CIDR contains the worker's IP,
+chosen by longest-prefix match.
+
+Here: a pure-Python binary (Patricia-style) trie over the address bits
+(32 for IPv4, 128 for IPv6 — O(k) lookups, no py-radix dependency), an
+in-memory peer registry seeded from config and adjustable at runtime
+(the reference's proxy holds peers in memory the same way), and a
+``/v2/federation/forward`` hop that replays the request through the
+peer's own worker path (tunnel or direct) with loop protection.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class CIDRTrie:
+    """Longest-prefix match over CIDRs, one bit per level.
+
+    Nodes are [zero_child, one_child, value]; paths are compressed only
+    by depth-limiting to the prefix length (insertion walks prefixlen
+    bits, lookup walks at most address-width bits) — O(k) per op with
+    k = 32/128, independent of how many prefixes are registered."""
+
+    def __init__(self) -> None:
+        self._roots = {4: [None, None, None], 6: [None, None, None]}
+
+    @staticmethod
+    def _bits(packed: int, width: int, n: int):
+        for i in range(n):
+            yield (packed >> (width - 1 - i)) & 1
+
+    def insert(self, cidr: str, value: Any) -> None:
+        net = ipaddress.ip_network(cidr, strict=False)
+        width = net.max_prefixlen
+        node = self._roots[net.version]
+        for bit in self._bits(
+            int(net.network_address), width, net.prefixlen
+        ):
+            if node[bit] is None:
+                node[bit] = [None, None, None]
+            node = node[bit]
+        node[2] = value
+
+    def longest_match(self, ip: str) -> Optional[Any]:
+        try:
+            addr = ipaddress.ip_address(ip)
+        except ValueError:
+            return None
+        width = addr.max_prefixlen
+        node = self._roots[addr.version]
+        best = node[2]
+        for bit in self._bits(int(addr), width, width):
+            node = node[bit]
+            if node is None:
+                break
+            if node[2] is not None:
+                best = node[2]
+        return best
+
+
+class FederationPeer:
+    def __init__(self, name: str, url: str, token: str,
+                 cidrs: List[str]):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.token = token
+        self.cidrs = list(cidrs)
+
+    def to_public(self) -> Dict[str, Any]:
+        # token never serialized back out
+        return {"name": self.name, "url": self.url,
+                "cidrs": self.cidrs}
+
+
+class FederationRegistry:
+    """Peers + the CIDR trie that routes worker IPs to them."""
+
+    def __init__(self) -> None:
+        self._peers: Dict[str, FederationPeer] = {}
+        self._trie = CIDRTrie()
+
+    @classmethod
+    def from_config(cls, entries) -> "FederationRegistry":
+        """``federation_peers`` config entries:
+        [{name, url, token, cidrs: [...]}, ...]."""
+        reg = cls()
+        for e in entries or []:
+            try:
+                reg.upsert(FederationPeer(
+                    str(e["name"]), str(e["url"]),
+                    str(e.get("token", "")),
+                    [str(c) for c in e.get("cidrs", [])],
+                ))
+            except (KeyError, TypeError, ValueError) as exc:
+                logger.warning("skipping bad federation peer %r: %s",
+                               e, exc)
+        return reg
+
+    def upsert(self, peer: FederationPeer) -> None:
+        # validate every CIDR before mutating state
+        for cidr in peer.cidrs:
+            ipaddress.ip_network(cidr, strict=False)
+        self._peers[peer.name] = peer
+        self._rebuild()
+
+    def remove(self, name: str) -> bool:
+        if name not in self._peers:
+            return False
+        del self._peers[name]
+        self._rebuild()
+        return True
+
+    def _rebuild(self) -> None:
+        trie = CIDRTrie()
+        for peer in self._peers.values():
+            for cidr in peer.cidrs:
+                trie.insert(cidr, peer)
+        self._trie = trie
+
+    def peers(self) -> List[FederationPeer]:
+        return list(self._peers.values())
+
+    def route(self, worker_ip: str) -> Optional[FederationPeer]:
+        return self._trie.longest_match(worker_ip)
+
+
+async def forward_via_peer(
+    session, peer: FederationPeer, worker, method: str,
+    path: str, headers: Dict[str, str], body: bytes,
+    timeout: float,
+):
+    """Replay a worker-bound request through ``peer``'s forward
+    endpoint. Returns (response, None) or (None, error).
+
+    The worker is identified to the peer by ip AND port — several
+    workers can share one host IP (multi-worker hosts use disjoint
+    port bands), and an ip-only lookup could replay onto a sibling
+    worker's engine. A response is only the WORKER's if the peer
+    stamped ``X-GPUStack-Forwarded: 1``; without it, an error status is
+    the peer's own control plane talking (expired token, missing
+    worker) and the hop failed — it must not masquerade as the model's
+    answer."""
+    import aiohttp
+
+    from gpustack_tpu.server.worker_request import DirectResponse
+
+    fwd_headers = {
+        "Authorization": f"Bearer {peer.token}",
+        "X-GPUStack-Forward-Method": method,
+        "X-GPUStack-Forward-Path": path,
+        "X-GPUStack-Worker-Ip": worker.ip,
+        "X-GPUStack-Worker-Port": str(worker.port),
+        # marks an already-hopped request; the peer's forward handler
+        # requires it and never re-federates
+        "X-GPUStack-Federated": "1",
+    }
+    if headers.get("Content-Type"):
+        fwd_headers["Content-Type"] = headers["Content-Type"]
+    try:
+        resp = await session.request(
+            "POST", f"{peer.url}/v2/federation/forward",
+            data=body or None,
+            headers=fwd_headers,
+            timeout=aiohttp.ClientTimeout(total=timeout),
+        )
+    except aiohttp.ClientError as e:
+        return None, f"peer {peer.name} unreachable: {e}"
+    if (
+        resp.status >= 400
+        and resp.headers.get("X-GPUStack-Forwarded") != "1"
+    ):
+        try:
+            detail = (await resp.read())[:200].decode(errors="replace")
+        finally:
+            resp.release()
+        return None, (
+            f"peer {peer.name} rejected the hop "
+            f"({resp.status}): {detail}"
+        )
+    return DirectResponse(resp), None
