@@ -28,6 +28,39 @@ from gpustack_tpu.models.transformer import init_params
 logger = logging.getLogger(__name__)
 
 
+# MXFP4 e2m1 value table, nibble-indexed (sign bit high): the packing
+# the GPT-OSS hub checkpoints use for expert weights (transformers
+# integrations/mxfp4 FP4_VALUES)
+_FP4_VALUES = (
+    0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+    -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0,
+)
+
+
+def _mxfp4_dequant(blocks, scales) -> jax.Array:
+    """MXFP4 blocks/scales → bf16 weight, matching
+    convert_moe_packed_tensors: ``blocks`` uint8 [..., G, B] holds fp4
+    PAIRS (low nibble = even element), ``scales`` uint8 e8m0 [..., G]
+    biased by 127; output interleaves, applies 2^scale, flattens the
+    block axes and swaps the last two dims into the [E, in, out]
+    layout the bf16 exports use."""
+    import numpy as np
+
+    lut = np.asarray(_FP4_VALUES, np.float32)
+    lo = lut[blocks & 0x0F]
+    hi = lut[blocks >> 4]
+    out = np.empty(
+        (*blocks.shape[:-1], blocks.shape[-1] * 2), np.float32
+    )
+    out[..., 0::2] = lo
+    out[..., 1::2] = hi
+    out *= np.exp2(
+        scales.astype(np.int32) - 127
+    )[..., None].astype(np.float32)
+    out = out.reshape(*blocks.shape[:-2], -1)      # [E, X, D]
+    return jnp.asarray(out.swapaxes(-1, -2)).astype(jnp.bfloat16)
+
+
 def _to_jnp(t, dtype=jnp.bfloat16) -> jax.Array:
     """torch tensor (possibly bf16) → jnp array."""
     import torch
@@ -165,6 +198,17 @@ def build_lm_params(
             layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias")
             layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias")
             layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias")
+        if cfg.o_bias:
+            layers["bo"] = stack("model.layers.{}.self_attn.o_proj.bias")
+        if cfg.attn_sinks:
+            # fp32: sink logits join the softmax denominator directly
+            layers["sinks"] = jnp.stack([
+                _to_jnp(
+                    tensors.pop(f"model.layers.{i}.self_attn.sinks"),
+                    jnp.float32,
+                )
+                for i in rng
+            ])
         if cfg.qk_norm:
             layers["q_norm"] = stack(
                 "model.layers.{}.self_attn.q_norm.weight"
@@ -172,7 +216,56 @@ def build_lm_params(
             layers["k_norm"] = stack(
                 "model.layers.{}.self_attn.k_norm.weight"
             )
-        if moe:
+        def pop_gptoss_expert(name: str, i: int):
+            """GPT-OSS expert tensor, dequantizing the hub's MXFP4
+            packing when present (openai/gpt-oss-* ship
+            ``{name}_blocks`` uint8 fp4-pairs + ``{name}_scales`` e8m0
+            per 32-value block — transformers integrations/mxfp4
+            convert_moe_packed_tensors); dequantized bf16 re-exports
+            carry the plain tensor."""
+            base = f"model.layers.{i}.mlp.experts.{name}"
+            if base in tensors:
+                return _to_jnp(tensors.pop(base))
+            blocks = tensors.pop(base + "_blocks").numpy()
+            scales = tensors.pop(base + "_scales").numpy()
+            return _mxfp4_dequant(blocks, scales)
+
+        if moe and cfg.moe_act == "gptoss":
+            # GPT-OSS fused expert tensors (modeling_gpt_oss
+            # GptOssExperts/GptOssTopKRouter): gate_up_proj [E, D, 2F]
+            # with gate/up INTERLEAVED on the last axis, biased
+            # everywhere, router as a true affine map
+            layers["router"] = stack(
+                "model.layers.{}.mlp.router.weight", True
+            )
+            layers["router_bias"] = jnp.stack([
+                _to_jnp(
+                    tensors.pop(f"model.layers.{i}.mlp.router.bias"),
+                    jnp.float32,
+                )
+                for i in rng
+            ])
+
+            def popb(name: str, i: int):
+                return _to_jnp(
+                    tensors.pop(f"model.layers.{i}.mlp.experts.{name}")
+                )
+
+            gu = [
+                pop_gptoss_expert("gate_up_proj", i) for i in rng
+            ]                                                # [E, D, 2F]
+            gub = [popb("gate_up_proj_bias", i) for i in rng]  # [E, 2F]
+            layers["we_gate"] = jnp.stack([t[..., 0::2] for t in gu])
+            layers["we_up"] = jnp.stack([t[..., 1::2] for t in gu])
+            layers["we_gate_b"] = jnp.stack([t[..., 0::2] for t in gub])
+            layers["we_up_b"] = jnp.stack([t[..., 1::2] for t in gub])
+            layers["we_down"] = jnp.stack(
+                [pop_gptoss_expert("down_proj", i) for i in rng]
+            )                                                # [E, F, D]
+            layers["we_down_b"] = jnp.stack(
+                [popb("down_proj_bias", i) for i in rng]     # [E, D]
+            )
+        elif moe:
             # Three HF MoE naming schemes: Mixtral (block_sparse_moe /
             # w1|w2|w3), Qwen-MoE and DeepSeek (mlp.gate /
             # experts.{e}.gate_proj|down_proj|up_proj)

@@ -85,7 +85,18 @@ class ModelConfig:
     routed_scaling_factor: float = 1.0
     first_k_dense: int = 0
     # "softmax" (v2) | "sigmoid" (v3: score + e_score_correction_bias)
+    # | "softmax_topk" (GPT-OSS: softmax over the selected top-k logits)
     moe_scoring: str = "softmax"
+    # ---- GPT-OSS knobs ----
+    # learned per-head attention-sink logits (join the softmax
+    # denominator only — modeling_gpt_oss eager_attention_forward)
+    attn_sinks: bool = False
+    o_bias: bool = False            # bias on the attention out proj
+    # expert activation: "silu" (swiglu) | "gptoss" (clamped
+    # gate*sigmoid(1.702*gate), combined as (up+1)*glu) — experts carry
+    # biases on gate/up/down when moe_bias is set
+    moe_act: str = "silu"
+    moe_bias: bool = False
     dtype: str = "bfloat16"
 
     # ---- derived ----
@@ -231,10 +242,14 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
     gemma2plus = "Gemma2" in arch or "Gemma3" in arch
     gemma1 = arch == "GemmaForCausalLM"
     gemma = gemma2plus or gemma1
+    # GPT-OSS: attention sinks, alternating sliding/full layers, biased
+    # attention + router + experts, clamped-glu MoE, YaRN rope
+    # (modeling_gpt_oss)
+    gptoss = "GptOss" in arch
     layer_types = cfg.get("layer_types")
     layer_sliding = (
         tuple(t == "sliding_attention" for t in layer_types)
-        if gemma2plus and layer_types
+        if (gemma2plus or gptoss) and layer_types
         else None
     )
     if gemma2plus and layer_sliding is None:
@@ -249,6 +264,14 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
             else 2
         )
         layer_sliding = tuple(bool((i + 1) % pat) for i in range(L))
+    if gptoss and layer_sliding is None:
+        # a stripped config without layer_types must NOT fall through
+        # to the global-window branch (it would window the
+        # full-attention layers too — silently wrong past 128 tokens);
+        # GptOssConfig's own default is alternating starting sliding
+        layer_sliding = tuple(
+            i % 2 == 0 for i in range(cfg["num_hidden_layers"])
+        )
     return ModelConfig(
         name=name,
         vocab_size=cfg["vocab_size"],
@@ -266,7 +289,14 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         rope_scaling=cfg.get("rope_scaling"),
         rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
         tie_word_embeddings=cfg.get("tie_word_embeddings", False),
-        qkv_bias="Qwen2" in arch and not cfg.get("no_bias", False),
+        qkv_bias=(
+            ("Qwen2" in arch and not cfg.get("no_bias", False))
+            or (gptoss and cfg.get("attention_bias", True))
+        ),
+        o_bias=gptoss and bool(cfg.get("attention_bias", True)),
+        attn_sinks=gptoss,
+        moe_act="gptoss" if gptoss else "silu",
+        moe_bias=gptoss,
         # Qwen3 (dense + MoE) and Gemma3 replace attention bias with
         # per-head q/k RMSNorm
         qk_norm="Qwen3" in arch or "Gemma3" in arch,
@@ -330,7 +360,7 @@ def config_from_hf(cfg: Dict[str, Any], name: str = "custom") -> ModelConfig:
         moe_scoring=(
             "sigmoid"
             if deepseek and cfg.get("scoring_func") == "sigmoid"
-            else "softmax"
+            else ("softmax_topk" if gptoss else "softmax")
         ),
     ).validate()
 
@@ -440,6 +470,70 @@ PRESETS: Dict[str, ModelConfig] = {
         sliding_window=4096,
         layer_sliding=tuple(i % 2 == 0 for i in range(42)),
         max_position_embeddings=8192,
+    ),
+    # GPT-OSS (openai/gpt-oss-20b — BASELINE.md headline anchor,
+    # docs/performance-lab/gpt-oss-20b/a100.md): attention sinks,
+    # alternating sliding/full layers, biased everything, clamped-glu
+    # MoE, YaRN truncate=false. Hub dims from GptOssConfig.
+    "gpt-oss-20b": ModelConfig(
+        name="gpt-oss-20b",
+        vocab_size=201088,
+        hidden_size=2880,
+        intermediate_size=2880,
+        num_layers=24,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=150000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 32.0,
+            "beta_fast": 32.0, "beta_slow": 1.0,
+            "truncate": False,
+            "original_max_position_embeddings": 4096,
+        },
+        rms_norm_eps=1e-5,
+        max_position_embeddings=131072,
+        sliding_window=128,
+        layer_sliding=tuple(i % 2 == 0 for i in range(24)),
+        qkv_bias=True,
+        o_bias=True,
+        attn_sinks=True,
+        num_experts=32,
+        num_experts_per_tok=4,
+        moe_intermediate_size=2880,
+        moe_scoring="softmax_topk",
+        moe_act="gptoss",
+        moe_bias=True,
+    ),
+    "gpt-oss-120b": ModelConfig(
+        name="gpt-oss-120b",
+        vocab_size=201088,
+        hidden_size=2880,
+        intermediate_size=2880,
+        num_layers=36,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=150000.0,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 32.0,
+            "beta_fast": 32.0, "beta_slow": 1.0,
+            "truncate": False,
+            "original_max_position_embeddings": 4096,
+        },
+        rms_norm_eps=1e-5,
+        max_position_embeddings=131072,
+        sliding_window=128,
+        layer_sliding=tuple(i % 2 == 0 for i in range(36)),
+        qkv_bias=True,
+        o_bias=True,
+        attn_sinks=True,
+        num_experts=128,
+        num_experts_per_tok=4,
+        moe_intermediate_size=2880,
+        moe_scoring="softmax_topk",
+        moe_act="gptoss",
+        moe_bias=True,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

@@ -149,6 +149,10 @@ def init_params(
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
         layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
         layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.o_bias:
+        layers["bo"] = jnp.zeros((L, d), dtype)
+    if cfg.attn_sinks:
+        layers["sinks"] = jnp.zeros((L, cfg.num_heads), jnp.float32)
     if cfg.norm_delta_gain:
         # gemma stores norm gains as deltas: zero == identity gain
         for name in ("attn_norm", "mlp_norm"):
@@ -174,8 +178,13 @@ def init_params(
             layers["ws_down"] = w(next(keys), L, fs, d)
             if cfg.shared_expert_gated:
                 layers["shared_gate"] = w(next(keys), L, d, 1)
-        if cfg.moe_scoring == "sigmoid":
+        if cfg.moe_scoring in ("sigmoid", "softmax_topk"):
+            # DeepSeek-V3 correction bias / GPT-OSS affine router
             layers["router_bias"] = jnp.zeros((L, E), jnp.float32)
+        if cfg.moe_bias:
+            layers["we_gate_b"] = jnp.zeros((L, E, fm), dtype)
+            layers["we_up_b"] = jnp.zeros((L, E, fm), dtype)
+            layers["we_down_b"] = jnp.zeros((L, E, d), dtype)
     else:
         layers["w_gate"] = w(next(keys), L, d, f)
         layers["w_up"] = w(next(keys), L, d, f)
@@ -195,7 +204,7 @@ def init_params(
         moe_keys = (
             "router", "we_gate", "we_up", "we_down",
             "ws_gate", "ws_up", "ws_down", "shared_gate",
-            "router_bias",
+            "router_bias", "we_gate_b", "we_up_b", "we_down_b",
         )
         dense: Dict[str, jax.Array] = {
             k: v[:kd] for k, v in layers.items() if k not in moe_keys
@@ -328,8 +337,14 @@ def yarn_inv_freq(
             dim * math.log(orig / (n_rot * 2 * math.pi))
         ) / (2 * math.log(theta))
 
-    low = max(math.floor(correction_dim(beta_fast)), 0)
-    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    low = correction_dim(beta_fast)
+    high = correction_dim(beta_slow)
+    if rs.get("truncate", True):
+        # HF find_correction_range: integer bounds unless the config
+        # opts out (GPT-OSS ships truncate: false — fractional ramp)
+        low, high = math.floor(low), math.ceil(high)
+    low = max(low, 0)
+    high = min(high, dim - 1)
     if low == high:
         high += 0.001
     ramp = jnp.clip(
@@ -388,14 +403,27 @@ def _attend(
     mask: jax.Array,   # [B, T, S] bool (True = attend)
     scale: float,
     softcap: float = 0.0,
+    sinks: Optional[jax.Array] = None,   # [Hkv, G] learned sink logits
 ) -> jax.Array:
-    """Grouped-query attention; fp32 softmax; returns [B, T, Hkv*G*hd]."""
+    """Grouped-query attention; fp32 softmax; returns [B, T, Hkv*G*hd].
+
+    ``sinks`` (GPT-OSS, modeling_gpt_oss eager_attention_forward): a
+    per-head learned logit joins the softmax DENOMINATOR only — the
+    probability mass it absorbs is dropped, softening every real score
+    without a corresponding value row."""
     scores = jnp.einsum("bthgd,bshd->bhgts", q, k).astype(jnp.float32) * scale
     if softcap:
         # gemma2 attention-logit softcapping, applied before the mask
         scores = softcap * jnp.tanh(scores / softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if sinks is not None:
+        sink = sinks.astype(jnp.float32)[None, :, :, None]  # [1,Hkv,G,1]
+        m = jnp.maximum(jnp.max(scores, axis=-1), sink)     # [B,Hkv,G,T]
+        p = jnp.exp(scores - m[..., None])
+        denom = jnp.sum(p, axis=-1) + jnp.exp(sink - m)
+        weights = (p / denom[..., None]).astype(q.dtype)
+    else:
+        weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhgts,bshd->bthgd", weights, v)
     b, t = out.shape[0], out.shape[1]
     return out.reshape(b, t, -1)
@@ -409,7 +437,9 @@ def _moe_mlp(
     we_down: jax.Array,     # [E, Fm, D]
     cfg: ModelConfig,
     router_bias=None,       # [E] sigmoid-selection bias (DeepSeek-V3)
+                            # or logit bias (GPT-OSS softmax_topk)
     shared=None,            # (ws_gate, ws_up, ws_down, gate_w|None)
+    biases=None,            # (bg [E,Fm], bu [E,Fm], bd [E,D]) GPT-OSS
 ) -> jax.Array:
     """Mixtral-style top-k MoE, dense-dispatch formulation.
 
@@ -435,10 +465,18 @@ def _moe_mlp(
         sel = scores + (router_bias if router_bias is not None else 0.0)
         _, top_idx = lax.top_k(sel, cfg.num_experts_per_tok)
         top_w = jnp.take_along_axis(scores, top_idx, axis=-1)
+    elif cfg.moe_scoring == "softmax_topk":
+        # GPT-OSS (modeling_gpt_oss GptOssTopKRouter): the router is a
+        # true affine map; softmax runs over the SELECTED top-k logits,
+        # not the full expert set
+        if router_bias is not None:
+            logits = logits + router_bias.astype(jnp.float32)
+        top_v, top_idx = lax.top_k(logits, cfg.num_experts_per_tok)
+        top_w = jax.nn.softmax(top_v, axis=-1)
     else:
         gates = jax.nn.softmax(logits, axis=-1)
         top_w, top_idx = lax.top_k(gates, cfg.num_experts_per_tok)
-    if cfg.norm_topk_prob:
+    if cfg.norm_topk_prob and cfg.moe_scoring != "softmax_topk":
         top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
     # Scatter top-k weights back to a dense [B, T, E] combine tensor.
     combine = jnp.sum(
@@ -448,8 +486,23 @@ def _moe_mlp(
     ).astype(x.dtype)
     g = _mm("btd,edf->btef", x, we_gate)
     u = _mm("btd,edf->btef", x, we_up)
-    h = jax.nn.silu(g) * u
+    if biases is not None:
+        bg, bu, _bd = biases
+        g = g + bg[None, None].astype(g.dtype)
+        u = u + bu[None, None].astype(u.dtype)
+    if cfg.moe_act == "gptoss":
+        # GptOssExperts: clamped glu — gate capped above, up clamped
+        # both ways, (up + 1) multiplies gate*sigmoid(1.702*gate)
+        limit = 7.0
+        g = jnp.clip(g, None, limit)
+        u = jnp.clip(u, -limit, limit)
+        h = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+    else:
+        h = jax.nn.silu(g) * u
     y = _mm("btef,efd->bted", h, we_down)
+    if biases is not None:
+        _bg, _bu, bd = biases
+        y = y + bd[None, None].astype(y.dtype)
     out = jnp.einsum("bted,bte->btd", y, combine)
     if cfg.routed_scaling_factor != 1.0:
         out = out * jnp.asarray(
@@ -585,14 +638,16 @@ def forward(
         and cache.max_len >= T
         and not cfg.sliding_window
         and not cfg.attn_logit_softcap
+        and not cfg.attn_sinks
     )
     use_ring = attn_impl == "ring" and cache is not None
     if use_ring and (
         mesh is None or cfg.sliding_window or cfg.attn_logit_softcap
+        or cfg.attn_sinks
     ):
         raise ValueError(
-            "attn_impl='ring' needs a mesh, no sliding window and no "
-            "attention softcapping"
+            "attn_impl='ring' needs a mesh, no sliding window, no "
+            "attention softcapping and no attention sinks"
         )
 
     # mask[b, t, s] — query t attends key s
@@ -708,9 +763,14 @@ def forward(
             )
             k = apply_rope(k, sin_b, cos_b)
 
+        sinks_l = (
+            lp["sinks"].reshape(cfg.num_kv_heads, cfg.group_size)
+            if cfg.attn_sinks else None
+        )
         if cache is None:
             attn = _attend(
-                q, k, v, mask_l, scale, cfg.attn_logit_softcap
+                q, k, v, mask_l, scale, cfg.attn_logit_softcap,
+                sinks=sinks_l,
             )
             new_k, new_v = k_cache_l, v_cache_l
         else:
@@ -763,6 +823,7 @@ def forward(
                 attn = _attend(
                     q, new_k, new_v, mask_l, scale,
                     cfg.attn_logit_softcap,
+                    sinks=sinks_l,
                 )
 
         if cfg.is_mla:
@@ -774,6 +835,8 @@ def forward(
                 B, T, cfg.num_heads * cfg.v_head_dim
             )
         attn_out = _mm("btq,qd->btd", attn, lp["wo"])
+        if cfg.o_bias:
+            attn_out = attn_out + lp["bo"]
         if cfg.post_norms:
             attn_out = rms_norm(
                 attn_out, lp["post_attn_norm"], cfg.rms_norm_eps,
@@ -795,6 +858,10 @@ def forward(
                         lp.get("shared_gate"),
                     )
                     if "ws_gate" in lp else None
+                ),
+                biases=(
+                    (lp["we_gate_b"], lp["we_up_b"], lp["we_down_b"])
+                    if cfg.moe_bias else None
                 ),
             )
         else:

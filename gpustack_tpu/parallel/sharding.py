@@ -64,6 +64,14 @@ def _layer_rules(train: bool) -> Dict[str, P]:
         "ws_down": P(None, AXIS_TP, fsdp),
         "shared_gate": P(None, None, None),
         "router_bias": P(None, None),
+        # GPT-OSS: o-proj bias is hidden-wide (replicate with the
+        # norms); sink logits are per-head tiny; expert biases shard
+        # with their expert matrices (E over ep, F over tp)
+        "bo": P(None, None),
+        "sinks": P(None, None),
+        "we_gate_b": P(None, AXIS_EP, AXIS_TP),
+        "we_up_b": P(None, AXIS_EP, AXIS_TP),
+        "we_down_b": P(None, AXIS_EP, None),
     }
 
 

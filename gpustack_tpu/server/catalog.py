@@ -147,6 +147,15 @@ _ROWS = [
      "deepseek-ai/DeepSeek-R1-Distill-Llama-70B", "llama3-70b", 70.6,
      ["llm", "chat", "reasoning"], "int8", 131072, 8, 2,
      {"mesh_plan": "dp1xsp1xep1xtp8"}),
+    # ---- GPT-OSS (BASELINE.md headline anchors) ------------------------
+    ("GPT-OSS-20B", "openai/gpt-oss-20b", "gpt-oss-20b", 20.9,
+     ["llm", "chat", "moe", "reasoning"], "int8", 131072, 2, 1,
+     {"attention": "sinks+sliding", "rope": "yarn",
+      "mesh_plan": "dp1xsp1xep2xtp1"}),
+    ("GPT-OSS-120B", "openai/gpt-oss-120b", "gpt-oss-120b", 116.8,
+     ["llm", "chat", "moe", "reasoning"], "int8", 131072, 16, 2,
+     {"attention": "sinks+sliding", "rope": "yarn",
+      "mesh_plan": "dp1xsp1xep8xtp2"}),
     # ---- Mistral / Mixtral ---------------------------------------------
     ("Mistral-7B-Instruct-v0.3", "mistralai/Mistral-7B-Instruct-v0.3",
      "", 7.2, ["llm", "chat"], "int8", 32768, 1, 1, {}),

@@ -1,0 +1,274 @@
+"""GPT-OSS family HF parity (BASELINE.md headline anchor:
+gpt-oss-20b on A100, docs/performance-lab/gpt-oss-20b/a100.md:95-99).
+
+The family's quirks, each exercised here: learned per-head attention
+SINKS joining the softmax denominator, alternating sliding/full layers,
+biased attention (qkv + o), a true-affine MoE router with softmax over
+the selected top-k logits, fused interleaved gate_up expert weights
+with biases, the clamped (up+1)*glu activation, and YaRN rope with
+truncate=false. Bit-parity against transformers on a tiny random
+checkpoint — same doctrine as the gemma/qwen/deepseek tests.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpustack_tpu.models import forward
+
+TOKENS = np.array([[3, 17, 92, 5, 44, 8, 120, 63, 7, 99]], dtype=np.int32)
+
+
+@pytest.fixture(scope="module")
+def gptoss_checkpoint(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    tfm = pytest.importorskip("transformers")
+
+    torch.manual_seed(0)
+    hf_cfg = tfm.GptOssConfig(
+        vocab_size=128,
+        hidden_size=32,
+        # 32 (not 16): the MXFP4 repack test groups the contraction dim
+        # in 32-value blocks
+        intermediate_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        sliding_window=4,
+        layer_types=["sliding_attention", "full_attention"],
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 4.0,
+            "beta_fast": 32.0,
+            "beta_slow": 1.0,
+            "truncate": False,
+            "original_max_position_embeddings": 32,
+        },
+        tie_word_embeddings=False,
+        attention_bias=True,
+        attention_dropout=0.0,
+    )
+    model = tfm.GptOssForCausalLM(hf_cfg).eval()
+    # random init leaves sinks/biases near zero — randomize so the test
+    # actually catches a missing sink or bias term
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.sinks.uniform_(-1.0, 1.0)
+            layer.mlp.router.bias.uniform_(-0.5, 0.5)
+            layer.mlp.experts.gate_up_proj_bias.uniform_(-0.2, 0.2)
+            layer.mlp.experts.down_proj_bias.uniform_(-0.2, 0.2)
+    d = tmp_path_factory.mktemp("gptoss")
+    model.save_pretrained(d, safe_serialization=True)
+    return model, str(d)
+
+
+def _ours(model_dir, tokens, positions=None):
+    from gpustack_tpu.engine.weights import load_hf_checkpoint
+    from gpustack_tpu.models.config import load_hf_config
+
+    cfg = load_hf_config(model_dir)
+    cfg = dataclasses.replace(cfg, dtype="float32")
+    params = load_hf_checkpoint(cfg, model_dir)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if x.dtype == jnp.bfloat16 else x,
+        params,
+    )
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+        )
+    out, _ = forward(
+        params, cfg, jnp.asarray(tokens),
+        jnp.asarray(positions, jnp.int32),
+    )
+    return cfg, np.asarray(out)
+
+
+def test_gptoss_logits_match_transformers(gptoss_checkpoint):
+    torch = pytest.importorskip("torch")
+    model, model_dir = gptoss_checkpoint
+    cfg, ours = _ours(model_dir, TOKENS)
+
+    assert cfg.attn_sinks and cfg.o_bias and cfg.qkv_bias
+    assert cfg.moe_scoring == "softmax_topk"
+    assert cfg.moe_act == "gptoss" and cfg.moe_bias
+    assert cfg.layer_sliding == (True, False)
+    assert (cfg.rope_scaling or {}).get("truncate") is False
+
+    with torch.no_grad():
+        ref = model(torch.tensor(TOKENS, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=2e-2)
+
+
+def test_gptoss_long_position_yarn(gptoss_checkpoint):
+    """Positions past original_max_position_embeddings=32: the
+    truncate=false YaRN ramp and the sliding mask must both match."""
+    torch = pytest.importorskip("torch")
+    model, model_dir = gptoss_checkpoint
+    tokens = np.array([[5, 9, 33, 7, 21, 64]], dtype=np.int32)
+    positions = np.arange(60, 66, dtype=np.int64)[None, :]
+
+    with torch.no_grad():
+        ref = model(
+            torch.tensor(tokens, dtype=torch.long),
+            position_ids=torch.tensor(positions),
+        ).logits.numpy()
+    _, ours = _ours(model_dir, tokens, positions)
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=2e-2)
+
+
+def test_gptoss_engine_greedy_serving(gptoss_checkpoint):
+    """The full serving path (prefill→insert→decode) produces the
+    no-cache oracle's greedy tokens — sinks and sliding masks must hold
+    across the cache layout too."""
+    _, model_dir = gptoss_checkpoint
+
+    from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+    from gpustack_tpu.engine.weights import load_hf_checkpoint
+    from gpustack_tpu.models.config import load_hf_config
+
+    cfg = load_hf_config(model_dir)
+    cfg = dataclasses.replace(cfg, dtype="float32")
+    params = load_hf_checkpoint(cfg, model_dir)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if x.dtype == jnp.bfloat16 else x,
+        params,
+    )
+
+    prompt = [5, 17, 42, 9]
+    ids = list(prompt)
+    oracle = []
+    for _ in range(5):
+        toks = jnp.asarray(ids, jnp.int32)[None, :]
+        pos = jnp.arange(len(ids), dtype=jnp.int32)[None, :]
+        logits, _ = forward(params, cfg, toks, pos)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        oracle.append(nxt)
+        ids.append(nxt)
+
+    engine = LLMEngine(cfg, params, max_slots=2, max_seq_len=64)
+    engine.start()
+    try:
+        req = engine.generate(
+            GenRequest(
+                prompt_ids=prompt, max_tokens=5, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=600,
+        )
+    finally:
+        engine.stop()
+    assert req.output_ids == oracle[: len(req.output_ids)]
+    assert len(req.output_ids) >= 1
+
+
+def test_mxfp4_dequant_matches_hf_reference():
+    """The hub openai/gpt-oss-* checkpoints ship MXFP4 expert weights;
+    our numpy dequant must match transformers'
+    convert_moe_packed_tensors bit-for-bit (on the fp4 grid)."""
+    torch = pytest.importorskip("torch")
+    from transformers.integrations.mxfp4 import (
+        convert_moe_packed_tensors,
+    )
+
+    from gpustack_tpu.engine.weights import _mxfp4_dequant
+
+    rng = np.random.default_rng(0)
+    E, X, G, B = 2, 6, 4, 16      # -> weight [E, G*B*2=128, X]
+    blocks = rng.integers(0, 256, (E, X, G, B), dtype=np.uint8)
+    scales = rng.integers(120, 135, (E, X, G), dtype=np.uint8)
+
+    want = convert_moe_packed_tensors(
+        torch.from_numpy(blocks), torch.from_numpy(scales),
+        dtype=torch.float32,
+    ).numpy()
+    got = np.asarray(_mxfp4_dequant(blocks, scales)).astype(np.float32)
+    assert got.shape == want.shape == (E, G * B * 2, X)
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-6)
+
+
+def test_gptoss_loader_accepts_mxfp4_checkpoint(
+    gptoss_checkpoint, tmp_path
+):
+    """Repack the tiny checkpoint's expert tensors as MXFP4 and load:
+    logits must track the bf16 original within fp4 tolerance."""
+    torch = pytest.importorskip("torch")
+    import json
+    import os
+    import shutil
+
+    from safetensors import safe_open
+    from safetensors.torch import save_file
+
+    _, model_dir = gptoss_checkpoint
+    q_dir = str(tmp_path / "mxfp4")
+    os.makedirs(q_dir)
+    for fname in os.listdir(model_dir):
+        if not fname.endswith(".safetensors"):
+            shutil.copy(
+                os.path.join(model_dir, fname),
+                os.path.join(q_dir, fname),
+            )
+
+    def quantize_mxfp4(w: torch.Tensor):
+        """[E, in, out] float -> (blocks [E, out, in/32, 16], scales)."""
+        t = w.transpose(1, 2).contiguous().float().numpy()  # [E, out, in]
+        E_, O_, I_ = t.shape
+        assert I_ % 32 == 0
+        g = t.reshape(E_, O_, I_ // 32, 32)
+        absmax = np.abs(g).max(axis=-1, keepdims=True)
+        exp = np.ceil(np.log2(np.maximum(absmax / 6.0, 1e-30)))
+        exp = np.clip(exp, -127, 128)
+        scaled = g / np.exp2(exp)
+        lut = np.asarray(
+            [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0], np.float32
+        )
+        mags = np.abs(scaled)[..., None] - lut
+        idx = np.abs(mags).argmin(axis=-1).astype(np.uint8)
+        nib = np.where(scaled < 0, idx | 0x8, idx).astype(np.uint8)
+        blocks = (nib[..., 0::2] | (nib[..., 1::2] << 4)).astype(
+            np.uint8
+        )
+        scales = (exp[..., 0] + 127).astype(np.uint8)
+        return torch.from_numpy(blocks), torch.from_numpy(scales)
+
+    shard = next(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    tensors = {}
+    with safe_open(
+        os.path.join(model_dir, shard), framework="pt"
+    ) as f:
+        for name in f.keys():
+            t = f.get_tensor(name)
+            if name.endswith(
+                ("experts.gate_up_proj", "experts.down_proj")
+            ):
+                blocks, scales = quantize_mxfp4(t)
+                tensors[name + "_blocks"] = blocks
+                tensors[name + "_scales"] = scales
+            else:
+                tensors[name] = t
+    save_file(tensors, os.path.join(q_dir, shard))
+    # model.safetensors.index.json (if any) references old names; the
+    # single-shard loader path reads the file directly
+    idx = os.path.join(q_dir, "model.safetensors.index.json")
+    if os.path.exists(idx):
+        os.unlink(idx)
+
+    _, ours_bf16 = _ours(model_dir, TOKENS)
+    _, ours_q = _ours(q_dir, TOKENS)
+    # fp4 is coarse; logits correlate strongly but aren't equal
+    a, b = ours_q.ravel(), ours_bf16.ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert corr > 0.95, corr
