@@ -14,7 +14,9 @@ tensor info table, aligned data section. Quantizations supported:
 F32/F16/BF16 passthrough, Q8_0, Q4_0/Q4_1, Q5_0/Q5_1, and the K-quant
 super-block formats Q2_K/Q3_K/Q4_K/Q5_K/Q6_K (what real-world Q4_K_M /
 Q5_K_M / Q6_K checkpoints ship). gguf-split multi-file checkpoints are
-resolved via ``split.count`` metadata (gguf_shard_paths).
+resolved via ``split.count`` metadata (gguf_shard_paths). MoE exports
+(mixtral/qwen3moe-class fused ffn_*_exps tensors + ffn_gate_inp
+router) load too; shared-expert (shexp) exports are rejected loudly.
 
 Tokenizer: a ``tokenizer.json`` sidecar next to the .gguf wins (exact
 HF tokenization). Without one, the GGUF's embedded vocab drives exact
@@ -28,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -423,13 +426,40 @@ def _map_name(name: str) -> Optional[str]:
         _, layer, rest = name.split(".", 2)
         if rest in _BLK_MAP:
             return f"model.layers.{layer}.{_BLK_MAP[rest]}"
-        if "exps" in rest or "ffn_gate_inp" in rest:
+        if "shexp" in rest:
+            # Qwen2-MoE-class shared experts: fused shexp tensors are
+            # not mapped yet — loud, not silently dropped
             raise ValueError(
-                "GGUF MoE checkpoints are not supported yet "
-                f"(tensor {name!r}); use the safetensors export"
+                "GGUF shared-expert (shexp) checkpoints are not "
+                f"supported yet (tensor {name!r}); use the safetensors "
+                "export"
             )
+        if re.match(r"ffn_(gate|up|down)\.\d+\.weight$", rest):
+            # legacy per-expert MoE layout (pre-fused llama.cpp
+            # exports, e.g. early Mixtral GGUFs): silently warn-dropping
+            # these would surface as a cryptic KeyError after minutes
+            # of dequantizing a multi-GB file
+            raise ValueError(
+                "legacy per-expert MoE GGUF layout is not supported "
+                f"(tensor {name!r}); re-export with a current "
+                "llama.cpp (fused ffn_*_exps tensors) or use the "
+                "safetensors checkpoint"
+            )
+        if rest == "ffn_gate_inp.weight":
+            return f"model.layers.{layer}.mlp.gate.weight"
     logger.warning("ignoring unrecognized GGUF tensor %r", name)
     return None
+
+
+# fused MoE expert tensors (llama.cpp exports one 3-D tensor per
+# projection, experts stacked on the slowest axis after dim reversal:
+# gate/up [E, F, D], down [E, D, F]) → the per-expert HF names
+# build_lm_params already maps
+_EXPS_MAP = {
+    "ffn_gate_exps.weight": "gate_proj",
+    "ffn_up_exps.weight": "up_proj",
+    "ffn_down_exps.weight": "down_proj",
+}
 
 
 def _reverse_llama_permute(w: np.ndarray, n_head: int) -> np.ndarray:
@@ -462,8 +492,6 @@ def gguf_shard_paths(
     shard. ``first_parse`` lets callers that already read ``path``
     (read_gguf result tuple) avoid a second full metadata parse — the
     KV section can embed a 100k+-entry tokenizer vocab."""
-    import re
-
     metadata = (first_parse or read_gguf(path))[0]
     count = int(metadata.get("split.count", 1) or 1)
     if count <= 1:
@@ -530,6 +558,22 @@ def load_gguf_tensors(path: str) -> Dict[str, Any]:
             first if shard == path else read_gguf(shard)
         )
         for name, shape, ggml_type, offset in infos:
+            # single parse point: fused-exps dispatch and _map_name see
+            # the same (layer, rest) split
+            layer = rest = ""
+            if name.startswith("blk."):
+                _, layer, rest = name.split(".", 2)
+            if rest in _EXPS_MAP:
+                fused = _tensor_data(
+                    name, shape, ggml_type, offset, data_start, raw
+                )
+                proj = _EXPS_MAP[rest]
+                for e in range(fused.shape[0]):
+                    tensors[
+                        f"model.layers.{layer}.mlp.experts.{e}"
+                        f".{proj}.weight"
+                    ] = torch.from_numpy(fused[e].copy())
+                continue
             hf_name = _map_name(name)
             if hf_name is None:
                 continue
@@ -620,6 +664,17 @@ def config_from_gguf(path: str, name: str = ""):
         )
     tensor_names = {t[0] for t in infos}
 
+    # MoE metadata (mixtral exports under arch "llama" with
+    # expert_count set; qwen3moe under its own arch). Weight routing:
+    # softmax over the selected experts with renormalization — the
+    # semantics both mixtral and qwen3moe use.
+    num_experts = int(md("expert_count", 0) or 0)
+    num_experts_per_tok = int(md("expert_used_count", 0) or 0)
+    moe_inter = int(
+        md("expert_feed_forward_length", 0)
+        or md("feed_forward_length", 0)
+    )
+
     rope_scaling = None
     rs_type = md("rope.scaling.type")
     if rs_type == "linear":
@@ -678,6 +733,10 @@ def config_from_gguf(path: str, name: str = ""):
         tie_word_embeddings="output.weight" not in tensor_names,
         qkv_bias="blk.0.attn_q.bias" in tensor_names,
         qk_norm="blk.0.attn_q_norm.weight" in tensor_names,
+        num_experts=num_experts,
+        num_experts_per_tok=num_experts_per_tok,
+        moe_intermediate_size=moe_inter if num_experts else 0,
+        norm_topk_prob=True,
     )
 
 
