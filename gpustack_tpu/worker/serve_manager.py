@@ -246,7 +246,11 @@ class ServeManager:
                 )
                 if backend is not None:
                     self.backends_cache[model.backend] = backend
-        port = self._allocate_port()
+        own_coord: tuple = ()
+        if inst.coordinator_address:
+            cp = int(inst.coordinator_address.rsplit(":", 1)[1])
+            own_coord = (cp, cp + 1)
+        port = self._allocate_port(exclude=own_coord)
         try:
             argv, extra_env = build_command(
                 model, inst, port, backend,
@@ -263,14 +267,13 @@ class ServeManager:
             return
 
         # multi-host leader: fence the jax.distributed coordinator port
+        # pair (coordinator + command channel, engine/multihost.py)
         # before spawning — the scheduler avoids DB-known collisions but
-        # only the leader host can see ports taken by unrelated processes
-        # (reference port-band probing, serve_manager.py:1456-1508)
-        if is_leader and inst.coordinator_address:
-            coord_port = int(inst.coordinator_address.rsplit(":", 1)[1])
-            # probe the pair: coordinator + command channel (+1,
-            # engine/multihost.py) — both must be free on this host
-            for probe_port in (coord_port, coord_port + 1):
+        # only the leader host can see ports taken by unrelated
+        # processes (reference port-band probing,
+        # serve_manager.py:1456-1508)
+        if is_leader and own_coord:
+            for probe_port in own_coord:
                 with socket.socket(
                     socket.AF_INET, socket.SOCK_STREAM
                 ) as probe:
@@ -282,11 +285,48 @@ class ServeManager:
                     try:
                         probe.bind(("0.0.0.0", probe_port))
                     except OSError as e:
+                        # a busy coordinator port is usually TRANSIENT
+                        # (the previous placement's engine still
+                        # releasing) — retry with backoff instead of
+                        # parking the instance in a terminal ERROR
+                        # nobody reschedules. The attempt count lives on
+                        # the INSTANCE ROW: the event path recreates the
+                        # RunningInstance per attempt, so a local
+                        # counter would reset every time.
+                        attempts = inst.restarts + 1
+                        if attempts > MAX_RESTARTS:
+                            await self._set_state(
+                                instance_id,
+                                ModelInstanceState.ERROR,
+                                f"coordinator port {probe_port} "
+                                f"unavailable after "
+                                f"{MAX_RESTARTS} retries: {e}",
+                            )
+                            return
+                        delay = min(30.0, 2.0 ** attempts)
+                        logger.warning(
+                            "instance %d: coordinator port %d busy "
+                            "(%s); retry %d in %.0fs",
+                            instance_id, probe_port, e, attempts,
+                            delay,
+                        )
                         await self._set_state(
                             instance_id,
-                            ModelInstanceState.ERROR,
-                            f"coordinator port {probe_port} "
-                            f"unavailable: {e}",
+                            ModelInstanceState.SCHEDULED,
+                            f"coordinator port {probe_port} busy; "
+                            f"retry {attempts}",
+                            restarts=attempts,
+                        )
+
+                        async def _retry(iid=instance_id):
+                            # spawn_start wraps start_instance with
+                            # the same exception handling + placeholder
+                            # cleanup as the event path
+                            await asyncio.sleep(delay)
+                            self.spawn_start(iid)
+
+                        asyncio.create_task(
+                            _retry(), name=f"coord-retry-{instance_id}"
                         )
                         return
 
@@ -549,39 +589,62 @@ class ServeManager:
                 "failed to update instance %d state: %s", instance_id, e
             )
 
-    def _allocate_port(self) -> int:
+    def _allocate_port(self, exclude=()) -> int:
+        """Free engine port from the configured band.
+
+        ``exclude``: ports this instance must never take — its own
+        coordinator pair (the engine binding the port its own
+        jax.distributed coordinator needs starts fine once, then every
+        restart collides). When the band overlaps the scheduler's
+        coordinator range, ports OUTSIDE that range are preferred, but
+        overlap alone never exhausts the band."""
         from gpustack_tpu.scheduler.scheduler import (
             COORDINATOR_PORT_BASE,
             COORDINATOR_PORT_RANGE,
         )
 
-        used = {r.port for r in self.running.values()}
+        used = {r.port for r in self.running.values()} | set(exclude)
         base = self.cfg.engine_port_base
         coord_band = range(
             COORDINATOR_PORT_BASE,
             COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE,
         )
+
+        def bindable(port: int) -> bool:
+            with socket.socket(
+                socket.AF_INET, socket.SOCK_STREAM
+            ) as s:
+                try:
+                    s.bind(("127.0.0.1", port))
+                except OSError:
+                    return False
+            return True
+
+        in_band_candidates = []
         for offset in range(self.cfg.engine_port_range):
             port = base + offset
             if port in used:
                 continue
             if port in coord_band:
-                # a misconfigured engine_port_base overlapping the
-                # scheduler's coordinator band would brick multi-host
-                # placements subtly (the engine API server binds the
-                # port its own jax.distributed coordinator needs —
-                # first startup works, every restart collides)
+                in_band_candidates.append(port)
                 continue
-            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-                try:
-                    s.bind(("127.0.0.1", port))
-                except OSError:
-                    continue
-            return port
+            if bindable(port):
+                return port
+        for port in in_band_candidates:
+            if bindable(port):
+                logger.warning(
+                    "engine port %d falls inside the scheduler's "
+                    "coordinator band (%d..%d): engine_port_base "
+                    "overlaps it and no out-of-band port was free — a "
+                    "future multi-host placement assigned this port "
+                    "as its coordinator will have to wait for this "
+                    "engine to stop; reconfigure engine_port_base to "
+                    "a disjoint range",
+                    port, COORDINATOR_PORT_BASE,
+                    COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE,
+                )
+                return port
         raise RuntimeError(
             "no free engine ports (band "
-            f"{base}..{base + self.cfg.engine_port_range}; note the "
-            f"coordinator band {COORDINATOR_PORT_BASE}.."
-            f"{COORDINATOR_PORT_BASE + COORDINATOR_PORT_RANGE} is "
-            "excluded)"
+            f"{base}..{base + self.cfg.engine_port_range})"
         )

@@ -60,3 +60,141 @@ def test_reap_orphans(tmp_path):
         for p in (orphan, bystander):
             if p.poll() is None:
                 os.kill(p.pid, signal.SIGKILL)
+
+
+def test_busy_coordinator_port_retries_then_succeeds(tmp_path):
+    """A transient holder of the fenced coordinator port must trigger a
+    backoff retry, NOT a terminal ERROR nobody reschedules (seen live:
+    a lingering engine from a previous placement held the port for a
+    few seconds)."""
+    import asyncio
+    import socket
+
+    from gpustack_tpu.schemas import (
+        Model,
+        ModelInstance,
+        ModelInstanceState,
+    )
+
+    model = Model(id=1, name="m", preset="tiny")
+    inst = ModelInstance(
+        id=9, model_id=1, name="m-0", worker_id=1,
+        coordinator_address="127.0.0.1:45790",
+        subordinate_workers=[{"worker_id": 2, "process_index": 1}],
+    )
+    states = []
+
+    class _Client:
+        async def get(self, kind, id):
+            return (
+                inst.model_dump(mode="json") if kind == "model-instances"
+                else model.model_dump(mode="json")
+            )
+
+        async def update(self, kind, id, fields):
+            states.append(
+                (fields.get("state"), fields.get("state_message", ""))
+            )
+            # persist like the server would — the retry counter rides
+            # the instance row
+            if "restarts" in fields:
+                inst.restarts = fields["restarts"]
+            return {}
+
+        async def list(self, kind, **kw):
+            return []
+
+    cfg = Config.load({"data_dir": str(tmp_path)})
+    sm = ServeManager(cfg, _Client(), worker_id=1)
+
+    async def go():
+        holder = socket.socket()
+        holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        holder.bind(("0.0.0.0", 45790))
+        holder.listen(1)
+        try:
+            # the REAL event path: spawn_start wraps start_instance and
+            # pops its placeholder on failure — the retry must survive
+            # that (a self.running-keyed guard would no-op)
+            sm.spawn_start(9)
+            deadline = asyncio.get_event_loop().time() + 20
+            while not states:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            state, msg = states[-1]
+            assert state == ModelInstanceState.SCHEDULED.value, states
+            assert "busy" in msg and "retry 1" in msg
+            # attempt count persisted on the ROW (the event path
+            # recreates RunningInstance per attempt)
+            assert inst.restarts == 1
+        finally:
+            holder.close()
+        # with the port free, the delayed respawn proceeds past the
+        # probe (it will fail later at spawn on this bare harness, but
+        # it must NOT re-report a busy port)
+        n = len(states)
+        deadline = asyncio.get_event_loop().time() + 30
+        while len(states) == n and (
+            asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.2)
+        busy_again = [
+            s for s in states[n:] if "busy" in (s[1] or "")
+        ]
+        assert not busy_again, states
+        await sm.stop_all()
+
+    asyncio.run(go())
+
+
+def test_busy_coordinator_port_goes_terminal_after_max_retries(tmp_path):
+    import asyncio
+    import socket
+
+    from gpustack_tpu.schemas import (
+        Model,
+        ModelInstance,
+        ModelInstanceState,
+    )
+    from gpustack_tpu.worker.serve_manager import MAX_RESTARTS
+
+    model = Model(id=1, name="m", preset="tiny")
+    inst = ModelInstance(
+        id=9, model_id=1, name="m-0", worker_id=1,
+        coordinator_address="127.0.0.1:45794",
+        subordinate_workers=[{"worker_id": 2, "process_index": 1}],
+        restarts=MAX_RESTARTS,       # budget exhausted on the row
+    )
+    states = []
+
+    class _Client:
+        async def get(self, kind, id):
+            return (
+                inst.model_dump(mode="json")
+                if kind == "model-instances"
+                else model.model_dump(mode="json")
+            )
+
+        async def update(self, kind, id, fields):
+            states.append(fields.get("state"))
+            return {}
+
+        async def list(self, kind, **kw):
+            return []
+
+    cfg = Config.load({"data_dir": str(tmp_path)})
+    sm = ServeManager(cfg, _Client(), worker_id=1)
+
+    async def go():
+        holder = socket.socket()
+        holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        holder.bind(("0.0.0.0", 45794))
+        holder.listen(1)
+        try:
+            await sm.start_instance(9)
+            assert states[-1] == ModelInstanceState.ERROR.value, states
+        finally:
+            holder.close()
+            await sm.stop_all()
+
+    asyncio.run(go())
