@@ -1,7 +1,15 @@
-.PHONY: test test-fast test-engine test-e2e native bench smoke clean
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify
 
 test:
 	python -m pytest tests/ -q
+
+# Canonical tier-1 gate: the EXACT command from ROADMAP.md ("Tier-1
+# verify"), so builders and CI invoke one entrypoint instead of
+# re-typing (and drifting from) the driver's command line.
+# bash, not sh: the command uses PIPESTATUS.
+verify: SHELL := /bin/bash
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # <2min signal on WARM caches (XLA compile + import caches). The first
 # run on a cold box pays one-time jax/XLA warmup and can take ~10min on

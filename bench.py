@@ -82,6 +82,34 @@ _OURS = ("bench.py", "tpu_watch", "profile_onchip", "microbench",
 # "stale" also means OLD: a holder younger than this is presumed to be a
 # live run that simply has the chip right now — back off, don't shoot.
 _STALE_AGE_S = 900.0
+# Idle `python -c "import time ... sleep"` loops holding the PJRT plugin
+# (the r5 diag showed 11 of them pinning the chip for up to 23 h) clear
+# after a much shorter age — but only when they are PROVABLY idle:
+# cmdline shape alone can't distinguish a pure sleep loop from a poller
+# doing real work between sleeps, so the kill additionally requires
+# near-zero accumulated CPU time relative to the process's age.
+_IDLE_AGE_S = 300.0
+_IDLE_MAX_CPU_S = 30.0
+
+
+def _is_idle_sleep_loop(cmd: str) -> bool:
+    return (
+        " -c " in f" {cmd} "
+        and "import time" in cmd
+        and "sleep" in cmd
+    )
+
+
+def _proc_cpu_seconds(pid) -> float:
+    """utime+stime from /proc/<pid>/stat; inf when unreadable (an
+    unreadable process must never be classified as idle)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(") ", 1)[1].split()
+        ticks = int(fields[11]) + int(fields[12])  # utime, stime
+        return ticks / os.sysconf("SC_CLK_TCK")
+    except (OSError, IndexError, ValueError):
+        return float("inf")
 
 
 def _proc_age_s(pid: str) -> float:
@@ -117,24 +145,65 @@ def _pjrt_processes(skip_self: bool = True):
 
 
 def _stale_chip_holders():
-    """Subset of _pjrt_processes whose cmdline looks like one of our own
-    bench entrypoints AND that have been alive long past a normal run —
-    an earlier probe that wedged holding the claim."""
-    return [
-        h for h in _pjrt_processes()
-        if any(tag in h["cmd"] for tag in _OURS)
-        and h["age_s"] >= _STALE_AGE_S
-    ]
+    """Plugin-holding processes safe to clear: our own bench entrypoints
+    wedged past a normal run's lifetime, plus idle `python -c "import
+    time ..."` sleep loops (any parentage) past _IDLE_AGE_S — the
+    holders the r5 diagnostics recorded surviving the old predicate."""
+    out = []
+    for h in _pjrt_processes():
+        ours = any(tag in h["cmd"] for tag in _OURS)
+        if ours and h["age_s"] >= _STALE_AGE_S:
+            out.append(h)
+        elif (
+            _is_idle_sleep_loop(h["cmd"])
+            and h["age_s"] >= _IDLE_AGE_S
+            and _proc_cpu_seconds(h["pid"]) < _IDLE_MAX_CPU_S
+        ):
+            out.append(h)
+    return out
+
+
+def _proc_state(pid):
+    """One-letter /proc state, or None when the pid is gone."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(") ", 1)[1].split()[0]
+    except (OSError, IndexError):
+        return None
 
 
 def _kill_stale_holders(holders):
+    """SIGKILL each holder and report per-pid outcomes (logged to stderr
+    and recorded in the bench diag — a kill that silently failed is how
+    r5's holders survived unexplained). A zombie counts as killed: the
+    kernel already dropped its plugin mappings; only a wedged parent's
+    missing wait() keeps the pid visible."""
+    outcomes = []
     for h in holders:
         try:
             os.kill(h["pid"], signal.SIGKILL)
-        except OSError:
-            pass
+            err = None
+        except OSError as e:
+            err = str(e)
+        outcomes.append(dict(h, kill_error=err))
     if holders:
         time.sleep(2.0)
+    for o in outcomes:
+        state = _proc_state(o["pid"])
+        o["gone"] = state is None or state == "Z"
+        o["proc_state"] = state
+        print(
+            f"bench: stale holder pid {o['pid']} "
+            f"({o['cmd'][:60]!r}, age {o['age_s']}s): "
+            + (
+                "killed" + (" (unreaped zombie)" if state == "Z" else "")
+                if o["gone"]
+                else f"STILL ALIVE state={state} "
+                     f"(kill_error={o['kill_error']})"
+            ),
+            file=sys.stderr,
+        )
+    return outcomes
 
 
 def _chip_diagnostics():
@@ -223,6 +292,61 @@ PERSIST_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "TPU_RUN_BEST.json"
 )
 
+# ---- artifact emission ----------------------------------------------------
+# The driver parses the LAST stdout line as the round's metric. r5 lost
+# its artifact (`parsed: null`) because a dead TPU put a huge diagnostics
+# blob on that line. Rule now: full diagnostics go to a FILE; the inline
+# copy is a ≤500-byte summary + pointer; the final line is always one
+# compact {"metric": ...} JSON no matter how the run died.
+
+DIAG_INLINE_BYTES = 500
+DIAG_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_DIAG.json"
+)
+
+
+def _diag_summary(diag, path):
+    summary = {
+        "file": path,
+        "verdict": str(diag.get("verdict", ""))[:300],
+        "relay_ports_up": diag.get("relay_ports_up", []),
+        "stale_holders_killed": len(
+            diag.get("stale_holders_killed") or []
+        ),
+    }
+    # hard byte guarantee, whatever ends up in verdict
+    while (
+        len(json.dumps(summary)) > DIAG_INLINE_BYTES
+        and summary["verdict"]
+    ):
+        summary["verdict"] = summary["verdict"][
+            : len(summary["verdict"]) // 2
+        ]
+    return summary
+
+
+def _emit(result) -> None:
+    """Print the metric line, offloading oversized diagnostics to
+    BENCH_DIAG.json (override with BENCH_DIAG_PATH) first."""
+    detail = result.get("detail")
+    if isinstance(detail, dict):
+        big = {
+            key: detail[key]
+            for key in ("tpu_diag", "bench_time_tpu_diag")
+            if isinstance(detail.get(key), dict)
+            and len(json.dumps(detail[key])) > DIAG_INLINE_BYTES
+        }
+        if big:
+            path = os.environ.get("BENCH_DIAG_PATH", DIAG_PATH)
+            try:
+                with open(path, "w") as f:
+                    json.dump(big, f)
+            except OSError:
+                path = None
+            for key, diag in big.items():
+                detail[key] = _diag_summary(diag, path)
+    print(json.dumps(result))
+
 
 # A persisted run older than this is from a previous round (rounds are
 # ~12h) and measured older code — never emit it as this round's artifact.
@@ -297,6 +421,16 @@ def acquire_tpu():
         diag["skipped"] = "BENCH_SMOKE=1"
         return False, diag
     diag["chip_state"] = _chip_diagnostics()
+    # Clear stale holders UP FRONT (r5: 11 idle sleep loops pinned the
+    # plugin through the whole round because cleanup only ran after a
+    # failed claim, and the claim path never ran with the relay down —
+    # a pinned chip plausibly contributes to cold-init UNAVAILABLE).
+    if os.environ.get("BENCH_KILL_HOLDERS", "1") == "1":
+        holders = _stale_chip_holders()
+        if holders:
+            diag.setdefault("stale_holders_killed", []).extend(
+                _kill_stale_holders(holders)
+            )
     relay_up = bool(_relay_listening())
     probe = None
     if not relay_up:
@@ -371,8 +505,11 @@ def acquire_tpu():
         if i == 0 and os.environ.get("BENCH_KILL_HOLDERS", "1") == "1":
             holders = _stale_chip_holders()
             if holders:
-                diag["stale_holders_killed"] = holders
-                _kill_stale_holders(holders)
+                # extend, don't overwrite: the up-front pass may have
+                # recorded kills already and those outcomes must survive
+                diag.setdefault("stale_holders_killed", []).extend(
+                    _kill_stale_holders(holders)
+                )
         if i + 1 < attempts:
             time.sleep(10.0 * (i + 1))
     diag["verdict"] = "tpu init failed after retries (see attempts)"
@@ -433,12 +570,12 @@ def main() -> None:
     on_tpu, diag = acquire_tpu()
     if not on_tpu:
         if os.environ.get("BENCH_REQUIRE_TPU") == "1":
-            print(json.dumps({
+            _emit({
                 "metric": "error", "value": 0, "unit": "",
                 "vs_baseline": None,
                 "detail": {"error": "BENCH_REQUIRE_TPU=1 and no TPU",
                            "tpu_diag": diag},
-            }))
+            })
             sys.exit(3)
         persisted = load_persisted_run(
             os.environ.get("BENCH_PROFILE", "throughput")
@@ -449,7 +586,7 @@ def main() -> None:
             # perf artifact; today's diag rides along for the record.
             persisted.setdefault("detail", {})["persisted_run"] = True
             persisted["detail"]["bench_time_tpu_diag"] = diag
-            print(json.dumps(persisted))
+            _emit(persisted)
             return
     if on_tpu:
         # Keep the TPU platform primary but expose host CPU for staging
@@ -475,19 +612,17 @@ def main() -> None:
     smoke = not on_tpu
     profile_name = os.environ.get("BENCH_PROFILE", "throughput")
     if profile_name not in PROFILES:
-        print(
-            json.dumps(
-                {
-                    "metric": "error",
-                    "value": 0,
-                    "unit": "",
-                    "vs_baseline": 0,
-                    "detail": {
-                        "error": f"unknown BENCH_PROFILE {profile_name!r}",
-                        "valid": sorted(PROFILES),
-                    },
-                }
-            )
+        _emit(
+            {
+                "metric": "error",
+                "value": 0,
+                "unit": "",
+                "vs_baseline": 0,
+                "detail": {
+                    "error": f"unknown BENCH_PROFILE {profile_name!r}",
+                    "valid": sorted(PROFILES),
+                },
+            }
         )
         return
     prof = dict(PROFILES[profile_name])
@@ -630,8 +765,21 @@ def main() -> None:
             with open(tmp, "w") as f:
                 json.dump(result, f)
             os.replace(tmp, PERSIST_PATH)
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — the artifact line must print
+        import traceback
+
+        traceback.print_exc()
+        _emit({
+            "metric": "error", "value": 0, "unit": "",
+            "vs_baseline": None,
+            "detail": {"error": repr(e)[:300]},
+        })
+        sys.exit(1)

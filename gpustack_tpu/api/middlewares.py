@@ -53,6 +53,11 @@ _WORKER_ROUTE_ALLOWLIST = (
         r"^/v2/(model-instances|model-files|benchmarks|dev-instances)"
         r"/\d+$"
     )),
+    # graceful-drain retirement: the owning worker deletes its drained
+    # instance row so replica sync creates a replacement (ownership is
+    # enforced in crud's instance_worker_owns — a worker can only ever
+    # delete instances placed on itself)
+    ("DELETE", re.compile(r"^/v2/model-instances/\d+$")),
 )
 
 

@@ -49,6 +49,24 @@ class Config(pydantic.BaseModel):
     engine_port_range: int = 200
     force_platform: str = ""          # "cpu" for hermetic tests
 
+    # data-plane resilience (server/resilience.py + openai proxy)
+    proxy_failover_attempts: int = 3    # max replicas tried per request
+    proxy_failover_deadline: float = 10.0  # seconds across all attempts
+    # hang guard: max seconds to upstream HEADERS per attempt. Matches
+    # the old worker_fetch tolerance by default — non-streaming
+    # generations send headers only when the body is ready, so this
+    # must comfortably exceed worst-case generation time.
+    proxy_headers_timeout: float = 600.0
+    breaker_failure_threshold: int = 3  # consecutive failures → open
+    breaker_open_seconds: float = 10.0  # base open window (jittered)
+    model_max_outstanding: int = 256    # per-model in-flight cap; 0 = off
+    # worker: graceful drain — wait for the reverse proxy's in-flight
+    # count to reach zero (bounded) before SIGTERM on stop/recreate
+    drain_timeout: float = 30.0
+    # worker: per-instance log rotation (copy-truncate; 0 cap disables)
+    instance_log_max_bytes: int = 64 * 2**20
+    instance_log_keep: int = 3
+
     # observability
     enable_metrics: bool = True
 

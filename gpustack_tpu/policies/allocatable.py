@@ -23,6 +23,7 @@ CLAIMING_STATES = {
     ModelInstanceState.DOWNLOADING,
     ModelInstanceState.STARTING,
     ModelInstanceState.RUNNING,
+    ModelInstanceState.DRAINING,      # engine still serving in-flight work
     ModelInstanceState.UNREACHABLE,   # the worker may come back; hold chips
 }
 DEV_CLAIMING_STATES = {

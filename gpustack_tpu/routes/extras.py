@@ -588,6 +588,36 @@ def add_extra_routes(app: web.Application) -> None:
             return float(value)
         return str(value)
 
+    async def instance_drain(request: web.Request):
+        """Graceful retirement of one replica (rolling updates): flips a
+        RUNNING instance to DRAINING — the proxy's picker stops routing
+        to it, the owning worker waits for in-flight requests to finish
+        (bounded by its drain timeout), SIGTERMs the engine, and retires
+        the row so replica sync creates a replacement. Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
+        inst = await ModelInstance.get(int(request.match_info["id"]))
+        if inst is None:
+            return json_error(404, "instance not found")
+        if inst.state == ModelInstanceState.DRAINING:
+            return web.json_response(inst.model_dump(mode="json"))
+        if inst.state != ModelInstanceState.RUNNING:
+            return json_error(
+                409,
+                f"instance is {inst.state.value}; only a running "
+                "instance can drain",
+            )
+        await inst.update(
+            state=ModelInstanceState.DRAINING,
+            state_message="drain requested",
+        )
+        return web.json_response(inst.model_dump(mode="json"))
+
+    app.router.add_post(
+        "/v2/model-instances/{id:\\d+}/drain", instance_drain
+    )
     app.router.add_get("/v2/config/reload", reload_config)
     app.router.add_post("/v2/config/reload", reload_config)
     app.router.add_get("/v2/model-catalog", catalog)

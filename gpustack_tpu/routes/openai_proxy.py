@@ -2,17 +2,26 @@
 
 Reference call path parity (gpustack/routes/openai.py:185-313):
 auth → model route resolution (weighted targets) → pick a RUNNING instance
-(round-robin) → relay the request, streaming SSE chunks through unbuffered
-— with token usage extracted from the response and recorded
-(api/middlewares.py:226-307 analogue, in-process)."""
+→ relay the request, streaming SSE chunks through unbuffered — with token
+usage extracted from the response and recorded (api/middlewares.py:226-307
+analogue, in-process).
+
+Data-plane resilience (server/resilience.py): replicas are picked by
+least-outstanding-requests behind per-instance circuit breakers; a
+connect failure or 5xx BEFORE any bytes reach the client fails over to
+the remaining replicas (bounded attempts, jittered backoff, overall
+deadline); once streaming has begun the request is never retried (no
+silent duplicate generation). Per-model in-flight caps shed excess load
+as 429 + Retry-After instead of queueing unboundedly — the resilience
+role the reference delegates to its Envoy/Higress gateway."""
 
 from __future__ import annotations
 
-import itertools
+import asyncio
 import json
 import logging
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
@@ -29,8 +38,6 @@ from gpustack_tpu.schemas import (
 from gpustack_tpu.schemas.usage import ModelUsage
 
 logger = logging.getLogger(__name__)
-
-_rr_counters: Dict[int, itertools.count] = {}
 
 
 class ProviderTarget:
@@ -98,14 +105,208 @@ async def _resolve_model(name: str):
     return await Model.first(name=name)
 
 
-async def _pick_instance(model: Model) -> Optional[ModelInstance]:
-    instances = await ModelInstance.filter(
-        model_id=model.id, state=ModelInstanceState.RUNNING
+class _TrackedResponse:
+    """Upstream response adapter that reports completion to the
+    resilience registry exactly once — on full-body read or release,
+    whichever the handler hits first — so outstanding-request counts
+    (the selection signal and the shed denominator) can't leak."""
+
+    def __init__(self, upstream, on_done):
+        self._upstream = upstream
+        self._on_done = on_done
+        self._finished = False
+        self.status = upstream.status
+        self.headers = upstream.headers
+
+    @property
+    def content_type(self) -> str:
+        return self._upstream.content_type
+
+    @property
+    def content(self):
+        return self._upstream.content
+
+    async def read(self) -> bytes:
+        try:
+            return await self._upstream.read()
+        finally:
+            self._finish()
+
+    def release(self) -> None:
+        self._upstream.release()
+        self._finish()
+
+    def _finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self._on_done()
+
+
+def _shed_response(model_name: str, retry_after: float) -> web.Response:
+    return web.json_response(
+        {
+            "error": (
+                f"model {model_name!r} is at its in-flight request "
+                "cap; retry later"
+            )
+        },
+        status=429,
+        headers={"Retry-After": str(max(1, int(retry_after)))},
     )
-    if not instances:
-        return None
-    counter = _rr_counters.setdefault(model.id, itertools.count())
-    return instances[next(counter) % len(instances)]
+
+
+async def _instance_fetch(
+    app: web.Application,
+    model: Model,
+    instances: List[ModelInstance],
+    path_for,
+    *,
+    json_body=None,
+    raw_body: bytes = b"",
+    content_type: str = "",
+):
+    """Dial one of the model's RUNNING replicas with failover.
+
+    Returns ``(upstream, None)`` on success or ``(None, error_response)``.
+    Replicas are tried in breaker-gated least-outstanding order; a
+    connect failure, a headers timeout, or a 5xx moves on to the next
+    replica (jittered backoff, bounded attempts, overall deadline).
+    Everything here happens before any byte reaches the client, so
+    failing over can never duplicate output the client already saw.
+    ``path_for(instance)`` builds the worker-proxy path per attempt.
+    """
+    from gpustack_tpu.server.worker_request import worker_fetch
+
+    reg = app["resilience"]
+    retry_after = reg.try_shed(model.id)
+    if retry_after is not None:
+        return None, _shed_response(model.name, retry_after)
+
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + reg.failover_deadline
+    candidates = reg.order(instances)[: reg.failover_attempts]
+    errors: List[str] = []
+    tried = 0
+    for inst in candidates:
+        if loop.time() >= deadline:
+            errors.append("failover deadline exceeded")
+            break
+        if not reg.admit(inst.id):
+            continue  # breaker open and not yet probe-eligible
+        if tried:
+            # count + back off only between ACTUAL dials — skipped
+            # (breaker-refused) candidates must not inflate the
+            # failover metric or pay pointless sleep latency.
+            # Jittered: a replica set failing for one shared reason
+            # shouldn't be hammered in lockstep.
+            reg.failovers_total += 1
+            await asyncio.sleep(
+                min(0.25, 0.05 * (2 ** (tried - 1)))
+                * random.uniform(0.5, 1.5)
+            )
+            if loop.time() >= deadline:
+                # admit() may have consumed the half-open probe slot
+                reg.abort_probe(inst.id)
+                errors.append("failover deadline exceeded")
+                break
+        tried += 1
+        worker = await Worker.get(inst.worker_id or 0)
+        if worker is None:
+            reg.record_failure(inst.id)
+            errors.append(f"{inst.name}: no placed worker")
+            continue
+        reg.begin(model.id, inst.id)
+        handed_off = False
+        try:
+            try:
+                # wait_for is a HANG guard on time-to-headers only, and
+                # deliberately generous (default 600s, the old
+                # worker_fetch tolerance): a non-streaming generation
+                # sends headers only when the body is ready, so a tight
+                # deadline-derived budget would kill slow-but-healthy
+                # replicas and trip their breakers. The failover
+                # deadline bounds RETRIES after fast failures, not a
+                # legitimate attempt in progress. Stream duration after
+                # headers is unbounded — worker_fetch's own timeout
+                # governs.
+                upstream = await asyncio.wait_for(
+                    worker_fetch(
+                        app, worker, "POST", path_for(inst),
+                        json_body=json_body,
+                        raw_body=raw_body,
+                        content_type=content_type,
+                    ),
+                    timeout=reg.headers_timeout,
+                )
+            except (
+                aiohttp.ClientError, asyncio.TimeoutError, OSError
+            ) as e:
+                reg.record_failure(inst.id)
+                errors.append(
+                    f"{inst.name}: {str(e) or type(e).__name__}"
+                )
+                continue
+            stale_routing = (
+                upstream.status == 404
+                and upstream.headers.get("X-GPUStack-Worker")
+                == "instance-not-running"
+            )
+            if upstream.status >= 500 or stale_routing:
+                # replica-side failure with no bytes relayed yet:
+                # count against the breaker, move on. A 404 fails over
+                # ONLY when the worker proxy tagged it as its own
+                # "instance not running here" (stale routing view
+                # during a drain/stop) — an engine's own 404 (e.g. an
+                # op that model doesn't serve) is a client-visible
+                # answer, and treating it as replica failure would let
+                # wrong-op requests trip every breaker
+                reg.record_failure(inst.id)
+                errors.append(
+                    f"{inst.name}: upstream HTTP {upstream.status}"
+                )
+                # release WITHOUT reading: draining a failed replica's
+                # body is unbounded (a stalled 500 could trickle for
+                # minutes and eat the whole failover deadline); closing
+                # the connection costs one keep-alive slot, nothing more
+                upstream.release()
+                continue
+            reg.record_success(inst.id)
+            handed_off = True
+            return (
+                _TrackedResponse(
+                    upstream,
+                    lambda m=model.id, i=inst.id: reg.end(m, i),
+                ),
+                None,
+            )
+        finally:
+            # the outstanding slot must survive ONLY a successful
+            # hand-off to _TrackedResponse; a client disconnect
+            # (CancelledError) or any unexpected raise mid-dial would
+            # otherwise leak it until the model pins at its shed cap —
+            # and a half-open probe aborted without an outcome must
+            # release its probe slot or the breaker wedges shut
+            if not handed_off:
+                reg.end(model.id, inst.id)
+                reg.abort_probe(inst.id)
+    if not errors:
+        # nothing was even dialable: every breaker open inside its window
+        wait = reg.seconds_until_any_probe(instances)
+        return None, web.json_response(
+            {
+                "error": (
+                    f"all replicas of {model.name!r} are "
+                    "circuit-broken; retry later"
+                )
+            },
+            status=503,
+            headers={"Retry-After": str(max(1, int(wait)))},
+        )
+    return None, json_error(
+        502,
+        f"all replicas of {model.name!r} failed: "
+        + "; ".join(errors[-3:]),
+    )
 
 
 def _extract_usage(payload: dict) -> Tuple[int, int]:
@@ -191,10 +392,14 @@ async def _provider_fetch(
 
 
 async def _resolve_target(request: web.Request, name: str):
-    """name → (model, instance, worker) | ProviderTarget, or an error.
+    """name → (model, running_instances) | ProviderTarget, or an error.
 
     Shared by the JSON and audio proxies: tenancy denial is a 404
-    indistinguishable from nonexistence; no instance / no worker is 503.
+    indistinguishable from nonexistence; no running instance is 503.
+    Only RUNNING replicas qualify — DRAINING instances still finish
+    their in-flight work but take no new requests (the drain contract).
+    The actual replica pick happens per dial attempt in
+    ``_instance_fetch`` so failover sees the full replica set.
     """
     from gpustack_tpu.api.tenant import model_accessible
 
@@ -212,17 +417,14 @@ async def _resolve_target(request: web.Request, name: str):
         request.get("principal"), model
     ):
         return None, json_error(404, f"model {name!r} not found")
-    instance = await _pick_instance(model)
-    if instance is None:
+    instances = await ModelInstance.filter(
+        model_id=model.id, state=ModelInstanceState.RUNNING
+    )
+    if not instances:
         return None, json_error(
             503, f"no running instances for model {name!r}"
         )
-    worker = await Worker.get(instance.worker_id or 0)
-    if worker is None:
-        return None, json_error(
-            503, f"instance for {name!r} has no placed worker"
-        )
-    return (model, instance, worker), None
+    return (model, instances), None
 
 
 def add_openai_routes(app: web.Application) -> None:
@@ -325,23 +527,22 @@ def add_openai_routes(app: web.Application) -> None:
             except aiohttp.ClientError as e:
                 return json_error(502, f"provider unreachable: {e}")
         else:
-            model, instance, worker = target
+            model, instances = target
             model_id, provider_id = model.id, 0
             # All data-plane traffic flows through the worker's
             # authenticated reverse proxy (or its tunnel): engines bind to
             # 127.0.0.1 and the bare engine port is never dialed (reference
             # routes/worker/proxy.py:200; round-1 direct dialing was an
             # unauthenticated bypass of the entire auth layer).
-            from gpustack_tpu.server.worker_request import worker_fetch
-
-            try:
-                upstream = await worker_fetch(
-                    app, worker, "POST",
-                    f"/proxy/instances/{instance.id}/v1/{operation}",
-                    json_body=body,
-                )
-            except aiohttp.ClientError as e:
-                return json_error(502, f"instance unreachable: {e}")
+            upstream, err = await _instance_fetch(
+                app, model, instances,
+                lambda inst: (
+                    f"/proxy/instances/{inst.id}/v1/{operation}"
+                ),
+                json_body=body,
+            )
+            if err is not None:
+                return err
 
         if not stream:
             payload_bytes = await upstream.read()
@@ -381,11 +582,13 @@ def add_openai_routes(app: web.Application) -> None:
                 "Cache-Control": "no-cache",
             },
         )
-        await resp.prepare(request)
         usage_tokens: List[int] = [0, 0]
         buffer = b""
         skip_blank = False  # swallow the blank line after a dropped event
         try:
+            # prepare inside the guard: a client gone before headers
+            # must still release the upstream (and its outstanding slot)
+            await resp.prepare(request)
             async for chunk in upstream.content.iter_any():
                 buffer += chunk
                 while b"\n" in buffer:
@@ -436,8 +639,6 @@ def add_openai_routes(app: web.Application) -> None:
         VoxBox-role audio engine)."""
         import uuid as _uuid
 
-        from gpustack_tpu.server.worker_request import worker_fetch
-
         if not request.content_type.startswith("multipart/"):
             return json_error(400, "multipart/form-data required")
         wav = b""
@@ -462,7 +663,7 @@ def add_openai_routes(app: web.Application) -> None:
             # the upstream needs the provider's model name as a form field
             fields["model"] = target.upstream_model
         else:
-            model, instance, worker = target
+            model, instances = target
             model_id, provider_id = model.id, 0
 
         # rebuild the multipart body for the upstream hop
@@ -488,27 +689,24 @@ def add_openai_routes(app: web.Application) -> None:
         parts.append(f"--{boundary}--\r\n".encode())
         raw = b"".join(parts)
         ctype = f"multipart/form-data; boundary={boundary}"
-        try:
-            op = request.path.removeprefix("/v1/")   # audio/<task>s
-            if isinstance(target, ProviderTarget):
+        op = request.path.removeprefix("/v1/")   # audio/<task>s
+        if isinstance(target, ProviderTarget):
+            try:
                 upstream = await _provider_fetch(
                     app, target.provider, op,
                     raw_body=raw, content_type=ctype,
                 )
-            else:
-                upstream = await worker_fetch(
-                    app, worker, "POST",
-                    f"/proxy/instances/{instance.id}/v1/{op}",
-                    raw_body=raw,
-                    content_type=ctype,
-                )
-        except aiohttp.ClientError as e:
-            kind = (
-                "provider"
-                if isinstance(target, ProviderTarget)
-                else "instance"
+            except aiohttp.ClientError as e:
+                return json_error(502, f"provider unreachable: {e}")
+        else:
+            upstream, err = await _instance_fetch(
+                app, model, instances,
+                lambda inst: f"/proxy/instances/{inst.id}/v1/{op}",
+                raw_body=raw,
+                content_type=ctype,
             )
-            return json_error(502, f"{kind} unreachable: {e}")
+            if err is not None:
+                return err
         payload = await upstream.read()
         upstream.release()
         if upstream.status == 200:
@@ -528,8 +726,6 @@ def add_openai_routes(app: web.Application) -> None:
         """/v1/audio/speech: JSON relay to a TTS-model instance; the
         response is audio bytes, not JSON (reference VoxBox TTS role,
         worker/backends/vox_box.py:23)."""
-        from gpustack_tpu.server.worker_request import worker_fetch
-
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -540,28 +736,27 @@ def add_openai_routes(app: web.Application) -> None:
         target, err = await _resolve_target(request, name)
         if err is not None:
             return err
-        try:
-            if isinstance(target, ProviderTarget):
-                body["model"] = target.upstream_model
+        if isinstance(target, ProviderTarget):
+            body["model"] = target.upstream_model
+            model_id, provider_id = 0, target.provider.id
+            try:
                 upstream = await _provider_fetch(
                     app, target.provider, "audio/speech", body
                 )
-                model_id, provider_id = 0, target.provider.id
-            else:
-                model, instance, worker = target
-                model_id, provider_id = model.id, 0
-                upstream = await worker_fetch(
-                    app, worker, "POST",
-                    f"/proxy/instances/{instance.id}/v1/audio/speech",
-                    json_body=body,
-                )
-        except aiohttp.ClientError as e:
-            kind = (
-                "provider"
-                if isinstance(target, ProviderTarget)
-                else "instance"
+            except aiohttp.ClientError as e:
+                return json_error(502, f"provider unreachable: {e}")
+        else:
+            model, instances = target
+            model_id, provider_id = model.id, 0
+            upstream, err = await _instance_fetch(
+                app, model, instances,
+                lambda inst: (
+                    f"/proxy/instances/{inst.id}/v1/audio/speech"
+                ),
+                json_body=body,
             )
-            return json_error(502, f"{kind} unreachable: {e}")
+            if err is not None:
+                return err
         payload = await upstream.read()
         upstream.release()
         if upstream.status == 200:

@@ -4,6 +4,10 @@ State machine (mirrors reference gpustack/schemas/models.py:384-399):
 
     PENDING → ANALYZING → SCHEDULED → DOWNLOADING → STARTING → RUNNING
         ↘ ERROR (from any)      RUNNING → UNREACHABLE (worker lost)
+                                RUNNING → DRAINING (graceful stop: the
+        proxy's picker excludes the instance, in-flight requests finish
+        — bounded by the drain timeout — then the worker SIGTERMs the
+        engine and retires the row; worker/serve_manager.py drain path)
 
 Placement on TPU is a **mesh plan** (dp/sp/ep/tp axis sizes whose product
 is chips-per-replica) rather than engine flags — the scheduler computes it,
@@ -32,6 +36,7 @@ class ModelInstanceState(str, enum.Enum):
     DOWNLOADING = "downloading"
     STARTING = "starting"
     RUNNING = "running"
+    DRAINING = "draining"
     ERROR = "error"
     UNREACHABLE = "unreachable"
 

@@ -543,11 +543,23 @@ def create_app(cfg: Config) -> web.Application:
         cfg.federation_peers
     )
 
+    # data-plane resilience: breaker/health view + least-outstanding
+    # selection + load shedding for the OpenAI proxy (server/resilience.py)
+    from gpustack_tpu.server.resilience import ResilienceRegistry
+
+    app["resilience"] = ResilienceRegistry.from_config(cfg)
+
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):
         import asyncio as _asyncio
 
         app["proxy_session"] = aiohttp.ClientSession()
+        # feed the health view from instance/worker lifecycle events
+        # (heartbeat staleness → worker UNREACHABLE → breakers trip
+        # without waiting for request traffic to fail)
+        app["resilience_watch"] = _asyncio.create_task(
+            app["resilience"].watch(), name="resilience-watch"
+        )
         app["plugin_tasks"] = []
         for plugin in app["plugins"]:
             try:
@@ -566,6 +578,16 @@ def create_app(cfg: Config) -> web.Application:
     async def on_cleanup(app: web.Application):
         import asyncio as _asyncio
 
+        watch = app.get("resilience_watch")
+        if watch is not None:
+            watch.cancel()
+            try:
+                await watch
+            except (
+                _asyncio.CancelledError,
+                Exception,
+            ):
+                pass
         tasks = app.get("plugin_tasks", [])
         for task in tasks:
             task.cancel()

@@ -198,6 +198,21 @@ class ModelController(Controller):
                 key=lambda i: (order.get(i.state, 0), -i.id),
             )[: len(instances) - want]
             for inst in doomed:
+                if inst.state == ModelInstanceState.DRAINING:
+                    continue  # already on its way out
+                if inst.state == ModelInstanceState.RUNNING:
+                    # graceful scale-down: DRAINING holds the chip claim
+                    # while the worker finishes in-flight requests, then
+                    # the worker retires the row itself — a hard delete
+                    # would free the claim under a still-serving engine
+                    logger.info(
+                        "draining instance %s for scale-down", inst.name
+                    )
+                    await inst.update(
+                        state=ModelInstanceState.DRAINING,
+                        state_message="scale-down drain",
+                    )
+                    continue
                 logger.info("retiring instance %s", inst.name)
                 await inst.delete()
 
@@ -460,6 +475,19 @@ class WorkerController(Controller):
         _, new = state_change
         if new == WorkerState.UNREACHABLE.value:
             for inst in await ModelInstance.filter(worker_id=event.id):
+                if inst.state == ModelInstanceState.DRAINING:
+                    # same semantics as RUNNING below: the worker may
+                    # be partitioned, not dead, with its engine still
+                    # serving its last streams — deleting the row here
+                    # would free the chip claim under a live engine
+                    # and invite a double placement. UNREACHABLE holds
+                    # the claim; worker deletion (or its return) takes
+                    # it from there.
+                    await inst.update(
+                        state=ModelInstanceState.UNREACHABLE,
+                        state_message="worker unreachable during drain",
+                    )
+                    continue
                 if inst.state != ModelInstanceState.RUNNING:
                     continue
                 if inst.subordinate_workers:

@@ -57,7 +57,9 @@ class ServerExporter:
         used_chips = 0
         for inst in instances:
             by_state[inst.state.value] = by_state.get(inst.state.value, 0) + 1
-            if inst.state.value in ("running", "starting", "scheduled"):
+            if inst.state.value in (
+                "running", "starting", "scheduled", "draining"
+            ):
                 used_chips += len(inst.chip_indexes)
                 for sub in inst.subordinate_workers:
                     used_chips += len(sub.chip_indexes)
@@ -105,6 +107,12 @@ def add_metrics_route(app: web.Application) -> None:
     exporter = ServerExporter()
 
     async def metrics(request: web.Request):
-        return web.Response(text=await exporter.metrics_text())
+        text = await exporter.metrics_text()
+        # data-plane resilience counters (failovers/shed/breaker state)
+        # live in the per-app registry, not the DB — append uncached
+        registry = request.app.get("resilience")
+        if registry is not None:
+            text += "\n".join(registry.metrics_lines()) + "\n"
+        return web.Response(text=text)
 
     app.router.add_get("/metrics", metrics)

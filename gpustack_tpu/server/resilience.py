@@ -1,0 +1,452 @@
+"""Data-plane resilience: circuit breakers, health view, replica selection.
+
+The reference delegates request-path resilience to its Envoy/Higress
+gateway (outlier detection, retries, connection limits — PAPER.md §1);
+with an in-process gateway we own that layer ourselves. One
+``ResilienceRegistry`` per server app holds:
+
+- a per-instance **circuit breaker** (closed → open → half-open with a
+  jittered probe window and exponential re-open backoff),
+- an **outstanding-request count** per instance, used for
+  least-outstanding-requests replica selection (replacing the blind
+  round-robin the proxy shipped with),
+- a per-model outstanding total for **load shedding** (429 +
+  ``Retry-After`` instead of queueing unboundedly),
+- Prometheus-style counters surfaced through the server's existing
+  ``/metrics`` exporter (``gpustack_proxy_failovers_total``,
+  ``gpustack_proxy_shed_total``, ``gpustack_proxy_breaker_state``, …).
+
+The view is fed from two directions: proxy outcomes
+(``record_success``/``record_failure`` per dial) and the control plane's
+own failure detection (``watch()`` subscribes to instance/worker events,
+so a heartbeat-staleness UNREACHABLE trips the breakers of every
+instance on that worker without waiting for a request to fail).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import random
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class BreakerState(str, enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+# numeric encoding for the breaker_state gauge (0 is healthy so alerts
+# can be written as `> 0`)
+_STATE_GAUGE = {
+    BreakerState.CLOSED: 0,
+    BreakerState.HALF_OPEN: 1,
+    BreakerState.OPEN: 2,
+}
+
+
+class CircuitBreaker:
+    """Per-instance breaker: N consecutive failures open it; after a
+    jittered window one probe request is admitted (half-open); the
+    probe's outcome closes it or re-opens with exponential backoff."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        open_seconds: float = 10.0,
+        max_open_seconds: float = 120.0,
+        clock=time.monotonic,
+    ):
+        self.failure_threshold = max(1, failure_threshold)
+        self.open_seconds = open_seconds
+        self.max_open_seconds = max_open_seconds
+        self._clock = clock
+        self.state = BreakerState.CLOSED
+        self.consecutive_failures = 0
+        self.open_count = 0          # consecutive opens → probe backoff
+        self.probe_at = 0.0
+        self.probing = False
+
+    def would_allow(self) -> bool:
+        """Pure peek for candidate ordering — never consumes the probe
+        slot (``allow`` does, at dial time)."""
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.OPEN:
+            return self._clock() >= self.probe_at
+        return not self.probing
+
+    def allow(self) -> bool:
+        """Stateful admission: an OPEN breaker past its window moves to
+        HALF_OPEN and admits exactly one probe until its outcome lands."""
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.OPEN:
+            if self._clock() < self.probe_at:
+                return False
+            self.state = BreakerState.HALF_OPEN
+            self.probing = True
+            return True
+        if self.probing:
+            return False
+        self.probing = True
+        return True
+
+    def record_success(self) -> None:
+        self.probing = False
+        self.consecutive_failures = 0
+        self.open_count = 0
+        self.state = BreakerState.CLOSED
+
+    def record_failure(self) -> None:
+        self.probing = False
+        self.consecutive_failures += 1
+        if (
+            self.state is BreakerState.HALF_OPEN
+            or self.consecutive_failures >= self.failure_threshold
+        ):
+            self.trip()
+
+    def trip(self) -> None:
+        """Force-open (also the worker-lost path: don't wait for dials
+        to a dead host to time out one by one)."""
+        self.state = BreakerState.OPEN
+        self.probing = False
+        self.open_count += 1
+        base = min(
+            self.max_open_seconds,
+            self.open_seconds * (2 ** (self.open_count - 1)),
+        )
+        # jittered probe: replicas broken by one event must not all
+        # probe (and all re-fail) in the same instant
+        self.probe_at = self._clock() + base * random.uniform(0.8, 1.2)
+
+    def seconds_until_probe(self) -> float:
+        if self.state is not BreakerState.OPEN:
+            return 0.0
+        return max(0.0, self.probe_at - self._clock())
+
+
+class InstanceHealth:
+    __slots__ = ("breaker", "outstanding")
+
+    def __init__(self, breaker: CircuitBreaker):
+        self.breaker = breaker
+        self.outstanding = 0
+
+
+class ResilienceRegistry:
+    """In-memory health view + selection + shed policy for the proxy."""
+
+    def __init__(
+        self,
+        *,
+        failover_attempts: int = 3,
+        failover_deadline: float = 10.0,
+        headers_timeout: float = 600.0,
+        breaker_failure_threshold: int = 3,
+        breaker_open_seconds: float = 10.0,
+        model_max_outstanding: int = 256,
+        clock=time.monotonic,
+    ):
+        self.failover_attempts = max(1, failover_attempts)
+        self.failover_deadline = failover_deadline
+        self.headers_timeout = headers_timeout
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_open_seconds = breaker_open_seconds
+        self.model_max_outstanding = model_max_outstanding
+        self._clock = clock
+        self._instances: Dict[int, InstanceHealth] = {}
+        self._model_outstanding: Dict[int, int] = {}
+        # counters (exported via server /metrics)
+        self.failovers_total = 0
+        self.shed_total = 0
+        self.breaker_opens_total = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "ResilienceRegistry":
+        return cls(
+            failover_attempts=int(
+                getattr(cfg, "proxy_failover_attempts", 3)
+            ),
+            failover_deadline=float(
+                getattr(cfg, "proxy_failover_deadline", 10.0)
+            ),
+            headers_timeout=float(
+                getattr(cfg, "proxy_headers_timeout", 600.0)
+            ),
+            breaker_failure_threshold=int(
+                getattr(cfg, "breaker_failure_threshold", 3)
+            ),
+            breaker_open_seconds=float(
+                getattr(cfg, "breaker_open_seconds", 10.0)
+            ),
+            model_max_outstanding=int(
+                getattr(cfg, "model_max_outstanding", 256)
+            ),
+        )
+
+    # ---- per-instance state ---------------------------------------------
+
+    def health(self, instance_id: int) -> InstanceHealth:
+        h = self._instances.get(instance_id)
+        if h is None:
+            h = InstanceHealth(
+                CircuitBreaker(
+                    failure_threshold=self.breaker_failure_threshold,
+                    open_seconds=self.breaker_open_seconds,
+                    clock=self._clock,
+                )
+            )
+            self._instances[instance_id] = h
+        return h
+
+    def breaker_state(self, instance_id: int) -> BreakerState:
+        return self.health(instance_id).breaker.state
+
+    def forget(self, instance_id: int) -> None:
+        """Instance deleted: drop its state (ids are never reused by the
+        autoincrement PK, so stale entries are pure leak)."""
+        self._instances.pop(instance_id, None)
+
+    def reset(self, instance_id: int) -> None:
+        """Instance freshly RUNNING (restart recovered): clean slate so a
+        previous life's open breaker doesn't shadow the new engine."""
+        h = self._instances.get(instance_id)
+        if h is not None:
+            h.breaker.record_success()
+
+    def trip(self, instance_id: int, reason: str = "") -> None:
+        h = self.health(instance_id)
+        if h.breaker.state is BreakerState.OPEN:
+            # already open: re-tripping would inflate the counter and
+            # double the probe backoff without any probe having failed
+            return
+        logger.info(
+            "circuit breaker for instance %d opened%s",
+            instance_id, f" ({reason})" if reason else "",
+        )
+        h.breaker.trip()
+        self.breaker_opens_total += 1
+
+    # ---- proxy outcome feed ---------------------------------------------
+
+    def record_success(self, instance_id: int) -> None:
+        self.health(instance_id).breaker.record_success()
+
+    def record_failure(self, instance_id: int) -> None:
+        b = self.health(instance_id).breaker
+        was_open = b.state is BreakerState.OPEN
+        b.record_failure()
+        if b.state is BreakerState.OPEN and not was_open:
+            self.breaker_opens_total += 1
+            logger.warning(
+                "circuit breaker for instance %d opened after %d "
+                "consecutive failures", instance_id,
+                b.consecutive_failures,
+            )
+
+    def admit(self, instance_id: int) -> bool:
+        return self.health(instance_id).breaker.allow()
+
+    def abort_probe(self, instance_id: int) -> None:
+        """A dial admitted by ``admit`` ended with NO outcome (the
+        caller was cancelled mid-request): release the half-open probe
+        slot. Without this the breaker wedges — probing stays True and
+        ``allow`` refuses every future request forever."""
+        h = self._instances.get(instance_id)
+        if h is not None:
+            h.breaker.probing = False
+
+    # ---- selection --------------------------------------------------------
+
+    def order(self, instances: Sequence) -> List:
+        """Preference order for a dial: breaker-admittable replicas
+        first, least-outstanding-requests within each group (random
+        tie-break so equal replicas share load). Breaker-open replicas
+        stay in the list (last) purely so ``seconds_until_any_probe``
+        and callers can report on them — ``admit`` still refuses them."""
+
+        def key(inst):
+            h = self.health(inst.id)
+            return (
+                0 if h.breaker.would_allow() else 1,
+                h.outstanding,
+                random.random(),
+            )
+
+        return sorted(instances, key=key)
+
+    def seconds_until_any_probe(self, instances: Iterable) -> float:
+        waits = [
+            self.health(i.id).breaker.seconds_until_probe()
+            for i in instances
+        ]
+        return min(waits) if waits else 0.0
+
+    # ---- outstanding accounting + shedding --------------------------------
+
+    def begin(self, model_id: int, instance_id: int) -> None:
+        self.health(instance_id).outstanding += 1
+        self._model_outstanding[model_id] = (
+            self._model_outstanding.get(model_id, 0) + 1
+        )
+
+    def end(self, model_id: int, instance_id: int) -> None:
+        h = self._instances.get(instance_id)
+        if h is not None and h.outstanding > 0:
+            h.outstanding -= 1
+        n = self._model_outstanding.get(model_id, 0) - 1
+        if n <= 0:
+            self._model_outstanding.pop(model_id, None)
+        else:
+            self._model_outstanding[model_id] = n
+
+    def outstanding(self, instance_id: int) -> int:
+        h = self._instances.get(instance_id)
+        return h.outstanding if h else 0
+
+    def model_outstanding(self, model_id: int) -> int:
+        return self._model_outstanding.get(model_id, 0)
+
+    def try_shed(self, model_id: int) -> Optional[float]:
+        """None = admitted; a float = shed, with the suggested
+        ``Retry-After`` seconds. The cap bounds in-flight work per model
+        so a stalled engine turns into fast 429s, not an unbounded queue
+        of blocked clients."""
+        cap = self.model_max_outstanding
+        if cap <= 0:
+            return None
+        if self._model_outstanding.get(model_id, 0) < cap:
+            return None
+        self.shed_total += 1
+        return 1.0
+
+    # ---- control-plane feed ----------------------------------------------
+
+    async def watch(self) -> None:
+        """Subscribe to instance + worker events and keep the health
+        view honest without request traffic: a worker whose heartbeats
+        went stale (WorkerSyncer → UNREACHABLE) trips every breaker on
+        it; an instance re-entering RUNNING gets a clean slate; deleted
+        instances are forgotten."""
+        from gpustack_tpu.schemas import (
+            ModelInstance,
+            ModelInstanceState,
+            Worker,
+            WorkerState,
+        )
+        from gpustack_tpu.server.bus import EventType
+
+        async def instance_loop():
+            agen = ModelInstance.subscribe(heartbeat=30.0)
+            try:
+                async for event in agen:
+                    if event.type == EventType.RESYNC:
+                        break
+                    if event.type == EventType.HEARTBEAT:
+                        continue
+                    if event.type == EventType.DELETED:
+                        self.forget(event.id)
+                        continue
+                    # TRANSITIONS only: keying off the absolute state
+                    # would let any unrelated row update while RUNNING
+                    # close a legitimately open breaker (and re-trip an
+                    # open one on repeated ERROR-state writes)
+                    changed = (event.changes or {}).get("state")
+                    if not changed:
+                        continue
+                    state = changed[1]
+                    if state == ModelInstanceState.RUNNING.value:
+                        self.reset(event.id)
+                    elif state in (
+                        ModelInstanceState.ERROR.value,
+                        ModelInstanceState.UNREACHABLE.value,
+                    ):
+                        self.trip(event.id, f"instance {state}")
+            finally:
+                await agen.aclose()
+
+        async def worker_loop():
+            agen = Worker.subscribe(heartbeat=30.0)
+            try:
+                async for event in agen:
+                    if event.type == EventType.RESYNC:
+                        break
+                    if event.type != EventType.UPDATED:
+                        continue
+                    changed = (event.changes or {}).get("state")
+                    if not changed:
+                        continue
+                    if changed[1] != WorkerState.UNREACHABLE.value:
+                        continue
+                    for inst in await ModelInstance.filter(
+                        worker_id=event.id
+                    ):
+                        self.trip(inst.id, "worker unreachable")
+            finally:
+                await agen.aclose()
+
+        async def forever(loop_fn):
+            # one transient DB/subscribe error must not silently
+            # disable the control-plane breaker feed for the rest of
+            # the server's life (the controllers use the same pattern)
+            while True:
+                try:
+                    await loop_fn()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "resilience %s failed; retrying",
+                        loop_fn.__name__,
+                    )
+                    await asyncio.sleep(2.0)
+
+        loops = [
+            asyncio.create_task(
+                forever(instance_loop), name="resilience-inst"
+            ),
+            asyncio.create_task(
+                forever(worker_loop), name="resilience-worker"
+            ),
+        ]
+        try:
+            await asyncio.gather(*loops)
+        finally:
+            for t in loops:
+                t.cancel()
+
+    # ---- metrics ----------------------------------------------------------
+
+    def metrics_lines(self) -> List[str]:
+        lines = [
+            "# TYPE gpustack_proxy_failovers_total counter",
+            f"gpustack_proxy_failovers_total {self.failovers_total}",
+            "# TYPE gpustack_proxy_shed_total counter",
+            f"gpustack_proxy_shed_total {self.shed_total}",
+            "# TYPE gpustack_proxy_breaker_opens_total counter",
+            f"gpustack_proxy_breaker_opens_total "
+            f"{self.breaker_opens_total}",
+        ]
+        if self._instances:
+            lines.append("# TYPE gpustack_proxy_breaker_state gauge")
+            for iid, h in sorted(self._instances.items()):
+                lines.append(
+                    f'gpustack_proxy_breaker_state{{instance_id="{iid}"}} '
+                    f"{_STATE_GAUGE[h.breaker.state]}"
+                )
+            lines.append(
+                "# TYPE gpustack_proxy_outstanding_requests gauge"
+            )
+            for iid, h in sorted(self._instances.items()):
+                lines.append(
+                    f"gpustack_proxy_outstanding_requests"
+                    f'{{instance_id="{iid}"}} {h.outstanding}'
+                )
+        return lines

@@ -1,0 +1,206 @@
+"""Fault-injectable stand-in for a worker's data-plane surface.
+
+Speaks the exact path the server's OpenAI proxy dials on a worker
+(``/proxy/instances/{id}/v1/...``) and flips failure modes on command,
+so the resilience layer (failover, circuit breaking, streaming safety,
+load shedding — server/resilience.py) is testable without TPUs, real
+engines, or even a ServeManager:
+
+==================  =====================================================
+mode                behavior
+==================  =====================================================
+``none``            healthy: deterministic OpenAI-style completions
+                    (stream and non-stream), like testing/stub_engine.py
+``error``           HTTP 500 JSON body (replica-side failure)
+``hang``            accept the request, never send headers (wedged
+                    engine — exercises the proxy's headers timeout)
+``slow``            respond after ``delay_s`` (shed/backlog tests)
+``die_mid_stream``  emit ``stream_chunks_before_death`` SSE chunks, then
+                    abort the connection without ``[DONE]`` (the
+                    must-never-retry case)
+==================  =====================================================
+
+Modes switch in-process via :attr:`FaultyReplica.mode` or over HTTP via
+``POST /__fault__ {"mode": ..., "delay_s": ...}`` when the replica runs
+as a separate process (``python -m gpustack_tpu.testing.faulty_replica``).
+A full outage (connect refused) is simulated by :meth:`stop` — a closed
+listener is the real thing, not an approximation.
+
+``attempts`` counts data-plane requests received; the streaming-safety
+test asserts it stays at 1 after a mid-stream death (no silent retry).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+VALID_MODES = ("none", "error", "hang", "slow", "die_mid_stream")
+
+
+class FaultyReplica:
+    def __init__(self, served_name: str = "stub-model"):
+        self.served_name = served_name
+        self.mode = "none"
+        self.delay_s = 1.0
+        self.stream_chunks_before_death = 2
+        self.attempts = 0          # data-plane requests received
+        self.port = 0
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/healthz", self._healthz),
+                web.post("/__fault__", self._set_fault),
+                web.route(
+                    "*",
+                    "/proxy/instances/{id:\\d+}/{tail:.*}",
+                    self._handle,
+                ),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    # ---- lifecycle ------------------------------------------------------
+
+    async def start(self, port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        for sock in site._server.sockets:  # noqa: SLF001
+            self.port = sock.getsockname()[1]
+            break
+        return self.port
+
+    async def stop(self) -> None:
+        """Close the listener — subsequent dials get connect-refused,
+        the genuine article for dead-replica failover tests."""
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ---- control --------------------------------------------------------
+
+    async def _healthz(self, _request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "mode": self.mode, "attempts": self.attempts}
+        )
+
+    async def _set_fault(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        mode = body.get("mode", self.mode)
+        if mode not in VALID_MODES:
+            return web.json_response(
+                {"error": f"unknown mode {mode!r} (valid: {VALID_MODES})"},
+                status=400,
+            )
+        self.mode = mode
+        if "delay_s" in body:
+            self.delay_s = float(body["delay_s"])
+        if "stream_chunks_before_death" in body:
+            self.stream_chunks_before_death = int(
+                body["stream_chunks_before_death"]
+            )
+        if body.get("reset_attempts"):
+            self.attempts = 0
+        return web.json_response({"mode": self.mode})
+
+    # ---- data plane -----------------------------------------------------
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        self.attempts += 1
+        mode = self.mode
+        if mode == "hang":
+            # never respond; aiohttp cancels this handler when the
+            # client gives up (the proxy's headers timeout)
+            await asyncio.sleep(3600)
+        if mode == "slow":
+            await asyncio.sleep(self.delay_s)
+        if mode == "error":
+            return web.json_response(
+                {"error": "injected replica failure"}, status=500
+            )
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            body = {}
+        prompt = " ".join(
+            str(m.get("content", ""))
+            for m in body.get("messages", [])
+        ) or str(body.get("prompt", "") or "ok")
+        words = (prompt.split() or ["ok"]) * 4
+        text = "stub: " + " ".join(words[:8])
+        usage = {
+            "prompt_tokens": len(prompt.split()),
+            "completion_tokens": len(text.split()),
+            "total_tokens": len(prompt.split()) + len(text.split()),
+        }
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for n, piece in enumerate(text.split(" ")):
+                if (
+                    mode == "die_mid_stream"
+                    and n >= self.stream_chunks_before_death
+                ):
+                    # abort without [DONE]: the client must see the
+                    # truncation, never a silently retried duplicate
+                    request.transport.close()
+                    return resp
+                chunk = {
+                    "id": rid, "object": "chat.completion.chunk",
+                    "model": self.served_name,
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"content": piece + " "},
+                        "finish_reason": None,
+                    }],
+                }
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode()
+                )
+                await asyncio.sleep(0)
+            done = {
+                "id": rid, "object": "chat.completion.chunk",
+                "model": self.served_name,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": "stop"}],
+                "usage": usage,
+            }
+            await resp.write(f"data: {json.dumps(done)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        return web.json_response({
+            "id": rid, "object": "chat.completion",
+            "created": int(time.time()), "model": self.served_name,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "stop",
+            }],
+            "usage": usage,
+        })
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("fault-injectable replica")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--served-name", default="stub-model")
+    p.add_argument("--mode", default="none", choices=VALID_MODES)
+    args = p.parse_args(argv)
+    replica = FaultyReplica(args.served_name)
+    replica.mode = args.mode
+    web.run_app(replica.app, host="127.0.0.1", port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,7 +57,11 @@ def _usage(prompt: str, text: str) -> dict:
     }
 
 
-def build_app(served_name: str, fail_health_after: float = 0.0) -> web.Application:
+def build_app(
+    served_name: str,
+    fail_health_after: float = 0.0,
+    token_delay: float = 0.0,
+) -> web.Application:
     app = web.Application()
 
     async def health(_request):
@@ -96,7 +100,9 @@ def build_app(served_name: str, fail_health_after: float = 0.0) -> web.Applicati
                 await resp.write(
                     f"data: {json.dumps(chunk)}\n\n".encode()
                 )
-                await asyncio.sleep(0)
+                # paced streaming (drain tests need a generation that is
+                # genuinely in flight while the instance drains)
+                await asyncio.sleep(token_delay)
             done = {
                 "id": rid, "object": "chat.completion.chunk",
                 "model": served_name,
@@ -162,9 +168,15 @@ def main(argv=None) -> None:
         "--fail-health-after", type=float, default=0.0,
         help="seconds after which /health flips 503 (crash-path tests)",
     )
+    p.add_argument(
+        "--token-delay", type=float, default=0.0,
+        help="seconds between streamed SSE chunks (drain tests)",
+    )
     args = p.parse_args(argv)
     web.run_app(
-        build_app(args.served_name, args.fail_health_after),
+        build_app(
+            args.served_name, args.fail_health_after, args.token_delay
+        ),
         host=args.host, port=args.port, print=None,
     )
 

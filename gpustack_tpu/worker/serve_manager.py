@@ -13,7 +13,7 @@ import logging
 import os
 import socket
 import time
-from typing import Dict, Optional, Set
+from typing import Callable, Dict, Optional, Set
 
 import aiohttp
 
@@ -39,6 +39,7 @@ class RunningInstance:
         self.monitor_task: Optional[asyncio.Task] = None
         self.restarts = 0
         self.stopping = False
+        self.draining = False
         self.is_leader = True
         # external engines declare their own readiness endpoint (vLLM
         # uses /health) via BackendVersionConfig.health_path
@@ -60,6 +61,27 @@ class ServeManager:
         # inference-backends watch (reference InferenceBackendManager
         # caches via watch instead of fetching per start)
         self.backends_cache: Dict[str, InferenceBackend] = {}
+        # graceful drain: the worker HTTP server's per-instance in-flight
+        # count (WorkerServer.inflight_count), wired by the agent; stop
+        # waits for it to reach zero (bounded) before SIGTERM
+        self.inflight_source: Optional[Callable[[int], int]] = None
+        self.drains_total = 0
+        self.drain_seconds_total = 0.0
+        # drains in progress: stop_instance pops self.running at entry,
+        # so reconcile's "DRAINING row with no local engine" orphan
+        # check needs this to not mistake an ACTIVE drain (engine still
+        # serving its last streams) for an agent-restart leftover
+        self._draining_ids: Set[int] = set()
+        self._rotate_task: Optional[asyncio.Task] = None
+        # strong refs to fire-and-forget stop/drain tasks: asyncio only
+        # weak-refs scheduled tasks, and a GC'd drain would strand a
+        # DRAINING row holding its chip claim forever
+        self._bg_tasks: Set[asyncio.Task] = set()
+
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     def handle_backend_event(self, event: Event) -> None:
         if event.type == EventType.RESYNC:
@@ -101,14 +123,23 @@ class ServeManager:
             await self.reconcile()
             return
         if event.type == EventType.DELETED:
-            await self.stop_instance(event.id)
+            # hard removal: the row — and its CHIP CLAIM — is already
+            # gone, so the scheduler may place a replacement onto these
+            # chips immediately; draining here would make the old
+            # engine contend with the new one for the device (graceful
+            # paths go through the DRAINING state, which holds the
+            # claim until the engine has stopped). AWAITED, not
+            # backgrounded: a replacement's SCHEDULED event must not be
+            # processed until this engine has released the chips.
+            await self.stop_instance(event.id, drain=False)
             return
         data = event.data or {}
         role = self._my_role(data)
         if role is None:
-            # instance moved away from us (reschedule): stop local copy
+            # instance moved away from us (reschedule): the claim now
+            # points elsewhere — same fast-stop reasoning as DELETED
             if event.id in self.running:
-                await self.stop_instance(event.id)
+                await self.stop_instance(event.id, drain=False)
             return
         state = data.get("state")
         if (
@@ -116,6 +147,28 @@ class ServeManager:
             and event.id not in self.running
         ):
             self.spawn_start(event.id)
+        elif state == ModelInstanceState.DRAINING.value:
+            # server-requested graceful retirement (rolling update /
+            # rebalance): finish in-flight requests, SIGTERM, then
+            # delete the row so replica sync creates a replacement.
+            # LEADER-ONLY: data-plane traffic flows through the leader's
+            # reverse proxy, so a subordinate's in-flight count is
+            # always zero — it would SIGTERM its engine shard instantly,
+            # collapsing the distributed engine mid-generation. The
+            # subordinates stop when the leader's retirement DELETEs
+            # the row.
+            run = self.running.get(event.id)
+            if (
+                role[0] == 0
+                and run is not None
+                and not run.stopping
+                and not run.draining
+            ):
+                run.draining = True
+                self._track(asyncio.create_task(
+                    self._drain_and_retire(event.id),
+                    name=f"drain-{event.id}",
+                ))
 
     def spawn_start(self, instance_id: int) -> None:
         """Run start_instance as its own task: downloads can take minutes
@@ -174,8 +227,14 @@ class ServeManager:
                     ModelInstanceState.STARTING,
                     ModelInstanceState.RUNNING,
                     ModelInstanceState.DOWNLOADING,
+                    # we are reachable again (this reconcile reached
+                    # the server) but the engine is gone — e.g. a
+                    # drain interrupted by the partition that marked
+                    # us unreachable; re-drive to restore capacity
+                    ModelInstanceState.UNREACHABLE,
                 )
                 and inst.id not in self.running
+                and inst.id not in self._draining_ids
             ):
                 # DB says alive but no local process (agent restarted, or
                 # the engine was reaped as an orphan): re-drive through the
@@ -193,9 +252,36 @@ class ServeManager:
                     "engine process lost; restarting",
                 )
                 self.spawn_start(inst.id)
+            elif inst.state == ModelInstanceState.DRAINING and is_leader:
+                run = self.running.get(inst.id)
+                if run is None and inst.id not in self._draining_ids:
+                    # drain orphaned by an agent restart: the engine is
+                    # gone; retire the row so replica sync replaces it
+                    # (an ACTIVE drain also has run popped, but its id
+                    # sits in _draining_ids — deleting under it would
+                    # free the chip claim while the engine still serves)
+                    try:
+                        await self.client.delete(
+                            "model-instances", inst.id
+                        )
+                    except APIError:
+                        logger.exception(
+                            "failed to retire drained instance %d",
+                            inst.id,
+                        )
+                elif (
+                    run is not None
+                    and not run.stopping
+                    and not run.draining
+                ):
+                    run.draining = True
+                    self._track(asyncio.create_task(
+                        self._drain_and_retire(inst.id),
+                        name=f"drain-{inst.id}",
+                    ))
         for iid in list(self.running):
             if iid not in mine:
-                await self.stop_instance(iid)
+                await self.stop_instance(iid, drain=False)
 
     # ---- lifecycle ------------------------------------------------------
 
@@ -464,32 +550,179 @@ class ServeManager:
                     pass
         return len(reaped_pids)
 
-    async def stop_instance(self, instance_id: int) -> None:
+    async def stop_instance(
+        self, instance_id: int, *, drain: bool = True
+    ) -> None:
         run = self.running.pop(instance_id, None)
+        if run is not None:
+            run.stopping = True
+            if run.monitor_task:
+                run.monitor_task.cancel()
+            if run.process and run.process.returncode is None:
+                if drain:
+                    await self._drain(run)
+                logger.info("terminating instance %d", instance_id)
+                try:
+                    run.process.terminate()
+                    try:
+                        await asyncio.wait_for(run.process.wait(), 10)
+                    except asyncio.TimeoutError:
+                        run.process.kill()
+                        await run.process.wait()
+                except ProcessLookupError:
+                    pass
+        # pidfile LAST: while the drain waits (up to drain_timeout) the
+        # engine is still alive, and an agent crash in that window must
+        # leave the pidfile for reap_orphans to find the survivor
         try:
             os.unlink(self._pidfile(instance_id))
         except OSError:
             pass
-        if run is None:
+
+    async def _drain(self, run: RunningInstance) -> None:
+        """Wait (bounded by ``drain_timeout``) for the worker reverse
+        proxy's in-flight count for this instance to reach zero before
+        the SIGTERM — a scheduler-driven rebalance or rolling update
+        must not kill a live generation mid-stream. The DRAINING state
+        makes the server's picker stop routing new requests here while
+        the wait runs."""
+        if self.inflight_source is None:
             return
-        run.stopping = True
-        if run.monitor_task:
-            run.monitor_task.cancel()
-        if run.process and run.process.returncode is None:
-            logger.info("terminating instance %d", instance_id)
+        timeout = float(getattr(self.cfg, "drain_timeout", 30.0))
+        if timeout <= 0:
+            return
+        inflight = self.inflight_source(run.instance_id)
+        if inflight <= 0:
+            return
+        self.drains_total += 1
+        if run.is_leader:
+            # best-effort: on a DELETE-triggered stop the row is already
+            # gone and this update just logs a warning
+            await self._set_state(
+                run.instance_id, ModelInstanceState.DRAINING,
+                f"draining {inflight} in-flight request(s)",
+            )
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if self.inflight_source(run.instance_id) <= 0:
+                break
+            if run.process is None or run.process.returncode is not None:
+                break  # engine died on its own; nothing left to drain
+            await asyncio.sleep(0.2)
+        waited = time.monotonic() - t0
+        self.drain_seconds_total += waited
+        remaining = self.inflight_source(run.instance_id)
+        if remaining > 0:
+            logger.warning(
+                "instance %d drain timed out after %.1fs with %d "
+                "request(s) still in flight; terminating anyway",
+                run.instance_id, waited, remaining,
+            )
+        else:
+            logger.info(
+                "instance %d drained in %.1fs", run.instance_id, waited
+            )
+
+    async def _drain_and_retire(self, instance_id: int) -> None:
+        """DRAINING event path: graceful stop, then delete the instance
+        row so the ModelController's replica sync creates a fresh
+        replacement (the rolling-update contract)."""
+        self._draining_ids.add(instance_id)
+        try:
             try:
-                run.process.terminate()
-                try:
-                    await asyncio.wait_for(run.process.wait(), 10)
-                except asyncio.TimeoutError:
-                    run.process.kill()
-                    await run.process.wait()
-            except ProcessLookupError:
-                pass
+                await self.stop_instance(instance_id)
+            except Exception:
+                logger.exception(
+                    "drain of instance %d failed", instance_id
+                )
+            try:
+                await self.client.delete("model-instances", instance_id)
+            except APIError as e:
+                logger.warning(
+                    "failed to retire drained instance %d: %s",
+                    instance_id, e,
+                )
+        finally:
+            self._draining_ids.discard(instance_id)
 
     async def stop_all(self) -> None:
+        if self._rotate_task is not None:
+            self._rotate_task.cancel()
+            self._rotate_task = None
         for iid in list(self.running):
-            await self.stop_instance(iid)
+            # agent shutdown: fast teardown — draining every instance
+            # serially could hold SIGTERM handling for minutes
+            await self.stop_instance(iid, drain=False)
+
+    # ---- log rotation ---------------------------------------------------
+
+    def start_log_rotation(self, interval: float = 10.0) -> None:
+        """Periodic size-capped rotation of instance log files
+        (reference rotates per-instance logs, serve_manager.py:902-1289;
+        without it a long-lived chatty engine grows one file unbounded)."""
+        if self._rotate_task is None and float(
+            getattr(self.cfg, "instance_log_max_bytes", 0)
+        ) > 0:
+            self._rotate_task = asyncio.create_task(
+                self._rotate_loop(interval), name="log-rotation"
+            )
+
+    async def _rotate_loop(self, interval: float) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                # executor: copying a >=64 MiB log synchronously would
+                # stall every relay, /healthz, and the drain poll
+                await loop.run_in_executor(None, self.rotate_logs_once)
+            except Exception:
+                logger.exception("instance log rotation failed")
+
+    def rotate_logs_once(self) -> int:
+        """Copy-truncate rotation: ``x.log`` over the cap is copied to
+        ``x.log.1`` (shifting .1→.2 … up to ``instance_log_keep``, oldest
+        dropped) and the live file truncated to zero. Copy-truncate, not
+        rename: the engine holds an O_APPEND fd ("ab"), so truncation is
+        safe — its next write lands at offset 0 — while a rename would
+        carry the fd into the rotated file and the live path would stop
+        growing. Bytes appended between the copy and the truncate are
+        lost; the window is one copyfile of a capped file.
+
+        Follow-streaming (worker/server.py instance_logs) survives: its
+        poll loop treats a shrinking file as truncation and restarts
+        from offset zero."""
+        import shutil
+
+        cap = int(getattr(self.cfg, "instance_log_max_bytes", 0))
+        keep = max(1, int(getattr(self.cfg, "instance_log_keep", 3)))
+        if cap <= 0:
+            return 0
+        rotated = 0
+        for fname in os.listdir(self.log_dir):
+            if not fname.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, fname)
+            try:
+                if os.path.getsize(path) <= cap:
+                    continue
+            except OSError:
+                continue
+            try:
+                oldest = f"{path}.{keep}"
+                if os.path.exists(oldest):
+                    os.unlink(oldest)
+                for i in range(keep - 1, 0, -1):
+                    src = f"{path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{path}.{i + 1}")
+                shutil.copyfile(path, f"{path}.1")
+                os.truncate(path, 0)
+                rotated += 1
+                logger.info("rotated instance log %s", fname)
+            except OSError:
+                logger.exception("failed to rotate %s", fname)
+        return rotated
 
     # ---- monitoring -----------------------------------------------------
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 import aiohttp
 from aiohttp import web
@@ -58,6 +58,13 @@ class WorkerServer:
         # long-lived pool for the hot proxy path — per-request sessions
         # would pay connect+teardown per completion
         self._proxy_session: Optional[aiohttp.ClientSession] = None
+        # in-flight data-plane requests per instance: the graceful-drain
+        # gate (ServeManager waits for zero before SIGTERM) and a
+        # /metrics gauge
+        self._inflight: Dict[int, int] = {}
+
+    def inflight_count(self, instance_id: int) -> int:
+        return self._inflight.get(instance_id, 0)
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
@@ -91,9 +98,13 @@ class WorkerServer:
         instance_id = int(request.match_info["id"])
         run = sm.running.get(instance_id)
         if run is None or not run.port:
+            # the header distinguishes THIS 404 (stale routing view —
+            # the server's failover may retry another replica) from an
+            # engine's own 404 (a client error that must pass through)
             return web.json_response(
                 {"error": f"instance {instance_id} not running here"},
                 status=404,
+                headers={"X-GPUStack-Worker": "instance-not-running"},
             )
         tail = request.match_info["tail"]
         qs = f"?{request.query_string}" if request.query_string else ""
@@ -105,6 +116,12 @@ class WorkerServer:
         }
         if self._proxy_session is None or self._proxy_session.closed:
             self._proxy_session = aiohttp.ClientSession()
+        # counted over the WHOLE relay (headers through last stream
+        # byte): drain waits on this, so an in-flight SSE generation
+        # holds the count until its final chunk lands
+        self._inflight[instance_id] = (
+            self._inflight.get(instance_id, 0) + 1
+        )
         try:
             async with self._proxy_session.request(
                 request.method,
@@ -131,6 +148,12 @@ class WorkerServer:
             return web.json_response(
                 {"error": f"engine unreachable: {e}"}, status=502
             )
+        finally:
+            n = self._inflight.get(instance_id, 1) - 1
+            if n <= 0:
+                self._inflight.pop(instance_id, None)
+            else:
+                self._inflight[instance_id] = n
 
     async def start(self, host: str, port: int) -> int:
         """Bind and return the actual port (``port=0`` binds ephemeral —
@@ -184,6 +207,27 @@ class WorkerServer:
                 f'gpustack_worker_tpu_hbm_bytes{{chip="{chip.index}",'
                 f'type="{chip.chip_type}"}} {chip.hbm_bytes}'
             )
+        # data-plane resilience: in-flight relay counts (the drain gate)
+        # + cumulative drain accounting from the serve manager
+        if self._inflight:
+            lines.append(
+                "# TYPE gpustack_worker_inflight_requests gauge"
+            )
+            for iid, n in sorted(self._inflight.items()):
+                lines.append(
+                    f"gpustack_worker_inflight_requests"
+                    f'{{instance_id="{iid}"}} {n}'
+                )
+        sm = self.agent.serve_manager
+        if sm is not None:
+            lines += [
+                "# TYPE gpustack_worker_drains_total counter",
+                f"gpustack_worker_drains_total "
+                f"{getattr(sm, 'drains_total', 0)}",
+                "# TYPE gpustack_worker_drain_seconds_total counter",
+                f"gpustack_worker_drain_seconds_total "
+                f"{round(getattr(sm, 'drain_seconds_total', 0.0), 3)}",
+            ]
         # normalized engine metrics: per-engine names mapped onto the
         # gpustack_tpu:* namespace (reference RuntimeMetricsAggregator +
         # metrics_config.yaml)

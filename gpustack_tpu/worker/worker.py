@@ -93,6 +93,10 @@ class WorkerAgent:
         self.serve_manager = ServeManager(
             self.cfg, self.client, self.worker_id
         )
+        # graceful drain: stops wait for the reverse proxy's in-flight
+        # count to reach zero before SIGTERM (worker/server.py counter)
+        self.serve_manager.inflight_source = self.http.inflight_count
+        self.serve_manager.start_log_rotation()
         # reaps block on /proc probes and grace waits — keep them off
         # the event loop so /healthz and registration stay responsive
         # during startup cleanup after a crash

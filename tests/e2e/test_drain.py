@@ -1,0 +1,259 @@
+"""Graceful-drain e2e (ISSUE 2 acceptance): an instance set to DRAINING
+with an in-flight streaming request → the picker routes new requests to
+the other replica, the in-flight stream completes, and the engine
+process exits on SIGTERM (never SIGKILL), after which the worker retires
+the instance row so replica sync can create a replacement.
+
+Real pieces on real TCP: a stub-engine subprocess (paced SSE so the
+generation is genuinely in flight while draining), the worker's
+authenticated reverse proxy with its in-flight counter, a ServeManager
+driving the drain, and the server app's OpenAI proxy on top.
+"""
+
+import asyncio
+import os
+import signal
+import sys
+import time
+import types
+
+import aiohttp
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import Event, EventBus, EventType
+from gpustack_tpu.testing.faulty_replica import FaultyReplica
+from gpustack_tpu.worker.serve_manager import (
+    RunningInstance,
+    ServeManager,
+)
+from gpustack_tpu.worker.server import WorkerServer
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class _RecordingClient:
+    """Duck-typed ClientSet: the drain path only reports state and
+    retires the row; record both."""
+
+    def __init__(self):
+        self.updates = []
+        self.deletes = []
+
+    async def update(self, kind, id, fields):
+        self.updates.append((kind, id, fields))
+        return fields
+
+    async def delete(self, kind, id):
+        self.deletes.append((kind, id))
+
+    async def list(self, kind, **kw):
+        return []
+
+
+async def _spawn_stub_engine(port: int):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "gpustack_tpu.testing.stub_engine",
+        "--port", str(port), "--served-name", "m",
+        "--token-delay", "0.25", "--host", "127.0.0.1",
+        env=env,
+        stdout=asyncio.subprocess.DEVNULL,
+        stderr=asyncio.subprocess.DEVNULL,
+    )
+    deadline = time.time() + 30
+    async with aiohttp.ClientSession() as http:
+        while time.time() < deadline:
+            try:
+                async with http.get(
+                    f"http://127.0.0.1:{port}/health",
+                    timeout=aiohttp.ClientTimeout(total=1),
+                ) as r:
+                    if r.status == 200:
+                        return proc
+            except (aiohttp.ClientError, OSError):
+                pass
+            await asyncio.sleep(0.2)
+    raise AssertionError("stub engine never became healthy")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_drain_completes_inflight_stream_then_sigterm(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    cfg = Config.load(
+        {"data_dir": str(tmp_path), "drain_timeout": 30.0}
+    )
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        # --- worker side: stub engine + reverse proxy + serve manager
+        engine_port = _free_port()
+        engine_proc = await _spawn_stub_engine(engine_port)
+        sm = ServeManager(cfg, _RecordingClient(), worker_id=1)
+        run = RunningInstance(0, engine_port)  # instance id fixed below
+        run.process = engine_proc
+        agent = types.SimpleNamespace(
+            cfg=cfg, worker_id=1, serve_manager=sm,
+            proxy_secret="drain-secret", detector=None,
+        )
+        ws = WorkerServer(agent)
+        sm.inflight_source = ws.inflight_count
+        worker_port = await ws.start("127.0.0.1", 0)
+
+        # --- second replica elsewhere (the "routes elsewhere" target)
+        other = FaultyReplica()
+        other_port = await other.start()
+
+        # --- control plane rows
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        hdrs = {"Authorization": f"Bearer {token}"}
+        model = await Model.create(Model(name="m", preset="tiny"))
+        w1 = await Worker.create(
+            Worker(
+                name="w1", ip="127.0.0.1", port=worker_port,
+                state=WorkerState.READY, proxy_secret="drain-secret",
+            )
+        )
+        w2 = await Worker.create(
+            Worker(
+                name="w2", ip="127.0.0.1", port=other_port,
+                state=WorkerState.READY, proxy_secret="s",
+            )
+        )
+        inst1 = await ModelInstance.create(
+            ModelInstance(
+                name="m-0", model_id=model.id, model_name="m",
+                state=ModelInstanceState.RUNNING, worker_id=w1.id,
+                port=engine_port,
+            )
+        )
+        inst2 = await ModelInstance.create(
+            ModelInstance(
+                name="m-1", model_id=model.id, model_name="m",
+                state=ModelInstanceState.RUNNING, worker_id=w2.id,
+                port=other_port,
+            )
+        )
+        run.instance_id = inst1.id
+        sm.running[inst1.id] = run
+
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # force the in-flight stream onto instance 1 by making it
+            # the only candidate for the first request
+            await inst2.update(state=ModelInstanceState.STARTING)
+            stream_resp = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "a b c"}],
+                    "max_tokens": 10,
+                    "stream": True,
+                },
+                headers=hdrs,
+            )
+            assert stream_resp.status == 200
+            first = await stream_resp.content.read(10)
+            assert first                      # bytes are flowing
+            # the worker's counter sees the in-flight relay
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                ws.inflight_count(inst1.id) == 0
+            ):
+                await asyncio.sleep(0.05)
+            assert ws.inflight_count(inst1.id) == 1
+            await inst2.update(state=ModelInstanceState.RUNNING)
+
+            # --- drain instance 1 (what POST .../drain does), then
+            # deliver the event to the worker as its watch would
+            r = await client.post(
+                f"/v2/model-instances/{inst1.id}/drain", headers=hdrs
+            )
+            assert r.status == 200, await r.text()
+            row = await ModelInstance.get(inst1.id)
+            assert row.state == ModelInstanceState.DRAINING
+            await sm.handle_event(
+                Event(
+                    kind="model_instance",
+                    type=EventType.UPDATED,
+                    id=inst1.id,
+                    data=row.model_dump(mode="json"),
+                )
+            )
+
+            # picker excludes DRAINING: new traffic lands on replica 2
+            before = other.attempts
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "max_tokens": 4,
+                },
+                headers=hdrs,
+            )
+            assert r.status == 200, await r.text()
+            assert other.attempts == before + 1
+
+            # the in-flight stream COMPLETES despite the drain
+            body = first + await stream_resp.content.read()
+            assert b"[DONE]" in body
+
+            # the engine exits via SIGTERM (graceful), never SIGKILL
+            deadline = time.time() + 20
+            while time.time() < deadline and engine_proc.returncode is None:
+                await asyncio.sleep(0.2)
+            assert engine_proc.returncode is not None, "engine never exited"
+            assert engine_proc.returncode != -signal.SIGKILL
+            assert sm.drains_total == 1
+            assert sm.drain_seconds_total > 0
+
+            # the worker retired the row for replica sync to replace
+            deadline = time.time() + 5
+            while time.time() < deadline and not sm.client.deletes:
+                await asyncio.sleep(0.1)
+            assert ("model-instances", inst1.id) in sm.client.deletes
+            assert inst1.id not in sm.running
+        finally:
+            await client.close()
+            await ws.stop()
+            await other.stop()
+            if engine_proc.returncode is None:
+                engine_proc.kill()
+                await engine_proc.wait()
+
+    asyncio.run(go())
+    db.close()

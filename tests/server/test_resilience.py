@@ -1,0 +1,146 @@
+"""Circuit breaker + resilience registry unit contracts.
+
+The e2e counterpart (tests/e2e/test_proxy_failover.py) drives these
+through the real proxy against fault-injected replicas; here the state
+machine itself is pinned with a fake clock.
+"""
+
+import types
+
+from gpustack_tpu.server.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    ResilienceRegistry,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _inst(iid):
+    return types.SimpleNamespace(id=iid, name=f"i{iid}")
+
+
+def test_breaker_opens_after_threshold_and_probes():
+    clock = FakeClock()
+    b = CircuitBreaker(
+        failure_threshold=3, open_seconds=10.0, clock=clock
+    )
+    assert b.state is BreakerState.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state is BreakerState.CLOSED  # under threshold
+    b.record_failure()
+    assert b.state is BreakerState.OPEN
+    assert not b.allow()
+    # jittered window: 10s * [0.8, 1.2]
+    assert 8.0 <= b.seconds_until_probe() <= 12.0
+
+    # inside the window nothing is admitted
+    clock.advance(5.0)
+    assert not b.allow()
+
+    # past the window: exactly ONE probe goes through (half-open)
+    clock.advance(8.0)
+    assert b.allow()
+    assert b.state is BreakerState.HALF_OPEN
+    assert not b.allow()   # second caller blocked while probe in flight
+
+    # probe success closes and fully resets
+    b.record_success()
+    assert b.state is BreakerState.CLOSED
+    assert b.allow()
+
+
+def test_breaker_probe_failure_reopens_with_backoff():
+    clock = FakeClock()
+    b = CircuitBreaker(
+        failure_threshold=1, open_seconds=10.0, clock=clock
+    )
+    b.record_failure()
+    first_window = b.seconds_until_probe()
+    clock.advance(first_window + 0.01)
+    assert b.allow()              # half-open probe
+    b.record_failure()            # probe failed
+    assert b.state is BreakerState.OPEN
+    # exponential: second open window is ~2x the base
+    assert b.seconds_until_probe() >= first_window
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(failure_threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state is BreakerState.CLOSED  # never 3 consecutive
+
+
+def test_order_prefers_least_outstanding():
+    reg = ResilienceRegistry()
+    a, b, c = _inst(1), _inst(2), _inst(3)
+    reg.begin(9, 1)
+    reg.begin(9, 1)
+    reg.begin(9, 2)
+    ordered = reg.order([a, b, c])
+    assert ordered[0].id == 3            # zero outstanding wins
+    assert [i.id for i in ordered[1:]] == [2, 1]
+
+
+def test_order_puts_broken_instances_last():
+    reg = ResilienceRegistry()
+    a, b = _inst(1), _inst(2)
+    reg.trip(1)
+    reg.begin(9, 2)  # healthy but loaded still beats circuit-broken
+    ordered = reg.order([a, b])
+    assert [i.id for i in ordered] == [2, 1]
+    assert not reg.admit(1)
+    assert reg.admit(2)
+
+
+def test_shed_cap_and_release():
+    reg = ResilienceRegistry(model_max_outstanding=2)
+    assert reg.try_shed(5) is None
+    reg.begin(5, 1)
+    reg.begin(5, 2)
+    retry_after = reg.try_shed(5)
+    assert retry_after is not None and retry_after > 0
+    assert reg.shed_total == 1
+    reg.end(5, 1)
+    assert reg.try_shed(5) is None       # slot freed
+    # other models unaffected
+    assert reg.try_shed(6) is None
+
+
+def test_reset_and_forget():
+    reg = ResilienceRegistry()
+    reg.trip(7)
+    assert reg.breaker_state(7) is BreakerState.OPEN
+    reg.reset(7)
+    assert reg.breaker_state(7) is BreakerState.CLOSED
+    reg.begin(5, 7)
+    reg.forget(7)
+    assert reg.outstanding(7) == 0
+
+
+def test_metrics_lines_cover_counters_and_gauges():
+    reg = ResilienceRegistry()
+    reg.trip(3)
+    reg.begin(5, 4)
+    reg.failovers_total = 2
+    text = "\n".join(reg.metrics_lines())
+    assert "gpustack_proxy_failovers_total 2" in text
+    assert "gpustack_proxy_shed_total 0" in text
+    assert 'gpustack_proxy_breaker_state{instance_id="3"} 2' in text
+    assert (
+        'gpustack_proxy_outstanding_requests{instance_id="4"} 1' in text
+    )

@@ -1,0 +1,157 @@
+"""bench.py artifact robustness (VERDICT r5 weak #1/#2): the final
+stdout line is always a compact metric JSON — the full diag goes to a
+file — and the stale-holder predicate matches idle PJRT-pinning sleep
+loops without ever matching a serving engine.
+"""
+
+import json
+
+import bench
+
+
+def _huge_diag():
+    return {
+        "verdict": "tpu unreachable " + "x" * 400,
+        "relay_ports_up": [],
+        "chip_state": {
+            "pjrt_plugin_processes": [
+                {"pid": 1000 + i, "cmd": "python -c ...", "age_s": 9e4}
+                for i in range(20)
+            ]
+        },
+        "attempts": [{"stderr_tail": "E" * 2000}] * 5,
+    }
+
+
+def test_emit_keeps_final_line_compact(tmp_path, capsys, monkeypatch):
+    diag_path = tmp_path / "diag.json"
+    monkeypatch.setenv("BENCH_DIAG_PATH", str(diag_path))
+    result = {
+        "metric": "output_tok_per_s_per_chip (SMOKE tiny)",
+        "value": 12.3,
+        "unit": "tok/s/chip",
+        "vs_baseline": None,
+        "detail": {"profile": "throughput", "tpu_diag": _huge_diag()},
+    }
+    bench._emit(result)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert parsed["metric"].startswith("output_tok_per_s_per_chip")
+    assert parsed["value"] == 12.3
+    # inline diag is the bounded summary + pointer …
+    inline = parsed["detail"]["tpu_diag"]
+    assert len(json.dumps(inline)) <= bench.DIAG_INLINE_BYTES
+    assert inline["file"] == str(diag_path)
+    # … and the file holds the full blob
+    full = json.loads(diag_path.read_text())
+    assert len(full["tpu_diag"]["attempts"]) == 5
+
+
+def test_emit_small_diag_stays_inline(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("BENCH_DIAG_PATH", str(tmp_path / "d.json"))
+    result = {
+        "metric": "m", "value": 1, "unit": "u", "vs_baseline": None,
+        "detail": {"tpu_diag": {"verdict": "tpu up"}},
+    }
+    bench._emit(result)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    assert parsed["detail"]["tpu_diag"] == {"verdict": "tpu up"}
+    assert not (tmp_path / "d.json").exists()
+
+
+def test_emit_compacts_persisted_run_diag(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("BENCH_DIAG_PATH", str(tmp_path / "d.json"))
+    result = {
+        "metric": "m", "value": 100.0, "unit": "u", "vs_baseline": 0.5,
+        "detail": {
+            "persisted_run": True,
+            "bench_time_tpu_diag": _huge_diag(),
+        },
+    }
+    bench._emit(result)
+    parsed = json.loads(capsys.readouterr().out.strip())
+    inline = parsed["detail"]["bench_time_tpu_diag"]
+    assert len(json.dumps(inline)) <= bench.DIAG_INLINE_BYTES
+
+
+def test_stale_holder_predicate(monkeypatch):
+    procs = [
+        # our own wedged bench entrypoint, old → killable
+        {"pid": 1, "cmd": "python bench.py", "age_s": 2000.0},
+        # our own entrypoint but YOUNG → a live run, spared
+        {"pid": 2, "cmd": "python hack/tpu_watch.py", "age_s": 60.0},
+        # idle sleep loop pinning the plugin (r5's survivors) → killable
+        {
+            "pid": 3,
+            "cmd": 'python -c import time\nwhile True: time.sleep(3600)',
+            "age_s": 4600.0,
+        },
+        # young idle loop → spared (grace window)
+        {
+            "pid": 4,
+            "cmd": 'python -c import time; time.sleep(60)',
+            "age_s": 30.0,
+        },
+        # live serving engine → NEVER matched
+        {
+            "pid": 5,
+            "cmd": "python -m gpustack_tpu.engine.api_server --port 40000",
+            "age_s": 90000.0,
+        },
+        # unrelated long-lived python → spared
+        {"pid": 6, "cmd": "python train.py", "age_s": 90000.0},
+        # sleep-SHAPED cmdline but real CPU burned between sleeps (an
+        # active poller) → spared by the idleness check
+        {
+            "pid": 7,
+            "cmd": 'python -c import time\nwhile 1: step(); time.sleep(5)',
+            "age_s": 7200.0,
+        },
+    ]
+    monkeypatch.setattr(bench, "_pjrt_processes", lambda **kw: procs)
+    cpu = {3: 0.4, 7: 1800.0}
+    monkeypatch.setattr(
+        bench, "_proc_cpu_seconds", lambda pid: cpu.get(pid, 0.0)
+    )
+    killable = {h["pid"] for h in bench._stale_chip_holders()}
+    assert killable == {1, 3}
+
+
+def test_kill_outcomes_are_reported(monkeypatch, capsys):
+    killed = []
+    monkeypatch.setattr(
+        bench.os, "kill", lambda pid, sig: killed.append(pid)
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_proc_state", lambda pid: None)  # gone
+    holders = [
+        {"pid": 9, "cmd": "python bench.py", "age_s": 9999.0}
+    ]
+    outcomes = bench._kill_stale_holders(holders)
+    assert killed == [9]
+    assert outcomes[0]["gone"] is True
+    assert outcomes[0]["kill_error"] is None
+    assert "stale holder pid 9" in capsys.readouterr().err
+
+
+def test_kill_outcome_zombie_counts_as_killed(monkeypatch, capsys):
+    monkeypatch.setattr(bench.os, "kill", lambda pid, sig: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    states = {9: "Z", 10: "S"}
+    monkeypatch.setattr(
+        bench, "_proc_state", lambda pid: states.get(pid)
+    )
+    outcomes = bench._kill_stale_holders(
+        [
+            {"pid": 9, "cmd": "python bench.py", "age_s": 9999.0},
+            {"pid": 10, "cmd": "python bench.py", "age_s": 9999.0},
+        ]
+    )
+    # a zombie was killed — only its wedged parent's wait() is missing
+    assert outcomes[0]["gone"] is True
+    assert outcomes[0]["proc_state"] == "Z"
+    # a still-running process is loudly NOT killed
+    assert outcomes[1]["gone"] is False
+    err = capsys.readouterr().err
+    assert "unreaped zombie" in err
+    assert "STILL ALIVE state=S" in err

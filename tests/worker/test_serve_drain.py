@@ -1,0 +1,119 @@
+"""Graceful drain at the ServeManager level: SIGTERM waits for the
+reverse proxy's in-flight count to hit zero (bounded by drain_timeout).
+"""
+
+import asyncio
+import signal
+import time
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.worker.serve_manager import RunningInstance, ServeManager
+
+
+class _FakeClient:
+    def __init__(self):
+        self.updates = []
+        self.deletes = []
+
+    async def update(self, kind, id, fields):
+        self.updates.append((kind, id, fields))
+        return fields
+
+    async def delete(self, kind, id):
+        self.deletes.append((kind, id))
+
+    async def list(self, kind, **kw):
+        return []
+
+    async def get(self, kind, id):
+        raise AssertionError("unexpected get")
+
+
+def _manager(tmp_path, **cfg_overrides):
+    cfg = Config.load({"data_dir": str(tmp_path), **cfg_overrides})
+    return ServeManager(cfg, _FakeClient(), worker_id=1)
+
+
+def test_stop_waits_for_inflight_then_sigterms(tmp_path):
+    sm = _manager(tmp_path, drain_timeout=10.0)
+    busy_until = [0.0]
+    sm.inflight_source = (
+        lambda iid: 1 if time.monotonic() < busy_until[0] else 0
+    )
+
+    async def go():
+        run = RunningInstance(5, 0)
+        run.process = await asyncio.create_subprocess_exec(
+            "sleep", "30"
+        )
+        sm.running[5] = run
+        busy_until[0] = time.monotonic() + 0.6
+        t0 = time.monotonic()
+        await sm.stop_instance(5)
+        waited = time.monotonic() - t0
+        # the SIGTERM was held until in-flight hit zero…
+        assert waited >= 0.5
+        # …but not for the whole drain_timeout
+        assert waited < 5.0
+        assert run.process.returncode == -signal.SIGTERM
+        assert sm.drains_total == 1
+        assert sm.drain_seconds_total >= 0.5
+        # the DRAINING state was reported while waiting
+        states = [f.get("state") for _, _, f in sm.client.updates]
+        assert "draining" in states
+
+    asyncio.run(go())
+
+
+def test_drain_timeout_bounds_the_wait(tmp_path):
+    sm = _manager(tmp_path, drain_timeout=0.5)
+    sm.inflight_source = lambda iid: 1   # never drains
+
+    async def go():
+        run = RunningInstance(6, 0)
+        run.process = await asyncio.create_subprocess_exec(
+            "sleep", "30"
+        )
+        sm.running[6] = run
+        t0 = time.monotonic()
+        await sm.stop_instance(6)
+        waited = time.monotonic() - t0
+        assert 0.4 <= waited < 5.0       # bounded, then terminated anyway
+        assert run.process.returncode == -signal.SIGTERM
+
+    asyncio.run(go())
+
+
+def test_no_inflight_means_immediate_stop(tmp_path):
+    sm = _manager(tmp_path, drain_timeout=30.0)
+    sm.inflight_source = lambda iid: 0
+
+    async def go():
+        run = RunningInstance(7, 0)
+        run.process = await asyncio.create_subprocess_exec(
+            "sleep", "30"
+        )
+        sm.running[7] = run
+        t0 = time.monotonic()
+        await sm.stop_instance(7)
+        assert time.monotonic() - t0 < 2.0
+        assert sm.drains_total == 0      # nothing to drain
+
+    asyncio.run(go())
+
+
+def test_stop_all_skips_drain(tmp_path):
+    sm = _manager(tmp_path, drain_timeout=30.0)
+    sm.inflight_source = lambda iid: 1   # would block forever if drained
+
+    async def go():
+        run = RunningInstance(8, 0)
+        run.process = await asyncio.create_subprocess_exec(
+            "sleep", "30"
+        )
+        sm.running[8] = run
+        t0 = time.monotonic()
+        await sm.stop_all()
+        assert time.monotonic() - t0 < 2.0   # fast shutdown path
+
+    asyncio.run(go())

@@ -149,12 +149,17 @@ def test_instance_proxy_forwards_to_local_engine(tmp_path):
             )
             assert r.status == 200
             assert (await r.json())["echo"] == 42
-            # unknown instance → 404
+            # unknown instance → 404, tagged so the server's failover
+            # can tell stale routing from an engine's own 404
             r = await client.post(
                 "/proxy/instances/9/v1/chat/completions",
                 json={}, headers=AUTH,
             )
             assert r.status == 404
+            assert (
+                r.headers.get("X-GPUStack-Worker")
+                == "instance-not-running"
+            )
         finally:
             await client.close()
             await runner.cleanup()
