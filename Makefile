@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze
 
 test:
 	python -m pytest tests/ -q
@@ -17,6 +17,13 @@ verify:
 # fast. The full suite remains the merge gate.
 test-fast:
 	python -m pytest tests/ -q -m fast
+
+# Project-native static analysis (docs/ANALYSIS.md): event-loop safety,
+# state-machine conformance, config/metric drift. Also enforced inside
+# tier-1 via tests/analysis/test_codebase_clean.py — this target is the
+# fast direct entrypoint (~1s).
+analyze:
+	python -m gpustack_tpu.analysis
 
 test-engine:
 	python -m pytest tests/ -q -m engine

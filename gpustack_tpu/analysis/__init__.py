@@ -1,0 +1,22 @@
+"""Project-native static analysis (AST lint specialized to this repo).
+
+Five checkers turn this codebase's real hazard classes — blocking calls
+inside the asyncio control plane, sync locks held across ``await``,
+undeclared ``ModelInstanceState`` transitions, config/doc drift, and
+metric-name drift — into deterministic findings. Wired into tier-1 via
+``tests/analysis/test_codebase_clean.py``; run directly with
+``python -m gpustack_tpu.analysis`` or ``make analyze``.
+
+See docs/ANALYSIS.md for rule descriptions, the suppression-comment
+syntax (``# analysis: ignore[rule-id]``), and the baseline ratchet.
+"""
+
+from gpustack_tpu.analysis.core import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    Project,
+    Rule,
+    load_baseline,
+    run_analysis,
+)
+from gpustack_tpu.analysis.rules import ALL_RULES, get_rules  # noqa: F401

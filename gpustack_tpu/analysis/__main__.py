@@ -1,0 +1,112 @@
+"""CLI: ``python -m gpustack_tpu.analysis [options]``.
+
+Exit codes: 0 = clean (baseline-frozen findings allowed), 1 = new
+findings, 2 = usage error. ``--update-baseline`` rewrites the ratchet
+file from the current findings (review the diff — the baseline must
+stay empty for blocking-in-async and state-machine).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    from gpustack_tpu.analysis import core, rules
+
+    parser = argparse.ArgumentParser(
+        prog="python -m gpustack_tpu.analysis",
+        description="Project-native static analysis (docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "--root",
+        default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repo root (default: auto-detected from this package)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        metavar="ID",
+        help="run only this rule (repeatable); default: all",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=core.DEFAULT_BASELINE,
+        help="baseline ratchet file",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="freeze current findings into the baseline file",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids"
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="summary line only",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in rules.get_rules():
+            print(f"{rule.id:20s} {rule.description}")
+        return 0
+
+    try:
+        selected = rules.get_rules(args.rule)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    result = core.run_analysis(
+        args.root, rules=selected, baseline_path=args.baseline
+    )
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        # a partial run (--rule) must not erase other rules' frozen
+        # entries — carry them over verbatim
+        ran = {r.id for r in selected}
+        preserve = {
+            key: count
+            for key, count in core.load_baseline(args.baseline).items()
+            if key.split("::", 1)[0] not in ran
+        }
+        core.save_baseline(
+            result.new + result.frozen, args.baseline, preserve=preserve
+        )
+        print(
+            f"baseline updated: {len(result.new) + len(result.frozen)} "
+            f"finding(s) frozen in {args.baseline}"
+            + (f" ({len(preserve)} entries from unrun rules kept)"
+               if preserve else "")
+        )
+        return 0
+
+    if not args.quiet:
+        for f in result.new:
+            print(f.render())
+        for f in result.frozen:
+            print(f"{f.render()}  [baselined]")
+        for key in result.stale_baseline_keys:
+            print(
+                f"note: stale baseline entry (violation fixed — run "
+                f"--update-baseline to ratchet down): {key}"
+            )
+    print(
+        f"analysis: {len(result.new)} new, {len(result.frozen)} "
+        f"baselined finding(s); {len(result.rules_run)} rule(s) over "
+        f"{result.files_scanned} files in {elapsed:.2f}s"
+    )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

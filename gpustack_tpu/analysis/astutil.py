@@ -1,0 +1,139 @@
+"""Shared AST helpers: alias-aware name resolution and async scopes.
+
+The rules reason about *lexical* async scope: the statements that run on
+the event loop inside an ``async def``, excluding nested ``def``/
+``lambda`` bodies (those are plain callables — typically handed to
+``asyncio.to_thread``/``run_in_executor`` — and do not execute on the
+loop at that point)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> canonical dotted origin, from every import in the
+    module (``import time as _time`` -> ``_time: time``; ``from time
+    import sleep`` -> ``sleep: time.sleep``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` expression -> "a.b.c"; None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(
+    call: ast.Call, aliases: Dict[str, str]
+) -> Optional[str]:
+    """Canonical dotted name of a call target, import aliases applied
+    to the leading segment."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    origin = aliases.get(head)
+    if origin:
+        name = origin + ("." + rest if rest else "")
+    return name
+
+
+def async_functions(tree: ast.AST) -> Iterator[ast.AsyncFunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node
+
+
+def scope_walk(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
+    """Every node lexically inside ``fn`` that executes on the event
+    loop: nested function/lambda bodies are skipped (they run wherever
+    they are later called — to_thread'd helpers must not be flagged),
+    but nodes keep their ``.parent`` links for context checks."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_BARRIERS):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def contains_await(node: ast.AST) -> bool:
+    """Does an ``await`` execute within this statement's own scope
+    (nested def/lambda bodies excluded)?"""
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if n is not node and isinstance(n, _SCOPE_BARRIERS):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def enclosing(
+    node: ast.AST, kinds: Tuple[type, ...]
+) -> Optional[ast.AST]:
+    """Nearest ancestor of one of ``kinds`` (needs .parent links)."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def string_constants(tree: ast.AST) -> Iterator[Tuple[int, str]]:
+    """(line, value) for every string literal, f-string fragments
+    included."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.lineno, node.value
+
+
+def open_handle_names(fn: ast.AsyncFunctionDef) -> Set[str]:
+    """Names bound to a sync file handle inside ``fn``'s loop scope:
+    ``with open(...) as f`` and ``f = open(...)`` (io.open/gzip.open
+    count too)."""
+    opens: Set[str] = set()
+    for node in scope_walk(fn):
+        if isinstance(node, ast.withitem):
+            call = node.context_expr
+            if (
+                isinstance(call, ast.Call)
+                and dotted_name(call.func)
+                in ("open", "io.open", "gzip.open")
+                and isinstance(node.optional_vars, ast.Name)
+            ):
+                opens.add(node.optional_vars.id)
+        elif isinstance(node, ast.Assign):
+            if (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func)
+                in ("open", "io.open", "gzip.open")
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        opens.add(tgt.id)
+    return opens

@@ -1,0 +1,299 @@
+"""Analysis framework: findings, suppressions, project model, baseline.
+
+Design (in the spirit of flake8-async's blocking-call rules, but
+project-native): each :class:`Rule` walks the repo through a shared
+:class:`Project` (parsed-AST cache, so five rules pay one parse) and
+yields :class:`Finding`\\ s. A finding is silenced either by an inline
+``# analysis: ignore[rule-id]`` comment at (or directly above) the
+flagged line, or by the checked-in baseline ratchet
+(``gpustack_tpu/analysis/baseline.json``): keys present in the baseline
+are *frozen* — reported but non-fatal — while anything new fails. The
+baseline stores occurrence counts per key, so adding a second instance
+of an already-baselined violation still fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+# paths never scanned: analyzer fixtures contain deliberate violations.
+# Matched per path SEGMENT (or segment-prefix for the multi-segment
+# entry), never by substring — a module merely *containing* one of
+# these words must not silently escape the gate.
+EXCLUDED_SEGMENTS = ("__pycache__", "fixtures")
+EXCLUDED_PREFIXES = ("tests/analysis/",)
+
+SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?"
+)
+
+ALL_RULES_MARKER = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+    severity: str = "error"
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: line numbers churn on unrelated edits, so
+        the key is (rule, path, message) — stable across reflows."""
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """One checker. Subclasses set ``id``/``description`` and implement
+    :meth:`check`. Rules must only report through ``Finding`` so the
+    suppression and baseline layers apply uniformly."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, project: "Project") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, path: str, line: int, message: str, severity: str = "error"
+    ) -> Finding:
+        return Finding(self.id, path, line, message, severity)
+
+
+class SourceFile:
+    """A parsed python file: text, AST (with ``.parent`` back-links),
+    and the per-line suppression table."""
+
+    def __init__(self, root: str, rel: str):
+        self.root = root
+        self.rel = rel
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError as e:
+                self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+                return None
+            for node in ast.walk(self._tree):
+                for child in ast.iter_child_nodes(node):
+                    child.parent = node  # type: ignore[attr-defined]
+        return self._tree
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """line number -> rule ids silenced there ('*' = every rule).
+        A trailing comment silences its own line; a standalone comment
+        line silences the next line (so multi-line statements can carry
+        the marker above them)."""
+        if self._suppressions is None:
+            table: Dict[int, Set[str]] = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                rules = (
+                    {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    if m.group(1)
+                    else {ALL_RULES_MARKER}
+                )
+                target = (
+                    i + 1 if line.strip().startswith("#") else i
+                )
+                table.setdefault(target, set()).update(rules)
+            self._suppressions = table
+        return self._suppressions
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        rules = self.suppressions.get(line, set())
+        return ALL_RULES_MARKER in rules or rule_id in rules
+
+
+class Project:
+    """Shared view of the repo for all rules: file discovery plus a
+    parse cache. ``root`` is the repo root (the directory holding
+    ``gpustack_tpu/``, ``docs/``, ``tests/``)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._files: Dict[str, SourceFile] = {}
+        self._listing: Dict[str, List[str]] = {}
+
+    # ---- discovery ------------------------------------------------------
+
+    def py_files(self, prefix: str = "gpustack_tpu") -> List[str]:
+        """Repo-relative paths of .py files under ``prefix``, sorted,
+        minus excluded parts (fixtures, caches). Memoized — every rule
+        asks for the same listing."""
+        if prefix in self._listing:
+            return self._listing[prefix]
+        out: List[str] = []
+        base = os.path.join(self.root, prefix)
+        if os.path.isfile(base) and prefix.endswith(".py"):
+            return [prefix]
+        for dirpath, dirnames, filenames in os.walk(base):
+            rel_dir = os.path.relpath(dirpath, self.root).replace(
+                os.sep, "/"
+            )
+            if self._excluded(rel_dir + "/"):
+                dirnames[:] = []
+                continue
+            dirnames.sort()
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                rel = f"{rel_dir}/{name}"
+                if not self._excluded(rel):
+                    out.append(rel)
+        self._listing[prefix] = out
+        return out
+
+    @staticmethod
+    def _excluded(rel: str) -> bool:
+        if rel.startswith(EXCLUDED_PREFIXES):
+            return True
+        return any(
+            seg in EXCLUDED_SEGMENTS
+            for seg in rel.rstrip("/").split("/")
+        )
+
+    # ---- access ---------------------------------------------------------
+
+    def source(self, rel: str) -> Optional[SourceFile]:
+        rel = rel.replace(os.sep, "/")
+        if rel not in self._files:
+            if not os.path.exists(os.path.join(self.root, rel)):
+                return None
+            self._files[rel] = SourceFile(self.root, rel)
+        return self._files[rel]
+
+    def read_text(self, rel: str) -> Optional[str]:
+        path = os.path.join(self.root, rel)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+
+# ---- baseline ratchet ---------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, int]:
+    """Baseline file -> {finding key: frozen occurrence count}."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        counts[entry["key"]] = int(entry.get("count", 1))
+    return counts
+
+
+def save_baseline(
+    findings: Iterable[Finding],
+    path: str,
+    preserve: Optional[Dict[str, int]] = None,
+) -> None:
+    """Write the ratchet file. ``preserve`` carries existing entries to
+    keep verbatim — used when only a subset of rules ran, so a partial
+    ``--update-baseline`` can't silently erase other rules' freezes."""
+    counter = collections.Counter(f.key for f in findings)
+    for key, count in (preserve or {}).items():
+        counter[key] = max(counter[key], count) if key in counter \
+            else count
+    payload = {
+        "comment": (
+            "Frozen pre-existing findings (ratchet): entries here are "
+            "reported but non-fatal; anything new fails. Regenerate "
+            "with `python -m gpustack_tpu.analysis --update-baseline`. "
+            "Must stay EMPTY for blocking-in-async and state-machine."
+        ),
+        "findings": [
+            {"key": k, "count": n} for k, n in sorted(counter.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    new: List[Finding]
+    frozen: List[Finding]
+    stale_baseline_keys: List[str]
+    rules_run: List[str]
+    files_scanned: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def run_analysis(
+    root: str,
+    rules: Optional[Iterable[Rule]] = None,
+    baseline: Optional[Dict[str, int]] = None,
+    baseline_path: str = DEFAULT_BASELINE,
+) -> AnalysisResult:
+    """Run ``rules`` (default: all registered) over ``root`` and split
+    findings into new vs. baseline-frozen."""
+    if rules is None:
+        from gpustack_tpu.analysis.rules import get_rules
+
+        rules = get_rules()
+    if baseline is None:
+        baseline = load_baseline(baseline_path)
+
+    project = Project(root)
+    findings: List[Finding] = []
+    rule_ids: List[str] = []
+    for rule in rules:
+        rule_ids.append(rule.id)
+        for f in rule.check(project):
+            src = project.source(f.path)
+            if src is not None and src.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    budget = dict(baseline)
+    new: List[Finding] = []
+    frozen: List[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            frozen.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0)
+    return AnalysisResult(
+        new=new,
+        frozen=frozen,
+        stale_baseline_keys=stale,
+        rules_run=rule_ids,
+        files_scanned=len(project.py_files()),
+    )

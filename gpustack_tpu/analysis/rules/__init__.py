@@ -1,0 +1,36 @@
+"""Rule registry. Rule ids are stable API: they appear in suppression
+comments and baseline keys — never rename one casually."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from gpustack_tpu.analysis.core import Rule
+from gpustack_tpu.analysis.rules.blocking import BlockingInAsyncRule
+from gpustack_tpu.analysis.rules.locks import HeldAcrossAwaitRule
+from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
+from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
+from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
+
+ALL_RULES = (
+    BlockingInAsyncRule,
+    HeldAcrossAwaitRule,
+    StateMachineRule,
+    ConfigDocDriftRule,
+    MetricsDriftRule,
+)
+
+
+def get_rules(ids: Optional[Iterable[str]] = None) -> List[Rule]:
+    rules = [cls() for cls in ALL_RULES]
+    if ids is None:
+        return rules
+    wanted = set(ids)
+    known = {r.id for r in rules}
+    unknown = wanted - known
+    if unknown:
+        raise KeyError(
+            f"unknown rule id(s): {', '.join(sorted(unknown))}; "
+            f"known: {', '.join(sorted(known))}"
+        )
+    return [r for r in rules if r.id in wanted]

@@ -1,0 +1,129 @@
+"""blocking-in-async: sync calls that stall the event loop.
+
+The control plane is one asyncio loop per process — a single
+``time.sleep`` or sync HTTP call inside an ``async def`` in the proxy
+path stalls *every* in-flight request on that process. Flagged inside
+async scope (nested def/lambda bodies excluded — those are the bodies
+handed to ``asyncio.to_thread``/``run_in_executor``):
+
+- known blockers by dotted name (``time.sleep``, ``requests.*``,
+  ``subprocess.run``/``check_*``/``Popen``, ``os.system``,
+  ``urllib.request.urlopen``, ``sqlite3.connect``, heavy ``shutil``
+  tree ops);
+- sync file I/O: ``.read()``/``.write()``/etc. on a handle bound by
+  ``open(...)`` in the same async scope, and ``json``/``yaml``
+  (de)serialization given such a handle.
+
+Fix by wrapping in ``asyncio.to_thread`` / ``run_in_executor`` or
+moving the work off the hot path; genuinely-safe cases (e.g. tiny
+procfs reads) take ``# analysis: ignore[blocking-in-async]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "urllib.request.urlopen",
+    "sqlite3.connect",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "shutil.rmtree",
+    "shutil.copytree",
+    "shutil.move",
+    "shutil.copy",
+    "shutil.copy2",
+    "shutil.copyfile",
+    # directory scans: unbounded work on big dirs / networked FS.
+    # (single-inode ops — stat/unlink/rename — are deliberately NOT
+    # listed: they are microsecond-scale and flagging them would bury
+    # the real stalls in noise)
+    "os.listdir",
+    "os.walk",
+    "os.scandir",
+    "glob.glob",
+    "glob.iglob",
+}
+
+# any attribute call on these modules blocks (sync HTTP clients)
+BLOCKING_MODULES = ("requests", "httpx_sync")
+
+FILE_METHODS = {
+    "read", "readline", "readlines", "write", "writelines", "flush"
+}
+
+# serializers that drive a passed-in handle synchronously
+HANDLE_CONSUMERS = {
+    "json.load", "json.dump", "yaml.safe_load", "yaml.safe_dump",
+    "yaml.load", "yaml.dump", "pickle.load", "pickle.dump",
+}
+
+
+class BlockingInAsyncRule(Rule):
+    id = "blocking-in-async"
+    description = (
+        "sync blocking call (sleep/HTTP/subprocess/file I/O) inside "
+        "async def without to_thread/run_in_executor"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            aliases = astutil.import_aliases(tree)
+            for fn in astutil.async_functions(tree):
+                handles = astutil.open_handle_names(fn)
+                for node in astutil.scope_walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    msg = self._classify(node, aliases, handles)
+                    if msg:
+                        yield self.finding(
+                            rel,
+                            node.lineno,
+                            f"{msg} in async def {fn.name}()",
+                        )
+
+    def _classify(self, call, aliases, handles):
+        name = astutil.resolve_call(call, aliases)
+        if name is None:
+            # open(...).read() style: receiver is itself an open() call
+            if isinstance(call.func, ast.Attribute):
+                recv = call.func.value
+                if (
+                    isinstance(recv, ast.Call)
+                    and astutil.dotted_name(recv.func) == "open"
+                    and call.func.attr in FILE_METHODS
+                ):
+                    return f"sync file .{call.func.attr}() on open(...)"
+            return None
+        if name in BLOCKING_CALLS:
+            return f"blocking call {name}()"
+        head = name.split(".", 1)[0]
+        if head in BLOCKING_MODULES and "." in name:
+            return f"sync HTTP call {name}()"
+        if name in HANDLE_CONSUMERS and any(
+            isinstance(a, ast.Name) and a.id in handles
+            for a in list(call.args) + [k.value for k in call.keywords]
+        ):
+            return f"sync file (de)serialization {name}()"
+        head_tail = name.rsplit(".", 1)
+        if (
+            len(head_tail) == 2
+            and head_tail[1] in FILE_METHODS
+            and head_tail[0] in handles
+        ):
+            return f"sync file .{head_tail[1]}() on handle " \
+                f"'{head_tail[0]}'"
+        return None

@@ -1,0 +1,186 @@
+"""config-doc-drift: Config fields <-> docs/CONFIG.md <-> env reads.
+
+Three invariants:
+
+1. every ``Config`` field in ``config.py`` is documented in
+   ``docs/CONFIG.md`` — as ``GPUSTACK_TPU_<FIELD>`` or the table's
+   ``_<FIELD>`` continuation shorthand;
+2. every ``GPUSTACK_TPU_*`` variable named in the docs is either a
+   ``Config`` field or a literal actually read somewhere in the code
+   (the "operational knobs" read directly from the environment) — a
+   doc row that matches neither is a stale name;
+3. env-prefix consistency: any environment key starting with
+   ``GPUSTACK`` read in code must carry the full ``GPUSTACK_TPU_``
+   prefix, and every directly-read ``GPUSTACK_TPU_*`` knob must be
+   documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+CONFIG_PATH = "gpustack_tpu/config.py"
+DOC_PATH = "docs/CONFIG.md"
+ENV_PREFIX = "GPUSTACK_TPU_"
+
+DOC_TOKEN = re.compile(r"GPUSTACK_TPU_([A-Z0-9_]+)")
+ENV_READ_FUNCS = {
+    "os.environ.get", "environ.get", "os.getenv", "getenv",
+    "os.environ.pop", "environ.pop",
+    "os.environ.setdefault", "environ.setdefault",
+}
+
+
+class ConfigDocDriftRule(Rule):
+    id = "config-doc-drift"
+    description = (
+        "Config fields, docs/CONFIG.md rows, and env reads must agree "
+        "(names and GPUSTACK_TPU_ prefix)"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        fields = self._config_fields(project)
+        if fields is None:
+            yield self.finding(
+                CONFIG_PATH, 1, "Config class not found or unparseable"
+            )
+            return
+        doc = project.read_text(DOC_PATH)
+        if doc is None:
+            yield self.finding(DOC_PATH, 1, f"{DOC_PATH} is missing")
+            return
+
+        env_reads = list(self._env_reads(project))
+        code_literals = self._env_literals(project)
+
+        # 1. every field documented. Whole-token match, not substring:
+        # GPUSTACK_TPU_WORKER_PORT documenting itself must not also
+        # count as documentation for GPUSTACK_TPU_PORT.
+        doc_full_tokens = {
+            ENV_PREFIX + m.group(1) for m in DOC_TOKEN.finditer(doc)
+        }
+        doc_short_tokens = set(
+            re.findall(r"`_([A-Z0-9_]+)`", doc)
+        )
+        for field, line in sorted(fields.items()):
+            token = ENV_PREFIX + field.upper()
+            if (
+                token not in doc_full_tokens
+                and field.upper() not in doc_short_tokens
+            ):
+                yield self.finding(
+                    CONFIG_PATH, line,
+                    f"Config field '{field}' is not documented in "
+                    f"{DOC_PATH} (expected {token})",
+                )
+
+        # 2. every documented variable exists
+        field_tokens = {f.upper() for f in fields}
+        for i, doc_line in enumerate(doc.splitlines(), start=1):
+            for m in DOC_TOKEN.finditer(doc_line):
+                suffix = m.group(1)
+                if suffix in field_tokens:
+                    continue
+                if ENV_PREFIX + suffix in code_literals:
+                    continue
+                yield self.finding(
+                    DOC_PATH, i,
+                    f"documented variable GPUSTACK_TPU_{suffix} is "
+                    f"neither a Config field nor read anywhere in the "
+                    f"code (stale name?)",
+                )
+
+        # 3a. prefix consistency on env reads
+        for rel, line, key in env_reads:
+            if key.startswith("GPUSTACK") and not key.startswith(
+                ENV_PREFIX
+            ):
+                yield self.finding(
+                    rel, line,
+                    f"env read of '{key}' does not use the "
+                    f"{ENV_PREFIX} prefix",
+                )
+
+        # 3b. directly-read operational knobs must be documented
+        seen: Set[str] = set()
+        for rel, line, key in env_reads:
+            if not key.startswith(ENV_PREFIX) or key in seen:
+                continue
+            seen.add(key)
+            suffix = key[len(ENV_PREFIX):]
+            if suffix.lower() in fields:
+                continue  # reachable via Config.load's generic env layer
+            if key not in doc_full_tokens:
+                yield self.finding(
+                    rel, line,
+                    f"operational env knob {key} is read here but not "
+                    f"documented in {DOC_PATH}",
+                )
+
+    # ---- extraction -----------------------------------------------------
+
+    def _config_fields(
+        self, project: Project
+    ) -> Optional[Dict[str, int]]:
+        src = project.source(CONFIG_PATH)
+        tree = src.tree if src else None
+        if tree is None:
+            return None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                return {
+                    stmt.target.id: stmt.lineno
+                    for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and not stmt.target.id.startswith("_")
+                }
+        return None
+
+    def _env_reads(
+        self, project: Project
+    ) -> Iterator[Tuple[str, int, str]]:
+        """(file, line, key) for every literal-keyed environ access."""
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                key: Optional[str] = None
+                if isinstance(node, ast.Call):
+                    name = astutil.dotted_name(node.func)
+                    if name in ENV_READ_FUNCS and node.args:
+                        arg = node.args[0]
+                        if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, str
+                        ):
+                            key = arg.value
+                elif isinstance(node, ast.Subscript):
+                    base = astutil.dotted_name(node.value)
+                    if base in ("os.environ", "environ") and isinstance(
+                        node.slice, ast.Constant
+                    ) and isinstance(node.slice.value, str):
+                        key = node.slice.value
+                if key is not None:
+                    yield rel, node.lineno, key
+
+    def _env_literals(self, project: Project) -> Set[str]:
+        """Every GPUSTACK_TPU_* string literal in the code tree (covers
+        injection sites like subprocess env dicts, not just reads)."""
+        out: Set[str] = set()
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            if src is None:
+                continue
+            out.update(
+                m.group(0) for m in re.finditer(
+                    r"GPUSTACK_TPU_[A-Z0-9_]+", src.text
+                )
+            )
+        return out

@@ -1,0 +1,80 @@
+"""held-across-await: sync primitives held through a suspension point.
+
+A ``with threading.Lock()`` (or an ORM session) held across an
+``await`` deadlocks the loop the moment a second coroutine reaches the
+same lock: the holder is suspended, the waiter blocks the whole thread,
+and the holder can never resume to release. Only *sync* ``with`` is
+flagged — ``async with asyncio.Lock()`` is the correct pattern and
+parses as a different node. Matched context managers:
+
+- calls to ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore``
+- names/attributes whose last segment looks lock-like (``lock``,
+  ``_lock``, ``mutex``, ``rlock``) or session-like (``session``,
+  ``*_session``)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+
+LOCKLIKE_NAME = re.compile(r"(^|_)(r?lock|mutex|session)$", re.I)
+
+
+class HeldAcrossAwaitRule(Rule):
+    id = "held-across-await"
+    description = (
+        "sync lock/session `with` block containing an await "
+        "(suspension while holding a thread-blocking primitive)"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            aliases = astutil.import_aliases(tree)
+            for fn in astutil.async_functions(tree):
+                for node in astutil.scope_walk(fn):
+                    if not isinstance(node, ast.With):
+                        continue
+                    held = self._lock_expr(node, aliases)
+                    if held and any(
+                        astutil.contains_await(stmt)
+                        for stmt in node.body
+                    ):
+                        yield self.finding(
+                            rel,
+                            node.lineno,
+                            f"sync '{held}' held across await in "
+                            f"async def {fn.name}()",
+                        )
+
+    def _lock_expr(self, node: ast.With, aliases) -> str:
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                name = astutil.resolve_call(expr, aliases)
+                if name in LOCK_FACTORIES:
+                    return f"{name}()"
+                expr_name = name
+            else:
+                expr_name = astutil.dotted_name(expr)
+            if expr_name and LOCKLIKE_NAME.search(
+                expr_name.rsplit(".", 1)[-1]
+            ):
+                return expr_name
+        return ""

@@ -1,0 +1,192 @@
+"""metrics-drift: metric names stay unique, well-formed, and real.
+
+The production tree is the vocabulary of record: every ``# TYPE name
+kind`` declaration and every metric-shaped string literal under
+``gpustack_tpu/`` defines what actually exists on the wire. Checks:
+
+1. declarations — no duplicate ``# TYPE`` for a name within one file,
+   no kind conflict for a name across files, every declared name
+   ``snake_case`` (one optional ``namespace:`` colon, as in
+   ``gpustack_tpu:requests_running`` or engine-native ``vllm:*``);
+2. the normalization table (``worker/metrics_map.py`` METRIC_MAP) —
+   no duplicate keys (silent last-wins in a dict literal!), every
+   value under the ``gpustack_tpu:`` namespace;
+3. references — metric-shaped names mentioned in ``docs/*.md``,
+   ``README.md`` and ``tests/**`` must exist in the production
+   vocabulary (histogram ``_bucket``/``_sum``/``_count`` suffixes
+   allowed); a rename that orphans a dashboard/doc/test name fails
+   here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+METRICS_MAP_PATH = "gpustack_tpu/worker/metrics_map.py"
+NORMALIZED_PREFIX = "gpustack_tpu:"
+
+TYPE_DECL = re.compile(
+    r"#\s*TYPE\s+([A-Za-z_:][A-Za-z0-9_:]*)\s+"
+    r"(counter|gauge|histogram|summary|untyped)"
+)
+# a well-formed name: snake_case with at most one namespace colon
+WELL_FORMED = re.compile(r"^[a-z][a-z0-9_]*(:[a-z][a-z0-9_]*)?$")
+# candidate metric tokens in docs/tests (filtered against vocabulary)
+REF_TOKEN = re.compile(
+    r"\b(?:gpustack|vllm|sglang)[a-z0-9]*[_:][A-Za-z0-9_:]+"
+)
+HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class MetricsDriftRule(Rule):
+    id = "metrics-drift"
+    description = (
+        "metric names unique/snake_case in emitters; docs and tests "
+        "reference only names the code can emit"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        decls: List[Tuple[str, str, str, int]] = []  # name,kind,file,line
+        vocab: Set[str] = set()
+        for rel in project.py_files("gpustack_tpu"):
+            if rel.startswith("gpustack_tpu/analysis/"):
+                # the analyzers' docstrings/examples must not keep dead
+                # metric names alive in the vocabulary
+                continue
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            for line, value in astutil.string_constants(tree):
+                for m in TYPE_DECL.finditer(value):
+                    decls.append((m.group(1), m.group(2), rel, line))
+                vocab.update(
+                    t.rstrip("_:") for t in REF_TOKEN.findall(value)
+                )
+
+        yield from self._declaration_checks(decls)
+        yield from self._map_checks(project)
+        yield from self._reference_checks(project, vocab)
+
+    # ---- 1. TYPE declarations ------------------------------------------
+
+    def _declaration_checks(self, decls) -> Iterator[Finding]:
+        per_file: Dict[Tuple[str, str], int] = {}
+        kinds: Dict[str, Tuple[str, str, int]] = {}
+        for name, kind, rel, line in decls:
+            if not WELL_FORMED.match(name):
+                yield self.finding(
+                    rel, line,
+                    f"metric name '{name}' is not snake_case "
+                    f"(optionally 'namespace:name')",
+                )
+            # messages deliberately omit the other site's line number:
+            # Finding.key embeds the message, and a line number there
+            # would churn baseline keys on unrelated edits
+            seen_at = per_file.get((rel, name))
+            if seen_at is not None and seen_at != line:
+                yield self.finding(
+                    rel, line,
+                    f"duplicate # TYPE declaration for '{name}' "
+                    f"in this file",
+                )
+            per_file.setdefault((rel, name), line)
+            prev = kinds.get(name)
+            if prev is not None and prev[0] != kind:
+                yield self.finding(
+                    rel, line,
+                    f"metric '{name}' declared {kind} here but "
+                    f"{prev[0]} in {prev[1]}",
+                )
+            kinds.setdefault(name, (kind, rel, line))
+
+    # ---- 2. normalization map ------------------------------------------
+
+    def _map_checks(self, project: Project) -> Iterator[Finding]:
+        src = project.source(METRICS_MAP_PATH)
+        tree = src.tree if src else None
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "METRIC_MAP"
+                for t in node.targets
+            ):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                return
+            seen: Dict[str, int] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    continue
+                if k.value in seen:
+                    yield self.finding(
+                        METRICS_MAP_PATH, k.lineno,
+                        f"duplicate METRIC_MAP key '{k.value}' (a "
+                        f"dict literal silently keeps the last)",
+                    )
+                seen.setdefault(k.value, k.lineno)
+                if not v.value.startswith(NORMALIZED_PREFIX):
+                    yield self.finding(
+                        METRICS_MAP_PATH, v.lineno,
+                        f"METRIC_MAP value '{v.value}' must live under "
+                        f"the {NORMALIZED_PREFIX} namespace",
+                    )
+                elif not WELL_FORMED.match(v.value):
+                    yield self.finding(
+                        METRICS_MAP_PATH, v.lineno,
+                        f"METRIC_MAP value '{v.value}' is not "
+                        f"snake_case",
+                    )
+            return
+
+    # ---- 3. doc/test references ----------------------------------------
+
+    def _reference_checks(
+        self, project: Project, vocab: Set[str]
+    ) -> Iterator[Finding]:
+        targets: List[str] = ["README.md"]
+        import os
+
+        docs_dir = os.path.join(project.root, "docs")
+        if os.path.isdir(docs_dir):
+            targets += [
+                f"docs/{n}" for n in sorted(os.listdir(docs_dir))
+                if n.endswith(".md")
+            ]
+        targets += project.py_files("tests")
+        for rel in targets:
+            text = project.read_text(rel)
+            if text is None:
+                continue
+            for i, line in enumerate(text.splitlines(), start=1):
+                for m in REF_TOKEN.finditer(line):
+                    token = m.group(0).rstrip("_:")
+                    if self._known(token, vocab):
+                        continue
+                    yield self.finding(
+                        rel, i,
+                        f"reference to metric-like name '{token}' that "
+                        f"no production code emits or maps",
+                    )
+
+    @staticmethod
+    def _known(token: str, vocab: Set[str]) -> bool:
+        if token in vocab:
+            return True
+        for suffix in HISTO_SUFFIXES:
+            if token.endswith(suffix) and token[: -len(suffix)] in vocab:
+                return True
+        return False

@@ -1,0 +1,362 @@
+"""state-machine: ModelInstanceState writes vs. the declared graph.
+
+``schemas/models.py`` declares the authoritative lifecycle next to the
+enum itself: ``INSTANCE_STATE_INITIAL``, ``INSTANCE_STATE_TRANSITIONS``
+(state -> allowed successors; terminal states map to an empty set) and
+``INSTANCE_STATE_WRITERS`` (module path suffix -> states that module is
+allowed to write). This rule parses those declarations (pure AST — no
+imports) and enforces:
+
+1. the declarations exist and cover the enum exactly — adding a state
+   (like PR 2's DRAINING) without declaring its transitions fails;
+2. every state is reachable from the initial state and every declared
+   successor is a real enum member;
+3. every static write site — ``inst.update(state=ModelInstanceState.X)``,
+   ``ModelInstance(... state=X)``, ``self._set_state(id, X, ...)``,
+   ``inst.state = X`` — targets a state the graph can actually produce,
+   from a module declared as a writer of that state. Read sites
+   (``filter(state=...)``, comparisons) are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+SCHEMAS_PATH = "gpustack_tpu/schemas/models.py"
+ENUM_NAME = "ModelInstanceState"
+TRANSITIONS_NAME = "INSTANCE_STATE_TRANSITIONS"
+INITIAL_NAME = "INSTANCE_STATE_INITIAL"
+WRITERS_NAME = "INSTANCE_STATE_WRITERS"
+
+# read idioms: a `state=` keyword on these call targets is a filter
+READ_FUNCS = {"filter", "find", "first", "get", "all", "model_validate"}
+WRITE_FUNCS = {"update"}
+SETTER_FUNCS = {"_set_state", "set_state"}
+
+
+def _state_attr(node: ast.AST) -> Optional[str]:
+    """``ModelInstanceState.X`` attribute -> "X" (``.value`` access and
+    plain names return None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == ENUM_NAME
+    ):
+        return node.attr
+    return None
+
+
+class StateMachineRule(Rule):
+    id = "state-machine"
+    description = (
+        "ModelInstanceState transition-graph completeness and "
+        "write-site conformance"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        src = project.source(SCHEMAS_PATH)
+        tree = src.tree if src else None
+        if tree is None:
+            yield self.finding(
+                SCHEMAS_PATH, 1, f"cannot parse {SCHEMAS_PATH}"
+            )
+            return
+        members = self._enum_members(tree)
+        if not members:
+            yield self.finding(
+                SCHEMAS_PATH, 1, f"enum {ENUM_NAME} not found"
+            )
+            return
+
+        decls, problems = self._declarations(tree, members)
+        for line, msg in problems:
+            yield self.finding(SCHEMAS_PATH, line, msg)
+        if decls is None:
+            return
+        initial, transitions, writers = decls
+
+        yield from self._graph_checks(members, initial, transitions)
+        yield from self._write_site_checks(
+            project, members, initial, transitions, writers
+        )
+
+    # ---- declaration parsing -------------------------------------------
+
+    def _enum_members(self, tree: ast.AST) -> Set[str]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == ENUM_NAME:
+                return {
+                    t.id
+                    for stmt in node.body
+                    if isinstance(stmt, ast.Assign)
+                    for t in stmt.targets
+                    if isinstance(t, ast.Name)
+                }
+        return set()
+
+    def _declarations(
+        self, tree: ast.AST, members: Set[str]
+    ) -> Tuple[
+        Optional[Tuple[str, Dict[str, Set[str]], Dict[str, Set[str]]]],
+        List[Tuple[int, str]],
+    ]:
+        initial: Optional[str] = None
+        transitions: Optional[Dict[str, Set[str]]] = None
+        writers: Optional[Dict[str, Set[str]]] = None
+        problems: List[Tuple[int, str]] = []
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if INITIAL_NAME in names:
+                initial = _state_attr(node.value)
+                if initial is None:
+                    problems.append(
+                        (node.lineno,
+                         f"{INITIAL_NAME} must be {ENUM_NAME}.<member>")
+                    )
+            elif TRANSITIONS_NAME in names:
+                transitions, errs = self._parse_state_dict(
+                    node, key_is_state=True
+                )
+                problems.extend(errs)
+            elif WRITERS_NAME in names:
+                writers, errs = self._parse_state_dict(
+                    node, key_is_state=False
+                )
+                problems.extend(errs)
+
+        missing = [
+            n
+            for n, v in (
+                (INITIAL_NAME, initial),
+                (TRANSITIONS_NAME, transitions),
+                (WRITERS_NAME, writers),
+            )
+            if v is None
+        ]
+        if missing:
+            problems.append(
+                (1, "missing declaration(s): " + ", ".join(missing))
+            )
+            return None, problems
+        return (initial, transitions, writers), problems
+
+    def _parse_state_dict(
+        self, node: ast.Assign, key_is_state: bool
+    ) -> Tuple[Optional[Dict[str, Set[str]]], List[Tuple[int, str]]]:
+        problems: List[Tuple[int, str]] = []
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            return None, [(node.lineno, "declaration must be a dict "
+                           "literal (parsed statically, not imported)")]
+        out: Dict[str, Set[str]] = {}
+        for key, val in zip(value.keys, value.values):
+            if key_is_state:
+                k = _state_attr(key)
+            else:
+                k = (
+                    key.value
+                    if isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    else None
+                )
+            if k is None:
+                problems.append(
+                    (getattr(key, "lineno", node.lineno),
+                     "unparseable key in state declaration")
+                )
+                continue
+            states: Set[str] = set()
+            elts = None
+            if isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                elts = val.elts
+            elif isinstance(val, ast.Call) and astutil.dotted_name(
+                val.func
+            ) in ("set", "frozenset"):
+                # there is no empty-set literal: `set()` / `frozenset()`
+                # (optionally around a container literal) declares one
+                if not val.args:
+                    elts = []
+                elif isinstance(
+                    val.args[0], (ast.Set, ast.Tuple, ast.List)
+                ):
+                    elts = val.args[0].elts
+            if elts is None:
+                problems.append(
+                    (getattr(val, "lineno", node.lineno),
+                     f"value for {k} must be a set/tuple/list of "
+                     f"{ENUM_NAME} members")
+                )
+                continue
+            for e in elts:
+                s = _state_attr(e)
+                if s is None:
+                    problems.append(
+                        (getattr(e, "lineno", node.lineno),
+                         f"non-{ENUM_NAME} entry in value for {k}")
+                    )
+                else:
+                    states.add(s)
+            if k in out:
+                problems.append(
+                    (getattr(key, "lineno", node.lineno),
+                     f"duplicate key {k} in state declaration")
+                )
+            out[k] = states
+        return out, problems
+
+    # ---- graph checks ---------------------------------------------------
+
+    def _graph_checks(
+        self,
+        members: Set[str],
+        initial: str,
+        transitions: Dict[str, Set[str]],
+    ) -> Iterator[Finding]:
+        if initial not in members:
+            yield self.finding(
+                SCHEMAS_PATH, 1,
+                f"initial state {initial} is not an enum member",
+            )
+        for state in sorted(members - set(transitions)):
+            yield self.finding(
+                SCHEMAS_PATH, 1,
+                f"state {state} has no entry in {TRANSITIONS_NAME} "
+                f"(declare its successors, or an empty set if terminal)",
+            )
+        for state in sorted(set(transitions) - members):
+            yield self.finding(
+                SCHEMAS_PATH, 1,
+                f"{TRANSITIONS_NAME} declares unknown state {state}",
+            )
+        for state, succs in sorted(transitions.items()):
+            for s in sorted(succs - members):
+                yield self.finding(
+                    SCHEMAS_PATH, 1,
+                    f"transition {state} -> {s} targets unknown state",
+                )
+        # reachability from the initial state
+        seen = {initial}
+        frontier = [initial]
+        while frontier:
+            cur = frontier.pop()
+            for nxt in transitions.get(cur, ()):  # pragma: no branch
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        for state in sorted(members - seen):
+            yield self.finding(
+                SCHEMAS_PATH, 1,
+                f"state {state} is unreachable from {initial} in the "
+                f"declared transition graph",
+            )
+
+    # ---- write sites ----------------------------------------------------
+
+    def _write_site_checks(
+        self,
+        project: Project,
+        members: Set[str],
+        initial: str,
+        transitions: Dict[str, Set[str]],
+        writers: Dict[str, Set[str]],
+    ) -> Iterator[Finding]:
+        producible = {initial} | {
+            s for succs in transitions.values() for s in succs
+        }
+        for rel in project.py_files("gpustack_tpu"):
+            if rel == SCHEMAS_PATH or rel.startswith(
+                "gpustack_tpu/analysis/"
+            ):
+                continue
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            allowed = self._allowed_for(rel, writers)
+            for line, state, how in self._write_sites(tree):
+                if state not in members:
+                    yield self.finding(
+                        rel, line,
+                        f"write of unknown state {state} ({how})",
+                    )
+                    continue
+                if state not in producible:
+                    yield self.finding(
+                        rel, line,
+                        f"state {state} written ({how}) but no declared "
+                        f"transition produces it — update "
+                        f"{TRANSITIONS_NAME} in {SCHEMAS_PATH}",
+                    )
+                if allowed is None:
+                    yield self.finding(
+                        rel, line,
+                        f"state write ({how} -> {state}) in a module "
+                        f"not declared in {WRITERS_NAME}",
+                    )
+                elif state not in allowed:
+                    yield self.finding(
+                        rel, line,
+                        f"module is not declared to write {state} "
+                        f"({how}) — update {WRITERS_NAME} in "
+                        f"{SCHEMAS_PATH}",
+                    )
+
+    @staticmethod
+    def _allowed_for(
+        rel: str, writers: Dict[str, Set[str]]
+    ) -> Optional[Set[str]]:
+        for suffix, states in writers.items():
+            if rel.endswith(suffix):
+                return states
+        return None
+
+    def _write_sites(
+        self, tree: ast.AST
+    ) -> Iterator[Tuple[int, str, str]]:
+        """(line, state member, idiom) for every static state write."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and tgt.attr == "state"
+                    ):
+                        s = _state_attr(node.value)
+                        if s is not None:
+                            yield node.lineno, s, ".state assignment"
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = astutil.dotted_name(node.func) or ""
+            tail = func.rsplit(".", 1)[-1]
+            if tail in SETTER_FUNCS:
+                for arg in list(node.args) + [
+                    k.value for k in node.keywords
+                ]:
+                    s = _state_attr(arg)
+                    if s is not None:
+                        yield node.lineno, s, f"{tail}() call"
+                continue
+            if tail in READ_FUNCS:
+                continue
+            is_ctor = tail == "ModelInstance"
+            if tail in WRITE_FUNCS or is_ctor:
+                for kw in node.keywords:
+                    if kw.arg == "state":
+                        s = _state_attr(kw.value)
+                        if s is not None:
+                            yield (
+                                node.lineno,
+                                s,
+                                "constructor" if is_ctor
+                                else f"{tail}(state=...)",
+                            )

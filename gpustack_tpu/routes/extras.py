@@ -27,6 +27,7 @@ from gpustack_tpu.schemas import (
     ModelInstanceState,
     Worker,
     WorkerState,
+    validate_instance_transition,
 )
 from gpustack_tpu.server.catalog import get_catalog
 
@@ -563,8 +564,12 @@ def add_extra_routes(app: web.Application) -> None:
             import os as _os
 
             path = _os.path.join(cfg.data_dir, "registration_token")
-            with open(path, "w") as f:
-                f.write(token)
+
+            def _persist() -> None:
+                with open(path, "w") as f:
+                    f.write(token)
+
+            await asyncio.to_thread(_persist)
         except OSError:
             logger.warning("could not persist rotated token")
 
@@ -603,7 +608,11 @@ def add_extra_routes(app: web.Application) -> None:
             return json_error(404, "instance not found")
         if inst.state == ModelInstanceState.DRAINING:
             return web.json_response(inst.model_dump(mode="json"))
-        if inst.state != ModelInstanceState.RUNNING:
+        # the declared lifecycle (schemas/models.py) is the authority
+        # on which states may drain — today only RUNNING -> DRAINING
+        if not validate_instance_transition(
+            inst.state, ModelInstanceState.DRAINING
+        ):
             return json_error(
                 409,
                 f"instance is {inst.state.value}; only a running "

@@ -22,6 +22,7 @@ from gpustack_tpu.schemas.models import (
     ModelInstanceState,
     PlacementStrategy,
     SubordinateWorker,
+    validate_instance_transition,
 )
 from gpustack_tpu.schemas.model_files import ModelFile, ModelFileState
 from gpustack_tpu.schemas.model_routes import ModelRoute, ModelRouteTarget
@@ -57,6 +58,7 @@ __all__ = [
     "ComputedResourceClaim",
     "SubordinateWorker",
     "PlacementStrategy",
+    "validate_instance_transition",
     "ModelFile",
     "ModelFileState",
     "ModelRoute",

@@ -41,6 +41,114 @@ class ModelInstanceState(str, enum.Enum):
     UNREACHABLE = "unreachable"
 
 
+# ---------------------------------------------------------------------------
+# Declared lifecycle. The static state-machine checker
+# (gpustack_tpu/analysis/rules/state_machine.py, wired into tier-1)
+# parses these dict literals and fails the build when a state write
+# anywhere in the tree falls outside them — adding an enum member (as
+# PR 2 did with DRAINING) without declaring its transitions and writers
+# is a test failure, not silent drift. Keep the values LITERAL: the
+# checker reads the AST, it does not import this module.
+# ---------------------------------------------------------------------------
+
+INSTANCE_STATE_INITIAL = ModelInstanceState.PENDING
+
+INSTANCE_STATE_TRANSITIONS = {
+    ModelInstanceState.PENDING: {
+        ModelInstanceState.ANALYZING,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.ANALYZING: {
+        ModelInstanceState.SCHEDULED,
+        # unschedulable backoff / stuck-reschedule return the instance
+        # to the scheduler's queue
+        ModelInstanceState.PENDING,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.SCHEDULED: {
+        ModelInstanceState.DOWNLOADING,
+        # local-path models skip the download phase
+        ModelInstanceState.STARTING,
+        # coordinator-port-busy retry re-posts SCHEDULED with a new
+        # restarts count (worker/serve_manager.py start path)
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.PENDING,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.DOWNLOADING: {
+        ModelInstanceState.STARTING,
+        # agent restarted mid-download with no local engine: re-drive
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.STARTING: {
+        ModelInstanceState.RUNNING,
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.RUNNING: {
+        ModelInstanceState.DRAINING,
+        ModelInstanceState.UNREACHABLE,
+        # engine process lost (reaped/agent restart): re-drive
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.ERROR,
+    },
+    ModelInstanceState.DRAINING: {
+        # worker partitioned mid-drain; the claim must be held
+        ModelInstanceState.UNREACHABLE,
+        ModelInstanceState.ERROR,
+        # otherwise terminal: the worker retires (deletes) the row
+    },
+    ModelInstanceState.ERROR: {
+        # restart_on_error backoff path re-schedules in place
+        ModelInstanceState.SCHEDULED,
+    },
+    ModelInstanceState.UNREACHABLE: {
+        # the worker came back (reconcile reached the server): re-drive
+        ModelInstanceState.SCHEDULED,
+    },
+}
+
+# Which modules may write which states (path suffix -> states). The
+# checker flags any `state=` write in a module missing from this map,
+# or targeting a state outside the module's declared set — a new write
+# site must be declared here, which is exactly the review hook that
+# would have caught undocumented DRAINING writers.
+INSTANCE_STATE_WRITERS = {
+    "scheduler/scheduler.py": {
+        ModelInstanceState.PENDING,
+        ModelInstanceState.ANALYZING,
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.ERROR,
+    },
+    "server/controllers.py": {
+        ModelInstanceState.PENDING,      # replica creation
+        ModelInstanceState.DRAINING,     # graceful scale-down
+        ModelInstanceState.UNREACHABLE,  # worker lost
+    },
+    "worker/serve_manager.py": {
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.DOWNLOADING,
+        ModelInstanceState.STARTING,
+        ModelInstanceState.RUNNING,
+        ModelInstanceState.DRAINING,
+        ModelInstanceState.ERROR,
+    },
+    "routes/extras.py": {
+        ModelInstanceState.DRAINING,     # operator drain endpoint
+    },
+}
+
+
+def validate_instance_transition(
+    old: "ModelInstanceState", new: "ModelInstanceState"
+) -> bool:
+    """Runtime mirror of the declared graph (the static checker parses
+    the literal above; callers that want belt-and-braces enforcement
+    use this)."""
+    return new in INSTANCE_STATE_TRANSITIONS.get(old, set())
+
+
 @register_record
 class Model(Record):
     __kind__ = "model"

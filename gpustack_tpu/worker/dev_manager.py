@@ -204,7 +204,14 @@ class DevManager:
         log_path = os.path.join(
             self.log_dir, f"{dev.name}-{dev.id}.log"
         )
-        try:
+        pidfile = self._pidfile(dev_id)
+
+        def _spawn():
+            # fork/exec + pidfile write are sync syscalls — keep them
+            # off the event loop (one slow NFS write would stall every
+            # in-flight worker request)
+            import json as _json
+
             with open(log_path, "ab") as logf:
                 proc = subprocess.Popen(
                     argv,
@@ -213,16 +220,47 @@ class DevManager:
                     stderr=subprocess.STDOUT,
                     start_new_session=True,
                 )
+            try:
+                with open(pidfile, "w") as pf:
+                    _json.dump({"pid": proc.pid, "argv": argv}, pf)
+            except OSError:
+                # a holder without a pidfile is invisible to
+                # reap_orphans and would pin its chips forever if we
+                # error out here — kill AND reap it (no wait = zombie)
+                # before reporting failure
+                proc.kill()
+                proc.wait()
+                raise
+            return proc
+
+        spawn = asyncio.get_running_loop().run_in_executor(None, _spawn)
+        try:
+            proc = await spawn
+        except asyncio.CancelledError:
+            # the executor thread runs to completion regardless; a
+            # holder spawned after our cancellation would be registered
+            # nowhere and pin its chips until the next reap_orphans —
+            # kill it the moment the spawn lands
+            def _kill_stranded(fut) -> None:
+                try:
+                    stranded = fut.result()
+                except BaseException:
+                    return
+                stranded.kill()
+                stranded.wait()
+                try:
+                    os.unlink(pidfile)
+                except OSError:
+                    pass
+
+            spawn.add_done_callback(_kill_stranded)
+            raise
         except OSError as e:
             await self._set_state(
                 dev_id, DevInstanceState.ERROR,
                 f"failed to start holder: {e}",
             )
             return
-        import json as _json
-
-        with open(self._pidfile(dev_id), "w") as pf:
-            _json.dump({"pid": proc.pid, "argv": argv}, pf)
         self.running[dev_id] = RunningDev(dev_id, proc, env)
         await self._set_state(
             dev_id, DevInstanceState.RUNNING, pid=proc.pid

@@ -170,8 +170,11 @@ class ModelFileManager:
                     state_message=str(e)[:500],
                 )
                 raise
-            with open(marker, "w") as f:
-                f.write("ok")
+            def _mark_done() -> None:
+                with open(marker, "w") as f:
+                    f.write("ok")
+
+            await asyncio.to_thread(_mark_done)
             await self._update_record(
                 record,
                 state=ModelFileState.READY,

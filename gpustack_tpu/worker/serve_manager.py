@@ -451,14 +451,17 @@ class ServeManager:
             )
             import json as _json
 
-            with open(self._pidfile(instance_id), "w") as pf:
-                # record an argv fingerprint so the reaper can verify the
-                # pid wasn't recycled to an unrelated process
-                pf.write(
-                    _json.dumps(
-                        {"pid": run.process.pid, "argv": argv[:4]}
-                    )
-                )
+            pid_payload = _json.dumps(
+                # argv fingerprint so the reaper can verify the pid
+                # wasn't recycled to an unrelated process
+                {"pid": run.process.pid, "argv": argv[:4]}
+            )
+
+            def _write_pidfile() -> None:
+                with open(self._pidfile(instance_id), "w") as pf:
+                    pf.write(pid_payload)
+
+            await asyncio.to_thread(_write_pidfile)
         except OSError as e:
             log_file.close()
             if is_leader:

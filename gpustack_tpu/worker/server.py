@@ -8,6 +8,7 @@ metrics via RuntimeMetricsAggregator, runtime_metrics_aggregator.py:48).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 from typing import Dict, Optional
@@ -324,14 +325,26 @@ class WorkerServer:
             "config": None,
         }
         if result["exists"]:
-            escaped = _glob.escape(path)
-            st = _glob.glob(os.path.join(escaped, "*.safetensors"))
-            gg = _glob.glob(os.path.join(escaped, "*.gguf"))
-            result["safetensors_files"] = len(st)
-            result["gguf_files"] = len(gg)
-            result["total_bytes"] = sum(
-                os.path.getsize(f) for f in st + gg if os.path.exists(f)
-            )
+
+            def _scan():
+                # checkpoint dirs hold hundreds of multi-GB shards and
+                # may sit on networked storage — never glob them on the
+                # event loop
+                escaped = _glob.escape(path)
+                st = _glob.glob(os.path.join(escaped, "*.safetensors"))
+                gg = _glob.glob(os.path.join(escaped, "*.gguf"))
+                total = sum(
+                    os.path.getsize(f)
+                    for f in st + gg
+                    if os.path.exists(f)
+                )
+                return len(st), len(gg), total
+
+            (
+                result["safetensors_files"],
+                result["gguf_files"],
+                result["total_bytes"],
+            ) = await asyncio.to_thread(_scan)
             cfg_path = os.path.join(path, "config.json")
             # re-resolve: a symlinked config.json inside an allowed root
             # must not read files outside the roots
@@ -341,9 +354,15 @@ class WorkerServer:
                 for root in roots
             )
             if os.path.exists(cfg_path) and cfg_allowed:
-                try:
+
+                def _load_config():
                     with open(cfg_real) as f:
-                        result["config"] = _json.load(f)
+                        return _json.load(f)
+
+                try:
+                    result["config"] = await asyncio.to_thread(
+                        _load_config
+                    )
                 except (OSError, _json.JSONDecodeError) as e:
                     result["config_error"] = str(e)
             elif os.path.exists(cfg_path):
@@ -399,20 +418,25 @@ class WorkerServer:
                 {"error": "tail must be an integer"}, status=400
             )
         # log files are named {instance_name}-{id}.log
-        match = None
-        for fname in os.listdir(sm.log_dir):
-            if fname.endswith(f"-{instance_id}.log"):
-                match = os.path.join(sm.log_dir, fname)
-                break
+        def _find_log():
+            for fname in os.listdir(sm.log_dir):
+                if fname.endswith(f"-{instance_id}.log"):
+                    return os.path.join(sm.log_dir, fname)
+            return None
+
+        match = await asyncio.to_thread(_find_log)
         if match is None:
             return web.json_response(
                 {"error": f"no logs for instance {instance_id}"}, status=404
             )
-        with open(match, "rb") as f:
-            f.seek(0, os.SEEK_END)
-            size = f.tell()
-            f.seek(max(0, size - 512 * 1024))
-            text = f.read().decode(errors="replace")
+        def _read_tail():
+            with open(match, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                end = f.tell()
+                f.seek(max(0, end - 512 * 1024))
+                return end, f.read().decode(errors="replace")
+
+        size, text = await asyncio.to_thread(_read_tail)
         lines = text.splitlines()[-tail:]
         body = "\n".join(lines) + "\n"
         if request.query.get("follow") not in ("1", "true"):
@@ -421,8 +445,6 @@ class WorkerServer:
         # follow mode (reference routes/worker/logs.py tail+follow):
         # stream the tail, then poll the file for appended bytes until
         # the client disconnects or the instance's log goes away
-        import asyncio as _asyncio
-
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/plain; charset=utf-8",
@@ -434,7 +456,7 @@ class WorkerServer:
         offset = size
         try:
             while True:
-                await _asyncio.sleep(0.5)
+                await asyncio.sleep(0.5)
                 try:
                     new_size = os.path.getsize(match)
                 except OSError:
@@ -442,11 +464,15 @@ class WorkerServer:
                 if new_size < offset:
                     offset = 0  # truncated: restart from head
                 if new_size > offset:
-                    with open(match, "rb") as f:
-                        f.seek(offset)
-                        chunk = f.read(512 * 1024)
+
+                    def _read_chunk(start=offset):
+                        with open(match, "rb") as f:
+                            f.seek(start)
+                            return f.read(512 * 1024)
+
+                    chunk = await asyncio.to_thread(_read_chunk)
                     offset += len(chunk)
                     await resp.write(chunk)
-        except (ConnectionResetError, _asyncio.CancelledError):
+        except (ConnectionResetError, asyncio.CancelledError):
             pass
         return resp

@@ -1,0 +1,590 @@
+"""Per-rule fixture tests for the static analyzers.
+
+Each rule gets a known-bad snippet (must fire), a known-good snippet
+(must stay quiet), plus framework-level coverage: suppression comments
+and the baseline ratchet. Fixture trees are materialized under
+``tmp_path`` with the same relative layout as the real repo, because
+the cross-file rules locate their inputs by those paths.
+
+(This directory is excluded from the analyzer's own scan — see
+``EXCLUDED_PREFIXES`` in gpustack_tpu/analysis/core.py — so the deliberate
+violations in these snippets never leak into the tree gate.)
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from gpustack_tpu.analysis import core
+from gpustack_tpu.analysis.rules.blocking import BlockingInAsyncRule
+from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
+from gpustack_tpu.analysis.rules.locks import HeldAcrossAwaitRule
+from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
+from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
+
+
+def make_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(content))
+
+
+def run(root, rules, baseline=None):
+    return core.run_analysis(
+        str(root), rules=rules, baseline=baseline or {}
+    )
+
+
+GOOD_SCHEMAS = """\
+    import enum
+
+    class ModelInstanceState(str, enum.Enum):
+        PENDING = "pending"
+        RUNNING = "running"
+        ERROR = "error"
+
+    INSTANCE_STATE_INITIAL = ModelInstanceState.PENDING
+    INSTANCE_STATE_TRANSITIONS = {
+        ModelInstanceState.PENDING: {
+            ModelInstanceState.RUNNING,
+            ModelInstanceState.ERROR,
+        },
+        ModelInstanceState.RUNNING: {ModelInstanceState.ERROR},
+        ModelInstanceState.ERROR: set(),
+    }
+    INSTANCE_STATE_WRITERS = {
+        "server/controllers.py": {
+            ModelInstanceState.PENDING,
+            ModelInstanceState.RUNNING,
+            ModelInstanceState.ERROR,
+        },
+    }
+"""
+
+
+# ---------------------------------------------------------------------------
+# blocking-in-async
+# ---------------------------------------------------------------------------
+
+
+class TestBlockingInAsync:
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [BlockingInAsyncRule()]).new
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            "import time\nasync def f():\n    time.sleep(1)\n",
+            "import time as _t\nasync def f():\n    _t.sleep(1)\n",
+            "from time import sleep\nasync def f():\n    sleep(1)\n",
+            "import requests\nasync def f():\n"
+            "    requests.get('http://x')\n",
+            "import subprocess\nasync def f():\n"
+            "    subprocess.run(['ls'])\n",
+            "import subprocess\nasync def f():\n"
+            "    subprocess.check_output(['ls'])\n",
+            "import shutil\nasync def f():\n    shutil.rmtree('/tmp/x')\n",
+            "import os\nasync def f(d):\n    return os.listdir(d)\n",
+            "import glob\nasync def f(d):\n    return glob.glob(d)\n",
+            "async def f(p):\n    with open(p) as fh:\n"
+            "        return fh.read()\n",
+            "async def f(p):\n    fh = open(p)\n    fh.write('x')\n",
+            "async def f(p):\n    return open(p).read()\n",
+            "import json\nasync def f(p):\n    with open(p) as fh:\n"
+            "        return json.load(fh)\n",
+        ],
+    )
+    def test_fires(self, tmp_path, snippet):
+        found = self.fire(tmp_path, snippet)
+        assert len(found) == 1, found
+        assert found[0].rule == "blocking-in-async"
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            # sleeping correctly
+            "import asyncio\nasync def f():\n    await asyncio.sleep(1)\n",
+            # sync helper defined inside an async def runs via to_thread
+            "import time, asyncio\nasync def f():\n"
+            "    def work():\n        time.sleep(1)\n"
+            "    await asyncio.to_thread(work)\n",
+            # blocking calls in plain sync functions are fine
+            "import time\ndef f():\n    time.sleep(1)\n",
+            # lambda bodies don't run on the loop at definition point
+            "import time, asyncio\nasync def f(loop):\n"
+            "    await loop.run_in_executor(None, lambda: time.sleep(1))\n",
+            # .read() on a non-file object is not flagged
+            "async def f(resp):\n    return resp.read()\n",
+        ],
+    )
+    def test_quiet(self, tmp_path, snippet):
+        assert self.fire(tmp_path, snippet) == []
+
+    def test_suppression_comment(self, tmp_path):
+        body = (
+            "import time\nasync def f():\n"
+            "    time.sleep(1)  # analysis: ignore[blocking-in-async]\n"
+        )
+        assert self.fire(tmp_path, body) == []
+
+    def test_suppression_on_line_above(self, tmp_path):
+        body = (
+            "import time\nasync def f():\n"
+            "    # analysis: ignore[blocking-in-async]\n"
+            "    time.sleep(1)\n"
+        )
+        assert self.fire(tmp_path, body) == []
+
+    def test_suppression_other_rule_does_not_silence(self, tmp_path):
+        body = (
+            "import time\nasync def f():\n"
+            "    time.sleep(1)  # analysis: ignore[metrics-drift]\n"
+        )
+        assert len(self.fire(tmp_path, body)) == 1
+
+
+# ---------------------------------------------------------------------------
+# held-across-await
+# ---------------------------------------------------------------------------
+
+
+class TestHeldAcrossAwait:
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [HeldAcrossAwaitRule()]).new
+
+    def test_fires_on_attribute_lock(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            "import asyncio\nasync def f(self):\n"
+            "    with self._lock:\n        await asyncio.sleep(0)\n",
+        )
+        assert len(found) == 1
+        assert found[0].rule == "held-across-await"
+
+    def test_fires_on_threading_factory(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            "import asyncio, threading\nasync def f():\n"
+            "    with threading.Lock():\n        await asyncio.sleep(0)\n",
+        )
+        assert len(found) == 1
+
+    def test_quiet_without_await_in_body(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            "import asyncio\nasync def f(self):\n"
+            "    with self._lock:\n        x = 1\n"
+            "    await asyncio.sleep(0)\n",
+        ) == []
+
+    def test_quiet_on_async_with(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            "import asyncio\nasync def f(self):\n"
+            "    async with self._lock:\n        await asyncio.sleep(0)\n",
+        ) == []
+
+    def test_quiet_on_non_lock_manager(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            "import asyncio\nasync def f(tmp):\n"
+            "    with tmp.directory():\n        await asyncio.sleep(0)\n",
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# state-machine
+# ---------------------------------------------------------------------------
+
+
+class TestStateMachine:
+    def fire(self, tmp_path, schemas=GOOD_SCHEMAS, writer=None):
+        files = {"gpustack_tpu/schemas/models.py": schemas}
+        if writer is not None:
+            files["gpustack_tpu/server/controllers.py"] = writer
+        make_tree(tmp_path, files)
+        return run(tmp_path, [StateMachineRule()]).new
+
+    def test_clean_graph_and_writer(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState\n"
+                "async def go(inst):\n"
+                "    await inst.update("
+                "state=ModelInstanceState.RUNNING)\n"
+            ),
+        ) == []
+
+    def test_new_enum_member_without_transitions_fails(self, tmp_path):
+        schemas = GOOD_SCHEMAS.replace(
+            '        ERROR = "error"\n',
+            '        ERROR = "error"\n        DRAINING = "draining"\n',
+        )
+        assert "DRAINING" in schemas
+        msgs = [f.message for f in self.fire(tmp_path, schemas=schemas)]
+        assert any("DRAINING has no entry" in m for m in msgs)
+
+    def test_unreachable_state_fails(self, tmp_path):
+        schemas = GOOD_SCHEMAS.replace(
+            "            ModelInstanceState.RUNNING,\n"
+            "            ModelInstanceState.ERROR,\n",
+            "            ModelInstanceState.ERROR,\n",
+        )
+        msgs = [f.message for f in self.fire(tmp_path, schemas=schemas)]
+        assert any("RUNNING is unreachable" in m for m in msgs)
+
+    def test_undeclared_writer_module_fails(self, tmp_path):
+        make_tree(
+            tmp_path,
+            {
+                "gpustack_tpu/schemas/models.py": GOOD_SCHEMAS,
+                "gpustack_tpu/routes/sneaky.py": (
+                    "from gpustack_tpu.schemas.models import"
+                    " ModelInstanceState\n"
+                    "async def go(inst):\n"
+                    "    await inst.update("
+                    "state=ModelInstanceState.ERROR)\n"
+                ),
+            },
+        )
+        found = run(tmp_path, [StateMachineRule()]).new
+        assert any(
+            "not declared in INSTANCE_STATE_WRITERS" in f.message
+            for f in found
+        )
+
+    def test_state_outside_module_allowance_fails(self, tmp_path):
+        schemas = GOOD_SCHEMAS.replace(
+            "            ModelInstanceState.PENDING,\n"
+            "            ModelInstanceState.RUNNING,\n"
+            "            ModelInstanceState.ERROR,\n",
+            "            ModelInstanceState.PENDING,\n",
+        )
+        found = self.fire(
+            tmp_path,
+            schemas=schemas,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState\n"
+                "async def go(inst):\n"
+                "    await inst.update("
+                "state=ModelInstanceState.RUNNING)\n"
+            ),
+        )
+        assert any(
+            "not declared to write RUNNING" in f.message for f in found
+        )
+
+    def test_setter_idiom_and_assignment_detected(self, tmp_path):
+        schemas = GOOD_SCHEMAS.replace(
+            '        "server/controllers.py"', '        "server/other.py"'
+        )
+        found = self.fire(
+            tmp_path,
+            schemas=schemas,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState\n"
+                "async def a(self, iid):\n"
+                "    await self._set_state("
+                "iid, ModelInstanceState.RUNNING, '')\n"
+                "def b(inst):\n"
+                "    inst.state = ModelInstanceState.ERROR\n"
+            ),
+        )
+        # both idioms land in an undeclared module -> two findings
+        assert len(found) == 2
+
+    def test_filters_and_comparisons_are_reads(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState\n"
+                "async def go(ModelInstance, inst):\n"
+                "    xs = await ModelInstance.filter("
+                "state=ModelInstanceState.RUNNING)\n"
+                "    return inst.state == ModelInstanceState.ERROR, xs\n"
+            ),
+        ) == []
+
+    def test_missing_declarations_fail(self, tmp_path):
+        schemas = (
+            "import enum\n\n"
+            "class ModelInstanceState(str, enum.Enum):\n"
+            '    PENDING = "pending"\n'
+        )
+        msgs = [f.message for f in self.fire(tmp_path, schemas=schemas)]
+        assert any("missing declaration" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# config-doc-drift
+# ---------------------------------------------------------------------------
+
+GOOD_CONFIG = """\
+    import pydantic
+
+    ENV_PREFIX = "GPUSTACK_TPU_"
+
+    class Config(pydantic.BaseModel):
+        host: str = ""
+        port: int = 1
+"""
+
+
+class TestConfigDocDrift:
+    def fire(self, tmp_path, config=GOOD_CONFIG, doc=None, extra=None):
+        files = {
+            "gpustack_tpu/config.py": config,
+            "docs/CONFIG.md": doc
+            if doc is not None
+            else "`GPUSTACK_TPU_HOST` and `GPUSTACK_TPU_PORT`.\n",
+        }
+        files.update(extra or {})
+        make_tree(tmp_path, files)
+        return run(tmp_path, [ConfigDocDriftRule()]).new
+
+    def test_clean(self, tmp_path):
+        assert self.fire(tmp_path) == []
+
+    def test_undocumented_field_fails(self, tmp_path):
+        config = GOOD_CONFIG + "        new_knob: float = 0.5\n"
+        found = self.fire(tmp_path, config=config)
+        assert any("new_knob" in f.message for f in found)
+
+    def test_stale_doc_name_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            doc="`GPUSTACK_TPU_HOST` `GPUSTACK_TPU_PORT` "
+            "`GPUSTACK_TPU_REMOVED_KNOB`\n",
+        )
+        assert any("REMOVED_KNOB" in f.message for f in found)
+
+    def test_operational_knob_in_code_passes_doc_check(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            doc="`GPUSTACK_TPU_HOST` `GPUSTACK_TPU_PORT` "
+            "`GPUSTACK_TPU_SPECIAL`\n",
+            extra={
+                "gpustack_tpu/util.py": (
+                    "import os\n"
+                    'X = os.environ.get("GPUSTACK_TPU_SPECIAL")\n'
+                )
+            },
+        )
+        assert found == []
+
+    def test_unprefixed_env_read_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            extra={
+                "gpustack_tpu/util.py": (
+                    "import os\n"
+                    'X = os.environ.get("GPUSTACK_OLD_NAME")\n'
+                )
+            },
+        )
+        assert any("GPUSTACK_OLD_NAME" in f.message for f in found)
+
+    def test_undocumented_operational_knob_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            extra={
+                "gpustack_tpu/util.py": (
+                    "import os\n"
+                    'X = os.environ["GPUSTACK_TPU_HIDDEN_KNOB"]\n'
+                )
+            },
+        )
+        assert any("HIDDEN_KNOB" in f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# metrics-drift
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsDrift:
+    def fire(self, tmp_path, files):
+        make_tree(tmp_path, files)
+        return run(tmp_path, [MetricsDriftRule()]).new
+
+    def test_clean(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'L = ["# TYPE gpustack_good_total counter",\n'
+                    '     "gpustack_good_total 1"]\n'
+                ),
+                "docs/OBS.md": "Watch `gpustack_good_total`.\n",
+                "tests/test_exp.py": (
+                    'def test_x(body):\n'
+                    '    assert "gpustack_good_total" in body\n'
+                ),
+            },
+        ) == []
+
+    def test_duplicate_and_conflicting_type_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_x_total counter"\n'
+                    'B = "# TYPE gpustack_x_total gauge"\n'
+                )
+            },
+        )
+        assert any("declared gauge here but counter" in f.message
+                   for f in found)
+
+    def test_non_snake_case_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_BadName gauge"\n'
+                )
+            },
+        )
+        assert any("not snake_case" in f.message for f in found)
+
+    def test_orphaned_doc_reference_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_real_total counter"\n'
+                ),
+                "docs/OBS.md": "Alert on `gpustack_ghost_total`.\n",
+            },
+        )
+        assert any("gpustack_ghost_total" in f.message for f in found)
+
+    def test_histogram_suffix_references_allowed(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'H = "gpustack_lat_seconds"  # histogram base\n'
+                ),
+                "tests/test_h.py": (
+                    'def test_h(b):\n'
+                    '    assert "gpustack_lat_seconds_bucket" in b\n'
+                ),
+            },
+        ) == []
+
+    def test_metric_map_checks(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/worker/metrics_map.py": (
+                    "METRIC_MAP = {\n"
+                    '    "vllm:a_total": "gpustack_tpu:a_total",\n'
+                    '    "vllm:a_total": "gpustack_tpu:b_total",\n'
+                    '    "vllm:c_total": "unprefixed_total",\n'
+                    "}\n"
+                )
+            },
+        )
+        msgs = " | ".join(f.message for f in found)
+        assert "duplicate METRIC_MAP key" in msgs
+        assert "must live under the gpustack_tpu:" in msgs
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline ratchet
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineRatchet:
+    BAD = "import time\nasync def f():\n    time.sleep(1)\n"
+
+    def test_frozen_finding_does_not_fail(self, tmp_path):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": self.BAD})
+        first = run(tmp_path, [BlockingInAsyncRule()])
+        assert len(first.new) == 1
+        baseline = {first.new[0].key: 1}
+        again = run(tmp_path, [BlockingInAsyncRule()], baseline=baseline)
+        assert again.new == [] and len(again.frozen) == 1
+        assert again.ok
+
+    def test_new_finding_still_fails(self, tmp_path):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": self.BAD})
+        baseline = {
+            run(tmp_path, [BlockingInAsyncRule()]).new[0].key: 1
+        }
+        make_tree(
+            tmp_path,
+            {
+                "gpustack_tpu/mod.py": self.BAD
+                + "import requests\nasync def g():\n"
+                "    requests.get('http://x')\n"
+            },
+        )
+        result = run(tmp_path, [BlockingInAsyncRule()], baseline=baseline)
+        assert len(result.frozen) == 1
+        assert len(result.new) == 1
+        assert "requests.get" in result.new[0].message
+
+    def test_second_occurrence_of_frozen_key_fails(self, tmp_path):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": self.BAD})
+        baseline = {
+            run(tmp_path, [BlockingInAsyncRule()]).new[0].key: 1
+        }
+        # same violation duplicated inside the same function -> same
+        # key twice; the count-budget of 1 must only absorb one
+        make_tree(
+            tmp_path,
+            {
+                "gpustack_tpu/mod.py": (
+                    "import time\nasync def f():\n"
+                    "    time.sleep(1)\n    time.sleep(1)\n"
+                )
+            },
+        )
+        result = run(tmp_path, [BlockingInAsyncRule()], baseline=baseline)
+        assert len(result.frozen) == 1 and len(result.new) == 1
+
+    def test_stale_baseline_reported(self, tmp_path):
+        make_tree(
+            tmp_path,
+            {"gpustack_tpu/mod.py": "async def f():\n    pass\n"},
+        )
+        result = run(
+            tmp_path, [BlockingInAsyncRule()], baseline={"gone::x::y": 1}
+        )
+        assert result.ok
+        assert result.stale_baseline_keys == ["gone::x::y"]
+
+    def test_partial_update_preserves_unrun_rules(self, tmp_path):
+        # --rule X --update-baseline must not erase other rules' frozen
+        # entries (save_baseline's preserve parameter)
+        path = os.path.join(str(tmp_path), "baseline.json")
+        finding = core.Finding("metrics-drift", "a.py", 1, "dup")
+        core.save_baseline(
+            [finding], path, preserve={"config-doc-drift::d.md::m": 2}
+        )
+        loaded = core.load_baseline(path)
+        assert loaded[finding.key] == 1
+        assert loaded["config-doc-drift::d.md::m"] == 2
+
+    def test_baseline_roundtrip(self, tmp_path):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": self.BAD})
+        findings = run(tmp_path, [BlockingInAsyncRule()]).new
+        path = os.path.join(str(tmp_path), "baseline.json")
+        core.save_baseline(findings, path)
+        loaded = core.load_baseline(path)
+        assert loaded == {findings[0].key: 1}
+        with open(path) as f:
+            assert json.load(f)["findings"][0]["count"] == 1
