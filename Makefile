@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze chaos
 
 test:
 	python -m pytest tests/ -q
@@ -24,6 +24,14 @@ test-fast:
 # fast direct entrypoint (~1s).
 analyze:
 	python -m gpustack_tpu.analysis
+
+# Seeded chaos against the in-process cluster (docs/RESILIENCE.md): one
+# schedule per fault class (worker kill, heartbeat blackhole, RPC
+# delay/drop, engine crash mid-STARTING, server restart); exits nonzero
+# on any invariant violation or failed convergence. Same seed ⇒ same
+# schedule, so failures are replayable.
+chaos:
+	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes all --seed 1
 
 test-engine:
 	python -m pytest tests/ -q -m engine

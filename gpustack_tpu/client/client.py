@@ -26,6 +26,20 @@ class APIError(Exception):
         self.message = message
 
 
+# Everything a control-plane HTTP call can raise. ONE definition on
+# purpose: asyncio.TimeoutError is NOT an OSError before Python 3.11,
+# and a call site hand-rolling this tuple and omitting it has its loop
+# task killed by a single hung request — a drift bug this constant
+# exists to prevent.
+NETWORK_ERRORS = (
+    APIError,
+    aiohttp.ClientError,
+    OSError,
+    TimeoutError,
+    asyncio.TimeoutError,
+)
+
+
 class ClientSet:
     def __init__(self, base_url: str, token: str = ""):
         self.base_url = base_url.rstrip("/")
@@ -154,5 +168,14 @@ class ClientSet:
             "POST", f"/v2/workers/{worker_id}/status", {"status": status}
         )
 
-    async def heartbeat(self, worker_id: int) -> None:
-        await self.request("POST", f"/v2/workers/{worker_id}/heartbeat", {})
+    async def heartbeat(
+        self, worker_id: int, timeout: float = 5.0
+    ) -> Dict[str, Any]:
+        """Short deadline: one hung heartbeat must not eat half the
+        server's staleness budget (~4.5 intervals). Returns the server's
+        response — ``{"recovered": true}`` means the server had marked
+        this worker UNREACHABLE and the agent should reconcile."""
+        return await self.request(
+            "POST", f"/v2/workers/{worker_id}/heartbeat", {},
+            timeout=timeout,
+        )

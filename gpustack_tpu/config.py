@@ -67,6 +67,24 @@ class Config(pydantic.BaseModel):
     instance_log_max_bytes: int = 64 * 2**20
     instance_log_keep: int = 3
 
+    # control-plane self-healing (server/controllers.py InstanceRescuer
+    # + server/worker_request.py deadline tiers; docs/RESILIENCE.md)
+    # grace period before UNREACHABLE single-host instances are torn
+    # down so replica sync re-places them on healthy workers. Within the
+    # window the chip claim is held (the worker may be partitioned, not
+    # dead). 0 disables the teardown; the rescuer's level-triggered
+    # park sweep (crash-lost worker edges) always runs.
+    unreachable_rescue_after: float = 300.0
+    # server→worker RPC deadline tiers: TCP-connect budget per dial,
+    # total budget + jittered retry count for short idempotent control
+    # RPCs (streaming relays keep their own long timeouts)
+    worker_connect_timeout: float = 5.0
+    worker_control_timeout: float = 15.0
+    worker_control_retries: int = 2
+    # max seconds the HTTP runner waits for in-flight connections on
+    # shutdown before force-closing (server restarts must be bounded)
+    shutdown_timeout: float = 10.0
+
     # observability
     enable_metrics: bool = True
 

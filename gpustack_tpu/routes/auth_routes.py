@@ -541,10 +541,38 @@ def add_worker_facing_routes(app: web.Application) -> None:
         if worker is None:
             return json_error(404, "worker not found")
         updates = {"heartbeat_at": auth_mod.time_iso_now()}
+        recovered = False
         if worker.state == WorkerState.UNREACHABLE:
+            # tell the agent it was marked lost: its instances may be
+            # parked UNREACHABLE server-side, and only the agent can
+            # legally re-drive them — it reconciles on this flag
+            # instead of waiting for a watch-stream RESYNC that never
+            # comes when the partition didn't break the TCP stream
             updates["state"] = WorkerState.READY
+            # the syncer's "no heartbeat for Ns" annotation must not
+            # outlive the recovery it describes
+            updates["state_message"] = ""
+            recovered = True
         await worker.update(**updates)
-        return web.json_response({"ok": True})
+        if not recovered:
+            # LEVEL-triggered, not edge-: the READY flip happens once,
+            # and if that one response is lost (client timeout after
+            # the server committed) the agent would never learn it has
+            # parked instances. Keep signaling while any of its rows
+            # sit UNREACHABLE — the agent's reconcile clears them,
+            # which clears this flag. Indexed two-column filter: cheap.
+            from gpustack_tpu.schemas import (
+                ModelInstance,
+                ModelInstanceState,
+            )
+
+            parked = await ModelInstance.filter(
+                worker_id=worker_id,
+                state=ModelInstanceState.UNREACHABLE,
+                limit=1,
+            )
+            recovered = bool(parked)
+        return web.json_response({"ok": True, "recovered": recovered})
 
     app.router.add_post("/v2/workers/{id:\\d+}/status", post_status)
     app.router.add_post("/v2/workers/{id:\\d+}/heartbeat", heartbeat)

@@ -627,6 +627,30 @@ def add_extra_routes(app: web.Application) -> None:
     app.router.add_post(
         "/v2/model-instances/{id:\\d+}/drain", instance_drain
     )
+
+    async def debug_invariants(request: web.Request):
+        """Convergence-invariant report for production triage (the same
+        checks the chaos harness runs — testing/invariants.py):
+        `violations` must be empty on a healthy control plane at any
+        instant; `eventual` entries persisting across calls point at
+        the component that stopped converging. Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.testing.invariants import (
+            DEFAULT_STUCK_BOUND,
+            control_plane_snapshot,
+        )
+
+        if err := require_admin(request):
+            return err
+        try:
+            bound = float(
+                request.query.get("stuck_bound", DEFAULT_STUCK_BOUND)
+            )
+        except ValueError:
+            return json_error(400, "stuck_bound must be a number")
+        return web.json_response(await control_plane_snapshot(bound))
+
+    app.router.add_get("/v2/debug/invariants", debug_invariants)
     app.router.add_get("/v2/config/reload", reload_config)
     app.router.add_post("/v2/config/reload", reload_config)
     app.router.add_get("/v2/model-catalog", catalog)

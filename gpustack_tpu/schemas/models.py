@@ -74,17 +74,23 @@ INSTANCE_STATE_TRANSITIONS = {
         ModelInstanceState.SCHEDULED,
         ModelInstanceState.PENDING,
         ModelInstanceState.ERROR,
+        # worker lost mid-flight: the claim is held through the rescue
+        # grace window like RUNNING (chaos finding: these used to stay
+        # parked in their transient state forever on a dead worker)
+        ModelInstanceState.UNREACHABLE,
     },
     ModelInstanceState.DOWNLOADING: {
         ModelInstanceState.STARTING,
         # agent restarted mid-download with no local engine: re-drive
         ModelInstanceState.SCHEDULED,
         ModelInstanceState.ERROR,
+        ModelInstanceState.UNREACHABLE,  # worker lost mid-download
     },
     ModelInstanceState.STARTING: {
         ModelInstanceState.RUNNING,
         ModelInstanceState.SCHEDULED,
         ModelInstanceState.ERROR,
+        ModelInstanceState.UNREACHABLE,  # worker lost mid-start
     },
     ModelInstanceState.RUNNING: {
         ModelInstanceState.DRAINING,
@@ -100,12 +106,27 @@ INSTANCE_STATE_TRANSITIONS = {
         # otherwise terminal: the worker retires (deletes) the row
     },
     ModelInstanceState.ERROR: {
-        # restart_on_error backoff path re-schedules in place
+        # restart_on_error backoff path re-schedules in place.
+        # Deliberately NOT ERROR -> UNREACHABLE: ERROR holds no chip
+        # claim (policies/allocatable.py CLAIMING_STATES), so parking
+        # it would resurrect a claim the allocator already re-issued —
+        # a double claim. An ERROR row on a dead worker is instead
+        # deleted outright by the InstanceRescuer after the grace
+        # window so replica sync re-places it.
         ModelInstanceState.SCHEDULED,
     },
     ModelInstanceState.UNREACHABLE: {
-        # the worker came back (reconcile reached the server): re-drive
+        # the worker came back (reconcile reached the server) with no
+        # local engine: re-drive from scratch
         ModelInstanceState.SCHEDULED,
+        # the worker came back AND the engine survived the partition:
+        # resume serving without a restart (worker/serve_manager.py
+        # post-recovery reconcile)
+        ModelInstanceState.RUNNING,
+        # no declared exit for a worker that never returns: the
+        # InstanceRescuer (server/controllers.py) DELETES the row after
+        # the grace window and replica sync re-places it — deletion is
+        # not a transition, so it does not appear here.
     },
 }
 
@@ -136,6 +157,17 @@ INSTANCE_STATE_WRITERS = {
     },
     "routes/extras.py": {
         ModelInstanceState.DRAINING,     # operator drain endpoint
+    },
+    # the chaos harness's stub workers stand in for serve_manager and
+    # write the same lifecycle over the HTTP API (wire strings — the
+    # static checker can't see those writes; declared for honesty and
+    # for any future in-process writes)
+    "testing/chaos.py": {
+        ModelInstanceState.SCHEDULED,
+        ModelInstanceState.DOWNLOADING,
+        ModelInstanceState.STARTING,
+        ModelInstanceState.RUNNING,
+        ModelInstanceState.ERROR,
     },
 }
 

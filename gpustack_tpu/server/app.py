@@ -26,12 +26,14 @@ from gpustack_tpu.schemas import (
     Model,
     ModelFile,
     ModelInstance,
+    ModelInstanceState,
     ModelProvider,
     ModelRoute,
     Org,
     OrgMember,
     User,
     Worker,
+    WorkerState,
 )
 from gpustack_tpu.schemas.usage import ModelUsage
 
@@ -95,9 +97,12 @@ def create_app(cfg: Config) -> web.Application:
         if follow:
             path += "&follow=1"
         try:
+            # tail reads are short idempotent control RPCs (retry tier);
+            # follow is a streaming relay and keeps the long budget
             resp = await worker_fetch(
                 app, worker, "GET", path,
                 timeout=3600 if follow else 10,
+                control=not follow,
             )
         except aiohttp.ClientError as e:
             return json_error(502, f"worker unreachable: {e}")
@@ -309,9 +314,54 @@ def create_app(cfg: Config) -> web.Application:
         create_hook=org_member_create_hook,
         visible=org_member_visible,
     )
+    async def instance_transition_hook(request, obj: ModelInstance, fields):
+        """Enforce the declared lifecycle at the API boundary. In-process
+        writers (scheduler, controllers) are trusted; HTTP writers race
+        the controllers — e.g. an agent's RUNNING report landing after
+        the server parked the row UNREACHABLE — and an illegal write
+        here used to silently corrupt the state machine (chaos-harness
+        finding: the transition-legality invariant tripped on exactly
+        this race). The agent recovers via its post-recovery reconcile,
+        which re-drives through a declared path."""
+        new_state = (fields or {}).get("state")
+        if new_state is None:
+            return None
+        try:
+            target = ModelInstanceState(new_state)
+        except ValueError:
+            return json_error(400, f"unknown instance state {new_state!r}")
+        if target == obj.state:
+            return None  # idempotent re-assert
+        from gpustack_tpu.schemas import validate_instance_transition
+
+        if not validate_instance_transition(obj.state, target):
+            return json_error(
+                409,
+                f"illegal instance state transition "
+                f"{obj.state.value} -> {target.value}",
+            )
+        if (
+            obj.state == ModelInstanceState.UNREACHABLE
+            and target == ModelInstanceState.RUNNING
+        ):
+            # un-parking is only legal once the worker itself is back:
+            # an agent's in-flight RUNNING report squeezing through a
+            # closing partition would otherwise park a RUNNING row on a
+            # dead worker forever (no worker-state edge fires again,
+            # and the rescuer scans only UNREACHABLE/ERROR rows)
+            worker = await Worker.get(obj.worker_id or 0)
+            if worker is None or worker.state != WorkerState.READY:
+                return json_error(
+                    409,
+                    "instance cannot resume running while its worker "
+                    "is not ready",
+                )
+        return None
+
     add_crud_routes(
         app, ModelInstance, "model-instances",
         worker_write=True, worker_owns=instance_worker_owns,
+        update_hook=instance_transition_hook,
     )
     add_crud_routes(app, Worker, "workers", redact=("proxy_secret",))
     add_crud_routes(app, Cluster, "clusters")

@@ -20,7 +20,16 @@ import dataclasses
 import enum
 import time
 from collections import deque
-from typing import Any, AsyncIterator, Dict, Optional, Set, Tuple
+from typing import (
+    Any,
+    AsyncIterator,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
 
 
 class EventType(str, enum.Enum):
@@ -167,6 +176,14 @@ class EventBus:
         self._subscribers: Set[Subscriber] = set()
         self.default_queue_size = default_queue_size
         self.published: Dict[Tuple[str, str], int] = {}
+        # Synchronous, LOSSLESS observation taps. Subscriber queues
+        # coalesce UPDATED events (by design — consumers re-read state
+        # anyway), which folds consecutive writes into multi-hop change
+        # pairs; anything auditing per-write properties (the chaos
+        # harness's transition-legality observer) needs every single
+        # event in publish order. Taps must be fast and non-raising;
+        # a tap exception is contained so it can never break commits.
+        self._taps: List[Callable[[Event], None]] = []
 
     def subscribe(
         self,
@@ -177,9 +194,25 @@ class EventBus:
         self._subscribers.add(sub)
         return sub
 
+    def add_tap(self, fn: Callable[[Event], None]) -> None:
+        self._taps.append(fn)
+
+    def remove_tap(self, fn: Callable[[Event], None]) -> None:
+        if fn in self._taps:
+            self._taps.remove(fn)
+
     def publish(self, event: Event) -> None:
         key = (event.kind, event.type.value)
         self.published[key] = self.published.get(key, 0) + 1
+        for fn in list(self._taps):
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — taps never break commits
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "event tap failed"
+                )
         for sub in list(self._subscribers):
             sub._offer(event)
 

@@ -459,13 +459,92 @@ class RouteTargetController(Controller):
                 await route.update(targets=new_targets)
 
 
+# States a lost worker parks in UNREACHABLE: every CLAIM-HOLDING state
+# only its agent could have progressed. SCHEDULED/DOWNLOADING/STARTING
+# used to be left in place (chaos finding: stuck forever —
+# stuck-reschedule covers only ANALYZING/SCHEDULED via the scheduler,
+# and not placed-and-claimed rows). ERROR is deliberately absent:
+# it holds no chip claim, so parking it in UNREACHABLE (a claiming
+# state) would resurrect chips the allocator may already have re-issued
+# — ERROR rows on dead workers are rescued by deletion instead
+# (InstanceRescuer).
+_PARK_UNREACHABLE_STATES = (
+    ModelInstanceState.SCHEDULED,
+    ModelInstanceState.DOWNLOADING,
+    ModelInstanceState.STARTING,
+    ModelInstanceState.RUNNING,
+)
+
+
+def _is_subordinate(inst: ModelInstance, worker_id: int) -> bool:
+    return any(
+        sub.worker_id == worker_id for sub in inst.subordinate_workers
+    )
+
+
+async def _teardown_for_reschedule(
+    inst: ModelInstance, worker_id: int, reason: str
+) -> None:
+    """Multi-host replica lost a member host: it cannot function and
+    cannot recover in place — delete it. The DELETED event stops the
+    surviving hosts' engines (freeing their chips) and the
+    ModelController's replica sync creates a fresh instance to
+    reschedule."""
+    logger.warning(
+        "instance %s %s (worker %d); tearing down for reschedule",
+        inst.name, reason, worker_id,
+    )
+    await inst.delete()
+
+
+async def _leader_worker_lost(
+    inst: ModelInstance, worker_id: int
+) -> None:
+    """One leader-owned instance on a lost worker. Shared by the
+    edge-triggered path (WorkerController, on the worker-state event)
+    and the level-triggered sweep (InstanceRescuer, every scan) — the
+    sweep exists because a server crash between the worker flip and
+    these per-instance writes would otherwise lose the edge forever."""
+    if inst.state == ModelInstanceState.DRAINING:
+        # same semantics as RUNNING below: the worker may be
+        # partitioned, not dead, with its engine still serving its
+        # last streams — deleting the row here would free the chip
+        # claim under a live engine and invite a double placement.
+        # UNREACHABLE holds the claim; the rescue grace window (or
+        # the worker's return) takes it from there.
+        await inst.update(
+            state=ModelInstanceState.UNREACHABLE,
+            state_message="worker unreachable during drain",
+        )
+        return
+    if inst.state not in _PARK_UNREACHABLE_STATES:
+        return
+    if inst.subordinate_workers:
+        # multi-host replica that lost its LEADER: followers cannot
+        # function alone
+        await _teardown_for_reschedule(inst, worker_id, "lost its leader")
+    else:
+        await inst.update(
+            state=ModelInstanceState.UNREACHABLE,
+            state_message=f"worker unreachable (was {inst.state.value})",
+        )
+
+
 class WorkerController(Controller):
     record_cls = Worker
 
     async def handle(self, event: Event) -> None:
         if event.type == EventType.DELETED:
-            for inst in await ModelInstance.filter(worker_id=event.id):
-                await inst.delete()
+            # single pass: leader-owned rows AND multi-host replicas
+            # that used this worker as a subordinate (those cannot
+            # function with a member host gone)
+            for inst in await ModelInstance.all():
+                if inst.worker_id == event.id:
+                    await inst.delete()
+                elif _is_subordinate(inst, event.id):
+                    await _teardown_for_reschedule(
+                        inst, event.id, "lost subordinate (worker deleted)"
+                    )
             return
         if event.type != EventType.UPDATED or not event.changes:
             return
@@ -474,61 +553,28 @@ class WorkerController(Controller):
             return
         _, new = state_change
         if new == WorkerState.UNREACHABLE.value:
-            for inst in await ModelInstance.filter(worker_id=event.id):
-                if inst.state == ModelInstanceState.DRAINING:
-                    # same semantics as RUNNING below: the worker may
-                    # be partitioned, not dead, with its engine still
-                    # serving its last streams — deleting the row here
-                    # would free the chip claim under a live engine
-                    # and invite a double placement. UNREACHABLE holds
-                    # the claim; worker deletion (or its return) takes
-                    # it from there.
-                    await inst.update(
-                        state=ModelInstanceState.UNREACHABLE,
-                        state_message="worker unreachable during drain",
-                    )
-                    continue
-                if inst.state != ModelInstanceState.RUNNING:
-                    continue
-                if inst.subordinate_workers:
-                    # multi-host replica that lost its LEADER: followers
-                    # cannot function alone and UNREACHABLE is not
-                    # covered by stuck-reschedule — tear down so replica
-                    # sync recreates and reschedules (freeing the
-                    # surviving hosts' chips)
-                    logger.warning(
-                        "instance %s lost its leader worker %d; tearing "
-                        "down for reschedule", inst.name, event.id,
-                    )
-                    await inst.delete()
-                else:
-                    await inst.update(
-                        state=ModelInstanceState.UNREACHABLE,
-                        state_message="worker unreachable",
-                    )
-            # A multi-host replica with this worker as a SUBORDINATE
-            # cannot function (its collectives span the dead host) and
-            # cannot recover in place — tear the instance down; the
-            # DELETED event stops the leader/sibling engines and the
-            # ModelController's replica sync creates a fresh instance to
-            # reschedule (reference role: Ray-cluster member loss fails
-            # the whole vLLM multinode replica).
+            # ONE pass over the table (was: indexed filter for
+            # leader-owned rows + a second full scan for subordinates —
+            # two queries and two walks per worker state change)
             for inst in await ModelInstance.all():
                 if inst.worker_id == event.id:
-                    continue
-                if any(
-                    sub.worker_id == event.id
-                    for sub in inst.subordinate_workers
-                ):
-                    logger.warning(
-                        "instance %s lost subordinate worker %d; tearing "
-                        "down for reschedule", inst.name, event.id,
+                    await _leader_worker_lost(inst, event.id)
+                elif _is_subordinate(inst, event.id):
+                    # A multi-host replica with this worker as a
+                    # SUBORDINATE cannot function (its collectives span
+                    # the dead host) and cannot recover in place
+                    # (reference role: Ray-cluster member loss fails
+                    # the whole vLLM multinode replica).
+                    await _teardown_for_reschedule(
+                        inst, event.id, "lost subordinate"
                     )
-                    await inst.delete()
         elif new == WorkerState.READY.value:
-            # instances recover via the worker's own state sync; nothing to
-            # do server-side (the worker re-reports actual health).
+            # instances recover via the worker's own state sync: the
+            # heartbeat that flipped the worker READY also tells the
+            # agent it recovered, and the agent reconciles (worker.py
+            # post-recovery reconcile) — nothing to do server-side.
             pass
+
 
 
 class WorkerSyncer:
@@ -575,3 +621,177 @@ class WorkerSyncer:
                     state=WorkerState.UNREACHABLE,
                     state_message=f"no heartbeat for {age:.0f}s",
                 )
+
+
+class InstanceRescuer:
+    """Tear down UNREACHABLE instances whose worker never came back.
+
+    Closes the known self-healing hole: a permanently dead worker left
+    its instances parked in UNREACHABLE forever (nothing rescued them),
+    so a model silently stayed under-replicated until an operator
+    intervened. Semantics:
+
+    - WITHIN the grace window (``unreachable_rescue_after``) the row —
+      and its chip claim — is held untouched: the worker may be
+      partitioned, not dead, with a live engine; deleting early would
+      invite a double placement onto claimed chips.
+    - PAST the window, single-host UNREACHABLE instances are deleted;
+      the ModelController's replica sync recreates them and the
+      scheduler places the replacement on a healthy worker. Multi-host
+      replicas never reach this loop — worker loss tears them down
+      immediately (WorkerController).
+    - A worker that returned (READY) is never rescued out from under:
+      its agent's post-recovery reconcile re-drives the instance, and a
+      delete here would race that into a double placement.
+    """
+
+    def __init__(self, grace: float = 300.0, interval: float = 15.0):
+        self.grace = grace
+        self.interval = interval
+        self.rescued_total = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        # ALWAYS runs: grace <= 0 disables only the teardown sweeps;
+        # the level-triggered park sweep is a correctness mechanism
+        # (crash-lost worker edges) independent of the rescue deletion
+        if self.grace <= 0:
+            logger.info(
+                "instance rescue teardown disabled (grace <= 0); "
+                "park sweep stays on"
+            )
+        self._task = asyncio.create_task(
+            self.run(), name="InstanceRescuer"
+        )
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.sync_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("instance rescue scan failed")
+            await asyncio.sleep(self.interval)
+
+    async def sync_once(self) -> None:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        # one worker prefetch per scan, shared by every sweep (this
+        # loop runs every heartbeat interval — per-instance Worker.get
+        # would be an N+1 on a hot path)
+        workers = {w.id: w for w in await Worker.all()}
+        await self._park_sweep(workers)
+        if self.grace <= 0:
+            return  # teardown disabled; parking convergence only
+        for inst in await ModelInstance.filter(
+            state=ModelInstanceState.UNREACHABLE
+        ):
+            # updated_at is the moment the row was parked UNREACHABLE
+            # (nothing else may legally write a parked row)
+            age = self._age(inst.updated_at, now)
+            if age is None or age <= self.grace:
+                continue
+            worker = workers.get(inst.worker_id or 0)
+            if worker is not None and worker.state == WorkerState.READY:
+                # worker is back; its agent re-drives the instance
+                continue
+            await self._rescue(
+                inst, ModelInstanceState.UNREACHABLE,
+                f"worker {worker.name if worker else inst.worker_id} "
+                f"unreachable for {age:.0f}s (> {self.grace:.0f}s grace)",
+            )
+        # ERROR rows hold NO chip claim, so they are never parked in
+        # UNREACHABLE (that would resurrect a claim the allocator may
+        # have re-issued) — but on a dead worker nothing will ever
+        # restart them either. Delete after the WORKER has been gone
+        # past grace so replica sync re-places them.
+        for inst in await ModelInstance.filter(
+            state=ModelInstanceState.ERROR
+        ):
+            if not inst.worker_id:
+                continue
+            worker = workers.get(inst.worker_id)
+            if worker is not None and worker.state == WorkerState.READY:
+                continue  # restart_on_error is the live-worker path
+            # grace measured from when the WORKER was marked lost (its
+            # row stops changing once heartbeats stop), not from the
+            # instance's own — possibly ancient — error time
+            age = self._age(
+                worker.updated_at if worker else inst.updated_at, now
+            )
+            if age is None or age <= self.grace:
+                continue
+            await self._rescue(
+                inst, ModelInstanceState.ERROR,
+                f"errored on worker {inst.worker_id}, gone for "
+                f"{age:.0f}s (> {self.grace:.0f}s grace)",
+            )
+
+    async def _park_sweep(self, workers) -> None:
+        """LEVEL-triggered parking: re-derive "this instance's worker is
+        lost" from current state, not just from worker-state edge
+        events. A server crash between WorkerSyncer's UNREACHABLE flip
+        and WorkerController's per-instance park writes loses the edge
+        forever — on reboot the controller replays rows as synthetic
+        CREATED events it ignores, and the dead worker never produces
+        another edge. This sweep converges those instances on the next
+        scan. Writes are idempotent with the edge path (same states),
+        so the two racing is harmless."""
+
+        def lost(worker_id) -> bool:
+            if not worker_id:
+                return False
+            w = workers.get(worker_id)
+            return w is None or w.state == WorkerState.UNREACHABLE
+
+        for inst in await ModelInstance.all():
+            if inst.worker_id and lost(inst.worker_id):
+                await _leader_worker_lost(inst, inst.worker_id)
+            elif inst.subordinate_workers:
+                gone = [
+                    sub.worker_id
+                    for sub in inst.subordinate_workers
+                    if lost(sub.worker_id)
+                ]
+                if gone:
+                    await _teardown_for_reschedule(
+                        inst, gone[0], "lost subordinate (sweep)"
+                    )
+
+    @staticmethod
+    def _age(
+        iso: str, now: datetime.datetime
+    ) -> Optional[float]:
+        try:
+            return (now - datetime.datetime.fromisoformat(iso)).total_seconds()
+        except ValueError:
+            return None
+
+    async def _rescue(
+        self,
+        inst: ModelInstance,
+        expected_state: ModelInstanceState,
+        why: str,
+    ) -> None:
+        # re-fetch BOTH rows right before acting: the agent may have
+        # recovered and re-driven the instance while this scan awaited
+        # — and the worker snapshot from the top of the scan can be
+        # stale in exactly that window. Deleting a freshly re-driven
+        # instance (or one whose worker just came back) would throw
+        # away a live engine and double-place its replica.
+        fresh = await ModelInstance.get(inst.id)
+        if fresh is None or fresh.state != expected_state:
+            return
+        worker = await Worker.get(fresh.worker_id or 0)
+        if worker is not None and worker.state == WorkerState.READY:
+            return
+        logger.warning(
+            "rescuing instance %s: %s; tearing down for re-placement",
+            inst.name, why,
+        )
+        self.rescued_total += 1
+        await fresh.delete()

@@ -28,6 +28,7 @@ from gpustack_tpu.schemas.inference_backends import BackendVersionConfig
 from gpustack_tpu.server.app import create_app
 from gpustack_tpu.server.bus import EventBus
 from gpustack_tpu.server.controllers import (
+    InstanceRescuer,
     ModelController,
     ModelProviderController,
     WorkerController,
@@ -83,7 +84,15 @@ class Server:
             await self._init_data()
 
         app = create_app(cfg)
-        self._runner = web.AppRunner(app)
+        self.app = app
+        # bounded shutdown: a restart must not hang behind long-lived
+        # watch/log-follow streams (chaos finding: the default 60 s
+        # connection drain made restart-mid-reconcile a minute-long
+        # op). On the runner, not the site — the site-level parameter
+        # is deprecated in aiohttp 3.11.
+        self._runner = web.AppRunner(
+            app, shutdown_timeout=cfg.shutdown_timeout
+        )
         await self._runner.setup()
         site = web.TCPSite(self._runner, cfg.host, cfg.port)
 
@@ -132,6 +141,10 @@ class Server:
             stale_after=cfg.heartbeat_interval * 4.5,
             interval=cfg.heartbeat_interval,
         )
+        self.rescuer = InstanceRescuer(
+            grace=cfg.unreachable_rescue_after,
+            interval=cfg.heartbeat_interval,
+        )
 
         from gpustack_tpu.server.collectors import (
             ResourceEventLogger,
@@ -169,6 +182,7 @@ class Server:
                     c.start()
                 self.scheduler.start()
                 self.syncer.start()
+                self.rescuer.start()
                 self.usage_archiver.start()
                 self.resource_events.start()
                 self.system_load.start()
@@ -225,6 +239,8 @@ class Server:
             self.scheduler.stop()
         if hasattr(self, "syncer"):
             self.syncer.stop()
+        if hasattr(self, "rescuer"):
+            self.rescuer.stop()
         if hasattr(self, "status_buffer"):
             self.status_buffer.stop()
         if hasattr(self, "usage_archiver"):

@@ -5,17 +5,51 @@ tunnel-proxied request helpers). Every server→worker request carries the
 worker's proxy secret as a bearer token — the worker's HTTP server
 rejects anything else, which closes the round-1 hole where engine ports
 answered unauthenticated inference to anyone who could reach them.
+
+Deadline tiers (chaos-harness hardening): one 600 s total timeout used
+to serve both quick control calls and long streaming relays, so a
+partitioned worker could park a status probe for ten minutes. Now:
+
+- every dial separates the CONNECT budget (``worker_connect_timeout``,
+  default 5 s — a host that won't even accept the TCP handshake should
+  fail fast) from the total budget;
+- ``control=True`` marks a short idempotent control RPC: the total
+  budget drops to ``worker_control_timeout`` and, for GET/HEAD only,
+  failures retry with jittered exponential backoff up to
+  ``worker_control_retries`` times (non-idempotent methods never
+  retry — a repeated POST could double-apply);
+- callers that relay streams (log follow, inference proxy) keep passing
+  their own long ``timeout`` and are never retried here.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json as jsonlib
-from typing import Any, Dict, Optional
+import random
+from typing import Any, Awaitable, Callable, Dict, Optional
 
 import aiohttp
 from aiohttp import web
 
 from gpustack_tpu.schemas import Worker
+
+# Defaults used when the app carries no Config (unit tests that mount a
+# bare aiohttp app around this helper).
+DEFAULT_CONNECT_TIMEOUT = 5.0
+DEFAULT_CONTROL_TIMEOUT = 15.0
+DEFAULT_CONTROL_RETRIES = 2
+DEFAULT_STREAM_TIMEOUT = 600.0
+
+# Fault-injection hook (testing/chaos.py installs one; ALWAYS None in
+# production). Called before every dial attempt with
+# (worker, method, path); it may sleep (RPC delay) or raise
+# aiohttp.ClientError (RPC drop). Retries treat an injected failure
+# exactly like a network one — which is the point: the chaos harness
+# proves the retry tier rides through transient drops.
+rpc_fault_hook: Optional[
+    Callable[[Worker, str, str], Awaitable[None]]
+] = None
 
 
 class DirectResponse:
@@ -50,7 +84,9 @@ async def worker_fetch(
     json_body: Optional[Dict[str, Any]] = None,
     raw_body: bytes = b"",
     content_type: str = "",
-    timeout: float = 600.0,
+    timeout: Optional[float] = None,
+    connect_timeout: Optional[float] = None,
+    control: bool = False,
     allow_federation: bool = True,
 ):
     """Send an authenticated request to a worker; returns a response
@@ -63,8 +99,31 @@ async def worker_fetch(
     websocket proxy performs) → direct dial of ``worker.ip:worker.port``.
     ``allow_federation=False`` is the loop guard used by the peer-side
     forward handler. Raises ``aiohttp.ClientError`` when no path works.
+
+    ``timeout=None`` resolves per tier: short (``worker_control_timeout``)
+    when ``control=True``, long (600 s) for streaming relays.
     """
-    headers = {}
+    cfg = app.get("config") if hasattr(app, "get") else None
+    if connect_timeout is None:
+        connect_timeout = getattr(
+            cfg, "worker_connect_timeout", DEFAULT_CONNECT_TIMEOUT
+        )
+    if timeout is None:
+        timeout = (
+            getattr(cfg, "worker_control_timeout", DEFAULT_CONTROL_TIMEOUT)
+            if control
+            else DEFAULT_STREAM_TIMEOUT
+        )
+    retries = 0
+    if control and method.upper() in ("GET", "HEAD"):
+        retries = max(
+            0,
+            int(getattr(
+                cfg, "worker_control_retries", DEFAULT_CONTROL_RETRIES
+            )),
+        )
+
+    headers: Dict[str, str] = {}
     if worker.proxy_secret:
         headers["Authorization"] = f"Bearer {worker.proxy_secret}"
     body = b""
@@ -76,6 +135,49 @@ async def worker_fetch(
         if content_type:
             headers["Content-Type"] = content_type
 
+    # ``timeout`` is the TOTAL budget across every attempt and backoff,
+    # not per attempt — a worker that accepts connections but hangs
+    # responses must not turn a "15 s control RPC" into 3×15 s + sleeps.
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    attempt = 0
+    while True:
+        remaining = deadline - loop.time()
+        try:
+            if rpc_fault_hook is not None:
+                await rpc_fault_hook(worker, method, path)
+            return await _dial_once(
+                app, worker, method, path, headers, body,
+                timeout=max(0.05, remaining),
+                connect_timeout=connect_timeout,
+                allow_federation=allow_federation,
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            if attempt >= retries:
+                raise
+            attempt += 1
+            # jittered: a worker briefly mid-restart shouldn't be
+            # re-hit by every pending control RPC in lockstep
+            backoff = min(1.0, 0.1 * (2 ** (attempt - 1))) * (
+                random.uniform(0.5, 1.5)
+            )
+            if loop.time() + backoff >= deadline - 0.05:
+                raise  # no budget left for another attempt
+            await asyncio.sleep(backoff)
+
+
+async def _dial_once(
+    app: web.Application,
+    worker: Worker,
+    method: str,
+    path: str,
+    headers: Dict[str, str],
+    body: bytes,
+    *,
+    timeout: float,
+    connect_timeout: float,
+    allow_federation: bool,
+):
     hub = app.get("tunnel_hub")
     session = hub.get(worker.id) if hub else None
     if session is not None:
@@ -113,6 +215,8 @@ async def worker_fetch(
         url,
         data=body or None,
         headers=headers,
-        timeout=aiohttp.ClientTimeout(total=timeout),
+        timeout=aiohttp.ClientTimeout(
+            total=timeout, connect=connect_timeout
+        ),
     )
     return DirectResponse(resp)

@@ -1,0 +1,1040 @@
+"""Seeded chaos harness: in-process control plane + protocol-true stub
+workers + deterministic fault schedules + convergence invariants.
+
+What runs where:
+
+- The REAL server (``server/server.py`` Server: app, controllers,
+  scheduler, worker syncer, instance rescuer) runs in-process on a real
+  TCP port with a real sqlite DB under a temp dir.
+- ``StubWorker`` agents register over the REAL HTTP API with worker
+  tokens and drive the REAL instance lifecycle (scheduled → starting →
+  running, crash/restart, drain-retire, post-partition re-drive) the
+  same way ``worker/serve_manager.py`` does — but their "engines" are
+  in-memory markers, so a full cluster boots in well under a second and
+  faults are a flag flip, not a SIGKILL race.
+- Faults come from a SEEDED schedule: ``generate_schedule(seed)`` is a
+  pure function of the seed, so re-running a seed reproduces the exact
+  op sequence (the acceptance property). Supported fault kinds:
+    * ``worker_kill``        — agent dies and never returns
+    * ``worker_suspend``     — agent pauses (heartbeats + event
+                               processing) and resumes later
+    * ``heartbeat_blackhole``— liveness channel drops; data path lives
+    * ``rpc_delay``/``rpc_drop`` — server→worker control RPCs slowed /
+                               failed via the ``worker_request``
+                               fault hook (retry tier exercised by a
+                               live probe through the real app)
+    * ``engine_crash``       — a running engine dies AND the restart
+                               crashes mid-STARTING (one-shot)
+    * ``server_restart``     — the whole control plane stops and boots
+                               again on the same DB, mid-reconcile
+- Invariants (testing/invariants.py) are checked continuously mid-run
+  (always-scope) by a monitor task plus a transition-legality observer
+  on the instance watch stream, and in full (eventual-scope) by
+  ``wait_converged``.
+
+CLI (used by ``make chaos``)::
+
+    python -m gpustack_tpu.testing.chaos --classes all --seed 1
+
+runs one seeded schedule per fault class and exits non-zero on any
+invariant violation or failed convergence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import random
+import socket
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import aiohttp
+
+from gpustack_tpu.client.client import (
+    APIError,
+    NETWORK_ERRORS,
+    ClientSet,
+)
+from gpustack_tpu.config import Config
+from gpustack_tpu.server import worker_request
+from gpustack_tpu.server.bus import EventType
+from gpustack_tpu.testing import invariants as inv
+
+logger = logging.getLogger(__name__)
+
+CLIENT_ERRORS = NETWORK_ERRORS
+
+FAULT_KINDS = (
+    "worker_kill",
+    "worker_suspend",
+    "heartbeat_blackhole",
+    "rpc_delay",
+    "rpc_drop",
+    "engine_crash",
+    "server_restart",
+)
+
+# the acceptance matrix: one seeded schedule per named fault class
+FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
+    "worker-kill": ("worker_kill",),
+    "heartbeat-blackhole": ("heartbeat_blackhole",),
+    "rpc": ("rpc_delay", "rpc_drop"),
+    "engine-crash": ("engine_crash",),
+    "server-restart": ("server_restart",),
+    "mixed": FAULT_KINDS,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosOp:
+    at: float      # seconds from schedule start
+    kind: str      # one of FAULT_KINDS
+    target: int    # worker ordinal (ignored by server_restart)
+    arg: float     # kind-specific magnitude (delay seconds / jitter)
+
+
+def generate_schedule(
+    seed: int,
+    *,
+    kinds: Sequence[str] = FAULT_KINDS,
+    ops: int = 3,
+    workers: int = 2,
+    gap: Tuple[float, float] = (0.2, 0.8),
+) -> List[ChaosOp]:
+    """Pure function of (seed, shape): the same seed ALWAYS yields the
+    same schedule — determinism is the contract chaos repros rest on."""
+    rng = random.Random(f"gpustack-tpu-chaos-{seed}")
+    out: List[ChaosOp] = []
+    t = 0.0
+    for _ in range(ops):
+        t += rng.uniform(*gap)
+        out.append(ChaosOp(
+            at=round(t, 3),
+            kind=kinds[rng.randrange(len(kinds))],
+            target=rng.randrange(max(1, workers)),
+            arg=round(rng.uniform(0.05, 0.35), 3),
+        ))
+    return out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FaultInjector:
+    """Installed as ``worker_request.rpc_fault_hook`` for the run."""
+
+    def __init__(self) -> None:
+        self.delay = 0.0
+        self.dropping = False
+        self.delayed = 0
+        self.dropped = 0
+
+    async def __call__(self, worker, method: str, path: str) -> None:
+        if self.delay > 0:
+            self.delayed += 1
+            await asyncio.sleep(self.delay)
+        if self.dropping:
+            self.dropped += 1
+            raise aiohttp.ClientError(
+                f"chaos: dropped {method} {path} to worker {worker.id}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Stub worker agent
+# ---------------------------------------------------------------------------
+
+
+class StubWorker:
+    """Protocol-true worker agent with in-memory engines.
+
+    Drives instances through the SAME declared lifecycle writes as
+    worker/serve_manager.py (states go over the wire as strings; the
+    declared writer set lives in schemas/models.py next to
+    serve_manager's).
+    """
+
+    def __init__(
+        self,
+        server_url: str,
+        registration_token: str,
+        name: str,
+        *,
+        chips: int = 8,
+        heartbeat_interval: float = 0.25,
+        start_delay: float = 0.08,
+    ):
+        self.server_url = server_url
+        self.registration_token = registration_token
+        self.name = name
+        self.chips = chips
+        self.heartbeat_interval = heartbeat_interval
+        self.start_delay = start_delay
+
+        self.worker_id = 0
+        self.proxy_secret = ""
+        self.client: Optional[ClientSet] = None
+        self.port = 0
+
+        self.alive = False
+        self.hb_blackholed = False
+        self.crash_next_start = False
+        self.engines: set = set()       # instance ids with a "live" engine
+        self._starting: set = set()
+        self._paused = asyncio.Event()  # cleared == suspended
+        self._paused.set()
+        # same serialization serve_manager has: reconcile's trailing
+        # engine-discard sweep must not interleave with another
+        # reconcile (watch RESYNC vs periodic vs recovery task)
+        self._reconcile_lock = asyncio.Lock()
+        self._tasks: List[asyncio.Task] = []
+        self._runner: Optional[aiohttp.web.AppRunner] = None
+
+    # ---- lifecycle ---------------------------------------------------
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+
+        async def healthz(request: web.Request):
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.proxy_secret}":
+                return web.json_response({"error": "forbidden"}, status=403)
+            return web.json_response(
+                {"ok": True, "engines": len(self.engines)}
+            )
+
+        app.router.add_get("/healthz", healthz)
+        self._runner = web.AppRunner(app, shutdown_timeout=0.2)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        for sock in site._server.sockets:  # noqa: SLF001 (no public API)
+            self.port = sock.getsockname()[1]
+            break
+
+        anon = ClientSet(self.server_url)
+        try:
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while True:
+                try:
+                    result = await anon.register_worker({
+                        "registration_token": self.registration_token,
+                        "name": self.name,
+                        "worker_uuid": f"stub-{self.name}",
+                        "ip": "127.0.0.1",
+                        "port": self.port,
+                    })
+                    break
+                except CLIENT_ERRORS:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+        finally:
+            await anon.close()
+        self.worker_id = result["worker_id"]
+        self.proxy_secret = result.get("proxy_secret", "")
+        self.client = ClientSet(self.server_url, result["token"])
+        self.alive = True
+        await self._post_status()
+        self._tasks = [
+            asyncio.create_task(
+                self._heartbeat_loop(), name=f"{self.name}-hb"
+            ),
+            asyncio.create_task(
+                self._watch_loop(), name=f"{self.name}-watch"
+            ),
+            asyncio.create_task(
+                self._reconcile_loop(), name=f"{self.name}-reconcile"
+            ),
+        ]
+
+    async def kill(self) -> None:
+        """The host dies: no deregistration, no goodbye."""
+        self.alive = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self.client is not None:
+            await self.client.close()
+
+    def suspend(self) -> None:
+        self._paused.clear()
+
+    def resume(self) -> None:
+        self._paused.set()
+
+    def crash_engine(self) -> None:
+        """Kill one engine (if any) and arm a one-shot mid-STARTING
+        crash for the next start attempt."""
+        self.crash_next_start = True
+        if self.engines:
+            self.engines.discard(min(self.engines))
+
+    # ---- agent loops -------------------------------------------------
+
+    def _status(self) -> dict:
+        return {
+            "cpu_count": 8,
+            "memory_total_bytes": 16 * 2**30,
+            "chips": [
+                {"index": i, "chip_type": "v5e", "hbm_bytes": 16 * 2**30}
+                for i in range(self.chips)
+            ],
+            "slice": {
+                "topology": f"2x{max(1, self.chips // 2)}",
+                "chips_per_host": self.chips,
+                "num_hosts": 1,
+                "host_index": 0,
+            },
+        }
+
+    async def _post_status(self) -> None:
+        try:
+            await self.client.post_status(self.worker_id, self._status())
+        except CLIENT_ERRORS as e:
+            logger.debug("%s status post failed: %s", self.name, e)
+
+    async def _heartbeat_loop(self) -> None:
+        recovery_task: Optional[asyncio.Task] = None
+        while self.alive:
+            if self._paused.is_set() and not self.hb_blackholed:
+                try:
+                    resp = await self.client.heartbeat(
+                        self.worker_id, timeout=2.0
+                    )
+                    if resp.get("recovered") and (
+                        recovery_task is None or recovery_task.done()
+                    ):
+                        # mirror worker/worker.py: re-drive parked
+                        # instances, but never stall the liveness
+                        # signal behind the reconcile (fire-and-forget,
+                        # deduped; the level-triggered flag re-arms)
+                        recovery_task = asyncio.create_task(
+                            self._post_recovery(),
+                            name=f"{self.name}-recovery",
+                        )
+                except CLIENT_ERRORS as e:
+                    logger.debug("%s heartbeat failed: %s", self.name, e)
+            await asyncio.sleep(self.heartbeat_interval)
+
+    async def _post_recovery(self) -> None:
+        await self._post_status()
+        try:
+            await self.reconcile()
+        except CLIENT_ERRORS as e:
+            logger.debug("%s recovery reconcile failed: %s", self.name, e)
+
+    async def _watch_loop(self) -> None:
+        async for event in self.client.watch(
+            "model-instances", retry_delay=0.25
+        ):
+            if not self.alive:
+                return
+            await self._paused.wait()
+            try:
+                await self._handle_event(event)
+            except CLIENT_ERRORS as e:
+                logger.debug("%s event handling failed: %s", self.name, e)
+
+    async def _reconcile_loop(self) -> None:
+        # the periodic safety net a real agent gets from RESYNC +
+        # monitor loops, compressed for test time
+        while self.alive:
+            await asyncio.sleep(max(0.5, self.heartbeat_interval * 3))
+            await self._paused.wait()
+            try:
+                await self.reconcile()
+            except CLIENT_ERRORS as e:
+                logger.debug("%s reconcile failed: %s", self.name, e)
+
+    async def _handle_event(self, event) -> None:
+        if event.type == EventType.RESYNC:
+            await self.reconcile()
+            return
+        if event.type == EventType.HEARTBEAT:
+            return
+        if event.type == EventType.DELETED:
+            self.engines.discard(event.id)
+            return
+        data = event.data or {}
+        if data.get("worker_id") != self.worker_id:
+            # moved away from us (reschedule): drop the engine
+            self.engines.discard(event.id)
+            return
+        state = data.get("state")
+        if state == "scheduled":
+            self._spawn(event.id)
+        elif state == "draining":
+            await self._retire(event.id)
+
+    # ---- instance lifecycle (serve_manager's writes, stubbed) --------
+
+    def _spawn(self, iid: int) -> None:
+        if iid in self._starting or iid in self.engines:
+            return
+        self._starting.add(iid)
+
+        async def go():
+            try:
+                await self._start(iid)
+            finally:
+                self._starting.discard(iid)
+
+        asyncio.create_task(go(), name=f"{self.name}-start-{iid}")
+
+    async def _start(self, iid: int) -> None:
+        try:
+            raw = await self.client.get("model-instances", iid)
+        except CLIENT_ERRORS:
+            return
+        if raw.get("worker_id") != self.worker_id:
+            return
+        if raw.get("state") != "scheduled":
+            return
+        await self._set_state(
+            iid, "starting", "stub engine starting",
+            port=40000 + (iid % 1000),
+        )
+        await asyncio.sleep(self.start_delay)
+        if not self.alive:
+            return
+        if self.crash_next_start:
+            # the named fault: engine dies MID-STARTING, then the
+            # restart_on_error path re-drives (serve_manager._crash)
+            self.crash_next_start = False
+            await self._set_state(
+                iid, "error", "chaos: engine crashed mid-starting"
+            )
+            await asyncio.sleep(self.start_delay)
+            await self._set_state(
+                iid, "scheduled", "restart after engine crash",
+                restarts=int(raw.get("restarts", 0)) + 1,
+            )
+            return  # our own watch/reconcile re-drives from SCHEDULED
+        self.engines.add(iid)
+        await self._set_state(iid, "running", "")
+
+    async def _retire(self, iid: int) -> None:
+        self.engines.discard(iid)
+        try:
+            await self.client.delete("model-instances", iid)
+        except CLIENT_ERRORS:
+            pass
+
+    async def _set_state(
+        self, iid: int, state: str, message: str, **extra
+    ) -> None:
+        fields = {"state": state, "state_message": message, **extra}
+        try:
+            await self.client.update("model-instances", iid, fields)
+        except APIError as e:
+            # 404: the row was rescued/deleted under us → drop the
+            # engine; 409: we lost a race with the controllers (e.g.
+            # RUNNING landing after UNREACHABLE) — the transition guard
+            # rejected it and reconcile re-drives legally
+            if e.status == 404:
+                self.engines.discard(iid)
+            logger.debug(
+                "%s: state write %s -> instance %d rejected: %s",
+                self.name, state, iid, e,
+            )
+        except CLIENT_ERRORS as e:
+            logger.debug(
+                "%s: state write %s -> instance %d failed: %s",
+                self.name, state, iid, e,
+            )
+
+    async def reconcile(self) -> None:
+        """Converge local stub engines with the server's view — the
+        same decision table (and the same serialization) as
+        serve_manager.reconcile."""
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self) -> None:
+        try:
+            items = await self.client.list("model-instances")
+        except CLIENT_ERRORS:
+            return
+        mine = set()
+        for item in items:
+            if item.get("worker_id") != self.worker_id:
+                continue
+            iid, st = item["id"], item["state"]
+            mine.add(iid)
+            if st == "scheduled":
+                self._spawn(iid)
+            elif st in ("starting", "downloading") and (
+                iid not in self._starting
+            ):
+                # DB says mid-start but no local attempt: re-drive
+                await self._set_state(
+                    iid, "scheduled", "stub agent lost the start"
+                )
+                self._spawn(iid)
+            elif st == "running" and iid not in self.engines:
+                await self._set_state(
+                    iid, "scheduled", "engine process lost; restarting"
+                )
+                self._spawn(iid)
+            elif st == "unreachable":
+                if iid in self.engines:
+                    # engine survived the partition: resume in place
+                    await self._set_state(
+                        iid, "running", "engine survived worker partition"
+                    )
+                elif iid not in self._starting:
+                    await self._set_state(
+                        iid, "scheduled", "worker back; re-driving"
+                    )
+                    self._spawn(iid)
+            elif st == "draining":
+                await self._retire(iid)
+            elif st == "error" and (
+                iid not in self._starting and iid not in self.engines
+            ):
+                await self._set_state(
+                    iid, "scheduled", "restart after error"
+                )
+                self._spawn(iid)
+        for iid in list(self.engines):
+            if iid not in mine:
+                self.engines.discard(iid)
+
+
+# ---------------------------------------------------------------------------
+# Transition-legality observer
+# ---------------------------------------------------------------------------
+
+
+class TransitionObserver:
+    """Judge EVERY instance state write against the declared lifecycle.
+
+    Installed as a synchronous bus tap (``EventBus.add_tap``), not a
+    subscriber: subscriber queues coalesce consecutive UPDATED events
+    into multi-hop change pairs, which would make single-step legality
+    unjudgeable. The tap sees each write exactly once, in publish
+    order. Re-attached to the fresh bus after a server restart."""
+
+    def __init__(self) -> None:
+        self.violations: List[inv.Violation] = []
+        self.observed: List[Tuple[int, str, str]] = []
+
+    def attach(self, bus) -> None:
+        bus.add_tap(self._tap)
+
+    def _tap(self, event) -> None:
+        if event.kind != "model_instance":
+            return
+        if event.type != EventType.UPDATED or not event.changes:
+            return
+        pair = event.changes.get("state")
+        if not pair:
+            return
+        old, new = pair[0], pair[1]
+        self.observed.append((event.id, old, new))
+        v = inv.transition_violation(
+            old, new, label=f"instance {event.id}"
+        )
+        if v is not None:
+            self.violations.append(v)
+
+
+# ---------------------------------------------------------------------------
+# Harness
+# ---------------------------------------------------------------------------
+
+
+class ChaosHarness:
+    """One in-process cluster: real server, N stub workers, seeded
+    faults, continuous invariant checking."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        *,
+        workers: int = 2,
+        chips: int = 8,
+        replicas: int = 2,
+        heartbeat_interval: float = 0.25,
+        rescue_grace: float = 1.2,
+        stuck_bound: float = 15.0,
+        start_delay: float = 0.08,
+    ):
+        self.data_dir = str(data_dir)
+        self.n_workers = workers
+        self.chips = chips
+        self.replicas = replicas
+        self.heartbeat_interval = heartbeat_interval
+        self.stale_after = heartbeat_interval * 4.5
+        self.rescue_grace = rescue_grace
+        self.stuck_bound = stuck_bound
+        self.start_delay = start_delay
+
+        self.server = None
+        self.cfg: Optional[Config] = None
+        self.base = ""
+        self.admin: Optional[ClientSet] = None
+        self.observer: Optional[TransitionObserver] = None
+        self.stubs: List[StubWorker] = []
+        self.injector = FaultInjector()
+        self.monitor_violations: List[inv.Violation] = []
+        self.skipped_ops: List[ChaosOp] = []
+        self.probe_results: List = []
+        self._restores: List[asyncio.Task] = []
+        self._monitor_task: Optional[asyncio.Task] = None
+
+    # ---- lifecycle ---------------------------------------------------
+
+    async def start(self) -> None:
+        from gpustack_tpu.server.server import Server
+
+        self.cfg = Config(
+            host="127.0.0.1",
+            port=_free_port(),
+            data_dir=self.data_dir,
+            disable_worker=True,
+            bootstrap_password="chaos-pass",
+            registration_token="chaos-tok",
+            heartbeat_interval=self.heartbeat_interval,
+            unreachable_rescue_after=self.rescue_grace,
+            worker_connect_timeout=0.5,
+            worker_control_timeout=1.5,
+            worker_control_retries=2,
+            shutdown_timeout=0.3,
+            force_platform="cpu",
+        ).finalize()
+        self.server = Server(self.cfg)
+        await self.server.start()
+        self.base = f"http://127.0.0.1:{self.cfg.port}"
+
+        token = await self._login()
+        self.admin = ClientSet(self.base, token)
+        self.observer = TransitionObserver()
+        self.observer.attach(self.server.bus)
+
+        self.stubs = [
+            StubWorker(
+                self.base, "chaos-tok", f"chaos-w{i}",
+                chips=self.chips,
+                heartbeat_interval=self.heartbeat_interval,
+                start_delay=self.start_delay,
+            )
+            for i in range(self.n_workers)
+        ]
+        for stub in self.stubs:
+            await stub.start()
+        await self._wait_workers_ready()
+        self._monitor_task = asyncio.create_task(
+            self._monitor(), name="chaos-monitor"
+        )
+
+    async def stop(self) -> None:
+        worker_request.rpc_fault_hook = None
+        if self._monitor_task:
+            self._monitor_task.cancel()
+        for t in self._restores:
+            t.cancel()
+        for stub in self.stubs:
+            if stub.alive:
+                await stub.kill()
+        if self.admin:
+            await self.admin.close()
+        if self.server is not None:
+            await self.server.stop()
+
+    async def _login(self) -> str:
+        deadline = asyncio.get_running_loop().time() + 30.0
+        async with aiohttp.ClientSession() as http:
+            while True:
+                try:
+                    async with http.post(
+                        self.base + "/auth/login",
+                        json={
+                            "username": "admin",
+                            "password": "chaos-pass",
+                        },
+                        timeout=aiohttp.ClientTimeout(total=5),
+                    ) as r:
+                        if r.status == 200:
+                            return (await r.json())["token"]
+                except CLIENT_ERRORS:
+                    pass
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("server never came up")
+                await asyncio.sleep(0.2)
+
+    async def _wait_workers_ready(self, timeout: float = 20.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            workers = await self.admin.list("workers")
+            ready = [w for w in workers if w["state"] == "ready"]
+            if len(ready) >= self.n_workers:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise AssertionError(
+                    f"only {len(ready)}/{self.n_workers} workers ready"
+                )
+            await asyncio.sleep(0.1)
+
+    # ---- workload ----------------------------------------------------
+
+    async def deploy(
+        self, name: str = "chaos-model", replicas: Optional[int] = None
+    ) -> dict:
+        return await self.admin.create("models", {
+            "name": name,
+            "preset": "tiny",
+            "replicas": (
+                self.replicas if replicas is None else replicas
+            ),
+            "max_seq_len": 256,
+            "max_slots": 2,
+            "distributable": False,
+        })
+
+    # ---- fault execution ---------------------------------------------
+
+    async def run_schedule(self, ops: Sequence[ChaosOp]) -> None:
+        loop = asyncio.get_running_loop()
+        worker_request.rpc_fault_hook = self.injector
+        start = loop.time()
+        try:
+            for op in sorted(ops, key=lambda o: (o.at, o.kind)):
+                delay = start + op.at - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                logger.info("chaos op: %s", op)
+                await self._apply(op)
+            await self._drain_restores()
+        finally:
+            worker_request.rpc_fault_hook = None
+
+    def _pick_alive(self, ordinal: int) -> Optional[StubWorker]:
+        alive = [s for s in self.stubs if s.alive]
+        if not alive:
+            return None
+        return alive[ordinal % len(alive)]
+
+    def _restore_later(self, delay: float, fn) -> None:
+        async def go():
+            await asyncio.sleep(delay)
+            fn()
+
+        self._restores.append(
+            asyncio.create_task(go(), name="chaos-restore")
+        )
+
+    async def _drain_restores(self) -> None:
+        pending, self._restores = self._restores, []
+        for t in pending:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+
+    async def _apply(self, op: ChaosOp) -> None:
+        stub = self._pick_alive(op.target)
+        if op.kind == "worker_kill":
+            alive = [s for s in self.stubs if s.alive]
+            if len(alive) <= 1:
+                # never kill the last worker: convergence would be
+                # impossible by construction, which tests nothing
+                self.skipped_ops.append(op)
+                return
+            await stub.kill()
+        elif op.kind == "worker_suspend":
+            if stub is None:
+                self.skipped_ops.append(op)
+                return
+            stub.suspend()
+            self._restore_later(
+                self.stale_after * 1.6 + op.arg, stub.resume
+            )
+        elif op.kind == "heartbeat_blackhole":
+            if stub is None:
+                self.skipped_ops.append(op)
+                return
+            stub.hb_blackholed = True
+
+            def restore(s=stub):
+                s.hb_blackholed = False
+
+            self._restore_later(self.stale_after * 1.6 + op.arg, restore)
+        elif op.kind == "rpc_delay":
+            self.injector.delay = max(0.05, op.arg)
+            self._fire_probe(stub)
+
+            def clear_delay():
+                self.injector.delay = 0.0
+
+            self._restore_later(1.0 + op.arg, clear_delay)
+        elif op.kind == "rpc_drop":
+            self.injector.dropping = True
+            self._fire_probe(stub)
+
+            def clear_drop():
+                self.injector.dropping = False
+
+            self._restore_later(0.6 + op.arg, clear_drop)
+        elif op.kind == "engine_crash":
+            if stub is None:
+                self.skipped_ops.append(op)
+                return
+            stub.crash_engine()
+        elif op.kind == "server_restart":
+            await self.restart_server()
+        else:
+            raise ValueError(f"unknown chaos op kind {op.kind!r}")
+
+    def _fire_probe(self, stub: Optional[StubWorker]) -> None:
+        """Drive a real control RPC through the live server app while
+        the fault window is open — exercises worker_fetch's retry tier
+        end to end."""
+        if stub is None or self.server is None:
+            return
+
+        async def go():
+            from gpustack_tpu.schemas import Worker
+
+            try:
+                worker = await Worker.get(stub.worker_id)
+                if worker is None:
+                    return
+                resp = await worker_request.worker_fetch(
+                    self.server.app, worker, "GET", "/healthz",
+                    control=True,
+                )
+                await resp.read()
+                resp.release()
+                self.probe_results.append((stub.name, resp.status))
+            except CLIENT_ERRORS as e:
+                self.probe_results.append((stub.name, repr(e)))
+
+        self._restores.append(
+            asyncio.create_task(go(), name="chaos-probe")
+        )
+
+    async def restart_server(self) -> None:
+        from gpustack_tpu.server.server import Server
+
+        await self.server.stop()
+        self.server = Server(self.cfg)
+        # the old listener may linger a beat after cleanup
+        for attempt in range(5):
+            try:
+                await self.server.start()
+                break
+            except OSError:
+                if attempt == 4:
+                    raise
+                await asyncio.sleep(0.2)
+        # fresh server ⇒ fresh bus: re-attach the lossless observer
+        if self.observer is not None:
+            self.observer.attach(self.server.bus)
+
+    # ---- invariants --------------------------------------------------
+
+    async def _records(self):
+        from gpustack_tpu.schemas import (
+            DevInstance,
+            Model,
+            ModelInstance,
+            Worker,
+        )
+
+        return (
+            await Model.all(),
+            await Worker.all(),
+            await ModelInstance.all(),
+            await DevInstance.all(),
+        )
+
+    async def _monitor(self) -> None:
+        """Continuously assert the always-scope invariants mid-chaos."""
+        while True:
+            await asyncio.sleep(0.25)
+            try:
+                models, workers, instances, devs = await self._records()
+            except Exception:
+                continue  # server mid-restart: DB handle swapped
+            for v in inv.snapshot_violations(
+                models, workers, instances, devs,
+                stuck_bound=self.stuck_bound,
+                include_eventual=False,
+            ):
+                self.monitor_violations.append(v)
+
+    def violations(self) -> List[inv.Violation]:
+        seen = set()
+        out: List[inv.Violation] = []
+        for v in list(self.monitor_violations) + (
+            list(self.observer.violations) if self.observer else []
+        ):
+            key = (v.rule, v.detail)
+            if key not in seen:
+                seen.add(key)
+                out.append(v)
+        return out
+
+    async def wait_converged(
+        self, timeout: float = 30.0, settle: float = 0.6
+    ) -> None:
+        """Block until the declared spec holds (replica counts, all
+        RUNNING on READY workers, zero always-scope violations) and
+        KEEPS holding for ``settle`` seconds."""
+        await self._drain_restores()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        good_since: Optional[float] = None
+        last: List[inv.Violation] = []
+        while True:
+            try:
+                models, workers, instances, devs = await self._records()
+                last = inv.snapshot_violations(
+                    models, workers, instances, devs,
+                    stuck_bound=self.stuck_bound,
+                    include_eventual=True,
+                )
+            except Exception as e:
+                last = [inv.Violation(
+                    "snapshot-failed", "always", repr(e)
+                )]
+            if not last:
+                now = loop.time()
+                if good_since is None:
+                    good_since = now
+                elif now - good_since >= settle:
+                    return
+            else:
+                good_since = None
+            if loop.time() > deadline:
+                raise AssertionError(
+                    "cluster did not converge: "
+                    + "; ".join(f"{v.rule}: {v.detail}" for v in last)
+                )
+            await asyncio.sleep(0.15)
+
+
+# ---------------------------------------------------------------------------
+# One-call runner + CLI
+# ---------------------------------------------------------------------------
+
+
+async def run_seeded(
+    data_dir: str,
+    seed: int,
+    *,
+    kinds: Sequence[str] = FAULT_KINDS,
+    ops: int = 3,
+    workers: int = 2,
+    replicas: int = 2,
+    converge_timeout: float = 30.0,
+    **harness_kw,
+) -> dict:
+    """Boot a cluster, deploy, run the seeded schedule, wait for
+    convergence; returns a report dict (raises on non-convergence)."""
+    schedule = generate_schedule(
+        seed, kinds=kinds, ops=ops, workers=workers
+    )
+    harness = ChaosHarness(
+        data_dir, workers=workers, replicas=replicas, **harness_kw
+    )
+    await harness.start()
+    try:
+        await harness.deploy()
+        await harness.wait_converged(timeout=converge_timeout)
+        await harness.run_schedule(schedule)
+        await harness.wait_converged(timeout=converge_timeout)
+        violations = harness.violations()
+        return {
+            "seed": seed,
+            "schedule": [dataclasses.asdict(o) for o in schedule],
+            "skipped_ops": [
+                dataclasses.asdict(o) for o in harness.skipped_ops
+            ],
+            "violations": [v.to_dict() for v in violations],
+            "observed_transitions": len(harness.observer.observed),
+            "probes": list(harness.probe_results),
+            "rpc_faults": {
+                "delayed": harness.injector.delayed,
+                "dropped": harness.injector.dropped,
+            },
+        }
+    finally:
+        await harness.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json as jsonlib
+    import tempfile
+
+    p = argparse.ArgumentParser("gpustack-tpu chaos harness")
+    p.add_argument(
+        "--classes", default="all",
+        help="comma-separated fault classes "
+             f"({', '.join(FAULT_CLASSES)}; 'all' = every named class)",
+    )
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--ops", type=int, default=3)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--timeout", type=float, default=40.0)
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING
+    )
+    if args.classes == "all":
+        classes = [c for c in FAULT_CLASSES if c != "mixed"]
+    else:
+        classes = [c.strip() for c in args.classes.split(",") if c.strip()]
+    unknown = [c for c in classes if c not in FAULT_CLASSES]
+    if unknown:
+        print(f"unknown fault classes: {unknown}")
+        return 2
+
+    failures = 0
+    for i, cls_name in enumerate(classes):
+        seed = args.seed + i
+        tmp = tempfile.mkdtemp(prefix=f"chaos-{cls_name}-")
+        print(f"=== {cls_name} (seed {seed}) ===")
+        try:
+            report = asyncio.run(run_seeded(
+                tmp, seed,
+                kinds=FAULT_CLASSES[cls_name],
+                ops=args.ops,
+                workers=args.workers,
+                replicas=args.replicas,
+                converge_timeout=args.timeout,
+            ))
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"FAIL {cls_name}: {e}")
+            failures += 1
+            continue
+        if report["violations"]:
+            print(f"FAIL {cls_name}: invariant violations")
+            print(jsonlib.dumps(report["violations"], indent=2))
+            failures += 1
+        else:
+            print(
+                f"PASS {cls_name}: converged; "
+                f"{report['observed_transitions']} transitions observed, "
+                f"schedule {report['schedule']}"
+            )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

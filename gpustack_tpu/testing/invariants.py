@@ -1,0 +1,298 @@
+"""Control-plane convergence invariants.
+
+The properties the cluster manager promises to hold — checked by the
+chaos harness (testing/chaos.py) mid-fault and at quiescence, and
+exposed for production triage at ``GET /v2/debug/invariants``
+(routes/extras.py).
+
+Two scopes:
+
+- ``always``: must hold at every instant, even mid-chaos. A violation
+  is a bug no matter when it is observed:
+    * no chip is claimed by two live placements on the same worker;
+    * chip accounting is conserved (claims reference real, usable chips
+      on a known worker);
+    * no instance sits in a transient state longer than the bound
+      (something must always be driving it forward);
+    * every observed state write follows ``INSTANCE_STATE_TRANSITIONS``
+      (checked by the event observer, not the snapshot).
+- ``eventual``: may be transiently false while controllers converge
+  (a worker just died; its instances are still marked RUNNING for a
+  beat) but must hold at quiescence:
+    * every RUNNING instance's worker is READY;
+    * every model's replica count matches its spec, all RUNNING.
+
+All check functions are pure (records in, violations out) so they run
+identically inside the harness, inside the debug endpoint, and in unit
+tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from gpustack_tpu.policies.allocatable import (
+    CLAIMING_STATES,
+    DEV_CLAIMING_STATES,
+)
+from gpustack_tpu.schemas import (
+    ModelInstanceState,
+    WorkerState,
+    validate_instance_transition,
+)
+
+# states an instance may only pass through, never rest in — something
+# (scheduler, worker agent, controller) must always be driving it on
+TRANSIENT_STATES = {
+    ModelInstanceState.ANALYZING,
+    ModelInstanceState.SCHEDULED,
+    ModelInstanceState.DOWNLOADING,
+    ModelInstanceState.STARTING,
+    ModelInstanceState.DRAINING,
+}
+
+DEFAULT_STUCK_BOUND = 600.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str      # short machine id, e.g. "double-chip-claim"
+    scope: str     # "always" | "eventual"
+    detail: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _claims_by_worker(instances: Iterable, dev_instances: Iterable):
+    """worker_id -> list of (owner-label, chip_index) for every live
+    claim, including multi-host subordinate legs."""
+    out: Dict[int, List] = {}
+
+    def add(worker_id, label, chips):
+        out.setdefault(int(worker_id), []).extend(
+            (label, int(c)) for c in chips
+        )
+
+    for inst in instances:
+        if inst.state not in CLAIMING_STATES:
+            continue
+        if inst.worker_id:
+            add(inst.worker_id, f"instance {inst.name}", inst.chip_indexes)
+        for sub in inst.subordinate_workers:
+            if sub.worker_id:
+                add(
+                    sub.worker_id,
+                    f"instance {inst.name} (subordinate)",
+                    sub.chip_indexes,
+                )
+    for dev in dev_instances:
+        if getattr(dev, "state", None) in DEV_CLAIMING_STATES and (
+            dev.worker_id
+        ):
+            add(dev.worker_id, f"dev {dev.name}", dev.chip_indexes)
+    return out
+
+
+def check_chip_claims(
+    workers: Sequence,
+    instances: Sequence,
+    dev_instances: Sequence = (),
+) -> List[Violation]:
+    """No double claim; every claim lands on a real usable chip of a
+    known worker (conservation)."""
+    out: List[Violation] = []
+    by_id = {w.id: w for w in workers}
+    for worker_id, claims in _claims_by_worker(
+        instances, dev_instances
+    ).items():
+        worker = by_id.get(worker_id)
+        seen: Dict[int, str] = {}
+        for label, chip in claims:
+            if chip in seen:
+                out.append(Violation(
+                    "double-chip-claim", "always",
+                    f"worker {worker_id}: chip {chip} claimed by both "
+                    f"{seen[chip]} and {label}",
+                ))
+            else:
+                seen[chip] = label
+        if worker is None:
+            out.append(Violation(
+                "claim-unknown-worker", "always",
+                f"{len(claims)} chip claim(s) reference worker "
+                f"{worker_id}, which does not exist",
+            ))
+            continue
+        usable = {c.index for c in worker.status.chips if c.usable}
+        bogus = sorted({c for _, c in claims} - usable)
+        if bogus:
+            out.append(Violation(
+                "chip-conservation", "always",
+                f"worker {worker.name or worker_id}: claimed chip(s) "
+                f"{bogus} are not usable chips of this worker "
+                f"(usable: {sorted(usable)})",
+            ))
+    return out
+
+
+def check_stuck_transient(
+    instances: Sequence,
+    now: Optional[datetime.datetime] = None,
+    bound: float = DEFAULT_STUCK_BOUND,
+) -> List[Violation]:
+    now = now or _now()
+    out: List[Violation] = []
+    for inst in instances:
+        if inst.state not in TRANSIENT_STATES:
+            continue
+        try:
+            updated = datetime.datetime.fromisoformat(inst.updated_at)
+        except ValueError:
+            continue
+        age = (now - updated).total_seconds()
+        if age > bound:
+            out.append(Violation(
+                "stuck-transient-state", "always",
+                f"instance {inst.name} has sat in "
+                f"{inst.state.value} for {age:.0f}s (> {bound:.0f}s)",
+            ))
+    return out
+
+
+def check_running_worker_ready(
+    workers: Sequence, instances: Sequence
+) -> List[Violation]:
+    by_id = {w.id: w for w in workers}
+    out: List[Violation] = []
+    for inst in instances:
+        if inst.state != ModelInstanceState.RUNNING:
+            continue
+        worker = by_id.get(inst.worker_id or 0)
+        if worker is None:
+            out.append(Violation(
+                "running-without-worker", "eventual",
+                f"instance {inst.name} is RUNNING on worker "
+                f"{inst.worker_id}, which does not exist",
+            ))
+        elif worker.state != WorkerState.READY:
+            out.append(Violation(
+                "running-on-unready-worker", "eventual",
+                f"instance {inst.name} is RUNNING but its worker "
+                f"{worker.name} is {worker.state.value}",
+            ))
+    return out
+
+
+def check_replica_convergence(
+    models: Sequence, instances: Sequence
+) -> List[Violation]:
+    per_model: Dict[int, List] = {}
+    for inst in instances:
+        per_model.setdefault(inst.model_id, []).append(inst)
+    out: List[Violation] = []
+    for model in models:
+        mine = per_model.get(model.id, [])
+        want = max(0, model.replicas)
+        if len(mine) != want:
+            out.append(Violation(
+                "replica-count-diverged", "eventual",
+                f"model {model.name}: {len(mine)} instance(s), "
+                f"spec says {want}",
+            ))
+        not_running = [
+            f"{i.name}={i.state.value}"
+            for i in mine
+            if i.state != ModelInstanceState.RUNNING
+        ]
+        if not_running:
+            out.append(Violation(
+                "replicas-not-running", "eventual",
+                f"model {model.name}: {', '.join(not_running)}",
+            ))
+    return out
+
+
+def transition_violation(
+    old: str, new: str, label: str = ""
+) -> Optional[Violation]:
+    """Judge one observed state write (from a watch event's
+    ``changes['state']`` pair) against the declared lifecycle."""
+    try:
+        old_s = ModelInstanceState(old)
+        new_s = ModelInstanceState(new)
+    except ValueError:
+        return Violation(
+            "unknown-state-written", "always",
+            f"{label}: {old!r} -> {new!r}",
+        )
+    if validate_instance_transition(old_s, new_s):
+        return None
+    return Violation(
+        "illegal-state-transition", "always",
+        f"{label}: {old_s.value} -> {new_s.value} is not declared in "
+        f"INSTANCE_STATE_TRANSITIONS",
+    )
+
+
+def snapshot_violations(
+    models: Sequence,
+    workers: Sequence,
+    instances: Sequence,
+    dev_instances: Sequence = (),
+    *,
+    now: Optional[datetime.datetime] = None,
+    stuck_bound: float = DEFAULT_STUCK_BOUND,
+    include_eventual: bool = True,
+) -> List[Violation]:
+    """All snapshot-checkable invariants over one consistent read.
+    ``include_eventual=False`` is the mid-chaos mode: controllers are
+    allowed to be mid-convergence."""
+    out = check_chip_claims(workers, instances, dev_instances)
+    out += check_stuck_transient(instances, now=now, bound=stuck_bound)
+    if include_eventual:
+        out += check_running_worker_ready(workers, instances)
+        out += check_replica_convergence(models, instances)
+    return out
+
+
+async def control_plane_snapshot(
+    stuck_bound: float = DEFAULT_STUCK_BOUND,
+) -> Dict:
+    """Server-side report over the live records (the debug endpoint's
+    body). ``always``-scope violations are bugs; ``eventual``-scope
+    entries are listed separately — mid-convergence they are expected,
+    persistently they point at the stuck component."""
+    from gpustack_tpu.schemas import DevInstance, Model, Worker
+    from gpustack_tpu.schemas import ModelInstance as MI
+
+    models = await Model.all()
+    workers = await Worker.all()
+    instances = await MI.all()
+    devs = await DevInstance.all()
+    violations = snapshot_violations(
+        models, workers, instances, devs,
+        stuck_bound=stuck_bound, include_eventual=True,
+    )
+    return {
+        "checked_at": _now().isoformat(),
+        "stuck_bound_seconds": stuck_bound,
+        "counts": {
+            "models": len(models),
+            "workers": len(workers),
+            "instances": len(instances),
+            "dev_instances": len(devs),
+        },
+        "violations": [
+            v.to_dict() for v in violations if v.scope == "always"
+        ],
+        "eventual": [
+            v.to_dict() for v in violations if v.scope == "eventual"
+        ],
+    }

@@ -17,7 +17,11 @@ from typing import Callable, Dict, Optional, Set
 
 import aiohttp
 
-from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.client.client import (
+    APIError,
+    NETWORK_ERRORS,
+    ClientSet,
+)
 from gpustack_tpu.config import Config
 from gpustack_tpu.schemas import Model, ModelInstance, ModelInstanceState
 from gpustack_tpu.schemas.inference_backends import InferenceBackend
@@ -77,6 +81,11 @@ class ServeManager:
         # weak-refs scheduled tasks, and a GC'd drain would strand a
         # DRAINING row holding its chip claim forever
         self._bg_tasks: Set[asyncio.Task] = set()
+        # reconcile is no longer single-caller (startup + watch RESYNC
+        # + the heartbeat-recovery task): two interleaved runs would
+        # race the trailing orphan-stop sweep against the other's
+        # spawn_start and kill a freshly spawned engine
+        self._reconcile_lock = asyncio.Lock()
 
     def _track(self, task: asyncio.Task) -> asyncio.Task:
         self._bg_tasks.add(task)
@@ -201,10 +210,20 @@ class ServeManager:
 
     async def reconcile(self) -> None:
         """Converge local processes with the server's view (orphan reaping —
-        reference worker/workload_cleaner.py role)."""
+        reference worker/workload_cleaner.py role). Serialized: the
+        orphan-stop sweep at the end acts on a list snapshot and must
+        not interleave with another reconcile's spawns."""
+        async with self._reconcile_lock:
+            await self._reconcile_locked()
+
+    async def _reconcile_locked(self) -> None:
         try:
             items = await self.client.list("model-instances")
-        except APIError:
+        except NETWORK_ERRORS:
+            # transport errors too: the recovery path runs reconcile
+            # precisely during flaky-network windows, and the startup
+            # call has no try/except above it — a ClientConnectorError
+            # escaping here would kill the agent at boot
             logger.exception("reconcile list failed")
             return
         mine: Set[int] = set()
@@ -252,6 +271,55 @@ class ServeManager:
                     "engine process lost; restarting",
                 )
                 self.spawn_start(inst.id)
+            elif (
+                is_leader
+                and inst.state == ModelInstanceState.UNREACHABLE
+                and inst.id in self.running
+                and inst.id not in self._draining_ids
+            ):
+                run = self.running[inst.id]
+                if run.stopping or run.draining:
+                    pass  # a stop/drain already owns this engine
+                elif run.process is None:
+                    # mid-start PLACEHOLDER: spawn_start registers the
+                    # run before start_instance fills in the process
+                    # (downloads take minutes). An in-flight start task
+                    # owns this id — its RUNNING report un-parks the
+                    # row when it lands; respawning here would
+                    # double-spawn the engine and leak the loser
+                    pass
+                elif run.process.returncode is None:
+                    # we are reachable again AND the engine survived
+                    # the partition: resume serving in place — a
+                    # restart here would throw away a healthy engine
+                    # and its in-flight work (declared transition
+                    # UNREACHABLE -> RUNNING)
+                    logger.warning(
+                        "instance %s survived the partition; resuming "
+                        "as running", inst.name,
+                    )
+                    await self._set_state(
+                        inst.id, ModelInstanceState.RUNNING,
+                        "engine survived worker partition",
+                    )
+                else:
+                    # the tracked engine EXITED during the partition
+                    # and its crash report never reached the server
+                    # (the monitor's state write failed with the
+                    # network): drop the stale handle and re-drive, or
+                    # the row sits UNREACHABLE forever — the rescuer
+                    # skips it (worker READY) and the orphan sweep
+                    # skips it (id is in mine)
+                    logger.warning(
+                        "instance %s: engine died during the "
+                        "partition; re-driving", inst.name,
+                    )
+                    self.running.pop(inst.id, None)
+                    await self._set_state(
+                        inst.id, ModelInstanceState.SCHEDULED,
+                        "engine died during partition; restarting",
+                    )
+                    self.spawn_start(inst.id)
             elif inst.state == ModelInstanceState.DRAINING and is_leader:
                 run = self.running.get(inst.id)
                 if run is None and inst.id not in self._draining_ids:
@@ -774,6 +842,13 @@ class ServeManager:
         self, run: RunningInstance, model: Model, reason: str
     ) -> None:
         logger.warning("instance %d: %s", run.instance_id, reason)
+        if run.stopping or self.running.get(run.instance_id) is not run:
+            # identity check BEFORE the ERROR write, not just after the
+            # backoff: the recovery reconcile may already have popped
+            # this dead run and re-driven the instance — a late ERROR
+            # write would knock the fresh row into a state nobody on a
+            # healthy worker re-drives
+            return
         restartable = (
             model.restart_on_error and run.restarts < MAX_RESTARTS
         )
@@ -791,7 +866,11 @@ class ServeManager:
             run.instance_id, backoff, run.restarts, MAX_RESTARTS,
         )
         await asyncio.sleep(backoff)
-        if run.stopping or run.instance_id not in self.running:
+        if run.stopping or self.running.get(run.instance_id) is not run:
+            # IDENTITY, not membership: the recovery reconcile may have
+            # popped this dead run and spawned a replacement under the
+            # same id while we slept — restarting on top of it would
+            # double-spawn the engine and knock the fresh row backwards
             return
         if run.is_leader:
             await self._set_state(
@@ -820,7 +899,11 @@ class ServeManager:
             await self.client.update(
                 "model-instances", instance_id, fields
             )
-        except APIError as e:
+        except NETWORK_ERRORS as e:
+            # network errors too, not just HTTP-level APIError: a state
+            # write failing mid-partition must degrade to a warning —
+            # an exception here propagates into the monitor/crash tasks
+            # and kills the restart machinery with the engine down
             logger.warning(
                 "failed to update instance %d state: %s", instance_id, e
             )

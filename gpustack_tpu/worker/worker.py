@@ -13,7 +13,11 @@ import socket
 import uuid
 from typing import List, Optional
 
-from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.client.client import (
+    APIError,
+    NETWORK_ERRORS,
+    ClientSet,
+)
 from gpustack_tpu.config import Config
 from gpustack_tpu.detectors import create_detector
 from gpustack_tpu.worker.serve_manager import ServeManager
@@ -44,6 +48,7 @@ class WorkerAgent:
         self.bound_port = 0  # actual HTTP port once bound (worker_port=0 ⇒ ephemeral)
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
+        self._recovery_reconcile: Optional[asyncio.Task] = None
 
     def _load_or_create_uuid(self) -> str:
         """Stable worker identity across restarts: a fresh uuid per boot
@@ -162,6 +167,10 @@ class WorkerAgent:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
+        if self._recovery_reconcile is not None:
+            # a reconcile racing shutdown could spawn a fresh engine
+            # AFTER stop_all() below, or use the client after close()
+            self._recovery_reconcile.cancel()
         if self.serve_manager:
             await self.serve_manager.stop_all()
         if getattr(self, "dev_manager", None):
@@ -188,7 +197,7 @@ class WorkerAgent:
                     }
                 )
                 break
-            except (APIError, OSError) as e:
+            except NETWORK_ERRORS as e:
                 logger.warning(
                     "registration failed (%s); retrying in %.0fs", e, delay
                 )
@@ -204,12 +213,61 @@ class WorkerAgent:
     # ---- loops ----------------------------------------------------------
 
     async def _heartbeat_loop(self) -> None:
+        import random
+
+        interval = self.cfg.heartbeat_interval
         while not self._stopping:
-            try:
-                await self.client.heartbeat(self.worker_id)
-            except (APIError, OSError) as e:
-                logger.warning("heartbeat failed: %s", e)
-            await asyncio.sleep(self.cfg.heartbeat_interval)
+            recovered = False
+            # one FAST retry: heartbeats are the worker's liveness
+            # signal and the server's staleness budget is only ~4.5
+            # intervals — waiting a full interval after a single lost
+            # request spends a third of it for nothing
+            for attempt in (0, 1):
+                try:
+                    resp = await self.client.heartbeat(self.worker_id)
+                    recovered = bool(resp and resp.get("recovered"))
+                    break
+                except NETWORK_ERRORS as e:
+                    if attempt == 0:
+                        logger.warning(
+                            "heartbeat failed: %s; fast retry", e
+                        )
+                        await asyncio.sleep(
+                            min(1.0, interval * 0.2)
+                            * random.uniform(0.5, 1.0)
+                        )
+                    else:
+                        logger.warning("heartbeat retry failed: %s", e)
+            if recovered and self.serve_manager is not None:
+                # the server had us marked UNREACHABLE: our instances
+                # may be parked UNREACHABLE and only this agent can
+                # legally re-drive them — reconcile now instead of
+                # waiting for a watch RESYNC that may never come.
+                # FIRE-AND-FORGET (deduped): awaiting reconcile inline
+                # would starve the liveness signal during exactly the
+                # flaky-network window that triggers it — slow API
+                # calls would stall heartbeats past the staleness
+                # budget and re-park everything in a recover/park loop.
+                # The level-triggered `recovered` flag re-arms this on
+                # a later heartbeat if the attempt fails.
+                task = self._recovery_reconcile
+                if task is None or task.done():
+                    logger.warning(
+                        "server reports we were unreachable; reconciling"
+                    )
+                    self._recovery_reconcile = asyncio.create_task(
+                        self._post_recovery_reconcile(),
+                        name="wk-recovery-reconcile",
+                    )
+            # jittered cadence: a fleet restarted together must not
+            # heartbeat in lockstep forever
+            await asyncio.sleep(interval * random.uniform(0.9, 1.1))
+
+    async def _post_recovery_reconcile(self) -> None:
+        try:
+            await self.serve_manager.reconcile()
+        except Exception:
+            logger.exception("post-recovery reconcile failed")
 
     async def _status_loop(self) -> None:
         while not self._stopping:
@@ -222,7 +280,7 @@ class WorkerAgent:
             await self.client.post_status(
                 self.worker_id, status.model_dump(mode="json")
             )
-        except (APIError, OSError) as e:
+        except NETWORK_ERRORS as e:
             logger.warning("status post failed: %s", e)
         except Exception:
             logger.exception("detector failed")

@@ -1,0 +1,135 @@
+"""UNREACHABLE-instance rescue semantics (ISSUE 4 tentpole + tests).
+
+Two sides of the grace window, against the real in-process control
+plane with protocol-true stub workers:
+
+- worker DEAD past grace: the parked instance is torn down and replica
+  sync re-places it on the healthy worker (new row, new placement);
+- worker BACK within grace: the same row is kept — the heartbeat
+  recovery path re-drives it on its original worker, and at no point
+  does a second placement exist (no double claim).
+"""
+
+import asyncio
+
+from gpustack_tpu.testing.chaos import ChaosHarness
+
+
+async def _wait(pred_coro, timeout, interval=0.15, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    last = None
+    while True:
+        last = await pred_coro()
+        if last is not None:
+            return last
+        if loop.time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(interval)
+
+
+def test_dead_worker_past_grace_replaces_replica(tmp_path):
+    async def go():
+        h = ChaosHarness(
+            str(tmp_path), workers=2, replicas=1, rescue_grace=1.0,
+        )
+        await h.start()
+        try:
+            await h.deploy()
+            await h.wait_converged(timeout=30.0)
+            items = await h.admin.list("model-instances")
+            assert len(items) == 1 and items[0]["state"] == "running"
+            old_id, old_worker = items[0]["id"], items[0]["worker_id"]
+
+            victim = next(
+                s for s in h.stubs if s.worker_id == old_worker
+            )
+            await victim.kill()
+
+            async def replaced():
+                got = await h.admin.list("model-instances")
+                if (
+                    len(got) == 1
+                    and got[0]["state"] == "running"
+                    and got[0]["id"] != old_id
+                ):
+                    return got[0]
+                return None
+
+            new = await _wait(
+                replaced, timeout=45.0, what="replica re-placement"
+            )
+            # re-created AND re-placed onto the surviving worker
+            assert new["worker_id"] != old_worker
+            await h.wait_converged(timeout=20.0)
+            assert h.violations() == []
+            assert h.server.rescuer.rescued_total >= 1
+
+            # debug endpoint view agrees at quiescence
+            report = await h.admin.request(
+                "GET", "/v2/debug/invariants"
+            )
+            assert report["violations"] == []
+            assert report["eventual"] == []
+        finally:
+            await h.stop()
+
+    asyncio.run(go())
+
+
+def test_worker_back_within_grace_keeps_instance(tmp_path):
+    async def go():
+        h = ChaosHarness(
+            str(tmp_path), workers=2, replicas=1,
+            rescue_grace=30.0,  # generous: the worker WILL return first
+        )
+        await h.start()
+        try:
+            await h.deploy()
+            await h.wait_converged(timeout=30.0)
+            items = await h.admin.list("model-instances")
+            old_id, old_worker = items[0]["id"], items[0]["worker_id"]
+            victim = next(
+                s for s in h.stubs if s.worker_id == old_worker
+            )
+
+            # liveness channel goes dark; the engine stays up
+            victim.hb_blackholed = True
+
+            async def parked():
+                got = await h.admin.list("model-instances")
+                if got and got[0]["state"] == "unreachable":
+                    return got[0]
+                return None
+
+            await _wait(parked, timeout=15.0, what="UNREACHABLE parking")
+            # still within grace: the row must be held, claim intact
+            items = await h.admin.list("model-instances")
+            assert len(items) == 1 and items[0]["id"] == old_id
+
+            victim.hb_blackholed = False
+
+            async def recovered():
+                got = await h.admin.list("model-instances")
+                # never more than one placement at any poll
+                assert len(got) <= 1, f"double placement: {got}"
+                if (
+                    got
+                    and got[0]["state"] == "running"
+                    and got[0]["id"] == old_id
+                ):
+                    return got[0]
+                return None
+
+            kept = await _wait(
+                recovered, timeout=20.0, what="in-place recovery"
+            )
+            # SAME row, SAME worker: nothing was re-placed
+            assert kept["worker_id"] == old_worker
+            await h.wait_converged(timeout=20.0)
+            assert h.violations() == []
+            assert h.server.rescuer.rescued_total == 0
+        finally:
+            await h.stop()
+
+    asyncio.run(go())

@@ -21,6 +21,7 @@ from gpustack_tpu.orm.record import Record
 from gpustack_tpu.schemas import (
     Model,
     ModelInstance,
+    ModelInstanceState,
     User,
     Worker,
     WorkerState,
@@ -119,9 +120,13 @@ def test_model_usage_admin_only(cfg):
 def test_instance_writes_require_admin_or_owner(cfg):
     async def go(client, hdrs, workers):
         w1, w2 = workers
+        # STARTING: the state→running writes below must be legal per the
+        # declared lifecycle — the API now 409s illegal transitions and
+        # this test is about WHO may write, not what
         inst = await ModelInstance.create(
             ModelInstance(
-                name="m-0", model_id=1, worker_id=w1.id, port=9000
+                name="m-0", model_id=1, worker_id=w1.id, port=9000,
+                state=ModelInstanceState.STARTING,
             )
         )
         # non-admin user: denied (the round-1 hijack vector)

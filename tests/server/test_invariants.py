@@ -1,0 +1,181 @@
+"""Unit tests for the convergence-invariant checks
+(gpustack_tpu/testing/invariants.py) over hand-built records — the same
+functions the chaos harness and the /v2/debug/invariants endpoint run.
+"""
+
+import datetime
+
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.schemas.models import SubordinateWorker
+from gpustack_tpu.schemas.workers import TPUChip, WorkerStatus
+from gpustack_tpu.testing import invariants as inv
+
+
+def _worker(wid, chips=4, state=WorkerState.READY):
+    w = Worker(
+        name=f"w{wid}",
+        state=state,
+        status=WorkerStatus(
+            chips=[TPUChip(index=i) for i in range(chips)]
+        ),
+    )
+    w.id = wid
+    return w
+
+
+def _inst(iid, worker_id, chips, state=ModelInstanceState.RUNNING,
+          model_id=1, subs=()):
+    inst = ModelInstance(
+        name=f"m-{iid}",
+        model_id=model_id,
+        worker_id=worker_id,
+        chip_indexes=list(chips),
+        state=state,
+        subordinate_workers=list(subs),
+    )
+    inst.id = iid
+    inst.updated_at = _now_iso()
+    return inst
+
+
+def _now_iso(ago=0.0):
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(seconds=ago)
+    ).isoformat()
+
+
+def _rules(violations):
+    return sorted(v.rule for v in violations)
+
+
+# ---- chip claims ----------------------------------------------------------
+
+
+def test_clean_cluster_has_no_violations():
+    workers = [_worker(1), _worker(2)]
+    instances = [_inst(1, 1, [0, 1]), _inst(2, 2, [2, 3])]
+    model = Model(name="m", replicas=2)
+    model.id = 1
+    assert inv.snapshot_violations([model], workers, instances) == []
+
+
+def test_double_claim_same_worker():
+    workers = [_worker(1)]
+    instances = [_inst(1, 1, [0, 1]), _inst(2, 1, [1, 2])]
+    vs = inv.check_chip_claims(workers, instances)
+    assert _rules(vs) == ["double-chip-claim"]
+    assert "chip 1" in vs[0].detail
+
+
+def test_subordinate_claims_counted():
+    workers = [_worker(1), _worker(2)]
+    # instance 1 leads on worker 1 and claims chips 0-1 of worker 2;
+    # instance 2 claims chip 1 of worker 2 directly → overlap
+    sub = SubordinateWorker(worker_id=2, chip_indexes=[0, 1])
+    instances = [
+        _inst(1, 1, [0, 1], subs=[sub]),
+        _inst(2, 2, [1, 2]),
+    ]
+    vs = inv.check_chip_claims(workers, instances)
+    assert _rules(vs) == ["double-chip-claim"]
+
+
+def test_terminal_states_hold_no_claim():
+    workers = [_worker(1)]
+    instances = [
+        _inst(1, 1, [0, 1], state=ModelInstanceState.ERROR),
+        _inst(2, 1, [0, 1]),  # same chips, but 1 is ERROR → no claim
+    ]
+    assert inv.check_chip_claims(workers, instances) == []
+
+
+def test_conservation_flags_unknown_chips_and_workers():
+    workers = [_worker(1, chips=2)]
+    instances = [
+        _inst(1, 1, [0, 7]),      # chip 7 does not exist on worker 1
+        _inst(2, 99, [0]),        # worker 99 does not exist
+    ]
+    vs = inv.check_chip_claims(workers, instances)
+    assert _rules(vs) == ["chip-conservation", "claim-unknown-worker"]
+
+
+# ---- stuck / eventual -----------------------------------------------------
+
+
+def test_stuck_transient_state():
+    inst = _inst(1, 1, [0], state=ModelInstanceState.STARTING)
+    inst.updated_at = _now_iso(ago=100.0)
+    assert inv.check_stuck_transient([inst], bound=30.0)[0].rule == (
+        "stuck-transient-state"
+    )
+    # inside the bound, or a settled state, is fine
+    assert inv.check_stuck_transient([inst], bound=300.0) == []
+    inst.state = ModelInstanceState.RUNNING
+    assert inv.check_stuck_transient([inst], bound=30.0) == []
+
+
+def test_running_requires_ready_worker():
+    workers = [_worker(1, state=WorkerState.UNREACHABLE)]
+    instances = [_inst(1, 1, [0]), _inst(2, 2, [0])]
+    vs = inv.check_running_worker_ready(workers, instances)
+    assert _rules(vs) == [
+        "running-on-unready-worker", "running-without-worker"
+    ]
+    assert all(v.scope == "eventual" for v in vs)
+
+
+def test_replica_convergence():
+    model = Model(name="m", replicas=2)
+    model.id = 1
+    good = [_inst(1, 1, [0]), _inst(2, 2, [0])]
+    assert inv.check_replica_convergence([model], good) == []
+    under = [_inst(1, 1, [0])]
+    assert _rules(inv.check_replica_convergence([model], under)) == [
+        "replica-count-diverged"
+    ]
+    not_running = [
+        _inst(1, 1, [0]),
+        _inst(2, 2, [0], state=ModelInstanceState.UNREACHABLE),
+    ]
+    assert _rules(
+        inv.check_replica_convergence([model], not_running)
+    ) == ["replicas-not-running"]
+
+
+# ---- transition legality --------------------------------------------------
+
+
+def test_transition_violation_judgement():
+    assert inv.transition_violation("pending", "analyzing") is None
+    assert inv.transition_violation("running", "unreachable") is None
+    # the rescue-era transitions are declared
+    assert inv.transition_violation("starting", "unreachable") is None
+    assert inv.transition_violation("unreachable", "running") is None
+    v = inv.transition_violation("pending", "running", label="x")
+    assert v is not None and v.rule == "illegal-state-transition"
+    v = inv.transition_violation("running", "bogus")
+    assert v is not None and v.rule == "unknown-state-written"
+
+
+def test_snapshot_scopes():
+    """include_eventual=False is the mid-chaos mode: convergence lag is
+    not a violation, double claims still are."""
+    workers = [_worker(1, state=WorkerState.UNREACHABLE)]
+    instances = [_inst(1, 1, [0]), _inst(2, 1, [0])]
+    model = Model(name="m", replicas=2)
+    model.id = 1
+    mid = inv.snapshot_violations(
+        [model], workers, instances, include_eventual=False
+    )
+    assert _rules(mid) == ["double-chip-claim"]
+    full = inv.snapshot_violations(
+        [model], workers, instances, include_eventual=True
+    )
+    assert "running-on-unready-worker" in _rules(full)

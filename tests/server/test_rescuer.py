@@ -1,0 +1,222 @@
+"""InstanceRescuer unit contracts (ISSUE 4): grace-windowed teardown of
+UNREACHABLE rows, deletion of claim-less ERROR rows on dead workers, and
+the keep-conditions (within grace / worker READY)."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.controllers import InstanceRescuer
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def _ago(seconds):
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(seconds=seconds)
+    ).isoformat()
+
+
+async def _backdate(obj, ago):
+    """save() re-stamps updated_at by design; write the row directly to
+    simulate a record that has sat untouched for ``ago`` seconds."""
+    obj.updated_at = _ago(ago)
+    cls = type(obj)
+    await Record.db().execute(
+        f"UPDATE {cls.__kind__} SET data = ?, updated_at = ? "
+        f"WHERE id = ?",
+        [obj.model_dump_json(exclude={"id"}), obj.updated_at, obj.id],
+    )
+
+
+async def _mk_worker(state, updated_ago=0.0):
+    w = await Worker.create(Worker(name="w", state=state))
+    await _backdate(w, updated_ago)
+    return w
+
+
+async def _mk_inst(worker_id, state, updated_ago=0.0):
+    inst = await ModelInstance.create(ModelInstance(
+        name=f"i-{state.value}", model_id=1, worker_id=worker_id,
+        chip_indexes=[0], state=state,
+    ))
+    await _backdate(inst, updated_ago)
+    return inst
+
+
+def test_unreachable_past_grace_is_torn_down(db):
+    async def go():
+        w = await _mk_worker(WorkerState.UNREACHABLE)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.UNREACHABLE, updated_ago=100.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        assert await ModelInstance.get(inst.id) is None
+        assert rescuer.rescued_total == 1
+
+    asyncio.run(go())
+
+
+def test_unreachable_within_grace_is_held(db):
+    async def go():
+        w = await _mk_worker(WorkerState.UNREACHABLE)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.UNREACHABLE, updated_ago=3.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        assert await ModelInstance.get(inst.id) is not None
+
+    asyncio.run(go())
+
+
+def test_unreachable_on_returned_worker_is_left_to_the_agent(db):
+    async def go():
+        w = await _mk_worker(WorkerState.READY)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.UNREACHABLE, updated_ago=100.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        # the agent's post-recovery reconcile owns this row now
+        assert await ModelInstance.get(inst.id) is not None
+
+    asyncio.run(go())
+
+
+def test_error_on_dead_worker_is_deleted_after_worker_grace(db):
+    async def go():
+        # the WORKER has been gone past grace; the instance's own
+        # error time is ancient and must not matter on its own
+        w = await _mk_worker(WorkerState.UNREACHABLE, updated_ago=50.0)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.ERROR, updated_ago=9999.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        assert await ModelInstance.get(inst.id) is None
+
+    asyncio.run(go())
+
+
+def test_error_on_live_worker_is_not_touched(db):
+    async def go():
+        # restart_on_error is the live-worker path; an old ERROR row on
+        # a READY worker is the agent's business, not the rescuer's
+        w = await _mk_worker(WorkerState.READY)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.ERROR, updated_ago=9999.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        assert await ModelInstance.get(inst.id) is not None
+
+    asyncio.run(go())
+
+
+def test_error_on_recently_lost_worker_waits_for_grace(db):
+    async def go():
+        w = await _mk_worker(WorkerState.UNREACHABLE, updated_ago=3.0)
+        inst = await _mk_inst(
+            w.id, ModelInstanceState.ERROR, updated_ago=9999.0
+        )
+        rescuer = InstanceRescuer(grace=10.0)
+        await rescuer.sync_once()
+        assert await ModelInstance.get(inst.id) is not None
+
+    asyncio.run(go())
+
+
+def test_zero_grace_disables_teardown_but_not_parking(db):
+    """grace=0 turns off the deletion sweeps only — the level-triggered
+    park sweep is a correctness mechanism and must keep running."""
+
+    async def go():
+        w = await _mk_worker(WorkerState.UNREACHABLE)
+        parked = await _mk_inst(
+            w.id, ModelInstanceState.UNREACHABLE, updated_ago=9999.0
+        )
+        unparked = await _mk_inst(w.id, ModelInstanceState.RUNNING)
+        rescuer = InstanceRescuer(grace=0.0)
+        await rescuer.sync_once()
+        # no teardown, however ancient the row...
+        assert await ModelInstance.get(parked.id) is not None
+        assert rescuer.rescued_total == 0
+        # ...but the lost-edge RUNNING row still gets parked
+        fresh = await ModelInstance.get(unparked.id)
+        assert fresh.state == ModelInstanceState.UNREACHABLE
+
+    asyncio.run(go())
+
+
+def test_level_triggered_park_sweep_catches_lost_edge(db):
+    """A server crash between the worker's UNREACHABLE flip and the
+    per-instance park writes loses the edge event; the rescuer's sweep
+    must re-derive the parking from current state."""
+
+    async def go():
+        w = await _mk_worker(WorkerState.UNREACHABLE)
+        # RUNNING on an UNREACHABLE worker, never parked (lost edge)
+        inst = await _mk_inst(w.id, ModelInstanceState.RUNNING)
+        rescuer = InstanceRescuer(grace=300.0)
+        await rescuer.sync_once()
+        fresh = await ModelInstance.get(inst.id)
+        assert fresh.state == ModelInstanceState.UNREACHABLE
+        # within grace: parked, not deleted (claim held)
+        assert fresh.id == inst.id
+
+    asyncio.run(go())
+
+
+def test_park_sweep_tears_down_multihost_on_lost_subordinate(db):
+    from gpustack_tpu.schemas.models import SubordinateWorker
+
+    async def go():
+        leader = await _mk_worker(WorkerState.READY)
+        lost = await Worker.create(
+            Worker(name="w2", state=WorkerState.UNREACHABLE)
+        )
+        inst = await ModelInstance.create(ModelInstance(
+            name="mh-0", model_id=1, worker_id=leader.id,
+            chip_indexes=[0], state=ModelInstanceState.RUNNING,
+            subordinate_workers=[
+                SubordinateWorker(worker_id=lost.id, chip_indexes=[0])
+            ],
+        ))
+        rescuer = InstanceRescuer(grace=300.0)
+        await rescuer.sync_once()
+        # multi-host cannot recover in place: deleted for reschedule
+        assert await ModelInstance.get(inst.id) is None
+
+    asyncio.run(go())
+
+
+def test_park_sweep_leaves_healthy_placements_alone(db):
+    async def go():
+        w = await _mk_worker(WorkerState.READY)
+        inst = await _mk_inst(w.id, ModelInstanceState.RUNNING)
+        rescuer = InstanceRescuer(grace=300.0)
+        await rescuer.sync_once()
+        fresh = await ModelInstance.get(inst.id)
+        assert fresh.state == ModelInstanceState.RUNNING
+
+    asyncio.run(go())

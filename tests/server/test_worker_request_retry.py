@@ -1,0 +1,204 @@
+"""worker_fetch deadline tiers + retry semantics (ISSUE 4 satellite).
+
+A single 600 s total timeout used to serve both quick control calls and
+streaming relays. Now: connect budget split from total, short-deadline
+jittered retries for idempotent (GET/HEAD) control RPCs only, and the
+chaos fault hook slotting in as "the network" for these tests.
+"""
+
+import asyncio
+import types
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from gpustack_tpu.schemas import Worker
+from gpustack_tpu.server import worker_request
+from gpustack_tpu.server.worker_request import worker_fetch
+
+SECRET = "wr-test-secret"
+
+
+class _Target:
+    """Real worker-side HTTP endpoint on an ephemeral port."""
+
+    def __init__(self):
+        self.hits = 0
+        self.runner = None
+        self.port = 0
+
+    async def start(self):
+        app = web.Application()
+
+        async def ok(request):
+            self.hits += 1
+            if request.headers.get("Authorization") != f"Bearer {SECRET}":
+                return web.json_response({"error": "no"}, status=403)
+            return web.json_response({"ok": True})
+
+        async def slow(request):
+            self.hits += 1
+            await asyncio.sleep(5.0)
+            return web.json_response({"ok": True})
+
+        app.router.add_get("/ok", ok)
+        app.router.add_post("/ok", ok)
+        app.router.add_get("/slow", slow)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+def _app(session, **cfg_fields):
+    defaults = dict(
+        worker_connect_timeout=1.0,
+        worker_control_timeout=2.0,
+        worker_control_retries=2,
+    )
+    defaults.update(cfg_fields)
+    cfg = types.SimpleNamespace(**defaults)
+    # worker_fetch duck-types the app: .get + [] are all it uses
+    return {"proxy_session": session, "config": cfg}
+
+
+def _worker(port):
+    w = Worker(name="t", ip="127.0.0.1", port=port, proxy_secret=SECRET)
+    w.id = 1
+    return w
+
+
+class _FlakyHook:
+    """Raise for the first ``fail`` calls, then pass through."""
+
+    def __init__(self, fail):
+        self.fail = fail
+        self.calls = 0
+
+    async def __call__(self, worker, method, path):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise aiohttp.ClientError("injected drop")
+
+
+@pytest.fixture
+def target():
+    t = _Target()
+    yield t
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_control_get_retries_through_transient_drops(target):
+    async def go():
+        await target.start()
+        hook = _FlakyHook(fail=2)
+        worker_request.rpc_fault_hook = hook
+        try:
+            async with aiohttp.ClientSession() as session:
+                resp = await worker_fetch(
+                    _app(session), _worker(target.port), "GET", "/ok",
+                    control=True,
+                )
+                body = await resp.read()
+                resp.release()
+        finally:
+            worker_request.rpc_fault_hook = None
+        # two injected failures + one success = three attempts, and the
+        # target was actually reached exactly once
+        assert hook.calls == 3
+        assert target.hits == 1
+        assert b"true" in body
+        await target.stop()
+
+    _run(go())
+
+
+def test_non_control_never_retries(target):
+    async def go():
+        await target.start()
+        hook = _FlakyHook(fail=1)
+        worker_request.rpc_fault_hook = hook
+        try:
+            async with aiohttp.ClientSession() as session:
+                with pytest.raises(aiohttp.ClientError):
+                    await worker_fetch(
+                        _app(session), _worker(target.port), "GET", "/ok",
+                    )
+        finally:
+            worker_request.rpc_fault_hook = None
+        assert hook.calls == 1      # streaming tier: fail fast, no retry
+        assert target.hits == 0
+        await target.stop()
+
+    _run(go())
+
+
+def test_control_post_is_not_retried(target):
+    async def go():
+        await target.start()
+        hook = _FlakyHook(fail=1)
+        worker_request.rpc_fault_hook = hook
+        try:
+            async with aiohttp.ClientSession() as session:
+                with pytest.raises(aiohttp.ClientError):
+                    await worker_fetch(
+                        _app(session), _worker(target.port), "POST", "/ok",
+                        json_body={"x": 1},
+                        control=True,
+                    )
+        finally:
+            worker_request.rpc_fault_hook = None
+        # non-idempotent: a repeated POST could double-apply
+        assert hook.calls == 1
+        await target.stop()
+
+    _run(go())
+
+
+def test_control_timeout_is_short(target):
+    async def go():
+        await target.start()
+        async with aiohttp.ClientSession() as session:
+            app = _app(
+                session,
+                worker_control_timeout=0.3,
+                worker_control_retries=0,
+            )
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            with pytest.raises((aiohttp.ClientError, asyncio.TimeoutError)):
+                resp = await worker_fetch(
+                    app, _worker(target.port), "GET", "/slow",
+                    control=True,
+                )
+                await resp.read()
+        # the 5 s handler was cut off by the 0.3 s control budget —
+        # nowhere near the 600 s streaming default
+        assert loop.time() - t0 < 2.0
+        await target.stop()
+
+    _run(go())
+
+
+def test_streaming_default_timeout_untouched(target):
+    async def go():
+        await target.start()
+        async with aiohttp.ClientSession() as session:
+            resp = await worker_fetch(
+                _app(session), _worker(target.port), "GET", "/ok",
+            )
+            assert resp.status == 200
+            await resp.read()
+            resp.release()
+        assert target.hits == 1
+        await target.stop()
+
+    _run(go())
