@@ -679,6 +679,33 @@ def main() -> None:
     ttfts = sorted(r.ttft_ms for r in reqs)
     p50_ttft = ttfts[len(ttfts) // 2]
 
+    # per-phase latency decomposition through the observability
+    # histograms (gpustack_tpu/observability/metrics.py — the same
+    # estimator the dashboards' histogram_quantile uses), so the bench
+    # trajectory attributes a regression to prefill (ttft) vs decode
+    # instead of one end-to-end number
+    from gpustack_tpu.observability.metrics import Histogram
+
+    phase_hists = {
+        "ttft": Histogram("bench_ttft_seconds"),
+        "decode": Histogram("bench_decode_seconds"),
+        "e2e": Histogram("bench_e2e_seconds"),
+    }
+    for r in reqs:
+        ttft_s = max(0.0, r.first_token_at - r.submitted_at)
+        e2e_s = max(0.0, r.finished_at - r.submitted_at)
+        phase_hists["ttft"].observe(ttft_s)
+        phase_hists["decode"].observe(max(0.0, e2e_s - ttft_s))
+        phase_hists["e2e"].observe(e2e_s)
+
+    def _quantiles_ms(h):
+        return {
+            f"p{int(q * 100)}_ms": round((h.quantile(q) or 0.0) * 1e3, 1)
+            for q in (0.5, 0.95, 0.99)
+        }
+
+    phases = {name: _quantiles_ms(h) for name, h in phase_hists.items()}
+
     import jax
 
     # Per-chip denominator from the mesh the engine actually ran on —
@@ -735,6 +762,7 @@ def main() -> None:
                         (out_tokens + in_tokens) / wall, 2
                     ),
                     "p50_ttft_ms": round(p50_ttft, 1),
+                    "phases": phases,
                     "mfu_est": mfu,
                     "n_chips": n_chips,
                     "platform": jax.default_backend(),

@@ -7,11 +7,18 @@ kind`` declaration and every metric-shaped string literal under
 1. declarations — no duplicate ``# TYPE`` for a name within one file,
    no kind conflict for a name across files, every declared name
    ``snake_case`` (one optional ``namespace:`` colon, as in
-   ``gpustack_tpu:requests_running`` or engine-native ``vllm:*``);
-2. the normalization table (``worker/metrics_map.py`` METRIC_MAP) —
+   ``gpustack_tpu:requests_running`` or engine-native ``vllm:*``).
+   Declarations come from literal ``# TYPE name kind`` strings AND
+   from ``METRIC_FAMILIES`` dict literals (observability/metrics.py
+   renders its families from that declared vocabulary);
+2. histogram families — ``_bucket``/``_sum``/``_count`` are series of
+   ONE declared base family, never metrics of their own: declaring
+   ``# TYPE foo_seconds_bucket gauge`` next to a ``foo_seconds``
+   histogram is three drifting metrics wearing one name;
+3. the normalization table (``worker/metrics_map.py`` METRIC_MAP) —
    no duplicate keys (silent last-wins in a dict literal!), every
    value under the ``gpustack_tpu:`` namespace;
-3. references — metric-shaped names mentioned in ``docs/*.md``,
+4. references — metric-shaped names mentioned in ``docs/*.md``,
    ``README.md`` and ``tests/**`` must exist in the production
    vocabulary (histogram ``_bucket``/``_sum``/``_count`` suffixes
    allowed); a rename that orphans a dashboard/doc/test name fails
@@ -41,6 +48,8 @@ REF_TOKEN = re.compile(
     r"\b(?:gpustack|vllm|sglang)[a-z0-9]*[_:][A-Za-z0-9_:]+"
 )
 HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+VALID_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+FAMILIES_NAME = "METRIC_FAMILIES"
 
 
 class MetricsDriftRule(Rule):
@@ -68,10 +77,53 @@ class MetricsDriftRule(Rule):
                 vocab.update(
                     t.rstrip("_:") for t in REF_TOKEN.findall(value)
                 )
+            family_decls, bad_kinds = self._family_decls(tree, rel)
+            decls.extend(family_decls)
+            yield from bad_kinds
 
         yield from self._declaration_checks(decls)
+        yield from self._family_checks(decls)
         yield from self._map_checks(project)
         yield from self._reference_checks(project, vocab)
+
+    # ---- 0. METRIC_FAMILIES dict declarations --------------------------
+
+    def _family_decls(
+        self, tree, rel: str
+    ) -> Tuple[List[Tuple[str, str, str, int]], List[Finding]]:
+        """``METRIC_FAMILIES = {"name": "kind", ...}`` literals declare
+        metrics the same way a ``# TYPE`` string does (the histogram
+        renderer emits its TYPE lines from that vocabulary at runtime,
+        so the static view must read the same source of truth)."""
+        decls: List[Tuple[str, str, str, int]] = []
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == FAMILIES_NAME
+                for t in node.targets
+            ):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    continue
+                if v.value not in VALID_KINDS:
+                    findings.append(self.finding(
+                        rel, k.lineno,
+                        f"{FAMILIES_NAME} kind '{v.value}' for "
+                        f"'{k.value}' is not one of {VALID_KINDS}",
+                    ))
+                    continue
+                decls.append((k.value, v.value, rel, k.lineno))
+        return decls, findings
 
     # ---- 1. TYPE declarations ------------------------------------------
 
@@ -104,6 +156,32 @@ class MetricsDriftRule(Rule):
                     f"{prev[0]} in {prev[1]}",
                 )
             kinds.setdefault(name, (kind, rel, line))
+
+    # ---- 1b. histogram family integrity --------------------------------
+
+    def _family_checks(self, decls) -> Iterator[Finding]:
+        """A histogram's ``_bucket``/``_sum``/``_count`` series belong
+        to the ONE declared base family — a separate declaration for a
+        suffixed name next to its base means the exporter is emitting
+        the family and something else is emitting a same-named metric
+        (three drifting metrics wearing one histogram's name)."""
+        declared: Dict[str, str] = {}
+        for name, kind, _rel, _line in decls:
+            declared.setdefault(name, kind)
+        for name, kind, rel, line in decls:
+            for suffix in HISTO_SUFFIXES:
+                if not name.endswith(suffix):
+                    continue
+                base = name[: -len(suffix)]
+                base_kind = declared.get(base)
+                if base_kind in ("histogram", "summary"):
+                    yield self.finding(
+                        rel, line,
+                        f"'{name}' declared {kind} but it is a series "
+                        f"of the declared {base_kind} family '{base}' "
+                        f"— emit the family, not its parts",
+                    )
+                break
 
     # ---- 2. normalization map ------------------------------------------
 

@@ -1,17 +1,25 @@
-"""aiohttp middlewares: authentication + request logging.
+"""aiohttp middlewares: tracing + authentication + request logging.
 
 Reference analogue: the FastAPI dependency chain ``get_current_user``
-(gpustack/api/auth.py:118) + middleware stack (server/app.py:26)."""
+(gpustack/api/auth.py:118) + middleware stack (server/app.py:26).
+
+``timing_middleware`` is the trace edge: it mints (or adopts from
+``traceparent``/``X-Request-ID``) the request's trace context, echoes
+``X-Request-ID`` on every response, and emits ONE access log line per
+request — trace id, principal kind, status, per-phase breakdown —
+which is also where slow requests surface (threshold:
+``Config.slow_request_ms``). It must be the OUTERMOST middleware so
+auth time and auth failures are traced too."""
 
 from __future__ import annotations
 
 import logging
 import re
-import time
 
 from aiohttp import web
 
 from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.observability import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -84,7 +92,12 @@ async def auth_middleware(request: web.Request, handler):
         return await handler(request)
     cfg = request.app["config"]
     token = _extract_token(request)
+    trace = request.get("trace")
+    if trace is not None:
+        trace.begin("auth")
     principal = await auth_mod.authenticate(token, cfg.jwt_secret)
+    if trace is not None:
+        trace.end("auth")
     if principal is None:
         return web.json_response(
             {"error": "authentication required"}, status=401
@@ -111,13 +124,61 @@ async def auth_middleware(request: web.Request, handler):
 
 @web.middleware
 async def timing_middleware(request: web.Request, handler):
-    start = time.monotonic()
-    try:
+    # machine chatter (health probes, metrics scrapes) must not flood
+    # the access log or evict real requests from the trace ring
+    if request.path in tracing.UNTRACED_PATHS:
         return await handler(request)
+    ctx = tracing.from_headers(request.headers)
+    trace = tracing.RequestTrace(
+        ctx, "server", f"{request.method} {request.path}"
+    )
+    request["trace"] = trace
+    status = 500
+    try:
+        try:
+            resp = await handler(request)
+        except web.HTTPException as e:
+            # router 404s/405s propagate as exceptions — they are
+            # ordinary responses, not server errors
+            status = e.status
+            e.headers.setdefault(
+                tracing.REQUEST_ID_HEADER, ctx.request_id
+            )
+            raise
+        status = resp.status
+        if not resp.prepared:
+            # streamed responses (SSE relays, log follow) set these
+            # themselves before prepare(); everything else gets them here
+            resp.headers.setdefault(
+                tracing.REQUEST_ID_HEADER, ctx.request_id
+            )
+            resp.headers.setdefault(
+                tracing.TRACEPARENT_HEADER, ctx.traceparent()
+            )
+        return resp
     finally:
-        elapsed = (time.monotonic() - start) * 1e3
-        if elapsed > 1000:
+        principal = request.get("principal")
+        kind = principal.kind if principal else "-"
+        phases = trace.phases          # sealed by finish() below
+        elapsed_ms = trace.finish(
+            status=status, log=False, principal=kind,
+        )
+        logger.info(
+            "access %s %s status=%d ms=%.1f trace=%s req=%s "
+            "principal=%s model=%s phases=[%s]",
+            request.method, request.path, status, elapsed_ms,
+            ctx.trace_id, ctx.request_id, kind, trace.model or "-",
+            " ".join(
+                f"{p['phase']}:{p['duration_ms']:.1f}" for p in phases
+            ),
+        )
+        slow_ms = getattr(
+            request.app.get("config"), "slow_request_ms", 1000.0
+        )
+        if elapsed_ms > slow_ms:
             logger.warning(
-                "slow request: %s %s took %.0fms",
-                request.method, request.path, elapsed,
+                "slow request: %s %s took %.0fms (threshold %.0fms) "
+                "trace=%s",
+                request.method, request.path, elapsed_ms, slow_ms,
+                ctx.trace_id,
             )

@@ -87,6 +87,12 @@ class Config(pydantic.BaseModel):
 
     # observability
     enable_metrics: bool = True
+    # access-log slow-request warning threshold in milliseconds
+    # (api/middlewares.py timing middleware; used to be hard-coded 1000)
+    slow_request_ms: float = 1000.0
+    # bounded in-memory trace ring served at GET /v2/debug/traces
+    # (observability/tracing.py TraceStore entries kept per component)
+    trace_ring_size: int = 512
 
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False

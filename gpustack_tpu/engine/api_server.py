@@ -106,9 +106,15 @@ class OpenAIServer:
     """aiohttp application serving one LLMEngine."""
 
     def __init__(self, engine: LLMEngine, model_name: Optional[str] = None):
+        from gpustack_tpu.observability.tracing import trace_middleware
+
         self.engine = engine
         self.model_name = model_name or engine.cfg.name
-        self.app = web.Application()
+        # the engine is the last hop of the trace: the middleware adopts
+        # the worker proxy's traceparent and logs this hop's trace=… line
+        self.app = web.Application(
+            middlewares=[trace_middleware("engine")]
+        )
         self.app.add_routes(
             [
                 web.get("/healthz", self.healthz),

@@ -1,0 +1,39 @@
+"""Dependency-free observability layer: tracing, histograms, timelines.
+
+Three cooperating pieces, all stdlib-only (the image carries no
+opentelemetry / prometheus_client):
+
+- :mod:`gpustack_tpu.observability.tracing` — W3C-``traceparent``-style
+  trace/span ids minted (or adopted from ``X-Request-ID``) at the API
+  edge and propagated through every hop of a request (server proxy →
+  worker reverse proxy → engine), with per-phase spans collected into a
+  bounded in-memory ring served at ``GET /v2/debug/traces`` and emitted
+  as one greppable ``trace=…`` log line per hop.
+- :mod:`gpustack_tpu.observability.metrics` — Prometheus text-format
+  histograms (proper ``_bucket``/``_sum``/``_count`` rendering with
+  label escaping) behind per-component registries, rendered into the
+  existing server and worker ``/metrics`` exporters.
+- :mod:`gpustack_tpu.observability.lifecycle` — a lossless
+  ``EventBus.add_tap`` consumer measuring time-in-state per model
+  instance (the same tap mechanism the chaos harness's invariant
+  observer uses), exported as histograms and surfaced per-instance at
+  ``GET /v2/model-instances/{id}/timeline``.
+"""
+
+from gpustack_tpu.observability.tracing import (  # noqa: F401
+    RequestTrace,
+    TraceContext,
+    TraceStore,
+    from_headers,
+    get_store,
+    parse_traceparent,
+    trace_middleware,
+)
+from gpustack_tpu.observability.metrics import (  # noqa: F401
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from gpustack_tpu.observability.lifecycle import (  # noqa: F401
+    LifecycleTracker,
+)

@@ -1,0 +1,186 @@
+"""Instance lifecycle timelines: time-in-state from lossless bus taps.
+
+Subscriber queues coalesce UPDATED events by design, which folds
+consecutive state writes together — useless for dwell measurement. The
+lossless ``EventBus.add_tap`` hook (the same mechanism the chaos
+harness's transition-legality observer rides) sees every publish in
+order, so this tracker can measure exactly how long each instance sat
+in SCHEDULED/DOWNLOADING/STARTING/…, including UNREACHABLE and DRAINING
+dwell during faults.
+
+Dwell samples feed the ``gpustack_instance_state_seconds`` histogram
+(per-state labels) on the server's /metrics; the raw per-instance
+timeline is bounded and served at
+``GET /v2/model-instances/{id}/timeline`` for triage ("where did the
+five minutes between deploy and RUNNING go?").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from gpustack_tpu.observability.metrics import (
+    DWELL_BUCKETS,
+    get_registry,
+)
+
+KIND = "model_instance"
+
+MAX_INSTANCES = 512          # timelines kept (LRU-evicted)
+MAX_ENTRIES = 64             # per-instance timeline length
+
+
+class LifecycleTracker:
+    """Tap consumer: per-instance state timeline + dwell histogram.
+
+    ``on_event`` runs synchronously inside ``EventBus.publish`` — it
+    must stay fast and non-raising (the bus contains tap exceptions,
+    but a slow tap would stretch every commit)."""
+
+    def __init__(self, component: str = "server"):
+        self._hist = get_registry(component).histogram(
+            "gpustack_instance_state_seconds",
+            buckets=DWELL_BUCKETS,
+            label_names=("state",),
+        )
+        self._mu = threading.Lock()
+        # instance id -> {"name", "current", "entered_at", "entries"}
+        self._instances: "OrderedDict[int, Dict[str, Any]]" = (
+            OrderedDict()
+        )
+        self._bus = None
+
+    # ---- wiring ---------------------------------------------------------
+
+    def attach(self, bus) -> None:
+        self._bus = bus
+        bus.add_tap(self.on_event)
+
+    def detach(self) -> None:
+        if self._bus is not None:
+            self._bus.remove_tap(self.on_event)
+            self._bus = None
+
+    # ---- tap ------------------------------------------------------------
+
+    def on_event(self, event) -> None:
+        if event.kind != KIND:
+            return
+        etype = event.type.value
+        ts = event.ts or time.time()
+        with self._mu:
+            if etype == "CREATED":
+                state = (event.data or {}).get("state", "pending")
+                self._start(event.id, event.data, str(state), ts)
+            elif etype == "UPDATED":
+                changed = (event.changes or {}).get("state")
+                if changed:
+                    self._transition(
+                        event.id, event.data,
+                        str(changed[0]), str(changed[1]), ts,
+                    )
+            elif etype == "DELETED":
+                self._close(event.id, "deleted", ts)
+
+    # ---- internals (lock held) ------------------------------------------
+
+    def _record(self, instance_id: int, data) -> Dict[str, Any]:
+        rec = self._instances.get(instance_id)
+        if rec is None:
+            rec = {
+                "name": (data or {}).get("name", ""),
+                "current": "",
+                "entered_at": 0.0,
+                "entries": [],
+            }
+            self._instances[instance_id] = rec
+            while len(self._instances) > MAX_INSTANCES:
+                self._instances.popitem(last=False)
+        else:
+            self._instances.move_to_end(instance_id)
+            if (data or {}).get("name"):
+                rec["name"] = data["name"]
+        return rec
+
+    def _start(
+        self, instance_id: int, data, state: str, ts: float
+    ) -> None:
+        rec = self._record(instance_id, data)
+        rec["current"] = state
+        rec["entered_at"] = ts
+
+    def _transition(
+        self, instance_id: int, data, old: str, new: str, ts: float
+    ) -> None:
+        rec = self._record(instance_id, data)
+        if rec["current"]:
+            dwell = max(0.0, ts - rec["entered_at"])
+            self._append(rec, rec["current"], rec["entered_at"], ts, new)
+            self._hist.observe(dwell, state=rec["current"])
+        elif old:
+            # first sighting mid-life (tracker attached after the row
+            # existed): adopt without a dwell sample — the entry ts
+            # would be a guess
+            self._append(rec, old, 0.0, ts, new)
+        rec["current"] = new
+        rec["entered_at"] = ts
+
+    def _close(self, instance_id: int, reason: str, ts: float) -> None:
+        rec = self._instances.get(instance_id)
+        if rec is None or not rec["current"]:
+            return
+        dwell = max(0.0, ts - rec["entered_at"])
+        self._append(rec, rec["current"], rec["entered_at"], ts, reason)
+        self._hist.observe(dwell, state=rec["current"])
+        rec["current"] = ""
+        rec["entered_at"] = 0.0
+
+    @staticmethod
+    def _append(
+        rec: Dict[str, Any], state: str, entered: float,
+        left: float, to: str,
+    ) -> None:
+        rec["entries"].append(
+            {
+                "state": state,
+                "entered_at": entered or None,
+                "left_at": left,
+                "seconds": (
+                    round(left - entered, 3) if entered else None
+                ),
+                "to": to,
+            }
+        )
+        if len(rec["entries"]) > MAX_ENTRIES:
+            del rec["entries"][: len(rec["entries"]) - MAX_ENTRIES]
+
+    # ---- reads ----------------------------------------------------------
+
+    def timeline(self, instance_id: int) -> Optional[Dict[str, Any]]:
+        with self._mu:
+            rec = self._instances.get(instance_id)
+            if rec is None:
+                return None
+            entries = list(rec["entries"])
+            current = rec["current"]
+            entered_at = rec["entered_at"]
+            name = rec["name"]
+        out: Dict[str, Any] = {
+            "instance_id": instance_id,
+            "name": name,
+            "entries": entries,
+        }
+        if current:
+            out["current"] = {
+                "state": current,
+                "entered_at": entered_at,
+                "seconds": round(time.time() - entered_at, 3),
+            }
+        return out
+
+    def known_instances(self) -> List[int]:
+        with self._mu:
+            return list(self._instances)

@@ -1,0 +1,281 @@
+"""Prometheus text-format histograms and counters, dependency-free.
+
+The existing exporters (server/exporter.py, worker/server.py) are
+gauge/counter-only string builders; attributing latency needs real
+histograms with correct wire format: ``# TYPE`` before the first
+sample, cumulative ``_bucket`` counts ending in ``+Inf`` ==
+``_count``, and label values escaped per the exposition format
+(backslash, double-quote, newline).
+
+``METRIC_FAMILIES`` below is the declared vocabulary for everything
+this module can emit — the metrics-drift analyzer parses the literal
+dict (like METRIC_MAP in worker/metrics_map.py) so a histogram family
+rename that orphans a dashboard or doc reference fails CI, and so
+``_bucket``/``_sum``/``_count`` stay series of ONE declared family
+instead of three drifting metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Declared metric families (name -> prometheus kind). Keep LITERAL:
+# the metrics-drift rule reads the AST, it does not import this module.
+METRIC_FAMILIES = {
+    # per-phase request latency through the server's proxy path
+    "gpustack_request_duration_seconds": "histogram",
+    # per-phase relay latency through the worker's reverse proxy
+    "gpustack_worker_request_duration_seconds": "histogram",
+    # instance lifecycle: dwell time per state (lifecycle.py tap)
+    "gpustack_instance_state_seconds": "histogram",
+    # utils/profiling.CallStats surfaced on /metrics (slow-call tracing)
+    "gpustack_slow_call_count": "counter",
+    "gpustack_slow_call_seconds_total": "counter",
+    "gpustack_slow_call_max_seconds": "gauge",
+}
+
+# request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a
+# slow non-streaming generation
+DURATION_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+# state-dwell buckets: instances legitimately sit minutes in
+# DOWNLOADING/STARTING and hours in RUNNING
+DWELL_BUCKETS = (
+    0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+    600.0, 1800.0, 3600.0, 14400.0,
+)
+
+_INF = float("inf")
+
+
+def escape_label_value(value: str) -> str:
+    """Exposition-format label escaping: ``\\`` then ``"`` then LF."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def format_labels(labels: Sequence[Tuple[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels
+    )
+    return "{" + inner + "}"
+
+
+class Histogram:
+    """One histogram family with optional labels.
+
+    ``observe`` is thread-safe (bench and executor threads record into
+    it); ``render`` emits the full family — ``# TYPE`` first, one
+    cumulative bucket series per label set, ``+Inf`` always present and
+    equal to ``_count``.
+    """
+
+    # backstop against label-cardinality explosions: past this many
+    # distinct label sets, new ones fold into a sentinel series so a
+    # misbehaving caller can bloat neither memory nor the scrape
+    MAX_SERIES = 1024
+    OVERFLOW_LABEL = "_other"
+
+    def __init__(
+        self,
+        name: str,
+        buckets: Sequence[float] = DURATION_BUCKETS,
+        label_names: Sequence[str] = (),
+    ):
+        self.name = name
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+        # label values tuple -> (bucket counts list, sum, count)
+        self._series: Dict[
+            Tuple[str, ...], List
+        ] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(
+            str(labels.get(name, "")) for name in self.label_names
+        )
+        with self._mu:
+            series = self._series.get(key)
+            if series is None and len(self._series) >= self.MAX_SERIES:
+                key = tuple(
+                    self.OVERFLOW_LABEL for _ in self.label_names
+                )
+                series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = series
+            counts, _, _ = series
+            placed = False
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+                    placed = True
+                    break
+            if not placed:
+                counts[-1] += 1          # +Inf bucket
+            series[1] += value
+            series[2] += 1
+
+    def snapshot(
+        self,
+    ) -> Dict[Tuple[str, ...], Tuple[List[Tuple[float, int]], float, int]]:
+        """label values -> (cumulative (upper_bound, count) pairs
+        including +Inf, sum, count)."""
+        out = {}
+        with self._mu:
+            items = [
+                (k, (list(v[0]), v[1], v[2]))
+                for k, v in self._series.items()
+            ]
+        for key, (counts, total, count) in items:
+            cum, acc = [], 0
+            for ub, c in zip(self.buckets + (_INF,), counts):
+                acc += c
+                cum.append((ub, acc))
+            out[key] = (cum, total, count)
+        return out
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Estimated quantile via linear interpolation within the
+        bucket (the same estimate PromQL's histogram_quantile makes).
+        None when the (labeled) series has no observations."""
+        key = tuple(
+            str(labels.get(name, "")) for name in self.label_names
+        )
+        snap = self.snapshot().get(key)
+        if snap is None or snap[2] == 0:
+            return None
+        cum, _total, count = snap
+        rank = q * count
+        prev_ub, prev_cum = 0.0, 0
+        for ub, c in cum:
+            if c >= rank:
+                if ub == _INF:
+                    return prev_ub
+                if c == prev_cum:
+                    return ub
+                frac = (rank - prev_cum) / (c - prev_cum)
+                return prev_ub + (ub - prev_ub) * frac
+            prev_ub, prev_cum = ub, c
+        return prev_ub
+
+    def render(self) -> List[str]:
+        lines = [f"# TYPE {self.name} histogram"]
+        for key, (cum, total, count) in sorted(
+            self.snapshot().items()
+        ):
+            base_labels = list(zip(self.label_names, key))
+            for ub, c in cum:
+                le = "+Inf" if ub == _INF else repr(ub)
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{format_labels(base_labels + [('le', le)])} {c}"
+                )
+            lines.append(
+                f"{self.name}_sum{format_labels(base_labels)} "
+                f"{total:.6f}"
+            )
+            lines.append(
+                f"{self.name}_count{format_labels(base_labels)} {count}"
+            )
+        return lines
+
+
+class MetricsRegistry:
+    """Named histograms for one component (server / worker): creation
+    is idempotent so call sites can resolve by name without import-time
+    ordering concerns."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._hists: Dict[str, Histogram] = {}
+
+    def histogram(
+        self,
+        name: str,
+        buckets: Sequence[float] = DURATION_BUCKETS,
+        label_names: Sequence[str] = (),
+    ) -> Histogram:
+        with self._mu:
+            h = self._hists.get(name)
+            if h is None:
+                h = Histogram(
+                    name, buckets=buckets, label_names=label_names
+                )
+                self._hists[name] = h
+            return h
+
+    def render_lines(self) -> List[str]:
+        with self._mu:
+            hists = sorted(self._hists.items())
+        lines: List[str] = []
+        for _, h in hists:
+            lines.extend(h.render())
+        return lines
+
+
+_REGISTRIES: Dict[str, MetricsRegistry] = {}
+_REGISTRIES_MU = threading.Lock()
+
+
+def get_registry(component: str) -> MetricsRegistry:
+    """Process-global registry per component. Server and worker keep
+    separate registries because in embedded-worker mode both live in
+    one process but scrape on different ports — each /metrics must
+    serve only its own families."""
+    with _REGISTRIES_MU:
+        reg = _REGISTRIES.get(component)
+        if reg is None:
+            reg = MetricsRegistry()
+            _REGISTRIES[component] = reg
+        return reg
+
+
+def slow_call_lines(stats=None) -> List[str]:
+    """Render utils/profiling.CallStats as gpustack_slow_call_* series
+    (count/total/max per decorated call site)."""
+    if stats is None:
+        from gpustack_tpu.utils.profiling import STATS as stats  # noqa: N813
+
+    snap = stats.snapshot()
+    if not snap:
+        return []
+
+    def type_line(family: str) -> str:
+        # TYPE text derives from the declared vocabulary — exactly one
+        # declaration site for the metrics-drift analyzer to read
+        return f"# TYPE {family} {METRIC_FAMILIES[family]}"
+
+    lines = [type_line("gpustack_slow_call_count")]
+    for name in sorted(snap):
+        labels = format_labels([("name", name)])
+        lines.append(
+            f"gpustack_slow_call_count{labels} "
+            f"{int(snap[name]['count'])}"
+        )
+    lines.append(type_line("gpustack_slow_call_seconds_total"))
+    for name in sorted(snap):
+        labels = format_labels([("name", name)])
+        lines.append(
+            f"gpustack_slow_call_seconds_total{labels} "
+            f"{snap[name]['total_s']:.6f}"
+        )
+    lines.append(type_line("gpustack_slow_call_max_seconds"))
+    for name in sorted(snap):
+        labels = format_labels([("name", name)])
+        lines.append(
+            f"gpustack_slow_call_max_seconds{labels} "
+            f"{snap[name]['max_s']:.6f}"
+        )
+    return lines

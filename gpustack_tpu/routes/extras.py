@@ -651,6 +651,81 @@ def add_extra_routes(app: web.Application) -> None:
         return web.json_response(await control_plane_snapshot(bound))
 
     app.router.add_get("/v2/debug/invariants", debug_invariants)
+
+    async def debug_traces(request: web.Request):
+        """Recent request traces from the in-memory ring
+        (observability/tracing.py): per-phase spans for every hop this
+        process served — the server's auth/schedule/connect/ttft/stream
+        decomposition, plus (embedded-worker mode) the worker relay's
+        spans. Filterable by trace id / model / minimum duration.
+        Admin-only."""
+        from gpustack_tpu.observability import tracing
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.utils.profiling import STATS
+
+        if err := require_admin(request):
+            return err
+        trace_id = request.query.get("trace_id", "").strip().lower()
+        model = request.query.get("model", "")
+        try:
+            min_ms = float(request.query.get("min_duration_ms", 0))
+            limit = min(200, int(request.query.get("limit", 50)))
+        except ValueError:
+            return json_error(
+                400, "min_duration_ms/limit must be numbers"
+            )
+        components = request.query.get("component", "")
+        wanted = (
+            [c for c in components.split(",") if c]
+            or tracing.store_components()
+        )
+        items = []
+        for component in wanted:
+            items.extend(
+                tracing.get_store(component).query(
+                    trace_id=trace_id, model=model,
+                    min_duration_ms=min_ms, limit=limit,
+                )
+            )
+        items.sort(key=lambda e: e.get("started_at", 0.0), reverse=True)
+        return web.json_response(
+            {
+                "items": items[:limit],
+                "components": tracing.store_components(),
+                # slow-call accounting (utils/profiling @timed sites)
+                # rides along: one triage endpoint for "where is the
+                # time going" questions
+                "slow_calls": STATS.snapshot(),
+            }
+        )
+
+    app.router.add_get("/v2/debug/traces", debug_traces)
+
+    async def instance_timeline(request: web.Request):
+        """Lifecycle timeline for one instance: how long it sat in each
+        state (fed by the lossless bus tap — observability/lifecycle.py).
+        Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
+        instance_id = int(request.match_info["id"])
+        tracker = request.app.get("lifecycle")
+        if tracker is None:
+            return json_error(503, "lifecycle tracker not running")
+        timeline = tracker.timeline(instance_id)
+        if timeline is None:
+            # the row may exist but predate this server's tap
+            if await ModelInstance.get(instance_id) is None:
+                return json_error(404, "instance not found")
+            return web.json_response(
+                {"instance_id": instance_id, "entries": []}
+            )
+        return web.json_response(timeline)
+
+    app.router.add_get(
+        "/v2/model-instances/{id:\\d+}/timeline", instance_timeline
+    )
     app.router.add_get("/v2/config/reload", reload_config)
     app.router.add_post("/v2/config/reload", reload_config)
     app.router.add_get("/v2/model-catalog", catalog)

@@ -164,6 +164,7 @@ async def _instance_fetch(
     json_body=None,
     raw_body: bytes = b"",
     content_type: str = "",
+    trace=None,
 ):
     """Dial one of the model's RUNNING replicas with failover.
 
@@ -180,8 +181,15 @@ async def _instance_fetch(
     reg = app["resilience"]
     retry_after = reg.try_shed(model.id)
     if retry_after is not None:
+        if trace is not None:
+            trace.event("shed", retry_after=retry_after)
         return None, _shed_response(model.name, retry_after)
 
+    if trace is not None:
+        # "connect" spans replica pick through upstream HEADERS —
+        # including failed dials and inter-attempt backoff, so a
+        # failover-heavy request shows its cost here, not hidden in ttft
+        trace.begin("connect")
     loop = asyncio.get_running_loop()
     deadline = loop.time() + reg.failover_deadline
     candidates = reg.order(instances)[: reg.failover_attempts]
@@ -217,6 +225,12 @@ async def _instance_fetch(
             continue
         reg.begin(model.id, inst.id)
         handed_off = False
+        hop_headers = None
+        if trace is not None:
+            # propagate THIS hop's span id: the worker hop's parent_id
+            # then points at a span that actually exists in the store,
+            # so the cross-hop tree reconstructs from /v2/debug/traces
+            hop_headers = trace.ctx.propagation_headers()
         try:
             try:
                 # wait_for is a HANG guard on time-to-headers only, and
@@ -235,6 +249,7 @@ async def _instance_fetch(
                         json_body=json_body,
                         raw_body=raw_body,
                         content_type=content_type,
+                        extra_headers=hop_headers,
                     ),
                     timeout=reg.headers_timeout,
                 )
@@ -242,6 +257,11 @@ async def _instance_fetch(
                 aiohttp.ClientError, asyncio.TimeoutError, OSError
             ) as e:
                 reg.record_failure(inst.id)
+                if trace is not None:
+                    trace.event(
+                        "dial_failed", instance_id=inst.id,
+                        error=str(e) or type(e).__name__,
+                    )
                 errors.append(
                     f"{inst.name}: {str(e) or type(e).__name__}"
                 )
@@ -251,6 +271,13 @@ async def _instance_fetch(
                 and upstream.headers.get("X-GPUStack-Worker")
                 == "instance-not-running"
             )
+            if (upstream.status >= 500 or stale_routing) and (
+                trace is not None
+            ):
+                trace.event(
+                    "dial_failed", instance_id=inst.id,
+                    error=f"HTTP {upstream.status}",
+                )
             if upstream.status >= 500 or stale_routing:
                 # replica-side failure with no bytes relayed yet:
                 # count against the breaker, move on. A 404 fails over
@@ -272,6 +299,10 @@ async def _instance_fetch(
                 continue
             reg.record_success(inst.id)
             handed_off = True
+            if trace is not None:
+                trace.end(
+                    "connect", instance_id=inst.id, attempts=tried
+                )
             return (
                 _TrackedResponse(
                     upstream,
@@ -289,6 +320,8 @@ async def _instance_fetch(
             if not handed_off:
                 reg.end(model.id, inst.id)
                 reg.abort_probe(inst.id)
+    if trace is not None:
+        trace.end("connect", failed=True, attempts=tried)
     if not errors:
         # nothing was even dialable: every breaker open inside its window
         wait = reg.seconds_until_any_probe(instances)
@@ -498,9 +531,22 @@ def add_openai_routes(app: web.Application) -> None:
         name = body.get("model")
         if not name:
             return json_error(400, "missing 'model'")
+        trace = request.get("trace")
+        if trace is not None:
+            # "schedule": route resolution + replica-set lookup — the
+            # queue-wait analogue of this gateway (admission happens in
+            # _instance_fetch's shed check)
+            trace.begin("schedule")
         target, err = await _resolve_target(request, str(name))
+        if trace is not None:
+            trace.end("schedule")
         if err is not None:
             return err
+        if trace is not None:
+            # model set only AFTER resolution: resolved names are
+            # operator-defined (bounded); labeling the raw client
+            # string would let junk names grow metric series forever
+            trace.model = str(name)
         stream = bool(body.get("stream"))
         suppress_usage_chunk = False
         if isinstance(target, ProviderTarget):
@@ -540,12 +586,20 @@ def add_openai_routes(app: web.Application) -> None:
                     f"/proxy/instances/{inst.id}/v1/{operation}"
                 ),
                 json_body=body,
+                trace=trace,
             )
             if err is not None:
                 return err
 
         if not stream:
+            # ttft here is headers→full body: a non-streaming
+            # generation sends headers only when the body is ready, so
+            # the read is the generation wait
+            if trace is not None:
+                trace.begin("ttft")
             payload_bytes = await upstream.read()
+            if trace is not None:
+                trace.end("ttft")
             try:
                 payload = json.loads(payload_bytes)
                 pt, ct = _extract_usage(payload)
@@ -573,23 +627,35 @@ def add_openai_routes(app: web.Application) -> None:
             )
 
         # SSE relay: forward chunks unbuffered; sniff usage from data lines.
+        sse_headers = {
+            "Content-Type": upstream.headers.get(
+                "Content-Type", "text/event-stream"
+            ),
+            "Cache-Control": "no-cache",
+        }
+        if trace is not None:
+            # streamed responses prepare() before the middleware can
+            # stamp these — set them on the response headers now
+            sse_headers.update(trace.ctx.propagation_headers())
         resp = web.StreamResponse(
-            status=upstream.status,
-            headers={
-                "Content-Type": upstream.headers.get(
-                    "Content-Type", "text/event-stream"
-                ),
-                "Cache-Control": "no-cache",
-            },
+            status=upstream.status, headers=sse_headers,
         )
         usage_tokens: List[int] = [0, 0]
         buffer = b""
         skip_blank = False  # swallow the blank line after a dropped event
+        first_chunk = True
+        if trace is not None:
+            trace.begin("ttft")
         try:
             # prepare inside the guard: a client gone before headers
             # must still release the upstream (and its outstanding slot)
             await resp.prepare(request)
             async for chunk in upstream.content.iter_any():
+                if first_chunk:
+                    first_chunk = False
+                    if trace is not None:
+                        trace.end("ttft")
+                        trace.begin("stream")
                 buffer += chunk
                 while b"\n" in buffer:
                     line, buffer = buffer.split(b"\n", 1)
@@ -623,6 +689,8 @@ def add_openai_routes(app: web.Application) -> None:
         except (ConnectionResetError, aiohttp.ClientError):
             logger.info("client or upstream dropped during stream relay")
         finally:
+            if trace is not None:
+                trace.end("stream")
             upstream.release()
         if usage_tokens[0] or usage_tokens[1]:
             await _record_usage(
@@ -655,9 +723,16 @@ def add_openai_routes(app: web.Application) -> None:
             return json_error(400, "missing 'model' form field")
         if not wav:
             return json_error(400, "missing 'file' form field")
+        trace = request.get("trace")
+        if trace is not None:
+            trace.begin("schedule")
         target, err = await _resolve_target(request, name)
+        if trace is not None:
+            trace.end("schedule")
         if err is not None:
             return err
+        if trace is not None:
+            trace.model = name       # resolved: bounded cardinality
         if isinstance(target, ProviderTarget):
             model_id, provider_id = 0, target.provider.id
             # the upstream needs the provider's model name as a form field
@@ -704,10 +779,15 @@ def add_openai_routes(app: web.Application) -> None:
                 lambda inst: f"/proxy/instances/{inst.id}/v1/{op}",
                 raw_body=raw,
                 content_type=ctype,
+                trace=trace,
             )
             if err is not None:
                 return err
+        if trace is not None:
+            trace.begin("ttft")
         payload = await upstream.read()
+        if trace is not None:
+            trace.end("ttft")
         upstream.release()
         if upstream.status == 200:
             # usage row per transcription: token fields are zero (audio
@@ -733,9 +813,16 @@ def add_openai_routes(app: web.Application) -> None:
         name = (body.get("model") or "").strip()
         if not name:
             return json_error(400, "missing 'model'")
+        trace = request.get("trace")
+        if trace is not None:
+            trace.begin("schedule")
         target, err = await _resolve_target(request, name)
+        if trace is not None:
+            trace.end("schedule")
         if err is not None:
             return err
+        if trace is not None:
+            trace.model = name       # resolved: bounded cardinality
         if isinstance(target, ProviderTarget):
             body["model"] = target.upstream_model
             model_id, provider_id = 0, target.provider.id
@@ -754,10 +841,15 @@ def add_openai_routes(app: web.Application) -> None:
                     f"/proxy/instances/{inst.id}/v1/audio/speech"
                 ),
                 json_body=body,
+                trace=trace,
             )
             if err is not None:
                 return err
+        if trace is not None:
+            trace.begin("ttft")
         payload = await upstream.read()
+        if trace is not None:
+            trace.end("ttft")
         upstream.release()
         if upstream.status == 200:
             await _record_usage(

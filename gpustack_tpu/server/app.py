@@ -41,11 +41,22 @@ logger = logging.getLogger(__name__)
 
 
 def create_app(cfg: Config) -> web.Application:
+    # timing (the trace edge) is OUTERMOST so auth latency and auth
+    # failures are traced and every response — 401s included — carries
+    # X-Request-ID
     app = web.Application(
-        middlewares=[auth_middleware, timing_middleware],
+        middlewares=[timing_middleware, auth_middleware],
         client_max_size=64 * 2**20,
     )
     app["config"] = cfg
+
+    from gpustack_tpu.observability import LifecycleTracker, tracing
+
+    tracing.get_store("server").configure(cfg.trace_ring_size)
+    # embedded-worker mode shares this process: size its ring too (a
+    # standalone worker sizes it from its own cfg in WorkerServer)
+    tracing.get_store("worker").configure(cfg.trace_ring_size)
+    app["lifecycle"] = LifecycleTracker("server")
 
     async def healthz(request):
         payload = {"status": "ok"}
@@ -604,6 +615,17 @@ def create_app(cfg: Config) -> web.Application:
         import asyncio as _asyncio
 
         app["proxy_session"] = aiohttp.ClientSession()
+        # lifecycle timelines ride the lossless bus tap (same mechanism
+        # as the chaos harness's invariant observer) — attached here,
+        # after the ORM layer is bound to its bus
+        from gpustack_tpu.orm.record import Record
+
+        try:
+            app["lifecycle"].attach(Record.bus())
+        except Exception as e:
+            # an app mounted without a bound Record (bare unit-test
+            # mounts) simply runs without timelines
+            logger.warning("lifecycle tracker not attached: %s", e)
         # feed the health view from instance/worker lifecycle events
         # (heartbeat staleness → worker UNREACHABLE → breakers trip
         # without waiting for request traffic to fail)
@@ -628,6 +650,9 @@ def create_app(cfg: Config) -> web.Application:
     async def on_cleanup(app: web.Application):
         import asyncio as _asyncio
 
+        tracker = app.get("lifecycle")
+        if tracker is not None:
+            tracker.detach()
         watch = app.get("resilience_watch")
         if watch is not None:
             watch.cancel()

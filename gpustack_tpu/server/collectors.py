@@ -21,6 +21,7 @@ from typing import Dict, Optional, Tuple
 from gpustack_tpu.orm.record import Record, register_record
 from gpustack_tpu.schemas import Worker, WorkerState
 from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.utils.profiling import timed
 
 logger = logging.getLogger(__name__)
 
@@ -95,6 +96,7 @@ class WorkerStatusBuffer(PeriodicTask):
     async def tick(self) -> None:
         await self.flush()
 
+    @timed(threshold_s=2.0, name="collectors.status_buffer_flush")
     async def flush(self) -> int:
         pending, self._pending = self._pending, {}
         flushed = 0
@@ -245,6 +247,7 @@ class SystemLoadCollector(PeriodicTask):
         await _prune_old(SystemLoad, self.RETENTION_DAYS)
         await ResourceEventLogger.prune()
 
+    @timed(threshold_s=5.0, name="collectors.system_load_sweep")
     async def collect_once(self) -> SystemLoad:
         from gpustack_tpu.policies.allocatable import CLAIMING_STATES
         from gpustack_tpu.schemas import ModelInstance
@@ -314,6 +317,7 @@ class UsageArchiver(PeriodicTask):
 
     BATCH = 10_000
 
+    @timed(threshold_s=30.0, name="collectors.usage_archive_sweep")
     async def archive_once(self) -> int:
         """Aggregate hot rows older than retention into daily archive
         rows; delete the hot rows. Returns rows archived.

@@ -30,6 +30,7 @@ from gpustack_tpu.schemas import (
     WorkerState,
 )
 from gpustack_tpu.server.bus import Event, EventType
+from gpustack_tpu.utils.profiling import timed
 
 logger = logging.getLogger(__name__)
 
@@ -166,6 +167,7 @@ class ModelController(Controller):
         await self._sync_replicas(model)
         await self._ensure_route(model)
 
+    @timed(threshold_s=5.0, name="controllers.replica_sync")
     async def _sync_replicas(self, model: Model) -> None:
         instances = await ModelInstance.filter(model_id=model.id)
         want = max(0, model.replicas)
@@ -602,6 +604,7 @@ class WorkerSyncer:
                 logger.exception("worker sync failed")
             await asyncio.sleep(self.interval)
 
+    @timed(threshold_s=5.0, name="controllers.worker_sync_scan")
     async def sync_once(self) -> None:
         now = datetime.datetime.now(datetime.timezone.utc)
         for worker in await Worker.filter(state=WorkerState.READY):
@@ -678,6 +681,7 @@ class InstanceRescuer:
                 logger.exception("instance rescue scan failed")
             await asyncio.sleep(self.interval)
 
+    @timed(threshold_s=5.0, name="controllers.rescuer_scan")
     async def sync_once(self) -> None:
         now = datetime.datetime.now(datetime.timezone.utc)
         # one worker prefetch per scan, shared by every sweep (this

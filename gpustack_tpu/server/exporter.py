@@ -113,6 +113,18 @@ def add_metrics_route(app: web.Application) -> None:
         registry = request.app.get("resilience")
         if registry is not None:
             text += "\n".join(registry.metrics_lines()) + "\n"
+        # observability histograms (per-phase request latency, instance
+        # time-in-state) + slow-call stats (utils/profiling.CallStats,
+        # recorded by @timed call sites) — in-memory, appended uncached
+        from gpustack_tpu.observability.metrics import (
+            get_registry,
+            slow_call_lines,
+        )
+
+        obs_lines = get_registry("server").render_lines()
+        obs_lines += slow_call_lines()
+        if obs_lines:
+            text += "\n".join(obs_lines) + "\n"
         return web.Response(text=text)
 
     app.router.add_get("/metrics", metrics)

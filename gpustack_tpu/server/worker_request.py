@@ -88,6 +88,7 @@ async def worker_fetch(
     connect_timeout: Optional[float] = None,
     control: bool = False,
     allow_federation: bool = True,
+    extra_headers: Optional[Dict[str, str]] = None,
 ):
     """Send an authenticated request to a worker; returns a response
     adapter (.status/.headers/.content.iter_any()/.read()/.release()).
@@ -124,6 +125,10 @@ async def worker_fetch(
         )
 
     headers: Dict[str, str] = {}
+    if extra_headers:
+        # trace propagation (traceparent / X-Request-ID) — merged first
+        # so protocol headers below always win
+        headers.update(extra_headers)
     if worker.proxy_secret:
         headers["Authorization"] = f"Bearer {worker.proxy_secret}"
     body = b""

@@ -1,0 +1,218 @@
+"""Strict Prometheus text-format (exposition 0.0.4) parser for tests.
+
+The exporters are hand-built string emitters, so nothing at runtime
+guarantees the wire format is parseable by a real scraper. This parser
+is deliberately STRICTER than Prometheus itself and raises
+:class:`ExpositionError` on anything a hand-rolled emitter typically
+gets wrong:
+
+- a sample line that does not fully parse (unquoted/unescaped label
+  values, trailing garbage, non-numeric value);
+- a ``# TYPE`` repeated for the same family, appearing AFTER the
+  family's first sample, or naming an invalid kind;
+- histogram family violations: non-cumulative ``_bucket`` counts, a
+  missing ``+Inf`` bucket, ``+Inf`` != ``_count``, missing
+  ``_sum``/``_count`` series.
+
+Untyped samples are allowed (the worker relays engine metrics without
+re-declaring them) — but once a family IS declared, its declaration
+must precede its samples.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+VALID_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_TYPE_RE = re.compile(rf"^#\s*TYPE\s+({_NAME})\s+(\S+)\s*$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(\{{(.*)\}})?\s+(-?[0-9.eE+\-]+|NaN|[+-]Inf)"
+    r"(\s+-?[0-9]+)?\s*$"
+)
+_LABEL_RE = re.compile(rf'({_NAME})="((?:[^"\\\n]|\\["\\n])*)"')
+
+
+class ExpositionError(AssertionError):
+    pass
+
+
+class Sample:
+    __slots__ = ("name", "labels", "value", "line_no")
+
+    def __init__(self, name, labels, value, line_no):
+        self.name = name
+        self.labels = labels
+        self.value = value
+        self.line_no = line_no
+
+
+def _parse_labels(raw: str, line_no: int, line: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            raise ExpositionError(
+                f"line {line_no}: malformed label pair at char {pos} "
+                f"in: {line!r}"
+            )
+        if m.group(1) in labels:
+            raise ExpositionError(
+                f"line {line_no}: duplicate label {m.group(1)!r} "
+                f"in: {line!r}"
+            )
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                raise ExpositionError(
+                    f"line {line_no}: expected ',' between labels "
+                    f"in: {line!r}"
+                )
+            pos += 1
+    return labels
+
+
+def _family_of(name: str) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def parse_exposition(
+    text: str,
+) -> Tuple[List[Sample], Dict[str, str]]:
+    """Parse strictly; returns (samples, {family: kind}). Raises
+    :class:`ExpositionError` on any format violation."""
+    samples: List[Sample] = []
+    types: Dict[str, str] = {}
+    seen_sample_families = set()
+    for line_no, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m is None:
+                if re.match(r"^#\s*TYPE\b", line):
+                    raise ExpositionError(
+                        f"line {line_no}: malformed TYPE line: {line!r}"
+                    )
+                continue           # HELP/comment lines pass through
+            name, kind = m.groups()
+            if kind not in VALID_KINDS:
+                raise ExpositionError(
+                    f"line {line_no}: invalid TYPE kind {kind!r} "
+                    f"for {name}"
+                )
+            if name in types:
+                raise ExpositionError(
+                    f"line {line_no}: duplicate TYPE declaration "
+                    f"for {name}"
+                )
+            if name in seen_sample_families:
+                raise ExpositionError(
+                    f"line {line_no}: TYPE for {name} appears after "
+                    f"its first sample"
+                )
+            types[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ExpositionError(
+                f"line {line_no}: unparseable sample line: {line!r}"
+            )
+        name, braces, raw_labels, value, _ts = m.groups()
+        labels = (
+            _parse_labels(raw_labels, line_no, line) if braces else {}
+        )
+        try:
+            val = float(value)
+        except ValueError:
+            raise ExpositionError(
+                f"line {line_no}: non-numeric value {value!r}"
+            ) from None
+        samples.append(Sample(name, labels, val, line_no))
+        seen_sample_families.add(_family_of(name))
+        seen_sample_families.add(name)
+    return samples, types
+
+
+def check_histograms(
+    samples: List[Sample], types: Dict[str, str]
+) -> None:
+    """Per declared histogram family and label set: buckets cumulative,
+    ``+Inf`` present and equal to ``_count``, ``_sum`` present."""
+    for family, kind in types.items():
+        if kind != "histogram":
+            continue
+        # group by the non-le label set
+        buckets: Dict[Tuple, List[Tuple[float, float]]] = {}
+        counts: Dict[Tuple, float] = {}
+        sums: Dict[Tuple, float] = {}
+        for s in samples:
+            base_key = tuple(sorted(
+                (k, v) for k, v in s.labels.items() if k != "le"
+            ))
+            if s.name == family + "_bucket":
+                le = s.labels.get("le")
+                if le is None:
+                    raise ExpositionError(
+                        f"line {s.line_no}: {s.name} sample without "
+                        f"an 'le' label"
+                    )
+                ub = math.inf if le == "+Inf" else float(le)
+                buckets.setdefault(base_key, []).append((ub, s.value))
+            elif s.name == family + "_count":
+                counts[base_key] = s.value
+            elif s.name == family + "_sum":
+                sums[base_key] = s.value
+        for key, series in buckets.items():
+            ordered = sorted(series, key=lambda p: p[0])
+            last = -1.0
+            for ub, cum in ordered:
+                if cum < last:
+                    raise ExpositionError(
+                        f"{family}{dict(key)}: bucket le={ub} count "
+                        f"{cum} < previous {last} (not cumulative)"
+                    )
+                last = cum
+            if not ordered or ordered[-1][0] != math.inf:
+                raise ExpositionError(
+                    f"{family}{dict(key)}: no +Inf bucket"
+                )
+            if key not in counts:
+                raise ExpositionError(
+                    f"{family}{dict(key)}: missing _count series"
+                )
+            if key not in sums:
+                raise ExpositionError(
+                    f"{family}{dict(key)}: missing _sum series"
+                )
+            if ordered[-1][1] != counts[key]:
+                raise ExpositionError(
+                    f"{family}{dict(key)}: +Inf bucket "
+                    f"{ordered[-1][1]} != _count {counts[key]}"
+                )
+
+
+def assert_well_formed(
+    text: str, require_histograms: Optional[List[str]] = None
+) -> Tuple[List[Sample], Dict[str, str]]:
+    """One-call strict validation; optionally require specific
+    histogram families to be declared AND populated."""
+    samples, types = parse_exposition(text)
+    check_histograms(samples, types)
+    for family in require_histograms or ():
+        if types.get(family) != "histogram":
+            raise ExpositionError(
+                f"{family} is not declared as a histogram "
+                f"(declared: {types.get(family)!r})"
+            )
+        if not any(s.name == family + "_count" for s in samples):
+            raise ExpositionError(f"{family} has no samples")
+    return samples, types

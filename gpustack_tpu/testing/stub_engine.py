@@ -62,7 +62,11 @@ def build_app(
     fail_health_after: float = 0.0,
     token_delay: float = 0.0,
 ) -> web.Application:
-    app = web.Application()
+    from gpustack_tpu.observability.tracing import trace_middleware
+
+    # same trace hop contract as the real engine (engine/api_server.py):
+    # hermetic e2es assert the full four-hop trace against this stub
+    app = web.Application(middlewares=[trace_middleware("engine")])
 
     async def health(_request):
         if fail_health_after and time.time() - START > fail_health_after:

@@ -1,0 +1,89 @@
+"""Reusable trace assertions for e2e and chaos suites.
+
+The trace smoke test (tests/e2e/test_trace_smoke.py) and any chaos-run
+postmortem share the same questions: did ONE trace id flow through
+every hop, and did each hop record the phases it owes? These helpers
+answer them from the two places traces land — log lines (``trace=…``)
+and the in-memory stores served at ``GET /v2/debug/traces``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+TRACE_ID_RE = re.compile(r"\btrace=([0-9a-f]{32})\b")
+COMPONENT_RE = re.compile(r"\bcomponent=([a-zA-Z_\-]+)\b")
+
+
+def trace_ids_in(lines: Iterable[str]) -> Set[str]:
+    ids: Set[str] = set()
+    for line in lines:
+        ids.update(TRACE_ID_RE.findall(line))
+    return ids
+
+
+def components_for_trace(
+    lines: Iterable[str], trace_id: str
+) -> Set[str]:
+    """Components whose hop log line carries this trace id."""
+    out: Set[str] = set()
+    for line in lines:
+        if trace_id not in line:
+            continue
+        m = COMPONENT_RE.search(line)
+        if m:
+            out.add(m.group(1))
+        elif line.lstrip().startswith("access ") or " access " in line:
+            out.add("server")
+    return out
+
+
+def assert_single_trace(
+    lines: Iterable[str],
+    expect_components: Sequence[str] = (),
+) -> str:
+    """Exactly one trace id across the given log lines, present in
+    every expected component's hop line. Returns the trace id."""
+    lines = list(lines)
+    ids = trace_ids_in(lines)
+    assert len(ids) == 1, (
+        f"expected exactly one trace id across hops, saw {sorted(ids)}"
+    )
+    trace_id = next(iter(ids))
+    seen = components_for_trace(lines, trace_id)
+    missing = [c for c in expect_components if c not in seen]
+    assert not missing, (
+        f"trace {trace_id} missing from hops {missing} "
+        f"(seen in: {sorted(seen)})"
+    )
+    return trace_id
+
+
+def find_trace(
+    items: List[Dict], trace_id: str, component: str = ""
+) -> Optional[Dict]:
+    """First /v2/debug/traces item matching trace id (and component)."""
+    for entry in items:
+        if entry.get("trace_id") != trace_id:
+            continue
+        if component and entry.get("component") != component:
+            continue
+        return entry
+    return None
+
+
+def assert_phases(entry: Dict, expected: Sequence[str]) -> None:
+    """Every expected phase appears in the trace entry's spans with a
+    non-negative duration."""
+    assert entry, "no trace entry"
+    spans = {p["phase"]: p for p in entry.get("spans", [])}
+    missing = [p for p in expected if p not in spans]
+    assert not missing, (
+        f"trace {entry.get('trace_id')} ({entry.get('component')}) "
+        f"missing phases {missing}; has {sorted(spans)}"
+    )
+    for name in expected:
+        assert spans[name]["duration_ms"] >= 0.0, (
+            f"phase {name} has negative duration"
+        )

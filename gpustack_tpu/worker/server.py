@@ -28,7 +28,16 @@ class WorkerServer:
     PUBLIC_PATHS = {"/healthz", "/metrics", "/metrics/raw"}
 
     def __init__(self, agent) -> None:
+        from gpustack_tpu.observability import tracing
+
         self.agent = agent
+        # standalone worker: size this hop's trace ring from the
+        # worker's own config (GPUSTACK_TPU_TRACE_RING_SIZE)
+        tracing.get_store("worker").configure(
+            int(getattr(
+                getattr(agent, "cfg", None), "trace_ring_size", 512
+            ))
+        )
         # body cap must dominate the hops it relays for (server app: 64
         # MiB, audio engine: 256 MiB) — the default 1 MiB would 413 every
         # real audio upload at this middle hop
@@ -92,7 +101,14 @@ class WorkerServer:
         """Authenticated reverse proxy to a local engine instance
         (reference routes/worker/proxy.py:200 model-name→port middleware;
         here instance-id→port — the server already resolved the model).
-        Engines bind to 127.0.0.1, so this is the only way in."""
+        Engines bind to 127.0.0.1, so this is the only way in.
+
+        This hop adopts the server's ``traceparent``, records its own
+        connect/ttft/stream spans (``gpustack_worker_request_duration_``
+        ``seconds`` on /metrics + one ``trace=…`` log line), and hands
+        a fresh child context to the engine."""
+        from gpustack_tpu.observability import tracing
+
         sm = self.agent.serve_manager
         if sm is None:
             return web.json_response({"error": "not ready"}, status=503)
@@ -115,6 +131,14 @@ class WorkerServer:
             k: v for k, v in request.headers.items()
             if k.lower() in ("content-type", "accept")
         }
+        trace = tracing.RequestTrace(
+            tracing.from_headers(request.headers),
+            "worker",
+            f"{request.method} /proxy/instances/{instance_id}/{tail}",
+        )
+        # forward THIS hop's span id so the engine's parent_id points
+        # at a recorded span (reconstructable cross-process tree)
+        headers.update(trace.ctx.propagation_headers())
         if self._proxy_session is None or self._proxy_session.closed:
             self._proxy_session = aiohttp.ClientSession()
         # counted over the WHOLE relay (headers through last stream
@@ -123,7 +147,9 @@ class WorkerServer:
         self._inflight[instance_id] = (
             self._inflight.get(instance_id, 0) + 1
         )
+        status = 502
         try:
+            trace.begin("connect")
             async with self._proxy_session.request(
                 request.method,
                 url,
@@ -131,25 +157,36 @@ class WorkerServer:
                 headers=headers,
                 timeout=aiohttp.ClientTimeout(total=600),
             ) as upstream:
+                trace.end("connect")
+                status = upstream.status
+                out_headers = {
+                    "Content-Type": upstream.headers.get(
+                        "Content-Type", "application/json"
+                    ),
+                    "Cache-Control": "no-cache",
+                }
+                out_headers.update(trace.ctx.propagation_headers())
                 resp = web.StreamResponse(
-                    status=upstream.status,
-                    headers={
-                        "Content-Type": upstream.headers.get(
-                            "Content-Type", "application/json"
-                        ),
-                        "Cache-Control": "no-cache",
-                    },
+                    status=upstream.status, headers=out_headers,
                 )
                 await resp.prepare(request)
+                trace.begin("ttft")
+                first = True
                 async for chunk in upstream.content.iter_any():
+                    if first:
+                        first = False
+                        trace.end("ttft")
+                        trace.begin("stream")
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
         except (aiohttp.ClientError, OSError) as e:
+            trace.event("engine_unreachable", error=str(e))
             return web.json_response(
                 {"error": f"engine unreachable: {e}"}, status=502
             )
         finally:
+            trace.finish(status=status, instance_id=instance_id)
             n = self._inflight.get(instance_id, 1) - 1
             if n <= 0:
                 self._inflight.pop(instance_id, None)
@@ -229,6 +266,11 @@ class WorkerServer:
                 f"gpustack_worker_drain_seconds_total "
                 f"{round(getattr(sm, 'drain_seconds_total', 0.0), 3)}",
             ]
+        # per-phase relay latency histograms (observability/metrics.py):
+        # connect/ttft/stream through this reverse proxy
+        from gpustack_tpu.observability.metrics import get_registry
+
+        lines.extend(get_registry("worker").render_lines())
         # normalized engine metrics: per-engine names mapped onto the
         # gpustack_tpu:* namespace (reference RuntimeMetricsAggregator +
         # metrics_config.yaml)

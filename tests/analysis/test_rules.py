@@ -484,6 +484,104 @@ class TestMetricsDrift:
             },
         ) == []
 
+    def test_metric_families_declare_vocabulary(self, tmp_path):
+        # a METRIC_FAMILIES histogram covers doc references to the
+        # family AND its _bucket/_sum/_count series
+        assert self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/obs.py": (
+                    "METRIC_FAMILIES = {\n"
+                    '    "gpustack_lat_seconds": "histogram",\n'
+                    "}\n"
+                ),
+                "docs/OBS.md": (
+                    "Alert on `gpustack_lat_seconds_bucket` and "
+                    "`gpustack_lat_seconds_count`.\n"
+                ),
+            },
+        ) == []
+
+    def test_metric_families_kind_conflict_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/obs.py": (
+                    "METRIC_FAMILIES = {\n"
+                    '    "gpustack_lat_seconds": "histogram",\n'
+                    "}\n"
+                ),
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_lat_seconds gauge"\n'
+                ),
+            },
+        )
+        assert any(
+            "declared" in f.message and "gpustack_lat_seconds" in f.message
+            for f in found
+        )
+
+    def test_metric_families_invalid_kind_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/obs.py": (
+                    "METRIC_FAMILIES = {\n"
+                    '    "gpustack_lat_seconds": "histogramm",\n'
+                    "}\n"
+                ),
+            },
+        )
+        assert any("is not one of" in f.message for f in found)
+
+    def test_histogram_series_part_declared_separately_fails(
+        self, tmp_path
+    ):
+        # the _bucket series of a declared histogram getting its own
+        # TYPE means three metrics drifting under one family's name
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_lat_seconds histogram"\n'
+                    'B = "# TYPE gpustack_lat_seconds_bucket gauge"\n'
+                ),
+            },
+        )
+        assert any(
+            "series of the declared histogram" in f.message
+            for f in found
+        )
+
+    def test_histogram_series_part_via_families_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/obs.py": (
+                    "METRIC_FAMILIES = {\n"
+                    '    "gpustack_lat_seconds": "histogram",\n'
+                    '    "gpustack_lat_seconds_count": "counter",\n'
+                    "}\n"
+                ),
+            },
+        )
+        assert any(
+            "series of the declared histogram" in f.message
+            for f in found
+        )
+
+    def test_unrelated_count_suffix_quiet(self, tmp_path):
+        # *_count with no declared base family is a plain counter, not
+        # a histogram series — must not fire
+        assert self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/exp.py": (
+                    'A = "# TYPE gpustack_worker_cpu_count gauge"\n'
+                ),
+            },
+        ) == []
+
     def test_metric_map_checks(self, tmp_path):
         found = self.fire(
             tmp_path,

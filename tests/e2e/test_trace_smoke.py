@@ -1,0 +1,233 @@
+"""Tier-1 trace smoke: one in-process request, a complete 4-hop trace.
+
+The full data-plane chain — client → server app (auth/proxy/failover)
+→ REAL worker reverse proxy (worker/server.py) → engine (the stub
+engine speaking the real engine's trace contract) — on loopback TCP,
+no TPUs, no subprocesses. Asserts the ISSUE 5 acceptance criteria:
+
+- a single trace id appears in every hop's structured log line;
+- `GET /v2/debug/traces` returns the server hop with
+  auth/schedule/connect/ttft/stream phases populated (plus the worker
+  and engine hop entries, since all hops share this process);
+- `/metrics` on server AND worker serve well-formed request-duration
+  histograms (strict text-format parse);
+- every response carries `X-Request-ID`.
+
+The helpers used here (gpustack_tpu/testing/traces.py, promtext.py)
+are the reusable assertion surface for chaos scenarios.
+"""
+
+import asyncio
+import logging
+from types import SimpleNamespace
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.testing import promtext
+from gpustack_tpu.testing.stub_engine import build_app as engine_app
+from gpustack_tpu.testing.traces import (
+    assert_phases,
+    assert_single_trace,
+    find_trace,
+)
+from gpustack_tpu.worker.server import WorkerServer
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+class _StubDetector:
+    def detect(self):
+        return SimpleNamespace(
+            cpu_count=1,
+            memory_total_bytes=1,
+            memory_used_bytes=0,
+            chips=[],
+        )
+
+
+async def _start_engine():
+    from aiohttp import web
+
+    runner = web.AppRunner(engine_app("traced-model"))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+    return runner, port
+
+
+async def _start_worker(tmp_path, instance_id, engine_port):
+    agent = SimpleNamespace(
+        serve_manager=SimpleNamespace(
+            running={instance_id: SimpleNamespace(port=engine_port)},
+            log_dir=str(tmp_path),
+        ),
+        proxy_secret="proxy-secret",
+        detector=_StubDetector(),
+        cfg=SimpleNamespace(cache_dir=str(tmp_path)),
+        worker_id=1,
+    )
+    ws = WorkerServer(agent)
+    port = await ws.start("127.0.0.1", 0)
+    return ws, port
+
+
+def test_trace_smoke_multihop(cfg, tmp_path, caplog):
+    async def go():
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        hdrs = {"Authorization": f"Bearer {token}"}
+        model = await Model.create(
+            Model(name="traced-model", preset="tiny")
+        )
+        engine_runner, engine_port = await _start_engine()
+        # instance row first (its id keys the worker's routing table)
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="traced-model-0", model_id=model.id,
+                model_name=model.name,
+                state=ModelInstanceState.RUNNING,
+            )
+        )
+        worker_server, worker_port = await _start_worker(
+            tmp_path, inst.id, engine_port
+        )
+        worker = await Worker.create(
+            Worker(
+                name="w0", ip="127.0.0.1", port=worker_port,
+                state=WorkerState.READY,
+                proxy_secret="proxy-secret",
+            )
+        )
+        await inst.update(worker_id=worker.id)
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            with caplog.at_level(logging.INFO):
+                resp = await client.post(
+                    "/v1/chat/completions",
+                    headers=hdrs,
+                    json={
+                        "model": "traced-model",
+                        "messages": [
+                            {"role": "user", "content": "hello trace"}
+                        ],
+                        "max_tokens": 8,
+                        "stream": True,
+                    },
+                )
+                body = await resp.text()
+            assert resp.status == 200, body
+            assert "data:" in body
+            # streamed responses carry the ids too (set pre-prepare)
+            assert resp.headers.get("X-Request-ID")
+
+            # --- one trace id across all hops' structured logs ------
+            lines = [
+                r.getMessage() for r in caplog.records
+                if "trace=" in r.getMessage()
+            ]
+            trace_id = assert_single_trace(
+                lines,
+                expect_components=["server", "worker", "engine"],
+            )
+            assert resp.headers["X-Request-ID"] == trace_id
+
+            # --- debug endpoint: phases populated per hop -----------
+            r = await client.get(
+                f"/v2/debug/traces?trace_id={trace_id}", headers=hdrs
+            )
+            assert r.status == 200, await r.text()
+            payload = await r.json()
+            items = payload["items"]
+            assert_phases(
+                find_trace(items, trace_id, component="server"),
+                ["auth", "schedule", "connect", "ttft", "stream"],
+            )
+            assert_phases(
+                find_trace(items, trace_id, component="worker"),
+                ["connect", "ttft", "stream"],
+            )
+            assert find_trace(items, trace_id, component="engine")
+
+            # a non-matching filter returns nothing
+            r = await client.get(
+                "/v2/debug/traces?trace_id=" + "0" * 32, headers=hdrs
+            )
+            assert (await r.json())["items"] == []
+
+            # --- histograms well-formed on both exporters -----------
+            r = await client.get("/metrics")
+            promtext.assert_well_formed(
+                await r.text(),
+                require_histograms=[
+                    "gpustack_request_duration_seconds"
+                ],
+            )
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{worker_port}/metrics"
+                ) as wr:
+                    promtext.assert_well_formed(
+                        await wr.text(),
+                        require_histograms=[
+                            "gpustack_worker_request_duration_seconds"
+                        ],
+                    )
+
+            # --- client-supplied X-Request-ID is adopted + echoed ---
+            r = await client.post(
+                "/v1/chat/completions",
+                headers={**hdrs, "X-Request-ID": "f" * 32},
+                json={
+                    "model": "traced-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                },
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Request-ID"] == "f" * 32
+            r = await client.get(
+                "/v2/debug/traces?trace_id=" + "f" * 32, headers=hdrs
+            )
+            assert (await r.json())["items"], (
+                "adopted request id must be queryable as the trace id"
+            )
+        finally:
+            await client.close()
+            await worker_server.stop()
+            await engine_runner.cleanup()
+
+    asyncio.run(go())

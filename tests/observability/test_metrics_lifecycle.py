@@ -1,0 +1,211 @@
+"""Unit coverage: histogram math/rendering/escaping, slow-call lines,
+and the lifecycle tracker's dwell accounting over real bus publishes."""
+
+import math
+
+from gpustack_tpu.observability.lifecycle import LifecycleTracker
+from gpustack_tpu.observability.metrics import (
+    Histogram,
+    MetricsRegistry,
+    escape_label_value,
+    slow_call_lines,
+)
+from gpustack_tpu.server.bus import Event, EventBus, EventType
+from gpustack_tpu.testing.promtext import (
+    assert_well_formed,
+    parse_exposition,
+)
+from gpustack_tpu.utils.profiling import CallStats
+
+
+class TestHistogram:
+    def test_buckets_cumulative_inf_equals_count(self):
+        h = Histogram("t_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = "\n".join(h.render()) + "\n"
+        samples, types = assert_well_formed(
+            text, require_histograms=["t_seconds"]
+        )
+        by_le = {
+            s.labels["le"]: s.value
+            for s in samples if s.name == "t_seconds_bucket"
+        }
+        assert by_le == {"0.1": 1, "1.0": 2, "10.0": 3, "+Inf": 4}
+        count = [s for s in samples if s.name == "t_seconds_count"]
+        assert count[0].value == 4
+        total = [s for s in samples if s.name == "t_seconds_sum"]
+        assert math.isclose(total[0].value, 55.55, rel_tol=1e-6)
+
+    def test_label_escaping_parses(self):
+        h = Histogram("lbl_seconds", buckets=(1.0,), label_names=("m",))
+        h.observe(0.5, m='we"ird\\name\nx')
+        text = "\n".join(h.render()) + "\n"
+        samples, _ = assert_well_formed(text)
+        vals = {s.labels.get("m") for s in samples}
+        assert 'we\\"ird\\\\name\\nx' in vals
+
+    def test_quantile_interpolation(self):
+        h = Histogram("q_seconds", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v)
+        # p50 rank=2 lands at the 2.0 bucket boundary region
+        assert 1.0 <= h.quantile(0.5) <= 2.0
+        assert h.quantile(0.99) <= 4.0
+        assert Histogram("empty_seconds").quantile(0.5) is None
+
+    def test_labeled_series_independent(self):
+        h = Histogram(
+            "s_seconds", buckets=(1.0,), label_names=("phase",)
+        )
+        h.observe(0.1, phase="a")
+        h.observe(0.2, phase="a")
+        h.observe(0.3, phase="b")
+        snap = h.snapshot()
+        assert snap[("a",)][2] == 2
+        assert snap[("b",)][2] == 1
+
+    def test_registry_idempotent(self):
+        reg = MetricsRegistry()
+        a = reg.histogram("one_seconds")
+        b = reg.histogram("one_seconds")
+        assert a is b
+
+
+class TestSlowCallLines:
+    def test_render_and_parse(self):
+        stats = CallStats()
+        stats.record("scheduler.evaluate", 0.2)
+        stats.record("scheduler.evaluate", 1.4)
+        stats.record("collectors.sweep", 0.01)
+        text = "\n".join(slow_call_lines(stats)) + "\n"
+        samples, types = parse_exposition(text)
+        assert types["gpustack_slow_call_count"] == "counter"
+        counts = {
+            s.labels["name"]: s.value
+            for s in samples if s.name == "gpustack_slow_call_count"
+        }
+        assert counts == {
+            "scheduler.evaluate": 2, "collectors.sweep": 1,
+        }
+        maxes = {
+            s.labels["name"]: s.value
+            for s in samples
+            if s.name == "gpustack_slow_call_max_seconds"
+        }
+        assert math.isclose(maxes["scheduler.evaluate"], 1.4)
+
+    def test_empty_stats_render_nothing(self):
+        assert slow_call_lines(CallStats()) == []
+
+
+def _publish(bus, etype, iid, ts, data=None, changes=None):
+    bus.publish(
+        Event(
+            kind="model_instance", type=etype, id=iid,
+            data=data, changes=changes, ts=ts,
+        )
+    )
+
+
+class TestLifecycleTracker:
+    def test_dwell_measured_per_state(self):
+        bus = EventBus()
+        tracker = LifecycleTracker("lifecycle-test")
+        tracker.attach(bus)
+        _publish(
+            bus, EventType.CREATED, 1, 100.0,
+            data={"state": "pending", "name": "m-0"},
+        )
+        _publish(
+            bus, EventType.UPDATED, 1, 103.0,
+            data={"state": "scheduled", "name": "m-0"},
+            changes={"state": ("pending", "scheduled")},
+        )
+        _publish(
+            bus, EventType.UPDATED, 1, 110.5,
+            data={"state": "running", "name": "m-0"},
+            changes={"state": ("scheduled", "running")},
+        )
+        timeline = tracker.timeline(1)
+        assert timeline["name"] == "m-0"
+        states = [(e["state"], e["seconds"], e["to"])
+                  for e in timeline["entries"]]
+        assert states == [
+            ("pending", 3.0, "scheduled"),
+            ("scheduled", 7.5, "running"),
+        ]
+        assert timeline["current"]["state"] == "running"
+        tracker.detach()
+        assert bus._taps == []
+
+    def test_non_state_update_ignored(self):
+        bus = EventBus()
+        tracker = LifecycleTracker("lifecycle-test")
+        tracker.attach(bus)
+        _publish(
+            bus, EventType.CREATED, 2, 10.0, data={"state": "pending"}
+        )
+        _publish(
+            bus, EventType.UPDATED, 2, 20.0,
+            data={"state": "pending"},
+            changes={"state_message": ("", "waiting")},
+        )
+        assert tracker.timeline(2)["entries"] == []
+        tracker.detach()
+
+    def test_delete_closes_dwell(self):
+        bus = EventBus()
+        tracker = LifecycleTracker("lifecycle-test")
+        tracker.attach(bus)
+        _publish(
+            bus, EventType.CREATED, 3, 5.0, data={"state": "pending"}
+        )
+        _publish(bus, EventType.DELETED, 3, 9.0)
+        entries = tracker.timeline(3)["entries"]
+        assert entries[-1]["to"] == "deleted"
+        assert entries[-1]["seconds"] == 4.0
+        assert "current" not in tracker.timeline(3)
+        tracker.detach()
+
+    def test_adoption_mid_life_no_fabricated_dwell(self):
+        bus = EventBus()
+        tracker = LifecycleTracker("lifecycle-test")
+        tracker.attach(bus)
+        # first sighting is a transition (tracker attached late)
+        _publish(
+            bus, EventType.UPDATED, 4, 50.0,
+            data={"state": "running"},
+            changes={"state": ("starting", "running")},
+        )
+        entries = tracker.timeline(4)["entries"]
+        assert entries[0]["state"] == "starting"
+        assert entries[0]["seconds"] is None    # no invented duration
+        tracker.detach()
+
+    def test_dwell_histogram_feeds_metrics(self):
+        from gpustack_tpu.observability.metrics import get_registry
+
+        bus = EventBus()
+        tracker = LifecycleTracker("lifecycle-test")
+        tracker.attach(bus)
+        _publish(
+            bus, EventType.CREATED, 5, 0.0, data={"state": "pending"}
+        )
+        _publish(
+            bus, EventType.UPDATED, 5, 2.0,
+            data={"state": "scheduled"},
+            changes={"state": ("pending", "scheduled")},
+        )
+        text = "\n".join(
+            get_registry("lifecycle-test").render_lines()
+        ) + "\n"
+        samples, _ = assert_well_formed(
+            text, require_histograms=["gpustack_instance_state_seconds"]
+        )
+        assert any(
+            s.name == "gpustack_instance_state_seconds_count"
+            and s.labels.get("state") == "pending"
+            for s in samples
+        )
+        tracker.detach()

@@ -1,0 +1,195 @@
+"""Unit coverage for the tracing layer: context parsing/propagation,
+span collection, the bounded store, and the generic hop middleware."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.observability import tracing
+from gpustack_tpu.observability.tracing import (
+    RequestTrace,
+    TraceContext,
+    TraceStore,
+    from_headers,
+    parse_traceparent,
+)
+
+
+class TestContext:
+    def test_mint_roundtrip(self):
+        ctx = from_headers({})
+        assert len(ctx.trace_id) == 32
+        assert len(ctx.span_id) == 16
+        parsed = parse_traceparent(ctx.traceparent())
+        assert parsed is not None
+        assert parsed.trace_id == ctx.trace_id
+        # the receiving hop parents onto the sender's span
+        assert parsed.parent_id == ctx.span_id
+
+    def test_traceparent_adopted(self):
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        ctx = from_headers({"traceparent": tp})
+        assert ctx.trace_id == "ab" * 16
+        assert ctx.parent_id == "cd" * 8
+        assert ctx.span_id != ctx.parent_id
+
+    def test_all_zero_ids_rejected(self):
+        assert parse_traceparent(
+            "00-" + "1" * 32 + "-" + "1" * 16 + "-01"
+        ) is not None
+        assert (
+            parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01")
+            is None
+        )
+        assert (
+            parse_traceparent("00-" + "1" * 32 + "-" + "0" * 16 + "-01")
+            is None
+        )
+        assert parse_traceparent("garbage") is None
+
+    def test_request_id_adopted_hex32(self):
+        rid = "f" * 32
+        ctx = from_headers({"X-Request-ID": rid})
+        assert ctx.trace_id == rid
+        assert ctx.request_id == rid
+
+    def test_request_id_hashed_when_not_hex(self):
+        ctx = from_headers({"X-Request-ID": "my-req-0042"})
+        assert len(ctx.trace_id) == 32
+        assert ctx.request_id == "my-req-0042"
+        # deterministic: same id maps to the same trace
+        again = from_headers({"X-Request-ID": "my-req-0042"})
+        assert again.trace_id == ctx.trace_id
+
+    def test_garbage_request_id_ignored(self):
+        ctx = from_headers({"X-Request-ID": "bad id\nwith junk"})
+        assert ctx.request_id == ctx.trace_id
+
+    def test_child_keeps_trace_changes_span(self):
+        ctx = TraceContext("a" * 32)
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.parent_id == ctx.span_id
+        assert child.span_id != ctx.span_id
+
+
+class TestRequestTrace:
+    def test_phases_and_store(self):
+        store = tracing.get_store("unit-test")
+        ctx = TraceContext("b" * 32)
+        trace = RequestTrace(ctx, "unit-test", "POST /x", model="m")
+        trace.begin("auth")
+        trace.end("auth")
+        with trace.phase("connect", instance_id=7):
+            pass
+        trace.event("dial_failed", instance_id=9, error="boom")
+        ms = trace.finish(status=200, log=False)
+        assert ms >= 0.0
+        entry = store.query(trace_id=ctx.trace_id)[0]
+        assert [s["phase"] for s in entry["spans"]] == [
+            "auth", "connect",
+        ]
+        assert entry["outcome"] == "ok"
+        assert entry["events"][0]["event"] == "dial_failed"
+        assert entry["model"] == "m"
+
+    def test_finish_idempotent_and_closes_dangling(self):
+        ctx = TraceContext("c" * 32)
+        trace = RequestTrace(ctx, "unit-test", "GET /y")
+        trace.begin("stream")
+        trace.finish(status=500, log=False)
+        assert trace.finish(status=200, log=False) == 0.0
+        entry = tracing.get_store("unit-test").query(
+            trace_id=ctx.trace_id
+        )[0]
+        assert entry["outcome"] == "error"
+        span = entry["spans"][0]
+        assert span["phase"] == "stream"
+        assert span["attrs"]["truncated"] is True
+
+    def test_end_without_begin_is_noop(self):
+        trace = RequestTrace(
+            TraceContext("d" * 32), "unit-test", "GET /z"
+        )
+        trace.end("never-started")
+        assert trace.phases == []
+
+    def test_log_line_greppable(self):
+        ctx = TraceContext("e" * 32)
+        trace = RequestTrace(ctx, "unit-test", "GET /l")
+        trace.begin("ttft")
+        trace.end("ttft")
+        trace.finish(status=200, log=False)
+        entry = tracing.get_store("unit-test").query(
+            trace_id=ctx.trace_id
+        )[0]
+        line = RequestTrace.log_line(entry)
+        assert f"trace={ctx.trace_id}" in line
+        assert "ttft:" in line
+        assert "component=unit-test" in line
+
+
+class TestStore:
+    def test_bounded_and_filterable(self):
+        store = TraceStore(maxlen=3)
+        for i in range(5):
+            store.add(
+                {
+                    "trace_id": f"{i:032x}",
+                    "model": "m" if i % 2 else "n",
+                    "duration_ms": float(i * 100),
+                    "started_at": float(i),
+                }
+            )
+        assert len(store.query(limit=50)) == 3      # ring dropped 2
+        assert store.query(limit=50)[0]["trace_id"] == f"{4:032x}"
+        assert all(
+            e["model"] == "m" for e in store.query(model="m")
+        )
+        assert [
+            e["trace_id"] for e in store.query(min_duration_ms=400)
+        ] == [f"{4:032x}"]
+
+    def test_configure_preserves(self):
+        store = TraceStore(maxlen=10)
+        store.add({"trace_id": "x", "duration_ms": 1.0})
+        store.configure(5)
+        assert len(store.query()) == 1
+
+
+class TestMiddleware:
+    def test_hop_middleware_stamps_headers_and_records(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def go():
+            app = web.Application(
+                middlewares=[tracing.trace_middleware("mw-test")]
+            )
+
+            async def handler(request):
+                assert request["trace"] is not None
+                return web.json_response({"ok": True})
+
+            app.router.add_get("/x", handler)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                tp = "00-" + "9a" * 16 + "-" + "7b" * 8 + "-01"
+                resp = await client.get(
+                    "/x", headers={"traceparent": tp}
+                )
+                assert resp.status == 200
+                assert resp.headers["X-Request-ID"]
+                assert resp.headers["traceparent"].startswith(
+                    "00-" + "9a" * 16
+                )
+            finally:
+                await client.close()
+            entry = tracing.get_store("mw-test").query(
+                trace_id="9a" * 16
+            )[0]
+            assert entry["component"] == "mw-test"
+            assert entry["status"] == 200
+
+        asyncio.run(go())

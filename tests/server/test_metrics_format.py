@@ -1,0 +1,241 @@
+"""Strict Prometheus text-format contract for both exporters.
+
+The exporters are hand-built string emitters; this suite parses their
+real output with the strict parser (gpustack_tpu/testing/promtext.py):
+every sample line must fully parse, ``# TYPE`` must precede the
+family's first sample and never repeat, label values must be escaped,
+and histograms must be cumulative with ``+Inf`` == ``_count``.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import pytest
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability.metrics import get_registry
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.testing.promtext import (
+    ExpositionError,
+    assert_well_formed,
+    check_histograms,
+    parse_exposition,
+)
+from gpustack_tpu.worker.server import WorkerServer
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# the parser itself rejects the classic hand-emitter bugs
+# ---------------------------------------------------------------------------
+
+
+class TestStrictParser:
+    def test_unescaped_quote_rejected(self):
+        with pytest.raises(ExpositionError, match="label"):
+            parse_exposition('m{a="un"escaped"} 1\n')
+
+    def test_trailing_garbage_rejected(self):
+        with pytest.raises(ExpositionError, match="unparseable"):
+            parse_exposition("m 1 trailing junk here\n")
+
+    def test_duplicate_type_rejected(self):
+        with pytest.raises(ExpositionError, match="duplicate TYPE"):
+            parse_exposition(
+                "# TYPE m counter\nm 1\n# TYPE m counter\n"
+            )
+
+    def test_type_after_sample_rejected(self):
+        with pytest.raises(ExpositionError, match="after"):
+            parse_exposition("m 1\n# TYPE m counter\n")
+
+    def test_histogram_type_after_bucket_sample_rejected(self):
+        with pytest.raises(ExpositionError, match="after"):
+            parse_exposition(
+                'm_bucket{le="+Inf"} 1\nm_sum 1\nm_count 1\n'
+                "# TYPE m histogram\n"
+            )
+
+    def test_non_cumulative_buckets_rejected(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 5\n'
+            'h_bucket{le="2.0"} 3\n'
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 4.0\nh_count 5\n"
+        )
+        samples, types = parse_exposition(text)
+        with pytest.raises(ExpositionError, match="not cumulative"):
+            check_histograms(samples, types)
+
+    def test_inf_must_equal_count(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 4\n'
+            "h_sum 4.0\nh_count 5\n"
+        )
+        samples, types = parse_exposition(text)
+        with pytest.raises(ExpositionError, match="!= _count"):
+            check_histograms(samples, types)
+
+    def test_missing_inf_bucket_rejected(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 4\n'
+            "h_sum 4.0\nh_count 4\n"
+        )
+        samples, types = parse_exposition(text)
+        with pytest.raises(ExpositionError, match="no \\+Inf"):
+            check_histograms(samples, types)
+
+    def test_escaped_labels_accepted(self):
+        samples, _ = parse_exposition(
+            'm{a="q\\"uote",b="back\\\\slash",c="new\\nline"} 1\n'
+        )
+        assert samples[0].labels["a"] == 'q\\"uote'
+
+
+# ---------------------------------------------------------------------------
+# server /metrics
+# ---------------------------------------------------------------------------
+
+
+async def _seed_cluster():
+    await Worker.create(
+        Worker(name="w0", ip="10.0.0.1", state=WorkerState.READY)
+    )
+    model = await Model.create(Model(name="fmt-model", preset="tiny"))
+    await ModelInstance.create(
+        ModelInstance(
+            name="fmt-model-0", model_id=model.id,
+            model_name=model.name,
+            state=ModelInstanceState.RUNNING, worker_id=1,
+        )
+    )
+    await ModelUsage.create(
+        ModelUsage(
+            user_id=1, model_id=model.id, route_name="fmt-model",
+            operation="chat/completions", prompt_tokens=3,
+            completion_tokens=5, total_tokens=8,
+        )
+    )
+
+
+def test_server_metrics_strictly_well_formed(cfg):
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        await _seed_cluster()
+        # adversarial label values through the observability path: a
+        # model name with quote/backslash/newline must render escaped
+        get_registry("server").histogram(
+            "gpustack_request_duration_seconds",
+            label_names=("phase", "model", "outcome"),
+        ).observe(
+            0.25, phase="total", model='evil"name\\x\n', outcome="ok",
+        )
+        from gpustack_tpu.utils.profiling import STATS
+
+        STATS.record("format.test.site", 0.5)
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/metrics")
+            text = await r.text()
+        finally:
+            await client.close()
+        samples, types = assert_well_formed(
+            text,
+            require_histograms=["gpustack_request_duration_seconds"],
+        )
+        names = {s.name for s in samples}
+        # DB gauges, resilience counters, slow-call stats all present
+        assert "gpustack_model_instances" in names
+        assert "gpustack_proxy_failovers_total" in names
+        assert "gpustack_slow_call_count" in names
+        evil = [
+            s for s in samples
+            if s.labels.get("model", "").startswith("evil")
+        ]
+        assert evil, "escaped model label did not round-trip"
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# worker /metrics
+# ---------------------------------------------------------------------------
+
+
+def test_worker_metrics_strictly_well_formed(tmp_path):
+    async def go():
+        import aiohttp
+
+        chip = SimpleNamespace(
+            index=0, chip_type="v5e", hbm_bytes=16 * 2**30
+        )
+        agent = SimpleNamespace(
+            serve_manager=SimpleNamespace(
+                running={}, log_dir=str(tmp_path),
+                drains_total=2, drain_seconds_total=1.5,
+            ),
+            proxy_secret="s",
+            detector=SimpleNamespace(
+                detect=lambda: SimpleNamespace(
+                    cpu_count=4,
+                    memory_total_bytes=8 * 2**30,
+                    memory_used_bytes=2**30,
+                    chips=[chip],
+                )
+            ),
+            cfg=SimpleNamespace(cache_dir=str(tmp_path)),
+            worker_id=1,
+        )
+        ws = WorkerServer(agent)
+        ws._inflight[3] = 1
+        # relay histogram sample so the family renders populated
+        get_registry("worker").histogram(
+            "gpustack_worker_request_duration_seconds",
+            label_names=("phase", "model", "outcome"),
+        ).observe(0.1, phase="total", model="", outcome="ok")
+        port = await ws.start("127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{port}/metrics"
+                ) as resp:
+                    text = await resp.text()
+        finally:
+            await ws.stop()
+        samples, types = assert_well_formed(
+            text,
+            require_histograms=[
+                "gpustack_worker_request_duration_seconds"
+            ],
+        )
+        names = {s.name for s in samples}
+        assert "gpustack_worker_tpu_hbm_bytes" in names
+        assert "gpustack_worker_inflight_requests" in names
+        assert "gpustack_worker_drains_total" in names
+
+    asyncio.run(go())

@@ -107,6 +107,17 @@ def _exported_metric_names():
 
     for m in re.finditer(r"# TYPE (gpustack[a-zA-Z0-9_:]*)", src):
         names.add(m.group(1))
+    # observability families (tracing/lifecycle histograms + slow-call
+    # counters) render from the declared vocabulary, not literal # TYPE
+    # strings — read the same declaration the metrics-drift rule checks
+    from gpustack_tpu.observability.metrics import METRIC_FAMILIES
+
+    for name, kind in METRIC_FAMILIES.items():
+        names.add(name)
+        if kind == "histogram":
+            names.update(
+                name + s for s in ("_bucket", "_sum", "_count")
+            )
     return names
 
 
