@@ -13,7 +13,11 @@ Qwen3-8B on Ascend 910B×8, 1512.21 output tok/s total → 189 output
 tok/s/chip (docs/performance-lab/qwen3-8b/910b.md:95-98).
 
 Env knobs:
-  BENCH_PROFILE=throughput|longcontext|latency   (default throughput)
+  BENCH_PROFILE=throughput|longcontext|latency|multiturn
+      (default throughput; multiturn = ShareGPT-shaped conversations
+      run twice over one seeded schedule — cache-off then cache-on —
+      reporting paired cold vs prefix-hit TTFT + greedy token parity
+      in detail.multiturn)
   BENCH_MODEL=<preset>                           (default llama3-8b)
   BENCH_SMOKE=1      force the tiny CPU smoke
   BENCH_ATTEMPTS=N   TPU probe attempts (default 3)
@@ -172,12 +176,17 @@ def _proc_state(pid):
         return None
 
 
+_REAP_WAIT_S = 10.0
+
+
 def _kill_stale_holders(holders):
-    """SIGKILL each holder and report per-pid outcomes (logged to stderr
-    and recorded in the bench diag — a kill that silently failed is how
-    r5's holders survived unexplained). A zombie counts as killed: the
-    kernel already dropped its plugin mappings; only a wedged parent's
-    missing wait() keeps the pid visible."""
+    """SIGKILL each holder, then actually REAP it: ``waitpid`` for our
+    own children (a killed child we never wait on stays a zombie whose
+    pid keeps showing up in scans), then poll ``/proc`` until the pid is
+    gone or provably a zombie (kernel already dropped its plugin
+    mappings). Per-pid outcomes are logged to stderr and recorded in the
+    bench diag — a kill that silently failed is how r5's holders
+    survived unexplained."""
     outcomes = []
     for h in holders:
         try:
@@ -186,10 +195,26 @@ def _kill_stale_holders(holders):
         except OSError as e:
             err = str(e)
         outcomes.append(dict(h, kill_error=err))
-    if holders:
-        time.sleep(2.0)
     for o in outcomes:
+        # reap attempt INSIDE the poll loop: a waitpid issued only once,
+        # microseconds after SIGKILL, runs before the child has exited
+        # and reaps nothing — our own killed children would linger as
+        # zombies, the exact case this sweep exists to clear.
+        # Iteration-bounded (~_REAP_WAIT_S wall time), never a
+        # wall-clock busy-wait.
         state = _proc_state(o["pid"])
+        for _ in range(int(_REAP_WAIT_S / 0.2)):
+            if state is None:
+                break
+            try:
+                os.waitpid(o["pid"], os.WNOHANG)
+            except (ChildProcessError, OSError):
+                pass  # not our child / already reaped
+            state = _proc_state(o["pid"])
+            if state is None or state == "Z":
+                break
+            time.sleep(0.2)
+            state = _proc_state(o["pid"])
         o["gone"] = state is None or state == "Z"
         o["proc_state"] = state
         print(
@@ -204,6 +229,37 @@ def _kill_stale_holders(holders):
             file=sys.stderr,
         )
     return outcomes
+
+
+def _sweep_stale_holders(diag):
+    """kill → reap → RE-SCAN until the stale-holder scan comes back
+    empty (bounded rounds). r5's diag showed ~12 idle sleep loops
+    pinning the plugin through a whole round with no record of why the
+    sweep missed them — so every round's outcomes land in the diag and
+    a sweep that CANNOT clear the plugin fails loudly instead of
+    letting the claim path discover a pinned chip later. Returns True
+    when no stale holder survives."""
+    for _ in range(3):
+        holders = _stale_chip_holders()
+        if not holders:
+            break
+        diag.setdefault("stale_holders_killed", []).extend(
+            _kill_stale_holders(holders)
+        )
+        time.sleep(1.0)   # let the kernel drop maps before the re-scan
+    leftover = _stale_chip_holders()
+    if leftover:
+        diag["stale_holders_unreaped"] = leftover
+        print(
+            f"bench: FAILED to reap {len(leftover)} stale PJRT "
+            "holder(s) after kill+re-scan: "
+            + ", ".join(
+                f"{h['pid']} ({h['cmd'][:50]!r}, age {h['age_s']}s)"
+                for h in leftover
+            ),
+            file=sys.stderr,
+        )
+    return not leftover
 
 
 def _chip_diagnostics():
@@ -426,10 +482,10 @@ def acquire_tpu():
     # failed claim, and the claim path never ran with the relay down —
     # a pinned chip plausibly contributes to cold-init UNAVAILABLE).
     if os.environ.get("BENCH_KILL_HOLDERS", "1") == "1":
-        holders = _stale_chip_holders()
-        if holders:
-            diag.setdefault("stale_holders_killed", []).extend(
-                _kill_stale_holders(holders)
+        if not _sweep_stale_holders(diag):
+            diag["verdict_note"] = (
+                "stale PJRT holders survived the sweep — chip may "
+                "still be pinned (see stale_holders_unreaped)"
             )
     relay_up = bool(_relay_listening())
     probe = None
@@ -503,13 +559,9 @@ def acquire_tpu():
         # the chip) — a free chip never triggers a kill and foreign
         # processes are never touched. BENCH_KILL_HOLDERS=0 opts out.
         if i == 0 and os.environ.get("BENCH_KILL_HOLDERS", "1") == "1":
-            holders = _stale_chip_holders()
-            if holders:
-                # extend, don't overwrite: the up-front pass may have
-                # recorded kills already and those outcomes must survive
-                diag.setdefault("stale_holders_killed", []).extend(
-                    _kill_stale_holders(holders)
-                )
+            # the sweep extends diag["stale_holders_killed"], so the
+            # up-front pass's recorded outcomes survive
+            _sweep_stale_holders(diag)
         if i + 1 < attempts:
             time.sleep(10.0 * (i + 1))
     diag["verdict"] = "tpu init failed after retries (see attempts)"
@@ -539,10 +591,25 @@ PROFILES = {
         # requests sharing the slot
         closed_loop=True,
     ),
+    # ShareGPT-shaped multi-turn chat/agent loop (reference
+    # profiles_config.yaml lineage, synthetic — zero egress): every
+    # turn's prompt is the full conversation so far (shared system
+    # prompt + prior turns + the model's own replies), so with the host
+    # block KV cache on, turn N+1's prefill is a prefix hit on the
+    # blocks turn N decoded. Reported: cold vs prefix-hit TTFT, so the
+    # cache win is phase-attributed instead of smeared into throughput.
+    "multiturn": dict(
+        conversations=8, turns=4, system_len=512, user_len=192,
+        output_len=96, max_slots=4, max_seq_len=8192, prefill_chunk=0,
+        host_kv_cache_mb=4096, kv_block_tokens=256, multiturn=True,
+    ),
 }
 
 
-def build_engine(cfg_name, max_slots, max_seq_len, prefill_chunk, on_tpu):
+def build_engine(
+    cfg_name, max_slots, max_seq_len, prefill_chunk, on_tpu,
+    host_kv_cache_mb=0, kv_block_tokens=0, kv_cache_int8=False,
+):
     import jax
 
     from gpustack_tpu.engine.engine import LLMEngine
@@ -563,7 +630,121 @@ def build_engine(cfg_name, max_slots, max_seq_len, prefill_chunk, on_tpu):
     return LLMEngine(
         cfg, params, max_slots=max_slots, max_seq_len=max_seq_len,
         prefill_chunk=prefill_chunk,
+        host_kv_cache_mb=host_kv_cache_mb,
+        kv_block_tokens=kv_block_tokens,
+        kv_cache_int8=kv_cache_int8,
     )
+
+
+# ---------------------- multiturn profile flow ------------------------------
+
+
+def _wait_for_cache_store(engine, history, deadline_s=15.0):
+    """Model user think-time between turns: wait (bounded) until the
+    finished turn's full history is actually matchable — the engine
+    queues TWO async stores per request (prompt-time and finish-time),
+    so a global block-count bump alone could be the prompt store with
+    the reply blocks still in flight, racing the next turn's lookup.
+    ``peek_prefix_len`` probes without touching hit/miss counters."""
+    cache = getattr(engine, "host_kv_cache", None)
+    if cache is None:
+        return
+    # the finish-time store covers prompt + reply minus the final token
+    expected = (len(history) - 1) // cache.block_tokens \
+        * cache.block_tokens
+    if expected <= 0:
+        return
+    probe = list(history) + [0]   # proper-prefix probe
+    t0 = time.time()
+    while (
+        cache.peek_prefix_len(probe) < expected
+        and time.time() - t0 < deadline_s
+    ):
+        time.sleep(0.01)
+
+
+def multiturn_schedule(seed, vocab, prof):
+    """Seeded conversation schedule: one shared system prompt + per-
+    conversation user turns. Pure in (seed, vocab, prof) so the cold
+    (cache-off) and hit (cache-on) passes replay identical traffic."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    system = rng.integers(1, vocab, prof["system_len"]).tolist()
+    users = [
+        [
+            rng.integers(1, vocab, prof["user_len"]).tolist()
+            for _ in range(prof["turns"])
+        ]
+        for _ in range(prof["conversations"])
+    ]
+    return system, users
+
+
+def run_multiturn(engine, prof, schedule):
+    """Drive ShareGPT-shaped conversations closed-loop: per turn the
+    prompt is the whole history (shared system prompt + user turns +
+    the model's own greedy replies). Returns per-turn records
+    ``{conv, turn, prompt_len, ttft_ms, reused, output_ids}``."""
+    from gpustack_tpu.engine.engine import GenRequest
+
+    system, users = schedule
+    recs = []
+    for c, conv in enumerate(users):
+        history = list(system)
+        for t, user in enumerate(conv):
+            history += user
+            req = engine.generate(
+                GenRequest(
+                    prompt_ids=list(history),
+                    max_tokens=prof["output_len"],
+                    temperature=0.0,
+                    stop_ids=(),
+                ),
+                timeout=7200,
+            )
+            recs.append({
+                "conv": c, "turn": t, "prompt_len": len(history),
+                "ttft_ms": req.ttft_ms,
+                "reused": req.prefix_tokens_reused,
+                "output_ids": list(req.output_ids),
+                "req": req,   # internal: not part of the JSON detail
+            })
+            history += req.output_ids
+            _wait_for_cache_store(engine, history)
+    return recs
+
+
+def _p50(xs):
+    return sorted(xs)[len(xs) // 2] if xs else 0.0
+
+
+def summarize_multiturn(cold_recs, hit_recs):
+    """Cold-vs-hit TTFT attribution over PAIRED turns: the same
+    (conversation, turn) measured on a cache-off engine and on a
+    cache-on engine that actually reused blocks there — plus greedy
+    token parity across the two passes (identical traffic must yield
+    identical outputs whether or not the cache served the prefix)."""
+    hit_ttfts, cold_ttfts = [], []
+    parity = True
+    for cold, hot in zip(cold_recs, hit_recs):
+        parity = parity and cold["output_ids"] == hot["output_ids"]
+        if hot["reused"] > 0:
+            hit_ttfts.append(hot["ttft_ms"])
+            cold_ttfts.append(cold["ttft_ms"])
+    cold_p50, hit_p50 = _p50(cold_ttfts), _p50(hit_ttfts)
+    return {
+        "hit_turns": len(hit_ttfts),
+        "total_turns": len(hit_recs),
+        "cold_ttft_ms_p50": round(cold_p50, 1),
+        "hit_ttft_ms_p50": round(hit_p50, 1),
+        # the acceptance lever: prefix-hit TTFT vs cold TTFT, same turns
+        "ttft_improvement": (
+            round(1.0 - hit_p50 / cold_p50, 3) if cold_p50 else None
+        ),
+        "token_parity": parity,
+        "prefix_tokens_reused": sum(r["reused"] for r in hit_recs),
+    }
 
 
 def main() -> None:
@@ -628,51 +809,109 @@ def main() -> None:
     prof = dict(PROFILES[profile_name])
     cfg_name = "tiny" if smoke else os.environ.get("BENCH_MODEL", "llama3-8b")
     if smoke:
-        prof = dict(
-            prompt_len=56, output_len=16, num_requests=6,
-            max_slots=4, max_seq_len=128, prefill_chunk=0,
-        )
+        if prof.get("multiturn"):
+            # scaled multiturn smoke: small blocks so the tiny prompts
+            # still span several cache blocks, prompts long enough that
+            # prefill (not fixed overhead) dominates TTFT
+            prof = dict(
+                conversations=3, turns=3, system_len=384, user_len=128,
+                output_len=12, max_slots=2, max_seq_len=2048,
+                prefill_chunk=0, host_kv_cache_mb=64, kv_block_tokens=16,
+                multiturn=True,
+            )
+        else:
+            prof = dict(
+                prompt_len=56, output_len=16, num_requests=6,
+                max_slots=4, max_seq_len=128, prefill_chunk=0,
+            )
 
     engine = build_engine(
         cfg_name, prof["max_slots"], prof["max_seq_len"],
         prof["prefill_chunk"], on_tpu,
+        host_kv_cache_mb=prof.get("host_kv_cache_mb", 0),
+        kv_block_tokens=prof.get("kv_block_tokens", 0),
+        kv_cache_int8=prof.get("kv_cache_int8", False),
     )
     engine.start()
     rng = np.random.default_rng(0)
     vocab = engine.cfg.vocab_size
 
-    def make_req():
-        return GenRequest(
-            prompt_ids=rng.integers(
-                1, vocab, prof["prompt_len"]
-            ).tolist(),
-            max_tokens=prof["output_len"],
-            temperature=0.0,
-            # random-weight models rarely emit eos, but make termination
-            # deterministic regardless:
-            stop_ids=(),
+    multiturn_detail = None
+    if prof.get("multiturn"):
+        # Two passes over the SAME seeded schedule: cache-off (cold)
+        # then the cache-on engine built above (hit), pairing each
+        # turn's TTFT so the cache win is measured like-for-like and
+        # greedy outputs are parity-checked across the passes. Each
+        # pass first runs a warmup conversation on independent tokens —
+        # compiles every prefill bucket and the prefix-continuation jit
+        # keys, so cold-vs-hit compares prefill work, not compile time.
+        schedule = multiturn_schedule(0, vocab, prof)
+        # two warmup conversations: the second exercises the CROSS-
+        # conversation match shape (system prompt only), which is a
+        # different prefix-continuation jit key than within-conversation
+        # matches — one warmup conversation would leave it to compile
+        # mid-measurement
+        warm_sched = multiturn_schedule(
+            1, vocab, dict(prof, conversations=min(2, prof["conversations"]))
+        )
+        # cold pass on the SAME engine with the cache detached: a second
+        # engine would double weight HBM (an 8B model would not fit
+        # twice on one chip), and same-engine passes share jit warmup
+        cache = engine.host_kv_cache
+        engine.host_kv_cache = None
+        run_multiturn(engine, prof, warm_sched)
+        cold_recs = run_multiturn(engine, prof, schedule)
+        engine.host_kv_cache = cache
+        run_multiturn(engine, prof, warm_sched)
+        t0 = time.time()
+        hit_recs = run_multiturn(engine, prof, schedule)
+        wall = time.time() - t0
+        engine.stop()
+        h = engine.health()
+        multiturn_detail = dict(
+            summarize_multiturn(cold_recs, hit_recs),
+            conversations=prof["conversations"],
+            turns=prof["turns"],
+            kv_cache_blocks=h["kv_cache_blocks"],
+            kv_cache_host_mb=round(h["kv_cache_host_bytes"] / 2**20, 1),
         )
 
-    # Warmup: compile prefill bucket + decode step.
-    engine.generate(make_req(), timeout=3600)
+        reqs = [r["req"] for r in hit_recs]
+    else:
+        def make_req():
+            return GenRequest(
+                prompt_ids=rng.integers(
+                    1, vocab, prof["prompt_len"]
+                ).tolist(),
+                max_tokens=prof["output_len"],
+                temperature=0.0,
+                # random-weight models rarely emit eos, but make
+                # termination deterministic regardless:
+                stop_ids=(),
+            )
 
-    reqs = [make_req() for _ in range(prof["num_requests"])]
-    closed_loop = bool(prof.get("closed_loop"))
+        # Warmup: compile prefill bucket + decode step.
+        engine.generate(make_req(), timeout=3600)
 
-    def wait_done(r):
-        if not r.done.wait(7200):
-            raise TimeoutError(f"bench request {r.request_id} unfinished")
+        reqs = [make_req() for _ in range(prof["num_requests"])]
+        closed_loop = bool(prof.get("closed_loop"))
 
-    t0 = time.time()
-    for r in reqs:
-        engine.submit(r)
-        if closed_loop:
-            wait_done(r)
-    if not closed_loop:
+        def wait_done(r):
+            if not r.done.wait(7200):
+                raise TimeoutError(
+                    f"bench request {r.request_id} unfinished"
+                )
+
+        t0 = time.time()
         for r in reqs:
-            wait_done(r)
-    wall = time.time() - t0
-    engine.stop()
+            engine.submit(r)
+            if closed_loop:
+                wait_done(r)
+        if not closed_loop:
+            for r in reqs:
+                wait_done(r)
+        wall = time.time() - t0
+        engine.stop()
 
     out_tokens = sum(len(r.output_ids) for r in reqs)
     in_tokens = sum(len(r.prompt_ids) for r in reqs)
@@ -754,7 +993,7 @@ def main() -> None:
                 "vs_baseline": vs_baseline,
                 "detail": {
                     "profile": profile_name,
-                    "requests": prof["num_requests"],
+                    "requests": len(reqs),
                     "output_tokens": out_tokens,
                     "input_tokens": in_tokens,
                     "wall_s": round(wall, 2),
@@ -772,6 +1011,8 @@ def main() -> None:
                 },
         }
     )
+    if multiturn_detail is not None:
+        result["detail"]["multiturn"] = multiturn_detail
     if on_tpu and profile_name == "throughput":
         # Persist a real TPU throughput run so a later bench invocation
         # (or the end-of-round driver run) can fall back to it if the

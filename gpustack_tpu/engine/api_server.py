@@ -165,6 +165,22 @@ class OpenAIServer:
             "# TYPE gpustack_engine_tokens_generated_total counter",
             f"gpustack_engine_tokens_generated_total {h['tokens_generated']}",
         ]
+        # host KV cache: TYPE text derives from the declared vocabulary
+        # (observability/metrics.py METRIC_FAMILIES) so the metrics-
+        # drift analyzer sees exactly one declaration site per family
+        from gpustack_tpu.observability.metrics import METRIC_FAMILIES
+
+        for family, value in (
+            ("gpustack_kv_cache_hits", h["kv_cache_hits"]),
+            ("gpustack_kv_cache_misses", h["kv_cache_misses"]),
+            (
+                "gpustack_kv_cache_prefix_tokens_reused",
+                h["kv_cache_prefix_tokens_reused"],
+            ),
+            ("gpustack_kv_cache_bytes", h["kv_cache_host_bytes"]),
+        ):
+            lines.append(f"# TYPE {family} {METRIC_FAMILIES[family]}")
+            lines.append(f"{family} {value}")
         # request-latency histograms (vLLM's ttft/tpot observability
         # parity — the reference normalizes these into its dashboards,
         # metrics_config.yaml)
@@ -654,6 +670,7 @@ class OpenAIServer:
             await loop.run_in_executor(None, gen.done.wait, remaining)
             if not gen.done.is_set():
                 return _error(504, "generation timed out")
+        self._trace_kv(request, gens)
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
         # usage is billed on what the CLIENT sent + everything actually
         # generated (incl. discarded schema-retry attempts) — a swapped
@@ -902,7 +919,24 @@ class OpenAIServer:
                 final["usage"] = _usage(gens)
             await write(final)
         await resp.write(b"data: [DONE]\n\n")
+        self._trace_kv(request, gens)
         return resp
+
+    @staticmethod
+    def _trace_kv(request: web.Request, gens: List[GenRequest]) -> None:
+        """Attach host-KV-cache phases to this hop's trace: the
+        ``kv_upload`` span (host→device re-materialization of matched
+        prefix blocks, measured by the engine scheduler) plus a
+        prefix-hit event carrying the reused-token count."""
+        trace = request.get("trace")
+        if trace is None:
+            return
+        upload_s = sum(g.kv_upload_s for g in gens)
+        if upload_s > 0:
+            trace.add_phase("kv_upload", upload_s)
+        reused = sum(g.prefix_tokens_reused for g in gens)
+        if reused:
+            trace.event("kv_prefix_hit", tokens_reused=reused)
 
 
 def _error(status: int, message: str) -> web.Response:
@@ -1025,6 +1059,8 @@ def build_engine_from_args(args) -> LLMEngine:
         draft_cfg=draft_cfg,
         draft_params=draft_params,
         host_kv_cache_mb=getattr(args, "host_kv_cache_mb", 0),
+        kv_block_tokens=getattr(args, "kv_block_tokens", 0),
+        kv_cache_int8=getattr(args, "kv_cache_int8", False),
         prefill_chunk=getattr(args, "prefill_chunk", 0),
     )
     if vlm_cfg is not None:
@@ -1096,7 +1132,20 @@ def main(argv=None) -> None:
     p.add_argument("--num-devices", type=int, default=0)
     p.add_argument(
         "--host-kv-cache-mb", type=int, default=0,
-        help="host-RAM prefill KV cache budget (extended-KV-cache role)",
+        help="host-RAM block KV cache budget (extended-KV-cache role): "
+        "finished sequences are cached block-granular and shared "
+        "across requests via radix prefix matching",
+    )
+    p.add_argument(
+        "--kv-block-tokens", type=int, default=0,
+        help="host KV cache block granularity in tokens (0 = default "
+        "256); smaller blocks match shorter shared prefixes at more "
+        "per-block overhead",
+    )
+    p.add_argument(
+        "--kv-cache-int8", action="store_true",
+        help="quantize host-tier KV blocks to int8 (per-block scales, "
+        "dequantized on upload) — ~2x cache capacity per byte",
     )
     p.add_argument(
         "--lora", action="append", default=[],

@@ -176,6 +176,11 @@ class GenRequest:
     submitted_at: float = 0.0
     first_token_at: float = 0.0
     finished_at: float = 0.0
+    # host KV cache accounting for this request: prefix tokens whose
+    # prefill was skipped, and the host→device upload seconds spent
+    # re-materializing them (surfaced as the trace's kv_upload phase)
+    prefix_tokens_reused: int = 0
+    kv_upload_s: float = 0.0
 
     def abort(self) -> None:
         self.aborted.set()
@@ -234,7 +239,9 @@ class LLMEngine:
         spec_tokens: int = 4,        # proposals verified per spec step
         draft_cfg=None,              # draft model config (speculative=draft)
         draft_params=None,
-        host_kv_cache_mb: int = 0,   # >0: host-RAM prefill KV cache
+        host_kv_cache_mb: int = 0,   # >0: host-RAM block KV cache
+        kv_block_tokens: int = 0,    # block granularity (0 = default 256)
+        kv_cache_int8: bool = False,  # int8 host tier (per-block scales)
         prefill_chunk: int = 0,      # >0: chunked prefill (tokens/chunk)
     ):
         self.cfg = cfg
@@ -294,9 +301,22 @@ class LLMEngine:
         if host_kv_cache_mb > 0:
             import concurrent.futures
 
-            from gpustack_tpu.engine.kv_host_cache import HostKVCache
+            from gpustack_tpu.engine.kv_host_cache import (
+                DEFAULT_BLOCK_TOKENS,
+                HostKVCache,
+            )
 
-            self.host_kv_cache = HostKVCache(host_kv_cache_mb * 2**20)
+            self.host_kv_cache = HostKVCache(
+                host_kv_cache_mb * 2**20,
+                # <= 0 (unset, or a bad spec value — ModelSpec has no
+                # range validation) falls back to the default instead
+                # of crash-looping the engine process at startup
+                block_tokens=(
+                    kv_block_tokens if kv_block_tokens > 0
+                    else DEFAULT_BLOCK_TOKENS
+                ),
+                int8=kv_cache_int8,
+            )
             # device→host KV copies run off-thread: a synchronous PCIe
             # pull of a whole bucket's KV would stall the scheduler
             # thread (and every decoding slot) on each prefill miss
@@ -450,6 +470,13 @@ class LLMEngine:
                 self.host_kv_cache.prefix_hits
                 if self.host_kv_cache else 0
             ),
+            "kv_cache_prefix_tokens_reused": (
+                self.host_kv_cache.prefix_tokens_reused
+                if self.host_kv_cache else 0
+            ),
+            "kv_cache_blocks": (
+                self.host_kv_cache.entries if self.host_kv_cache else 0
+            ),
             "kv_cache_host_bytes": (
                 self.host_kv_cache.bytes_used if self.host_kv_cache else 0
             ),
@@ -510,14 +537,15 @@ class LLMEngine:
         self._drain_pending()
         return not self._waiting.empty()
 
-    def _plan_chunk_job(self, req: GenRequest, ids) -> "Optional[_ChunkJob]":
+    def _plan_chunk_job(
+        self, req: GenRequest, ids, matched: int = 0
+    ) -> "Optional[_ChunkJob]":
         """Chunk schedule for a long prompt, seeded from the host KV
-        cache's longest prefix when one fits. Returns None when any
-        continuation would overflow the top bucket (possible with
-        non-power-of-two max_seq_len shapes) — the caller then falls
-        back to one-shot prefill, which always fits."""
-        import jax.numpy as jnp
-
+        cache's matched block run (``matched``, probed once by the
+        caller) when one fits. Returns None when any continuation would
+        overflow the top bucket (possible with non-power-of-two
+        max_seq_len shapes) — the caller then falls back to one-shot
+        prefill, which always fits."""
         top = self.runner.prefill_buckets[-1]
 
         def fits(start: int) -> bool:
@@ -534,19 +562,56 @@ class LLMEngine:
             return True
 
         kv_cache = self.host_kv_cache
-        if kv_cache is not None:
-            prefix = kv_cache.find_longest_prefix(ids)
-            if prefix is not None:
-                (_, pk, pv), plen = prefix
-                if fits(plen):
-                    kv_cache.prefix_hits += 1
-                    return _ChunkJob(
-                        req=req, ids=list(ids), done=plen,
-                        k=jnp.asarray(pk), v=jnp.asarray(pv),
-                    )
+        if kv_cache is not None and matched > 0:
+            # block granularity means the bounds guard can trim the
+            # matched run block-by-block instead of rejecting it
+            # outright — a partially usable prefix still saves its
+            # blocks' prefill FLOPs. Trim BEFORE gathering so no KV
+            # bytes are assembled for blocks the guard discards.
+            plen = matched
+            while plen > 0 and not fits(plen):
+                plen -= kv_cache.block_tokens
+            got = (
+                kv_cache.gather_prefix(ids, plen) if plen > 0 else None
+            )
+            if got is not None:
+                pk, pv = got
+                kv_cache.prefix_hits += 1
+                kv_cache.prefix_tokens_reused += plen
+                req.prefix_tokens_reused = plen
+                t0 = time.time()
+                k, v = self._upload_prefix(pk, pv, plen)
+                req.kv_upload_s = time.time() - t0
+                return _ChunkJob(
+                    req=req, ids=list(ids), done=plen, k=k, v=v,
+                )
         if fits(0):
             return _ChunkJob(req=req, ids=list(ids))
         return None
+
+    def _upload_prefix(self, pk, pv, use_len: int):
+        """Upload a matched prefix run padded to its BUCKET width, not
+        its exact block-multiple length: prefill_with_prefix jit-keys on
+        (Pb, Tsb, total_bucket), so exact widths would compile one fresh
+        executable per distinct matched length — bucket padding keeps the
+        key set as bounded as v1's bucket-stored arrays. Pad rows sit at
+        positions >= use_len: overwritten by the suffix's own writes or
+        invisible through the causal mask (the prefix-prefill invariant).
+        Blocks until resident so the caller's kv_upload timing is
+        honest (prefill would stall on the transfer anyway)."""
+        import jax.numpy as jnp
+
+        pw = self.runner.bucket_for(use_len)
+        if pk.shape[1] >= pw:
+            k_host, v_host = pk[:, :pw], pv[:, :pw]
+        else:
+            pad = ((0, 0), (0, pw - pk.shape[1]), (0, 0), (0, 0))
+            k_host = np.pad(pk, pad)
+            v_host = np.pad(pv, pad)
+        k = jnp.asarray(k_host)
+        v = jnp.asarray(v_host)
+        jax.block_until_ready((k, v))
+        return k, v
 
     def _advance_chunk(self) -> bool:
         """Run ONE chunk of the oldest in-progress chunked prefill."""
@@ -593,17 +658,9 @@ class LLMEngine:
         if job.done >= len(job.ids):
             del self._chunk_jobs[slot]
             ids = job.ids
-            bucket = self.runner.bucket_for(len(ids))
-            # chunk continuation widths round to the same bucket as a
-            # one-shot prefill would; trim defensively before store.
-            # snapshot: the copy worker may null host_kv_cache concurrently
-            kv_cache = self.host_kv_cache
-            if kv_cache is not None:
-                padded_full = list(ids) + [0] * (bucket - len(ids))
-                key = kv_cache.key(bucket, padded_full, len(ids))
-                self._store_host_kv(
-                    key, job.last, job.k, job.v, ids, bucket
-                )
+            # block insert trims to full blocks <= len(ids); the copy
+            # worker trims the (continuation-padded) arrays to match
+            self._submit_kv_copy(ids, job.k, job.v, len(ids))
             commit = getattr(self.runner, "chunk_commit", None)
             if commit is not None:
                 # multi-host: followers promote their chunk register so
@@ -639,8 +696,6 @@ class LLMEngine:
         req.done.set()
 
     def _start_request(self, slot: int, req: GenRequest) -> None:
-        import jax.numpy as jnp
-
         ids = req.prompt_ids
         bucket = self.runner.bucket_for(max(1, len(ids)))
         padded = list(ids) + [0] * (bucket - len(ids))
@@ -659,99 +714,91 @@ class LLMEngine:
             )
             self._finalize_start(slot, req, last_logits, k, v)
             return
-        cache_key = None
-        cached = None
-        # local read: the copy worker may null host_kv_cache concurrently
+        # ONE prefix probe per request (counts one hit or miss), shared
+        # by the chunked and one-shot paths. Local read: the copy worker
+        # may null host_kv_cache concurrently.
         kv_cache = self.host_kv_cache
-        if kv_cache is not None:
-            cache_key = kv_cache.key(bucket, padded, len(ids))
-            cached = kv_cache.get(cache_key)
-        if cached is not None:
-            # host→HBM re-upload beats redoing the prefill FLOPs
-            last_np, k_np, v_np = cached
-            last_logits = jnp.asarray(last_np)
-            k = jnp.asarray(k_np)
-            v = jnp.asarray(v_np)
-        elif (
+        matched = (
+            kv_cache.match_prefix_len(ids) if kv_cache is not None else 0
+        )
+        if (
             self.prefill_chunk
             and len(ids) > self.prefill_chunk
-            and (job := self._plan_chunk_job(req, ids)) is not None
+            and (job := self._plan_chunk_job(req, ids, matched)) is not None
         ):
             # long prompt: prefill in chunks, one per scheduler step
-            # (the step loop interleaves decode between chunks)
+            # (the step loop interleaves decode between chunks; the job
+            # planner seeds from the host cache's matched block run)
             self._chunk_jobs[slot] = job
             return
-        else:
-            prefix = (
-                kv_cache.find_longest_prefix(ids)
-                if kv_cache is not None else None
+        use_len = matched
+        if use_len > 0:
+            top = self.runner.prefill_buckets[-1]
+            # cache bounds contract: the suffix BLOCK (bucketed) must
+            # fit above the prefix within a REAL bucket —
+            # dynamic_update_slice clamps out-of-range writes and would
+            # silently corrupt the tail. Block granularity lets the
+            # guard trim the matched run one block at a time instead of
+            # rejecting the whole match; trimming happens BEFORE any KV
+            # bytes are assembled.
+            while use_len > 0:
+                sb = self.runner.bucket_for(len(ids) - use_len)
+                if use_len + sb <= top:
+                    break
+                use_len -= kv_cache.block_tokens
+        prefix = (
+            kv_cache.gather_prefix(ids, use_len) if use_len > 0 else None
+        )
+        if prefix is not None:
+            pk, pv = prefix
+            # prefix reuse: upload the cached block run, prefill only
+            # the suffix from that offset. Counted here, not in the
+            # lookup — a match the bounds guard rejected (or that
+            # evicted before the gather) saved nothing.
+            kv_cache.prefix_hits += 1
+            kv_cache.prefix_tokens_reused += use_len
+            req.prefix_tokens_reused = use_len
+            suffix = ids[use_len:]
+            sb = self.runner.bucket_for(len(suffix))
+            total_bucket = self.runner.bucket_for(use_len + sb)
+            t0 = time.time()
+            pk_dev, pv_dev = self._upload_prefix(pk, pv, use_len)
+            req.kv_upload_s = time.time() - t0
+            suffix_padded = list(suffix) + [0] * (sb - len(suffix))
+            last_logits, k, v = self.runner.prefill_with_prefix(
+                pk_dev, pv_dev, use_len, suffix_padded, len(suffix),
+                total_bucket,
             )
-            use_prefix = False
-            if prefix is not None:
-                (_, pk, pv), plen = prefix
-                suffix = ids[plen:]
-                sb = self.runner.bucket_for(len(suffix))
-                # cache bounds contract: the suffix BLOCK (bucketed) must
-                # fit above the prefix within a REAL bucket —
-                # dynamic_update_slice clamps out-of-range writes and
-                # would silently corrupt the tail
-                # (the continuation runs flash with q_offset at flash-
-                # sized totals, so no bucket class is excluded anymore)
-                use_prefix = (
-                    plen + sb <= self.runner.prefill_buckets[-1]
-                )
-            if use_prefix:
-                # prefix reuse: upload the cached prefix KV, prefill
-                # only the suffix from that offset. Counted here, not in
-                # the lookup — a match the bounds guard rejected saved
-                # nothing.
-                kv_cache.prefix_hits += 1
-                total_bucket = self.runner.bucket_for(plen + sb)
-                suffix_padded = list(suffix) + [0] * (sb - len(suffix))
-                last_logits, k, v = self.runner.prefill_with_prefix(
-                    pk, pv, plen, suffix_padded, len(suffix),
-                    total_bucket,
-                )
-            else:
-                last_logits, k, v = self.runner.prefill(padded, len(ids))
-            if kv_cache is not None:
-                self._store_host_kv(cache_key, last_logits, k, v, ids, bucket)
+        else:
+            last_logits, k, v = self.runner.prefill(padded, len(ids))
+        if kv_cache is not None:
+            self._submit_kv_copy(ids, k, v, len(ids))
         self._finalize_start(slot, req, last_logits, k, v)
 
-    def _store_host_kv(
-        self, cache_key, last_logits, k, v, ids, store_bucket: int
-    ) -> None:
-        """Queue an async device→host copy of a finished prefill's KV."""
+    def _submit_kv_copy(self, seq, k_dev, v_dev, total: int) -> None:
+        """Queue an async device→host copy + block insert of ``seq``'s
+        KV. The device arrays may be wider than ``total`` (bucket or
+        prefix-continuation padding); they are trimmed host-side in the
+        copy worker. Shared by the prefill-time and finish-time stores
+        so the disable-on-error path exists exactly once."""
         kv_cache = self.host_kv_cache
         if kv_cache is None or self._kv_copy_pool is None:
             return
 
         def copy_to_host(
-            key=cache_key, logits=last_logits, k_=k, v_=v,
-            kv_cache=kv_cache, prompt=tuple(ids),
-            store_bucket=store_bucket,
+            seq=tuple(seq), k_=k_dev, v_=v_dev,
+            kv_cache=kv_cache, total=total,
         ):
             try:
-                # trim to the prompt's own bucket: the prefix
-                # path returns total_bucket-wide arrays, and a
-                # wider-than-bucket_for(prompt) entry would break
-                # the Pb <= total_bucket invariant on later reuse
-                # (and waste host bytes)
-                kv_cache.put(
-                    key,
-                    (
-                        np.asarray(logits),
-                        np.asarray(k_[:, :store_bucket]),
-                        np.asarray(v_[:, :store_bucket]),
-                    ),
-                    prompt_ids=prompt,
+                kv_cache.insert_sequence(
+                    seq,
+                    np.asarray(k_)[:, :total],
+                    np.asarray(v_)[:, :total],
                 )
             except RuntimeError as e:
-                # non-addressable shards (defensive: backends
-                # gates multi-host off already)
-                logger.warning(
-                    "disabling host KV cache: %s", e
-                )
+                # non-addressable shards (defensive: backends gates
+                # multi-host off already)
+                logger.warning("disabling host KV cache: %s", e)
                 self.host_kv_cache = None
 
         try:
@@ -760,6 +807,39 @@ class LLMEngine:
             # pool shut down (engine stopping) — skip the store; the
             # cache is an optimization, never required for correctness
             pass
+
+    def _store_finished_sequence(self, slot: int, req: GenRequest) -> None:
+        """Cache the FULL finished sequence (prompt + generated tokens)
+        so turn N+1 of a conversation prefix-hits the blocks turn N
+        decoded — the multi-turn/agent-loop win block granularity
+        exists for. Rides the same kv-copy executor as the prefill
+        store. Single-host only by construction: worker/backends.py
+        never passes ``host_kv_cache_mb`` to multi-host replicas, so
+        the decode-state rows sliced here are always addressable."""
+        kv_cache = self.host_kv_cache
+        if kv_cache is None or self._kv_copy_pool is None:
+            return
+        if req.embeds_override is not None:
+            # VLM prompt: placeholder ids alias across different images,
+            # so image-conditioned KV must never enter the token-keyed
+            # cache (same exclusion as the prefill-time paths)
+            return
+        # Drop the trailing output token: a sampled token's KV is only
+        # written on device when it is *fed* on a later step, which may
+        # not have happened for the final one by finish time. Every
+        # earlier token was fed (its successor was sampled from it).
+        seq = list(req.prompt_ids) + list(req.output_ids[:-1])
+        bt = kv_cache.block_tokens
+        if len(seq) // bt <= len(req.prompt_ids) // bt:
+            # no full block beyond what the prefill-time store already
+            # indexed — skip the device pull entirely
+            return
+        total = len(seq)
+        # slice at a bucketed width so the dispatched slice executables
+        # stay bounded; trim to the true length host-side in the worker
+        width = self.runner.bucket_for(total)
+        k_dev, v_dev = self.runner.slot_kv(self._state, slot, width)
+        self._submit_kv_copy(seq, k_dev, v_dev, total)
 
     def _finalize_start(
         self, slot: int, req: GenRequest, last_logits, k, v
@@ -1077,6 +1157,10 @@ class LLMEngine:
         req.finish_reason = reason
         req.output_text = info.text
         req.finished_at = time.time()
+        if reason in ("stop", "length"):
+            # aborted/errored slots may have undelivered device state;
+            # only cleanly finished sequences are safe to cache
+            self._store_finished_sequence(slot, info.request)
         if req.first_token_at and req.submitted_at:
             self.ttft_hist.observe(req.first_token_at - req.submitted_at)
             self.e2e_hist.observe(req.finished_at - req.submitted_at)

@@ -1,25 +1,41 @@
-"""Host-RAM prefill KV cache: the extended-KV-cache role on TPU.
+"""Host-RAM KV cache: block-granular radix prefix reuse on TPU.
 
 Reference parity: first-class ``ExtendedKVCacheConfig`` wired into vLLM's
 LMCache env/args (schemas/models.py:111-122, worker/backends/vllm.py:
-418-436,822-840). On TPU the analogous lever is spilling prefill KV over
-PCIe into host RAM: a repeated prompt (system prompts, retried requests,
-agent loops) skips its entire prefill — the dominant FLOPs cost for long
-prompts — and re-uploads cached K/V instead.
+418-436,822-840). On TPU the analogous lever is spilling KV over PCIe
+into host RAM: a prompt sharing a prefix with any previously served
+sequence (system prompts, agent loops, multi-turn chat) re-uploads the
+cached K/V for the shared run and prefills only its suffix — skipping
+the dominant FLOPs cost for long prompts.
 
-v1 granularity is the whole padded prompt bucket (exact-match). Prefix-
-granular reuse (continue prefill from a cached prefix) needs
-prefill-from-offset in the runner and is the planned upgrade.
+v2 granularity is a fixed-size token **block** (default 256, see
+``kv_block_tokens``): KV is split into blocks deduplicated across
+requests via a radix trie keyed on rolling token-block hashes —
+``child_key = sha256(parent_key || block_token_bytes)`` — so lookup is
+O(prompt_len / block) hash-map probes (each hashing one block's bytes,
+O(prompt_len) total) instead of the v1 O(entries × prompt_len) linear
+scan over whole-prompt entries. Eviction is block-level LRU over leaf
+blocks only: an interior block is referenced by its children
+(``refs``), so a hot shared system-prompt block survives while cold
+per-conversation suffixes evict. Sequences are inserted at *request
+finish* (prompt + generated tokens), which is what makes turn N+1 of a
+conversation hit the blocks turn N decoded.
+
+Opt-in ``int8`` host tiering quantizes each block with a per-block
+scale (amax per layer × head within the block) and dequantizes on
+upload, roughly doubling cache capacity per byte of host RAM at a KV
+precision cost that greedy-parity tests bound.
 """
 
 from __future__ import annotations
 
 import hashlib
 import threading
-from collections import OrderedDict
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+DEFAULT_BLOCK_TOKENS = 256
 
 
 def _prompt_key(bucket: int, prompt_ids, true_len: int) -> str:
@@ -29,97 +45,337 @@ def _prompt_key(bucket: int, prompt_ids, true_len: int) -> str:
     return h.hexdigest()
 
 
-class HostKVCache:
-    """Byte-bounded LRU of host-resident prefill results.
+def _quantize_block(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-block int8: scale = amax over (tokens, head_dim) per
+    layer × head, so one outlier token degrades only its own block."""
+    x32 = np.asarray(x, np.float32)
+    scale = np.max(np.abs(x32), axis=(1, 3), keepdims=True) / 127.0
+    scale = np.maximum(scale, 1e-8).astype(np.float32)
+    q = np.clip(np.rint(x32 / scale), -127, 127).astype(np.int8)
+    return q, scale
 
-    Each entry optionally records its true prompt tokens, enabling
-    PREFIX reuse: a new prompt that extends a cached one re-uploads the
-    cached K/V and prefills only the suffix (prefill-from-offset in the
-    runner) — the LMCache-style long-context lever for shared system
-    prompts and agent loops.
+
+def _dequantize_block(q: np.ndarray, scale: np.ndarray, dtype) -> np.ndarray:
+    return (q.astype(np.float32) * scale).astype(dtype)
+
+
+class _Block:
+    """One cached KV block: ``block_tokens`` tokens of one sequence.
+
+    ``refs`` counts child blocks whose prefix this block is — a block
+    with live children can never evict (its children would dangle), the
+    refcount behaviour the LRU needs so shared prefixes outlive cold
+    suffixes.
     """
 
-    def __init__(self, max_bytes: int):
+    __slots__ = (
+        "key", "tokens", "parent", "children", "refs",
+        "k", "v", "k_scale", "v_scale", "dtype",
+        "nbytes", "last_used",
+    )
+
+    def __init__(self, key: bytes, tokens: Tuple[int, ...], parent):
+        self.key = key
+        self.tokens = tokens
+        self.parent = parent
+        self.children: Dict[bytes, "_Block"] = {}
+        self.refs = 0
+        self.k = self.v = None
+        self.k_scale = self.v_scale = None
+        self.dtype = None
+        self.nbytes = 0
+        self.last_used = 0
+
+
+class HostKVCache:
+    """Byte-bounded block-granular radix prefix cache in host RAM.
+
+    Thread contract: ``match_prefix`` runs on the engine scheduler
+    thread, ``put``/``insert_sequence`` on the kv-copy executor. The
+    lock guards only the trie walk and accounting; quantization and
+    the dequantize+concatenate assembly of a matched run happen outside
+    it (block arrays are immutable once attached — eviction drops
+    references, it never mutates)."""
+
+    def __init__(
+        self,
+        max_bytes: int,
+        block_tokens: int = DEFAULT_BLOCK_TOKENS,
+        int8: bool = False,
+    ):
+        if block_tokens <= 0:
+            raise ValueError(f"block_tokens must be > 0: {block_tokens}")
         self.max_bytes = max_bytes
-        # key -> (arrays, prompt_ids tuple or None)
-        self._lru: "OrderedDict[str, Tuple[Tuple[Any, ...], Any]]" = (
-            OrderedDict()
-        )
+        self.block_tokens = int(block_tokens)
+        self.int8 = bool(int8)
+        self._root = _Block(b"", (), None)
+        self._blocks: Dict[bytes, _Block] = {}
         self._bytes = 0
+        self._tick = 0
         self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.prefix_hits = 0
+        self.hits = 0            # match_prefix calls that matched >= 1 block
+        self.misses = 0          # match_prefix calls that matched nothing
+        self.prefix_hits = 0     # matches the engine actually consumed
+        self.prefix_tokens_reused = 0   # tokens the engine skipped prefilling
+        self.blocks_inserted = 0
+        self.blocks_evicted = 0
+
+    # ---- keys -----------------------------------------------------------
 
     @staticmethod
     def key(bucket: int, prompt_ids, true_len: int) -> str:
         return _prompt_key(bucket, prompt_ids, true_len)
 
-    def get(self, key: str) -> Optional[Tuple[Any, ...]]:
-        with self._lock:
-            entry = self._lru.get(key)
-            if entry is None:
-                self.misses += 1
-                return None
-            self._lru.move_to_end(key)
-            self.hits += 1
-            return entry[0]
+    def _child_key(self, parent_key: bytes, tokens) -> bytes:
+        h = hashlib.sha256()
+        h.update(parent_key)
+        h.update(np.asarray(tokens, np.int32).tobytes())
+        return h.digest()
 
-    def find_longest_prefix(
-        self, prompt_ids, min_len: int = 32
-    ) -> Optional[Tuple[Tuple[Any, ...], int]]:
-        """Cached entry whose TRUE prompt is the longest proper prefix
-        of ``prompt_ids`` (>= min_len tokens); returns (arrays, plen).
-        The caller counts a prefix hit only when it actually USES the
-        match (bounds guards may still reject it)."""
-        prompt = tuple(prompt_ids)
-        # snapshot under the lock, compare outside: the token-by-token
-        # comparisons are O(entries x plen) and must not stall the
-        # scheduler thread against the copy worker
+    # ---- lookup ---------------------------------------------------------
+
+    def _walk(self, prompt, max_blocks: int, touch: bool) -> List[_Block]:
+        """Locked trie walk: the longest cached block run prefixing
+        ``prompt``, at most ``max_blocks`` long. O(len/block) probes;
+        each hashes one block and verifies the stored tokens (collision
+        guard) — total work O(len) in the prompt, never O(entries)."""
+        bt = self.block_tokens
+        run: List[_Block] = []
         with self._lock:
-            candidates = [
-                (key, arrays, entry_prompt)
-                for key, (arrays, entry_prompt) in self._lru.items()
-                if entry_prompt is not None
-                and min_len <= len(entry_prompt) < len(prompt)
-            ]
-        best = None
-        best_key = None
-        best_len = min_len - 1
-        for key, arrays, entry_prompt in candidates:
-            plen = len(entry_prompt)
-            if plen > best_len and prompt[:plen] == entry_prompt:
-                best, best_key, best_len = (arrays, plen), key, plen
-        if best_key is not None:
-            with self._lock:
-                if best_key in self._lru:
-                    # refresh recency: a hot shared prefix hit only via
-                    # extension must not be the first eviction victim
-                    self._lru.move_to_end(best_key)
-        return best
+            node = self._root
+            for b in range(max_blocks):
+                block = prompt[b * bt : (b + 1) * bt]
+                child = node.children.get(self._child_key(node.key, block))
+                if child is None or child.tokens != block:
+                    break
+                run.append(child)
+                node = child
+            if touch and run:
+                self._tick += 1
+                for blk in run:
+                    blk.last_used = self._tick
+        return run
+
+    def match_prefix_len(self, prompt_ids) -> int:
+        """Length of the longest cached block run that is a proper
+        prefix of ``prompt_ids`` — a multiple of ``block_tokens``,
+        strictly less than ``len(prompt_ids)`` (at least one suffix
+        token always remains to prefill, which regenerates the
+        last-position logits). Counts one hit or miss per call and
+        touches the matched path's recency; no KV bytes move — callers
+        trim the length against their bounds guards first and then
+        assemble only what they will use via :meth:`gather_prefix`."""
+        prompt = tuple(int(t) for t in prompt_ids)
+        max_blocks = (len(prompt) - 1) // self.block_tokens
+        run = self._walk(prompt, max_blocks, touch=True) if max_blocks > 0 \
+            else []
+        with self._lock:
+            if run:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return len(run) * self.block_tokens
+
+    def peek_prefix_len(self, prompt_ids) -> int:
+        """Like :meth:`match_prefix_len` but side-effect free (no
+        counters, no recency touch) — a probe for tests and benches
+        waiting on async stores to land."""
+        prompt = tuple(int(t) for t in prompt_ids)
+        max_blocks = (len(prompt) - 1) // self.block_tokens
+        if max_blocks <= 0:
+            return 0
+        return len(self._walk(prompt, max_blocks, touch=False)) \
+            * self.block_tokens
+
+    def gather_prefix(
+        self, prompt_ids, length: int
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Assemble (dequantize + concatenate) exactly ``length`` tokens
+        of cached prefix KV — the post-trim amount the caller will
+        actually upload, so no bytes are copied for blocks a bounds
+        guard discarded. Returns None when the run is no longer fully
+        resident (evicted since the length probe); callers fall back to
+        a cold prefill."""
+        bt = self.block_tokens
+        if length <= 0 or length % bt:
+            return None
+        prompt = tuple(int(t) for t in prompt_ids[:length])
+        run = self._walk(prompt, length // bt, touch=True)
+        if len(run) * bt < length:
+            return None
+        # assembly OUTSIDE the lock: block arrays are immutable once
+        # attached (eviction only drops references)
+        k = np.concatenate([self._block_k(b) for b in run], axis=1)
+        v = np.concatenate([self._block_v(b) for b in run], axis=1)
+        return k, v
+
+    def match_prefix(
+        self, prompt_ids
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+        """One-shot convenience (tests, small prompts): longest proper
+        prefix run fully assembled. The engine uses the two-phase
+        match_prefix_len → trim → gather_prefix flow instead, so it
+        never assembles bytes its bounds guards then discard."""
+        matched = self.match_prefix_len(prompt_ids)
+        if matched <= 0:
+            return None
+        got = self.gather_prefix(prompt_ids, matched)
+        if got is None:
+            return None
+        return got[0], got[1], matched
+
+    def _block_k(self, blk: _Block) -> np.ndarray:
+        if blk.k_scale is None:
+            return blk.k
+        return _dequantize_block(blk.k, blk.k_scale, blk.dtype)
+
+    def _block_v(self, blk: _Block) -> np.ndarray:
+        if blk.v_scale is None:
+            return blk.v
+        return _dequantize_block(blk.v, blk.v_scale, blk.dtype)
+
+    # ---- insert ---------------------------------------------------------
+
+    def insert_sequence(self, token_ids, k, v) -> int:
+        """Split ``(k, v)`` (``[L, T, H, hd]`` with ``T >=
+        len(token_ids)``; extra width is bucket padding) into full
+        blocks and attach any that are not already cached. Existing
+        blocks are touched (LRU recency), never re-stored — that is the
+        cross-request dedup. Returns the number of new blocks."""
+        tokens = tuple(int(t) for t in token_ids)
+        bt = self.block_tokens
+        n_blocks = len(tokens) // bt
+        if n_blocks <= 0:
+            return 0
+        k = np.asarray(k)
+        v = np.asarray(v)
+        # Walk under the lock FIRST to find where new blocks start
+        # (touching the shared prefix's recency on the way), so the
+        # quantize/copy work below runs only for the genuinely new
+        # suffix — a turn-N conversation store must not re-quantize
+        # turn 1's blocks just to discard them at the dedup check.
+        start = 0
+        with self._lock:
+            node = self._root
+            for b in range(n_blocks):
+                block = tokens[b * bt : (b + 1) * bt]
+                child = node.children.get(self._child_key(node.key, block))
+                if child is None or child.tokens != block:
+                    break
+                self._tick += 1
+                child.last_used = self._tick
+                node = child
+                start += 1
+        if start == n_blocks:
+            return 0
+        # quantize/copy OUTSIDE the lock, new suffix blocks only
+        prepared: Dict[int, Tuple[Any, Any, Any, Any, int]] = {}
+        for b in range(start, n_blocks):
+            bk = k[:, b * bt : (b + 1) * bt]
+            bv = v[:, b * bt : (b + 1) * bt]
+            if self.int8:
+                qk, sk = _quantize_block(bk)
+                qv, sv = _quantize_block(bv)
+                nbytes = qk.nbytes + qv.nbytes + sk.nbytes + sv.nbytes
+                prepared[b] = (qk, qv, (sk, sv), k.dtype, nbytes)
+            else:
+                bk = np.ascontiguousarray(bk)
+                bv = np.ascontiguousarray(bv)
+                prepared[b] = (
+                    bk, bv, None, k.dtype, bk.nbytes + bv.nbytes
+                )
+        inserted = 0
+        # re-walk from the root to attach: the trie may have changed
+        # meanwhile (concurrent insert, eviction of the walked prefix) —
+        # existing blocks are touched, prepared ones attached, and a
+        # block that is neither (evicted prefix, rare race) ends the run
+        with self._lock:
+            node = self._root
+            for b in range(n_blocks):
+                block = tokens[b * bt : (b + 1) * bt]
+                key = self._child_key(node.key, block)
+                child = node.children.get(key)
+                if child is not None and child.tokens == block:
+                    self._tick += 1
+                    child.last_used = self._tick
+                    node = child
+                    continue
+                if b not in prepared:
+                    break
+                bk, bv, scales, dtype, nbytes = prepared[b]
+                if nbytes > self.max_bytes:
+                    break   # one block over the whole budget: stop here
+                child = _Block(key, block, node)
+                child.k, child.v = bk, bv
+                if scales is not None:
+                    child.k_scale, child.v_scale = scales
+                child.dtype = dtype
+                child.nbytes = nbytes
+                self._tick += 1
+                child.last_used = self._tick
+                node.children[key] = child
+                node.refs += 1
+                self._blocks[key] = child
+                self._bytes += nbytes
+                self.blocks_inserted += 1
+                inserted += 1
+                node = child
+            self._evict_locked()
+        return inserted
+
+    def _evict_locked(self) -> None:
+        """Drop LRU leaf blocks until back under budget. Leaf-only:
+        ``refs > 0`` means children still extend this block. O(#leaves)
+        per evicted block — fine at the hundreds-to-thousands of blocks
+        a host-RAM budget holds."""
+        while self._bytes > self.max_bytes and self._blocks:
+            victim = None
+            for blk in self._blocks.values():
+                if blk.refs:
+                    continue
+                if victim is None or blk.last_used < victim.last_used:
+                    victim = blk
+            if victim is None:       # all blocks interior (can't happen
+                return               # while leaves exist, but stay safe)
+            parent = victim.parent
+            del parent.children[victim.key]
+            parent.refs -= 1
+            del self._blocks[victim.key]
+            self._bytes -= victim.nbytes
+            self.blocks_evicted += 1
+
+    # ---- legacy store surface ------------------------------------------
 
     def put(
         self, key: str, arrays: Tuple[Any, ...], prompt_ids=None
     ) -> None:
-        size = sum(a.nbytes for a in arrays)
-        if size > self.max_bytes:
-            return  # single entry larger than the whole budget
-        with self._lock:
-            if key in self._lru:
-                self._lru.move_to_end(key)
-                return
-            self._lru[key] = (
-                arrays,
-                tuple(prompt_ids) if prompt_ids is not None else None,
-            )
-            self._bytes += size
-            while self._bytes > self.max_bytes and self._lru:
-                _, (evicted, _) = self._lru.popitem(last=False)
-                self._bytes -= sum(a.nbytes for a in evicted)
+        """Store a finished prefill's KV under its sequence tokens.
+
+        ``arrays`` is ``(last_logits, k, v)`` (the v1 exact-entry
+        shape) or ``(k, v)``; only the K/V blocks are retained — block
+        granularity subsumes the exact-match tier (an identical prompt
+        re-matches every full block and prefills a >= 1 token tail).
+        A ``key`` whose first put lacked ``prompt_ids`` is upgraded in
+        place when a later put supplies them, instead of early-returning
+        with the tokens dropped (the v1 bug)."""
+        if len(arrays) == 3:
+            _, k, v = arrays
+        else:
+            k, v = arrays
+        if prompt_ids is None:
+            return  # nothing placeable in the trie without the tokens
+        # ALWAYS insert: the trie walk dedups existing blocks cheaply,
+        # a put whose first call lacked prompt_ids upgrades the moment
+        # the tokens arrive (the v1 key-level early-return dropped
+        # them), and a key whose blocks were evicted under pressure
+        # rejoins the cache on its next prefill store
+        self.insert_sequence(tuple(int(t) for t in prompt_ids), k, v)
+
+    # ---- introspection --------------------------------------------------
 
     @property
     def entries(self) -> int:
-        return len(self._lru)
+        return len(self._blocks)
 
     @property
     def bytes_used(self) -> int:

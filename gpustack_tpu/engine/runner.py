@@ -439,6 +439,17 @@ class ModelRunner:
             state, active=state.active.at[slot].set(False)
         )
 
+    def slot_kv(self, state: DecodeState, slot: int, width: int):
+        """Copy a slot's KV rows ``[:width]`` out of the decode cache
+        (host KV cache's finish-time store). Dispatches eagerly, so the
+        returned arrays survive the next decode step's donation of
+        ``state``; callers pass a bucketed ``width`` to bound the slice
+        executables compiled."""
+        return (
+            state.cache.k[:, slot, :width],
+            state.cache.v[:, slot, :width],
+        )
+
     # -- decode -----------------------------------------------------------
 
     def _decode_impl(self, params, state, key):

@@ -33,6 +33,13 @@ METRIC_FAMILIES = {
     "gpustack_slow_call_count": "counter",
     "gpustack_slow_call_seconds_total": "counter",
     "gpustack_slow_call_max_seconds": "gauge",
+    # host-RAM block KV cache (engine/kv_host_cache.py), emitted by the
+    # engine exporter (engine/api_server.py) and normalized onto the
+    # gpustack_tpu: namespace by the worker (worker/metrics_map.py)
+    "gpustack_kv_cache_hits": "counter",
+    "gpustack_kv_cache_misses": "counter",
+    "gpustack_kv_cache_prefix_tokens_reused": "counter",
+    "gpustack_kv_cache_bytes": "gauge",
 }
 
 # request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a

@@ -29,6 +29,14 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+# jax moved shard_map to the top level in 0.6; older runtimes (this
+# container ships 0.4.x) only have the experimental path — resolve once
+# so every wrapper below works on both
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on old-jax containers
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 _NEG = -1e30
 
 
@@ -68,14 +76,18 @@ def ring_attention(
     k_positions: jax.Array,   # [B, Tk_local]
     axis_name: str,
     scale: float,
+    sp: Optional[int] = None,
 ) -> jax.Array:
     """Causal GQA attention where sequence blocks live on ``axis_name``.
 
     Must run inside shard_map (or an equivalent SPMD context) over a mesh
     with ``axis_name``. Returns the local output block
-    [B, Tq_local, Hkv*G*d].
+    [B, Tq_local, Hkv*G*d]. ``sp`` must be passed on old-jax runtimes
+    where ``lax.axis_size`` does not exist (the ring permutation needs
+    the CONCRETE axis size; a psum(1) stand-in would be traced).
     """
-    sp = lax.axis_size(axis_name)
+    if sp is None:
+        sp = lax.axis_size(axis_name)
     B, Tq = q.shape[0], q.shape[1]
     Hkv, G, d = q.shape[2], q.shape[3], q.shape[4]
 
@@ -98,12 +110,17 @@ def ring_attention(
 
     # the locally-created accumulators start device-invariant; mark them
     # varying over every mesh axis the loop body's outputs vary over, so
-    # the scan carry types match (k/v/k_positions are already varying)
-    vma = jax.typeof(k).vma
-    m, l, acc = (
-        lax.pvary(x, tuple(ax for ax in vma if ax not in jax.typeof(x).vma))
-        for x in (m, l, acc)
-    )
+    # the scan carry types match (k/v/k_positions are already varying).
+    # jax.typeof/lax.pvary are the 0.6+ varying-manual-axes machinery;
+    # pre-vma runtimes (0.4.x) need no marking — carry types match as-is
+    if hasattr(jax, "typeof") and hasattr(lax, "pvary"):
+        vma = jax.typeof(k).vma
+        m, l, acc = (
+            lax.pvary(
+                x, tuple(ax for ax in vma if ax not in jax.typeof(x).vma)
+            )
+            for x in (m, l, acc)
+        )
     m, l, acc, _, _, _ = lax.fori_loop(
         0, sp, body, (m, l, acc, k, v, k_positions)
     )
@@ -158,7 +175,7 @@ def sp_cache_attention(
         out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, T, -1)
         return out.astype(q_.dtype)
 
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(
@@ -189,8 +206,11 @@ def sharded_prefill_attention(
     pos_spec = P("dp", axis_name)
     out_spec = P("dp", axis_name, "tp")
 
-    fn = functools.partial(ring_attention, axis_name=axis_name, scale=scale)
-    return jax.shard_map(
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, scale=scale,
+        sp=int(mesh.shape[axis_name]),
+    )
+    return _shard_map(
         lambda q_, k_, v_, pq, pk: fn(q_, k_, v_, pq, pk),
         mesh=mesh,
         in_specs=(qkv_spec, kv_spec, kv_spec, pos_spec, pos_spec),

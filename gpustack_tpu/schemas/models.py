@@ -224,8 +224,15 @@ class Model(Record):
     # preset name or local checkpoint dir of the small proposer model
     draft_source: str = ""
     # extended KV cache (LMCache role, reference schemas/models.py:111-122
-    # + vllm.py:418-436): host-RAM prefill-KV budget in MiB; 0 = off
+    # + vllm.py:418-436): host-RAM KV budget in MiB; 0 = off. Finished
+    # sequences (prompt + generated tokens) are cached block-granular
+    # and shared across requests via radix prefix matching
     host_kv_cache_mb: int = 0
+    # host KV cache block granularity in tokens (0 = engine default 256)
+    kv_block_tokens: int = 0
+    # int8 host-tier KV (per-block scales, dequantized on upload):
+    # ~2x cache capacity per byte of host_kv_cache_mb
+    kv_cache_int8: bool = False
     # >0: chunked prefill — prompts longer than this many tokens prefill
     # in chunks with decode steps interleaved (vLLM enable-chunked-prefill
     # role; bounds long-prompt impact on running slots' token cadence)

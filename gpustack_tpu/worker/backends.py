@@ -183,6 +183,10 @@ def _tpu_native_command(
         # single-host only: on multi-host meshes the prefill K/V spans
         # non-addressable devices and cannot be pulled to one host's RAM
         argv += ["--host-kv-cache-mb", str(model.host_kv_cache_mb)]
+        if model.kv_block_tokens:
+            argv += ["--kv-block-tokens", str(model.kv_block_tokens)]
+        if model.kv_cache_int8:
+            argv += ["--kv-cache-int8"]
     if multi_host and model.speculative:
         logger.warning(
             "model %s: speculative decoding is single-host only; "

@@ -25,6 +25,12 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_engine_ttft_seconds": "gpustack_tpu:ttft_seconds",
     "gpustack_engine_tpot_seconds": "gpustack_tpu:tpot_seconds",
     "gpustack_engine_e2e_seconds": "gpustack_tpu:e2e_request_seconds",
+    # host-RAM block KV cache on the in-repo engine (kv_host_cache.py)
+    "gpustack_kv_cache_hits": "gpustack_tpu:kv_cache_hits",
+    "gpustack_kv_cache_misses": "gpustack_tpu:kv_cache_misses",
+    "gpustack_kv_cache_prefix_tokens_reused":
+        "gpustack_tpu:kv_cache_prefix_tokens_reused",
+    "gpustack_kv_cache_bytes": "gpustack_tpu:kv_cache_host_bytes",
     # in-repo audio engine (engine/audio_server.py)
     "gpustack_tpu_audio_requests_total": "gpustack_tpu:audio_requests_total",
     "gpustack_tpu_audio_seconds_total": "gpustack_tpu:audio_seconds_total",

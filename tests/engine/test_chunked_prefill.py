@@ -107,13 +107,13 @@ def test_chunked_prefill_interleaves_decode(setup):
 
 
 def test_chunked_prefill_with_host_kv_cache(setup):
-    """A chunked prefill stores its KV; an identical follow-up prompt is
-    a full-bucket cache hit (no re-chunking)."""
+    """A chunked prefill stores its KV blocks; an identical follow-up
+    prompt matches them and chunk-prefills only the unmatched tail."""
     cfg, params = setup
     prompt = _prompt(cfg, 70)
     eng = LLMEngine(
         cfg, params, max_slots=2, max_seq_len=192,
-        prefill_chunk=32, host_kv_cache_mb=64,
+        prefill_chunk=32, host_kv_cache_mb=64, kv_block_tokens=16,
     )
     eng.start()
     try:
@@ -138,6 +138,8 @@ def test_chunked_prefill_with_host_kv_cache(setup):
         eng.stop()
     assert r1.output_ids == r2.output_ids
     assert eng.host_kv_cache.hits >= 1
+    # 70-token prompt = 4 full 16-blocks, all reused on the repeat
+    assert r2.prefix_tokens_reused >= 64
 
 
 def test_chunked_prefill_flash_continuation_parity(setup, monkeypatch):
@@ -230,7 +232,7 @@ def test_chunked_prefill_seeds_from_cached_prefix(setup):
     base = _prompt(cfg, 60, seed=13)
     eng = LLMEngine(
         cfg, params, max_slots=1, max_seq_len=256,
-        prefill_chunk=32, host_kv_cache_mb=64,
+        prefill_chunk=32, host_kv_cache_mb=64, kv_block_tokens=16,
     )
     eng.start()
     try:
@@ -246,7 +248,7 @@ def test_chunked_prefill_seeds_from_cached_prefix(setup):
         import time as _time
 
         deadline = _time.time() + 60
-        while not eng.host_kv_cache._lru and _time.time() < deadline:
+        while not eng.host_kv_cache.entries and _time.time() < deadline:
             _time.sleep(0.05)
         hits_before = eng.host_kv_cache.prefix_hits
         extended = base + _prompt(cfg, 60, seed=14)
@@ -261,3 +263,96 @@ def test_chunked_prefill_seeds_from_cached_prefix(setup):
         eng.stop()
     assert eng.host_kv_cache.prefix_hits > hits_before
     assert req.output_ids == _greedy_reference(cfg, params, extended, 4)
+
+
+def test_chunked_prefix_seeded_vs_cold_token_parity(setup):
+    """Satellite coverage: greedy outputs are IDENTICAL for the same
+    prompt run as a cold chunk job (cache off) and as a prefix-seeded
+    chunk job (cache on, warm) — and the fits() overflow fallback keeps
+    holding with a warm cache on a non-power-of-two max_seq_len."""
+    cfg, params = setup
+    base = _prompt(cfg, 60, seed=21)
+    extended = base + _prompt(cfg, 50, seed=22)
+
+    cold = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=256, prefill_chunk=32
+    )
+    cold.start()
+    try:
+        want = cold.generate(
+            GenRequest(
+                prompt_ids=extended, max_tokens=5, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        ).output_ids
+    finally:
+        cold.stop()
+
+    warm = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=256,
+        prefill_chunk=32, host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    warm.start()
+    try:
+        warm.generate(
+            GenRequest(
+                prompt_ids=base, max_tokens=2, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        )
+        warm._kv_copy_pool.shutdown(wait=True)
+        req = warm.generate(
+            GenRequest(
+                prompt_ids=extended, max_tokens=5, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        )
+    finally:
+        warm.stop()
+    assert req.prefix_tokens_reused >= 48          # 3 of base's blocks
+    assert req.output_ids == want
+    assert req.output_ids == _greedy_reference(cfg, params, extended, 5)
+
+
+def test_chunk_overflow_fallback_with_warm_cache(setup):
+    """fits() bounds guard with a MATCHED prefix on a non-power-of-two
+    max_seq_len (buckets 32..128,150): the full 128-token match
+    overflows (128 + 32 > 150), so the planner must TRIM the matched
+    run block-by-block to an offset whose continuation fits — and the
+    output must stay bit-identical to the cold run either way."""
+    cfg, params = setup
+    prompt = _prompt(cfg, 140, seed=23)
+    eng = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=150,
+        prefill_chunk=64, host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    eng.start()
+    try:
+        r1 = eng.generate(
+            GenRequest(
+                prompt_ids=prompt, max_tokens=4, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        )
+        eng._kv_copy_pool.shutdown(wait=True)
+        # warm repeat: blocks exist now, but any continuation from a
+        # 16-aligned offset still overflows (plen + sb > 150 for every
+        # plen the 64-token chunk schedule would use) — the non-chunked
+        # prefix path may still serve what fits within the top bucket
+        r2 = eng.generate(
+            GenRequest(
+                prompt_ids=prompt, max_tokens=4, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        )
+    finally:
+        eng.stop()
+    assert not eng._chunk_jobs
+    oracle = _greedy_reference(cfg, params, prompt, 4)
+    assert r1.output_ids == oracle
+    assert r2.output_ids == oracle

@@ -1,4 +1,13 @@
-"""Host-RAM prefill KV cache (extended-KV-cache role)."""
+"""Block-granular radix host KV cache (extended-KV-cache role).
+
+Unit layer: trie lookup/insert/dedup, refcounted leaf-only LRU
+eviction, int8 round-trip, the legacy put() upgrade path. Engine
+layer: greedy parity across exact-repeat / extension / multi-turn
+reuse, plus the tier-1 perf guard — a prefix hit must skip at least
+the matched blocks' prefill work (step/token-count based, CPU-stable).
+"""
+
+import time
 
 import jax
 import numpy as np
@@ -9,31 +18,190 @@ from gpustack_tpu.engine.kv_host_cache import HostKVCache
 from gpustack_tpu.models import init_params
 from gpustack_tpu.models.config import get_config
 
+L, H, HD = 2, 2, 4  # toy KV dims for unit tests
 
-def test_lru_accounting_and_eviction():
-    cache = HostKVCache(max_bytes=1000)
-    a = (np.zeros(100, np.uint8),)          # 100 B
-    key1 = cache.key(32, [1, 2, 3], 3)
-    key2 = cache.key(32, [1, 2, 4], 3)
-    assert key1 != key2
-    # same content hashes identically
-    assert key1 == cache.key(32, [1, 2, 3], 3)
 
-    cache.put(key1, a)
-    assert cache.get(key1) is a
-    assert cache.get(key2) is None
-    assert cache.hits == 1 and cache.misses == 1
+def _kv(n_tokens, seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    v = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    return k, v
 
-    # fill past the budget: LRU evicts key1 (key2 was touched later)
-    cache.put(key2, (np.zeros(500, np.uint8),))
-    cache.get(key2)
-    cache.put(cache.key(32, [9], 1), (np.zeros(600, np.uint8),))
-    assert cache.bytes_used <= 1000
-    assert cache.get(key1) is None          # evicted (oldest)
 
-    # an entry bigger than the whole budget is refused
-    cache.put(cache.key(32, [8], 1), (np.zeros(5000, np.uint8),))
-    assert cache.bytes_used <= 1000
+# ---------------------------------------------------------------------------
+# unit: radix trie
+# ---------------------------------------------------------------------------
+
+
+def test_match_prefix_block_granular():
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    seq = list(range(1, 13))            # 12 tokens = 3 full blocks
+    k, v = _kv(12)
+    assert cache.insert_sequence(seq, k, v) == 3
+    assert cache.entries == 3
+
+    # a prompt extending the sequence matches all 3 blocks
+    got = cache.match_prefix(seq + [99, 98])
+    assert got is not None
+    mk, mv, plen = got
+    assert plen == 12
+    np.testing.assert_array_equal(mk, k)
+    np.testing.assert_array_equal(mv, v)
+
+    # an identical prompt matches only PROPER prefixes: >= 1 suffix
+    # token always remains to prefill (regenerates the last logits)
+    _, _, plen = cache.match_prefix(seq)
+    assert plen == 8
+
+    # a diverging prompt matches up to the divergence block
+    _, _, plen = cache.match_prefix(seq[:8] + [77, 77, 77, 77, 1])
+    assert plen == 8
+
+    # diverging inside the first block: no match
+    assert cache.match_prefix([5, 1, 2, 3, 4, 5]) is None
+    assert cache.hits == 3 and cache.misses == 1
+
+
+def test_insert_dedup_shares_blocks():
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    shared = list(range(1, 9))          # 2 blocks
+    k, v = _kv(12)
+    assert cache.insert_sequence(shared + [10, 11, 12, 13], k, v) == 3
+    # same shared prefix, different suffix: only the suffix block is new
+    k2, v2 = _kv(12, seed=1)
+    k2[:, :8], v2[:, :8] = k[:, :8], v[:, :8]
+    assert cache.insert_sequence(shared + [20, 21, 22, 23], k2, v2) == 1
+    assert cache.entries == 4
+
+
+def test_partial_tail_block_not_stored():
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    k, v = _kv(7)
+    assert cache.insert_sequence(list(range(7)), k, v) == 1  # 4 of 7
+    assert cache.entries == 1
+
+
+def test_eviction_is_leaf_only_lru():
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    shared = [1, 2, 3, 4]               # 1 shared root block
+    k, v = _kv(8)
+    cache.insert_sequence(shared + [11, 12, 13, 14], k, v)
+    k2, v2 = _kv(8, seed=1)
+    k2[:, :4], v2[:, :4] = k[:, :4], v[:, :4]
+    cache.insert_sequence(shared + [21, 22, 23, 24], k2, v2)
+    assert cache.entries == 3
+    block_bytes = cache.bytes_used // 3
+
+    # budget for 3 blocks: inserting a 4th forces ONE eviction — the
+    # cold leaf; the shared root block has refs > 0 and must survive
+    # even though it is the oldest
+    cache.max_bytes = 3 * block_bytes
+    # touch one leaf so the other is the LRU victim
+    assert cache.match_prefix(shared + [21, 22, 23, 24, 0])[2] == 8
+    k3, v3 = _kv(4, seed=2)
+    cache.insert_sequence([31, 32, 33, 34], k3, v3)   # forces eviction
+    assert cache.bytes_used <= cache.max_bytes
+    # the hot path (shared -> [21..]) survived
+    assert cache.match_prefix(shared + [21, 22, 23, 24, 0])[2] == 8
+    # the cold leaf [11..] is gone: only the shared block matches
+    assert cache.match_prefix(shared + [11, 12, 13, 14, 0])[2] == 4
+    assert cache.blocks_evicted >= 1
+
+
+def test_block_larger_than_budget_refused():
+    cache = HostKVCache(max_bytes=64, block_tokens=4)
+    k, v = _kv(4)
+    assert cache.insert_sequence([1, 2, 3, 4], k, v) == 0
+    assert cache.bytes_used == 0
+
+
+def test_int8_roundtrip_close_and_smaller():
+    f32 = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    i8 = HostKVCache(max_bytes=1 << 20, block_tokens=4, int8=True)
+    seq = list(range(1, 9))
+    k, v = _kv(8)
+    f32.insert_sequence(seq, k, v)
+    i8.insert_sequence(seq, k, v)
+    # ~half the bytes (int8 payload + small scale overhead)
+    assert i8.bytes_used < 0.6 * f32.bytes_used
+    mk, mv, plen = i8.match_prefix(seq + [99])
+    assert plen == 8
+    assert mk.dtype == k.dtype
+    # per-block scales bound the error at ~amax/127 per layer x head
+    scale = np.max(np.abs(k), axis=(1, 3), keepdims=True)
+    np.testing.assert_allclose(mk, k[:, :8], atol=(scale / 120).max())
+    np.testing.assert_allclose(
+        mv, v[:, :8],
+        atol=(np.max(np.abs(v), axis=(1, 3), keepdims=True) / 120).max(),
+    )
+
+
+def test_put_upgrades_entry_that_lacked_prompt_ids():
+    """The v1 bug: an entry first stored without prompt_ids early-
+    returned on the re-store that supplied them, permanently losing
+    prefix-match ability. The stored prompt must upgrade instead."""
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    seq = list(range(1, 9))
+    k, v = _kv(8)
+    logits = np.zeros(16, np.float32)
+    key = cache.key(8, seq, 8)
+    cache.put(key, (logits, k, v))               # no prompt_ids
+    assert cache.match_prefix(seq + [99]) is None
+    cache.put(key, (logits, k, v), prompt_ids=seq)   # upgrade
+    assert cache.match_prefix(seq + [99])[2] == 8
+    # idempotent: a third put with tokens is a no-op, not a re-store
+    before = cache.blocks_inserted
+    cache.put(key, (logits, k, v), prompt_ids=seq)
+    assert cache.blocks_inserted == before
+
+
+def test_put_reinserts_after_eviction():
+    """A key whose blocks were evicted under pressure must rejoin the
+    cache on its next prefill-time put — key-level dedup must not
+    permanently suppress the hot repeat prompts the cache exists for."""
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=4)
+    seq = list(range(1, 9))
+    k, v = _kv(8)
+    key = cache.key(8, seq, 8)
+    cache.put(key, (k, v), prompt_ids=seq)
+    assert cache.match_prefix(seq + [99])[2] == 8
+    # evict everything by shrinking the budget to zero
+    cache.max_bytes = 0
+    with cache._lock:
+        cache._evict_locked()
+    assert cache.entries == 0
+    assert cache.match_prefix(seq + [99]) is None
+    # the same key put again (e.g. the prompt was served cold again)
+    cache.max_bytes = 1 << 20
+    cache.put(key, (k, v), prompt_ids=seq)
+    assert cache.match_prefix(seq + [99])[2] == 8
+
+
+def test_lookup_is_radix_not_linear_scan():
+    """Populate many unrelated sequences; a lookup touches only the
+    prompt's own path (probe count == blocks walked), independent of
+    how many entries the cache holds — the v1 linear scan is gone."""
+    cache = HostKVCache(max_bytes=1 << 30, block_tokens=4)
+    for s in range(50):
+        seq = [1000 + 10 * s + i for i in range(8)]
+        k, v = _kv(8, seed=s)
+        cache.insert_sequence(seq, k, v)
+    assert not hasattr(cache, "find_longest_prefix")
+    probes = []
+    orig = cache._child_key
+
+    def counting(parent_key, tokens):
+        probes.append(1)
+        return orig(parent_key, tokens)
+
+    cache._child_key = counting
+    assert cache.match_prefix([7, 7, 7, 7, 7]) is None
+    assert len(probes) == 1              # one root probe, 0 entries scanned
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
 
 
 @pytest.fixture(scope="module")
@@ -42,44 +210,137 @@ def shared():
     return cfg, init_params(cfg, jax.random.key(0))
 
 
-def test_engine_kv_cache_hit_is_output_identical(shared):
+def _gen(eng, prompt, n=6):
+    return eng.generate(
+        GenRequest(prompt_ids=list(prompt), max_tokens=n, temperature=0.0),
+        timeout=180,
+    )
+
+
+def _wait_blocks(eng, min_blocks=1, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if eng.health()["kv_cache_blocks"] >= min_blocks:
+            return
+        time.sleep(0.05)
+    raise AssertionError("host KV store never landed")
+
+
+def test_engine_repeat_prompt_is_prefix_hit_and_identical(shared):
     cfg, params = shared
     eng = LLMEngine(
-        cfg, params, max_slots=2, max_seq_len=128, host_kv_cache_mb=64
+        cfg, params, max_slots=2, max_seq_len=128,
+        host_kv_cache_mb=64, kv_block_tokens=16,
     )
     eng.start()
     try:
-        prompt = [5, 17, 42, 99, 7, 23]
-        r1 = eng.generate(
-            GenRequest(prompt_ids=prompt, max_tokens=8, temperature=0.0),
-            timeout=180,
-        )
+        prompt = [5, 17, 42, 99, 7, 23, 81, 3] * 5     # 40 tokens
+        r1 = _gen(eng, prompt, n=8)
         h = eng.health()
         assert h["kv_cache_misses"] == 1 and h["kv_cache_hits"] == 0
-        # the device->host copy is async; wait for it to land
-        import time as _time
-
-        for _ in range(100):
-            if eng.health()["kv_cache_host_bytes"] > 0:
-                break
-            _time.sleep(0.1)
-        # identical prompt: served from the host cache, same output
-        r2 = eng.generate(
-            GenRequest(prompt_ids=prompt, max_tokens=8, temperature=0.0),
-            timeout=180,
-        )
+        _wait_blocks(eng)
+        r2 = _gen(eng, prompt, n=8)
         h = eng.health()
         assert h["kv_cache_hits"] == 1
+        assert h["kv_cache_prefix_hits"] == 1
+        assert h["kv_cache_prefix_tokens_reused"] >= 32
         assert h["kv_cache_host_bytes"] > 0
         assert r2.output_ids == r1.output_ids
-        # different prompt: miss
-        eng.generate(
-            GenRequest(
-                prompt_ids=[1, 2, 3], max_tokens=4, temperature=0.0
-            ),
-            timeout=180,
-        )
+        assert r2.prefix_tokens_reused >= 32
+        assert r2.kv_upload_s > 0
+        # unrelated prompt: miss
+        _gen(eng, [1, 2, 3], n=4)
         assert eng.health()["kv_cache_misses"] == 2
+    finally:
+        eng.stop()
+
+
+def test_engine_prefix_reuse_is_output_identical(shared):
+    cfg, params = shared
+    prefix = [5, 17, 42, 99, 7, 23, 81, 3] * 5
+    extended = prefix + [9, 4, 33, 7]
+
+    plain = LLMEngine(cfg, params, max_slots=2, max_seq_len=128)
+    plain.start()
+    try:
+        want = _gen(plain, extended).output_ids
+    finally:
+        plain.stop()
+
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=128,
+        host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    eng.start()
+    try:
+        _gen(eng, prefix)                      # seeds the cache
+        _wait_blocks(eng)
+        got = _gen(eng, extended).output_ids
+        h = eng.health()
+        assert h["kv_cache_prefix_hits"] == 1, h
+        assert got == want
+    finally:
+        eng.stop()
+
+
+def test_engine_multiturn_reuses_generated_blocks(shared):
+    """Turn N+1 hits blocks covering turn N's GENERATED tokens — the
+    finish-time full-sequence store, not just the prefill store."""
+    cfg, params = shared
+    rng = np.random.default_rng(0)
+    turn1 = rng.integers(1, cfg.vocab_size, 40).tolist()
+    user2 = rng.integers(1, cfg.vocab_size, 10).tolist()
+
+    plain = LLMEngine(cfg, params, max_slots=2, max_seq_len=256)
+    plain.start()
+    try:
+        out1 = _gen(plain, turn1, n=12).output_ids
+        turn2 = turn1 + out1 + user2
+        want2 = _gen(plain, turn2, n=8).output_ids
+    finally:
+        plain.stop()
+
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=256,
+        host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    eng.start()
+    try:
+        got1 = _gen(eng, turn1, n=12).output_ids
+        assert got1 == out1
+        # prompt holds 2 full 16-blocks; prompt+output holds 3+
+        _wait_blocks(eng, min_blocks=3)
+        r2 = _gen(eng, turn2, n=8)
+        # matched run covers prompt AND generated tokens of turn 1
+        assert r2.prefix_tokens_reused > len(turn1)
+        assert r2.output_ids == want2
+    finally:
+        eng.stop()
+
+
+def test_engine_int8_cache_keeps_greedy_parity(shared):
+    cfg, params = shared
+    prompt = [3, 9, 27, 81, 11, 33] * 8        # 48 tokens
+    extended = prompt + [2, 4, 6]
+
+    plain = LLMEngine(cfg, params, max_slots=2, max_seq_len=128)
+    plain.start()
+    try:
+        want1 = _gen(plain, prompt).output_ids
+        want2 = _gen(plain, extended).output_ids
+    finally:
+        plain.stop()
+
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=128,
+        host_kv_cache_mb=64, kv_block_tokens=16, kv_cache_int8=True,
+    )
+    eng.start()
+    try:
+        assert _gen(eng, prompt).output_ids == want1
+        _wait_blocks(eng)
+        assert _gen(eng, extended).output_ids == want2
+        assert eng.health()["kv_cache_prefix_hits"] >= 1
     finally:
         eng.stop()
 
@@ -123,40 +384,99 @@ def test_prefix_prefill_matches_full_prefill(shared):
     )
 
 
-def test_engine_prefix_reuse_is_output_identical(shared):
+# ---------------------------------------------------------------------------
+# tier-1 perf guard: a prefix hit skips the matched blocks' prefill work
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_skips_matched_prefill_tokens(shared):
+    """Step/token-count based (CPU-stable): with the cache warm, the
+    engine prefills at most ``len(prompt) - matched`` tokens instead of
+    the whole prompt."""
     cfg, params = shared
-    prefix = [5, 17, 42, 99, 7, 23, 81, 3] * 5
-    extended = prefix + [9, 4, 33, 7]
-
-    def gen(eng, prompt):
-        return eng.generate(
-            GenRequest(prompt_ids=prompt, max_tokens=6, temperature=0.0),
-            timeout=180,
-        ).output_ids
-
-    # reference: no cache at all
-    plain = LLMEngine(cfg, params, max_slots=2, max_seq_len=128)
-    plain.start()
-    try:
-        want = gen(plain, extended)
-    finally:
-        plain.stop()
-
     eng = LLMEngine(
-        cfg, params, max_slots=2, max_seq_len=128, host_kv_cache_mb=64
+        cfg, params, max_slots=1, max_seq_len=256,
+        host_kv_cache_mb=64, kv_block_tokens=16,
     )
+    calls = []
+    orig_full = eng.runner.prefill
+    orig_prefix = eng.runner.prefill_with_prefix
+
+    def spy_full(ids, true_len):
+        calls.append(("full", int(true_len)))
+        return orig_full(ids, true_len)
+
+    def spy_prefix(pk, pv, plen, ids, true_len, tb):
+        calls.append(("prefix", int(true_len)))
+        return orig_prefix(pk, pv, plen, ids, true_len, tb)
+
+    eng.runner.prefill = spy_full
+    eng.runner.prefill_with_prefix = spy_prefix
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, cfg.vocab_size, 80).tolist()
+    extended = prompt + rng.integers(1, cfg.vocab_size, 20).tolist()
     eng.start()
     try:
-        gen(eng, prefix)                      # seeds the cache
-        import time as _time
-
-        for _ in range(100):
-            if eng.health()["kv_cache_host_bytes"] > 0:
-                break
-            _time.sleep(0.1)
-        got = gen(eng, extended)              # prefix hit
-        h = eng.health()
-        assert h["kv_cache_prefix_hits"] == 1, h
-        assert got == want
+        _gen(eng, prompt, n=4)
+        assert ("full", 80) in calls
+        _wait_blocks(eng)
+        calls.clear()
+        r = _gen(eng, extended, n=4)
     finally:
         eng.stop()
+    matched = r.prefix_tokens_reused
+    # 80-token prompt holds >= 5 full 16-blocks; all must be reused
+    assert matched >= 80 - 80 % 16
+    prefilled = sum(n for kind, n in calls if kind in ("full", "prefix"))
+    # the guard: prefill work on the hit is bounded by the unmatched
+    # tail — skipping at least the matched blocks' share
+    assert prefilled <= len(extended) - matched, (calls, matched)
+
+
+def test_chunked_prefix_hit_skips_matched_chunk_steps(shared):
+    """Chunked path: a seeded job takes ceil((len - matched)/chunk)
+    chunk steps; the cold job ceil(len/chunk). Step counts, not wall
+    time, so the assertion is CPU-stable."""
+    cfg, params = shared
+    rng = np.random.default_rng(5)
+    base = rng.integers(1, cfg.vocab_size, 96).tolist()
+    extended = base + rng.integers(1, cfg.vocab_size, 32).tolist()
+
+    def chunk_steps(eng, prompt, out):
+        req = GenRequest(
+            prompt_ids=list(prompt), max_tokens=4, temperature=0.0
+        )
+        eng.submit(req)
+        eng.step()      # admit; the same step advances the first chunk
+        steps = 1
+        while eng._chunk_jobs:
+            eng.step()
+            steps += 1
+            assert steps < 50
+        while not req.done.is_set():
+            if not eng.step():
+                eng._drain_pending()
+        out.append(req)
+        return steps
+
+    eng = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=256,
+        prefill_chunk=32, host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    reqs = []
+    cold_steps = chunk_steps(eng, extended, reqs)   # 128 tokens / 32
+    assert cold_steps >= 4
+    chunk_steps(eng, base, reqs)                    # seed 96-token base
+    eng._kv_copy_pool.shutdown(wait=True)           # stores land
+    assert eng.health()["kv_cache_blocks"] >= 96 // 16
+    hot_steps = chunk_steps(eng, extended, reqs)
+    matched = reqs[-1].prefix_tokens_reused
+    assert matched >= 96 - 96 % 16
+    # ceil((128 - matched)/32) vs ceil(128/32): at least the matched
+    # blocks' worth of chunk steps is skipped
+    assert hot_steps <= cold_steps - matched // 32, (
+        cold_steps, hot_steps, matched
+    )
+    # and the outputs agree with the cold run of the same prompt
+    assert reqs[-1].output_ids == reqs[0].output_ids

@@ -1,0 +1,140 @@
+"""Host KV cache observability: engine exporter wire format (strict
+Prometheus parse), worker normalization of the KV-cache metric
+families, and the engine hop's ``kv_upload`` trace phase.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from gpustack_tpu.engine.api_server import OpenAIServer
+from gpustack_tpu.engine.engine import LLMEngine
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+from gpustack_tpu.testing.promtext import (
+    assert_well_formed,
+    check_histograms,
+    parse_exposition,
+)
+
+KV_FAMILIES = (
+    "gpustack_kv_cache_hits",
+    "gpustack_kv_cache_misses",
+    "gpustack_kv_cache_prefix_tokens_reused",
+    "gpustack_kv_cache_bytes",
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=128,
+        host_kv_cache_mb=64, kv_block_tokens=16,
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _client_run(engine, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    server = OpenAIServer(engine, model_name="tiny-kv")
+
+    async def run():
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            return await coro_fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_engine_metrics_strict_format_and_kv_families(engine):
+    async def go(client):
+        r = await client.get("/metrics")
+        assert r.status == 200
+        return await r.text()
+
+    text = _client_run(engine, go)
+    # the whole exposition must survive the strict parser: TYPE before
+    # first sample, no duplicates, cumulative histograms, +Inf == count
+    samples, types = parse_exposition(text)
+    assert_well_formed(text)
+    check_histograms(samples, types)
+    for family in KV_FAMILIES:
+        assert family in types, family
+        assert any(s.name == family for s in samples), family
+    assert types["gpustack_kv_cache_bytes"] == "gauge"
+    assert types["gpustack_kv_cache_hits"] == "counter"
+
+
+def test_worker_normalizes_kv_families(engine):
+    async def go(client):
+        r = await client.get("/metrics")
+        return await r.text()
+
+    text = _client_run(engine, go)
+    from gpustack_tpu.worker.metrics_map import normalize_engine_metrics
+
+    normalized = "\n".join(
+        normalize_engine_metrics(text, {"instance_id": "7"})
+    )
+    assert "gpustack_tpu:kv_cache_hits" in normalized
+    assert "gpustack_tpu:kv_cache_misses" in normalized
+    assert "gpustack_tpu:kv_cache_prefix_tokens_reused" in normalized
+    assert "gpustack_tpu:kv_cache_host_bytes" in normalized
+
+
+def test_engine_trace_records_kv_upload_phase(engine):
+    """End-to-end through the aiohttp middleware: the second identical
+    completion prefix-hits the cache and its engine-hop trace carries a
+    ``kv_upload`` span plus a ``kv_prefix_hit`` event with the
+    reused-token count."""
+    import time as _time
+
+    from gpustack_tpu.observability.tracing import get_store
+
+    # byte-level tokenizer: a long text prompt spans several 16-blocks
+    body = {
+        "model": "tiny-kv",
+        "prompt": "the quick brown fox jumps over the lazy dog " * 2,
+        "max_tokens": 4,
+        "temperature": 0,
+    }
+    trace_id = "fe" * 16
+
+    async def call(client):
+        r = await client.post(
+            "/v1/completions",
+            json=body,
+            headers={"traceparent": f"00-{trace_id}-{'12' * 8}-01"},
+        )
+        assert r.status == 200
+
+    blocks_before = engine.health()["kv_cache_blocks"]
+    _client_run(engine, call)
+    deadline = _time.time() + 20
+    while (
+        engine.health()["kv_cache_blocks"] <= blocks_before
+        and _time.time() < deadline
+    ):
+        _time.sleep(0.05)
+
+    _client_run(engine, call)
+    entries = get_store("engine").query(trace_id=trace_id)
+    assert entries, "engine trace ring lost the hops"
+    hit = entries[0]                      # newest first = second call
+    spans = hit["spans"]
+    assert any(p["phase"] == "kv_upload" for p in spans), spans
+    events = hit.get("events", [])
+    assert any(
+        e.get("event") == "kv_prefix_hit"
+        and e["attrs"]["tokens_reused"] >= 32
+        for e in events
+    ), events

@@ -10,6 +10,14 @@ from gpustack_tpu.ops import sharded_prefill_attention
 from gpustack_tpu.parallel import MeshPlan, make_mesh
 
 
+def _set_mesh(mesh):
+    """jax.sharding.set_mesh is 0.6+; on 0.4.x the Mesh object itself
+    is the context manager that sets the default mesh."""
+    if hasattr(jax.sharding, "set_mesh"):
+        return jax.sharding.set_mesh(mesh)
+    return mesh
+
+
 @pytest.mark.parametrize("plan", [
     MeshPlan(dp=1, sp=4, ep=1, tp=2),
     MeshPlan(dp=2, sp=2, ep=1, tp=2),
@@ -28,7 +36,7 @@ def test_ring_attention_matches_full(plan):
     mask = positions[:, :, None] >= positions[:, None, :]
     ref = _attend(q, k, v, mask, scale)
 
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         out = jax.jit(
             lambda q, k, v, p: sharded_prefill_attention(
                 mesh, q, k, v, p, scale
@@ -54,7 +62,7 @@ def test_ring_attention_nonzero_offset_positions():
     scale = 1.0 / np.sqrt(d)
     mask = positions[:, :, None] >= positions[:, None, :]
     ref = _attend(q, k, v, mask, scale)
-    with jax.sharding.set_mesh(mesh):
+    with _set_mesh(mesh):
         out = jax.jit(
             lambda q, k, v, p: sharded_prefill_attention(
                 mesh, q, k, v, p, scale

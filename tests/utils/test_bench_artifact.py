@@ -155,3 +155,87 @@ def test_kill_outcome_zombie_counts_as_killed(monkeypatch, capsys):
     err = capsys.readouterr().err
     assert "unreaped zombie" in err
     assert "STILL ALIVE state=S" in err
+
+
+def test_sweep_rescans_and_fails_loudly(monkeypatch, capsys):
+    """The sweep must kill → reap → RE-SCAN, and when a holder survives
+    every round it must land in the diag and on stderr instead of
+    silently staying pinned (the r5 failure mode)."""
+    immortal = {"pid": 13, "cmd": "python -c import time...sleep",
+                "age_s": 50000.0}
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench, "_stale_chip_holders", lambda: [dict(immortal)]
+    )
+    monkeypatch.setattr(
+        bench, "_kill_stale_holders",
+        lambda holders: [
+            dict(h, kill_error=None, gone=False, proc_state="S")
+            for h in holders
+        ],
+    )
+    diag = {}
+    assert bench._sweep_stale_holders(diag) is False
+    # three rounds attempted, every outcome recorded
+    assert len(diag["stale_holders_killed"]) == 3
+    assert diag["stale_holders_unreaped"][0]["pid"] == 13
+    assert "FAILED to reap" in capsys.readouterr().err
+
+
+def test_sweep_succeeds_after_reap(monkeypatch, capsys):
+    """One kill round clears the holders: the re-scan comes back empty
+    and the sweep reports success with the outcomes recorded."""
+    scans = [[{"pid": 21, "cmd": "python bench.py", "age_s": 9999.0}]]
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench, "_stale_chip_holders",
+        lambda: scans.pop(0) if scans else [],
+    )
+    monkeypatch.setattr(
+        bench, "_kill_stale_holders",
+        lambda holders: [
+            dict(h, kill_error=None, gone=True, proc_state=None)
+            for h in holders
+        ],
+    )
+    diag = {}
+    assert bench._sweep_stale_holders(diag) is True
+    assert len(diag["stale_holders_killed"]) == 1
+    assert "stale_holders_unreaped" not in diag
+
+
+def test_multiturn_schedule_is_pure_and_shaped():
+    prof = dict(conversations=3, turns=2, system_len=16, user_len=8)
+    a = bench.multiturn_schedule(7, 1000, prof)
+    b = bench.multiturn_schedule(7, 1000, prof)
+    assert a == b                     # cold/hit passes replay identically
+    system, users = a
+    assert len(system) == 16
+    assert len(users) == 3 and all(len(c) == 2 for c in users)
+    assert all(len(u) == 8 for c in users for u in c)
+    assert bench.multiturn_schedule(8, 1000, prof) != a
+
+
+def test_summarize_multiturn_pairs_cold_and_hit():
+    cold = [
+        {"ttft_ms": 100.0, "reused": 0, "output_ids": [1, 2]},
+        {"ttft_ms": 120.0, "reused": 0, "output_ids": [3, 4]},
+        {"ttft_ms": 140.0, "reused": 0, "output_ids": [5, 6]},
+    ]
+    hit = [
+        {"ttft_ms": 95.0, "reused": 0, "output_ids": [1, 2]},    # cold turn
+        {"ttft_ms": 30.0, "reused": 64, "output_ids": [3, 4]},
+        {"ttft_ms": 40.0, "reused": 96, "output_ids": [5, 6]},
+    ]
+    s = bench.summarize_multiturn(cold, hit)
+    assert s["hit_turns"] == 2 and s["total_turns"] == 3
+    # paired medians: cold over the SAME turns that hit (120, 140)
+    assert s["cold_ttft_ms_p50"] == 140.0
+    assert s["hit_ttft_ms_p50"] == 40.0
+    assert s["ttft_improvement"] == round(1 - 40.0 / 140.0, 3)
+    assert s["token_parity"] is True
+    assert s["prefix_tokens_reused"] == 160
+
+    hit_bad = [dict(h) for h in hit]
+    hit_bad[2] = dict(hit_bad[2], output_ids=[9, 9])
+    assert bench.summarize_multiturn(cold, hit_bad)["token_parity"] is False
