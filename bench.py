@@ -945,6 +945,25 @@ def main() -> None:
 
     phases = {name: _quantiles_ms(h) for name, h in phase_hists.items()}
 
+    # flight-derived utilization (observability/flight.py): what the
+    # scheduler actually did per step — tokens/step, padding waste,
+    # occupancy, per-mode step time — so BENCH_r* measures engine
+    # efficiency, not just harness health. The recorder's own cost
+    # rides along (overhead_ratio; tier-1 asserts <1%).
+    fl = engine.flight.aggregate()
+    flight_detail = {
+        "steps": fl.get("steps", 0),
+        "tokens_per_step": fl.get("tokens_per_step", 0.0),
+        "padding_waste_pct": fl.get("padding_waste_pct", 0.0),
+        "occupancy_p50": fl.get("occupancy_p50", 0.0),
+        "occupancy_p95": fl.get("occupancy_p95", 0.0),
+        "queue_wait_ms_p50": fl.get("queue_wait_ms_p50", 0.0),
+        "queue_wait_ms_max": fl.get("queue_wait_ms_max", 0.0),
+        "spec_acceptance": fl.get("spec_acceptance"),
+        "modes": fl.get("modes", {}),
+        "recorder_overhead_ratio": fl.get("overhead_ratio", 0.0),
+    }
+
     import jax
 
     # Per-chip denominator from the mesh the engine actually ran on —
@@ -1002,6 +1021,7 @@ def main() -> None:
                     ),
                     "p50_ttft_ms": round(p50_ttft, 1),
                     "phases": phases,
+                    "flight": flight_detail,
                     "mfu_est": mfu,
                     "n_chips": n_chips,
                     "platform": jax.default_backend(),

@@ -49,7 +49,11 @@ REF_TOKEN = re.compile(
 )
 HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
 VALID_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
-FAMILIES_NAME = "METRIC_FAMILIES"
+# dict literals that declare metric vocabularies (name -> kind):
+# METRIC_FAMILIES (observability/metrics.py render vocabulary) and
+# NORMALIZED_FAMILIES (worker/metrics_map.py normalized namespace)
+FAMILY_DICT_NAMES = ("METRIC_FAMILIES", "NORMALIZED_FAMILIES")
+NORMALIZED_FAMILIES_NAME = "NORMALIZED_FAMILIES"
 
 
 class MetricsDriftRule(Rule):
@@ -88,26 +92,32 @@ class MetricsDriftRule(Rule):
 
     # ---- 0. METRIC_FAMILIES dict declarations --------------------------
 
-    def _family_decls(
-        self, tree, rel: str
-    ) -> Tuple[List[Tuple[str, str, str, int]], List[Finding]]:
-        """``METRIC_FAMILIES = {"name": "kind", ...}`` literals declare
-        metrics the same way a ``# TYPE`` string does (the histogram
-        renderer emits its TYPE lines from that vocabulary at runtime,
-        so the static view must read the same source of truth)."""
-        decls: List[Tuple[str, str, str, int]] = []
-        findings: List[Finding] = []
+    @staticmethod
+    def _dict_literal_items(tree, names):
+        """Yield ``(var_name, key_node, value_node)`` for every
+        string-keyed entry of module-level dict literals assigned to
+        one of ``names`` — both plain (``X = {}``) and annotated
+        (``X: Dict[str, str] = {}``) assignments (the annotated form is
+        what the production files actually use)."""
         for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign):
+            if isinstance(node, ast.Assign):
+                targets = [
+                    t.id for t in node.targets
+                    if isinstance(t, ast.Name)
+                ]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets = (
+                    [node.target.id]
+                    if isinstance(node.target, ast.Name) else []
+                )
+                value = node.value
+            else:
                 continue
-            if not any(
-                isinstance(t, ast.Name) and t.id == FAMILIES_NAME
-                for t in node.targets
-            ):
+            name = next((t for t in targets if t in names), None)
+            if name is None or not isinstance(value, ast.Dict):
                 continue
-            if not isinstance(node.value, ast.Dict):
-                continue
-            for k, v in zip(node.value.keys, node.value.values):
+            for k, v in zip(value.keys, value.values):
                 if not (
                     isinstance(k, ast.Constant)
                     and isinstance(k.value, str)
@@ -115,14 +125,29 @@ class MetricsDriftRule(Rule):
                     and isinstance(v.value, str)
                 ):
                     continue
-                if v.value not in VALID_KINDS:
-                    findings.append(self.finding(
-                        rel, k.lineno,
-                        f"{FAMILIES_NAME} kind '{v.value}' for "
-                        f"'{k.value}' is not one of {VALID_KINDS}",
-                    ))
-                    continue
-                decls.append((k.value, v.value, rel, k.lineno))
+                yield name, k, v
+
+    def _family_decls(
+        self, tree, rel: str
+    ) -> Tuple[List[Tuple[str, str, str, int]], List[Finding]]:
+        """``METRIC_FAMILIES = {"name": "kind", ...}`` (and
+        ``NORMALIZED_FAMILIES``) literals declare metrics the same way
+        a ``# TYPE`` string does (the renderers emit their TYPE lines
+        from those vocabularies at runtime, so the static view must
+        read the same source of truth)."""
+        decls: List[Tuple[str, str, str, int]] = []
+        findings: List[Finding] = []
+        for dict_name, k, v in self._dict_literal_items(
+            tree, FAMILY_DICT_NAMES
+        ):
+            if v.value not in VALID_KINDS:
+                findings.append(self.finding(
+                    rel, k.lineno,
+                    f"{dict_name} kind '{v.value}' for "
+                    f"'{k.value}' is not one of {VALID_KINDS}",
+                ))
+                continue
+            decls.append((k.value, v.value, rel, k.lineno))
         return decls, findings
 
     # ---- 1. TYPE declarations ------------------------------------------
@@ -190,45 +215,48 @@ class MetricsDriftRule(Rule):
         tree = src.tree if src else None
         if tree is None:
             return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign):
-                continue
-            if not any(
-                isinstance(t, ast.Name) and t.id == "METRIC_MAP"
-                for t in node.targets
+        # the declared normalized vocabulary: every METRIC_MAP value
+        # must be a member, so a gpustack_tpu:* typo in the map fails
+        # here instead of minting an undeclared series on the wire
+        normalized_vocab = {
+            k.value
+            for _n, k, _v in self._dict_literal_items(
+                tree, (NORMALIZED_FAMILIES_NAME,)
+            )
+        }
+        seen: Dict[str, int] = {}
+        for _name, k, v in self._dict_literal_items(
+            tree, ("METRIC_MAP",)
+        ):
+            if k.value in seen:
+                yield self.finding(
+                    METRICS_MAP_PATH, k.lineno,
+                    f"duplicate METRIC_MAP key '{k.value}' (a "
+                    f"dict literal silently keeps the last)",
+                )
+            seen.setdefault(k.value, k.lineno)
+            if not v.value.startswith(NORMALIZED_PREFIX):
+                yield self.finding(
+                    METRICS_MAP_PATH, v.lineno,
+                    f"METRIC_MAP value '{v.value}' must live under "
+                    f"the {NORMALIZED_PREFIX} namespace",
+                )
+            elif not WELL_FORMED.match(v.value):
+                yield self.finding(
+                    METRICS_MAP_PATH, v.lineno,
+                    f"METRIC_MAP value '{v.value}' is not "
+                    f"snake_case",
+                )
+            elif (
+                normalized_vocab
+                and v.value not in normalized_vocab
             ):
-                continue
-            if not isinstance(node.value, ast.Dict):
-                return
-            seen: Dict[str, int] = {}
-            for k, v in zip(node.value.keys, node.value.values):
-                if not (
-                    isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)
-                    and isinstance(v, ast.Constant)
-                    and isinstance(v.value, str)
-                ):
-                    continue
-                if k.value in seen:
-                    yield self.finding(
-                        METRICS_MAP_PATH, k.lineno,
-                        f"duplicate METRIC_MAP key '{k.value}' (a "
-                        f"dict literal silently keeps the last)",
-                    )
-                seen.setdefault(k.value, k.lineno)
-                if not v.value.startswith(NORMALIZED_PREFIX):
-                    yield self.finding(
-                        METRICS_MAP_PATH, v.lineno,
-                        f"METRIC_MAP value '{v.value}' must live under "
-                        f"the {NORMALIZED_PREFIX} namespace",
-                    )
-                elif not WELL_FORMED.match(v.value):
-                    yield self.finding(
-                        METRICS_MAP_PATH, v.lineno,
-                        f"METRIC_MAP value '{v.value}' is not "
-                        f"snake_case",
-                    )
-            return
+                yield self.finding(
+                    METRICS_MAP_PATH, v.lineno,
+                    f"METRIC_MAP value '{v.value}' is not declared "
+                    f"in {NORMALIZED_FAMILIES_NAME} (typo, or add "
+                    f"the family to the normalized vocabulary)",
+                )
 
     # ---- 3. doc/test references ----------------------------------------
 

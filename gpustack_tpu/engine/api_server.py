@@ -124,6 +124,8 @@ class OpenAIServer:
                 web.post("/v1/embeddings", self.embeddings),
                 web.post("/v1/rerank", self.rerank),
                 web.get("/metrics", self.metrics),
+                web.get("/debug/flight", self.debug_flight),
+                web.post("/debug/profile", self.debug_profile),
             ]
         )
         self._started = time.time()
@@ -181,6 +183,12 @@ class OpenAIServer:
         ):
             lines.append(f"# TYPE {family} {METRIC_FAMILIES[family]}")
             lines.append(f"{family} {value}")
+        # flight recorder: per-step scheduler telemetry (step-time
+        # histogram by mode, real-vs-padded dispatch, occupancy, queue
+        # wait, speculation economics — observability/flight.py)
+        flight = getattr(self.engine, "flight", None)
+        if flight is not None:
+            lines.extend(flight.metrics_lines())
         # request-latency histograms (vLLM's ttft/tpot observability
         # parity — the reference normalizes these into its dashboards,
         # metrics_config.yaml)
@@ -197,6 +205,59 @@ class OpenAIServer:
             lines.append(f"{name}_sum {total:.6f}")
             lines.append(f"{name}_count {count}")
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def debug_flight(self, request: web.Request) -> web.Response:
+        """Raw flight-recorder view: the most recent per-step records
+        plus windowed aggregates (``window_s=`` bounds the aggregate to
+        recent steps; ``limit=`` caps the raw records returned). The
+        fleet rollup (server ``GET /v2/debug/fleet``) consumes the same
+        numbers through the normalized /metrics path — this endpoint is
+        the ground truth it must agree with."""
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return _error(404, "engine has no flight recorder")
+        try:
+            limit = min(2048, int(request.query.get("limit", 100)))
+            window_s = request.query.get("window_s")
+            window = float(window_s) if window_s is not None else None
+        except ValueError:
+            return _error(400, "limit/window_s must be numbers")
+        return web.json_response({
+            "model": self.model_name,
+            "records": flight.snapshot(limit=limit),
+            "aggregate": flight.aggregate(window_s=window),
+            "overhead_ratio": round(flight.overhead_ratio(), 6),
+        })
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """On-demand profiler capture: wrap the next N busy scheduler
+        steps in ``jax.profiler.trace`` (when this jax build has the
+        profiler API — degrades to flight-records-only otherwise),
+        writing the artifact under ``out_dir``. Blocks until the steps
+        elapse or ``timeout_s`` passes; an idle engine returns whatever
+        it captured. Relayed from the server admin surface
+        (``POST /v2/model-instances/{id}/profile``) via the worker."""
+        try:
+            steps = int(request.query.get("steps", 20))
+            timeout_s = min(
+                120.0, float(request.query.get("timeout_s", 30.0))
+            )
+        except ValueError:
+            return _error(400, "steps/timeout_s must be numbers")
+        if steps < 1:
+            return _error(400, "steps must be >= 1")
+        out_dir = request.query.get("out_dir", "")
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None,
+                lambda: self.engine.capture_profile(
+                    steps, out_dir=out_dir, timeout_s=timeout_s
+                ),
+            )
+        except ValueError as e:
+            return _error(409, str(e))
+        return web.json_response(result)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:

@@ -32,6 +32,7 @@ import numpy as np
 from gpustack_tpu.engine.runner import DecodeState, ModelRunner
 from gpustack_tpu.engine.tokenizer import load_tokenizer
 from gpustack_tpu.models.config import ModelConfig
+from gpustack_tpu.observability import flight as _flight
 
 logger = logging.getLogger(__name__)
 
@@ -264,6 +265,23 @@ class LLMEngine:
         self._id_counter = itertools.count()
         self._step_count = 0
         self._tokens_generated = 0
+        # Flight recorder: one record per scheduler step, always on
+        # (observability/flight.py — the self-measured overhead ratio
+        # is exported and tier-1 asserts it stays <1% of step time).
+        self.flight = _flight.FlightRecorder(max_slots)
+        # per-step accumulators reset at the top of step(); written only
+        # by the scheduler thread
+        self._step_mode = ""
+        self._step_real = 0          # tokens genuinely dispatched
+        self._step_padded = 0        # tokens the padded dispatch computed
+        self._step_out = 0           # tokens delivered to requests
+        self._step_prompt = 0        # prompt tokens entering prefill
+        self._step_spec_proposed = 0
+        self._step_spec_accepted = 0
+        # on-demand profiler capture (capture_profile): the scheduler
+        # thread starts/stops the jax.profiler trace around N busy steps
+        self._profile_mu = threading.Lock()
+        self._profile: Optional[Dict[str, Any]] = None
         self.ttft_hist = LatencyHistogram(TTFT_BUCKETS_S)
         self.tpot_hist = LatencyHistogram(TPOT_BUCKETS_S)
         self.e2e_hist = LatencyHistogram(E2E_BUCKETS_S)
@@ -449,6 +467,10 @@ class LLMEngine:
             "waiting": self._waiting.qsize(),
             "steps": self._step_count,
             "tokens_generated": self._tokens_generated,
+            "prompt_tokens": self.flight.prompt_tokens_total,
+            "flight_overhead_ratio": round(
+                self.flight.overhead_ratio(), 6
+            ),
             "speculative": self.speculative,
             "spec_steps": self._spec_steps,
             "spec_extra_tokens": self._spec_hits,
@@ -523,19 +545,164 @@ class LLMEngine:
 
     def step(self) -> bool:
         """One scheduling iteration. Returns False when fully idle."""
+        t0 = time.perf_counter()
+        self._step_mode = ""
+        self._step_real = self._step_padded = 0
+        self._step_out = self._step_prompt = 0
+        self._step_spec_proposed = self._step_spec_accepted = 0
         admitted = self._admit()
         # at most one prefill chunk per step: decode cadence for running
         # slots is bounded by one chunk's latency, not a whole prompt's
         progressed = self._advance_chunk()
         if self._slots:
             self._decode_once()
+            self._flight_record(t0)
             return True
         if admitted or progressed or self._chunk_jobs:
+            self._flight_record(t0)
             return True
         # Nothing active: drain any lagging fetches so finished requests
         # complete deterministically.
         self._drain_pending()
+        if self._step_out or self._step_spec_accepted:
+            # tokens delivered by the drain would otherwise vanish when
+            # the next step resets the accumulators — record them so
+            # flight tokens_out/spec_accepted match tokens_generated
+            self._flight_record(t0)
         return not self._waiting.empty()
+
+    def _flight_record(self, t0: float) -> None:
+        """Seal this step's flight record (and advance an in-flight
+        profiler capture). Scheduler-thread only."""
+        dur_s = time.perf_counter() - t0
+        oldest = 0.0
+        try:
+            # peeking the queue head without its mutex is safe here:
+            # worst case a racing admit swaps the head and the gauge is
+            # one submit stale — observability, not control flow
+            oldest = time.time() - self._waiting.queue[0].submitted_at
+        except (IndexError, AttributeError):
+            pass
+        kv = self.host_kv_cache
+        self.flight.record(
+            dur_s=dur_s,
+            mode=self._step_mode or "decode",
+            slots_used=self.max_slots - len(self._free),
+            waiting=self._waiting.qsize(),
+            oldest_wait_s=max(0.0, oldest),
+            tokens_real=self._step_real,
+            tokens_padded=self._step_padded,
+            tokens_out=self._step_out,
+            prompt_tokens=self._step_prompt,
+            spec_proposed=self._step_spec_proposed,
+            spec_accepted=self._step_spec_accepted,
+            kv_blocks=kv.entries if kv is not None else 0,
+            kv_reused_total=(
+                kv.prefix_tokens_reused if kv is not None else 0
+            ),
+        )
+        if self._profile is not None:
+            self._profile_step()
+
+    # ---- on-demand profiler capture -----------------------------------
+
+    def capture_profile(
+        self, steps: int, out_dir: str = "", timeout_s: float = 30.0
+    ) -> Dict[str, Any]:
+        """Wrap the next ``steps`` busy scheduler steps in a
+        ``jax.profiler`` trace (hasattr-guarded: jax builds in this
+        container drift across 0.4.x — when the profiler API is
+        missing, or ``out_dir`` is empty, the capture degrades to
+        flight-records-only) and return the captured step summary.
+
+        Blocks up to ``timeout_s`` for the steps to elapse; an idle
+        engine returns whatever was captured by the deadline. One
+        capture at a time — a concurrent request gets a ValueError
+        (profiler state is process-global)."""
+        cap: Dict[str, Any] = {
+            "remaining": max(1, min(int(steps), 10_000)),
+            "requested": max(1, min(int(steps), 10_000)),
+            "records": [],
+            "out_dir": out_dir,
+            "profiler": "flight-only",
+            "started": False,
+            "error": "",
+            "done": threading.Event(),
+        }
+        with self._profile_mu:
+            if self._profile is not None:
+                raise ValueError(
+                    "a profile capture is already in progress"
+                )
+            self._profile = cap
+        cap["done"].wait(timeout_s)
+        with self._profile_mu:
+            if self._profile is cap:
+                self._profile = None
+            if cap["started"]:
+                # idle-timeout path: the scheduler never reached zero
+                # remaining, so the trace is still open — close it here
+                # (stop mid-step only truncates collection)
+                self._profiler_stop(cap)
+        records = list(cap["records"])
+        return {
+            "requested": cap["requested"],
+            "steps_captured": len(records),
+            "profiler": cap["profiler"],
+            "artifact": out_dir if cap["profiler"] == "jax" else "",
+            "error": cap["error"],
+            "records": records,
+            "aggregate": _flight.aggregate_records(
+                records, self.max_slots,
+                overhead_ratio=self.flight.overhead_ratio(),
+            ) if records else {},
+        }
+
+    def _profile_step(self) -> None:
+        """Advance the active capture by one recorded step (scheduler
+        thread; the lock only guards handoff with the capture thread's
+        timeout finalizer, never device work)."""
+        with self._profile_mu:
+            cap = self._profile
+            if cap is None or cap["remaining"] <= 0:
+                return
+            if not cap["started"]:
+                cap["started"] = True
+                if cap["out_dir"] and self._profiler_start(cap):
+                    cap["profiler"] = "jax"
+            snap = self.flight.snapshot(limit=1)
+            if snap:
+                cap["records"].append(snap[-1])
+            cap["remaining"] -= 1
+            if cap["remaining"] <= 0:
+                self._profiler_stop(cap)
+                self._profile = None
+                cap["done"].set()
+
+    @staticmethod
+    def _profiler_start(cap: Dict[str, Any]) -> bool:
+        prof = getattr(jax, "profiler", None)
+        start = getattr(prof, "start_trace", None)
+        if start is None or not hasattr(prof, "stop_trace"):
+            cap["error"] = "jax.profiler.start_trace unavailable"
+            return False
+        try:
+            start(cap["out_dir"])
+            return True
+        except Exception as e:  # profiler must never kill the loop
+            cap["error"] = f"start_trace failed: {e}"
+            return False
+
+    @staticmethod
+    def _profiler_stop(cap: Dict[str, Any]) -> None:
+        if cap.get("profiler") != "jax" or cap.get("_stopped"):
+            return
+        cap["_stopped"] = True
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            cap["error"] = f"stop_trace failed: {e}"
+            cap["profiler"] = "flight-only"
 
     def _plan_chunk_job(
         self, req: GenRequest, ids, matched: int = 0
@@ -633,6 +800,10 @@ class LLMEngine:
             return True
         start = job.done
         chunk = job.ids[start : start + self.prefill_chunk]
+        self._step_mode = self._step_mode or "prefill_chunk"
+        self._step_real += len(chunk)
+        self._step_prompt += len(chunk)
+        self._step_padded += self.runner.bucket_for(len(chunk))
         # chunk-specific runner entry points exist on the multi-host
         # BroadcastingRunner (separate follower register + no device
         # arrays on the wire); the single-host runner serves both roles
@@ -703,6 +874,10 @@ class LLMEngine:
             # VLM prompt: placeholder ids alias across different images,
             # so the token-keyed host KV cache and chunked prefill don't
             # apply — one fused prefill with the embedding override
+            self._step_mode = self._step_mode or "prefill"
+            self._step_real += len(ids)
+            self._step_prompt += len(ids)
+            self._step_padded += bucket
             embeds, mask = req.embeds_override
             pad_rows = bucket - len(ids)
             embeds = np.pad(
@@ -729,6 +904,7 @@ class LLMEngine:
             # long prompt: prefill in chunks, one per scheduler step
             # (the step loop interleaves decode between chunks; the job
             # planner seeds from the host cache's matched block run)
+            self._step_mode = self._step_mode or "prefill_chunk"
             self._chunk_jobs[slot] = job
             return
         use_len = matched
@@ -761,6 +937,10 @@ class LLMEngine:
             suffix = ids[use_len:]
             sb = self.runner.bucket_for(len(suffix))
             total_bucket = self.runner.bucket_for(use_len + sb)
+            self._step_mode = self._step_mode or "prefill"
+            self._step_real += len(suffix)
+            self._step_prompt += len(suffix)
+            self._step_padded += sb
             t0 = time.time()
             pk_dev, pv_dev = self._upload_prefix(pk, pv, use_len)
             req.kv_upload_s = time.time() - t0
@@ -770,6 +950,10 @@ class LLMEngine:
                 total_bucket,
             )
         else:
+            self._step_mode = self._step_mode or "prefill"
+            self._step_real += len(ids)
+            self._step_prompt += len(ids)
+            self._step_padded += bucket
             last_logits, k, v = self.runner.prefill(padded, len(ids))
         if kv_cache is not None:
             self._submit_kv_copy(ids, k, v, len(ids))
@@ -928,6 +1112,7 @@ class LLMEngine:
             self._spec_steps += 1
             self._spec_proposed += len(owners) * (self.spec_tokens - 1)
             self._pending.append((("spec", (tokens, produced)), owners))
+            self._note_spec_dispatch(len(owners))
         elif self.draft_runner is not None and self._spec_safe():
             proposals = self._draft_propose()
             self._state, tokens, produced = self.runner.verify_step(
@@ -936,15 +1121,29 @@ class LLMEngine:
             self._spec_steps += 1
             self._spec_proposed += len(owners) * (self.spec_tokens - 1)
             self._pending.append((("spec", (tokens, produced)), owners))
+            self._note_spec_dispatch(len(owners))
         else:
             self._key, step_key = jax.random.split(self._key)
             self._state, out = self.runner.decode_step(
                 self._state, step_key
             )
             self._pending.append((("decode", out), owners))
+            # decode runs every slot whether or not it is active: the
+            # idle-slot share is the decode side of padding waste
+            self._step_mode = self._step_mode or "decode"
+            self._step_real += len(owners)
+            self._step_padded += self.max_slots
         self._step_count += 1
         if len(self._pending) > _FETCH_LAG:
             self._process_fetch(*self._pending.pop(0))
+
+    def _note_spec_dispatch(self, active: int) -> None:
+        """Flight accounting for one verify step: every slot computes
+        spec_tokens positions whether active or not."""
+        self._step_mode = self._step_mode or "spec_verify"
+        self._step_real += active * self.spec_tokens
+        self._step_padded += self.max_slots * self.spec_tokens
+        self._step_spec_proposed += active * (self.spec_tokens - 1)
 
     # ---- speculative decoding (greedy n-gram) -------------------------
 
@@ -1049,6 +1248,7 @@ class LLMEngine:
                 continue
             if produced is not None:
                 self._spec_hits += n - 1
+                self._step_spec_accepted += n - 1
             lps = None
             if lp_arr is not None and info.request.logprobs:
                 lps = [(
@@ -1081,6 +1281,7 @@ class LLMEngine:
                     req.output_logprobs.append(lps[j][0])
                     req.output_top_logprobs.append(lps[j][1])
                 self._tokens_generated += 1
+                self._step_out += 1
                 info.buffer_ids.append(tok)
                 if info.ngram is not None:
                     info.ngram.append(tok)

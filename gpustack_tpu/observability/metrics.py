@@ -40,6 +40,19 @@ METRIC_FAMILIES = {
     "gpustack_kv_cache_misses": "counter",
     "gpustack_kv_cache_prefix_tokens_reused": "counter",
     "gpustack_kv_cache_bytes": "gauge",
+    # engine flight recorder (observability/flight.py): per-step
+    # scheduler telemetry, emitted by the engine exporter and
+    # normalized by the worker (worker/metrics_map.py)
+    "gpustack_engine_step_seconds": "histogram",
+    "gpustack_engine_dispatched_tokens_total": "counter",
+    "gpustack_engine_prompt_tokens_total": "counter",
+    "gpustack_engine_occupancy_ratio": "gauge",
+    "gpustack_engine_queue_oldest_wait_seconds": "gauge",
+    "gpustack_engine_queue_depth": "gauge",
+    "gpustack_engine_spec_proposed_total": "counter",
+    "gpustack_engine_spec_accepted_total": "counter",
+    "gpustack_engine_kv_blocks_used": "gauge",
+    "gpustack_engine_flight_overhead_ratio": "gauge",
 }
 
 # request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a

@@ -41,7 +41,10 @@ REQUEST_ID_HEADER = "X-Request-ID"
 # trace ring. Shared by the server's timing middleware, the generic
 # hop middleware below, and anything else that adopts tracing.
 UNTRACED_PATHS = frozenset(
-    {"/healthz", "/readyz", "/health", "/metrics", "/metrics/raw"}
+    {
+        "/healthz", "/readyz", "/health", "/metrics", "/metrics/raw",
+        "/debug/flight",
+    }
 )
 
 _TRACEPARENT_RE = re.compile(
@@ -165,8 +168,13 @@ class TraceStore:
         trace_id: str = "",
         model: str = "",
         min_duration_ms: float = 0.0,
+        phase: str = "",
+        outcome: str = "",
         limit: int = 50,
     ) -> List[Dict[str, Any]]:
+        """Filter the ring: ``phase`` keeps entries that recorded a span
+        with that name (e.g. ``kv_upload``, ``connect``); ``outcome``
+        matches the sealed outcome (``ok``/``error``/``shed``/…)."""
         with self._mu:
             entries = list(self._ring)
         out = []
@@ -176,6 +184,13 @@ class TraceStore:
             if model and entry.get("model") != model:
                 continue
             if entry.get("duration_ms", 0.0) < min_duration_ms:
+                continue
+            if outcome and entry.get("outcome") != outcome:
+                continue
+            if phase and not any(
+                p.get("phase") == phase
+                for p in entry.get("spans", ())
+            ):
                 continue
             out.append(entry)
             if len(out) >= max(1, limit):

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 
 import aiohttp
 from aiohttp import web
@@ -667,6 +668,11 @@ def add_extra_routes(app: web.Application) -> None:
             return err
         trace_id = request.query.get("trace_id", "").strip().lower()
         model = request.query.get("model", "")
+        # phase= keeps traces that recorded a span with that name
+        # (connect, ttft, kv_upload, …); outcome= matches the sealed
+        # outcome (ok/error/…) — docs/OBSERVABILITY.md lists both
+        phase = request.query.get("phase", "")
+        outcome = request.query.get("outcome", "")
         try:
             min_ms = float(request.query.get("min_duration_ms", 0))
             limit = min(200, int(request.query.get("limit", 50)))
@@ -684,7 +690,8 @@ def add_extra_routes(app: web.Application) -> None:
             items.extend(
                 tracing.get_store(component).query(
                     trace_id=trace_id, model=model,
-                    min_duration_ms=min_ms, limit=limit,
+                    min_duration_ms=min_ms, phase=phase,
+                    outcome=outcome, limit=limit,
                 )
             )
         items.sort(key=lambda e: e.get("started_at", 0.0), reverse=True)
@@ -700,6 +707,315 @@ def add_extra_routes(app: web.Application) -> None:
         )
 
     app.router.add_get("/v2/debug/traces", debug_traces)
+
+    # fleet rollup: which normalized series aggregate how. SUM gauges
+    # add across a model's replicas; MAX gauges answer "worst replica";
+    # RATE counters become per-second throughput between consecutive
+    # calls (the first call has no window and reports null rates).
+    FLEET_SUM_GAUGES = (
+        "gpustack_tpu:requests_running",
+        "gpustack_tpu:requests_waiting",
+        "gpustack_tpu:slots_total",
+        "gpustack_tpu:queue_depth",
+        "gpustack_tpu:kv_cache_host_bytes",
+        "gpustack_tpu:kv_blocks_used",
+    )
+    FLEET_MAX_GAUGES = (
+        "gpustack_tpu:queue_oldest_wait_seconds",
+        "gpustack_tpu:scrape_age_seconds",
+        "gpustack_tpu:flight_overhead_ratio",
+    )
+    FLEET_COUNTERS = (
+        "gpustack_tpu:prompt_tokens_total",
+        "gpustack_tpu:generation_tokens_total",
+        "gpustack_tpu:spec_proposed_total",
+        "gpustack_tpu:spec_accepted_total",
+        "gpustack_tpu:kv_cache_prefix_tokens_reused",
+    )
+
+    async def debug_fleet(request: web.Request):
+        """Cluster-wide engine saturation rollup: scrapes every READY
+        worker's /metrics (the normalized ``gpustack_tpu:*`` engine
+        series the worker already aggregates), groups by model, and
+        reports the signals a replica autoscaler consumes — tokens/s
+        prefill vs decode, occupancy, queue wait, KV pressure, spec
+        acceptance, and scrape staleness. Consistent by construction
+        with each engine's own ``GET /debug/flight``: both read the
+        same flight-recorder counters. Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.server.worker_request import worker_fetch
+        from gpustack_tpu.worker.metrics_map import (
+            NORMALIZED_PREFIX,
+            parse_metric_line,
+        )
+
+        if err := require_admin(request):
+            return err
+        now = time.time()
+        workers = [
+            w for w in await Worker.filter(limit=None)
+            if w.state == WorkerState.READY
+        ]
+        instances = await ModelInstance.filter(limit=None)
+        inst_model = {str(i.id): i.model_name for i in instances}
+        workers_out = {}
+        # per (model, instance_id) -> {metric: value}
+        samples: dict = {}
+
+        async def scrape(w):
+            try:
+                resp = await worker_fetch(
+                    request.app, w, "GET", "/metrics", control=True,
+                )
+                try:
+                    return w, (await resp.read()).decode(
+                        errors="replace"
+                    ), ""
+                finally:
+                    resp.release()
+            except (aiohttp.ClientError, OSError,
+                    asyncio.TimeoutError) as e:
+                return w, None, str(e)[:200]
+
+        # concurrent: one partitioned worker must cost the rollup its
+        # own timeout, not a per-worker serial sum
+        for w, body, err in await asyncio.gather(
+            *(scrape(w) for w in workers)
+        ):
+            if body is None:
+                workers_out[w.id] = {
+                    "name": w.name, "reachable": False, "error": err,
+                }
+                continue
+            workers_out[w.id] = {"name": w.name, "reachable": True}
+            for line in body.splitlines():
+                parsed = parse_metric_line(line)
+                if parsed is None:
+                    continue
+                name, labels, value = parsed
+                if not name.startswith(NORMALIZED_PREFIX):
+                    continue
+                if "le" in labels or name.endswith(
+                    ("_bucket", "_sum", "_count")
+                ):
+                    # histogram series stay per-engine: the rollup
+                    # doesn't merge them, and keying them by bare name
+                    # would fold the per-mode series into one value
+                    continue
+                iid = labels.get("instance_id", "")
+                model = (
+                    labels.get("model")
+                    or inst_model.get(iid)
+                    or "unknown"
+                )
+                try:
+                    val = float(value)
+                except ValueError:
+                    continue
+                key = labels.get("kind")
+                metric = f"{name}|{key}" if key else name
+                samples.setdefault((model, iid), {})[metric] = val
+
+        models_out: dict = {}
+        for (model, iid), metrics in samples.items():
+            m = models_out.setdefault(model, {
+                "instances": 0,
+                "sums": {}, "maxes": {}, "counters": {},
+                "per_instance": {},
+            })
+            m["instances"] += 1
+            m["per_instance"][iid] = {
+                k: v for k, v in sorted(metrics.items())
+            }
+            for name in FLEET_SUM_GAUGES:
+                if name in metrics:
+                    m["sums"][name] = (
+                        m["sums"].get(name, 0.0) + metrics[name]
+                    )
+            for name in FLEET_MAX_GAUGES:
+                if name in metrics:
+                    m["maxes"][name] = max(
+                        m["maxes"].get(name, 0.0), metrics[name]
+                    )
+            for name in FLEET_COUNTERS:
+                if name in metrics:
+                    m["counters"][name] = (
+                        m["counters"].get(name, 0.0) + metrics[name]
+                    )
+            real = metrics.get(
+                "gpustack_tpu:dispatched_tokens_total|real"
+            )
+            padded = metrics.get(
+                "gpustack_tpu:dispatched_tokens_total|padded"
+            )
+            if real is not None and padded is not None:
+                c = m["counters"]
+                c["dispatched_real"] = (
+                    c.get("dispatched_real", 0.0) + real
+                )
+                c["dispatched_padded"] = (
+                    c.get("dispatched_padded", 0.0) + padded
+                )
+
+        # counter rates between consecutive calls (per-process cache)
+        prev = request.app.setdefault("fleet_scrape_prev", {})
+
+        def rate(model: str, metric: str, cur: float):
+            entry = prev.get((model, metric))
+            prev[(model, metric)] = (cur, now)
+            if entry is None:
+                return None
+            last, ts = entry
+            dt = now - ts
+            if dt <= 0 or cur < last:   # reset (replica restart)
+                return None
+            return round((cur - last) / dt, 3)
+
+        out_models = {}
+        for model, m in sorted(models_out.items()):
+            sums, maxes, counters = (
+                m["sums"], m["maxes"], m["counters"]
+            )
+            slots = sums.get("gpustack_tpu:slots_total", 0.0)
+            running = sums.get("gpustack_tpu:requests_running", 0.0)
+            proposed = counters.get(
+                "gpustack_tpu:spec_proposed_total", 0.0
+            )
+            accepted = counters.get(
+                "gpustack_tpu:spec_accepted_total", 0.0
+            )
+            d_real = counters.get("dispatched_real")
+            d_padded = counters.get("dispatched_padded")
+            out_models[model] = {
+                "instances": m["instances"],
+                "slots_total": int(slots),
+                "requests_running": int(running),
+                "requests_waiting": int(
+                    sums.get("gpustack_tpu:requests_waiting", 0.0)
+                ),
+                "occupancy": round(running / slots, 4) if slots else None,
+                "queue_oldest_wait_seconds": round(
+                    maxes.get(
+                        "gpustack_tpu:queue_oldest_wait_seconds", 0.0
+                    ), 3,
+                ),
+                "prefill_tokens_per_s": rate(
+                    model, "prompt_tokens",
+                    counters.get(
+                        "gpustack_tpu:prompt_tokens_total", 0.0
+                    ),
+                ),
+                "decode_tokens_per_s": rate(
+                    model, "generation_tokens",
+                    counters.get(
+                        "gpustack_tpu:generation_tokens_total", 0.0
+                    ),
+                ),
+                "prompt_tokens_total": int(counters.get(
+                    "gpustack_tpu:prompt_tokens_total", 0.0
+                )),
+                "generation_tokens_total": int(counters.get(
+                    "gpustack_tpu:generation_tokens_total", 0.0
+                )),
+                "spec_acceptance": (
+                    round(accepted / proposed, 4) if proposed else None
+                ),
+                "padding_waste_pct": (
+                    round(100.0 * (1.0 - d_real / d_padded), 2)
+                    if d_padded else None
+                ),
+                "kv": {
+                    "host_bytes": int(sums.get(
+                        "gpustack_tpu:kv_cache_host_bytes", 0.0
+                    )),
+                    "blocks": int(sums.get(
+                        "gpustack_tpu:kv_blocks_used", 0.0
+                    )),
+                    "prefix_tokens_reused": int(counters.get(
+                        "gpustack_tpu:kv_cache_prefix_tokens_reused",
+                        0.0,
+                    )),
+                },
+                "scrape_age_seconds_max": round(
+                    maxes.get("gpustack_tpu:scrape_age_seconds", 0.0),
+                    3,
+                ),
+                "flight_overhead_ratio_max": maxes.get(
+                    "gpustack_tpu:flight_overhead_ratio"
+                ),
+                "per_instance": m["per_instance"],
+            }
+        return web.json_response({
+            "scraped_at": now,
+            "workers": workers_out,
+            "models": out_models,
+        })
+
+    app.router.add_get("/v2/debug/fleet", debug_fleet)
+
+    async def instance_profile_capture(request: web.Request):
+        """Relay an on-demand profiler capture server → worker →
+        engine: wraps N scheduler steps in ``jax.profiler.trace`` on
+        the engine host (flight-records-only when that jax build has
+        no profiler), writes the artifact under the instance's log
+        dir, and returns its path plus the captured step summary.
+        Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.server.worker_request import worker_fetch
+
+        if err := require_admin(request):
+            return err
+        inst = await ModelInstance.get(int(request.match_info["id"]))
+        if inst is None:
+            return json_error(404, "instance not found")
+        worker = await Worker.get(inst.worker_id or 0)
+        if worker is None:
+            return json_error(
+                409, "instance is not placed on a worker"
+            )
+        try:
+            steps = int(request.query.get("steps", 20))
+            timeout_s = min(
+                120.0, float(request.query.get("timeout_s", 30.0))
+            )
+        except ValueError:
+            return json_error(400, "steps/timeout_s must be numbers")
+        if steps < 1:
+            return json_error(400, "steps must be >= 1")
+        path = (
+            f"/v2/instances/{inst.id}/profile"
+            f"?steps={steps}&timeout_s={timeout_s}"
+        )
+        try:
+            # a capture blocks until its steps elapse — long budget,
+            # never the control-retry tier (a retried POST would 409
+            # on the capture-in-progress guard)
+            resp = await worker_fetch(
+                request.app, worker, "POST", path,
+                timeout=timeout_s + 90,
+            )
+        except (
+            aiohttp.ClientError, OSError, asyncio.TimeoutError,
+        ) as e:
+            return json_error(502, f"worker unreachable: {e}")
+        try:
+            raw = await resp.read()
+        except (
+            aiohttp.ClientError, OSError, asyncio.TimeoutError,
+        ) as e:
+            return json_error(502, f"worker unreachable: {e}")
+        finally:
+            resp.release()
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            payload = {"error": raw.decode(errors="replace")[:500]}
+        return web.json_response(payload, status=resp.status)
+
+    app.router.add_post(
+        "/v2/model-instances/{id:\\d+}/profile",
+        instance_profile_capture,
+    )
 
     async def instance_timeline(request: web.Request):
         """Lifecycle timeline for one instance: how long it sat in each

@@ -57,16 +57,48 @@ def _usage(prompt: str, text: str) -> dict:
     }
 
 
+def _bucket(n: int) -> int:
+    """Power-of-two padding stand-in for the real runner's prefill
+    buckets — gives the stub's flight records a nonzero padding waste
+    the fleet-rollup e2e can assert on."""
+    b = 1
+    while b < max(1, n):
+        b *= 2
+    return b
+
+
 def build_app(
     served_name: str,
     fail_health_after: float = 0.0,
     token_delay: float = 0.0,
 ) -> web.Application:
+    from gpustack_tpu.observability.flight import FlightRecorder
     from gpustack_tpu.observability.tracing import trace_middleware
 
     # same trace hop contract as the real engine (engine/api_server.py):
     # hermetic e2es assert the full four-hop trace against this stub
     app = web.Application(middlewares=[trace_middleware("engine")])
+    # same flight-recorder contract as the real engine: one prefill +
+    # one decode record per generation, served at /debug/flight and on
+    # /metrics, so `GET /v2/debug/fleet` consistency is e2e-testable
+    # without TPUs
+    flight = FlightRecorder(slots_total=4)
+    app["flight"] = flight
+
+    def record_generation(pt: int, ct: int, dur_s: float) -> None:
+        flight.record(
+            dur_s=dur_s / 2, mode="prefill", slots_used=1,
+            waiting=0, oldest_wait_s=0.0,
+            tokens_real=pt, tokens_padded=_bucket(pt),
+            tokens_out=1, prompt_tokens=pt,
+        )
+        flight.record(
+            dur_s=dur_s / 2, mode="decode", slots_used=1,
+            waiting=0, oldest_wait_s=0.0,
+            tokens_real=max(0, ct - 1),
+            tokens_padded=flight.slots_total * max(0, ct - 1),
+            tokens_out=max(0, ct - 1),
+        )
 
     async def health(_request):
         if fail_health_after and time.time() - START > fail_health_after:
@@ -85,8 +117,13 @@ def build_app(
         prompt = " ".join(
             str(m.get("content", "")) for m in body.get("messages", [])
         )
+        t0 = time.perf_counter()
         text = _gen_text(prompt, int(body.get("max_tokens", 16)))
         usage = _usage(prompt, text)
+        record_generation(
+            usage["prompt_tokens"], usage["completion_tokens"],
+            time.perf_counter() - t0,
+        )
         rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
         if body.get("stream"):
             resp = web.StreamResponse(
@@ -131,14 +168,20 @@ def build_app(
     async def completions(request: web.Request):
         body = await request.json()
         prompt = str(body.get("prompt", ""))
+        t0 = time.perf_counter()
         text = _gen_text(prompt, int(body.get("max_tokens", 16)))
+        usage = _usage(prompt, text)
+        record_generation(
+            usage["prompt_tokens"], usage["completion_tokens"],
+            time.perf_counter() - t0,
+        )
         return web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:12]}",
             "object": "text_completion",
             "created": int(time.time()), "model": served_name,
             "choices": [{"index": 0, "text": text,
                          "finish_reason": "stop"}],
-            "usage": _usage(prompt, text),
+            "usage": usage,
         })
 
     async def metrics(_request):
@@ -152,14 +195,66 @@ def build_app(
             f"vllm:generation_tokens_total {STATS['generation_tokens']}",
             "# TYPE vllm:request_success_total counter",
             f"vllm:request_success_total {STATS['requests']}",
+            # in-repo engine gauge names too, so the fleet rollup's
+            # slots/occupancy math is exercised against the stub
+            "# TYPE gpustack_engine_slots_total gauge",
+            f"gpustack_engine_slots_total {flight.slots_total}",
+            "# TYPE gpustack_engine_slots_used gauge",
+            "gpustack_engine_slots_used 0",
+            "# TYPE gpustack_engine_waiting gauge",
+            "gpustack_engine_waiting 0",
         ]
+        # flight families ride along exactly like the real engine
+        # exporter, so the worker's normalization and the server's
+        # fleet rollup see the full vocabulary in hermetic e2es
+        lines.extend(flight.metrics_lines())
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def debug_flight(request: web.Request):
+        try:
+            limit = min(2048, int(request.query.get("limit", 100)))
+        except ValueError:
+            return web.json_response(
+                {"error": "limit must be an integer"}, status=400
+            )
+        return web.json_response({
+            "model": served_name,
+            "records": flight.snapshot(limit=limit),
+            "aggregate": flight.aggregate(),
+            "overhead_ratio": round(flight.overhead_ratio(), 6),
+        })
+
+    async def debug_profile(request: web.Request):
+        # the stub has no jax: permanently the flight-only degradation
+        # path of the real engine's /debug/profile contract
+        try:
+            steps = int(request.query.get("steps", 20))
+        except ValueError:
+            return web.json_response(
+                {"error": "steps must be an integer"}, status=400
+            )
+        records = flight.snapshot(limit=max(1, steps))
+        from gpustack_tpu.observability.flight import aggregate_records
+
+        return web.json_response({
+            "requested": steps,
+            "steps_captured": len(records),
+            "profiler": "flight-only",
+            "artifact": "",
+            "error": "jax.profiler.start_trace unavailable",
+            "records": records,
+            "aggregate": aggregate_records(
+                records, flight.slots_total
+            ) if records else {},
+        })
 
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/v1/completions", completions)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_post("/debug/profile", debug_profile)
     return app
 
 

@@ -31,6 +31,24 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_kv_cache_prefix_tokens_reused":
         "gpustack_tpu:kv_cache_prefix_tokens_reused",
     "gpustack_kv_cache_bytes": "gpustack_tpu:kv_cache_host_bytes",
+    # engine flight recorder (observability/flight.py): per-step
+    # scheduler telemetry — the fleet rollup's saturation signals
+    "gpustack_engine_step_seconds": "gpustack_tpu:engine_step_seconds",
+    "gpustack_engine_dispatched_tokens_total":
+        "gpustack_tpu:dispatched_tokens_total",
+    "gpustack_engine_prompt_tokens_total":
+        "gpustack_tpu:prompt_tokens_total",
+    "gpustack_engine_occupancy_ratio": "gpustack_tpu:occupancy_ratio",
+    "gpustack_engine_queue_oldest_wait_seconds":
+        "gpustack_tpu:queue_oldest_wait_seconds",
+    "gpustack_engine_queue_depth": "gpustack_tpu:queue_depth",
+    "gpustack_engine_spec_proposed_total":
+        "gpustack_tpu:spec_proposed_total",
+    "gpustack_engine_spec_accepted_total":
+        "gpustack_tpu:spec_accepted_total",
+    "gpustack_engine_kv_blocks_used": "gpustack_tpu:kv_blocks_used",
+    "gpustack_engine_flight_overhead_ratio":
+        "gpustack_tpu:flight_overhead_ratio",
     # in-repo audio engine (engine/audio_server.py)
     "gpustack_tpu_audio_requests_total": "gpustack_tpu:audio_requests_total",
     "gpustack_tpu_audio_seconds_total": "gpustack_tpu:audio_seconds_total",
@@ -50,6 +68,43 @@ METRIC_MAP: Dict[str, str] = {
     "sglang:generation_tokens_total":
         "gpustack_tpu:generation_tokens_total",
     "sglang:token_usage": "gpustack_tpu:kv_cache_usage_ratio",
+}
+
+# Declared vocabulary of the normalized namespace (name -> prometheus
+# kind). Keep LITERAL: the metrics-drift analyzer reads this dict from
+# the AST (like METRIC_FAMILIES in observability/metrics.py) and
+# enforces that every METRIC_MAP value above is a member — a
+# ``gpustack_tpu:*`` typo in the map fails `make analyze` instead of
+# silently minting a series no dashboard has ever heard of.
+# ``gpustack_tpu:scrape_age_seconds`` is worker-emitted (not mapped):
+# the staleness gauge for each instance's scraped engine body.
+NORMALIZED_FAMILIES: Dict[str, str] = {
+    "gpustack_tpu:requests_running": "gauge",
+    "gpustack_tpu:slots_total": "gauge",
+    "gpustack_tpu:requests_waiting": "gauge",
+    "gpustack_tpu:decode_steps_total": "counter",
+    "gpustack_tpu:generation_tokens_total": "counter",
+    "gpustack_tpu:prompt_tokens_total": "counter",
+    "gpustack_tpu:ttft_seconds": "histogram",
+    "gpustack_tpu:tpot_seconds": "histogram",
+    "gpustack_tpu:e2e_request_seconds": "histogram",
+    "gpustack_tpu:kv_cache_hits": "counter",
+    "gpustack_tpu:kv_cache_misses": "counter",
+    "gpustack_tpu:kv_cache_prefix_tokens_reused": "counter",
+    "gpustack_tpu:kv_cache_host_bytes": "gauge",
+    "gpustack_tpu:kv_cache_usage_ratio": "gauge",
+    "gpustack_tpu:audio_requests_total": "counter",
+    "gpustack_tpu:audio_seconds_total": "counter",
+    "gpustack_tpu:engine_step_seconds": "histogram",
+    "gpustack_tpu:dispatched_tokens_total": "counter",
+    "gpustack_tpu:occupancy_ratio": "gauge",
+    "gpustack_tpu:queue_oldest_wait_seconds": "gauge",
+    "gpustack_tpu:queue_depth": "gauge",
+    "gpustack_tpu:spec_proposed_total": "counter",
+    "gpustack_tpu:spec_accepted_total": "counter",
+    "gpustack_tpu:kv_blocks_used": "gauge",
+    "gpustack_tpu:flight_overhead_ratio": "gauge",
+    "gpustack_tpu:scrape_age_seconds": "gauge",
 }
 
 _LINE = re.compile(

@@ -48,6 +48,9 @@ class RunningInstance:
         # external engines declare their own readiness endpoint (vLLM
         # uses /health) via BackendVersionConfig.health_path
         self.health_path = "/healthz"
+        # served model name: labels this instance's scraped engine
+        # metrics on the worker exporter (worker/server.py)
+        self.model_name = ""
 
 
 class ServeManager:
@@ -490,6 +493,7 @@ class ServeManager:
         run.port = port
         run.is_leader = is_leader
         run.health_path = health_path_for(model, backend)
+        run.model_name = inst.model_name or model.name
         self.running[instance_id] = run
 
         env = dict(os.environ)

@@ -11,7 +11,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from typing import Dict, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
@@ -57,6 +58,10 @@ class WorkerServer:
                 web.post(
                     "/v2/dev-instances/{id:\\d+}/exec", self.dev_exec
                 ),
+                web.post(
+                    "/v2/instances/{id:\\d+}/profile",
+                    self.instance_profile,
+                ),
                 web.route(
                     "*",
                     "/proxy/instances/{id:\\d+}/{tail:.*}",
@@ -72,6 +77,11 @@ class WorkerServer:
         # gate (ServeManager waits for zero before SIGTERM) and a
         # /metrics gauge
         self._inflight: Dict[int, int] = {}
+        # last-good engine scrape per instance: a wedged engine keeps
+        # serving its frozen gauges WITH a visibly growing
+        # gpustack_tpu:scrape_age_seconds instead of silently vanishing
+        # from (or freezing inside) the worker's /metrics
+        self._engine_scrape_cache: Dict[int, Tuple[str, float]] = {}
 
     def inflight_count(self, instance_id: int) -> int:
         return self._inflight.get(instance_id, 0)
@@ -278,12 +288,23 @@ class WorkerServer:
             normalize_engine_metrics,
         )
 
-        for iid, body in await self._scrape_engines():
-            lines.extend(
-                normalize_engine_metrics(
-                    body, {"instance_id": str(iid)}
+        scrapes = await self._scrape_engines()
+        if scrapes:
+            # scrape staleness: age of the body each instance's series
+            # below were read from — 0-ish on a live engine, growing on
+            # a wedged one (the cached last-good body keeps serving so
+            # the freeze is visible instead of silent)
+            lines.append("# TYPE gpustack_tpu:scrape_age_seconds gauge")
+            for iid, _body, age_s, _model in scrapes:
+                lines.append(
+                    f"gpustack_tpu:scrape_age_seconds"
+                    f'{{instance_id="{iid}"}} {age_s:.3f}'
                 )
-            )
+        for iid, body, _age_s, model in scrapes:
+            extra = {"instance_id": str(iid)}
+            if model:
+                extra["model"] = model
+            lines.extend(normalize_engine_metrics(body, extra))
         return web.Response(text="\n".join(lines) + "\n")
 
     async def metrics_raw(self, request: web.Request) -> web.Response:
@@ -291,30 +312,114 @@ class WorkerServer:
         from gpustack_tpu.worker.metrics_map import raw_engine_metrics
 
         lines = []
-        for iid, body in await self._scrape_engines():
-            lines.extend(
-                raw_engine_metrics(body, {"instance_id": str(iid)})
-            )
+        for iid, body, _age_s, model in await self._scrape_engines():
+            extra = {"instance_id": str(iid)}
+            if model:
+                extra["model"] = model
+            lines.extend(raw_engine_metrics(body, extra))
         return web.Response(text="\n".join(lines) + "\n")
 
-    async def _scrape_engines(self):
+    async def _scrape_engines(
+        self,
+    ) -> List[Tuple[int, str, float, str]]:
+        """Scrape every local engine's /metrics. Returns
+        ``(instance_id, body, age_seconds, model_name)`` per instance —
+        ``body`` is the freshest successful scrape (this call's when it
+        succeeded, the cached last-good one when the engine is wedged)
+        and ``age_seconds`` says how stale it is."""
         sm = self.agent.serve_manager
-        out = []
+        out: List[Tuple[int, str, float, str]] = []
         if not sm:
             return out
+        running = dict(sm.running)
         async with aiohttp.ClientSession() as session:
-            for iid, run in list(sm.running.items()):
+            for iid, run in running.items():
+                now = time.time()
                 try:
                     async with session.get(
                         f"http://127.0.0.1:{run.port}/metrics",
                         timeout=aiohttp.ClientTimeout(total=2),
                     ) as resp:
-                        if resp.status != 200:
-                            continue
-                        out.append((iid, await resp.text()))
+                        if resp.status == 200:
+                            self._engine_scrape_cache[iid] = (
+                                await resp.text(), now,
+                            )
                 except (aiohttp.ClientError, OSError):
-                    continue
+                    pass
+                cached = self._engine_scrape_cache.get(iid)
+                if cached is None:
+                    continue   # never scraped successfully yet
+                body, scraped_at = cached
+                out.append((
+                    iid, body, max(0.0, now - scraped_at),
+                    getattr(run, "model_name", ""),
+                ))
+        # instances gone from the routing table take their cache along
+        for iid in list(self._engine_scrape_cache):
+            if iid not in running:
+                self._engine_scrape_cache.pop(iid, None)
         return out
+
+    async def instance_profile(self, request: web.Request) -> web.Response:
+        """Relay an on-demand profiler capture to a local engine
+        (server admin ``POST /v2/model-instances/{id}/profile`` lands
+        here). The worker picks the artifact directory — under the
+        instance log dir, next to the engine's logs — because the
+        engine process runs on this host and can write it directly."""
+        sm = self.agent.serve_manager
+        if sm is None:
+            return web.json_response({"error": "not ready"}, status=503)
+        instance_id = int(request.match_info["id"])
+        run = sm.running.get(instance_id)
+        if run is None or not run.port:
+            return web.json_response(
+                {"error": f"instance {instance_id} not running here"},
+                status=404,
+                headers={"X-GPUStack-Worker": "instance-not-running"},
+            )
+        try:
+            steps = int(request.query.get("steps", 20))
+            timeout_s = min(
+                120.0, float(request.query.get("timeout_s", 30.0))
+            )
+        except ValueError:
+            return web.json_response(
+                {"error": "steps/timeout_s must be numbers"}, status=400
+            )
+        if steps < 1:
+            return web.json_response(
+                {"error": "steps must be >= 1"}, status=400
+            )
+        out_dir = os.path.join(
+            sm.log_dir, f"profile-{instance_id}-{int(time.time())}"
+        )
+        from urllib.parse import quote
+
+        url = (
+            f"http://127.0.0.1:{run.port}/debug/profile"
+            f"?steps={steps}&timeout_s={timeout_s}"
+            f"&out_dir={quote(out_dir, safe='')}"
+        )
+        if self._proxy_session is None or self._proxy_session.closed:
+            self._proxy_session = aiohttp.ClientSession()
+        try:
+            async with self._proxy_session.post(
+                url,
+                timeout=aiohttp.ClientTimeout(total=timeout_s + 60),
+            ) as upstream:
+                try:
+                    payload = await upstream.json()
+                except (aiohttp.ContentTypeError, ValueError):
+                    payload = {"error": await upstream.text()}
+                return web.json_response(
+                    payload, status=upstream.status
+                )
+        except (
+            aiohttp.ClientError, OSError, asyncio.TimeoutError,
+        ) as e:
+            return web.json_response(
+                {"error": f"engine unreachable: {e}"}, status=502
+            )
 
     async def filesystem_probe(self, request: web.Request) -> web.Response:
         """Probe a worker-local model path for the scheduler/evaluator

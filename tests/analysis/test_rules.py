@@ -599,6 +599,85 @@ class TestMetricsDrift:
         assert "duplicate METRIC_MAP key" in msgs
         assert "must live under the gpustack_tpu:" in msgs
 
+    def test_metric_map_annotated_assign_recognized(self, tmp_path):
+        # the production file uses `METRIC_MAP: Dict[str, str] = {}` —
+        # the AnnAssign form must be checked, not just plain Assign
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/worker/metrics_map.py": (
+                    "from typing import Dict\n"
+                    "METRIC_MAP: Dict[str, str] = {\n"
+                    '    "vllm:c_total": "unprefixed_total",\n'
+                    "}\n"
+                )
+            },
+        )
+        assert any(
+            "must live under the gpustack_tpu:" in f.message
+            for f in found
+        )
+
+    def test_metric_map_value_outside_normalized_vocab_fails(
+        self, tmp_path
+    ):
+        # a gpustack_tpu:* typo in the map mints a series no dashboard
+        # knows — membership in NORMALIZED_FAMILIES is enforced
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/worker/metrics_map.py": (
+                    "from typing import Dict\n"
+                    "METRIC_MAP: Dict[str, str] = {\n"
+                    '    "vllm:a_total": "gpustack_tpu:a_total",\n'
+                    '    "vllm:b_total": "gpustack_tpu:b_totaal",\n'
+                    "}\n"
+                    "NORMALIZED_FAMILIES: Dict[str, str] = {\n"
+                    '    "gpustack_tpu:a_total": "counter",\n'
+                    '    "gpustack_tpu:b_total": "counter",\n'
+                    "}\n"
+                )
+            },
+        )
+        hits = [
+            f for f in found
+            if "not declared in NORMALIZED_FAMILIES" in f.message
+        ]
+        assert len(hits) == 1 and "b_totaal" in hits[0].message
+
+    def test_metric_map_vocab_members_clean(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/worker/metrics_map.py": (
+                    "from typing import Dict\n"
+                    "METRIC_MAP: Dict[str, str] = {\n"
+                    '    "vllm:a_total": "gpustack_tpu:a_total",\n'
+                    "}\n"
+                    "NORMALIZED_FAMILIES: Dict[str, str] = {\n"
+                    '    "gpustack_tpu:a_total": "counter",\n'
+                    "}\n"
+                )
+            },
+        ) == []
+
+    def test_normalized_families_invalid_kind_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            {
+                "gpustack_tpu/worker/metrics_map.py": (
+                    "NORMALIZED_FAMILIES = {\n"
+                    '    "gpustack_tpu:x_total": "countr",\n'
+                    "}\n"
+                )
+            },
+        )
+        assert any(
+            "is not one of" in f.message
+            and "NORMALIZED_FAMILIES" in f.message
+            for f in found
+        )
+
 
 # ---------------------------------------------------------------------------
 # framework: baseline ratchet

@@ -1,0 +1,256 @@
+"""Tier-1 fleet telemetry e2e (ISSUE 7): in-process server + REAL
+worker HTTP server + stub engine speaking the real engine's flight
+contract, on loopback TCP — no TPUs, no subprocesses.
+
+Asserts the acceptance criteria that don't need a real jax engine:
+
+- `GET /v2/debug/fleet` returns a per-model rollup consistent with the
+  engine's own `GET /debug/flight` (padding waste, slots, prompt
+  tokens — both read the same flight-recorder counters);
+- counter rates appear from the second scrape on;
+- the worker exporter emits `gpustack_tpu:scrape_age_seconds` and
+  keeps serving the cached engine body (age growing) after the engine
+  dies, and the whole exposition stays strictly parseable;
+- `POST /v2/model-instances/{id}/profile` relays server → worker →
+  engine and returns the flight-only capture (the stub has no jax —
+  the real-profiler path is tests/engine/test_flight_profile.py).
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.testing import promtext
+from gpustack_tpu.testing.stub_engine import build_app as engine_app
+from gpustack_tpu.worker.server import WorkerServer
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+class _StubDetector:
+    def detect(self):
+        return SimpleNamespace(
+            cpu_count=1,
+            memory_total_bytes=1,
+            memory_used_bytes=0,
+            chips=[],
+        )
+
+
+async def _start_engine(name):
+    from aiohttp import web
+
+    app = engine_app(name)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+    return runner, port, app
+
+
+async def _start_worker(tmp_path, instances):
+    agent = SimpleNamespace(
+        serve_manager=SimpleNamespace(
+            running={
+                iid: SimpleNamespace(port=port, model_name=model)
+                for iid, (port, model) in instances.items()
+            },
+            log_dir=str(tmp_path),
+        ),
+        proxy_secret="proxy-secret",
+        detector=_StubDetector(),
+        cfg=SimpleNamespace(cache_dir=str(tmp_path)),
+        worker_id=1,
+    )
+    ws = WorkerServer(agent)
+    port = await ws.start("127.0.0.1", 0)
+    return ws, port
+
+
+def test_fleet_rollup_and_profile_relay(cfg, tmp_path):
+    async def go():
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        hdrs = {"Authorization": f"Bearer {token}"}
+        model = await Model.create(
+            Model(name="fleet-model", preset="tiny")
+        )
+        engine_runner, engine_port, engine = await _start_engine(
+            "fleet-model"
+        )
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="fleet-model-0", model_id=model.id,
+                model_name=model.name,
+                state=ModelInstanceState.RUNNING,
+            )
+        )
+        worker_server, worker_port = await _start_worker(
+            tmp_path, {inst.id: (engine_port, model.name)}
+        )
+        worker = await Worker.create(
+            Worker(
+                name="w0", ip="127.0.0.1", port=worker_port,
+                state=WorkerState.READY,
+                proxy_secret="proxy-secret",
+            )
+        )
+        await inst.update(worker_id=worker.id)
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            async def chat(n=3):
+                for _ in range(n):
+                    resp = await client.post(
+                        "/v1/chat/completions",
+                        headers=hdrs,
+                        json={
+                            "model": "fleet-model",
+                            "messages": [
+                                {"role": "user",
+                                 "content": "fleet telemetry check"}
+                            ],
+                            "max_tokens": 8,
+                        },
+                    )
+                    assert resp.status == 200, await resp.text()
+
+            await chat()
+
+            # --- engine ground truth ----------------------------------
+            flight = engine["flight"]
+            truth = flight.aggregate()
+            assert truth["steps"] > 0
+
+            # --- fleet rollup consistent with /debug/flight -----------
+            r = await client.get("/v2/debug/fleet", headers=hdrs)
+            assert r.status == 200, await r.text()
+            fleet = await r.json()
+            assert fleet["workers"][str(worker.id)]["reachable"]
+            m = fleet["models"]["fleet-model"]
+            assert m["instances"] == 1
+            assert m["slots_total"] == flight.slots_total
+            # both read the same cumulative flight counters
+            assert m["padding_waste_pct"] == pytest.approx(
+                truth["padding_waste_pct"], abs=0.011
+            )
+            assert m["prompt_tokens_total"] == (
+                flight.prompt_tokens_total
+            )
+            assert m["kv"]["host_bytes"] == 0
+            assert m["scrape_age_seconds_max"] >= 0.0
+            assert m["queue_oldest_wait_seconds"] >= 0.0
+            per_inst = m["per_instance"][str(inst.id)]
+            assert (
+                per_inst["gpustack_tpu:occupancy_ratio"] is not None
+            )
+            # first scrape: no window yet, rates must be null not fake
+            assert m["decode_tokens_per_s"] is None
+
+            # --- rates appear on the second scrape --------------------
+            await chat()
+            r = await client.get("/v2/debug/fleet", headers=hdrs)
+            m = (await r.json())["models"]["fleet-model"]
+            assert m["decode_tokens_per_s"] is not None
+            assert m["decode_tokens_per_s"] >= 0.0
+            assert m["prefill_tokens_per_s"] is not None
+
+            # --- worker exporter: staleness gauge + strict format -----
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{worker_port}/metrics"
+                ) as wr:
+                    body = await wr.text()
+            samples, _types = promtext.assert_well_formed(body)
+            ages = [
+                s2 for s2 in samples
+                if s2.name == "gpustack_tpu:scrape_age_seconds"
+            ]
+            assert ages and ages[0].labels["instance_id"] == str(
+                inst.id
+            )
+            # normalized engine series carry the model label
+            assert any(
+                s2.labels.get("model") == "fleet-model"
+                for s2 in samples
+                if s2.name.startswith("gpustack_tpu:")
+            )
+
+            # --- profile capture relay (flight-only on the stub) ------
+            r = await client.post(
+                f"/v2/model-instances/{inst.id}/profile?steps=4",
+                headers=hdrs,
+            )
+            assert r.status == 200, await r.text()
+            prof = await r.json()
+            assert prof["profiler"] == "flight-only"
+            assert prof["steps_captured"] >= 1
+            assert prof["artifact"] == ""
+            assert prof["aggregate"]["steps"] == prof["steps_captured"]
+
+            # admin-only surfaces reject anonymous callers
+            r = await client.get("/v2/debug/fleet")
+            assert r.status in (401, 403)
+            r = await client.post(
+                f"/v2/model-instances/{inst.id}/profile?steps=1"
+            )
+            assert r.status in (401, 403)
+
+            # --- dead engine: cached gauges keep serving, age grows ---
+            await engine_runner.cleanup()
+            await asyncio.sleep(0.05)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{worker_port}/metrics"
+                ) as wr:
+                    body = await wr.text()
+            samples, _types = promtext.assert_well_formed(body)
+            ages = [
+                s2 for s2 in samples
+                if s2.name == "gpustack_tpu:scrape_age_seconds"
+            ]
+            assert ages and ages[0].value > 0.0
+            # the frozen engine series are still present (cached body)
+            assert any(
+                s2.name == "gpustack_tpu:prompt_tokens_total"
+                for s2 in samples
+            )
+        finally:
+            await client.close()
+            await worker_server.stop()
+            await engine_runner.cleanup()
+
+    asyncio.run(go())

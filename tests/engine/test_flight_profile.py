@@ -1,0 +1,184 @@
+"""Engine flight recorder + on-demand profiler capture (ISSUE 7):
+the scheduler feeds one record per step with honest mode/token
+accounting, the recorder's measured overhead stays under 1% of step
+wall time on the CPU smoke, and capture_profile wraps N steps in
+jax.profiler when this jax has one — degrading to flight-only when it
+doesn't. Hermetic: tiny model, CPU."""
+
+import os
+import threading
+
+import jax
+import pytest
+
+from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+from gpustack_tpu.testing import promtext
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    eng = LLMEngine(cfg, params, max_slots=4, max_seq_len=64)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _gen(engine, n=6, prompt=(5, 17, 42, 99, 7)):
+    return engine.generate(
+        GenRequest(
+            prompt_ids=list(prompt), max_tokens=n, temperature=0.0
+        ),
+        timeout=120,
+    )
+
+
+def test_flight_records_prefill_and_decode(engine):
+    _gen(engine)
+    agg = engine.flight.aggregate()
+    assert agg["steps"] > 0
+    assert "prefill" in agg["modes"] and "decode" in agg["modes"]
+    # the 5-token prompt prefilled into a padded bucket: waste > 0
+    assert agg["tokens_padded"] > agg["tokens_real"] > 0
+    assert agg["tokens_out"] > 0
+    assert agg["prompt_tokens"] >= 5
+    # health carries the same counters the exporter serves
+    h = engine.health()
+    assert h["prompt_tokens"] == engine.flight.prompt_tokens_total
+    assert h["flight_overhead_ratio"] < 0.5
+
+
+def test_flight_overhead_under_one_percent(engine):
+    """ISSUE 7 acceptance: recorder overhead <1% of step wall time on
+    the CPU stub smoke (real steps dispatch jit computations; the
+    recorder appends one tuple)."""
+    for _ in range(3):
+        _gen(engine)
+    ratio = engine.flight.overhead_ratio()
+    assert 0.0 < ratio < 0.01, ratio
+
+
+def test_engine_exporter_serves_flight_families(engine):
+    """The engine /metrics text stays strictly parseable with the
+    flight families present (gpustack_engine_step_seconds histogram by
+    mode, dispatched real/padded counters, occupancy gauge)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpustack_tpu.engine.api_server import OpenAIServer
+
+    _gen(engine)
+
+    async def go():
+        server = OpenAIServer(engine, model_name="tiny-flight")
+        client = TestClient(TestServer(server.app))
+        await client.start_server()
+        try:
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+            samples, types = promtext.assert_well_formed(
+                text,
+                require_histograms=["gpustack_engine_step_seconds"],
+            )
+            names = {s.name for s in samples}
+            assert "gpustack_engine_dispatched_tokens_total" in names
+            assert "gpustack_engine_occupancy_ratio" in names
+            assert "gpustack_engine_queue_depth" in names
+
+            # raw ring + aggregates over HTTP
+            resp = await client.get("/debug/flight?limit=10")
+            assert resp.status == 200
+            payload = await resp.json()
+            assert payload["model"] == "tiny-flight"
+            assert payload["records"]
+            assert payload["aggregate"]["steps"] > 0
+            assert payload["overhead_ratio"] < 0.01
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def _background_traffic(engine, n_reqs=3):
+    def go():
+        for _ in range(n_reqs):
+            _gen(engine, n=6)
+
+    t = threading.Thread(target=go)
+    t.start()
+    return t
+
+
+def test_capture_profile_with_jax_profiler(engine, tmp_path):
+    assert hasattr(jax.profiler, "start_trace"), (
+        "this jax build has no profiler; the degraded path is covered "
+        "by test_capture_profile_degrades_without_profiler"
+    )
+    out_dir = str(tmp_path / "prof")
+    t = _background_traffic(engine)
+    try:
+        result = engine.capture_profile(8, out_dir=out_dir, timeout_s=30)
+    finally:
+        t.join()
+    assert result["profiler"] == "jax", result["error"]
+    assert result["artifact"] == out_dir
+    assert result["steps_captured"] >= 1
+    assert result["aggregate"]["steps"] == result["steps_captured"]
+    # jax writes the trace tree under the artifact dir
+    assert os.path.isdir(out_dir) and os.listdir(out_dir)
+
+
+def test_capture_profile_degrades_without_profiler(
+    engine, tmp_path, monkeypatch
+):
+    """jax 0.4.x drift guard: with no usable profiler API the capture
+    still returns flight records and says so instead of crashing the
+    scheduler."""
+    import gpustack_tpu.engine.engine as engine_mod
+
+    class _NoProfiler:
+        profiler = None
+
+        def __getattr__(self, name):
+            return getattr(jax, name)
+
+    monkeypatch.setattr(engine_mod, "jax", _NoProfiler())
+    t = _background_traffic(engine)
+    try:
+        result = engine.capture_profile(
+            5, out_dir=str(tmp_path / "x"), timeout_s=30
+        )
+    finally:
+        t.join()
+    assert result["profiler"] == "flight-only"
+    assert result["artifact"] == ""
+    assert "unavailable" in result["error"]
+    assert result["steps_captured"] >= 1
+
+
+def test_capture_profile_idle_times_out_gracefully(engine):
+    """No traffic: the capture returns empty at its deadline instead
+    of blocking forever."""
+    result = engine.capture_profile(3, out_dir="", timeout_s=0.3)
+    assert result["profiler"] == "flight-only"
+    assert result["steps_captured"] == 0
+
+
+def test_capture_profile_concurrent_captures_rejected(engine):
+    t = threading.Thread(
+        target=lambda: engine.capture_profile(
+            1000, out_dir="", timeout_s=1.0
+        )
+    )
+    t.start()
+    import time as _time
+
+    _time.sleep(0.05)
+    with pytest.raises(ValueError):
+        engine.capture_profile(1, out_dir="", timeout_s=0.1)
+    t.join()

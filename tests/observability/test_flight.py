@@ -1,0 +1,142 @@
+"""FlightRecorder unit tests: ring bounds, aggregate math, exposition
+format, and the self-measured overhead contract (the recorder is
+always on in the engine scheduler, so its cost is itself a tested
+number — ISSUE 7 acceptance: <1% of step wall time)."""
+
+import time
+
+from gpustack_tpu.observability.flight import (
+    FlightRecorder,
+    aggregate_records,
+)
+from gpustack_tpu.testing import promtext
+
+
+def _rec(fr, **kw):
+    base = dict(
+        dur_s=0.002, mode="decode", slots_used=2, waiting=0,
+        oldest_wait_s=0.0, tokens_real=2, tokens_padded=4,
+        tokens_out=2,
+    )
+    base.update(kw)
+    fr.record(**base)
+
+
+class TestRing:
+    def test_bounded(self):
+        fr = FlightRecorder(slots_total=4, capacity=16)
+        for _ in range(100):
+            _rec(fr)
+        assert len(fr.snapshot(limit=1000)) == 16
+        # cumulative counters survive ring eviction
+        assert fr.tokens_out_total == 200
+
+    def test_snapshot_newest_last(self):
+        fr = FlightRecorder(slots_total=4)
+        _rec(fr, tokens_out=1)
+        _rec(fr, tokens_out=7)
+        snap = fr.snapshot(limit=1)
+        assert len(snap) == 1 and snap[0]["tokens_out"] == 7
+
+
+class TestAggregate:
+    def test_empty(self):
+        fr = FlightRecorder(slots_total=4)
+        agg = fr.aggregate()
+        assert agg["steps"] == 0 and agg["modes"] == {}
+
+    def test_padding_waste_and_occupancy(self):
+        fr = FlightRecorder(slots_total=4)
+        # prefill: 10 real tokens in a 16-wide bucket
+        _rec(fr, mode="prefill", tokens_real=10, tokens_padded=16,
+             tokens_out=1, slots_used=1, prompt_tokens=10)
+        # decode: 2 active of 4 slots
+        _rec(fr, mode="decode", tokens_real=2, tokens_padded=4,
+             tokens_out=2, slots_used=2)
+        agg = fr.aggregate()
+        assert agg["steps"] == 2
+        assert agg["tokens_real"] == 12 and agg["tokens_padded"] == 20
+        assert agg["padding_waste_pct"] == 40.0
+        assert agg["prompt_tokens"] == 10
+        assert agg["tokens_out"] == 3
+        assert set(agg["modes"]) == {"prefill", "decode"}
+        assert 0.0 < agg["occupancy_p50"] <= 0.5
+
+    def test_window_filters_old_records(self):
+        fr = FlightRecorder(slots_total=4)
+        _rec(fr)
+        # rewrite the stored timestamp to fake an old record
+        fr._ring[0] = (time.time() - 3600,) + fr._ring[0][1:]
+        _rec(fr)
+        assert fr.aggregate(window_s=60)["steps"] == 1
+        assert fr.aggregate()["steps"] == 2
+
+    def test_spec_acceptance(self):
+        fr = FlightRecorder(slots_total=4)
+        _rec(fr, mode="spec_verify", spec_proposed=12, spec_accepted=9)
+        agg = fr.aggregate()
+        assert agg["spec_acceptance"] == 0.75
+
+    def test_aggregate_records_standalone(self):
+        fr = FlightRecorder(slots_total=8)
+        for i in range(5):
+            _rec(fr, tokens_out=i)
+        subset = fr.snapshot(limit=2)
+        agg = aggregate_records(subset, 8)
+        assert agg["steps"] == 2 and agg["tokens_out"] == 3 + 4
+
+
+class TestMetricsLines:
+    def test_exposition_parses_strictly(self):
+        fr = FlightRecorder(slots_total=4)
+        _rec(fr, mode="prefill", tokens_real=10, tokens_padded=16,
+             prompt_tokens=10)
+        _rec(fr, mode="decode")
+        text = "\n".join(fr.metrics_lines()) + "\n"
+        samples, types = promtext.assert_well_formed(
+            text,
+            require_histograms=["gpustack_engine_step_seconds"],
+        )
+        by_name = {}
+        for s in samples:
+            by_name.setdefault(s.name, []).append(s)
+        real = [
+            s for s in by_name["gpustack_engine_dispatched_tokens_total"]
+            if s.labels.get("kind") == "real"
+        ]
+        assert real and real[0].value == 12
+        assert by_name["gpustack_engine_prompt_tokens_total"][0].value == 10
+        # step histogram labeled by mode
+        modes = {
+            s.labels.get("mode")
+            for s in by_name["gpustack_engine_step_seconds_count"]
+        }
+        assert modes == {"prefill", "decode"}
+
+    def test_families_all_declared(self):
+        from gpustack_tpu.observability.metrics import METRIC_FAMILIES
+
+        fr = FlightRecorder(slots_total=2)
+        _rec(fr)
+        _samples, types = promtext.parse_exposition(
+            "\n".join(fr.metrics_lines()) + "\n"
+        )
+        for family, kind in types.items():
+            assert METRIC_FAMILIES.get(family) == kind, family
+
+
+class TestOverhead:
+    def test_overhead_under_one_percent_of_realistic_steps(self):
+        """The acceptance bound: against steps of ~1ms (far below real
+        engine steps, which include a jit dispatch), recording must
+        cost <1% of step wall time."""
+        fr = FlightRecorder(slots_total=8)
+        for _ in range(300):
+            t0 = time.perf_counter()
+            time.sleep(0.001)      # stand-in for the device step
+            fr.record(
+                dur_s=time.perf_counter() - t0, mode="decode",
+                slots_used=4, waiting=2, oldest_wait_s=0.01,
+                tokens_real=4, tokens_padded=8, tokens_out=4,
+            )
+        assert fr.overhead_ratio() < 0.01, fr.overhead_ratio()

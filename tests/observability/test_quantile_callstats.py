@@ -1,0 +1,120 @@
+"""ISSUE 7 satellite coverage: Histogram.quantile edge cases (empty
+family, single bucket, +Inf-only mass, labeled series) and CallStats
+snapshot consistency under concurrent @timed callers."""
+
+import threading
+
+from gpustack_tpu.observability.metrics import Histogram
+from gpustack_tpu.utils.profiling import CallStats, timed
+
+
+class TestQuantileEdges:
+    def test_empty_family_returns_none(self):
+        h = Histogram("t_seconds", buckets=(0.1, 1.0))
+        assert h.quantile(0.5) is None
+        assert h.quantile(0.99) is None
+
+    def test_missing_labeled_series_returns_none(self):
+        h = Histogram(
+            "t_seconds", buckets=(0.1, 1.0), label_names=("phase",)
+        )
+        h.observe(0.05, phase="connect")
+        assert h.quantile(0.5, phase="ttft") is None
+        assert h.quantile(0.5, phase="connect") is not None
+
+    def test_single_bucket_histogram(self):
+        h = Histogram("t_seconds", buckets=(1.0,))
+        for _ in range(10):
+            h.observe(0.5)
+        q = h.quantile(0.5)
+        # all mass in [0, 1.0]: interpolation stays inside the bucket
+        assert q is not None and 0.0 < q <= 1.0
+
+    def test_all_mass_in_inf_bucket(self):
+        h = Histogram("t_seconds", buckets=(0.001,))
+        for _ in range(5):
+            h.observe(10.0)       # > top bucket -> +Inf
+        # quantile can't exceed the last finite bound — it clamps there
+        # instead of fabricating an infinite estimate
+        assert h.quantile(0.9) == 0.001
+
+    def test_zero_and_one_quantiles(self):
+        h = Histogram("t_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        q0 = h.quantile(0.0)
+        q1 = h.quantile(1.0)
+        assert q0 is not None and q1 is not None and q0 <= q1
+        assert q1 <= 10.0
+
+    def test_labeled_series_quantiles_independent(self):
+        h = Histogram(
+            "t_seconds",
+            buckets=(0.01, 0.1, 1.0),
+            label_names=("phase",),
+        )
+        for _ in range(20):
+            h.observe(0.005, phase="fast")
+            h.observe(0.5, phase="slow")
+        fast = h.quantile(0.5, phase="fast")
+        slow = h.quantile(0.5, phase="slow")
+        assert fast is not None and slow is not None
+        assert fast <= 0.01 < slow
+
+
+class TestCallStatsConcurrency:
+    def test_concurrent_timed_calls_consistent(self):
+        stats = CallStats()
+        n_threads, n_calls = 8, 200
+
+        def worker():
+            for _ in range(n_calls):
+                stats.record("hot.call", 0.001)
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = stats.snapshot()["hot.call"]
+        assert snap["count"] == n_threads * n_calls
+        assert abs(snap["total_s"] - 0.001 * n_threads * n_calls) < 1e-6
+        assert snap["max_s"] == 0.001
+
+    def test_snapshot_is_a_copy(self):
+        stats = CallStats()
+        stats.record("a", 1.0)
+        snap = stats.snapshot()
+        snap["a"]["count"] = 999
+        assert stats.snapshot()["a"]["count"] == 1
+
+    def test_timed_decorator_records_under_concurrency(self):
+        stats = CallStats()
+        import gpustack_tpu.utils.profiling as prof
+
+        @timed(threshold_s=10.0, name="decorated.call")
+        def work():
+            return 42
+
+        # route the decorator's global STATS at our instance for the
+        # duration (the decorator binds STATS at call time)
+        old = prof.STATS
+        prof.STATS = stats
+        try:
+            threads = [
+                threading.Thread(
+                    target=lambda: [work() for _ in range(100)]
+                )
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            prof.STATS = old
+        snap = stats.snapshot()["decorated.call"]
+        assert snap["count"] == 400
+        assert snap["total_s"] >= 0.0

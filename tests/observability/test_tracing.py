@@ -156,6 +156,40 @@ class TestStore:
         store.configure(5)
         assert len(store.query()) == 1
 
+    def test_phase_and_outcome_filters(self):
+        store = TraceStore(maxlen=10)
+        store.add({
+            "trace_id": "a" * 32, "duration_ms": 5.0,
+            "outcome": "ok",
+            "spans": [{"phase": "connect", "duration_ms": 1.0},
+                      {"phase": "ttft", "duration_ms": 2.0}],
+        })
+        store.add({
+            "trace_id": "b" * 32, "duration_ms": 9.0,
+            "outcome": "error",
+            "spans": [{"phase": "connect", "duration_ms": 1.0},
+                      {"phase": "kv_upload", "duration_ms": 3.0}],
+        })
+        store.add({
+            "trace_id": "c" * 32, "duration_ms": 2.0,
+            "outcome": "ok",
+            # no spans at all (sealed before any phase recorded)
+        })
+        assert [
+            e["trace_id"] for e in store.query(phase="kv_upload")
+        ] == ["b" * 32]
+        assert {
+            e["trace_id"] for e in store.query(phase="connect")
+        } == {"a" * 32, "b" * 32}
+        assert [
+            e["trace_id"] for e in store.query(outcome="error")
+        ] == ["b" * 32]
+        # filters compose (phase AND outcome AND min duration)
+        assert store.query(
+            phase="connect", outcome="ok", min_duration_ms=6.0
+        ) == []
+        assert store.query(phase="nope") == []
+
 
 class TestMiddleware:
     def test_hop_middleware_stamps_headers_and_records(self):
