@@ -93,6 +93,29 @@ class Config(pydantic.BaseModel):
     # bounded in-memory trace ring served at GET /v2/debug/traces
     # (observability/tracing.py TraceStore entries kept per component)
     trace_ring_size: int = 512
+    # per-model SLO engine (observability/slo.py + server/sloeval.py;
+    # docs/OBSERVABILITY.md "SLOs, burn rates, and incidents"):
+    # evaluator tick cadence
+    slo_eval_interval: float = 15.0
+    # multiplies the canonical burn windows (5m/1h fast-burn,
+    # 30m/6h slow-burn) — tests and chaos runs compress time with it
+    slo_window_scale: float = 1.0
+    # anti-flap damping: seconds the clear condition must hold before
+    # an alert resolves, and seconds RESOLVED holds before OK
+    slo_min_hold: float = 120.0
+    # bounded incident ring served at GET /v2/debug/incidents
+    slo_incident_ring: int = 256
+    # objective defaults (per-model ModelSpec fields override; 0 on
+    # the model inherits these, negative on the model disables; a
+    # non-positive default means off-unless-configured)
+    slo_default_availability: float = 0.99
+    slo_default_error_rate: float = 0.05
+    slo_default_ttft_p95_ms: float = 0.0
+    slo_default_queue_wait_p95_ms: float = 0.0
+    # cluster-scope objective: ratio of evaluator ticks with zero
+    # always-scope invariant violations (pseudo-model "_cluster";
+    # <= 0 disables)
+    slo_invariants_target: float = 0.999
 
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False

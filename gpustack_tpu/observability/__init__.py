@@ -18,6 +18,12 @@ opentelemetry / prometheus_client):
   instance (the same tap mechanism the chaos harness's invariant
   observer uses), exported as histograms and surfaced per-instance at
   ``GET /v2/model-instances/{id}/timeline``.
+- :mod:`gpustack_tpu.observability.slo` — the judgment layer over all
+  of the above: per-model objectives, Google-SRE two-window burn
+  rates, an ``ok → warning → firing → resolved`` alert state machine
+  with min-hold damping, and a bounded incident ring with correlated
+  evidence (served at ``GET /v2/debug/slo`` and
+  ``GET /v2/debug/incidents``; fed by server/sloeval.py).
 """
 
 from gpustack_tpu.observability.tracing import (  # noqa: F401
@@ -36,4 +42,10 @@ from gpustack_tpu.observability.metrics import (  # noqa: F401
 )
 from gpustack_tpu.observability.lifecycle import (  # noqa: F401
     LifecycleTracker,
+)
+from gpustack_tpu.observability.slo import (  # noqa: F401
+    AlertState,
+    BurnWindow,
+    ObjectiveSpec,
+    SLOEngine,
 )

@@ -53,6 +53,18 @@ METRIC_FAMILIES = {
     "gpustack_engine_spec_accepted_total": "counter",
     "gpustack_engine_kv_blocks_used": "gauge",
     "gpustack_engine_flight_overhead_ratio": "gauge",
+    # proxy-side usage metering (routes/openai_proxy.py _record_usage):
+    # per-model token throughput on /metrics instead of DB-only, plus a
+    # loss counter so silently-swallowed usage writes become visible
+    "gpustack_model_usage_tokens_total": "counter",
+    "gpustack_usage_records_dropped_total": "counter",
+    # per-model SLO engine (observability/slo.py, fed by
+    # server/sloeval.py): long-window compliance, two-window burn
+    # rates, and the alert state machine (0 ok / 1 warning / 2 firing /
+    # 3 resolved)
+    "gpustack_slo_compliance_ratio": "gauge",
+    "gpustack_slo_burn_rate": "gauge",
+    "gpustack_slo_alert_state": "gauge",
 }
 
 # request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a
@@ -212,14 +224,66 @@ class Histogram:
         return lines
 
 
+class Counter:
+    """One labeled counter family (same thread-safety and overflow
+    backstop contract as :class:`Histogram`)."""
+
+    MAX_SERIES = 1024
+    OVERFLOW_LABEL = "_other"
+
+    def __init__(self, name: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.label_names = tuple(label_names)
+        self._mu = threading.Lock()
+        self._series: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            return                    # counters only go up
+        key = tuple(
+            str(labels.get(name, "")) for name in self.label_names
+        )
+        with self._mu:
+            if (
+                key not in self._series
+                and len(self._series) >= self.MAX_SERIES
+            ):
+                key = tuple(
+                    self.OVERFLOW_LABEL for _ in self.label_names
+                )
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(
+            str(labels.get(name, "")) for name in self.label_names
+        )
+        with self._mu:
+            return self._series.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        with self._mu:
+            items = sorted(self._series.items())
+        if not items:
+            return []
+        lines = [f"# TYPE {self.name} counter"]
+        for key, value in items:
+            labels = format_labels(list(zip(self.label_names, key)))
+            if value == int(value):
+                lines.append(f"{self.name}{labels} {int(value)}")
+            else:
+                lines.append(f"{self.name}{labels} {value:.6f}")
+        return lines
+
+
 class MetricsRegistry:
-    """Named histograms for one component (server / worker): creation
-    is idempotent so call sites can resolve by name without import-time
-    ordering concerns."""
+    """Named histograms + counters for one component (server /
+    worker): creation is idempotent so call sites can resolve by name
+    without import-time ordering concerns."""
 
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self._hists: Dict[str, Histogram] = {}
+        self._counters: Dict[str, Counter] = {}
 
     def histogram(
         self,
@@ -236,12 +300,25 @@ class MetricsRegistry:
                 self._hists[name] = h
             return h
 
+    def counter(
+        self, name: str, label_names: Sequence[str] = ()
+    ) -> Counter:
+        with self._mu:
+            c = self._counters.get(name)
+            if c is None:
+                c = Counter(name, label_names=label_names)
+                self._counters[name] = c
+            return c
+
     def render_lines(self) -> List[str]:
         with self._mu:
             hists = sorted(self._hists.items())
+            counters = sorted(self._counters.items())
         lines: List[str] = []
         for _, h in hists:
             lines.extend(h.render())
+        for _, c in counters:
+            lines.extend(c.render())
         return lines
 
 

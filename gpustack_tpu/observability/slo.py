@@ -1,0 +1,650 @@
+"""Per-model SLO engine: objectives, multi-window burn rates, alerts.
+
+PRs 5 and 7 built the raw telemetry (per-phase request histograms, the
+engine flight recorder, lifecycle timelines); nothing *judged* it.
+This module is the judgment layer, kept pure and dependency-free so it
+evaluates identically inside the server's periodic evaluator
+(server/sloeval.py), in unit tests with synthetic clocks, and in the
+chaos harness:
+
+- every objective is a **good/total ratio** target (the Google SRE
+  framing): "95% of requests see TTFT under the threshold", "99% of
+  replica-ticks are RUNNING", "error rate under 5%". Signals arrive as
+  cumulative good/total counters; windowed ratios come from ring
+  deltas, never from unbounded history;
+- **burn rate** = (bad fraction over a window) / (allowed bad
+  fraction). Burn 1.0 spends the error budget exactly at the rate the
+  target allows; the canonical two-window pairs (5m/1h at 14.4×
+  fast-burn, 30m/6h at 6× slow-burn) page only when BOTH windows of a
+  pair exceed the threshold — the long window proves the problem is
+  real, the short window proves it is still happening;
+- the **alert state machine** is ``ok → warning → firing → resolved →
+  ok``: escalations are immediate (a bounded number of evaluation
+  ticks after the signal crosses), de-escalations are damped — the
+  clear condition (every pair's SHORT window back under threshold ×
+  ``resolve_factor``) must hold for ``min_hold`` seconds before
+  ``resolved``, and ``resolved`` holds another ``min_hold`` before
+  ``ok``. Flapping signals therefore ride out inside one incident
+  instead of paging repeatedly;
+- every escalation opens (or re-opens) an entry in a bounded
+  **incident ring** and snapshots correlated evidence through an
+  injected ``evidence_hook`` (trace exemplars, lifecycle timelines,
+  engine metrics — impure, so the *evaluator* supplies it), making an
+  incident a self-contained debuggable artifact served at
+  ``GET /v2/debug/incidents``.
+
+Time is always passed in (``now``) — nothing here reads the clock, so
+burn-rate math and state transitions replay bit-for-bit in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from gpustack_tpu.observability.metrics import (
+    METRIC_FAMILIES,
+    escape_label_value,
+)
+
+
+class AlertState(str, enum.Enum):
+    OK = "ok"
+    WARNING = "warning"
+    FIRING = "firing"
+    RESOLVED = "resolved"
+
+
+# gauge encoding for gpustack_slo_alert_state (docs/OBSERVABILITY.md)
+ALERT_STATE_VALUES = {
+    AlertState.OK: 0,
+    AlertState.WARNING: 1,
+    AlertState.FIRING: 2,
+    AlertState.RESOLVED: 3,
+}
+
+_SEVERITY_RANK = {
+    AlertState.OK: 0,
+    AlertState.RESOLVED: 0,
+    AlertState.WARNING: 1,
+    AlertState.FIRING: 2,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectiveSpec:
+    """One model's target for one objective.
+
+    ``target`` is the required good ratio in (0, 1); the error budget
+    is ``1 - target``. ``threshold`` carries the objective's scalar
+    knob (e.g. the TTFT p95 milliseconds) for display — the engine
+    itself only ever sees good/total counts.
+    """
+
+    objective: str            # label value: ttft | error_rate | ...
+    target: float
+    threshold: Optional[float] = None
+    description: str = ""
+
+    @property
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - self.target)
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One two-window burn-rate pair (short confirms it's still
+    happening, long confirms it's real)."""
+
+    short_s: float
+    long_s: float
+    threshold: float          # burn-rate multiple that activates it
+    severity: str             # "page" -> firing, "ticket" -> warning
+    short_label: str          # canonical label for the metric series
+    long_label: str
+
+
+# The Google SRE multiwindow defaults: a 14.4× fast burn exhausts a
+# 30-day budget in ~2 days (page), a 6× slow burn in ~5 days (ticket).
+DEFAULT_WINDOWS: Tuple[BurnWindow, ...] = (
+    BurnWindow(300.0, 3600.0, 14.4, "page", "5m", "1h"),
+    BurnWindow(1800.0, 21600.0, 6.0, "ticket", "30m", "6h"),
+)
+
+
+class CounterSeries:
+    """Ring of cumulative ``(ts, good, total)`` samples.
+
+    Windowed ratios subtract the newest sample at-or-before the window
+    start; when history is shorter than the window the oldest sample
+    anchors it (the effective window shrinks — the same semantics a
+    Prometheus range query has right after a restart)."""
+
+    def __init__(self, horizon_s: float, maxlen: int = 4096):
+        self.horizon_s = horizon_s
+        self._ring: deque = deque(maxlen=maxlen)
+
+    def add(self, ts: float, good: float, total: float) -> None:
+        if self._ring:
+            _, pg, pt = self._ring[-1]
+            if good < pg or total < pt:
+                # cumulative counters never go backwards in one
+                # process; a regression means the feeder reset (e.g. a
+                # histogram registry swap in tests) — restart history
+                # rather than reporting a negative window delta
+                self._ring.clear()
+        self._ring.append((ts, good, total))
+        cutoff = ts - self.horizon_s
+        while len(self._ring) > 2 and self._ring[1][0] <= cutoff:
+            self._ring.popleft()
+
+    def latest(self) -> Optional[Tuple[float, float, float]]:
+        return self._ring[-1] if self._ring else None
+
+    def window_counts(
+        self, now: float, window_s: float
+    ) -> Optional[Tuple[float, float]]:
+        """(good_delta, total_delta) over [now - window_s, now], or
+        None when there is no usable baseline yet."""
+        if len(self._ring) < 2:
+            return None
+        start = now - window_s
+        anchor = self._ring[0]
+        for sample in self._ring:
+            if sample[0] <= start:
+                anchor = sample
+            else:
+                break
+        _, g0, t0 = anchor
+        _, g1, t1 = self._ring[-1]
+        if (g1, t1) == (g0, t0) and anchor is self._ring[-1]:
+            return None
+        return g1 - g0, t1 - t0
+
+    def window_ratio(
+        self, now: float, window_s: float
+    ) -> Optional[float]:
+        counts = self.window_counts(now, window_s)
+        if counts is None or counts[1] <= 0:
+            return None
+        good, total = counts
+        return max(0.0, min(1.0, good / total))
+
+
+def burn_rate(
+    good_ratio: Optional[float], budget: float
+) -> Optional[float]:
+    """(1 - good_ratio) / budget; None propagates no-data."""
+    if good_ratio is None:
+        return None
+    return (1.0 - good_ratio) / budget
+
+
+class _Tracker:
+    """Per (model, objective): series + alert state + open incident."""
+
+    def __init__(self, spec: ObjectiveSpec, horizon_s: float):
+        self.spec = spec
+        self.series = CounterSeries(horizon_s)
+        # per-tick gauge feeds accumulate into cumulative counters so
+        # one windowing mechanism serves counters and samples alike
+        self.acc_good = 0.0
+        self.acc_total = 0.0
+        self.state = AlertState.OK
+        self.state_since = 0.0
+        self.clear_since: Optional[float] = None
+        self.incident: Optional[Dict[str, Any]] = None
+        self.peak_burn = 0.0
+
+
+class SLOEngine:
+    """Declarative SLO evaluation over injected signals.
+
+    Thread-safety: the evaluator feeds and evaluates from one task,
+    while ``status``/``metrics_lines``/``incidents`` serve HTTP reads —
+    a single lock guards the tracker map and incident ring (never held
+    across an await; nothing here awaits).
+    """
+
+    def __init__(
+        self,
+        windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+        *,
+        window_scale: float = 1.0,
+        min_hold: float = 120.0,
+        resolve_factor: float = 1.0,
+        incident_ring: int = 256,
+        evidence_hook: Optional[
+            Callable[[str, str], Dict[str, Any]]
+        ] = None,
+    ):
+        scale = max(1e-9, window_scale)
+        self.windows: Tuple[BurnWindow, ...] = tuple(
+            dataclasses.replace(
+                w, short_s=w.short_s * scale, long_s=w.long_s * scale
+            )
+            for w in windows
+        )
+        self.horizon_s = max(w.long_s for w in self.windows) * 1.5
+        self.min_hold = max(0.0, min_hold)
+        self.resolve_factor = resolve_factor
+        self.evidence_hook = evidence_hook
+        self._mu = threading.Lock()
+        self._trackers: Dict[Tuple[str, str], _Tracker] = {}
+        self._incidents: deque = deque(maxlen=max(1, incident_ring))
+        self._incident_ids = itertools.count(1)
+        self.evaluations = 0
+        self.transitions_total = 0
+
+    # ---- objective + signal feeds ---------------------------------------
+
+    def set_objective(self, model: str, spec: ObjectiveSpec) -> None:
+        key = (model, spec.objective)
+        with self._mu:
+            tracker = self._trackers.get(key)
+            if tracker is None:
+                self._trackers[key] = _Tracker(spec, self.horizon_s)
+            elif tracker.spec != spec:
+                tracker.spec = spec
+
+    def record_cumulative(
+        self,
+        model: str,
+        objective: str,
+        good: float,
+        total: float,
+        now: float,
+    ) -> None:
+        """Feed cumulative good/total counters (e.g. request counts
+        from a histogram snapshot)."""
+        with self._mu:
+            tracker = self._trackers.get((model, objective))
+            if tracker is not None:
+                tracker.series.add(now, good, total)
+
+    def record_sample(
+        self,
+        model: str,
+        objective: str,
+        good: float,
+        total: float,
+        now: float,
+    ) -> None:
+        """Feed one evaluation tick's gauge-style sample (e.g. running
+        replicas out of spec replicas); accumulated internally."""
+        with self._mu:
+            tracker = self._trackers.get((model, objective))
+            if tracker is not None:
+                tracker.acc_good += max(0.0, good)
+                tracker.acc_total += max(0.0, total)
+                tracker.series.add(
+                    now, tracker.acc_good, tracker.acc_total
+                )
+
+    def retain(
+        self,
+        keys: Sequence[Tuple[str, str]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Drop trackers not in ``keys`` — deleted models AND
+        objectives an operator disabled per model (a stale tracker
+        would keep exporting gauges and /v2/debug/slo rows for an
+        objective nobody evaluates). Incidents stay in the ring —
+        history outlives the tracker — but an episode still open when
+        its tracker retires is closed here, not left as a ghost
+        "open" entry nothing can ever resolve."""
+        keep = set(keys)
+        with self._mu:
+            for key in [k for k in self._trackers if k not in keep]:
+                tracker = self._trackers.pop(key)
+                incident = tracker.incident
+                if (
+                    incident is not None
+                    and incident["state"] != "closed"
+                ):
+                    incident["state"] = "closed"
+                    incident["retired"] = True
+                    if now is not None:
+                        incident["closed_at"] = now
+
+    # ---- burn computation -----------------------------------------------
+
+    def _burns(
+        self, tracker: _Tracker, now: float
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for w in self.windows:
+            short = burn_rate(
+                tracker.series.window_ratio(now, w.short_s),
+                tracker.spec.budget,
+            )
+            long = burn_rate(
+                tracker.series.window_ratio(now, w.long_s),
+                tracker.spec.budget,
+            )
+            out.append({
+                "window": w, "short": short, "long": long,
+                "active": (
+                    short is not None and long is not None
+                    and short > w.threshold and long > w.threshold
+                ),
+            })
+        return out
+
+    # ---- evaluation -----------------------------------------------------
+
+    def evaluate(self, now: float) -> List[Dict[str, Any]]:
+        """Advance every alert state machine; returns the transitions
+        that happened this pass (also recorded on their incidents)."""
+        transitions: List[Dict[str, Any]] = []
+        with self._mu:
+            self.evaluations += 1
+            for (model, objective), tracker in list(
+                self._trackers.items()
+            ):
+                burns = self._burns(tracker, now)
+                transitions.extend(
+                    self._step(model, tracker, burns, now)
+                )
+            self.transitions_total += len(transitions)
+        return transitions
+
+    def _step(
+        self,
+        model: str,
+        tracker: _Tracker,
+        burns: List[Dict[str, Any]],
+        now: float,
+    ) -> List[Dict[str, Any]]:
+        page = any(
+            b["active"] for b in burns
+            if b["window"].severity == "page"
+        )
+        ticket = any(
+            b["active"] for b in burns
+            if b["window"].severity == "ticket"
+        )
+        desired = (
+            AlertState.FIRING if page
+            else AlertState.WARNING if ticket
+            else None
+        )
+        for b in burns:
+            for v in (b["short"], b["long"]):
+                if v is not None:
+                    tracker.peak_burn = max(tracker.peak_burn, v)
+        # clear condition: every pair's SHORT window back under its
+        # threshold (scaled by resolve_factor for hysteresis) — the
+        # short window reacts fastest to recovery, so resolution
+        # doesn't wait out the long window's memory of the outage.
+        # Total signal loss (every short window data-free) is NOT
+        # clear: a firing alert whose feed went dark holds its state
+        # instead of auto-resolving into a silent outage.
+        shorts = [b["short"] for b in burns]
+        clear = any(s is not None for s in shorts) and all(
+            s is None
+            or s < b["window"].threshold * self.resolve_factor
+            for s, b in zip(shorts, burns)
+        )
+        if clear:
+            if tracker.clear_since is None:
+                tracker.clear_since = now
+        else:
+            tracker.clear_since = None
+
+        out: List[Dict[str, Any]] = []
+
+        def move(to: AlertState) -> None:
+            out.append(
+                self._transition(model, tracker, to, burns, now)
+            )
+
+        state = tracker.state
+        if state == AlertState.OK:
+            if desired is not None:
+                move(desired)
+        elif state == AlertState.WARNING:
+            if desired == AlertState.FIRING:
+                move(AlertState.FIRING)
+            elif self._held_clear(tracker, now):
+                move(AlertState.RESOLVED)
+        elif state == AlertState.FIRING:
+            if self._held_clear(tracker, now):
+                move(AlertState.RESOLVED)
+        elif state == AlertState.RESOLVED:
+            if desired is not None:
+                move(desired)          # re-fired: reopen the episode
+            elif now - tracker.state_since >= self.min_hold:
+                move(AlertState.OK)
+        return out
+
+    def _held_clear(self, tracker: _Tracker, now: float) -> bool:
+        return (
+            tracker.clear_since is not None
+            and now - tracker.clear_since >= self.min_hold
+        )
+
+    def _transition(
+        self,
+        model: str,
+        tracker: _Tracker,
+        to: AlertState,
+        burns: List[Dict[str, Any]],
+        now: float,
+    ) -> Dict[str, Any]:
+        frm = tracker.state
+        tracker.state = to
+        tracker.state_since = now
+        record = {
+            "at": now,
+            "model": model,
+            "objective": tracker.spec.objective,
+            "from": frm.value,
+            "to": to.value,
+            "burns": self._burn_summary(burns),
+        }
+        if to in (AlertState.WARNING, AlertState.FIRING):
+            self._open_or_escalate(model, tracker, record, now)
+        elif to == AlertState.RESOLVED:
+            if tracker.incident is not None:
+                tracker.incident["state"] = "resolved"
+                tracker.incident["resolved_at"] = now
+                tracker.incident["transitions"].append(record)
+        elif to == AlertState.OK:
+            if tracker.incident is not None:
+                tracker.incident["state"] = "closed"
+                tracker.incident["closed_at"] = now
+                tracker.incident["transitions"].append(record)
+                tracker.incident = None
+            tracker.peak_burn = 0.0
+        return record
+
+    def _open_or_escalate(
+        self,
+        model: str,
+        tracker: _Tracker,
+        record: Dict[str, Any],
+        now: float,
+    ) -> None:
+        to = tracker.state
+        incident = tracker.incident
+        if incident is None:
+            incident = {
+                "id": next(self._incident_ids),
+                "model": model,
+                "objective": tracker.spec.objective,
+                "target": tracker.spec.target,
+                "threshold": tracker.spec.threshold,
+                "opened_at": now,
+                "state": "open",
+                "severity": to.value,
+                "transitions": [],
+                "evidence": {},
+            }
+            tracker.incident = incident
+            self._incidents.append(incident)
+        elif incident["state"] == "resolved":
+            incident["state"] = "open"      # re-fired inside min_hold
+            incident.pop("resolved_at", None)
+        if _SEVERITY_RANK[to] > _SEVERITY_RANK[
+            AlertState(incident["severity"])
+        ]:
+            incident["severity"] = to.value
+        incident["transitions"].append(record)
+        incident["peak_burn"] = round(tracker.peak_burn, 3)
+        if self.evidence_hook is not None:
+            # refresh on every escalation: the firing snapshot is
+            # richer than the warning one taken moments earlier
+            try:
+                incident["evidence"] = self.evidence_hook(
+                    model, tracker.spec.objective
+                )
+            except Exception as e:  # noqa: BLE001 — evidence is
+                # best-effort; a hook bug must not wedge alerting
+                incident["evidence"] = {"error": repr(e)}
+
+    # ---- reads ----------------------------------------------------------
+
+    @staticmethod
+    def _burn_summary(
+        burns: List[Dict[str, Any]]
+    ) -> Dict[str, Optional[float]]:
+        out: Dict[str, Optional[float]] = {}
+        for b in burns:
+            w = b["window"]
+            out[w.short_label] = (
+                round(b["short"], 3) if b["short"] is not None else None
+            )
+            out[w.long_label] = (
+                round(b["long"], 3) if b["long"] is not None else None
+            )
+        return out
+
+    def status(self, now: float) -> Dict[str, Any]:
+        """Current compliance + burn rates + alert state, per model
+        and objective (the /v2/debug/slo body)."""
+        models: Dict[str, Dict[str, Any]] = {}
+        with self._mu:
+            for (model, _), tracker in sorted(self._trackers.items()):
+                burns = self._burns(tracker, now)
+                compliance = tracker.series.window_ratio(
+                    now, max(w.long_s for w in self.windows)
+                )
+                entry = {
+                    "target": tracker.spec.target,
+                    "threshold": tracker.spec.threshold,
+                    "description": tracker.spec.description,
+                    "compliance": (
+                        round(compliance, 6)
+                        if compliance is not None else None
+                    ),
+                    "burn_rates": self._burn_summary(burns),
+                    "state": tracker.state.value,
+                    "state_since": tracker.state_since or None,
+                    "incident_id": (
+                        tracker.incident["id"]
+                        if tracker.incident else None
+                    ),
+                }
+                models.setdefault(model, {})[
+                    tracker.spec.objective
+                ] = entry
+            open_incidents = sum(
+                1 for i in self._incidents if i["state"] == "open"
+            )
+        return {
+            "models": models,
+            "windows": [
+                {
+                    "short": w.short_label,
+                    "long": w.long_label,
+                    "short_seconds": w.short_s,
+                    "long_seconds": w.long_s,
+                    "threshold": w.threshold,
+                    "severity": w.severity,
+                }
+                for w in self.windows
+            ],
+            "min_hold_seconds": self.min_hold,
+            "evaluations": self.evaluations,
+            "open_incidents": open_incidents,
+        }
+
+    def incidents(
+        self,
+        model: str = "",
+        state: str = "",
+        since: float = 0.0,
+        limit: int = 50,
+    ) -> List[Dict[str, Any]]:
+        with self._mu:
+            items = list(self._incidents)
+        out = []
+        for incident in reversed(items):      # newest first
+            if model and incident["model"] != model:
+                continue
+            if state and incident["state"] != state:
+                continue
+            if since and incident["opened_at"] < since:
+                continue
+            out.append(incident)
+            if len(out) >= max(1, limit):
+                break
+        return out
+
+    # ---- prometheus rendering -------------------------------------------
+
+    def metrics_lines(self, now: float) -> List[str]:
+        """gpustack_slo_* gauge families (declared in METRIC_FAMILIES;
+        appended to the server exporter uncached)."""
+        compliance: List[str] = []
+        burn: List[str] = []
+        state: List[str] = []
+        with self._mu:
+            for (model, objective), tracker in sorted(
+                self._trackers.items()
+            ):
+                labels = (
+                    f'model="{escape_label_value(model)}",'
+                    f'objective="{escape_label_value(objective)}"'
+                )
+                ratio = tracker.series.window_ratio(
+                    now, max(w.long_s for w in self.windows)
+                )
+                if ratio is not None:
+                    compliance.append(
+                        "gpustack_slo_compliance_ratio"
+                        f"{{{labels}}} {ratio:.6f}"
+                    )
+                for b in self._burns(tracker, now):
+                    w = b["window"]
+                    for label, value in (
+                        (w.short_label, b["short"]),
+                        (w.long_label, b["long"]),
+                    ):
+                        if value is not None:
+                            burn.append(
+                                "gpustack_slo_burn_rate"
+                                f'{{{labels},window="{label}"}} '
+                                f"{value:.6f}"
+                            )
+                state.append(
+                    "gpustack_slo_alert_state"
+                    f"{{{labels}}} "
+                    f"{ALERT_STATE_VALUES[tracker.state]}"
+                )
+
+        def family(name: str, lines: List[str]) -> List[str]:
+            if not lines:
+                return []
+            return [f"# TYPE {name} {METRIC_FAMILIES[name]}"] + lines
+
+        return (
+            family("gpustack_slo_compliance_ratio", compliance)
+            + family("gpustack_slo_burn_rate", burn)
+            + family("gpustack_slo_alert_state", state)
+        )

@@ -363,8 +363,26 @@ async def _record_usage(
     stream: bool,
     provider_id: int = 0,
 ) -> None:
+    from gpustack_tpu.observability.metrics import get_registry
+
     principal = request.get("principal")
     user_id = principal.user.id if principal and principal.user else 0
+    registry = get_registry("server")
+    # scrape-visible metering next to the DB row: per-model token
+    # throughput on /metrics instead of DB-only (route_name is
+    # operator-defined, so the label cardinality is bounded)
+    tokens = registry.counter(
+        "gpustack_model_usage_tokens_total",
+        label_names=("model", "operation", "kind"),
+    )
+    tokens.inc(
+        prompt_tokens,
+        model=route_name, operation=operation, kind="prompt",
+    )
+    tokens.inc(
+        completion_tokens,
+        model=route_name, operation=operation, kind="completion",
+    )
     try:
         await ModelUsage.create(
             ModelUsage(
@@ -379,8 +397,23 @@ async def _record_usage(
                 stream=stream,
             )
         )
-    except Exception:
+    except Exception as e:
+        # a swallowed write here is silent metering loss — make the
+        # drop scrape-visible and pin it to the request's trace
         logger.exception("failed to record usage")
+        registry.counter(
+            "gpustack_usage_records_dropped_total",
+            label_names=("model", "operation"),
+        ).inc(1, model=route_name, operation=operation)
+        trace = request.get("trace")
+        if trace is not None:
+            trace.event(
+                "usage_record_dropped",
+                model=route_name,
+                operation=operation,
+                tokens=prompt_tokens + completion_tokens,
+                error=str(e) or type(e).__name__,
+            )
 
 
 async def _provider_fetch(

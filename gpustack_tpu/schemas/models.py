@@ -243,6 +243,15 @@ class Model(Record):
     lora_adapters: List[str] = []
     restart_on_error: bool = True
     distributable: bool = True        # allow multi-host placement
+    # per-model SLO objectives (observability/slo.py, evaluated by
+    # server/sloeval.py): 0 = inherit the config-level default
+    # (slo_default_*), negative = objective disabled for this model.
+    # Latency objectives are "95% of requests at-or-under this many
+    # milliseconds"; error/availability are ratio budgets/targets.
+    slo_ttft_p95_ms: float = 0.0
+    slo_error_rate: float = 0.0
+    slo_queue_wait_p95_ms: float = 0.0
+    slo_availability: float = 0.0
 
     def source_str(self) -> str:
         return (

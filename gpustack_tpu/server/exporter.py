@@ -123,6 +123,11 @@ def add_metrics_route(app: web.Application) -> None:
 
         obs_lines = get_registry("server").render_lines()
         obs_lines += slow_call_lines()
+        # SLO engine gauges (compliance / burn rate / alert state) —
+        # in-memory judgment over the series above, appended uncached
+        slo = request.app.get("slo")
+        if slo is not None:
+            obs_lines += slo.metrics_lines()
         if obs_lines:
             text += "\n".join(obs_lines) + "\n"
         return web.Response(text=text)

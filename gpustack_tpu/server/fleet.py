@@ -1,0 +1,96 @@
+"""Shared READY-worker metrics scrape.
+
+Both the fleet saturation rollup (``GET /v2/debug/fleet``,
+routes/extras.py) and the SLO evaluator's queue-wait feed
+(server/sloeval.py) read the workers' normalized ``gpustack_tpu:*``
+engine series; this is the ONE implementation of that scrape so the
+two surfaces cannot drift apart (same histogram-series exclusion,
+same instance→model resolution, same ``name|kind`` folding for
+kind-labeled counters).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+import aiohttp
+
+
+async def scrape_normalized_samples(
+    app,
+    workers,
+    inst_model: Dict[str, str],
+) -> Tuple[
+    Dict[int, dict], Dict[Tuple[str, str], Dict[str, float]]
+]:
+    """Scrape each worker's ``/metrics`` concurrently.
+
+    Returns ``(workers_out, samples)``:
+
+    - ``workers_out[worker.id]`` = ``{"name", "reachable", "error"?}``;
+    - ``samples[(model, instance_id)][metric]`` = value, where
+      ``metric`` is the normalized name, suffixed ``|<kind>`` when the
+      sample carries a ``kind`` label. Histogram series
+      (``_bucket``/``_sum``/``_count``) stay per-engine and are
+      excluded — the rollup doesn't merge them, and keying them by
+      bare name would fold per-mode series into one value. ``model``
+      is ``""`` when neither the series label nor ``inst_model``
+      resolves it — callers decide whether to skip or bucket those.
+    """
+    from gpustack_tpu.server.worker_request import worker_fetch
+    from gpustack_tpu.worker.metrics_map import (
+        NORMALIZED_PREFIX,
+        parse_metric_line,
+    )
+
+    async def scrape(w):
+        try:
+            resp = await worker_fetch(
+                app, w, "GET", "/metrics", control=True,
+            )
+            try:
+                return w, (await resp.read()).decode(
+                    errors="replace"
+                ), ""
+            finally:
+                resp.release()
+        except (
+            aiohttp.ClientError, OSError, asyncio.TimeoutError,
+        ) as e:
+            return w, None, str(e)[:200]
+
+    workers_out: Dict[int, dict] = {}
+    samples: Dict[Tuple[str, str], Dict[str, float]] = {}
+    # concurrent: one partitioned worker must cost the scrape its own
+    # timeout, not a per-worker serial sum
+    for w, body, err in await asyncio.gather(
+        *(scrape(w) for w in workers)
+    ):
+        if body is None:
+            workers_out[w.id] = {
+                "name": w.name, "reachable": False, "error": err,
+            }
+            continue
+        workers_out[w.id] = {"name": w.name, "reachable": True}
+        for line in body.splitlines():
+            parsed = parse_metric_line(line)
+            if parsed is None:
+                continue
+            name, labels, value = parsed
+            if not name.startswith(NORMALIZED_PREFIX):
+                continue
+            if "le" in labels or name.endswith(
+                ("_bucket", "_sum", "_count")
+            ):
+                continue
+            iid = labels.get("instance_id", "")
+            model = labels.get("model") or inst_model.get(iid) or ""
+            try:
+                val = float(value)
+            except ValueError:
+                continue
+            kind: Optional[str] = labels.get("kind")
+            metric = f"{name}|{kind}" if kind else name
+            samples.setdefault((model, iid), {})[metric] = val
+    return workers_out, samples

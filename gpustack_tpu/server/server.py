@@ -162,6 +162,16 @@ class Server:
         self.usage_archiver = UsageArchiver()
         self.resource_events = ResourceEventLogger()
         self.system_load = SystemLoadCollector()
+        from gpustack_tpu.server.sloeval import SLOEvaluator
+
+        # per-model SLO engine: burn-rate alerting + incident ring
+        # (observability/slo.py). Constructed unconditionally so the
+        # /v2/debug/slo surface and /metrics families exist on every
+        # server; evaluation ticks are leader-only like the other
+        # collectors (two HA peers double-judging would double-count
+        # availability samples).
+        self.slo_evaluator = SLOEvaluator(app, cfg)
+        app["slo"] = self.slo_evaluator
         from gpustack_tpu.server.update_check import UpdateChecker
 
         self.update_checker = UpdateChecker()
@@ -187,6 +197,7 @@ class Server:
                 self.resource_events.start()
                 self.system_load.start()
                 self.backend_catalog.start()
+                self.slo_evaluator.start()
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
@@ -253,6 +264,8 @@ class Server:
             self.resource_events.stop()
         if hasattr(self, "system_load"):
             self.system_load.stop()
+        if hasattr(self, "slo_evaluator"):
+            self.slo_evaluator.stop()
         for t in self._tasks:
             t.cancel()
         if self._runner:

@@ -1,0 +1,398 @@
+"""Periodic SLO evaluation: wire live signals into the SLO engine.
+
+The engine (observability/slo.py) is pure; this evaluator is the impure
+side of the split — every tick it reads the signals the system already
+emits and feeds them in as good/total counts:
+
+- **availability** — RUNNING replicas vs the model's spec, straight
+  from the instance table (works even against chaos-harness stub
+  workers, which is what the tier-1 chaos e2e leans on);
+- **error_rate** — ``gpustack_request_duration_seconds`` cumulative
+  counts (phase=total), outcome ``ok`` vs everything else, per model;
+- **ttft** — the same histogram's phase=ttft bucket counts: requests
+  at-or-under the model's TTFT threshold vs all (the threshold snaps
+  down to a bucket boundary — pick thresholds on them);
+- **queue_wait** — READY workers' normalized
+  ``gpustack_tpu:queue_oldest_wait_seconds`` gauges (the fleet-rollup
+  signal), sampled per tick against the model's threshold. Scraped
+  only when some model actually enables the objective;
+- **invariants** — the chaos harness's always-scope convergence checks
+  (testing/invariants.py) as a cluster-wide objective under the
+  pseudo-model ``_cluster``.
+
+On every escalation the engine calls back into :meth:`_evidence`,
+which snapshots what a responder needs in one place: matching trace
+exemplars from the PR 5 trace store, the instance lifecycle timelines,
+the last scraped engine metrics, and the invariant report — the
+incident ring served at ``GET /v2/debug/incidents`` is self-contained.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability.metrics import get_registry
+from gpustack_tpu.observability.slo import ObjectiveSpec, SLOEngine
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.utils.profiling import timed
+
+logger = logging.getLogger(__name__)
+
+# cluster-scope objectives (invariants) live under this pseudo-model so
+# one status/metric surface covers both granularities
+CLUSTER_MODEL = "_cluster"
+
+# the "p95" in slo_ttft_p95_ms / slo_queue_wait_p95_ms: 95% of
+# requests (or ticks) must be at-or-under the threshold
+LATENCY_GOOD_RATIO = 0.95
+
+QUEUE_WAIT_METRIC = "gpustack_tpu:queue_oldest_wait_seconds"
+
+
+def resolve_target(
+    model_value: float, default: float
+) -> Optional[float]:
+    """Per-model override semantics: negative disables the objective
+    for this model, 0 inherits the config default, and a non-positive
+    default means off-unless-configured."""
+    value = default if model_value == 0 else model_value
+    if value is None or value <= 0:
+        return None
+    return value
+
+
+class SLOEvaluator(PeriodicTask):
+    task_name = "slo-evaluator"
+
+    def __init__(self, app, cfg: Config):
+        super().__init__(max(0.05, cfg.slo_eval_interval))
+        self.app = app
+        self.cfg = cfg
+        self.engine = SLOEngine(
+            window_scale=cfg.slo_window_scale,
+            min_hold=cfg.slo_min_hold,
+            incident_ring=cfg.slo_incident_ring,
+            evidence_hook=self._evidence,
+        )
+        self.ticks = 0
+        # evidence caches refreshed each tick (read synchronously by
+        # the evidence hook mid-evaluate)
+        self._model_instances: Dict[str, List[int]] = {}
+        self._last_engine_metrics: Dict[str, Dict[str, Dict]] = {}
+        self._last_violations: List[Dict[str, str]] = []
+        # (model, objective) pairs enabled this tick — everything
+        # else is pruned, so disabling an objective per model retires
+        # its tracker instead of leaving stale gauges behind
+        self._active: set = set()
+
+    async def tick(self) -> None:
+        await self.evaluate_once()
+
+    # ------------------------------------------------------------------
+
+    @timed(threshold_s=5.0, name="sloeval.evaluate")
+    async def evaluate_once(
+        self, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """One evaluation pass; ``now`` is injectable so tests drive
+        synthetic clocks through real DB state. Returns the alert
+        transitions that fired."""
+        now = time.time() if now is None else now
+        self.ticks += 1
+        cfg = self.cfg
+        models = await Model.filter(limit=None)
+        instances = await ModelInstance.filter(limit=None)
+
+        by_model: Dict[int, List[ModelInstance]] = {}
+        for inst in instances:
+            by_model.setdefault(inst.model_id, []).append(inst)
+        self._model_instances = {
+            m.name: [i.id for i in by_model.get(m.id, [])]
+            for m in models
+        }
+
+        self._active = set()
+        # one histogram copy per tick, shared by every model's
+        # error-rate/ttft extraction (snapshot() rebuilds cumulative
+        # arrays for every labeled series — never per model)
+        request_snap = get_registry("server").histogram(
+            "gpustack_request_duration_seconds",
+            label_names=("phase", "model", "outcome"),
+        ).snapshot()
+        queue_targets: Dict[str, float] = {}
+        for model in models:
+            self._feed_availability(model, by_model, now)
+            self._feed_requests(model, request_snap, now)
+            thr = resolve_target(
+                model.slo_queue_wait_p95_ms,
+                cfg.slo_default_queue_wait_p95_ms,
+            )
+            if thr is not None:
+                queue_targets[model.name] = thr
+        if queue_targets:
+            await self._feed_queue_wait(queue_targets, now)
+        else:
+            self._last_engine_metrics = {}
+        await self._feed_invariants(models, instances, now)
+
+        self.engine.retain(sorted(self._active), now)
+        transitions = self.engine.evaluate(now)
+        for t in transitions:
+            logger.info(
+                "slo alert: model=%s objective=%s %s -> %s burns=%s",
+                t["model"], t["objective"], t["from"], t["to"],
+                t["burns"],
+            )
+        return transitions
+
+    # ---- signal feeds ----------------------------------------------------
+
+    def _enable(self, model: str, spec: ObjectiveSpec) -> None:
+        """Register a configured objective for this tick. Called even
+        when the tick has no data for it — a tracker must survive a
+        signal outage (its alert holds state) and retire only when
+        the objective is disabled or the model deleted."""
+        self.engine.set_objective(model, spec)
+        self._active.add((model, spec.objective))
+
+    def _feed_availability(
+        self,
+        model: Model,
+        by_model: Dict[int, List[ModelInstance]],
+        now: float,
+    ) -> None:
+        target = resolve_target(
+            model.slo_availability, self.cfg.slo_default_availability
+        )
+        replicas = max(0, model.replicas)
+        if target is None or replicas == 0:
+            return
+        running = sum(
+            1
+            for inst in by_model.get(model.id, [])
+            if inst.state == ModelInstanceState.RUNNING
+        )
+        self._enable(
+            model.name,
+            ObjectiveSpec(
+                "availability", target,
+                description="RUNNING replicas / spec replicas "
+                            "per evaluator tick",
+            ),
+        )
+        self.engine.record_sample(
+            model.name, "availability",
+            min(running, replicas), replicas, now,
+        )
+
+    def _feed_requests(self, model: Model, snap, now: float) -> None:
+        """error_rate + ttft from the server's cumulative request
+        histogram snapshot (taken once per tick in evaluate_once)."""
+        cfg = self.cfg
+        error_budget = resolve_target(
+            model.slo_error_rate, cfg.slo_default_error_rate
+        )
+        ttft_ms = resolve_target(
+            model.slo_ttft_p95_ms, cfg.slo_default_ttft_p95_ms
+        )
+        if error_budget is None and ttft_ms is None:
+            return
+        err_good = err_total = 0
+        ttft_good = ttft_total = 0
+        ttft_s = (ttft_ms or 0.0) / 1000.0
+        for (phase, m, outcome), (cum, _sum, count) in snap.items():
+            if m != model.name:
+                continue
+            if phase == "total":
+                err_total += count
+                if outcome == "ok":
+                    err_good += count
+            elif phase == "ttft":
+                ttft_total += count
+                ttft_good += self._count_at_or_under(cum, ttft_s)
+        if error_budget is not None:
+            # an error budget >= 1 would be a degenerate always-good
+            # objective; clamp into (0, 1)
+            target = min(0.999999, max(1e-6, 1.0 - error_budget))
+            self._enable(
+                model.name,
+                ObjectiveSpec(
+                    "error_rate", target, threshold=error_budget,
+                    description="proxy outcome=ok ratio "
+                                "(phase=total)",
+                ),
+            )
+            self.engine.record_cumulative(
+                model.name, "error_rate", err_good, err_total, now,
+            )
+        if ttft_ms is not None:
+            self._enable(
+                model.name,
+                ObjectiveSpec(
+                    "ttft", LATENCY_GOOD_RATIO, threshold=ttft_ms,
+                    description="requests with TTFT at-or-under "
+                                "the threshold",
+                ),
+            )
+            self.engine.record_cumulative(
+                model.name, "ttft", ttft_good, ttft_total, now,
+            )
+
+    @staticmethod
+    def _count_at_or_under(
+        cum: List[Tuple[float, int]], threshold_s: float
+    ) -> int:
+        """Cumulative count of the largest bucket bound <= threshold
+        (conservative: a threshold between bounds snaps down)."""
+        good = 0
+        for ub, count in cum:
+            if ub <= threshold_s:
+                good = count
+            else:
+                break
+        return good
+
+    async def _feed_queue_wait(
+        self, targets: Dict[str, float], now: float
+    ) -> None:
+        """Sample each model's worst replica queue wait from READY
+        workers' normalized engine series — the SAME scrape pipeline
+        the fleet rollup uses (server/fleet.py), so this signal and
+        ``GET /v2/debug/fleet`` cannot drift apart."""
+        from gpustack_tpu.server.fleet import (
+            scrape_normalized_samples,
+        )
+
+        workers = [
+            w for w in await Worker.filter(limit=None)
+            if w.state == WorkerState.READY
+        ]
+        inst_model = {
+            str(iid): name
+            for name, ids in self._model_instances.items()
+            for iid in ids
+        }
+        _, samples = await scrape_normalized_samples(
+            self.app, workers, inst_model
+        )
+        per_model: Dict[str, Dict[str, Dict]] = {}
+        worst: Dict[str, float] = {}
+        for (model, iid), metrics in samples.items():
+            if not model:
+                continue
+            per_model.setdefault(model, {})[iid] = dict(
+                sorted(metrics.items())
+            )
+            wait = metrics.get(QUEUE_WAIT_METRIC)
+            if wait is not None:
+                worst[model] = max(worst.get(model, 0.0), wait)
+        self._last_engine_metrics = per_model
+        for model, threshold_ms in targets.items():
+            # always enabled while configured (the tracker must hold
+            # its state through a scrape outage)...
+            self._enable(
+                model,
+                ObjectiveSpec(
+                    "queue_wait", LATENCY_GOOD_RATIO,
+                    threshold=threshold_ms,
+                    description="ticks with worst replica queue "
+                                "wait at-or-under the threshold",
+                ),
+            )
+            # ...but a tick only samples when the queue-wait gauge
+            # itself was scraped: replicas that report other series
+            # without it must read as no-data, not as zero wait
+            if model not in worst:
+                continue
+            self.engine.record_sample(
+                model, "queue_wait",
+                1.0 if worst[model] * 1000.0 <= threshold_ms else 0.0,
+                1.0, now,
+            )
+
+    async def _feed_invariants(
+        self, models, instances, now: float
+    ) -> None:
+        target = self.cfg.slo_invariants_target
+        if target <= 0:
+            self._last_violations = []
+            return
+        from gpustack_tpu.schemas import DevInstance
+        from gpustack_tpu.testing import invariants as inv
+
+        workers = await Worker.filter(limit=None)
+        devs = await DevInstance.filter(limit=None)
+        violations = inv.snapshot_violations(
+            models, workers, instances, devs,
+            include_eventual=False,
+        )
+        self._last_violations = [v.to_dict() for v in violations]
+        self._enable(
+            CLUSTER_MODEL,
+            ObjectiveSpec(
+                "invariants", min(0.999999, target),
+                description="ticks with zero always-scope "
+                            "invariant violations",
+            ),
+        )
+        self.engine.record_sample(
+            CLUSTER_MODEL, "invariants",
+            0.0 if violations else 1.0, 1.0, now,
+        )
+
+    # ---- evidence capture (sync; called inside engine.evaluate) ---------
+
+    def _evidence(self, model: str, objective: str) -> Dict[str, Any]:
+        """Correlated snapshot for an incident: trace exemplars,
+        lifecycle timelines, last engine metrics, invariant report."""
+        from gpustack_tpu.observability import tracing
+
+        store = tracing.get_store("server")
+        if model == CLUSTER_MODEL:
+            traces = store.query(limit=5)
+        else:
+            # the model's own hops first; fall back to the slowest
+            # recent traces so an incident never ships evidence-free
+            traces = store.query(model=model, limit=5) or store.query(
+                min_duration_ms=1.0, limit=3
+            )
+        timelines = []
+        tracker = self.app.get("lifecycle")
+        if tracker is not None:
+            for iid in self._model_instances.get(model, [])[:8]:
+                timeline = tracker.timeline(iid)
+                if timeline is not None:
+                    timelines.append(timeline)
+        out: Dict[str, Any] = {
+            "captured_at": time.time(),
+            "traces": traces,
+            "lifecycle": timelines,
+        }
+        engine_metrics = self._last_engine_metrics.get(model)
+        if engine_metrics:
+            out["engine_metrics"] = engine_metrics
+        if model == CLUSTER_MODEL or self._last_violations:
+            out["invariants"] = list(self._last_violations)
+        return out
+
+    # ---- reads -----------------------------------------------------------
+
+    def status(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = time.time() if now is None else now
+        out = self.engine.status(now)
+        out["interval_seconds"] = self.interval
+        out["ticks"] = self.ticks
+        return out
+
+    def metrics_lines(self) -> List[str]:
+        return self.engine.metrics_lines(time.time())

@@ -569,8 +569,12 @@ class ChaosHarness:
         rescue_grace: float = 1.2,
         stuck_bound: float = 15.0,
         start_delay: float = 0.08,
+        extra_cfg: Optional[Dict] = None,
     ):
         self.data_dir = str(data_dir)
+        # extra Config fields merged over the harness defaults (e.g.
+        # the SLO e2e compresses burn windows and evaluator cadence)
+        self.extra_cfg = dict(extra_cfg or {})
         self.n_workers = workers
         self.chips = chips
         self.replicas = replicas
@@ -598,7 +602,7 @@ class ChaosHarness:
     async def start(self) -> None:
         from gpustack_tpu.server.server import Server
 
-        self.cfg = Config(
+        cfg_fields = dict(
             host="127.0.0.1",
             port=_free_port(),
             data_dir=self.data_dir,
@@ -612,7 +616,9 @@ class ChaosHarness:
             worker_control_retries=2,
             shutdown_timeout=0.3,
             force_platform="cpu",
-        ).finalize()
+        )
+        cfg_fields.update(self.extra_cfg)
+        self.cfg = Config(**cfg_fields).finalize()
         self.server = Server(self.cfg)
         await self.server.start()
         self.base = f"http://127.0.0.1:{self.cfg.port}"

@@ -49,6 +49,11 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_engine_kv_blocks_used": "gpustack_tpu:kv_blocks_used",
     "gpustack_engine_flight_overhead_ratio":
         "gpustack_tpu:flight_overhead_ratio",
+    # proxy-side usage metering (routes/openai_proxy.py): mapped so a
+    # custom OpenAI-gateway backend emitting the same family lands in
+    # the normalized namespace alongside the engine token counters
+    "gpustack_model_usage_tokens_total":
+        "gpustack_tpu:model_usage_tokens_total",
     # in-repo audio engine (engine/audio_server.py)
     "gpustack_tpu_audio_requests_total": "gpustack_tpu:audio_requests_total",
     "gpustack_tpu_audio_seconds_total": "gpustack_tpu:audio_seconds_total",
@@ -105,6 +110,7 @@ NORMALIZED_FAMILIES: Dict[str, str] = {
     "gpustack_tpu:kv_blocks_used": "gauge",
     "gpustack_tpu:flight_overhead_ratio": "gauge",
     "gpustack_tpu:scrape_age_seconds": "gauge",
+    "gpustack_tpu:model_usage_tokens_total": "counter",
 }
 
 _LINE = re.compile(

@@ -1,0 +1,160 @@
+"""Tier-1 chaos-driven SLO e2e (ISSUE 8 acceptance): against the REAL
+in-process server with protocol-true stub workers, a seeded fault
+schedule degrades a model's availability objective — the alert must
+go ``ok → firing`` within a bounded number of evaluator ticks, the
+recorded incident must carry correlated evidence (≥1 matching trace
+exemplar + instance lifecycle snapshots), and after the control
+plane self-heals the alert must transition to ``resolved``. The
+executed schedule replays bit-for-bit from the seed.
+
+Burn windows are compressed via ``slo_window_scale`` (canonical
+5m/1h + 30m/6h shapes, scaled ×1/1200 → 0.25s/3s + 1.5s/18s) so the
+two-window policy runs for real — both windows of the fast pair must
+genuinely cross 14.4× before the page fires.
+"""
+
+import asyncio
+import dataclasses
+
+from gpustack_tpu.client.client import APIError
+from gpustack_tpu.testing import chaos
+
+SEED = 21
+SCHEDULE_KW = dict(kinds=("worker_kill",), ops=1, workers=2)
+
+SLO_CFG = {
+    "slo_eval_interval": 0.1,
+    "slo_window_scale": 1.0 / 1200.0,
+    "slo_min_hold": 0.3,
+    "slo_default_availability": 0.99,
+    # keep the chaos run to the availability objective: queue/ttft
+    # need engine metrics the stub workers don't serve
+    "slo_default_error_rate": 0.0,
+    "slo_default_ttft_p95_ms": 0.0,
+}
+
+MODEL = "slo-chaos-model"
+# bounded-tick acceptance: at a 0.1s evaluator cadence the long fast
+# window (3s) crosses 14.4x within ~1s of the replica parking; 120
+# ticks (~12s wall) is the generous CI bound
+FIRING_TICK_BOUND = 120
+
+
+def test_slo_alert_fires_and_resolves_under_seeded_fault(tmp_path):
+    async def go():
+        schedule = chaos.generate_schedule(SEED, **SCHEDULE_KW)
+        harness = chaos.ChaosHarness(
+            str(tmp_path),
+            workers=2,
+            replicas=2,
+            rescue_grace=1.5,
+            extra_cfg=SLO_CFG,
+        )
+        await harness.start()
+        try:
+            await harness.deploy(MODEL)
+            await harness.wait_converged(timeout=45.0)
+            evaluator = harness.server.slo_evaluator
+
+            # trace exemplars for the incident to correlate: real
+            # proxy requests through the live app (the stub workers
+            # answer 404 — no engine — which is fine; the hop trace
+            # records the resolved model either way)
+            for _ in range(3):
+                try:
+                    await harness.admin.request(
+                        "POST", "/v1/chat/completions",
+                        json_body={
+                            "model": MODEL,
+                            "messages": [
+                                {"role": "user", "content": "hi"}
+                            ],
+                        },
+                    )
+                except APIError:
+                    pass
+
+            # healthy baseline long enough to fill the long windows
+            await asyncio.sleep(3.5)
+            status = evaluator.status()
+            entry = status["models"][MODEL]["availability"]
+            assert entry["state"] == "ok", entry
+            assert entry["compliance"] == 1.0
+
+            fault_tick = evaluator.ticks
+            await harness.run_schedule(schedule)
+
+            # --- ok -> firing within a bounded number of ticks ------
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 20.0
+            fired_tick = None
+            while loop.time() < deadline:
+                body = await harness.admin.request(
+                    "GET", "/v2/debug/slo"
+                )
+                state = body["models"][MODEL]["availability"][
+                    "state"
+                ]
+                if state == "firing":
+                    fired_tick = evaluator.ticks
+                    break
+                await asyncio.sleep(0.1)
+            assert fired_tick is not None, "alert never fired"
+            assert fired_tick - fault_tick <= FIRING_TICK_BOUND
+
+            # --- incident carries correlated evidence ---------------
+            body = await harness.admin.request(
+                "GET", f"/v2/debug/incidents?model={MODEL}"
+            )
+            items = body["items"]
+            assert items, "no incident recorded"
+            incident = items[0]
+            assert incident["objective"] == "availability"
+            assert incident["severity"] == "firing"
+            evidence = incident["evidence"]
+            assert any(
+                t.get("model") == MODEL
+                for t in evidence["traces"]
+            ), "no correlated trace exemplar"
+            assert evidence["lifecycle"], "no lifecycle snapshot"
+            assert any(
+                entry_["state"] in ("running", "unreachable")
+                for tl in evidence["lifecycle"]
+                for entry_ in tl["entries"]
+            )
+
+            # --- self-heal, then the alert resolves -----------------
+            await harness.wait_converged(timeout=45.0)
+            deadline = loop.time() + 20.0
+            resolved = False
+            while loop.time() < deadline:
+                body = await harness.admin.request(
+                    "GET", f"/v2/debug/incidents?model={MODEL}"
+                )
+                incident = body["items"][0]
+                tos = [
+                    tr["to"] for tr in incident["transitions"]
+                ]
+                if "resolved" in tos:
+                    resolved = True
+                    break
+                await asyncio.sleep(0.1)
+            assert resolved, (
+                "alert never resolved after the fault cleared: "
+                f"{incident['transitions']}"
+            )
+
+            # the chaos invariants held throughout
+            assert harness.violations() == []
+
+            # --- replayable bit-for-bit from the seed ---------------
+            assert [
+                dataclasses.asdict(o) for o in schedule
+            ] == [
+                dataclasses.asdict(o)
+                for o in chaos.generate_schedule(SEED, **SCHEDULE_KW)
+            ]
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())

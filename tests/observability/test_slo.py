@@ -1,0 +1,470 @@
+"""Pure SLO engine semantics (observability/slo.py): windowed
+burn-rate math, the two-window alert policy, min-hold damping, the
+incident ring, and metric rendering — everything on injected clocks so
+each case replays bit-for-bit.
+"""
+
+from gpustack_tpu.observability.slo import (
+    ALERT_STATE_VALUES,
+    AlertState,
+    BurnWindow,
+    CounterSeries,
+    ObjectiveSpec,
+    SLOEngine,
+    burn_rate,
+)
+from gpustack_tpu.testing import promtext
+
+# compressed two-window pairs: fast pair 2s/10s at 10x, slow pair
+# 6s/30s at 4x — same shape as the canonical 5m/1h + 30m/6h
+WINDOWS = (
+    BurnWindow(2.0, 10.0, 10.0, "page", "5m", "1h"),
+    BurnWindow(6.0, 30.0, 4.0, "ticket", "30m", "6h"),
+)
+
+
+def make_engine(min_hold=2.0, **kw):
+    return SLOEngine(windows=WINDOWS, min_hold=min_hold, **kw)
+
+
+def feed(engine, model, objective, samples):
+    """samples: [(now, good_cum, total_cum)]"""
+    for now, good, total in samples:
+        engine.record_cumulative(model, objective, good, total, now)
+
+
+# ---------------------------------------------------------------------------
+# window math
+# ---------------------------------------------------------------------------
+
+
+class TestCounterSeries:
+    def test_window_ratio_uses_window_anchor(self):
+        s = CounterSeries(horizon_s=100.0)
+        s.add(0.0, 0, 0)
+        s.add(5.0, 50, 100)     # 50% good in (0, 5]
+        s.add(10.0, 150, 200)   # 100% good in (5, 10]
+        # full window sees both halves
+        assert s.window_ratio(10.0, 10.0) == 150 / 200
+        # short window anchored at t=5 sees only the good half
+        assert s.window_ratio(10.0, 5.0) == 100 / 100
+
+    def test_no_data_cases(self):
+        s = CounterSeries(horizon_s=100.0)
+        assert s.window_ratio(0.0, 10.0) is None      # empty
+        s.add(0.0, 1, 2)
+        assert s.window_ratio(0.0, 10.0) is None      # single sample
+        s.add(5.0, 1, 2)
+        # no new observations in the window -> total delta 0 -> None
+        assert s.window_ratio(5.0, 10.0) is None
+
+    def test_counter_reset_clears_history(self):
+        s = CounterSeries(horizon_s=100.0)
+        s.add(0.0, 10, 20)
+        s.add(1.0, 20, 40)
+        s.add(2.0, 1, 2)        # regression: feeder reset
+        assert s.window_ratio(2.0, 10.0) is None
+        s.add(3.0, 2, 4)
+        assert s.window_ratio(3.0, 10.0) == 0.5
+
+    def test_horizon_pruning_is_bounded(self):
+        s = CounterSeries(horizon_s=10.0)
+        for i in range(1000):
+            s.add(float(i), i, i)
+        assert len(s._ring) < 50  # noqa: SLF001
+
+    def test_burn_rate_math(self):
+        import pytest
+
+        # 2% bad against a 1% budget burns at 2x
+        assert burn_rate(0.98, 0.01) == pytest.approx(2.0)
+        assert burn_rate(None, 0.01) is None
+        assert burn_rate(1.0, 0.05) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# alert state machine
+# ---------------------------------------------------------------------------
+
+
+def outage(engine, model, start, end, step=0.25, rate=100):
+    """Total outage: every request bad, `rate` per step."""
+    good = total = 0
+    t = start
+    while t <= end:
+        total += rate
+        engine.record_cumulative(model, "error_rate", good, total, t)
+        engine.evaluate(t)
+        t += step
+    return t
+
+
+class TestAlertStateMachine:
+    def setup_method(self):
+        self.engine = make_engine()
+        self.engine.set_objective(
+            "m", ObjectiveSpec("error_rate", 0.95)
+        )
+
+    def state(self):
+        return self.engine.status(0)["models"]["m"]["error_rate"][
+            "state"
+        ]
+
+    def test_fires_when_both_fast_windows_burn(self):
+        # healthy baseline long enough to fill the long window
+        good = total = 0
+        for i in range(40):
+            good += 100
+            total += 100
+            t = i * 0.25
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            assert self.engine.evaluate(t) == []
+        assert self.state() == "ok"
+        # hard outage: 100% errors at 20x the 5% budget. The slow
+        # (ticket) pair crosses first -> warning, then the fast (page)
+        # pair confirms -> firing
+        t0 = 10.0
+        transitions = []
+        for i in range(1, 120):
+            t = t0 + i * 0.25
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            transitions += self.engine.evaluate(t)
+            if any(tr["to"] == "firing" for tr in transitions):
+                break
+        tos = [tr["to"] for tr in transitions]
+        assert "firing" in tos, f"alert never fired: {tos}"
+        fired = next(
+            tr for tr in transitions if tr["to"] == "firing"
+        )
+        # the long fast-window (10s) must genuinely exceed 10x before
+        # firing: not on the very first bad tick
+        assert fired["at"] > t0 + 0.25
+
+    def test_slow_burn_only_warns(self):
+        # 30% errors: fast burn = 0.30/0.05 = 6 < 10 (page) but > 4
+        # (ticket) -> warning, never firing
+        good = total = 0
+        t = 0.0
+        for i in range(200):
+            t = i * 0.25
+            good += 70
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        assert self.state() == "warning"
+
+    def test_resolve_requires_min_hold_and_then_ok(self):
+        good = total = 0
+        # baseline then outage to FIRING
+        for i in range(20):
+            t = i * 0.25
+            good += 100
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        t = 5.0
+        while self.state() != "firing" and t < 30.0:
+            t += 0.25
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        assert self.state() == "firing"
+        # recovery: clear must HOLD for min_hold (2s) before resolved
+        recovery_start = t
+        resolved_at = None
+        while t < recovery_start + 30.0:
+            t += 0.25
+            good += 100
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            for tr in self.engine.evaluate(t):
+                if tr["to"] == "resolved":
+                    resolved_at = tr["at"]
+            if resolved_at:
+                break
+        assert resolved_at is not None
+        # short fast-window is 2s and min_hold 2s: resolution can't
+        # precede recovery_start + min_hold
+        assert resolved_at >= recovery_start + 2.0
+        # resolved holds min_hold, then ok
+        t_ok = None
+        while t < resolved_at + 10.0:
+            t += 0.25
+            good += 100
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            for tr in self.engine.evaluate(t):
+                if tr["to"] == "ok":
+                    t_ok = tr["at"]
+            if t_ok:
+                break
+        assert t_ok is not None and t_ok >= resolved_at + 2.0
+
+    def test_flap_inside_min_hold_stays_one_incident(self):
+        good = total = 0
+        t = 0.0
+        for i in range(20):
+            t = i * 0.25
+            good += 100
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        # outage -> firing
+        while self.state() != "firing":
+            t += 0.25
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        # brief recovery (shorter than min_hold), then outage again
+        for _ in range(4):  # 1s of good traffic < 2s min_hold
+            t += 0.25
+            good += 100
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        assert self.state() == "firing"  # never resolved mid-flap
+        for _ in range(8):
+            t += 0.25
+            total += 100
+            self.engine.record_cumulative(
+                "m", "error_rate", good, total, t
+            )
+            self.engine.evaluate(t)
+        incidents = self.engine.incidents(model="m")
+        assert len(incidents) == 1
+
+    def test_no_data_never_transitions(self):
+        for t in (0.0, 1.0, 2.0):
+            assert self.engine.evaluate(t) == []
+        assert self.state() == "ok"
+
+
+# ---------------------------------------------------------------------------
+# incidents + evidence
+# ---------------------------------------------------------------------------
+
+
+class TestIncidents:
+    def test_incident_lifecycle_and_evidence_hook(self):
+        captured = []
+
+        def hook(model, objective):
+            captured.append((model, objective))
+            return {"traces": [{"trace_id": "abc"}]}
+
+        engine = make_engine(evidence_hook=hook)
+        engine.set_objective("m", ObjectiveSpec("error_rate", 0.95))
+        good = total = 0
+        t = 0.0
+        for i in range(20):
+            t = i * 0.25
+            good += 100
+            total += 100
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+        while not (
+            engine.incidents(model="m")
+            and engine.incidents(model="m")[0]["severity"] == "firing"
+        ):
+            t += 0.25
+            total += 100
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+            if t > 200:
+                raise AssertionError("incident never reached firing")
+        incident = engine.incidents(model="m")[0]
+        assert incident["state"] == "open"
+        assert incident["evidence"]["traces"][0]["trace_id"] == "abc"
+        assert captured and captured[0] == ("m", "error_rate")
+        assert incident["peak_burn"] > 10.0
+        assert incident["transitions"][-1]["to"] == "firing"
+        # recover through resolved -> closed
+        while engine.incidents(model="m", state="open"):
+            t += 0.25
+            good += 100
+            total += 100
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+            if t > 200:
+                raise AssertionError("incident never left open")
+        while not engine.incidents(model="m", state="closed"):
+            t += 0.25
+            good += 100
+            total += 100
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+            if t > 400:
+                raise AssertionError("incident never closed")
+        closed = engine.incidents(model="m")[0]
+        assert closed["resolved_at"] < closed["closed_at"]
+        tos = [tr["to"] for tr in closed["transitions"]]
+        assert "firing" in tos
+        assert tos[-2:] == ["resolved", "ok"]
+
+    def test_evidence_hook_errors_are_contained(self):
+        def hook(model, objective):
+            raise RuntimeError("boom")
+
+        engine = make_engine(evidence_hook=hook)
+        engine.set_objective("m", ObjectiveSpec("error_rate", 0.95))
+        good = total = 0
+        t = 0.0
+        for i in range(80):
+            t = i * 0.25
+            total += 100
+            if i < 20:
+                good = total
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+        incident = engine.incidents(model="m")[0]
+        assert "error" in incident["evidence"]
+
+    def test_ring_bound_and_filters(self):
+        engine = make_engine(incident_ring=3)
+        t = 0.0
+        for n in range(5):
+            model = f"m{n}"
+            engine.set_objective(
+                model, ObjectiveSpec("error_rate", 0.95)
+            )
+            good = total = 0
+            for i in range(60):
+                t += 0.25
+                total += 100
+                if i < 20:
+                    good = total
+                engine.record_cumulative(
+                    model, "error_rate", good, total, t
+                )
+                engine.evaluate(t)
+        assert len(engine.incidents(limit=100)) == 3   # bounded
+        assert engine.incidents(model="m4")
+        assert not engine.incidents(model="m0")        # evicted
+        ts = engine.incidents(model="m4")[0]["opened_at"]
+        assert engine.incidents(since=ts)
+        assert not engine.incidents(since=ts + 1000)
+
+    def test_retain_drops_deleted_models_keeps_incidents(self):
+        engine = make_engine()
+        engine.set_objective("gone", ObjectiveSpec("error_rate", 0.95))
+        good = total = 0
+        t = 0.0
+        for i in range(60):
+            t += 0.25
+            total += 100
+            if i < 20:
+                good = total
+            engine.record_cumulative(
+                "gone", "error_rate", good, total, t
+            )
+            engine.evaluate(t)
+        assert engine.incidents(model="gone")
+        engine.retain([("other", "error_rate")])
+        assert "gone" not in engine.status(t)["models"]
+        assert engine.incidents(model="gone")  # history survives
+
+    def test_signal_loss_holds_the_alert(self):
+        """A firing alert whose feed goes completely dark must hold
+        state, not auto-resolve into a silent outage."""
+        engine = make_engine(min_hold=1.0)
+        engine.set_objective("m", ObjectiveSpec("error_rate", 0.95))
+        good = total = 0
+        t = 0.0
+        for i in range(80):
+            t = i * 0.25
+            total += 100
+            if i < 20:
+                good = total
+            engine.record_cumulative("m", "error_rate", good, total, t)
+            engine.evaluate(t)
+        status = engine.status(t)["models"]["m"]["error_rate"]
+        assert status["state"] == "firing"
+        # signal outage: no samples at all for far longer than every
+        # window + min_hold
+        for i in range(400):
+            t += 0.25
+            engine.evaluate(t)
+        status = engine.status(t)["models"]["m"]["error_rate"]
+        assert status["state"] == "firing"
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+class TestRendering:
+    def test_metrics_lines_are_well_formed(self):
+        engine = make_engine()
+        engine.set_objective(
+            'mo"del', ObjectiveSpec("error_rate", 0.95)
+        )
+        good = total = 0
+        t = 0.0
+        for i in range(30):
+            t = i * 0.25
+            good += 90
+            total += 100
+            engine.record_cumulative(
+                'mo"del', "error_rate", good, total, t
+            )
+            engine.evaluate(t)
+        text = "\n".join(engine.metrics_lines(t)) + "\n"
+        samples, types = promtext.assert_well_formed(text)
+        names = {s.name for s in samples}
+        assert "gpustack_slo_compliance_ratio" in names
+        assert "gpustack_slo_burn_rate" in names
+        assert "gpustack_slo_alert_state" in names
+        windows = {
+            s.labels["window"] for s in samples
+            if s.name == "gpustack_slo_burn_rate"
+        }
+        assert {"5m", "1h", "30m", "6h"} <= windows
+        # escaped model label round-trips
+        assert any(
+            s.labels.get("model") == 'mo\\"del' for s in samples
+        )
+        state = [
+            s for s in samples
+            if s.name == "gpustack_slo_alert_state"
+        ]
+        assert state[0].value == ALERT_STATE_VALUES[AlertState.OK]
+
+    def test_status_shape(self):
+        engine = make_engine()
+        engine.set_objective(
+            "m", ObjectiveSpec("ttft", 0.95, threshold=500.0)
+        )
+        feed(engine, "m", "ttft", [(0.0, 0, 0), (5.0, 95, 100)])
+        engine.evaluate(5.0)
+        status = engine.status(5.0)
+        entry = status["models"]["m"]["ttft"]
+        assert entry["target"] == 0.95
+        assert entry["threshold"] == 500.0
+        assert entry["compliance"] == 0.95
+        assert entry["state"] == "ok"
+        assert set(entry["burn_rates"]) == {"5m", "1h", "30m", "6h"}
+        assert status["windows"][0]["severity"] == "page"
+        assert status["evaluations"] >= 1

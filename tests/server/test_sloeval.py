@@ -1,0 +1,431 @@
+"""SLO evaluator over live signals (server/sloeval.py) + the admin
+debug surfaces: availability from instance states, error-rate/TTFT
+from the request histogram, queue wait from worker scrapes, metrics
+export, and /v2/debug/slo + /v2/debug/incidents.
+
+Every case drives ``evaluate_once(now=...)`` with a synthetic clock
+over real DB state, so transitions land on deterministic ticks.
+"""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability import tracing
+from gpustack_tpu.observability.metrics import get_registry
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.sloeval import (
+    CLUSTER_MODEL,
+    SLOEvaluator,
+    resolve_target,
+)
+from gpustack_tpu.testing import promtext
+
+# compressed clocks: canonical windows x0.01 -> fast pair 3s/36s,
+# slow pair 18s/216s; min_hold 2 virtual seconds
+SLO_CFG = {
+    "slo_window_scale": 0.01,
+    "slo_min_hold": 2.0,
+    "slo_eval_interval": 1.0,
+}
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    # collector-owned tables (usage_archive) register on import
+    import gpustack_tpu.server.collectors  # noqa: F401
+
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path), **SLO_CFG})
+    db.close()
+
+
+def test_resolve_target_semantics():
+    assert resolve_target(0, 0.99) == 0.99      # inherit default
+    assert resolve_target(0.95, 0.99) == 0.95   # explicit override
+    assert resolve_target(-1, 0.99) is None     # per-model disable
+    assert resolve_target(0, 0.0) is None       # default off
+
+
+def test_count_at_or_under_snaps_to_bucket():
+    cum = [(0.1, 3), (0.25, 7), (1.0, 9), (float("inf"), 10)]
+    f = SLOEvaluator._count_at_or_under
+    assert f(cum, 0.25) == 7
+    assert f(cum, 0.3) == 7      # between bounds: snaps down
+    assert f(cum, 0.05) == 0
+    # +Inf observations exceeded every finite bound — they can never
+    # count as good, whatever the threshold (conservative)
+    assert f(cum, 100.0) == 9
+
+
+async def _admin_headers(cfg):
+    admin = await User.create(
+        User(
+            username="admin", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        )
+    )
+    token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+    return {"Authorization": f"Bearer {token}"}
+
+
+def test_availability_objective_full_loop(cfg):
+    """The acceptance loop against DB state alone: replicas degrade ->
+    firing within a bounded number of ticks; recover -> resolved ->
+    ok. Incident carries correlated evidence."""
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        hdrs = await _admin_headers(cfg)
+        model = await Model.create(
+            Model(name="slo-m", preset="tiny", replicas=2)
+        )
+        insts = [
+            await ModelInstance.create(
+                ModelInstance(
+                    name=f"slo-m-{i}", model_id=model.id,
+                    model_name=model.name,
+                    state=ModelInstanceState.RUNNING,
+                )
+            )
+            for i in range(2)
+        ]
+        app = create_app(cfg)
+        evaluator = SLOEvaluator(app, cfg)
+        app["slo"] = evaluator
+        client = TestClient(TestServer(app))
+        await client.start_server()  # attaches the lifecycle tracker
+        try:
+            # a matching trace exemplar for the evidence snapshot
+            tracing.get_store("server").add({
+                "trace_id": "slo-trace-1", "span_id": "s1",
+                "component": "server", "name": "POST /v1/x",
+                "model": "slo-m", "status": 502, "outcome": "error",
+                "started_at": time.time(), "duration_ms": 12.0,
+                "spans": [],
+            })
+            base = time.time()
+            t = base
+            for i in range(40):          # healthy baseline
+                t = base + i * 1.0
+                await evaluator.evaluate_once(now=t)
+            status = evaluator.status(t)
+            entry = status["models"]["slo-m"]["availability"]
+            assert entry["state"] == "ok"
+            assert entry["compliance"] == 1.0
+            # cluster invariants objective rides along, healthy
+            assert (
+                status["models"][CLUSTER_MODEL]["invariants"]["state"]
+                == "ok"
+            )
+
+            # fault: one of two replicas lost
+            await insts[0].update(state=ModelInstanceState.ERROR)
+            fault_tick = evaluator.ticks
+            fired_tick = None
+            for i in range(40, 100):
+                t = base + i * 1.0
+                transitions = await evaluator.evaluate_once(now=t)
+                if any(
+                    tr["to"] == "firing"
+                    and tr["model"] == "slo-m"
+                    for tr in transitions
+                ):
+                    fired_tick = evaluator.ticks
+                    break
+            assert fired_tick is not None, "never fired"
+            # bounded: 50% down at a 1% budget burns 50x; the long
+            # fast window (36 virtual s) crosses 14.4x in ~11 ticks
+            assert fired_tick - fault_tick <= 20
+
+            # incident evidence: trace exemplar + lifecycle snapshot
+            r = await client.get(
+                "/v2/debug/incidents?model=slo-m", headers=hdrs
+            )
+            assert r.status == 200, await r.text()
+            items = (await r.json())["items"]
+            assert items and items[0]["state"] == "open"
+            assert items[0]["severity"] == "firing"
+            evidence = items[0]["evidence"]
+            assert any(
+                tr["trace_id"] == "slo-trace-1"
+                for tr in evidence["traces"]
+            )
+            timelines = evidence["lifecycle"]
+            assert timelines, "no lifecycle snapshot captured"
+            assert any(
+                e["state"] == "running"
+                for tl in timelines for e in tl["entries"]
+            )
+
+            # /v2/debug/slo reflects the firing state; burn values
+            # are asserted on the synthetic clock (the route computes
+            # them at wall time, which this test deliberately outruns)
+            r = await client.get("/v2/debug/slo", headers=hdrs)
+            body = await r.json()
+            avail = body["models"]["slo-m"]["availability"]
+            assert avail["state"] == "firing"
+            burns = evaluator.status(t)["models"]["slo-m"][
+                "availability"
+            ]["burn_rates"]
+            assert burns["5m"] > 14.4 and burns["1h"] > 14.4
+
+            # recovery -> resolved -> ok (min-hold damped)
+            await insts[0].update(
+                state=ModelInstanceState.SCHEDULED
+            )
+            await insts[0].update(
+                state=ModelInstanceState.STARTING
+            )
+            await insts[0].update(state=ModelInstanceState.RUNNING)
+            saw = []
+            for i in range(100, 200):
+                t = base + i * 1.0
+                for tr in await evaluator.evaluate_once(now=t):
+                    if tr["model"] == "slo-m":
+                        saw.append(tr["to"])
+                if "ok" in saw:
+                    break
+            assert saw == ["resolved", "ok"], saw
+            r = await client.get(
+                "/v2/debug/incidents?model=slo-m&state=closed",
+                headers=hdrs,
+            )
+            items = (await r.json())["items"]
+            assert items and items[0]["resolved_at"] is not None
+
+            # filters: state + since validation
+            r = await client.get(
+                "/v2/debug/incidents?state=bogus", headers=hdrs
+            )
+            assert r.status == 400
+            r = await client.get(
+                f"/v2/debug/incidents?since={t + 999}", headers=hdrs
+            )
+            assert (await r.json())["items"] == []
+
+            # admin-only
+            for path in ("/v2/debug/slo", "/v2/debug/incidents"):
+                r = await client.get(path)
+                assert r.status in (401, 403)
+
+            # /metrics exports the slo families, strictly well-formed
+            r = await client.get("/metrics")
+            samples, _ = promtext.assert_well_formed(await r.text())
+            names = {s.name for s in samples}
+            assert "gpustack_slo_compliance_ratio" in names
+            assert "gpustack_slo_burn_rate" in names
+            states = {
+                s.labels.get("model"): s.value
+                for s in samples
+                if s.name == "gpustack_slo_alert_state"
+            }
+            assert states["slo-m"] == 0   # back to ok
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_error_rate_and_ttft_from_request_histogram(cfg):
+    async def go():
+        await Model.create(
+            Model(
+                name="hist-m", preset="tiny", replicas=1,
+                slo_error_rate=-1.0,       # isolate the ttft objective
+                slo_ttft_p95_ms=250.0,
+                slo_availability=-1.0,
+            )
+        )
+        await Model.create(
+            Model(
+                name="err-m", preset="tiny", replicas=1,
+                slo_error_rate=0.05,
+                slo_availability=-1.0,
+            )
+        )
+        app = create_app(cfg)
+        evaluator = SLOEvaluator(app, cfg)
+        hist = get_registry("server").histogram(
+            "gpustack_request_duration_seconds",
+            label_names=("phase", "model", "outcome"),
+        )
+        base = time.time()
+        t = base
+        for i in range(40):
+            t = base + i * 1.0
+            for _ in range(20):
+                hist.observe(
+                    0.1, phase="ttft", model="hist-m", outcome="ok"
+                )
+                hist.observe(
+                    0.05, phase="total", model="err-m", outcome="ok"
+                )
+            await evaluator.evaluate_once(now=t)
+        status = evaluator.status(t)
+        ttft = status["models"]["hist-m"]["ttft"]
+        assert ttft["state"] == "ok" and ttft["compliance"] == 1.0
+        assert ttft["threshold"] == 250.0
+        # per-model disables hold: no error_rate objective on hist-m,
+        # no availability on either
+        assert "error_rate" not in status["models"]["hist-m"]
+        assert "availability" not in status["models"]["err-m"]
+
+        # degrade both: slow ttft on hist-m, errors on err-m
+        fired = set()
+        for i in range(40, 120):
+            t = base + i * 1.0
+            for _ in range(20):
+                hist.observe(
+                    2.0, phase="ttft", model="hist-m", outcome="ok"
+                )
+                hist.observe(
+                    0.05, phase="total", model="err-m",
+                    outcome="error",
+                )
+            for tr in await evaluator.evaluate_once(now=t):
+                if tr["to"] == "firing":
+                    fired.add((tr["model"], tr["objective"]))
+            if len(fired) == 2:
+                break
+        assert ("hist-m", "ttft") in fired
+        assert ("err-m", "error_rate") in fired
+
+        # disabling an objective per model retires its tracker on the
+        # next tick — no stale gauges/status rows for something
+        # nobody evaluates anymore
+        err_m = await Model.first(name="err-m")
+        await err_m.update(slo_error_rate=-1.0)
+        t += 1.0
+        await evaluator.evaluate_once(now=t)
+        status = evaluator.status(t)
+        assert "err-m" not in status["models"]
+        assert not any(
+            'model="err-m"' in line
+            for line in evaluator.engine.metrics_lines(t)
+        )
+        # ...but the incident history survives retirement, closed —
+        # retiring a tracker mid-episode must not leave a ghost
+        # "open" incident nothing can ever resolve
+        survivors = evaluator.engine.incidents(model="err-m")
+        assert survivors
+        assert all(i["state"] == "closed" for i in survivors)
+        assert any(i.get("retired") for i in survivors)
+
+    asyncio.run(go())
+
+
+def test_queue_wait_objective_from_worker_scrape(cfg, monkeypatch):
+    async def go():
+        model = await Model.create(
+            Model(
+                name="q-m", preset="tiny", replicas=1,
+                slo_queue_wait_p95_ms=100.0,
+                slo_error_rate=-1.0,
+                slo_availability=-1.0,
+            )
+        )
+        inst = await ModelInstance.create(
+            ModelInstance(
+                name="q-m-0", model_id=model.id, model_name="q-m",
+                state=ModelInstanceState.RUNNING, worker_id=1,
+            )
+        )
+        await Worker.create(
+            Worker(name="w0", ip="127.0.0.1", port=1,
+                   state=WorkerState.READY)
+        )
+        app = create_app(cfg)
+        evaluator = SLOEvaluator(app, cfg)
+
+        queue_wait = {"value": 0.01, "present": True}
+
+        class FakeResp:
+            async def read(self):
+                if not queue_wait["present"]:
+                    # replica reports OTHER series but no queue gauge:
+                    # must read as no-data, never as zero wait
+                    return (
+                        "gpustack_tpu:requests_running"
+                        f'{{instance_id="{inst.id}",model="q-m"}} 1\n'
+                    ).encode()
+                return (
+                    "gpustack_tpu:queue_oldest_wait_seconds"
+                    f'{{instance_id="{inst.id}",model="q-m"}} '
+                    f"{queue_wait['value']}\n"
+                ).encode()
+
+            def release(self):
+                pass
+
+        async def fake_fetch(app_, worker, method, path, **kw):
+            return FakeResp()
+
+        from gpustack_tpu.server import worker_request
+
+        monkeypatch.setattr(
+            worker_request, "worker_fetch", fake_fetch
+        )
+        base = time.time()
+        t = base
+        for i in range(40):
+            t = base + i * 1.0
+            await evaluator.evaluate_once(now=t)
+        status = evaluator.status(t)
+        assert status["models"]["q-m"]["queue_wait"]["state"] == "ok"
+        # engine metrics cached for incident evidence
+        assert evaluator._last_engine_metrics["q-m"]  # noqa: SLF001
+
+        queue_wait["value"] = 3.5      # 3500ms >> 100ms threshold
+        fired = False
+        for i in range(40, 120):
+            t = base + i * 1.0
+            for tr in await evaluator.evaluate_once(now=t):
+                if (
+                    tr["to"] == "firing"
+                    and tr["objective"] == "queue_wait"
+                ):
+                    fired = True
+            if fired:
+                break
+        assert fired
+        incident = evaluator.engine.incidents(model="q-m")[0]
+        assert "engine_metrics" in incident["evidence"]
+
+        # the gauge disappears from the scrape while firing: that is
+        # signal loss, and the alert must HOLD, not resolve on a
+        # phantom zero-wait sample
+        queue_wait["present"] = False
+        samples_before = evaluator.engine._trackers[  # noqa: SLF001
+            ("q-m", "queue_wait")
+        ].acc_total
+        for i in range(120, 180):
+            t = base + i * 1.0
+            await evaluator.evaluate_once(now=t)
+        tracker = evaluator.engine._trackers[  # noqa: SLF001
+            ("q-m", "queue_wait")
+        ]
+        assert tracker.acc_total == samples_before  # nothing sampled
+        assert evaluator.status(t)["models"]["q-m"]["queue_wait"][
+            "state"
+        ] == "firing"
+
+    asyncio.run(go())

@@ -1,0 +1,120 @@
+"""UsageArchiver contract (server/collectors.py): idempotent sweeps,
+day-boundary bucketing, and retention-window safety (ISSUE 8
+satellite — the hot→cold path multi-tenant quota/billing will lean
+on)."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.collectors import UsageArchive, UsageArchiver
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def _days_ago(days: float) -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(days=days)
+    ).isoformat()
+
+
+async def _old_row(days: float, **fields) -> ModelUsage:
+    defaults = dict(
+        user_id=1, model_id=2, operation="chat/completions",
+        prompt_tokens=10, completion_tokens=5, total_tokens=15,
+    )
+    defaults.update(fields)
+    row = await ModelUsage.create(ModelUsage(**defaults))
+    await row.update(created_at=_days_ago(days))
+    return row
+
+
+def test_rerun_of_the_same_sweep_is_idempotent(db):
+    async def go():
+        for _ in range(4):
+            await _old_row(10)
+        archiver = UsageArchiver(retention_days=7)
+        assert await archiver.archive_once() == 4
+        rows = await UsageArchive.filter(limit=None)
+        snapshot = [
+            (r.day, r.model_id, r.user_id, r.requests, r.total_tokens)
+            for r in rows
+        ]
+        # nothing left to archive: the second sweep must not touch
+        # the aggregates (no double count, no new rows)
+        assert await archiver.archive_once() == 0
+        rows2 = await UsageArchive.filter(limit=None)
+        assert [
+            (r.day, r.model_id, r.user_id, r.requests, r.total_tokens)
+            for r in rows2
+        ] == snapshot
+        assert rows2[0].requests == 4
+        assert rows2[0].total_tokens == 60
+
+    asyncio.run(go())
+
+
+def test_day_boundary_rows_land_in_their_own_day(db):
+    async def go():
+        # three distinct calendar days, same model/user/operation
+        await _old_row(10)
+        await _old_row(10)
+        await _old_row(11)
+        await _old_row(12, total_tokens=100, prompt_tokens=100,
+                       completion_tokens=0)
+        archiver = UsageArchiver(retention_days=7)
+        assert await archiver.archive_once() == 4
+        rows = sorted(
+            await UsageArchive.filter(limit=None),
+            key=lambda r: r.day,
+        )
+        assert [r.day for r in rows] == sorted(
+            {_days_ago(12)[:10], _days_ago(11)[:10],
+             _days_ago(10)[:10]}
+        )
+        by_day = {r.day: r for r in rows}
+        assert by_day[_days_ago(10)[:10]].requests == 2
+        assert by_day[_days_ago(11)[:10]].requests == 1
+        assert by_day[_days_ago(12)[:10]].total_tokens == 100
+        # distinct (model, user, operation) keys split too
+        await _old_row(10, user_id=9)
+        await archiver.archive_once()
+        day = _days_ago(10)[:10]
+        day_rows = await UsageArchive.filter(day=day, limit=None)
+        assert {r.user_id for r in day_rows} == {1, 9}
+
+    asyncio.run(go())
+
+
+def test_hot_rows_inside_retention_untouched(db):
+    async def go():
+        old = await _old_row(8)
+        inside = [
+            await _old_row(6.5),
+            await _old_row(0.5),
+            await ModelUsage.create(
+                ModelUsage(user_id=1, model_id=2, total_tokens=1)
+            ),
+        ]
+        archiver = UsageArchiver(retention_days=7)
+        assert await archiver.archive_once() == 1
+        remaining = {u.id for u in await ModelUsage.filter(limit=None)}
+        assert remaining == {u.id for u in inside}
+        assert old.id not in remaining
+        rows = await UsageArchive.filter(limit=None)
+        assert len(rows) == 1 and rows[0].requests == 1
+
+    asyncio.run(go())

@@ -1,0 +1,232 @@
+"""Scrape-visible usage metering (ISSUE 8 satellites): the proxy's
+``_record_usage`` emits ``gpustack_model_usage_tokens_total`` on the
+server registry, a forced DB failure increments
+``gpustack_usage_records_dropped_total`` AND leaves a trace event, and
+``GET /v2/usage/summary?window=…`` merges hot rows with cold archive
+aggregates."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability.metrics import get_registry
+from gpustack_tpu.observability.tracing import (
+    RequestTrace,
+    TraceContext,
+)
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.routes.openai_proxy import _record_usage
+from gpustack_tpu.schemas import User
+from gpustack_tpu.schemas.usage import ModelUsage
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.collectors import UsageArchive
+from gpustack_tpu.testing import promtext
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    import gpustack_tpu.server.collectors  # noqa: F401
+
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+def _tokens_counter():
+    return get_registry("server").counter(
+        "gpustack_model_usage_tokens_total",
+        label_names=("model", "operation", "kind"),
+    )
+
+
+def _dropped_counter():
+    return get_registry("server").counter(
+        "gpustack_usage_records_dropped_total",
+        label_names=("model", "operation"),
+    )
+
+
+def test_record_usage_emits_token_counters(cfg):
+    async def go():
+        counter = _tokens_counter()
+        before_p = counter.value(
+            model="meter-m", operation="chat/completions",
+            kind="prompt",
+        )
+        before_c = counter.value(
+            model="meter-m", operation="chat/completions",
+            kind="completion",
+        )
+        await _record_usage(
+            {}, 1, "meter-m", "chat/completions", 30, 12, False
+        )
+        await _record_usage(
+            {}, 1, "meter-m", "chat/completions", 5, 7, True
+        )
+        assert counter.value(
+            model="meter-m", operation="chat/completions",
+            kind="prompt",
+        ) == before_p + 35
+        assert counter.value(
+            model="meter-m", operation="chat/completions",
+            kind="completion",
+        ) == before_c + 19
+        # the DB row still lands
+        rows = await ModelUsage.filter(route_name="meter-m")
+        assert len(rows) == 2
+        # registry render is strictly well-formed and carries the
+        # family (rides the server /metrics exporter)
+        text = "\n".join(
+            get_registry("server").render_lines()
+        ) + "\n"
+        samples, _ = promtext.assert_well_formed(text)
+        assert any(
+            s.name == "gpustack_model_usage_tokens_total"
+            and s.labels.get("kind") == "prompt"
+            for s in samples
+        )
+
+    asyncio.run(go())
+
+
+def test_dropped_usage_is_counted_and_traced(cfg, monkeypatch):
+    async def go():
+        dropped = _dropped_counter()
+        before = dropped.value(
+            model="drop-m", operation="embeddings"
+        )
+
+        async def boom(obj):
+            raise RuntimeError("db is sideways")
+
+        monkeypatch.setattr(ModelUsage, "create", boom)
+        trace = RequestTrace(
+            TraceContext("a" * 32), "server", "POST /v1/embeddings"
+        )
+        request = {"trace": trace}
+        # must not raise — the proxy path continues serving
+        await _record_usage(
+            request, 1, "drop-m", "embeddings", 11, 0, False
+        )
+        assert dropped.value(
+            model="drop-m", operation="embeddings"
+        ) == before + 1
+        events = [e for e in trace.events
+                  if e["event"] == "usage_record_dropped"]
+        assert events and events[0]["attrs"]["tokens"] == 11
+        assert "db is sideways" in events[0]["attrs"]["error"]
+
+    asyncio.run(go())
+
+
+def test_usage_summary_window_merges_hot_and_archive(cfg):
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from gpustack_tpu.server.app import create_app
+
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        user = await User.create(
+            User(
+                username="u2",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        }
+        user_hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(user, cfg.jwt_secret)
+        }
+        # hot rows: inside the window, two users
+        for uid, tokens in ((admin.id, 10), (user.id, 20)):
+            await ModelUsage.create(
+                ModelUsage(
+                    user_id=uid, model_id=7, route_name="win-m",
+                    operation="chat/completions",
+                    prompt_tokens=tokens, completion_tokens=0,
+                    total_tokens=tokens,
+                )
+            )
+        # cold archive: two days back for the same model
+        two_days = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(days=2)
+        ).isoformat()[:10]
+        await UsageArchive.create(
+            UsageArchive(
+                day=two_days, model_id=7, user_id=user.id,
+                operation="chat/completions", requests=5,
+                prompt_tokens=100, completion_tokens=50,
+                total_tokens=150,
+            )
+        )
+        # an archive row OUTSIDE the window must not leak in
+        old_day = (
+            datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(days=40)
+        ).isoformat()[:10]
+        await UsageArchive.create(
+            UsageArchive(
+                day=old_day, model_id=7, user_id=user.id,
+                requests=999, total_tokens=99999,
+            )
+        )
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(
+                "/v2/usage/summary?window=7d", headers=hdrs
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["window"]["hours"] == 7 * 24
+            (entry,) = [
+                m for m in body["by_model"] if m["model_id"] == 7
+            ]
+            assert entry["requests"] == 2 + 5
+            assert entry["total_tokens"] == 10 + 20 + 150
+            assert entry["archived_requests"] == 5
+            by_user = {
+                u["user_id"]: u for u in body["by_user"]
+            }
+            assert by_user[user.id]["total_tokens"] == 20 + 150
+            assert by_user[admin.id]["total_tokens"] == 10
+
+            # non-admin sees only their own usage (both tiers scoped)
+            r = await client.get(
+                "/v2/usage/summary?window=7d", headers=user_hdrs
+            )
+            body = await r.json()
+            assert [u["user_id"] for u in body["by_user"]] == [
+                user.id
+            ]
+            (entry,) = body["by_model"]
+            assert entry["total_tokens"] == 20 + 150
+
+            # bad windows rejected; legacy shape unchanged without it
+            r = await client.get(
+                "/v2/usage/summary?window=fortnight", headers=hdrs
+            )
+            assert r.status == 400
+            r = await client.get("/v2/usage/summary", headers=hdrs)
+            body = await r.json()
+            assert body["by_model"][0]["route"] == "win-m"
+        finally:
+            await client.close()
+
+    asyncio.run(go())
