@@ -179,3 +179,28 @@ class ClientSet:
             "POST", f"/v2/workers/{worker_id}/heartbeat", {},
             timeout=timeout,
         )
+
+
+async def update_settled(
+    client, kind: str, id: int, fields: Dict[str, Any],
+    attempts: int = 3,
+) -> Dict[str, Any]:
+    """PATCH with a bounded retry on the crud layer's honest 409
+    ("changed concurrently"): the server re-reads and re-validates on
+    every attempt, so a plain re-send IS the re-decide — for one-shot
+    owner reports (dev/benchmark/model-file state) that must not be
+    dropped because an unrelated writer touched the row mid-flight.
+    Writers with their own conflict policy (e.g. serve_manager's
+    lifecycle reports) keep calling ``client.update`` directly. A free
+    function over any duck-typed client (only ``update`` is needed)."""
+    for attempt in range(attempts):
+        try:
+            return await client.update(kind, id, fields)
+        except APIError as e:
+            if (
+                e.status != 409
+                or "changed concurrently" not in e.message
+                or attempt == attempts - 1
+            ):
+                raise
+    raise AssertionError("unreachable")

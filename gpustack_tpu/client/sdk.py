@@ -44,9 +44,11 @@ from gpustack_tpu.schemas import (
     ModelFile,
     ModelInstance,
     ModelProvider,
+    ModelRevision,
     ModelRoute,
     Org,
     OrgMember,
+    Rollout,
     User,
     Worker,
     WorkerPool,
@@ -156,6 +158,10 @@ RESOURCES: Dict[str, Tuple[str, Type[Record]]] = {
     "benchmarks": ("benchmarks", Benchmark),
     "inference_backends": ("inference-backends", InferenceBackend),
     "dev_instances": ("dev-instances", DevInstance),
+    # controller-owned, read-only over the API (mutations go through
+    # /v2/models/{id}/rollback) — typed reads + watch still apply
+    "rollouts": ("rollouts", Rollout),
+    "model_revisions": ("model-revisions", ModelRevision),
 }
 
 

@@ -117,6 +117,52 @@ class Config(pydantic.BaseModel):
     # <= 0 disables)
     slo_invariants_target: float = 0.999
 
+    # zero-downtime rollouts (server/rollout.py; docs/RESILIENCE.md
+    # "Rollouts & autoscaling"): controller reconcile cadence
+    rollout_interval: float = 2.0
+    # default new-generation replicas surged per batch (Model field
+    # rollout_surge overrides per model; 0 there inherits this)
+    rollout_surge: int = 1
+    # a surged replica must reach RUNNING within this many seconds of
+    # its creation or the rollout auto-rolls-back
+    rollout_running_deadline: float = 300.0
+    # seconds each batch's canaries are observed (health gates judged
+    # every controller tick) before the matched old batch drains
+    rollout_observe_s: float = 30.0
+    # delta gates only judge once this many requests landed in the
+    # window (tiny samples would make the gate a coin flip)
+    rollout_min_requests: int = 5
+    # gate: canary-window error rate may exceed the pre-rollout
+    # baseline by at most this much (absolute ratio)
+    rollout_max_error_delta: float = 0.05
+    # gate: canary-window TTFT p95 may degrade to at most this multiple
+    # of the pre-rollout baseline p95
+    rollout_max_ttft_degradation: float = 2.0
+
+    # SLO-driven replica autoscaling (server/autoscaler.py): evaluation
+    # cadence; per-model bounds live on the Model (autoscale_min/_max,
+    # max 0 = autoscaling off for that model)
+    autoscale_interval: float = 5.0
+    # scale up when fleet occupancy (running/slots) reaches this
+    autoscale_up_occupancy: float = 0.85
+    # scale down only when occupancy is at-or-under this…
+    autoscale_down_occupancy: float = 0.3
+    # …and has stayed there this many seconds (hysteresis)
+    autoscale_down_stable_s: float = 30.0
+    # scale up when the worst replica queue wait reaches this (seconds)
+    autoscale_queue_wait_s: float = 5.0
+    # minimum seconds between scaling actions per model (flap damping;
+    # wake-from-zero is exempt — cold start already costs enough)
+    autoscale_cooldown_s: float = 60.0
+    # scale-to-zero: with autoscale_min 0, a model idle (no proxied
+    # requests and zero in-flight) this long releases its replicas
+    autoscale_idle_after_s: float = 300.0
+    # fail-safe freeze: if the newest fleet scrape for a model with
+    # running replicas is older than this, the autoscaler freezes that
+    # model (trace event + gpustack_autoscale_frozen metric) instead of
+    # acting on stale signals
+    autoscale_stale_after_s: float = 30.0
+
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False
 

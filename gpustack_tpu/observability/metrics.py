@@ -65,6 +65,22 @@ METRIC_FAMILIES = {
     "gpustack_slo_compliance_ratio": "gauge",
     "gpustack_slo_burn_rate": "gauge",
     "gpustack_slo_alert_state": "gauge",
+    # zero-downtime rollouts (server/rollout.py): numeric state of a
+    # model's newest rollout (0 completed / 1 surging / 2 observing /
+    # 3 promoting / 4 rolling_back / 5 rolled_back / 6 failed) and a
+    # labeled event counter (started / batch_promoted / completed /
+    # gate_failed / rolled_back / …)
+    "gpustack_rollout_state": "gauge",
+    "gpustack_rollout_events_total": "counter",
+    # SLO-driven autoscaler (server/autoscaler.py): the replica target
+    # it last wrote, a 0/1 stale-signal freeze flag per model, the
+    # measured cold-start estimate (SCHEDULED→RUNNING dwell p95 from
+    # lifecycle timelines), and a labeled decision counter
+    # (up / down / to_zero / wake / freeze / bounds)
+    "gpustack_autoscale_replicas_target": "gauge",
+    "gpustack_autoscale_frozen": "gauge",
+    "gpustack_autoscale_cold_start_seconds": "gauge",
+    "gpustack_autoscale_events_total": "counter",
 }
 
 # request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a

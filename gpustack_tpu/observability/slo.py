@@ -574,6 +574,59 @@ class SLOEngine:
             "open_incidents": open_incidents,
         }
 
+    def firing_objectives(self, model: str) -> List[str]:
+        """Objectives currently FIRING for ``model`` — the rollout
+        health gate's burn-rate signal (any page-severity burn on the
+        model fails the canary)."""
+        with self._mu:
+            return sorted(
+                objective
+                for (m, objective), tracker in self._trackers.items()
+                if m == model and tracker.state == AlertState.FIRING
+            )
+
+    def record_incident(
+        self,
+        model: str,
+        objective: str,
+        *,
+        now: float,
+        severity: str = "firing",
+        detail: str = "",
+        evidence: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Record an externally-judged incident (e.g. a rollout
+        rollback) into the same bounded ring burn-rate incidents live
+        in — one triage surface for everything. The episode is closed
+        at creation: its lifecycle belongs to the recorder, not the
+        alert state machines."""
+        incident = {
+            "id": next(self._incident_ids),
+            "model": model,
+            "objective": objective,
+            "target": None,
+            "threshold": None,
+            "opened_at": now,
+            "closed_at": now,
+            "state": "closed",
+            "severity": severity,
+            "transitions": [
+                {
+                    "at": now,
+                    "model": model,
+                    "objective": objective,
+                    "from": AlertState.OK.value,
+                    "to": severity,
+                    "burns": {},
+                    "detail": detail,
+                }
+            ],
+            "evidence": evidence or {},
+        }
+        with self._mu:
+            self._incidents.append(incident)
+        return incident
+
     def incidents(
         self,
         model: str = "",

@@ -87,6 +87,11 @@ class Database:
 
         return sql.json_text(field, col, self.dialect)
 
+    def json_set(self, field: str, col: str = "data") -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.json_set(field, col, self.dialect)
+
     # ---- async API ------------------------------------------------------
 
     async def run(self, fn: Callable[[sqlite3.Connection], Any]) -> Any:

@@ -284,6 +284,36 @@ class Record(pydantic.BaseModel):
                 setattr(self, f, getattr(fresh, f))
         return fresh
 
+    @classmethod
+    async def set_field(cls, id: int, field: str, value: Any) -> int:
+        """Column-targeted single-field JSON write. Unlike
+        :meth:`update`, this does NOT persist the whole document, so a
+        stale in-memory snapshot can never revert concurrent writers'
+        other fields — for hot-path server-internal markers (e.g. the
+        autoscaler wake marker) written without a re-fetch/409 dance.
+        Deliberately bypasses the event bus (no watch event, no
+        updated_at bump); index columns may not be written this way.
+        Returns the affected row count."""
+        if field in cls.__indexes__:
+            raise ValueError(
+                f"{field!r} is an index column; use update()"
+            )
+        setter = cls.db().json_set(field)
+        # bind JSON text: every dialect spelling parses it, so numbers
+        # stay JSON numbers on sqlite/postgres/mysql alike
+        encoded = json.dumps(_jsonable(value))
+
+        def go(conn):
+            cur = conn.execute(
+                f"UPDATE {cls.__kind__} SET data = {setter} "
+                "WHERE id = ?",
+                (encoded, id),
+            )
+            conn.commit()
+            return cur.rowcount
+
+        return await cls.db().run(go)
+
     async def update(self: T, **fields: Any) -> T:
         """Apply field updates, persist, publish UPDATED with a
         changed-field diff (old, new) — reference active_record.py:46-74."""

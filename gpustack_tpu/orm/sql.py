@@ -24,6 +24,22 @@ _JSON_TEXT = {
     "mysql": "JSON_UNQUOTE(JSON_EXTRACT({col}, '$.{field}'))",
 }
 
+# single-field JSON writer: each spelling consumes exactly ONE bind
+# parameter — the new value as JSON text (``json.dumps``) — and yields
+# the whole updated document, so call sites compose
+# ``SET data = <json_set(...)>``. Every dialect PARSES the bind as
+# JSON, so a numeric value stays a JSON number on all three (a raw
+# text bind would store "1.5" as a string on postgres but 1.5 as a
+# number on sqlite/mysql, silently diverging document shapes).
+_JSON_SET = {
+    "sqlite": "json_set({col}, '$.{field}', json(?))",
+    "postgres": (
+        "jsonb_set(({col})::jsonb, '{{{field}}}', "
+        "(?)::jsonb)::text"
+    ),
+    "mysql": "JSON_SET({col}, '$.{field}', CAST(? AS JSON))",
+}
+
 DIALECTS = tuple(_JSON_NUM)
 
 
@@ -35,3 +51,8 @@ def json_num(field: str, col: str = "data", dialect: str = "sqlite") -> str:
 def json_text(field: str, col: str = "data", dialect: str = "sqlite") -> str:
     """Textual JSON field accessor."""
     return _JSON_TEXT[dialect].format(col=col, field=field)
+
+
+def json_set(field: str, col: str = "data", dialect: str = "sqlite") -> str:
+    """Single-field JSON document writer; binds one ``?`` (the value)."""
+    return _JSON_SET[dialect].format(col=col, field=field)

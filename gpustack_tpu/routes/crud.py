@@ -261,13 +261,50 @@ def add_crud_routes(
         except pydantic.ValidationError as e:
             return json_error(400, str(e))
         if update_hook:
+            before = dict(fields)
             err = await update_hook(request, obj, fields)
             if err is not None:
                 return err
-        await obj.update(
+            if fields != before:
+                # the hook may canonicalize or add server-owned fields
+                # (e.g. the model hook bumps `generation` on serving
+                # changes) — re-validate so the write sees them
+                merged = obj.model_dump()
+                merged.update(fields)
+                try:
+                    validated = cls.model_validate(merged)
+                except pydantic.ValidationError as e:
+                    return json_error(400, str(e))
+        # re-fetch before the write: Record.update persists the WHOLE
+        # document, and the hook awaited (queries, revision archives)
+        # since `obj` was read — background writers (rollback restore,
+        # autoscaler) may have advanced the row, and persisting the
+        # stale snapshot would silently revert their fields along with
+        # this request's change
+        fresh = await cls.get(obj.id)
+        if fresh is None:
+            return json_error(404, f"{path} not found")
+        # ...but only fields whose CURRENT value still matches the
+        # snapshot the hook validated against may be written: e.g. the
+        # instance transition hook judged old-state -> new-state legal
+        # on `obj` — if the rescuer parked the row UNREACHABLE during
+        # the hook's awaits, writing the approved state would persist
+        # a transition nobody validated. An honest 409 lets the caller
+        # re-read and re-decide.
+        conflicts = sorted(
+            k for k in fields
+            if getattr(fresh, k) != getattr(obj, k)
+        )
+        if conflicts:
+            return json_error(
+                409,
+                f"{path} field(s) {', '.join(conflicts)} changed "
+                "concurrently; retry",
+            )
+        await fresh.update(
             **{k: getattr(validated, k) for k in fields}
         )
-        return web.json_response(dump(obj))
+        return web.json_response(dump(fresh))
 
     async def delete(request: web.Request):
         if err := check_write(request, None, None):

@@ -736,6 +736,145 @@ def add_extra_routes(app: web.Application) -> None:
         "/v2/model-instances/{id:\\d+}/drain", instance_drain
     )
 
+    async def model_rollout(request: web.Request):
+        """Rollout status for one model: the active (or newest) plan
+        with its batch history, gate snapshots and state, plus recent
+        attempts (server/rollout.py). Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.schemas import Rollout
+        from gpustack_tpu.schemas.rollouts import (
+            ACTIVE_ROLLOUT_STATES,
+        )
+
+        if err := require_admin(request):
+            return err
+        model = await Model.get(int(request.match_info["id"]))
+        if model is None:
+            return json_error(404, "model not found")
+        rollouts = sorted(
+            await Rollout.filter(model_id=model.id),
+            key=lambda r: r.id,
+        )
+        active = [
+            r for r in rollouts if r.state in ACTIVE_ROLLOUT_STATES
+        ]
+        instances = await ModelInstance.filter(model_id=model.id)
+        return web.json_response({
+            "model": model.name,
+            "generation": model.generation,
+            "instances": [
+                {
+                    "id": i.id,
+                    "name": i.name,
+                    "state": i.state.value,
+                    "generation": i.generation,
+                }
+                for i in sorted(instances, key=lambda i: i.id)
+            ],
+            "active": (
+                active[-1].model_dump(mode="json") if active else None
+            ),
+            "history": [
+                r.model_dump(mode="json") for r in rollouts[-10:]
+            ],
+        })
+
+    app.router.add_get("/v2/models/{id:\\d+}/rollout", model_rollout)
+
+    async def model_rollback(request: web.Request):
+        """Manually roll back the model's active rollout: restores the
+        previous generation's archived spec and drains the new
+        generation — the same path automatic gate failures take.
+        409 when no rollout is mid-flight. Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+        from gpustack_tpu.schemas import Rollout, RolloutState
+        from gpustack_tpu.schemas.rollouts import (
+            ACTIVE_ROLLOUT_STATES,
+        )
+
+        if err := require_admin(request):
+            return err
+        model = await Model.get(int(request.match_info["id"]))
+        if model is None:
+            return json_error(404, "model not found")
+        controller = request.app.get("rollout")
+        if controller is None:
+            return json_error(503, "rollout controller not running")
+        rollout = await Rollout.active_for(model.id)
+        if rollout is None:
+            return json_error(
+                409, f"no rollout in flight for model {model.name!r}"
+            )
+        coordinator = request.app.get("coordinator")
+        is_leader = coordinator is None or coordinator.is_leader
+        if rollout.state != RolloutState.ROLLING_BACK:
+            if is_leader:
+                instances = await ModelInstance.filter(
+                    model_id=model.id
+                )
+                # shared with the automatic gate path: spec restore +
+                # re-tag + new-generation teardown + incident record
+                await controller.begin_rollback(
+                    model, rollout, instances, time.time(),
+                    "manual rollback requested",
+                    event="manual_rollback",
+                )
+            elif not rollout.rollback_requested:
+                # HA follower: executing here would strand the
+                # incident + event counter in THIS process's in-memory
+                # SLO ring where no operator looks — note the request
+                # on the plan and let the leader's next reconcile tick
+                # execute it. SQL-conditional on the indexed `state`
+                # column: a fetch-then-save here could interleave with
+                # the leader writing COMPLETED and resurrect the plan
+                # from the stale snapshot (the leader polls the marker,
+                # so skipping the event-bus publish is fine).
+                still_forward = tuple(
+                    s.value for s in ACTIVE_ROLLOUT_STATES
+                    if s != RolloutState.ROLLING_BACK
+                )
+                qs = ",".join("?" * len(still_forward))
+                setter = Rollout.db().json_set("rollback_requested")
+
+                def _note(conn, _id=rollout.id, _states=still_forward):
+                    cur = conn.execute(
+                        f"UPDATE rollout SET data = {setter} "
+                        f"WHERE id = ? AND state IN ({qs})",
+                        # json_set binds JSON text on every dialect
+                        (
+                            json.dumps("manual rollback requested"),
+                            _id, *_states,
+                        ),
+                    )
+                    conn.commit()
+                    return cur.rowcount
+
+                # the leader's whole-document plan writes (_record)
+                # can erase a marker that commits inside their
+                # fetch->update window — verify the note survived and
+                # re-land it (bounded) so the 202 acknowledgement
+                # can't silently lose the rollback. Each _record
+                # erasure needs the leader to take its plan lock, so
+                # a couple of re-lands outlast any realistic race.
+                for _ in range(5):
+                    await Rollout.db().run(_note)
+                    fresh = await Rollout.get(rollout.id)
+                    if (
+                        fresh is None
+                        or fresh.rollback_requested
+                        or fresh.state.value not in still_forward
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+            rollout = await Rollout.get(rollout.id) or rollout
+        return web.json_response(
+            rollout.model_dump(mode="json"), status=202
+        )
+
+    app.router.add_post(
+        "/v2/models/{id:\\d+}/rollback", model_rollback
+    )
+
     async def debug_invariants(request: web.Request):
         """Convergence-invariant report for production triage (the same
         checks the chaos harness runs — testing/invariants.py):
@@ -1050,11 +1189,17 @@ def add_extra_routes(app: web.Application) -> None:
                 ),
                 "per_instance": m["per_instance"],
             }
-        return web.json_response({
+        body = {
             "scraped_at": now,
             "workers": workers_out,
             "models": out_models,
-        })
+        }
+        # autoscaler view rides the fleet rollup: the decisions and
+        # the signals they read belong on one surface
+        autoscaler = request.app.get("autoscaler")
+        if autoscaler is not None:
+            body["autoscaler"] = autoscaler.status()
+        return web.json_response(body)
 
     app.router.add_get("/v2/debug/fleet", debug_fleet)
 

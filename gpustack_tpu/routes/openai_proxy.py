@@ -21,6 +21,7 @@ import asyncio
 import json
 import logging
 import random
+import time
 from typing import List, Optional, Tuple
 
 import aiohttp
@@ -487,6 +488,28 @@ async def _resolve_target(request: web.Request, name: str):
         model_id=model.id, state=ModelInstanceState.RUNNING
     )
     if not instances:
+        # first-request wake: a scaled-to-zero model's next tick
+        # brings a replica back (server/autoscaler.py); the client
+        # retries through the 503 while the cold start runs
+        autoscaler = request.app.get("autoscaler")
+        if autoscaler is not None:
+            autoscaler.note_demand(model.name)
+        if model.autoscale_max > 0:
+            # durable marker for HA: only the LEADER's autoscaler loop
+            # runs, and note_demand above is process-local — a 503 on
+            # a follower must still wake the model. Throttled so cold-
+            # start retries don't become a write per request; column-
+            # targeted (set_field) so this hot-path write can never
+            # revert an operator PATCH committing concurrently.
+            from gpustack_tpu.server.autoscaler import (
+                WAKE_MARKER_REFRESH_S,
+            )
+
+            now = time.time()
+            if now - model.wake_requested_at >= WAKE_MARKER_REFRESH_S:
+                await Model.set_field(
+                    model.id, "wake_requested_at", now
+                )
         return None, json_error(
             503, f"no running instances for model {name!r}"
         )

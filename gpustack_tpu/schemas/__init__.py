@@ -43,6 +43,12 @@ from gpustack_tpu.schemas.dev_instances import (
     DevInstance,
     DevInstanceState,
 )
+from gpustack_tpu.schemas.rollouts import (
+    ACTIVE_ROLLOUT_STATES,
+    ModelRevision,
+    Rollout,
+    RolloutState,
+)
 
 __all__ = [
     "Cluster",
@@ -78,4 +84,8 @@ __all__ = [
     "CloudWorkerState",
     "DevInstance",
     "DevInstanceState",
+    "Rollout",
+    "RolloutState",
+    "ModelRevision",
+    "ACTIVE_ROLLOUT_STATES",
 ]

@@ -158,6 +158,11 @@ INSTANCE_STATE_WRITERS = {
     "routes/extras.py": {
         ModelInstanceState.DRAINING,     # operator drain endpoint
     },
+    "server/rollout.py": {
+        # surge-replica PENDING creation goes through controllers.py's
+        # create_pending_instances, so only the drains write here
+        ModelInstanceState.DRAINING,     # old-batch / rollback drains
+    },
     # the chaos harness's stub workers stand in for serve_manager and
     # write the same lifecycle over the HTTP API (wire strings — the
     # static checker can't see those writes; declared for honesty and
@@ -170,6 +175,39 @@ INSTANCE_STATE_WRITERS = {
         ModelInstanceState.ERROR,
     },
 }
+
+
+# Serving-relevant Model fields: changing any of these on a DEPLOYED
+# model means its running engines no longer match the spec, so the API
+# update hook bumps ``Model.generation`` and the RolloutController
+# (server/rollout.py) rolls replicas onto the new generation with
+# health gates instead of restarting them in place. Fields NOT listed
+# here (replicas, SLO targets, autoscale bounds, selectors, org/
+# description) reconcile without a rollout.
+ROLLOUT_FIELDS = (
+    "preset",
+    "local_path",
+    "huggingface_repo_id",
+    "huggingface_filename",
+    "model_scope_model_id",
+    "backend",
+    "backend_version",
+    "backend_parameters",
+    "env",
+    "mesh_plan",
+    "chips_per_replica",
+    "max_seq_len",
+    "max_slots",
+    "quantization",
+    "speculative",
+    "spec_tokens",
+    "draft_source",
+    "host_kv_cache_mb",
+    "kv_block_tokens",
+    "kv_cache_int8",
+    "prefill_chunk",
+    "lora_adapters",
+)
 
 
 def validate_instance_transition(
@@ -252,6 +290,25 @@ class Model(Record):
     slo_error_rate: float = 0.0
     slo_queue_wait_p95_ms: float = 0.0
     slo_availability: float = 0.0
+    # serving-spec version: bumped by the model-update API hook when a
+    # ROLLOUT_FIELDS value changes; instances are tagged with the
+    # generation they were created under, and the RolloutController
+    # converges tagged instances onto the model's generation
+    generation: int = 0
+    # new-generation replicas brought up per rollout batch
+    # (0 = inherit the GPUSTACK_TPU_ROLLOUT_SURGE config default)
+    rollout_surge: int = 0
+    # replica autoscaling bounds (server/autoscaler.py): max 0 disables
+    # autoscaling for this model; min 0 allows scale-to-zero (the
+    # first request for a scaled-to-zero model triggers a wake)
+    autoscale_min: int = 0
+    autoscale_max: int = 0
+    # server-managed durable wake marker (unix seconds; 0 = none): the
+    # proxy's 503 path persists demand here so that in HA a request
+    # landing on a FOLLOWER still wakes a scaled-to-zero model — the
+    # leader's in-memory note_demand set never sees follower traffic.
+    # The leader's autoscaler consumes and clears it.
+    wake_requested_at: float = 0.0
 
     def source_str(self) -> str:
         return (
@@ -307,6 +364,10 @@ class ModelInstance(Record):
     restarts: int = 0
     last_error: str = ""
     pid: int = 0
+    # Model.generation this instance was created under: its engine runs
+    # THAT spec (engines never restart on spec edits), so a mismatch
+    # with the model's current generation is what a rollout converges
+    generation: int = 0
 
     def is_placed(self) -> bool:
         return self.worker_id is not None

@@ -1,0 +1,130 @@
+"""Rollout + model-revision records: versioned, health-gated model
+updates.
+
+A serving-relevant change to a deployed ``Model`` (new checkpoint,
+quantization, slots, … — the fields in
+``schemas/models.py::ROLLOUT_FIELDS``) bumps ``Model.generation``; the
+``RolloutController`` (server/rollout.py) then converges the live
+replica set onto the new generation without ever dropping serving
+capacity below spec:
+
+    surging  → observing → promoting → (surging … per batch) → completed
+        ↘ rolling_back (gate failure / SLO burn / manual) → rolled_back
+
+``ModelRevision`` archives the serving fields of each generation (the
+k8s ReplicaSet-history role) so an automatic rollback can restore the
+previous known-good spec instead of leaving the bad one in the Model
+row for the next replica restart to pick up.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List
+
+from gpustack_tpu.orm.record import Record, register_record
+
+
+class RolloutState(str, enum.Enum):
+    # bringing up the current batch of new-generation replicas
+    SURGING = "surging"
+    # batch RUNNING; health gates judging the observation window
+    OBSERVING = "observing"
+    # gates passed; draining the matched batch of old replicas
+    PROMOTING = "promoting"
+    # terminal: every replica serves the target generation
+    COMPLETED = "completed"
+    # tearing the new generation down, old spec restored
+    ROLLING_BACK = "rolling_back"
+    # terminal: new generation removed, previous spec live again
+    ROLLED_BACK = "rolled_back"
+    # terminal: rollback itself could not complete (e.g. no revision)
+    FAILED = "failed"
+
+
+ACTIVE_ROLLOUT_STATES = frozenset(
+    {
+        RolloutState.SURGING,
+        RolloutState.OBSERVING,
+        RolloutState.PROMOTING,
+        RolloutState.ROLLING_BACK,
+    }
+)
+
+TERMINAL_ROLLOUT_STATES = frozenset(
+    {
+        RolloutState.COMPLETED,
+        RolloutState.ROLLED_BACK,
+        RolloutState.FAILED,
+    }
+)
+
+
+@register_record
+class Rollout(Record):
+    """One versioned rollout plan for one model generation change."""
+
+    __kind__ = "rollout"
+    __indexes__ = ("model_id", "state")
+
+    @classmethod
+    async def active_for(cls, model_id: int) -> "Rollout | None":
+        """Newest mid-flight plan for one model, or None — the single
+        definition of "a rollout owns this model" shared by the
+        routes, replica sync, and the autoscaler's mutual exclusion.
+        Served by one indexed query over (model_id, state): this runs
+        on every replica-sync reconcile, which must not pay for
+        deserializing the model's full retained plan history."""
+        states = sorted(s.value for s in ACTIVE_ROLLOUT_STATES)
+        marks = ", ".join("?" for _ in states)
+        rows = await cls.db().execute(
+            f"SELECT * FROM {cls.__kind__} "
+            f"WHERE model_id = ? AND state IN ({marks}) "
+            "ORDER BY id DESC LIMIT 1",
+            [model_id, *states],
+        )
+        return cls._from_row(rows[0]) if rows else None
+
+    model_id: int = 0
+    model_name: str = ""
+    from_generation: int = 0
+    to_generation: int = 0
+    surge: int = 1                  # new replicas brought up per batch
+    state: RolloutState = RolloutState.SURGING
+    state_message: str = ""
+    # unix seconds the current batch's observation window opened
+    # (0 = not observing)
+    observe_since: float = 0.0
+    # request-histogram snapshots for the delta gates: ``baseline`` is
+    # taken at plan creation, ``baseline_end`` frozen at the FIRST
+    # observation-window open (so the baseline window stays pure
+    # old-generation traffic for every later batch), ``canary`` at
+    # each observation-window open
+    baseline: Dict[str, Any] = {}
+    baseline_end: Dict[str, Any] = {}
+    canary: Dict[str, Any] = {}
+    # operator-requested rollback (reason text) noted by an HA
+    # follower serving POST /rollback — the leader's reconcile
+    # executes it so the incident lands in the leader's SLO ring
+    rollback_requested: str = ""
+    # objectives already FIRING when the plan opened: a rollout is
+    # often the FIX for a live incident, so the burn gate only judges
+    # burns that start after it (pre-existing ones would insta-roll
+    # the fix back and restore the spec that caused them)
+    preexisting_firing: List[str] = []
+    # bounded event log: {"at", "event", "detail"}
+    history: List[Dict[str, Any]] = []
+    # batches already promoted (old replicas drained and retired)
+    promoted: int = 0
+
+
+@register_record
+class ModelRevision(Record):
+    """Serving-field archive of one model generation (rollback source)."""
+
+    __kind__ = "model_revision"
+    __indexes__ = ("model_id", "generation")
+
+    model_id: int = 0
+    generation: int = 0
+    spec: Dict[str, Any] = {}       # ROLLOUT_FIELDS values at this gen

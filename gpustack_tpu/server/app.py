@@ -238,6 +238,9 @@ def create_app(cfg: Config) -> web.Application:
             "worker_id", "worker_name", "worker_ip", "chip_indexes",
             "computed_resource_claim", "subordinate_workers",
             "model_id", "model_name", "cluster_id", "name",
+            # rollout bookkeeping: which spec generation the instance
+            # serves is controller-owned, never agent-reported
+            "generation",
         }
     )
     # Runtime endpoint fields only the leading (placed-on) worker reports.
@@ -281,10 +284,88 @@ def create_app(cfg: Config) -> web.Application:
             return err
         return await model_org_check(request, obj, body)
 
+    async def model_update_hook(request, obj: Model, fields):
+        """Org check + rollout versioning: a change to any serving-
+        relevant field (schemas/models.py ROLLOUT_FIELDS) on a deployed
+        model archives the current spec as a ModelRevision (the
+        rollback source) and bumps ``generation`` — which is what the
+        RolloutController converges instances onto. Replica counts,
+        SLO targets, autoscale bounds etc. reconcile without a
+        rollout."""
+        if err := await model_org_check(request, obj, fields):
+            return err
+        from gpustack_tpu.schemas import ModelRevision
+        from gpustack_tpu.schemas.models import ROLLOUT_FIELDS
+
+        touched = set(fields) & set(ROLLOUT_FIELDS)
+        if "generation" in fields:
+            # generation is server-owned: derived here, never client-set
+            fields.pop("generation")
+        # the durable wake marker is written by the proxy's 503 path
+        # and consumed by the leader's autoscaler — never client-set
+        fields.pop("wake_requested_at", None)
+        if not touched:
+            return None
+        try:
+            candidate = Model.model_validate(
+                {**obj.model_dump(), **fields}
+            )
+        except pydantic.ValidationError as e:
+            return json_error(400, str(e))
+        if all(
+            getattr(candidate, k) == getattr(obj, k) for k in touched
+        ):
+            return None  # no-op writes don't version
+        if await ModelRevision.first(
+            model_id=obj.id, generation=obj.generation
+        ) is None:
+            await ModelRevision.create(ModelRevision(
+                model_id=obj.id,
+                generation=obj.generation,
+                spec={k: getattr(obj, k) for k in ROLLOUT_FIELDS},
+            ))
+        # bounded history: the rollback source only ever needs recent
+        # generations — but a generation an ACTIVE rollout would
+        # restore on gate failure is pinned regardless of age, or a
+        # burst of updates mid-rollout would turn its rollback into
+        # FAILED-with-the-bad-spec-live
+        from gpustack_tpu.schemas import Rollout
+        from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+
+        pinned = {
+            r.from_generation
+            for r in await Rollout.filter(model_id=obj.id)
+            if r.state in ACTIVE_ROLLOUT_STATES
+        }
+        revisions = sorted(
+            await ModelRevision.filter(model_id=obj.id),
+            key=lambda r: r.generation,
+        )
+        for stale in revisions[:-8]:
+            if stale.generation not in pinned:
+                await stale.delete()
+        # derive the bump from a generation re-read AFTER this hook's
+        # awaits: a rollback restore racing this request would have
+        # bumped the row already, and writing obj.generation+1 from
+        # the stale snapshot would give two different specs the same
+        # generation number — the operator's update would then never
+        # roll out (instances already tagged with it). A short window
+        # remains until the route's final write; an honest 409 beats
+        # a silent no-op.
+        current = await Model.get(obj.id)
+        if current is None:
+            return json_error(404, "model deleted concurrently")
+        if current.generation != obj.generation:
+            return json_error(
+                409, "model generation changed concurrently; retry"
+            )
+        fields["generation"] = obj.generation + 1
+        return None
+
     add_crud_routes(
         app, Model, "models",
         create_hook=model_create_and_org_hook,
-        update_hook=model_org_check,
+        update_hook=model_update_hook,
         visible=model_visible,
     )
 
@@ -571,6 +652,17 @@ def create_app(cfg: Config) -> web.Application:
         UsageArchive,
     )
 
+    from gpustack_tpu.schemas import ModelRevision, Rollout
+
+    # rollout plans + per-generation spec archive: controller-owned
+    # (mutations go through /v2/models/{id}/rollback), read-only here
+    add_crud_routes(
+        app, Rollout, "rollouts", readonly=True, admin_read=True
+    )
+    add_crud_routes(
+        app, ModelRevision, "model-revisions",
+        readonly=True, admin_read=True,
+    )
     add_crud_routes(
         app, ResourceEvent, "resource-events",
         readonly=True, admin_read=True,

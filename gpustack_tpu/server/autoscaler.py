@@ -1,0 +1,653 @@
+"""SLO-driven replica autoscaling with scale-to-zero and guardrails.
+
+ROADMAP item 4 closes here: the fleet saturation rollup (PR 7,
+``server/fleet.py``) and the per-model SLO burn state (PR 8) were built
+as the autoscaler's signal sources — this leader-only loop finally
+consumes them. Per-model bounds live on the Model
+(``autoscale_min``/``autoscale_max``; max 0 = off). Decisions use ONLY
+existing signals:
+
+- **occupancy** (running/slots) and **queue wait** from the shared
+  READY-worker scrape (``scrape_normalized_samples`` — the same
+  pipeline ``GET /v2/debug/fleet`` serves, so dashboards and decisions
+  can't disagree);
+- **SLO burn state**: a FIRING queue-wait/TTFT burn on the model is an
+  immediate scale-up signal;
+- **traffic**: per-model request-count deltas from the live request
+  histogram drive the idle clock for scale-to-zero.
+
+Flap damping: scale-down needs the low-occupancy condition to HOLD for
+``autoscale_down_stable_s`` (hysteresis), and every action starts a
+per-model ``autoscale_cooldown_s`` cooldown (wake-from-zero exempt —
+cold start already costs enough). Scale-to-zero releases a min-0
+model's replicas after ``autoscale_idle_after_s`` of zero traffic and
+zero in-flight; the first request for the scaled-to-zero model (the
+proxy's 503 path calls :meth:`note_demand` AND persists the durable
+``Model.wake_requested_at`` marker, so in HA a request landing on a
+follower still wakes the model — the leader consumes and clears the
+marker each pass) wakes it, and the measured
+cold start — SCHEDULED→RUNNING dwell p95 from the PR 5 lifecycle
+histogram — is exported so operators can judge whether scale-to-zero
+is affordable for a model.
+
+Hard guardrails: never scale down past in-flight load (running +
+waiting vs slot capacity), never act while a rollout for the model is
+mid-flight, and **freeze** — trace event + ``gpustack_autoscale_frozen``
+metric, no replica writes — when the newest scrape for a model with
+running replicas is older than ``autoscale_stale_after_s``. Degraded
+telemetry fails safe instead of thrashing replicas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability.metrics import (
+    METRIC_FAMILIES,
+    escape_label_value,
+    get_registry,
+)
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    Rollout,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.utils.profiling import timed
+
+logger = logging.getLogger(__name__)
+
+QUEUE_WAIT_METRIC = "gpustack_tpu:queue_oldest_wait_seconds"
+SCRAPE_AGE_METRIC = "gpustack_tpu:scrape_age_seconds"
+
+# lifecycle states whose dwell adds up to a cold start (instance
+# creation → serving); labels of gpustack_instance_state_seconds
+COLD_START_STATES = ("scheduled", "downloading", "starting")
+
+DECISION_HISTORY = 128
+
+# minimum seconds between durable wake-marker writes from the proxy's
+# 503 path (routes/openai_proxy.py) — client retries through a cold
+# start must not become one Model write per request
+WAKE_MARKER_REFRESH_S = 5.0
+
+
+@dataclasses.dataclass
+class ModelSignals:
+    """Per-model slice of the fleet scrape the decision loop reads."""
+
+    occupancy: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+    requests_running: float = 0.0
+    requests_waiting: float = 0.0
+    slots_total: float = 0.0
+    # cumulative prompt+generation tokens across the model's engines —
+    # ground-truth traffic no matter WHICH server proxied the request
+    # (in HA the leader's request histogram never sees follower-served
+    # traffic, but the engines' own counters do)
+    tokens_total: float = 0.0
+    # seconds since the stalest replica's engine scrape; None = the
+    # scrape returned no samples for this model at all
+    age_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _ModelState:
+    name: str = ""
+    last_action_at: float = 0.0
+    low_since: Optional[float] = None
+    last_traffic_at: float = 0.0
+    last_request_count: float = -1.0
+    last_engine_tokens: float = -1.0
+    frozen: bool = False
+    last_action: str = ""
+    target: int = -1
+
+
+class Autoscaler(PeriodicTask):
+    task_name = "autoscaler"
+
+    def __init__(self, app, cfg: Config, signals=None):
+        super().__init__(max(0.05, cfg.autoscale_interval))
+        self.app = app
+        self.cfg = cfg
+        # injectable signal provider (tests feed synthetic fleets);
+        # the default reads the shared READY-worker scrape
+        self._signals = signals or self._fleet_signals
+        self._state: Dict[int, _ModelState] = {}
+        self._wake: set = set()          # model names with 503'd demand
+        self._decisions: deque = deque(maxlen=DECISION_HISTORY)
+        self._events = get_registry("server").counter(
+            "gpustack_autoscale_events_total",
+            label_names=("model", "action"),
+        )
+        self.ticks = 0
+
+    async def tick(self) -> None:
+        await self.scale_once()
+
+    # ---- wake hook (called from the proxy's 503 path) --------------------
+
+    def note_demand(self, model_name: str) -> None:
+        """A request arrived for a model with no running replicas —
+        remember it so the next tick can wake a scaled-to-zero model."""
+        self._wake.add(model_name)
+
+    # ---- decision loop ---------------------------------------------------
+
+    @timed(threshold_s=5.0, name="autoscaler.scale")
+    async def scale_once(
+        self, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """One decision pass; ``now`` is injectable (sloeval-style) so
+        tests drive cooldowns and idle clocks deterministically.
+        Returns the decisions applied this pass."""
+        now = time.time() if now is None else now
+        self.ticks += 1
+        models = await Model.filter(limit=None)
+        scaled = [m for m in models if m.autoscale_max > 0]
+        if not scaled:
+            self._state.clear()
+            # demand notes for non-autoscaled models must not pool
+            self._wake.clear()
+            return []
+        instances = await ModelInstance.filter(limit=None)
+        by_model: Dict[int, List[ModelInstance]] = {}
+        for inst in instances:
+            by_model.setdefault(inst.model_id, []).append(inst)
+        rollouts = await Rollout.filter(limit=None)
+        mid_rollout = {
+            r.model_id for r in rollouts
+            if r.state in ACTIVE_ROLLOUT_STATES
+        }
+        # snapshot-and-swap: demand noted WHILE this pass runs (the
+        # fleet scrape awaits) must survive to the next tick, not be
+        # cleared unhandled at the end
+        wake, self._wake = self._wake, set()
+        # wake demand for a scaled-to-zero model is the ONLY record of
+        # a waiting client: track it as pending BEFORE any durable
+        # marker is cleared, and re-pool whatever a replica write
+        # never served in the finally — a skipped decision (freeze,
+        # rollout exclusion, changed-under-us), a pass that dies
+        # mid-scrape, or an exception in the consume loop itself must
+        # not evaporate the demand
+        pending = {
+            m.name for m in scaled
+            if m.name in wake and max(0, m.replicas) == 0
+        }
+        applied: List[Dict[str, Any]] = []
+        live_ids = set()
+        try:
+            # durable wake markers (HA): a request that 503'd on a
+            # FOLLOWER lands in Model.wake_requested_at
+            # (routes/openai_proxy.py) — the leader's in-memory
+            # note_demand set never sees follower traffic.
+            # Consume-and-clear so a handled marker can't replay as a
+            # phantom wake after a later scale-to-zero; pending is
+            # updated BEFORE each clear so a mid-loop failure keeps
+            # already-cleared markers' demand alive.
+            for m in scaled:
+                if m.wake_requested_at > 0:
+                    wake.add(m.name)
+                    if max(0, m.replicas) == 0:
+                        pending.add(m.name)
+                    # column-targeted clear: a whole-document write
+                    # from this (already stale) snapshot could revert
+                    # an operator PATCH landing between the filter and
+                    # here
+                    await Model.set_field(
+                        m.id, "wake_requested_at", 0.0
+                    )
+            signals = await self._signals(scaled, instances)
+            traffic = self._request_counts({m.name for m in scaled})
+            for model in scaled:
+                live_ids.add(model.id)
+                try:
+                    decision = await self._decide(
+                        model,
+                        by_model.get(model.id, []),
+                        signals.get(model.name, ModelSignals()),
+                        traffic.get(model.name, 0.0),
+                        model.id in mid_rollout,
+                        wake,
+                        now,
+                    )
+                except Exception:
+                    # one model's broken decision must not starve the
+                    # rest (mirrors the rollout reconcile loop); its
+                    # pending wake stays pooled for the next tick
+                    logger.exception(
+                        "autoscale decision failed for model %s",
+                        model.name,
+                    )
+                    continue
+                if decision is not None:
+                    applied.append(decision)
+                    # at zero the only possible actions write
+                    # replicas >= 1, so the demand is served
+                    pending.discard(model.name)
+        finally:
+            self._wake |= pending
+        # deleted / autoscale-disabled models retire their state
+        for mid in [m for m in self._state if m not in live_ids]:
+            del self._state[mid]
+        return applied
+
+    async def _decide(
+        self,
+        model: Model,
+        insts: List[ModelInstance],
+        sig: ModelSignals,
+        request_count: float,
+        rollout_active: bool,
+        wake: set,
+        now: float,
+    ) -> Optional[Dict[str, Any]]:
+        st = self._state.setdefault(model.id, _ModelState())
+        st.name = model.name
+        lo = max(0, model.autoscale_min)
+        hi = max(lo, model.autoscale_max)
+        current = max(0, model.replicas)
+        st.target = current
+
+        # traffic clock: any new proxied request resets the idle timer
+        if st.last_request_count < 0:
+            st.last_request_count = request_count
+            st.last_traffic_at = now
+        elif request_count > st.last_request_count:
+            st.last_request_count = request_count
+            st.last_traffic_at = now
+        # engine-observed traffic also resets the clock: the request
+        # histogram above is leader-local, so in HA a model served
+        # entirely through a follower would look idle here and get
+        # reaped by to_zero mid-use. The engines' scraped in-flight
+        # gauges and cumulative token counters see every request
+        # regardless of which server proxied it.
+        if sig.requests_running + sig.requests_waiting > 0:
+            st.last_traffic_at = now
+        if (
+            st.last_engine_tokens < 0
+            or sig.tokens_total < st.last_engine_tokens
+        ):
+            # first sight, or counter went backwards (engine restart /
+            # scaled to zero) — rebaseline without claiming traffic
+            st.last_engine_tokens = sig.tokens_total
+        elif sig.tokens_total > st.last_engine_tokens:
+            st.last_engine_tokens = sig.tokens_total
+            st.last_traffic_at = now
+        if model.name in wake:
+            # 503'd demand IS traffic. The proxy's 503 never reaches the
+            # per-model request histogram (the trace has no resolved
+            # target), so without this a cold start longer than the
+            # cooldown flaps forever: wake → idle clock still stale →
+            # to_zero reaps the warming replica → client retries → wake.
+            st.last_traffic_at = now
+
+        if rollout_active:
+            # mutual exclusion: a rollout owns the replica set — a
+            # concurrent resize would race its surge/drain arithmetic
+            st.last_action = "skip_rollout"
+            return None
+
+        running = [
+            i for i in insts if i.state == ModelInstanceState.RUNNING
+        ]
+        # ---- fail-safe freeze on stale signals ------------------------
+        stale = bool(running) and (
+            sig.age_s is None
+            or sig.age_s > self.cfg.autoscale_stale_after_s
+        )
+        if stale:
+            if not st.frozen:
+                st.frozen = True
+                self._events.inc(model=model.name, action="freeze")
+                self._trace_freeze(model.name, sig, now)
+                logger.warning(
+                    "autoscaler frozen for model %s: fleet signals "
+                    "stale (age %s)", model.name,
+                    f"{sig.age_s:.1f}s" if sig.age_s is not None
+                    else "no samples",
+                )
+            st.last_action = "freeze"
+            # the hysteresis clock must not accrue while telemetry is
+            # untrusted — otherwise the first unfrozen tick could
+            # scale down on "stability" nobody actually observed
+            st.low_since = None
+            return None
+        st.frozen = False
+
+        in_flight = sig.requests_running + sig.requests_waiting
+        slots_per_replica = max(1, model.max_slots)
+        min_for_load = math.ceil(in_flight / slots_per_replica)
+        cooled = now - st.last_action_at >= self.cfg.autoscale_cooldown_s
+
+        target, action = current, ""
+        slo_pressure = self._slo_pressure(model.name)
+        hot = (
+            (
+                sig.occupancy is not None
+                and sig.occupancy >= self.cfg.autoscale_up_occupancy
+            )
+            or (
+                sig.queue_wait_s is not None
+                and sig.queue_wait_s >= self.cfg.autoscale_queue_wait_s
+            )
+            or slo_pressure
+        )
+        cold = (
+            sig.occupancy is not None
+            and sig.occupancy <= self.cfg.autoscale_down_occupancy
+            and (
+                sig.queue_wait_s is None
+                or sig.queue_wait_s
+                < self.cfg.autoscale_queue_wait_s
+            )
+        )
+        if cold and current > lo:
+            if st.low_since is None:
+                st.low_since = now
+        else:
+            st.low_since = None
+
+        if current < lo:
+            target, action = lo, "bounds"
+        elif current > hi:
+            # hard bound: autoscale_max wins even over in-flight load
+            # (the operator lowered it deliberately; the invariant is
+            # replicas-within-bounds)
+            target, action = hi, "bounds"
+        elif current == 0:
+            if model.name in wake:
+                # wake-from-zero skips the cooldown: the client is
+                # already waiting out the cold start
+                target, action = max(1, lo), "wake"
+        elif hot:
+            if current < hi and cooled:
+                target, action = current + 1, "up"
+        elif (
+            lo == 0
+            and in_flight <= 0
+            and now - st.last_traffic_at
+            >= self.cfg.autoscale_idle_after_s
+            and cooled
+        ):
+            target, action = 0, "to_zero"
+        elif (
+            st.low_since is not None
+            and now - st.low_since >= self.cfg.autoscale_down_stable_s
+            and cooled
+        ):
+            # guardrail: never scale down past in-flight load. Floor
+            # is at least 1: the 1 -> 0 step belongs exclusively to
+            # the to_zero branch above, which alone checks the idle
+            # clock and zero in-flight — 5s of low occupancy must not
+            # park a model that served a request seconds ago
+            target = max(
+                current - 1, lo, 1, min(min_for_load, hi)
+            )
+            if target < current:
+                action = "down"
+
+        if not action or target == current:
+            # st.target keeps `current`: the scale-down guardrail can
+            # compute min_for_load > current, and exporting that as
+            # "the target the autoscaler last wrote" would show a
+            # phantom divergence on the target-vs-instances panel
+            st.last_action = st.last_action or ""
+            return None
+        # re-fetch right before writing: Record.update persists the
+        # WHOLE document, and this pass awaited worker scrapes since
+        # `model` was read — writing the stale object would silently
+        # revert a concurrent operator update (spec, generation, …)
+        fresh = await Model.get(model.id)
+        if fresh is None or fresh.replicas != model.replicas:
+            # compare the RAW snapshot, not the 0-clamped `current`: a
+            # (client-writable) negative replica count would otherwise
+            # mismatch forever and silently wedge bounds/wake
+            return None  # changed under us; re-decide next tick
+        await fresh.update(replicas=target)
+        # exported target tracks WRITES only — set after the
+        # changed-under-us guard, or a skipped write would still
+        # report the unapplied target on /metrics
+        st.target = target
+        st.last_action_at = now
+        st.last_action = action
+        st.low_since = None
+        self._events.inc(model=model.name, action=action)
+        decision = {
+            "at": now,
+            "model": model.name,
+            "action": action,
+            "from": current,
+            "to": target,
+            "occupancy": sig.occupancy,
+            "queue_wait_s": sig.queue_wait_s,
+            "in_flight": in_flight,
+            "slo_pressure": slo_pressure,
+        }
+        self._decisions.append(decision)
+        logger.info(
+            "autoscaler: model %s %s %d -> %d (occ=%s wait=%s "
+            "in_flight=%.0f slo=%s)",
+            model.name, action, current, target,
+            sig.occupancy, sig.queue_wait_s, in_flight, slo_pressure,
+        )
+        return decision
+
+    # ---- signal collection -----------------------------------------------
+
+    async def _fleet_signals(
+        self, models: List[Model], instances: List[ModelInstance]
+    ) -> Dict[str, ModelSignals]:
+        """Default provider: the shared READY-worker metrics scrape
+        (server/fleet.py — identical samples to /v2/debug/fleet)."""
+        from gpustack_tpu.server.fleet import scrape_normalized_samples
+
+        workers = [
+            w for w in await Worker.filter(limit=None)
+            if w.state == WorkerState.READY
+        ]
+        inst_model = {str(i.id): i.model_name for i in instances}
+        workers_out, samples = await scrape_normalized_samples(
+            self.app, workers, inst_model
+        )
+        dark = {
+            wid for wid, info in workers_out.items()
+            if not info.get("reachable")
+        }
+        out: Dict[str, ModelSignals] = {}
+        for (model, _iid), metrics in samples.items():
+            if not model:
+                continue
+            sig = out.setdefault(model, ModelSignals())
+            sig.requests_running += metrics.get(
+                "gpustack_tpu:requests_running", 0.0
+            )
+            sig.requests_waiting += metrics.get(
+                "gpustack_tpu:requests_waiting", 0.0
+            )
+            sig.slots_total += metrics.get(
+                "gpustack_tpu:slots_total", 0.0
+            )
+            sig.tokens_total += metrics.get(
+                "gpustack_tpu:prompt_tokens_total", 0.0
+            ) + metrics.get(
+                "gpustack_tpu:generation_tokens_total", 0.0
+            )
+            wait = metrics.get(QUEUE_WAIT_METRIC)
+            if wait is not None:
+                sig.queue_wait_s = max(
+                    sig.queue_wait_s or 0.0, wait
+                )
+            age = metrics.get(SCRAPE_AGE_METRIC)
+            if age is not None:
+                # worst replica: decisions must not ride one fresh
+                # replica while another's telemetry went dark
+                sig.age_s = max(sig.age_s or 0.0, age)
+            elif sig.age_s is None:
+                sig.age_s = 0.0
+        # a replica whose WORKER scrape failed contributes no sample at
+        # all — if a sibling replica still reports, the model would
+        # read fresh (age from the sibling) with the dark replica's
+        # load simply invisible, and 'cold' could scale down mid-
+        # partition. Force worst-replica staleness so the freeze
+        # guardrail catches partially-dark fleets too.
+        if dark:
+            for inst in instances:
+                if (
+                    inst.state == ModelInstanceState.RUNNING
+                    and inst.worker_id in dark
+                ):
+                    sig = out.setdefault(
+                        inst.model_name, ModelSignals()
+                    )
+                    sig.age_s = float("inf")
+        for sig in out.values():
+            if sig.slots_total > 0:
+                sig.occupancy = min(
+                    1.0, sig.requests_running / sig.slots_total
+                )
+        return out
+
+    def _request_counts(self, names: set) -> Dict[str, float]:
+        """Cumulative proxied-request counts per model (phase=total)
+        from the live request histogram — the idle clock's input."""
+        snap = get_registry("server").histogram(
+            "gpustack_request_duration_seconds",
+            label_names=("phase", "model", "outcome"),
+        ).snapshot()
+        out: Dict[str, float] = {}
+        for (phase, model, _outcome), (_cum, _sum, count) in snap.items():
+            if phase == "total" and model in names:
+                out[model] = out.get(model, 0.0) + count
+        return out
+
+    def _slo_pressure(self, model_name: str) -> bool:
+        """FIRING latency-shaped burn (queue_wait/ttft) = scale-up
+        pressure; error-rate/availability burns are not capacity
+        signals and never trigger growth."""
+        evaluator = self.app.get("slo")
+        if evaluator is None:
+            return False
+        firing = evaluator.engine.firing_objectives(model_name)
+        return bool({"queue_wait", "ttft"} & set(firing))
+
+    def _trace_freeze(
+        self, model_name: str, sig: ModelSignals, now: float
+    ) -> None:
+        """Stale-signal freezes are operator-relevant: drop a trace
+        entry in the server ring so /v2/debug/traces shows WHEN the
+        autoscaler stopped trusting its inputs."""
+        from gpustack_tpu.observability import tracing
+
+        tracing.get_store("server").add({
+            "trace_id": uuid.uuid4().hex,
+            "span_id": uuid.uuid4().hex[:16],
+            "component": "server",
+            "name": "autoscaler.freeze",
+            "model": model_name,
+            "started_at": now,
+            "duration_ms": 0.0,
+            "outcome": "frozen",
+            "events": [{
+                "name": "signals_stale",
+                "age_s": sig.age_s,
+                "threshold_s": self.cfg.autoscale_stale_after_s,
+            }],
+        })
+
+    # ---- reads -----------------------------------------------------------
+
+    def cold_start_estimate(self) -> Optional[float]:
+        """Measured cold start: p95 dwell of the pre-serving lifecycle
+        states (SCHEDULED→RUNNING path) from the PR 5 state-dwell
+        histogram. None until enough lifecycle history exists."""
+        from gpustack_tpu.observability.metrics import DWELL_BUCKETS
+
+        hist = get_registry("server").histogram(
+            "gpustack_instance_state_seconds",
+            buckets=DWELL_BUCKETS,
+            label_names=("state",),
+        )
+        total, seen = 0.0, False
+        for state in COLD_START_STATES:
+            q = hist.quantile(0.95, state=state)
+            if q is not None:
+                total += q
+                seen = True
+        return total if seen else None
+
+    def status(self) -> Dict[str, Any]:
+        """Autoscaler view for ``GET /v2/debug/fleet``."""
+        models = {}
+        for _mid, st in sorted(self._state.items()):
+            models[st.name or str(_mid)] = {
+                "target": st.target,
+                "frozen": st.frozen,
+                "last_action": st.last_action,
+                "last_action_at": st.last_action_at or None,
+                "idle_seconds": (
+                    round(time.time() - st.last_traffic_at, 1)
+                    if st.last_traffic_at else None
+                ),
+            }
+        return {
+            "ticks": self.ticks,
+            "interval_seconds": self.interval,
+            "cold_start_p95_seconds": self.cold_start_estimate(),
+            "models": models,
+            "decisions": list(self._decisions)[-20:],
+        }
+
+    def metrics_lines(self) -> List[str]:
+        """``gpustack_autoscale_*`` gauges (the decision counter
+        renders via the shared registry). Rendered from the live state
+        map — model ids resolve lazily through the decision loop's
+        last pass."""
+        target: List[str] = []
+        frozen: List[str] = []
+        lines: List[str] = []
+        for _mid, st in sorted(self._state.items()):
+            if not st.name:
+                continue
+            labels = f'model="{escape_label_value(st.name)}"'
+            if st.target >= 0:
+                target.append(
+                    "gpustack_autoscale_replicas_target"
+                    f"{{{labels}}} {st.target}"
+                )
+            frozen.append(
+                "gpustack_autoscale_frozen"
+                f"{{{labels}}} {1 if st.frozen else 0}"
+            )
+
+        def family(name: str, out: List[str]) -> List[str]:
+            if not out:
+                return []
+            return [f"# TYPE {name} {METRIC_FAMILIES[name]}"] + out
+
+        lines = family(
+            "gpustack_autoscale_replicas_target", target
+        ) + family("gpustack_autoscale_frozen", frozen)
+        cold = self.cold_start_estimate()
+        if cold is not None:
+            kind = METRIC_FAMILIES[
+                "gpustack_autoscale_cold_start_seconds"
+            ]
+            lines += [
+                "# TYPE gpustack_autoscale_cold_start_seconds "
+                f"{kind}",
+                f"gpustack_autoscale_cold_start_seconds {cold:.3f}",
+            ]
+        return lines

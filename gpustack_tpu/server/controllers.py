@@ -35,6 +35,41 @@ from gpustack_tpu.utils.profiling import timed
 logger = logging.getLogger(__name__)
 
 
+async def create_pending_instances(
+    model: Model,
+    count: int,
+    generation: int,
+    existing: list,
+    prefix: Optional[str] = None,
+) -> list:
+    """Create ``count`` PENDING replicas for ``model`` tagged with
+    ``generation``, skipping name collisions with ``existing``.
+
+    Shared by replica sync (steady-state creation, ``model-N`` names)
+    and the rollout controller's surge step (``model-gG-N`` names) so
+    instance-creation defaults live in exactly one place.
+    """
+    used = {i.name for i in existing}
+    stem = prefix or model.name
+    created = []
+    idx = 0
+    while len(created) < count:
+        name = f"{stem}-{idx}"
+        idx += 1
+        if name in used:
+            continue
+        inst = await ModelInstance.create(ModelInstance(
+            name=name,
+            model_id=model.id,
+            model_name=model.name,
+            cluster_id=model.cluster_id,
+            state=ModelInstanceState.PENDING,
+            generation=generation,
+        ))
+        created.append(inst)
+    return created
+
+
 class Controller:
     """Base: consume a Record watch stream; re-list on RESYNC."""
 
@@ -169,27 +204,25 @@ class ModelController(Controller):
 
     @timed(threshold_s=5.0, name="controllers.replica_sync")
     async def _sync_replicas(self, model: Model) -> None:
+        from gpustack_tpu.schemas import Rollout
+
+        if await Rollout.active_for(model.id) is not None:
+            # a mid-flight rollout owns the replica set: it deliberately
+            # runs spec+surge instances and drains batches itself —
+            # count enforcement here would fight its arithmetic
+            return
         instances = await ModelInstance.filter(model_id=model.id)
         want = max(0, model.replicas)
         if len(instances) < want:
-            used_names = {i.name for i in instances}
-            idx = 0
-            while len(instances) < want:
-                name = f"{model.name}-{idx}"
-                idx += 1
-                if name in used_names:
-                    continue
-                inst = await ModelInstance.create(
-                    ModelInstance(
-                        name=name,
-                        model_id=model.id,
-                        model_name=model.name,
-                        cluster_id=model.cluster_id,
-                        state=ModelInstanceState.PENDING,
-                    )
-                )
+            # new replicas tagged with the spec version they will
+            # serve — the RolloutController converges tags
+            created = await create_pending_instances(
+                model, want - len(instances),
+                model.generation, instances,
+            )
+            for inst in created:
                 instances.append(inst)
-                logger.info("created instance %s", name)
+                logger.info("created instance %s", inst.name)
         elif len(instances) > want:
             # retire non-running first, then newest
             order = {

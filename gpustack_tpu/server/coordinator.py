@@ -60,6 +60,7 @@ class LocalCoordinator(Coordinator):
     def __init__(self) -> None:
         self._callbacks: List[Callable[[bool], Awaitable[None]]] = []
         self._started = False
+        self._late_tasks: set = set()
 
     async def start(self) -> None:
         self._started = True
@@ -78,7 +79,17 @@ class LocalCoordinator(Coordinator):
     ) -> None:
         self._callbacks.append(callback)
         if self._started:
-            asyncio.get_event_loop().create_task(callback(True))
+            # register-after-start still fires: get_running_loop, not
+            # the deprecated get_event_loop (which creates a NEW loop
+            # when called off-loop and silently never runs the task).
+            # The loop holds only a weak reference to tasks — keep a
+            # strong one until done or GC can collect it mid-flight
+            # and the component never hears on_leadership(True)
+            task = asyncio.get_running_loop().create_task(
+                callback(True), name="coordinator-late-callback"
+            )
+            self._late_tasks.add(task)
+            task.add_done_callback(self._late_tasks.discard)
 
     def publish_remote(self, event: Event) -> None:
         pass  # no peers
@@ -127,9 +138,20 @@ class LeaseCoordinator(Coordinator):
         self._task = asyncio.create_task(self._loop(), name="coordinator")
 
     async def stop(self) -> None:
-        if self._task:
-            self._task.cancel()
+        # await the cancelled election task BEFORE touching the lease
+        # row: cancel() alone leaves a mid-renewal UPDATE in flight
+        # that could re-extend the lease AFTER the delete below, making
+        # graceful shutdown hand leadership over only after a full TTL
+        # instead of immediately
+        task, self._task = self._task, None
+        if task:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
         if self._leader:
+            self._leader = False
             await self.db.execute(
                 "DELETE FROM leadership WHERE holder = ?", (self.identity,)
             )
@@ -156,12 +178,21 @@ class LeaseCoordinator(Coordinator):
             try:
                 now = time.time()
                 if self._leader:
-                    rows = await self.db.execute(
+                    # renew-then-verify instead of UPDATE..RETURNING:
+                    # the container's sqlite (3.34) predates RETURNING
+                    # (3.35+). The renewal UPDATE is atomic; the
+                    # follow-up SELECT can only disagree if the lease
+                    # was ALREADY lost — exactly the case that must be
+                    # fatal.
+                    await self.db.execute(
                         "UPDATE leadership SET expires_at = ? "
-                        "WHERE id = 1 AND holder = ? RETURNING holder",
+                        "WHERE id = 1 AND holder = ?",
                         (now + self.ttl, self.identity),
                     )
-                    if not rows:
+                    rows = await self.db.execute(
+                        "SELECT holder FROM leadership WHERE id = 1"
+                    )
+                    if not rows or rows[0]["holder"] != self.identity:
                         # lease lost while held: fatal, never split-brain
                         logger.error(
                             "leadership lease lost; exiting (reference "
@@ -169,15 +200,20 @@ class LeaseCoordinator(Coordinator):
                         )
                         os._exit(1)
                 else:
-                    rows = await self.db.execute(
+                    # atomic conditional upsert (steal only an expired
+                    # lease), then read back who holds it — a fresh
+                    # lease cannot be stolen between the two statements
+                    await self.db.execute(
                         "INSERT INTO leadership (id, holder, expires_at) "
                         "VALUES (1, ?, ?) "
                         "ON CONFLICT(id) DO UPDATE SET "
                         "holder = excluded.holder, "
                         "expires_at = excluded.expires_at "
-                        "WHERE leadership.expires_at < ? "
-                        "RETURNING holder",
+                        "WHERE leadership.expires_at < ?",
                         (self.identity, now + self.ttl, now),
+                    )
+                    rows = await self.db.execute(
+                        "SELECT holder FROM leadership WHERE id = 1"
                     )
                     if rows and rows[0]["holder"] == self.identity:
                         logger.info("acquired leadership")

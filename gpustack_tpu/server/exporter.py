@@ -128,6 +128,12 @@ def add_metrics_route(app: web.Application) -> None:
         slo = request.app.get("slo")
         if slo is not None:
             obs_lines += slo.metrics_lines()
+        # rollout / autoscaler gauges (their event counters render
+        # through the shared registry above)
+        for key in ("rollout", "autoscaler"):
+            component = request.app.get(key)
+            if component is not None:
+                obs_lines += component.metrics_lines()
         if obs_lines:
             text += "\n".join(obs_lines) + "\n"
         return web.Response(text=text)

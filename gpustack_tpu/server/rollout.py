@@ -1,0 +1,992 @@
+"""Zero-downtime rollouts: health-gated canaries + automatic rollback.
+
+The reference rolls replicas through drain-based updates (reference
+scheduler/scheduler.py:261-298 reschedule shape); this controller goes
+further and makes a serving-spec change a *versioned, judged* operation:
+
+1. the model-update API hook bumps ``Model.generation`` for any
+   ``ROLLOUT_FIELDS`` change and archives the previous spec as a
+   ``ModelRevision``;
+2. this leader-only reconcile loop notices instances tagged with an
+   older generation and opens a ``Rollout`` plan: bring up ``surge``
+   new-generation replicas (capacity never dips below spec), wait for
+   each to reach RUNNING within ``rollout_running_deadline``, then hold
+   an observation window;
+3. health gates run every tick: new-generation replica health
+   (ERROR/UNREACHABLE/deadline), any PR 8 SLO burn FIRING on the model,
+   and delta gates against the request histogram — the canary window's
+   error rate and TTFT p95 vs the pre-rollout baseline window (pure
+   old-generation traffic);
+4. gates pass → the matched batch of old replicas drains through the
+   existing DRAINING path (PR 2) and the worker retires them; repeat
+   until the old generation is gone;
+5. ANY gate failure (or ``POST /v2/models/{id}/rollback``) triggers
+   automatic rollback: the archived old spec is restored onto the
+   Model row (generation bumped again so nothing re-rolls), surviving
+   old-generation instances are re-tagged to the restored generation,
+   the new generation is drained/deleted, and the incident lands in
+   the PR 8 ring with a ``rollout`` evidence tag.
+
+During a canary-stage rollback (no batch promoted yet — the seeded
+chaos e2e's acceptance case) the old generation is never touched, so
+it never drops below spec. ``ModelController._sync_replicas`` defers
+replica-count enforcement to this controller while a rollout is
+active; the autoscaler likewise refuses to act mid-rollout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import datetime
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from gpustack_tpu.config import Config
+from gpustack_tpu.observability.metrics import (
+    METRIC_FAMILIES,
+    escape_label_value,
+    get_registry,
+)
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    ModelRevision,
+    Rollout,
+    RolloutState,
+)
+from gpustack_tpu.schemas.models import ROLLOUT_FIELDS
+from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.server.controllers import create_pending_instances
+from gpustack_tpu.utils.profiling import timed
+
+logger = logging.getLogger(__name__)
+
+# gpustack_rollout_state gauge encoding (docs/OBSERVABILITY.md)
+ROLLOUT_STATE_CODES = {
+    RolloutState.COMPLETED: 0,
+    RolloutState.SURGING: 1,
+    RolloutState.OBSERVING: 2,
+    RolloutState.PROMOTING: 3,
+    RolloutState.ROLLING_BACK: 4,
+    RolloutState.ROLLED_BACK: 5,
+    RolloutState.FAILED: 6,
+}
+
+HISTORY_CAP = 50
+# finished plans kept per model (active plans are never pruned)
+ROLLOUT_KEEP = 20
+
+
+# ---------------------------------------------------------------------------
+# request-histogram snapshots + delta gates (pure helpers, unit-tested)
+# ---------------------------------------------------------------------------
+
+
+def snapshot_model_requests(model_name: str) -> Dict[str, Any]:
+    """JSON-serializable cumulative request counts for one model from
+    the server's live ``gpustack_request_duration_seconds`` histogram:
+    outcome=ok vs all (phase=total) and the TTFT bucket counts."""
+    snap = get_registry("server").histogram(
+        "gpustack_request_duration_seconds",
+        label_names=("phase", "model", "outcome"),
+    ).snapshot()
+    ok = total = ttft_count = 0
+    ttft: Dict[str, float] = {}
+    for (phase, m, _outcome), (cum, _sum, count) in snap.items():
+        if m != model_name:
+            continue
+        if phase == "total":
+            total += count
+            if _outcome == "ok":
+                ok += count
+        elif phase == "ttft":
+            ttft_count += count
+            # cumulative arrays share bucket bounds, so summing them
+            # pairwise across outcomes keeps them cumulative
+            for ub, c in cum:
+                key = "inf" if ub == float("inf") else repr(ub)
+                ttft[key] = ttft.get(key, 0) + c
+    return {
+        "ok": ok, "total": total,
+        "ttft": ttft, "ttft_count": ttft_count,
+    }
+
+
+def window_error_rate(
+    end: Dict[str, Any], start: Dict[str, Any], min_requests: int
+) -> Optional[float]:
+    """Error rate over the [start, end) snapshot delta, or None when
+    the window saw fewer than ``min_requests`` requests."""
+    total = end.get("total", 0) - start.get("total", 0)
+    if total < max(1, min_requests):
+        return None
+    ok = end.get("ok", 0) - start.get("ok", 0)
+    return max(0.0, min(1.0, 1.0 - ok / total))
+
+
+def window_ttft_p95(
+    end: Dict[str, Any], start: Dict[str, Any], min_requests: int
+) -> Optional[float]:
+    """TTFT p95 (seconds) over the snapshot delta via the same
+    within-bucket interpolation PromQL's histogram_quantile uses."""
+    count = end.get("ttft_count", 0) - start.get("ttft_count", 0)
+    if count < max(1, min_requests):
+        return None
+    s_ttft = start.get("ttft", {})
+    cum: List[Tuple[float, float]] = []
+    for key, c in end.get("ttft", {}).items():
+        ub = float("inf") if key == "inf" else float(key)
+        cum.append((ub, c - s_ttft.get(key, 0)))
+    cum.sort(key=lambda p: p[0])
+    if not cum:
+        return None
+    rank = 0.95 * count
+    prev_ub, prev_cum = 0.0, 0.0
+    for ub, c in cum:
+        if c >= rank:
+            if ub == float("inf"):
+                return prev_ub
+            if c == prev_cum:
+                return ub
+            frac = (rank - prev_cum) / (c - prev_cum)
+            return prev_ub + (ub - prev_ub) * frac
+        prev_ub, prev_cum = ub, c
+    return prev_ub
+
+
+def delta_gate_failure(
+    baseline: Dict[str, Any],
+    baseline_end: Dict[str, Any],
+    canary: Dict[str, Any],
+    current: Dict[str, Any],
+    cfg: Config,
+) -> Optional[str]:
+    """Judge the canary window against the pre-rollout baseline window.
+
+    Baseline window = [plan creation, FIRST observation start): pure
+    old-generation traffic — frozen there so later batches are not
+    judged against a baseline the new generation already contaminated
+    (a canary just under the allowed delta per batch would otherwise
+    ratchet the baseline up batch over batch). Canary window =
+    [current observation start, now). Either window with fewer than
+    ``rollout_min_requests`` requests leaves its gate undecided (no
+    verdict from noise).
+    """
+    min_req = cfg.rollout_min_requests
+    during_err = window_error_rate(current, canary, min_req)
+    base_err = window_error_rate(baseline_end, baseline, min_req)
+    if during_err is not None and base_err is not None:
+        # BOTH windows must be sampled: an under-sampled baseline is
+        # "no verdict", never a perfect 0.0 — a low-traffic model's
+        # first transient error must not blacklist its generation
+        # (the burn-rate gate still covers absolute error budgets)
+        if during_err > base_err + cfg.rollout_max_error_delta:
+            return (
+                f"error-rate gate: {during_err:.3f} in the canary "
+                f"window vs {base_err:.3f} baseline "
+                f"(allowed delta {cfg.rollout_max_error_delta})"
+            )
+    during_p95 = window_ttft_p95(current, canary, min_req)
+    base_p95 = window_ttft_p95(baseline_end, baseline, min_req)
+    if during_p95 is not None and base_p95 is not None:
+        limit = max(base_p95, 1e-3) * cfg.rollout_max_ttft_degradation
+        if during_p95 > limit:
+            return (
+                f"ttft gate: p95 {during_p95 * 1000:.0f}ms in the "
+                f"canary window vs {base_p95 * 1000:.0f}ms baseline "
+                f"(allowed x{cfg.rollout_max_ttft_degradation})"
+            )
+    return None
+
+
+def _created_age(inst: ModelInstance, now: float) -> Optional[float]:
+    try:
+        created = datetime.datetime.fromisoformat(inst.created_at)
+    except ValueError:
+        return None
+    return now - created.timestamp()
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+
+class RolloutController(PeriodicTask):
+    task_name = "rollout-controller"
+
+    def __init__(self, app, cfg: Config):
+        super().__init__(max(0.05, cfg.rollout_interval))
+        self.app = app
+        self.cfg = cfg
+        # serializes every plan write: the route (leader path) and the
+        # reconcile tick both run in the leader process, and the
+        # ROLLING_BACK write lands only AFTER the restore's awaits —
+        # unserialized, a concurrent begin_rollback pair could bump
+        # the generation twice, and a forward _record could fetch its
+        # guard snapshot before a rollback lands yet write after it
+        # (follower routes defer via rollback_requested, so
+        # leader-local locking is sufficient). Reentrant per task:
+        # begin_rollback holds it across its body while its own
+        # _record/_finish calls pass straight through.
+        self._plan_lock_inner = asyncio.Lock()
+        self._plan_lock_task: Optional[asyncio.Task] = None
+        self._events = get_registry("server").counter(
+            "gpustack_rollout_events_total",
+            label_names=("model", "event"),
+        )
+        # model name -> newest rollout state (metrics render cache —
+        # the scrape path never touches the DB)
+        self._latest_state: Dict[str, RolloutState] = {}
+        self.ticks = 0
+
+    async def tick(self) -> None:
+        await self.reconcile_once()
+
+    # ------------------------------------------------------------------
+
+    @timed(threshold_s=5.0, name="rollout.reconcile")
+    async def reconcile_once(self, now: Optional[float] = None) -> None:
+        """One reconcile pass; ``now`` is injectable so tests drive a
+        synthetic clock over real DB state."""
+        now = time.time() if now is None else now
+        self.ticks += 1
+        models = await Model.filter(limit=None)
+        instances = await ModelInstance.filter(limit=None)
+        rollouts = await Rollout.filter(limit=None)
+        by_model: Dict[int, List[ModelInstance]] = {}
+        for inst in instances:
+            by_model.setdefault(inst.model_id, []).append(inst)
+        ro_by_model: Dict[int, List[Rollout]] = {}
+        for r in rollouts:
+            ro_by_model.setdefault(r.model_id, []).append(r)
+
+        latest: Dict[str, RolloutState] = {}
+        for model in models:
+            insts = by_model.get(model.id, [])
+            ros = sorted(
+                ro_by_model.get(model.id, []), key=lambda r: r.id
+            )
+            if ros:
+                latest[model.name] = ros[-1].state
+            active = [
+                r for r in ros if r.state in ACTIVE_ROLLOUT_STATES
+            ]
+            try:
+                if active:
+                    await self._advance(model, active[-1], insts, now)
+                    fresh = await Rollout.get(active[-1].id)
+                    if fresh is not None:
+                        latest[model.name] = fresh.state
+                elif self._needs_rollout(model, insts, ros):
+                    rollout = await self._start(model, insts, now)
+                    latest[model.name] = rollout.state
+            except Exception:
+                # one model's broken rollout must not starve the rest
+                logger.exception(
+                    "rollout reconcile failed for model %s", model.name
+                )
+        # a model deleted mid-rollout orphans its active plan — close
+        # it so nothing reads as "in flight" forever
+        model_ids = {m.id for m in models}
+        for r in rollouts:
+            if (
+                r.state in ACTIVE_ROLLOUT_STATES
+                and r.model_id not in model_ids
+            ):
+                # no `latest` touch: the gauge cache is built from the
+                # EXISTING models list above, so the orphan was never
+                # added — and popping by name would wrongly drop the
+                # sample of a new model that reused the deleted name
+                await r.update(
+                    state=RolloutState.FAILED,
+                    state_message="model deleted mid-rollout",
+                )
+        # bound the table: finished plans beyond the newest ROLLOUT_KEEP
+        # per model are deleted — otherwise every reconcile (and
+        # _sync_replicas' rollout-active check) scans a set that grows
+        # for the life of the model. A finished plan targeting the
+        # model's CURRENT generation is kept regardless: it is what
+        # stops _needs_rollout from auto-retrying a failed spec.
+        gen_by_model = {m.id: m.generation for m in models}
+        for mid, ros in ro_by_model.items():
+            done = [
+                r for r in sorted(ros, key=lambda r: r.id)
+                if r.state not in ACTIVE_ROLLOUT_STATES
+                and r.to_generation != gen_by_model.get(mid)
+            ]
+            for r in done[:-ROLLOUT_KEEP]:
+                await r.delete()
+        self._latest_state = latest
+
+    # ---- plan lifecycle --------------------------------------------------
+
+    def _needs_rollout(
+        self, model: Model, insts: List[ModelInstance], ros: List[Rollout]
+    ) -> bool:
+        if model.replicas <= 0 or not insts:
+            return False
+        if all(i.generation == model.generation for i in insts):
+            return False
+        # one attempt per target generation: a rolled-back/failed
+        # attempt blocks retries until the operator ships a new spec
+        # (which bumps the generation) — automatic re-tries of a spec
+        # that just failed its canary would flap forever
+        return not any(r.to_generation == model.generation for r in ros)
+
+    async def _start(
+        self, model: Model, insts: List[ModelInstance], now: float
+    ) -> Rollout:
+        surge = max(1, model.rollout_surge or self.cfg.rollout_surge)
+        evaluator = self.app.get("slo")
+        preexisting = (
+            list(evaluator.engine.firing_objectives(model.name))
+            if evaluator is not None
+            else []
+        )
+        from_gen = max(
+            (
+                i.generation for i in insts
+                if i.generation != model.generation
+            ),
+            default=max(0, model.generation - 1),
+        )
+        rollout = await Rollout.create(Rollout(
+            model_id=model.id,
+            model_name=model.name,
+            from_generation=from_gen,
+            to_generation=model.generation,
+            surge=surge,
+            state=RolloutState.SURGING,
+            state_message="surging first batch",
+            baseline=snapshot_model_requests(model.name),
+            preexisting_firing=preexisting,
+            history=[{
+                "at": now, "event": "started",
+                "detail": (
+                    f"generation {from_gen} -> {model.generation}, "
+                    f"surge {surge}"
+                    + (
+                        "; already-firing burns excluded from the "
+                        f"gate: {'/'.join(preexisting)}"
+                        if preexisting else ""
+                    )
+                ),
+            }],
+        ))
+        self._events.inc(model=model.name, event="started")
+        logger.info(
+            "rollout %d started: model %s generation %d -> %d",
+            rollout.id, model.name, from_gen, model.generation,
+        )
+        return rollout
+
+    async def _advance(
+        self,
+        model: Model,
+        rollout: Rollout,
+        insts: List[ModelInstance],
+        now: float,
+    ) -> None:
+        spec = max(0, model.replicas)
+        new = [
+            i for i in insts if i.generation == rollout.to_generation
+        ]
+        old = [
+            i for i in insts if i.generation != rollout.to_generation
+        ]
+        if rollout.state == RolloutState.ROLLING_BACK:
+            await self._rollback_step(model, rollout, new, now)
+            return
+        if rollout.rollback_requested:
+            # an HA follower served POST /rollback and could only note
+            # the request (executing there would strand the incident
+            # in the follower's in-memory SLO ring) — the leader
+            # executes it
+            await self.begin_rollback(
+                model, rollout, insts, now,
+                rollout.rollback_requested, event="manual_rollback",
+            )
+            return
+        if spec == 0:
+            # scaled to zero mid-rollout: the rollout drains EVERY
+            # instance itself and completes only once the set is
+            # empty. Completing immediately would hand a mixed set to
+            # replica sync, whose newest-first retirement keeps the
+            # OLD generation — stranded behind this plan's no-retry
+            # marker if the spec is raised again before drains land.
+            # (If the spec comes back up mid-drain, the normal state
+            # machine resumes and converges what survives.)
+            await self._drain_old(
+                insts, "rollout: model scaled to zero"
+            )
+            if not insts:
+                await self._finish(
+                    model, rollout, RolloutState.COMPLETED,
+                    "spec scaled to zero mid-rollout", now,
+                )
+            return
+
+        reason = self._gate_failure(model, rollout, new, now)
+        if reason is not None:
+            await self.begin_rollback(model, rollout, insts, now, reason)
+            return
+
+        if model.generation != rollout.to_generation:
+            # superseded: an operator update landed mid-rollout.
+            # Advancing would surge replicas that BOOT the newest spec
+            # (serve_manager reads the live Model row) while tagged
+            # with this plan's stale generation — the tag invariant
+            # ("its engine runs THAT spec") breaks and the gates judge
+            # a population that is not the generation the plan claims.
+            # Fail the plan instead (mirrors begin_rollback's
+            # supersede branch); _needs_rollout opens a fresh plan
+            # toward the superseding generation on the next pass and
+            # converges the stray canaries as old-generation rows.
+            # (Checked AFTER the gate so a firing canary still routes
+            # through begin_rollback, which records the incident.)
+            await self._finish(
+                model, rollout, RolloutState.FAILED,
+                f"superseded by generation {model.generation} before "
+                "completion; a new rollout converges the fleet", now,
+                event="superseded",
+            )
+            return
+
+        if rollout.state == RolloutState.SURGING:
+            await self._surge_step(model, rollout, new, old, spec, now)
+        elif rollout.state == RolloutState.OBSERVING:
+            await self._observe_step(
+                model, rollout, old, spec, now
+            )
+        elif rollout.state == RolloutState.PROMOTING:
+            await self._promote_step(model, rollout, new, old, spec, now)
+
+    async def _surge_step(
+        self,
+        model: Model,
+        rollout: Rollout,
+        new: List[ModelInstance],
+        old: List[ModelInstance],
+        spec: int,
+        now: float,
+    ) -> None:
+        batch = min(rollout.surge, spec - rollout.promoted)
+        if batch <= 0:
+            if old:
+                # spec shrank mid-rollout below the batches already
+                # promoted: the promoted new-generation capacity covers
+                # the whole (smaller) spec, so every remaining old
+                # replica is excess — drain them all rather than
+                # completing with the generations still mixed. Same
+                # atomicity discipline as _observe_step: re-check the
+                # plan under the lock so a rollback that landed
+                # mid-tick never finds its old generation drained.
+                async with self._plan_lock():
+                    fresh = await Rollout.get(rollout.id)
+                    if fresh is None or fresh.state != rollout.state:
+                        return
+                    await self._drain_old(old)
+                    if await self._record(
+                        rollout, now, "batch_promoted",
+                        f"spec shrank to {spec}; draining all "
+                        f"{len(old)} remaining old replica(s)",
+                        state=RolloutState.PROMOTING,
+                    ):
+                        self._events.inc(
+                            model=model.name, event="batch_promoted"
+                        )
+                return
+            await self._finish(
+                model, rollout, RolloutState.COMPLETED,
+                "all batches promoted", now,
+            )
+            return
+        want_new = rollout.promoted + batch
+        if len(new) < want_new:
+            # new + old is the model's full instance snapshot for this
+            # reconcile pass — the name-collision set needs no re-query
+            created = await create_pending_instances(
+                model, want_new - len(new),
+                rollout.to_generation, new + old,
+                prefix=f"{model.name}-g{rollout.to_generation}",
+            )
+            for inst in created:
+                logger.info(
+                    "rollout %d: surged instance %s",
+                    rollout.id, inst.name,
+                )
+            return
+        running = [
+            i for i in new if i.state == ModelInstanceState.RUNNING
+        ]
+        if len(running) >= want_new:
+            snap = snapshot_model_requests(model.name)
+            fields: Dict[str, Any] = dict(
+                state=RolloutState.OBSERVING,
+                observe_since=now,
+                canary=snap,
+            )
+            if not rollout.baseline_end:
+                # freeze the baseline window's end at the FIRST
+                # observation open: later batches must still be judged
+                # against pure old-generation traffic, not windows the
+                # new generation already served into
+                fields["baseline_end"] = dict(snap)
+            await self._record(
+                rollout, now, "observing",
+                f"batch of {batch} RUNNING; observation window open",
+                **fields,
+            )
+
+    async def _observe_step(
+        self,
+        model: Model,
+        rollout: Rollout,
+        old: List[ModelInstance],
+        spec: int,
+        now: float,
+    ) -> None:
+        current = snapshot_model_requests(model.name)
+        if (
+            current.get("total", 0) < rollout.canary.get("total", 0)
+            or current.get("ttft_count", 0)
+            < rollout.canary.get("ttft_count", 0)
+        ):
+            # the in-memory histogram the persisted snapshots came
+            # from reset (server restart / HA leader change). No
+            # pre-rollout baseline exists anymore, so for THIS batch
+            # the delta gates are undecided by construction
+            # (baseline == canary → 0-request base window) and only
+            # the burn-rate + instance-health gates judge it; from the
+            # NEXT batch on the re-anchored baseline has accumulated
+            # real traffic and the delta gates recover.
+            await self._record(
+                rollout, now, "window_reanchored",
+                "request-histogram counters regressed (restart or "
+                "failover); observation window restarted",
+                baseline=current,
+                baseline_end={},    # re-frozen at the next observe-open
+                canary=dict(current),
+                observe_since=now,
+            )
+            return
+        if now - rollout.observe_since < self.cfg.rollout_observe_s:
+            return
+        quota = spec - rollout.promoted
+        if quota <= 0:
+            # spec shrank while observing: promoted capacity already
+            # covers the whole spec — all remaining old are excess
+            batch, doomed = 0, sorted(old, key=lambda i: i.id)
+        else:
+            batch = min(rollout.surge, quota, len(old))
+            doomed = sorted(old, key=lambda i: i.id)[:batch]
+        # The drain and the PROMOTING record must be atomic against a
+        # manual rollback: begin_rollback holds the plan lock across
+        # its body, so re-checking the plan state under the same lock
+        # before the instance writes guarantees a rollback that landed
+        # mid-tick never sees old-generation replicas we drained —
+        # "the old generation never drops below spec" holds.
+        async with self._plan_lock():
+            fresh = await Rollout.get(rollout.id)
+            if fresh is None or fresh.state != rollout.state:
+                return
+            await self._drain_old(
+                doomed,
+                "rollout: superseded by generation "
+                f"{rollout.to_generation}",
+            )
+            if await self._record(
+                rollout, now, "batch_promoted",
+                f"gates passed; draining {len(doomed)} old replica(s)",
+                state=RolloutState.PROMOTING,
+                promoted=rollout.promoted + batch,
+            ):
+                self._events.inc(
+                    model=model.name, event="batch_promoted"
+                )
+
+    async def _drain_old(
+        self,
+        doomed: List[ModelInstance],
+        message: str = "rollout: superseded",
+    ) -> None:
+        for inst in doomed:
+            # re-fetch before writing: Record.update persists the whole
+            # document and the agent may have advanced this row since
+            # the reconcile pass snapshotted it
+            fresh = await ModelInstance.get(inst.id)
+            if fresh is None:
+                continue
+            if fresh.state == ModelInstanceState.RUNNING:
+                await fresh.update(
+                    state=ModelInstanceState.DRAINING,
+                    state_message=message,
+                )
+            elif fresh.state != ModelInstanceState.DRAINING:
+                # a non-running old row (e.g. parked ERROR) has no
+                # stream to drain — retire it directly
+                await fresh.delete()
+
+    async def _promote_step(
+        self,
+        model: Model,
+        rollout: Rollout,
+        new: List[ModelInstance],
+        old: List[ModelInstance],
+        spec: int,
+        now: float,
+    ) -> None:
+        if any(
+            i.state == ModelInstanceState.DRAINING for i in old
+        ):
+            return  # the workers are still retiring the drained batch
+        if old:
+            # undrained old replicas remain: another surge/observe
+            # round — SURGING re-judges with the CURRENT spec, so a
+            # mid-rollout resize (grow or shrink) converges instead of
+            # wedging on the plan-time arithmetic
+            await self._record(
+                rollout, now, "next_batch",
+                f"{len(old)} old replica(s) remain; surging next batch",
+                state=RolloutState.SURGING,
+            )
+            return
+        # old generation fully retired: done. Completion hands the
+        # replica set back to _sync_replicas, which reconciles the
+        # count to spec — necessary when the spec grew mid-rollout and
+        # the surged batches alone cannot reach it
+        await self._finish(
+            model, rollout, RolloutState.COMPLETED,
+            "old generation fully retired", now,
+        )
+
+    # ---- gates -----------------------------------------------------------
+
+    def _gate_failure(
+        self,
+        model: Model,
+        rollout: Rollout,
+        new: List[ModelInstance],
+        now: float,
+    ) -> Optional[str]:
+        for inst in new:
+            if inst.state in (
+                ModelInstanceState.ERROR,
+                ModelInstanceState.UNREACHABLE,
+            ):
+                return (
+                    f"canary {inst.name} is {inst.state.value}: "
+                    f"{inst.state_message or 'no detail'}"
+                )
+            if inst.state != ModelInstanceState.RUNNING:
+                age = _created_age(inst, now)
+                if (
+                    age is not None
+                    and age > self.cfg.rollout_running_deadline
+                ):
+                    return (
+                        f"canary {inst.name} not RUNNING within "
+                        f"{self.cfg.rollout_running_deadline:.0f}s "
+                        f"(still {inst.state.value} after {age:.0f}s)"
+                    )
+        evaluator = self.app.get("slo")
+        if evaluator is not None:
+            # only burns that STARTED after the plan opened gate it: a
+            # rollout shipped to fix a firing incident must not be
+            # insta-rolled-back (restoring the broken spec, forever)
+            # by the very burn it exists to resolve
+            known = set(rollout.preexisting_firing)
+            firing = [
+                o for o in evaluator.engine.firing_objectives(model.name)
+                if o not in known
+            ]
+            if firing:
+                return (
+                    "slo burn-rate firing on "
+                    f"{'/'.join(firing)} during rollout"
+                )
+        if rollout.canary:
+            return delta_gate_failure(
+                rollout.baseline,
+                # pre-baseline_end plans (or a just-reanchored window)
+                # fall back to the batch's own canary snapshot — the
+                # first batch's [baseline, canary) window is identical
+                rollout.baseline_end or rollout.canary,
+                rollout.canary,
+                snapshot_model_requests(model.name),
+                self.cfg,
+            )
+        return None
+
+    # ---- rollback --------------------------------------------------------
+
+    async def begin_rollback(
+        self,
+        model: Model,
+        rollout: Rollout,
+        insts: List[ModelInstance],
+        now: float,
+        reason: str,
+        event: str = "gate_failed",
+    ) -> None:
+        """Restore the previous generation's spec and start tearing the
+        new generation down. Shared by the automatic gate path and the
+        manual ``POST /v2/models/{id}/rollback`` route (which passes
+        ``event="manual_rollback"`` so operator actions are not counted
+        as health-gate failures)."""
+        async with self._plan_lock():
+            await self._begin_rollback_locked(
+                model, rollout, insts, now, reason, event
+            )
+
+    @contextlib.asynccontextmanager
+    async def _plan_lock(self):
+        task = asyncio.current_task()
+        if self._plan_lock_task is task:
+            yield                       # reentrant within one task
+            return
+        async with self._plan_lock_inner:
+            self._plan_lock_task = task
+            try:
+                yield
+            finally:
+                self._plan_lock_task = None
+
+    async def _begin_rollback_locked(
+        self,
+        model: Model,
+        rollout: Rollout,
+        insts: List[ModelInstance],
+        now: float,
+        reason: str,
+        event: str,
+    ) -> None:
+        # re-fetch before acting: the route (or an HA peer) may race
+        # the reconcile loop's completing tick — rolling back a rollout
+        # that just COMPLETED would resurrect the plan via a stale
+        # whole-document write and drain the entire serving generation.
+        # The fetch happens INSIDE the lock, so a concurrent executor
+        # that just wrote ROLLING_BACK is seen here and bails.
+        fresh_ro = await Rollout.get(rollout.id)
+        if (
+            fresh_ro is None
+            or fresh_ro.state not in ACTIVE_ROLLOUT_STATES
+            # already rolling back (e.g. the gate tick beat a manual
+            # POST): re-running would bump the generation again and
+            # duplicate the revision + incident
+            or fresh_ro.state == RolloutState.ROLLING_BACK
+        ):
+            return
+        rollout = fresh_ro
+        self._events.inc(model=model.name, event=event)
+        revision = await ModelRevision.first(
+            model_id=model.id, generation=rollout.from_generation
+        )
+        if revision is None:
+            # nothing to restore onto the Model row: removing the new
+            # generation would leave replica sync recreating it from
+            # the (bad) live spec — refuse rather than flap
+            await self._finish(
+                model, rollout, RolloutState.FAILED,
+                f"{reason}; rollback impossible: no archived revision "
+                f"for generation {rollout.from_generation}", now,
+            )
+            self._record_incident(model, rollout, now, reason)
+            return
+        # re-fetch right before the restore write: Record.update
+        # persists the WHOLE document, and `model` may be a stale
+        # snapshot from the top of the reconcile pass — writing it
+        # would silently revert any concurrent operator edit
+        fresh_model = await Model.get(model.id)
+        if fresh_model is None:
+            await self._finish(
+                model, rollout, RolloutState.FAILED,
+                f"{reason}; model deleted during rollback", now,
+            )
+            return
+        if fresh_model.generation != rollout.to_generation:
+            # superseded: an operator update landed mid-rollout (its
+            # spec lives only on the Model row — never archived), so
+            # restoring this plan's old spec would silently clobber
+            # the newer fix and re-tag every instance past it. Finish
+            # the stale plan instead; _needs_rollout opens a plan
+            # toward the superseding generation on the next pass and
+            # converges the stray canaries as old-generation rows.
+            await self._finish(
+                model, rollout, RolloutState.FAILED,
+                f"{reason}; superseded by generation "
+                f"{fresh_model.generation} — old spec not restored",
+                now,
+            )
+            self._record_incident(model, rollout, now, reason)
+            return
+        restored_gen = fresh_model.generation + 1
+        spec_fields = {
+            k: v for k, v in revision.spec.items()
+            if k in ROLLOUT_FIELDS
+        }
+        await ModelRevision.create(ModelRevision(
+            model_id=model.id,
+            generation=restored_gen,
+            spec=dict(spec_fields),
+        ))
+        await fresh_model.update(
+            **spec_fields, generation=restored_gen
+        )
+        # re-tag surviving old-generation instances BEFORE draining the
+        # new generation: they run exactly the restored spec, and the
+        # tag match keeps replica sync and _needs_rollout quiet
+        # (re-fetched per row — whole-document writes on the stale
+        # snapshots could revert concurrent agent state reports)
+        for inst in insts:
+            if inst.generation == rollout.to_generation:
+                continue
+            fresh = await ModelInstance.get(inst.id)
+            if (
+                fresh is not None
+                and fresh.generation != rollout.to_generation
+            ):
+                await fresh.update(generation=restored_gen)
+        await self._record(
+            rollout, now, "rollback_started", reason,
+            state=RolloutState.ROLLING_BACK,
+            state_message=reason[:500],
+        )
+        self._record_incident(model, rollout, now, reason)
+        logger.warning(
+            "rollout %d rolling back model %s: %s",
+            rollout.id, model.name, reason,
+        )
+        # start the new-generation teardown in the same pass — the
+        # canary should stop taking traffic NOW, not a tick later
+        fresh = await Rollout.get(rollout.id) or rollout
+        await self._rollback_step(
+            model, fresh,
+            [i for i in insts if i.generation == rollout.to_generation],
+            now,
+        )
+
+    async def _rollback_step(
+        self,
+        model: Model,
+        rollout: Rollout,
+        new: List[ModelInstance],
+        now: float,
+    ) -> None:
+        await self._drain_old(new, "rollout rollback")
+        if not new:
+            await self._finish(
+                model, rollout, RolloutState.ROLLED_BACK,
+                "new generation removed; previous spec restored", now,
+                event="rolled_back",
+            )
+
+    def _record_incident(
+        self, model: Model, rollout: Rollout, now: float, reason: str
+    ) -> None:
+        evaluator = self.app.get("slo")
+        if evaluator is None:
+            return
+        try:
+            evidence = evaluator._evidence(model.name, "rollout")
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            evidence = {}
+        evidence["rollout"] = {
+            "id": rollout.id,
+            "from_generation": rollout.from_generation,
+            "to_generation": rollout.to_generation,
+            "promoted_batches": rollout.promoted,
+            "reason": reason,
+        }
+        evaluator.engine.record_incident(
+            model.name, "rollout",
+            now=now, detail=reason, evidence=evidence,
+        )
+
+    # ---- shared writes ---------------------------------------------------
+
+    async def _record(
+        self,
+        rollout: Rollout,
+        now: float,
+        event: str,
+        detail: str,
+        **fields,
+    ) -> bool:
+        # Optimistic-concurrency guard: Record.update persists the
+        # WHOLE document, and every caller holds a snapshot that
+        # awaited (instance drains, revision writes) since it was
+        # read. If the plan's state moved under us — e.g. a manual
+        # POST /rollback landed mid-_observe_step — a stale forward
+        # write would resurrect the pre-rollback state and re-surge
+        # the bad generation. Only a ROLLING_BACK transition may
+        # override a concurrent forward move; every other stale
+        # writer defers to the next tick's fresh read. The fetch AND
+        # the write sit under the plan lock, so a rollback cannot land
+        # between them and be clobbered anyway. Returns whether the
+        # write landed so callers can gate side effects (metrics,
+        # logs, instance writes) on the transition actually happening.
+        async with self._plan_lock():
+            fresh = await Rollout.get(rollout.id)
+            if fresh is None:
+                return False
+            if fresh.state != rollout.state and not (
+                fields.get("state") == RolloutState.ROLLING_BACK
+                and fresh.state in ACTIVE_ROLLOUT_STATES
+                and fresh.state != RolloutState.ROLLING_BACK
+            ):
+                return False
+            history = list(fresh.history) + [{
+                "at": now, "event": event, "detail": detail,
+            }]
+            await fresh.update(
+                history=history[-HISTORY_CAP:], **fields
+            )
+            return True
+
+    async def _finish(
+        self,
+        model: Model,
+        rollout: Rollout,
+        state: RolloutState,
+        detail: str,
+        now: float,
+        event: Optional[str] = None,
+    ) -> None:
+        if not await self._record(
+            rollout, now, event or state.value, detail,
+            state=state, state_message=detail[:500],
+        ):
+            # the plan moved under us (e.g. a manual rollback beat a
+            # COMPLETED write): counting/logging the terminal state
+            # anyway would corrupt the event stream operators audit
+            return
+        self._events.inc(
+            model=model.name, event=event or state.value
+        )
+        logger.info(
+            "rollout %d for model %s %s: %s",
+            rollout.id, model.name, state.value, detail,
+        )
+
+    # ---- reads -----------------------------------------------------------
+
+    def metrics_lines(self) -> List[str]:
+        """``gpustack_rollout_state`` per model with rollout history
+        (the events counter renders via the shared registry)."""
+        lines: List[str] = []
+        for model, state in sorted(self._latest_state.items()):
+            lines.append(
+                "gpustack_rollout_state"
+                f'{{model="{escape_label_value(model)}"}} '
+                f"{ROLLOUT_STATE_CODES.get(state, 6)}"
+            )
+        if not lines:
+            return []
+        kind = METRIC_FAMILIES["gpustack_rollout_state"]
+        return [f"# TYPE gpustack_rollout_state {kind}"] + lines

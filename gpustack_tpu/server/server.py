@@ -172,6 +172,16 @@ class Server:
         # availability samples).
         self.slo_evaluator = SLOEvaluator(app, cfg)
         app["slo"] = self.slo_evaluator
+        from gpustack_tpu.server.autoscaler import Autoscaler
+        from gpustack_tpu.server.rollout import RolloutController
+
+        # rollouts + autoscaling consume the SLO/fleet signals above;
+        # constructed always (debug surfaces + manual rollback need
+        # them on every server), reconcile ticks leader-only
+        self.rollout_controller = RolloutController(app, cfg)
+        app["rollout"] = self.rollout_controller
+        self.autoscaler = Autoscaler(app, cfg)
+        app["autoscaler"] = self.autoscaler
         from gpustack_tpu.server.update_check import UpdateChecker
 
         self.update_checker = UpdateChecker()
@@ -198,6 +208,8 @@ class Server:
                 self.system_load.start()
                 self.backend_catalog.start()
                 self.slo_evaluator.start()
+                self.rollout_controller.start()
+                self.autoscaler.start()
 
         self.coordinator.on_leadership_change(on_leadership)
         await self.coordinator.start()
@@ -266,6 +278,10 @@ class Server:
             self.system_load.stop()
         if hasattr(self, "slo_evaluator"):
             self.slo_evaluator.stop()
+        if hasattr(self, "rollout_controller"):
+            self.rollout_controller.stop()
+        if hasattr(self, "autoscaler"):
+            self.autoscaler.stop()
         for t in self._tasks:
             t.cancel()
         if self._runner:

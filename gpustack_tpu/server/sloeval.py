@@ -327,13 +327,15 @@ class SLOEvaluator(PeriodicTask):
         if target <= 0:
             self._last_violations = []
             return
-        from gpustack_tpu.schemas import DevInstance
+        from gpustack_tpu.schemas import DevInstance, Rollout
         from gpustack_tpu.testing import invariants as inv
 
         workers = await Worker.filter(limit=None)
         devs = await DevInstance.filter(limit=None)
+        rollouts = await Rollout.filter(limit=None)
         violations = inv.snapshot_violations(
             models, workers, instances, devs,
+            rollouts=rollouts,
             include_eventual=False,
         )
         self._last_violations = [v.to_dict() for v in violations]

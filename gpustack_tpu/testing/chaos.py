@@ -184,6 +184,10 @@ class StubWorker:
         self.hb_blackholed = False
         self.crash_next_start = False
         self.engines: set = set()       # instance ids with a "live" engine
+        # data-plane fault injection: proxied requests to these
+        # instance ids answer 500 (a "bad canary" for rollout e2es)
+        self.proxy_fail_ids: set = set()
+        self.proxied = 0                # data-plane requests served
         self._starting: set = set()
         self._paused = asyncio.Event()  # cleared == suspended
         self._paused.set()
@@ -210,6 +214,55 @@ class StubWorker:
             )
 
         app.router.add_get("/healthz", healthz)
+
+        async def proxy(request: web.Request):
+            """Stub of the worker's authenticated reverse proxy
+            (worker/server.py /proxy/instances/...): enough of the
+            data-plane contract for rollout/autoscaler e2es to drive
+            REAL proxied requests through the server's failover path.
+            Same auth, same stale-routing 404 marker, plus the
+            fault-injection hook (``proxy_fail_ids``)."""
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.proxy_secret}":
+                return web.json_response(
+                    {"error": "forbidden"}, status=403
+                )
+            iid = int(request.match_info["id"])
+            if iid not in self.engines:
+                return web.json_response(
+                    {"error": "instance not running here"},
+                    status=404,
+                    headers={
+                        "X-GPUStack-Worker": "instance-not-running"
+                    },
+                )
+            self.proxied += 1
+            if iid in self.proxy_fail_ids:
+                return web.json_response(
+                    {"error": "chaos: injected engine failure"},
+                    status=500,
+                )
+            return web.json_response({
+                "id": f"stub-{iid}-{self.proxied}",
+                "object": "chat.completion",
+                "model": "stub",
+                "choices": [{
+                    "index": 0,
+                    "finish_reason": "stop",
+                    "message": {
+                        "role": "assistant", "content": "ok",
+                    },
+                }],
+                "usage": {
+                    "prompt_tokens": 1,
+                    "completion_tokens": 1,
+                    "total_tokens": 2,
+                },
+            })
+
+        app.router.add_post(
+            "/proxy/instances/{id:\\d+}/{tail:.*}", proxy
+        )
         self._runner = web.AppRunner(app, shutdown_timeout=0.2)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
@@ -855,6 +908,7 @@ class ChaosHarness:
             DevInstance,
             Model,
             ModelInstance,
+            Rollout,
             Worker,
         )
 
@@ -863,6 +917,7 @@ class ChaosHarness:
             await Worker.all(),
             await ModelInstance.all(),
             await DevInstance.all(),
+            await Rollout.all(),
         )
 
     async def _monitor(self) -> None:
@@ -870,11 +925,14 @@ class ChaosHarness:
         while True:
             await asyncio.sleep(0.25)
             try:
-                models, workers, instances, devs = await self._records()
+                (
+                    models, workers, instances, devs, rollouts,
+                ) = await self._records()
             except Exception:
                 continue  # server mid-restart: DB handle swapped
             for v in inv.snapshot_violations(
                 models, workers, instances, devs,
+                rollouts=rollouts,
                 stuck_bound=self.stuck_bound,
                 include_eventual=False,
             ):
@@ -905,9 +963,12 @@ class ChaosHarness:
         last: List[inv.Violation] = []
         while True:
             try:
-                models, workers, instances, devs = await self._records()
+                (
+                    models, workers, instances, devs, rollouts,
+                ) = await self._records()
                 last = inv.snapshot_violations(
                     models, workers, instances, devs,
+                    rollouts=rollouts,
                     stuck_bound=self.stuck_bound,
                     include_eventual=True,
                 )

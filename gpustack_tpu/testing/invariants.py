@@ -191,13 +191,23 @@ def check_running_worker_ready(
 
 
 def check_replica_convergence(
-    models: Sequence, instances: Sequence
+    models: Sequence, instances: Sequence, rollouts: Sequence = ()
 ) -> List[Violation]:
+    from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+
+    mid_rollout = {
+        r.model_id for r in rollouts
+        if r.state in ACTIVE_ROLLOUT_STATES
+    }
     per_model: Dict[int, List] = {}
     for inst in instances:
         per_model.setdefault(inst.model_id, []).append(inst)
     out: List[Violation] = []
     for model in models:
+        if model.id in mid_rollout:
+            # a rollout deliberately runs spec+surge replicas and
+            # drains batches — its own surge-cap check governs here
+            continue
         mine = per_model.get(model.id, [])
         want = max(0, model.replicas)
         if len(mine) != want:
@@ -215,6 +225,99 @@ def check_replica_convergence(
             out.append(Violation(
                 "replicas-not-running", "eventual",
                 f"model {model.name}: {', '.join(not_running)}",
+            ))
+    return out
+
+
+def check_rollout_surge(
+    models: Sequence, instances: Sequence, rollouts: Sequence
+) -> List[Violation]:
+    """During an active rollout the controller may run at most
+    ``promoted + surge`` NEW-generation instances — always-scope: it
+    creates batch-by-batch, so exceeding that at any instant is a
+    runaway surge loop, not mid-convergence noise. The bound is on the
+    new generation (the only thing the controller creates), NOT on the
+    total against the current spec: an operator shrinking ``replicas``
+    mid-rollout legitimately leaves the total above ``replicas +
+    surge`` until the excess old batch drains."""
+    from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+
+    models_by_id = {m.id: m for m in models}
+    out: List[Violation] = []
+    for r in rollouts:
+        if r.state not in ACTIVE_ROLLOUT_STATES:
+            continue
+        model = models_by_id.get(r.model_id)
+        if model is None:
+            continue
+        cap = r.promoted + max(1, r.surge)
+        have = sum(
+            1 for inst in instances
+            if inst.model_id == r.model_id
+            and inst.generation == r.to_generation
+        )
+        if have > cap:
+            out.append(Violation(
+                "rollout-surge-exceeded", "always",
+                f"model {model.name}: {have} new-generation "
+                f"instance(s) during rollout {r.id}, surge cap is "
+                f"{cap} (promoted {r.promoted} + surge {r.surge})",
+            ))
+    return out
+
+
+def check_generation_converged(
+    models: Sequence, instances: Sequence, rollouts: Sequence
+) -> List[Violation]:
+    """With no rollout mid-flight every instance must serve the
+    model's current generation — eventual-scope (an operator update
+    legitimately mismatches for the beat before the controller opens
+    a plan), but persistent mixing means a rollout stalled or leaked
+    replicas across generations."""
+    from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
+
+    active_models = {
+        r.model_id for r in rollouts
+        if r.state in ACTIVE_ROLLOUT_STATES
+    }
+    per_model: Dict[int, List] = {}
+    for inst in instances:
+        per_model.setdefault(inst.model_id, []).append(inst)
+    out: List[Violation] = []
+    for model in models:
+        if model.id in active_models:
+            continue
+        mixed = [
+            f"{i.name}=g{i.generation}"
+            for i in per_model.get(model.id, [])
+            if i.generation != model.generation
+        ]
+        if mixed:
+            out.append(Violation(
+                "generation-mixing", "eventual",
+                f"model {model.name} is at generation "
+                f"{model.generation} with no active rollout, but: "
+                + ", ".join(mixed),
+            ))
+    return out
+
+
+def check_autoscale_bounds(models: Sequence) -> List[Violation]:
+    """Autoscaled models keep their replica spec inside
+    [autoscale_min, autoscale_max] — eventual-scope: an operator may
+    write an out-of-bounds count, which the autoscaler's next tick
+    corrects."""
+    out: List[Violation] = []
+    for model in models:
+        if model.autoscale_max <= 0:
+            continue
+        lo = max(0, model.autoscale_min)
+        hi = max(lo, model.autoscale_max)
+        if not lo <= model.replicas <= hi:
+            out.append(Violation(
+                "autoscale-bounds", "eventual",
+                f"model {model.name}: replicas {model.replicas} "
+                f"outside autoscale bounds [{lo}, {hi}]",
             ))
     return out
 
@@ -247,6 +350,7 @@ def snapshot_violations(
     instances: Sequence,
     dev_instances: Sequence = (),
     *,
+    rollouts: Sequence = (),
     now: Optional[datetime.datetime] = None,
     stuck_bound: float = DEFAULT_STUCK_BOUND,
     include_eventual: bool = True,
@@ -256,9 +360,12 @@ def snapshot_violations(
     allowed to be mid-convergence."""
     out = check_chip_claims(workers, instances, dev_instances)
     out += check_stuck_transient(instances, now=now, bound=stuck_bound)
+    out += check_rollout_surge(models, instances, rollouts)
     if include_eventual:
         out += check_running_worker_ready(workers, instances)
-        out += check_replica_convergence(models, instances)
+        out += check_replica_convergence(models, instances, rollouts)
+        out += check_generation_converged(models, instances, rollouts)
+        out += check_autoscale_bounds(models)
     return out
 
 
@@ -269,15 +376,17 @@ async def control_plane_snapshot(
     body). ``always``-scope violations are bugs; ``eventual``-scope
     entries are listed separately — mid-convergence they are expected,
     persistently they point at the stuck component."""
-    from gpustack_tpu.schemas import DevInstance, Model, Worker
+    from gpustack_tpu.schemas import DevInstance, Model, Rollout, Worker
     from gpustack_tpu.schemas import ModelInstance as MI
 
     models = await Model.all()
     workers = await Worker.all()
     instances = await MI.all()
     devs = await DevInstance.all()
+    rollouts = await Rollout.all()
     violations = snapshot_violations(
         models, workers, instances, devs,
+        rollouts=rollouts,
         stuck_bound=stuck_bound, include_eventual=True,
     )
     return {
@@ -288,6 +397,7 @@ async def control_plane_snapshot(
             "workers": len(workers),
             "instances": len(instances),
             "dev_instances": len(devs),
+            "rollouts": len(rollouts),
         },
         "violations": [
             v.to_dict() for v in violations if v.scope == "always"

@@ -16,7 +16,7 @@ from typing import Optional
 
 from gpustack_tpu.benchmark.loadgen import run_load_test
 from gpustack_tpu.benchmark.profiles import PROFILES, BenchmarkProfile
-from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.client.client import APIError, ClientSet, update_settled
 from gpustack_tpu.schemas import (
     Benchmark,
     BenchmarkState,
@@ -104,8 +104,8 @@ class BenchmarkManager:
     async def _run(self, bench: Benchmark, instance: ModelInstance) -> None:
         profile = self._profile(bench)
         try:
-            await self.client.update(
-                "benchmarks", bench.id,
+            await update_settled(
+                self.client, "benchmarks", bench.id,
                 {
                     "state": BenchmarkState.RUNNING.value,
                     "worker_id": self.worker_id,
@@ -118,8 +118,8 @@ class BenchmarkManager:
                 profile=profile,
             )
             failed = report.metrics.error_count >= profile.num_requests
-            await self.client.update(
-                "benchmarks", bench.id,
+            await update_settled(
+                self.client, "benchmarks", bench.id,
                 {
                     "state": (
                         BenchmarkState.ERROR.value
@@ -144,8 +144,8 @@ class BenchmarkManager:
         except Exception as e:
             logger.exception("benchmark %d failed", bench.id)
             try:
-                await self.client.update(
-                    "benchmarks", bench.id,
+                await update_settled(
+                    self.client, "benchmarks", bench.id,
                     {
                         "state": BenchmarkState.ERROR.value,
                         "state_message": str(e),

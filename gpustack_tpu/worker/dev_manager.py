@@ -19,7 +19,7 @@ import subprocess
 import sys
 from typing import Dict, Optional
 
-from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.client.client import APIError, ClientSet, update_settled
 from gpustack_tpu.schemas import DevInstance, DevInstanceState
 from gpustack_tpu.server.bus import Event, EventType
 
@@ -361,7 +361,12 @@ class DevManager:
         if pid is not None:
             fields["pid"] = pid
         try:
-            await self.client.update("dev-instances", dev_id, fields)
+            # settled: a one-shot owner report must survive the crud
+            # layer's 409 when an unrelated writer touched the row
+            # between the server's validation and write
+            await update_settled(
+                self.client, "dev-instances", dev_id, fields
+            )
         except APIError as e:
             logger.warning(
                 "dev instance %d state update failed: %s", dev_id, e

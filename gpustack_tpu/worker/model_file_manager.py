@@ -18,7 +18,7 @@ import os
 import re
 from typing import Callable, Optional
 
-from gpustack_tpu.client.client import APIError, ClientSet
+from gpustack_tpu.client.client import APIError, ClientSet, update_settled
 from gpustack_tpu.config import Config
 from gpustack_tpu.schemas import Model, ModelFile, ModelFileState
 from gpustack_tpu.utils.locks import SoftFileLock
@@ -216,6 +216,8 @@ class ModelFileManager:
             for k, v in fields.items()
         }
         try:
-            await self.client.update("model-files", record["id"], payload)
+            await update_settled(
+                self.client, "model-files", record["id"], payload
+            )
         except APIError as e:
             logger.warning("model-file update failed: %s", e)

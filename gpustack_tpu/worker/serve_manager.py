@@ -899,18 +899,49 @@ class ServeManager:
         fields = {"state": state.value, "state_message": message, **extra}
         if state == ModelInstanceState.ERROR:
             fields["last_error"] = message
-        try:
-            await self.client.update(
-                "model-instances", instance_id, fields
-            )
-        except NETWORK_ERRORS as e:
-            # network errors too, not just HTTP-level APIError: a state
-            # write failing mid-partition must degrade to a warning —
-            # an exception here propagates into the monitor/crash tasks
-            # and kills the restart machinery with the engine down
-            logger.warning(
-                "failed to update instance %d state: %s", instance_id, e
-            )
+        for attempt in range(3):
+            try:
+                await self.client.update(
+                    "model-instances", instance_id, fields
+                )
+                return
+            except APIError as e:
+                # the server 409s when the row moved between its
+                # validation and write (routes/crud.py) — a one-shot
+                # lifecycle report (STARTING->RUNNING racing a rescuer
+                # blip) must re-read and re-decide, not drop the
+                # transition and leave the row wedged until a rollout
+                # deadline reaps a healthy canary
+                retriable = (
+                    e.status == 409
+                    and "changed concurrently" in e.message
+                    and attempt < 2
+                )
+                if not retriable:
+                    logger.warning(
+                        "failed to update instance %d state: %s",
+                        instance_id, e,
+                    )
+                    return
+                try:
+                    current = await self.client.get(
+                        "model-instances", instance_id
+                    )
+                except NETWORK_ERRORS:
+                    return  # row gone/unreadable; reconcile re-drives
+                if current.get("state") == state.value:
+                    return  # another writer already landed it
+            except NETWORK_ERRORS as e:
+                # network errors too, not just HTTP-level APIError: a
+                # state write failing mid-partition must degrade to a
+                # warning — an exception here propagates into the
+                # monitor/crash tasks and kills the restart machinery
+                # with the engine down
+                logger.warning(
+                    "failed to update instance %d state: %s",
+                    instance_id, e,
+                )
+                return
 
     def _allocate_port(self, exclude=()) -> int:
         """Free engine port from the configured band.

@@ -303,6 +303,59 @@ class TestStateMachine:
         # both idioms land in an undeclared module -> two findings
         assert len(found) == 2
 
+    def test_rollout_writer_declared_passes(self, tmp_path):
+        """The rollout controller's write set (surge PENDING creation,
+        old-batch/rollback DRAINING) mirrors the production
+        declaration for server/rollout.py."""
+        schemas = GOOD_SCHEMAS.replace(
+            '        "server/controllers.py"',
+            '        "server/rollout.py": {\n'
+            "            ModelInstanceState.PENDING,\n"
+            "            ModelInstanceState.RUNNING,\n"
+            "        },\n"
+            '        "server/controllers.py"',
+        )
+        make_tree(tmp_path, {
+            "gpustack_tpu/schemas/models.py": schemas,
+            "gpustack_tpu/server/rollout.py": (
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState, ModelInstance\n"
+                "async def surge(model):\n"
+                "    await ModelInstance.create(ModelInstance(\n"
+                "        state=ModelInstanceState.PENDING))\n"
+                "async def promote(inst):\n"
+                "    await inst.update("
+                "state=ModelInstanceState.RUNNING)\n"
+            ),
+        })
+        assert run(tmp_path, [StateMachineRule()]).new == []
+
+    def test_rollout_writer_outside_allowance_fails(self, tmp_path):
+        """A rollout-controller write of a state outside its declared
+        set (here ERROR) must fail the gate — new rollout transitions
+        have to be declared in INSTANCE_STATE_WRITERS first."""
+        schemas = GOOD_SCHEMAS.replace(
+            '        "server/controllers.py"',
+            '        "server/rollout.py": {\n'
+            "            ModelInstanceState.PENDING,\n"
+            "        },\n"
+            '        "server/controllers.py"',
+        )
+        make_tree(tmp_path, {
+            "gpustack_tpu/schemas/models.py": schemas,
+            "gpustack_tpu/server/rollout.py": (
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstanceState\n"
+                "async def bad(inst):\n"
+                "    await inst.update("
+                "state=ModelInstanceState.ERROR)\n"
+            ),
+        })
+        found = run(tmp_path, [StateMachineRule()]).new
+        assert any(
+            "not declared to write ERROR" in f.message for f in found
+        )
+
     def test_filters_and_comparisons_are_reads(self, tmp_path):
         assert self.fire(
             tmp_path,

@@ -1,0 +1,220 @@
+"""Drain-deadline force-stop e2e (ISSUE 9 satellite): a DRAINING
+instance whose in-flight counter NEVER reaches zero — the client holds
+a slow stream open past ``drain_timeout`` — must still be terminated
+at the deadline, and its row retired so the chip claim is released for
+replica sync to re-place.
+
+Same real pieces as tests/e2e/test_drain.py: stub-engine subprocess
+with paced SSE, the worker's authenticated reverse proxy + in-flight
+counter, a ServeManager driving the drain, and the server app's
+OpenAI proxy on top — but with a drain window the stream deliberately
+outlives.
+"""
+
+import asyncio
+import os
+import sys
+import time
+import types
+
+import aiohttp
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import Event, EventBus, EventType
+from gpustack_tpu.worker.serve_manager import (
+    RunningInstance,
+    ServeManager,
+)
+from gpustack_tpu.worker.server import WorkerServer
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+DRAIN_TIMEOUT = 1.0
+
+
+class _RecordingClient:
+    def __init__(self):
+        self.updates = []
+        self.deletes = []
+
+    async def update(self, kind, id, fields):
+        self.updates.append((kind, id, fields))
+        return fields
+
+    async def delete(self, kind, id):
+        self.deletes.append((kind, id))
+
+    async def list(self, kind, **kw):
+        return []
+
+
+async def _spawn_stub_engine(port: int):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "gpustack_tpu.testing.stub_engine",
+        "--port", str(port), "--served-name", "m",
+        # 0.5s per token x 120 tokens: the stream outlives any
+        # plausible test wall-clock, so in-flight NEVER clears
+        "--token-delay", "0.5", "--host", "127.0.0.1",
+        env=env,
+        stdout=asyncio.subprocess.DEVNULL,
+        stderr=asyncio.subprocess.DEVNULL,
+    )
+    deadline = time.time() + 30
+    async with aiohttp.ClientSession() as http:
+        while time.time() < deadline:
+            try:
+                async with http.get(
+                    f"http://127.0.0.1:{port}/health",
+                    timeout=aiohttp.ClientTimeout(total=1),
+                ) as r:
+                    if r.status == 200:
+                        return proc
+            except (aiohttp.ClientError, OSError):
+                pass
+            await asyncio.sleep(0.2)
+    raise AssertionError("stub engine never became healthy")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_drain_deadline_force_stops_stuck_stream(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    cfg = Config.load(
+        {"data_dir": str(tmp_path), "drain_timeout": DRAIN_TIMEOUT}
+    )
+
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        engine_port = _free_port()
+        engine_proc = await _spawn_stub_engine(engine_port)
+        sm = ServeManager(cfg, _RecordingClient(), worker_id=1)
+        run = RunningInstance(0, engine_port)
+        run.process = engine_proc
+        agent = types.SimpleNamespace(
+            cfg=cfg, worker_id=1, serve_manager=sm,
+            proxy_secret="force-secret", detector=None,
+        )
+        ws = WorkerServer(agent)
+        sm.inflight_source = ws.inflight_count
+        worker_port = await ws.start("127.0.0.1", 0)
+
+        admin = await User.create(User(
+            username="admin", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        ))
+        token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        hdrs = {"Authorization": f"Bearer {token}"}
+        model = await Model.create(Model(name="m", preset="tiny"))
+        w1 = await Worker.create(Worker(
+            name="w1", ip="127.0.0.1", port=worker_port,
+            state=WorkerState.READY, proxy_secret="force-secret",
+        ))
+        inst = await ModelInstance.create(ModelInstance(
+            name="m-0", model_id=model.id, model_name="m",
+            state=ModelInstanceState.RUNNING, worker_id=w1.id,
+            port=engine_port, chip_indexes=[0],
+        ))
+        run.instance_id = inst.id
+        sm.running[inst.id] = run
+
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            stream_resp = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "a"}],
+                    "max_tokens": 120,
+                    "stream": True,
+                },
+                headers=hdrs,
+            )
+            assert stream_resp.status == 200
+            assert await stream_resp.content.read(10)
+            deadline = time.time() + 5
+            while time.time() < deadline and (
+                ws.inflight_count(inst.id) == 0
+            ):
+                await asyncio.sleep(0.05)
+            assert ws.inflight_count(inst.id) == 1
+
+            r = await client.post(
+                f"/v2/model-instances/{inst.id}/drain", headers=hdrs
+            )
+            assert r.status == 200, await r.text()
+            row = await ModelInstance.get(inst.id)
+
+            t0 = time.monotonic()
+            await sm.handle_event(Event(
+                kind="model_instance",
+                type=EventType.UPDATED,
+                id=inst.id,
+                data=row.model_dump(mode="json"),
+            ))
+            # handle_event fires the drain task; wait for the engine
+            # to be force-stopped at (not before) the deadline
+            deadline = time.time() + 25
+            while time.time() < deadline and (
+                engine_proc.returncode is None
+            ):
+                await asyncio.sleep(0.1)
+            elapsed = time.monotonic() - t0
+            assert engine_proc.returncode is not None, (
+                "engine was never terminated despite the drain deadline"
+            )
+            # the drain WAITED the full window (the stream was still
+            # in flight) before terminating…
+            assert elapsed >= DRAIN_TIMEOUT, elapsed
+            # …but did not wait unboundedly for in-flight to clear
+            assert elapsed < 15.0, elapsed
+            assert sm.drains_total == 1
+            # drain_seconds_total ~ the full window proves the stream
+            # was STILL in flight when the axe fell (a cleared counter
+            # would have ended the wait early); the relay unwinds and
+            # zeroes the counter once the engine dies, so the counter
+            # itself can't be asserted post-mortem
+            assert sm.drain_seconds_total >= DRAIN_TIMEOUT * 0.9
+
+            # the row was retired -> the chip claim ([0]) is released
+            # for replica sync to re-place
+            deadline = time.time() + 5
+            while time.time() < deadline and not sm.client.deletes:
+                await asyncio.sleep(0.1)
+            assert ("model-instances", inst.id) in sm.client.deletes
+            assert inst.id not in sm.running
+        finally:
+            await client.close()
+            await ws.stop()
+            if engine_proc.returncode is None:
+                engine_proc.kill()
+                await engine_proc.wait()
+
+    asyncio.run(go())
+    db.close()

@@ -1,0 +1,316 @@
+"""Rollout e2es against the REAL in-process server + protocol-true
+stub workers (ISSUE 9 acceptance).
+
+Fault path (seeded chaos): a model update opens a canary rollout; the
+canary's engine is fault-injected so proxied requests through it fail,
+the SLO error-rate burn fires (compressed two-window policy, PR 8),
+and the rollout AUTO-ROLLS-BACK — the old generation never drops below
+spec, the previous spec is restored onto the Model row, the incident
+ring carries rollout-tagged evidence, the seeded schedule replays
+bit-for-bit, and zero invariants are violated.
+
+Happy path: the same rolling update with a healthy canary completes
+batch-by-batch under live proxied traffic with ZERO failed requests —
+the drain contract plus stale-routing failover make the switchover
+invisible to clients.
+"""
+
+import asyncio
+import dataclasses
+
+from gpustack_tpu.client.client import APIError
+from gpustack_tpu.schemas import (
+    ModelInstance,
+    ModelInstanceState,
+    RolloutState,
+)
+from gpustack_tpu.testing import chaos
+
+SEED = 33
+SCHEDULE_KW = dict(kinds=("rpc_delay",), ops=1, workers=2)
+
+BASE_CFG = {
+    "rollout_interval": 0.1,
+    "slo_default_availability": 0.0,    # keep the run to one objective
+    "slo_default_ttft_p95_ms": 0.0,
+}
+
+FAULT_CFG = {
+    **BASE_CFG,
+    # the burn, not the delta gate, must be the trigger here
+    "rollout_observe_s": 6.0,
+    "rollout_min_requests": 100000,
+    # compressed canonical windows: fast pair 0.25s/3s @ 14.4x
+    "slo_eval_interval": 0.1,
+    "slo_window_scale": 1.0 / 1200.0,
+    "slo_min_hold": 0.3,
+    "slo_default_error_rate": 0.01,
+    # a request that lands on the bad canary must FAIL (no failover
+    # rescue) and the canary must keep taking traffic (no breaker)
+    "proxy_failover_attempts": 1,
+    "breaker_failure_threshold": 100000,
+}
+
+HAPPY_CFG = {
+    **BASE_CFG,
+    "rollout_observe_s": 0.3,
+    "rollout_min_requests": 3,
+    "slo_default_error_rate": 0.0,
+}
+
+
+async def _chat(harness, model):
+    return await harness.admin.request(
+        "POST", "/v1/chat/completions",
+        json_body={
+            "model": model,
+            "messages": [{"role": "user", "content": "hi"}],
+        },
+    )
+
+
+async def _rollout_view(harness, model_id):
+    return await harness.admin.request(
+        "GET", f"/v2/models/{model_id}/rollout"
+    )
+
+
+def test_bad_canary_fires_error_burn_and_rolls_back(tmp_path):
+    async def go():
+        schedule = chaos.generate_schedule(SEED, **SCHEDULE_KW)
+        harness = chaos.ChaosHarness(
+            str(tmp_path), workers=2, replicas=2,
+            extra_cfg=FAULT_CFG,
+        )
+        await harness.start()
+        stop_traffic = asyncio.Event()
+        guard_failures = []
+        traffic_task = guard_task = None
+        try:
+            model = await harness.deploy("roll-chaos")
+            await harness.wait_converged(timeout=45.0)
+
+            async def traffic():
+                # continuous proxied load: successes fill the burn
+                # windows' baseline, canary hits fill their numerator
+                while not stop_traffic.is_set():
+                    try:
+                        await _chat(harness, "roll-chaos")
+                    except APIError:
+                        pass
+                    await asyncio.sleep(0.02)
+
+            traffic_task = asyncio.create_task(traffic())
+            await asyncio.sleep(1.0)      # healthy baseline window
+
+            async def spec_guard():
+                # acceptance: the OLD generation never drops below
+                # spec — sampled continuously until rollback lands
+                while not stop_traffic.is_set():
+                    insts = await ModelInstance.filter(
+                        model_id=model["id"]
+                    )
+                    old_running = [
+                        i for i in insts
+                        if i.generation != 1
+                        and i.state == ModelInstanceState.RUNNING
+                    ]
+                    if len(old_running) < 2:
+                        guard_failures.append([
+                            (i.name, i.state.value, i.generation)
+                            for i in insts
+                        ])
+                    await asyncio.sleep(0.05)
+
+            guard_task = asyncio.create_task(spec_guard())
+
+            # ship a bad model update -> generation 1, rollout opens
+            await harness.admin.update(
+                "models", model["id"], {"max_slots": 4}
+            )
+            # seeded chaos rides along mid-rollout
+            await harness.run_schedule(schedule)
+
+            # fault-inject the canary's engine as soon as it exists
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 20.0
+            canary_ids = set()
+            while loop.time() < deadline and not canary_ids:
+                view = await _rollout_view(harness, model["id"])
+                canary_ids = {
+                    i["id"] for i in view["instances"]
+                    if i["generation"] == 1
+                }
+                await asyncio.sleep(0.05)
+            assert canary_ids, "rollout never surged a canary"
+            for stub in harness.stubs:
+                stub.proxy_fail_ids |= canary_ids
+
+            # burn fires -> automatic rollback
+            deadline = loop.time() + 25.0
+            rolled_back = False
+            while loop.time() < deadline:
+                view = await _rollout_view(harness, model["id"])
+                states = [
+                    r["state"] for r in view["history"]
+                ]
+                if RolloutState.ROLLED_BACK.value in states:
+                    rolled_back = True
+                    break
+                await asyncio.sleep(0.1)
+            assert rolled_back, f"rollout never rolled back: {view}"
+            stop_traffic.set()
+            await traffic_task
+            await guard_task
+
+            plan = view["history"][-1]
+            assert plan["to_generation"] == 1
+            reasons = [
+                h["detail"] for h in plan["history"]
+                if h["event"] == "rollback_started"
+            ]
+            assert reasons and "slo burn-rate firing" in reasons[0], (
+                plan["history"]
+            )
+            # never promoted a batch: the old generation was untouched
+            events = [h["event"] for h in plan["history"]]
+            assert "batch_promoted" not in events
+            assert guard_failures == [], guard_failures[:3]
+
+            # the bad spec was rolled off the Model row
+            fresh = await harness.admin.request(
+                "GET", f"/v2/models/{model['id']}"
+            )
+            assert fresh["max_slots"] == 2
+            assert fresh["generation"] == 2
+
+            # incident ring carries rollout-tagged evidence
+            body = await harness.admin.request(
+                "GET", "/v2/debug/incidents?model=roll-chaos"
+            )
+            rollout_incidents = [
+                i for i in body["items"]
+                if i["objective"] == "rollout"
+            ]
+            assert rollout_incidents, body["items"]
+            evidence = rollout_incidents[0]["evidence"]
+            assert evidence["rollout"]["to_generation"] == 1
+            assert "reason" in evidence["rollout"]
+
+            # cluster converges back to spec on the restored spec
+            await harness.wait_converged(timeout=45.0)
+            insts = await ModelInstance.filter(model_id=model["id"])
+            assert len(insts) == 2
+            assert all(i.generation == 2 for i in insts)
+
+            # chaos invariants held throughout (incl. the surge cap)
+            assert harness.violations() == []
+
+            # the executed schedule replays bit-for-bit from the seed
+            assert [
+                dataclasses.asdict(o) for o in schedule
+            ] == [
+                dataclasses.asdict(o)
+                for o in chaos.generate_schedule(SEED, **SCHEDULE_KW)
+            ]
+        finally:
+            stop_traffic.set()
+            for t in (traffic_task, guard_task):
+                if t is not None:
+                    t.cancel()
+            await harness.stop()
+
+    asyncio.run(go())
+
+
+def test_healthy_rolling_update_loses_zero_requests(tmp_path):
+    async def go():
+        harness = chaos.ChaosHarness(
+            str(tmp_path), workers=2, replicas=2,
+            extra_cfg=HAPPY_CFG,
+        )
+        await harness.start()
+        stop_traffic = asyncio.Event()
+        results = {"ok": 0, "failed": []}
+        traffic_task = None
+        try:
+            model = await harness.deploy("roll-happy")
+            await harness.wait_converged(timeout=45.0)
+
+            async def traffic():
+                while not stop_traffic.is_set():
+                    try:
+                        body = await _chat(harness, "roll-happy")
+                        assert body["object"] == "chat.completion"
+                        results["ok"] += 1
+                    except APIError as e:
+                        results["failed"].append(
+                            (e.status, str(e)[:200])
+                        )
+                    await asyncio.sleep(0.03)
+
+            traffic_task = asyncio.create_task(traffic())
+            await asyncio.sleep(0.3)
+
+            # rolling update: checkpoint-knob change, healthy canary
+            await harness.admin.update(
+                "models", model["id"], {"max_slots": 4}
+            )
+
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 30.0
+            completed = False
+            while loop.time() < deadline:
+                view = await _rollout_view(harness, model["id"])
+                states = [r["state"] for r in view["history"]]
+                if RolloutState.COMPLETED.value in states:
+                    completed = True
+                    break
+                assert RolloutState.ROLLED_BACK.value not in states, (
+                    f"healthy rollout rolled back: {view}"
+                )
+                await asyncio.sleep(0.1)
+            assert completed, f"rollout never completed: {view}"
+
+            # traffic kept flowing THROUGH the switchover
+            await asyncio.sleep(0.3)
+            stop_traffic.set()
+            await traffic_task
+            assert results["failed"] == [], results["failed"][:5]
+            assert results["ok"] >= 10
+
+            # both batches promoted; no generation mixing after
+            plan = view["history"][-1]
+            events = [h["event"] for h in plan["history"]]
+            assert events.count("batch_promoted") == 2
+            await harness.wait_converged(timeout=45.0)
+            insts = await ModelInstance.filter(model_id=model["id"])
+            assert len(insts) == 2
+            assert all(
+                i.generation == 1
+                and i.state == ModelInstanceState.RUNNING
+                for i in insts
+            )
+            assert harness.violations() == []
+
+            # the new rollout/autoscaler families render promtext-clean
+            # on the live server exporter
+            import aiohttp as _aiohttp
+
+            from gpustack_tpu.testing import promtext
+
+            async with _aiohttp.ClientSession() as http:
+                async with http.get(harness.base + "/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+            samples, _types = promtext.assert_well_formed(text)
+            names = {s.name for s in samples}
+            assert "gpustack_rollout_state" in names
+            assert "gpustack_rollout_events_total" in names
+        finally:
+            stop_traffic.set()
+            if traffic_task is not None:
+                traffic_task.cancel()
+            await harness.stop()
+
+    asyncio.run(go())

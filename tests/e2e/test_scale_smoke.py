@@ -1,0 +1,113 @@
+"""Scheduler-at-scale smoke (ISSUE 9 satellite; ROADMAP item 3's first
+measurement): ~300 protocol-true stub workers against the REAL
+in-process control plane. Asserts that the core reconcile passes stay
+cheap at fleet width — a replica-sync pass, a worker-staleness sweep,
+and a rescuer scan must each complete in bounded time over 300 live
+workers (an accidentally quadratic scan blows these bounds by orders
+of magnitude), and a deploy still converges.
+
+``slow``-marked: boots hundreds of HTTP servers + watch streams; runs
+via ``pytest -m slow``, not tier-1.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from gpustack_tpu.schemas import Model
+from gpustack_tpu.testing import chaos
+
+WORKERS = 300
+REPLICAS = 8
+
+# generous CI bounds — the point is catching O(workers^2) regressions
+# (which land at minutes, not seconds), not micro-benchmarking
+SYNC_PASS_BUDGET_S = 3.0
+CONVERGE_BUDGET_S = 120.0
+
+
+@pytest.mark.slow
+def test_control_plane_passes_stay_linear_at_300_workers(tmp_path):
+    async def go():
+        harness = chaos.ChaosHarness(
+            str(tmp_path),
+            workers=WORKERS,
+            chips=4,
+            replicas=REPLICAS,
+            # calm cadence: 300 workers at the default 0.25s heartbeat
+            # would melt the box before measuring anything
+            heartbeat_interval=6.0,
+            start_delay=0.01,
+            stuck_bound=CONVERGE_BUDGET_S,
+        )
+
+        # registration of 300 workers outlives the harness's default
+        # readiness window, and under the start stampede some status
+        # POSTs time out (stubs swallow those) — widen the window and
+        # re-nudge stragglers until the whole fleet reports READY
+        async def wait_wide(timeout: float = 240.0):
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout
+            while True:
+                # the list API defaults to limit=100 — ask for the
+                # whole fleet
+                workers = await harness.admin.list(
+                    "workers", limit=2 * WORKERS
+                )
+                ready = {
+                    w["name"] for w in workers
+                    if w["state"] == "ready"
+                }
+                if len(ready) >= WORKERS:
+                    return
+                for stub in harness.stubs:
+                    if stub.alive and stub.name not in ready:
+                        await stub._post_status()
+                if loop.time() > deadline:
+                    raise AssertionError(
+                        f"only {len(ready)}/{WORKERS} workers ready"
+                    )
+                await asyncio.sleep(1.0)
+
+        harness._wait_workers_ready = wait_wide
+        await harness.start()
+        try:
+            t0 = time.monotonic()
+            await harness.deploy("scale-model")
+            await harness.wait_converged(timeout=CONVERGE_BUDGET_S)
+            converge_s = time.monotonic() - t0
+            assert converge_s < CONVERGE_BUDGET_S
+
+            server = harness.server
+            # one worker-staleness sweep over the full fleet
+            t0 = time.monotonic()
+            await server.syncer.sync_once()
+            syncer_s = time.monotonic() - t0
+            # one rescuer scan (park sweep walks every instance with a
+            # single worker prefetch — the N+1 would show here)
+            t0 = time.monotonic()
+            await server.rescuer.sync_once()
+            rescuer_s = time.monotonic() - t0
+            # one replica-sync pass for the deployed model
+            model = await Model.first(name="scale-model")
+            mc = server.controllers[0]
+            t0 = time.monotonic()
+            await mc._sync_replicas(model)
+            replica_sync_s = time.monotonic() - t0
+
+            timings = {
+                "workers": WORKERS,
+                "converge_s": round(converge_s, 2),
+                "worker_sync_pass_s": round(syncer_s, 3),
+                "rescuer_pass_s": round(rescuer_s, 3),
+                "replica_sync_pass_s": round(replica_sync_s, 3),
+            }
+            assert syncer_s < SYNC_PASS_BUDGET_S, timings
+            assert rescuer_s < SYNC_PASS_BUDGET_S, timings
+            assert replica_sync_s < SYNC_PASS_BUDGET_S, timings
+            assert harness.violations() == [], timings
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())

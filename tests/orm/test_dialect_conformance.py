@@ -112,7 +112,7 @@ def test_control_plane_sql_is_dialect_generic(traced_db):
 def test_json_accessor_covers_reference_dialects():
     """JSON field access (dashboard/usage/exporter SQL) goes through the
     per-dialect helpers — never a hardcoded json_extract."""
-    from gpustack_tpu.orm.sql import DIALECTS, json_num, json_text
+    from gpustack_tpu.orm.sql import DIALECTS, json_num, json_set, json_text
 
     assert set(DIALECTS) == {"sqlite", "postgres", "mysql"}
     assert json_num("total_tokens") == (
@@ -122,32 +122,55 @@ def test_json_accessor_covers_reference_dialects():
     assert "::numeric" in json_num("x", dialect="postgres")
     assert "JSON_EXTRACT" in json_num("x", dialect="mysql")
     assert json_text("op", dialect="postgres").endswith("'op')")
+    # the writer: one bind slot (JSON text), whole-document result,
+    # and every dialect PARSES the bind so numeric values stay JSON
+    # numbers instead of diverging into strings on postgres
+    assert json_set("rollback_requested") == (
+        "json_set(data, '$.rollback_requested', json(?))"
+    )
+    assert "jsonb_set" in json_set("x", dialect="postgres")
+    assert "'{x}'" in json_set("x", dialect="postgres")
+    assert "::jsonb" in json_set("x", dialect="postgres")
+    assert "JSON_SET" in json_set("x", dialect="mysql")
+    assert "CAST(? AS JSON)" in json_set("x", dialect="mysql")
+    for d in DIALECTS:
+        assert json_set("x", dialect=d).count("?") == 1
 
 
 def test_no_hardcoded_json_extract_in_sources():
     """Source scan: route/exporter SQL must compose orm/sql.py helpers
-    (the runtime trace can't see route SQL, so this closes that gap)."""
+    (the runtime trace can't see route SQL, so this closes that gap).
+    Covers the reader (json_extract) AND the writer (json_set) — a raw
+    ``json_set(data, ...`` in an SQL string is just as sqlite-only;
+    bound calls (``db().json_set(``) are fine and excluded by the
+    dot-lookbehind."""
     import os
+    import re
 
+    raw_set = re.compile(r"(?<!\.)\bjson_set\s*\(")
     root = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)
         ))),
         "gpustack_tpu",
     )
+    allowed = {
+        os.path.join("orm", "sql.py"), os.path.join("orm", "db.py"),
+    }
     offenders = []
     for dirpath, _dirs, files in os.walk(root):
         for fname in files:
             if not fname.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fname)
-            if path.endswith(os.path.join("orm", "sql.py")):
+            if os.path.relpath(path, root) in allowed:
                 continue
             with open(path) as f:
-                if "json_extract(" in f.read():
-                    offenders.append(os.path.relpath(path, root))
+                src = f.read()
+            if "json_extract(" in src or raw_set.search(src):
+                offenders.append(os.path.relpath(path, root))
     assert not offenders, (
-        f"hardcoded json_extract in {offenders}; use orm/sql.py helpers"
+        f"hardcoded json1 SQL in {offenders}; use orm/sql.py helpers"
     )
 
 
@@ -171,7 +194,7 @@ def test_query_code_uses_dialect_bound_accessors():
     allowed = {
         os.path.join("orm", "sql.py"), os.path.join("orm", "db.py"),
     }
-    pat = re.compile(r"(?<!\.)\b(?:json_num|json_text)\s*\(")
+    pat = re.compile(r"(?<!\.)\b(?:json_num|json_text|json_set)\s*\(")
     offenders = []
     for dirpath, _dirs, files in os.walk(root):
         for fname in files:
@@ -185,7 +208,10 @@ def test_query_code_uses_dialect_bound_accessors():
                 src = f.read()
             if (
                 "from gpustack_tpu.orm.sql import" in src
-                and ("json_num" in src or "json_text" in src)
+                and (
+                    "json_num" in src or "json_text" in src
+                    or "json_set" in src
+                )
             ) or pat.search(src):
                 offenders.append(rel)
     assert not offenders, (

@@ -73,6 +73,33 @@ def test_filter_indexed_and_python_fields(ctx):
     run(go())
 
 
+def test_set_field_is_column_targeted(ctx):
+    async def go():
+        m = await Model.create(Model(
+            name="m", preset="tiny", replicas=2, max_slots=4,
+        ))
+        # a writer holding a STALE snapshot advances one field while a
+        # concurrent update() lands on another — set_field must not
+        # revert it (the whole-document hazard it exists to avoid)
+        await (await Model.get(m.id)).update(max_slots=8)
+        assert await Model.set_field(
+            m.id, "wake_requested_at", 123.5
+        ) == 1
+        fresh = await Model.get(m.id)
+        assert fresh.wake_requested_at == 123.5
+        assert fresh.max_slots == 8          # concurrent write survives
+        assert fresh.replicas == 2
+        # missing row: rowcount says so instead of raising
+        assert await Model.set_field(
+            999_999, "wake_requested_at", 1.0
+        ) == 0
+        # index columns would silently diverge from the document
+        with pytest.raises(ValueError):
+            await ModelInstance.set_field(1, "state", "running")
+
+    run(go())
+
+
 def test_update_publishes_changed_fields(ctx):
     db, bus = ctx
 

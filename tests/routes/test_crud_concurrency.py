@@ -1,0 +1,98 @@
+"""The update route's re-fetch-before-write (routes/crud.py) must not
+apply fields whose validation basis changed during the hook's awaits: a
+PATCH judged legal against the row it read has to 409 — not write —
+when a background writer (rescuer, rollback restore, autoscaler) moved
+the row in between. Regression for the UNREACHABLE->RUNNING corruption:
+the transition hook approves STARTING->RUNNING on the stale snapshot,
+the rescuer parks the row, and the stale write would persist a
+transition nobody validated (a RUNNING row on a dead worker that no
+worker-state edge ever revisits)."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    ModelInstance,
+    ModelInstanceState,
+    User,
+    Worker,
+    WorkerState,
+)
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+def test_concurrent_state_change_409s_instead_of_stale_write(
+    cfg, monkeypatch
+):
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        admin = await User.create(User(
+            username="admin", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        ))
+        token = auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        worker = await Worker.create(Worker(
+            name="w", ip="127.0.0.1", state=WorkerState.READY,
+        ))
+        inst = await ModelInstance.create(ModelInstance(
+            name="m-0", model_id=1, worker_id=worker.id,
+            state=ModelInstanceState.STARTING,
+        ))
+
+        real_get = ModelInstance.get.__func__
+        raced = {"done": False}
+
+        async def racing_get(cls, rid):
+            row = await real_get(cls, rid)
+            if rid == inst.id and not raced["done"] and row is not None:
+                # the rescuer parks the row between the route's first
+                # read and its re-fetch-before-write; the route keeps
+                # holding the pre-park snapshot
+                raced["done"] = True
+                parked = await real_get(cls, rid)
+                await parked.update(
+                    state=ModelInstanceState.UNREACHABLE
+                )
+            return row
+
+        monkeypatch.setattr(
+            ModelInstance, "get", classmethod(racing_get)
+        )
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.put(
+                f"/v2/model-instances/{inst.id}",
+                json={"state": "running"},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            assert r.status == 409, await r.text()
+            assert "changed concurrently" in await r.text()
+        finally:
+            await client.close()
+        monkeypatch.setattr(
+            ModelInstance, "get", classmethod(real_get)
+        )
+        # the row keeps the rescuer's park — never the stale RUNNING
+        assert (
+            await ModelInstance.get(inst.id)
+        ).state == ModelInstanceState.UNREACHABLE
+
+    asyncio.run(go())

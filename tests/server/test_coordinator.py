@@ -23,6 +23,68 @@ def test_local_coordinator_always_leader():
     asyncio.run(go())
 
 
+def test_local_coordinator_register_after_start_fires():
+    """A callback registered AFTER start() must still fire — via
+    get_running_loop (the deprecated get_event_loop path could mint a
+    brand-new never-running loop and silently drop the task)."""
+
+    async def go():
+        c = LocalCoordinator()
+        await c.start()
+        fired = []
+
+        async def cb(leading):
+            fired.append(leading)
+
+        c.on_leadership_change(cb)
+        await asyncio.sleep(0)     # let the created task run
+        assert fired == [True]
+        await c.stop()
+
+    asyncio.run(go())
+
+
+def test_lease_stop_awaits_task_and_releases_immediately():
+    """Graceful shutdown hands leadership over NOW, not after a full
+    TTL: stop() awaits the cancelled election task (so no in-flight
+    renewal can resurrect the lease) and deletes the row, letting a
+    follower acquire on its next tick."""
+
+    async def go():
+        db = Database(":memory:")
+        # TTL chosen so immediate handoff (<= ~ttl/3 follower tick) is
+        # clearly distinguishable from expiry-based handoff (>= ttl)
+        a = LeaseCoordinator(db, identity="a", ttl=3.0)
+        b = LeaseCoordinator(db, identity="b", ttl=3.0)
+        await a.start()
+        await asyncio.sleep(0.3)
+        assert a.is_leader
+        await b.start()
+        await asyncio.sleep(0.2)
+        assert not b.is_leader
+
+        task = a._task
+        await a.stop()
+        # the election task was awaited to completion, not abandoned
+        assert task is not None and task.done()
+        assert not a.is_leader
+        # the lease row is gone the moment stop() returns
+        rows = await db.execute("SELECT holder FROM leadership")
+        assert rows == [] or rows[0]["holder"] != "a"
+
+        # follower takes over well inside the TTL window
+        deadline = asyncio.get_running_loop().time() + 2.0
+        while not b.is_leader:
+            assert (
+                asyncio.get_running_loop().time() < deadline
+            ), "follower did not take over before the old lease TTL"
+            await asyncio.sleep(0.1)
+        await b.stop()
+        db.close()
+
+    asyncio.run(go())
+
+
 def test_lease_coordinator_single_leader_and_failover():
     async def go():
         db = Database(":memory:")

@@ -179,3 +179,31 @@ def test_snapshot_scopes():
         [model], workers, instances, include_eventual=True
     )
     assert "running-on-unready-worker" in _rules(full)
+
+
+def test_rollout_surge_cap_binds_new_generation_only():
+    """The always-scope surge cap bounds what the controller CREATES
+    (new-generation instances <= promoted + surge). An operator
+    shrinking replicas mid-rollout leaves the total above the new
+    spec until the excess old batch drains — that must not fire."""
+    from gpustack_tpu.schemas import Rollout, RolloutState
+
+    model = Model(name="m", replicas=2)   # shrunk from 4 mid-rollout
+    model.id = 1
+    ro = Rollout(
+        model_id=1, model_name="m", to_generation=1,
+        surge=1, promoted=1, state=RolloutState.PROMOTING,
+    )
+    ro.id = 1
+    old = [_inst(i, 1, []) for i in range(1, 5)]        # 4 old-gen
+    new = [_inst(i, 1, []) for i in range(5, 7)]        # 2 new-gen
+    for inst in new:
+        inst.generation = 1
+    # total 6 > replicas+surge (3), but legal: cap binds new-gen only
+    assert inv.check_rollout_surge([model], old + new, [ro]) == []
+    # a runaway surge loop DOES fire: new-gen beyond promoted + surge
+    runaway = [_inst(i, 1, []) for i in range(5, 8)]    # 3 new-gen
+    for inst in runaway:
+        inst.generation = 1
+    out = inv.check_rollout_surge([model], old + runaway, [ro])
+    assert _rules(out) == ["rollout-surge-exceeded"]

@@ -1,0 +1,992 @@
+"""RolloutController (server/rollout.py): delta-gate math, the batch
+state machine under an injected clock, automatic rollback with spec
+restore + incident recording, and the model-update hook that versions
+serving changes (generation bump + ModelRevision archive).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+    ModelRevision,
+    Rollout,
+    RolloutState,
+    User,
+)
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.rollout import (
+    RolloutController,
+    delta_gate_failure,
+    window_error_rate,
+    window_ttft_p95,
+)
+
+CFG = {
+    "rollout_interval": 0.5,
+    "rollout_observe_s": 10.0,
+    "rollout_min_requests": 5,
+    "rollout_max_error_delta": 0.05,
+    "rollout_max_ttft_degradation": 2.0,
+    "rollout_running_deadline": 60.0,
+}
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    import gpustack_tpu.server.collectors  # noqa: F401
+
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path), **CFG})
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# pure gate helpers
+# ---------------------------------------------------------------------------
+
+
+def snap(ok, total, ttft=None, ttft_count=0):
+    return {
+        "ok": ok, "total": total,
+        "ttft": ttft or {}, "ttft_count": ttft_count,
+    }
+
+
+def test_window_error_rate():
+    base = snap(10, 10)
+    assert window_error_rate(snap(15, 20), base, 5) == 0.5
+    # under min_requests: no verdict
+    assert window_error_rate(snap(12, 13), base, 5) is None
+    assert window_error_rate(snap(20, 20), base, 5) == 0.0
+
+
+def test_window_ttft_p95_interpolates_within_bucket():
+    base = snap(0, 0, {"0.1": 0, "0.5": 0, "inf": 0}, 0)
+    # 10 requests, all in the (0.1, 0.5] bucket -> p95 interpolated
+    cur = snap(0, 0, {"0.1": 0, "0.5": 10, "inf": 10}, 10)
+    p95 = window_ttft_p95(cur, base, 5)
+    assert 0.1 < p95 <= 0.5
+    assert window_ttft_p95(cur, base, 20) is None  # too few requests
+
+
+def test_delta_gate_failure_error_rate(cfg):
+    baseline = snap(0, 0)
+    canary = snap(20, 20)            # baseline window: 20 ok / 20
+    healthy = snap(40, 40)           # canary window: 20 ok / 20
+    assert delta_gate_failure(
+        baseline, canary, canary, healthy, cfg
+    ) is None
+    bad = snap(30, 40)               # canary window: 10 ok / 20
+    reason = delta_gate_failure(baseline, canary, canary, bad, cfg)
+    assert reason is not None and "error-rate gate" in reason
+
+
+def test_delta_gate_failure_ttft(cfg):
+    baseline = snap(0, 0, {"0.1": 0, "1.0": 0, "inf": 0}, 0)
+    # baseline window: 20 fast requests (<= 0.1s)
+    canary = snap(20, 20, {"0.1": 20, "1.0": 20, "inf": 20}, 20)
+    # canary window: 20 slow requests in the (0.1, 1.0] bucket
+    slow = snap(
+        40, 40, {"0.1": 20, "1.0": 40, "inf": 40}, 40
+    )
+    reason = delta_gate_failure(baseline, canary, canary, slow, cfg)
+    assert reason is not None and "ttft gate" in reason
+    # same speed as baseline: quiet
+    fast = snap(40, 40, {"0.1": 40, "1.0": 40, "inf": 40}, 40)
+    assert delta_gate_failure(
+        baseline, canary, canary, fast, cfg
+    ) is None
+
+
+def test_delta_gate_baseline_window_stays_pure(cfg):
+    """The baseline window ends at the FIRST observation open
+    (baseline_end), not the current batch's canary snapshot — a
+    canary degrading just under the per-window delta must not ratchet
+    the baseline up batch over batch."""
+    baseline = snap(0, 0)
+    first_observe = snap(100, 100)   # pure old-gen: 0% errors
+    # by batch 3 the new generation has served into the stream at
+    # ~10% errors; judged against the PURE baseline it fails ...
+    batch3_canary = snap(280, 300)
+    current = snap(307, 330)         # this window: 27 ok / 30 = 10%
+    reason = delta_gate_failure(
+        baseline, first_observe, batch3_canary, current, cfg
+    )
+    assert reason is not None and "error-rate gate" in reason
+    # ... while the contaminated window (old behavior: baseline_end ==
+    # current batch's canary, ~6.7% errors) would have let it ratchet
+    assert delta_gate_failure(
+        baseline, batch3_canary, batch3_canary, current, cfg
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# controller state machine (injected clock over real DB state)
+# ---------------------------------------------------------------------------
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.engine = self
+        self.firing = []
+        self.incidents = []
+
+    def firing_objectives(self, model):
+        return list(self.firing)
+
+    def record_incident(self, model, objective, **kw):
+        self.incidents.append({"model": model, "objective": objective, **kw})
+        return self.incidents[-1]
+
+    def _evidence(self, model, objective):
+        return {"traces": [], "lifecycle": []}
+
+
+async def _deploy(name, replicas=2):
+    model = await Model.create(Model(
+        name=name, preset="tiny", replicas=replicas,
+        max_slots=2, generation=0,
+    ))
+    insts = []
+    for i in range(replicas):
+        insts.append(await ModelInstance.create(ModelInstance(
+            name=f"{name}-{i}", model_id=model.id, model_name=name,
+            state=ModelInstanceState.RUNNING, generation=0,
+        )))
+    return model, insts
+
+
+async def _bump(model, **fields):
+    """Simulate the API hook: archive the old spec, bump generation."""
+    from gpustack_tpu.schemas.models import ROLLOUT_FIELDS
+
+    await ModelRevision.create(ModelRevision(
+        model_id=model.id, generation=model.generation,
+        spec={k: getattr(model, k) for k in ROLLOUT_FIELDS},
+    ))
+    await model.update(generation=model.generation + 1, **fields)
+    return await Model.get(model.id)
+
+
+async def _set_running(model_id, generation):
+    out = []
+    for inst in await ModelInstance.filter(model_id=model_id):
+        if inst.generation == generation and (
+            inst.state != ModelInstanceState.RUNNING
+        ):
+            await inst.update(state=ModelInstanceState.RUNNING)
+        out.append(inst)
+    return out
+
+
+def test_rollout_happy_path_batches_to_completion(cfg):
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-ok", replicas=2)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)
+        ros = await Rollout.filter(model_id=model.id)
+        assert len(ros) == 1
+        rollout = ros[0]
+        assert rollout.state == RolloutState.SURGING
+        assert rollout.to_generation == 1
+
+        # surge created exactly one new-generation replica (surge=1)
+        await ctl.reconcile_once(now=t)
+        new = [
+            i for i in await ModelInstance.filter(model_id=model.id)
+            if i.generation == 1
+        ]
+        assert len(new) == 1 and new[0].name == "roll-ok-g1-0"
+        # surge cap: never more than spec+surge total
+        assert len(await ModelInstance.filter(model_id=model.id)) == 3
+
+        # canary RUNNING -> observation window opens
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.OBSERVING
+        assert rollout.observe_since == t + 1
+
+        # window not elapsed: no promotion yet
+        await ctl.reconcile_once(now=t + 5)
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.OBSERVING
+        )
+
+        # window elapsed, gates quiet -> old batch drains
+        await ctl.reconcile_once(now=t + 12)
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.PROMOTING
+        assert rollout.promoted == 1
+        draining = [
+            i for i in await ModelInstance.filter(model_id=model.id)
+            if i.state == ModelInstanceState.DRAINING
+        ]
+        assert len(draining) == 1 and draining[0].generation == 0
+
+        # worker retires the drained row -> next batch surges
+        await draining[0].delete()
+        await ctl.reconcile_once(now=t + 13)
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.SURGING
+        )
+        await ctl.reconcile_once(now=t + 13)
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 14)       # observing
+        await ctl.reconcile_once(now=t + 25)       # promote batch 2
+        for inst in await ModelInstance.filter(model_id=model.id):
+            if inst.state == ModelInstanceState.DRAINING:
+                await inst.delete()
+        await ctl.reconcile_once(now=t + 26)
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.COMPLETED
+        # no generation mixing after completion
+        insts = await ModelInstance.filter(model_id=model.id)
+        assert len(insts) == 2
+        assert all(i.generation == 1 for i in insts)
+        events = [h["event"] for h in rollout.history]
+        assert events.count("batch_promoted") == 2
+
+    asyncio.run(go())
+
+
+def test_rollback_of_superseded_plan_keeps_newer_spec(cfg):
+    """An operator update landing mid-rollout bumps the generation past
+    the active plan's target; a later gate failure on that STALE plan
+    must not restore the plan's old spec over the newer fix (which was
+    never archived) — it finishes superseded and the new generation
+    rolls out normally."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-sup", replicas=2)
+        model = await _bump(model, max_slots=8)    # gen 1: the bad spec
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)            # plan + surge
+        await ctl.reconcile_once(now=t)            # create canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        stale = (await Rollout.filter(model_id=model.id))[0]
+        # the operator ships a fix mid-rollout -> gen 2 (only on the
+        # Model row; revisions archive the PREVIOUS spec, never gen 2)
+        model = await _bump(await Model.get(model.id), max_slots=4)
+        assert model.generation == 2
+        # burn fires while the stale gen-1 plan is still active
+        slo.firing = ["error_rate"]
+        await ctl.reconcile_once(now=t + 2)
+
+        stale = await Rollout.get(stale.id)
+        assert stale.state == RolloutState.FAILED
+        assert "superseded" in stale.state_message
+        # the fix survives untouched: spec NOT overwritten, generation
+        # NOT bumped past the operator's update
+        model = await Model.get(model.id)
+        assert model.max_slots == 4
+        assert model.generation == 2
+        assert slo.incidents  # the gate failure still left evidence
+        # the superseding generation gets its own plan and converges
+        await ctl.reconcile_once(now=t + 3)
+        plans = await Rollout.filter(model_id=model.id)
+        assert any(
+            r.to_generation == 2
+            and r.state in (RolloutState.SURGING, RolloutState.OBSERVING)
+            for r in plans
+        )
+
+    asyncio.run(go())
+
+
+def test_operator_update_mid_rollout_supersedes_plan(cfg):
+    """A second spec change landing while a plan is mid-flight must
+    fail the stale plan (its surged replicas would boot the NEWEST
+    spec while tagged with the plan's generation) and let a fresh plan
+    toward the superseding generation converge the fleet."""
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-sup2", replicas=2)
+        model = await _bump(model, max_slots=8)     # gen 1
+        t = time.time()
+        await ctl.reconcile_once(now=t)             # plan g0 -> g1
+        await ctl.reconcile_once(now=t)             # surge canary
+        plan = (await Rollout.filter(model_id=model.id))[0]
+        assert plan.state == RolloutState.SURGING
+        # the operator ships another update mid-flight -> gen 2
+        model = await _bump(await Model.get(model.id), max_slots=4)
+        await ctl.reconcile_once(now=t + 1)
+        plan = await Rollout.get(plan.id)
+        assert plan.state == RolloutState.FAILED
+        assert "superseded" in plan.state_message
+        # the newer spec survives untouched
+        model = await Model.get(model.id)
+        assert model.max_slots == 4 and model.generation == 2
+        # next pass opens a fresh plan toward the superseding gen
+        await ctl.reconcile_once(now=t + 2)
+        plans = await Rollout.filter(model_id=model.id)
+        assert any(
+            r.to_generation == 2
+            and r.state in (RolloutState.SURGING, RolloutState.OBSERVING)
+            for r in plans
+        )
+
+    asyncio.run(go())
+
+
+def test_stale_observe_snapshot_never_drains_after_rollback(cfg):
+    """A reconcile tick holding a pre-rollback plan snapshot must not
+    drain old-generation replicas: _observe_step re-checks the plan
+    state under the plan lock (the lock begin_rollback holds across
+    its body) before any instance write, so a rollback landing
+    mid-tick keeps the old generation at spec."""
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-race", replicas=2)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)            # plan
+        await ctl.reconcile_once(now=t)            # surge canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        stale = (await Rollout.filter(model_id=model.id))[0]
+        assert stale.state == RolloutState.OBSERVING
+        # a manual rollback lands AFTER this tick's snapshot was read
+        await (await Rollout.get(stale.id)).update(
+            state=RolloutState.ROLLING_BACK
+        )
+        old = [
+            i for i in await ModelInstance.filter(model_id=model.id)
+            if i.generation == 0
+        ]
+        # window elapsed on the stale snapshot -> would drain old batch
+        await ctl._observe_step(model, stale, old, 2, t + 30)
+        assert all(
+            i.state == ModelInstanceState.RUNNING
+            for i in await ModelInstance.filter(model_id=model.id)
+            if i.generation == 0
+        )
+        # no stale PROMOTING write resurrected the pre-rollback state
+        fresh = await Rollout.get(stale.id)
+        assert fresh.state == RolloutState.ROLLING_BACK
+        assert fresh.promoted == 0
+
+    asyncio.run(go())
+
+
+def test_preexisting_burn_does_not_insta_rollback(cfg):
+    """A rollout is often the FIX for a live incident: a burn already
+    FIRING when the plan opens must not gate it (it would insta-restore
+    the broken spec that caused the burn, forever). A burn that STARTS
+    mid-rollout still gates."""
+    async def go():
+        slo = _FakeSLO()
+        slo.firing = ["error_rate"]        # firing BEFORE the update
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-fix", replicas=2)
+        model = await _bump(model, max_slots=8)
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)            # plan + surge
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        assert rollout.preexisting_firing == ["error_rate"]
+        assert rollout.state == RolloutState.SURGING
+        await ctl.reconcile_once(now=t)            # create canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.OBSERVING
+        )
+        # a DIFFERENT objective starting to fire mid-rollout gates
+        slo.firing = ["error_rate", "ttft"]
+        await ctl.reconcile_once(now=t + 2)
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.ROLLING_BACK
+        assert "ttft" in rollout.state_message
+        assert "error_rate" not in rollout.state_message
+
+    asyncio.run(go())
+
+
+def test_slo_burn_firing_triggers_rollback_with_restore(cfg):
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-burn", replicas=2)
+        model = await _bump(model, max_slots=8)
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)            # plan + surge
+        await ctl.reconcile_once(now=t)            # create canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        # burn-rate fires on the model mid-observation
+        slo.firing = ["error_rate"]
+        await ctl.reconcile_once(now=t + 2)
+
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.ROLLING_BACK
+        # the bad spec was rolled off the Model row (generation moved
+        # FORWARD to the restored revision — nothing re-rolls)
+        model = await Model.get(model.id)
+        assert model.max_slots == 2
+        assert model.generation == 2
+        # surviving old replicas re-tagged to the restored generation
+        old = [
+            i for i in await ModelInstance.filter(model_id=model.id)
+            if not i.name.startswith("roll-burn-g1-")
+        ]
+        assert len(old) == 2
+        assert all(i.generation == 2 for i in old)
+        assert all(
+            i.state == ModelInstanceState.RUNNING for i in old
+        ), "old generation must never be touched by a canary rollback"
+        # canary drains
+        canary = [
+            i for i in await ModelInstance.filter(model_id=model.id)
+            if i.name.startswith("roll-burn-g1-")
+        ]
+        assert len(canary) == 1
+        assert canary[0].state == ModelInstanceState.DRAINING
+        # incident recorded with the rollout evidence tag
+        assert slo.incidents and slo.incidents[0]["objective"] == "rollout"
+        assert "rollout" in slo.incidents[0]["evidence"]
+
+        # worker retires the canary -> terminal ROLLED_BACK
+        await canary[0].delete()
+        await ctl.reconcile_once(now=t + 3)
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.ROLLED_BACK
+        )
+        # no retry of the failed generation
+        await ctl.reconcile_once(now=t + 4)
+        assert len(await Rollout.filter(model_id=model.id)) == 1
+
+    asyncio.run(go())
+
+
+def test_spec_shrink_mid_rollout_converges(cfg):
+    """An operator shrinking replicas mid-rollout must not wedge the
+    plan in PROMOTING or complete it with generations still mixed: the
+    promoted new capacity covers the smaller spec, so every remaining
+    old replica drains and the rollout completes."""
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-shrink", replicas=3)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+
+        await ctl.reconcile_once(now=t)            # plan
+        await ctl.reconcile_once(now=t)            # surge canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        await ctl.reconcile_once(now=t + 12)       # promote batch 1
+        for inst in await ModelInstance.filter(model_id=model.id):
+            if inst.state == ModelInstanceState.DRAINING:
+                await inst.delete()
+
+        # shrink the spec to 1 mid-rollout: promoted (1) now covers it
+        await (await Model.get(model.id)).update(replicas=1)
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        for step in range(1, 6):
+            await ctl.reconcile_once(now=t + 12 + step)
+            for inst in await ModelInstance.filter(model_id=model.id):
+                if inst.state == ModelInstanceState.DRAINING:
+                    await inst.delete()
+            if (await Rollout.get(rollout.id)).state == (
+                RolloutState.COMPLETED
+            ):
+                break
+        rollout = await Rollout.get(rollout.id)
+        assert rollout.state == RolloutState.COMPLETED, rollout.history
+        insts = await ModelInstance.filter(model_id=model.id)
+        # no old-generation replica survived completion
+        assert all(i.generation == 1 for i in insts)
+
+    asyncio.run(go())
+
+
+def test_scale_to_zero_mid_rollout_drains_everything(cfg):
+    """Spec scaled to 0 mid-rollout: the plan drains every instance
+    itself and completes only once the set is empty — completing with
+    a mixed set would let replica sync retire the NEW generation first
+    and strand stale replicas behind the no-retry marker."""
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-zero", replicas=2)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+        await ctl.reconcile_once(now=t)            # plan
+        await ctl.reconcile_once(now=t)            # surge canary
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+
+        await (await Model.get(model.id)).update(replicas=0)
+        await ctl.reconcile_once(now=t + 2)
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        # still active: completion waits for the drains to land
+        assert rollout.state == RolloutState.OBSERVING
+        insts = await ModelInstance.filter(model_id=model.id)
+        assert insts
+        assert all(
+            i.state == ModelInstanceState.DRAINING for i in insts
+        )
+        for inst in insts:                         # workers retire
+            await inst.delete()
+        await ctl.reconcile_once(now=t + 3)
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.COMPLETED
+        )
+
+    asyncio.run(go())
+
+
+def test_double_rollback_does_not_reexecute(cfg):
+    """A manual rollback racing the gate tick's rollback (stale
+    snapshot still reading OBSERVING) must be a no-op: re-running
+    would bump the generation twice and duplicate revision +
+    incident."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-twice", replicas=1)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+        await ctl.reconcile_once(now=t)
+        await ctl.reconcile_once(now=t)
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        stale = (await Rollout.filter(model_id=model.id))[0]
+        insts = await ModelInstance.filter(model_id=model.id)
+
+        await ctl.begin_rollback(
+            model, stale, insts, t + 2, "gate failed"
+        )
+        assert (await Model.get(model.id)).generation == 2
+        assert len(slo.incidents) == 1
+        revs = await ModelRevision.filter(model_id=model.id)
+
+        # the racing manual POST arrives with the stale snapshot
+        await ctl.begin_rollback(
+            model, stale, insts, t + 2, "manual rollback",
+            event="manual_rollback",
+        )
+        assert (await Model.get(model.id)).generation == 2
+        assert len(slo.incidents) == 1
+        assert len(
+            await ModelRevision.filter(model_id=model.id)
+        ) == len(revs)
+
+    asyncio.run(go())
+
+
+def test_rollback_is_noop_when_rollout_already_finished(cfg):
+    """begin_rollback racing the completing tick (manual POST or HA
+    peer) must not resurrect a finished plan via a stale
+    whole-document write — it re-fetches and bails."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, insts = await _deploy("roll-race", replicas=1)
+        model = await _bump(model, max_slots=4)
+        stale = await Rollout.create(Rollout(
+            model_id=model.id, model_name=model.name,
+            from_generation=0, to_generation=1,
+            state=RolloutState.OBSERVING,
+        ))
+        # the "leader's tick" completes the plan after our snapshot
+        await (await Rollout.get(stale.id)).update(
+            state=RolloutState.COMPLETED
+        )
+        await ctl.begin_rollback(
+            model, stale, insts, time.time(), "manual rollback",
+            event="manual_rollback",
+        )
+        fresh = await Rollout.get(stale.id)
+        assert fresh.state == RolloutState.COMPLETED
+        assert (await Model.get(model.id)).generation == 1
+        assert slo.incidents == []
+        for inst in await ModelInstance.filter(model_id=model.id):
+            assert inst.state == ModelInstanceState.RUNNING
+
+    asyncio.run(go())
+
+
+def test_concurrent_rollbacks_execute_once(cfg):
+    """The manual route (leader path) and a gate-failure tick can call
+    begin_rollback concurrently; the ROLLING_BACK write lands after
+    the restore's awaits, so without serialization both would pass the
+    entry guard and bump the generation twice."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-conc", replicas=1)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+        await ctl.reconcile_once(now=t)
+        await ctl.reconcile_once(now=t)
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        stale = (await Rollout.filter(model_id=model.id))[0]
+        insts = await ModelInstance.filter(model_id=model.id)
+
+        await asyncio.gather(
+            ctl.begin_rollback(
+                model, stale, insts, t + 2, "gate failed"
+            ),
+            ctl.begin_rollback(
+                model, stale, insts, t + 2, "manual rollback",
+                event="manual_rollback",
+            ),
+        )
+        assert (await Model.get(model.id)).generation == 2
+        assert len(slo.incidents) == 1
+        revs = await ModelRevision.filter(
+            model_id=model.id, limit=None
+        )
+        # one archive per generation: 0 (pre-bump) and 2 (restored)
+        assert sorted(r.generation for r in revs) == [0, 2]
+
+    asyncio.run(go())
+
+
+def test_manual_rollback_route_leader_and_follower(cfg):
+    """POST /v2/models/{id}/rollback: the leader executes the rollback
+    synchronously; a follower only notes rollback_requested on the
+    plan for the leader's reconcile to execute."""
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from gpustack_tpu.server.app import create_app
+
+        admin = await User.create(User(
+            username="admin3", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        ))
+        hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        }
+
+        class _Follower:
+            @property
+            def is_leader(self):
+                return False
+
+        slo = _FakeSLO()
+        app = create_app(cfg)
+        app["slo"] = slo
+        app["rollout"] = RolloutController(app, cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # --- leader path: executes in-process ------------------
+            model, _ = await _deploy("route-lead", replicas=1)
+            model = await _bump(model, max_slots=4)
+            ro = await Rollout.create(Rollout(
+                model_id=model.id, model_name=model.name,
+                from_generation=0, to_generation=1,
+                state=RolloutState.OBSERVING,
+            ))
+            r = await client.post(
+                f"/v2/models/{model.id}/rollback", headers=hdrs
+            )
+            assert r.status == 202, await r.text()
+            # no surged canaries to drain in this synthetic plan, so
+            # the teardown finishes within the same request
+            assert (await r.json())["state"] == "rolled_back"
+            assert (await Model.get(model.id)).generation == 2
+            assert len(slo.incidents) == 1
+
+            # --- follower path: notes the request only -------------
+            app["coordinator"] = _Follower()
+            model2, _ = await _deploy("route-follow", replicas=1)
+            model2 = await _bump(model2, max_slots=4)
+            ro2 = await Rollout.create(Rollout(
+                model_id=model2.id, model_name=model2.name,
+                from_generation=0, to_generation=1,
+                state=RolloutState.OBSERVING,
+            ))
+            r = await client.post(
+                f"/v2/models/{model2.id}/rollback", headers=hdrs
+            )
+            assert r.status == 202, await r.text()
+            fresh = await Rollout.get(ro2.id)
+            assert fresh.state == RolloutState.OBSERVING
+            assert fresh.rollback_requested
+            # no follower-local side effects
+            assert (await Model.get(model2.id)).generation == 1
+            assert len(slo.incidents) == 1
+
+            # 409 when nothing is in flight
+            await (await Rollout.get(ro.id)).update(
+                state=RolloutState.ROLLED_BACK
+            )
+            await (await Rollout.get(ro2.id)).update(
+                state=RolloutState.ROLLED_BACK,
+                rollback_requested="",
+            )
+            r = await client.post(
+                f"/v2/models/{model.id}/rollback", headers=hdrs
+            )
+            assert r.status == 409
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_follower_noted_rollback_executed_by_leader(cfg):
+    """POST /rollback on an HA follower only notes the request on the
+    plan (rollback_requested) — the leader's reconcile executes it so
+    the incident and event counter land in the LEADER's SLO ring."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-defer", replicas=1)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+        await ctl.reconcile_once(now=t)
+        await ctl.reconcile_once(now=t)            # canary created
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        assert rollout.state == RolloutState.OBSERVING
+
+        # the follower's route write
+        await rollout.update(
+            rollback_requested="manual rollback requested"
+        )
+        # the leader's next tick executes it
+        await ctl.reconcile_once(now=t + 2)
+        fresh = await Rollout.get(rollout.id)
+        assert fresh.state == RolloutState.ROLLING_BACK
+        assert (await Model.get(model.id)).generation == 2
+        assert len(slo.incidents) == 1             # leader-side ring
+
+    asyncio.run(go())
+
+
+def test_stale_forward_record_cannot_clobber_rollback(cfg):
+    """A rollback landing while a forward step awaits (drains, revision
+    writes) must win: the resumed stale PROMOTING write is dropped —
+    Record.update persists the whole document, so writing it would
+    resurrect the rolled-back plan and re-surge the bad generation."""
+    async def go():
+        slo = _FakeSLO()
+        ctl = RolloutController({"slo": slo}, cfg)
+        model, _ = await _deploy("roll-clobber", replicas=1)
+        model = await _bump(model, max_slots=4)
+        t = time.time()
+        await ctl.reconcile_once(now=t)
+        await ctl.reconcile_once(now=t)            # canary created
+        await _set_running(model.id, 1)
+        await ctl.reconcile_once(now=t + 1)        # observing
+        stale = (await Rollout.filter(model_id=model.id))[0]
+        assert stale.state == RolloutState.OBSERVING
+
+        # the manual POST lands mid-await of the forward step
+        model = await Model.get(model.id)
+        insts = await ModelInstance.filter(model_id=model.id)
+        await ctl.begin_rollback(
+            model, stale, insts, t + 2, "operator says no",
+            event="manual_rollback",
+        )
+        assert (await Rollout.get(stale.id)).state == (
+            RolloutState.ROLLING_BACK
+        )
+
+        # the stale forward holder resumes and tries its write
+        await ctl._record(
+            stale, t + 3, "batch_promoted", "stale forward write",
+            state=RolloutState.PROMOTING, promoted=1,
+        )
+        fresh = await Rollout.get(stale.id)
+        assert fresh.state == RolloutState.ROLLING_BACK
+        assert fresh.promoted == 0
+        assert all(
+            h["event"] != "batch_promoted" for h in fresh.history
+        )
+
+    asyncio.run(go())
+
+
+def test_finished_rollouts_pruned_to_cap(cfg):
+    async def go():
+        from gpustack_tpu.server.rollout import ROLLOUT_KEEP
+
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-prune", replicas=1)
+        # oldest row first: a finished plan targeting the CURRENT
+        # generation survives pruning regardless of age — it is the
+        # marker that stops _needs_rollout auto-retrying a failed spec
+        keeper = await Rollout.create(Rollout(
+            model_id=model.id, model_name=model.name,
+            from_generation=0, to_generation=model.generation,
+            state=RolloutState.ROLLED_BACK,
+        ))
+        for g in range(1, ROLLOUT_KEEP + 6):
+            await Rollout.create(Rollout(
+                model_id=model.id, model_name=model.name,
+                from_generation=g - 1, to_generation=g,
+                state=RolloutState.COMPLETED,
+            ))
+        await ctl.reconcile_once(now=time.time())
+        ros = await Rollout.filter(model_id=model.id, limit=None)
+        assert len(ros) == ROLLOUT_KEEP + 1
+        assert any(r.id == keeper.id for r in ros)
+
+    asyncio.run(go())
+
+
+def test_running_deadline_gate(cfg):
+    async def go():
+        ctl = RolloutController({"slo": _FakeSLO()}, cfg)
+        model, _ = await _deploy("roll-stuck", replicas=1)
+        model = await _bump(model, max_slots=8)
+        t = time.time()
+        await ctl.reconcile_once(now=t)
+        await ctl.reconcile_once(now=t)            # canary created, PENDING
+        # deadline not hit: still surging
+        await ctl.reconcile_once(now=t + 10)
+        rollout = (await Rollout.filter(model_id=model.id))[0]
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.SURGING
+        )
+        # canary never reaches RUNNING within rollout_running_deadline
+        await ctl.reconcile_once(now=t + 61)
+        assert (await Rollout.get(rollout.id)).state == (
+            RolloutState.ROLLING_BACK
+        )
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# model-update hook: generation bump + revision archive (HTTP path)
+# ---------------------------------------------------------------------------
+
+
+def test_model_update_hook_versions_serving_changes(cfg):
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from gpustack_tpu.server.app import create_app
+
+        admin = await User.create(User(
+            username="admin", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        ))
+        hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        }
+        model = await Model.create(Model(
+            name="hook-m", preset="tiny", replicas=1, max_slots=2,
+        ))
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # serving-relevant change -> generation bump + archive
+            r = await client.patch(
+                f"/v2/models/{model.id}",
+                json={"max_slots": 4}, headers=hdrs,
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["generation"] == 1
+            assert body["max_slots"] == 4
+            rev = await ModelRevision.first(
+                model_id=model.id, generation=0
+            )
+            assert rev is not None and rev.spec["max_slots"] == 2
+
+            # non-serving change -> no bump
+            r = await client.patch(
+                f"/v2/models/{model.id}",
+                json={"replicas": 3}, headers=hdrs,
+            )
+            assert (await r.json())["generation"] == 1
+
+            # no-op serving write -> no bump
+            r = await client.patch(
+                f"/v2/models/{model.id}",
+                json={"max_slots": 4}, headers=hdrs,
+            )
+            assert (await r.json())["generation"] == 1
+
+            # generation itself is server-owned: client writes ignored
+            r = await client.patch(
+                f"/v2/models/{model.id}",
+                json={"generation": 99}, headers=hdrs,
+            )
+            assert r.status == 200, await r.text()
+            assert (await r.json())["generation"] == 1
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_revision_pruning_pins_active_rollout_source(cfg):
+    """An update burst mid-rollout must not prune the revision the
+    active plan would restore on gate failure — losing it turns any
+    later rollback into FAILED with the bad spec left live."""
+    async def go():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from gpustack_tpu.server.app import create_app
+
+        admin = await User.create(User(
+            username="admin2", is_admin=True,
+            password_hash=auth_mod.hash_password("pw"),
+        ))
+        hdrs = {
+            "Authorization": "Bearer "
+            + auth_mod.issue_session_token(admin, cfg.jwt_secret)
+        }
+        model = await Model.create(Model(
+            name="hook-pin", preset="tiny", replicas=1, max_slots=2,
+        ))
+        # an active plan still able to roll back to generation 0
+        await Rollout.create(Rollout(
+            model_id=model.id, model_name=model.name,
+            from_generation=0, to_generation=1,
+            state=RolloutState.OBSERVING,
+        ))
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for i in range(12):     # well past the keep-8 window
+                r = await client.patch(
+                    f"/v2/models/{model.id}",
+                    json={"max_slots": 4 + i}, headers=hdrs,
+                )
+                assert r.status == 200, await r.text()
+        finally:
+            await client.close()
+        revs = await ModelRevision.filter(
+            model_id=model.id, limit=None
+        )
+        gens = {r.generation for r in revs}
+        assert 0 in gens            # the rollback source survived
+        # the prune window itself still holds: pinned + newest 8
+        assert len(revs) <= 9
+
+    asyncio.run(go())

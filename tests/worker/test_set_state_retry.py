@@ -1,0 +1,83 @@
+"""ServeManager._set_state vs the server's 409-on-concurrent-change
+(routes/crud.py): a one-shot lifecycle report (STARTING->RUNNING racing
+a background writer) must re-read and re-decide instead of silently
+dropping the transition — a dropped report wedges the row until a
+rollout deadline reaps a healthy canary."""
+
+import asyncio
+
+from gpustack_tpu.client.client import APIError
+from gpustack_tpu.config import Config
+from gpustack_tpu.schemas import ModelInstanceState
+from gpustack_tpu.worker.serve_manager import ServeManager
+
+
+class _Client:
+    def __init__(self, fail_times, message, current_state="unreachable"):
+        self.updates = []
+        self.gets = 0
+        self.fail_times = fail_times
+        self.message = message
+        self.current_state = current_state
+
+    async def update(self, kind, id, fields):
+        self.updates.append(dict(fields))
+        if len(self.updates) <= self.fail_times:
+            raise APIError(409, self.message)
+        return fields
+
+    async def get(self, kind, id):
+        self.gets += 1
+        return {"id": id, "state": self.current_state}
+
+
+CONCURRENT = "model-instances field(s) state changed concurrently; retry"
+
+
+def _manager(tmp_path, client):
+    cfg = Config.load({"data_dir": str(tmp_path)})
+    return ServeManager(cfg, client, worker_id=1)
+
+
+def test_concurrent_409_retries_with_fresh_read(tmp_path):
+    client = _Client(fail_times=1, message=CONCURRENT)
+    sm = _manager(tmp_path, client)
+    asyncio.run(
+        sm._set_state(5, ModelInstanceState.RUNNING, "engine healthy")
+    )
+    assert len(client.updates) == 2
+    assert client.gets == 1
+    assert client.updates[-1]["state"] == "running"
+
+
+def test_non_concurrent_409_is_not_retried(tmp_path):
+    # the transition-legality 409 is deterministic — retrying it would
+    # just hammer the server three times per report
+    client = _Client(
+        fail_times=9,
+        message="illegal instance state transition error -> running",
+    )
+    sm = _manager(tmp_path, client)
+    asyncio.run(
+        sm._set_state(5, ModelInstanceState.RUNNING, "engine healthy")
+    )
+    assert len(client.updates) == 1
+    assert client.gets == 0
+
+
+def test_409_already_resolved_by_another_writer_stops(tmp_path):
+    client = _Client(
+        fail_times=9, message=CONCURRENT, current_state="running"
+    )
+    sm = _manager(tmp_path, client)
+    asyncio.run(sm._set_state(5, ModelInstanceState.RUNNING, "ok"))
+    assert len(client.updates) == 1
+    assert client.gets == 1
+
+
+def test_persistent_concurrent_409_gives_up_bounded(tmp_path):
+    client = _Client(fail_times=9, message=CONCURRENT)
+    sm = _manager(tmp_path, client)
+    asyncio.run(sm._set_state(5, ModelInstanceState.RUNNING, "ok"))
+    assert len(client.updates) == 3          # bounded, never unbounded
+    assert client.gets == 2
