@@ -27,11 +27,15 @@ analyze:
 
 # Seeded chaos against the in-process cluster (docs/RESILIENCE.md): one
 # schedule per fault class (worker kill, heartbeat blackhole, RPC
-# delay/drop, engine crash mid-STARTING, server restart); exits nonzero
-# on any invariant violation or failed convergence. Same seed ⇒ same
-# schedule, so failures are replayable.
+# delay/drop, engine crash mid-STARTING, server restart, and the
+# multi-server ha-failover class: leader kill/hang + lease expiry over
+# a shared DB); exits nonzero on any invariant violation or failed
+# convergence. Same seed ⇒ same schedule, so failures are replayable.
+# Narrow with CLASSES (e.g. `make chaos CLASSES=ha-failover`).
+CLASSES ?= all
+SEED ?= 1
 chaos:
-	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes all --seed 1
+	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes $(CLASSES) --seed $(SEED)
 
 test-engine:
 	python -m pytest tests/ -q -m engine

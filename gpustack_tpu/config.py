@@ -165,6 +165,17 @@ class Config(pydantic.BaseModel):
 
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False
+    # lease TTL in seconds (server/coordinator.py LeaseCoordinator):
+    # the leader renews at ttl/3; after a leader dies a follower
+    # acquires within ~1 TTL (chaos asserts < 3×TTL end to end).
+    # Sizing: > 3× worst-case DB write latency or healthy leaders
+    # flap; failover time is proportional to it.
+    ha_ttl: float = 15.0
+    # escape hatch: disable epoch write-fencing for leader-only
+    # writers (orm/fencing.py). Fencing is what stops a deposed
+    # leader's in-flight writes from clobbering its successor — leave
+    # on unless debugging the fence itself.
+    ha_epoch_fence: bool = True
 
     # OIDC SSO (reference routes/auth.py; flags cmd/start.py:370-512)
     oidc_issuer: str = ""

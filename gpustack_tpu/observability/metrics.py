@@ -81,6 +81,16 @@ METRIC_FAMILIES = {
     "gpustack_autoscale_frozen": "gauge",
     "gpustack_autoscale_cold_start_seconds": "gauge",
     "gpustack_autoscale_events_total": "counter",
+    # control-plane HA (server/coordinator.py + orm/fencing.py):
+    # whether THIS server holds the lease, the fencing epoch of the
+    # current lease, leadership transitions this process observed
+    # (acquired + lost), and writes rejected by the epoch fence — a
+    # nonzero fenced count is a deposed leader caught mid-write, i.e.
+    # the fence doing its job
+    "gpustack_ha_is_leader": "gauge",
+    "gpustack_ha_epoch": "gauge",
+    "gpustack_ha_leader_transitions_total": "counter",
+    "gpustack_ha_fenced_writes_total": "counter",
 }
 
 # request-latency buckets: 1ms .. 10min covers auth (sub-ms) through a

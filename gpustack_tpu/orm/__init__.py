@@ -19,6 +19,17 @@ Postgres, docs/architecture.md:33).
 """
 
 from gpustack_tpu.orm.db import Database
-from gpustack_tpu.orm.record import Record, register_record
+from gpustack_tpu.orm.record import (
+    ConflictError,
+    Record,
+    StaleEpochError,
+    register_record,
+)
 
-__all__ = ["Database", "Record", "register_record"]
+__all__ = [
+    "ConflictError",
+    "Database",
+    "Record",
+    "StaleEpochError",
+    "register_record",
+]

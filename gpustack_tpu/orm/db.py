@@ -28,6 +28,7 @@ class Database:
             raise ValueError(f"unknown SQL dialect {dialect!r}")
         self.path = path
         self.dialect = dialect
+        self.closed = False
         self._work: "queue.Queue[Optional[Tuple[Callable, asyncio.Future, asyncio.AbstractEventLoop]]]" = (
             queue.Queue()
         )
@@ -42,7 +43,13 @@ class Database:
     # ---- worker thread --------------------------------------------------
 
     def _run(self) -> None:
-        self._conn = sqlite3.connect(self.path, check_same_thread=True)
+        # generous busy timeout: HA runs several server processes (or
+        # the in-process chaos harness's several Database instances)
+        # against ONE sqlite file — WAL serializes writers, and a
+        # losing writer must wait, not throw "database is locked"
+        self._conn = sqlite3.connect(
+            self.path, check_same_thread=True, timeout=30.0
+        )
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
@@ -59,15 +66,41 @@ class Database:
             else:
                 loop.call_soon_threadsafe(self._set_result, fut, result)
         self._conn.close()
+        # items that slipped in behind the shutdown sentinel must fail,
+        # not hang their awaiting callers forever
+        self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        """Resolve every still-queued work item with a closed error.
+        Only safe once the worker thread is no longer consuming."""
+        if self._thread.is_alive() and (
+            threading.current_thread() is not self._thread
+        ):
+            return
+        while True:
+            try:
+                item = self._work.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                continue
+            _fn, fut, loop = item
+            try:
+                loop.call_soon_threadsafe(
+                    self._set_exc, fut,
+                    RuntimeError(f"database {self.path!r} is closed"),
+                )
+            except RuntimeError:
+                pass  # caller's loop already gone
 
     @staticmethod
     def _set_result(fut: asyncio.Future, result: Any) -> None:
-        if not fut.cancelled():
+        if not fut.done():
             fut.set_result(result)
 
     @staticmethod
     def _set_exc(fut: asyncio.Future, exc: Exception) -> None:
-        if not fut.cancelled():
+        if not fut.done():
             fut.set_exception(exc)
 
     # ---- dialect-bound SQL fragments ------------------------------------
@@ -92,13 +125,47 @@ class Database:
 
         return sql.json_set(field, col, self.dialect)
 
+    def lease_upsert(self) -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.lease_upsert(self.dialect)
+
+    def lease_upsert_params(
+        self, holder: str, expires: float, now: float
+    ) -> Tuple:
+        from gpustack_tpu.orm import sql
+
+        return sql.lease_upsert_params(
+            holder, expires, now, self.dialect
+        )
+
+    def fence_guard(self) -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.fence_guard(self.dialect)
+
+    def dual_from(self) -> str:
+        from gpustack_tpu.orm import sql
+
+        return sql.dual_from(self.dialect)
+
     # ---- async API ------------------------------------------------------
 
     async def run(self, fn: Callable[[sqlite3.Connection], Any]) -> Any:
         """Run ``fn(conn)`` on the db thread; commit is the fn's concern."""
+        if self.closed:
+            # the writer thread is gone: queueing would await a future
+            # nothing will ever resolve (a stopped HA server's handle)
+            raise RuntimeError(f"database {self.path!r} is closed")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._work.put((fn, fut, loop))
+        if self.closed:
+            # close() raced the put: our item may sit BEHIND the
+            # shutdown sentinel where the worker never looks — make
+            # sure someone resolves it (idempotent: _set_exc/_set_result
+            # both check fut.done())
+            self._fail_pending()
         return await fut
 
     async def execute(
@@ -138,12 +205,19 @@ class Database:
         return box[0]
 
     def close(self) -> None:
+        self.closed = True
         self._work.put(None)
         self._thread.join(timeout=10)
+        # anything enqueued between the flag and the join (TOCTOU with
+        # run()) fails loudly instead of hanging its awaiter
+        self._fail_pending()
 
 
 class _NullFuture:
     def cancelled(self) -> bool:
+        return True
+
+    def done(self) -> bool:
         return True
 
 
@@ -226,6 +300,29 @@ def _migrate_user_table(conn: sqlite3.Connection) -> None:
         "CREATE INDEX IF NOT EXISTS idx_users_username "
         "ON users (username)"
     )
+
+
+@migration(2, "leadership lease row gains a fencing epoch column")
+def _migrate_leadership_epoch(conn: sqlite3.Connection) -> None:
+    # pre-PR-10 HA deployments created ``leadership (id, holder,
+    # expires_at)`` lazily in the coordinator; the fencing layer needs
+    # the monotonic epoch on that row. sqlite_master probe for the same
+    # reason as migration 1: migrations only run against the embedded
+    # sqlite store.
+    row = conn.execute(
+        "SELECT name FROM sqlite_master "
+        "WHERE type='table' AND name='leadership'"
+    ).fetchone()
+    if row is None:
+        return  # fresh DB: the coordinator creates the new shape
+    # column probe via cursor description (PRAGMA table_info would
+    # trip the dialect-conformance statement trace)
+    cur = conn.execute("SELECT * FROM leadership LIMIT 0")
+    cols = {d[0] for d in cur.description}
+    if "epoch" not in cols:
+        conn.execute(
+            "ALTER TABLE leadership ADD COLUMN epoch INTEGER DEFAULT 0"
+        )
 
 
 def run_migrations(db: Database) -> int:

@@ -1,0 +1,83 @@
+"""Epoch write-fencing for HA leader-only writers.
+
+TTL-lease leader election (server/coordinator.py) cannot, by itself,
+stop a deposed-but-not-yet-exited leader from writing: between losing
+the lease and noticing (up to ttl/3 later — or much later if its event
+loop stalled), its controllers keep issuing whole-document writes that
+would clobber the successor's state. The classic fix is a fencing
+token: every lease acquisition bumps a monotonic ``epoch`` on the lease
+row, leader-only tasks stamp their writes with the epoch they acquired,
+and the storage layer rejects any write carrying an epoch older than
+the current lease — atomically, in the same statement as the write, so
+no check-then-act race remains.
+
+The stamp travels via a :class:`contextvars.ContextVar`: the server
+sets it inside the leadership callback, so every task the callback
+starts (scheduler, controllers, rescuer, rollout, autoscaler,
+collectors) inherits it, while request handlers and follower tasks stay
+unfenced (API writes are legitimate on any server). ``Record``'s write
+methods (orm/record.py) read the stamp and compose the guard clause.
+
+Module-level counters/hooks (not per-instance) because a process is one
+server in production; the in-process multi-server chaos harness reads
+them as cluster-wide totals, which is what its invariants want anyway.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Callable, Dict, Optional
+
+# epoch this task's writes are stamped with; None = unfenced
+_fence_epoch: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "gpustack_tpu_fence_epoch", default=None
+)
+
+# lossless audit tap for the chaos harness's no-stale-epoch-write
+# invariant: called for every fenced write attempt with
+# (kind, record_id, write_epoch, lease_epoch_at_statement, landed).
+# ``lease_epoch_at_statement`` is read on the same connection inside the
+# same implicit transaction as the guarded statement, so it is exactly
+# the epoch the guard judged against. May be called from the DB writer
+# thread — handlers must be thread-safe and non-raising.
+audit_hook: Optional[Callable[[str, int, int, int, bool], None]] = None
+
+_lock = threading.Lock()
+# kind -> rejected-write count (gpustack_ha_fenced_writes_total)
+_fenced: Dict[str, int] = {}
+
+
+def set_fence(epoch: int) -> None:
+    """Stamp this context (and every task it spawns) with ``epoch``."""
+    _fence_epoch.set(int(epoch))
+
+
+def clear_fence() -> None:
+    _fence_epoch.set(None)
+
+
+def fence_epoch() -> Optional[int]:
+    return _fence_epoch.get()
+
+
+def record_fenced(kind: str) -> None:
+    """Count one rejected stale-epoch write (called by orm/record.py)."""
+    with _lock:
+        _fenced[kind] = _fenced.get(kind, 0) + 1
+
+
+def fenced_writes() -> Dict[str, int]:
+    with _lock:
+        return dict(_fenced)
+
+
+def fenced_writes_total() -> int:
+    with _lock:
+        return sum(_fenced.values())
+
+
+def reset_counters() -> None:
+    """Test helper: isolate per-test fenced-write assertions."""
+    with _lock:
+        _fenced.clear()

@@ -8,6 +8,7 @@ column plus extracted index columns (see orm/__init__ docstring).
 
 from __future__ import annotations
 
+import contextvars
 import datetime
 import json
 import logging
@@ -25,8 +26,40 @@ from typing import (
 
 import pydantic
 
+from gpustack_tpu.orm import fencing
 from gpustack_tpu.orm.db import Database
 from gpustack_tpu.server.bus import Event, EventBus, EventType
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency (CAS) failure: the row changed since this
+    snapshot was read. Callers re-fetch and retry (``Record.update``
+    does so itself, bounded); the crud route surfaces it as 409."""
+
+    def __init__(self, kind: str, id: int, detail: str = ""):
+        self.kind = kind
+        self.id = id
+        super().__init__(
+            f"{kind} id={id} changed concurrently"
+            + (f": {detail}" if detail else "")
+        )
+
+
+class StaleEpochError(Exception):
+    """Write fenced: it carried a leadership epoch older than the
+    current lease — this process was deposed as leader mid-write. The
+    write did NOT land. Leader-only loops treat it like any other
+    per-iteration failure (the fatal path is already in flight)."""
+
+    def __init__(self, kind: str, id: int, epoch: int, lease_epoch: int):
+        self.kind = kind
+        self.id = id
+        self.epoch = epoch
+        self.lease_epoch = lease_epoch
+        super().__init__(
+            f"{kind} id={id} write fenced: epoch {epoch} < "
+            f"current lease epoch {lease_epoch}"
+        )
 
 # Per-dialect autoincrement primary key — the single DDL divergence
 # across the backends the reference supports (its alembic migrations
@@ -74,10 +107,29 @@ class Record(pydantic.BaseModel):
     created_at: str = ""
     updated_at: str = ""
 
+    # CAS basis: ``updated_at`` as this snapshot was LOADED (set by
+    # _from_row/create/save/refresh) — deliberately distinct from the
+    # field, which a caller may legitimately rewrite (backdating a
+    # timestamp must not defeat, or falsely trip, the concurrency
+    # guard). None = never loaded → unconditional write.
+    _cas_basis: Optional[str] = pydantic.PrivateAttr(default=None)
+
     # ---- binding --------------------------------------------------------
+    # Process-global by default (one server per process in production).
+    # The in-process multi-server chaos harness boots N Servers in ONE
+    # process sharing one DB file — each server's task tree (and, via
+    # an app middleware, each request handler) additionally carries a
+    # context-local binding so server A's controllers publish to A's
+    # bus, not whichever server bound last. ``bind`` keeps its global
+    # last-wins semantics untouched; ``bind_context`` is the opt-in
+    # context layer.
 
     _db: ClassVar[Optional[Database]] = None
     _bus: ClassVar[Optional[EventBus]] = None
+
+    _binding_ctx: ClassVar[
+        "contextvars.ContextVar[Optional[Tuple[Database, EventBus]]]"
+    ] = contextvars.ContextVar("record_binding", default=None)
 
     @classmethod
     def bind(cls, db: Database, bus: EventBus) -> None:
@@ -86,14 +138,30 @@ class Record(pydantic.BaseModel):
         Record._bus = bus
 
     @classmethod
+    def bind_context(cls, db: Database, bus: EventBus) -> None:
+        """Bind for THIS context and every task it spawns (HA servers
+        sharing a process). Falls back to the global binding wherever
+        unset."""
+        Record._binding_ctx.set((db, bus))
+
+    @classmethod
+    def _binding(cls) -> Tuple[Optional[Database], Optional[EventBus]]:
+        ctx = Record._binding_ctx.get()
+        if ctx is not None:
+            return ctx
+        return Record._db, Record._bus
+
+    @classmethod
     def db(cls) -> Database:
-        assert Record._db is not None, "Record.bind() not called"
-        return Record._db
+        db, _bus = cls._binding()
+        assert db is not None, "Record.bind() not called"
+        return db
 
     @classmethod
     def bus(cls) -> EventBus:
-        assert Record._bus is not None, "Record.bind() not called"
-        return Record._bus
+        _db, bus = cls._binding()
+        assert bus is not None, "Record.bind() not called"
+        return bus
 
     # ---- schema ---------------------------------------------------------
     # The autoincrement primary key is the ONE piece of DDL that differs
@@ -144,30 +212,109 @@ class Record(pydantic.BaseModel):
     def _from_row(cls: Type[T], row) -> T:
         obj = cls.model_validate_json(row["data"])
         obj.id = row["id"]
+        obj._cas_basis = obj.updated_at
         return obj
 
     # ---- CRUD -----------------------------------------------------------
+
+    # ---- fencing plumbing (orm/fencing.py) -----------------------------
+    # When the calling context carries a leadership epoch, every write
+    # statement appends the fence-guard clause so a deposed leader's
+    # write rejects ATOMICALLY; the epoch check and the write are one
+    # statement, leaving no check-then-act window. The helpers below run
+    # on the DB thread, inside the statement's implicit transaction, so
+    # the lease epoch they read is exactly what the guard judged.
+
+    @staticmethod
+    def _lease_epoch(conn) -> int:
+        row = conn.execute(
+            "SELECT epoch FROM leadership WHERE id = 1"
+        ).fetchone()
+        if row is None:
+            return 0
+        return int(row["epoch"] or 0)
+
+    @classmethod
+    def _audit_fenced(
+        cls, conn, record_id: int, epoch: int, landed: bool
+    ) -> int:
+        """Report one fenced-write attempt to the audit tap; returns
+        the lease epoch observed in this transaction. Callers skip this
+        (and its SELECT) for landed writes when no audit tap is set —
+        the lease epoch is only NEEDED to classify a rejected write."""
+        lease = cls._lease_epoch(conn)
+        hook = fencing.audit_hook
+        if hook is not None:
+            try:
+                hook(cls.__kind__, record_id, epoch, lease, landed)
+            except Exception:  # noqa: BLE001 — taps never break writes
+                logger.exception("fencing audit hook failed")
+        return lease
+
+    @classmethod
+    def _guarded_execute(cls, conn, sql, params, epoch, record_id):
+        """Execute one (possibly fence-guarded) write on the DB
+        thread; returns (cursor, landed, lease_epoch). One home for
+        the guard protocol create/save/set_field/delete share — the
+        lease epoch is read (same transaction) only when NEEDED: the
+        write was rejected, or the lossless audit tap is attached."""
+        cur = conn.execute(sql, params)
+        landed = cur.rowcount != 0
+        lease = 0
+        if epoch is not None and (
+            not landed or fencing.audit_hook is not None
+        ):
+            lease = cls._audit_fenced(conn, record_id, epoch, landed)
+        return cur, landed, lease
+
+    @classmethod
+    def _raise_fenced(cls, record_id, epoch, lease):
+        fencing.record_fenced(cls.__kind__)
+        raise StaleEpochError(cls.__kind__, record_id, epoch, lease)
 
     @classmethod
     async def create(cls: Type[T], obj: T) -> T:
         obj.created_at = obj.created_at or _now()
         obj.updated_at = _now()
         idx_cols = "".join(f", {f}" for f in cls.__indexes__)
-        idx_q = ", ?" * len(cls.__indexes__)
         data = obj.model_dump_json(exclude={"id"})
         params = [data, obj.created_at, obj.updated_at] + obj._index_values()
-
-        def go(conn):
-            cur = conn.execute(
+        epoch = fencing.fence_epoch()
+        db = cls.db()
+        if epoch is None:
+            idx_q = ", ?" * len(cls.__indexes__)
+            sql = (
                 f"INSERT INTO {cls.__kind__} "
                 f"(data, created_at, updated_at{idx_cols}) "
-                f"VALUES (?, ?, ?{idx_q})",
-                params,
+                f"VALUES (?, ?, ?{idx_q})"
             )
-            conn.commit()
-            return cur.lastrowid
+        else:
+            # guarded insert: INSERT ... SELECT so the fence clause can
+            # gate row production itself (VALUES admits no WHERE)
+            marks = ", ".join(["?"] * (3 + len(cls.__indexes__)))
+            sql = (
+                f"INSERT INTO {cls.__kind__} "
+                f"(data, created_at, updated_at{idx_cols}) "
+                f"SELECT {marks}{db.dual_from()} "
+                f"WHERE {db.fence_guard()}"
+            )
+            params = params + [epoch]
 
-        obj.id = await cls.db().run(go)
+        def go(conn):
+            cur, landed, lease = cls._guarded_execute(
+                conn, sql, params, epoch, 0
+            )
+            rowid = cur.lastrowid
+            conn.commit()
+            if not landed:
+                return ("fenced", lease)
+            return ("ok", rowid)
+
+        outcome, value = await db.run(go)
+        if outcome == "fenced":
+            cls._raise_fenced(0, epoch, value)
+        obj.id = value
+        obj._cas_basis = obj.updated_at
         cls.bus().publish(
             Event(
                 kind=cls.__kind__,
@@ -282,6 +429,7 @@ class Record(pydantic.BaseModel):
         if fresh is not None:
             for f in type(self).model_fields:
                 setattr(self, f, getattr(fresh, f))
+            self._cas_basis = fresh._cas_basis
         return fresh
 
     @classmethod
@@ -291,48 +439,109 @@ class Record(pydantic.BaseModel):
         stale in-memory snapshot can never revert concurrent writers'
         other fields — for hot-path server-internal markers (e.g. the
         autoscaler wake marker) written without a re-fetch/409 dance.
-        Deliberately bypasses the event bus (no watch event, no
-        updated_at bump); index columns may not be written this way.
-        Returns the affected row count."""
+        Deliberately bypasses the event bus (no watch event) but DOES
+        bump ``updated_at``: the CAS guard on whole-document saves
+        keys on it, and an invisible set_field would let a concurrent
+        save's CAS pass and silently revert this very write — the
+        hazard set_field exists to avoid, mirrored. Index columns may
+        not be written this way. Returns the affected row count."""
         if field in cls.__indexes__:
             raise ValueError(
                 f"{field!r} is an index column; use update()"
             )
-        setter = cls.db().json_set(field)
+        db = cls.db()
+        # nested writer: the target field, then the document's own
+        # updated_at (kept in lockstep with the SQL column) — bind
+        # order is textual: inner value first, then the timestamp
+        setter = db.json_set("updated_at", col=db.json_set(field))
         # bind JSON text: every dialect spelling parses it, so numbers
         # stay JSON numbers on sqlite/postgres/mysql alike
         encoded = json.dumps(_jsonable(value))
+        now = _now()
+        epoch = fencing.fence_epoch()
+        sql = (
+            f"UPDATE {cls.__kind__} SET data = {setter}, "
+            "updated_at = ? WHERE id = ?"
+        )
+        params: List[Any] = [encoded, json.dumps(now), now, id]
+        if epoch is not None:
+            sql += f" AND {db.fence_guard()}"
+            params.append(epoch)
 
         def go(conn):
-            cur = conn.execute(
-                f"UPDATE {cls.__kind__} SET data = {setter} "
-                "WHERE id = ?",
-                (encoded, id),
+            cur, landed, lease = cls._guarded_execute(
+                conn, sql, params, epoch, id
             )
             conn.commit()
-            return cur.rowcount
+            if not landed and epoch is not None and lease > epoch:
+                return ("fenced", lease)
+            return ("ok", cur.rowcount)
 
-        return await cls.db().run(go)
+        outcome, count = await db.run(go)
+        if outcome == "fenced":
+            cls._raise_fenced(id, epoch, count)
+        return count
 
-    async def update(self: T, **fields: Any) -> T:
+    async def update(
+        self: T, _retries: int = 3, **fields: Any
+    ) -> T:
         """Apply field updates, persist, publish UPDATED with a
-        changed-field diff (old, new) — reference active_record.py:46-74."""
-        changes: Dict[str, Any] = {}
-        for k, v in fields.items():
-            old = getattr(self, k)
-            if old != v:
-                old_j = old.value if hasattr(old, "value") else old
-                new_j = v.value if hasattr(v, "value") else v
-                changes[k] = (_jsonable(old_j), _jsonable(new_j))
-            setattr(self, k, v)
-        if not changes:
-            return self
-        await self.save(changes=changes)
-        return self
+        changed-field diff (old, new) — reference active_record.py:46-74.
+
+        Persistence is CAS-guarded (see :meth:`save`); on
+        :class:`ConflictError` the row is re-fetched and the SAME field
+        updates re-applied, up to ``_retries`` times, so convergence
+        loops keep their fire-and-forget ergonomics while a concurrent
+        writer's OTHER fields can never be silently reverted by this
+        stale snapshot (the pre-CAS lost-update window). ``_retries=0``
+        surfaces the conflict to the caller (the crud route's 409
+        path)."""
+        attempt = 0
+        while True:
+            changes: Dict[str, Any] = {}
+            for k, v in fields.items():
+                old = getattr(self, k)
+                if old != v:
+                    old_j = old.value if hasattr(old, "value") else old
+                    new_j = v.value if hasattr(v, "value") else v
+                    changes[k] = (_jsonable(old_j), _jsonable(new_j))
+                setattr(self, k, v)
+            if not changes:
+                return self
+            try:
+                await self.save(changes=changes)
+                return self
+            except ConflictError:
+                if attempt >= _retries:
+                    raise
+                attempt += 1
+                fresh = await type(self).get(self.id)
+                if fresh is None:
+                    raise KeyError(
+                        f"{type(self).__kind__} id={self.id} "
+                        "does not exist"
+                    )
+                for f in type(self).model_fields:
+                    setattr(self, f, getattr(fresh, f))
+                self._cas_basis = fresh._cas_basis
 
     async def save(self: T, changes: Optional[Dict[str, Any]] = None) -> T:
+        """Persist the whole document with optimistic concurrency: the
+        UPDATE is conditioned on ``updated_at`` still matching the value
+        this snapshot was loaded with (rowcount 0 → typed
+        :class:`ConflictError`; callers re-fetch and retry bounded —
+        :meth:`update` does it for them). This closes the residual
+        lost-update windows the per-site re-fetch guards (crud 409
+        path, autoscaler, rollout ``_record``) each narrowed but could
+        not eliminate: the guard and the write are one statement.
+        Fenced contexts additionally carry the leadership-epoch guard
+        (see orm/fencing.py)."""
+        expected = self._cas_basis
+        prior_field = self.updated_at
         self.updated_at = _now()
         cls = type(self)
+        epoch = fencing.fence_epoch()
+        db = cls.db()
         idx_sets = "".join(f", {f} = ?" for f in cls.__indexes__)
         data = self.model_dump_json(exclude={"id"})
         # created_at is both a document field and a real SQL column (range
@@ -342,19 +551,55 @@ class Record(pydantic.BaseModel):
             + self._index_values()
             + [self.id]
         )
+        where = "WHERE id = ?"
+        if expected:
+            # CAS on the loaded snapshot; a legacy row saved without
+            # ever being loaded (empty updated_at) falls back to the
+            # unconditional write
+            where += " AND updated_at = ?"
+            params = params + [expected]
+        if epoch is not None:
+            where += f" AND {db.fence_guard()}"
+            params = params + [epoch]
 
         def go(conn):
-            cur = conn.execute(
+            cur, landed, lease = cls._guarded_execute(
+                conn,
                 f"UPDATE {cls.__kind__} SET data = ?, updated_at = ?, "
-                f"created_at = ?{idx_sets} WHERE id = ?",
-                params,
+                f"created_at = ?{idx_sets} {where}",
+                params, epoch, self.id,
             )
+            if landed:
+                conn.commit()
+                return ("ok", cur.rowcount)
+            if epoch is not None and lease > epoch:
+                conn.commit()
+                return ("fenced", lease)
+            row = conn.execute(
+                f"SELECT updated_at FROM {cls.__kind__} WHERE id = ?",
+                (self.id,),
+            ).fetchone()
             conn.commit()
-            return cur.rowcount
+            if row is None:
+                return ("missing", None)
+            return ("conflict", row["updated_at"])
 
-        count = await cls.db().run(go)
-        if count == 0:
+        outcome, value = await db.run(go)
+        if outcome == "ok":
+            self._cas_basis = self.updated_at
+        else:
+            # the write did not land: restore the field so a caller
+            # retry sees the object exactly as before the attempt
+            self.updated_at = prior_field
+        if outcome == "fenced":
+            type(self)._raise_fenced(self.id, epoch, value)
+        if outcome == "missing":
             raise KeyError(f"{cls.__kind__} id={self.id} does not exist")
+        if outcome == "conflict":
+            raise ConflictError(
+                cls.__kind__, self.id,
+                f"updated_at moved {expected!r} -> {value!r}",
+            )
         cls.bus().publish(
             Event(
                 kind=cls.__kind__,
@@ -368,15 +613,26 @@ class Record(pydantic.BaseModel):
 
     async def delete(self) -> None:
         cls = type(self)
+        epoch = fencing.fence_epoch()
+        db = cls.db()
+        sql = f"DELETE FROM {cls.__kind__} WHERE id = ?"
+        params: List[Any] = [self.id]
+        if epoch is not None:
+            sql += f" AND {db.fence_guard()}"
+            params.append(epoch)
 
         def go(conn):
-            cur = conn.execute(
-                f"DELETE FROM {cls.__kind__} WHERE id = ?", (self.id,)
+            cur, landed, lease = cls._guarded_execute(
+                conn, sql, params, epoch, self.id
             )
             conn.commit()
-            return cur.rowcount
+            if not landed and epoch is not None and lease > epoch:
+                return ("fenced", lease)
+            return ("ok", cur.rowcount)
 
-        count = await cls.db().run(go)
+        outcome, count = await db.run(go)
+        if outcome == "fenced":
+            cls._raise_fenced(self.id, epoch, count)
         if count:
             cls.bus().publish(
                 Event(

@@ -40,6 +40,75 @@ _JSON_SET = {
     "mysql": "JSON_SET({col}, '$.{field}', CAST(? AS JSON))",
 }
 
+# HA lease election (server/coordinator.py): atomic conditional upsert
+# that steals ONLY an expired lease and bumps the monotonic fencing
+# ``epoch`` on every acquisition (never on renewal). sqlite/postgres
+# share the ON CONFLICT .. DO UPDATE .. WHERE spelling; mysql has no
+# conditional upsert WHERE, so each assignment re-checks expiry with
+# IF() — ``expires_at`` is assigned LAST so the earlier assignments
+# still read the pre-update value (mysql evaluates left-to-right).
+# Bind parameters differ per dialect; compose them with
+# :func:`lease_upsert_params`, never by hand.
+_LEASE_UPSERT = {
+    "sqlite": (
+        "INSERT INTO leadership (id, holder, expires_at, epoch) "
+        "VALUES (1, ?, ?, 1) "
+        "ON CONFLICT(id) DO UPDATE SET "
+        "holder = excluded.holder, "
+        "expires_at = excluded.expires_at, "
+        "epoch = leadership.epoch + 1 "
+        "WHERE leadership.expires_at < ?"
+    ),
+    "postgres": (
+        "INSERT INTO leadership (id, holder, expires_at, epoch) "
+        "VALUES (1, ?, ?, 1) "
+        "ON CONFLICT(id) DO UPDATE SET "
+        "holder = excluded.holder, "
+        "expires_at = excluded.expires_at, "
+        "epoch = leadership.epoch + 1 "
+        "WHERE leadership.expires_at < ?"
+    ),
+    "mysql": (
+        "INSERT INTO leadership (id, holder, expires_at, epoch) "
+        "VALUES (1, ?, ?, 1) "
+        "ON DUPLICATE KEY UPDATE "
+        "epoch = IF(expires_at < ?, epoch + 1, epoch), "
+        "holder = IF(expires_at < ?, VALUES(holder), holder), "
+        "expires_at = IF(expires_at < ?, VALUES(expires_at), expires_at)"
+    ),
+}
+
+# bind order per spelling (names resolved by lease_upsert_params)
+_LEASE_UPSERT_PARAMS = {
+    "sqlite": ("holder", "expires", "now"),
+    "postgres": ("holder", "expires", "now"),
+    "mysql": ("holder", "expires", "now", "now", "now"),
+}
+
+# Fencing guard (orm/fencing.py): appended to a leader-stamped write's
+# WHERE so a write carrying an epoch older than the current lease
+# rejects ATOMICALLY in the same statement. One bind: the writer's
+# epoch. The spelling is already dialect-generic (plain NOT EXISTS
+# subquery) — kept here anyway so every HA SQL fragment has one home.
+_FENCE_GUARD = {
+    "sqlite": (
+        "NOT EXISTS (SELECT 1 FROM leadership "
+        "WHERE id = 1 AND epoch > ?)"
+    ),
+    "postgres": (
+        "NOT EXISTS (SELECT 1 FROM leadership "
+        "WHERE id = 1 AND epoch > ?)"
+    ),
+    "mysql": (
+        "NOT EXISTS (SELECT 1 FROM leadership "
+        "WHERE id = 1 AND epoch > ?)"
+    ),
+}
+
+# a SELECT without a table reference may not carry WHERE on mysql —
+# guarded INSERT ... SELECT needs FROM DUAL there (8.0.19+ spelling)
+_DUAL_FROM = {"sqlite": "", "postgres": "", "mysql": " FROM DUAL"}
+
 DIALECTS = tuple(_JSON_NUM)
 
 
@@ -56,3 +125,26 @@ def json_text(field: str, col: str = "data", dialect: str = "sqlite") -> str:
 def json_set(field: str, col: str = "data", dialect: str = "sqlite") -> str:
     """Single-field JSON document writer; binds one ``?`` (the value)."""
     return _JSON_SET[dialect].format(col=col, field=field)
+
+
+def lease_upsert(dialect: str = "sqlite") -> str:
+    """Conditional lease-steal upsert with fencing-epoch bump."""
+    return _LEASE_UPSERT[dialect]
+
+
+def lease_upsert_params(
+    holder: str, expires: float, now: float, dialect: str = "sqlite"
+) -> tuple:
+    """Bind tuple matching :func:`lease_upsert`'s per-dialect order."""
+    values = {"holder": holder, "expires": expires, "now": now}
+    return tuple(values[name] for name in _LEASE_UPSERT_PARAMS[dialect])
+
+
+def fence_guard(dialect: str = "sqlite") -> str:
+    """Stale-epoch rejection clause; binds one ``?`` (writer's epoch)."""
+    return _FENCE_GUARD[dialect]
+
+
+def dual_from(dialect: str = "sqlite") -> str:
+    """Table-less SELECT filler for guarded INSERT ... SELECT."""
+    return _DUAL_FROM[dialect]

@@ -275,36 +275,46 @@ def add_crud_routes(
                     validated = cls.model_validate(merged)
                 except pydantic.ValidationError as e:
                     return json_error(400, str(e))
-        # re-fetch before the write: Record.update persists the WHOLE
-        # document, and the hook awaited (queries, revision archives)
-        # since `obj` was read — background writers (rollback restore,
-        # autoscaler) may have advanced the row, and persisting the
-        # stale snapshot would silently revert their fields along with
-        # this request's change
-        fresh = await cls.get(obj.id)
-        if fresh is None:
-            return json_error(404, f"{path} not found")
-        # ...but only fields whose CURRENT value still matches the
+        # CAS write loop: Record.update persists the WHOLE document and
+        # the hook awaited (queries, revision archives) since `obj` was
+        # read. Only fields whose CURRENT value still matches the
         # snapshot the hook validated against may be written: e.g. the
         # instance transition hook judged old-state -> new-state legal
         # on `obj` — if the rescuer parked the row UNREACHABLE during
-        # the hook's awaits, writing the approved state would persist
-        # a transition nobody validated. An honest 409 lets the caller
-        # re-read and re-decide.
-        conflicts = sorted(
-            k for k in fields
-            if getattr(fresh, k) != getattr(obj, k)
-        )
-        if conflicts:
-            return json_error(
-                409,
-                f"{path} field(s) {', '.join(conflicts)} changed "
-                "concurrently; retry",
+        # the hook's awaits, writing the approved state would persist a
+        # transition nobody validated. An honest 409 lets the caller
+        # re-read and re-decide. The write itself is CAS-guarded
+        # (orm/record.py), so the old fetch→write gap is GONE: an
+        # unrelated field moving in that instant surfaces as
+        # ConflictError and we simply re-read and retry, while a
+        # validated-field conflict keeps its per-field 409.
+        from gpustack_tpu.orm.record import ConflictError
+
+        for _attempt in range(3):
+            fresh = await cls.get(obj.id)
+            if fresh is None:
+                return json_error(404, f"{path} not found")
+            conflicts = sorted(
+                k for k in fields
+                if getattr(fresh, k) != getattr(obj, k)
             )
-        await fresh.update(
-            **{k: getattr(validated, k) for k in fields}
+            if conflicts:
+                return json_error(
+                    409,
+                    f"{path} field(s) {', '.join(conflicts)} changed "
+                    "concurrently; retry",
+                )
+            try:
+                await fresh.update(
+                    _retries=0,
+                    **{k: getattr(validated, k) for k in fields},
+                )
+            except ConflictError:
+                continue
+            return web.json_response(dump(fresh))
+        return json_error(
+            409, f"{path} changed concurrently; retry"
         )
-        return web.json_response(dump(fresh))
 
     async def delete(request: web.Request):
         if err := check_write(request, None, None):

@@ -40,12 +40,31 @@ from gpustack_tpu.schemas.usage import ModelUsage
 logger = logging.getLogger(__name__)
 
 
+@web.middleware
+async def record_binding_middleware(request: web.Request, handler):
+    """Pin this request's ORM binding to the owning server's db/bus.
+
+    A no-op for the common one-server-per-process case; with several
+    in-process HA servers (chaos harness) it guarantees a handler
+    writes through — and publishes onto — the server that actually
+    received the request, not whichever server bound last."""
+    binding = request.app.get("record_binding")
+    if binding is not None:
+        from gpustack_tpu.orm.record import Record
+
+        Record.bind_context(*binding)
+    return await handler(request)
+
+
 def create_app(cfg: Config) -> web.Application:
     # timing (the trace edge) is OUTERMOST so auth latency and auth
     # failures are traced and every response — 401s included — carries
-    # X-Request-ID
+    # X-Request-ID; the binding middleware sits outside even that so
+    # auth's own DB reads resolve against the right server
     app = web.Application(
-        middlewares=[timing_middleware, auth_middleware],
+        middlewares=[
+            record_binding_middleware, timing_middleware, auth_middleware,
+        ],
         client_max_size=64 * 2**20,
     )
     app["config"] = cfg
@@ -63,6 +82,7 @@ def create_app(cfg: Config) -> web.Application:
         coordinator = app.get("coordinator")
         if coordinator is not None:
             payload["leader"] = coordinator.is_leader
+            payload["ha_epoch"] = getattr(coordinator, "epoch", 0)
         # A dead embedded worker means this node can't serve anything —
         # surface it here instead of leaving the worker row silently
         # not_ready (the round-3 failure mode).

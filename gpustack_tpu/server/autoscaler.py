@@ -63,7 +63,7 @@ from gpustack_tpu.schemas import (
     WorkerState,
 )
 from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
-from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.server.collectors import DirtyTrackedTask
 from gpustack_tpu.utils.profiling import timed
 
 logger = logging.getLogger(__name__)
@@ -115,7 +115,8 @@ class _ModelState:
     target: int = -1
 
 
-class Autoscaler(PeriodicTask):
+class Autoscaler(DirtyTrackedTask):
+    dirty_kinds = ("model", "model_instance", "rollout")
     task_name = "autoscaler"
 
     def __init__(self, app, cfg: Config, signals=None):
@@ -133,6 +134,15 @@ class Autoscaler(PeriodicTask):
             label_names=("model", "action"),
         )
         self.ticks = 0
+        # dirty-set skip (DirtyTrackedTask): with NO autoscale-enabled
+        # model and nothing dirty since the last pass, the tick skips
+        # its Model/Instance/Rollout scans entirely; with autoscale
+        # models present, the Model list is still read every tick (the
+        # durable wake marker is a set_field write and deliberately
+        # publishes no bus event) but the big instance/rollout scans
+        # reuse the cached snapshot while nothing is dirty
+        self._no_autoscale = False
+        self._inst_cache = None
 
     async def tick(self) -> None:
         await self.scale_once()
@@ -155,18 +165,37 @@ class Autoscaler(PeriodicTask):
         Returns the decisions applied this pass."""
         now = time.time() if now is None else now
         self.ticks += 1
-        models = await Model.filter(limit=None)
-        scaled = [m for m in models if m.autoscale_max > 0]
-        if not scaled:
-            self._state.clear()
-            # demand notes for non-autoscaled models must not pool
-            self._wake.clear()
+        changed = self._drain_dirty()
+        if not changed and self._no_autoscale and not self._wake:
+            # steady-state no-op: no model opted into autoscaling
+            # last pass and nothing was written since — zero
+            # Model/Instance list queries this tick
+            self.skipped_ticks += 1
             return []
-        instances = await ModelInstance.filter(limit=None)
+        try:
+            models = await Model.filter(limit=None)
+            scaled = [m for m in models if m.autoscale_max > 0]
+            self._no_autoscale = not scaled
+            if not scaled:
+                self._state.clear()
+                # demand notes for non-autoscaled models must not pool
+                self._wake.clear()
+                return []
+            if changed or self._inst_cache is None:
+                instances = await ModelInstance.filter(limit=None)
+                rollouts = await Rollout.filter(limit=None)
+                self._inst_cache = (instances, rollouts)
+        except Exception:
+            # the drained dirtiness was consumed but nothing acted on
+            # it — re-arm or the next tick could skip pending work
+            self._rearm_dirty()
+            raise
+        # on a clean pass the cached snapshot is exact (any write —
+        # ours included — re-arms a fresh read above)
+        instances, rollouts = self._inst_cache
         by_model: Dict[int, List[ModelInstance]] = {}
         for inst in instances:
             by_model.setdefault(inst.model_id, []).append(inst)
-        rollouts = await Rollout.filter(limit=None)
         mid_rollout = {
             r.model_id for r in rollouts
             if r.state in ACTIVE_ROLLOUT_STATES
@@ -406,17 +435,26 @@ class Autoscaler(PeriodicTask):
             # phantom divergence on the target-vs-instances panel
             st.last_action = st.last_action or ""
             return None
-        # re-fetch right before writing: Record.update persists the
-        # WHOLE document, and this pass awaited worker scrapes since
-        # `model` was read — writing the stale object would silently
-        # revert a concurrent operator update (spec, generation, …)
+        # fresh read for the decision basis, CAS for the write: this
+        # pass awaited worker scrapes since `model` was read, and the
+        # decision above assumed `model.replicas`. The pre-CAS version
+        # re-fetched AND hoped nothing moved before its write; now the
+        # write itself is guarded (Record.save, PR 10) with retries
+        # OFF — any concurrent move (operator PATCH, rollout restore,
+        # an HA peer) surfaces as ConflictError and this model simply
+        # re-decides next tick on fresh state.
+        from gpustack_tpu.orm.record import ConflictError
+
         fresh = await Model.get(model.id)
         if fresh is None or fresh.replicas != model.replicas:
             # compare the RAW snapshot, not the 0-clamped `current`: a
             # (client-writable) negative replica count would otherwise
             # mismatch forever and silently wedge bounds/wake
             return None  # changed under us; re-decide next tick
-        await fresh.update(replicas=target)
+        try:
+            await fresh.update(_retries=0, replicas=target)
+        except ConflictError:
+            return None  # changed under us; re-decide next tick
         # exported target tracks WRITES only — set after the
         # changed-under-us guard, or a skipped write would still
         # report the unapplied target on /metrics

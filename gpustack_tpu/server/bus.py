@@ -48,6 +48,11 @@ class Event:
     data: Optional[Dict[str, Any]] = None
     changes: Optional[Dict[str, Any]] = None   # field -> (old, new)
     ts: float = dataclasses.field(default_factory=time.time)
+    # True for events an HA coordinator re-published from a PEER's
+    # change-log entry: consumers treat them like local events, but
+    # per-write auditors (the chaos transition observer) skip them so
+    # each write is judged exactly once cluster-wide, at its origin
+    remote: bool = False
 
     def to_wire(self) -> Dict[str, Any]:
         return {
@@ -166,6 +171,49 @@ class Subscriber:
 
     def close(self) -> None:
         self._bus._subscribers.discard(self)
+
+
+class DirtySet:
+    """Synchronous bus tap accumulating changed record ids per kind.
+
+    Reconcile loops that full-scan tables every tick (rollout,
+    autoscaler) drain this instead: an empty drain on a steady-state
+    pass means NOTHING they watch changed since the last tick, so the
+    cached snapshot from that tick is still exact and the scan can be
+    skipped. Conservative by construction: a RESYNC marker (subscriber
+    overflow, HA re-list) reads as everything-dirty. Taps are lossless
+    (no coalescing), so a single write can never slip through."""
+
+    def __init__(self, bus: "EventBus", kinds: Set[str]):
+        self._bus = bus
+        self.kinds = set(kinds)
+        self._dirty: Dict[str, Set[int]] = {}
+        self._all = False
+        bus.add_tap(self._tap)
+
+    def _tap(self, event: "Event") -> None:
+        if event.type == EventType.RESYNC:
+            self._all = True
+            return
+        if event.kind in self.kinds and event.type in (
+            EventType.CREATED, EventType.UPDATED, EventType.DELETED
+        ):
+            self._dirty.setdefault(event.kind, set()).add(event.id)
+
+    def drain(self) -> Tuple[bool, Dict[str, Set[int]]]:
+        """(everything_dirty, {kind: ids}) since the last drain."""
+        dirty, self._dirty = self._dirty, {}
+        all_, self._all = self._all, False
+        return all_, dirty
+
+    def mark_all(self) -> None:
+        """Re-arm after a FAILED pass: the drained events were consumed
+        but never acted on — without this, the next tick would read an
+        empty set and skip work that is still pending."""
+        self._all = True
+
+    def close(self) -> None:
+        self._bus.remove_tap(self._tap)
 
 
 class EventBus:

@@ -71,6 +71,58 @@ class PeriodicTask(BackgroundTask):
             await asyncio.sleep(self.interval)
 
 
+class DirtyTrackedTask(PeriodicTask):
+    """PeriodicTask with a bus-tap dirty-set (server/bus.py DirtySet)
+    so steady-state no-op ticks can skip their table scans.
+
+    One home for the lifecycle the rollout controller and autoscaler
+    share: lazy attach at start() from the bound Record bus (unbound
+    unit-test mounts simply scan every tick), detach at stop(), a
+    drain at tick start, and an exception-path re-arm so drained-but-
+    unacted events can never shelve pending work behind the skip."""
+
+    #: record kinds whose writes invalidate the cached snapshot
+    dirty_kinds: Tuple[str, ...] = ()
+
+    def __init__(self, interval: float):
+        super().__init__(interval)
+        self._dirty = None
+        self.skipped_ticks = 0
+
+    def attach_dirty(self, bus) -> None:
+        from gpustack_tpu.server.bus import DirtySet
+
+        self._dirty = DirtySet(bus, set(self.dirty_kinds))
+
+    def start(self) -> None:
+        if self._dirty is None:
+            try:
+                self.attach_dirty(Record.bus())
+            except AssertionError:
+                pass
+        super().start()
+
+    def stop(self) -> None:
+        if self._dirty is not None:
+            self._dirty.close()
+            self._dirty = None
+        super().stop()
+
+    def _drain_dirty(self) -> bool:
+        """True when anything watched changed since the last drain —
+        or when no dirty-set is attached (always scan then)."""
+        if self._dirty is None:
+            return True
+        dirty_all, dirty = self._dirty.drain()
+        return dirty_all or any(dirty.values())
+
+    def _rearm_dirty(self) -> None:
+        """A pass failed AFTER draining: the consumed events were
+        never acted on — mark everything dirty so the next tick runs."""
+        if self._dirty is not None:
+            self._dirty.mark_all()
+
+
 class WorkerStatusBuffer(PeriodicTask):
     task_name = "status-buffer"
 

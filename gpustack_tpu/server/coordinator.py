@@ -1,4 +1,4 @@
-"""HA coordinator: leader election + cross-instance event propagation.
+"""HA coordinator: epoch-fenced leader election + change-log propagation.
 
 Reference parity (gpustack/server/coordinator/base.py:94 Coordinator ABC;
 local.py:17 LocalCoordinator; distributed impls ship as plugins,
@@ -6,27 +6,89 @@ server/server.py:1166-1194; lost leadership exits the process,
 server/server.py:1296-1304).
 
 Single-server deployments use LocalCoordinator (always leader, in-process
-bus only). A distributed coordinator implements acquire/renew over a
-shared store (Postgres advisory locks, Redis leases) and republishes bus
-events across instances; leader-only tasks (scheduler, controllers)
-start/stop on leadership transitions.
+bus only). LeaseCoordinator implements multi-server HA over the shared
+DB with three mechanisms:
+
+- **TTL-lease election with fencing epochs**: one ``leadership`` row
+  holds (holder, expires_at, epoch); the conditional upsert
+  (orm/sql.py ``lease_upsert`` — per-dialect spellings) steals only an
+  expired lease and bumps the monotonic ``epoch`` on every acquisition.
+  Leader-only writers stamp their writes with the acquired epoch
+  (orm/fencing.py), so a deposed-but-not-yet-exited leader's queued
+  write rejects atomically instead of clobbering its successor's state.
+- **Injectable fatal path**: losing a held lease is fatal (reference
+  semantics — leader-only tasks must never split-brain); the default
+  ``os._exit(1)`` is an injectable ``fatal_hook`` so the in-process
+  chaos harness can assert the fatal path without dying with it.
+- **Change-log propagation**: every server appends its post-commit bus
+  events to a shared ``change_log`` table (id-only); every server tails
+  the others' entries each replication cycle and re-fetches the touched
+  rows, republishing full events on its local bus. Follower watch
+  fan-out stays O(events) instead of the old RESYNC-every-TTL/3 forced
+  re-list (O(tables) at scale), and the leader finally *hears* writes
+  that landed through a follower's API.
+
+Election observability: ``election_tap_hook`` (module-level, harness
+style like worker_request.rpc_fault_hook) receives every
+acquired/renewed/lost/released event losslessly — the chaos harness
+builds its at-most-one-leader invariant from it.
 """
 
 from __future__ import annotations
 
 import abc
 import asyncio
+import json
 import logging
 import os
-from typing import Awaitable, Callable, List, Optional
+import time
+from collections import deque
+from typing import Awaitable, Callable, Deque, List, Optional, Tuple
 
-from gpustack_tpu.server.bus import Event
+from gpustack_tpu.server.bus import Event, EventType
 
 logger = logging.getLogger(__name__)
+
+# Lossless election-event tap (chaos harness): called synchronously with
+# {ts, identity, event, epoch, expires_at, ttl} for every election
+# transition. Module-level injectable, same idiom as
+# worker_request.rpc_fault_hook.
+election_tap_hook: Optional[Callable[[dict], None]] = None
+
+
+def _os_exit_fatal(coordinator: "LeaseCoordinator") -> None:
+    """Production fatal path: a leader that lost its lease must die
+    before its leader-only tasks can split-brain (reference
+    server/server.py:1296-1304)."""
+    os._exit(1)
+
+
+# replaceable process-wide default for newly constructed coordinators
+# (the chaos harness swaps it BEFORE booting servers, so even the very
+# first election cycle is covered); an explicit ``fatal_hook`` argument
+# always wins
+default_fatal_hook: Callable[["LeaseCoordinator"], None] = _os_exit_fatal
+
+# tail batch bound: more pending entries than this in one cycle degrades
+# to a RESYNC (re-list) instead of a fetch storm
+TAIL_BATCH = 1000
+
+# analytics/collector rows are written per-request or per-sweep and only
+# ever READ straight from the shared DB (usage queries, archiver) —
+# replicating them through the change log would make every proxied
+# request a cross-server event at exactly the scale HA exists for
+REPLICATION_SKIP_KINDS = frozenset({
+    "model_usage", "usage_archive", "resource_event", "system_load",
+})
 
 
 class Coordinator(abc.ABC):
     """Leadership + cross-instance pub/sub contract."""
+
+    #: fencing epoch of the held lease (0 = not leading / non-HA)
+    epoch: int = 0
+    #: leadership transitions observed by this instance (acquired+lost)
+    transitions: int = 0
 
     @abc.abstractmethod
     async def start(self) -> None:
@@ -99,24 +161,33 @@ class LeaseCoordinator(Coordinator):
     """TTL-lease leader election over the shared sqlite/Postgres DB.
 
     Multi-server HA without external dependencies: one row in a
-    ``leadership`` table holds (holder, expires_at); the leader renews at
-    ttl/3, followers try to acquire when the lease lapses. Losing a held
-    lease is fatal (reference semantics: os._exit so leader-only tasks
-    can't split-brain, server/server.py:1296-1304).
+    ``leadership`` table holds (holder, expires_at, epoch); the leader
+    renews at ttl/3, followers try to acquire when the lease lapses.
+    Losing a held lease is fatal (reference semantics: os._exit so
+    leader-only tasks can't split-brain, server/server.py:1296-1304) —
+    via the injectable ``fatal_hook`` so tests can assert the path
+    in-process. Every acquisition bumps the monotonic fencing ``epoch``
+    consumed by orm/fencing.py.
     """
 
     def __init__(
-        self, db, identity: str = "", ttl: float = 0.0, bus=None
+        self,
+        db,
+        identity: str = "",
+        ttl: float = 0.0,
+        bus=None,
+        fatal_hook: Optional[
+            Callable[["LeaseCoordinator"], None]
+        ] = None,
     ):
         import secrets
         import socket
 
         self.db = db
         self.bus = bus
-        if not ttl:
-            # operational knob (reference envs/__init__.py pattern);
-            # e2e failover tests shrink it to keep wall-clock sane
-            ttl = float(os.environ.get("GPUSTACK_TPU_HA_TTL", "15"))
+        # operational knob: Config.ha_ttl (env GPUSTACK_TPU_HA_TTL);
+        # e2e failover tests shrink it to keep wall-clock sane
+        self.ttl = ttl or 15.0
         # hostname + random suffix: pids collide across containers (every
         # process is pid 1), which would let a stale leader renew against
         # its successor's row and split-brain
@@ -124,18 +195,51 @@ class LeaseCoordinator(Coordinator):
             f"{socket.gethostname()}-{os.getpid()}-"
             f"{secrets.token_hex(4)}"
         )
-        self.ttl = ttl
+        self.fatal_hook = fatal_hook or default_fatal_hook
+        self.epoch = 0
+        self.transitions = 0
         self._leader = False
         self._callbacks: List[Callable[[bool], Awaitable[None]]] = []
         self._task: Optional[asyncio.Task] = None
+        self._repl_task: Optional[asyncio.Task] = None
+        # chaos harness: clearing this stalls the ELECTION loop (a
+        # leader whose event loop hung past TTL, emulated) without
+        # touching anything else
+        self.hang_gate = asyncio.Event()
+        self.hang_gate.set()
+        # change-log replication: (kind, event_type, id, changes_json)
+        self._outbox: Deque[
+            Tuple[str, str, int, Optional[str]]
+        ] = deque()
+        self._outbox_event = asyncio.Event()
+        self._last_seen = 0
+        self._republishing = False
+        self._prune_at = 0.0
 
     async def start(self) -> None:
+        from gpustack_tpu.orm.record import PK_CLAUSE
+
         await self.db.execute(
             "CREATE TABLE IF NOT EXISTS leadership ("
             "id INTEGER PRIMARY KEY CHECK (id = 1), "
-            "holder TEXT, expires_at REAL)"
+            "holder TEXT, expires_at REAL, epoch INTEGER DEFAULT 0)"
         )
+        await self.db.execute(
+            "CREATE TABLE IF NOT EXISTS change_log ("
+            f"{PK_CLAUSE[self.db.dialect]}, "
+            "origin TEXT, kind TEXT, record_id INTEGER, "
+            "event_type TEXT, changes TEXT, created_at REAL)"
+        )
+        # start tailing at the PRESENT: everything already in the DB is
+        # covered by the initial list every watch/controller performs
+        rows = await self.db.execute(
+            "SELECT COALESCE(MAX(id), 0) AS top FROM change_log"
+        )
+        self._last_seen = int(rows[0]["top"]) if rows else 0
         self._task = asyncio.create_task(self._loop(), name="coordinator")
+        self._repl_task = asyncio.create_task(
+            self._repl_loop(), name="coordinator-repl"
+        )
 
     async def stop(self) -> None:
         # await the cancelled election task BEFORE touching the lease
@@ -143,18 +247,46 @@ class LeaseCoordinator(Coordinator):
         # that could re-extend the lease AFTER the delete below, making
         # graceful shutdown hand leadership over only after a full TTL
         # instead of immediately
-        task, self._task = self._task, None
-        if task:
-            task.cancel()
-            try:
-                await task
-            except asyncio.CancelledError:
-                pass
+        await self._cancel_tasks()
+        # best-effort final flush: events enqueued within the last
+        # replication cycle have NO other path to peers (the periodic
+        # follower RESYNC is gone) — a graceful shutdown must not
+        # drop them. (A crashed process still loses its unflushed
+        # outbox; peers recover only when the rows are next touched —
+        # recorded as a residual limit.)
+        try:
+            await self._flush_outbox()
+        except Exception:
+            logger.exception("final change-log flush failed")
         if self._leader:
             self._leader = False
+            # expire in place, NEVER delete: the epoch column must
+            # survive graceful handoffs or the successor's acquisition
+            # would reuse epoch 1 and fencing monotonicity breaks
             await self.db.execute(
-                "DELETE FROM leadership WHERE holder = ?", (self.identity,)
+                "UPDATE leadership SET holder = '', expires_at = 0 "
+                "WHERE holder = ?",
+                (self.identity,),
             )
+            self._emit("released")
+
+    async def halt(self) -> None:
+        """Hard stop: tasks die, the lease row is left to EXPIRE (the
+        fatal path and the harness's leader-kill both come through
+        here — a crashed leader deletes nothing)."""
+        await self._cancel_tasks()
+        self._leader = False
+
+    async def _cancel_tasks(self) -> None:
+        for attr in ("_task", "_repl_task"):
+            task = getattr(self, attr)
+            setattr(self, attr, None)
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
 
     @property
     def is_leader(self) -> bool:
@@ -165,77 +297,354 @@ class LeaseCoordinator(Coordinator):
     ) -> None:
         self._callbacks.append(callback)
 
-    def publish_remote(self, event: Event) -> None:
-        # same-DB deployments see each other's state via the DB; watch
-        # streams re-list on RESYNC. Cross-instance low-latency event
-        # fan-out (Redis/PG LISTEN) slots in here.
-        pass
+    # ---- election ----------------------------------------------------
+
+    def _emit(self, event: str, expires_at: float = 0.0) -> None:
+        hook = election_tap_hook
+        if hook is None:
+            return
+        try:
+            hook({
+                "ts": time.time(),
+                "identity": self.identity,
+                "event": event,
+                "epoch": self.epoch,
+                "expires_at": expires_at,
+                "ttl": self.ttl,
+            })
+        except Exception:  # noqa: BLE001 — taps never break elections
+            logger.exception("election tap failed")
+
+    def _trace(self, name: str) -> None:
+        """leader.acquired / leader.lost land in the server trace ring
+        so failovers show up next to the requests they affected."""
+        import uuid
+
+        from gpustack_tpu.observability import tracing
+
+        tracing.get_store("server").add({
+            "trace_id": uuid.uuid4().hex,
+            "span_id": uuid.uuid4().hex[:16],
+            "component": "server",
+            "name": name,
+            "started_at": time.time(),
+            "duration_ms": 0.0,
+            "outcome": "ok",
+            "events": [{
+                "name": name,
+                "identity": self.identity,
+                "epoch": self.epoch,
+            }],
+        })
 
     async def _loop(self) -> None:
-        import time
-
         while True:
             try:
+                # chaos hook: a cleared gate freezes elections (renewal
+                # AND acquisition), emulating an event-loop stall
+                await self.hang_gate.wait()
                 now = time.time()
                 if self._leader:
-                    # renew-then-verify instead of UPDATE..RETURNING:
-                    # the container's sqlite (3.34) predates RETURNING
-                    # (3.35+). The renewal UPDATE is atomic; the
-                    # follow-up SELECT can only disagree if the lease
-                    # was ALREADY lost — exactly the case that must be
-                    # fatal.
-                    await self.db.execute(
-                        "UPDATE leadership SET expires_at = ? "
-                        "WHERE id = 1 AND holder = ?",
-                        (now + self.ttl, self.identity),
-                    )
-                    rows = await self.db.execute(
-                        "SELECT holder FROM leadership WHERE id = 1"
-                    )
-                    if not rows or rows[0]["holder"] != self.identity:
-                        # lease lost while held: fatal, never split-brain
-                        logger.error(
-                            "leadership lease lost; exiting (reference "
-                            "semantics: os._exit on lost lease)"
-                        )
-                        os._exit(1)
-                else:
-                    # atomic conditional upsert (steal only an expired
-                    # lease), then read back who holds it — a fresh
-                    # lease cannot be stolen between the two statements
-                    await self.db.execute(
-                        "INSERT INTO leadership (id, holder, expires_at) "
-                        "VALUES (1, ?, ?) "
-                        "ON CONFLICT(id) DO UPDATE SET "
-                        "holder = excluded.holder, "
-                        "expires_at = excluded.expires_at "
-                        "WHERE leadership.expires_at < ?",
-                        (self.identity, now + self.ttl, now),
-                    )
-                    rows = await self.db.execute(
-                        "SELECT holder FROM leadership WHERE id = 1"
-                    )
-                    if rows and rows[0]["holder"] == self.identity:
-                        logger.info("acquired leadership")
-                        self._leader = True
-                        for cb in self._callbacks:
-                            await cb(True)
-                    elif self.bus is not None:
-                        # follower: the leader's writes land in the shared
-                        # DB but not on this instance's in-process bus —
-                        # force local watchers to re-list every cycle
-                        # (poll-based propagation; low-latency fan-out via
-                        # PG LISTEN/Redis slots into publish_remote later)
-                        from gpustack_tpu.server.bus import (
-                            Event as _Event,
-                            EventType as _EventType,
-                        )
-
-                        self.bus.publish(
-                            _Event(kind="*", type=_EventType.RESYNC)
-                        )
+                    if not await self._renew(now):
+                        # fatal path taken: in production the process
+                        # is already dead (os._exit); with an injected
+                        # hook, a deposed leader must not linger in the
+                        # election and steal leadership right back
+                        return
+                elif not await self._try_acquire(now):
+                    return  # acquisition callbacks failed → fatal
             except asyncio.CancelledError:
                 raise
             except Exception:
                 logger.exception("coordinator iteration failed")
             await asyncio.sleep(self.ttl / 3)
+
+    async def _renew(self, now: float) -> bool:
+        # renew-then-verify instead of UPDATE..RETURNING: the
+        # container's sqlite (3.34) predates RETURNING (3.35+). The
+        # renewal UPDATE is atomic; the follow-up SELECT can only
+        # disagree if the lease was ALREADY lost — exactly the case
+        # that must be fatal.
+        expires = now + self.ttl
+        await self.db.execute(
+            "UPDATE leadership SET expires_at = ? "
+            "WHERE id = 1 AND holder = ?",
+            (expires, self.identity),
+        )
+        rows = await self.db.execute(
+            "SELECT holder, epoch FROM leadership WHERE id = 1"
+        )
+        if not rows or rows[0]["holder"] != self.identity:
+            # lease lost while held: fatal, never split-brain. Queued
+            # writes from still-running leader tasks are already
+            # rejected by the epoch fence regardless of when this
+            # branch notices.
+            logger.error(
+                "leadership lease lost (held epoch %d); invoking "
+                "fatal hook", self.epoch,
+            )
+            self._leader = False
+            self.transitions += 1
+            self._emit("lost")
+            self._trace("leader.lost")
+            self.fatal_hook(self)
+            return False
+        self._emit("renewed", expires_at=expires)
+        return True
+
+    async def _try_acquire(self, now: float) -> bool:
+        # atomic conditional upsert (steal only an expired lease, bump
+        # the fencing epoch), then read back who holds it — a fresh
+        # lease cannot be stolen between the two statements
+        expires = now + self.ttl
+        await self.db.execute(
+            self.db.lease_upsert(),
+            self.db.lease_upsert_params(self.identity, expires, now),
+        )
+        rows = await self.db.execute(
+            "SELECT holder, epoch, expires_at FROM leadership "
+            "WHERE id = 1"
+        )
+        if rows and rows[0]["holder"] == self.identity:
+            self.epoch = int(rows[0]["epoch"] or 0)
+            self._leader = True
+            self.transitions += 1
+            logger.info(
+                "acquired leadership (epoch %d)", self.epoch
+            )
+            self._emit(
+                "acquired", expires_at=float(rows[0]["expires_at"])
+            )
+            self._trace("leader.acquired")
+            try:
+                for cb in self._callbacks:
+                    await cb(True)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a leader whose leader-only tasks never started must
+                # NOT squat on the lease renewing forever — release it
+                # and take the fatal path so a healthy peer (or this
+                # process's restart) can actually lead
+                logger.exception(
+                    "leadership callbacks failed; releasing lease "
+                    "and invoking fatal hook"
+                )
+                self._leader = False
+                self.transitions += 1
+                self._emit("lost")
+                self._trace("leader.lost")
+                try:
+                    await self.db.execute(
+                        "UPDATE leadership SET holder = '', "
+                        "expires_at = 0 WHERE holder = ?",
+                        (self.identity,),
+                    )
+                except Exception:
+                    logger.exception(
+                        "could not release the lease; it will expire"
+                    )
+                self.fatal_hook(self)
+                return False
+        return True
+
+    # ---- change-log replication --------------------------------------
+
+    def publish_remote(self, event: Event) -> None:
+        """Append an id-only entry for peers to tail. Synchronous and
+        cheap (called from a bus tap inside publish); the replication
+        loop flushes to the shared DB."""
+        if self._republishing:
+            return  # never re-log events we just tailed from a peer
+        if event.type not in (
+            EventType.CREATED, EventType.UPDATED, EventType.DELETED
+        ) or not event.kind or event.kind == "*":
+            return
+        if event.kind in REPLICATION_SKIP_KINDS:
+            return
+        # carry the changed-field diff (already jsonable — Record.update
+        # builds it with _jsonable old/new pairs): peers' changes-gated
+        # consumers (route targets, breaker resets, worker-lost edges)
+        # must see WHICH fields moved, not just that something did
+        changes = None
+        if event.changes:
+            try:
+                changes = json.dumps(event.changes)
+            except (TypeError, ValueError):
+                changes = None
+        self._outbox.append(
+            (event.kind, event.type.value, event.id, changes)
+        )
+        self._outbox_event.set()
+
+    async def _repl_loop(self) -> None:
+        interval = max(0.05, self.ttl / 6)
+        while True:
+            try:
+                await self._flush_outbox()
+                await self._tail_changes()
+                await self._maybe_prune()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("coordinator replication failed")
+            try:
+                await asyncio.wait_for(
+                    self._outbox_event.wait(), timeout=interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._outbox_event.clear()
+
+    async def _flush_outbox(self) -> None:
+        if not self._outbox:
+            return
+        batch: List[Tuple[str, str, int, Optional[str]]] = []
+        while self._outbox:
+            batch.append(self._outbox.popleft())
+        now = time.time()
+        origin = self.identity
+
+        def go(conn):
+            try:
+                conn.executemany(
+                    "INSERT INTO change_log "
+                    "(origin, kind, record_id, event_type, changes, "
+                    "created_at) VALUES (?, ?, ?, ?, ?, ?)",
+                    [
+                        (origin, kind, rid, etype, changes, now)
+                        for kind, etype, rid, changes in batch
+                    ],
+                )
+                conn.commit()
+            except BaseException:
+                # never leave a half-inserted batch in an open txn — a
+                # later unrelated commit would land it AND the retry,
+                # duplicating entries
+                conn.rollback()
+                raise
+
+        try:
+            await self.db.run(go)
+        except BaseException:
+            # transient insert failure (lock contention, shutdown
+            # races): these events have no other path to peers — put
+            # them back at the FRONT so order survives the retry
+            self._outbox.extendleft(reversed(batch))
+            self._outbox_event.set()
+            raise
+
+    async def _tail_changes(self) -> None:
+        """Republish peers' writes onto the local bus: id-only entries
+        in, re-fetched full events out — O(events), not O(tables)."""
+        if self.bus is None:
+            return
+        rows = await self.db.execute(
+            "SELECT id, origin, kind, record_id, event_type, changes "
+            "FROM change_log WHERE id > ? ORDER BY id "
+            f"LIMIT {TAIL_BATCH}",
+            (self._last_seen,),
+        )
+        if not rows:
+            return
+        batch_top = int(rows[-1]["id"])
+        if len(rows) >= TAIL_BATCH:
+            # flood: one re-list beats a thousand fetches
+            self._last_seen = batch_top
+            rows2 = await self.db.execute(
+                "SELECT COALESCE(MAX(id), 0) AS top FROM change_log"
+            )
+            if rows2:
+                self._last_seen = max(
+                    self._last_seen, int(rows2[0]["top"])
+                )
+            self.bus.publish(Event(kind="*", type=EventType.RESYNC))
+            return
+        if self._last_seen and int(rows[0]["id"]) > self._last_seen + 1:
+            # front gap: entries between our cursor and the oldest
+            # surviving row were PRUNED while this tailer lagged (or a
+            # rolled-back insert left an id hole — a false positive
+            # costs one harmless re-list). The skipped events are
+            # unrecoverable, so degrade to RESYNC for local watchers
+            # and dirty-set consumers.
+            self._last_seen = batch_top
+            self.bus.publish(Event(kind="*", type=EventType.RESYNC))
+            return
+        # one event PER ENTRY, each carrying its own changed-field
+        # diff: changes-gated consumers (route targets, breaker
+        # resets, worker-lost edges) need every transition, and the
+        # per-subscriber queues already coalesce runs of UPDATED with
+        # correct change merging (bus.py). The document re-fetch is
+        # still one per unique id per batch.
+        from gpustack_tpu.orm.record import registered_records
+
+        registry = registered_records()
+        docs: dict = {}
+        events: List[Event] = []
+        for row in rows:
+            if row["origin"] == self.identity:
+                continue
+            kind = row["kind"]
+            rid = int(row["record_id"])
+            etype = row["event_type"]
+            changes = None
+            if row["changes"]:
+                try:
+                    changes = json.loads(row["changes"])
+                except ValueError:
+                    changes = None
+            if etype == EventType.DELETED.value:
+                events.append(Event(
+                    kind=kind, type=EventType.DELETED, id=rid,
+                    remote=True,
+                ))
+                docs.pop((kind, rid), None)
+                continue
+            cls = registry.get(kind)
+            if cls is None:
+                continue
+            key = (kind, rid)
+            if key not in docs:
+                obj = await cls.get(rid)
+                docs[key] = (
+                    None if obj is None
+                    else obj.model_dump(mode="json")
+                )
+            if docs[key] is None:
+                continue  # deleted since; its DELETED entry follows
+            events.append(Event(
+                kind=kind,
+                type=EventType(etype),
+                id=rid,
+                data=docs[key],
+                changes=changes,
+                remote=True,
+            ))
+        if not events:
+            self._last_seen = batch_top
+            return
+        self._republishing = True
+        try:
+            for event in events:
+                self.bus.publish(event)
+        finally:
+            self._republishing = False
+        # advance the cursor only AFTER the batch fully republished:
+        # a re-fetch/publish failure re-tails the same rows next cycle
+        # (re-fetched republishes are upsert-shaped, so duplicates are
+        # harmless) instead of silently dropping peers' events
+        self._last_seen = batch_top
+
+    async def _maybe_prune(self) -> None:
+        """Leader-only, occasional: the change log is a propagation
+        buffer, not history — entries older than every live peer's tail
+        position (bounded by a generous multiple of the TTL) go."""
+        now = time.time()
+        if not self._leader or now < self._prune_at:
+            return
+        self._prune_at = now + max(10.0, self.ttl * 2)
+        keep = max(60.0, self.ttl * 20)
+        await self.db.execute(
+            "DELETE FROM change_log WHERE created_at < ?",
+            (now - keep,),
+        )

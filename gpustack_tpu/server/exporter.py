@@ -123,6 +123,35 @@ def add_metrics_route(app: web.Application) -> None:
 
         obs_lines = get_registry("server").render_lines()
         obs_lines += slow_call_lines()
+        # control-plane HA: election + fencing state (coordinator.py /
+        # orm/fencing.py) — always rendered so dashboards don't gap
+        # when a server runs single-node (LocalCoordinator: leader=1,
+        # epoch=0, transitions=0)
+        coordinator = request.app.get("coordinator")
+        if coordinator is not None:
+            from gpustack_tpu.observability.metrics import (
+                METRIC_FAMILIES,
+            )
+            from gpustack_tpu.orm import fencing
+
+            obs_lines += [
+                "# TYPE gpustack_ha_is_leader "
+                f"{METRIC_FAMILIES['gpustack_ha_is_leader']}",
+                "gpustack_ha_is_leader "
+                f"{1 if coordinator.is_leader else 0}",
+                "# TYPE gpustack_ha_epoch "
+                f"{METRIC_FAMILIES['gpustack_ha_epoch']}",
+                "gpustack_ha_epoch "
+                f"{getattr(coordinator, 'epoch', 0)}",
+                "# TYPE gpustack_ha_leader_transitions_total "
+                f"{METRIC_FAMILIES['gpustack_ha_leader_transitions_total']}",
+                "gpustack_ha_leader_transitions_total "
+                f"{getattr(coordinator, 'transitions', 0)}",
+                "# TYPE gpustack_ha_fenced_writes_total "
+                f"{METRIC_FAMILIES['gpustack_ha_fenced_writes_total']}",
+                "gpustack_ha_fenced_writes_total "
+                f"{fencing.fenced_writes_total()}",
+            ]
         # SLO engine gauges (compliance / burn rate / alert state) —
         # in-memory judgment over the series above, appended uncached
         slo = request.app.get("slo")

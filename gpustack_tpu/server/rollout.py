@@ -59,7 +59,7 @@ from gpustack_tpu.schemas import (
 )
 from gpustack_tpu.schemas.models import ROLLOUT_FIELDS
 from gpustack_tpu.schemas.rollouts import ACTIVE_ROLLOUT_STATES
-from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.server.collectors import DirtyTrackedTask
 from gpustack_tpu.server.controllers import create_pending_instances
 from gpustack_tpu.utils.profiling import timed
 
@@ -216,7 +216,8 @@ def _created_age(inst: ModelInstance, now: float) -> Optional[float]:
 # ---------------------------------------------------------------------------
 
 
-class RolloutController(PeriodicTask):
+class RolloutController(DirtyTrackedTask):
+    dirty_kinds = ("model", "model_instance", "rollout")
     task_name = "rollout-controller"
 
     def __init__(self, app, cfg: Config):
@@ -243,6 +244,11 @@ class RolloutController(PeriodicTask):
         # the scrape path never touches the DB)
         self._latest_state: Dict[str, RolloutState] = {}
         self.ticks = 0
+        # dirty-set (DirtyTrackedTask): a steady-state pass with
+        # nothing dirty AND no active plan skips the per-tick
+        # Model/Instance/Rollout table scans entirely — any DB action
+        # (ours or anyone's) dirties the set and re-arms the next pass
+        self._had_active = True  # conservative until the first pass
 
     async def tick(self) -> None:
         await self.reconcile_once()
@@ -255,9 +261,26 @@ class RolloutController(PeriodicTask):
         synthetic clock over real DB state."""
         now = time.time() if now is None else now
         self.ticks += 1
-        models = await Model.filter(limit=None)
-        instances = await ModelInstance.filter(limit=None)
-        rollouts = await Rollout.filter(limit=None)
+        changed = self._drain_dirty()
+        if not changed and not self._had_active:
+            # steady-state no-op: nothing we watch was written since
+            # last pass AND no plan was mid-flight — time alone cannot
+            # progress anything (gates/windows only matter to ACTIVE
+            # plans), so skip the table scans
+            self.skipped_ticks += 1
+            return
+        try:
+            models = await Model.filter(limit=None)
+            instances = await ModelInstance.filter(limit=None)
+            rollouts = await Rollout.filter(limit=None)
+        except Exception:
+            # the drained dirtiness was consumed but nothing acted on
+            # it — re-arm or the next tick would skip pending work
+            self._rearm_dirty()
+            raise
+        self._had_active = any(
+            r.state in ACTIVE_ROLLOUT_STATES for r in rollouts
+        )
         by_model: Dict[int, List[ModelInstance]] = {}
         for inst in instances:
             by_model.setdefault(inst.model_id, []).append(inst)
@@ -286,7 +309,10 @@ class RolloutController(PeriodicTask):
                     rollout = await self._start(model, insts, now)
                     latest[model.name] = rollout.state
             except Exception:
-                # one model's broken rollout must not starve the rest
+                # one model's broken rollout must not starve the rest;
+                # re-arm the dirty-set so the no-op skip can't shelve
+                # this model's still-pending work
+                self._rearm_dirty()
                 logger.exception(
                     "rollout reconcile failed for model %s", model.name
                 )
@@ -918,19 +944,23 @@ class RolloutController(PeriodicTask):
         detail: str,
         **fields,
     ) -> bool:
-        # Optimistic-concurrency guard: Record.update persists the
-        # WHOLE document, and every caller holds a snapshot that
+        # State-machine guard + CAS: every caller holds a snapshot that
         # awaited (instance drains, revision writes) since it was
         # read. If the plan's state moved under us — e.g. a manual
         # POST /rollback landed mid-_observe_step — a stale forward
         # write would resurrect the pre-rollback state and re-surge
         # the bad generation. Only a ROLLING_BACK transition may
         # override a concurrent forward move; every other stale
-        # writer defers to the next tick's fresh read. The fetch AND
-        # the write sit under the plan lock, so a rollback cannot land
-        # between them and be clobbered anyway. Returns whether the
+        # writer defers to the next tick's fresh read. The write
+        # itself is CAS-guarded (Record.save, PR 10) with retries OFF:
+        # a conflict means the plan moved between our fresh read and
+        # the write (an HA peer, a route) — same verdict as the state
+        # guard, so the pre-CAS re-fetch dance is gone and even its
+        # residual fetch→write window is closed. Returns whether the
         # write landed so callers can gate side effects (metrics,
         # logs, instance writes) on the transition actually happening.
+        from gpustack_tpu.orm.record import ConflictError
+
         async with self._plan_lock():
             fresh = await Rollout.get(rollout.id)
             if fresh is None:
@@ -944,9 +974,12 @@ class RolloutController(PeriodicTask):
             history = list(fresh.history) + [{
                 "at": now, "event": event, "detail": detail,
             }]
-            await fresh.update(
-                history=history[-HISTORY_CAP:], **fields
-            )
+            try:
+                await fresh.update(
+                    _retries=0, history=history[-HISTORY_CAP:], **fields
+                )
+            except ConflictError:
+                return False
             return True
 
     async def _finish(

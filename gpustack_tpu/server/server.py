@@ -77,6 +77,13 @@ class Server:
         # registered BEFORE create_all_tables or they silently miss
         import gpustack_tpu.server.collectors  # noqa: F401
         Record.bind(self.db, self.bus)
+        # context-local binding too: the in-process multi-server chaos
+        # harness boots several Servers in one process — every task this
+        # coroutine spawns (coordinator, controllers, HTTP accept path)
+        # inherits THIS server's db/bus instead of whichever server
+        # bound last; request handlers additionally re-bind via the app
+        # middleware below
+        Record.bind_context(self.db, self.bus)
         Record.create_all_tables(self.db)
         if not cfg.ha:
             # HA: bootstrap writes are leader-only (racing get-or-create
@@ -85,6 +92,7 @@ class Server:
 
         app = create_app(cfg)
         self.app = app
+        app["record_binding"] = (self.db, self.bus)
         # bounded shutdown: a restart must not hang behind long-lived
         # watch/log-follow streams (chaos finding: the default 60 s
         # connection drain made restart-mid-reconcile a minute-long
@@ -118,9 +126,14 @@ class Server:
             if plugin_coordinator is not None:
                 break
         self.coordinator = plugin_coordinator or (
-            LeaseCoordinator(self.db, bus=self.bus)
+            LeaseCoordinator(self.db, bus=self.bus, ttl=cfg.ha_ttl)
             if cfg.ha else LocalCoordinator()
         )
+        if cfg.ha:
+            # replicate every post-commit event to HA peers through the
+            # shared change_log table (id-only; peers re-fetch). A sync
+            # bus tap: publish_remote only enqueues.
+            self.bus.add_tap(self.coordinator.publish_remote)
         from gpustack_tpu.cloud.controller import WorkerPoolController
 
         from gpustack_tpu.server.controllers import RouteTargetController
@@ -197,6 +210,17 @@ class Server:
         async def on_leadership(leading: bool) -> None:
             if leading:
                 if cfg.ha:
+                    if cfg.ha_epoch_fence and getattr(
+                        self.coordinator, "epoch", 0
+                    ):
+                        # stamp this context with the acquired epoch
+                        # BEFORE starting leader-only tasks: every task
+                        # below inherits it, so their writes reject
+                        # atomically once a successor bumps the lease
+                        # epoch (orm/fencing.py)
+                        from gpustack_tpu.orm import fencing
+
+                        fencing.set_fence(self.coordinator.epoch)
                     await self._init_data()
                 for c in self.controllers:
                     c.start()
@@ -252,10 +276,25 @@ class Server:
         await self._stop.wait()
 
     async def stop(self) -> None:
+        await self._shutdown(release_lease=True)
+
+    async def abort(self) -> None:
+        """Hard stop without releasing the leadership lease — the fatal
+        path (lost lease) and the chaos harness's leader-kill both come
+        through here. A crashed leader deletes nothing: its lease row
+        must EXPIRE before a follower may acquire, which is exactly the
+        failover the TTL contract promises."""
+        await self._shutdown(release_lease=False)
+
+    async def _shutdown(self, release_lease: bool) -> None:
         if self.worker_agent:
             await self.worker_agent.stop()
         if hasattr(self, "coordinator"):
-            await self.coordinator.stop()
+            halt = getattr(self.coordinator, "halt", None)
+            if release_lease or halt is None:
+                await self.coordinator.stop()
+            else:
+                await halt()
         for c in getattr(self, "controllers", []):
             c.stop()
         if hasattr(self, "scheduler"):

@@ -47,6 +47,7 @@ import dataclasses
 import logging
 import random
 import socket
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import aiohttp
@@ -75,6 +76,26 @@ FAULT_KINDS = (
     "server_restart",
 )
 
+# control-plane HA faults: require a multi-server harness (servers>=2,
+# shared DB, shrunken GPUSTACK_TPU_HA_TTL) — kept out of FAULT_KINDS so
+# the single-server classes never draw an op they can only skip
+#   * leader_kill  — the leading server dies mid-reconcile WITHOUT
+#                    releasing its lease (SIGKILL shape): the follower
+#                    may acquire only after TTL expiry
+#   * leader_hang  — the leader's election loop stalls past the TTL
+#                    without exiting (event-loop stall shape): a
+#                    follower steals the lease, and the hung leader's
+#                    still-running writers get FENCED before it
+#                    revives, notices, and takes the fatal path
+#   * lease_expire — the lease row is force-expired out from under the
+#                    leader: fatal on next renewal, successor acquires
+#                    with a bumped epoch
+HA_FAULT_KINDS = (
+    "leader_kill",
+    "leader_hang",
+    "lease_expire",
+)
+
 # the acceptance matrix: one seeded schedule per named fault class
 FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "worker-kill": ("worker_kill",),
@@ -82,8 +103,12 @@ FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "rpc": ("rpc_delay", "rpc_drop"),
     "engine-crash": ("engine_crash",),
     "server-restart": ("server_restart",),
+    "ha-failover": HA_FAULT_KINDS,
     "mixed": FAULT_KINDS,
 }
+
+# classes that need more than one server to mean anything
+MULTI_SERVER_CLASSES = {"ha-failover"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,6 +222,7 @@ class StubWorker:
         self._reconcile_lock = asyncio.Lock()
         self._tasks: List[asyncio.Task] = []
         self._runner: Optional[aiohttp.web.AppRunner] = None
+        self._retired_clients: List[ClientSet] = []
 
     # ---- lifecycle ---------------------------------------------------
 
@@ -292,7 +318,8 @@ class StubWorker:
             await anon.close()
         self.worker_id = result["worker_id"]
         self.proxy_secret = result.get("proxy_secret", "")
-        self.client = ClientSet(self.server_url, result["token"])
+        self._token = result["token"]
+        self.client = ClientSet(self.server_url, self._token)
         self.alive = True
         await self._post_status()
         self._tasks = [
@@ -318,6 +345,35 @@ class StubWorker:
             self._runner = None
         if self.client is not None:
             await self.client.close()
+        retired, self._retired_clients = self._retired_clients, []
+        for client in retired:
+            await client.close()
+
+    async def rebase(self, new_url: str) -> None:
+        """Re-point at a surviving HA server (the load balancer a real
+        deployment puts in front of the control plane): the worker
+        token is a shared-secret JWT, valid against any peer."""
+        if not self.alive or new_url == self.server_url:
+            return
+        self.server_url = new_url
+        old_client = self.client
+        self.client = ClientSet(new_url, self._token)
+        # the watch generator captured the OLD client and would retry
+        # against the dead server forever — restart that task only
+        for i, task in enumerate(self._tasks):
+            if task.get_name() == f"{self.name}-watch":
+                task.cancel()
+                self._tasks[i] = asyncio.create_task(
+                    self._watch_loop(), name=f"{self.name}-watch"
+                )
+                break
+        if old_client is not None:
+            # do NOT close yet: the heartbeat/reconcile loops may have
+            # an in-flight call on it, and a closed session raises
+            # RuntimeError (outside CLIENT_ERRORS) which would KILL the
+            # loop task. Requests against the dead server fail as
+            # ordinary network errors; the session closes at kill().
+            self._retired_clients.append(old_client)
 
     def suspend(self) -> None:
         self._paused.clear()
@@ -588,6 +644,12 @@ class TransitionObserver:
     def _tap(self, event) -> None:
         if event.kind != "model_instance":
             return
+        if getattr(event, "remote", False):
+            # a peer's write republished by the HA change-log tail:
+            # judged once already, on its ORIGIN server's bus (and a
+            # coalesced replicated diff could span multiple hops,
+            # which single-step legality cannot judge)
+            return
         if event.type != EventType.UPDATED or not event.changes:
             return
         pair = event.changes.get("state")
@@ -608,8 +670,15 @@ class TransitionObserver:
 
 
 class ChaosHarness:
-    """One in-process cluster: real server, N stub workers, seeded
-    faults, continuous invariant checking."""
+    """One in-process cluster: N real servers (>=2 = HA over one shared
+    DB), N stub workers, seeded faults, continuous invariant checking.
+
+    Multi-server mode boots every server IN-PROCESS against the same
+    sqlite file with a shrunken lease TTL, taps every election event
+    (``coordinator.election_tap_hook``) and every fenced-write attempt
+    (``fencing.audit_hook``) losslessly, and swaps the coordinator's
+    fatal hook so a lost lease aborts that one server instead of
+    ``os._exit``-ing the whole test."""
 
     def __init__(
         self,
@@ -618,6 +687,8 @@ class ChaosHarness:
         workers: int = 2,
         chips: int = 8,
         replicas: int = 2,
+        servers: int = 1,
+        ha_ttl: float = 1.0,
         heartbeat_interval: float = 0.25,
         rescue_grace: float = 1.2,
         stuck_bound: float = 15.0,
@@ -629,6 +700,8 @@ class ChaosHarness:
         # the SLO e2e compresses burn windows and evaluator cadence)
         self.extra_cfg = dict(extra_cfg or {})
         self.n_workers = workers
+        self.n_servers = max(1, servers)
+        self.ha_ttl = ha_ttl
         self.chips = chips
         self.replicas = replicas
         self.heartbeat_interval = heartbeat_interval
@@ -637,27 +710,64 @@ class ChaosHarness:
         self.stuck_bound = stuck_bound
         self.start_delay = start_delay
 
-        self.server = None
+        self.servers: List = []
+        self.cfgs: List[Config] = []
+        self.dead: set = set()
         self.cfg: Optional[Config] = None
-        self.base = ""
         self.admin: Optional[ClientSet] = None
+        self._admin_token = ""
         self.observer: Optional[TransitionObserver] = None
         self.stubs: List[StubWorker] = []
         self.injector = FaultInjector()
         self.monitor_violations: List[inv.Violation] = []
         self.skipped_ops: List[ChaosOp] = []
         self.probe_results: List = []
+        self.election_events: List[Dict] = []
+        self.fenced_audit: List[Dict] = []
         self._restores: List[asyncio.Task] = []
         self._monitor_task: Optional[asyncio.Task] = None
+        self._saved_hooks: Optional[Tuple] = None
+
+    # ---- topology ----------------------------------------------------
+
+    @property
+    def server(self):
+        """First ALIVE server (back-compat accessor: single-server
+        callers keep reading ``harness.server.app`` etc.)."""
+        for i, srv in enumerate(self.servers):
+            if i not in self.dead and srv is not None:
+                return srv
+        return None
+
+    @property
+    def base(self) -> str:
+        srv = self.server
+        if srv is None:
+            return ""
+        return f"http://127.0.0.1:{srv.cfg.port}"
+
+    def alive_indexes(self) -> List[int]:
+        return [
+            i for i, srv in enumerate(self.servers)
+            if i not in self.dead and srv is not None
+        ]
+
+    def leader_index(self) -> Optional[int]:
+        for i in self.alive_indexes():
+            coord = getattr(self.servers[i], "coordinator", None)
+            if coord is not None and coord.is_leader:
+                return i
+        return None
 
     # ---- lifecycle ---------------------------------------------------
 
     async def start(self) -> None:
+        from gpustack_tpu.orm import fencing
+        from gpustack_tpu.server import coordinator as coordinator_mod
         from gpustack_tpu.server.server import Server
 
         cfg_fields = dict(
             host="127.0.0.1",
-            port=_free_port(),
             data_dir=self.data_dir,
             disable_worker=True,
             bootstrap_password="chaos-pass",
@@ -670,16 +780,37 @@ class ChaosHarness:
             shutdown_timeout=0.3,
             force_platform="cpu",
         )
+        if self.n_servers > 1:
+            # shared data_dir ⇒ shared state.db + shared jwt secret;
+            # shrunken lease TTL keeps failover inside test budgets
+            cfg_fields.update(ha=True, ha_ttl=self.ha_ttl)
         cfg_fields.update(self.extra_cfg)
-        self.cfg = Config(**cfg_fields).finalize()
-        self.server = Server(self.cfg)
-        await self.server.start()
-        self.base = f"http://127.0.0.1:{self.cfg.port}"
 
-        token = await self._login()
-        self.admin = ClientSet(self.base, token)
+        # hooks BEFORE the first boot: the very first election and the
+        # very first fenced write must be observed (lossless contract)
+        self._saved_hooks = (
+            coordinator_mod.election_tap_hook,
+            coordinator_mod.default_fatal_hook,
+            fencing.audit_hook,
+        )
+        coordinator_mod.election_tap_hook = self._on_election
+        coordinator_mod.default_fatal_hook = self._on_fatal
+        fencing.audit_hook = self._on_fence_audit
+
         self.observer = TransitionObserver()
-        self.observer.attach(self.server.bus)
+        for _ in range(self.n_servers):
+            cfg = Config(
+                **dict(cfg_fields, port=_free_port())
+            ).finalize()
+            server = Server(cfg)
+            await server.start()
+            self.cfgs.append(cfg)
+            self.servers.append(server)
+            self.observer.attach(server.bus)
+        self.cfg = self.cfgs[0]
+
+        self._admin_token = await self._login()
+        self.admin = ClientSet(self.base, self._admin_token)
 
         self.stubs = [
             StubWorker(
@@ -699,6 +830,16 @@ class ChaosHarness:
 
     async def stop(self) -> None:
         worker_request.rpc_fault_hook = None
+        if self._saved_hooks is not None:
+            from gpustack_tpu.orm import fencing
+            from gpustack_tpu.server import coordinator as coordinator_mod
+
+            (
+                coordinator_mod.election_tap_hook,
+                coordinator_mod.default_fatal_hook,
+                fencing.audit_hook,
+            ) = self._saved_hooks
+            self._saved_hooks = None
         if self._monitor_task:
             self._monitor_task.cancel()
         for t in self._restores:
@@ -708,8 +849,62 @@ class ChaosHarness:
                 await stub.kill()
         if self.admin:
             await self.admin.close()
-        if self.server is not None:
-            await self.server.stop()
+        for i, srv in enumerate(self.servers):
+            if srv is not None and i not in self.dead:
+                await srv.stop()
+
+    # ---- election / fencing taps -------------------------------------
+
+    def _on_election(self, payload: Dict) -> None:
+        self.election_events.append(payload)
+
+    def _on_fence_audit(
+        self, kind: str, rid: int, epoch: int, lease: int, landed: bool
+    ) -> None:
+        # called from a DB writer thread: append only (GIL-atomic)
+        self.fenced_audit.append({
+            "ts": time.time(),
+            "kind": kind, "id": rid,
+            "epoch": epoch, "lease_epoch": lease, "landed": landed,
+        })
+
+    def _on_fatal(self, coordinator) -> None:
+        """A leader lost its lease: in production the process dies
+        (os._exit); here that one server is aborted — hard, without
+        releasing the lease it no longer owns."""
+        for i, srv in enumerate(self.servers):
+            if srv is not None and getattr(
+                srv, "coordinator", None
+            ) is coordinator:
+                self._restores.append(asyncio.create_task(
+                    self._abort_server(i), name="chaos-fatal-abort"
+                ))
+                return
+
+    async def _abort_server(self, idx: int) -> None:
+        if idx in self.dead or self.servers[idx] is None:
+            return
+        self.dead.add(idx)
+        logger.info("chaos: server %d aborted (of %d)", idx,
+                    len(self.servers))
+        await self.servers[idx].abort()
+        await self._rebase_clients()
+
+    async def _rebase_clients(self) -> None:
+        """Re-point the admin client and every stub at a surviving
+        server — the role a front-of-plane load balancer plays in a
+        real HA deployment."""
+        base = self.base
+        if not base:
+            return
+        old, self.admin = self.admin, ClientSet(
+            base, self._admin_token
+        )
+        if old is not None:
+            await old.close()
+        for stub in self.stubs:
+            if stub.alive:
+                await stub.rebase(base)
 
     async def _login(self) -> str:
         deadline = asyncio.get_running_loop().time() + 30.0
@@ -852,8 +1047,80 @@ class ChaosHarness:
             stub.crash_engine()
         elif op.kind == "server_restart":
             await self.restart_server()
+        elif op.kind == "leader_kill":
+            idx = await self._wait_leader()
+            if idx is None or len(self.alive_indexes()) <= 1:
+                # never kill the last server: convergence would be
+                # impossible by construction
+                self.skipped_ops.append(op)
+                return
+            await self._abort_server(idx)
+        elif op.kind == "leader_hang":
+            idx = await self._wait_leader()
+            if idx is None or len(self.alive_indexes()) <= 1:
+                self.skipped_ops.append(op)
+                return
+            coord = self.servers[idx].coordinator
+            # the leader's election loop stalls past the TTL (the
+            # event-loop-hang shape) while its controllers keep
+            # believing; a follower steals the lease meanwhile and the
+            # hung leader's writes get FENCED. On revival it notices
+            # the lost lease and takes the (injected) fatal path.
+            coord.hang_gate.clear()
+            self._restore_later(
+                self.ha_ttl * 1.6 + op.arg, coord.hang_gate.set
+            )
+        elif op.kind == "lease_expire":
+            if len(self.alive_indexes()) <= 1:
+                self.skipped_ops.append(op)
+                return
+            srv = self.server
+            if srv is None:
+                self.skipped_ops.append(op)
+                return
+            # force-expire AND blank the holder: the sitting leader's
+            # next renewal matches nothing → deterministic fatal; any
+            # peer (or a fresh election by a survivor) re-acquires
+            # with a bumped epoch
+            rows = await srv.db.execute(
+                "SELECT holder, epoch FROM leadership WHERE id = 1"
+            )
+            await srv.db.execute(
+                "UPDATE leadership SET expires_at = 0, holder = '' "
+                "WHERE id = 1"
+            )
+            if rows and rows[0]["holder"]:
+                # the election tap can't see an EXTERNAL revocation —
+                # record it, or the victim's tap interval would run to
+                # its last granted expiry and read as a false overlap
+                # with its successor
+                self.election_events.append({
+                    "ts": time.time(),
+                    "identity": rows[0]["holder"],
+                    "event": "revoked",
+                    "epoch": int(rows[0]["epoch"] or 0),
+                    "expires_at": 0.0,
+                    "ttl": self.ha_ttl,
+                })
         else:
             raise ValueError(f"unknown chaos op kind {op.kind!r}")
+
+    async def _wait_leader(
+        self, timeout: Optional[float] = None
+    ) -> Optional[int]:
+        """Index of the current leader, waiting up to ~3 TTLs for an
+        election to settle (an op firing mid-failover should hit the
+        NEW leader, not vanish as a skip)."""
+        deadline = asyncio.get_running_loop().time() + (
+            timeout if timeout is not None else self.ha_ttl * 3
+        )
+        while True:
+            idx = self.leader_index()
+            if idx is not None:
+                return idx
+            if asyncio.get_running_loop().time() > deadline:
+                return None
+            await asyncio.sleep(0.05)
 
     def _fire_probe(self, stub: Optional[StubWorker]) -> None:
         """Drive a real control RPC through the live server app while
@@ -863,14 +1130,19 @@ class ChaosHarness:
             return
 
         async def go():
+            from gpustack_tpu.orm.record import Record
             from gpustack_tpu.schemas import Worker
 
             try:
+                srv = self.server
+                if srv is None:
+                    return
+                Record.bind_context(srv.db, srv.bus)
                 worker = await Worker.get(stub.worker_id)
                 if worker is None:
                     return
                 resp = await worker_request.worker_fetch(
-                    self.server.app, worker, "GET", "/healthz",
+                    srv.app, worker, "GET", "/healthz",
                     control=True,
                 )
                 await resp.read()
@@ -883,15 +1155,17 @@ class ChaosHarness:
             asyncio.create_task(go(), name="chaos-probe")
         )
 
-    async def restart_server(self) -> None:
+    async def restart_server(self, idx: int = 0) -> None:
         from gpustack_tpu.server.server import Server
 
-        await self.server.stop()
-        self.server = Server(self.cfg)
+        if idx in self.dead or self.servers[idx] is None:
+            return
+        await self.servers[idx].stop()
+        self.servers[idx] = Server(self.cfgs[idx])
         # the old listener may linger a beat after cleanup
         for attempt in range(5):
             try:
-                await self.server.start()
+                await self.servers[idx].start()
                 break
             except OSError:
                 if attempt == 4:
@@ -899,11 +1173,12 @@ class ChaosHarness:
                 await asyncio.sleep(0.2)
         # fresh server ⇒ fresh bus: re-attach the lossless observer
         if self.observer is not None:
-            self.observer.attach(self.server.bus)
+            self.observer.attach(self.servers[idx].bus)
 
     # ---- invariants --------------------------------------------------
 
     async def _records(self):
+        from gpustack_tpu.orm.record import Record
         from gpustack_tpu.schemas import (
             DevInstance,
             Model,
@@ -912,6 +1187,15 @@ class ChaosHarness:
             Worker,
         )
 
+        # read through an ALIVE server's handle: with several
+        # in-process servers the process-global binding points at
+        # whichever server bound last — which may be dead (closed DB)
+        # after a leader kill. The context binding is task-local, so
+        # re-binding here never disturbs the servers themselves.
+        srv = self.server
+        if srv is None or srv.db is None:
+            raise RuntimeError("no alive server")
+        Record.bind_context(srv.db, srv.bus)
         return (
             await Model.all(),
             await Worker.all(),
@@ -941,8 +1225,18 @@ class ChaosHarness:
     def violations(self) -> List[inv.Violation]:
         seen = set()
         out: List[inv.Violation] = []
-        for v in list(self.monitor_violations) + (
-            list(self.observer.violations) if self.observer else []
+        election: List[inv.Violation] = []
+        if self.n_servers > 1:
+            election = inv.check_election_history(
+                list(self.election_events), self.ha_ttl,
+                now=time.time(), require_leader=bool(
+                    self.alive_indexes()
+                ),
+            ) + inv.check_fenced_writes(list(self.fenced_audit))
+        for v in (
+            list(self.monitor_violations)
+            + (list(self.observer.violations) if self.observer else [])
+            + election
         ):
             key = (v.rule, v.detail)
             if key not in seen:
@@ -1005,16 +1299,25 @@ async def run_seeded(
     ops: int = 3,
     workers: int = 2,
     replicas: int = 2,
+    servers: int = 1,
+    ha_ttl: float = 1.0,
     converge_timeout: float = 30.0,
     **harness_kw,
 ) -> dict:
     """Boot a cluster, deploy, run the seeded schedule, wait for
     convergence; returns a report dict (raises on non-convergence)."""
+    gap = (0.2, 0.8)
+    if any(k in HA_FAULT_KINDS for k in kinds):
+        # leader faults each need an election (~TTL) to play out; the
+        # gap scales with the lease so ops land on a settled leader.
+        # Still a pure function of (seed, shape): ha_ttl is shape.
+        gap = (ha_ttl * 1.5, ha_ttl * 3.0)
     schedule = generate_schedule(
-        seed, kinds=kinds, ops=ops, workers=workers
+        seed, kinds=kinds, ops=ops, workers=workers, gap=gap
     )
     harness = ChaosHarness(
-        data_dir, workers=workers, replicas=replicas, **harness_kw
+        data_dir, workers=workers, replicas=replicas,
+        servers=servers, ha_ttl=ha_ttl, **harness_kw
     )
     await harness.start()
     try:
@@ -1036,6 +1339,16 @@ async def run_seeded(
                 "delayed": harness.injector.delayed,
                 "dropped": harness.injector.dropped,
             },
+            "servers": servers,
+            "dead_servers": sorted(harness.dead),
+            "election_events": len(harness.election_events),
+            # true fence REJECTIONS only: a fenced-context write can
+            # also fail to land on a plain CAS conflict or missing row
+            # (lease_epoch <= epoch) — those are not fencing events
+            "fenced_writes": sum(
+                1 for w in harness.fenced_audit
+                if not w["landed"] and w["lease_epoch"] > w["epoch"]
+            ),
         }
     finally:
         await harness.stop()
@@ -1056,6 +1369,12 @@ def main(argv=None) -> int:
     p.add_argument("--ops", type=int, default=3)
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--replicas", type=int, default=2)
+    p.add_argument(
+        "--servers", type=int, default=0,
+        help="control-plane servers (0 = auto: 2 for HA classes, "
+             "1 otherwise)",
+    )
+    p.add_argument("--ha-ttl", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=40.0)
     p.add_argument("--verbose", action="store_true")
     args = p.parse_args(argv)
@@ -1076,7 +1395,10 @@ def main(argv=None) -> int:
     for i, cls_name in enumerate(classes):
         seed = args.seed + i
         tmp = tempfile.mkdtemp(prefix=f"chaos-{cls_name}-")
-        print(f"=== {cls_name} (seed {seed}) ===")
+        servers = args.servers or (
+            2 if cls_name in MULTI_SERVER_CLASSES else 1
+        )
+        print(f"=== {cls_name} (seed {seed}, servers {servers}) ===")
         try:
             report = asyncio.run(run_seeded(
                 tmp, seed,
@@ -1084,6 +1406,8 @@ def main(argv=None) -> int:
                 ops=args.ops,
                 workers=args.workers,
                 replicas=args.replicas,
+                servers=servers,
+                ha_ttl=args.ha_ttl,
                 converge_timeout=args.timeout,
             ))
         except Exception as e:  # noqa: BLE001 — CLI boundary

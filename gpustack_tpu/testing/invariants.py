@@ -322,6 +322,134 @@ def check_autoscale_bounds(models: Sequence) -> List[Violation]:
     return out
 
 
+def check_election_history(
+    events: Sequence[Dict],
+    ttl: float,
+    *,
+    now: Optional[float] = None,
+    require_leader: bool = False,
+) -> List[Violation]:
+    """Judge a lossless election-event stream (coordinator.py
+    ``election_tap_hook`` payloads: ts/identity/event/epoch/
+    expires_at/ttl) against the HA contract:
+
+    - **at-most-one-leader** (always): lease-validity intervals never
+      overlap. An interval opens at ``acquired``, its expiry advances
+      with every ``renewed``, and it closes at ``lost``/``released``
+      — or, for a leader that died silently (SIGKILL), at its last
+      granted expiry. Two overlapping intervals mean two coordinators
+      simultaneously held *valid* leases — the split-brain fencing
+      exists to make unreachable.
+    - **epoch monotonicity** (always): every acquisition's fencing
+      epoch is strictly greater than all before it — exactly one
+      winner per epoch, no reuse.
+    - **leader-exists-within-3×TTL** (eventual): no leaderless gap
+      between consecutive intervals (or after the last one, with
+      ``require_leader``) exceeds 3×TTL.
+    """
+    out: List[Violation] = []
+    intervals: List[Dict] = []  # {identity, epoch, start, end, open}
+    open_by_identity: Dict[str, Dict] = {}
+    last_epoch = 0
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        kind = ev["event"]
+        identity = ev["identity"]
+        if kind == "acquired":
+            epoch = int(ev.get("epoch", 0))
+            if epoch <= last_epoch:
+                out.append(Violation(
+                    "epoch-regression", "always",
+                    f"{identity} acquired epoch {epoch} but epoch "
+                    f"{last_epoch} was already granted",
+                ))
+            last_epoch = max(last_epoch, epoch)
+            iv = {
+                "identity": identity,
+                "epoch": epoch,
+                "start": ev["ts"],
+                "end": ev.get("expires_at") or (ev["ts"] + ttl),
+                "open": True,
+            }
+            intervals.append(iv)
+            open_by_identity[identity] = iv
+        elif kind == "renewed":
+            iv = open_by_identity.get(identity)
+            if iv is not None:
+                iv["end"] = max(
+                    iv["end"], ev.get("expires_at") or ev["ts"]
+                )
+        elif kind in ("lost", "released", "fatal", "revoked"):
+            # "revoked": an EXTERNAL actor invalidated the lease (the
+            # chaos harness's lease_expire fault) — the holder's
+            # validity ends at revocation time, not at the expiry it
+            # was last granted
+            iv = open_by_identity.pop(identity, None)
+            if iv is not None:
+                iv["end"] = min(iv["end"], ev["ts"])
+                iv["open"] = False
+    # overlap + gap checks over start-ordered intervals
+    intervals.sort(key=lambda iv: iv["start"])
+    for prev, cur in zip(intervals, intervals[1:]):
+        if cur["start"] < prev["end"] - 1e-6:
+            out.append(Violation(
+                "overlapping-leases", "always",
+                f"{cur['identity']} (epoch {cur['epoch']}) acquired at "
+                f"{cur['start']:.3f} while {prev['identity']} (epoch "
+                f"{prev['epoch']})'s lease was valid until "
+                f"{prev['end']:.3f}",
+            ))
+        gap = cur["start"] - prev["end"]
+        if gap > 3 * ttl:
+            out.append(Violation(
+                "leaderless-too-long", "eventual",
+                f"no leader for {gap:.2f}s between "
+                f"{prev['identity']} and {cur['identity']} "
+                f"(bound 3*ttl = {3 * ttl:.2f}s)",
+            ))
+    if require_leader and intervals:
+        last = max(intervals, key=lambda iv: iv["end"])
+        end_now = now if now is not None else last["end"]
+        if not any(
+            iv["open"] and iv["end"] >= end_now - 1e-6
+            for iv in intervals
+        ):
+            gap = end_now - last["end"]
+            if gap > 3 * ttl:
+                out.append(Violation(
+                    "leaderless-too-long", "eventual",
+                    f"no leader for the trailing {gap:.2f}s "
+                    f"(bound 3*ttl = {3 * ttl:.2f}s)",
+                ))
+    if require_leader and not intervals:
+        out.append(Violation(
+            "leaderless-too-long", "eventual",
+            "no acquisition was ever observed",
+        ))
+    return out
+
+
+def check_fenced_writes(writes: Sequence[Dict]) -> List[Violation]:
+    """**no-stale-epoch-write** (always), from the lossless fencing
+    audit tap (orm/fencing.py ``audit_hook``): every write that LANDED
+    must have carried an epoch >= the lease epoch observed inside its
+    own transaction. A landed write with a smaller epoch is a deposed
+    leader mutating its successor's state — the exact corruption the
+    fence exists to make impossible, so one occurrence is a fencing
+    bug no matter when it happens."""
+    out: List[Violation] = []
+    for w in writes:
+        if w.get("landed") and w.get("lease_epoch", 0) > w.get(
+            "epoch", 0
+        ):
+            out.append(Violation(
+                "stale-epoch-write", "always",
+                f"{w.get('kind')} id={w.get('id')}: write with epoch "
+                f"{w.get('epoch')} landed while the lease epoch was "
+                f"{w.get('lease_epoch')}",
+            ))
+    return out
+
+
 def transition_violation(
     old: str, new: str, label: str = ""
 ) -> Optional[Violation]:

@@ -1,0 +1,254 @@
+"""Multi-server control-plane HA under chaos (ISSUE 10 acceptance).
+
+Two REAL in-process servers share one sqlite DB with a shrunken lease
+TTL (``GPUSTACK_TPU_HA_TTL`` → ``Config.ha_ttl``). The tier-1 subset
+proves the two headline properties end to end:
+
+- **leader-kill failover**: the leader dies mid-reconcile WITHOUT
+  releasing its lease; the follower acquires within 3×TTL, finishes the
+  interrupted reconcile, the seeded schedule replays bit-for-bit, and
+  the lossless election tap shows zero invariant violations (including
+  at-most-one-leader and no-stale-epoch-write).
+- **write fencing**: a hung-then-revived old leader's queued write is
+  rejected (``gpustack_ha_fenced_writes_total`` increments, the write
+  never lands) and the successor's state is intact.
+
+The full multi-server soak (seeded ha-failover schedules, also
+``make chaos CLASSES=ha-failover``) is marked slow.
+"""
+
+import asyncio
+import dataclasses
+
+import aiohttp
+import pytest
+
+from gpustack_tpu.testing import chaos
+from gpustack_tpu.testing import invariants as inv
+
+# the leader-exists-within-3×TTL bound is enforced through the
+# election-event invariant (harness.violations()); 1.0s keeps that 3s
+# window honest on a loaded CI box while the polls above it stay loose
+HA_TTL = 1.0
+
+
+async def _wait(predicate, timeout, what):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        result = predicate()
+        if result:
+            return result
+        assert (
+            asyncio.get_running_loop().time() < deadline
+        ), f"timed out waiting for {what}"
+        await asyncio.sleep(0.05)
+
+
+async def _metrics_text(base: str) -> str:
+    async with aiohttp.ClientSession() as http:
+        async with http.get(
+            base + "/metrics",
+            timeout=aiohttp.ClientTimeout(total=5),
+        ) as r:
+            assert r.status == 200
+            return await r.text()
+
+
+def _metric_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{name} not exported:\n{text[:2000]}")
+
+
+def test_leader_kill_mid_reconcile_failover(tmp_path):
+    """Kill the leader BETWEEN a spec write and its reconcile: the
+    promoted follower must finish the interrupted reconcile."""
+
+    async def go():
+        # stuck_bound/timeouts sized for a loaded CI box: under a full
+        # tier-1 run a SCHEDULED instance can legitimately sit >15s
+        # while the machine thrashes, and that must read as slow, not
+        # as a stuck-transient invariant violation
+        harness = chaos.ChaosHarness(
+            str(tmp_path), servers=2, workers=2, replicas=1,
+            ha_ttl=HA_TTL, stuck_bound=45.0,
+        )
+        await harness.start()
+        try:
+            model = await harness.deploy()
+            await harness.wait_converged(timeout=60)
+            old_leader = harness.leader_index()
+            assert old_leader is not None
+            old_epoch = harness.servers[old_leader].coordinator.epoch
+
+            # interrupted reconcile: write the new spec, then SIGKILL
+            # the leader before it can act on it
+            await harness.admin.update(
+                "models", model["id"], {"replicas": 2}
+            )
+            await harness._abort_server(old_leader)
+
+            # follower acquires within 3×TTL with a bumped epoch
+            new_leader = await _wait(
+                harness.leader_index, 30.0, "failover"
+            )
+            assert new_leader != old_leader
+            coord = harness.servers[new_leader].coordinator
+            assert coord.epoch == old_epoch + 1
+
+            # ...and FINISHES the interrupted reconcile: 2 replicas
+            await harness.wait_converged(timeout=60)
+            instances = await harness.admin.list("model-instances")
+            assert len(instances) == 2
+            assert all(i["state"] == "running" for i in instances)
+
+            assert harness.violations() == []
+            # the lossless election tap replays cleanly through the
+            # SAME invariant the soak uses
+            acquired = [
+                e for e in harness.election_events
+                if e["event"] == "acquired"
+            ]
+            assert [e["epoch"] for e in acquired] == [
+                old_epoch, old_epoch + 1,
+            ]
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())
+
+
+def test_hung_leader_write_is_fenced(tmp_path):
+    """Event-loop-stall shape: the old leader keeps BELIEVING while a
+    follower takes over. Any write its leader-only tasks then attempt
+    carries the stale epoch and must reject — atomically, counted on
+    /metrics — leaving the successor's state intact."""
+
+    async def go():
+        from gpustack_tpu.orm import fencing
+
+        fencing.reset_counters()
+        # stuck_bound/timeouts sized for a loaded CI box: under a full
+        # tier-1 run a SCHEDULED instance can legitimately sit >15s
+        # while the machine thrashes, and that must read as slow, not
+        # as a stuck-transient invariant violation
+        harness = chaos.ChaosHarness(
+            str(tmp_path), servers=2, workers=2, replicas=1,
+            ha_ttl=HA_TTL, stuck_bound=45.0,
+        )
+        await harness.start()
+        try:
+            model = await harness.deploy()
+            await harness.wait_converged(timeout=60)
+            idx = harness.leader_index()
+            hung = harness.servers[idx]
+            hung_base = f"http://127.0.0.1:{hung.cfg.port}"
+            hung.coordinator.hang_gate.clear()
+
+            # follower steals the lease while the old leader hangs
+            # (leader_index() would still surface the hung BELIEVER —
+            # watch the usurper's coordinator directly)
+            other = next(
+                i for i in harness.alive_indexes() if i != idx
+            )
+            await _wait(
+                lambda: harness.servers[other].coordinator.is_leader,
+                30.0, "usurpation",
+            )
+            assert hung.coordinator.is_leader  # still believes!
+
+            # queue work for the DEPOSED leader's controllers: a spec
+            # change through ITS api — its ModelController reacts and
+            # every resulting write carries the stale epoch
+            await harness.admin.update(
+                "models", model["id"], {"replicas": 2}
+            )
+            await _wait(
+                fencing.fenced_writes_total,
+                30.0, "a fenced write",
+            )
+
+            # the fence shows on the old leader's own exporter, and
+            # the whole exposition stays spec-valid
+            text = await _metrics_text(hung_base)
+            assert _metric_value(
+                text, "gpustack_ha_fenced_writes_total"
+            ) >= 1
+            assert _metric_value(text, "gpustack_ha_is_leader") == 1
+            from gpustack_tpu.testing.promtext import (
+                assert_well_formed,
+            )
+
+            assert_well_formed(text)
+
+            # revival → fatal path → that server aborts itself
+            hung.coordinator.hang_gate.set()
+            await _wait(
+                lambda: idx in harness.dead,
+                30.0, "fatal abort",
+            )
+
+            # successor state intact: exactly the spec'd replicas,
+            # zero violations — including no-stale-epoch-write over
+            # the lossless fencing audit
+            await harness.wait_converged(timeout=60)
+            instances = await harness.admin.list("model-instances")
+            assert len(instances) == 2
+            assert harness.violations() == []
+            assert any(
+                not w["landed"] and w["lease_epoch"] > w["epoch"]
+                for w in harness.fenced_audit
+            )
+            assert inv.check_fenced_writes(harness.fenced_audit) == []
+            survivor_base = harness.base
+            text = await _metrics_text(survivor_base)
+            assert _metric_value(text, "gpustack_ha_is_leader") == 1
+            assert _metric_value(text, "gpustack_ha_epoch") >= 2
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())
+
+
+def test_ha_schedule_replays_bit_for_bit():
+    a = chaos.generate_schedule(
+        11, kinds=chaos.HA_FAULT_KINDS, ops=4, workers=2
+    )
+    b = chaos.generate_schedule(
+        11, kinds=chaos.HA_FAULT_KINDS, ops=4, workers=2
+    )
+    assert a == b
+    assert {o.kind for o in a} <= set(chaos.HA_FAULT_KINDS)
+
+
+@pytest.mark.slow
+def test_ha_failover_soak(tmp_path):
+    """Seeded multi-server soak: several leader faults per schedule,
+    full convergence + election/fencing invariants each time.
+
+    TTL sizing matters here exactly as docs/RESILIENCE.md says it does
+    in production: three full in-process servers sharing ONE event
+    loop on a slow CI box see multi-second scheduling stalls, and the
+    leader-exists-within-3×TTL invariant is judged against wall clock
+    — a sub-second lease on this box would self-report as an outage."""
+    soak_ttl = 2.5
+    for seed in (1, 2):
+        report = asyncio.run(chaos.run_seeded(
+            str(tmp_path / f"s{seed}"), seed,
+            kinds=chaos.HA_FAULT_KINDS,
+            ops=3, workers=2, replicas=2,
+            servers=3, ha_ttl=soak_ttl,
+            converge_timeout=90.0, stuck_bound=45.0,
+        ))
+        assert report["violations"] == [], report
+        # reproducibility: the executed schedule IS the seed's schedule
+        regenerated = [
+            dataclasses.asdict(o)
+            for o in chaos.generate_schedule(
+                seed, kinds=chaos.HA_FAULT_KINDS, ops=3, workers=2,
+                gap=(soak_ttl * 1.5, soak_ttl * 3.0),
+            )
+        ]
+        assert report["schedule"] == regenerated
+        assert report["election_events"] > 0

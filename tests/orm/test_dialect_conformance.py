@@ -90,17 +90,41 @@ def test_control_plane_sql_is_dialect_generic(traced_db):
 
     asyncio.run(crud())
 
-    # coordinator lease SQL (HA path)
+    # coordinator lease SQL (HA path): table DDL + conditional upsert
+    # with epoch bump + renewal + fenced-write guard, all through the
+    # live trace
     async def lease():
+        import time
+
         from gpustack_tpu.server.coordinator import LeaseCoordinator
 
         coord = LeaseCoordinator(db, "node-a", ttl=5.0)
-        await coord._try_acquire()
+        await db.execute(
+            "CREATE TABLE IF NOT EXISTS leadership ("
+            "id INTEGER PRIMARY KEY CHECK (id = 1), "
+            "holder TEXT, expires_at REAL, epoch INTEGER DEFAULT 0)"
+        )
+        await coord._try_acquire(time.time())
+        assert coord.is_leader and coord.epoch == 1
+        await coord._renew(time.time())
 
-    try:
-        asyncio.run(lease())
-    except (AttributeError, TypeError):
-        pass  # private API drift: the ORM/migration trace is the core
+    asyncio.run(lease())
+
+    # fenced CRUD (leader-stamped writes compose the guard clause)
+    async def fenced():
+        from gpustack_tpu.orm import fencing
+
+        fencing.set_fence(1)
+        try:
+            m = await Model.create(Model(name="m2", preset="tiny"))
+            await m.update(replicas=3)
+            await Model.set_field(m.id, "replicas", 4)
+            await m.refresh()
+            await m.delete()
+        finally:
+            fencing.clear_fence()
+
+    asyncio.run(fenced())
 
     assert len(statements) > 10, "trace captured nothing"
     violations = check_statements(statements)
@@ -135,6 +159,44 @@ def test_json_accessor_covers_reference_dialects():
     assert "CAST(? AS JSON)" in json_set("x", dialect="mysql")
     for d in DIALECTS:
         assert json_set("x", dialect=d).count("?") == 1
+
+
+def test_lease_upsert_covers_reference_dialects():
+    """The HA election's conditional upsert + epoch bump has an
+    explicit spelling per dialect (sqlite/postgres share ON CONFLICT ..
+    DO UPDATE .. WHERE; mysql re-checks expiry per assignment with
+    IF()), and the bind tuples match each spelling's ? count."""
+    from gpustack_tpu.orm.sql import (
+        DIALECTS,
+        dual_from,
+        fence_guard,
+        lease_upsert,
+        lease_upsert_params,
+    )
+
+    for d in DIALECTS:
+        sql = lease_upsert(d)
+        params = lease_upsert_params("h", 2.0, 1.0, d)
+        assert sql.count("?") == len(params), d
+        # the epoch bump is present and conditional in every spelling
+        assert "epoch" in sql, d
+    assert "ON CONFLICT(id) DO UPDATE" in lease_upsert("sqlite")
+    assert "ON CONFLICT(id) DO UPDATE" in lease_upsert("postgres")
+    assert "leadership.epoch + 1" in lease_upsert("postgres")
+    assert "ON DUPLICATE KEY UPDATE" in lease_upsert("mysql")
+    assert "IF(expires_at < ?" in lease_upsert("mysql")
+    # sqlite/postgres bind (holder, expires, now); mysql re-binds now
+    # once per conditional assignment
+    assert lease_upsert_params("h", 2.0, 1.0, "sqlite") == ("h", 2.0, 1.0)
+    assert lease_upsert_params("h", 2.0, 1.0, "mysql") == (
+        "h", 2.0, 1.0, 1.0, 1.0
+    )
+    # the fence guard binds exactly one ? (the writer's epoch) and the
+    # guarded INSERT..SELECT filler is empty except mysql's FROM DUAL
+    for d in DIALECTS:
+        assert fence_guard(d).count("?") == 1, d
+    assert dual_from("sqlite") == "" and dual_from("postgres") == ""
+    assert dual_from("mysql") == " FROM DUAL"
 
 
 def test_no_hardcoded_json_extract_in_sources():

@@ -237,3 +237,200 @@ def test_migrations_table(ctx):
     assert n >= 0
     # idempotent
     assert run_migrations(db) == 0
+
+
+# ---------------------------------------------------------------------------
+# CAS persistence (PR 10): Record.save carries WHERE updated_at = <snapshot>
+# ---------------------------------------------------------------------------
+
+
+def test_stale_save_raises_conflict_instead_of_losing_update(ctx):
+    """The pre-CAS lost-update regression: two writers load the same
+    row; writer A lands a field, then writer B's whole-document save
+    from the STALE snapshot used to silently revert A's field. Now the
+    stale save raises typed ConflictError and the row keeps A's write."""
+    from gpustack_tpu.orm.record import ConflictError
+
+    async def go():
+        await Model.create(Model(name="cas", preset="tiny", replicas=1))
+        a = await Model.first(name="cas")
+        b = await Model.first(name="cas")
+        await a.update(replicas=5)
+
+        b.max_slots = 99
+        with pytest.raises(ConflictError):
+            await b.save()
+        fresh = await Model.first(name="cas")
+        assert fresh.replicas == 5          # A's write survived
+        assert fresh.max_slots != 99        # B's stale write rejected
+
+    run(go())
+
+
+def test_update_retries_conflict_and_converges(ctx):
+    """Record.update re-fetches and re-applies on conflict (bounded):
+    both writers' fields land — the exact lost-update the per-site
+    re-fetch guards could only narrow."""
+
+    async def go():
+        await Model.create(Model(name="cas2", preset="tiny"))
+        a = await Model.first(name="cas2")
+        b = await Model.first(name="cas2")
+        await a.update(replicas=7)
+        await b.update(max_slots=3)         # stale snapshot: retries
+        fresh = await Model.first(name="cas2")
+        assert fresh.replicas == 7 and fresh.max_slots == 3
+
+    run(go())
+
+
+def test_update_with_zero_retries_surfaces_conflict(ctx):
+    from gpustack_tpu.orm.record import ConflictError
+
+    async def go():
+        await Model.create(Model(name="cas3", preset="tiny"))
+        a = await Model.first(name="cas3")
+        b = await Model.first(name="cas3")
+        await a.update(replicas=2)
+        with pytest.raises(ConflictError):
+            await b.update(_retries=0, max_slots=4)
+
+    run(go())
+
+
+def test_conflict_then_noop_publishes_nothing(ctx):
+    """A retry that discovers the concurrent writer already applied the
+    same value converges WITHOUT a redundant write/event."""
+    db, bus = ctx
+
+    async def go():
+        await Model.create(Model(name="cas4", preset="tiny"))
+        a = await Model.first(name="cas4")
+        b = await Model.first(name="cas4")
+        await a.update(replicas=9)
+        before = dict(bus.published)
+        await b.update(replicas=9)          # conflicts, refreshes, no-op
+        assert bus.published == before
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing (PR 10): orm/fencing.py + the leadership table guard
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fenced_ctx(ctx):
+    db, bus = ctx
+    db.execute_sync(
+        "CREATE TABLE IF NOT EXISTS leadership ("
+        "id INTEGER PRIMARY KEY CHECK (id = 1), "
+        "holder TEXT, expires_at REAL, epoch INTEGER DEFAULT 0)"
+    )
+    db.execute_sync(
+        "INSERT INTO leadership (id, holder, expires_at, epoch) "
+        "VALUES (1, 'L2', 1e12, 2)"
+    )
+    from gpustack_tpu.orm import fencing
+
+    fencing.reset_counters()
+    yield db, bus
+    fencing.clear_fence()
+    fencing.audit_hook = None
+
+
+def test_fenced_write_with_current_epoch_lands(fenced_ctx):
+    from gpustack_tpu.orm import fencing
+
+    async def go():
+        fencing.set_fence(2)
+        m = await Model.create(Model(name="f1", preset="tiny"))
+        await m.update(replicas=3)
+        await Model.set_field(m.id, "max_slots", 5)
+        fresh = await Model.get(m.id)
+        assert fresh.replicas == 3 and fresh.max_slots == 5
+        await fresh.delete()
+        assert fencing.fenced_writes_total() == 0
+
+    run(go())
+
+
+def test_stale_epoch_write_rejected_everywhere(fenced_ctx):
+    """A deposed leader (epoch 1, lease already at 2) cannot create,
+    save, set_field or delete — each path raises StaleEpochError,
+    mutates nothing, publishes nothing, and increments the fenced
+    counter."""
+    from gpustack_tpu.orm import fencing
+    from gpustack_tpu.orm.record import StaleEpochError
+
+    db, bus = fenced_ctx
+
+    async def go():
+        # a row created BEFORE deposition (current epoch then)
+        fencing.set_fence(2)
+        m = await Model.create(Model(name="f2", preset="tiny"))
+
+        fencing.set_fence(1)  # now deposed
+        with pytest.raises(StaleEpochError):
+            await Model.create(Model(name="f3", preset="tiny"))
+        with pytest.raises(StaleEpochError):
+            await m.update(replicas=4)
+        with pytest.raises(StaleEpochError):
+            await Model.set_field(m.id, "max_slots", 9)
+        with pytest.raises(StaleEpochError):
+            await m.delete()
+        fencing.clear_fence()
+        fresh = await Model.get(m.id)
+        assert fresh is not None            # delete fenced
+        assert fresh.replicas != 4 and fresh.max_slots != 9
+        assert await Model.first(name="f3") is None
+        assert fencing.fenced_writes_total() == 4
+
+    run(go())
+
+
+def test_fencing_audit_hook_sees_every_attempt(fenced_ctx):
+    from gpustack_tpu.orm import fencing
+
+    seen = []
+    fencing.audit_hook = (
+        lambda kind, rid, epoch, lease, landed:
+        seen.append((kind, epoch, lease, landed))
+    )
+
+    async def go():
+        fencing.set_fence(2)
+        m = await Model.create(Model(name="f4", preset="tiny"))
+        await m.update(replicas=2)
+        fencing.set_fence(1)
+        try:
+            await m.update(replicas=3)
+        except Exception:
+            pass
+
+    run(go())
+    landed = [s for s in seen if s[3]]
+    fenced = [s for s in seen if not s[3]]
+    assert len(landed) == 2 and len(fenced) == 1
+    # the no-stale-epoch-write invariant over the audit stream holds
+    assert all(lease <= epoch for _k, epoch, lease, _l in landed)
+    from gpustack_tpu.testing import invariants as inv
+
+    writes = [
+        {"kind": k, "id": 0, "epoch": e, "lease_epoch": le, "landed": ld}
+        for k, e, le, ld in seen
+    ]
+    assert inv.check_fenced_writes(writes) == []
+
+
+def test_unfenced_context_ignores_leadership_table(fenced_ctx):
+    """Follower/request contexts carry no fence: their writes never
+    consult the lease row (API writes are legitimate on any server)."""
+
+    async def go():
+        m = await Model.create(Model(name="f5", preset="tiny"))
+        await m.update(replicas=8)
+        assert (await Model.get(m.id)).replicas == 8
+
+    run(go())

@@ -589,3 +589,76 @@ def test_bounds_enforcement(cfg):
         assert (await Model.get(under.id)).replicas == 2
 
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# PR 10: event-bus dirty-set — steady-state no-op ticks skip table scans
+# ---------------------------------------------------------------------------
+
+
+def test_noop_tick_issues_zero_list_queries(cfg):
+    """With no autoscale-enabled model and nothing dirty since the last
+    pass, a tick touches the DB zero times (the regression the
+    ROADMAP item-4 follow-on asked for)."""
+
+    def forbid(label):
+        return classmethod(
+            lambda cls, **k: (_ for _ in ()).throw(
+                AssertionError(f"{label} list query on a no-op tick")
+            )
+        )
+
+    async def go():
+        scaler = make_scaler(cfg, {})
+        scaler.attach_dirty(Record.bus())
+        await Model.create(Model(name="plain", preset="tiny"))
+        await scaler.scale_once(now=T0)       # warm pass: scans, caches
+
+        orig_m, orig_i = Model.filter, ModelInstance.filter
+        Model.filter = forbid("Model")
+        ModelInstance.filter = forbid("ModelInstance")
+        try:
+            assert await scaler.scale_once(now=T0 + 1) == []
+            assert scaler.skipped_ticks == 1
+        finally:
+            Model.filter, ModelInstance.filter = orig_m, orig_i
+
+        # a write re-arms the next pass (and the pass runs clean)
+        await Model.create(
+            Model(name="scaled", preset="tiny", autoscale_max=2)
+        )
+        await scaler.scale_once(now=T0 + 2)
+        assert scaler.skipped_ticks == 1      # ran, not skipped
+        scaler._dirty.close()
+
+    asyncio.run(go())
+
+
+def test_clean_pass_reuses_cached_instance_lists(cfg):
+    """With autoscale models present the Model list is still read every
+    tick (the durable wake marker is a set_field write that publishes
+    no bus event), but the big instance/rollout scans reuse the cached
+    snapshot while nothing is dirty."""
+
+    async def go():
+        scaler = make_scaler(cfg, {"m": busy()})
+        scaler.attach_dirty(Record.bus())
+        await Model.create(Model(
+            name="m", preset="tiny", replicas=1, autoscale_max=4,
+        ))
+        await scaler.scale_once(now=T0)       # warm: scans (+ scales)
+        await scaler.scale_once(now=T0 + 1)   # drains any self-dirty
+
+        orig_i = ModelInstance.filter
+        ModelInstance.filter = classmethod(
+            lambda cls, **k: (_ for _ in ()).throw(
+                AssertionError("instance scan on a clean pass")
+            )
+        )
+        try:
+            await scaler.scale_once(now=T0 + 2)
+        finally:
+            ModelInstance.filter = orig_i
+        scaler._dirty.close()
+
+    asyncio.run(go())

@@ -114,3 +114,235 @@ def test_lease_coordinator_single_leader_and_failover():
         db.close()
 
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# PR 10: fencing epochs, injectable fatal path, change-log propagation
+# ---------------------------------------------------------------------------
+
+import time as _time
+
+from gpustack_tpu.server import coordinator as coordinator_mod
+
+
+def test_epoch_bumps_once_per_acquisition(tmp_path):
+    """Every acquisition bumps the monotonic fencing epoch; renewals
+    never do."""
+
+    async def go():
+        db = Database(":memory:")
+        a = LeaseCoordinator(db, identity="a", ttl=0.4)
+        await a.start()
+        await asyncio.sleep(0.3)
+        assert a.is_leader and a.epoch == 1
+        await asyncio.sleep(0.5)  # a few renewals
+        assert a.epoch == 1
+        await a.stop()
+
+        b = LeaseCoordinator(db, identity="b", ttl=0.4)
+        await b.start()
+        deadline = asyncio.get_running_loop().time() + 3.0
+        while not b.is_leader:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert b.epoch == 2
+        await b.stop()
+        db.close()
+
+    asyncio.run(go())
+
+
+def test_lost_lease_takes_injectable_fatal_path():
+    """A usurped lease triggers the fatal hook IN-PROCESS (no
+    os._exit), emits a lossless 'lost' election event, and the
+    election loop ends instead of stealing leadership right back."""
+
+    async def go():
+        db = Database(":memory:")
+        fatals = []
+        events = []
+        saved = coordinator_mod.election_tap_hook
+        coordinator_mod.election_tap_hook = events.append
+        try:
+            a = LeaseCoordinator(
+                db, identity="a", ttl=0.4,
+                fatal_hook=fatals.append,
+            )
+            await a.start()
+            await asyncio.sleep(0.3)
+            assert a.is_leader and a.epoch == 1
+            # usurp: what a successor's acquisition does to the row
+            await db.execute(
+                "UPDATE leadership SET holder = 'usurper', epoch = 2 "
+                "WHERE id = 1"
+            )
+            deadline = asyncio.get_running_loop().time() + 3.0
+            while not fatals:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            assert fatals == [a]
+            assert not a.is_leader
+            kinds = [e["event"] for e in events]
+            assert "acquired" in kinds and "lost" in kinds
+            # the election task ended: a deposed leader never re-runs
+            await asyncio.sleep(0.6)
+            assert not a.is_leader
+            await a.stop()
+        finally:
+            coordinator_mod.election_tap_hook = saved
+            db.close()
+
+    asyncio.run(go())
+
+
+def test_acquire_storm_exactly_one_winner_per_epoch(tmp_path):
+    """Many coordinators hammering one shared DB: at most one holder at
+    any instant and exactly one winner per epoch — judged by the same
+    election-history invariant the chaos harness uses."""
+    from gpustack_tpu.testing import invariants as inv
+
+    N = 8
+    path = str(tmp_path / "storm.db")
+
+    async def go():
+        events = []
+        saved = coordinator_mod.election_tap_hook
+        coordinator_mod.election_tap_hook = events.append
+        dbs = [Database(path) for _ in range(N)]
+        coords = [
+            LeaseCoordinator(dbs[i], identity=f"c{i}", ttl=0.5)
+            for i in range(N)
+        ]
+        try:
+            await asyncio.gather(*(c.start() for c in coords))
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while not any(c.is_leader for c in coords):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(0.6)  # let stragglers try to steal
+            leaders = [c for c in coords if c.is_leader]
+            assert len(leaders) == 1
+            assert leaders[0].epoch == 1
+
+            # kill the winner WITHOUT releasing (halt = SIGKILL shape):
+            # the next epoch has exactly one winner again, post-expiry
+            await leaders[0].halt()
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while not any(
+                c.is_leader for c in coords if c is not leaders[0]
+            ):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            second = [c for c in coords if c.is_leader]
+            assert len(second) == 1 and second[0].epoch == 2
+
+            acquired = [e for e in events if e["event"] == "acquired"]
+            assert [e["epoch"] for e in acquired] == [1, 2]
+            assert inv.check_election_history(
+                events, 0.5, now=_time.time(), require_leader=True
+            ) == []
+        finally:
+            coordinator_mod.election_tap_hook = saved
+            for c in coords:
+                await c.stop()
+            for db in dbs:
+                db.close()
+
+    asyncio.run(go())
+
+
+def test_hang_gate_stalls_elections_until_set():
+    async def go():
+        db = Database(":memory:")
+        fatals = []
+        a = LeaseCoordinator(
+            db, identity="a", ttl=0.4, fatal_hook=fatals.append
+        )
+        b = LeaseCoordinator(db, identity="b", ttl=0.4)
+        await a.start()
+        await asyncio.sleep(0.3)
+        assert a.is_leader
+        await b.start()
+        # stall a's election loop past the TTL: b steals the lease
+        a.hang_gate.clear()
+        deadline = asyncio.get_running_loop().time() + 3.0
+        while not b.is_leader:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert b.epoch == 2
+        assert a.is_leader  # still BELIEVES (the dangerous window)
+        # revival: a notices and takes the fatal path
+        a.hang_gate.set()
+        deadline = asyncio.get_running_loop().time() + 3.0
+        while not fatals:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert not a.is_leader
+        await a.stop()
+        await b.stop()
+        db.close()
+
+    asyncio.run(go())
+
+
+def test_change_log_propagates_peer_writes(tmp_path):
+    """Follower propagation is O(events): a write on server A lands on
+    server B's bus as a full re-fetched event (CREATED/UPDATED) or an
+    id-only DELETED — no RESYNC re-list involved."""
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.server.bus import EventBus, EventType
+
+    path = str(tmp_path / "repl.db")
+
+    async def next_real(sub, want_type):
+        deadline = asyncio.get_running_loop().time() + 8.0
+        while True:
+            assert asyncio.get_running_loop().time() < deadline
+            event = await sub.get(timeout=0.5)
+            if event.type == want_type:
+                return event
+            assert event.type == EventType.HEARTBEAT, event
+
+    async def go():
+        db_a, db_b = Database(path), Database(path)
+        bus_a, bus_b = EventBus(), EventBus()
+        Record.bind(db_a, bus_a)
+        Record.create_all_tables(db_a)
+        a = LeaseCoordinator(db_a, identity="a", ttl=0.6, bus=bus_a)
+        b = LeaseCoordinator(db_b, identity="b", ttl=0.6, bus=bus_b)
+        bus_a.add_tap(a.publish_remote)
+        await a.start()
+        await asyncio.sleep(0.2)
+        assert a.is_leader
+        await b.start()
+        sub = bus_b.subscribe(kinds={"model"})
+        try:
+            m = await Model.create(Model(name="repl", preset="tiny"))
+            ev = await next_real(sub, EventType.CREATED)
+            assert ev.id == m.id and ev.data["name"] == "repl"
+            await m.update(replicas=3)
+            ev = await next_real(sub, EventType.UPDATED)
+            assert ev.data["replicas"] == 3
+            # the changed-field diff survives replication: peers'
+            # changes-gated consumers (route targets, breaker resets,
+            # worker-lost edges) depend on it — and the event is
+            # flagged remote so per-write auditors judge the origin
+            # copy only
+            assert dict(ev.changes)["replicas"][1] == 3
+            assert ev.remote is True
+            await m.delete()
+            ev = await next_real(sub, EventType.DELETED)
+            assert ev.id == m.id
+            # the leader never republishes its own entries
+            assert not any(
+                k == "model" for k, _t in bus_a.published
+            ) or bus_a.published.get(("model", "CREATED"), 0) == 1
+        finally:
+            sub.close()
+            await a.stop()
+            await b.stop()
+            db_a.close()
+            db_b.close()
+
+    asyncio.run(go())

@@ -990,3 +990,53 @@ def test_revision_pruning_pins_active_rollout_source(cfg):
         assert len(revs) <= 9
 
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# PR 10: event-bus dirty-set — steady-state no-op ticks skip table scans
+# ---------------------------------------------------------------------------
+
+
+def test_noop_reconcile_tick_issues_zero_list_queries(cfg):
+    """Converged world, no active plan, nothing written since the last
+    pass: the reconcile tick skips its Model/Instance/Rollout scans
+    entirely; any bus write re-arms the next pass."""
+
+    def forbid(label):
+        return classmethod(
+            lambda cls, **k: (_ for _ in ()).throw(
+                AssertionError(f"{label} list query on a no-op tick")
+            )
+        )
+
+    async def go():
+        ctrl = RolloutController({}, cfg)
+        ctrl.attach_dirty(Record.bus())
+        m = await Model.create(Model(
+            name="steady", preset="tiny", replicas=1, generation=1,
+        ))
+        await ModelInstance.create(ModelInstance(
+            name="steady-0", model_id=m.id, model_name=m.name,
+            state=ModelInstanceState.RUNNING, generation=1,
+        ))
+        await ctrl.reconcile_once(now=time.time())  # warm: scans
+
+        orig = (Model.filter, ModelInstance.filter, Rollout.filter)
+        Model.filter = forbid("Model")
+        ModelInstance.filter = forbid("ModelInstance")
+        Rollout.filter = forbid("Rollout")
+        try:
+            await ctrl.reconcile_once(now=time.time())
+            assert ctrl.skipped_ticks == 1
+        finally:
+            (
+                Model.filter, ModelInstance.filter, Rollout.filter,
+            ) = orig
+
+        # a write (any watched kind) re-arms the scan
+        await m.update(replicas=2)
+        await ctrl.reconcile_once(now=time.time())
+        assert ctrl.skipped_ticks == 1      # ran, not skipped
+        ctrl._dirty.close()
+
+    asyncio.run(go())
