@@ -11,6 +11,7 @@ from gpustack_tpu.analysis.rules.locks import HeldAcrossAwaitRule
 from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
 from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
 from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
+from gpustack_tpu.analysis.rules.sync_dispatch import SyncInDispatchRule
 
 ALL_RULES = (
     BlockingInAsyncRule,
@@ -18,6 +19,7 @@ ALL_RULES = (
     StateMachineRule,
     ConfigDocDriftRule,
     MetricsDriftRule,
+    SyncInDispatchRule,
 )
 
 

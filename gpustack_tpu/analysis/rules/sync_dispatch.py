@@ -1,0 +1,132 @@
+"""sync-in-dispatch: device-sync calls on the engine dispatch path.
+
+The overlapped engine's speed rests on one property: the scheduler
+thread DISPATCHES device work and never waits for it — sampled tokens
+are fetched ``pipeline_depth`` steps behind, detokenization rides a
+worker thread, KV uploads stage on the copy executor. One stray
+``np.asarray`` on a device array (or ``.item()``, or
+``jax.block_until_ready``) inside the dispatch path silently
+re-serializes the whole pipeline, and nothing crashes — throughput just
+quietly drops. This rule makes that a deterministic test failure.
+
+A module opts in by declaring, at module level, the functions that form
+its dispatch path::
+
+    DISPATCH_SYNC_FREE = ("step", "_admit", "_decode_once", ...)
+
+Inside those functions (nested ``def``/``lambda`` bodies excluded —
+they run on worker threads or executors), any call to the device-sync
+vocabulary is flagged:
+
+- ``np.asarray(...)`` (``numpy.asarray`` after alias resolution) — a
+  device→host copy when handed a device array;
+- ``.item()`` — a device scalar sync;
+- ``jax.block_until_ready(...)`` / ``jax.device_get(...)``.
+
+Host syncs belong in the module's designated fetch/drain helpers
+(simply not listed in ``DISPATCH_SYNC_FREE``); a genuinely host-only
+``np.asarray`` in a listed function takes
+``# analysis: ignore[sync-in-dispatch]``. The rule only checks the
+listed functions' direct bodies — designated helpers are the escape
+hatch, which is exactly the declared contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+DECLARATION = "DISPATCH_SYNC_FREE"
+
+SYNC_CALLS = {
+    "numpy.asarray": "device→host copy np.asarray()",
+    "jax.block_until_ready": "jax.block_until_ready()",
+    "jax.device_get": "jax.device_get()",
+}
+
+
+def _declared(tree: ast.AST) -> Set[str]:
+    """Names listed in the module-level DISPATCH_SYNC_FREE literal
+    (tuple/list of string constants); empty when undeclared."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == DECLARATION
+            for t in targets
+        ):
+            continue
+        if isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    names.add(elt.value)
+    return names
+
+
+class SyncInDispatchRule(Rule):
+    id = "sync-in-dispatch"
+    description = (
+        "device-sync call (np.asarray/.item()/block_until_ready/"
+        "device_get) inside a declared DISPATCH_SYNC_FREE function — "
+        "host syncs belong in designated fetch/drain helpers"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            declared = _declared(tree)
+            if not declared:
+                continue
+            aliases = astutil.import_aliases(tree)
+            for fn in ast.walk(tree):
+                if not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if fn.name not in declared:
+                    continue
+                for node in astutil.scope_walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    msg = self._classify(node, aliases)
+                    if msg:
+                        yield self.finding(
+                            rel,
+                            node.lineno,
+                            f"{msg} in dispatch-path function "
+                            f"{fn.name}() (host syncs belong in a "
+                            f"designated fetch/drain helper)",
+                        )
+
+    @staticmethod
+    def _classify(call: ast.Call, aliases) -> Optional[str]:
+        # alias resolution canonicalizes every import spelling:
+        # `import numpy as np` → numpy.asarray, `from jax import
+        # block_until_ready` → jax.block_until_ready
+        name = astutil.resolve_call(call, aliases)
+        if name in SYNC_CALLS:
+            return SYNC_CALLS[name]
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "item"
+            and not call.args
+            and not call.keywords
+        ):
+            return "device scalar sync .item()"
+        return None

@@ -48,6 +48,12 @@ class Config(pydantic.BaseModel):
     engine_port_base: int = 40000
     engine_port_range: int = 200
     force_platform: str = ""          # "cpu" for hermetic tests
+    # default decode-fetch pipeline depth for engine processes
+    # (docs/ENGINE_PIPELINE.md): engines read the matching env var
+    # directly (subprocesses inherit the worker's environment);
+    # ModelSpec.engine_pipeline_depth overrides per model. 0 = serial
+    # reference mode.
+    engine_pipeline_depth: int = 2
 
     # data-plane resilience (server/resilience.py + openai proxy)
     proxy_failover_attempts: int = 3    # max replicas tried per request

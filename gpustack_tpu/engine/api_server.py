@@ -1108,6 +1108,15 @@ def build_engine_from_args(args) -> LLMEngine:
         )
         max_slots = rounded
 
+    # dispatch-ahead depth: argv (per-model knob) > env (Config
+    # engine_pipeline_depth — engine subprocesses inherit the worker's
+    # environment) > built-in default 2
+    pipeline_depth = getattr(args, "pipeline_depth", -1)
+    if pipeline_depth is None or pipeline_depth < 0:
+        pipeline_depth = int(
+            os.environ.get("GPUSTACK_TPU_ENGINE_PIPELINE_DEPTH") or 2
+        )
+
     engine = LLMEngine(
         cfg,
         params,
@@ -1123,6 +1132,7 @@ def build_engine_from_args(args) -> LLMEngine:
         kv_block_tokens=getattr(args, "kv_block_tokens", 0),
         kv_cache_int8=getattr(args, "kv_cache_int8", False),
         prefill_chunk=getattr(args, "prefill_chunk", 0),
+        pipeline_depth=pipeline_depth,
     )
     if vlm_cfg is not None:
         from gpustack_tpu.models.vlm import VisionBundle, init_vision_params
@@ -1178,6 +1188,13 @@ def main(argv=None) -> None:
         "--prefill-chunk", type=int, default=0,
         help="chunked prefill: process prompts in chunks of this many "
         "tokens, interleaving decode between chunks (0 = off)",
+    )
+    p.add_argument(
+        "--pipeline-depth", type=int, default=-1,
+        help="decode-fetch pipeline depth (dispatch-ahead overlap): "
+        "0 = serial reference mode, -1 = inherit "
+        "GPUSTACK_TPU_ENGINE_PIPELINE_DEPTH (default 2) — "
+        "docs/ENGINE_PIPELINE.md",
     )
     p.add_argument("--quantization", choices=["", "int8"], default="")
     p.add_argument(

@@ -4,13 +4,27 @@ This is the TPU replacement for the engine containers the reference
 launches (reference gpustack/worker/backends/vllm.py role): an in-process
 orchestrator around :class:`~gpustack_tpu.engine.runner.ModelRunner`.
 
-Scheduling loop (one thread, device never idles on the host):
+Overlapped scheduling (one dispatch thread that never waits on the
+device, ``pipeline_depth`` steps of work in flight — the
+``--async-scheduling`` role the reference Performance Lab credits its
+biggest serving wins to):
 
 1. admit: while a slot is free and requests wait → prefill (bucketed) +
-   insert.
-2. decode: one ``decode_step`` advances all active slots; sampled tokens are
-   fetched with a small async lag so the device pipeline stays full.
-3. retire: EOS / max_tokens / capacity → free slot, finish stream.
+   insert. The first sampled token is fed on-device (a device scalar
+   into ``insert``), so admission dispatches N+1's prefill while N's
+   sample is still in flight.
+2. decode: one ``decode_step`` advances all active slots; sampled tokens
+   are fetched ``pipeline_depth`` steps behind dispatch. When a lagged
+   fetch reveals a slot finished, the speculatively dispatched steps
+   for it are rolled back host-side (dropped + counted) and the slot is
+   re-tenanted cleanly.
+3. retire: EOS / max_tokens / capacity → free slot; detokenization and
+   SSE stream writes ride a dedicated worker thread so tokenizer calls
+   and client queues never stall dispatch.
+
+``pipeline_depth=0`` is the serial reference mode (fetch + inline
+detok every step) — greedy outputs are bit-identical across modes; the
+parity suite (tests/engine/test_overlap.py) enforces it.
 
 The reference's per-instance health probe contract (serve_manager health
 checks) maps to :meth:`LLMEngine.health`.
@@ -18,6 +32,8 @@ checks) maps to :meth:`LLMEngine.health`.
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
 import dataclasses
 import itertools
 import logging
@@ -36,7 +52,26 @@ from gpustack_tpu.observability import flight as _flight
 
 logger = logging.getLogger(__name__)
 
-_FETCH_LAG = 2  # decode steps in flight before the host inspects tokens
+# default decode-fetch pipeline depth: decode steps in flight before the
+# host inspects tokens (ModelSpec.engine_pipeline_depth / Config
+# engine_pipeline_depth override it per deployment; 0 = serial mode)
+_FETCH_LAG = 2
+
+# sync-in-dispatch contract (analysis/rules/sync_dispatch.py): these
+# functions form the scheduler dispatch path and must never block on the
+# device — the analyzer flags np.asarray / .item() /
+# jax.block_until_ready / jax.device_get inside them (nested def bodies
+# excluded: they run on worker threads). Host syncs belong in the
+# designated fetch/drain helpers (_process_fetch, _drain_pending,
+# _draft_propose, _upload_prefix, _resolve_staged_prefix) or off-thread.
+DISPATCH_SYNC_FREE = (
+    "_loop", "step", "_admit", "_start_request", "_finalize_start",
+    "_new_slot_info", "_plan_chunk_job", "_advance_chunk",
+    "_decode_once", "_note_spec_dispatch", "_spec_safe", "_deliver",
+    "_emit_text", "_push", "_finish", "_flight_record",
+    "_submit_kv_copy", "_store_finished_sequence", "_build_proposals",
+    "_entry_ready", "_drain_ready",
+)
 
 
 class LatencyHistogram:
@@ -201,6 +236,11 @@ class _ChunkJob:
     last: Any = None         # last-position logits of the latest chunk
     k: Any = None            # accumulated KV [L, bucket, H, hd]
     v: Any = None
+    # staged prefix upload in flight on the kv-copy executor (double
+    # buffering): resolves to (k, v, prefix_len) or None on eviction —
+    # the job cold-starts then. While pending, decode for running slots
+    # proceeds; that concurrency is the overlap win.
+    pending_kv: Any = None
 
 
 @dataclasses.dataclass
@@ -219,6 +259,128 @@ class _SlotInfo:
     # JSON mode: incremental end-of-value scanner + chars already scanned
     json_scan: Optional[Any] = None
     json_scanned: int = 0
+    # True: the scheduler detokenizes inline (serial mode, or the
+    # request's termination depends on decoded text — stop strings /
+    # JSON mode). False: buffer_ids/text/emitted are owned by the detok
+    # worker after handoff; the scheduler only appends token ids.
+    sync_detok: bool = True
+
+
+class _DetokWorker:
+    """Dedicated detokenization + stream-write thread (overlap mode).
+
+    The scheduler hands accepted token ids through a bounded queue and,
+    for offloaded requests, never touches the slot's detok state
+    (``buffer_ids``/``text``/``emitted``) again — this thread owns the
+    tokenizer calls and SSE queue puts, so neither stalls device
+    dispatch. A finish item flushes the tail, publishes ``output_text``
+    and sets the request's ``done`` event; the single FIFO queue is the
+    ordering contract (all tokens precede their request's finish). Busy
+    seconds feed the engine's host-overlap accounting (the flight
+    recorder's ``host_overlap_ratio``)."""
+
+    _STOP = object()
+
+    def __init__(self, engine: "LLMEngine", maxsize: int = 4096):
+        self._engine = engine
+        # bounded: a stalled consumer backpressures dispatch instead of
+        # pinning unbounded text host-side
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        # lazy: only engines that actually offload pay for a thread.
+        # Scheduler-thread-only callers, so no start race.
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="llm-detok", daemon=True
+            )
+            self._thread.start()
+
+    def put_tokens(self, info: "_SlotInfo", toks: List[int]) -> None:
+        self._ensure_thread()
+        self._q.put((info, toks))
+
+    def finish(self, info: "_SlotInfo") -> None:
+        self._ensure_thread()
+        self._q.put((info, None))
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._thread is None:
+            return
+        self._q.put(self._STOP)
+        self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        eng = self._engine
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            info, toks = item
+            t0 = time.perf_counter()
+            try:
+                req = info.request
+                if toks is None:
+                    # finish: flush the multibyte tail, publish, wake
+                    # the waiter (finish_reason was set by the
+                    # scheduler before the handoff)
+                    eng._emit_text(info, final=True)
+                    req.output_text = info.text
+                    if req.stream is not None:
+                        req.stream.put(None)
+                    req.done.set()
+                else:
+                    info.buffer_ids.extend(toks)
+                    eng._emit_text(info, final=False)
+            except Exception:
+                # a tokenizer fault must fail ONE request loudly, not
+                # wedge every waiter behind it in the queue
+                logger.exception("detok worker item failed")
+                req = info.request
+                if not req.done.is_set():
+                    req.finish_reason = req.finish_reason or "error"
+                    # publish whatever text HAD decoded — a fault in
+                    # the final flush must not turn a finished request
+                    # into an empty-looking success
+                    req.output_text = info.text
+                    if req.stream is not None:
+                        req.stream.put(None)
+                    req.done.set()
+            finally:
+                eng._note_overlap(time.perf_counter() - t0)
+
+
+class _KVStager:
+    """Two-slot staging buffer for host→device prefix-KV uploads on the
+    kv-copy executor: at most ``depth`` gather+upload jobs in flight, so
+    the next chunk job's prefix copies while the current chunk (or the
+    running slots' decode) computes, without unbounded host pinning."""
+
+    def __init__(self, executor, depth: int = 2):
+        self._ex = executor
+        self._inflight: "collections.deque" = collections.deque()
+        self.depth = depth
+
+    def submit(self, fn):
+        while self._inflight and self._inflight[0].done():
+            self._inflight.popleft()
+        while len(self._inflight) >= self.depth:
+            # backpressure: the two-slot bound is the memory contract
+            concurrent.futures.wait([self._inflight.popleft()])
+        try:
+            fut = self._ex.submit(fn)
+        except RuntimeError:
+            # executor shut down (engine stopping / tests draining the
+            # copy pool): run inline — a resolved future keeps the
+            # caller's contract
+            fut = concurrent.futures.Future()
+            try:
+                fut.set_result(fn())
+            except Exception as e:
+                fut.set_exception(e)
+        self._inflight.append(fut)
+        return fut
 
 
 class LLMEngine:
@@ -244,6 +406,7 @@ class LLMEngine:
         kv_block_tokens: int = 0,    # block granularity (0 = default 256)
         kv_cache_int8: bool = False,  # int8 host tier (per-block scales)
         prefill_chunk: int = 0,      # >0: chunked prefill (tokens/chunk)
+        pipeline_depth: int = _FETCH_LAG,  # 0 = serial reference mode
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -259,9 +422,30 @@ class LLMEngine:
         self._waiting: "queue.Queue[GenRequest]" = queue.Queue()
         self._key = jax.random.key(seed)
         self._pending: List[Tuple[Any, Dict[int, int]]] = []
+        # Dispatch-ahead pipeline (docs/ENGINE_PIPELINE.md): sampled
+        # tokens are fetched this many steps behind dispatch, so the
+        # device always has work queued while the host inspects older
+        # results. 0 = serial reference mode (fetch + inline detok every
+        # step) — greedy-identical to overlapped mode, used for parity.
+        # Clamped: depth only buys overlap up to the device queue, and
+        # every extra step is wasted compute after a slot finishes.
+        self.pipeline_depth = max(0, min(int(pipeline_depth), 16))
+        self.overlap = self.pipeline_depth > 0
         self._running = False
         self._fatal = ""            # set when the scheduling loop dies
         self._thread: Optional[threading.Thread] = None
+        # idle wakeup: submit() signals under this condition, replacing
+        # the old 2 ms poll loop (idle-spin saved is exported via the
+        # flight recorder's idle_wait counter)
+        self._wake = threading.Condition()
+        # detokenization + SSE stream writes off the dispatch path
+        self._detok = _DetokWorker(self)
+        # host work overlapped with device compute (detok worker + kv
+        # staging/copy executor busy seconds), drained per step into the
+        # flight record's host_overlap field
+        self._overlap_mu = threading.Lock()
+        self._overlap_s = 0.0
+        self._overlap_seen = 0.0
         self._id_counter = itertools.count()
         self._step_count = 0
         self._tokens_generated = 0
@@ -316,9 +500,8 @@ class LLMEngine:
         # verifies — output is bit-identical to plain greedy decode.
         self.host_kv_cache = None
         self._kv_copy_pool = None
+        self._kv_stage = None
         if host_kv_cache_mb > 0:
-            import concurrent.futures
-
             from gpustack_tpu.engine.kv_host_cache import (
                 DEFAULT_BLOCK_TOKENS,
                 HostKVCache,
@@ -341,6 +524,9 @@ class LLMEngine:
             self._kv_copy_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="kv-copy"
             )
+            # double-buffered host→device prefix uploads ride the same
+            # executor behind a two-slot stager (chunked prefill seeds)
+            self._kv_stage = _KVStager(self._kv_copy_pool)
         self.draft_runner = None
         self._draft_state = None
         if speculative == "draft":
@@ -411,7 +597,12 @@ class LLMEngine:
                 f"prompt of {len(req.prompt_ids)} tokens >= max_seq_len "
                 f"{self.max_seq_len}"
             )
-        self._waiting.put(req)
+        # enqueue + notify under one lock so a submit can never slip
+        # between the scheduler's emptiness check and its cv wait (the
+        # classic lost wakeup)
+        with self._wake:
+            self._waiting.put(req)
+            self._wake.notify_all()
         return req
 
     def generate(self, req: GenRequest, timeout: float = 300.0) -> GenRequest:
@@ -430,8 +621,13 @@ class LLMEngine:
 
     def stop(self) -> None:
         self._running = False
+        with self._wake:
+            self._wake.notify_all()
         if self._thread:
             self._thread.join(timeout=30)
+        # drain the detok queue so every finished request's text/done
+        # landed before the engine object is abandoned
+        self._detok.stop()
 
     def embed(self, batch_prompt_ids: List[List[int]]) -> List[List[float]]:
         """Mean-pooled, l2-normalized embeddings — one batched forward for
@@ -471,6 +667,19 @@ class LLMEngine:
             "flight_overhead_ratio": round(
                 self.flight.overhead_ratio(), 6
             ),
+            # overlapped pipeline (docs/ENGINE_PIPELINE.md)
+            "pipeline_depth": self.pipeline_depth,
+            "overlap": self.overlap,
+            "host_overlap_ratio": round(
+                self.flight.host_overlap_ratio(), 6
+            ),
+            "pipeline_rollback_tokens": (
+                self.flight.rollback_tokens_total
+            ),
+            "idle_wait_s": round(self.flight.idle_wait_s_total, 3),
+            # the replica's multi-chip layout as one inspectable object
+            # (parallel/sharding.SpecLayout)
+            "layout": self.runner.layout.describe(),
             "speculative": self.speculative,
             "spec_steps": self._spec_steps,
             "spec_extra_tokens": self._spec_hits,
@@ -522,17 +731,53 @@ class LLMEngine:
                 self._fail_all_requests(str(e))
                 return
             if not busy:
-                time.sleep(0.002)
+                # Idle: park on the wakeup condition instead of the old
+                # 2 ms poll. submit() notifies under the same lock; the
+                # bounded timeout is a backstop for wake sources that
+                # don't notify (aborts on queued requests). Waited
+                # seconds are exported as the spin this saves.
+                with self._wake:
+                    if self._running and self._waiting.empty():
+                        t0 = time.perf_counter()
+                        self._wake.wait(timeout=0.05)
+                        self.flight.note_idle_wait(
+                            time.perf_counter() - t0
+                        )
+
+    def _notify_wake(self) -> None:
+        with self._wake:
+            self._wake.notify_all()
+
+    def _note_overlap(self, seconds: float) -> None:
+        """Worker threads report host work done concurrently with the
+        scheduler here; _flight_record drains the delta per step."""
+        with self._overlap_mu:
+            self._overlap_s += seconds
 
     def _fail_all_requests(self, message: str) -> None:
         for info in list(self._slots.values()):
             req = info.request
             req.finish_reason = "error"
-            req.output_text = info.text
+            if info.sync_detok:
+                req.output_text = info.text
+                if req.stream is not None:
+                    req.stream.put(None)
+                req.done.set()
+            else:
+                # the detok worker owns this request's text/stream/done;
+                # queue ordering delivers any buffered tokens first
+                self._detok.finish(info)
+        self._slots.clear()
+        # mid-chunked-prefill requests live in _chunk_jobs, not _slots —
+        # they must fail just as loudly (their clients are blocked on
+        # done too)
+        for job in self._chunk_jobs.values():
+            req = job.req
+            req.finish_reason = "error"
             if req.stream is not None:
                 req.stream.put(None)
             req.done.set()
-        self._slots.clear()
+        self._chunk_jobs.clear()
         while not self._waiting.empty():
             try:
                 req = self._waiting.get_nowait()
@@ -550,6 +795,14 @@ class LLMEngine:
         self._step_real = self._step_padded = 0
         self._step_out = self._step_prompt = 0
         self._step_spec_proposed = self._step_spec_accepted = 0
+        # Eager-ready drain BEFORE admission: fetch whatever the device
+        # already finished (non-blocking readiness probe), so a slot
+        # whose request ended re-tenants THIS step instead of
+        # pipeline_depth steps later. The depth is a cap on in-flight
+        # work (the only place the host may block), never a mandatory
+        # delay — on a fast link results drain one step after dispatch,
+        # on a slow link up to `depth` dispatches proceed unfetched.
+        self._drain_ready()
         admitted = self._admit()
         # at most one prefill chunk per step: decode cadence for running
         # slots is bounded by one chunk's latency, not a whole prompt's
@@ -584,8 +837,13 @@ class LLMEngine:
         except (IndexError, AttributeError):
             pass
         kv = self.host_kv_cache
+        with self._overlap_mu:
+            overlap_total = self._overlap_s
+        overlap_delta = overlap_total - self._overlap_seen
+        self._overlap_seen = overlap_total
         self.flight.record(
             dur_s=dur_s,
+            host_overlap_s=max(0.0, overlap_delta),
             mode=self._step_mode or "decode",
             slots_used=self.max_slots - len(self._free),
             waiting=self._waiting.qsize(),
@@ -738,23 +996,82 @@ class LLMEngine:
             plen = matched
             while plen > 0 and not fits(plen):
                 plen -= kv_cache.block_tokens
+            if plen > 0 and self._kv_stage is not None and fits(0):
+                # double-buffered staging: the gather (host memcpy) and
+                # upload (host→device) run on the kv-copy executor while
+                # this and later steps decode the running slots; the
+                # chunk job rendezvouses when it is actually reached.
+                # fits(0) guards the eviction fallback: a run that
+                # vanishes between match and gather cold-starts the job.
+                fut = self._kv_stage.submit(
+                    self._stage_prefix_fn(req, ids, plen, kv_cache)
+                )
+                return _ChunkJob(req=req, ids=list(ids), pending_kv=fut)
             got = (
-                kv_cache.gather_prefix(ids, plen) if plen > 0 else None
+                self._gather_and_upload(req, ids, plen, kv_cache)
+                if plen > 0 else None
             )
             if got is not None:
-                pk, pv = got
-                kv_cache.prefix_hits += 1
-                kv_cache.prefix_tokens_reused += plen
-                req.prefix_tokens_reused = plen
-                t0 = time.time()
-                k, v = self._upload_prefix(pk, pv, plen)
-                req.kv_upload_s = time.time() - t0
+                k, v, _ = got
                 return _ChunkJob(
                     req=req, ids=list(ids), done=plen, k=k, v=v,
                 )
         if fits(0):
             return _ChunkJob(req=req, ids=list(ids))
         return None
+
+    def _gather_and_upload(self, req, ids, plen: int, kv_cache):
+        """Gather a matched block run from host RAM and upload it at
+        bucket width. Returns ``(k, v, plen)``, or None when the run
+        evicted between match and gather. Hit counters and the request's
+        attribution are recorded here, success-only — the ONE
+        implementation behind both the staged (executor) and cold
+        (inline fallback) prefix paths, so their accounting can't
+        drift."""
+        got = kv_cache.gather_prefix(list(ids), plen)
+        if got is None:
+            return None
+        pk, pv = got
+        kv_cache.prefix_hits += 1
+        kv_cache.prefix_tokens_reused += plen
+        req.prefix_tokens_reused = plen
+        t0 = time.time()
+        k, v = self._upload_prefix(pk, pv, plen)
+        req.kv_upload_s = time.time() - t0
+        return k, v, plen
+
+    def _stage_prefix_fn(self, req, ids, plen: int, kv_cache):
+        """Build the kv-copy-executor job for a chunked prefix seed
+        (``_upload_prefix`` blocks off-thread — that wait IS the
+        overlap being bought)."""
+        ids_t = tuple(ids)
+
+        def stage():
+            t0 = time.perf_counter()
+            try:
+                return self._gather_and_upload(
+                    req, list(ids_t), plen, kv_cache
+                )
+            finally:
+                self._note_overlap(time.perf_counter() - t0)
+                self._notify_wake()
+        return stage
+
+    def _resolve_staged_prefix(self, job: "_ChunkJob") -> None:
+        """Rendezvous with a staged gather+upload — the designated wait
+        point (may block when the job is reached before the upload
+        lands, i.e. when there was no decode work to overlap with). A
+        failed or evicted stage cold-starts the job."""
+        fut, job.pending_kv = job.pending_kv, None
+        try:
+            got = fut.result()
+        except Exception as e:
+            logger.warning(
+                "prefix staging failed; cold chunked prefill: %s", e
+            )
+            got = None
+        if got is not None:
+            job.k, job.v, job.done = got
 
     def _upload_prefix(self, pk, pv, use_len: int):
         """Upload a matched prefix run padded to its BUCKET width, not
@@ -781,11 +1098,23 @@ class LLMEngine:
         return k, v
 
     def _advance_chunk(self) -> bool:
-        """Run ONE chunk of the oldest in-progress chunked prefill."""
+        """Run ONE chunk of the oldest runnable in-progress chunked
+        prefill. A job whose staged prefix upload is still in flight is
+        passed over while any decode work exists — that concurrency is
+        the double-buffer win; with nothing else to run, the oldest
+        upload is awaited instead."""
         if not self._chunk_jobs:
             return False
-        slot = next(iter(self._chunk_jobs))
-        job = self._chunk_jobs[slot]
+        slot = job = None
+        for s, j in self._chunk_jobs.items():
+            if j.pending_kv is None or j.pending_kv.done():
+                slot, job = s, j
+                break
+        if job is None:
+            if self._slots:
+                return False   # decode while the upload lands
+            slot = next(iter(self._chunk_jobs))
+            job = self._chunk_jobs[slot]
         if job.req.aborted.is_set():
             # abandon the remaining chunks; the slot never activated
             del self._chunk_jobs[slot]
@@ -798,6 +1127,8 @@ class LLMEngine:
                 abort_op()
             self._finish_aborted(job.req)
             return True
+        if job.pending_kv is not None:
+            self._resolve_staged_prefix(job)
         start = job.done
         chunk = job.ids[start : start + self.prefill_chunk]
         self._step_mode = self._step_mode or "prefill_chunk"
@@ -878,12 +1209,7 @@ class LLMEngine:
             self._step_real += len(ids)
             self._step_prompt += len(ids)
             self._step_padded += bucket
-            embeds, mask = req.embeds_override
-            pad_rows = bucket - len(ids)
-            embeds = np.pad(
-                np.asarray(embeds, np.float32), ((0, pad_rows), (0, 0))
-            )
-            mask = np.pad(np.asarray(mask, bool), (0, pad_rows))
+            embeds, mask = self._padded_embeds(req, bucket, len(ids))
             last_logits, k, v = self.runner.prefill_with_embeds(
                 padded, len(ids), embeds, mask
             )
@@ -959,6 +1285,18 @@ class LLMEngine:
             self._submit_kv_copy(ids, k, v, len(ids))
         self._finalize_start(slot, req, last_logits, k, v)
 
+    @staticmethod
+    def _padded_embeds(req: GenRequest, bucket: int, n_ids: int):
+        """Bucket-pad a VLM request's override embeddings (host-side np
+        prep — kept out of the declared dispatch functions)."""
+        embeds, mask = req.embeds_override
+        pad_rows = bucket - n_ids
+        embeds = np.pad(
+            np.asarray(embeds, np.float32), ((0, pad_rows), (0, 0))
+        )
+        mask = np.pad(np.asarray(mask, bool), (0, pad_rows))
+        return embeds, mask
+
     def _submit_kv_copy(self, seq, k_dev, v_dev, total: int) -> None:
         """Queue an async device→host copy + block insert of ``seq``'s
         KV. The device arrays may be wider than ``total`` (bucket or
@@ -1025,12 +1363,40 @@ class LLMEngine:
         k_dev, v_dev = self.runner.slot_kv(self._state, slot, width)
         self._submit_kv_copy(seq, k_dev, v_dev, total)
 
+    def _new_slot_info(self, req: GenRequest) -> _SlotInfo:
+        info = _SlotInfo(request=req)
+        # Stop strings and JSON-mode termination decide WHICH tokens
+        # count from decoded text, so their detok must stay inline on
+        # the scheduler (decision before the next delivery) — plain
+        # requests stream through the detok worker in overlap mode.
+        info.sync_detok = (
+            not self.overlap
+            or bool(req.stop_texts)
+            or req.json_mode
+        )
+        if req.json_mode:
+            from gpustack_tpu.engine.openai_tools import JsonScanner
+
+            info.json_scan = JsonScanner()
+        if self.speculative == "ngram":
+            info.ngram = _NgramIndex(req.prompt_ids)
+        return info
+
     def _finalize_start(
         self, slot: int, req: GenRequest, last_logits, k, v
     ) -> None:
-        """Insert a finished prefill into the decode state and deliver
-        the first token (shared by the one-shot, cached and chunked
-        prefill paths)."""
+        """Insert a finished prefill into the decode state and feed the
+        first sampled token (shared by the one-shot, cached and chunked
+        prefill paths).
+
+        Overlap mode: the sampled token never touches the host here —
+        ``insert`` consumes it as a device scalar, and the host learns
+        it through the fetch pipeline like any decode token, so
+        admission N+1 dispatches while N's prefill+sample is still in
+        flight on device. Speculative modes (the proposers need exact
+        host state) and logprobs requests (per-token arrays wanted
+        immediately) take the synchronous path.
+        """
         ids = req.prompt_ids
         # First generated token through the runner's device sampler
         # (multi-host followers replay the same call). Seeded rows draw
@@ -1046,6 +1412,35 @@ class LLMEngine:
             seed, req.seed is not None, len(ids) - 1, first_key,
             logit_bias=req.logit_bias,
         )
+        if (
+            self.overlap
+            and not self.speculative
+            and not req.logprobs
+            and getattr(self.runner, "supports_async_insert", False)
+        ):
+            self._state = self.runner.insert(
+                self._state, k, v, slot, len(ids), toks[0],
+                req.temperature, req.top_k, req.top_p,
+                seed, req.seed is not None, req.logit_bias,
+            )
+            self._slots[slot] = self._new_slot_info(req)
+            # deferred first-token feed: fetched (and rolled back if the
+            # request was aborted meanwhile) with the decode pipeline
+            self._pending.append(
+                (("first", toks), {slot: req.request_id})
+            )
+            return
+        self._finalize_start_sync(
+            slot, req, k, v, seed, toks, tok_lp, top_ids, top_lps
+        )
+
+    def _finalize_start_sync(
+        self, slot, req, k, v, seed, toks, tok_lp, top_ids, top_lps
+    ) -> None:
+        """Synchronous first-token path (serial mode, speculative
+        proposers, logprobs, multi-host broadcast runners): reads the
+        sampled token to the host before insert — a designated sync."""
+        ids = req.prompt_ids
         first = int(toks[0])
         first_lps = None
         if req.logprobs:
@@ -1058,20 +1453,13 @@ class LLMEngine:
                     )
                 ],
             )]
-        req.first_token_at = time.time()
         self._state = self.runner.insert(
             self._state, k, v, slot, len(ids), first,
             req.temperature, req.top_k, req.top_p,
             seed, req.seed is not None, req.logit_bias,
         )
-        info = _SlotInfo(request=req)
-        if req.json_mode:
-            from gpustack_tpu.engine.openai_tools import JsonScanner
-
-            info.json_scan = JsonScanner()
-        if self.speculative == "ngram":
-            info.ngram = _NgramIndex(req.prompt_ids)
-        elif self.draft_runner is not None:
+        info = self._new_slot_info(req)
+        if self.draft_runner is not None:
             # mirror the slot on the draft: prefill + insert (greedy)
             dk_bucket = self.draft_runner.bucket_for(max(1, len(ids)))
             d_padded = list(ids) + [0] * (dk_bucket - len(ids))
@@ -1134,7 +1522,7 @@ class LLMEngine:
             self._step_real += len(owners)
             self._step_padded += self.max_slots
         self._step_count += 1
-        if len(self._pending) > _FETCH_LAG:
+        if len(self._pending) > self.pipeline_depth:
             self._process_fetch(*self._pending.pop(0))
 
     def _note_spec_dispatch(self, active: int) -> None:
@@ -1149,8 +1537,9 @@ class LLMEngine:
 
     def _spec_safe(self) -> bool:
         """Spec steps write P KV slots contiguously; stay clear of the
-        cache end (host view lags by _FETCH_LAG steps, so add margin)."""
-        margin = self.spec_tokens * (_FETCH_LAG + 2)
+        cache end (host view lags by pipeline_depth steps, so add
+        margin)."""
+        margin = self.spec_tokens * (self.pipeline_depth + 2)
         for info in self._slots.values():
             req = info.request
             used = len(req.prompt_ids) + len(req.output_ids)
@@ -1220,6 +1609,24 @@ class LLMEngine:
         )
         return proposals
 
+    @staticmethod
+    def _entry_ready(entry) -> bool:
+        """Non-blocking: has the device finished computing this pending
+        entry's tokens? (hasattr-guarded — jax builds in this container
+        drift across 0.4.x; without the probe, entries wait out the
+        full pipeline depth, which is correct, just lazier)."""
+        (kind, payload), _ = entry
+        arr = payload if kind == "first" else payload[0]
+        ready = getattr(arr, "is_ready", None)
+        return bool(ready()) if ready is not None else False
+
+    def _drain_ready(self) -> None:
+        """Fetch every leading pending entry whose device work already
+        completed — the fetches are free (no wait), and delivering them
+        promptly keeps slot turnover at serial-mode latency."""
+        while self._pending and self._entry_ready(self._pending[0]):
+            self._process_fetch(*self._pending.pop(0))
+
     def _drain_pending(self) -> None:
         while self._pending:
             self._process_fetch(*self._pending.pop(0))
@@ -1227,6 +1634,17 @@ class LLMEngine:
     def _process_fetch(self, out, owners: Dict[int, str]) -> None:
         kind, payload = out
         lp_arr = top_ids_arr = top_lps_arr = None
+        if kind == "first":
+            # deferred first token from an overlapped admission: one row
+            ((slot, owner_id),) = owners.items()
+            info = self._slots.get(slot)
+            if info is None or info.request.request_id != owner_id:
+                # admission was aborted/finished before the fetch —
+                # the speculative feed rolls back
+                self.flight.note_rollback(1)
+                return
+            self._deliver(slot, info, [int(np.asarray(payload)[0])])
+            return
         if kind == "spec":
             tok_arr, produced = (np.asarray(x) for x in payload)
         else:
@@ -1237,13 +1655,18 @@ class LLMEngine:
             top_ids_arr = np.asarray(top_ids)
             top_lps_arr = np.asarray(top_lps)
         for slot, owner_id in owners.items():
-            info = self._slots.get(slot)
-            if info is None or info.request.request_id != owner_id:
-                continue
             n = (
                 int(produced[slot]) if produced is not None
                 else tok_arr.shape[1]
             )
+            info = self._slots.get(slot)
+            if info is None or info.request.request_id != owner_id:
+                # rollback: this step was dispatched before a lagged
+                # fetch ended (or re-tenanted) the slot — its tokens
+                # never existed as far as any request is concerned
+                if n > 0:
+                    self.flight.note_rollback(n)
+                continue
             if n <= 0:
                 continue
             if produced is not None:
@@ -1266,13 +1689,19 @@ class LLMEngine:
         self, slot: int, info: _SlotInfo, toks: List[int], lps=None
     ) -> None:
         """Deliver newly generated tokens (``lps``: optional aligned list
-        of (token_logprob, [(id, logprob) alternatives]))."""
+        of (token_logprob, [(id, logprob) alternatives])). Termination
+        is decided here at the id level; detokenization either runs
+        inline (``sync_detok`` — serial mode, stop strings, JSON mode)
+        or is batched onto the detok worker."""
         req = info.request
         if req.aborted.is_set():
             # client disconnected mid-generation: free the slot now
             # instead of decoding to max_tokens for nobody
             self._finish(slot, info, "abort")
             return
+        if not req.first_token_at:
+            req.first_token_at = time.time()
+        offload: List[int] = []
         for j, tok in enumerate(toks):
             is_eos = tok in self.tokenizer.eos_ids or tok in req.stop_ids
             if not is_eos:
@@ -1282,21 +1711,34 @@ class LLMEngine:
                     req.output_top_logprobs.append(lps[j][1])
                 self._tokens_generated += 1
                 self._step_out += 1
-                info.buffer_ids.append(tok)
                 if info.ngram is not None:
                     info.ngram.append(tok)
                 if self.draft_runner is not None:
                     info.pending_draft.append(tok)
-                if self._emit_text(info, final=False):
-                    self._finish(slot, info, "stop")
-                    return
+                if info.sync_detok:
+                    info.buffer_ids.append(tok)
+                    if self._emit_text(info, final=False):
+                        dropped = len(toks) - j - 1
+                        if dropped:
+                            self.flight.note_rollback(dropped)
+                        self._finish(slot, info, "stop")
+                        return
+                else:
+                    offload.append(tok)
             at_cap = (
                 len(req.prompt_ids) + len(req.output_ids)
                 >= self.max_seq_len - 1
             )
             if is_eos or at_cap or len(req.output_ids) >= req.max_tokens:
+                dropped = len(toks) - j - 1
+                if dropped:
+                    self.flight.note_rollback(dropped)
+                if offload:
+                    self._detok.put_tokens(info, offload)
                 self._finish(slot, info, "stop" if is_eos else "length")
                 return
+        if offload:
+            self._detok.put_tokens(info, offload)
 
     def _emit_text(self, info: _SlotInfo, final: bool) -> bool:
         """Advance incremental detokenization; stream newly-safe text.
@@ -1352,11 +1794,13 @@ class LLMEngine:
 
     def _finish(self, slot: int, info: _SlotInfo, reason: str) -> None:
         req = info.request
-        # A late stop-match during the final flush upgrades the reason.
-        if self._emit_text(info, final=True):
-            reason = "stop"
+        if info.sync_detok:
+            # A late stop-match during the final flush upgrades the
+            # reason (only sync requests can carry stop strings).
+            if self._emit_text(info, final=True):
+                reason = "stop"
+            req.output_text = info.text
         req.finish_reason = reason
-        req.output_text = info.text
         req.finished_at = time.time()
         if reason in ("stop", "length"):
             # aborted/errored slots may have undelivered device state;
@@ -1377,6 +1821,12 @@ class LLMEngine:
             )
         del self._slots[slot]
         self._free.append(slot)
-        if req.stream is not None:
-            req.stream.put(None)  # sentinel: stream end
-        req.done.set()
+        if info.sync_detok:
+            if req.stream is not None:
+                req.stream.put(None)  # sentinel: stream end
+            req.done.set()
+        else:
+            # the final flush, stream sentinel and done event ride the
+            # detok worker: the FIFO queue keeps them behind this
+            # request's last token batch
+            self._detok.finish(info)

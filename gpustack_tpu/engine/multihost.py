@@ -190,6 +190,12 @@ class BroadcastingRunner:
     """Wraps the leader's ModelRunner: every replayable device op is
     broadcast to the followers before running locally."""
 
+    # insert() serializes its args onto the follower command channel
+    # (ints on the wire), so the engine's dispatch-ahead admission must
+    # NOT hand it a device-scalar first token. Class attr (not
+    # __getattr__-delegated) so the wrapped runner's True never leaks.
+    supports_async_insert = False
+
     def __init__(self, runner, leader: CommandLeader):
         self._runner = runner
         self._leader = leader

@@ -39,7 +39,7 @@ from gpustack_tpu.models.config import ModelConfig
 from gpustack_tpu.models.quant import QuantW, quant_pspecs
 from gpustack_tpu.models.transformer import KVCache, forward
 from gpustack_tpu.parallel.mesh import MeshPlan, make_mesh
-from gpustack_tpu.parallel.sharding import cache_pspec, param_pspecs
+from gpustack_tpu.parallel.sharding import SpecLayout, param_pspecs
 
 
 def bias_arrays(logit_bias):
@@ -78,6 +78,12 @@ class DecodeState:
 
 class ModelRunner:
     """Owns sharded params + jitted prefill/insert/decode for one model."""
+
+    # insert() accepts the first token as a device scalar (no host
+    # roundtrip) — the engine's dispatch-ahead admission relies on this.
+    # The multi-host BroadcastingRunner does NOT set it: it serializes
+    # insert args onto the follower command channel, which needs ints.
+    supports_async_insert = True
 
     def __init__(
         self,
@@ -147,10 +153,20 @@ class ModelRunner:
             is_leaf=lambda x: isinstance(x, (QuantW, P)),
         )
 
+        # The replica's whole multi-chip layout as ONE inspectable
+        # object (parallel/sharding.SpecLayout): every NamedSharding the
+        # runner dispatches against derives from it, and the engine
+        # serves layout.describe() on its health surface.
+        self.layout = SpecLayout(long_context=self.sp_mode)
         self._cache_sharding = NamedSharding(
-            self.mesh, cache_pspec(long_context=self.sp_mode)
+            self.mesh, self.layout.cache()
         )
-        self._slot_sharding = NamedSharding(self.mesh, P(None))
+        self._slot_sharding = NamedSharding(
+            self.mesh, self.layout.slot_state()
+        )
+        self._replicated = NamedSharding(
+            self.mesh, self.layout.replicated()
+        )
 
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefills: Dict[int, Any] = {}
@@ -467,7 +483,7 @@ class ModelRunner:
         # unconstrained output can land dp/tp-sharded and span
         # non-addressable devices — force replication (an allgather over
         # a few hundred bytes)
-        rep = NamedSharding(self.mesh, P())
+        rep = self._replicated
         sampled, tok_lp, top_ids, top_lps = (
             jax.lax.with_sharding_constraint(x, rep)
             for x in (sampled, tok_lp, top_ids, top_lps)
@@ -509,7 +525,7 @@ class ModelRunner:
         )
         outs = sample(last_logits[None, :], st, key, position[None])
         # host-read outputs must be replicated on multi-host meshes
-        rep = NamedSharding(self.mesh, P())
+        rep = self._replicated
         return tuple(
             jax.lax.with_sharding_constraint(x, rep) for x in outs
         )

@@ -29,6 +29,18 @@ Record vocabulary (per step):
   counting smooths that honestly).
 - ``spec_proposed``/``spec_accepted`` — speculation economics.
 - ``kv_blocks``/``kv_reused_total`` — host KV cache pressure.
+- ``host_overlap_ms`` — host work (detokenization, SSE stream writes,
+  KV staging copies) done on worker threads DURING this step instead of
+  on the scheduler: the overlapped engine's win, phase-attributed.
+  ``host_overlap_ratio`` (aggregate) is overlapped host ms / step wall
+  ms and can exceed 1.0 when several workers overlap one step.
+
+Cumulative (not per-record): ``idle_wait_s_total`` — seconds the
+scheduler parked on its wakeup condition instead of busy-polling (the
+old 2 ms sleep loop, measured as saved spin); ``rollback_tokens_total``
+— speculatively generated tokens the pipeline rolled back because a
+lagged fetch revealed their slot finished/diverged (the cost of
+dispatch-ahead, which must stay a sliver of tokens_out).
 
 Everything here is dependency-free and import-light (no jax) so the
 stub engine and bench can share the exact contract.
@@ -81,6 +93,7 @@ def aggregate_records(
     waits: List[float] = []
     real = padded = tokens_out = proposed = accepted = 0
     prompt = 0
+    overlap_ms = dur_ms = 0.0
     for e in entries:
         by_mode.setdefault(e["mode"], []).append(e["dur_ms"])
         occ.append(e["slots_used"] / max(1, slots_total))
@@ -91,6 +104,8 @@ def aggregate_records(
         prompt += e.get("prompt_tokens", 0)
         proposed += e["spec_proposed"]
         accepted += e["spec_accepted"]
+        overlap_ms += e.get("host_overlap_ms", 0.0)
+        dur_ms += e["dur_ms"]
     occ.sort()
     waits.sort()
     span_s = (
@@ -125,6 +140,12 @@ def aggregate_records(
         ),
         kv_blocks=entries[-1]["kv_blocks"],
         kv_reused_total=entries[-1]["kv_reused_total"],
+        host_overlap_ms=round(overlap_ms, 3),
+        # overlapped host work / scheduler step wall time; > 1.0 means
+        # several worker threads overlapped the same step
+        host_overlap_ratio=(
+            round(overlap_ms / dur_ms, 4) if dur_ms else 0.0
+        ),
     )
     if span_s:
         out["tokens_out_per_s"] = round(tokens_out / span_s, 2)
@@ -165,6 +186,13 @@ class FlightRecorder:
         self._last_waiting = 0
         self._last_oldest_wait_s = 0.0
         self._last_kv_blocks = 0
+        # overlapped-engine accounting (ISSUE 12): cumulative host work
+        # overlapped with device compute, scheduler idle-park seconds
+        # (the spin the condition-variable wakeup saves), and tokens the
+        # dispatch-ahead pipeline rolled back after a lagged fetch
+        self.host_overlap_s_total = 0.0
+        self.idle_wait_s_total = 0.0
+        self.rollback_tokens_total = 0
         # self-measurement
         self._record_s = 0.0
         self._step_s = 0.0
@@ -187,6 +215,7 @@ class FlightRecorder:
         spec_accepted: int = 0,
         kv_blocks: int = 0,
         kv_reused_total: int = 0,
+        host_overlap_s: float = 0.0,
     ) -> None:
         t0 = time.perf_counter()
         with self._mu:
@@ -194,7 +223,7 @@ class FlightRecorder:
                 time.time(), dur_s, mode, slots_used, waiting,
                 oldest_wait_s, tokens_real, tokens_padded, tokens_out,
                 prompt_tokens, spec_proposed, spec_accepted, kv_blocks,
-                kv_reused_total,
+                kv_reused_total, host_overlap_s,
             ))
             h = self._hist.get(mode)
             if h is None:
@@ -214,14 +243,28 @@ class FlightRecorder:
             self._last_waiting = waiting
             self._last_oldest_wait_s = oldest_wait_s
             self._last_slots_used = slots_used
+            self.host_overlap_s_total += host_overlap_s
             self._step_s += dur_s
             self._record_s += time.perf_counter() - t0
+
+    def note_idle_wait(self, seconds: float) -> None:
+        """Scheduler parked on its wakeup condition for ``seconds`` —
+        spin time the condition-variable loop saved vs. busy-polling."""
+        with self._mu:
+            self.idle_wait_s_total += seconds
+
+    def note_rollback(self, tokens: int) -> None:
+        """``tokens`` speculatively generated tokens discarded because a
+        lagged fetch revealed their slot finished or was re-tenanted."""
+        with self._mu:
+            self.rollback_tokens_total += tokens
 
     @staticmethod
     def _to_entry(row) -> Dict[str, Any]:
         (ts, dur_s, mode, slots_used, waiting, oldest_wait_s,
          tokens_real, tokens_padded, tokens_out, prompt_tokens,
-         spec_proposed, spec_accepted, kv_blocks, kv_reused_total) = row
+         spec_proposed, spec_accepted, kv_blocks, kv_reused_total,
+         host_overlap_s) = row
         return {
             "ts": ts,
             "dur_ms": round(dur_s * 1e3, 4),
@@ -237,6 +280,7 @@ class FlightRecorder:
             "spec_accepted": spec_accepted,
             "kv_blocks": kv_blocks,
             "kv_reused_total": kv_reused_total,
+            "host_overlap_ms": round(host_overlap_s * 1e3, 4),
         }
 
     # ---- read side -----------------------------------------------------
@@ -247,6 +291,13 @@ class FlightRecorder:
         if self._step_s <= 0.0:
             return 0.0
         return self._record_s / self._step_s
+
+    def host_overlap_ratio(self) -> float:
+        """Cumulative overlapped host seconds / cumulative step wall
+        time (can exceed 1.0 with several overlapping workers)."""
+        if self._step_s <= 0.0:
+            return 0.0
+        return self.host_overlap_s_total / self._step_s
 
     def snapshot(self, limit: int = 200) -> List[Dict[str, Any]]:
         """Newest-last copy of the most recent ``limit`` records."""
@@ -348,5 +399,14 @@ class FlightRecorder:
             decl("gpustack_engine_flight_overhead_ratio"),
             f"gpustack_engine_flight_overhead_ratio "
             f"{self.overhead_ratio():.6f}",
+            decl("gpustack_engine_host_overlap_ratio"),
+            f"gpustack_engine_host_overlap_ratio "
+            f"{self.host_overlap_ratio():.6f}",
+            decl("gpustack_engine_idle_wait_seconds_total"),
+            f"gpustack_engine_idle_wait_seconds_total "
+            f"{self.idle_wait_s_total:.6f}",
+            decl("gpustack_engine_rollback_tokens_total"),
+            f"gpustack_engine_rollback_tokens_total "
+            f"{self.rollback_tokens_total}",
         ]
         return lines

@@ -53,6 +53,12 @@ METRIC_FAMILIES = {
     "gpustack_engine_spec_accepted_total": "counter",
     "gpustack_engine_kv_blocks_used": "gauge",
     "gpustack_engine_flight_overhead_ratio": "gauge",
+    # overlapped engine (ISSUE 12): host work overlapped with device
+    # compute, idle spin saved by the cv wakeup, and dispatch-ahead
+    # tokens rolled back after a lagged fetch
+    "gpustack_engine_host_overlap_ratio": "gauge",
+    "gpustack_engine_idle_wait_seconds_total": "counter",
+    "gpustack_engine_rollback_tokens_total": "counter",
     # proxy-side usage metering (routes/openai_proxy.py _record_usage):
     # per-model token throughput on /metrics instead of DB-only, plus a
     # loss counter so silently-swallowed usage writes become visible

@@ -206,6 +206,7 @@ ROLLOUT_FIELDS = (
     "kv_block_tokens",
     "kv_cache_int8",
     "prefill_chunk",
+    "engine_pipeline_depth",
     "lora_adapters",
 )
 
@@ -275,6 +276,13 @@ class Model(Record):
     # in chunks with decode steps interleaved (vLLM enable-chunked-prefill
     # role; bounds long-prompt impact on running slots' token cadence)
     prefill_chunk: int = 0
+    # engine decode-fetch pipeline depth (dispatch-ahead overlap,
+    # docs/ENGINE_PIPELINE.md): sampled-token fetches lag dispatch by
+    # this many steps so host work overlaps device compute. 0 = inherit
+    # the config default (GPUSTACK_TPU_ENGINE_PIPELINE_DEPTH, default
+    # 2); negative = serial reference mode (fetch + inline detok every
+    # step)
+    engine_pipeline_depth: int = 0
     # LoRA adapters merged into the base weights at load (reference
     # lora_model_routes.py role; merged-at-load is the TPU-friendly
     # shape — zero runtime overhead, one instance per adapter set)

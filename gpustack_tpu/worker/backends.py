@@ -179,6 +179,12 @@ def _tpu_native_command(
         # the placements that need chunking most (70B-class multi-host)
         # no longer lose it
         argv += ["--prefill-chunk", str(model.prefill_chunk)]
+    if model.engine_pipeline_depth:
+        # per-model dispatch-ahead depth; negative = serial mode (0).
+        # Unset (0) lets the engine read the config/env default.
+        argv += [
+            "--pipeline-depth", str(max(0, model.engine_pipeline_depth))
+        ]
     if model.host_kv_cache_mb and not multi_host:
         # single-host only: on multi-host meshes the prefill K/V spans
         # non-addressable devices and cannot be pulled to one host's RAM

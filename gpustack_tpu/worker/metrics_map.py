@@ -47,6 +47,12 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_engine_spec_accepted_total":
         "gpustack_tpu:spec_accepted_total",
     "gpustack_engine_kv_blocks_used": "gpustack_tpu:kv_blocks_used",
+    "gpustack_engine_host_overlap_ratio":
+        "gpustack_tpu:host_overlap_ratio",
+    "gpustack_engine_idle_wait_seconds_total":
+        "gpustack_tpu:idle_wait_seconds_total",
+    "gpustack_engine_rollback_tokens_total":
+        "gpustack_tpu:rollback_tokens_total",
     "gpustack_engine_flight_overhead_ratio":
         "gpustack_tpu:flight_overhead_ratio",
     # proxy-side usage metering (routes/openai_proxy.py): mapped so a
@@ -109,6 +115,9 @@ NORMALIZED_FAMILIES: Dict[str, str] = {
     "gpustack_tpu:spec_accepted_total": "counter",
     "gpustack_tpu:kv_blocks_used": "gauge",
     "gpustack_tpu:flight_overhead_ratio": "gauge",
+    "gpustack_tpu:host_overlap_ratio": "gauge",
+    "gpustack_tpu:idle_wait_seconds_total": "counter",
+    "gpustack_tpu:rollback_tokens_total": "counter",
     "gpustack_tpu:scrape_age_seconds": "gauge",
     "gpustack_tpu:model_usage_tokens_total": "counter",
 }

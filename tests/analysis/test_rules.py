@@ -23,6 +23,7 @@ from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
 from gpustack_tpu.analysis.rules.locks import HeldAcrossAwaitRule
 from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
 from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
+from gpustack_tpu.analysis.rules.sync_dispatch import SyncInDispatchRule
 
 
 def make_tree(root, files):
@@ -730,6 +731,92 @@ class TestMetricsDrift:
             and "NORMALIZED_FAMILIES" in f.message
             for f in found
         )
+
+
+# ---------------------------------------------------------------------------
+# sync-in-dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestSyncInDispatch:
+    def run_on(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/eng.py": body})
+        return run(tmp_path, [SyncInDispatchRule()]).new
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            # np.asarray through the usual alias
+            'import numpy as np\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return np.asarray(x)\n",
+            # bare asarray via from-import
+            'from numpy import asarray\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return asarray(x)\n",
+            # device scalar sync
+            'DISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return x.item()\n",
+            # explicit waits
+            'import jax\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    jax.block_until_ready(x)\n",
+            'from jax import device_get\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return device_get(x)\n",
+            # methods inside classes are checked too
+            'import numpy as np\nDISPATCH_SYNC_FREE = ("step",)\n'
+            "class E:\n    def step(self, x):\n"
+            "        return np.asarray(x)\n",
+        ],
+    )
+    def test_fires(self, tmp_path, snippet):
+        found = self.run_on(tmp_path, snippet)
+        assert len(found) == 1, found
+        assert found[0].rule == "sync-in-dispatch"
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            # no declaration: module opted out entirely
+            "import numpy as np\ndef f(x):\n    return np.asarray(x)\n",
+            # sync in an UNLISTED function (a designated fetch helper)
+            'import numpy as np\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return g(x)\n"
+            "def g(x):\n    return np.asarray(x)\n",
+            # nested def bodies run on worker threads — exempt
+            'import numpy as np\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n"
+            "    def work():\n        return np.asarray(x)\n"
+            "    return work\n",
+            # .items() is not .item()
+            'DISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(d):\n    return d.items()\n",
+            # async dispatch (jnp.asarray) is not a sync
+            'import jax.numpy as jnp\nDISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n    return jnp.asarray(x)\n",
+        ],
+    )
+    def test_quiet(self, tmp_path, snippet):
+        assert self.run_on(tmp_path, snippet) == []
+
+    def test_suppression_comment(self, tmp_path):
+        body = (
+            "import numpy as np\n"
+            'DISPATCH_SYNC_FREE = ("f",)\n'
+            "def f(x):\n"
+            "    # host-only array, reviewed\n"
+            "    return np.asarray(x)  "
+            "# analysis: ignore[sync-in-dispatch]\n"
+        )
+        assert self.run_on(tmp_path, body) == []
+
+    def test_engine_declaration_matches_real_functions(self):
+        """The declared dispatch path must name real LLMEngine
+        functions — a rename that orphans the contract fails here, not
+        silently ungates the rule."""
+        from gpustack_tpu.engine import engine as engine_mod
+
+        for name in engine_mod.DISPATCH_SYNC_FREE:
+            assert hasattr(engine_mod.LLMEngine, name) or hasattr(
+                engine_mod, name
+            ), f"DISPATCH_SYNC_FREE names unknown function {name!r}"
 
 
 # ---------------------------------------------------------------------------

@@ -163,7 +163,18 @@ def test_capture_profile_degrades_without_profiler(
 
 def test_capture_profile_idle_times_out_gracefully(engine):
     """No traffic: the capture returns empty at its deadline instead
-    of blocking forever."""
+    of blocking forever. The overlapped engine may still be sealing a
+    previous request's final step (done is set by the detok worker
+    before the scheduler's step record lands) — wait for quiescence so
+    'idle' is actually idle."""
+    import time
+
+    deadline = time.time() + 10
+    while (
+        (engine._pending or engine._slots) and time.time() < deadline
+    ):
+        time.sleep(0.01)
+    time.sleep(0.1)   # let the in-flight step seal its record
     result = engine.capture_profile(3, out_dir="", timeout_s=0.3)
     assert result["profiler"] == "flight-only"
     assert result["steps_captured"] == 0

@@ -1,0 +1,286 @@
+"""Overlapped vs serial engine (ISSUE 12): dispatch-ahead pipeline,
+deferred first-token feed, async detokenization, rollback of the
+speculative feed when a lagged fetch ends a slot — greedy outputs must
+be bit-identical between modes on a seeded schedule, and the flight
+recorder must attribute the overlap. Hermetic: tiny model, CPU."""
+
+import queue
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gpustack_tpu.engine.engine import GenRequest, LLMEngine
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _schedule(cfg, seed=0, n=7):
+    """Seeded request shapes: varied prompt lengths and budgets so
+    admissions, finishes and re-tenanting interleave across slots."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(3, 24))
+        out.append(dict(
+            prompt_ids=rng.integers(1, cfg.vocab_size, plen).tolist(),
+            max_tokens=int(rng.integers(1, 10)),
+        ))
+    return out
+
+
+def _run(cfg, params, sched, depth, **req_extra):
+    eng = LLMEngine(
+        cfg, params, max_slots=3, max_seq_len=64, pipeline_depth=depth
+    )
+    eng.start()
+    try:
+        reqs = [
+            eng.submit(GenRequest(
+                temperature=0.0, stop_ids=(), **r, **req_extra
+            ))
+            for r in sched
+        ]
+        for r in reqs:
+            assert r.done.wait(180), r.request_id
+    finally:
+        eng.stop()
+    return eng, reqs
+
+
+def test_overlap_serial_greedy_parity(setup):
+    """The acceptance gate: identical seeded traffic through a serial
+    (pipeline_depth=0) and an overlapped engine yields bit-exact greedy
+    tokens, finish reasons, and decoded text."""
+    cfg, params = setup
+    sched = _schedule(cfg)
+    serial_eng, serial = _run(cfg, params, sched, depth=0)
+    over_eng, over = _run(cfg, params, sched, depth=2)
+    assert not serial_eng.overlap and over_eng.overlap
+    for s, o in zip(serial, over):
+        assert s.output_ids == o.output_ids, s.request_id
+        assert s.finish_reason == o.finish_reason
+        assert s.output_text == o.output_text
+    # every request produced something and the engines agree on totals
+    assert sum(len(r.output_ids) for r in over) > 0
+
+
+def test_overlap_parity_with_stop_texts(setup):
+    """Stop-string requests keep synchronous detok in overlap mode so
+    their token accounting stays mode-independent."""
+    cfg, params = setup
+    sched = _schedule(cfg, seed=3, n=4)
+    _, serial = _run(
+        cfg, params, sched, depth=0, stop_texts=("§nope§",)
+    )
+    _, over = _run(
+        cfg, params, sched, depth=2, stop_texts=("§nope§",)
+    )
+    for s, o in zip(serial, over):
+        assert s.output_ids == o.output_ids
+        assert s.output_text == o.output_text
+
+
+def test_overlap_logprobs_takes_sync_path_and_matches(setup):
+    """logprobs requests fall back to the synchronous first-token path;
+    outputs and logprob alignment still match the serial engine."""
+    cfg, params = setup
+    sched = _schedule(cfg, seed=5, n=3)
+    _, serial = _run(
+        cfg, params, sched, depth=0, logprobs=True, top_logprobs=2
+    )
+    _, over = _run(
+        cfg, params, sched, depth=2, logprobs=True, top_logprobs=2
+    )
+    for s, o in zip(serial, over):
+        assert s.output_ids == o.output_ids
+        assert len(o.output_logprobs) == len(o.output_ids)
+        assert np.allclose(
+            s.output_logprobs, o.output_logprobs, atol=1e-4
+        )
+
+
+def test_rollback_when_lagged_fetch_ends_slot(setup):
+    """max_tokens=1 finishes at the deferred first-token fetch while
+    later decode dispatches are still in flight: those tokens roll back
+    (counted), and the slot re-tenants cleanly for the next request."""
+    cfg, params = setup
+    eng = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=64, pipeline_depth=2
+    )
+    eng.start()
+    try:
+        r1 = eng.generate(
+            GenRequest(
+                prompt_ids=[5, 9, 3], max_tokens=1, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=120,
+        )
+        assert len(r1.output_ids) == 1
+        assert r1.finish_reason == "length"
+        # the in-flight dispatches drain asynchronously after done
+        deadline = time.time() + 10
+        while (
+            eng.flight.rollback_tokens_total == 0
+            and time.time() < deadline
+        ):
+            time.sleep(0.02)
+        assert eng.flight.rollback_tokens_total > 0
+        # re-tenant the same slot: output must match a serial engine
+        r2 = eng.generate(
+            GenRequest(
+                prompt_ids=[7, 2, 11, 4], max_tokens=5,
+                temperature=0.0, stop_ids=(),
+            ),
+            timeout=120,
+        )
+    finally:
+        eng.stop()
+    serial = LLMEngine(
+        cfg, params, max_slots=1, max_seq_len=64, pipeline_depth=0
+    )
+    serial.start()
+    try:
+        s2 = serial.generate(
+            GenRequest(
+                prompt_ids=[7, 2, 11, 4], max_tokens=5,
+                temperature=0.0, stop_ids=(),
+            ),
+            timeout=120,
+        )
+    finally:
+        serial.stop()
+    assert r2.output_ids == s2.output_ids
+
+
+def test_streaming_through_detok_worker(setup):
+    """Async-detok streams deliver exactly the decoded output text,
+    then the sentinel, then done."""
+    cfg, params = setup
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=64, pipeline_depth=2
+    )
+    eng.start()
+    try:
+        q = queue.Queue()
+        req = eng.generate(
+            GenRequest(
+                prompt_ids=[72, 102, 109], max_tokens=6,
+                temperature=0.0, stop_ids=(), stream=q,
+            ),
+            timeout=120,
+        )
+        pieces = []
+        while True:
+            item = q.get(timeout=10)
+            if item is None:
+                break
+            pieces.append(item)
+        assert "".join(p for _, p in pieces) == req.output_text
+        assert req.output_text == eng.tokenizer.decode(req.output_ids)
+    finally:
+        eng.stop()
+
+
+def test_flight_overlap_accounting(setup):
+    """The flight recorder attributes the overlap: host_overlap fields
+    present per record, cumulative ratio > 0 with offloaded detok, and
+    recorder overhead stays under the 1% budget with overlap on."""
+    cfg, params = setup
+    sched = _schedule(cfg, seed=9, n=8)
+    eng, _ = _run(cfg, params, sched, depth=2)
+    assert eng.flight.host_overlap_s_total > 0
+    agg = eng.flight.aggregate()
+    assert "host_overlap_ratio" in agg and "host_overlap_ms" in agg
+    assert agg["host_overlap_ms"] > 0
+    snap = eng.flight.snapshot(limit=5)
+    assert all("host_overlap_ms" in e for e in snap)
+    # ISSUE 12 acceptance: overlap machinery keeps the recorder's
+    # self-measured overhead under 1% of step wall time
+    assert eng.flight.overhead_ratio() < 0.01
+    h = eng.health()
+    assert h["pipeline_depth"] == 2 and h["overlap"] is True
+    assert h["host_overlap_ratio"] > 0
+    # the declarative layout rides health as one inspectable object
+    assert h["layout"]["axes"] == {
+        "dp": "dp", "sp": "sp", "ep": "ep", "tp": "tp"
+    }
+
+
+def test_idle_wait_accounting_and_wakeup(setup):
+    """An idle engine parks on the wakeup condition (accounted as
+    saved spin) and a submit wakes it to completion."""
+    cfg, params = setup
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=64, pipeline_depth=2
+    )
+    eng.start()
+    try:
+        time.sleep(0.3)   # idle: the loop should be parked, not spinning
+        req = eng.generate(
+            GenRequest(
+                prompt_ids=[4, 5, 6], max_tokens=3, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=120,
+        )
+        assert req.finish_reason in ("stop", "length")
+        assert eng.flight.idle_wait_s_total > 0.05
+        lines = "\n".join(eng.flight.metrics_lines())
+        assert "gpustack_engine_idle_wait_seconds_total" in lines
+        assert "gpustack_engine_host_overlap_ratio" in lines
+        assert "gpustack_engine_rollback_tokens_total" in lines
+    finally:
+        eng.stop()
+
+
+def test_staged_prefix_upload_overlaps_decode(setup):
+    """Chunked prefill with a host-KV prefix hit stages the gather +
+    upload on the kv-copy executor while a running slot keeps decoding;
+    output parity with the cold pass holds."""
+    cfg, params = setup
+    eng = LLMEngine(
+        cfg, params, max_slots=2, max_seq_len=256, prefill_chunk=32,
+        host_kv_cache_mb=64, kv_block_tokens=16, pipeline_depth=2,
+    )
+    eng.start()
+    try:
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(1, cfg.vocab_size, 96).tolist()
+        r1 = eng.generate(
+            GenRequest(
+                prompt_ids=prompt, max_tokens=4, temperature=0.0,
+                stop_ids=(),
+            ),
+            timeout=300,
+        )
+        eng._kv_copy_pool.shutdown(wait=True)   # stores land
+        # keep one slot decoding while the chunked prefix hit admits
+        bg = eng.submit(GenRequest(
+            prompt_ids=[3, 1, 4, 1, 5], max_tokens=40,
+            temperature=0.0, stop_ids=(),
+        ))
+        r2 = eng.generate(
+            GenRequest(
+                prompt_ids=list(prompt), max_tokens=4,
+                temperature=0.0, stop_ids=(),
+            ),
+            timeout=300,
+        )
+        assert bg.done.wait(300)
+        # the match is capped below the full prompt (the final position
+        # must prefill for logits): 95 matchable tokens floor to 80
+        # with 16-token blocks
+        assert r2.prefix_tokens_reused >= (96 - 1) // 16 * 16 - 15
+        assert r2.output_ids == r1.output_ids
+    finally:
+        eng.stop()

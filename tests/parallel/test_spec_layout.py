@@ -1,0 +1,78 @@
+"""SpecLayout (ISSUE 12 tentpole d): the declarative dp/sp/ep/tp axis
+layout is the single source of truth — the legacy helper functions
+delegate to it, the runner holds one per replica, and describe() makes
+the multi-chip layout one inspectable object."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gpustack_tpu.models import init_params
+from gpustack_tpu.models.config import get_config
+from gpustack_tpu.parallel.sharding import (
+    SpecLayout,
+    activation_pspec,
+    cache_pspec,
+    param_pspecs,
+)
+
+
+def test_cache_spec_matches_legacy_helper():
+    assert SpecLayout().cache() == cache_pspec()
+    assert SpecLayout().cache() == P(None, "dp", None, "tp", None)
+    assert (
+        SpecLayout(long_context=True).cache()
+        == cache_pspec(long_context=True)
+        == P(None, "dp", "sp", "tp", None)
+    )
+
+
+def test_activation_and_state_specs():
+    assert SpecLayout().activations() == activation_pspec()
+    assert SpecLayout().activations(True) == P("dp", "sp")
+    assert SpecLayout().slot_state() == P(None)
+    assert SpecLayout().replicated() == P()
+
+
+def test_param_specs_match_legacy_and_modes():
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    inf = SpecLayout().params(params)
+    assert inf == param_pspecs(params, train=False)
+    # inference replicates over dp; training FSDP-shards over dp
+    assert inf["layers"]["wq"] == P(None, None, "tp")
+    train = SpecLayout(train=True).params(params)
+    assert train == param_pspecs(params, train=True)
+    assert train["layers"]["wq"] == P(None, "dp", "tp")
+    assert train["embed"] == P("tp", "dp")
+    assert inf["embed"] == P("tp", None)
+
+
+def test_describe_is_inspectable():
+    d = SpecLayout(long_context=True).describe()
+    assert d["axes"] == {"dp": "dp", "sp": "sp", "ep": "ep", "tp": "tp"}
+    assert d["long_context"] is True
+    # strings, so the dict is JSON-serializable for health surfaces
+    assert isinstance(d["cache"], str) and "sp" in d["cache"]
+    import json
+
+    json.dumps(d)
+
+
+def test_runner_holds_layout():
+    from gpustack_tpu.engine.runner import ModelRunner
+
+    cfg = get_config("tiny")
+    params = init_params(cfg, jax.random.key(0))
+    runner = ModelRunner(cfg, params, max_slots=2, max_seq_len=64)
+    assert isinstance(runner.layout, SpecLayout)
+    assert runner.layout.long_context is False
+    assert runner._cache_sharding.spec == runner.layout.cache()
+    assert runner._slot_sharding.spec == runner.layout.slot_state()
+    assert runner._replicated.spec == runner.layout.replicated()
+    assert runner.supports_async_insert is True
+
+
+def test_layout_is_frozen():
+    with pytest.raises(Exception):
+        SpecLayout().long_context = True
