@@ -31,6 +31,11 @@ ENUM_NAME = "ModelInstanceState"
 TRANSITIONS_NAME = "INSTANCE_STATE_TRANSITIONS"
 INITIAL_NAME = "INSTANCE_STATE_INITIAL"
 WRITERS_NAME = "INSTANCE_STATE_WRITERS"
+# disaggregated-serving role tags: assigned once at instance creation
+# from the spec's role deficit — the declared writer set (path
+# suffixes) lives next to the state declarations
+ROLE_WRITERS_NAME = "INSTANCE_ROLE_WRITERS"
+KNOWN_ROLES = {"", "prefill", "decode"}
 
 # read idioms: a `state=` keyword on these call targets is a filter
 READ_FUNCS = {"filter", "find", "first", "get", "all", "model_validate"}
@@ -83,6 +88,7 @@ class StateMachineRule(Rule):
         yield from self._write_site_checks(
             project, members, initial, transitions, writers
         )
+        yield from self._role_write_checks(project, tree)
 
     # ---- declaration parsing -------------------------------------------
 
@@ -309,6 +315,73 @@ class StateMachineRule(Rule):
                         f"({how}) — update {WRITERS_NAME} in "
                         f"{SCHEMAS_PATH}",
                     )
+
+    # ---- role writes (disaggregated serving) ----------------------------
+
+    def _role_write_checks(
+        self, project: Project, schemas_tree: ast.AST
+    ) -> Iterator[Finding]:
+        """``ModelInstance(... role=...)`` constructor writes must come
+        from a module declared in ``INSTANCE_ROLE_WRITERS`` (a role is
+        assigned exactly once, at creation, from the spec's role
+        deficit), and literal role values must be known tags. Scoped to
+        the constructor idiom: ``role`` is too common a keyword to flag
+        on arbitrary calls."""
+        declared: Optional[List[str]] = None
+        for node in ast.walk(schemas_tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == ROLE_WRITERS_NAME
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    declared = [
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+        if declared is None:
+            yield self.finding(
+                SCHEMAS_PATH, 1,
+                f"missing declaration: {ROLE_WRITERS_NAME} (tuple of "
+                f"module path suffixes allowed to write ModelInstance "
+                f"role tags)",
+            )
+            return
+        for rel in project.py_files("gpustack_tpu"):
+            if rel == SCHEMAS_PATH or rel.startswith(
+                "gpustack_tpu/analysis/"
+            ):
+                continue
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            allowed = any(rel.endswith(suffix) for suffix in declared)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = astutil.dotted_name(node.func) or ""
+                if func.rsplit(".", 1)[-1] != "ModelInstance":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "role":
+                        continue
+                    if not allowed:
+                        yield self.finding(
+                            rel, node.lineno,
+                            f"ModelInstance role write in a module not "
+                            f"declared in {ROLE_WRITERS_NAME}",
+                        )
+                    if (
+                        isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                        and kw.value.value not in KNOWN_ROLES
+                    ):
+                        yield self.finding(
+                            rel, node.lineno,
+                            f"unknown role tag {kw.value.value!r} "
+                            f"(known: {sorted(KNOWN_ROLES)})",
+                        )
 
     @staticmethod
     def _allowed_for(

@@ -66,6 +66,16 @@ class Config(pydantic.BaseModel):
     breaker_failure_threshold: int = 3  # consecutive failures → open
     breaker_open_seconds: float = 10.0  # base open window (jittered)
     model_max_outstanding: int = 256    # per-model in-flight cap; 0 = off
+    # prefix-affinity routing (server/resilience.py PrefixAffinityMap):
+    # bound on conversation-prefix → replica entries across all models
+    # (LRU past it) — each entry is one hash + two ints
+    affinity_max_entries: int = 4096
+    # disaggregated KV handoff: total seconds an engine spends pulling
+    # a conversation's blocks from a peer replica (and a prefill-role
+    # replica spends on prefill-for-export) before degrading to a cold
+    # prefill. Engines read the matching env var directly (subprocesses
+    # inherit the worker's environment).
+    kv_handoff_timeout: float = 10.0
     # worker: graceful drain — wait for the reverse proxy's in-flight
     # count to reach zero (bounded) before SIGTERM on stop/recreate
     drain_timeout: float = 30.0

@@ -111,9 +111,13 @@ class OpenAIServer:
         self.engine = engine
         self.model_name = model_name or engine.cfg.name
         # the engine is the last hop of the trace: the middleware adopts
-        # the worker proxy's traceparent and logs this hop's trace=… line
+        # the worker proxy's traceparent and logs this hop's trace=… line.
+        # Body cap matches the worker reverse proxy's (256 MiB): a KV
+        # handoff push at POST /kv/import carries whole block runs —
+        # the aiohttp default 1 MiB would 413 any real import
         self.app = web.Application(
-            middlewares=[trace_middleware("engine")]
+            middlewares=[trace_middleware("engine")],
+            client_max_size=256 * 2**20,
         )
         self.app.add_routes(
             [
@@ -126,9 +130,16 @@ class OpenAIServer:
                 web.get("/metrics", self.metrics),
                 web.get("/debug/flight", self.debug_flight),
                 web.post("/debug/profile", self.debug_profile),
+                # disaggregated prefill/decode (docs/KV_CACHE.md "KV
+                # handoff"): content-addressed block export/import
+                web.post("/kv/export", self.kv_export),
+                web.post("/kv/import", self.kv_import),
             ]
         )
         self._started = time.time()
+        # lazy session for pulling handed-off KV from a peer replica
+        # (the X-GPUStack-KV-Source request header names the source)
+        self._kv_session = None
 
     # ---- endpoints ------------------------------------------------------
 
@@ -183,6 +194,32 @@ class OpenAIServer:
         ):
             lines.append(f"# TYPE {family} {METRIC_FAMILIES[family]}")
             lines.append(f"{family} {value}")
+        # disaggregated KV handoff (engine/kv_transfer.py): wire
+        # bytes/blocks per direction + pull failures; the latency
+        # histogram rides the request-histogram loop below
+        ho = self.engine.kv_handoff
+        for family, series in (
+            (
+                "gpustack_kv_handoff_bytes_total",
+                (("in", ho.bytes_in), ("out", ho.bytes_out)),
+            ),
+            (
+                "gpustack_kv_handoff_blocks_total",
+                (("in", ho.blocks_in), ("out", ho.blocks_out)),
+            ),
+        ):
+            lines.append(f"# TYPE {family} {METRIC_FAMILIES[family]}")
+            for direction, value in series:
+                lines.append(
+                    f'{family}{{direction="{direction}"}} {value}'
+                )
+        lines.append(
+            "# TYPE gpustack_kv_handoff_failures_total "
+            f"{METRIC_FAMILIES['gpustack_kv_handoff_failures_total']}"
+        )
+        lines.append(
+            f"gpustack_kv_handoff_failures_total {ho.failures}"
+        )
         # flight recorder: per-step scheduler telemetry (step-time
         # histogram by mode, real-vs-padded dispatch, occupancy, queue
         # wait, speculation economics — observability/flight.py)
@@ -196,6 +233,7 @@ class OpenAIServer:
             ("gpustack_engine_ttft_seconds", self.engine.ttft_hist),
             ("gpustack_engine_tpot_seconds", self.engine.tpot_hist),
             ("gpustack_engine_e2e_seconds", self.engine.e2e_hist),
+            ("gpustack_kv_handoff_seconds", ho.seconds),
         ):
             cum, total, count = hist.snapshot()
             lines.append(f"# TYPE {name} histogram")
@@ -258,6 +296,258 @@ class OpenAIServer:
         except ValueError as e:
             return _error(409, str(e))
         return web.json_response(result)
+
+    # ---- disaggregated KV handoff (docs/KV_CACHE.md) -------------------
+
+    @staticmethod
+    def _handoff_timeout() -> float:
+        return float(
+            os.environ.get("GPUSTACK_TPU_KV_HANDOFF_TIMEOUT") or 10.0
+        )
+
+    async def kv_export(self, request: web.Request) -> web.StreamResponse:
+        """Stream the host cache's matched radix block run for a prompt
+        as content-addressed wire frames (engine/kv_transfer.py).
+
+        Body: ``{"prompt_ids": [...], "have": [hex...], "prefill":
+        bool}``. ``have`` keys the requester already holds travel as
+        token-only dedup frames. ``prefill=true`` on a miss runs a
+        one-token generation first so a prefill-role replica can be
+        handed a prompt it has never seen — THE disaggregated-serving
+        hop: prefill compute happens here, the decode replica imports
+        the blocks and prefills only the sub-block tail."""
+        eng = self.engine
+        cache = eng.host_kv_cache
+        if cache is None:
+            return _error(404, "engine has no host KV cache")
+        try:
+            body = await request.json()
+            prompt_ids = [int(t) for t in body.get("prompt_ids") or []]
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return _error(400, "invalid JSON body")
+        if not prompt_ids:
+            return _error(400, "missing 'prompt_ids'")
+        have = [str(k) for k in body.get("have") or []]
+        want_blocks = (len(prompt_ids) - 1) // cache.block_tokens
+        loop = asyncio.get_running_loop()
+        if body.get("prefill") and want_blocks > 0:
+            held = await loop.run_in_executor(
+                None, cache.peek_prefix_len, prompt_ids
+            )
+            if held < want_blocks * cache.block_tokens:
+                err = await loop.run_in_executor(
+                    None, self._prefill_for_export, prompt_ids,
+                    want_blocks * cache.block_tokens,
+                )
+                if err:
+                    return _error(503, err)
+        from gpustack_tpu.engine.kv_transfer import MAGIC, encode_block
+
+        def assemble():
+            # ONE trie walk: encode straight off export_blocks and
+            # count payload frames as they are produced (a second walk
+            # just to count could disagree under concurrent eviction)
+            have_set = frozenset(have)
+            chunks = [MAGIC]
+            payload_blocks = 0
+            for blk in cache.export_blocks(prompt_ids):
+                frame, carried = encode_block(blk, have_set)
+                chunks.append(frame)
+                payload_blocks += int(carried)
+            return chunks, payload_blocks
+
+        chunks, payload_blocks = await loop.run_in_executor(
+            None, assemble
+        )
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-gpustack-kv"}
+        )
+        await resp.prepare(request)
+        for chunk in chunks:
+            await resp.write(chunk)
+            eng.kv_handoff.bytes_out += len(chunk)
+        eng.kv_handoff.blocks_out += payload_blocks
+        await resp.write_eof()
+        return resp
+
+    def _prefill_for_export(
+        self, prompt_ids, want_tokens: int
+    ) -> str:
+        """Run a one-token generation so the prompt's KV lands in the
+        host cache (the prefill-time async store), then wait — bounded
+        — for the store to become matchable. Returns an error string,
+        or "" on success. Executor-thread only."""
+        timeout = self._handoff_timeout()
+        try:
+            req = GenRequest(
+                prompt_ids=list(prompt_ids), max_tokens=1,
+                temperature=0.0,
+            )
+            self.engine.generate(req, timeout=timeout)
+        except (TimeoutError, ValueError) as e:
+            return f"prefill for export failed: {e}"
+        cache = self.engine.host_kv_cache
+        if cache is None:
+            return "host KV cache disabled mid-prefill"
+        deadline = time.time() + timeout
+        while (
+            cache.peek_prefix_len(prompt_ids) < want_tokens
+            and time.time() < deadline
+        ):
+            time.sleep(0.01)
+        return ""
+
+    async def kv_import(self, request: web.Request) -> web.Response:
+        """Land wire frames (a prefill replica's push, or a relay) in
+        this engine's host cache through the kv stager — decode slots
+        never stall on the insert."""
+        eng = self.engine
+        cache = eng.host_kv_cache
+        if cache is None:
+            return _error(404, "engine has no host KV cache")
+        from gpustack_tpu.engine.kv_transfer import (
+            decode_stream,
+            prepare_import,
+        )
+
+        raw = await request.read()
+        loop = asyncio.get_running_loop()
+
+        def convert():
+            frames = decode_stream(raw)
+            return prepare_import(cache, frames)
+
+        try:
+            tokens, prepared, bytes_in = await loop.run_in_executor(
+                None, convert
+            )
+        except ValueError as e:
+            eng.kv_handoff.failures += 1
+            return _error(400, str(e))
+        try:
+            # the stager SUBMIT itself can block (two-slot backpressure
+            # while an upload lands) — keep it off the event loop, or
+            # every SSE stream and health probe on this engine stalls
+            fut = await loop.run_in_executor(
+                None, eng.kv_import_prepared, tokens, prepared
+            )
+            attached = await asyncio.wait_for(
+                asyncio.wrap_future(fut), self._handoff_timeout()
+            )
+        except asyncio.TimeoutError:
+            eng.kv_handoff.failures += 1
+            return _error(
+                503,
+                "kv import did not land within "
+                f"{self._handoff_timeout()}s (stager busy); retry",
+            )
+        eng.kv_handoff.bytes_in += bytes_in
+        return web.json_response({
+            "blocks_attached": attached,
+            "tokens": len(tokens),
+            "bytes": bytes_in,
+        })
+
+    async def _kv_prefetch(
+        self, request: web.Request, source: str, prompt_ids
+    ) -> None:
+        """Pull the prompt's radix prefix blocks from a peer replica
+        before submitting the generation — the decode half of the
+        disaggregated handoff. Never fails the request: a dead peer, a
+        truncated stream or a slow transfer degrades to a cold (or
+        partial-prefix) prefill, with the failure counted and traced.
+        Complete frames that arrived before a mid-stream death are
+        still imported — a radix cache can always use the intact
+        prefix."""
+        import aiohttp
+
+        eng = self.engine
+        cache = eng.host_kv_cache
+        stats = eng.kv_handoff
+        bt = cache.block_tokens
+        want_tokens = (len(prompt_ids) - 1) // bt * bt
+        if want_tokens <= 0:
+            return
+        loop = asyncio.get_running_loop()
+        have = await loop.run_in_executor(
+            None, cache.prefix_keys, prompt_ids
+        )
+        if len(have) * bt >= want_tokens:
+            return  # the full run is already local
+        from gpustack_tpu.engine.kv_transfer import (
+            FrameDecoder,
+            prepare_import,
+        )
+
+        trace = request.get("trace")
+        timeout = self._handoff_timeout()
+        t0 = time.perf_counter()
+        stats.pulls += 1
+        frames: list = []
+        failed = ""
+        try:
+            if self._kv_session is None or self._kv_session.closed:
+                self._kv_session = aiohttp.ClientSession()
+            headers = {}
+            auth = request.headers.get("X-GPUStack-KV-Source-Auth", "")
+            if auth:
+                headers["Authorization"] = auth
+            decoder = FrameDecoder()
+            async with self._kv_session.post(
+                source,
+                json={
+                    "prompt_ids": [int(t) for t in prompt_ids],
+                    "have": have,
+                    "prefill": True,
+                },
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"peer answered HTTP {resp.status}")
+                async for chunk in resp.content.iter_any():
+                    frames.extend(decoder.feed(chunk))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any peer fault → cold
+            failed = str(e) or type(e).__name__
+        imported = 0
+        bytes_in = 0
+        if frames:
+            try:
+                tokens, prepared, bytes_in = await loop.run_in_executor(
+                    None, prepare_import, cache, frames
+                )
+                # the stager submit can block on its two-slot bound:
+                # off the event loop, like the convert above
+                fut = await loop.run_in_executor(
+                    None, eng.kv_import_prepared, tokens, prepared
+                )
+                imported = await asyncio.wait_for(
+                    asyncio.wrap_future(fut),
+                    max(0.5, timeout - (time.perf_counter() - t0)),
+                )
+                stats.bytes_in += bytes_in
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                failed = failed or (str(e) or type(e).__name__)
+        dur = time.perf_counter() - t0
+        stats.seconds.observe(dur)
+        if failed:
+            stats.failures += 1
+            logger.warning(
+                "kv handoff from %s failed after %.3fs (%d block(s) "
+                "landed; continuing cold): %s",
+                source, dur, imported, failed,
+            )
+        if trace is not None:
+            # the engine hop's kv_handoff phase: transfer + import wait
+            trace.add_phase("kv_handoff", dur)
+            attrs = dict(source=source, blocks=imported, bytes=bytes_in)
+            if failed:
+                attrs["failed"] = failed
+            trace.event("kv_handoff", **attrs)
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -714,6 +1004,15 @@ class OpenAIServer:
             )
         except (TypeError, ValueError) as e:
             return _error(400, f"bad sampling params: {e}")
+        # disaggregated handoff: the proxy names the peer replica that
+        # already holds this conversation's radix prefix (or the
+        # prefill-role replica that should compute it) — pull its
+        # blocks before admission so _start_request prefix-hits them
+        source = request.headers.get("X-GPUStack-KV-Source", "")
+        if source and self.engine.host_kv_cache is not None and (
+            embeds_override is None
+        ):
+            await self._kv_prefetch(request, source, prompt_ids)
         if body.get("stream"):
             return await self._stream(
                 request, gens, chat, tools_active,
@@ -1133,6 +1432,7 @@ def build_engine_from_args(args) -> LLMEngine:
         kv_cache_int8=getattr(args, "kv_cache_int8", False),
         prefill_chunk=getattr(args, "prefill_chunk", 0),
         pipeline_depth=pipeline_depth,
+        kv_role=getattr(args, "kv_role", ""),
     )
     if vlm_cfg is not None:
         from gpustack_tpu.models.vlm import VisionBundle, init_vision_params
@@ -1219,6 +1519,14 @@ def main(argv=None) -> None:
         help="host KV cache block granularity in tokens (0 = default "
         "256); smaller blocks match shorter shared prefixes at more "
         "per-block overhead",
+    )
+    p.add_argument(
+        "--kv-role", choices=["", "prefill", "decode"], default="",
+        help="disaggregated-serving role tag (ModelSpec "
+        "prefill_replicas/decode_replicas): prefill replicas compute "
+        "prompt KV and export it at POST /kv/export; decode replicas "
+        "pull handed-off blocks and own the token loop. Empty = "
+        "colocated (both roles)",
     )
     p.add_argument(
         "--kv-cache-int8", action="store_true",

@@ -70,7 +70,8 @@ DISPATCH_SYNC_FREE = (
     "_decode_once", "_note_spec_dispatch", "_spec_safe", "_deliver",
     "_emit_text", "_push", "_finish", "_flight_record",
     "_submit_kv_copy", "_store_finished_sequence", "_build_proposals",
-    "_entry_ready", "_drain_ready",
+    "_entry_ready", "_drain_ready", "_advance_one_shot",
+    "_flush_detok",
 )
 
 
@@ -241,6 +242,11 @@ class _ChunkJob:
     # the job cold-starts then. While pending, decode for running slots
     # proceeds; that concurrency is the overlap win.
     pending_kv: Any = None
+    # deferred ONE-SHOT prefill (non-chunked prefix hit): the single
+    # "chunk" is the entire suffix, run the step after the staged
+    # upload lands — the job shape that un-blocks the scheduler from
+    # the old inline gather+upload (PR 11 residual)
+    one_shot: bool = False
 
 
 @dataclasses.dataclass
@@ -273,11 +279,16 @@ class _DetokWorker:
     for offloaded requests, never touches the slot's detok state
     (``buffer_ids``/``text``/``emitted``) again — this thread owns the
     tokenizer calls and SSE queue puts, so neither stalls device
-    dispatch. A finish item flushes the tail, publishes ``output_text``
-    and sets the request's ``done`` event; the single FIFO queue is the
-    ordering contract (all tokens precede their request's finish). Busy
-    seconds feed the engine's host-overlap accounting (the flight
-    recorder's ``host_overlap_ratio``)."""
+    dispatch. Queue items are COALESCED: one ``("batch", [(info,
+    toks), ...])`` entry per drained fetch covering every slot that
+    produced tokens (was: one entry per slot per fetch — a full batch
+    paid ``max_slots`` queue round-trips per step). A ``("finish",
+    info)`` item flushes the tail, publishes ``output_text`` and sets
+    the request's ``done`` event; the single FIFO queue is the
+    ordering contract (the scheduler flushes the pending batch before
+    queueing any finish, so all of a request's tokens precede its
+    finish). Busy seconds feed the engine's host-overlap accounting
+    (the flight recorder's ``host_overlap_ratio``)."""
 
     _STOP = object()
 
@@ -297,13 +308,17 @@ class _DetokWorker:
             )
             self._thread.start()
 
-    def put_tokens(self, info: "_SlotInfo", toks: List[int]) -> None:
+    def put_batch(
+        self, items: List[Tuple["_SlotInfo", List[int]]]
+    ) -> None:
+        """One coalesced entry for one drained fetch's accepted tokens
+        across every offloaded slot."""
         self._ensure_thread()
-        self._q.put((info, toks))
+        self._q.put(("batch", items))
 
     def finish(self, info: "_SlotInfo") -> None:
         self._ensure_thread()
-        self._q.put((info, None))
+        self._q.put(("finish", info))
 
     def stop(self, timeout: float = 10.0) -> None:
         if self._thread is None:
@@ -317,70 +332,93 @@ class _DetokWorker:
             item = self._q.get()
             if item is self._STOP:
                 return
-            info, toks = item
+            kind, payload = item
             t0 = time.perf_counter()
             try:
-                req = info.request
-                if toks is None:
-                    # finish: flush the multibyte tail, publish, wake
-                    # the waiter (finish_reason was set by the
-                    # scheduler before the handoff)
-                    eng._emit_text(info, final=True)
-                    req.output_text = info.text
-                    if req.stream is not None:
-                        req.stream.put(None)
-                    req.done.set()
+                if kind == "finish":
+                    self._finish_one(payload)
                 else:
-                    info.buffer_ids.extend(toks)
-                    eng._emit_text(info, final=False)
-            except Exception:
-                # a tokenizer fault must fail ONE request loudly, not
-                # wedge every waiter behind it in the queue
-                logger.exception("detok worker item failed")
-                req = info.request
-                if not req.done.is_set():
-                    req.finish_reason = req.finish_reason or "error"
-                    # publish whatever text HAD decoded — a fault in
-                    # the final flush must not turn a finished request
-                    # into an empty-looking success
-                    req.output_text = info.text
-                    if req.stream is not None:
-                        req.stream.put(None)
-                    req.done.set()
+                    for info, toks in payload:
+                        self._tokens_one(info, toks)
             finally:
                 eng._note_overlap(time.perf_counter() - t0)
 
+    def _tokens_one(self, info: "_SlotInfo", toks: List[int]) -> None:
+        try:
+            info.buffer_ids.extend(toks)
+            self._engine._emit_text(info, final=False)
+        except Exception:
+            # a tokenizer fault must fail ONE request loudly — never
+            # the rest of its batch, nor any waiter queued behind it
+            logger.exception("detok worker item failed")
+            self._fail_request(info)
+
+    def _finish_one(self, info: "_SlotInfo") -> None:
+        try:
+            # finish: flush the multibyte tail, publish, wake the
+            # waiter (finish_reason was set by the scheduler before
+            # the handoff)
+            req = info.request
+            self._engine._emit_text(info, final=True)
+            req.output_text = info.text
+            if req.stream is not None:
+                req.stream.put(None)
+            req.done.set()
+        except Exception:
+            logger.exception("detok worker finish failed")
+            self._fail_request(info)
+
+    @staticmethod
+    def _fail_request(info: "_SlotInfo") -> None:
+        req = info.request
+        if not req.done.is_set():
+            req.finish_reason = req.finish_reason or "error"
+            # publish whatever text HAD decoded — a fault in the final
+            # flush must not turn a finished request into an
+            # empty-looking success
+            req.output_text = info.text
+            if req.stream is not None:
+                req.stream.put(None)
+            req.done.set()
+
 
 class _KVStager:
-    """Two-slot staging buffer for host→device prefix-KV uploads on the
-    kv-copy executor: at most ``depth`` gather+upload jobs in flight, so
-    the next chunk job's prefix copies while the current chunk (or the
-    running slots' decode) computes, without unbounded host pinning."""
+    """Two-slot staging buffer for host→device prefix-KV uploads AND
+    wire imports on the kv-copy executor: at most ``depth`` jobs in
+    flight, so the next chunk job's prefix copies (or a handed-off
+    block run lands) while the current chunk or the running slots'
+    decode computes, without unbounded host pinning. Thread-safe:
+    the scheduler thread stages prefix uploads while api_server
+    executor threads stage KV-transfer imports."""
 
     def __init__(self, executor, depth: int = 2):
         self._ex = executor
         self._inflight: "collections.deque" = collections.deque()
+        self._mu = threading.Lock()
         self.depth = depth
 
     def submit(self, fn):
-        while self._inflight and self._inflight[0].done():
-            self._inflight.popleft()
-        while len(self._inflight) >= self.depth:
-            # backpressure: the two-slot bound is the memory contract
-            concurrent.futures.wait([self._inflight.popleft()])
-        try:
-            fut = self._ex.submit(fn)
-        except RuntimeError:
-            # executor shut down (engine stopping / tests draining the
-            # copy pool): run inline — a resolved future keeps the
-            # caller's contract
-            fut = concurrent.futures.Future()
+        with self._mu:
+            while self._inflight and self._inflight[0].done():
+                self._inflight.popleft()
+            while len(self._inflight) >= self.depth:
+                # backpressure: the two-slot bound is the memory
+                # contract (held under the lock — the bound is global,
+                # not per-submitter)
+                concurrent.futures.wait([self._inflight.popleft()])
             try:
-                fut.set_result(fn())
-            except Exception as e:
-                fut.set_exception(e)
-        self._inflight.append(fut)
-        return fut
+                fut = self._ex.submit(fn)
+            except RuntimeError:
+                # executor shut down (engine stopping / tests draining
+                # the copy pool): run inline — a resolved future keeps
+                # the caller's contract
+                fut = concurrent.futures.Future()
+                try:
+                    fut.set_result(fn())
+                except Exception as e:
+                    fut.set_exception(e)
+            self._inflight.append(fut)
+            return fut
 
 
 class LLMEngine:
@@ -407,6 +445,7 @@ class LLMEngine:
         kv_cache_int8: bool = False,  # int8 host tier (per-block scales)
         prefill_chunk: int = 0,      # >0: chunked prefill (tokens/chunk)
         pipeline_depth: int = _FETCH_LAG,  # 0 = serial reference mode
+        kv_role: str = "",           # ""|"prefill"|"decode" (disagg tag)
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -438,8 +477,11 @@ class LLMEngine:
         # the old 2 ms poll loop (idle-spin saved is exported via the
         # flight recorder's idle_wait counter)
         self._wake = threading.Condition()
-        # detokenization + SSE stream writes off the dispatch path
+        # detokenization + SSE stream writes off the dispatch path;
+        # accepted tokens accumulate here and flush as ONE coalesced
+        # queue entry per drained fetch (not one per slot)
         self._detok = _DetokWorker(self)
+        self._detok_batch: List[Tuple[_SlotInfo, List[int]]] = []
         # host work overlapped with device compute (detok worker + kv
         # staging/copy executor busy seconds), drained per step into the
         # flight record's host_overlap field
@@ -501,6 +543,17 @@ class LLMEngine:
         self.host_kv_cache = None
         self._kv_copy_pool = None
         self._kv_stage = None
+        # disaggregated-serving role tag (ModelSpec prefill_replicas /
+        # decode_replicas → backends --kv-role): advisory — the engine
+        # serves whatever arrives; the proxy's routing and the KV
+        # handoff surface (api_server /kv/export, /kv/import) are what
+        # make the roles mean something
+        self.kv_role = kv_role
+        # KV-transfer accounting (engine/kv_transfer.py): handoff
+        # bytes/blocks/failures/latency, rendered by the engine exporter
+        from gpustack_tpu.engine.kv_transfer import HandoffStats
+
+        self.kv_handoff = HandoffStats()
         if host_kv_cache_mb > 0:
             from gpustack_tpu.engine.kv_host_cache import (
                 DEFAULT_BLOCK_TOKENS,
@@ -711,6 +764,10 @@ class LLMEngine:
             "kv_cache_host_bytes": (
                 self.host_kv_cache.bytes_used if self.host_kv_cache else 0
             ),
+            # disaggregated serving (docs/KV_CACHE.md "KV handoff"):
+            # role tag + wire-transfer accounting
+            "kv_role": self.kv_role,
+            "kv_handoff": self.kv_handoff.snapshot(),
         }
 
     # ---- scheduling loop ------------------------------------------------
@@ -754,7 +811,16 @@ class LLMEngine:
         with self._overlap_mu:
             self._overlap_s += seconds
 
+    def _flush_detok(self) -> None:
+        """Hand the accumulated (info, tokens) pairs to the detok
+        worker as ONE queue entry — called once per drained fetch (and
+        before any finish item, so the FIFO ordering contract holds)."""
+        if self._detok_batch:
+            batch, self._detok_batch = self._detok_batch, []
+            self._detok.put_batch(batch)
+
     def _fail_all_requests(self, message: str) -> None:
+        self._flush_detok()
         for info in list(self._slots.values()):
             req = info.request
             req.finish_reason = "error"
@@ -1120,15 +1186,19 @@ class LLMEngine:
             del self._chunk_jobs[slot]
             self._free.append(slot)
             abort_op = getattr(self.runner, "chunk_abort", None)
-            if abort_op is not None and job.done > 0:
+            if abort_op is not None and job.done > 0 and not job.one_shot:
                 # multi-host: followers drop their chunk register too,
                 # or the aborted prompt's partial K/V stays pinned in
-                # device memory until the next chunked job
+                # device memory until the next chunked job (one-shot
+                # jobs never touched a chunk register)
                 abort_op()
             self._finish_aborted(job.req)
             return True
         if job.pending_kv is not None:
             self._resolve_staged_prefix(job)
+        if job.one_shot:
+            self._advance_one_shot(slot, job)
+            return True
         start = job.done
         chunk = job.ids[start : start + self.prefill_chunk]
         self._step_mode = self._step_mode or "prefill_chunk"
@@ -1170,6 +1240,39 @@ class LLMEngine:
                 commit()
             self._finalize_start(slot, job.req, job.last, job.k, job.v)
         return True
+
+    def _advance_one_shot(self, slot: int, job: "_ChunkJob") -> None:
+        """Complete a deferred one-shot prefill: the staged prefix (if
+        it landed — an evicted or failed stage leaves ``done == 0`` and
+        the job cold-starts) plus ONE bucketed forward over the entire
+        suffix, then slot activation. Greedy-identical to the old
+        inline path; only the scheduler-blocking gather+upload moved
+        onto the stager."""
+        req, ids = job.req, job.ids
+        r = self.runner
+        self._step_mode = self._step_mode or "prefill"
+        if job.done > 0:
+            suffix = ids[job.done:]
+            sb = r.bucket_for(len(suffix))
+            total_bucket = r.bucket_for(job.done + sb)
+            self._step_real += len(suffix)
+            self._step_prompt += len(suffix)
+            self._step_padded += sb
+            padded = list(suffix) + [0] * (sb - len(suffix))
+            last_logits, k, v = r.prefill_with_prefix(
+                job.k, job.v, job.done, padded, len(suffix),
+                total_bucket,
+            )
+        else:
+            bucket = r.bucket_for(max(1, len(ids)))
+            self._step_real += len(ids)
+            self._step_prompt += len(ids)
+            self._step_padded += bucket
+            padded = list(ids) + [0] * (bucket - len(ids))
+            last_logits, k, v = r.prefill(padded, len(ids))
+        del self._chunk_jobs[slot]
+        self._submit_kv_copy(ids, k, v, len(ids))
+        self._finalize_start(slot, req, last_logits, k, v)
 
     # admit as many waiting requests as there are free slots
     def _admit(self) -> bool:
@@ -1248,6 +1351,20 @@ class LLMEngine:
                 if use_len + sb <= top:
                     break
                 use_len -= kv_cache.block_tokens
+        if use_len > 0 and self._kv_stage is not None:
+            # Deferred one-shot prefill: the gather+upload used to run
+            # INLINE here, blocking the scheduler (and every decoding
+            # slot) on the host→device copy. It now rides the same
+            # two-slot stager as the chunked path — the slot holds a
+            # one-shot job whose single "chunk" is the entire suffix,
+            # and decode proceeds while the upload lands.
+            fut = self._kv_stage.submit(
+                self._stage_prefix_fn(req, ids, use_len, kv_cache)
+            )
+            self._chunk_jobs[slot] = _ChunkJob(
+                req=req, ids=list(ids), pending_kv=fut, one_shot=True,
+            )
+            return
         prefix = (
             kv_cache.gather_prefix(ids, use_len) if use_len > 0 else None
         )
@@ -1329,6 +1446,34 @@ class LLMEngine:
             # pool shut down (engine stopping) — skip the store; the
             # cache is an optimization, never required for correctness
             pass
+
+    def kv_import_prepared(self, tokens, prepared):
+        """Land a handed-off block run (already wire-decoded and
+        converted to the cache's tier) through the ``_KVStager`` so the
+        scheduler — and therefore every decoding slot — never stalls on
+        the transfer. Returns a ``concurrent.futures.Future`` resolving
+        to the number of blocks attached (0 when the cache is off)."""
+        kv_cache = self.host_kv_cache
+
+        def land():
+            if kv_cache is None:
+                return 0
+            t0 = time.perf_counter()
+            try:
+                n = kv_cache.import_blocks(tokens, prepared)
+                self.kv_handoff.blocks_in += n
+                return n
+            finally:
+                self._note_overlap(time.perf_counter() - t0)
+
+        if self._kv_stage is not None:
+            return self._kv_stage.submit(land)
+        fut = concurrent.futures.Future()
+        try:
+            fut.set_result(land())
+        except Exception as e:  # pragma: no cover - cache insert bug
+            fut.set_exception(e)
+        return fut
 
     def _store_finished_sequence(self, slot: int, req: GenRequest) -> None:
         """Cache the FULL finished sequence (prompt + generated tokens)
@@ -1470,6 +1615,9 @@ class LLMEngine:
             )
         self._slots[slot] = info
         self._deliver(slot, info, [first], first_lps)
+        # admission-time delivery: its own coalesced entry (the fetch
+        # pipeline's flush points never see this path)
+        self._flush_detok()
         if self.draft_runner is not None and slot in self._slots:
             # `first` is already the draft's pending last token (set at
             # insert); queueing it again would double-feed it
@@ -1644,6 +1792,7 @@ class LLMEngine:
                 self.flight.note_rollback(1)
                 return
             self._deliver(slot, info, [int(np.asarray(payload)[0])])
+            self._flush_detok()
             return
         if kind == "spec":
             tok_arr, produced = (np.asarray(x) for x in payload)
@@ -1684,6 +1833,9 @@ class LLMEngine:
             self._deliver(
                 slot, info, [int(t) for t in tok_arr[slot, :n]], lps
             )
+        # coalesce: every slot's accepted tokens from THIS fetch ride
+        # one detok queue entry
+        self._flush_detok()
 
     def _deliver(
         self, slot: int, info: _SlotInfo, toks: List[int], lps=None
@@ -1734,11 +1886,11 @@ class LLMEngine:
                 if dropped:
                     self.flight.note_rollback(dropped)
                 if offload:
-                    self._detok.put_tokens(info, offload)
+                    self._detok_batch.append((info, offload))
                 self._finish(slot, info, "stop" if is_eos else "length")
                 return
         if offload:
-            self._detok.put_tokens(info, offload)
+            self._detok_batch.append((info, offload))
 
     def _emit_text(self, info: _SlotInfo, final: bool) -> bool:
         """Advance incremental detokenization; stream newly-safe text.
@@ -1827,6 +1979,8 @@ class LLMEngine:
             req.done.set()
         else:
             # the final flush, stream sentinel and done event ride the
-            # detok worker: the FIFO queue keeps them behind this
-            # request's last token batch
+            # detok worker: flushing the coalesced batch FIRST keeps
+            # the FIFO queue's ordering contract (this request's last
+            # tokens precede its finish)
+            self._flush_detok()
             self._detok.finish(info)

@@ -209,6 +209,70 @@ class HostKVCache:
         v = np.concatenate([self._block_v(b) for b in run], axis=1)
         return k, v
 
+    def prefix_keys(self, prompt_ids) -> List[str]:
+        """Hex chain keys of the longest cached block run prefixing
+        ``prompt_ids`` (side-effect free). The KV-transfer dedup
+        protocol: a puller declares these so the exporter elides blocks
+        it already holds."""
+        prompt = tuple(int(t) for t in prompt_ids)
+        max_blocks = (len(prompt) - 1) // self.block_tokens
+        if max_blocks <= 0:
+            return []
+        return [
+            b.key.hex()
+            for b in self._walk(prompt, max_blocks, touch=False)
+        ]
+
+    def export_blocks(
+        self, prompt_ids, max_blocks: int = 0
+    ) -> List[dict]:
+        """The matched block run for ``prompt_ids`` AS STORED (int8
+        tiers export quantized + scales — no dequantize work, half the
+        wire bytes), for the KV-transfer exporter
+        (engine/kv_transfer.py). The walk touches recency (an exported
+        block is a hot block); array references are safe outside the
+        lock because blocks are immutable once attached."""
+        prompt = tuple(int(t) for t in prompt_ids)
+        limit = (len(prompt) - 1) // self.block_tokens
+        if max_blocks > 0:
+            limit = min(limit, max_blocks)
+        if limit <= 0:
+            return []
+        run = self._walk(prompt, limit, touch=True)
+        return [
+            {
+                "key": b.key.hex(),
+                "tokens": b.tokens,
+                "k": b.k,
+                "v": b.v,
+                "k_scale": b.k_scale,
+                "v_scale": b.v_scale,
+                "dtype": (
+                    "bfloat16"
+                    if str(b.dtype) == "bfloat16"
+                    else np.dtype(b.dtype).name
+                ),
+                "nbytes": b.nbytes,
+            }
+            for b in run
+        ]
+
+    def import_blocks(self, token_ids, prepared: Dict[int, Tuple]) -> int:
+        """Attach pre-converted blocks received over the wire:
+        ``prepared[b]`` is ``(k, v, scales|None, dtype, nbytes)`` for
+        block index ``b`` of ``token_ids``. Keys are recomputed from
+        the tokens (content addressing survives the wire); a gap —
+        neither cached nor provided — ends the run, so a truncated
+        transfer lands its complete prefix and nothing else."""
+        tokens = tuple(int(t) for t in token_ids)
+        n_blocks = len(tokens) // self.block_tokens
+        if n_blocks <= 0:
+            return 0
+        with self._lock:
+            return self._attach_prepared_locked(
+                tokens, n_blocks, prepared
+            )
+
     def match_prefix(
         self, prompt_ids
     ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
@@ -284,43 +348,54 @@ class HostKVCache:
                 prepared[b] = (
                     bk, bv, None, k.dtype, bk.nbytes + bv.nbytes
                 )
-        inserted = 0
-        # re-walk from the root to attach: the trie may have changed
-        # meanwhile (concurrent insert, eviction of the walked prefix) —
-        # existing blocks are touched, prepared ones attached, and a
-        # block that is neither (evicted prefix, rare race) ends the run
         with self._lock:
-            node = self._root
-            for b in range(n_blocks):
-                block = tokens[b * bt : (b + 1) * bt]
-                key = self._child_key(node.key, block)
-                child = node.children.get(key)
-                if child is not None and child.tokens == block:
-                    self._tick += 1
-                    child.last_used = self._tick
-                    node = child
-                    continue
-                if b not in prepared:
-                    break
-                bk, bv, scales, dtype, nbytes = prepared[b]
-                if nbytes > self.max_bytes:
-                    break   # one block over the whole budget: stop here
-                child = _Block(key, block, node)
-                child.k, child.v = bk, bv
-                if scales is not None:
-                    child.k_scale, child.v_scale = scales
-                child.dtype = dtype
-                child.nbytes = nbytes
+            return self._attach_prepared_locked(
+                tokens, n_blocks, prepared
+            )
+
+    def _attach_prepared_locked(
+        self, tokens: Tuple[int, ...], n_blocks: int,
+        prepared: Dict[int, Tuple],
+    ) -> int:
+        """Attach phase shared by the local store (insert_sequence) and
+        the wire import (import_blocks): re-walk from the root — the
+        trie may have changed since any earlier walk (concurrent
+        insert, eviction of the walked prefix) — touch existing blocks,
+        attach prepared ones, and end the run at the first block that
+        is neither (evicted prefix or transfer gap)."""
+        bt = self.block_tokens
+        inserted = 0
+        node = self._root
+        for b in range(n_blocks):
+            block = tokens[b * bt : (b + 1) * bt]
+            key = self._child_key(node.key, block)
+            child = node.children.get(key)
+            if child is not None and child.tokens == block:
                 self._tick += 1
                 child.last_used = self._tick
-                node.children[key] = child
-                node.refs += 1
-                self._blocks[key] = child
-                self._bytes += nbytes
-                self.blocks_inserted += 1
-                inserted += 1
                 node = child
-            self._evict_locked()
+                continue
+            if b not in prepared:
+                break
+            bk, bv, scales, dtype, nbytes = prepared[b]
+            if nbytes > self.max_bytes:
+                break   # one block over the whole budget: stop here
+            child = _Block(key, block, node)
+            child.k, child.v = bk, bv
+            if scales is not None:
+                child.k_scale, child.v_scale = scales
+            child.dtype = dtype
+            child.nbytes = nbytes
+            self._tick += 1
+            child.last_used = self._tick
+            node.children[key] = child
+            node.refs += 1
+            self._blocks[key] = child
+            self._bytes += nbytes
+            self.blocks_inserted += 1
+            inserted += 1
+            node = child
+        self._evict_locked()
         return inserted
 
     def _evict_locked(self) -> None:

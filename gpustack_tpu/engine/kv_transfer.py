@@ -1,0 +1,417 @@
+"""Content-addressed KV block transfer: disaggregated prefill/decode.
+
+The host KV cache (engine/kv_host_cache.py) already makes KV blocks
+content-addressed (radix trie on rolling sha256 block hashes) and
+serializable (host-RAM numpy, opt-in int8 with per-block scales). This
+module turns those blocks into a **wire format** so a prefill-role
+replica can hand a finished prompt's blocks to a decode-role replica
+(the reference treats extended KV cache + prefill-context-parallel as
+first-class placement fields, SURVEY §5 "Long-context"; vLLM's
+disaggregated serving moves KV over NCCL/LMCache — over PCIe-attached
+TPU hosts the transfer is plain HTTP between host RAMs).
+
+Wire format — a stream of self-describing frames, no stream trailer
+(the decoder yields every frame whose bytes fully arrived, so a peer
+dying mid-stream loses only the tail — the importer keeps the complete
+prefix, which is exactly what a radix cache can use):
+
+    magic   b"GKVX1\\n"                     (once, start of stream)
+    frame   u32 meta_len | meta JSON | k bytes | v bytes
+            | k_scale bytes | v_scale bytes
+
+``meta`` carries the block's chain key (hex — advisory; the importer
+recomputes keys from tokens, so content addressing survives the wire),
+its tokens, dtype/shape info, explicit payload byte lengths, and a
+crc32 of the payload. int8 blocks travel **as stored** (int8 + scales)
+— half the bytes of the fp tier, dequantized only if the receiving
+cache is not int8. A frame may be ``skipped`` (tokens only, no
+payload): the exporter elides blocks the requester declared it already
+holds (``have`` keys), while the token chain stays intact so the
+importer can rebuild the radix path.
+"""
+
+from __future__ import annotations
+
+import binascii
+import dataclasses
+import json
+import struct
+import threading
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"GKVX1\n"
+_U32 = struct.Struct("<I")
+
+# one frame's meta must stay far under this; a larger announced meta is
+# a corrupt or hostile stream, not a big block
+MAX_META_BYTES = 1 << 20
+
+
+def _dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if not _is_bf16(dtype) else "bfloat16"
+
+
+def _is_bf16(dtype) -> bool:
+    return str(np.dtype(dtype)) == "bfloat16" or str(dtype) == "bfloat16"
+
+
+def _dtype_from_name(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+@dataclasses.dataclass
+class Frame:
+    """One decoded wire frame (``skipped`` frames carry no arrays)."""
+
+    key: str                      # hex chain key (advisory)
+    tokens: Tuple[int, ...]
+    skipped: bool = False
+    k: Optional[np.ndarray] = None
+    v: Optional[np.ndarray] = None
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
+    dtype: str = ""               # logical (dequantized) dtype name
+    nbytes: int = 0               # payload bytes on the wire
+
+
+def _array_bytes(arr: Optional[np.ndarray]) -> bytes:
+    if arr is None:
+        return b""
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def encode_frame(
+    key_hex: str,
+    tokens,
+    *,
+    k: Optional[np.ndarray] = None,
+    v: Optional[np.ndarray] = None,
+    k_scale: Optional[np.ndarray] = None,
+    v_scale: Optional[np.ndarray] = None,
+    dtype: str = "",
+) -> bytes:
+    """One block → one wire frame. ``k is None`` encodes a skipped
+    (dedup) frame."""
+    kb, vb = _array_bytes(k), _array_bytes(v)
+    ksb, vsb = _array_bytes(k_scale), _array_bytes(v_scale)
+    payload = kb + vb + ksb + vsb
+    meta: Dict[str, Any] = {
+        "key": key_hex,
+        "tokens": [int(t) for t in tokens],
+    }
+    if k is None:
+        meta["skipped"] = True
+    else:
+        meta.update(
+            dtype=dtype or _dtype_name(k.dtype),
+            stored_dtype=_dtype_name(k.dtype),
+            k_shape=list(k.shape),
+            v_shape=list(v.shape),
+            k_len=len(kb),
+            v_len=len(vb),
+            ks_len=len(ksb),
+            vs_len=len(vsb),
+            crc=binascii.crc32(payload) & 0xFFFFFFFF,
+        )
+        if k_scale is not None:
+            meta["ks_shape"] = list(k_scale.shape)
+            meta["vs_shape"] = list(v_scale.shape)
+    mb = json.dumps(meta, separators=(",", ":")).encode()
+    return _U32.pack(len(mb)) + mb + payload
+
+
+def encode_stream(frames: Iterable[bytes]) -> Iterator[bytes]:
+    """Prepend the magic; yield each encoded frame."""
+    yield MAGIC
+    yield from frames
+
+
+class FrameDecoder:
+    """Incremental decoder: ``feed(chunk)`` yields every frame whose
+    bytes fully arrived. A truncated tail (peer died mid-stream) is
+    simply never yielded; a corrupt frame (bad magic, oversized meta,
+    crc mismatch) raises ``ValueError`` — the importer treats both the
+    same way: keep what landed, cold-start the rest."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._magic_seen = False
+
+    def feed(self, chunk: bytes) -> List[Frame]:
+        self._buf.extend(chunk)
+        out: List[Frame] = []
+        if not self._magic_seen:
+            if len(self._buf) < len(MAGIC):
+                return out
+            if bytes(self._buf[: len(MAGIC)]) != MAGIC:
+                raise ValueError("kv-transfer: bad stream magic")
+            del self._buf[: len(MAGIC)]
+            self._magic_seen = True
+        while True:
+            frame = self._try_frame()
+            if frame is None:
+                return out
+            out.append(frame)
+
+    def _try_frame(self) -> Optional[Frame]:
+        if len(self._buf) < _U32.size:
+            return None
+        (meta_len,) = _U32.unpack(bytes(self._buf[: _U32.size]))
+        if meta_len > MAX_META_BYTES:
+            raise ValueError(
+                f"kv-transfer: frame meta of {meta_len} bytes exceeds "
+                f"the {MAX_META_BYTES} cap"
+            )
+        if len(self._buf) < _U32.size + meta_len:
+            return None
+        meta = json.loads(
+            bytes(self._buf[_U32.size : _U32.size + meta_len])
+        )
+        if meta.get("skipped"):
+            del self._buf[: _U32.size + meta_len]
+            return Frame(
+                key=str(meta.get("key", "")),
+                tokens=tuple(int(t) for t in meta["tokens"]),
+                skipped=True,
+            )
+        payload_len = (
+            meta["k_len"] + meta["v_len"]
+            + meta.get("ks_len", 0) + meta.get("vs_len", 0)
+        )
+        total = _U32.size + meta_len + payload_len
+        if len(self._buf) < total:
+            return None
+        payload = bytes(self._buf[_U32.size + meta_len : total])
+        del self._buf[:total]
+        if (binascii.crc32(payload) & 0xFFFFFFFF) != meta.get("crc"):
+            raise ValueError("kv-transfer: frame crc mismatch")
+        off = 0
+
+        def take(n: int, shape, dtype) -> Optional[np.ndarray]:
+            nonlocal off
+            if n == 0:
+                return None
+            raw = payload[off : off + n]
+            off += n
+            return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+        stored = _dtype_from_name(
+            meta.get("stored_dtype") or meta["dtype"]
+        )
+        k = take(meta["k_len"], meta["k_shape"], stored)
+        v = take(meta["v_len"], meta["v_shape"], stored)
+        ks = take(
+            meta.get("ks_len", 0), meta.get("ks_shape"), np.float32
+        )
+        vs = take(
+            meta.get("vs_len", 0), meta.get("vs_shape"), np.float32
+        )
+        return Frame(
+            key=str(meta.get("key", "")),
+            tokens=tuple(int(t) for t in meta["tokens"]),
+            k=k, v=v, k_scale=ks, v_scale=vs,
+            dtype=meta["dtype"],
+            nbytes=payload_len,
+        )
+
+
+def decode_stream(data: bytes) -> List[Frame]:
+    """Whole-buffer convenience over :class:`FrameDecoder`."""
+    return FrameDecoder().feed(data)
+
+
+# ---------------------------------------------------------------------------
+# Cache-facing export / import
+# ---------------------------------------------------------------------------
+
+
+def encode_block(blk: Dict[str, Any], have_set) -> Tuple[bytes, bool]:
+    """One exported cache block → ``(wire frame, carried_payload)``:
+    a block the requester already holds travels as a token-only dedup
+    frame (payload False)."""
+    if blk["key"] in have_set:
+        return encode_frame(blk["key"], blk["tokens"]), False
+    return (
+        encode_frame(
+            blk["key"], blk["tokens"],
+            k=blk["k"], v=blk["v"],
+            k_scale=blk["k_scale"], v_scale=blk["v_scale"],
+            dtype=blk["dtype"],
+        ),
+        True,
+    )
+
+
+def export_frames(
+    cache,
+    prompt_ids,
+    have: Optional[Iterable[str]] = None,
+    max_blocks: int = 0,
+) -> Iterator[bytes]:
+    """Encode ``cache``'s matched block run for ``prompt_ids`` as wire
+    frames, eliding payloads for blocks whose chain key the requester
+    declared in ``have``. Blocks travel AS STORED (int8 stays int8 —
+    half the wire bytes), so export does no quantization work."""
+    have_set = frozenset(have or ())
+    blocks = cache.export_blocks(prompt_ids, max_blocks=max_blocks)
+    yield MAGIC
+    for blk in blocks:
+        yield encode_block(blk, have_set)[0]
+
+
+def prepare_import(
+    cache, frames: List[Frame]
+) -> Tuple[List[int], Dict[int, Tuple], int]:
+    """Convert decoded frames to the receiving cache's storage tier:
+    ``(token_chain, prepared_blocks, wire_bytes)`` ready for
+    ``cache.import_blocks`` (or the engine's stager-backed
+    ``kv_import_prepared``). Pure CPU work — callers run it off the
+    event loop. Every frame must carry exactly the importing cache's
+    block granularity: ``import_blocks`` re-slices the concatenated
+    token chain by ITS block_tokens, so a block-size-mismatched peer
+    (e.g. an old-generation exporter mid-rollout of a kv_block_tokens
+    change) would silently attach K/V to the wrong token runs —
+    rejected here instead (callers degrade to a cold prefill)."""
+    tokens: List[int] = []
+    prepared: Dict[int, Tuple] = {}
+    bytes_in = 0
+    for i, fr in enumerate(frames):
+        if len(fr.tokens) != cache.block_tokens:
+            raise ValueError(
+                f"kv-transfer: frame of {len(fr.tokens)} tokens does "
+                f"not match the cache's block_tokens="
+                f"{cache.block_tokens} (peer block-size mismatch)"
+            )
+        tokens.extend(fr.tokens)
+        if fr.skipped:
+            continue
+        bytes_in += fr.nbytes
+        prepared[i] = _to_cache_tier(cache, fr)
+    return tokens, prepared, bytes_in
+
+
+def import_frames(cache, frames: List[Frame]) -> Tuple[int, int, int]:
+    """Land decoded frames in ``cache``: rebuild the token chain (keys
+    are recomputed by the cache from tokens — the wire's hex keys are
+    advisory), convert payloads to the cache's tier (int8↔fp as
+    needed), attach. Returns ``(blocks_attached, tokens, bytes_in)``.
+
+    Skipped frames contribute tokens only (the requester already holds
+    those blocks); a skipped frame for a block the cache does NOT hold
+    ends the run — attaching past a gap would corrupt the radix path.
+    """
+    if not frames:
+        return 0, 0, 0
+    tokens, prepared, bytes_in = prepare_import(cache, frames)
+    attached = cache.import_blocks(tokens, prepared)
+    return attached, len(tokens), bytes_in
+
+
+def _to_cache_tier(cache, fr: Frame) -> Tuple:
+    """(k, v, scales|None, dtype, nbytes) in the receiving cache's
+    storage tier."""
+    from gpustack_tpu.engine.kv_host_cache import (
+        _dequantize_block,
+        _quantize_block,
+    )
+
+    logical = _dtype_from_name(fr.dtype)
+    is_int8 = fr.k_scale is not None
+    if cache.int8:
+        if is_int8:
+            k, v, scales = fr.k, fr.v, (fr.k_scale, fr.v_scale)
+        else:
+            qk, sk = _quantize_block(fr.k)
+            qv, sv = _quantize_block(fr.v)
+            k, v, scales = qk, qv, (sk, sv)
+        nbytes = (
+            k.nbytes + v.nbytes
+            + scales[0].nbytes + scales[1].nbytes
+        )
+        return k, v, scales, logical, nbytes
+    if is_int8:
+        k = _dequantize_block(fr.k, fr.k_scale, logical)
+        v = _dequantize_block(fr.v, fr.v_scale, logical)
+    else:
+        k, v = fr.k, fr.v
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    return k, v, None, logical, k.nbytes + v.nbytes
+
+
+# ---------------------------------------------------------------------------
+# Handoff accounting (rendered by the engine exporter)
+# ---------------------------------------------------------------------------
+
+HANDOFF_BUCKETS_S = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class SecondsHist:
+    """Minimal fixed-bucket histogram with the same ``snapshot()``
+    contract as the engine's LatencyHistogram (the exporter renders
+    both through one loop). Thread-safe: observed from request
+    handlers and executor threads."""
+
+    def __init__(self, buckets=HANDOFF_BUCKETS_S):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            self.total += value
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self.counts[i] += 1
+                    break
+            else:
+                self.counts[-1] += 1
+            self.count += 1
+
+    def snapshot(self):
+        with self._mu:
+            counts = list(self.counts)
+            total, count = self.total, self.count
+        cum, out = 0, []
+        for ub, c in zip(self.buckets, counts):
+            cum += c
+            out.append((ub, cum))
+        inf = cum + counts[-1]
+        out.append((float("inf"), inf))
+        return out, total, min(count, inf)
+
+
+class HandoffStats:
+    """Engine-side handoff accounting: bytes/blocks in either
+    direction, failures, and end-to-end pull latency. Counter writes
+    are GIL-atomic int adds from the aiohttp handlers and the kv-copy
+    executor; no lock needed."""
+
+    def __init__(self) -> None:
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.blocks_in = 0
+        self.blocks_out = 0
+        self.failures = 0
+        self.pulls = 0
+        self.seconds = SecondsHist()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "blocks_in": self.blocks_in,
+            "blocks_out": self.blocks_out,
+            "failures": self.failures,
+            "pulls": self.pulls,
+        }

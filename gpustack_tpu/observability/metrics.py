@@ -40,6 +40,14 @@ METRIC_FAMILIES = {
     "gpustack_kv_cache_misses": "counter",
     "gpustack_kv_cache_prefix_tokens_reused": "counter",
     "gpustack_kv_cache_bytes": "gauge",
+    # disaggregated KV handoff (engine/kv_transfer.py): wire bytes and
+    # blocks per direction (label direction=in|out), pull failures, and
+    # end-to-end pull latency — emitted by the engine exporter,
+    # normalized onto gpustack_tpu: by the worker
+    "gpustack_kv_handoff_bytes_total": "counter",
+    "gpustack_kv_handoff_blocks_total": "counter",
+    "gpustack_kv_handoff_failures_total": "counter",
+    "gpustack_kv_handoff_seconds": "histogram",
     # engine flight recorder (observability/flight.py): per-step
     # scheduler telemetry, emitted by the engine exporter and
     # normalized by the worker (worker/metrics_map.py)

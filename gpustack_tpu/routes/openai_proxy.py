@@ -166,16 +166,26 @@ async def _instance_fetch(
     raw_body: bytes = b"",
     content_type: str = "",
     trace=None,
+    preferred: int = 0,
+    affinity_key: str = "",
+    extra_headers=None,
 ):
     """Dial one of the model's RUNNING replicas with failover.
 
     Returns ``(upstream, None)`` on success or ``(None, error_response)``.
-    Replicas are tried in breaker-gated least-outstanding order; a
+    Replicas are tried in breaker-gated least-outstanding order — with
+    the prefix-affinity ``preferred`` replica promoted within the
+    admittable group, so a multi-turn conversation lands on the engine
+    whose radix KV cache already holds its prefix (breaker-open or
+    drained holders fall back to least-outstanding, never wait). A
     connect failure, a headers timeout, or a 5xx moves on to the next
     replica (jittered backoff, bounded attempts, overall deadline).
     Everything here happens before any byte reaches the client, so
     failing over can never duplicate output the client already saw.
     ``path_for(instance)`` builds the worker-proxy path per attempt.
+    ``affinity_key`` records the successful dial in the affinity map;
+    ``extra_headers`` carries the KV-handoff source headers to the
+    engine (forwarded through the worker's reverse proxy).
     """
     from gpustack_tpu.server.worker_request import worker_fetch
 
@@ -193,7 +203,9 @@ async def _instance_fetch(
         trace.begin("connect")
     loop = asyncio.get_running_loop()
     deadline = loop.time() + reg.failover_deadline
-    candidates = reg.order(instances)[: reg.failover_attempts]
+    candidates = reg.order(instances, preferred=preferred)[
+        : reg.failover_attempts
+    ]
     errors: List[str] = []
     tried = 0
     for inst in candidates:
@@ -226,12 +238,25 @@ async def _instance_fetch(
             continue
         reg.begin(model.id, inst.id)
         handed_off = False
-        hop_headers = None
+        hop_headers = dict(extra_headers or {})
+        if inst.id == int(
+            (hop_headers.get("X-GPUStack-KV-Source-Instance") or 0)
+        ):
+            # the dial landed on the KV source itself (failover, or the
+            # holder re-entered the candidate set): a self-pull would
+            # deadlock a single-slot engine on its own /kv/export
+            for h in (
+                "X-GPUStack-KV-Source",
+                "X-GPUStack-KV-Source-Auth",
+                "X-GPUStack-KV-Source-Instance",
+            ):
+                hop_headers.pop(h, None)
         if trace is not None:
             # propagate THIS hop's span id: the worker hop's parent_id
             # then points at a span that actually exists in the store,
             # so the cross-hop tree reconstructs from /v2/debug/traces
-            hop_headers = trace.ctx.propagation_headers()
+            hop_headers.update(trace.ctx.propagation_headers())
+        hop_headers = hop_headers or None
         try:
             try:
                 # wait_for is a HANG guard on time-to-headers only, and
@@ -300,6 +325,11 @@ async def _instance_fetch(
                 continue
             reg.record_success(inst.id)
             handed_off = True
+            if affinity_key:
+                # the conversation now lives on THIS replica: its KV
+                # cache will hold prompt + reply, so the next turn's
+                # longest-prefix lookup routes back here
+                reg.affinity.record(affinity_key, inst.id, model.id)
             if trace is not None:
                 trace.end(
                     "connect", instance_id=inst.id, attempts=tried
@@ -341,6 +371,91 @@ async def _instance_fetch(
         f"all replicas of {model.name!r} failed: "
         + "; ".join(errors[-3:]),
     )
+
+
+async def _affinity_routing(
+    app: web.Application,
+    model: Model,
+    instances: List[ModelInstance],
+    operation: str,
+    body: dict,
+    name: str,
+):
+    """Prefix-affinity + disaggregated routing decision for one chat
+    request. Returns ``(serving, preferred, affinity_key,
+    extra_headers)``:
+
+    - ``serving``: the candidate replica set — decode-role instances
+      for a disaggregated model (falling back to the full set if no
+      decode replica is RUNNING, so a half-converged flip still
+      serves);
+    - ``preferred``: the replica whose radix KV cache already holds
+      this conversation's prefix, when it is a serving candidate;
+    - ``affinity_key``: the full conversation-prefix hash to record on
+      the successful dial;
+    - ``extra_headers``: KV-handoff source headers when the prefix
+      lives on a NON-candidate replica (a prefill-role replica, or a
+      cold conversation on a disaggregated model — then the
+      least-loaded prefill replica computes the prompt KV and the
+      decode replica pulls it).
+    """
+    from gpustack_tpu.server.resilience import conversation_chain
+
+    reg = app["resilience"]
+    serving = instances
+    prefills: List[ModelInstance] = []
+    if model.disaggregated:
+        decode = [i for i in instances if i.role == "decode"]
+        serving = decode or instances
+        prefills = [i for i in instances if i.role == "prefill"]
+    messages = body.get("messages")
+    if operation != "chat/completions" or not isinstance(
+        messages, list
+    ) or not messages:
+        return serving, 0, "", None
+    if not model.host_kv_cache_mb and not model.disaggregated:
+        # no radix KV cache on the engines: affinity stickiness buys
+        # no prefix hit and would only fight least-outstanding
+        # balancing — stay out of the way entirely
+        return serving, 0, "", None
+    chain = conversation_chain(name, messages)
+    affinity_key = chain[-1]
+    holder_id = reg.affinity.lookup(chain)
+    serving_ids = {i.id for i in serving}
+    if holder_id is not None and holder_id in serving_ids:
+        return serving, holder_id, affinity_key, None
+    # the prefix lives off the candidate set (prefill replica, or the
+    # map outlived the holder's RUNNING row) — or nowhere yet
+    src = None
+    if holder_id is not None:
+        src = next((i for i in instances if i.id == holder_id), None)
+    if src is None and prefills:
+        # cold conversation on a disaggregated model: offload the
+        # prompt's prefill to a prefill-role replica; the decode
+        # replica pulls the blocks (prefill-on-miss export)
+        for cand in reg.order(prefills):
+            if reg.health(cand.id).breaker.would_allow():
+                src = cand
+                break
+    if src is None:
+        return serving, 0, affinity_key, None
+    worker = await Worker.get(src.worker_id or 0)
+    if worker is None or not worker.ip or not worker.port:
+        return serving, 0, affinity_key, None
+    headers = {
+        "X-GPUStack-KV-Source": (
+            f"http://{worker.ip}:{worker.port}"
+            f"/proxy/instances/{src.id}/kv/export"
+        ),
+        # lets the dial loop strip a self-pull if failover lands the
+        # request on the source itself (never forwarded to engines)
+        "X-GPUStack-KV-Source-Instance": str(src.id),
+    }
+    if worker.proxy_secret:
+        headers["X-GPUStack-KV-Source-Auth"] = (
+            f"Bearer {worker.proxy_secret}"
+        )
+    return serving, 0, affinity_key, headers
 
 
 def _extract_usage(payload: dict) -> Tuple[int, int]:
@@ -631,18 +746,34 @@ def add_openai_routes(app: web.Application) -> None:
         else:
             model, instances = target
             model_id, provider_id = model.id, 0
+            # prefix-affinity + disaggregated role routing: serve from
+            # the replica that already holds the conversation's radix
+            # prefix, or hand its KV between roles (docs/KV_CACHE.md)
+            serving, preferred, affinity_key, kv_headers = (
+                await _affinity_routing(
+                    app, model, instances, operation, body, str(name)
+                )
+            )
+            if trace is not None and (preferred or kv_headers):
+                attrs = {"handoff": bool(kv_headers)}
+                if preferred:
+                    attrs["preferred"] = preferred
+                trace.event("affinity", **attrs)
             # All data-plane traffic flows through the worker's
             # authenticated reverse proxy (or its tunnel): engines bind to
             # 127.0.0.1 and the bare engine port is never dialed (reference
             # routes/worker/proxy.py:200; round-1 direct dialing was an
             # unauthenticated bypass of the entire auth layer).
             upstream, err = await _instance_fetch(
-                app, model, instances,
+                app, model, serving,
                 lambda inst: (
                     f"/proxy/instances/{inst.id}/v1/{operation}"
                 ),
                 json_body=body,
                 trace=trace,
+                preferred=preferred,
+                affinity_key=affinity_key,
+                extra_headers=kv_headers,
             )
             if err is not None:
                 return err

@@ -210,9 +210,21 @@ def _modelscope_config_cached(model_id: str) -> dict:
 
 from gpustack_tpu.utils.profiling import timed
 
+# KV slots a PREFILL-role replica plans for: it computes prompt KV and
+# hands it off rather than decoding a full continuous batch, so a
+# couple of in-flight prefills bound its resident KV. This is what
+# makes context length a real placement dimension per role — a 32k-
+# context model's decode replicas claim the full ``max_slots`` KV
+# while its prefill replicas fit on fewer chips.
+PREFILL_ROLE_KV_SLOTS = 2
+
 
 @timed(threshold_s=5.0, name="scheduler.evaluate_model")
-def evaluate_model(model: Model) -> ModelEvaluation:
+def evaluate_model(model: Model, role: str = "") -> ModelEvaluation:
+    """HBM claim for one replica. ``role`` (disaggregated serving) is
+    a KV-sizing dimension: prefill-role replicas hold at most
+    ``PREFILL_ROLE_KV_SLOTS`` sequences of KV; decode/colocated
+    replicas hold ``max_slots``."""
     cfg = resolve_model_config(model)
     weight_bits = 8 if model.quantization == "int8" else 16
     weight_bytes = cfg.weight_bytes(weight_bits)
@@ -240,10 +252,13 @@ def evaluate_model(model: Model) -> ModelEvaluation:
     # allocates bf16 only for dtype == "bfloat16" and fp32 for anything
     # else, so mirror that exact rule or fp32 deployments undercount 2x
     kv_bits = 16 if getattr(cfg, "dtype", "bfloat16") == "bfloat16" else 32
+    kv_slots = model.max_slots
+    if role == "prefill":
+        kv_slots = min(model.max_slots, PREFILL_ROLE_KV_SLOTS)
     kv_bytes = (
         cfg.kv_cache_bytes_per_token(kv_bits)
         * model.max_seq_len
-        * model.max_slots
+        * kv_slots
     )
     # activation + runtime overhead: prefill attention scratch dominates;
     # scale with seq len, floor at 256 MiB (audio configs use d_model)

@@ -213,9 +213,13 @@ class Scheduler:
 
         try:
             # evaluate in an executor: it may shell out to model-meta on a
-            # large checkpoint dir — never block the control-plane loop
+            # large checkpoint dir — never block the control-plane loop.
+            # The instance's disaggregated role is a KV-sizing dimension
+            # (prefill replicas plan against a bounded handoff buffer,
+            # not the full continuous batch), so chips-per-replica is
+            # derived from the ROLE's KV fit.
             evaluation = await asyncio.get_running_loop().run_in_executor(
-                None, evaluate_model, model
+                None, evaluate_model, model, inst.role
             )
         except EvaluationError as e:
             await inst.update(

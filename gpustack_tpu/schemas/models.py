@@ -211,6 +211,16 @@ ROLLOUT_FIELDS = (
 )
 
 
+# Which modules may WRITE ``role=`` on a ModelInstance (path suffix).
+# The static state-machine rule (analysis/rules/state_machine.py)
+# enforces this like INSTANCE_STATE_WRITERS: a role is assigned exactly
+# once, at creation, from the spec's role deficit — any new write site
+# must be declared here. Keep LITERAL: the checker reads the AST.
+INSTANCE_ROLE_WRITERS = (
+    "server/controllers.py",   # create_pending_instances role deficit
+)
+
+
 def validate_instance_transition(
     old: "ModelInstanceState", new: "ModelInstanceState"
 ) -> bool:
@@ -276,6 +286,16 @@ class Model(Record):
     # in chunks with decode steps interleaved (vLLM enable-chunked-prefill
     # role; bounds long-prompt impact on running slots' token cadence)
     prefill_chunk: int = 0
+    # Disaggregated prefill/decode serving (docs/KV_CACHE.md "KV
+    # handoff"): both > 0 splits the replica set into role-tagged
+    # instances — prefill replicas compute prompt KV and export it
+    # (engine POST /kv/export), decode replicas own the token loop and
+    # pull handed-off blocks. Requires host_kv_cache_mb > 0 to do
+    # anything useful. 0/0 (default) = colocated replicas per
+    # ``replicas``. Roles scale independently: the autoscaler moves
+    # decode_replicas only; rollout surge caps apply per role.
+    prefill_replicas: int = 0
+    decode_replicas: int = 0
     # engine decode-fetch pipeline depth (dispatch-ahead overlap,
     # docs/ENGINE_PIPELINE.md): sampled-token fetches lag dispatch by
     # this many steps so host work overlaps device compute. 0 = inherit
@@ -317,6 +337,34 @@ class Model(Record):
     # leader's in-memory note_demand set never sees follower traffic.
     # The leader's autoscaler consumes and clears it.
     wake_requested_at: float = 0.0
+
+    @property
+    def disaggregated(self) -> bool:
+        """Both role counts set: the replica set splits into
+        prefill-role and decode-role instances."""
+        return self.prefill_replicas > 0 and self.decode_replicas > 0
+
+    def serving_replicas(self) -> int:
+        """Total replicas the spec wants: role counts for a
+        disaggregated model, ``replicas`` otherwise. Replica sync, the
+        rollout controller and the invariants all size against this."""
+        if self.disaggregated:
+            return max(0, self.prefill_replicas) + max(
+                0, self.decode_replicas
+            )
+        return max(0, self.replicas)
+
+    def role_spec(self) -> Dict[str, int]:
+        """Wanted instances per role tag (``""`` = colocated). A
+        disaggregated spec wants zero untagged instances, so flipping
+        disaggregation on converges existing colocated replicas out."""
+        if self.disaggregated:
+            return {
+                "prefill": max(0, self.prefill_replicas),
+                "decode": max(0, self.decode_replicas),
+                "": 0,
+            }
+        return {"prefill": 0, "decode": 0, "": max(0, self.replicas)}
 
     def source_str(self) -> str:
         return (
@@ -376,6 +424,12 @@ class ModelInstance(Record):
     # THAT spec (engines never restart on spec edits), so a mismatch
     # with the model's current generation is what a rollout converges
     generation: int = 0
+    # disaggregated-serving role tag ("" = colocated, "prefill",
+    # "decode"): fixed at creation (controllers assign it from the
+    # role deficit vs the spec) and flowed to the engine as --kv-role.
+    # The proxy serves traffic from decode-role replicas and hands
+    # conversation KV between roles (docs/KV_CACHE.md).
+    role: str = ""
 
     def is_placed(self) -> bool:
         return self.worker_id is not None

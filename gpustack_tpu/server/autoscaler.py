@@ -287,7 +287,16 @@ class Autoscaler(DirtyTrackedTask):
         st.name = model.name
         lo = max(0, model.autoscale_min)
         hi = max(lo, model.autoscale_max)
-        current = max(0, model.replicas)
+        # Disaggregated models scale their DECODE role only (decode
+        # capacity is the throughput dimension; prefill sizing is the
+        # operator's long-context lever) — and never to zero, because
+        # decode_replicas == 0 would flip the model out of
+        # disaggregated mode entirely. The scaled field is what the
+        # guarded write below targets.
+        field = "decode_replicas" if model.disaggregated else "replicas"
+        if model.disaggregated:
+            lo = max(1, lo)
+        current = max(0, getattr(model, field))
         st.target = current
 
         # traffic clock: any new proxied request resets the idle timer
@@ -446,13 +455,15 @@ class Autoscaler(DirtyTrackedTask):
         from gpustack_tpu.orm.record import ConflictError
 
         fresh = await Model.get(model.id)
-        if fresh is None or fresh.replicas != model.replicas:
+        if fresh is None or getattr(fresh, field) != getattr(
+            model, field
+        ):
             # compare the RAW snapshot, not the 0-clamped `current`: a
             # (client-writable) negative replica count would otherwise
             # mismatch forever and silently wedge bounds/wake
             return None  # changed under us; re-decide next tick
         try:
-            await fresh.update(_retries=0, replicas=target)
+            await fresh.update(_retries=0, **{field: target})
         except ConflictError:
             return None  # changed under us; re-decide next tick
         # exported target tracks WRITES only — set after the

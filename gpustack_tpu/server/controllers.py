@@ -35,22 +35,46 @@ from gpustack_tpu.utils.profiling import timed
 logger = logging.getLogger(__name__)
 
 
+def role_deficit(model: Model, existing: list) -> list:
+    """Role tags the spec still needs, given ``existing`` instances —
+    prefill first (a disaggregated model with no prefill replica can
+    serve but never hand KV off). Colocated models return untagged
+    slots sized against ``replicas``. Callers cap the list themselves
+    (e.g. rollout surge batches)."""
+    by_role: dict = {}
+    for inst in existing:
+        by_role[inst.role] = by_role.get(inst.role, 0) + 1
+    missing: list = []
+    for role, want in model.role_spec().items():
+        short = want - by_role.get(role, 0)
+        if short > 0:
+            missing.extend([role] * short)
+    return missing
+
+
 async def create_pending_instances(
     model: Model,
     count: int,
     generation: int,
     existing: list,
     prefix: Optional[str] = None,
+    roles: Optional[list] = None,
 ) -> list:
     """Create ``count`` PENDING replicas for ``model`` tagged with
     ``generation``, skipping name collisions with ``existing``.
 
     Shared by replica sync (steady-state creation, ``model-N`` names)
     and the rollout controller's surge step (``model-gG-N`` names) so
-    instance-creation defaults live in exactly one place.
+    instance-creation defaults live in exactly one place. ``roles``
+    assigns each new instance's disaggregated-serving role tag (the
+    role deficit vs the spec — see :func:`role_deficit`); None derives
+    it from ``existing``, so every creation path converges the role
+    populations without thinking about them.
     """
     used = {i.name for i in existing}
     stem = prefix or model.name
+    if roles is None:
+        roles = role_deficit(model, existing)
     created = []
     idx = 0
     while len(created) < count:
@@ -58,6 +82,7 @@ async def create_pending_instances(
         idx += 1
         if name in used:
             continue
+        role = roles[len(created)] if len(created) < len(roles) else ""
         inst = await ModelInstance.create(ModelInstance(
             name=name,
             model_id=model.id,
@@ -65,6 +90,7 @@ async def create_pending_instances(
             cluster_id=model.cluster_id,
             state=ModelInstanceState.PENDING,
             generation=generation,
+            role=role,
         ))
         created.append(inst)
     return created
@@ -212,44 +238,62 @@ class ModelController(Controller):
             # count enforcement here would fight its arithmetic
             return
         instances = await ModelInstance.filter(model_id=model.id)
-        want = max(0, model.replicas)
-        if len(instances) < want:
+        missing = role_deficit(model, instances)
+        if missing:
             # new replicas tagged with the spec version they will
-            # serve — the RolloutController converges tags
+            # serve — the RolloutController converges tags — and with
+            # their disaggregated-serving role (the deficit per role,
+            # so prefill and decode populations converge independently)
             created = await create_pending_instances(
-                model, want - len(instances),
-                model.generation, instances,
+                model, len(missing),
+                model.generation, instances, roles=missing,
             )
             for inst in created:
                 instances.append(inst)
-                logger.info("created instance %s", inst.name)
-        elif len(instances) > want:
-            # retire non-running first, then newest
-            order = {
-                ModelInstanceState.RUNNING: 1,
-            }
-            doomed = sorted(
-                instances,
-                key=lambda i: (order.get(i.state, 0), -i.id),
-            )[: len(instances) - want]
-            for inst in doomed:
-                if inst.state == ModelInstanceState.DRAINING:
-                    continue  # already on its way out
-                if inst.state == ModelInstanceState.RUNNING:
-                    # graceful scale-down: DRAINING holds the chip claim
-                    # while the worker finishes in-flight requests, then
-                    # the worker retires the row itself — a hard delete
-                    # would free the claim under a still-serving engine
-                    logger.info(
-                        "draining instance %s for scale-down", inst.name
-                    )
-                    await inst.update(
-                        state=ModelInstanceState.DRAINING,
-                        state_message="scale-down drain",
-                    )
-                    continue
-                logger.info("retiring instance %s", inst.name)
-                await inst.delete()
+                logger.info(
+                    "created instance %s%s", inst.name,
+                    f" (role {inst.role})" if inst.role else "",
+                )
+        # excess is judged PER ROLE: a disaggregated model with a
+        # decode surplus must never drain a prefill replica for it
+        # (and flipping disaggregation on/off converges the now-
+        # unwanted role's population out)
+        by_role: dict = {}
+        for inst in instances:
+            by_role.setdefault(inst.role, []).append(inst)
+        spec_roles = model.role_spec()
+        for role, insts in by_role.items():
+            excess = len(insts) - spec_roles.get(role, 0)
+            if excess > 0:
+                await self._retire_excess(insts, excess)
+
+    async def _retire_excess(self, insts: list, excess: int) -> None:
+        # retire non-running first, then newest
+        order = {
+            ModelInstanceState.RUNNING: 1,
+        }
+        doomed = sorted(
+            insts,
+            key=lambda i: (order.get(i.state, 0), -i.id),
+        )[:excess]
+        for inst in doomed:
+            if inst.state == ModelInstanceState.DRAINING:
+                continue  # already on its way out
+            if inst.state == ModelInstanceState.RUNNING:
+                # graceful scale-down: DRAINING holds the chip claim
+                # while the worker finishes in-flight requests, then
+                # the worker retires the row itself — a hard delete
+                # would free the claim under a still-serving engine
+                logger.info(
+                    "draining instance %s for scale-down", inst.name
+                )
+                await inst.update(
+                    state=ModelInstanceState.DRAINING,
+                    state_message="scale-down drain",
+                )
+                continue
+            logger.info("retiring instance %s", inst.name)
+            await inst.delete()
 
     async def _ensure_route(self, model: Model) -> None:
         route = await ModelRoute.first(name=model.name)

@@ -26,13 +26,103 @@ instance on that worker without waiting for a request to fail).
 from __future__ import annotations
 
 import asyncio
+import collections
 import enum
+import hashlib
+import json
 import logging
 import random
 import time
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
+
+
+def conversation_chain(model_name: str, messages: Sequence) -> List[str]:
+    """Rolling hex digests of a chat conversation's message prefixes:
+    ``chain[k]`` keys ``messages[:k+1]``. The affinity map records the
+    FULL chain head when a request is routed and looks up the longest
+    recorded prefix on the next turn — turn N+1's ``messages[:len_N]``
+    equals turn N's full message list, so the lookup finds the replica
+    whose radix KV cache already holds the conversation. Rolling
+    (chained) hashing keeps the whole chain O(total bytes)."""
+    chain: List[str] = []
+    h = hashlib.sha256(model_name.encode())
+    for msg in messages:
+        if isinstance(msg, dict):
+            payload = json.dumps(
+                {
+                    "role": msg.get("role", ""),
+                    "content": msg.get("content", ""),
+                },
+                sort_keys=True, default=str,
+            )
+        else:
+            payload = str(msg)
+        h.update(payload.encode())
+        chain.append(h.hexdigest())
+    return chain
+
+
+class PrefixAffinityMap:
+    """Bounded map: conversation-prefix hash head → instance id.
+
+    One map per :class:`ResilienceRegistry` (keys embed the model
+    name via :func:`conversation_chain`, entries also carry the model
+    id for targeted invalidation). LRU eviction bounds memory under
+    many concurrent conversations; entries pointing at a replica that
+    drained, errored, was deleted, or was re-tagged by a rollout are
+    invalidated by the registry's watch feed. Hit/miss counters are
+    exported on /metrics."""
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = max(16, int(max_entries))
+        # key -> (instance_id, model_id); OrderedDict = LRU order
+        self._entries: "collections.OrderedDict[str, Tuple[int, int]]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def record(self, key: str, instance_id: int, model_id: int) -> None:
+        if not key:
+            return
+        if key in self._entries:
+            self._entries.pop(key)
+        self._entries[key] = (instance_id, model_id)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def lookup(self, chain: Sequence[str]) -> Optional[int]:
+        """Longest recorded prefix wins: walk the chain from the
+        newest prefix down. Counts ONE hit or miss per lookup."""
+        for key in reversed(chain):
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return hit[0]
+        self.misses += 1
+        return None
+
+    def invalidate_instance(self, instance_id: int) -> int:
+        """Drop every entry pointing at ``instance_id`` (drained,
+        deleted, errored, or re-tagged replica — its KV is gone or its
+        role changed out from under the conversation)."""
+        doomed = [
+            k for k, (iid, _) in self._entries.items()
+            if iid == instance_id
+        ]
+        for k in doomed:
+            del self._entries[k]
+        self.invalidations += len(doomed)
+        return len(doomed)
 
 
 class BreakerState(str, enum.Enum):
@@ -152,6 +242,7 @@ class ResilienceRegistry:
         breaker_failure_threshold: int = 3,
         breaker_open_seconds: float = 10.0,
         model_max_outstanding: int = 256,
+        affinity_max_entries: int = 4096,
         clock=time.monotonic,
     ):
         self.failover_attempts = max(1, failover_attempts)
@@ -163,6 +254,9 @@ class ResilienceRegistry:
         self._clock = clock
         self._instances: Dict[int, InstanceHealth] = {}
         self._model_outstanding: Dict[int, int] = {}
+        # prefix-affinity routing (docs/KV_CACHE.md): conversation →
+        # the replica whose radix KV cache already holds its prefix
+        self.affinity = PrefixAffinityMap(affinity_max_entries)
         # counters (exported via server /metrics)
         self.failovers_total = 0
         self.shed_total = 0
@@ -189,6 +283,9 @@ class ResilienceRegistry:
             model_max_outstanding=int(
                 getattr(cfg, "model_max_outstanding", 256)
             ),
+            affinity_max_entries=int(
+                getattr(cfg, "affinity_max_entries", 4096)
+            ),
         )
 
     # ---- per-instance state ---------------------------------------------
@@ -211,8 +308,10 @@ class ResilienceRegistry:
 
     def forget(self, instance_id: int) -> None:
         """Instance deleted: drop its state (ids are never reused by the
-        autoincrement PK, so stale entries are pure leak)."""
+        autoincrement PK, so stale entries are pure leak) and its
+        affinity entries (its KV died with its engine)."""
         self._instances.pop(instance_id, None)
+        self.affinity.invalidate_instance(instance_id)
 
     def reset(self, instance_id: int) -> None:
         """Instance freshly RUNNING (restart recovered): clean slate so a
@@ -265,17 +364,21 @@ class ResilienceRegistry:
 
     # ---- selection --------------------------------------------------------
 
-    def order(self, instances: Sequence) -> List:
+    def order(self, instances: Sequence, preferred: int = 0) -> List:
         """Preference order for a dial: breaker-admittable replicas
-        first, least-outstanding-requests within each group (random
-        tie-break so equal replicas share load). Breaker-open replicas
-        stay in the list (last) purely so ``seconds_until_any_probe``
-        and callers can report on them — ``admit`` still refuses them."""
+        first, the prefix-affinity ``preferred`` replica ahead of its
+        group, then least-outstanding-requests (random tie-break so
+        equal replicas share load). Breaker-open replicas stay in the
+        list (last) purely so ``seconds_until_any_probe`` and callers
+        can report on them — ``admit`` still refuses them. An affinity
+        hit on a broken replica therefore falls back to the normal
+        least-outstanding pick, never waits on the breaker."""
 
         def key(inst):
             h = self.health(inst.id)
             return (
                 0 if h.breaker.would_allow() else 1,
+                0 if inst.id == preferred else 1,
                 h.outstanding,
                 random.random(),
             )
@@ -354,17 +457,29 @@ class ResilienceRegistry:
                     if event.type == EventType.DELETED:
                         self.forget(event.id)
                         continue
+                    changes = event.changes or {}
+                    # rollout re-tag (generation flip on a rollback's
+                    # surviving replicas) or a role change: the
+                    # conversation map must not keep steering turns at
+                    # an instance whose spec/role moved under it
+                    if "generation" in changes or "role" in changes:
+                        self.affinity.invalidate_instance(event.id)
                     # TRANSITIONS only: keying off the absolute state
                     # would let any unrelated row update while RUNNING
                     # close a legitimately open breaker (and re-trip an
                     # open one on repeated ERROR-state writes)
-                    changed = (event.changes or {}).get("state")
+                    changed = changes.get("state")
                     if not changed:
                         continue
                     state = changed[1]
                     if state == ModelInstanceState.RUNNING.value:
                         self.reset(event.id)
-                    elif state in (
+                    else:
+                        # any exit from RUNNING (drain, error,
+                        # unreachable, re-drive) invalidates affinity:
+                        # the engine — and its radix KV — is going away
+                        self.affinity.invalidate_instance(event.id)
+                    if state in (
                         ModelInstanceState.ERROR.value,
                         ModelInstanceState.UNREACHABLE.value,
                     ):
@@ -433,6 +548,21 @@ class ResilienceRegistry:
             "# TYPE gpustack_proxy_breaker_opens_total counter",
             f"gpustack_proxy_breaker_opens_total "
             f"{self.breaker_opens_total}",
+            # prefix-affinity routing (conversation → KV-holding
+            # replica): consult outcomes + map churn
+            "# TYPE gpustack_proxy_affinity_hits_total counter",
+            f"gpustack_proxy_affinity_hits_total {self.affinity.hits}",
+            "# TYPE gpustack_proxy_affinity_misses_total counter",
+            f"gpustack_proxy_affinity_misses_total "
+            f"{self.affinity.misses}",
+            "# TYPE gpustack_proxy_affinity_entries gauge",
+            f"gpustack_proxy_affinity_entries {len(self.affinity)}",
+            "# TYPE gpustack_proxy_affinity_evictions_total counter",
+            f"gpustack_proxy_affinity_evictions_total "
+            f"{self.affinity.evictions}",
+            "# TYPE gpustack_proxy_affinity_invalidations_total counter",
+            f"gpustack_proxy_affinity_invalidations_total "
+            f"{self.affinity.invalidations}",
         ]
         if self._instances:
             lines.append("# TYPE gpustack_proxy_breaker_state gauge")

@@ -354,7 +354,7 @@ class RolloutController(DirtyTrackedTask):
     def _needs_rollout(
         self, model: Model, insts: List[ModelInstance], ros: List[Rollout]
     ) -> bool:
-        if model.replicas <= 0 or not insts:
+        if model.serving_replicas() <= 0 or not insts:
             return False
         if all(i.generation == model.generation for i in insts):
             return False
@@ -418,7 +418,10 @@ class RolloutController(DirtyTrackedTask):
         insts: List[ModelInstance],
         now: float,
     ) -> None:
-        spec = max(0, model.replicas)
+        # disaggregated models roll their full role-tagged population
+        # (prefill + decode); surge batches draw roles from the new
+        # generation's per-role deficit, so the per-role caps hold
+        spec = model.serving_replicas()
         new = [
             i for i in insts if i.generation == rollout.to_generation
         ]
@@ -536,10 +539,16 @@ class RolloutController(DirtyTrackedTask):
         if len(new) < want_new:
             # new + old is the model's full instance snapshot for this
             # reconcile pass — the name-collision set needs no re-query
+            from gpustack_tpu.server.controllers import role_deficit
+
             created = await create_pending_instances(
                 model, want_new - len(new),
                 rollout.to_generation, new + old,
                 prefix=f"{model.name}-g{rollout.to_generation}",
+                # roles from the NEW generation's deficit vs the role
+                # spec: per-role populations never exceed their spec
+                # within a rollout (the per-role surge cap)
+                roles=role_deficit(model, new)[: want_new - len(new)],
             )
             for inst in created:
                 logger.info(

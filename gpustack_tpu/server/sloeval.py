@@ -174,7 +174,11 @@ class SLOEvaluator(PeriodicTask):
         target = resolve_target(
             model.slo_availability, self.cfg.slo_default_availability
         )
-        replicas = max(0, model.replicas)
+        # serving_replicas(): role counts for a disaggregated model
+        # (whose `replicas` field is ignored and may be 0), plain
+        # `replicas` otherwise — the same denominator replica sync,
+        # rollouts and the invariants converge toward
+        replicas = model.serving_replicas()
         if target is None or replicas == 0:
             return
         running = sum(

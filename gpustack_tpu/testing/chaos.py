@@ -96,6 +96,17 @@ HA_FAULT_KINDS = (
     "lease_expire",
 )
 
+# disaggregated-serving faults: require a role-tagged (prefill/decode)
+# deployment — kept out of FAULT_KINDS so plain classes never draw one
+#   * kv_handoff_abort — a real proxied request routes through the
+#     disaggregated handoff path (decode replica pulling the prefill
+#     replica's /kv/export) and the PREFILL worker is killed
+#     mid-stream: the decode replica must complete the request from
+#     cold, and the cluster must re-converge the role populations
+DISAGG_FAULT_KINDS = (
+    "kv_handoff_abort",
+)
+
 # the acceptance matrix: one seeded schedule per named fault class
 FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "worker-kill": ("worker_kill",),
@@ -104,6 +115,7 @@ FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "engine-crash": ("engine_crash",),
     "server-restart": ("server_restart",),
     "ha-failover": HA_FAULT_KINDS,
+    "kv-handoff": DISAGG_FAULT_KINDS,
     "mixed": FAULT_KINDS,
 }
 
@@ -213,6 +225,15 @@ class StubWorker:
         # instance ids answer 500 (a "bad canary" for rollout e2es)
         self.proxy_fail_ids: set = set()
         self.proxied = 0                # data-plane requests served
+        # disaggregated KV handoff simulation: /kv/export streams this
+        # many paced chunks (export_delay apart — a kill mid-window
+        # drops the connection, the kv_handoff_abort fault); a proxied
+        # request carrying X-GPUStack-KV-Source pulls from that URL
+        # first and records the outcome ("ok" | "failed-cold")
+        self.export_delay = 0.0
+        self.export_chunks = 6
+        self.export_started = asyncio.Event()
+        self.handoff_outcomes: List[str] = []
         self._starting: set = set()
         self._paused = asyncio.Event()  # cleared == suspended
         self._paused.set()
@@ -263,11 +284,58 @@ class StubWorker:
                     },
                 )
             self.proxied += 1
+            if request.match_info["tail"].rstrip("/") == "kv/export":
+                # prefill-role side of a KV handoff: stream paced fake
+                # frames. A worker killed mid-window drops the
+                # connection mid-stream — exactly the kv_handoff_abort
+                # shape the decode side must survive.
+                self.export_started.set()
+                resp = web.StreamResponse(headers={
+                    "Content-Type": "application/x-gpustack-kv"
+                })
+                await resp.prepare(request)
+                for i in range(self.export_chunks):
+                    await resp.write(b"GKVX-STUB-%02d" % i)
+                    if self.export_delay:
+                        await asyncio.sleep(self.export_delay)
+                await resp.write_eof()
+                return resp
             if iid in self.proxy_fail_ids:
                 return web.json_response(
                     {"error": "chaos: injected engine failure"},
                     status=500,
                 )
+            src = request.headers.get("X-GPUStack-KV-Source", "")
+            if src:
+                # decode-role side: pull the conversation's blocks from
+                # the named peer BEFORE serving — a dead/dying peer
+                # degrades to a cold completion, never a failure
+                outcome = "ok"
+                try:
+                    headers = {}
+                    src_auth = request.headers.get(
+                        "X-GPUStack-KV-Source-Auth", ""
+                    )
+                    if src_auth:
+                        headers["Authorization"] = src_auth
+                    async with aiohttp.ClientSession() as http:
+                        async with http.post(
+                            src,
+                            json={"prompt_ids": [], "have": []},
+                            headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=15),
+                        ) as r:
+                            if r.status != 200:
+                                raise aiohttp.ClientError(
+                                    f"peer HTTP {r.status}"
+                                )
+                            async for _ in r.content.iter_any():
+                                pass
+                except (
+                    aiohttp.ClientError, asyncio.TimeoutError, OSError
+                ):
+                    outcome = "failed-cold"
+                self.handoff_outcomes.append(outcome)
             return web.json_response({
                 "id": f"stub-{iid}-{self.proxied}",
                 "object": "chat.completion",
@@ -722,6 +790,9 @@ class ChaosHarness:
         self.monitor_violations: List[inv.Violation] = []
         self.skipped_ops: List[ChaosOp] = []
         self.probe_results: List = []
+        # kv_handoff_abort outcomes: one entry per executed op
+        self.handoff_results: List[Dict] = []
+        self._deployed_model = "chaos-model"
         self.election_events: List[Dict] = []
         self.fenced_audit: List[Dict] = []
         self._restores: List[asyncio.Task] = []
@@ -943,9 +1014,14 @@ class ChaosHarness:
     # ---- workload ----------------------------------------------------
 
     async def deploy(
-        self, name: str = "chaos-model", replicas: Optional[int] = None
+        self,
+        name: str = "chaos-model",
+        replicas: Optional[int] = None,
+        *,
+        prefill_replicas: int = 0,
+        decode_replicas: int = 0,
     ) -> dict:
-        return await self.admin.create("models", {
+        spec = {
             "name": name,
             "preset": "tiny",
             "replicas": (
@@ -954,7 +1030,18 @@ class ChaosHarness:
             "max_seq_len": 256,
             "max_slots": 2,
             "distributable": False,
-        })
+        }
+        if prefill_replicas and decode_replicas:
+            # disaggregated deployment (kv-handoff class): role-tagged
+            # replicas + a host KV cache so the proxy's handoff path
+            # engages
+            spec.update(
+                prefill_replicas=prefill_replicas,
+                decode_replicas=decode_replicas,
+                host_kv_cache_mb=64,
+            )
+        self._deployed_model = name
+        return await self.admin.create("models", spec)
 
     # ---- fault execution ---------------------------------------------
 
@@ -1070,6 +1157,8 @@ class ChaosHarness:
             self._restore_later(
                 self.ha_ttl * 1.6 + op.arg, coord.hang_gate.set
             )
+        elif op.kind == "kv_handoff_abort":
+            await self._kv_handoff_abort(op)
         elif op.kind == "lease_expire":
             if len(self.alive_indexes()) <= 1:
                 self.skipped_ops.append(op)
@@ -1104,6 +1193,80 @@ class ChaosHarness:
                 })
         else:
             raise ValueError(f"unknown chaos op kind {op.kind!r}")
+
+    async def _kv_handoff_abort(self, op: ChaosOp) -> None:
+        """Kill the prefill replica's worker MID-HANDOFF: a real
+        proxied chat request routes through the server's disaggregated
+        path (affinity miss → X-GPUStack-KV-Source at the prefill
+        replica → decode stub pulls its paced /kv/export), and the
+        prefill host dies while the stream is open. The request must
+        still complete (cold) and the cluster must re-converge."""
+        insts = await self.admin.list("model-instances")
+        pre = [
+            i for i in insts
+            if i.get("role") == "prefill" and i["state"] == "running"
+        ]
+        alive = [s for s in self.stubs if s.alive]
+        stub = None
+        if pre:
+            stub = next(
+                (
+                    s for s in alive
+                    if s.worker_id == pre[0].get("worker_id")
+                ),
+                None,
+            )
+        if stub is None or len(alive) <= 1:
+            # no running prefill replica to kill, or killing it would
+            # strand the cluster: nothing this op can prove
+            self.skipped_ops.append(op)
+            return
+        # pace the export so the kill provably lands mid-stream
+        stub.export_delay = max(0.2, op.arg)
+        stub.export_started.clear()
+        headers = {"Authorization": f"Bearer {self._admin_token}"}
+        payload = {
+            "model": self._deployed_model,
+            "messages": [{
+                "role": "user",
+                "content": f"chaos handoff probe at {op.at}",
+            }],
+            "max_tokens": 4,
+        }
+
+        async def fire():
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    self.base + "/v1/chat/completions",
+                    json=payload, headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as r:
+                    return r.status, await r.json()
+
+        task = asyncio.create_task(fire(), name="chaos-handoff-req")
+        started = True
+        try:
+            await asyncio.wait_for(stub.export_started.wait(), 10.0)
+        except asyncio.TimeoutError:
+            started = False
+        await stub.kill()   # the prefill host dies mid-stream
+        try:
+            status, body = await task
+        except CLIENT_ERRORS as e:
+            status, body = 0, {"error": repr(e)}
+        outcomes = [
+            o for s in self.stubs for o in s.handoff_outcomes
+        ]
+        self.handoff_results.append({
+            "status": status,
+            "killed_mid_stream": started,
+            "decode_outcomes": outcomes,
+            "content": (
+                (body.get("choices") or [{}])[0]
+                .get("message", {}).get("content", "")
+                if isinstance(body, dict) else ""
+            ),
+        })
 
     async def _wait_leader(
         self, timeout: Optional[float] = None
@@ -1321,7 +1484,13 @@ async def run_seeded(
     )
     await harness.start()
     try:
-        await harness.deploy()
+        if any(k in DISAGG_FAULT_KINDS for k in kinds):
+            # KV-handoff faults need a role-tagged deployment
+            await harness.deploy(
+                prefill_replicas=1, decode_replicas=1
+            )
+        else:
+            await harness.deploy()
         await harness.wait_converged(timeout=converge_timeout)
         await harness.run_schedule(schedule)
         await harness.wait_converged(timeout=converge_timeout)
@@ -1340,6 +1509,7 @@ async def run_seeded(
                 "dropped": harness.injector.dropped,
             },
             "servers": servers,
+            "handoffs": list(harness.handoff_results),
             "dead_servers": sorted(harness.dead),
             "election_events": len(harness.election_events),
             # true fence REJECTIONS only: a fenced-context write can

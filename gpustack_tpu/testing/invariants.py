@@ -209,13 +209,27 @@ def check_replica_convergence(
             # drains batches — its own surge-cap check governs here
             continue
         mine = per_model.get(model.id, [])
-        want = max(0, model.replicas)
+        want = model.serving_replicas()
         if len(mine) != want:
             out.append(Violation(
                 "replica-count-diverged", "eventual",
                 f"model {model.name}: {len(mine)} instance(s), "
                 f"spec says {want}",
             ))
+        # disaggregated models must also converge PER ROLE: the right
+        # total with the wrong prefill/decode split still can't serve
+        # (checked for colocated models only when stray role tags
+        # exist, so the total check isn't double-reported)
+        if model.disaggregated or any(i.role for i in mine):
+            for role, want_role in model.role_spec().items():
+                have_role = sum(1 for i in mine if i.role == role)
+                if have_role != want_role:
+                    out.append(Violation(
+                        "replica-role-diverged", "eventual",
+                        f"model {model.name}: {have_role} "
+                        f"{role or 'untagged'} instance(s), spec says "
+                        f"{want_role}",
+                    ))
         not_running = [
             f"{i.name}={i.state.value}"
             for i in mine
@@ -263,6 +277,27 @@ def check_rollout_surge(
                 f"instance(s) during rollout {r.id}, surge cap is "
                 f"{cap} (promoted {r.promoted} + surge {r.surge})",
             ))
+        if model.disaggregated:
+            # the surge cap applies PER ROLE for disaggregated models:
+            # surge batches draw from the new generation's role
+            # deficit, so any role exceeding its spec + surge is a
+            # runaway creation loop in that role's population
+            for role, spec_role in model.role_spec().items():
+                have_role = sum(
+                    1 for inst in instances
+                    if inst.model_id == r.model_id
+                    and inst.generation == r.to_generation
+                    and inst.role == role
+                )
+                role_cap = spec_role + max(1, r.surge)
+                if have_role > role_cap:
+                    out.append(Violation(
+                        "rollout-role-surge-exceeded", "always",
+                        f"model {model.name}: {have_role} "
+                        f"new-generation {role or 'untagged'} "
+                        f"instance(s) during rollout {r.id}, per-role "
+                        f"cap is {role_cap}",
+                    ))
     return out
 
 
@@ -313,10 +348,20 @@ def check_autoscale_bounds(models: Sequence) -> List[Violation]:
             continue
         lo = max(0, model.autoscale_min)
         hi = max(lo, model.autoscale_max)
-        if not lo <= model.replicas <= hi:
+        # disaggregated models autoscale their decode role only (the
+        # autoscaler additionally floors lo at 1 there — decode 0
+        # would flip the model out of disaggregated mode)
+        if model.disaggregated:
+            lo = max(1, lo)
+            scaled = model.decode_replicas
+            what = "decode_replicas"
+        else:
+            scaled = model.replicas
+            what = "replicas"
+        if not lo <= scaled <= hi:
             out.append(Violation(
                 "autoscale-bounds", "eventual",
-                f"model {model.name}: replicas {model.replicas} "
+                f"model {model.name}: {what} {scaled} "
                 f"outside autoscale bounds [{lo}, {hi}]",
             ))
     return out

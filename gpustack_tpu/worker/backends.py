@@ -193,6 +193,19 @@ def _tpu_native_command(
             argv += ["--kv-block-tokens", str(model.kv_block_tokens)]
         if model.kv_cache_int8:
             argv += ["--kv-cache-int8"]
+    if instance.role:
+        # disaggregated prefill/decode role tag (ModelSpec
+        # prefill_replicas/decode_replicas → controllers role deficit).
+        # Passed even without a host KV cache so health/debug surfaces
+        # show the tag — but warn: roleless KV means no handoff.
+        if not model.host_kv_cache_mb or multi_host:
+            logger.warning(
+                "model %s: instance %s is role-tagged %r but has no "
+                "host KV cache%s — KV handoff between roles is "
+                "disabled", model.name, instance.name, instance.role,
+                " (multi-host)" if multi_host else "",
+            )
+        argv += ["--kv-role", instance.role]
     if multi_host and model.speculative:
         logger.warning(
             "model %s: speculative decoding is single-host only; "

@@ -31,6 +31,14 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_kv_cache_prefix_tokens_reused":
         "gpustack_tpu:kv_cache_prefix_tokens_reused",
     "gpustack_kv_cache_bytes": "gpustack_tpu:kv_cache_host_bytes",
+    # disaggregated KV handoff (engine/kv_transfer.py)
+    "gpustack_kv_handoff_bytes_total":
+        "gpustack_tpu:kv_handoff_bytes_total",
+    "gpustack_kv_handoff_blocks_total":
+        "gpustack_tpu:kv_handoff_blocks_total",
+    "gpustack_kv_handoff_failures_total":
+        "gpustack_tpu:kv_handoff_failures_total",
+    "gpustack_kv_handoff_seconds": "gpustack_tpu:kv_handoff_seconds",
     # engine flight recorder (observability/flight.py): per-step
     # scheduler telemetry — the fleet rollup's saturation signals
     "gpustack_engine_step_seconds": "gpustack_tpu:engine_step_seconds",
@@ -104,6 +112,10 @@ NORMALIZED_FAMILIES: Dict[str, str] = {
     "gpustack_tpu:kv_cache_prefix_tokens_reused": "counter",
     "gpustack_tpu:kv_cache_host_bytes": "gauge",
     "gpustack_tpu:kv_cache_usage_ratio": "gauge",
+    "gpustack_tpu:kv_handoff_bytes_total": "counter",
+    "gpustack_tpu:kv_handoff_blocks_total": "counter",
+    "gpustack_tpu:kv_handoff_failures_total": "counter",
+    "gpustack_tpu:kv_handoff_seconds": "histogram",
     "gpustack_tpu:audio_requests_total": "counter",
     "gpustack_tpu:audio_seconds_total": "counter",
     "gpustack_tpu:engine_step_seconds": "histogram",

@@ -139,7 +139,15 @@ class WorkerServer:
         body = await request.read()
         headers = {
             k: v for k, v in request.headers.items()
-            if k.lower() in ("content-type", "accept")
+            if k.lower() in (
+                "content-type",
+                "accept",
+                # disaggregated KV handoff: the engine needs the peer
+                # source URL + its worker-proxy credential to pull the
+                # conversation's blocks (routes/openai_proxy.py)
+                "x-gpustack-kv-source",
+                "x-gpustack-kv-source-auth",
+            )
         }
         trace = tracing.RequestTrace(
             tracing.from_headers(request.headers),

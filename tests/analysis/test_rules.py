@@ -64,6 +64,7 @@ GOOD_SCHEMAS = """\
             ModelInstanceState.ERROR,
         },
     }
+    INSTANCE_ROLE_WRITERS = ("server/controllers.py",)
 """
 
 
@@ -378,6 +379,65 @@ class TestStateMachine:
         )
         msgs = [f.message for f in self.fire(tmp_path, schemas=schemas)]
         assert any("missing declaration" in m for m in msgs)
+
+    # ---- disaggregated role writers (ISSUE 13) ----------------------
+
+    def test_role_write_in_declared_module_passes(self, tmp_path):
+        assert self.fire(
+            tmp_path,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstance, ModelInstanceState\n"
+                "async def create(role):\n"
+                "    await ModelInstance.create(ModelInstance(\n"
+                "        state=ModelInstanceState.PENDING,"
+                " role=role))\n"
+            ),
+        ) == []
+
+    def test_role_write_outside_declared_module_fails(self, tmp_path):
+        make_tree(tmp_path, {
+            "gpustack_tpu/schemas/models.py": GOOD_SCHEMAS,
+            "gpustack_tpu/routes/sneaky.py": (
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstance\n"
+                "async def go():\n"
+                "    await ModelInstance.create(ModelInstance("
+                "role='prefill'))\n"
+            ),
+        })
+        found = run(tmp_path, [StateMachineRule()]).new
+        assert any(
+            "not declared in INSTANCE_ROLE_WRITERS" in f.message
+            for f in found
+        )
+
+    def test_unknown_literal_role_tag_fails(self, tmp_path):
+        found = self.fire(
+            tmp_path,
+            writer=(
+                "from gpustack_tpu.schemas.models import"
+                " ModelInstance\n"
+                "async def go():\n"
+                "    await ModelInstance.create(ModelInstance("
+                "role='typo-role'))\n"
+            ),
+        )
+        assert any(
+            "unknown role tag 'typo-role'" in f.message for f in found
+        )
+
+    def test_missing_role_writers_declaration_fails(self, tmp_path):
+        schemas = GOOD_SCHEMAS.replace(
+            '    INSTANCE_ROLE_WRITERS = ("server/controllers.py",)\n',
+            "",
+        )
+        assert "INSTANCE_ROLE_WRITERS" not in schemas
+        msgs = [f.message for f in self.fire(tmp_path, schemas=schemas)]
+        assert any(
+            "missing declaration: INSTANCE_ROLE_WRITERS" in m
+            for m in msgs
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,94 @@
+"""bench.py trajectory helpers: the prior-round vs_baseline scan and
+the long-context summary math (pure parts — the engine-driving passes
+are exercised by the profile itself).
+"""
+
+import json
+import types
+
+import bench
+
+
+def _round_file(tmp_path, n, profile, value, smoke=True):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+        "n": n,
+        "result": {
+            "value": value,
+            "detail": {"profile": profile, "tpu_unavailable": smoke},
+        },
+    }))
+
+
+def test_prior_round_value_picks_latest_matching_round(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    _round_file(tmp_path, 1, "long-context", 100.0)
+    _round_file(tmp_path, 2, "throughput", 500.0)
+    _round_file(tmp_path, 3, "long-context", 120.0)
+    # platform-class mismatch (real hardware) must not match a smoke
+    _round_file(tmp_path, 4, "long-context", 9000.0, smoke=False)
+    got = bench.prior_round_value("long-context", smoke=True)
+    assert got == {"round": 3, "value": 120.0}
+    assert bench.prior_round_value("long-context", smoke=False) == {
+        "round": 4, "value": 9000.0,
+    }
+    assert bench.prior_round_value("latency", smoke=True) is None
+
+
+def test_prior_round_value_skips_corrupt_rounds(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    (tmp_path / "BENCH_r05.json").write_text("{not json")
+    _round_file(tmp_path, 2, "latency", 42.0)
+    assert bench.prior_round_value("latency", smoke=True) == {
+        "round": 2, "value": 42.0,
+    }
+
+
+def _rec(conv, turn, ttft, out, reused=0):
+    return {
+        "conv": conv, "turn": turn, "ttft_ms": ttft,
+        "reused": reused, "output_ids": out,
+    }
+
+
+def test_summarize_long_context_math_and_parity():
+    cold = [
+        _rec(0, 0, 100.0, [1, 2]), _rec(0, 1, 90.0, [3, 4]),
+        _rec(1, 0, 110.0, [5, 6]), _rec(1, 1, 95.0, [7, 8]),
+    ]
+    warm = [
+        _rec(0, 0, 100.0, [1, 2]), _rec(0, 1, 9.0, [3, 4], reused=32),
+        _rec(1, 0, 105.0, [5, 6]), _rec(1, 1, 11.0, [7, 8], reused=48),
+    ]
+    disagg = [
+        _rec(0, 0, 100.0, [1, 2]), _rec(0, 1, 15.0, [3, 4], reused=32),
+        _rec(1, 0, 104.0, [5, 6]), _rec(1, 1, 18.0, [7, 8], reused=48),
+    ]
+    aff = types.SimpleNamespace(hits=2, misses=2)
+    handoff = {"blocks": 4, "bytes": 1024, "seconds": 0.01}
+    out = bench.summarize_long_context(cold, warm, disagg, aff, handoff)
+    assert out["cold_ttft_ms_p50"] == 95.0
+    assert out["affinity_warm_ttft_ms_p50"] == 11.0
+    assert out["disagg_warm_ttft_ms_p50"] == 18.0
+    assert out["ttft_improvement"] == round(1 - 11.0 / 95.0, 3)
+    assert out["disagg_vs_colocated_cold"] == round(1 - 18.0 / 95.0, 3)
+    assert out["affinity"]["hit_rate"] == 0.5
+    assert out["token_parity"] is True
+    assert out["prefix_tokens_reused"] == 80
+    # a greedy divergence in ANY pass flips parity
+    disagg[1]["output_ids"] = [7, 9]
+    out2 = bench.summarize_long_context(
+        cold, warm, disagg, aff, handoff
+    )
+    assert out2["token_parity"] is False
+
+
+def test_long_context_schedule_is_pure():
+    prof = dict(prompt_len=32, followup_len=8, conversations=3)
+    a = bench.long_context_schedule(0, 100, prof)
+    b = bench.long_context_schedule(0, 100, prof)
+    assert a == b
+    assert len(a) == 3
+    assert all(len(base) == 32 and len(fu) == 8 for base, fu in a)
+    assert bench.long_context_schedule(1, 100, prof) != a

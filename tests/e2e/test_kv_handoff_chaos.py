@@ -1,0 +1,63 @@
+"""Disaggregated KV handoff under chaos (ISSUE 13 acceptance).
+
+``kv_handoff_abort``: a real proxied chat request routes through the
+server's disaggregated path — affinity miss on a role-tagged model
+puts ``X-GPUStack-KV-Source`` (the prefill replica's worker-proxy
+/kv/export URL + credential) on the dial, the decode stub pulls the
+paced export stream — and the PREFILL worker is killed mid-stream.
+The decode replica must complete the request from cold, the schedule
+must replay bit-for-bit from the seed, and the cluster must
+re-converge its role populations with zero invariant violations.
+
+Rides tier-1 (fast subset, like tests/e2e/test_chaos.py).
+"""
+
+import asyncio
+import dataclasses
+
+from gpustack_tpu.testing import chaos
+
+
+def _run(tmp_path, seed, kinds, **kw):
+    return asyncio.run(chaos.run_seeded(
+        str(tmp_path), seed, kinds=kinds, converge_timeout=45.0, **kw
+    ))
+
+
+def test_kv_handoff_abort_decode_cold_starts_and_converges(tmp_path):
+    report = _run(
+        tmp_path, 6, chaos.DISAGG_FAULT_KINDS, ops=1, workers=3,
+    )
+    # acceptance: zero invariant violations (incl. the per-role
+    # convergence and rollout-surge checks) after the prefill kill
+    assert report["violations"] == []
+    # the schedule replays bit-for-bit from the seed alone
+    regenerated = [
+        dataclasses.asdict(o)
+        for o in chaos.generate_schedule(
+            6, kinds=chaos.DISAGG_FAULT_KINDS, ops=1, workers=3,
+        )
+    ]
+    assert report["schedule"] == regenerated
+    # the op executed (a running prefill replica existed to kill) …
+    assert report["handoffs"], report["skipped_ops"]
+    h = report["handoffs"][0]
+    # … the prefill worker died while its export stream was OPEN …
+    assert h["killed_mid_stream"] is True
+    # … and the decode replica finished the request from cold: the
+    # client saw a clean 200 with content, never the dead peer
+    assert h["status"] == 200
+    assert h["content"]
+    assert "failed-cold" in h["decode_outcomes"]
+
+
+def test_kv_handoff_class_is_seed_deterministic():
+    a = chaos.generate_schedule(
+        9, kinds=chaos.DISAGG_FAULT_KINDS, ops=2
+    )
+    b = chaos.generate_schedule(
+        9, kinds=chaos.DISAGG_FAULT_KINDS, ops=2
+    )
+    assert a == b
+    assert {o.kind for o in a} == {"kv_handoff_abort"}
+    assert "kv-handoff" in chaos.FAULT_CLASSES

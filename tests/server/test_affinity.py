@@ -1,0 +1,139 @@
+"""Prefix-affinity routing unit contracts (server/resilience.py):
+conversation chain hashing, longest-prefix lookup, bounded LRU
+eviction, invalidation on drain/rollback re-tag, and breaker-open
+fallback in the candidate order.
+"""
+
+import types
+
+from gpustack_tpu.server.resilience import (
+    PrefixAffinityMap,
+    ResilienceRegistry,
+    conversation_chain,
+)
+
+
+def _msgs(*contents):
+    return [{"role": "user", "content": c} for c in contents]
+
+
+def _inst(iid):
+    return types.SimpleNamespace(id=iid, name=f"i{iid}")
+
+
+def test_conversation_chain_is_a_rolling_prefix_hash():
+    chain = conversation_chain("m", _msgs("a", "b", "c"))
+    assert len(chain) == 3 and len(set(chain)) == 3
+    # a prefix of the conversation shares the chain prefix exactly
+    assert conversation_chain("m", _msgs("a", "b")) == chain[:2]
+    # the model name is part of the key space
+    assert conversation_chain("other", _msgs("a"))[0] != chain[0]
+    # extra dict fields don't perturb the key (only role/content hash)
+    noisy = [{"role": "user", "content": "a", "name": "x"}]
+    assert conversation_chain("m", noisy)[0] == chain[0]
+
+
+def test_multi_turn_lookup_finds_the_prior_turns_replica():
+    m = PrefixAffinityMap()
+    # turn 1 routed to replica 7: record the full chain head
+    t1 = conversation_chain("m", _msgs("hello"))
+    m.record(t1[-1], 7, model_id=1)
+    # turn 2 appends the assistant reply + a new user message; its
+    # chain INCLUDES turn 1's head at index 0, so the longest-prefix
+    # walk lands the conversation back on replica 7
+    t2 = conversation_chain(
+        "m",
+        [{"role": "user", "content": "hello"},
+         {"role": "assistant", "content": "hi!"},
+         {"role": "user", "content": "more"}],
+    )
+    assert t2[0] == t1[-1]
+    assert m.lookup(t2) == 7
+    assert m.hits == 1 and m.misses == 0
+    # an unrelated conversation misses
+    assert m.lookup(conversation_chain("m", _msgs("bye"))) is None
+    assert m.misses == 1
+
+
+def test_longest_recorded_prefix_wins():
+    m = PrefixAffinityMap()
+    chain = conversation_chain("m", _msgs("a", "b", "c"))
+    m.record(chain[0], 1, model_id=1)
+    m.record(chain[1], 2, model_id=1)
+    assert m.lookup(chain) == 2   # deeper prefix beats shallower
+
+
+def test_bounded_map_evicts_lru_under_many_conversations():
+    m = PrefixAffinityMap(max_entries=16)
+    chains = [
+        conversation_chain("m", _msgs(f"conv-{i}"))[-1]
+        for i in range(40)
+    ]
+    for i, key in enumerate(chains):
+        m.record(key, 100 + i, model_id=1)
+    assert len(m) == 16
+    assert m.evictions == 24
+    # oldest entries evicted, newest survive
+    assert m.lookup([chains[0]]) is None
+    assert m.lookup([chains[-1]]) == 139
+    # touching an entry refreshes its LRU position
+    m.lookup([chains[24]])
+    for i in range(15):
+        m.record(f"fresh-{i}", 900, model_id=1)
+    assert m.lookup([chains[24]]) == 124
+
+
+def test_invalidation_on_drain_and_retag():
+    m = PrefixAffinityMap()
+    m.record("k1", 5, model_id=1)
+    m.record("k2", 5, model_id=1)
+    m.record("k3", 6, model_id=1)
+    assert m.invalidate_instance(5) == 2
+    assert m.lookup(["k1"]) is None
+    assert m.lookup(["k2"]) is None
+    assert m.lookup(["k3"]) == 6
+    assert m.invalidations == 2
+
+
+def test_registry_forget_drops_affinity_entries():
+    reg = ResilienceRegistry()
+    reg.affinity.record("k", 9, model_id=3)
+    reg.forget(9)
+    assert reg.affinity.lookup(["k"]) is None
+
+
+def test_order_promotes_preferred_within_admittable_group():
+    reg = ResilienceRegistry()
+    insts = [_inst(1), _inst(2), _inst(3)]
+    # replica 3 is busier than everyone, but holds the prefix
+    reg.begin(1, 3)
+    reg.begin(1, 3)
+    ordered = reg.order(insts, preferred=3)
+    assert ordered[0].id == 3
+    # without a preference the idle replicas come first
+    assert reg.order(insts)[0].id != 3
+
+
+def test_breaker_open_holder_falls_back_to_least_outstanding():
+    reg = ResilienceRegistry()
+    insts = [_inst(1), _inst(2)]
+    # the prefix holder's breaker is OPEN inside its window
+    reg.health(1).breaker.trip()
+    ordered = reg.order(insts, preferred=1)
+    # the holder sorts LAST (breaker group dominates the preference) —
+    # the conversation serves cold from the healthy replica instead of
+    # waiting out the probe window
+    assert ordered[0].id == 2
+    assert ordered[-1].id == 1
+
+
+def test_affinity_counters_ride_metrics_lines():
+    reg = ResilienceRegistry()
+    reg.affinity.record("k", 1, model_id=1)
+    reg.affinity.lookup(["k"])
+    reg.affinity.lookup(["nope"])
+    text = "\n".join(reg.metrics_lines())
+    assert "gpustack_proxy_affinity_hits_total 1" in text
+    assert "gpustack_proxy_affinity_misses_total 1" in text
+    assert "gpustack_proxy_affinity_entries 1" in text
+    assert "gpustack_proxy_affinity_invalidations_total 0" in text

@@ -1,0 +1,152 @@
+"""Disaggregated role plumbing: role deficit/assignment at instance
+creation, per-role replica sync convergence, role-aware KV-fit
+placement math, and the --kv-role engine argv."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import (
+    Model,
+    ModelInstance,
+    ModelInstanceState,
+)
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.controllers import (
+    ModelController,
+    create_pending_instances,
+    role_deficit,
+)
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    Record.bind(database, EventBus())
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def _model(**kw):
+    return Model(name="m", preset="tiny", replicas=2, **kw)
+
+
+def test_role_spec_and_serving_replicas():
+    colo = _model()
+    assert not colo.disaggregated
+    assert colo.serving_replicas() == 2
+    assert colo.role_spec() == {"prefill": 0, "decode": 0, "": 2}
+    dis = _model(prefill_replicas=1, decode_replicas=3)
+    assert dis.disaggregated
+    assert dis.serving_replicas() == 4
+    assert dis.role_spec() == {"prefill": 1, "decode": 3, "": 0}
+    # one role at zero = NOT disaggregated (falls back to replicas)
+    half = _model(prefill_replicas=2, decode_replicas=0)
+    assert not half.disaggregated
+    assert half.serving_replicas() == 2
+
+
+def test_role_deficit_prefill_first():
+    dis = _model(prefill_replicas=1, decode_replicas=2)
+    assert role_deficit(dis, []) == ["prefill", "decode", "decode"]
+
+    class I:  # noqa: E742 - tiny stand-in
+        def __init__(self, role):
+            self.role = role
+
+    assert role_deficit(dis, [I("prefill")]) == ["decode", "decode"]
+    assert role_deficit(dis, [I("decode"), I("prefill"), I("decode")]) \
+        == []
+    # a colocated leftover counts toward no role: the spec wants it out
+    assert role_deficit(dis, [I(""), I("prefill")]) == [
+        "decode", "decode",
+    ]
+
+
+def test_create_pending_instances_assigns_roles(db):
+    async def go():
+        model = await Model.create(_model(
+            prefill_replicas=1, decode_replicas=2,
+        ))
+        created = await create_pending_instances(
+            model, 3, model.generation, [],
+        )
+        return created
+
+    created = asyncio.run(go())
+    assert [i.role for i in created] == ["prefill", "decode", "decode"]
+    assert all(i.state == ModelInstanceState.PENDING for i in created)
+
+
+def test_sync_replicas_converges_per_role(db):
+    async def go():
+        ctl = ModelController()
+        model = await Model.create(_model(
+            prefill_replicas=1, decode_replicas=2,
+        ))
+        await ctl._sync_replicas(model)
+        insts = await ModelInstance.filter(model_id=model.id)
+        roles = sorted(i.role for i in insts)
+        assert roles == ["decode", "decode", "prefill"]
+
+        # decode surplus must never drain a prefill replica: shrink
+        # decode to 1 — exactly one decode instance retires
+        await model.update(decode_replicas=1)
+        model = await Model.get(model.id)
+        await ctl._sync_replicas(model)
+        insts = await ModelInstance.filter(model_id=model.id)
+        assert sorted(i.role for i in insts) == ["decode", "prefill"]
+
+        # flipping disaggregation OFF converges role-tagged instances
+        # out and colocated ones in
+        await model.update(prefill_replicas=0, decode_replicas=0)
+        model = await Model.get(model.id)
+        await ctl._sync_replicas(model)
+        insts = await ModelInstance.filter(model_id=model.id)
+        assert sorted(i.role for i in insts) == ["", ""]
+
+    asyncio.run(go())
+
+
+def test_prefill_role_claims_less_kv():
+    from gpustack_tpu.scheduler.calculator import (
+        PREFILL_ROLE_KV_SLOTS,
+        evaluate_model,
+    )
+
+    model = _model(max_slots=8, max_seq_len=2048)
+    decode_eval = evaluate_model(model, role="decode")
+    prefill_eval = evaluate_model(model, role="prefill")
+    colo_eval = evaluate_model(model)
+    assert decode_eval.kv_cache_bytes == colo_eval.kv_cache_bytes
+    # prefill replicas plan a bounded handoff buffer, not the batch
+    assert prefill_eval.kv_cache_bytes == (
+        colo_eval.kv_cache_bytes * PREFILL_ROLE_KV_SLOTS
+        // model.max_slots
+    )
+    assert prefill_eval.weight_bytes == colo_eval.weight_bytes
+
+
+def test_backends_pass_kv_role_argv():
+    from gpustack_tpu.worker.backends import build_command
+
+    model = _model(
+        prefill_replicas=1, decode_replicas=1, host_kv_cache_mb=64,
+    )
+    inst = ModelInstance(
+        name="m-0", model_id=1, model_name="m", role="prefill",
+    )
+    argv, _env = build_command(model, inst, 9000, None)
+    assert "--kv-role" in argv
+    assert argv[argv.index("--kv-role") + 1] == "prefill"
+    assert "--host-kv-cache-mb" in argv
+    # colocated instances carry no role flag
+    argv2, _ = build_command(
+        model,
+        ModelInstance(name="m-1", model_id=1, model_name="m"),
+        9000, None,
+    )
+    assert "--kv-role" not in argv2
