@@ -27,11 +27,13 @@ analyze:
 
 # Seeded chaos against the in-process cluster (docs/RESILIENCE.md): one
 # schedule per fault class (worker kill, heartbeat blackhole, RPC
-# delay/drop, engine crash mid-STARTING, server restart, and the
+# delay/drop, engine crash mid-STARTING, server restart, the
 # multi-server ha-failover class: leader kill/hang + lease expiry over
-# a shared DB); exits nonzero on any invariant violation or failed
-# convergence. Same seed ⇒ same schedule, so failures are replayable.
-# Narrow with CLASSES (e.g. `make chaos CLASSES=ha-failover`).
+# a shared DB, kv-handoff aborts, and the noisy-neighbor tenant flood
+# with its fairness invariant — docs/TENANCY.md); exits nonzero on any
+# invariant violation or failed convergence. Same seed ⇒ same
+# schedule, so failures are replayable.
+# Narrow with CLASSES (e.g. `make chaos CLASSES=noisy-neighbor`).
 CLASSES ?= all
 SEED ?= 1
 chaos:

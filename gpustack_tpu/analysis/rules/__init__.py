@@ -12,6 +12,7 @@ from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
 from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
 from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
 from gpustack_tpu.analysis.rules.sync_dispatch import SyncInDispatchRule
+from gpustack_tpu.analysis.rules.route_auth import RouteAuthRule
 
 ALL_RULES = (
     BlockingInAsyncRule,
@@ -20,6 +21,7 @@ ALL_RULES = (
     ConfigDocDriftRule,
     MetricsDriftRule,
     SyncInDispatchRule,
+    RouteAuthRule,
 )
 
 

@@ -1,0 +1,246 @@
+"""route-auth: every route registered under ``routes/`` resolves a
+principal, or is explicitly declared public.
+
+The auth middleware (api/middlewares.py) guarantees *authentication*
+for every non-public path, but *authorization* is per-handler: a new
+route that never looks at ``request.get("principal")`` (directly or
+through a guard like ``require_admin``/``worker_principal``/the crud
+factory's ``check_read``/``check_write``, or the tenancy admission
+helper) silently serves every authenticated caller the same data —
+the exact bug class that turns one leaked low-privilege key into a
+cluster-wide read. This rule makes that a deterministic CI failure:
+
+- every ``app.router.add_*(path, handler)`` registration in
+  ``gpustack_tpu/routes/*.py`` must either
+    * name a path in the middleware's literal ``PUBLIC_PATHS``
+      allowlist (truly unauthenticated surfaces: login, SSO
+      callbacks, worker registration), or
+    * name a path in this rule's own literal ``EXEMPT_PATHS``
+      (authenticated-but-principal-agnostic handlers, each justified
+      inline), or
+    * reach a principal resolution marker somewhere in the handler's
+      same-module call graph (transitive, fixpoint over local calls).
+
+Like blocking-in-async, the baseline for this rule must stay EMPTY
+forever — new findings are fixed or explicitly exempted with review,
+never frozen.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+MIDDLEWARES_PATH = "gpustack_tpu/api/middlewares.py"
+ROUTES_PREFIX = "gpustack_tpu/routes"
+
+ADD_METHODS = {
+    "add_get", "add_post", "add_put", "add_patch", "add_delete",
+    "add_head", "add_options", "add_route",
+}
+
+# Authenticated routes whose handlers deliberately never inspect the
+# principal beyond the middleware's authentication gate. Every entry
+# needs a justification — this list is reviewed like code, and the
+# rule's empty-baseline contract means additions can't hide.
+EXEMPT_PATHS = {
+    # clears the session cookie; acting on an absent/expired session
+    # is the desired behavior for logout
+    "/auth/logout",
+    # read-only catalog of deployable model presets — the same static
+    # JSON for every authenticated management principal, by design
+    # (deploys themselves go through the admin-gated deploy route)
+    "/v2/model-catalog",
+}
+
+# resolution markers: a call/reference to any of these names counts as
+# resolving (or guarding on) the request's principal
+GUARD_NAMES = {"require_admin", "worker_principal", "_admit_tenant"}
+
+
+class RouteAuthRule(Rule):
+    id = "route-auth"
+    description = (
+        "every route registered under routes/ resolves a principal "
+        "(or is declared public in a literal allowlist)"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        public = self._public_paths(project)
+        if public is None:
+            yield self.finding(
+                MIDDLEWARES_PATH, 1,
+                "PUBLIC_PATHS literal not found (route-auth needs the "
+                "middleware's public allowlist to judge routes)",
+            )
+            return
+        for rel in project.py_files(ROUTES_PREFIX):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            funcs = self._function_map(tree)
+            resolved = self._resolve_fixpoint(funcs)
+            for line, path, handler in self._registrations(tree):
+                if path is not None and (
+                    path in public or path in EXEMPT_PATHS
+                ):
+                    continue
+                if handler is None:
+                    continue  # non-name handler: nothing to judge
+                nodes = funcs.get(handler)
+                if nodes is None:
+                    continue  # defined elsewhere (cross-module factory)
+                if not any(resolved.get(id(n)) for n in nodes):
+                    where = path if path is not None else "<dynamic>"
+                    yield self.finding(
+                        rel, line,
+                        f"route {where!r} handler '{handler}' never "
+                        f"resolves a principal (no "
+                        f"request.get(\"principal\") / require_admin / "
+                        f"guard in its call graph) and is not in "
+                        f"PUBLIC_PATHS or the route-auth EXEMPT_PATHS "
+                        f"allowlist",
+                    )
+
+    # ---- inputs ---------------------------------------------------------
+
+    def _public_paths(self, project: Project) -> Optional[Set[str]]:
+        src = project.source(MIDDLEWARES_PATH)
+        tree = src.tree if src else None
+        if tree is None:
+            return None
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "PUBLIC_PATHS"
+                for t in node.targets
+            ):
+                continue
+            if isinstance(node.value, (ast.Set, ast.List, ast.Tuple)):
+                out = set()
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        out.add(elt.value)
+                return out
+        return None
+
+    # ---- per-module analysis --------------------------------------------
+
+    @staticmethod
+    def _function_map(tree) -> Dict[str, List[ast.AST]]:
+        """name -> every (possibly nested) function def with that name.
+        Handlers live inside ``add_*_routes`` factory closures, so
+        nested defs must be first-class here."""
+        out: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                out.setdefault(node.name, []).append(node)
+        return out
+
+    @staticmethod
+    def _direct_and_calls(fn) -> Tuple[bool, Set[str]]:
+        """(resolves directly?, names of locally-called functions)."""
+        direct = False
+        calls: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Name):
+                    calls.add(func.id)
+                    if func.id in GUARD_NAMES:
+                        direct = True
+                elif isinstance(func, ast.Attribute):
+                    # request.get("principal") / request.get("trace")…
+                    if (
+                        func.attr == "get"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value == "principal"
+                    ):
+                        direct = True
+                    if func.attr in GUARD_NAMES:
+                        direct = True
+                        calls.add(func.attr)
+            elif isinstance(node, ast.Subscript):
+                # request["principal"]
+                if isinstance(node.slice, ast.Constant) and (
+                    node.slice.value == "principal"
+                ):
+                    direct = True
+            elif isinstance(node, ast.Name) and node.id in GUARD_NAMES:
+                direct = True
+        return direct, calls
+
+    def _resolve_fixpoint(
+        self, funcs: Dict[str, List[ast.AST]]
+    ) -> Dict[int, bool]:
+        """id(fn node) -> does the function reach a principal marker
+        through same-module calls (fixpoint over the local call
+        graph)."""
+        info: Dict[int, Tuple[bool, Set[str]]] = {}
+        for nodes in funcs.values():
+            for fn in nodes:
+                info[id(fn)] = self._direct_and_calls(fn)
+        resolved = {key: direct for key, (direct, _) in info.items()}
+        changed = True
+        while changed:
+            changed = False
+            for nodes in funcs.values():
+                for fn in nodes:
+                    if resolved[id(fn)]:
+                        continue
+                    _, calls = info[id(fn)]
+                    for name in calls:
+                        if any(
+                            resolved.get(id(callee))
+                            for callee in funcs.get(name, [])
+                        ):
+                            resolved[id(fn)] = True
+                            changed = True
+                            break
+        return resolved
+
+    # ---- registrations --------------------------------------------------
+
+    @staticmethod
+    def _registrations(tree):
+        """Yield ``(line, path|None, handler_name|None)`` for every
+        ``<x>.router.add_*(path, handler)`` call (path None when not a
+        string literal — dynamic paths are judged on the handler
+        alone, with no public exemption possible)."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in ADD_METHODS
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "router"
+            ):
+                continue
+            args = node.args
+            if func.attr == "add_route":
+                args = args[1:]
+            if len(args) < 2:
+                continue
+            path_node, handler_node = args[0], args[1]
+            path = (
+                path_node.value
+                if isinstance(path_node, ast.Constant)
+                and isinstance(path_node.value, str)
+                else None
+            )
+            handler = (
+                handler_node.id
+                if isinstance(handler_node, ast.Name) else None
+            )
+            yield node.lineno, path, handler

@@ -137,6 +137,10 @@ class Principal:
     user: Optional[User] = None
     worker_id: int = 0
     scopes: Tuple[str, ...] = ("management", "inference")
+    # the resolved ApiKey record when the bearer was an API key: the
+    # tenancy layer (server/tenancy.py) reads its QoS fields per
+    # request, so quota/weight updates apply without any cache bust
+    api_key: Optional[ApiKey] = None
 
     @property
     def is_admin(self) -> bool:
@@ -167,7 +171,10 @@ async def authenticate(
         user = await User.get(key.user_id)
         if user is None:
             return None
-        return Principal(kind="user", user=user, scopes=tuple(key.scopes))
+        return Principal(
+            kind="user", user=user, scopes=tuple(key.scopes),
+            api_key=key,
+        )
     payload = jwt_decode(token, jwt_secret)
     if payload is None:
         return None
@@ -181,6 +188,59 @@ async def authenticate(
     if user is None:
         return None
     return Principal(kind="user", user=user)
+
+
+# ---------------------------------------------------------------------------
+# KV-scoped worker-proxy tokens (disaggregated handoff credentials)
+# ---------------------------------------------------------------------------
+#
+# Engine→engine KV pulls ride the source worker's reverse proxy. The
+# pull credential travels in a per-request header through another
+# worker and an engine process, so it must NOT be the worker's full
+# proxy secret (which authorizes every instance-proxy and control
+# route): mint a short-lived token scoped to ONE instance's /kv/export
+# instead. HMAC over the worker's proxy secret — the worker verifies
+# without any server round-trip, and rotating the proxy secret (every
+# re-registration) invalidates outstanding KV tokens with it.
+
+KV_TOKEN_PREFIX = "gkv1"
+
+
+def mint_kv_token(
+    proxy_secret: str, instance_id: int, ttl: float,
+    now: Optional[float] = None,
+) -> str:
+    expires = int((time.time() if now is None else now) + max(1.0, ttl))
+    payload = f"{KV_TOKEN_PREFIX}:{int(instance_id)}:{expires}"
+    sig = hmac.new(
+        proxy_secret.encode(), payload.encode(), hashlib.sha256
+    ).hexdigest()
+    return f"{payload}:{sig}"
+
+
+def verify_kv_token(
+    token: str, proxy_secret: str, instance_id: int,
+    now: Optional[float] = None,
+) -> bool:
+    """True iff ``token`` is an unexpired KV token for THIS instance,
+    signed with THIS worker's proxy secret."""
+    parts = token.split(":")
+    if len(parts) != 4 or parts[0] != KV_TOKEN_PREFIX:
+        return False
+    prefix, iid_s, expires_s, sig = parts
+    payload = f"{prefix}:{iid_s}:{expires_s}"
+    expect = hmac.new(
+        proxy_secret.encode(), payload.encode(), hashlib.sha256
+    ).hexdigest()
+    if not hmac.compare_digest(expect, sig):
+        return False
+    try:
+        iid, expires = int(iid_s), int(expires_s)
+    except ValueError:
+        return False
+    if iid != int(instance_id):
+        return False
+    return (time.time() if now is None else now) < expires
 
 
 def issue_worker_token(worker_id: int, secret: str) -> str:

@@ -70,6 +70,42 @@ class Config(pydantic.BaseModel):
     # bound on conversation-prefix → replica entries across all models
     # (LRU past it) — each entry is one hash + two ints
     affinity_max_entries: int = 4096
+    # ---- tenant QoS (server/tenancy.py; docs/TENANCY.md) ----------------
+    # weighted-fair admission engages once a model's in-flight total
+    # reaches this fraction of model_max_outstanding; <= 0 disables the
+    # fair layer (the blind per-model shed path governs alone)
+    tenant_fair_watermark: float = 0.75
+    # absolute backstop: at this multiple of model_max_outstanding
+    # in-flight, everything sheds regardless of priority (the
+    # floor-of-one fair slot is otherwise unbounded at huge tenant
+    # counts)
+    tenant_hard_ceiling: float = 2.0
+    # defaults for tenants whose key does not set its own quota
+    # (0 = unlimited): sustained requests/second, token-bucket burst
+    # capacity, tenant-wide in-flight cap, tokens per budget window
+    tenant_default_rps: float = 0.0
+    tenant_default_burst: int = 0
+    tenant_default_concurrency: int = 0
+    tenant_default_token_budget: int = 0
+    # rolling token-budget window length (per-key budget_window_s
+    # overrides)
+    tenant_budget_window_s: float = 3600.0
+    # LRU bound on per-tenant QoS state entries (idle tenants evict
+    # first; in-flight ones always survive)
+    tenant_state_max: int = 65536
+    # per-tenant label budget on /metrics: the busiest N tenants get
+    # their own series, the tail aggregates under tenant="_other"
+    tenant_metrics_max_series: int = 50
+    # tenant-scoped SLO: shed-ratio budget per tenant (their own burn
+    # alert under pseudo-model "tenant:<id>"; <= 0 disables), and the
+    # bound on concurrently tracked tenant objectives
+    slo_tenant_shed_budget: float = 0.05
+    slo_tenant_max_objectives: int = 64
+    # KV-scoped worker-proxy token lifetime (api/auth.py mint_kv_token):
+    # engine→engine KV pulls authenticate with this short-lived token
+    # instead of the worker's full proxy secret
+    kv_token_ttl: float = 60.0
+
     # disaggregated KV handoff: total seconds an engine spends pulling
     # a conversation's blocks from a peer replica (and a prefill-role
     # replica spends on prefill-for-export) before degrading to a cold

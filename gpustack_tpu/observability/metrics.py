@@ -95,6 +95,14 @@ METRIC_FAMILIES = {
     "gpustack_autoscale_frozen": "gauge",
     "gpustack_autoscale_cold_start_seconds": "gauge",
     "gpustack_autoscale_events_total": "counter",
+    # tenant QoS (server/tenancy.py): per-tenant admission outcomes
+    # (outcome=admitted|<shed reason>), live in-flight, and budget-
+    # charged tokens — labels bounded to the first N tracked tenants
+    # (sticky) plus a monotonic tenant="_other" rollup so millions of
+    # users can't blow the scrape
+    "gpustack_tenant_requests_total": "counter",
+    "gpustack_tenant_inflight": "gauge",
+    "gpustack_tenant_tokens_total": "counter",
     # control-plane HA (server/coordinator.py + orm/fencing.py):
     # whether THIS server holds the lease, the fencing epoch of the
     # current lease, leadership transitions this process observed

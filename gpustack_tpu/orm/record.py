@@ -383,6 +383,28 @@ class Record(pydantic.BaseModel):
         return await cls.filter()
 
     @classmethod
+    async def get_many(cls: Type[T], ids) -> Dict[int, T]:
+        """Batch fetch by primary key: {id: record} for the ids that
+        exist (missing ids are simply absent). One ``IN`` query per
+        chunk instead of one round-trip per id — the change-log tailer
+        re-fetches whole replication batches through this, so follower
+        propagation stays O(queries-per-kind), not O(entries)."""
+        wanted = sorted({int(i) for i in ids})
+        out: Dict[int, T] = {}
+        chunk_size = 500  # stay well under sqlite's host-param limit
+        for start in range(0, len(wanted), chunk_size):
+            chunk = wanted[start:start + chunk_size]
+            marks = ", ".join("?" * len(chunk))
+            rows = await cls.db().execute(
+                f"SELECT * FROM {cls.__kind__} WHERE id IN ({marks})",
+                chunk,
+            )
+            for row in rows:
+                obj = cls._from_row(row)
+                out[obj.id] = obj
+        return out
+
+    @classmethod
     async def filter_created_before(
         cls: Type[T], cutoff_iso: str, limit: Optional[int] = None
     ) -> List[T]:

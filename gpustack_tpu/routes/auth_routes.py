@@ -10,6 +10,7 @@ from __future__ import annotations
 import hmac
 import json
 import logging
+import math
 import uuid
 
 import aiohttp
@@ -95,6 +96,52 @@ def add_auth_routes(app: web.Application) -> None:
         return web.json_response({"ok": True})
 
     # ---- API keys -------------------------------------------------------
+    # Each key is a QoS tenant (server/tenancy.py): the QoS fields
+    # below are ADMIN-only on create and update — a tenant raising its
+    # own quota would make every limit advisory.
+
+    QOS_FIELDS = (
+        "weight", "priority", "rate_limit_rps", "rate_limit_burst",
+        "max_concurrency", "token_budget", "budget_window_s",
+    )
+
+    def _validate_qos(body: dict):
+        """Range-check the QoS fields present in ``body``; returns an
+        error response or the validated {field: value} dict."""
+        out = {}
+        for field in QOS_FIELDS:
+            if field not in body:
+                continue
+            value = body[field]
+            try:
+                value = (
+                    float(value)
+                    if field in (
+                        "rate_limit_rps", "budget_window_s"
+                    ) else int(value)
+                )
+            except (TypeError, ValueError):
+                return json_error(400, f"{field} must be numeric"), None
+            if isinstance(value, float) and not math.isfinite(value):
+                # json.loads happily parses NaN/Infinity literals;
+                # NaN would silently no-op the limit (comparisons all
+                # False) and Infinity overflows the header rendering
+                return json_error(400, f"{field} must be finite"), None
+            if field == "weight" and not 1 <= value <= 10**6:
+                return json_error(
+                    400, "weight must be in [1, 1e6]"
+                ), None
+            if field != "priority" and value < 0:
+                return json_error(
+                    400, f"{field} must be >= 0"
+                ), None
+            out[field] = value
+        return None, out
+
+    def _dump_key(key: ApiKey) -> dict:
+        data = key.model_dump(mode="json")
+        data.pop("hashed_secret", None)
+        return data
 
     async def create_api_key(request: web.Request):
         principal = request.get("principal")
@@ -104,6 +151,13 @@ def add_auth_routes(app: web.Application) -> None:
             body = await request.json()
         except json.JSONDecodeError:
             return json_error(400, "invalid JSON body")
+        err, qos = _validate_qos(body)
+        if err is not None:
+            return err
+        if qos and not principal.is_admin:
+            return json_error(
+                403, "QoS fields (quota/weight/priority) are admin-only"
+            )
         full, access, hashed = auth_mod.generate_api_key()
         key = await ApiKey.create(
             ApiKey(
@@ -113,13 +167,90 @@ def add_auth_routes(app: web.Application) -> None:
                 hashed_secret=hashed,
                 scopes=body.get("scopes") or ["management", "inference"],
                 expires_at=body.get("expires_at") or "",
+                **qos,
             )
         )
-        data = key.model_dump(mode="json")
-        data.pop("hashed_secret", None)
+        data = _dump_key(key)
         # the full secret is returned exactly once
         data["value"] = full
         return web.json_response(data, status=201)
+
+    async def list_api_keys(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.user is None:
+            return json_error(401, "not authenticated")
+        if principal.is_admin:
+            user_id = request.query.get("user_id")
+            try:
+                filters = (
+                    {"user_id": int(user_id)} if user_id else {}
+                )
+            except ValueError:
+                return json_error(400, "user_id must be an integer")
+            keys = await ApiKey.filter(limit=None, **filters)
+        else:
+            # non-admins see exactly their own keys — a key id must
+            # not be an oracle across tenants
+            keys = await ApiKey.filter(
+                limit=None, user_id=principal.user.id
+            )
+        return web.json_response(
+            {"items": [_dump_key(k) for k in keys]}
+        )
+
+    async def _owned_key(request: web.Request):
+        principal = request.get("principal")
+        if principal is None or principal.user is None:
+            return None, json_error(401, "not authenticated")
+        key = await ApiKey.get(int(request.match_info["id"]))
+        if key is None or not (
+            principal.is_admin or key.user_id == principal.user.id
+        ):
+            # same 404 as nonexistence: no id oracle across tenants
+            return None, json_error(404, "api key not found")
+        return key, None
+
+    async def update_api_key(request: web.Request):
+        key, err = await _owned_key(request)
+        if err is not None:
+            return err
+        principal = request.get("principal")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return json_error(400, "invalid JSON body")
+        err, qos = _validate_qos(body)
+        if err is not None:
+            return err
+        if qos and not principal.is_admin:
+            return json_error(
+                403, "QoS fields (quota/weight/priority) are admin-only"
+            )
+        fields = dict(qos)
+        for field in ("name", "expires_at"):
+            if field in body:
+                fields[field] = str(body[field] or "")
+        if "scopes" in body:
+            scopes = body["scopes"]
+            if not isinstance(scopes, list) or not all(
+                s in ("management", "inference") for s in scopes
+            ):
+                return json_error(
+                    400,
+                    "scopes must be a list drawn from "
+                    "management/inference",
+                )
+            fields["scopes"] = scopes
+        if fields:
+            await key.update(**fields)
+        return web.json_response(_dump_key(key))
+
+    async def delete_api_key(request: web.Request):
+        key, err = await _owned_key(request)
+        if err is not None:
+            return err
+        await key.delete()
+        return web.json_response({"deleted": key.id})
 
     # ---- worker registration -------------------------------------------
 
@@ -482,6 +613,9 @@ def add_auth_routes(app: web.Application) -> None:
     app.router.add_get("/auth/cas/login", cas_login)
     app.router.add_get("/auth/cas/callback", cas_callback)
     app.router.add_post("/v2/api-keys", create_api_key)
+    app.router.add_get("/v2/api-keys", list_api_keys)
+    app.router.add_patch("/v2/api-keys/{id:\\d+}", update_api_key)
+    app.router.add_delete("/v2/api-keys/{id:\\d+}", delete_api_key)
     app.router.add_post("/v2/workers/register", register_worker)
 
 

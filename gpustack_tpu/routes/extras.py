@@ -64,7 +64,13 @@ def add_extra_routes(app: web.Application) -> None:
 
     async def evaluate(request: web.Request):
         """Deploy-time compatibility check: would this model spec fit the
-        current fleet? (reference evaluator: evaluate_models)."""
+        current fleet? (reference evaluator: evaluate_models).
+        Admin-only: the verdict enumerates worker topology and free
+        capacity, and only admins can act on it (deploys are gated)."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
         try:
             body = await request.json()
         except json.JSONDecodeError:
@@ -308,7 +314,13 @@ def add_extra_routes(app: web.Application) -> None:
         })
 
     async def dashboard(request: web.Request):
-        """Cluster overview (reference routes/dashboard.py)."""
+        """Cluster overview (reference routes/dashboard.py).
+        Admin-only: fleet size, chip accounting and instance states
+        are cluster-wide facts, not any one tenant's."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
         workers = await Worker.all()
         instances = await ModelInstance.all()
         models = await Model.all()
@@ -1003,6 +1015,31 @@ def add_extra_routes(app: web.Application) -> None:
         })
 
     app.router.add_get("/v2/debug/incidents", debug_incidents)
+
+    async def debug_tenancy(request: web.Request):
+        """Tenant QoS state (server/tenancy.py): per-tenant in-flight,
+        admission/shed counters by reason, token-budget position and
+        effective limits — hot tenants first, bounded. The triage
+        surface for "who is the noisy neighbor". Admin-only."""
+        from gpustack_tpu.routes.crud import require_admin
+
+        if err := require_admin(request):
+            return err
+        tenancy = request.app.get("tenancy")
+        if tenancy is None:
+            return json_error(503, "tenancy registry not mounted")
+        try:
+            limit = min(1000, int(request.query.get("limit", 100)))
+        except ValueError:
+            return json_error(400, "limit must be an integer")
+        return web.json_response({
+            "items": tenancy.snapshot(limit=limit),
+            "evictions": tenancy.evictions,
+            "model_cap": tenancy.model_cap,
+            "fair_watermark": tenancy.fair_watermark,
+        })
+
+    app.router.add_get("/v2/debug/tenancy", debug_tenancy)
 
     # fleet rollup: which normalized series aggregate how. SUM gauges
     # add across a model's replicas; MAX gauges answer "worst replica";

@@ -156,6 +156,60 @@ def _shed_response(model_name: str, retry_after: float) -> web.Response:
     )
 
 
+def _qos_shed_response(model_name: str, decision) -> web.Response:
+    """Tenant-level 429 (server/tenancy.py): the reason is machine-
+    readable and the tenant's ``X-RateLimit-*``/``Retry-After`` headers
+    ride along — this is THEIR 429, never the fleet's."""
+    return web.json_response(
+        {
+            "error": (
+                f"request to model {model_name!r} rejected for this "
+                f"tenant: {decision.reason}"
+            ),
+            "reason": decision.reason,
+            "tenant": decision.tenant,
+        },
+        status=429,
+        headers=decision.headers,
+    )
+
+
+def _admit_tenant(request: web.Request, model_name: str, target):
+    """Tenant QoS admission for one inference request. Returns
+    ``(lease, headers, owns_model_cap, shed_response)``: on admission
+    the caller MUST release ``lease`` when the request fully completes
+    (stream included) or the fair-share accounting leaks;
+    ``owns_model_cap`` means the weighted-fair layer governed this
+    model's slots, so the blind per-model shed must not double-judge."""
+    tenancy = request.app.get("tenancy")
+    if tenancy is None:
+        return None, {}, False, None
+    spec = tenancy.spec_for_principal(request.get("principal"))
+    # the fair-share pool keys on the RESOLVED serving identity, not
+    # the route name: several routes aliasing one model must share one
+    # admission pool, or each alias would admit a full cap of its own
+    if isinstance(target, ProviderTarget):
+        pool = (
+            f"provider:{target.provider.id}:{target.upstream_model}"
+        )
+    else:
+        pool = f"model:{target[0].id}"
+    decision, lease = tenancy.admit(spec, pool)
+    # usage recording charges the rolling token budget by tenant id
+    request["tenant"] = decision.tenant
+    if not decision.admitted:
+        trace = request.get("trace")
+        if trace is not None:
+            trace.event(
+                "tenant_shed",
+                tenant=decision.tenant, reason=decision.reason,
+            )
+        return None, decision.headers, False, _qos_shed_response(
+            model_name, decision
+        )
+    return lease, decision.headers, decision.owns_model_cap, None
+
+
 async def _instance_fetch(
     app: web.Application,
     model: Model,
@@ -169,6 +223,7 @@ async def _instance_fetch(
     preferred: int = 0,
     affinity_key: str = "",
     extra_headers=None,
+    skip_shed: bool = False,
 ):
     """Dial one of the model's RUNNING replicas with failover.
 
@@ -190,7 +245,10 @@ async def _instance_fetch(
     from gpustack_tpu.server.worker_request import worker_fetch
 
     reg = app["resilience"]
-    retry_after = reg.try_shed(model.id)
+    # when the tenancy layer's weighted-fair admission governed this
+    # model (skip_shed), the blind per-model cap must not double-judge:
+    # it would shed the polite tenant on the total the flooder filled
+    retry_after = None if skip_shed else reg.try_shed(model.id)
     if retry_after is not None:
         if trace is not None:
             trace.event("shed", retry_after=retry_after)
@@ -452,8 +510,15 @@ async def _affinity_routing(
         "X-GPUStack-KV-Source-Instance": str(src.id),
     }
     if worker.proxy_secret:
-        headers["X-GPUStack-KV-Source-Auth"] = (
-            f"Bearer {worker.proxy_secret}"
+        # short-lived token scoped to THIS instance's /kv/export — the
+        # credential rides a per-request header through another worker
+        # and an engine process, so the full proxy secret (which
+        # authorizes every route on the worker) must never travel
+        from gpustack_tpu.api.auth import mint_kv_token
+
+        ttl = float(getattr(app["config"], "kv_token_ttl", 60.0))
+        headers["X-GPUStack-KV-Source-Auth"] = "Bearer " + mint_kv_token(
+            worker.proxy_secret, src.id, ttl
         )
     return serving, 0, affinity_key, headers
 
@@ -483,6 +548,17 @@ async def _record_usage(
 
     principal = request.get("principal")
     user_id = principal.user.id if principal and principal.user else 0
+    # getattr: unit tests drive this recorder with a bare mapping in
+    # place of a web.Request (no .app) — metering must not care
+    app = getattr(request, "app", None)
+    tenancy = app.get("tenancy") if app is not None else None
+    if tenancy is not None:
+        # the rolling token budget rides the SAME usage counters the
+        # /v2/usage surface reports — enforcement and metering agree
+        tenancy.record_tokens(
+            request.get("tenant") or "",
+            prompt_tokens + completion_tokens,
+        )
     registry = get_registry("server")
     # scrape-visible metering next to the DB row: per-model token
     # throughput on /metrics instead of DB-only (route_name is
@@ -706,7 +782,7 @@ def add_openai_routes(app: web.Application) -> None:
         if trace is not None:
             # "schedule": route resolution + replica-set lookup — the
             # queue-wait analogue of this gateway (admission happens in
-            # _instance_fetch's shed check)
+            # the tenancy layer + _instance_fetch's shed check)
             trace.begin("schedule")
         target, err = await _resolve_target(request, str(name))
         if trace is not None:
@@ -718,6 +794,35 @@ def add_openai_routes(app: web.Application) -> None:
             # operator-defined (bounded); labeling the raw client
             # string would let junk names grow metric series forever
             trace.model = str(name)
+        # tenant QoS admission AFTER resolution (an unknown model stays
+        # a 404, and per-model fair-share state keys on operator-
+        # defined names, never raw client strings) and BEFORE any dial
+        lease, qos_headers, owns_cap, shed = _admit_tenant(
+            request, str(name), target
+        )
+        if shed is not None:
+            return shed
+        try:
+            # the lease covers the WHOLE relay (stream included): the
+            # fair-share slot frees only when the last byte lands
+            return await _relay_openai(
+                request, operation, body, str(name), target, trace,
+                qos_headers, owns_cap,
+            )
+        finally:
+            if lease is not None:
+                lease.release()
+
+    async def _relay_openai(
+        request: web.Request,
+        operation: str,
+        body: dict,
+        name: str,
+        target,
+        trace,
+        qos_headers: dict,
+        owns_cap: bool,
+    ):
         stream = bool(body.get("stream"))
         suppress_usage_chunk = False
         if isinstance(target, ProviderTarget):
@@ -774,6 +879,7 @@ def add_openai_routes(app: web.Application) -> None:
                 preferred=preferred,
                 affinity_key=affinity_key,
                 extra_headers=kv_headers,
+                skip_shed=owns_cap,
             )
             if err is not None:
                 return err
@@ -811,6 +917,7 @@ def add_openai_routes(app: web.Application) -> None:
                 body=payload_bytes,
                 status=upstream.status,
                 content_type=upstream.content_type,
+                headers=qos_headers or None,
             )
 
         # SSE relay: forward chunks unbuffered; sniff usage from data lines.
@@ -820,6 +927,9 @@ def add_openai_routes(app: web.Application) -> None:
             ),
             "Cache-Control": "no-cache",
         }
+        # the tenant's X-RateLimit-* view rides every response the
+        # limits apply to, not just the 429s
+        sse_headers.update(qos_headers)
         if trace is not None:
             # streamed responses prepare() before the middleware can
             # stamp these — set them on the response headers now
@@ -892,8 +1002,6 @@ def add_openai_routes(app: web.Application) -> None:
         multipart relay to an audio-model instance (reference openai
         endpoint registry covers audio, gateway/utils.py; served by the
         VoxBox-role audio engine)."""
-        import uuid as _uuid
-
         if not request.content_type.startswith("multipart/"):
             return json_error(400, "multipart/form-data required")
         wav = b""
@@ -920,6 +1028,32 @@ def add_openai_routes(app: web.Application) -> None:
             return err
         if trace is not None:
             trace.model = name       # resolved: bounded cardinality
+        lease, qos_headers, owns_cap, shed = _admit_tenant(
+            request, name, target
+        )
+        if shed is not None:
+            return shed
+        try:
+            return await _relay_audio(
+                request, name, wav, fields, target, trace,
+                qos_headers, owns_cap,
+            )
+        finally:
+            if lease is not None:
+                lease.release()
+
+    async def _relay_audio(
+        request: web.Request,
+        name: str,
+        wav: bytes,
+        fields: dict,
+        target,
+        trace,
+        qos_headers: dict,
+        owns_cap: bool,
+    ):
+        import uuid as _uuid
+
         if isinstance(target, ProviderTarget):
             model_id, provider_id = 0, target.provider.id
             # the upstream needs the provider's model name as a form field
@@ -967,6 +1101,7 @@ def add_openai_routes(app: web.Application) -> None:
                 raw_body=raw,
                 content_type=ctype,
                 trace=trace,
+                skip_shed=owns_cap,
             )
             if err is not None:
                 return err
@@ -987,6 +1122,7 @@ def add_openai_routes(app: web.Application) -> None:
             body=payload,
             status=upstream.status,
             content_type=upstream.content_type,
+            headers=qos_headers or None,
         )
 
     async def speech_proxy(request: web.Request):
@@ -1010,6 +1146,29 @@ def add_openai_routes(app: web.Application) -> None:
             return err
         if trace is not None:
             trace.model = name       # resolved: bounded cardinality
+        lease, qos_headers, owns_cap, shed = _admit_tenant(
+            request, name, target
+        )
+        if shed is not None:
+            return shed
+        try:
+            return await _relay_speech(
+                request, name, body, target, trace,
+                qos_headers, owns_cap,
+            )
+        finally:
+            if lease is not None:
+                lease.release()
+
+    async def _relay_speech(
+        request: web.Request,
+        name: str,
+        body: dict,
+        target,
+        trace,
+        qos_headers: dict,
+        owns_cap: bool,
+    ):
         if isinstance(target, ProviderTarget):
             body["model"] = target.upstream_model
             model_id, provider_id = 0, target.provider.id
@@ -1029,6 +1188,7 @@ def add_openai_routes(app: web.Application) -> None:
                 ),
                 json_body=body,
                 trace=trace,
+                skip_shed=owns_cap,
             )
             if err is not None:
                 return err
@@ -1047,6 +1207,7 @@ def add_openai_routes(app: web.Application) -> None:
             body=payload,
             status=upstream.status,
             content_type=upstream.content_type,
+            headers=qos_headers or None,
         )
 
     app.router.add_get("/v1/models", list_models)

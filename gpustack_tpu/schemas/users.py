@@ -31,6 +31,11 @@ class User(Record):
 
 @register_record
 class ApiKey(Record):
+    """Split-credential API key + the tenant's enforceable service
+    class (server/tenancy.py): each key IS a QoS tenant on the OpenAI
+    surface. QoS fields are admin-managed via /v2/api-keys — a tenant
+    must not be able to raise its own quota."""
+
     __kind__ = "api_key"
     __indexes__ = ("user_id", "access_key")
 
@@ -40,3 +45,12 @@ class ApiKey(Record):
     hashed_secret: str = ""
     expires_at: str = ""              # "" = never
     scopes: List[str] = ["management", "inference"]
+
+    # ---- QoS service class (0 = unlimited / inherit config default) ----
+    weight: int = 1                   # fair share of a saturated model
+    priority: int = 0                 # higher sheds later under pressure
+    rate_limit_rps: float = 0.0       # sustained requests/second
+    rate_limit_burst: int = 0         # token-bucket capacity (0 = ~1s)
+    max_concurrency: int = 0          # tenant-wide in-flight cap
+    token_budget: int = 0             # prompt+completion tokens / window
+    budget_window_s: float = 0.0      # 0 = Config.tenant_budget_window_s

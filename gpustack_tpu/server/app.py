@@ -722,6 +722,13 @@ def create_app(cfg: Config) -> web.Application:
 
     app["resilience"] = ResilienceRegistry.from_config(cfg)
 
+    # tenant QoS: per-key quotas, token budgets, weighted-fair
+    # admission + priority shedding for the OpenAI surface
+    # (server/tenancy.py; docs/TENANCY.md)
+    from gpustack_tpu.server.tenancy import TenancyRegistry
+
+    app["tenancy"] = TenancyRegistry.from_config(cfg)
+
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):
         import asyncio as _asyncio

@@ -113,6 +113,11 @@ def add_metrics_route(app: web.Application) -> None:
         registry = request.app.get("resilience")
         if registry is not None:
             text += "\n".join(registry.metrics_lines()) + "\n"
+        # tenant QoS admission/shed/token series (server/tenancy.py) —
+        # per-tenant labels, bounded to the busiest N + "_other"
+        tenancy = request.app.get("tenancy")
+        if tenancy is not None:
+            text += "\n".join(tenancy.metrics_lines()) + "\n"
         # observability histograms (per-phase request latency, instance
         # time-in-state) + slow-call stats (utils/profiling.CallStats,
         # recorded by @timed call sites) — in-memory, appended uncached

@@ -52,6 +52,11 @@ logger = logging.getLogger(__name__)
 # one status/metric surface covers both granularities
 CLUSTER_MODEL = "_cluster"
 
+# tenant-scoped objectives (tenancy admission layer) live under
+# pseudo-models "tenant:<id>" — a noisy neighbor's burn alert is keyed
+# to the tenant, not to any model and not to _cluster
+TENANT_MODEL_PREFIX = "tenant:"
+
 # the "p95" in slo_ttft_p95_ms / slo_queue_wait_p95_ms: 95% of
 # requests (or ticks) must be at-or-under the threshold
 LATENCY_GOOD_RATIO = 0.95
@@ -144,6 +149,7 @@ class SLOEvaluator(PeriodicTask):
         else:
             self._last_engine_metrics = {}
         await self._feed_invariants(models, instances, now)
+        self._feed_tenants(now)
 
         self.engine.retain(sorted(self._active), now)
         transitions = self.engine.evaluate(now)
@@ -355,6 +361,35 @@ class SLOEvaluator(PeriodicTask):
             CLUSTER_MODEL, "invariants",
             0.0 if violations else 1.0, 1.0, now,
         )
+
+    def _feed_tenants(self, now: float) -> None:
+        """Tenant-scoped shed objectives under pseudo-models
+        ``tenant:<id>`` (server/tenancy.py): a tenant's admitted/shed
+        cumulative counts become an error-budget objective, so a noisy
+        neighbor burning through its quota fires THEIR burn alert —
+        never ``_cluster``'s and never the model's. Bounded to the
+        most recently active tenants (label cardinality is an operator
+        budget, like model names)."""
+        budget = self.cfg.slo_tenant_shed_budget
+        tenancy = self.app.get("tenancy")
+        if budget <= 0 or tenancy is None:
+            return
+        target = min(0.999999, max(1e-6, 1.0 - budget))
+        for tenant, admitted, shed in tenancy.slo_samples(
+            limit=self.cfg.slo_tenant_max_objectives
+        ):
+            model = f"{TENANT_MODEL_PREFIX}{tenant}"
+            self._enable(
+                model,
+                ObjectiveSpec(
+                    "tenant_shed", target, threshold=budget,
+                    description="tenant requests admitted vs shed "
+                                "(tenancy admission layer)",
+                ),
+            )
+            self.engine.record_cumulative(
+                model, "tenant_shed", admitted, admitted + shed, now,
+            )
 
     # ---- evidence capture (sync; called inside engine.evaluate) ---------
 

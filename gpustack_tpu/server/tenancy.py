@@ -1,0 +1,734 @@
+"""Tenant QoS: per-key quotas, token budgets, weighted-fair admission.
+
+The reference meters every consumer at its gateway (Higress token-usage
+plugin + ``ModelUsageMiddleware``, SURVEY §5); PRs 8–9 built our
+metering half. This module is the *enforcement* half: with millions of
+users behind one OpenAI surface, a single flooding tenant must get
+**their own** 429s (and their own burn alert) — never the fleet's.
+
+A **tenant** is one credential: an API key (``key:<id>``), a session
+user (``user:<id>``), or a worker/system principal. API keys carry the
+enforceable service class (``schemas/users.py`` ApiKey: weight,
+priority, rate/concurrency quotas, rolling token budget); everything
+else inherits the config defaults.
+
+One :class:`TenancyRegistry` per server app makes one
+:meth:`~TenancyRegistry.admit` decision per inference request, in
+order:
+
+1. **concurrency** — the tenant's own in-flight cap;
+2. **request rate** — a clock-injected token bucket (``burst`` instant,
+   ``rps`` sustained);
+3. **token budget** — a rolling window fed by the PR 8 usage counters
+   (prompt+completion tokens recorded per response); exhaustion is a
+   429 with a machine-readable reason and a window-end ``Retry-After``;
+4. **weighted-fair admission** — layered onto the per-model
+   outstanding/shed path (``server/resilience.py``): once a model's
+   in-flight total crosses the fair watermark, each tenant may hold at
+   most its weight-proportional share of the model's admission slots
+   (computed among active tenants of the same-or-higher priority, so
+   the lowest priority sheds first); at the hard ceiling everything
+   sheds. A tenant's admitted share of a saturated model therefore
+   converges to its weight — the invariant the noisy-neighbor chaos
+   class asserts.
+
+Every path is pure and clock-injected (``clock=time.monotonic`` +
+explicit ``now`` arguments) so the fairness math unit-tests without a
+proxy. Per-tenant state is LRU-bounded (``tenant_state_max``) — tens
+of thousands of synthetic tenants must not grow memory without bound
+(the slow-suite scale test drives exactly that).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+# shed reasons (machine-readable: the 429 body carries them verbatim)
+REASON_RATE = "rate_limit_exceeded"
+REASON_CONCURRENCY = "concurrency_limit_exceeded"
+REASON_BUDGET = "token_budget_exhausted"
+REASON_FAIR = "fair_share_exceeded"
+REASON_SATURATED = "model_saturated"
+
+SHED_REASONS = (
+    REASON_RATE, REASON_CONCURRENCY, REASON_BUDGET,
+    REASON_FAIR, REASON_SATURATED,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's enforceable service class (from its ApiKey record,
+    or the config defaults for session/worker principals)."""
+
+    tenant: str = ""              # stable id: key:<id> | user:<id> | …
+    display: str = ""             # operator-facing name (key name)
+    weight: int = 1               # fair-share weight (>= 1)
+    priority: int = 0             # higher sheds later
+    rate_rps: float = 0.0         # sustained requests/second; 0 = off
+    burst: int = 0                # bucket capacity; 0 = derived
+    max_concurrency: int = 0      # tenant-wide in-flight cap; 0 = off
+    token_budget: int = 0         # tokens per window; 0 = off
+    budget_window_s: float = 0.0  # 0 = registry default
+
+    def bucket_capacity(self) -> float:
+        if self.rate_rps <= 0:
+            return 0.0
+        if self.burst > 0:
+            return float(self.burst)
+        # default burst: one second of sustained rate, floor 1 — a
+        # 0.5 rps tenant must still be able to send one request
+        return max(1.0, self.rate_rps)
+
+
+@dataclasses.dataclass
+class Decision:
+    """Outcome of one admission check. ``headers`` always carries the
+    applicable ``X-RateLimit-*`` set (and ``Retry-After`` on a shed);
+    ``owns_model_cap`` tells the proxy the weighted-fair layer governed
+    this model, so the blind per-model shed must not double-judge."""
+
+    admitted: bool
+    tenant: str
+    reason: str = ""
+    retry_after: float = 0.0
+    headers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    owns_model_cap: bool = False
+
+
+class TokenBucket:
+    """Request-rate limiter: ``capacity`` instant burst, ``rate``/s
+    sustained refill. Pure against an injected ``now``."""
+
+    __slots__ = ("rate", "capacity", "tokens", "stamped")
+
+    def __init__(self, rate: float, capacity: float, now: float):
+        self.rate = rate
+        self.capacity = capacity
+        self.tokens = capacity
+        self.stamped = now
+
+    def reconfigure(self, rate: float, capacity: float) -> None:
+        if rate == self.rate and capacity == self.capacity:
+            return
+        if capacity > self.capacity:
+            # a RAISED quota takes effect now: grant the new burst
+            # headroom instead of making the tenant refill a bucket
+            # sized for the old limit (operator raises a throttled
+            # tenant's rps → their very next request must admit)
+            self.tokens += capacity - self.capacity
+        self.rate = rate
+        self.capacity = capacity
+        self.tokens = min(self.tokens, capacity)
+
+    def _refill(self, now: float) -> None:
+        dt = max(0.0, now - self.stamped)
+        self.stamped = now
+        self.tokens = min(self.capacity, self.tokens + dt * self.rate)
+
+    def take(self, now: float) -> bool:
+        self._refill(now)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def remaining(self, now: float) -> int:
+        self._refill(now)
+        return int(self.tokens)
+
+    def seconds_until_token(self, now: float) -> float:
+        self._refill(now)
+        if self.tokens >= 1.0:
+            return 0.0
+        if self.rate <= 0:
+            return math.inf
+        return (1.0 - self.tokens) / self.rate
+
+
+class RollingBudget:
+    """Token budget over a rolling window: the window opens at the
+    first spend and rolls over (spend resets) at the boundary — the
+    reference's per-consumer quota cycle, clock-injected."""
+
+    __slots__ = ("window", "window_start", "spent")
+
+    def __init__(self, window: float):
+        self.window = max(1.0, window)
+        self.window_start = 0.0
+        self.spent = 0
+
+    def _roll(self, now: float) -> None:
+        if self.window_start == 0.0:
+            self.window_start = now
+            return
+        if now - self.window_start >= self.window:
+            # skip whole elapsed windows so an idle tenant's next
+            # window starts aligned with its own traffic, not 1970
+            elapsed = now - self.window_start
+            self.window_start += math.floor(
+                elapsed / self.window
+            ) * self.window
+            self.spent = 0
+
+    def record(self, tokens: int, now: float) -> None:
+        self._roll(now)
+        self.spent += max(0, int(tokens))
+
+    def remaining(self, limit: int, now: float) -> int:
+        self._roll(now)
+        return max(0, limit - self.spent)
+
+    def seconds_until_reset(self, now: float) -> float:
+        self._roll(now)
+        if self.window_start == 0.0:
+            return 0.0
+        return max(0.0, self.window_start + self.window - now)
+
+
+class _TenantState:
+    __slots__ = (
+        "spec", "bucket", "budget", "inflight", "per_model",
+        "admitted_total", "shed_total", "shed_by_reason",
+        "tokens_total", "last_seen", "named",
+    )
+
+    def __init__(self, spec: TenantSpec, now: float):
+        self.spec = spec
+        self.bucket: Optional[TokenBucket] = None
+        self.budget: Optional[RollingBudget] = None
+        self.inflight = 0
+        self.per_model: Dict[str, int] = {}
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.shed_by_reason: Dict[str, int] = {}
+        self.tokens_total = 0
+        self.last_seen = now
+        # exported as its own /metrics series (sticky: assigned at
+        # creation while named slots are free, never re-ranked — a
+        # tenant moving between the named set and the "_other" rollup
+        # would make the rollup counter non-monotonic)
+        self.named = False
+
+
+class _Lease:
+    """Handle for one admitted request: release exactly once (the
+    proxy's finally block), idempotent against double release."""
+
+    __slots__ = ("_registry", "tenant", "model", "_done")
+
+    def __init__(self, registry: "TenancyRegistry", tenant: str,
+                 model: str):
+        self._registry = registry
+        self.tenant = tenant
+        self.model = model
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._registry._end(self.tenant, self.model)
+
+
+class TenancyRegistry:
+    """In-memory QoS state + admission policy for the OpenAI surface."""
+
+    def __init__(
+        self,
+        *,
+        model_cap: int = 256,
+        fair_watermark: float = 0.75,
+        hard_ceiling: float = 2.0,
+        default_rps: float = 0.0,
+        default_burst: int = 0,
+        default_concurrency: int = 0,
+        default_token_budget: int = 0,
+        budget_window_s: float = 3600.0,
+        state_max: int = 65536,
+        metrics_max_series: int = 50,
+        clock=time.monotonic,
+    ):
+        self.model_cap = int(model_cap)
+        self.fair_watermark = float(fair_watermark)
+        self.hard_ceiling = max(1.0, float(hard_ceiling))
+        self.default_rps = float(default_rps)
+        self.default_burst = int(default_burst)
+        self.default_concurrency = int(default_concurrency)
+        self.default_token_budget = int(default_token_budget)
+        self.budget_window_s = max(1.0, float(budget_window_s))
+        self.state_max = max(16, int(state_max))
+        self.metrics_max_series = max(1, int(metrics_max_series))
+        self._clock = clock
+        # tenant id -> state; OrderedDict = LRU order for the bound
+        self._tenants: "collections.OrderedDict[str, _TenantState]" = (
+            collections.OrderedDict()
+        )
+        # model name -> {tenant id -> in-flight} (live entries only)
+        self._model_inflight: Dict[str, Dict[str, int]] = {}
+        self.evictions = 0
+        # /metrics export state: the first metrics_max_series tenants
+        # get their own labeled series (sticky); everyone else rolls
+        # into cumulative "_other" aggregates maintained INCREMENTALLY
+        # so scrapes are O(named) and the rollup counters stay
+        # monotonic through LRU eviction
+        self._named_states: Dict[str, _TenantState] = {}
+        self._tail_admitted = 0
+        self._tail_shed: Dict[str, int] = {}
+        self._tail_tokens = 0
+        self._tail_inflight = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "TenancyRegistry":
+        return cls(
+            model_cap=int(getattr(cfg, "model_max_outstanding", 256)),
+            fair_watermark=float(
+                getattr(cfg, "tenant_fair_watermark", 0.75)
+            ),
+            hard_ceiling=float(
+                getattr(cfg, "tenant_hard_ceiling", 2.0)
+            ),
+            default_rps=float(
+                getattr(cfg, "tenant_default_rps", 0.0)
+            ),
+            default_burst=int(
+                getattr(cfg, "tenant_default_burst", 0)
+            ),
+            default_concurrency=int(
+                getattr(cfg, "tenant_default_concurrency", 0)
+            ),
+            default_token_budget=int(
+                getattr(cfg, "tenant_default_token_budget", 0)
+            ),
+            budget_window_s=float(
+                getattr(cfg, "tenant_budget_window_s", 3600.0)
+            ),
+            state_max=int(getattr(cfg, "tenant_state_max", 65536)),
+            metrics_max_series=int(
+                getattr(cfg, "tenant_metrics_max_series", 50)
+            ),
+        )
+
+    # ---- tenant identity -------------------------------------------------
+
+    @staticmethod
+    def spec_for_principal(principal) -> TenantSpec:
+        """Principal → service class. API keys carry their own QoS
+        fields; session users / workers / system run under the
+        defaults (enforced only when the registry's defaults say so)."""
+        key = getattr(principal, "api_key", None)
+        if key is not None:
+            return TenantSpec(
+                tenant=f"key:{key.id}",
+                display=key.name or f"key:{key.id}",
+                weight=max(1, int(getattr(key, "weight", 1))),
+                priority=int(getattr(key, "priority", 0)),
+                rate_rps=float(getattr(key, "rate_limit_rps", 0.0)),
+                burst=int(getattr(key, "rate_limit_burst", 0)),
+                max_concurrency=int(
+                    getattr(key, "max_concurrency", 0)
+                ),
+                token_budget=int(getattr(key, "token_budget", 0)),
+                budget_window_s=float(
+                    getattr(key, "budget_window_s", 0.0)
+                ),
+            )
+        kind = getattr(principal, "kind", "user")
+        if kind == "user" and getattr(principal, "user", None):
+            tid = f"user:{principal.user.id}"
+            name = principal.user.username or tid
+        elif kind == "worker":
+            tid = f"worker:{getattr(principal, 'worker_id', 0)}"
+            name = tid
+        else:
+            tid, name = "system", "system"
+        return TenantSpec(tenant=tid, display=name)
+
+    # ---- state -----------------------------------------------------------
+
+    def _state(self, spec: TenantSpec, now: float) -> _TenantState:
+        st = self._tenants.get(spec.tenant)
+        if st is None:
+            st = _TenantState(spec, now)
+            if len(self._named_states) < self.metrics_max_series:
+                st.named = True
+                self._named_states[spec.tenant] = st
+            self._tenants[spec.tenant] = st
+            while len(self._tenants) > self.state_max:
+                # evict the coldest IDLE tenant; in-flight ones carry
+                # live accounting and must survive the bound. Lazy
+                # scan (almost always the very first entry) — a
+                # list() copy here would be an O(state_max) allocation
+                # on the admit hot path every time the bound is hit
+                doomed = next(
+                    (
+                        tid
+                        for tid, state in self._tenants.items()
+                        if state.inflight == 0
+                    ),
+                    None,
+                )
+                if doomed is None:
+                    break
+                if self._tenants[doomed].named:
+                    # frees the named slot; the series simply
+                    # disappears (an unnamed tenant's counts are
+                    # already folded into the tail)
+                    self._named_states.pop(doomed, None)
+                del self._tenants[doomed]
+                self.evictions += 1
+        else:
+            # key updated via /v2/api-keys: the spec travels with every
+            # request, so quota/weight changes apply on the next call
+            st.spec = spec
+        st.last_seen = now
+        self._tenants.move_to_end(spec.tenant)
+        return st
+
+    def _effective(self, spec: TenantSpec) -> Tuple[float, int, int, int]:
+        """(rps, concurrency, token_budget, burst) with defaults."""
+        rps = spec.rate_rps if spec.rate_rps > 0 else self.default_rps
+        conc = (
+            spec.max_concurrency
+            if spec.max_concurrency > 0 else self.default_concurrency
+        )
+        budget = (
+            spec.token_budget
+            if spec.token_budget > 0 else self.default_token_budget
+        )
+        burst = spec.burst if spec.burst > 0 else self.default_burst
+        return rps, conc, budget, burst
+
+    # ---- admission -------------------------------------------------------
+
+    def admit(
+        self,
+        spec: TenantSpec,
+        model: str,
+        now: Optional[float] = None,
+    ) -> Tuple[Decision, Optional[_Lease]]:
+        """One admission decision; on success the caller must release
+        the returned lease when the request fully completes (stream
+        included) or the fair-share accounting leaks."""
+        now = self._clock() if now is None else now
+        st = self._state(spec, now)
+        rps, conc, budget, burst = self._effective(spec)
+        headers = self._headers(st, rps, burst, budget, now)
+
+        if conc > 0 and st.inflight >= conc:
+            return self._shed(
+                st, REASON_CONCURRENCY, 1.0, headers
+            ), None
+        if rps > 0:
+            cap = (
+                float(burst) if burst > 0
+                else TenantSpec(rate_rps=rps).bucket_capacity()
+            )
+            if st.bucket is None:
+                st.bucket = TokenBucket(rps, cap, now)
+            else:
+                st.bucket.reconfigure(rps, cap)
+            if not st.bucket.take(now):
+                wait = st.bucket.seconds_until_token(now)
+                headers["X-RateLimit-Remaining-Requests"] = "0"
+                return self._shed(
+                    st, REASON_RATE, wait, headers
+                ), None
+            headers["X-RateLimit-Remaining-Requests"] = str(
+                st.bucket.remaining(now)
+            )
+        if budget > 0:
+            window = (
+                spec.budget_window_s
+                if spec.budget_window_s > 0 else self.budget_window_s
+            )
+            if st.budget is None:
+                st.budget = RollingBudget(window)
+            else:
+                st.budget.window = max(1.0, window)
+            if st.budget.remaining(budget, now) <= 0:
+                wait = st.budget.seconds_until_reset(now)
+                headers["X-RateLimit-Remaining-Tokens"] = "0"
+                return self._shed(
+                    st, REASON_BUDGET, wait, headers
+                ), None
+
+        owns_cap = self.model_cap > 0 and self.fair_watermark > 0
+        if owns_cap:
+            verdict = self._fair_share(spec, model, now)
+            if verdict is not None:
+                return self._shed(
+                    st, verdict, 1.0, headers
+                ), None
+
+        st.inflight += 1
+        st.admitted_total += 1
+        if not st.named:
+            self._tail_admitted += 1
+            self._tail_inflight += 1
+        st.per_model[model] = st.per_model.get(model, 0) + 1
+        self._model_inflight.setdefault(model, {})[spec.tenant] = (
+            st.per_model[model]
+        )
+        return (
+            Decision(
+                admitted=True, tenant=spec.tenant, headers=headers,
+                owns_model_cap=owns_cap,
+            ),
+            _Lease(self, spec.tenant, model),
+        )
+
+    def _fair_share(
+        self, spec: TenantSpec, model: str, now: float
+    ) -> Optional[str]:
+        """Weighted-fair check for one saturated model, or None when
+        admittable. Fair slots are weight-proportional among ACTIVE
+        (in-flight) tenants of the same-or-higher priority — a
+        higher-priority tenant's share ignores lower-priority demand
+        entirely, which is what "shed lowest-priority first" means in
+        slot form."""
+        cap = self.model_cap
+        per_tenant = self._model_inflight.get(model, {})
+        total = sum(per_tenant.values())
+        if total < self.fair_watermark * cap:
+            return None
+        if total >= self.hard_ceiling * cap:
+            # physical backstop: past the ceiling nothing admits (the
+            # floor-of-one fair slot would otherwise admit one request
+            # per tenant — unbounded at millions of tenants)
+            return REASON_SATURATED
+        active_weight = 0
+        for tid, n in per_tenant.items():
+            if n <= 0 or tid == spec.tenant:
+                continue
+            other = self._tenants.get(tid)
+            if other is None:
+                continue
+            if other.spec.priority >= spec.priority:
+                active_weight += max(1, other.spec.weight)
+        my_weight = max(1, spec.weight)
+        fair = cap * my_weight / float(my_weight + active_weight)
+        mine = per_tenant.get(spec.tenant, 0)
+        if mine < max(1.0, fair):
+            return None
+        return REASON_FAIR
+
+    def _shed(
+        self,
+        st: _TenantState,
+        reason: str,
+        retry_after: float,
+        headers: Dict[str, str],
+    ) -> Decision:
+        st.shed_total += 1
+        st.shed_by_reason[reason] = st.shed_by_reason.get(reason, 0) + 1
+        if not st.named:
+            self._tail_shed[reason] = (
+                self._tail_shed.get(reason, 0) + 1
+            )
+        retry = max(1.0, retry_after)
+        if retry == math.inf:
+            retry = 60.0
+        headers["Retry-After"] = str(int(math.ceil(retry)))
+        return Decision(
+            admitted=False, tenant=st.spec.tenant, reason=reason,
+            retry_after=retry, headers=headers,
+        )
+
+    def _headers(
+        self,
+        st: _TenantState,
+        rps: float,
+        burst: int,
+        budget: int,
+        now: float,
+    ) -> Dict[str, str]:
+        """The applicable ``X-RateLimit-*`` set (OpenAI-style split
+        into -Requests and -Tokens families)."""
+        out: Dict[str, str] = {}
+        if rps > 0:
+            cap = (
+                burst if burst > 0
+                else int(TenantSpec(rate_rps=rps).bucket_capacity())
+            )
+            out["X-RateLimit-Limit-Requests"] = str(int(cap))
+            if st.bucket is not None:
+                out["X-RateLimit-Reset-Requests"] = (
+                    f"{st.bucket.seconds_until_token(now):.3f}"
+                )
+        if budget > 0:
+            out["X-RateLimit-Limit-Tokens"] = str(budget)
+            if st.budget is not None:
+                out["X-RateLimit-Remaining-Tokens"] = str(
+                    st.budget.remaining(budget, now)
+                )
+                out["X-RateLimit-Reset-Tokens"] = str(
+                    int(math.ceil(
+                        st.budget.seconds_until_reset(now)
+                    ))
+                )
+        return out
+
+    def _end(self, tenant: str, model: str) -> None:
+        st = self._tenants.get(tenant)
+        if st is not None:
+            if st.inflight > 0:
+                st.inflight -= 1
+                if not st.named and self._tail_inflight > 0:
+                    self._tail_inflight -= 1
+            n = st.per_model.get(model, 0) - 1
+            if n <= 0:
+                st.per_model.pop(model, None)
+            else:
+                st.per_model[model] = n
+        slots = self._model_inflight.get(model)
+        if slots is not None:
+            n = slots.get(tenant, 0) - 1
+            if n <= 0:
+                slots.pop(tenant, None)
+                if not slots:
+                    self._model_inflight.pop(model, None)
+            else:
+                slots[tenant] = n
+
+    # ---- usage feed (the PR 8 metering pipeline) -------------------------
+
+    def record_tokens(
+        self, tenant: str, tokens: int, now: Optional[float] = None
+    ) -> None:
+        """Charge ``tokens`` (prompt + completion) against the tenant's
+        rolling budget — called by the proxy's usage recorder, so the
+        budget rides the same counters ``/v2/usage/summary`` reports."""
+        now = self._clock() if now is None else now
+        st = self._tenants.get(tenant)
+        if st is None:
+            return
+        st.tokens_total += max(0, int(tokens))
+        if not st.named:
+            self._tail_tokens += max(0, int(tokens))
+        budget = self._effective(st.spec)[2]
+        if budget <= 0:
+            return
+        if st.budget is None:
+            window = (
+                st.spec.budget_window_s
+                if st.spec.budget_window_s > 0 else self.budget_window_s
+            )
+            st.budget = RollingBudget(window)
+        st.budget.record(tokens, now)
+
+    # ---- reads -----------------------------------------------------------
+
+    def model_inflight(self, model: str) -> int:
+        return sum(self._model_inflight.get(model, {}).values())
+
+    def tenant_inflight(self, tenant: str) -> int:
+        st = self._tenants.get(tenant)
+        return st.inflight if st else 0
+
+    def slo_samples(
+        self, limit: int = 64
+    ) -> List[Tuple[str, int, int]]:
+        """(tenant, admitted_cum, shed_cum) for the most recently
+        active tenants that have seen any shed or admission — the SLO
+        evaluator turns each into a tenant-scoped shed-budget
+        objective (bounded: label cardinality is an operator budget)."""
+        items = [
+            (tid, st.admitted_total, st.shed_total)
+            for tid, st in self._tenants.items()
+            if st.admitted_total or st.shed_total
+        ]
+        # OrderedDict iterates cold → hot; take the hot tail
+        return items[-max(1, limit):]
+
+    def snapshot(self, limit: int = 100) -> List[Dict]:
+        """Operator view for ``GET /v2/debug/tenancy`` (hot tenants
+        first, bounded)."""
+        now = self._clock()
+        out = []
+        for tid, st in reversed(list(self._tenants.items())):
+            if len(out) >= limit:
+                break
+            rps, conc, budget, burst = self._effective(st.spec)
+            entry = {
+                "tenant": tid,
+                "display": st.spec.display,
+                "weight": st.spec.weight,
+                "priority": st.spec.priority,
+                "inflight": st.inflight,
+                "admitted_total": st.admitted_total,
+                "shed_total": st.shed_total,
+                "shed_by_reason": dict(st.shed_by_reason),
+                "tokens_total": st.tokens_total,
+                "limits": {
+                    "rate_rps": rps,
+                    "burst": burst,
+                    "max_concurrency": conc,
+                    "token_budget": budget,
+                },
+            }
+            if st.budget is not None and budget > 0:
+                entry["budget"] = {
+                    "remaining": st.budget.remaining(budget, now),
+                    "resets_in_s": round(
+                        st.budget.seconds_until_reset(now), 3
+                    ),
+                }
+            out.append(entry)
+        return out
+
+    def metrics_lines(self) -> List[str]:
+        """Per-tenant admission/shed/token series, bounded: the first
+        ``metrics_max_series`` concurrently tracked tenants hold their
+        own label (sticky — never re-ranked, so series don't teleport
+        between a name and the rollup); everyone else lands in
+        cumulative ``tenant="_other"`` aggregates maintained
+        incrementally at admit/shed/usage time. Scrapes are therefore
+        O(named series), not O(all tenants), and every counter —
+        ``_other`` included — stays monotonic through LRU eviction."""
+        lines = ["# TYPE gpustack_tenant_requests_total counter"]
+
+        def req_line(tenant: str, outcome: str, value: int) -> str:
+            return (
+                "gpustack_tenant_requests_total"
+                f'{{tenant="{tenant}",outcome="{outcome}"}} {value}'
+            )
+
+        for tid, st in self._named_states.items():
+            lines.append(req_line(tid, "admitted", st.admitted_total))
+            for reason, n in sorted(st.shed_by_reason.items()):
+                lines.append(req_line(tid, reason, n))
+        if self._tail_admitted or self._tail_shed:
+            lines.append(
+                req_line("_other", "admitted", self._tail_admitted)
+            )
+            for reason, n in sorted(self._tail_shed.items()):
+                lines.append(req_line("_other", reason, n))
+        lines.append("# TYPE gpustack_tenant_inflight gauge")
+        for tid, st in self._named_states.items():
+            if st.inflight:
+                lines.append(
+                    f'gpustack_tenant_inflight{{tenant="{tid}"}} '
+                    f"{st.inflight}"
+                )
+        if self._tail_inflight:
+            lines.append(
+                'gpustack_tenant_inflight{tenant="_other"} '
+                f"{self._tail_inflight}"
+            )
+        lines.append("# TYPE gpustack_tenant_tokens_total counter")
+        for tid, st in self._named_states.items():
+            if st.tokens_total:
+                lines.append(
+                    f'gpustack_tenant_tokens_total{{tenant="{tid}"}} '
+                    f"{st.tokens_total}"
+                )
+        if self._tail_tokens:
+            lines.append(
+                'gpustack_tenant_tokens_total{tenant="_other"} '
+                f"{self._tail_tokens}"
+            )
+        return lines

@@ -495,6 +495,35 @@ def check_fenced_writes(writes: Sequence[Dict]) -> List[Violation]:
     return out
 
 
+def check_fair_shares(
+    admitted: Dict[str, int],
+    weights: Dict[str, int],
+    eps: float = 0.2,
+) -> List[Violation]:
+    """**tenant-fair-share** (always): among tenants that SATURATED a
+    model (the chaos harness's flooders), each tenant's share of the
+    admitted requests must sit within ``eps`` of its weight share —
+    the convergence guarantee the tenancy layer's weighted-fair
+    admission promises (server/tenancy.py). Pure so the harness, the
+    e2e and unit tests judge identical math."""
+    out: List[Violation] = []
+    total_admitted = sum(admitted.get(t, 0) for t in weights)
+    total_weight = sum(max(1, w) for w in weights.values())
+    if total_admitted <= 0 or total_weight <= 0 or len(weights) < 2:
+        return out
+    for tenant, weight in sorted(weights.items()):
+        share = admitted.get(tenant, 0) / total_admitted
+        fair = max(1, weight) / total_weight
+        if abs(share - fair) > eps:
+            out.append(Violation(
+                "tenant-fair-share", "always",
+                f"tenant {tenant}: admitted share {share:.3f} vs "
+                f"weight share {fair:.3f} (weight {weight}, "
+                f"eps {eps})",
+            ))
+    return out
+
+
 def transition_violation(
     old: str, new: str, label: str = ""
 ) -> Optional[Violation]:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import re
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -86,11 +87,20 @@ class WorkerServer:
     def inflight_count(self, instance_id: int) -> int:
         return self._inflight.get(instance_id, 0)
 
+    # KV-scoped tokens (api/auth.py mint_kv_token) authorize exactly
+    # one instance's /kv/export relay — the credential engine→engine
+    # pulls carry in a per-request header, so the full proxy secret
+    # (which opens every route here) never travels between workers
+    _KV_EXPORT_RE = re.compile(
+        r"^/proxy/instances/(\d+)/kv/export/?$"
+    )
+
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
         """Server→worker auth: bearer must equal this worker's proxy
         secret (reference confines the worker API behind worker auth,
-        routes/worker/proxy.py; round 1 left these ports open)."""
+        routes/worker/proxy.py; round 1 left these ports open) — or a
+        short-lived KV-scoped token for that one export path."""
         import hmac as _hmac
 
         if request.path in self.PUBLIC_PATHS:
@@ -98,14 +108,25 @@ class WorkerServer:
         secret = getattr(self.agent, "proxy_secret", "")
         authz = request.headers.get("Authorization", "")
         token = authz[7:] if authz.startswith("Bearer ") else ""
-        if not secret or not token or not _hmac.compare_digest(
-            token, secret
-        ):
+        if not secret or not token:
             return web.json_response(
                 {"error": "worker proxy authentication required"},
                 status=401,
             )
-        return await handler(request)
+        if _hmac.compare_digest(token, secret):
+            return await handler(request)
+        kv_target = self._KV_EXPORT_RE.match(request.path)
+        if kv_target is not None:
+            from gpustack_tpu.api.auth import verify_kv_token
+
+            if verify_kv_token(
+                token, secret, int(kv_target.group(1))
+            ):
+                return await handler(request)
+        return web.json_response(
+            {"error": "worker proxy authentication required"},
+            status=401,
+        )
 
     async def instance_proxy(self, request: web.Request) -> web.StreamResponse:
         """Authenticated reverse proxy to a local engine instance

@@ -965,3 +965,132 @@ class TestBaselineRatchet:
         assert loaded == {findings[0].key: 1}
         with open(path) as f:
             assert json.load(f)["findings"][0]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# route-auth
+# ---------------------------------------------------------------------------
+
+
+MIDDLEWARES_STUB = """\
+    PUBLIC_PATHS = {
+        "/healthz",
+        "/auth/login",
+    }
+"""
+
+
+class TestRouteAuth:
+    def run_rule(self, tmp_path, routes_body):
+        from gpustack_tpu.analysis.rules.route_auth import RouteAuthRule
+
+        make_tree(tmp_path, {
+            "gpustack_tpu/api/middlewares.py": MIDDLEWARES_STUB,
+            "gpustack_tpu/routes/mod.py": routes_body,
+        })
+        return run(tmp_path, [RouteAuthRule()]).new
+
+    def test_fires_on_principal_less_handler(self, tmp_path):
+        found = self.run_rule(tmp_path, """\
+            def add_routes(app):
+                async def leaky(request):
+                    return {"every": "tenant sees this"}
+
+                app.router.add_get("/v2/leaky", leaky)
+        """)
+        assert len(found) == 1, found
+        assert found[0].rule == "route-auth"
+        assert "/v2/leaky" in found[0].message
+
+    def test_quiet_on_direct_principal_read(self, tmp_path):
+        found = self.run_rule(tmp_path, """\
+            def add_routes(app):
+                async def mine(request):
+                    principal = request.get("principal")
+                    return {"user": principal}
+
+                app.router.add_get("/v2/mine", mine)
+        """)
+        assert found == []
+
+    def test_quiet_on_transitive_guard(self, tmp_path):
+        # the crud-factory shape: the handler calls a local helper
+        # which calls require_admin — the fixpoint must reach it
+        found = self.run_rule(tmp_path, """\
+            from gpustack_tpu.routes.crud import require_admin
+
+            def add_routes(app):
+                def check_read(request):
+                    return require_admin(request)
+
+                async def listing(request):
+                    if err := check_read(request):
+                        return err
+                    return {}
+
+                app.router.add_get("/v2/things", listing)
+        """)
+        assert found == []
+
+    def test_quiet_on_declared_public_path(self, tmp_path):
+        found = self.run_rule(tmp_path, """\
+            def add_routes(app):
+                async def login(request):
+                    return {"token": "..."}
+
+                app.router.add_post("/auth/login", login)
+        """)
+        assert found == []
+
+    def test_add_route_form_is_covered(self, tmp_path):
+        # add_route("GET", path, handler): the method arg shifts the
+        # (path, handler) positions — the generic registration form
+        # must not be a blind spot in the empty-baseline contract
+        found = self.run_rule(tmp_path, """\
+            def add_routes(app):
+                async def leaky(request):
+                    return {}
+
+                app.router.add_route("GET", "/v2/leaky", leaky)
+        """)
+        assert len(found) == 1, found
+        assert "/v2/leaky" in found[0].message
+
+    def test_dynamic_path_gets_no_public_exemption(self, tmp_path):
+        # an f-string path can't be matched against the allowlists, so
+        # the handler itself must resolve — this one doesn't
+        found = self.run_rule(tmp_path, """\
+            def add_routes(app, kind):
+                async def anything(request):
+                    return {}
+
+                app.router.add_get(f"/v2/{kind}", anything)
+        """)
+        assert len(found) == 1, found
+
+    def test_suppression_silences(self, tmp_path):
+        from gpustack_tpu.analysis.rules.route_auth import RouteAuthRule
+
+        make_tree(tmp_path, {
+            "gpustack_tpu/api/middlewares.py": MIDDLEWARES_STUB,
+            "gpustack_tpu/routes/mod.py": textwrap.dedent("""\
+                def add_routes(app):
+                    async def leaky(request):
+                        return {}
+
+                    # analysis: ignore[route-auth]
+                    app.router.add_get("/v2/leaky", leaky)
+            """),
+        })
+        assert run(tmp_path, [RouteAuthRule()]).new == []
+
+    def test_missing_public_paths_is_a_finding(self, tmp_path):
+        from gpustack_tpu.analysis.rules.route_auth import RouteAuthRule
+
+        make_tree(tmp_path, {
+            "gpustack_tpu/api/middlewares.py": "X = 1\n",
+            "gpustack_tpu/routes/mod.py": "def f():\n    pass\n",
+        })
+        found = run(tmp_path, [RouteAuthRule()]).new
+        assert len(found) == 1
+        assert "PUBLIC_PATHS" in found[0].message

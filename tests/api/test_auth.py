@@ -94,3 +94,39 @@ def test_authenticate_paths(ctx):
         assert p.kind == "worker" and p.worker_id == 7
 
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# KV-scoped worker-proxy tokens (disaggregated handoff credentials)
+# ---------------------------------------------------------------------------
+
+
+class TestKvTokens:
+    def test_roundtrip(self):
+        token = auth_mod.mint_kv_token("secret", 7, ttl=60.0, now=1000.0)
+        assert auth_mod.verify_kv_token(token, "secret", 7, now=1030.0)
+
+    def test_scoped_to_one_instance(self):
+        token = auth_mod.mint_kv_token("secret", 7, ttl=60.0, now=1000.0)
+        assert not auth_mod.verify_kv_token(token, "secret", 8, now=1001.0)
+
+    def test_expires(self):
+        token = auth_mod.mint_kv_token("secret", 7, ttl=10.0, now=1000.0)
+        assert auth_mod.verify_kv_token(token, "secret", 7, now=1009.0)
+        assert not auth_mod.verify_kv_token(token, "secret", 7, now=1011.0)
+
+    def test_wrong_secret_rejected(self):
+        token = auth_mod.mint_kv_token("secret", 7, ttl=60.0, now=1000.0)
+        assert not auth_mod.verify_kv_token(token, "other", 7, now=1001.0)
+
+    def test_tampered_payload_rejected(self):
+        token = auth_mod.mint_kv_token("secret", 7, ttl=60.0, now=1000.0)
+        prefix, iid, expires, sig = token.split(":")
+        forged = f"{prefix}:{iid}:{int(expires) + 3600}:{sig}"
+        assert not auth_mod.verify_kv_token(
+            forged, "secret", 7, now=1001.0
+        )
+
+    def test_garbage_rejected(self):
+        for junk in ("", "Bearer x", "gkv1:7", "gkv1:a:b:c", "secret"):
+            assert not auth_mod.verify_kv_token(junk, "secret", 7)

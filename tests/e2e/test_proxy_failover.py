@@ -278,16 +278,29 @@ def test_load_shed_returns_429_with_retry_after(tmp_path):
             )
             assert r2.status == 429, await r2.text()
             assert int(r2.headers["Retry-After"]) >= 1
+            # the per-model cap is owned by the tenancy fair-share
+            # layer now (server/tenancy.py): the 429 names the tenant
+            # and carries a machine-readable reason
+            body = await r2.json()
+            assert body["reason"] in (
+                "fair_share_exceeded", "model_saturated"
+            ), body
             r1 = await t1
             assert r1.status == 200    # the admitted request completes
-            assert app["resilience"].shed_total >= 1
+            shed_tenant = body["tenant"]
+            assert app["tenancy"].snapshot()[0]["shed_total"] >= 1
+            assert any(
+                e["tenant"] == shed_tenant and e["shed_total"] >= 1
+                for e in app["tenancy"].snapshot()
+            )
 
-            # /metrics surfaces the resilience counters
+            # /metrics surfaces the resilience + tenancy counters
             m = await client.get("/metrics", headers=hdrs)
             text = await m.text()
             assert "gpustack_proxy_shed_total" in text
             assert "gpustack_proxy_failovers_total" in text
             assert "gpustack_proxy_breaker_state" in text
+            assert "gpustack_tenant_requests_total" in text
         finally:
             await client.close()
             for rep in replicas:

@@ -1,0 +1,237 @@
+"""Tier-1 noisy-neighbor chaos e2e (ISSUE 14 acceptance): against the
+REAL in-process server with protocol-true stub workers, a seeded
+``tenant_flood`` schedule drives two flooding API-key tenants (weights
+3:1) plus a polite higher-priority tenant through the live OpenAI
+proxy. The tentpole contract, judged end to end:
+
+- the flooding tenants receive **their own** 429s carrying
+  ``X-RateLimit-*`` and ``Retry-After`` headers with a
+  machine-readable reason;
+- a **tenant-scoped burn alert** fires for a flooder (pseudo-model
+  ``tenant:key:<id>``) while the model itself, the polite tenant, and
+  ``_cluster`` stay alert-free — the noisy neighbor's alert, never
+  the fleet's;
+- the polite tenant's requests **all succeed**, with error rate and
+  TTFT p95 judged *by the PR 9 rollout delta-gate functions
+  themselves* (``delta_gate_failure`` over baseline/canary windows
+  built from the polite tenant's own samples);
+- the **fairness invariant** holds: each saturating tenant's admitted
+  share sits within ε of its weight share
+  (``invariants.check_fair_shares``, asserted via
+  ``harness.violations()`` alongside every existing invariant);
+- the executed schedule replays **bit-for-bit** from the seed.
+"""
+
+import asyncio
+
+from gpustack_tpu.server.rollout import delta_gate_failure
+from gpustack_tpu.testing import chaos
+
+SEED = 41
+SCHEDULE_KW = dict(kinds=("tenant_flood",), ops=1, workers=2)
+
+MODEL = "qos-chaos-model"
+
+QOS_CFG = {
+    # saturable admission pool + fair layer (TENANT_CFG equivalent,
+    # set explicitly so the harness and the assertions agree)
+    "model_max_outstanding": 8,
+    "tenant_fair_watermark": 0.75,
+    # compressed two-window burn policy, as in the SLO chaos e2e
+    "slo_eval_interval": 0.1,
+    "slo_window_scale": 1.0 / 1200.0,
+    "slo_min_hold": 0.3,
+    # tenant shed budget low enough that BOTH flooders' shed ratios
+    # burn through it (14.4 x 0.02 = 29% bad fraction trips the page)
+    "slo_tenant_shed_budget": 0.02,
+    # keep the run to availability + tenant objectives: error/ttft/
+    # queue need signals the stub engines don't serve
+    "slo_default_error_rate": 0.0,
+    "slo_default_ttft_p95_ms": 0.0,
+}
+
+# the stub engines' synthetic service time — held IDENTICAL across the
+# polite tenant's baseline and canary windows, so any gate-visible
+# degradation is contention, never the harness changing its own load
+SERVICE_DELAY = 0.3
+
+
+def _gate_snapshot(samples):
+    """Polite-tenant samples [(status, elapsed_s)] → the cumulative
+    snapshot shape ``delta_gate_failure`` consumes
+    (server/rollout.py snapshot_model_requests). Bucket bounds are the
+    samples' own latencies, so the p95 interpolation is essentially
+    exact instead of histogram-coarse."""
+    ok = sum(1 for status, _ in samples if status == 200)
+    bounds = sorted({round(e, 4) for _, e in samples}) or [0.001]
+    ttft = {}
+    for ub in bounds:
+        ttft[repr(ub)] = sum(
+            1 for _, e in samples if round(e, 4) <= ub
+        )
+    ttft["inf"] = len(samples)
+    return {
+        "ok": ok,
+        "total": len(samples),
+        "ttft": ttft,
+        "ttft_count": len(samples),
+    }
+
+
+def _merge_snapshots(a, b):
+    """Cumulative union of two windows' snapshots (bucket keys are
+    per-window sample latencies, so cumulate by re-binning)."""
+    out = {
+        "ok": a["ok"] + b["ok"],
+        "total": a["total"] + b["total"],
+        "ttft_count": a["ttft_count"] + b["ttft_count"],
+    }
+    keys = sorted(
+        {
+            float(k)
+            for snap in (a, b)
+            for k in snap["ttft"]
+            if k != "inf"
+        }
+    )
+
+    def cum_at(snap, ub):
+        best = 0
+        for k, c in snap["ttft"].items():
+            if k != "inf" and float(k) <= ub:
+                best = max(best, c)
+        return best
+
+    ttft = {repr(k): cum_at(a, k) + cum_at(b, k) for k in keys}
+    ttft["inf"] = out["ttft_count"]
+    out["ttft"] = ttft
+    return out
+
+
+def test_noisy_neighbor_isolation_fairness_and_tenant_burn(tmp_path):
+    async def go():
+        schedule = chaos.generate_schedule(SEED, **SCHEDULE_KW)
+        harness = chaos.ChaosHarness(
+            str(tmp_path), workers=2, replicas=2, extra_cfg=QOS_CFG,
+        )
+        await harness.start()
+        try:
+            await harness.deploy(MODEL)
+            await harness.wait_converged(timeout=45.0)
+            await harness.ensure_tenants()
+
+            # --- polite baseline window (pre-flood), with the SAME
+            # synthetic service time the flood will run under
+            for stub in harness.stubs:
+                stub.proxy_delay = SERVICE_DELAY
+            baseline = []
+            try:
+                for _ in range(10):
+                    status, elapsed, _h = await harness.tenant_probe(
+                        "polite"
+                    )
+                    baseline.append((status, elapsed))
+            finally:
+                for stub in harness.stubs:
+                    stub.proxy_delay = 0.0
+            assert all(s == 200 for s, _ in baseline), baseline
+
+            # pre-flood incident snapshot: deploy-time availability
+            # blips under the compressed burn windows are not the
+            # flood's doing — only NEW innocent-model incidents count
+            pre = await harness.admin.request(
+                "GET", "/v2/debug/incidents"
+            )
+            pre_ids = {i["id"] for i in pre["items"]}
+
+            await harness.run_schedule(schedule)
+            assert harness.flood_results, "schedule executed no flood"
+            flood = harness.flood_results[0]
+
+            # --- the flooders got THEIR 429s, with the contract
+            # headers and a machine-readable reason
+            assert sum(flood["shed"].values()) > 0, flood
+            shed_headers = [
+                h
+                for per_tenant in flood["shed_headers"].values()
+                for h in per_tenant
+            ]
+            assert shed_headers, "no shed carried headers"
+            for headers in shed_headers:
+                assert "Retry-After" in headers, headers
+                assert any(
+                    k.lower().startswith("x-ratelimit-")
+                    for k in headers
+                ), headers
+
+            # --- isolation: every polite request succeeded...
+            polite = flood["polite"]
+            assert len(polite) >= 5, polite
+            assert all(s == 200 for s, _ in polite), polite
+
+            # ...and the polite tenant's canary window passes the REAL
+            # PR 9 delta gates against its own pre-flood baseline
+            base_end = _gate_snapshot(baseline)
+            current = _merge_snapshots(
+                base_end, _gate_snapshot(polite)
+            )
+            verdict = delta_gate_failure(
+                _gate_snapshot([]),   # baseline window opens at zero
+                base_end,             # ...and freezes pre-flood
+                base_end,             # canary window = the flood
+                current,
+                harness.cfg,
+            )
+            assert verdict is None, (
+                f"polite tenant failed the PR 9 delta gate: {verdict}"
+            )
+
+            # --- the noisy neighbor's OWN burn alert fired...
+            flooder_models = {
+                f"tenant:{harness.tenants[n]['tenant']}"
+                for n in ("flood-a", "flood-b")
+            }
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 15.0
+            fired = []
+            while loop.time() < deadline and not fired:
+                body = await harness.admin.request(
+                    "GET", "/v2/debug/incidents"
+                )
+                fired = [
+                    i for i in body["items"]
+                    if i["model"] in flooder_models
+                    and i["objective"] == "tenant_shed"
+                ]
+                if not fired:
+                    await asyncio.sleep(0.1)
+            assert fired, "no tenant-scoped burn alert ever fired"
+
+            # ...and NOBODY else's did: not the model's, not the
+            # polite tenant's, not the cluster invariants objective
+            body = await harness.admin.request(
+                "GET", "/v2/debug/incidents"
+            )
+            polite_model = (
+                f"tenant:{harness.tenants['polite']['tenant']}"
+            )
+            innocent = [
+                i for i in body["items"]
+                if i["model"] in (MODEL, "_cluster", polite_model)
+                and i["id"] not in pre_ids
+            ]
+            assert innocent == [], innocent
+
+            # --- fairness (admitted share within eps of weight) and
+            # every existing invariant, over the whole run
+            await harness.wait_converged(timeout=45.0)
+            assert harness.violations() == []
+
+            # --- replayable bit-for-bit from the seed
+            assert schedule == chaos.generate_schedule(
+                SEED, **SCHEDULE_KW
+            )
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())

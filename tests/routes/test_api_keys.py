@@ -1,0 +1,217 @@
+"""API-key management surface: CRUD, tenant scoping, and the
+admin-only QoS service-class fields (/v2/api-keys; ISSUE 14)."""
+
+import asyncio
+
+import pytest
+
+from gpustack_tpu.api import auth as auth_mod
+from gpustack_tpu.config import Config
+from gpustack_tpu.orm.db import Database
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import ApiKey, User
+from gpustack_tpu.server.app import create_app
+from gpustack_tpu.server.bus import EventBus
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    db = Database(":memory:")
+    Record.bind(db, EventBus())
+    Record.create_all_tables(db)
+    yield Config.load({"data_dir": str(tmp_path)})
+    db.close()
+
+
+def run_app(cfg, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def run():
+        admin = await User.create(
+            User(
+                username="admin", is_admin=True,
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        alice = await User.create(
+            User(
+                username="alice",
+                password_hash=auth_mod.hash_password("pw"),
+            )
+        )
+        hdrs = {
+            name: {
+                "Authorization": "Bearer "
+                + auth_mod.issue_session_token(u, cfg.jwt_secret)
+            }
+            for name, u in (("admin", admin), ("alice", alice))
+        }
+        app = create_app(cfg)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await coro_fn(client, hdrs)
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def test_create_returns_secret_once_and_defaults(cfg):
+    async def go(client, hdrs):
+        r = await client.post(
+            "/v2/api-keys", json={"name": "mine"},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 201
+        data = await r.json()
+        assert data["value"].startswith("gtpu_")
+        assert "hashed_secret" not in data
+        assert data["weight"] == 1 and data["priority"] == 0
+        assert data["rate_limit_rps"] == 0.0
+        # the full secret never appears again
+        r = await client.get("/v2/api-keys", headers=hdrs["alice"])
+        items = (await r.json())["items"]
+        assert len(items) == 1
+        assert "value" not in items[0]
+        assert "hashed_secret" not in items[0]
+
+    run_app(cfg, go)
+
+
+def test_qos_fields_are_admin_only(cfg):
+    async def go(client, hdrs):
+        # non-admin create with QoS fields: refused outright
+        r = await client.post(
+            "/v2/api-keys", json={"name": "x", "weight": 100},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 403
+        # non-admin plain create, then non-admin PATCH of QoS: refused
+        r = await client.post(
+            "/v2/api-keys", json={"name": "x"}, headers=hdrs["alice"]
+        )
+        key_id = (await r.json())["id"]
+        r = await client.patch(
+            f"/v2/api-keys/{key_id}", json={"rate_limit_rps": 0.0001},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 403
+        # ...but the owner may rename / narrow scopes
+        r = await client.patch(
+            f"/v2/api-keys/{key_id}",
+            json={"name": "renamed", "scopes": ["inference"]},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["name"] == "renamed"
+        assert data["scopes"] == ["inference"]
+        # admin sets the service class
+        r = await client.patch(
+            f"/v2/api-keys/{key_id}",
+            json={
+                "weight": 3, "priority": 2, "rate_limit_rps": 10.0,
+                "max_concurrency": 4, "token_budget": 100000,
+            },
+            headers=hdrs["admin"],
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["weight"] == 3 and data["priority"] == 2
+        assert data["max_concurrency"] == 4
+
+    run_app(cfg, go)
+
+
+def test_qos_validation(cfg):
+    async def go(client, hdrs):
+        r = await client.post(
+            "/v2/api-keys", json={"weight": 0}, headers=hdrs["admin"]
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v2/api-keys", json={"rate_limit_rps": -1},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v2/api-keys", json={"token_budget": "lots"},
+            headers=hdrs["admin"],
+        )
+        assert r.status == 400
+        # json.loads parses NaN/Infinity literals: NaN would silently
+        # no-op the limit, Infinity overflows the header rendering
+        for bad in (float("nan"), float("inf")):
+            r = await client.post(
+                "/v2/api-keys", json={"rate_limit_rps": bad},
+                headers=hdrs["admin"],
+            )
+            assert r.status == 400, bad
+
+    run_app(cfg, go)
+
+
+def test_listing_and_deletion_are_tenant_scoped(cfg):
+    async def go(client, hdrs):
+        r = await client.post(
+            "/v2/api-keys", json={"name": "alices"},
+            headers=hdrs["alice"],
+        )
+        alice_key = (await r.json())["id"]
+        r = await client.post(
+            "/v2/api-keys", json={"name": "admins"},
+            headers=hdrs["admin"],
+        )
+        admin_key = (await r.json())["id"]
+        # alice sees only her own
+        r = await client.get("/v2/api-keys", headers=hdrs["alice"])
+        names = {k["name"] for k in (await r.json())["items"]}
+        assert names == {"alices"}
+        # admin sees everything
+        r = await client.get("/v2/api-keys", headers=hdrs["admin"])
+        names = {k["name"] for k in (await r.json())["items"]}
+        assert {"alices", "admins"} <= names
+        # alice cannot touch the admin's key — 404, not 403 (no id
+        # oracle across tenants)
+        r = await client.delete(
+            f"/v2/api-keys/{admin_key}", headers=hdrs["alice"]
+        )
+        assert r.status == 404
+        r = await client.patch(
+            f"/v2/api-keys/{admin_key}", json={"name": "stolen"},
+            headers=hdrs["alice"],
+        )
+        assert r.status == 404
+        # the owner deletes her own
+        r = await client.delete(
+            f"/v2/api-keys/{alice_key}", headers=hdrs["alice"]
+        )
+        assert r.status == 200
+        assert await ApiKey.get(alice_key) is None
+
+    run_app(cfg, go)
+
+
+def test_key_auth_carries_the_key_record(cfg):
+    """authenticate() attaches the ApiKey to the principal — the
+    tenancy layer reads its QoS fields per request."""
+
+    async def go(client, hdrs):
+        r = await client.post(
+            "/v2/api-keys", json={"name": "probe"},
+            headers=hdrs["alice"],
+        )
+        full = (await r.json())["value"]
+        principal = await auth_mod.authenticate(full, cfg.jwt_secret)
+        assert principal is not None
+        assert principal.api_key is not None
+        assert principal.api_key.name == "probe"
+        # and the key itself works over HTTP (management scope)
+        r = await client.get(
+            "/v2/api-keys",
+            headers={"Authorization": f"Bearer {full}"},
+        )
+        assert r.status == 200
+
+    run_app(cfg, go)

@@ -346,3 +346,84 @@ def test_change_log_propagates_peer_writes(tmp_path):
             db_b.close()
 
     asyncio.run(go())
+
+
+def test_change_log_tail_batches_refetches_per_kind(tmp_path):
+    """PR 10 scale residual closed: tailing a flushed batch re-fetches
+    the touched rows with ONE ``IN`` query per kind, never one point
+    read per entry — follower propagation stays cheap at high peer
+    write rates. Regression-tested by counting the tailer's queries."""
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.server.bus import EventBus, EventType
+
+    path = str(tmp_path / "batch.db")
+
+    async def go():
+        db_a, db_b = Database(path), Database(path)
+        bus_a, bus_b = EventBus(), EventBus()
+        Record.bind(db_a, bus_a)
+        Record.create_all_tables(db_a)
+        a = LeaseCoordinator(db_a, identity="a", ttl=5.0, bus=bus_a)
+        bus_a.add_tap(a.publish_remote)
+        await a.start()
+        b = None
+        try:
+            models = [
+                await Model.create(Model(name=f"m{i}", preset="tiny"))
+                for i in range(20)
+            ]
+            for m in models:
+                await m.update(replicas=2)
+            await a._flush_outbox()
+
+            b = LeaseCoordinator(db_b, identity="b", ttl=5.0, bus=bus_b)
+            b._last_seen = 0
+            received = []
+            bus_b.add_tap(received.append)
+            queries = []
+            orig_execute = db_b.execute
+
+            async def counting_execute(sql, params=()):
+                queries.append(sql)
+                return await orig_execute(sql, params)
+
+            db_b.execute = counting_execute
+            # re-fetches must go through THIS follower's handle, not
+            # the process-global binding (which points at db_a)
+            Record.bind_context(db_b, bus_b)
+            try:
+                await b._tail_changes()
+            finally:
+                Record.bind_context(db_a, bus_a)
+
+            # 40 change-log entries (20 CREATED + 20 UPDATED) over one
+            # kind: exactly ONE model re-fetch query, not 40
+            model_fetches = [
+                q for q in queries
+                if q.lstrip().upper().startswith("SELECT * FROM MODEL")
+            ]
+            assert len(model_fetches) == 1, model_fetches
+            assert " IN (" in model_fetches[0]
+            # and every entry still republished as its own full event
+            created = [
+                e for e in received
+                if e.kind == "model" and e.type == EventType.CREATED
+            ]
+            updated = [
+                e for e in received
+                if e.kind == "model" and e.type == EventType.UPDATED
+            ]
+            assert len(created) == 20 and len(updated) == 20
+            assert all(e.remote for e in created + updated)
+            assert all(
+                e.data["replicas"] == 2 for e in created + updated
+            )
+        finally:
+            if b is not None:
+                await b.stop()
+            await a.stop()
+            db_a.close()
+            db_b.close()
+
+    asyncio.run(go())

@@ -429,3 +429,67 @@ def test_queue_wait_objective_from_worker_scrape(cfg, monkeypatch):
         ] == "firing"
 
     asyncio.run(go())
+
+
+def test_tenant_shed_objective_fires_for_noisy_tenant(cfg):
+    """The tenancy admission counters become per-tenant pseudo-model
+    objectives (tenant:<id>): a tenant shedding most of its requests
+    burns through its budget and escalates, while a healthy tenant
+    and _cluster stay quiet."""
+    from gpustack_tpu.server.tenancy import TenancyRegistry, TenantSpec
+
+    async def go():
+        cfg.slo_tenant_shed_budget = 0.05
+        tenancy = TenancyRegistry(
+            model_cap=2, fair_watermark=0.75,
+        )
+        app = {"tenancy": tenancy}
+        evaluator = SLOEvaluator(app, cfg)
+
+        noisy = TenantSpec(tenant="key:noisy")
+        polite = TenantSpec(tenant="key:polite", priority=5)
+        # noisy fills the pool and spins on sheds; polite stays clean
+        held = []
+        for _ in range(2):
+            decision, lease = tenancy.admit(noisy, "m")
+            assert decision.admitted
+            held.append(lease)
+        for _ in range(50):
+            decision, lease = tenancy.admit(noisy, "m")
+            assert lease is None and not decision.admitted
+        d, lease = tenancy.admit(polite, "m")
+        assert d.admitted
+        lease.release()
+
+        t0 = time.time()
+        transitions = []
+        # keep the sheds flowing while virtual time advances, so both
+        # fast windows see a sustained >5% bad fraction
+        for tick in range(80):
+            for _ in range(5):
+                tenancy.admit(noisy, "m")
+            d, lease = tenancy.admit(polite, "m")
+            if lease:
+                lease.release()
+            transitions += await evaluator.evaluate_once(
+                now=t0 + tick * 1.0
+            )
+        for lease in held:
+            lease.release()
+        status = evaluator.engine.status(t0 + 81.0)
+        noisy_entry = status["models"]["tenant:key:noisy"][
+            "tenant_shed"
+        ]
+        assert noisy_entry["state"] in ("warning", "firing"), (
+            noisy_entry
+        )
+        polite_entry = status["models"]["tenant:key:polite"][
+            "tenant_shed"
+        ]
+        assert polite_entry["state"] == "ok", polite_entry
+        # the noisy tenant's alert is THEIRS: nothing fired cluster-wide
+        assert not any(
+            t["model"] == CLUSTER_MODEL for t in transitions
+        )
+
+    asyncio.run(go())

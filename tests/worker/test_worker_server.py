@@ -211,3 +211,51 @@ def test_log_follow_streams_appended_lines(tmp_path):
             await client.close()
 
     asyncio.run(go())
+
+
+def test_kv_scoped_token_auth(tmp_path):
+    """The reverse-proxy middleware accepts a short-lived KV-scoped
+    token (api/auth.py mint_kv_token) for exactly one instance's
+    /kv/export path — and nothing else. The full proxy secret never
+    has to travel between workers for a KV pull."""
+    from gpustack_tpu.api.auth import mint_kv_token
+
+    cfg = Config.load({"data_dir": str(tmp_path / "data")})
+
+    async def go(client):
+        token = mint_kv_token("test-proxy-secret", 5, ttl=60.0)
+        hdr = {"Authorization": f"Bearer {token}"}
+        # scoped token on its own export path: passes auth (404s
+        # afterwards only because no serve manager runs instances)
+        r = await client.post("/proxy/instances/5/kv/export",
+                              headers=hdr)
+        assert r.status != 401, await r.text()
+        # same token, different instance: rejected at the door
+        r = await client.post("/proxy/instances/6/kv/export",
+                              headers=hdr)
+        assert r.status == 401
+        # same token, non-export path of ITS instance: rejected
+        r = await client.post(
+            "/proxy/instances/5/v1/chat/completions", headers=hdr
+        )
+        assert r.status == 401
+        # ...and a control route: rejected
+        r = await client.get(
+            "/v2/filesystem/probe", params={"path": "/x"}, headers=hdr
+        )
+        assert r.status == 401
+        # expired token: rejected
+        stale = mint_kv_token(
+            "test-proxy-secret", 5, ttl=1.0, now=0.0
+        )
+        r = await client.post(
+            "/proxy/instances/5/kv/export",
+            headers={"Authorization": f"Bearer {stale}"},
+        )
+        assert r.status == 401
+        # the full secret still opens everything
+        r = await client.post("/proxy/instances/5/kv/export",
+                              headers=AUTH)
+        assert r.status != 401
+
+    _run(cfg, go)
