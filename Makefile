@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze chaos
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze chaos scale
 
 test:
 	python -m pytest tests/ -q
@@ -29,15 +29,27 @@ analyze:
 # schedule per fault class (worker kill, heartbeat blackhole, RPC
 # delay/drop, engine crash mid-STARTING, server restart, the
 # multi-server ha-failover class: leader kill/hang + lease expiry over
-# a shared DB, kv-handoff aborts, and the noisy-neighbor tenant flood
-# with its fairness invariant — docs/TENANCY.md); exits nonzero on any
+# a shared DB, kv-handoff aborts, the noisy-neighbor tenant flood with
+# its fairness invariant — docs/TENANCY.md — and the fleet-scale
+# classes: acquire-storm (8-way lease storms) and
+# rolling-server-restart, both multi-server); exits nonzero on any
 # invariant violation or failed convergence. Same seed ⇒ same
 # schedule, so failures are replayable.
-# Narrow with CLASSES (e.g. `make chaos CLASSES=noisy-neighbor`).
+# Narrow with CLASSES (e.g.
+# `make chaos CLASSES=acquire-storm,rolling-server-restart`).
 CLASSES ?= all
 SEED ?= 1
 chaos:
 	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes $(CLASSES) --seed $(SEED)
+
+# Slow scheduler-at-scale suites (docs/RESILIENCE.md "Scale &
+# crash-consistency"): the 1000+-worker fleet suite (reconcile-pass
+# latency SLOs, sub-linear DB write rate query-counted 100-vs-1000,
+# O(events) watch fan-out across a multi-server cluster, zero
+# invariant violations) plus the 300-worker smoke. Width override:
+# GPUSTACK_TPU_SCALE_WORKERS=200 make scale
+scale:
+	JAX_PLATFORMS=cpu python -m pytest tests/e2e/test_fleet_scale.py tests/e2e/test_scale_smoke.py tests/e2e/test_scale_chaos.py -q
 
 test-engine:
 	python -m pytest tests/ -q -m engine

@@ -100,6 +100,42 @@ class ClientSet:
             await self.request("GET", self.query_path(kind, filters))
         )["items"]
 
+    async def list_all(
+        self, kind: str, page_size: int = 200, **filters: Any
+    ) -> List[Dict[str, Any]]:
+        """THE full-table read for control loops: paginate until the
+        server runs dry. The plain ``list`` call caps at the server's
+        100-row default, which silently truncates any fleet-scale
+        table (workers at 300+, instances at high replica counts) —
+        the PR 9 scale smoke worked around it per-site with oversized
+        ``limit`` guesses; every reconcile-style reader goes through
+        here instead (regression: tests/client/test_sdk.py asserts a
+        >100-row table is fully seen). Pages with a KEYSET cursor
+        (``since_id`` = last id seen, id order), not OFFSET: a row
+        deleted between pages shifts offset windows and would silently
+        skip a live row — which a reconcile loop would then treat as
+        gone and kill."""
+        page_size = max(1, int(page_size))
+        out: List[Dict[str, Any]] = []
+        since = 0
+        while True:
+            page = (
+                await self.request(
+                    "GET",
+                    self.query_path(
+                        kind,
+                        dict(
+                            filters,
+                            limit=page_size, since_id=since,
+                        ),
+                    ),
+                )
+            )["items"]
+            out.extend(page)
+            if len(page) < page_size:
+                return out
+            since = int(page[-1]["id"])
+
     async def get(self, kind: str, id: int) -> Dict[str, Any]:
         return await self.request("GET", f"/v2/{kind}/{id}")
 

@@ -73,6 +73,12 @@ class ResourceClient(Generic[T]):
         items = await self._client.list(self.path, **filters)
         return [self.model_cls.model_validate(i) for i in items]
 
+    async def list_all(self, **filters: Any) -> List[T]:
+        """Paginated full read: never truncated at the server's
+        100-row default (client.list_all)."""
+        items = await self._client.list_all(self.path, **filters)
+        return [self.model_cls.model_validate(i) for i in items]
+
     async def page(
         self, limit: int = 100, offset: int = 0, **filters: Any
     ) -> Tuple[List[T], Dict[str, int]]:

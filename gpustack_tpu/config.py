@@ -215,6 +215,22 @@ class Config(pydantic.BaseModel):
     # acting on stale signals
     autoscale_stale_after_s: float = 30.0
 
+    # ---- control-plane write combiner (server/write_combiner.py;
+    # docs/RESILIENCE.md "Scale & crash-consistency") ---------------------
+    # debounce: worker heartbeat/status refreshes buffer in memory and
+    # flush as batched column writes on this cadence — DB write rate is
+    # O(flushes), not O(workers)
+    control_flush_interval: float = 2.0
+    # hard bound: every buffered status write lands within this many
+    # seconds of arrival, overload degradation included
+    control_write_deadline: float = 10.0
+    # overload watermarks: buffered entries / last-flush seconds at
+    # which write_pressure reaches 1.0 and flushes degrade to
+    # liveness-only (status documents defer, heartbeats still land,
+    # freshness tracked in memory so healthy workers never park)
+    control_queue_watermark: int = 4096
+    control_latency_watermark: float = 1.0
+
     # multi-server HA: TTL-lease leader election over the shared DB
     ha: bool = False
     # lease TTL in seconds (server/coordinator.py LeaseCoordinator):

@@ -103,6 +103,16 @@ METRIC_FAMILIES = {
     "gpustack_tenant_requests_total": "counter",
     "gpustack_tenant_inflight": "gauge",
     "gpustack_tenant_tokens_total": "counter",
+    # control-plane write combiner (server/write_combiner.py):
+    # position on the overload-degradation ladder (>= 1.0 = degraded,
+    # liveness-only flushes), heartbeat/status writes coalesced away
+    # before ever reaching the DB, writes actually landed per batched
+    # flush, and status documents deferred past a flush by pressure —
+    # the knobs that keep DB write rate sub-linear in workers
+    "gpustack_control_write_pressure": "gauge",
+    "gpustack_control_coalesced_writes_total": "counter",
+    "gpustack_control_flushed_writes_total": "counter",
+    "gpustack_control_deferred_writes_total": "counter",
     # control-plane HA (server/coordinator.py + orm/fencing.py):
     # whether THIS server holds the lease, the fencing epoch of the
     # current lease, leadership transitions this process observed

@@ -1,0 +1,87 @@
+"""Transactional change-log appends: replication events commit WITH
+their data write.
+
+PR 10 propagated writes to HA peers through a shared ``change_log``
+table fed from an in-memory outbox (a bus tap enqueued, a ttl/6 loop
+flushed). That left a crash window: a SIGKILL'd leader lost every event
+enqueued since its last flush, and peers re-learned those rows only
+when they were next touched — the recorded durability residual this
+module closes.
+
+Now the change-log INSERT is folded into the SAME transaction as the
+guarded data write (orm/record.py ``create``/``save``/``delete`` call
+:func:`append_change` between the data statement and ``commit``), so a
+write is either fully replicated-on-commit or not committed at all.
+There is nothing left to lose in a crash: the coordinator's bus tap
+survives only as a post-commit no-op (and ``_flush_outbox`` as a
+migration shim for non-transactional bindings — plugin coordinators
+without a ``changelog_origin`` on their Database).
+
+``Record.set_field`` deliberately does NOT append: it is the
+event-less hot-path write shape (autoscaler wake markers, the
+heartbeat/status write combiner) whose whole point is that thousands
+of workers' liveness writes generate neither watch events nor
+replication traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+# analytics/collector rows are written per-request or per-sweep and
+# only ever READ straight from the shared DB (usage queries, archiver)
+# — replicating them through the change log would make every proxied
+# request a cross-server event at exactly the scale HA exists for
+REPLICATION_SKIP_KINDS = frozenset({
+    "model_usage", "usage_archive", "resource_event", "system_load",
+})
+
+
+def change_log_ddl(pk_clause: str) -> str:
+    """The shared replication table (one per cluster DB)."""
+    return (
+        "CREATE TABLE IF NOT EXISTS change_log ("
+        f"{pk_clause}, "
+        "origin TEXT, kind TEXT, record_id INTEGER, "
+        "event_type TEXT, changes TEXT, created_at REAL)"
+    )
+
+
+def encode_changes(changes) -> Optional[str]:
+    """Changed-field diff as JSON text (peers' changes-gated consumers
+    need WHICH fields moved, not just that something did)."""
+    if not changes:
+        return None
+    try:
+        return json.dumps(changes)
+    except (TypeError, ValueError):
+        return None
+
+
+def append_change(
+    conn,
+    origin: str,
+    kind: str,
+    event_type: str,
+    record_id: int,
+    changes_json: Optional[str] = None,
+    now: Optional[float] = None,
+) -> bool:
+    """Append one replication entry on the DB thread, inside the data
+    write's still-open transaction. Returns False for kinds that never
+    replicate. Raising here aborts the caller's commit — a data write
+    whose replication event cannot be recorded must not land half."""
+    if not origin or not kind or kind in REPLICATION_SKIP_KINDS:
+        return False
+    conn.execute(
+        "INSERT INTO change_log "
+        "(origin, kind, record_id, event_type, changes, created_at) "
+        "VALUES (?, ?, ?, ?, ?, ?)",
+        (
+            origin, kind, int(record_id), event_type, changes_json,
+            time.time() if now is None else now,
+        ),
+    )
+    return True

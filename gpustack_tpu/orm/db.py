@@ -18,6 +18,19 @@ from typing import Any, Callable, Iterable, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 
+class DatabaseClosedError(RuntimeError):
+    """The ONE drain-contract error for work queued behind a shutdown:
+    raised by :meth:`Database.run` once ``close()`` flagged the writer
+    thread down, set on every future still queued when the thread
+    exits, and re-raised by consumers with their own pending queues
+    (the control write combiner) so a write buffered behind shutdown
+    fails LOUDLY to its caller instead of silently dropping or hanging
+    an awaiter forever."""
+
+    def __init__(self, what: str):
+        super().__init__(f"{what} is closed; queued write dropped")
+
+
 class Database:
     """One sqlite file (or ':memory:') + a writer thread + migrations."""
 
@@ -29,6 +42,20 @@ class Database:
         self.path = path
         self.dialect = dialect
         self.closed = False
+        # HA replication: when set (LeaseCoordinator.start), Record
+        # write transactions append a change_log entry stamped with
+        # this server identity IN the same commit (orm/changelog.py)
+        self.changelog_origin = ""
+        # round-trips to the writer thread (run/execute/execute_sync):
+        # the scale suites' "query count" — a batched executemany is
+        # ONE op here, which is exactly the coalescing being measured
+        self.op_count = 0
+        # committed transactions that contained at least one
+        # INSERT/UPDATE/DELETE (sqlite trace callback, writer thread):
+        # the scale suites' "DB write rate" — a 1000-row batched flush
+        # is ONE write transaction
+        self.write_txn_count = 0
+        self._txn_dirty = False
         self._work: "queue.Queue[Optional[Tuple[Callable, asyncio.Future, asyncio.AbstractEventLoop]]]" = (
             queue.Queue()
         )
@@ -53,6 +80,12 @@ class Database:
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
+        # write-transaction accounting (scale suites): the trace fires
+        # per executed statement on THIS thread; a commit that saw any
+        # DML since the last boundary counts once. Tests that install
+        # their own trace callback (dialect conformance) simply pause
+        # this counter — it is telemetry, not a correctness feature.
+        self._conn.set_trace_callback(self._trace_stmt)
         self._started.set()
         while True:
             item = self._work.get()
@@ -88,10 +121,21 @@ class Database:
             try:
                 loop.call_soon_threadsafe(
                     self._set_exc, fut,
-                    RuntimeError(f"database {self.path!r} is closed"),
+                    DatabaseClosedError(f"database {self.path!r}"),
                 )
             except RuntimeError:
                 pass  # caller's loop already gone
+
+    def _trace_stmt(self, sql: str) -> None:
+        head = sql.lstrip().upper()
+        if head.startswith(("INSERT", "UPDATE", "DELETE", "REPLACE")):
+            self._txn_dirty = True
+        elif head.startswith("COMMIT"):
+            if self._txn_dirty:
+                self.write_txn_count += 1
+            self._txn_dirty = False
+        elif head.startswith("ROLLBACK"):
+            self._txn_dirty = False
 
     @staticmethod
     def _set_result(fut: asyncio.Future, result: Any) -> None:
@@ -156,7 +200,8 @@ class Database:
         if self.closed:
             # the writer thread is gone: queueing would await a future
             # nothing will ever resolve (a stopped HA server's handle)
-            raise RuntimeError(f"database {self.path!r} is closed")
+            raise DatabaseClosedError(f"database {self.path!r}")
+        self.op_count += 1
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self._work.put((fn, fut, loop))
@@ -198,6 +243,7 @@ class Database:
                 done.set()
 
         # Bypass the futures machinery (no event loop required).
+        self.op_count += 1
         self._work.put((lambda conn: go(conn), _NullFuture(), _NullLoop()))
         done.wait(30)
         if box[1] is not None:
@@ -323,6 +369,30 @@ def _migrate_leadership_epoch(conn: sqlite3.Connection) -> None:
         conn.execute(
             "ALTER TABLE leadership ADD COLUMN epoch INTEGER DEFAULT 0"
         )
+
+
+@migration(3, "model_usage rows gain a tenant index column")
+def _migrate_model_usage_tenant(conn: sqlite3.Connection) -> None:
+    # the rolling token budget rehydrates from durable usage rows
+    # (windowed SUM per tenant — server/tenancy.py durable_budget_
+    # spend); pre-ISSUE-15 tables lack the column the index needs.
+    # sqlite_master probe for the same reason as migrations 1/2.
+    row = conn.execute(
+        "SELECT name FROM sqlite_master "
+        "WHERE type='table' AND name='model_usage'"
+    ).fetchone()
+    if row is None:
+        return  # fresh DB: create_all_tables builds the new shape
+    cur = conn.execute("SELECT * FROM model_usage LIMIT 0")
+    cols = {d[0] for d in cur.description}
+    if "tenant" not in cols:
+        conn.execute(
+            "ALTER TABLE model_usage ADD COLUMN tenant TEXT"
+        )
+    conn.execute(
+        "CREATE INDEX IF NOT EXISTS idx_model_usage_tenant "
+        "ON model_usage (tenant)"
+    )
 
 
 def run_migrations(db: Database) -> int:

@@ -26,7 +26,7 @@ from typing import (
 
 import pydantic
 
-from gpustack_tpu.orm import fencing
+from gpustack_tpu.orm import changelog, fencing
 from gpustack_tpu.orm.db import Database
 from gpustack_tpu.server.bus import Event, EventBus, EventType
 
@@ -273,6 +273,25 @@ class Record(pydantic.BaseModel):
         raise StaleEpochError(cls.__kind__, record_id, epoch, lease)
 
     @classmethod
+    def _append_change(
+        cls, conn, db, event_type: str, record_id: int,
+        changes_json=None,
+    ) -> None:
+        """Transactional replication (orm/changelog.py): when this
+        binding carries an HA origin identity, the change-log entry
+        commits WITH the data write — a SIGKILL between them is
+        impossible, which kills the PR 10 unflushed-outbox crash
+        window. Runs on the DB thread inside the write's open
+        transaction; a failure here rolls the data write back (the
+        caller's except path), never half-lands it."""
+        origin = getattr(db, "changelog_origin", "")
+        if origin:
+            changelog.append_change(
+                conn, origin, cls.__kind__, event_type, record_id,
+                changes_json,
+            )
+
+    @classmethod
     async def create(cls: Type[T], obj: T) -> T:
         obj.created_at = obj.created_at or _now()
         obj.updated_at = _now()
@@ -301,11 +320,17 @@ class Record(pydantic.BaseModel):
             params = params + [epoch]
 
         def go(conn):
-            cur, landed, lease = cls._guarded_execute(
-                conn, sql, params, epoch, 0
-            )
-            rowid = cur.lastrowid
-            conn.commit()
+            try:
+                cur, landed, lease = cls._guarded_execute(
+                    conn, sql, params, epoch, 0
+                )
+                rowid = cur.lastrowid
+                if landed:
+                    cls._append_change(conn, db, "CREATED", rowid)
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
             if not landed:
                 return ("fenced", lease)
             return ("ok", rowid)
@@ -338,26 +363,31 @@ class Record(pydantic.BaseModel):
         limit: Optional[int] = None,
         offset: int = 0,
         order_by: str = "id",
+        since_id: Optional[int] = None,
         **conds: Any,
     ) -> List[T]:
         """Filter by equality conditions. Index fields filter in SQL; other
-        fields post-filter in Python."""
+        fields post-filter in Python. ``since_id`` adds ``id > ?`` —
+        keyset pagination for full-table readers (client ``list_all``):
+        unlike OFFSET, a row deleted between pages cannot shift a live
+        row out of the result set."""
         sql_conds = {
             k: v for k, v in conds.items() if k in cls.__indexes__ or k == "id"
         }
         py_conds = {k: v for k, v in conds.items() if k not in sql_conds}
-        where = ""
+        parts: List[str] = []
         params: List[Any] = []
-        if sql_conds:
-            parts = []
-            for k, v in sql_conds.items():
-                if isinstance(v, (dict, list)):
-                    v = json.dumps(v)
-                elif v is not None and not isinstance(v, (str, int, float)):
-                    v = str(v)
-                parts.append(f"{k} = ?")
-                params.append(v)
-            where = " WHERE " + " AND ".join(parts)
+        for k, v in sql_conds.items():
+            if isinstance(v, (dict, list)):
+                v = json.dumps(v)
+            elif v is not None and not isinstance(v, (str, int, float)):
+                v = str(v)
+            parts.append(f"{k} = ?")
+            params.append(v)
+        if since_id is not None:
+            parts.append("id > ?")
+            params.append(int(since_id))
+        where = (" WHERE " + " AND ".join(parts)) if parts else ""
         sql = f"SELECT * FROM {cls.__kind__}{where} ORDER BY {order_by}"
         if limit is not None and not py_conds:
             sql += f" LIMIT {int(limit)} OFFSET {int(offset)}"
@@ -584,24 +614,39 @@ class Record(pydantic.BaseModel):
             where += f" AND {db.fence_guard()}"
             params = params + [epoch]
 
+        # replication diff encoded once, off the DB thread; only
+        # needed when this binding replicates at all
+        changes_json = (
+            changelog.encode_changes(changes)
+            if getattr(db, "changelog_origin", "") else None
+        )
+
         def go(conn):
-            cur, landed, lease = cls._guarded_execute(
-                conn,
-                f"UPDATE {cls.__kind__} SET data = ?, updated_at = ?, "
-                f"created_at = ?{idx_sets} {where}",
-                params, epoch, self.id,
-            )
-            if landed:
+            try:
+                cur, landed, lease = cls._guarded_execute(
+                    conn,
+                    f"UPDATE {cls.__kind__} SET data = ?, "
+                    f"updated_at = ?, created_at = ?{idx_sets} {where}",
+                    params, epoch, self.id,
+                )
+                if landed:
+                    cls._append_change(
+                        conn, db, "UPDATED", self.id, changes_json
+                    )
+                    conn.commit()
+                    return ("ok", cur.rowcount)
+                if epoch is not None and lease > epoch:
+                    conn.commit()
+                    return ("fenced", lease)
+                row = conn.execute(
+                    f"SELECT updated_at FROM {cls.__kind__} "
+                    "WHERE id = ?",
+                    (self.id,),
+                ).fetchone()
                 conn.commit()
-                return ("ok", cur.rowcount)
-            if epoch is not None and lease > epoch:
-                conn.commit()
-                return ("fenced", lease)
-            row = conn.execute(
-                f"SELECT updated_at FROM {cls.__kind__} WHERE id = ?",
-                (self.id,),
-            ).fetchone()
-            conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
             if row is None:
                 return ("missing", None)
             return ("conflict", row["updated_at"])
@@ -644,10 +689,16 @@ class Record(pydantic.BaseModel):
             params.append(epoch)
 
         def go(conn):
-            cur, landed, lease = cls._guarded_execute(
-                conn, sql, params, epoch, self.id
-            )
-            conn.commit()
+            try:
+                cur, landed, lease = cls._guarded_execute(
+                    conn, sql, params, epoch, self.id
+                )
+                if landed and cur.rowcount:
+                    cls._append_change(conn, db, "DELETED", self.id)
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
             if not landed and epoch is not None and lease > epoch:
                 return ("fenced", lease)
             return ("ok", cur.rowcount)

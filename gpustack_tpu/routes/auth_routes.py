@@ -650,17 +650,25 @@ def add_worker_facing_routes(app: web.Application) -> None:
             status = WorkerStatus.model_validate(body.get("status") or {})
         except pydantic.ValidationError as e:
             return json_error(400, f"invalid worker status: {e}")
-        buffer = request.app.get("status_buffer")
-        if buffer is not None:
-            # batched DB writes (reference worker_status_buffer.py);
-            # state transitions flush through immediately
-            await buffer.put(worker, status, auth_mod.time_iso_now())
-        else:
+        combiner = request.app.get("write_combiner")
+        now = auth_mod.time_iso_now()
+        if worker.state != WorkerState.READY or combiner is None:
+            # state TRANSITIONS write through immediately (a worker
+            # coming READY unblocks scheduling and must publish its
+            # watch event); steady-state refreshes coalesce below
             await worker.update(
                 status=status,
                 state=WorkerState.READY,
                 state_message="",
-                heartbeat_at=auth_mod.time_iso_now(),
+                heartbeat_at=now,
+            )
+        else:
+            # steady state: a set_field-shaped batched column write
+            # lands on the combiner's next flush — no event, no
+            # change-log entry, O(flushes) DB write rate at any fleet
+            # width (server/write_combiner.py)
+            combiner.offer_status(
+                worker.id, status.model_dump(mode="json"), now
             )
         return web.json_response({"ok": True})
 
@@ -674,20 +682,32 @@ def add_worker_facing_routes(app: web.Application) -> None:
         worker = await Worker.get(worker_id)
         if worker is None:
             return json_error(404, "worker not found")
-        updates = {"heartbeat_at": auth_mod.time_iso_now()}
+        now = auth_mod.time_iso_now()
         recovered = False
         if worker.state == WorkerState.UNREACHABLE:
             # tell the agent it was marked lost: its instances may be
             # parked UNREACHABLE server-side, and only the agent can
             # legally re-drive them — it reconciles on this flag
             # instead of waiting for a watch-stream RESYNC that never
-            # comes when the partition didn't break the TCP stream
-            updates["state"] = WorkerState.READY
+            # comes when the partition didn't break the TCP stream.
+            # Recovery is a state TRANSITION: write through (event-ful;
             # the syncer's "no heartbeat for Ns" annotation must not
-            # outlive the recovery it describes
-            updates["state_message"] = ""
+            # outlive the recovery it describes).
+            await worker.update(
+                heartbeat_at=now,
+                state=WorkerState.READY,
+                state_message="",
+            )
             recovered = True
-        await worker.update(**updates)
+        else:
+            combiner = request.app.get("write_combiner")
+            if combiner is None:
+                await worker.update(heartbeat_at=now)
+            else:
+                # steady-state liveness: coalesced column write (see
+                # post_status above) — at 1000 workers the heartbeat
+                # path costs ONE batched statement per flush interval
+                combiner.offer_heartbeat(worker.id, now)
         if not recovered:
             # LEVEL-triggered, not edge-: the READY flip happens once,
             # and if that one response is lost (client timeout after

@@ -125,25 +125,35 @@ def add_crud_routes(
             return await watch(request)
         filters = {}
         for key, value in request.query.items():
-            if key in ("limit", "offset", "watch"):
+            if key in ("limit", "offset", "watch", "since_id"):
                 continue
             if key in cls.model_fields:
                 filters[key] = value
         try:
             limit = int(request.query.get("limit", 100))
             offset = int(request.query.get("offset", 0))
+            # keyset cursor (id > since_id, id order): list_all pages
+            # with this instead of OFFSET so a row deleted between
+            # pages can never shift a live row out of the result set
+            since_id = request.query.get("since_id")
+            since_id = int(since_id) if since_id is not None else None
         except ValueError:
-            return json_error(400, "limit/offset must be integers")
+            return json_error(
+                400, "limit/offset/since_id must be integers"
+            )
         if visible is None:
             items = await cls.filter(
-                limit=limit, offset=offset, **filters
+                limit=limit, offset=offset, since_id=since_id,
+                **filters,
             )
             total = await cls.count(**filters)
         else:
             # tenancy filter BEFORE pagination: pages must be full and
             # total must count only what this principal can see (a global
             # total would leak the number of hidden cross-tenant records)
-            all_items = await cls.filter(limit=None, **filters)
+            all_items = await cls.filter(
+                limit=None, since_id=since_id, **filters
+            )
             kept = []
             for item in all_items:
                 if await visible(request, item):

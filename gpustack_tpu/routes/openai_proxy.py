@@ -174,7 +174,7 @@ def _qos_shed_response(model_name: str, decision) -> web.Response:
     )
 
 
-def _admit_tenant(request: web.Request, model_name: str, target):
+async def _admit_tenant(request: web.Request, model_name: str, target):
     """Tenant QoS admission for one inference request. Returns
     ``(lease, headers, owns_model_cap, shed_response)``: on admission
     the caller MUST release ``lease`` when the request fully completes
@@ -185,6 +185,10 @@ def _admit_tenant(request: web.Request, model_name: str, target):
     if tenancy is None:
         return None, {}, False, None
     spec = tenancy.spec_for_principal(request.get("principal"))
+    # first admission per tenant state: re-seed the rolling token
+    # budget from durable usage rows so a server restart does not
+    # reopen the window (one indexed SUM, then never again)
+    await tenancy.ensure_rehydrated(spec)
     # the fair-share pool keys on the RESOLVED serving identity, not
     # the route name: several routes aliasing one model must share one
     # admission pool, or each alias would admit a full cap of its own
@@ -579,6 +583,7 @@ async def _record_usage(
         await ModelUsage.create(
             ModelUsage(
                 user_id=user_id,
+                tenant=request.get("tenant") or "",
                 model_id=model_id,
                 provider_id=provider_id,
                 route_name=route_name,
@@ -797,7 +802,7 @@ def add_openai_routes(app: web.Application) -> None:
         # tenant QoS admission AFTER resolution (an unknown model stays
         # a 404, and per-model fair-share state keys on operator-
         # defined names, never raw client strings) and BEFORE any dial
-        lease, qos_headers, owns_cap, shed = _admit_tenant(
+        lease, qos_headers, owns_cap, shed = await _admit_tenant(
             request, str(name), target
         )
         if shed is not None:
@@ -1028,7 +1033,7 @@ def add_openai_routes(app: web.Application) -> None:
             return err
         if trace is not None:
             trace.model = name       # resolved: bounded cardinality
-        lease, qos_headers, owns_cap, shed = _admit_tenant(
+        lease, qos_headers, owns_cap, shed = await _admit_tenant(
             request, name, target
         )
         if shed is not None:
@@ -1146,7 +1151,7 @@ def add_openai_routes(app: web.Application) -> None:
             return err
         if trace is not None:
             trace.model = name       # resolved: bounded cardinality
-        lease, qos_headers, owns_cap, shed = _admit_tenant(
+        lease, qos_headers, owns_cap, shed = await _admit_tenant(
             request, name, target
         )
         if shed is not None:

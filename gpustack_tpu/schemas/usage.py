@@ -10,9 +10,13 @@ from gpustack_tpu.orm.record import Record, register_record
 @register_record
 class ModelUsage(Record):
     __kind__ = "model_usage"
-    __indexes__ = ("user_id", "model_id", "route_name")
+    __indexes__ = ("user_id", "model_id", "route_name", "tenant")
 
     user_id: int = 0
+    # QoS tenant identity (server/tenancy.py: key:<id> | user:<id> |
+    # worker:<id> | system) — indexed so the rolling token budget can
+    # rehydrate from durable rows after a restart (windowed sum)
+    tenant: str = ""
     model_id: int = 0
     # external-provider requests carry the provider id (model_id = 0)
     provider_id: int = 0

@@ -725,9 +725,25 @@ def create_app(cfg: Config) -> web.Application:
     # tenant QoS: per-key quotas, token budgets, weighted-fair
     # admission + priority shedding for the OpenAI surface
     # (server/tenancy.py; docs/TENANCY.md)
-    from gpustack_tpu.server.tenancy import TenancyRegistry
+    from gpustack_tpu.server.tenancy import (
+        TenancyRegistry,
+        durable_budget_spend,
+    )
 
     app["tenancy"] = TenancyRegistry.from_config(cfg)
+    # rolling token budgets survive restarts: the first admission per
+    # tenant re-seeds the window from durable model_usage rows (the
+    # PR 14 process-local-budget residual, closed)
+    app["tenancy"].rehydrator = durable_budget_spend
+
+    # control-plane write combiner: worker heartbeat/status writes
+    # coalesce into batched column writes so DB write rate grows
+    # sub-linearly in workers (server/write_combiner.py). Constructed
+    # per app — leader AND follower, heartbeats land wherever the load
+    # balancer sends them; the Server starts/drains its flush loop.
+    from gpustack_tpu.server.write_combiner import ControlWriteCombiner
+
+    app["write_combiner"] = ControlWriteCombiner.from_config(cfg)
 
     # shared client session for the OpenAI proxy
     async def on_startup(app: web.Application):

@@ -1,15 +1,16 @@
-"""Server-side collectors: worker-status write buffering + usage archival.
+"""Server-side collectors: lifecycle audit, load samples, usage archival.
 
 Reference parity:
-- ``WorkerStatusBuffer`` — server/worker_status_buffer.py: status POSTs
-  land in memory and a single flush loop batches them to the DB (direct
-  per-POST writes are fine at 3 workers, not at 300). State TRANSITIONS
-  (NOT_READY→READY) flush immediately so deploys stay snappy; steady-state
-  refreshes batch.
 - ``UsageArchiver`` — server/usage_archiver.py + TableArchiver: hot
   ``model_usage`` rows older than the retention window aggregate into
   daily ``usage_archive`` rows and are deleted (hot→cold archival keeps
   the request-rate table bounded).
+
+(The old ``WorkerStatusBuffer`` — reference worker_status_buffer.py —
+grew into the control write combiner, server/write_combiner.py: same
+batching idea, but set_field-shaped column writes, a deadline bound,
+and an overload-degradation ladder so DB write rate stays sub-linear
+at 1000+ workers.)
 """
 
 from __future__ import annotations
@@ -121,53 +122,6 @@ class DirtyTrackedTask(PeriodicTask):
         never acted on — mark everything dirty so the next tick runs."""
         if self._dirty is not None:
             self._dirty.mark_all()
-
-
-class WorkerStatusBuffer(PeriodicTask):
-    task_name = "status-buffer"
-
-    def __init__(self, flush_interval: float = 2.0):
-        super().__init__(flush_interval)
-        # worker_id -> (status, heartbeat_at)
-        self._pending: Dict[int, Tuple[object, str]] = {}
-
-    async def put(self, worker: Worker, status, heartbeat_at: str) -> None:
-        """Buffer a status refresh; flush immediately on a state
-        transition (a worker coming READY unblocks scheduling)."""
-        if worker.state != WorkerState.READY:
-            await worker.update(
-                status=status,
-                state=WorkerState.READY,
-                state_message="",
-                heartbeat_at=heartbeat_at,
-            )
-            self._pending.pop(worker.id, None)
-            return
-        self._pending[worker.id] = (status, heartbeat_at)
-
-    async def tick(self) -> None:
-        await self.flush()
-
-    @timed(threshold_s=2.0, name="collectors.status_buffer_flush")
-    async def flush(self) -> int:
-        pending, self._pending = self._pending, {}
-        flushed = 0
-        for worker_id, (status, heartbeat_at) in pending.items():
-            worker = await Worker.get(worker_id)
-            if worker is None:
-                continue
-            # guard against the snapshot race: a write-through update
-            # (state transition) or a newer heartbeat may have landed
-            # after this entry was buffered — never regress it
-            if worker.state != WorkerState.READY:
-                continue
-            if worker.heartbeat_at and worker.heartbeat_at >= heartbeat_at:
-                continue
-            await worker.update(
-                status=status, heartbeat_at=heartbeat_at
-            )
-            flushed += 1
-        return flushed
 
 
 @register_record

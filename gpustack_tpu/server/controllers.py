@@ -657,11 +657,24 @@ class WorkerController(Controller):
 
 
 class WorkerSyncer:
-    """Flip workers to UNREACHABLE when heartbeats go stale."""
+    """Flip workers to UNREACHABLE when heartbeats go stale.
 
-    def __init__(self, stale_after: float = 45.0, interval: float = 15.0):
+    ``freshness_source`` (worker_id -> newest heartbeat iso, or "") is
+    the write combiner's in-memory liveness map: a heartbeat this
+    server RECEIVED but has not yet flushed (coalescing debounce, or
+    the overload-degradation ladder deferring writes) must never read
+    as staleness — that is exactly the "DB slow ⇒ healthy instances
+    parked" failure mode the combiner exists to prevent."""
+
+    def __init__(
+        self,
+        stale_after: float = 45.0,
+        interval: float = 15.0,
+        freshness_source=None,
+    ):
         self.stale_after = stale_after
         self.interval = interval
+        self.freshness_source = freshness_source
         self._task: Optional[asyncio.Task] = None
 
     def start(self) -> None:
@@ -685,10 +698,17 @@ class WorkerSyncer:
     async def sync_once(self) -> None:
         now = datetime.datetime.now(datetime.timezone.utc)
         for worker in await Worker.filter(state=WorkerState.READY):
-            if not worker.heartbeat_at:
+            heartbeat_at = worker.heartbeat_at
+            if self.freshness_source is not None:
+                # in-memory liveness beats the (possibly deferred) DB
+                # column; ISO-8601 strings order lexicographically
+                fresh = self.freshness_source(worker.id) or ""
+                if fresh > heartbeat_at:
+                    heartbeat_at = fresh
+            if not heartbeat_at:
                 continue
             try:
-                last = datetime.datetime.fromisoformat(worker.heartbeat_at)
+                last = datetime.datetime.fromisoformat(heartbeat_at)
             except ValueError:
                 continue
             age = (now - last).total_seconds()

@@ -20,13 +20,16 @@ DB with three mechanisms:
   semantics — leader-only tasks must never split-brain); the default
   ``os._exit(1)`` is an injectable ``fatal_hook`` so the in-process
   chaos harness can assert the fatal path without dying with it.
-- **Change-log propagation**: every server appends its post-commit bus
-  events to a shared ``change_log`` table (id-only); every server tails
-  the others' entries each replication cycle and re-fetches the touched
-  rows, republishing full events on its local bus. Follower watch
-  fan-out stays O(events) instead of the old RESYNC-every-TTL/3 forced
-  re-list (O(tables) at scale), and the leader finally *hears* writes
-  that landed through a follower's API.
+- **Change-log propagation**: every Record write commits an entry into
+  the shared ``change_log`` table INSIDE its own transaction
+  (orm/changelog.py — a SIGKILL'd server loses zero committed events;
+  the old in-memory outbox survives only as a migration shim for
+  non-transactional bindings); every server tails the others' entries
+  each replication cycle and re-fetches the touched rows, republishing
+  full events on its local bus. Follower watch fan-out stays O(events)
+  instead of the old RESYNC-every-TTL/3 forced re-list (O(tables) at
+  scale), and the leader finally *hears* writes that landed through a
+  follower's API.
 
 Election observability: ``election_tap_hook`` (module-level, harness
 style like worker_request.rpc_fault_hook) receives every
@@ -73,13 +76,11 @@ default_fatal_hook: Callable[["LeaseCoordinator"], None] = _os_exit_fatal
 # to a RESYNC (re-list) instead of a fetch storm
 TAIL_BATCH = 1000
 
-# analytics/collector rows are written per-request or per-sweep and only
-# ever READ straight from the shared DB (usage queries, archiver) —
-# replicating them through the change log would make every proxied
-# request a cross-server event at exactly the scale HA exists for
-REPLICATION_SKIP_KINDS = frozenset({
-    "model_usage", "usage_archive", "resource_event", "system_load",
-})
+# the never-replicated kinds live with the transactional append logic
+# (orm/changelog.py); re-exported here for existing importers
+from gpustack_tpu.orm.changelog import (  # noqa: E402
+    REPLICATION_SKIP_KINDS,
+)
 
 
 class Coordinator(abc.ABC):
@@ -217,6 +218,7 @@ class LeaseCoordinator(Coordinator):
         self._prune_at = 0.0
 
     async def start(self) -> None:
+        from gpustack_tpu.orm.changelog import change_log_ddl
         from gpustack_tpu.orm.record import PK_CLAUSE
 
         await self.db.execute(
@@ -225,10 +227,7 @@ class LeaseCoordinator(Coordinator):
             "holder TEXT, expires_at REAL, epoch INTEGER DEFAULT 0)"
         )
         await self.db.execute(
-            "CREATE TABLE IF NOT EXISTS change_log ("
-            f"{PK_CLAUSE[self.db.dialect]}, "
-            "origin TEXT, kind TEXT, record_id INTEGER, "
-            "event_type TEXT, changes TEXT, created_at REAL)"
+            change_log_ddl(PK_CLAUSE[self.db.dialect])
         )
         # start tailing at the PRESENT: everything already in the DB is
         # covered by the initial list every watch/controller performs
@@ -236,6 +235,12 @@ class LeaseCoordinator(Coordinator):
             "SELECT COALESCE(MAX(id), 0) AS top FROM change_log"
         )
         self._last_seen = int(rows[0]["top"]) if rows else 0
+        # from here on, every Record write through this Database
+        # appends its change-log entry INSIDE its own transaction
+        # (orm/record.py _append_change) — a crashed process loses
+        # zero committed events; the bus tap below degrades to a
+        # post-commit no-op. Set only after the table exists.
+        self.db.changelog_origin = self.identity
         self._task = asyncio.create_task(self._loop(), name="coordinator")
         self._repl_task = asyncio.create_task(
             self._repl_loop(), name="coordinator-repl"
@@ -248,12 +253,9 @@ class LeaseCoordinator(Coordinator):
         # graceful shutdown hand leadership over only after a full TTL
         # instead of immediately
         await self._cancel_tasks()
-        # best-effort final flush: events enqueued within the last
-        # replication cycle have NO other path to peers (the periodic
-        # follower RESYNC is gone) — a graceful shutdown must not
-        # drop them. (A crashed process still loses its unflushed
-        # outbox; peers recover only when the rows are next touched —
-        # recorded as a residual limit.)
+        # migration-shim flush: with transactional appends the outbox
+        # is always empty (every committed write carried its own
+        # entry); legacy/non-transactional bindings still drain here
         try:
             await self._flush_outbox()
         except Exception:
@@ -451,9 +453,14 @@ class LeaseCoordinator(Coordinator):
     # ---- change-log replication --------------------------------------
 
     def publish_remote(self, event: Event) -> None:
-        """Append an id-only entry for peers to tail. Synchronous and
-        cheap (called from a bus tap inside publish); the replication
-        loop flushes to the shared DB."""
+        """Post-commit bus tap. With transactional change-log appends
+        active (``db.changelog_origin`` set in :meth:`start`), every
+        Record write already committed its own entry — this tap is a
+        no-op and the in-memory outbox below survives only as a
+        migration shim for bindings without transactional logging
+        (e.g. a plugin coordinator delegating here before start)."""
+        if getattr(self.db, "changelog_origin", ""):
+            return  # entry committed WITH the write; nothing to lose
         if self._republishing:
             return  # never re-log events we just tailed from a peer
         if event.type not in (

@@ -157,6 +157,11 @@ def add_metrics_route(app: web.Application) -> None:
                 "gpustack_ha_fenced_writes_total "
                 f"{fencing.fenced_writes_total()}",
             ]
+        # control-plane write combiner: pressure ladder + coalescing
+        # counters (server/write_combiner.py)
+        combiner = request.app.get("write_combiner")
+        if combiner is not None:
+            obs_lines += combiner.metrics_lines()
         # SLO engine gauges (compliance / burn rate / alert state) —
         # in-memory judgment over the series above, appended uncached
         slo = request.app.get("slo")

@@ -153,6 +153,10 @@ class Server:
         self.syncer = WorkerSyncer(
             stale_after=cfg.heartbeat_interval * 4.5,
             interval=cfg.heartbeat_interval,
+            # degraded-mode safety: heartbeats this server has SEEN but
+            # not yet flushed must never read as stale (the combiner's
+            # in-memory freshness map is ahead of the DB by design)
+            freshness_source=app["write_combiner"].freshness_for,
         )
         self.rescuer = InstanceRescuer(
             grace=cfg.unreachable_rescue_after,
@@ -163,12 +167,14 @@ class Server:
             ResourceEventLogger,
             SystemLoadCollector,
             UsageArchiver,
-            WorkerStatusBuffer,
         )
 
-        self.status_buffer = WorkerStatusBuffer()
-        self.status_buffer.start()
-        app["status_buffer"] = self.status_buffer
+        # heartbeat/status write combiner (constructed in create_app so
+        # unit mounts have the debug/metrics surface): flushes on every
+        # server, leader or follower — heartbeats land wherever the
+        # load balancer sends them
+        self.write_combiner = app["write_combiner"]
+        self.write_combiner.start()
         # reload-config propagates rotated tokens/URLs into controllers
         # that copied them at construction (routes/extras.py)
         app["controllers"] = self.controllers
@@ -303,8 +309,16 @@ class Server:
             self.syncer.stop()
         if hasattr(self, "rescuer"):
             self.rescuer.stop()
-        if hasattr(self, "status_buffer"):
-            self.status_buffer.stop()
+        if hasattr(self, "write_combiner"):
+            # shared drain contract: buffered heartbeat/status writes
+            # land now or fail LOUDLY with the same typed error a
+            # write queued behind Database.close() gets
+            try:
+                await self.write_combiner.drain()
+            except Exception:
+                logger.exception(
+                    "write combiner drain dropped buffered writes"
+                )
         if hasattr(self, "usage_archiver"):
             self.usage_archiver.stop()
         if hasattr(self, "update_checker"):

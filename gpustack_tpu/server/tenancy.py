@@ -194,7 +194,7 @@ class _TenantState:
     __slots__ = (
         "spec", "bucket", "budget", "inflight", "per_model",
         "admitted_total", "shed_total", "shed_by_reason",
-        "tokens_total", "last_seen", "named",
+        "tokens_total", "last_seen", "named", "rehydrated",
     )
 
     def __init__(self, spec: TenantSpec, now: float):
@@ -213,6 +213,9 @@ class _TenantState:
         # tenant moving between the named set and the "_other" rollup
         # would make the rollup counter non-monotonic)
         self.named = False
+        # budget seeded from durable usage rows (once per state; see
+        # TenancyRegistry.ensure_rehydrated)
+        self.rehydrated = False
 
 
 class _Lease:
@@ -232,6 +235,43 @@ class _Lease:
         if not self._done:
             self._done = True
             self._registry._end(self.tenant, self.model)
+
+
+async def durable_budget_spend(tenant: str, window_s: float):
+    """The default rehydrator: windowed SUM over durable
+    ``model_usage`` rows for one tenant — the same rows that are
+    billing truth for ``/v2/usage/summary``, so enforcement and
+    metering agree across restarts. Returns ``(spent_tokens,
+    age_of_oldest_row_s)`` or None when the tenant has no in-window
+    history (or no Record binding exists — bare unit mounts)."""
+    import datetime
+
+    from gpustack_tpu.orm.record import Record
+
+    try:
+        db = Record.db()
+    except AssertionError:
+        return None
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cutoff = (
+        now - datetime.timedelta(seconds=max(1.0, window_s))
+    ).isoformat()
+    rows = await db.execute(
+        "SELECT COALESCE(SUM("
+        f"{db.json_num('total_tokens')}), 0) AS tok, "
+        "MIN(created_at) AS first FROM model_usage "
+        "WHERE tenant = ? AND created_at >= ?",
+        (tenant, cutoff),
+    )
+    if not rows or not rows[0]["first"]:
+        return None
+    spent = int(rows[0]["tok"] or 0)
+    try:
+        first = datetime.datetime.fromisoformat(rows[0]["first"])
+        age = max(0.0, (now - first).total_seconds())
+    except ValueError:
+        age = 0.0
+    return spent, age
 
 
 class TenancyRegistry:
@@ -269,6 +309,18 @@ class TenancyRegistry:
         )
         # model name -> {tenant id -> in-flight} (live entries only)
         self._model_inflight: Dict[str, Dict[str, int]] = {}
+        # durable-budget rehydration (PR 14 residual closed): an async
+        # callable ``(tenant_id, window_s) -> (spent, age_s) | None``
+        # consulted ONCE per tenant state before its first admission,
+        # so a server restart re-seeds the rolling window from the
+        # durable ``model_usage`` rows instead of reopening every
+        # tenant's budget (see :func:`durable_budget_spend`)
+        self.rehydrator = None
+        self.rehydrated_tenants = 0
+        # tenant id -> future resolved when its in-flight rehydration
+        # read completes: concurrent first requests WAIT instead of
+        # admitting against a still-unseeded budget
+        self._rehydrating: Dict[str, object] = {}
         self.evictions = 0
         # /metrics export state: the first metrics_max_series tenants
         # get their own labeled series (sticky); everyone else rolls
@@ -401,6 +453,90 @@ class TenancyRegistry:
         )
         burst = spec.burst if spec.burst > 0 else self.default_burst
         return rps, conc, budget, burst
+
+    # ---- durable-budget rehydration --------------------------------------
+
+    async def ensure_rehydrated(
+        self, spec: TenantSpec, now: Optional[float] = None
+    ) -> None:
+        """Seed a fresh tenant state's rolling budget from durable
+        usage rows (once per state). Without this, a server restart
+        zeroed every tenant's in-window spend — a client that had just
+        exhausted its budget got a whole new window for free. Failures
+        are logged and skipped (enforcement degrades open, billing
+        truth stays in the rows)."""
+        import asyncio
+
+        now = self._clock() if now is None else now
+        st = self._state(spec, now)
+        if self.rehydrator is None or self._effective(spec)[2] <= 0:
+            st.rehydrated = True
+            return
+        while not st.rehydrated:
+            pending = self._rehydrating.get(spec.tenant)
+            if pending is not None:
+                # another request is mid-read for this tenant: WAIT
+                # (marking rehydrated before the read completed would
+                # let concurrent first requests admit against an
+                # unseeded budget — the free window the seed closes);
+                # loop afterwards: the owner may have been CANCELLED,
+                # in which case this waiter becomes the owner
+                await pending
+                continue
+            fut = asyncio.get_running_loop().create_future()
+            self._rehydrating[spec.tenant] = fut
+            try:
+                result = await self._rehydrate_locked(spec, st, now)
+            except BaseException:
+                # cancellation (client disconnect mid-DB-read) must
+                # NOT burn the once-only flag: the seed was never
+                # applied, so the NEXT request retries it
+                self._rehydrating.pop(spec.tenant, None)
+                if not fut.done():
+                    fut.set_result(None)
+                raise
+            # once per state on COMPLETION, success or failed read (a
+            # broken rehydrator is not retried per request)
+            st.rehydrated = True
+            self._rehydrating.pop(spec.tenant, None)
+            if not fut.done():
+                fut.set_result(None)
+            if result:
+                self.rehydrated_tenants += 1
+
+    async def _rehydrate_locked(
+        self, spec: TenantSpec, st: "_TenantState", now: float
+    ) -> bool:
+        window = (
+            spec.budget_window_s
+            if spec.budget_window_s > 0 else self.budget_window_s
+        )
+        try:
+            result = await self.rehydrator(spec.tenant, window)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "budget rehydration failed for %s", spec.tenant
+            )
+            return False
+        if not result:
+            return False
+        spent, age = result
+        if spent <= 0:
+            return False
+        if st.budget is None:
+            st.budget = RollingBudget(window)
+        # the window re-opens where the oldest surviving in-window row
+        # says it did (capped just under one window so the seed cannot
+        # immediately roll over; floored above zero — the monotonic
+        # clock may be younger than the durable history)
+        st.budget.window_start = max(
+            1e-9, now - min(max(0.0, age), window * 0.999)
+        )
+        st.budget.spent = 0
+        st.budget.record(int(spent), now)
+        return True
 
     # ---- admission -------------------------------------------------------
 

@@ -1,0 +1,403 @@
+"""Control-plane write combiner: heartbeat/status writes that scale
+sub-linearly in workers.
+
+The reference architecture's control plane melts exactly here (SURVEY
+§1 state layer): every worker heartbeat and status refresh used to be
+its own read-modify-write cycle — a ``Worker.get`` + a whole-document
+CAS ``update`` + a bus event — so DB write rate (and watch fan-out)
+grew linearly in fleet width. At 1000+ workers that is thousands of
+transactions per flush interval for data nobody watches.
+
+:class:`ControlWriteCombiner` replaces that path on EVERY server
+(leader and follower — heartbeats land wherever the load balancer
+sends them):
+
+- **Debounced coalescing**: heartbeat and status refreshes buffer in
+  memory per worker (newest wins) and flush on a fixed cadence
+  (``control_flush_interval``). One flush issues at most TWO batched
+  statements (one ``executemany`` for liveness-only entries, one for
+  status refreshes) inside ONE transaction — DB write rate per second
+  is O(flushes), not O(workers).
+- **``Record.set_field``-shaped column writes**: the flush targets the
+  ``heartbeat_at``/``status`` document fields via the per-dialect
+  ``json_set`` helpers, bumps ``updated_at`` (column + document, so
+  whole-document CAS saves still conflict instead of silently
+  reverting), publishes NO bus event, and appends NO change-log entry
+  — liveness is read from the shared DB, never replicated. A guard
+  clause (``heartbeat_at`` strictly newer) makes a late flush unable
+  to regress a write-through state transition's fresher timestamp.
+- **Deadline bound**: every buffered status write lands within
+  ``control_write_deadline`` seconds of being offered, degraded mode
+  included.
+- **Overload degradation** (the ladder): when the buffered queue or
+  the last flush's latency crosses its watermark
+  (``control_queue_watermark`` / ``control_latency_watermark``),
+  ``write_pressure`` reaches 1.0 and the combiner degrades to
+  **liveness-only** — heartbeat timestamps still land (tiny, one
+  batched statement) while status-document writes defer until
+  pressure clears or their deadline expires. Freshness is always
+  tracked in memory (:meth:`freshness_for`), and the WorkerSyncer
+  consults THIS server's map, so a heartbeat the leader received is
+  never read as stale just because the DB is slow.
+  ``gpustack_control_write_pressure`` exports the ladder's position.
+  Scope honesty: the freshness shield is per-server. In HA, a
+  heartbeat routed to a FOLLOWER reaches the leader's syncer only via
+  the follower's flushed liveness row — which keeps landing every
+  flush interval even degraded, so the exposure narrows to a DB that
+  accepts reads while rejecting writes cluster-wide for most of the
+  staleness budget (recorded residual: a peer-freshness query would
+  close it).
+- **Shared drain contract** (orm/db.py :class:`DatabaseClosedError`):
+  a write offered behind shutdown — or a final drain racing a closed
+  Database — fails LOUDLY with the same typed error the Database's
+  own queue uses; nothing is ever silently dropped.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+from gpustack_tpu.orm.db import DatabaseClosedError
+from gpustack_tpu.server.collectors import PeriodicTask
+from gpustack_tpu.utils.profiling import timed
+
+
+class ControlWriteCombiner(PeriodicTask):
+    task_name = "control-write-combiner"
+
+    def __init__(
+        self,
+        flush_interval: float = 2.0,
+        deadline: float = 10.0,
+        queue_watermark: int = 4096,
+        latency_watermark: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        super().__init__(max(0.05, flush_interval))
+        self.deadline = max(flush_interval, deadline)
+        self.queue_watermark = max(1, int(queue_watermark))
+        self.latency_watermark = max(0.01, float(latency_watermark))
+        self._clock = clock
+        self.closed = False
+        # worker_id -> newest heartbeat iso awaiting flush
+        self._hb: Dict[int, str] = {}
+        # worker_id -> (status json-able doc, heartbeat iso, offered_at)
+        self._status: Dict[int, Tuple[dict, str, float]] = {}
+        # worker_id -> newest heartbeat iso EVER offered: the in-memory
+        # liveness truth the WorkerSyncer consults so degraded-mode
+        # deferral can never park a healthy worker
+        self._freshness: Dict[int, str] = {}
+        self._last_flush_s = 0.0
+        self.coalesced: Dict[str, int] = {"heartbeat": 0, "status": 0}
+        self.flushed: Dict[str, int] = {"heartbeat": 0, "status": 0}
+        self.deferred_total = 0
+        self.degraded_flushes = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "ControlWriteCombiner":
+        # the flush cadence must comfortably outpace the syncer's
+        # staleness budget (4.5 × heartbeat_interval): a combiner that
+        # flushes slower than workers heartbeat would itself make
+        # fresh heartbeats read stale from the DB
+        flush = min(
+            float(getattr(cfg, "control_flush_interval", 2.0)),
+            float(getattr(cfg, "heartbeat_interval", 10.0)),
+        )
+        return cls(
+            flush_interval=flush,
+            deadline=float(
+                getattr(cfg, "control_write_deadline", 10.0)
+            ),
+            queue_watermark=int(
+                getattr(cfg, "control_queue_watermark", 4096)
+            ),
+            latency_watermark=float(
+                getattr(cfg, "control_latency_watermark", 1.0)
+            ),
+        )
+
+    # ---- offer side (request handlers; sync + cheap) -----------------
+
+    def _check_open(self) -> None:
+        if self.closed:
+            # the shared drain contract: work offered behind shutdown
+            # fails loudly to its caller, exactly like a write queued
+            # behind Database.close()
+            raise DatabaseClosedError("control write combiner")
+
+    def offer_heartbeat(self, worker_id: int, heartbeat_at: str) -> None:
+        """Buffer one liveness write (newest wins per worker)."""
+        self._check_open()
+        worker_id = int(worker_id)
+        pending = self._status.get(worker_id)
+        if pending is not None:
+            # a status write is already queued for this worker and will
+            # carry liveness: advance ITS timestamp instead of queueing
+            # a plain heartbeat the flush would discard as subsumed —
+            # the DB must land the NEWEST liveness either way
+            doc, hb, offered = pending
+            if heartbeat_at > hb:
+                self._status[worker_id] = (
+                    doc, heartbeat_at, offered
+                )
+            self.coalesced["heartbeat"] += 1
+            self._note_fresh(worker_id, heartbeat_at)
+            return
+        if worker_id in self._hb:
+            self.coalesced["heartbeat"] += 1
+        if heartbeat_at > self._hb.get(worker_id, ""):
+            self._hb[worker_id] = heartbeat_at
+        self._note_fresh(worker_id, heartbeat_at)
+
+    def offer_status(
+        self, worker_id: int, status_doc: dict, heartbeat_at: str
+    ) -> None:
+        """Buffer one status refresh (carries liveness too)."""
+        self._check_open()
+        worker_id = int(worker_id)
+        if worker_id in self._status:
+            self.coalesced["status"] += 1
+            offered = self._status[worker_id][2]
+        else:
+            offered = self._clock()
+        self._status[worker_id] = (status_doc, heartbeat_at, offered)
+        # a pending plain heartbeat is subsumed: the status write lands
+        # heartbeat_at as well
+        self._hb.pop(worker_id, None)
+        self._note_fresh(worker_id, heartbeat_at)
+
+    def _note_fresh(self, worker_id: int, heartbeat_at: str) -> None:
+        prior = self._freshness.get(worker_id, "")
+        if heartbeat_at > prior:
+            self._freshness[worker_id] = heartbeat_at
+
+    def freshness_for(self, worker_id: int) -> str:
+        """Newest heartbeat this SERVER has seen for the worker —
+        in-memory, ahead of (or equal to) whatever the DB holds."""
+        return self._freshness.get(int(worker_id), "")
+
+    # ---- pressure ladder ---------------------------------------------
+
+    def queue_depth(self) -> int:
+        return len(self._hb) + len(self._status)
+
+    def write_pressure(self) -> float:
+        """0 = idle; >= 1.0 = degraded (liveness-only flushes)."""
+        return max(
+            self.queue_depth() / self.queue_watermark,
+            self._last_flush_s / self.latency_watermark,
+        )
+
+    @property
+    def degraded(self) -> bool:
+        return self.write_pressure() >= 1.0
+
+    # ---- flush side ---------------------------------------------------
+
+    def _requeue(
+        self,
+        statuses: Dict[int, Tuple[dict, str, float]],
+        heartbeats: Dict[int, str],
+    ) -> None:
+        """Put a swapped-out (but unlanded) batch back — never
+        clobbering anything NEWER offered while the flush was in
+        flight. One home for both failure paths (unbound mount,
+        failed DB run) so the newest-wins rules can't diverge."""
+        for wid, entry in statuses.items():
+            self._status.setdefault(wid, entry)
+        for wid, hb in heartbeats.items():
+            if wid not in self._status and hb > self._hb.get(wid, ""):
+                self._hb[wid] = hb
+
+    async def tick(self) -> None:
+        await self.flush()
+
+    @timed(threshold_s=2.0, name="write_combiner.flush")
+    async def flush(self, force: bool = False) -> Tuple[int, int]:
+        """Flush buffered writes; returns (heartbeats, statuses)
+        landed. Degraded mode defers status documents that are still
+        inside their deadline; liveness always lands. ``force`` skips
+        the degradation deferral (the shutdown drain)."""
+        from gpustack_tpu.orm.record import Record, _now
+
+        now_mono = self._clock()
+        degraded = self.degraded and not force
+        statuses, self._status = self._status, {}
+        if degraded and statuses:
+            self.degraded_flushes += 1
+            keep: Dict[int, Tuple[dict, str, float]] = {}
+            flush_now: Dict[int, Tuple[dict, str, float]] = {}
+            for wid, entry in statuses.items():
+                # the deadline bound survives degradation: an entry
+                # due now lands even under pressure
+                if now_mono - entry[2] >= self.deadline - self.interval:
+                    flush_now[wid] = entry
+                else:
+                    keep[wid] = entry
+            self.deferred_total += len(keep)
+            for wid, entry in keep.items():
+                self._status.setdefault(wid, entry)
+                # its liveness half still lands this flush
+                self._hb.setdefault(wid, entry[1])
+            statuses = flush_now
+        heartbeats, self._hb = self._hb, {}
+        # a status row that also re-buffered a liveness write above
+        # must not double-write
+        for wid in statuses:
+            heartbeats.pop(wid, None)
+        if not heartbeats and not statuses:
+            self._last_flush_s = 0.0
+            return (0, 0)
+
+        try:
+            db = Record.db()
+        except AssertionError:
+            # unbound test mount: drop is impossible to act on — put
+            # the work back and report pressure honestly
+            self._requeue(statuses, heartbeats)
+            return (0, 0)
+        from gpustack_tpu.schemas import Worker
+
+        table = Worker.__kind__
+        now = _now()
+        import json as _json
+
+        now_json = _json.dumps(now)
+        # <=, not <: a worker whose liveness already landed at this
+        # exact timestamp (a deferred status's heartbeat half flushed
+        # one interval earlier) must still take its status document;
+        # only a STRICTLY newer write-through timestamp blocks us
+        hb_guard = (
+            f"COALESCE({db.json_text('heartbeat_at')}, '') <= ?"
+        )
+        # liveness-only writer: nested per-dialect setters target the
+        # heartbeat_at field and the document's updated_at; binds in
+        # textual order: inner value first, then the timestamp, then
+        # the column, id, guard
+        hb_setter = db.json_set(
+            "updated_at", col=db.json_set("heartbeat_at")
+        )
+        hb_sql = (
+            f"UPDATE {table} SET data = {hb_setter}, updated_at = ? "
+            f"WHERE id = ? AND {hb_guard}"
+        )
+        hb_rows: List[Tuple] = [
+            (_json.dumps(hb), now_json, now, wid, hb)
+            for wid, hb in heartbeats.items()
+        ]
+        st_setter = db.json_set(
+            "updated_at",
+            col=db.json_set("heartbeat_at", col=db.json_set("status")),
+        )
+        st_sql = (
+            f"UPDATE {table} SET data = {st_setter}, updated_at = ? "
+            f"WHERE id = ? AND {hb_guard}"
+        )
+        st_rows: List[Tuple] = [
+            (
+                _json.dumps(status_doc), _json.dumps(hb), now_json,
+                now, wid, hb,
+            )
+            for wid, (status_doc, hb, _offered) in statuses.items()
+        ]
+
+        def go(conn):
+            try:
+                if hb_rows:
+                    conn.executemany(hb_sql, hb_rows)
+                if st_rows:
+                    conn.executemany(st_sql, st_rows)
+                conn.commit()
+            except BaseException:
+                conn.rollback()
+                raise
+            return (len(hb_rows), len(st_rows))
+
+        t0 = time.monotonic()
+        try:
+            counts = await db.run(go)
+        except BaseException:
+            # ANY failed flush (a closed DB's typed drain error, lock
+            # contention, disk I/O) re-buffers its batch so nothing is
+            # silently dropped and deadlines keep counting from the
+            # original offer; the error itself propagates loudly
+            # (run-loop log / drain() caller)
+            self._requeue(statuses, heartbeats)
+            raise
+        self._last_flush_s = time.monotonic() - t0
+        self.flushed["heartbeat"] += counts[0]
+        self.flushed["status"] += counts[1]
+        # the in-memory freshness map tracks every worker ever seen:
+        # keep it bounded against churned fleets (dead workers' entries
+        # serve nothing once the syncer has parked them)
+        cap = 4 * self.queue_watermark
+        if len(self._freshness) > cap:
+            doomed = sorted(
+                self._freshness, key=self._freshness.get
+            )[: len(self._freshness) - cap]
+            for wid in doomed:
+                self._freshness.pop(wid, None)
+        return counts
+
+    async def drain(self) -> None:
+        """Final flush at shutdown. Everything still buffered either
+        lands now or surfaces as :class:`DatabaseClosedError` — the
+        one loud way a queued write behind shutdown may end."""
+        self.closed = True
+        self.stop()
+        await self.flush(force=True)
+        if self.queue_depth():
+            raise DatabaseClosedError(
+                f"control write combiner ({self.queue_depth()} "
+                "buffered writes undrained)"
+            )
+
+    # ---- observability -------------------------------------------------
+
+    def metrics_lines(self) -> List[str]:
+        from gpustack_tpu.observability.metrics import METRIC_FAMILIES
+
+        lines = [
+            "# TYPE gpustack_control_write_pressure "
+            f"{METRIC_FAMILIES['gpustack_control_write_pressure']}",
+            f"gpustack_control_write_pressure "
+            f"{self.write_pressure():.6f}",
+            "# TYPE gpustack_control_coalesced_writes_total "
+            f"{METRIC_FAMILIES['gpustack_control_coalesced_writes_total']}",
+        ]
+        for kind in ("heartbeat", "status"):
+            lines.append(
+                "gpustack_control_coalesced_writes_total"
+                f'{{kind="{kind}"}} {self.coalesced[kind]}'
+            )
+        lines += [
+            "# TYPE gpustack_control_flushed_writes_total "
+            f"{METRIC_FAMILIES['gpustack_control_flushed_writes_total']}",
+        ]
+        for kind in ("heartbeat", "status"):
+            lines.append(
+                "gpustack_control_flushed_writes_total"
+                f'{{kind="{kind}"}} {self.flushed[kind]}'
+            )
+        lines += [
+            "# TYPE gpustack_control_deferred_writes_total "
+            f"{METRIC_FAMILIES['gpustack_control_deferred_writes_total']}",
+            "gpustack_control_deferred_writes_total "
+            f"{self.deferred_total}",
+        ]
+        return lines
+
+    def snapshot(self) -> Dict:
+        """Triage view (debug surfaces / tests)."""
+        return {
+            "queue_depth": self.queue_depth(),
+            "pressure": round(self.write_pressure(), 6),
+            "degraded": self.degraded,
+            "coalesced": dict(self.coalesced),
+            "flushed": dict(self.flushed),
+            "deferred_total": self.deferred_total,
+            "degraded_flushes": self.degraded_flushes,
+            "last_flush_s": round(self._last_flush_s, 6),
+            "tracked_workers": len(self._freshness),
+        }

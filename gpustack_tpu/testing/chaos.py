@@ -96,6 +96,26 @@ HA_FAULT_KINDS = (
     "lease_expire",
 )
 
+# fleet-scale control-plane faults (ISSUE 15): multi-server, aimed at
+# the election/replication machinery under churn rather than at one
+# leader
+#   * acquire_storm          — STORM_CONTENDERS ephemeral lease
+#     contenders (own Database handles on the shared file) hammer the
+#     leadership row for a few TTLs, stealing any lapsed lease and
+#     releasing gracefully when the storm ends; judged by the same
+#     election-history invariant (one winner per epoch, zero overlap)
+#   * rolling_server_restart — every alive server gracefully restarts
+#     one-by-one under live stub traffic (the production rolling
+#     deploy): leadership hands over without a leaderless gap > 3×TTL,
+#     replication resumes, and every committed write survives
+SCALE_FAULT_KINDS = (
+    "acquire_storm",
+    "rolling_server_restart",
+)
+
+# contenders per acquire_storm op ("8-way lease storms")
+STORM_CONTENDERS = 8
+
 # disaggregated-serving faults: require a role-tagged (prefill/decode)
 # deployment — kept out of FAULT_KINDS so plain classes never draw one
 #   * kv_handoff_abort — a real proxied request routes through the
@@ -148,11 +168,15 @@ FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "ha-failover": HA_FAULT_KINDS,
     "kv-handoff": DISAGG_FAULT_KINDS,
     "noisy-neighbor": TENANT_FAULT_KINDS,
+    "acquire-storm": ("acquire_storm",),
+    "rolling-server-restart": SCALE_FAULT_KINDS,
     "mixed": FAULT_KINDS,
 }
 
 # classes that need more than one server to mean anything
-MULTI_SERVER_CLASSES = {"ha-failover"}
+MULTI_SERVER_CLASSES = {
+    "ha-failover", "acquire-storm", "rolling-server-restart",
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,6 +260,7 @@ class StubWorker:
         chips: int = 8,
         heartbeat_interval: float = 0.25,
         start_delay: float = 0.08,
+        serve_http: bool = True,
     ):
         self.server_url = server_url
         self.registration_token = registration_token
@@ -243,6 +268,12 @@ class StubWorker:
         self.chips = chips
         self.heartbeat_interval = heartbeat_interval
         self.start_delay = start_delay
+        # lite mode (1000+-worker scale suites): skip the per-stub
+        # aiohttp reverse-proxy server — the control-plane paths under
+        # measurement (registration, heartbeats, status, watch,
+        # lifecycle writes) never dial the worker, and a thousand
+        # AppRunners would measure the harness, not the server
+        self.serve_http = serve_http
 
         self.worker_id = 0
         self.proxy_secret = ""
@@ -283,6 +314,13 @@ class StubWorker:
     # ---- lifecycle ---------------------------------------------------
 
     async def start(self) -> None:
+        if self.serve_http:
+            await self._start_http()
+        else:
+            self.port = 1  # lite mode: nothing ever dials a stub
+        await self._register_and_run()
+
+    async def _start_http(self) -> None:
         from aiohttp import web
 
         app = web.Application()
@@ -416,6 +454,7 @@ class StubWorker:
             self.port = sock.getsockname()[1]
             break
 
+    async def _register_and_run(self) -> None:
         anon = ClientSet(self.server_url)
         try:
             deadline = asyncio.get_running_loop().time() + 30.0
@@ -690,7 +729,7 @@ class StubWorker:
 
     async def _reconcile_locked(self) -> None:
         try:
-            items = await self.client.list("model-instances")
+            items = await self.client.list_all("model-instances")
         except CLIENT_ERRORS:
             return
         mine = set()
@@ -813,6 +852,8 @@ class ChaosHarness:
         stuck_bound: float = 15.0,
         start_delay: float = 0.08,
         extra_cfg: Optional[Dict] = None,
+        stub_http: bool = True,
+        stub_boot_concurrency: int = 1,
     ):
         self.data_dir = str(data_dir)
         # extra Config fields merged over the harness defaults (e.g.
@@ -828,6 +869,11 @@ class ChaosHarness:
         self.rescue_grace = rescue_grace
         self.stuck_bound = stuck_bound
         self.start_delay = start_delay
+        self.stub_http = stub_http
+        self.stub_boot_concurrency = max(1, stub_boot_concurrency)
+        # live acquire-storm contenders: (coordinator, database) pairs
+        # torn down at stop() if a schedule ends mid-storm
+        self._storm: List[Tuple] = []
 
         self.servers: List = []
         self.cfgs: List[Config] = []
@@ -948,11 +994,24 @@ class ChaosHarness:
                 chips=self.chips,
                 heartbeat_interval=self.heartbeat_interval,
                 start_delay=self.start_delay,
+                serve_http=self.stub_http,
             )
             for i in range(self.n_workers)
         ]
-        for stub in self.stubs:
-            await stub.start()
+        if self.stub_boot_concurrency <= 1:
+            for stub in self.stubs:
+                await stub.start()
+        else:
+            # fleet-width boots (the 1000-worker suite) register in
+            # bounded parallel — sequential registration would make
+            # harness boot time the thing under test
+            sem = asyncio.Semaphore(self.stub_boot_concurrency)
+
+            async def boot(stub: StubWorker) -> None:
+                async with sem:
+                    await stub.start()
+
+            await asyncio.gather(*(boot(s) for s in self.stubs))
         await self._wait_workers_ready()
         self._monitor_task = asyncio.create_task(
             self._monitor(), name="chaos-monitor"
@@ -972,6 +1031,8 @@ class ChaosHarness:
             self._saved_hooks = None
         if self._monitor_task:
             self._monitor_task.cancel()
+        for pair in list(self._storm):
+            await self._stop_contender(pair)
         for t in self._restores:
             t.cancel()
         for stub in self.stubs:
@@ -1060,7 +1121,7 @@ class ChaosHarness:
     async def _wait_workers_ready(self, timeout: float = 20.0) -> None:
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
-            workers = await self.admin.list("workers")
+            workers = await self.admin.list_all("workers")
             ready = [w for w in workers if w["state"] == "ready"]
             if len(ready) >= self.n_workers:
                 return
@@ -1216,6 +1277,10 @@ class ChaosHarness:
             self._restore_later(
                 self.ha_ttl * 1.6 + op.arg, coord.hang_gate.set
             )
+        elif op.kind == "acquire_storm":
+            await self._acquire_storm(op)
+        elif op.kind == "rolling_server_restart":
+            await self._rolling_server_restart(op)
         elif op.kind == "kv_handoff_abort":
             await self._kv_handoff_abort(op)
         elif op.kind == "tenant_flood":
@@ -1255,6 +1320,76 @@ class ChaosHarness:
         else:
             raise ValueError(f"unknown chaos op kind {op.kind!r}")
 
+    async def _acquire_storm(self, op: ChaosOp) -> None:
+        """STORM_CONTENDERS ephemeral lease contenders (each on its own
+        Database handle against the shared file) hammer the leadership
+        row for ~2 TTLs. While a real leader renews they only exercise
+        the contention path; any lapsed lease (a restart window, a
+        prior kill) they may legitimately steal — and release
+        gracefully when the storm ends, so a real server re-acquires
+        within one poll. The lossless election tap judges every
+        acquisition: exactly one winner per epoch, zero overlapping
+        leases, no leaderless gap > 3×TTL."""
+        from gpustack_tpu.orm.db import Database
+        from gpustack_tpu.server.coordinator import LeaseCoordinator
+
+        srv = self.server
+        if srv is None or self.n_servers < 2:
+            self.skipped_ops.append(op)
+            return
+        path = srv.cfg.database_path
+        storm: List[Tuple] = []
+        stamp = f"{op.at:.3f}".replace(".", "_")
+        for i in range(STORM_CONTENDERS):
+            db = Database(path)
+            coord = LeaseCoordinator(
+                db,
+                identity=f"storm-{stamp}-{i}",
+                ttl=self.ha_ttl,
+                # a deposed contender just stops contending — it owns
+                # no leader tasks to split-brain
+                fatal_hook=lambda _c: None,
+            )
+            storm.append((coord, db))
+        self._storm.extend(storm)
+        try:
+            for coord, _db in storm:
+                await coord.start()
+            await asyncio.sleep(self.ha_ttl * 2 + op.arg)
+        finally:
+            for pair in storm:
+                await self._stop_contender(pair)
+
+    async def _stop_contender(self, pair) -> None:
+        coord, db = pair
+        if pair in self._storm:
+            self._storm.remove(pair)
+        try:
+            # graceful stop EXPIRES a held lease in place: a real
+            # server acquires on its next tick, epoch monotonic
+            await coord.stop()
+        except Exception:
+            logger.exception("storm contender stop failed")
+        db.close()
+
+    async def _rolling_server_restart(self, op: ChaosOp) -> None:
+        """Gracefully restart every alive server one-by-one under live
+        stub traffic — the production rolling deploy. A restarting
+        leader hands its lease over (expire-in-place), the follower
+        acquires, the restarted server rejoins as follower, and
+        replication (transactional change log) resumes with zero lost
+        events."""
+        if len(self.alive_indexes()) < 2:
+            self.skipped_ops.append(op)
+            return
+        for idx in list(self.alive_indexes()):
+            await self.restart_server(idx)
+            # let the rejoined server settle (elections + tailing)
+            # before the next one goes down — a rolling deploy waits
+            # for health, it does not raze the fleet at once
+            await asyncio.sleep(self.ha_ttl * 0.7 + op.arg)
+        await self._rebase_clients()
+
     async def _kv_handoff_abort(self, op: ChaosOp) -> None:
         """Kill the prefill replica's worker MID-HANDOFF: a real
         proxied chat request routes through the server's disaggregated
@@ -1262,7 +1397,7 @@ class ChaosHarness:
         replica → decode stub pulls its paced /kv/export), and the
         prefill host dies while the stream is open. The request must
         still complete (cold) and the cluster must re-converge."""
-        insts = await self.admin.list("model-instances")
+        insts = await self.admin.list_all("model-instances")
         pre = [
             i for i in insts
             if i.get("role") == "prefill" and i["state"] == "running"
@@ -1689,10 +1824,13 @@ async def run_seeded(
     """Boot a cluster, deploy, run the seeded schedule, wait for
     convergence; returns a report dict (raises on non-convergence)."""
     gap = (0.2, 0.8)
-    if any(k in HA_FAULT_KINDS for k in kinds):
-        # leader faults each need an election (~TTL) to play out; the
-        # gap scales with the lease so ops land on a settled leader.
-        # Still a pure function of (seed, shape): ha_ttl is shape.
+    if any(
+        k in HA_FAULT_KINDS or k in SCALE_FAULT_KINDS for k in kinds
+    ):
+        # leader faults / storms / rolling restarts each need an
+        # election (~TTL) to play out; the gap scales with the lease
+        # so ops land on a settled leader. Still a pure function of
+        # (seed, shape): ha_ttl is shape.
         gap = (ha_ttl * 1.5, ha_ttl * 3.0)
     if any(k in TENANT_FAULT_KINDS for k in kinds):
         # noisy-neighbor saturation must be reachable: shrink the

@@ -495,6 +495,37 @@ def check_fenced_writes(writes: Sequence[Dict]) -> List[Violation]:
     return out
 
 
+def check_changelog_durability(
+    committed: Sequence[Dict],
+    observed: Sequence[Dict],
+) -> List[Violation]:
+    """**no-lost-replication-event** (always): every write COMMITTED on
+    the origin server before it died must be observed by a surviving
+    peer — either republished on its bus or present in the shared
+    ``change_log``. With transactional appends (orm/changelog.py) this
+    holds by construction even for a SIGKILL the instant after commit;
+    a miss means an event made it to the data table without its
+    replication entry, the exact crash window ISSUE 15 closes.
+
+    ``committed``/``observed`` entries are ``{kind, id, type}`` dicts
+    (type = CREATED/UPDATED/DELETED). Pure, so the chaos harness and
+    e2es judge identical math."""
+    seen = {
+        (o.get("kind"), int(o.get("id", 0)), o.get("type"))
+        for o in observed
+    }
+    out: List[Violation] = []
+    for c in committed:
+        key = (c.get("kind"), int(c.get("id", 0)), c.get("type"))
+        if key not in seen:
+            out.append(Violation(
+                "lost-replication-event", "always",
+                f"{key[2]} {key[0]} id={key[1]} committed on the "
+                "origin but never observed by any surviving peer",
+            ))
+    return out
+
+
 def check_fair_shares(
     admitted: Dict[str, int],
     weights: Dict[str, int],

@@ -53,7 +53,7 @@ class BenchmarkManager:
         while True:
             await asyncio.sleep(self.RESCAN_INTERVAL)
             try:
-                items = await self.client.list(
+                items = await self.client.list_all(
                     "benchmarks", state=BenchmarkState.PENDING.value
                 )
             except APIError:
@@ -76,7 +76,7 @@ class BenchmarkManager:
         self, bench: Benchmark
     ) -> Optional[ModelInstance]:
         try:
-            items = await self.client.list(
+            items = await self.client.list_all(
                 "model-instances", model_id=bench.model_id
             )
         except APIError:

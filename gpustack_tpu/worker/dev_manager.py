@@ -153,7 +153,7 @@ class DevManager:
         """DB is truth at startup: start SCHEDULED/claimed instances,
         stop local processes whose record is gone."""
         try:
-            items = await self.client.list("dev-instances")
+            items = await self.client.list_all("dev-instances")
         except APIError as e:
             logger.warning("dev reconcile list failed: %s", e)
             return

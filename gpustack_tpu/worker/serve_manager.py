@@ -221,7 +221,7 @@ class ServeManager:
 
     async def _reconcile_locked(self) -> None:
         try:
-            items = await self.client.list("model-instances")
+            items = await self.client.list_all("model-instances")
         except NETWORK_ERRORS:
             # transport errors too: the recovery path runs reconcile
             # precisely during flaky-network windows, and the startup

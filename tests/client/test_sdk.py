@@ -157,3 +157,78 @@ def test_sdk_error_surface(ctx):
             await sdk.login("admin", "wrong")
 
     _run(ctx, go)
+
+
+def test_list_all_sees_past_the_100_row_default(ctx):
+    """ISSUE 15 satellite: the paginated ``list_all`` helper fully
+    reads a >100-row table. The plain list call's server-side 100-row
+    default silently truncates fleet-scale tables — the exact bug the
+    PR 9 scale smoke worked around per-site with oversized limits."""
+
+    async def go(sdk: GPUStackClient):
+        await sdk.login("admin", "pw")
+        total = 130
+        for i in range(total):
+            await Model.create(
+                Model(name=f"wide-{i:03d}", preset="tiny")
+            )
+        # the naked list call truncates at the server default
+        assert len(await sdk.models.list()) == 100
+        # the control-loop read sees everything, exactly once
+        everything = await sdk.models.list_all()
+        assert len(everything) == total
+        assert len({m.id for m in everything}) == total
+        # raw ClientSet spelling too (what worker loops use;
+        # GPUStackClient IS a ClientSet), with a page size that does
+        # not divide the total
+        raw = await sdk.list_all("models", page_size=33)
+        assert len(raw) == total
+        # filters ride along on every page
+        assert len(await sdk.list_all("models", name="wide-007")) == 1
+
+    _run(ctx, go)
+
+
+def test_list_all_keyset_survives_concurrent_delete(ctx):
+    """Keyset pagination (since_id cursor): a row deleted between
+    pages must not shift a live row out of the result set — OFFSET
+    paging would skip one, and a reconcile loop would then kill the
+    'missing' instance's healthy engine (review finding)."""
+
+    async def go(sdk: GPUStackClient):
+        await sdk.login("admin", "pw")
+        created = [
+            await Model.create(Model(name=f"ks-{i:03d}", preset="tiny"))
+            for i in range(120)
+        ]
+        page_size = 50
+        # page 1 through the live API
+        page1 = (await sdk.request(
+            "GET", sdk.query_path("models", {"limit": page_size}),
+        ))["items"]
+        assert len(page1) == page_size
+        # a low-id row vanishes between pages (another worker's
+        # drained instance being retired)
+        await created[0].delete()
+        # continue with the keyset cursor: every SURVIVING row must be
+        # seen exactly once
+        seen = {m["id"] for m in page1}
+        since = page1[-1]["id"]
+        while True:
+            page = (await sdk.request(
+                "GET",
+                sdk.query_path(
+                    "models",
+                    {"limit": page_size, "since_id": since},
+                ),
+            ))["items"]
+            for m in page:
+                assert m["id"] not in seen
+                seen.add(m["id"])
+            if len(page) < page_size:
+                break
+            since = page[-1]["id"]
+        surviving = {m.id for m in created[1:]}
+        assert surviving <= seen, surviving - seen
+
+    _run(ctx, go)

@@ -62,6 +62,9 @@ class _RecordingClient:
         return []
 
 
+    # control loops read via the paginated helper now
+    list_all = list
+
 async def _spawn_stub_engine(port: int):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")

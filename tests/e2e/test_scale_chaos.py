@@ -1,0 +1,178 @@
+"""Fleet-scale control-plane chaos (ISSUE 15): rolling server
+restarts, acquire storms, and the SIGKILL-zero-loss acceptance for
+transactional change-log appends.
+
+The tier-1 subset proves the two headline properties cheaply:
+
+- **SIGKILL the leader loses zero replication events**: every write
+  COMMITTED through the leader's API before the kill is observed by
+  the surviving follower — invariant-checked via
+  ``check_changelog_durability``. No flush cycle is involved: the
+  change-log entry commits inside the write's own transaction
+  (orm/changelog.py), so the PR 10 ttl/6 outbox crash window is gone
+  by construction (the in-memory outbox is provably empty pre-kill).
+- **Rolling restart under live traffic converges clean**: every
+  server gracefully restarts one-by-one while stub workers keep
+  heartbeating and serving lifecycle writes; leadership hands over
+  without a leaderless gap > 3×TTL, the schedule replays bit-for-bit,
+  and the full election/fencing/convergence invariant set stays
+  empty.
+
+The seeded multi-op soaks (also ``make chaos
+CLASSES=acquire-storm,rolling-server-restart``) are marked slow.
+"""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from gpustack_tpu.testing import chaos
+from gpustack_tpu.testing import invariants as inv
+
+HA_TTL = 1.0
+
+
+def test_sigkill_leader_loses_no_change_log_events(tmp_path):
+    async def go():
+        harness = chaos.ChaosHarness(
+            str(tmp_path), servers=2, workers=1, replicas=1,
+            ha_ttl=HA_TTL, stuck_bound=45.0,
+        )
+        await harness.start()
+        try:
+            await harness.wait_converged(timeout=60)
+            leader_idx = await harness._wait_leader()
+            assert leader_idx is not None
+            leader = harness.servers[leader_idx]
+            follower_idx = next(
+                i for i in harness.alive_indexes() if i != leader_idx
+            )
+            follower = harness.servers[follower_idx]
+
+            # observe the follower's bus LOSSLESSLY from before the
+            # writes: every republished remote event lands here
+            observed = []
+
+            def tap(event):
+                if getattr(event, "remote", False):
+                    observed.append({
+                        "kind": event.kind,
+                        "id": event.id,
+                        "type": event.type.value,
+                    })
+
+            follower.bus.add_tap(tap)
+
+            # commit writes THROUGH THE LEADER's API, then SIGKILL it
+            # immediately — no sleep, no flush window
+            from gpustack_tpu.client.client import ClientSet
+
+            leader_api = ClientSet(
+                f"http://127.0.0.1:{leader.cfg.port}",
+                harness._admin_token,
+            )
+            committed = []
+            try:
+                for i in range(6):
+                    created = await leader_api.create("models", {
+                        "name": f"durable-{i}",
+                        "preset": "tiny",
+                        "replicas": 0,
+                    })
+                    committed.append({
+                        "kind": "model",
+                        "id": created["id"],
+                        "type": "CREATED",
+                    })
+            finally:
+                await leader_api.close()
+
+            # the crash window is structurally empty: nothing sits in
+            # an in-memory outbox awaiting a ttl/6 flush
+            assert not leader.coordinator._outbox
+            await harness._abort_server(leader_idx)
+
+            # the follower must observe every committed write within
+            # a few replication cycles
+            deadline = asyncio.get_running_loop().time() + HA_TTL * 6
+            while True:
+                missing = inv.check_changelog_durability(
+                    committed, observed
+                )
+                if not missing:
+                    break
+                assert (
+                    asyncio.get_running_loop().time() < deadline
+                ), [v.detail for v in missing]
+                await asyncio.sleep(0.05)
+
+            # and the overall run stayed invariant-clean
+            assert harness.violations() == []
+        finally:
+            await harness.stop()
+
+    asyncio.run(go())
+
+
+def test_rolling_restart_under_live_traffic_fast(tmp_path):
+    """One graceful rolling restart across both servers (seed 1 draws
+    exactly that op) with live stub workers: converges with zero
+    violations and the schedule replays bit-for-bit."""
+
+    async def go():
+        report = await chaos.run_seeded(
+            str(tmp_path), 1,
+            kinds=chaos.SCALE_FAULT_KINDS,
+            ops=1, workers=2, replicas=2, servers=2,
+            ha_ttl=HA_TTL, converge_timeout=60,
+            stuck_bound=45.0,
+        )
+        assert report["violations"] == []
+        kinds = [o["kind"] for o in report["schedule"]]
+        assert kinds == ["rolling_server_restart"]
+        assert report["skipped_ops"] == []
+        assert report["dead_servers"] == []
+        # leadership moved at least once (graceful handoff) and every
+        # epoch had exactly one winner — already invariant-judged;
+        # spot-check the tap saw the handoff
+        assert report["election_events"] >= 2
+
+    asyncio.run(go())
+
+
+def test_scale_schedules_replay_bit_for_bit():
+    a = chaos.generate_schedule(
+        11, kinds=chaos.SCALE_FAULT_KINDS, ops=4, workers=3,
+        gap=(1.5, 3.0),
+    )
+    b = chaos.generate_schedule(
+        11, kinds=chaos.SCALE_FAULT_KINDS, ops=4, workers=3,
+        gap=(1.5, 3.0),
+    )
+    assert [dataclasses.asdict(o) for o in a] == [
+        dataclasses.asdict(o) for o in b
+    ]
+    assert any(o.kind in chaos.SCALE_FAULT_KINDS for o in a)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "cls_name,seed",
+    [("acquire-storm", 3), ("rolling-server-restart", 1),
+     ("rolling-server-restart", 6)],
+)
+def test_scale_chaos_soak(tmp_path, cls_name, seed):
+    """Multi-op seeded soaks per class — the `make chaos` classes."""
+
+    async def go():
+        report = await chaos.run_seeded(
+            str(tmp_path), seed,
+            kinds=chaos.FAULT_CLASSES[cls_name],
+            ops=2, workers=3, replicas=2, servers=2,
+            ha_ttl=HA_TTL, converge_timeout=90,
+            stuck_bound=60.0,
+        )
+        assert report["violations"] == [], report["violations"]
+
+    asyncio.run(go())

@@ -50,11 +50,10 @@ def test_control_plane_passes_stay_linear_at_300_workers(tmp_path):
             loop = asyncio.get_running_loop()
             deadline = loop.time() + timeout
             while True:
-                # the list API defaults to limit=100 — ask for the
-                # whole fleet
-                workers = await harness.admin.list(
-                    "workers", limit=2 * WORKERS
-                )
+                # paginated full read: the 100-row-default workaround
+                # (oversized limit guess) is gone — list_all is THE
+                # full-table read for control loops
+                workers = await harness.admin.list_all("workers")
                 ready = {
                     w["name"] for w in workers
                     if w["state"] == "ready"

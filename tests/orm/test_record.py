@@ -434,3 +434,29 @@ def test_unfenced_context_ignores_leadership_table(fenced_ctx):
         assert (await Model.get(m.id)).replicas == 8
 
     run(go())
+
+
+def test_filter_since_id_keyset(ctx):
+    """since_id composes with equality conds and ordering — the keyset
+    cursor behind client.list_all (ISSUE 15)."""
+    import asyncio
+
+    from gpustack_tpu.schemas import Model
+
+    async def go():
+        rows = [
+            await Model.create(Model(
+                name=f"k{i}", preset="tiny",
+                cluster_id=1 if i % 2 == 0 else 2,
+            ))
+            for i in range(6)
+        ]
+        mid = rows[2].id
+        tail = await Model.filter(since_id=mid)
+        assert [m.id for m in tail] == [r.id for r in rows[3:]]
+        # composes with an indexed equality condition
+        even_tail = await Model.filter(since_id=mid, cluster_id=1)
+        assert all(m.cluster_id == 1 and m.id > mid for m in even_tail)
+        assert await Model.filter(since_id=rows[-1].id) == []
+
+    asyncio.run(go())

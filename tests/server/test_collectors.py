@@ -13,7 +13,6 @@ from gpustack_tpu.server.bus import EventBus
 from gpustack_tpu.server.collectors import (
     UsageArchive,
     UsageArchiver,
-    WorkerStatusBuffer,
 )
 from gpustack_tpu.worker.metrics_map import (
     normalize_engine_metrics,
@@ -66,25 +65,32 @@ def test_normalization_maps_known_names():
     assert 'some_unknown_metric{instance_id="5"} 7' in raw
 
 
-def test_status_buffer_batches_and_flushes_transitions(db):
+def test_status_refresh_coalesces_through_write_combiner(db):
+    """The WorkerStatusBuffer role moved to the write combiner
+    (server/write_combiner.py, its own suite): steady-state refreshes
+    buffer in memory and land as batched column writes on flush."""
+    from gpustack_tpu.server.write_combiner import ControlWriteCombiner
+
     async def go():
-        buffer = WorkerStatusBuffer(flush_interval=999)
+        combiner = ControlWriteCombiner(flush_interval=999)
         w = await Worker.create(
-            Worker(name="w1", state=WorkerState.NOT_READY)
+            Worker(name="w1", state=WorkerState.READY)
         )
-        # transition NOT_READY -> READY flushes immediately
-        await buffer.put(w, WorkerStatus(), "t1")
-        w = await Worker.get(w.id)
-        assert w.state == WorkerState.READY
-        assert w.heartbeat_at == "t1"
-        # steady-state refresh buffers (no DB write yet)
-        await buffer.put(w, WorkerStatus(), "t2")
-        assert (await Worker.get(w.id)).heartbeat_at == "t1"
-        flushed = await buffer.flush()
-        assert flushed == 1
-        assert (await Worker.get(w.id)).heartbeat_at == "t2"
+        iso = "2099-01-01T00:00:00+00:00"
+        combiner.offer_status(
+            w.id, WorkerStatus(cpu_count=3).model_dump(mode="json"),
+            iso,
+        )
+        # buffered, not yet written
+        assert (await Worker.get(w.id)).heartbeat_at == ""
+        hb, st = await combiner.flush()
+        assert (hb, st) == (0, 1)
+        fresh = await Worker.get(w.id)
+        assert fresh.heartbeat_at == iso
+        assert fresh.status.cpu_count == 3
+        assert fresh.state == WorkerState.READY
         # flush drains: second flush is a no-op
-        assert await buffer.flush() == 0
+        assert await combiner.flush() == (0, 0)
 
     asyncio.run(go())
 

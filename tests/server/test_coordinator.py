@@ -427,3 +427,163 @@ def test_change_log_tail_batches_refetches_per_kind(tmp_path):
             db_b.close()
 
     asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: transactional change-log appends (crash-window test INVERTED)
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_after_commit_loses_no_change_log_events(tmp_path):
+    """Change-log appends commit WITH the data write (orm/changelog.py):
+    a leader SIGKILL'd the instant after its writes commit — before any
+    ttl/6 replication flush could possibly have run — loses ZERO
+    events; a follower tails every one of them. This inverts the PR 10
+    crash-window residual (the unflushed in-memory outbox)."""
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.server.bus import EventBus, EventType
+
+    path = str(tmp_path / "durable.db")
+
+    async def go():
+        db_a, db_b = Database(path), Database(path)
+        bus_a, bus_b = EventBus(), EventBus()
+        Record.bind(db_a, bus_a)
+        Record.create_all_tables(db_a)
+        # huge TTL: the repl loop's flush/tail interval (ttl/6) can
+        # never tick inside this test — durability must come from the
+        # write transactions alone
+        a = LeaseCoordinator(db_a, identity="a", ttl=600.0, bus=bus_a)
+        bus_a.add_tap(a.publish_remote)
+        await a.start()
+        b = None
+        try:
+            created = []
+            for i in range(5):
+                m = await Model.create(
+                    Model(name=f"d{i}", preset="tiny")
+                )
+                created.append(m.id)
+            # the tap is a post-commit no-op now: nothing is waiting
+            # in a crash-lossable in-memory outbox
+            assert not a._outbox
+            # SIGKILL shape: tasks die, nothing flushed, lease not
+            # released
+            await a.halt()
+
+            rows = await db_b.execute(
+                "SELECT kind, record_id, event_type FROM change_log"
+            )
+            logged = {
+                int(r["record_id"]) for r in rows
+                if r["kind"] == "model" and r["event_type"] == "CREATED"
+            }
+            assert logged == set(created), (logged, created)
+
+            # and a follower actually republishes them as full events
+            b = LeaseCoordinator(db_b, identity="b", ttl=600.0, bus=bus_b)
+            b._last_seen = 0
+            received = []
+            bus_b.add_tap(received.append)
+            Record.bind_context(db_b, bus_b)
+            try:
+                await b._tail_changes()
+            finally:
+                Record.bind_context(db_a, bus_a)
+            seen = {
+                e.id for e in received
+                if e.kind == "model" and e.type == EventType.CREATED
+            }
+            assert seen == set(created)
+        finally:
+            if b is not None:
+                await b.stop()
+            db_a.close()
+            db_b.close()
+
+    asyncio.run(go())
+
+
+def test_change_log_append_failure_rolls_back_the_data_write(tmp_path):
+    """Replicated-on-commit or not committed at all: if the change-log
+    entry cannot be recorded, the data write must NOT half-land (a row
+    peers can never hear about)."""
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.server.bus import EventBus
+
+    path = str(tmp_path / "atomic.db")
+
+    async def go():
+        db = Database(path)
+        bus = EventBus()
+        Record.bind(db, bus)
+        Record.create_all_tables(db)
+        a = LeaseCoordinator(db, identity="a", ttl=600.0, bus=bus)
+        await a.start()
+        try:
+            m = await Model.create(Model(name="ok", preset="tiny"))
+            assert m.id
+            # sabotage the replication table: the next write's append
+            # fails inside the transaction
+            await db.execute("DROP TABLE change_log")
+            import sqlite3
+
+            try:
+                await Model.create(Model(name="lost", preset="tiny"))
+                raise AssertionError("create should have failed")
+            except sqlite3.OperationalError:
+                pass
+            # the data write rolled back with it
+            assert await Model.first(name="lost") is None
+            # updates too
+            try:
+                await m.update(replicas=7)
+                raise AssertionError("update should have failed")
+            except sqlite3.OperationalError:
+                pass
+            fresh = await Model.get(m.id)
+            assert fresh.replicas != 7
+        finally:
+            await a.halt()
+            db.close()
+
+    asyncio.run(go())
+
+
+def test_bus_tap_never_double_logs_with_transactional_appends(tmp_path):
+    """One committed write ⇒ exactly one change-log entry: the
+    post-commit tap must not re-append what the transaction already
+    recorded."""
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas import Model
+    from gpustack_tpu.server.bus import EventBus
+
+    path = str(tmp_path / "single.db")
+
+    async def go():
+        db = Database(path)
+        bus = EventBus()
+        Record.bind(db, bus)
+        Record.create_all_tables(db)
+        a = LeaseCoordinator(db, identity="a", ttl=600.0, bus=bus)
+        bus.add_tap(a.publish_remote)
+        await a.start()
+        try:
+            m = await Model.create(Model(name="once", preset="tiny"))
+            await m.update(replicas=2)
+            await a._flush_outbox()  # migration shim: must be a no-op
+            rows = await db.execute(
+                "SELECT event_type, COUNT(*) AS n FROM change_log "
+                "WHERE kind = ? AND record_id = ? "
+                "GROUP BY event_type",
+                ("model", m.id),
+            )
+            counts = {r["event_type"]: int(r["n"]) for r in rows}
+            assert counts == {"CREATED": 1, "UPDATED": 1}, counts
+        finally:
+            await a.halt()
+            db.close()
+
+    asyncio.run(go())

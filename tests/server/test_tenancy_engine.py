@@ -420,3 +420,215 @@ class TestRegistryState:
         assert d.admitted
         lease.release()
         lease2.release()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellite: RollingBudget rehydration from durable usage rows
+# (the PR 14 process-local-budget residual, closed)
+# ---------------------------------------------------------------------------
+
+
+def test_budget_rehydrates_from_injected_rehydrator():
+    """A fresh registry (process restart) seeds each tenant's rolling
+    window from the durable spend BEFORE its first admission — a
+    client that exhausted its budget cannot buy a new window with a
+    server restart."""
+    import asyncio
+
+    async def go():
+        clock = Clock(1000.0)
+        registry = make_registry(clock)
+        calls = []
+
+        async def rehydrator(tenant, window_s):
+            calls.append((tenant, window_s))
+            # 90 tokens spent, window opened 100s ago
+            return 90, 100.0
+
+        registry.rehydrator = rehydrator
+        spec = TenantSpec(
+            tenant="key:7", token_budget=100, budget_window_s=600.0
+        )
+        await registry.ensure_rehydrated(spec)
+        assert calls == [("key:7", 600.0)]
+        # one read per state, ever
+        await registry.ensure_rehydrated(spec)
+        assert len(calls) == 1
+
+        d, lease = registry.admit(spec, "m")
+        assert d.admitted  # 10 tokens of headroom remain
+        assert d.headers["X-RateLimit-Remaining-Tokens"] == "10"
+        lease.release()
+        registry.record_tokens("key:7", 10)
+        d, lease = registry.admit(spec, "m")
+        assert not d.admitted and d.reason == REASON_BUDGET
+        assert lease is None
+        # the window resets where the DURABLE history says: ~500s out
+        # (600s window opened 100s ago), not a fresh 600
+        assert 400 <= float(d.headers["X-RateLimit-Reset-Tokens"]) <= 501
+
+    asyncio.run(go())
+
+
+def test_budget_survives_restart_mid_window_durable_rows(tmp_path):
+    """End-to-end over a REAL database: usage rows land mid-window,
+    the 'server' restarts (fresh registry over the same DB), and the
+    durable_budget_spend rehydrator keeps the window shut."""
+    import asyncio
+
+    from gpustack_tpu.orm.db import Database
+    from gpustack_tpu.orm.record import Record
+    from gpustack_tpu.schemas.usage import ModelUsage
+    from gpustack_tpu.server.bus import EventBus
+    from gpustack_tpu.server.tenancy import durable_budget_spend
+
+    async def go():
+        db = Database(str(tmp_path / "usage.db"))
+        Record.bind(db, EventBus())
+        Record.create_all_tables(db)
+        try:
+            spec = TenantSpec(
+                tenant="key:42", token_budget=100,
+                budget_window_s=3600.0,
+            )
+
+            def fresh_process():
+                clock = Clock(50.0)
+                registry = make_registry(clock)
+                registry.rehydrator = durable_budget_spend
+                return registry
+
+            # process 1: tenant spends 100 tokens, rows are durable
+            registry1 = fresh_process()
+            await registry1.ensure_rehydrated(spec)
+            d, lease = registry1.admit(spec, "m")
+            assert d.admitted
+            lease.release()
+            for _ in range(2):
+                await ModelUsage.create(ModelUsage(
+                    tenant="key:42", model_id=1,
+                    prompt_tokens=30, completion_tokens=20,
+                    total_tokens=50,
+                ))
+            registry1.record_tokens("key:42", 100)
+            d, _lease = registry1.admit(spec, "m")
+            assert not d.admitted and d.reason == REASON_BUDGET
+
+            # kill + restart: a brand-new registry over the same DB
+            registry2 = fresh_process()
+            await registry2.ensure_rehydrated(spec)
+            assert registry2.rehydrated_tenants == 1
+            d, _lease = registry2.admit(spec, "m")
+            assert not d.admitted and d.reason == REASON_BUDGET, (
+                "restart reopened the token-budget window"
+            )
+
+            # an unknown tenant rehydrates to nothing (no history)
+            other = TenantSpec(
+                tenant="key:99", token_budget=100,
+                budget_window_s=3600.0,
+            )
+            await registry2.ensure_rehydrated(other)
+            d, lease = registry2.admit(other, "m")
+            assert d.admitted
+            lease.release()
+
+            # rows OUTSIDE the window don't count: shrink the window
+            narrow = TenantSpec(
+                tenant="key:42", token_budget=100,
+                budget_window_s=1.0,
+            )
+            await asyncio.sleep(1.1)
+            registry3 = fresh_process()
+            registry3.rehydrator = durable_budget_spend
+            await registry3.ensure_rehydrated(narrow)
+            d, lease = registry3.admit(narrow, "m")
+            assert d.admitted
+            lease.release()
+        finally:
+            db.close()
+
+    asyncio.run(go())
+
+
+def test_concurrent_first_requests_wait_for_rehydration():
+    """Two concurrent first requests after a restart: the second must
+    WAIT for the in-flight durable read instead of admitting against
+    an unseeded budget (review finding)."""
+    import asyncio
+
+    async def go():
+        clock = Clock(1000.0)
+        registry = make_registry(clock)
+        release = asyncio.Event()
+        reads = []
+
+        async def slow_rehydrator(tenant, window_s):
+            reads.append(tenant)
+            await release.wait()   # a slow DB read
+            return 100, 10.0       # budget fully exhausted
+
+        registry.rehydrator = slow_rehydrator
+        spec = TenantSpec(
+            tenant="key:9", token_budget=100, budget_window_s=600.0
+        )
+
+        async def first_request():
+            await registry.ensure_rehydrated(spec)
+            d, lease = registry.admit(spec, "m")
+            if lease is not None:
+                lease.release()
+            return d
+
+        t1 = asyncio.create_task(first_request())
+        await asyncio.sleep(0)      # t1 is now parked inside the read
+        t2 = asyncio.create_task(first_request())
+        await asyncio.sleep(0)
+        release.set()
+        d1, d2 = await asyncio.gather(t1, t2)
+        # ONE durable read served both, and NEITHER admitted
+        assert reads == ["key:9"]
+        assert not d1.admitted and d1.reason == REASON_BUDGET
+        assert not d2.admitted and d2.reason == REASON_BUDGET
+        assert registry.rehydrated_tenants == 1
+
+    asyncio.run(go())
+
+
+def test_cancelled_rehydration_retries_on_next_request():
+    """A client disconnect mid-rehydration-read must not burn the
+    once-only flag: the NEXT request re-runs the durable seed (review
+    finding — otherwise the exhausted tenant gets a free window for
+    the process lifetime)."""
+    import asyncio
+
+    async def go():
+        clock = Clock(1000.0)
+        registry = make_registry(clock)
+        gate = asyncio.Event()
+        reads = []
+
+        async def slow_rehydrator(tenant, window_s):
+            reads.append(tenant)
+            await gate.wait()
+            return 100, 10.0
+
+        registry.rehydrator = slow_rehydrator
+        spec = TenantSpec(
+            tenant="key:13", token_budget=100, budget_window_s=600.0
+        )
+        t1 = asyncio.create_task(registry.ensure_rehydrated(spec))
+        await asyncio.sleep(0)          # parked inside the read
+        t1.cancel()
+        try:
+            await t1
+        except asyncio.CancelledError:
+            pass
+        # the seed never applied, so the state is NOT marked done
+        gate.set()
+        await registry.ensure_rehydrated(spec)
+        assert reads == ["key:13", "key:13"]
+        d, _lease = registry.admit(spec, "m")
+        assert not d.admitted and d.reason == REASON_BUDGET
+
+    asyncio.run(go())

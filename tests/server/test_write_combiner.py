@@ -1,0 +1,361 @@
+"""Control write combiner (server/write_combiner.py): coalescing,
+sub-linear write rate, the overload-degradation ladder, the deadline
+bound, and the shared shutdown drain contract (ISSUE 15 tentpole)."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from gpustack_tpu.orm.db import Database, DatabaseClosedError
+from gpustack_tpu.orm.record import Record
+from gpustack_tpu.schemas import Worker, WorkerState, WorkerStatus
+from gpustack_tpu.server.bus import EventBus
+from gpustack_tpu.server.write_combiner import ControlWriteCombiner
+
+
+@pytest.fixture()
+def db():
+    database = Database(":memory:")
+    bus = EventBus()
+    Record.bind(database, bus)
+    Record.create_all_tables(database)
+    yield database
+    database.close()
+
+
+def _iso(offset_s: float = 0.0) -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(seconds=offset_s)
+    ).isoformat()
+
+
+async def _mk_workers(n: int):
+    out = []
+    for i in range(n):
+        out.append(await Worker.create(
+            Worker(name=f"w{i}", state=WorkerState.READY)
+        ))
+    return out
+
+
+def test_heartbeats_coalesce_newest_wins(db):
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        t1, t2 = _iso(0), _iso(1)
+        combiner.offer_heartbeat(w.id, t1)
+        combiner.offer_heartbeat(w.id, t2)
+        assert combiner.coalesced["heartbeat"] == 1
+        assert combiner.queue_depth() == 1
+        hb, st = await combiner.flush()
+        assert (hb, st) == (1, 0)
+        assert (await Worker.get(w.id)).heartbeat_at == t2
+
+    asyncio.run(go())
+
+
+def test_db_write_rate_is_sublinear_in_workers(db):
+    """THE query-count regression (acceptance): heartbeat-driven DB
+    write transactions at 1000 workers stay under a fixed multiple of
+    the 100-worker count — one batched write transaction per flush at
+    EITHER width, where the old per-worker read-modify-write path cost
+    O(workers) transactions."""
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        workers = await _mk_workers(1000)
+
+        def drive(n: int) -> int:
+            for w in workers[:n]:
+                combiner.offer_heartbeat(w.id, _iso())
+            return n
+
+        drive(100)
+        before = db.write_txn_count
+        await combiner.flush()
+        writes_100 = db.write_txn_count - before
+
+        drive(1000)
+        before = db.write_txn_count
+        await combiner.flush()
+        writes_1000 = db.write_txn_count - before
+
+        assert writes_100 >= 1
+        # 10× the workers, same transaction count (fixed multiple 2
+        # leaves slack for an extra batch, never O(workers))
+        assert writes_1000 <= 2 * writes_100, (
+            writes_100, writes_1000,
+        )
+        # and the rows all actually landed
+        fresh = await Worker.get(workers[999].id)
+        assert fresh.heartbeat_at != ""
+
+    asyncio.run(go())
+
+
+def test_flush_never_regresses_a_fresher_writethrough(db):
+    """A write-through state transition (recovery) carries a newer
+    heartbeat_at; a late combiner flush of an older buffered value
+    must not rewind it — the guard clause in the batched UPDATE."""
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        older, newer = _iso(0), _iso(5)
+        combiner.offer_heartbeat(w.id, older)
+        await w.update(heartbeat_at=newer)  # write-through wins
+        await combiner.flush()
+        assert (await Worker.get(w.id)).heartbeat_at == newer
+
+    asyncio.run(go())
+
+
+def test_combiner_writes_publish_no_events_and_no_changelog(db):
+    """set_field-shaped: liveness writes must create neither watch
+    events (fan-out stays O(events)) nor change_log entries
+    (replication traffic stays O(real writes)) — but must still bump
+    updated_at so whole-document CAS saves conflict honestly."""
+    from gpustack_tpu.orm.changelog import change_log_ddl
+    from gpustack_tpu.orm.record import PK_CLAUSE
+
+    async def go():
+        await db.execute(change_log_ddl(PK_CLAUSE["sqlite"]))
+        db.changelog_origin = "test-origin"
+        try:
+            combiner = ControlWriteCombiner(flush_interval=999)
+            (w,) = await _mk_workers(1)
+            loaded = await Worker.get(w.id)
+            published_before = dict(Record.bus().published)
+            combiner.offer_status(
+                w.id, WorkerStatus(cpu_count=5).model_dump(mode="json"),
+                _iso(),
+            )
+            await combiner.flush()
+            assert Record.bus().published == published_before
+            rows = await db.execute(
+                "SELECT COUNT(*) AS n FROM change_log WHERE kind = ?",
+                ("worker",),
+            )
+            # only the create (a real event) is logged — the combiner
+            # flush is not
+            assert int(rows[0]["n"]) == 1
+            # the stale pre-flush snapshot's CAS save must CONFLICT
+            from gpustack_tpu.orm.record import ConflictError
+
+            with pytest.raises(ConflictError):
+                await loaded.save()
+        finally:
+            db.changelog_origin = ""
+
+    asyncio.run(go())
+
+
+def test_degradation_ladder_defers_status_keeps_liveness(db):
+    """Past the queue watermark, write_pressure >= 1: status documents
+    defer (counted), heartbeat timestamps still land, freshness stays
+    in memory."""
+
+    async def go():
+        clock = [0.0]
+        combiner = ControlWriteCombiner(
+            flush_interval=1.0, deadline=30.0,
+            queue_watermark=2, clock=lambda: clock[0],
+        )
+        workers = await _mk_workers(3)
+        for w in workers:
+            combiner.offer_status(
+                w.id, WorkerStatus(cpu_count=9).model_dump(mode="json"),
+                _iso(),
+            )
+        assert combiner.write_pressure() >= 1.0 and combiner.degraded
+        hb, st = await combiner.flush()
+        # liveness-only: every worker's heartbeat landed, no status
+        assert st == 0 and hb == 3
+        assert combiner.deferred_total == 3
+        for w in workers:
+            fresh = await Worker.get(w.id)
+            assert fresh.heartbeat_at != ""
+            assert fresh.status.cpu_count == 0
+            assert combiner.freshness_for(w.id) == fresh.heartbeat_at
+
+        # pressure cleared (queue below watermark after deferral is
+        # still 3 >= 2 here, so advance the deadline instead): the
+        # deadline bound lands the deferred documents regardless
+        clock[0] += 29.5
+        hb, st = await combiner.flush()
+        assert st == 3
+        assert (await Worker.get(workers[0].id)).status.cpu_count == 9
+
+    asyncio.run(go())
+
+
+def test_deferred_status_lands_within_deadline(db):
+    """A coalesced-but-deferred status write still lands within its
+    deadline (acceptance): with pressure pinned high, the flush at
+    deadline - interval forces it through."""
+
+    async def go():
+        clock = [100.0]
+        combiner = ControlWriteCombiner(
+            flush_interval=1.0, deadline=5.0,
+            queue_watermark=1,  # permanently degraded
+            clock=lambda: clock[0],
+        )
+        (w,) = await _mk_workers(1)
+        combiner.offer_status(
+            w.id, WorkerStatus(cpu_count=7).model_dump(mode="json"),
+            _iso(),
+        )
+        assert combiner.degraded
+        landed_at = None
+        for tick in range(8):
+            await combiner.flush()
+            if (await Worker.get(w.id)).status.cpu_count == 7:
+                landed_at = clock[0] - 100.0
+                break
+            clock[0] += 1.0  # one flush interval per loop
+        assert landed_at is not None, "status never landed"
+        assert landed_at <= 5.0, landed_at
+
+    asyncio.run(go())
+
+
+def test_drain_contract_shared_typed_error(db):
+    """Database.close/run and the combiner flush share ONE drain
+    contract: work queued behind shutdown fails loudly with
+    DatabaseClosedError — never a silent drop, never a hang."""
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        combiner.offer_heartbeat(w.id, _iso())
+        # clean drain: buffered work lands, then the combiner refuses
+        # new offers with the typed error
+        await combiner.drain()
+        assert (await Worker.get(w.id)).heartbeat_at != ""
+        with pytest.raises(DatabaseClosedError):
+            combiner.offer_heartbeat(w.id, _iso())
+        with pytest.raises(DatabaseClosedError):
+            combiner.offer_status(w.id, {}, _iso())
+
+        # dirty drain: DB already closed under buffered work — the
+        # SAME typed error surfaces (and the Database's own run path
+        # raises it too)
+        combiner2 = ControlWriteCombiner(flush_interval=999)
+        combiner2.offer_heartbeat(w.id, _iso(1))
+        db.close()
+        with pytest.raises(DatabaseClosedError):
+            await combiner2.drain()
+        with pytest.raises(DatabaseClosedError):
+            await db.run(lambda conn: None)
+
+    asyncio.run(go())
+
+
+def test_syncer_consults_combiner_freshness(db):
+    """A heartbeat the server has SEEN but not flushed must never read
+    as staleness: the WorkerSyncer takes the in-memory freshness over
+    the DB column, so a slow DB cannot park a healthy worker."""
+    from gpustack_tpu.server.controllers import WorkerSyncer
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        w = await Worker.create(Worker(
+            name="wfresh", state=WorkerState.READY,
+            heartbeat_at=_iso(-3600),  # DB says: an hour stale
+        ))
+        combiner.offer_heartbeat(w.id, _iso())  # seen, unflushed
+        syncer = WorkerSyncer(
+            stale_after=60.0,
+            freshness_source=combiner.freshness_for,
+        )
+        await syncer.sync_once()
+        assert (await Worker.get(w.id)).state == WorkerState.READY
+
+        # control: without the freshness source the same state parks
+        syncer_blind = WorkerSyncer(stale_after=60.0)
+        await syncer_blind.sync_once()
+        assert (
+            await Worker.get(w.id)
+        ).state == WorkerState.UNREACHABLE
+
+    asyncio.run(go())
+
+
+def test_metrics_lines_promtext_valid(db):
+    """The combiner's metric families (write pressure, coalesced /
+    flushed / deferred counters) render as valid exposition text and
+    are declared in METRIC_FAMILIES (acceptance)."""
+    from gpustack_tpu.observability.metrics import METRIC_FAMILIES
+    from gpustack_tpu.testing.promtext import assert_well_formed
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        combiner.offer_heartbeat(w.id, _iso(0))
+        combiner.offer_heartbeat(w.id, _iso(1))
+        await combiner.flush()
+        text = "\n".join(combiner.metrics_lines()) + "\n"
+        assert_well_formed(text)
+        for family in (
+            "gpustack_control_write_pressure",
+            "gpustack_control_coalesced_writes_total",
+            "gpustack_control_flushed_writes_total",
+            "gpustack_control_deferred_writes_total",
+        ):
+            assert family in METRIC_FAMILIES
+            assert family in text
+
+    asyncio.run(go())
+
+
+def test_failed_flush_rebuffers_instead_of_dropping(db):
+    """ANY flush failure (not just a closed DB) re-buffers the swapped
+    batch: a transient lock/disk error may not silently lose a flush
+    interval's worth of liveness (review finding)."""
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        iso = _iso()
+        combiner.offer_status(w.id, {"cpu_count": 4}, iso)
+        # sabotage: drop the table so the batched UPDATE explodes
+        await db.execute("ALTER TABLE worker RENAME TO worker_hidden")
+        import sqlite3
+
+        with pytest.raises(sqlite3.OperationalError):
+            await combiner.flush()
+        # the batch is back in the queue, deadline clock intact
+        assert combiner.queue_depth() == 1
+        await db.execute("ALTER TABLE worker_hidden RENAME TO worker")
+        hb, st = await combiner.flush()
+        assert st == 1
+        assert (await Worker.get(w.id)).heartbeat_at == iso
+
+    asyncio.run(go())
+
+
+def test_heartbeat_after_pending_status_advances_its_timestamp(db):
+    """A heartbeat arriving AFTER a buffered status refresh must not be
+    discarded as subsumed: the status entry carries the NEWER liveness
+    to the DB (review finding — a stale landed heartbeat_at inflates a
+    peer syncer's staleness reading)."""
+
+    async def go():
+        combiner = ControlWriteCombiner(flush_interval=999)
+        (w,) = await _mk_workers(1)
+        older, newer = _iso(0), _iso(2)
+        combiner.offer_status(
+            w.id, WorkerStatus(cpu_count=2).model_dump(mode="json"),
+            older,
+        )
+        combiner.offer_heartbeat(w.id, newer)
+        hb, st = await combiner.flush()
+        assert (hb, st) == (0, 1)
+        fresh = await Worker.get(w.id)
+        assert fresh.heartbeat_at == newer
+        assert fresh.status.cpu_count == 2
+
+    asyncio.run(go())

@@ -132,6 +132,9 @@ class _FakeClient:
     async def list(self, kind):
         return [r.model_dump(mode="json") for r in self.records.values()]
 
+    # control loops read via the paginated helper now
+    list_all = list
+
     async def get(self, kind, rid):
         return self.records[rid].model_dump(mode="json")
 

@@ -19,6 +19,9 @@ class _NullClient:
         return []
 
 
+    # control loops read via the paginated helper now
+    list_all = list
+
 def _manager(tmp_path, cap=1024, keep=2):
     cfg = Config.load(
         {

@@ -199,6 +199,9 @@ def test_file_manager_routes_modelscope(tmp_path, monkeypatch):
         async def list(self, *a, **k):
             raise_err()
 
+        # control loops read via the paginated helper now
+        list_all = list
+
         async def create(self, *a, **k):
             raise_err()
 

@@ -104,6 +104,9 @@ def test_busy_coordinator_port_retries_then_succeeds(tmp_path):
         async def list(self, kind, **kw):
             return []
 
+        # control loops read via the paginated helper now
+        list_all = list
+
     cfg = Config.load({"data_dir": str(tmp_path)})
     sm = ServeManager(cfg, _Client(), worker_id=1)
 
@@ -181,6 +184,9 @@ def test_busy_coordinator_port_goes_terminal_after_max_retries(tmp_path):
 
         async def list(self, kind, **kw):
             return []
+
+        # control loops read via the paginated helper now
+        list_all = list
 
     cfg = Config.load({"data_dir": str(tmp_path)})
     sm = ServeManager(cfg, _Client(), worker_id=1)

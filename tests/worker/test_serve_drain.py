@@ -25,6 +25,9 @@ class _FakeClient:
     async def list(self, kind, **kw):
         return []
 
+    # control loops read via the paginated helper now
+    list_all = list
+
     async def get(self, kind, id):
         raise AssertionError("unexpected get")
 
