@@ -29,14 +29,15 @@ analyze:
 # schedule per fault class (worker kill, heartbeat blackhole, RPC
 # delay/drop, engine crash mid-STARTING, server restart, the
 # multi-server ha-failover class: leader kill/hang + lease expiry over
-# a shared DB, kv-handoff aborts, the noisy-neighbor tenant flood with
-# its fairness invariant — docs/TENANCY.md — and the fleet-scale
-# classes: acquire-storm (8-way lease storms) and
-# rolling-server-restart, both multi-server); exits nonzero on any
-# invariant violation or failed convergence. Same seed ⇒ same
-# schedule, so failures are replayable.
-# Narrow with CLASSES (e.g.
-# `make chaos CLASSES=acquire-storm,rolling-server-restart`).
+# a shared DB, kv-handoff aborts, the kv-directory staleness class
+# (a poisoned fleet KV directory entry must degrade to a counted cold
+# route, never a stall — docs/KV_CACHE.md "Fleet KV fabric"), the
+# noisy-neighbor tenant flood with its fairness invariant —
+# docs/TENANCY.md — and the fleet-scale classes: acquire-storm (8-way
+# lease storms) and rolling-server-restart, both multi-server); exits
+# nonzero on any invariant violation or failed convergence. Same seed
+# ⇒ same schedule, so failures are replayable.
+# Narrow with CLASSES (e.g. `make chaos CLASSES=kv-directory`).
 CLASSES ?= all
 SEED ?= 1
 chaos:

@@ -711,6 +711,21 @@ PROFILES = {
         prefill_chunk=2048, host_kv_cache_mb=16384,
         kv_block_tokens=256, long_context=True,
     ),
+    # cold-fleet warmup (the cluster KV fabric profile): one replica
+    # serves shared-prefix conversations and feeds its ConvIndex; a
+    # SECOND replica starts completely cold and is warmed through the
+    # fleet block directory — per turn the directory is consulted with
+    # the proxy's conversation chain, the holder's blocks travel the
+    # real wire codec into the cold replica (the /kv/pull path,
+    # in-process), and the turn serves there. detail.cold_fleet
+    # records cold vs affinity-warm vs directory-warm TTFT,
+    # cross-replica hit count, pull bytes, and greedy token parity
+    # across all three passes.
+    "cold-fleet-warmup": dict(
+        conversations=6, turns=3, system_len=2048, user_len=256,
+        output_len=64, max_slots=2, max_seq_len=8192, prefill_chunk=0,
+        host_kv_cache_mb=8192, kv_block_tokens=256, cold_fleet=True,
+    ),
 }
 
 
@@ -1030,6 +1045,190 @@ def summarize_long_context(cold_recs, warm_recs, disagg_recs, affinity,
     return out
 
 
+# ---------------------- cold-fleet warmup flow ------------------------------
+
+
+def _fleet_msgs(conv, turn):
+    """Deterministic proxy-side message list for (conversation, turn)
+    in the multiturn shape — the chat-visible identity of the token
+    schedule, so conversation_chain() yields the same keys the proxy
+    and the ConvIndex bridge would use in production."""
+    msgs = [{"role": "user", "content": f"conv-{conv}-turn-0"}]
+    for t in range(1, turn + 1):
+        msgs += [
+            {"role": "assistant", "content": f"reply-{t - 1}"},
+            {"role": "user", "content": f"turn-{t}"},
+        ]
+    return msgs
+
+
+def run_cold_fleet_affinity(engine, prof, schedule, affinity,
+                            model_name, replica_id=1):
+    """Affinity-warm pass on the holder replica: every turn consults
+    then records the REAL PrefixAffinityMap (proxy lookup-then-record
+    semantics), and every finished turn is recorded into the engine's
+    ConvIndex — the same feed /kv/summary scrapes — so the fleet
+    directory built afterwards reflects what this replica holds."""
+    from gpustack_tpu.engine.engine import GenRequest
+    from gpustack_tpu.server.resilience import conversation_chain
+
+    system, users = schedule
+    recs = []
+    for c, conv in enumerate(users):
+        history = list(system)
+        for t, user in enumerate(conv):
+            history += user
+            chain = conversation_chain(model_name, _fleet_msgs(c, t))
+            routed = affinity.lookup(chain)
+            affinity.record(chain[-1], replica_id, 1)
+            req = engine.generate(
+                GenRequest(
+                    prompt_ids=list(history),
+                    max_tokens=prof["output_len"],
+                    temperature=0.0, stop_ids=(),
+                ),
+                timeout=7200,
+            )
+            recs.append({
+                "conv": c, "turn": t, "prompt_len": len(history),
+                "ttft_ms": req.ttft_ms,
+                "reused": req.prefix_tokens_reused,
+                "affinity_routed": routed,
+                "output_ids": list(req.output_ids),
+                "req": req,
+            })
+            history += req.output_ids
+            _wait_for_cache_store(engine, history)
+            if getattr(engine, "kv_conv", None) is not None:
+                engine.kv_conv.record(chain, history)
+    return recs
+
+
+def run_cold_fleet_directory(src, dst, prof, schedule, directory,
+                             model_name, src_id=1):
+    """Directory-routed pass on a COLD second replica: per turn the
+    fleet directory is consulted with the proxy's conversation chain;
+    a hit names the holder replica, whose blocks travel the real wire
+    codec (engine/kv_transfer.py, `have` dedup) into the cold
+    replica's host cache before the turn runs there — the in-process
+    equivalent of the /kv/pull prefetch path. Returns (records, pull
+    accounting)."""
+    from gpustack_tpu.engine import kv_transfer as kt
+    from gpustack_tpu.engine.engine import GenRequest
+    from gpustack_tpu.server.resilience import conversation_chain
+
+    system, users = schedule
+    recs = []
+    pull = {"blocks": 0, "bytes": 0, "seconds": 0.0, "pulls": 0}
+    for c, conv in enumerate(users):
+        history = list(system)
+        for t, user in enumerate(conv):
+            history += user
+            chain = conversation_chain(model_name, _fleet_msgs(c, t))
+            hit = directory.lookup(chain)
+            pulled = 0
+            if hit is not None and hit.instance_id == src_id:
+                t0 = time.time()
+                probe = list(history) + [0]
+                have = dst.host_kv_cache.prefix_keys(probe)
+                frames = kt.decode_stream(b"".join(
+                    kt.export_frames(
+                        src.host_kv_cache, probe, have=have
+                    )
+                ))
+                attached, _, bytes_in = kt.import_frames(
+                    dst.host_kv_cache, frames
+                )
+                pull["seconds"] += time.time() - t0
+                pull["blocks"] += attached
+                pull["bytes"] += bytes_in
+                pull["pulls"] += 1
+                pulled = attached
+            req = dst.generate(
+                GenRequest(
+                    prompt_ids=list(history),
+                    max_tokens=prof["output_len"],
+                    temperature=0.0, stop_ids=(),
+                ),
+                timeout=7200,
+            )
+            recs.append({
+                "conv": c, "turn": t, "prompt_len": len(history),
+                "ttft_ms": req.ttft_ms,
+                "reused": req.prefix_tokens_reused,
+                "pulled_blocks": pulled,
+                "output_ids": list(req.output_ids),
+                "req": req,
+            })
+            history += req.output_ids
+            _wait_for_cache_store(dst, history)
+    pull["seconds"] = round(pull["seconds"], 4)
+    return recs, pull
+
+
+def summarize_cold_fleet(cold_recs, aff_recs, dir_recs, affinity,
+                         directory, pull):
+    """detail.cold_fleet: warm-turn (turn > 0) TTFT for the affinity
+    pass (holder replica, local cache) and the directory pass (cold
+    replica warmed over the wire) against the colocated cold baseline;
+    cross-replica shared-prefix hits; pull cost; greedy token parity
+    across all three passes."""
+    def warm_ttfts(recs):
+        return [r["ttft_ms"] for r in recs if r["turn"] > 0]
+
+    parity = all(
+        c["output_ids"] == a["output_ids"]
+        for c, a in zip(cold_recs, aff_recs)
+    ) and all(
+        c["output_ids"] == d["output_ids"]
+        for c, d in zip(cold_recs, dir_recs)
+    )
+    cold_p50 = _p50(warm_ttfts(cold_recs))
+    aff_p50 = _p50(warm_ttfts(aff_recs))
+    dir_p50 = _p50(warm_ttfts(dir_recs))
+    # a cross-replica hit: a turn on the cold replica that both pulled
+    # blocks over the wire and actually reused prefix tokens
+    cross = sum(
+        1 for r in dir_recs
+        if r.get("pulled_blocks", 0) > 0 and r["reused"] > 0
+    )
+    lookups = affinity.hits + affinity.misses
+    snap = directory.snapshot()
+    return {
+        "conversations": len({r["conv"] for r in dir_recs}),
+        "cold_ttft_ms_p50": round(cold_p50, 1),
+        "affinity_warm_ttft_ms_p50": round(aff_p50, 1),
+        "directory_warm_ttft_ms_p50": round(dir_p50, 1),
+        # the acceptance lever: directory-routed warm turns on the
+        # cold replica vs affinity-warm turns on the holder
+        "directory_vs_affinity": (
+            round(dir_p50 / aff_p50, 3) if aff_p50 else None
+        ),
+        "ttft_improvement": (
+            round(1.0 - dir_p50 / cold_p50, 3) if cold_p50 else None
+        ),
+        "cross_replica_hits": cross,
+        "pull": pull,
+        "affinity": {
+            "hits": affinity.hits,
+            "misses": affinity.misses,
+            "hit_rate": (
+                round(affinity.hits / lookups, 3) if lookups else None
+            ),
+        },
+        "directory": {
+            "hits": snap["hits"],
+            "misses": snap["misses"],
+            "keys": snap["keys"],
+            "stale_routes": snap["stale_routes"],
+        },
+        "token_parity": parity,
+        "prefix_tokens_reused_remote": sum(
+            r["reused"] for r in dir_recs
+        ),
+    }
+
+
 def _run_profile_pass(engine, prof, warm_prompt, prompts, closed_loop):
     """Warm up (compile), then drive one timed pass of ``prompts``
     through ``engine``. Returns (wall_s, finished requests). Pure in
@@ -1203,6 +1402,17 @@ def main() -> None:
                 prefill_chunk=0, host_kv_cache_mb=64,
                 kv_block_tokens=16, long_context=True,
             )
+        elif prof.get("cold_fleet"):
+            # scaled fleet-warmup smoke: small blocks so the shared
+            # system prefix spans many blocks and the cross-replica
+            # pull moves real frames; 3 turns → 2 warm-turn TTFT
+            # samples per conversation on each pass
+            prof = dict(
+                conversations=3, turns=3, system_len=384, user_len=96,
+                output_len=12, max_slots=2, max_seq_len=2048,
+                prefill_chunk=0, host_kv_cache_mb=64,
+                kv_block_tokens=16, cold_fleet=True,
+            )
         else:
             prof = dict(
                 prompt_len=56, output_len=16, num_requests=6,
@@ -1223,6 +1433,7 @@ def main() -> None:
 
     multiturn_detail = None
     long_context_detail = None
+    cold_fleet_detail = None
     mt_ctx = prompts = warm_prompt = None
     closed_loop = bool(prof.get("closed_loop"))
     if prof.get("long_context"):
@@ -1272,6 +1483,63 @@ def main() -> None:
             cold_recs, hit_recs, disagg_recs, amap, handoff
         )
         reqs = [r["req"] for r in hit_recs]
+    elif prof.get("cold_fleet"):
+        # Three passes over ONE seeded schedule: colocated cold (cache
+        # detached) → affinity-warm on the holder replica (REAL
+        # PrefixAffinityMap, ConvIndex fed per turn) → directory-warm
+        # on a SECOND replica built cold, warmed per turn through the
+        # REAL ClusterKVDirectory + wire-codec pull. Warmups compile
+        # every prefill bucket and prefix-continuation key per engine
+        # (two warmup conversations: the second exercises the cross-
+        # conversation match shape).
+        from gpustack_tpu.server.kv_directory import ClusterKVDirectory
+        from gpustack_tpu.server.resilience import PrefixAffinityMap
+
+        schedule = multiturn_schedule(0, vocab, prof)
+        warm_sched = multiturn_schedule(
+            1, vocab,
+            dict(prof, conversations=min(2, prof["conversations"])),
+        )
+        cache = engine.host_kv_cache
+        engine.host_kv_cache = None
+        run_multiturn(engine, prof, warm_sched)
+        cold_recs = run_multiturn(engine, prof, schedule)
+        engine.host_kv_cache = cache
+        run_multiturn(engine, prof, warm_sched)
+        amap = PrefixAffinityMap()
+        t0 = time.time()
+        aff_recs = run_cold_fleet_affinity(
+            engine, prof, schedule, amap, "bench-cf", replica_id=1
+        )
+        wall = time.time() - t0
+        # the fleet directory, fed exactly as the scrape loop feeds
+        # it: the holder replica's ConvIndex summary with residency
+        # re-checked against its cache NOW
+        directory = ClusterKVDirectory()
+        directory.update(
+            1, 1, engine.kv_conv.summary(engine.host_kv_cache)
+        )
+        # replica 2: built completely cold (its own cache, its own
+        # warmup on independent tokens — compile, not content)
+        dst = build_engine(
+            cfg_name, prof["max_slots"], prof["max_seq_len"],
+            prof["prefill_chunk"], on_tpu,
+            host_kv_cache_mb=prof.get("host_kv_cache_mb", 0),
+            kv_block_tokens=prof.get("kv_block_tokens", 0),
+            kv_cache_int8=prof.get("kv_cache_int8", False),
+        )
+        dst.start()
+        run_multiturn(dst, prof, warm_sched)
+        dir_recs, pull = run_cold_fleet_directory(
+            engine, dst, prof, schedule, directory, "bench-cf",
+            src_id=1,
+        )
+        dst.stop()
+        engine.stop()
+        cold_fleet_detail = summarize_cold_fleet(
+            cold_recs, aff_recs, dir_recs, amap, directory, pull
+        )
+        reqs = [r["req"] for r in aff_recs]
     elif prof.get("multiturn"):
         # Two passes over the SAME seeded schedule: cache-off (cold)
         # then the cache-on engine built above (hit), pairing each
@@ -1433,9 +1701,11 @@ def main() -> None:
         not on_tpu
         and os.environ.get("BENCH_OVERLAP_COMPARE", "1") == "1"
         and pipeline_depth > 0
-        # long-context measures routing/handoff, not overlap: a serial
-        # rerun of three passes would double its wall for no signal
+        # long-context and cold-fleet measure routing/handoff, not
+        # overlap: a serial rerun of their multi-pass flows would
+        # double their wall for no signal
         and not prof.get("long_context")
+        and not prof.get("cold_fleet")
     ):
         serial_engine = build_engine(
             cfg_name, prof["max_slots"], prof["max_seq_len"],
@@ -1513,6 +1783,8 @@ def main() -> None:
         result["detail"]["multiturn"] = multiturn_detail
     if long_context_detail is not None:
         result["detail"]["long_context"] = long_context_detail
+    if cold_fleet_detail is not None:
+        result["detail"]["cold_fleet"] = cold_fleet_detail
     if overlap_cmp is not None:
         result["detail"]["overlap_comparison"] = overlap_cmp
     result["detail"]["pipeline_depth"] = pipeline_depth

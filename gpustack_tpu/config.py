@@ -112,6 +112,18 @@ class Config(pydantic.BaseModel):
     # prefill. Engines read the matching env var directly (subprocesses
     # inherit the worker's environment).
     kv_handoff_timeout: float = 10.0
+    # ---- fleet KV fabric (server/kv_directory.py; docs/KV_CACHE.md) -----
+    # period of the server's per-replica /kv/summary scrape that keeps
+    # the cluster block directory fresh (it also ships fleet sharing
+    # counts back down to the engines' eviction economics)
+    kv_directory_refresh_s: float = 5.0
+    # bound on directory keys retained per replica (deepest resident
+    # runs win past the cap) AND on keys requested per scrape
+    kv_directory_max_keys: int = 4096
+    # drain-time warm-ahead: how many of a draining replica's hottest
+    # conversations are pulled to a sibling before its engine exits;
+    # 0 disables the prefetcher
+    kv_prefetch_conversations: int = 0
     # worker: graceful drain — wait for the reverse proxy's in-flight
     # count to reach zero (bounded) before SIGTERM on stop/recreate
     drain_timeout: float = 30.0

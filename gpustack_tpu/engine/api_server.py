@@ -134,12 +134,21 @@ class OpenAIServer:
                 # handoff"): content-addressed block export/import
                 web.post("/kv/export", self.kv_export),
                 web.post("/kv/import", self.kv_import),
+                # fleet KV fabric (docs/KV_CACHE.md "Fleet KV fabric"):
+                # directory scrape + background prefetch trigger
+                web.post("/kv/summary", self.kv_summary),
+                web.post("/kv/pull", self.kv_pull),
             ]
         )
         self._started = time.time()
         # lazy session for pulling handed-off KV from a peer replica
         # (the X-GPUStack-KV-Source request header names the source)
         self._kv_session = None
+        # in-flight background prefetch pulls (strong refs) + outcome
+        # counters for the gpustack_kv_prefetch_total metric family
+        self._kv_pulls: set = set()
+        self.prefetch_ok = 0
+        self.prefetch_failed = 0
 
     # ---- endpoints ------------------------------------------------------
 
@@ -220,6 +229,55 @@ class OpenAIServer:
         lines.append(
             f"gpustack_kv_handoff_failures_total {ho.failures}"
         )
+        # fleet KV fabric: disk spill tier + background prefetch
+        cache = self.engine.host_kv_cache
+        spill = cache.spill if cache is not None else None
+        if spill is not None:
+            s = spill.snapshot()
+            for family, series in (
+                (
+                    "gpustack_kv_spill_bytes_total",
+                    (
+                        ("out", s["bytes_spilled"]),
+                        ("in", s["bytes_loaded"]),
+                    ),
+                ),
+                (
+                    "gpustack_kv_spill_blocks_total",
+                    (
+                        ("out", s["blocks_spilled"]),
+                        ("in", s["blocks_loaded"]),
+                    ),
+                ),
+            ):
+                lines.append(
+                    f"# TYPE {family} {METRIC_FAMILIES[family]}"
+                )
+                for direction, value in series:
+                    lines.append(
+                        f'{family}{{direction="{direction}"}} {value}'
+                    )
+            for family, value in (
+                ("gpustack_kv_spill_resident_bytes", s["bytes"]),
+                ("gpustack_kv_spill_corrupt_total", s["corrupt"]),
+                ("gpustack_kv_spill_evictions_total", s["evictions"]),
+                (
+                    "gpustack_kv_spill_faultbacks_total",
+                    cache.faultbacks,
+                ),
+            ):
+                lines.append(
+                    f"# TYPE {family} {METRIC_FAMILIES[family]}"
+                )
+                lines.append(f"{family} {value}")
+        if cache is not None:
+            family = "gpustack_kv_prefetch_total"
+            lines.append(f"# TYPE {family} {METRIC_FAMILIES[family]}")
+            for result, value in (
+                ("ok", self.prefetch_ok),
+                ("failed", self.prefetch_failed),
+            ):
+                lines.append(f'{family}{{result="{result}"}} {value}')
         # flight recorder: per-step scheduler telemetry (step-time
         # histogram by mode, real-vs-padded dispatch, occupancy, queue
         # wait, speculation economics — observability/flight.py)
@@ -325,12 +383,19 @@ class OpenAIServer:
             prompt_ids = [int(t) for t in body.get("prompt_ids") or []]
         except (json.JSONDecodeError, TypeError, ValueError):
             return _error(400, "invalid JSON body")
-        if not prompt_ids:
-            return _error(400, "missing 'prompt_ids'")
+        # tail_key mode (fleet prefetch): the puller has no tokens —
+        # only the directory-advertised chain key of the deepest block
+        # — so the export walks parent pointers instead of the prompt
+        tail_key = str(body.get("tail_key") or "")
+        if not prompt_ids and not tail_key:
+            return _error(400, "missing 'prompt_ids' or 'tail_key'")
         have = [str(k) for k in body.get("have") or []]
-        want_blocks = (len(prompt_ids) - 1) // cache.block_tokens
+        want_blocks = (
+            (len(prompt_ids) - 1) // cache.block_tokens
+            if prompt_ids else 0
+        )
         loop = asyncio.get_running_loop()
-        if body.get("prefill") and want_blocks > 0:
+        if prompt_ids and body.get("prefill") and want_blocks > 0:
             held = await loop.run_in_executor(
                 None, cache.peek_prefix_len, prompt_ids
             )
@@ -350,7 +415,11 @@ class OpenAIServer:
             have_set = frozenset(have)
             chunks = [MAGIC]
             payload_blocks = 0
-            for blk in cache.export_blocks(prompt_ids):
+            blocks = (
+                cache.export_blocks(prompt_ids) if prompt_ids
+                else cache.export_chain(tail_key)
+            )
+            for blk in blocks:
                 frame, carried = encode_block(blk, have_set)
                 chunks.append(frame)
                 payload_blocks += int(carried)
@@ -447,6 +516,133 @@ class OpenAIServer:
             "tokens": len(tokens),
             "bytes": bytes_in,
         })
+
+    async def kv_summary(self, request: web.Request) -> web.Response:
+        """The cluster KV directory's scrape: fold the server-reported
+        fleet sharing counts into local eviction economics, then return
+        this replica's bounded prefix-key summary (conversation-hash →
+        resident block depth + deepest RAM chain key) re-checked
+        against BOTH cache tiers right now.
+
+        Body (all optional): ``{"sharing": {hash: replica_count},
+        "max_keys": n}``. One round-trip carries both directions."""
+        eng = self.engine
+        cache = eng.host_kv_cache
+        conv = getattr(eng, "kv_conv", None)
+        if cache is None or conv is None:
+            return _error(404, "engine has no host KV cache")
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        sharing = body.get("sharing") or {}
+        if not isinstance(sharing, dict):
+            return _error(400, "'sharing' must be an object")
+        from gpustack_tpu.engine.kv_fabric import DEFAULT_SUMMARY_KEYS
+
+        try:
+            max_keys = int(body.get("max_keys") or DEFAULT_SUMMARY_KEYS)
+        except (TypeError, ValueError):
+            return _error(400, "'max_keys' must be an integer")
+        loop = asyncio.get_running_loop()
+
+        def scrape():
+            boosted = conv.apply_sharing(cache, sharing)
+            summary = conv.summary(cache, max_keys=max(1, max_keys))
+            summary["sharing_boosted"] = boosted
+            return summary
+
+        return web.json_response(
+            await loop.run_in_executor(None, scrape)
+        )
+
+    async def kv_pull(self, request: web.Request) -> web.Response:
+        """Background prefetch trigger (the fleet fabric's low-priority
+        warm-ahead): pull a conversation's block chain from a peer
+        replica by its directory-advertised tail chain key. Returns 202
+        immediately — the pull runs as a background task so the caller
+        (the server's prefetcher) never blocks on transfer time, and a
+        dead/slow source degrades to "stayed cold", counted."""
+        eng = self.engine
+        cache = eng.host_kv_cache
+        if cache is None:
+            return _error(404, "engine has no host KV cache")
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _error(400, "invalid JSON body")
+        source = str(body.get("source") or "")
+        tail_key = str(body.get("tail_key") or "")
+        if not source or not tail_key:
+            return _error(400, "missing 'source' or 'tail_key'")
+        auth = str(body.get("auth") or "")
+        task = asyncio.get_running_loop().create_task(
+            self._kv_pull_chain(source, auth, tail_key)
+        )
+        self._kv_pulls.add(task)
+        task.add_done_callback(self._kv_pulls.discard)
+        return web.json_response({"accepted": True}, status=202)
+
+    async def _kv_pull_chain(
+        self, source: str, auth: str, tail_key: str
+    ) -> None:
+        """The prefetch pull itself: stream the peer's chain export,
+        land it through the stager. Failures are counted + logged,
+        never raised — prefetch is advisory."""
+        import aiohttp
+
+        eng = self.engine
+        cache = eng.host_kv_cache
+        from gpustack_tpu.engine.kv_transfer import (
+            FrameDecoder,
+            prepare_import,
+        )
+
+        timeout = self._handoff_timeout()
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        try:
+            if self._kv_session is None or self._kv_session.closed:
+                self._kv_session = aiohttp.ClientSession()
+            headers = {"Authorization": auth} if auth else {}
+            decoder = FrameDecoder()
+            frames: list = []
+            async with self._kv_session.post(
+                source,
+                json={"tail_key": tail_key},
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=timeout),
+            ) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"peer answered HTTP {resp.status}")
+                async for chunk in resp.content.iter_any():
+                    frames.extend(decoder.feed(chunk))
+            if not frames:
+                raise RuntimeError("peer exported no blocks")
+            tokens, prepared, bytes_in = await loop.run_in_executor(
+                None, prepare_import, cache, frames
+            )
+            fut = await loop.run_in_executor(
+                None, eng.kv_import_prepared, tokens, prepared
+            )
+            blocks = await asyncio.wait_for(
+                asyncio.wrap_future(fut),
+                max(0.5, timeout - (time.perf_counter() - t0)),
+            )
+            eng.kv_handoff.bytes_in += bytes_in
+            self.prefetch_ok += 1
+            logger.info(
+                "kv prefetch from %s landed %d block(s) (%d bytes)",
+                source, blocks, bytes_in,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — advisory: stay cold
+            self.prefetch_failed += 1
+            logger.warning(
+                "kv prefetch from %s failed (replica stays cold): %s",
+                source, str(e) or type(e).__name__,
+            )
 
     async def _kv_prefetch(
         self, request: web.Request, source: str, prompt_ids
@@ -570,6 +766,15 @@ class OpenAIServer:
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             return _error(400, "missing 'messages'")
+        if getattr(self.engine, "kv_conv", None) is not None:
+            # same rolling message-prefix hashes the proxy's affinity
+            # map and the cluster KV directory key on — recorded at
+            # finish (with the generated ids) via _record_conv
+            from gpustack_tpu.server.resilience import conversation_chain
+
+            request["conv_chain"] = conversation_chain(
+                self.model_name, messages
+            )
 
         tools = body.get("tools") or []
         tool_choice = body.get("tool_choice", "auto")
@@ -1031,6 +1236,7 @@ class OpenAIServer:
             if not gen.done.is_set():
                 return _error(504, "generation timed out")
         self._trace_kv(request, gens)
+        self._record_conv(request, gens)
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{gens[0].request_id}"
         # usage is billed on what the CLIENT sent + everything actually
         # generated (incl. discarded schema-retry attempts) — a swapped
@@ -1280,7 +1486,26 @@ class OpenAIServer:
             await write(final)
         await resp.write(b"data: [DONE]\n\n")
         self._trace_kv(request, gens)
+        self._record_conv(request, gens)
         return resp
+
+    def _record_conv(
+        self, request: web.Request, gens: List[GenRequest]
+    ) -> None:
+        """Feed the conversation index (engine/kv_fabric.ConvIndex) at
+        chat finish: the message-prefix hash chain (stashed on the
+        request by chat_completions) plus the token sequence whose KV
+        blocks now live in the cache (prompt + generated — what turn
+        N+1 will prefix-match)."""
+        chain = request.get("conv_chain")
+        conv = getattr(self.engine, "kv_conv", None)
+        if not chain or conv is None:
+            return
+        g = gens[0]
+        try:
+            conv.record(chain, list(g.prompt_ids) + list(g.output_ids))
+        except Exception:  # noqa: BLE001 — accounting must never 500
+            logger.exception("conversation-index record failed")
 
     @staticmethod
     def _trace_kv(request: web.Request, gens: List[GenRequest]) -> None:
@@ -1433,6 +1658,8 @@ def build_engine_from_args(args) -> LLMEngine:
         prefill_chunk=getattr(args, "prefill_chunk", 0),
         pipeline_depth=pipeline_depth,
         kv_role=getattr(args, "kv_role", ""),
+        kv_spill_mb=getattr(args, "kv_spill_mb", 0),
+        kv_spill_dir=getattr(args, "kv_spill_dir", ""),
     )
     if vlm_cfg is not None:
         from gpustack_tpu.models.vlm import VisionBundle, init_vision_params
@@ -1527,6 +1754,17 @@ def main(argv=None) -> None:
         "prompt KV and export it at POST /kv/export; decode replicas "
         "pull handed-off blocks and own the token loop. Empty = "
         "colocated (both roles)",
+    )
+    p.add_argument(
+        "--kv-spill-mb", type=int, default=0,
+        help="disk spill tier budget under the host KV cache (MiB): "
+        "blocks evicted from host RAM spill to one content-addressed "
+        "file each and fault back on a later prefix hit; 0 disables",
+    )
+    p.add_argument(
+        "--kv-spill-dir", default="",
+        help="spill-tier directory (default: a per-process tmp dir; "
+        "reusing a directory across restarts keeps the tier warm)",
     )
     p.add_argument(
         "--kv-cache-int8", action="store_true",

@@ -37,7 +37,9 @@ import concurrent.futures
 import dataclasses
 import itertools
 import logging
+import os
 import queue
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -446,6 +448,8 @@ class LLMEngine:
         prefill_chunk: int = 0,      # >0: chunked prefill (tokens/chunk)
         pipeline_depth: int = _FETCH_LAG,  # 0 = serial reference mode
         kv_role: str = "",           # ""|"prefill"|"decode" (disagg tag)
+        kv_spill_mb: int = 0,        # >0: disk spill tier under host RAM
+        kv_spill_dir: str = "",      # spill directory ("" = derived tmp)
     ):
         self.cfg = cfg
         self.tokenizer = tokenizer or load_tokenizer(model_dir)
@@ -543,6 +547,7 @@ class LLMEngine:
         self.host_kv_cache = None
         self._kv_copy_pool = None
         self._kv_stage = None
+        self.kv_conv = None
         # disaggregated-serving role tag (ModelSpec prefill_replicas /
         # decode_replicas → backends --kv-role): advisory — the engine
         # serves whatever arrives; the proxy's routing and the KV
@@ -571,6 +576,22 @@ class LLMEngine:
                 ),
                 int8=kv_cache_int8,
             )
+            if kv_spill_mb > 0:
+                from gpustack_tpu.engine.kv_spill import DiskKVSpill
+
+                spill_dir = kv_spill_dir or os.path.join(
+                    tempfile.gettempdir(),
+                    f"gpustack-kv-spill-{os.getpid()}",
+                )
+                self.host_kv_cache.spill = DiskKVSpill(
+                    spill_dir, kv_spill_mb * 2**20
+                )
+            # conversation index feeding the cluster KV directory:
+            # the API layer records (message-chain hashes, token ids)
+            # at chat finish; /kv/summary snapshots block residency
+            from gpustack_tpu.engine.kv_fabric import ConvIndex
+
+            self.kv_conv = ConvIndex()
             # device→host KV copies run off-thread: a synchronous PCIe
             # pull of a whole bucket's KV would stall the scheduler
             # thread (and every decoding slot) on each prefill miss
@@ -768,6 +789,21 @@ class LLMEngine:
             # role tag + wire-transfer accounting
             "kv_role": self.kv_role,
             "kv_handoff": self.kv_handoff.snapshot(),
+            # fleet KV fabric (docs/KV_CACHE.md "Fleet KV fabric"):
+            # disk spill tier counters + fault-backs + the bounded
+            # conversation index feeding the cluster directory
+            "kv_spill": (
+                self.host_kv_cache.spill.snapshot()
+                if self.host_kv_cache and self.host_kv_cache.spill
+                else {}
+            ),
+            "kv_faultbacks": (
+                self.host_kv_cache.faultbacks
+                if self.host_kv_cache else 0
+            ),
+            "kv_conversations": (
+                len(self.kv_conv) if self.kv_conv else 0
+            ),
         }
 
     # ---- scheduling loop ------------------------------------------------

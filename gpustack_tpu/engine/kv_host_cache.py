@@ -71,7 +71,7 @@ class _Block:
     __slots__ = (
         "key", "tokens", "parent", "children", "refs",
         "k", "v", "k_scale", "v_scale", "dtype",
-        "nbytes", "last_used",
+        "nbytes", "last_used", "touches", "sharing",
     )
 
     def __init__(self, key: bytes, tokens: Tuple[int, ...], parent):
@@ -85,6 +85,8 @@ class _Block:
         self.dtype = None
         self.nbytes = 0
         self.last_used = 0
+        self.touches = 0     # local reuse count (walk touches)
+        self.sharing = 0     # fleet sharing (directory-reported)
 
 
 class HostKVCache:
@@ -113,12 +115,17 @@ class HostKVCache:
         self._bytes = 0
         self._tick = 0
         self._lock = threading.Lock()
+        # optional disk spill tier (engine/kv_spill.DiskKVSpill):
+        # eviction victims spill instead of dropping; matches extend
+        # into disk residency and fault back on gather
+        self.spill = None
         self.hits = 0            # match_prefix calls that matched >= 1 block
         self.misses = 0          # match_prefix calls that matched nothing
         self.prefix_hits = 0     # matches the engine actually consumed
         self.prefix_tokens_reused = 0   # tokens the engine skipped prefilling
         self.blocks_inserted = 0
         self.blocks_evicted = 0
+        self.faultbacks = 0      # disk blocks pulled back into RAM runs
 
     # ---- keys -----------------------------------------------------------
 
@@ -154,7 +161,42 @@ class HostKVCache:
                 self._tick += 1
                 for blk in run:
                     blk.last_used = self._tick
+                    blk.touches += 1
         return run
+
+    def _disk_extension(
+        self, prompt, parent_key: bytes, start_b: int, max_blocks: int
+    ) -> List[str]:
+        """Hex chain keys of the contiguous DISK-resident continuation
+        of a RAM run ending at ``parent_key``. Chain keys derive from
+        the tokens alone (content addressing), so no trie state is
+        needed — residency probes are in-memory index lookups on the
+        spill tier, never file I/O."""
+        keys: List[str] = []
+        spill = self.spill
+        if spill is None:
+            return keys
+        bt = self.block_tokens
+        key = parent_key
+        # bound the extension by what the RAM budget can actually hold
+        # after fault-back (spill file size ≈ block nbytes): matching
+        # deeper than RAM fits would make every gather fail and
+        # cold-start — worse than consuming the fittable prefix
+        budget = self.max_bytes - start_b * self._avg_block_bytes()
+        for b in range(start_b, max_blocks):
+            key = self._child_key(key, prompt[b * bt : (b + 1) * bt])
+            key_hex = key.hex()
+            size = spill.size(key_hex)
+            if size <= 0 or size > budget:
+                break
+            budget -= size
+            keys.append(key_hex)
+        return keys
+
+    def _avg_block_bytes(self) -> int:
+        with self._lock:
+            n = len(self._blocks)
+            return (self._bytes // n) if n else 0
 
     def match_prefix_len(self, prompt_ids) -> int:
         """Length of the longest cached block run that is a proper
@@ -169,12 +211,15 @@ class HostKVCache:
         max_blocks = (len(prompt) - 1) // self.block_tokens
         run = self._walk(prompt, max_blocks, touch=True) if max_blocks > 0 \
             else []
+        disk = self._disk_extension(
+            prompt, run[-1].key if run else b"", len(run), max_blocks
+        )
         with self._lock:
-            if run:
+            if run or disk:
                 self.hits += 1
             else:
                 self.misses += 1
-        return len(run) * self.block_tokens
+        return (len(run) + len(disk)) * self.block_tokens
 
     def peek_prefix_len(self, prompt_ids) -> int:
         """Like :meth:`match_prefix_len` but side-effect free (no
@@ -184,8 +229,11 @@ class HostKVCache:
         max_blocks = (len(prompt) - 1) // self.block_tokens
         if max_blocks <= 0:
             return 0
-        return len(self._walk(prompt, max_blocks, touch=False)) \
-            * self.block_tokens
+        run = self._walk(prompt, max_blocks, touch=False)
+        disk = self._disk_extension(
+            prompt, run[-1].key if run else b"", len(run), max_blocks
+        )
+        return (len(run) + len(disk)) * self.block_tokens
 
     def gather_prefix(
         self, prompt_ids, length: int
@@ -202,12 +250,68 @@ class HostKVCache:
         prompt = tuple(int(t) for t in prompt_ids[:length])
         run = self._walk(prompt, length // bt, touch=True)
         if len(run) * bt < length:
-            return None
+            # disk fault-back: the probe counted spilled blocks toward
+            # the match; pull them into RAM (this method already runs
+            # on the kv-copy executor via the engine's stager, so the
+            # file reads never block dispatch). Any defect degrades to
+            # None — the caller cold-starts.
+            if not self._fault_back(prompt, length // bt):
+                return None
+            run = self._walk(prompt, length // bt, touch=True)
+            if len(run) * bt < length:
+                return None
         # assembly OUTSIDE the lock: block arrays are immutable once
         # attached (eviction only drops references)
         k = np.concatenate([self._block_k(b) for b in run], axis=1)
         v = np.concatenate([self._block_v(b) for b in run], axis=1)
         return k, v
+
+    def _fault_back(self, prompt: Tuple[int, ...], n_blocks: int) -> bool:
+        """Pull the first ``n_blocks`` of ``prompt`` that live only on
+        the spill tier back into the RAM trie. Returns True when the
+        whole run is RAM-resident afterwards. A missing, corrupt, or
+        content-mismatched spill file reads as False (cold prefill) —
+        never a crash, never wrong bytes (tokens inside the verified
+        frame must equal the prompt's block)."""
+        spill = self.spill
+        if spill is None:
+            return False
+        from gpustack_tpu.engine.kv_transfer import _to_cache_tier
+
+        bt = self.block_tokens
+        with self._lock:
+            resident = set(self._blocks.keys())
+        prepared: Dict[int, Tuple] = {}
+        key = b""
+        complete = True
+        for b in range(n_blocks):
+            block = prompt[b * bt : (b + 1) * bt]
+            key = self._child_key(key, block)
+            if key in resident:
+                continue
+            frame = spill.load(key.hex())
+            if frame is None:
+                complete = False
+                break
+            if tuple(frame.tokens) != block:
+                # file content does not match its key (rename, foreign
+                # file): corruption — quarantine and read as a miss
+                spill.corrupt += 1
+                spill.remove(key.hex())
+                complete = False
+                break
+            prepared[b] = _to_cache_tier(self, frame)
+        if prepared:
+            with self._lock:
+                _, victims = self._attach_prepared_locked(
+                    prompt[: n_blocks * bt], n_blocks, prepared
+                )
+                self.faultbacks += len(prepared)
+            self._spill_victims(victims)
+        # the caller's re-walk is the ground truth for whether the run
+        # is fully resident now; ``complete`` short-circuits the walk
+        # when a load already failed
+        return complete
 
     def prefix_keys(self, prompt_ids) -> List[str]:
         """Hex chain keys of the longest cached block run prefixing
@@ -221,6 +325,79 @@ class HostKVCache:
         return [
             b.key.hex()
             for b in self._walk(prompt, max_blocks, touch=False)
+        ]
+
+    def resident_keys(
+        self, prompt_ids
+    ) -> Tuple[List[str], List[str]]:
+        """``(ram_keys, disk_keys)`` of the longest resident block run
+        prefixing ``prompt_ids`` across both tiers (side-effect free).
+        ``prefix_keys`` stays RAM-only on purpose — it feeds the wire
+        ``have`` dedup, and a skipped frame for a disk-resident block
+        would end the import's attach run at the RAM trie gap."""
+        prompt = tuple(int(t) for t in prompt_ids)
+        max_blocks = (len(prompt) - 1) // self.block_tokens
+        if max_blocks <= 0:
+            return [], []
+        run = self._walk(prompt, max_blocks, touch=False)
+        disk = self._disk_extension(
+            prompt, run[-1].key if run else b"", len(run), max_blocks
+        )
+        return [b.key.hex() for b in run], disk
+
+    def boost_sharing(self, keys_hex, count: int) -> int:
+        """Record the fleet-wide sharing count the cluster directory
+        reports for these chain keys — the eviction score divides by
+        it, so a block many replicas hold locally (a shared system
+        prompt) outlives cold per-conversation suffixes. Returns how
+        many resident blocks were updated."""
+        count = max(0, int(count))
+        updated = 0
+        with self._lock:
+            for key_hex in keys_hex:
+                try:
+                    blk = self._blocks.get(bytes.fromhex(key_hex))
+                except ValueError:
+                    continue
+                if blk is not None and blk.sharing < count:
+                    blk.sharing = count
+                    updated += 1
+        return updated
+
+    def export_chain(self, tail_key_hex: str) -> List[dict]:
+        """The RAM-resident block chain ending at ``tail_key_hex``
+        (root → tail), in the same dict shape as :meth:`export_blocks`
+        — the prefetch export path, which is keyed by chain key because
+        the puller has no token ids, only the directory's summary."""
+        try:
+            tail = bytes.fromhex(tail_key_hex)
+        except ValueError:
+            return []
+        chain: List[_Block] = []
+        with self._lock:
+            node = self._blocks.get(tail)
+            self._tick += 1
+            while node is not None and node is not self._root:
+                node.last_used = self._tick
+                chain.append(node)
+                node = node.parent
+        chain.reverse()
+        return [
+            {
+                "key": b.key.hex(),
+                "tokens": b.tokens,
+                "k": b.k,
+                "v": b.v,
+                "k_scale": b.k_scale,
+                "v_scale": b.v_scale,
+                "dtype": (
+                    "bfloat16"
+                    if str(b.dtype) == "bfloat16"
+                    else np.dtype(b.dtype).name
+                ),
+                "nbytes": b.nbytes,
+            }
+            for b in chain
         ]
 
     def export_blocks(
@@ -269,9 +446,11 @@ class HostKVCache:
         if n_blocks <= 0:
             return 0
         with self._lock:
-            return self._attach_prepared_locked(
+            inserted, victims = self._attach_prepared_locked(
                 tokens, n_blocks, prepared
             )
+        self._spill_victims(victims)
+        return inserted
 
     def match_prefix(
         self, prompt_ids
@@ -349,14 +528,16 @@ class HostKVCache:
                     bk, bv, None, k.dtype, bk.nbytes + bv.nbytes
                 )
         with self._lock:
-            return self._attach_prepared_locked(
+            inserted, victims = self._attach_prepared_locked(
                 tokens, n_blocks, prepared
             )
+        self._spill_victims(victims)
+        return inserted
 
     def _attach_prepared_locked(
         self, tokens: Tuple[int, ...], n_blocks: int,
         prepared: Dict[int, Tuple],
-    ) -> int:
+    ) -> Tuple[int, List[_Block]]:
         """Attach phase shared by the local store (insert_sequence) and
         the wire import (import_blocks): re-walk from the root — the
         trie may have changed since any earlier walk (concurrent
@@ -395,29 +576,63 @@ class HostKVCache:
             self.blocks_inserted += 1
             inserted += 1
             node = child
-        self._evict_locked()
-        return inserted
+        return inserted, self._evict_locked()
 
-    def _evict_locked(self) -> None:
-        """Drop LRU leaf blocks until back under budget. Leaf-only:
-        ``refs > 0`` means children still extend this block. O(#leaves)
-        per evicted block — fine at the hundreds-to-thousands of blocks
-        a host-RAM budget holds."""
+    def _eviction_score(self, blk: _Block) -> float:
+        """Eviction economics (docs/KV_CACHE.md "Fleet KV fabric"):
+        bytes × age / (1 + sharing) instead of plain LRU — a large
+        stale block evicts before a small one, but a block many
+        requests (``touches``) or many replicas (directory-reported
+        ``sharing``) lean on survives past its raw recency. Highest
+        score evicts first."""
+        age = max(1, self._tick - blk.last_used + 1)
+        reuse = blk.sharing + min(blk.touches, 8)
+        return (blk.nbytes * age) / (1.0 + reuse)
+
+    def _evict_locked(self) -> List[_Block]:
+        """Detach worst-scoring leaf blocks until back under budget and
+        return them — the caller spills them to the disk tier (file
+        I/O must happen OUTSIDE the trie lock). Leaf-only: ``refs > 0``
+        means children still extend this block. O(#leaves) per evicted
+        block — fine at the hundreds-to-thousands of blocks a host-RAM
+        budget holds."""
+        victims: List[_Block] = []
         while self._bytes > self.max_bytes and self._blocks:
             victim = None
+            score = -1.0
             for blk in self._blocks.values():
                 if blk.refs:
                     continue
-                if victim is None or blk.last_used < victim.last_used:
-                    victim = blk
+                s = self._eviction_score(blk)
+                if s > score:
+                    victim, score = blk, s
             if victim is None:       # all blocks interior (can't happen
-                return               # while leaves exist, but stay safe)
+                break                # while leaves exist, but stay safe)
             parent = victim.parent
             del parent.children[victim.key]
             parent.refs -= 1
             del self._blocks[victim.key]
             self._bytes -= victim.nbytes
             self.blocks_evicted += 1
+            victims.append(victim)
+        return victims
+
+    def _spill_victims(self, victims: List[_Block]) -> None:
+        """Write evicted blocks to the disk tier (no-op without one).
+        Runs outside the trie lock on whatever thread performed the
+        attach (kv-copy executor for the engine's paths). Blocks whose
+        spill file already exists (a faulted-back copy re-evicting)
+        skip the rewrite."""
+        spill = self.spill
+        if spill is None or not victims:
+            return
+        from gpustack_tpu.engine.kv_spill import encode_spill_frame
+
+        for blk in victims:
+            key_hex = blk.key.hex()
+            if spill.has(key_hex):
+                continue
+            spill.store(key_hex, encode_spill_frame(blk)[1])
 
     # ---- legacy store surface ------------------------------------------
 

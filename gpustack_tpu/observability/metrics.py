@@ -48,6 +48,21 @@ METRIC_FAMILIES = {
     "gpustack_kv_handoff_blocks_total": "counter",
     "gpustack_kv_handoff_failures_total": "counter",
     "gpustack_kv_handoff_seconds": "histogram",
+    # disk spill tier under the host cache (engine/kv_spill.py): bytes
+    # and blocks per direction (direction=out spilled to disk, in
+    # faulted back), the resident spill footprint, corrupt/truncated
+    # files degraded to misses, disk-budget evictions, and blocks
+    # re-attached to the trie by fault-back — engine exporter, worker-
+    # normalized like the families above
+    "gpustack_kv_spill_bytes_total": "counter",
+    "gpustack_kv_spill_blocks_total": "counter",
+    "gpustack_kv_spill_resident_bytes": "gauge",
+    "gpustack_kv_spill_corrupt_total": "counter",
+    "gpustack_kv_spill_evictions_total": "counter",
+    "gpustack_kv_spill_faultbacks_total": "counter",
+    # background fleet prefetch pulls landed by this engine
+    # (POST /kv/pull; label result=ok|failed)
+    "gpustack_kv_prefetch_total": "counter",
     # engine flight recorder (observability/flight.py): per-step
     # scheduler telemetry, emitted by the engine exporter and
     # normalized by the worker (worker/metrics_map.py)

@@ -443,23 +443,31 @@ async def _affinity_routing(
     body: dict,
     name: str,
 ):
-    """Prefix-affinity + disaggregated routing decision for one chat
-    request. Returns ``(serving, preferred, affinity_key,
-    extra_headers)``:
+    """Prefix-affinity + directory + disaggregated routing decision
+    for one chat request. Returns ``(serving, preferred,
+    affinity_key, extra_headers, route_via)``:
 
     - ``serving``: the candidate replica set — decode-role instances
       for a disaggregated model (falling back to the full set if no
       decode replica is RUNNING, so a half-converged flip still
       serves);
     - ``preferred``: the replica whose radix KV cache already holds
-      this conversation's prefix, when it is a serving candidate;
+      this conversation's prefix, when it is a serving candidate —
+      exact conversation stickiness (affinity map) first, then
+      cached-prefix MASS (the cluster KV directory: the replica
+      holding the deepest resident run of this request's prefix
+      hashes, which is how a shared system prompt across tenants
+      becomes a cross-replica hit);
     - ``affinity_key``: the full conversation-prefix hash to record on
       the successful dial;
     - ``extra_headers``: KV-handoff source headers when the prefix
-      lives on a NON-candidate replica (a prefill-role replica, or a
-      cold conversation on a disaggregated model — then the
-      least-loaded prefill replica computes the prompt KV and the
-      decode replica pulls it).
+      lives on a NON-candidate replica (a prefill-role replica, a
+      directory-known holder outside the serving set, or a cold
+      conversation on a disaggregated model — then the least-loaded
+      prefill replica computes the prompt KV and the decode replica
+      pulls it);
+    - ``route_via``: trace attribution —
+      ``affinity``/``directory``/``prefill``/``""``.
     """
     from gpustack_tpu.server.resilience import conversation_chain
 
@@ -474,23 +482,47 @@ async def _affinity_routing(
     if operation != "chat/completions" or not isinstance(
         messages, list
     ) or not messages:
-        return serving, 0, "", None
+        return serving, 0, "", None, ""
     if not model.host_kv_cache_mb and not model.disaggregated:
         # no radix KV cache on the engines: affinity stickiness buys
         # no prefix hit and would only fight least-outstanding
         # balancing — stay out of the way entirely
-        return serving, 0, "", None
+        return serving, 0, "", None, ""
     chain = conversation_chain(name, messages)
     affinity_key = chain[-1]
     holder_id = reg.affinity.lookup(chain)
     serving_ids = {i.id for i in serving}
     if holder_id is not None and holder_id in serving_ids:
-        return serving, holder_id, affinity_key, None
+        return serving, holder_id, affinity_key, None, "affinity"
     # the prefix lives off the candidate set (prefill replica, or the
     # map outlived the holder's RUNNING row) — or nowhere yet
     src = None
+    route_via = "affinity" if holder_id is not None else ""
     if holder_id is not None:
         src = next((i for i in instances if i.id == holder_id), None)
+    if src is None:
+        # cached-prefix-mass routing: no exact-conversation holder, so
+        # ask the fleet directory who holds the deepest resident run
+        # of this request's prefix hashes (typically the shared system
+        # prompt). A directory answer naming a replica that no longer
+        # exists is a STALE route — counted, then ignored, so the
+        # request proceeds cold instead of stalling on a dead peer.
+        hit = reg.kv_directory.lookup(chain)
+        if hit is not None and hit.model_id == model.id:
+            if hit.instance_id in serving_ids:
+                return (
+                    serving, hit.instance_id, affinity_key, None,
+                    "directory",
+                )
+            cand = next(
+                (i for i in instances if i.id == hit.instance_id),
+                None,
+            )
+            if cand is not None:
+                src = cand
+                route_via = "directory"
+            else:
+                reg.kv_directory.stale_routes += 1
     if src is None and prefills:
         # cold conversation on a disaggregated model: offload the
         # prompt's prefill to a prefill-role replica; the decode
@@ -498,12 +530,17 @@ async def _affinity_routing(
         for cand in reg.order(prefills):
             if reg.health(cand.id).breaker.would_allow():
                 src = cand
+                route_via = "prefill"
                 break
     if src is None:
-        return serving, 0, affinity_key, None
+        return serving, 0, affinity_key, None, ""
     worker = await Worker.get(src.worker_id or 0)
     if worker is None or not worker.ip or not worker.port:
-        return serving, 0, affinity_key, None
+        # the directory (or affinity map) named a holder whose worker
+        # row can't be dialed — same stale-route degradation: cold
+        if route_via == "directory":
+            reg.kv_directory.stale_routes += 1
+        return serving, 0, affinity_key, None, ""
     headers = {
         "X-GPUStack-KV-Source": (
             f"http://{worker.ip}:{worker.port}"
@@ -524,7 +561,7 @@ async def _affinity_routing(
         headers["X-GPUStack-KV-Source-Auth"] = "Bearer " + mint_kv_token(
             worker.proxy_secret, src.id, ttl
         )
-    return serving, 0, affinity_key, headers
+    return serving, 0, affinity_key, headers, route_via
 
 
 def _extract_usage(payload: dict) -> Tuple[int, int]:
@@ -859,7 +896,7 @@ def add_openai_routes(app: web.Application) -> None:
             # prefix-affinity + disaggregated role routing: serve from
             # the replica that already holds the conversation's radix
             # prefix, or hand its KV between roles (docs/KV_CACHE.md)
-            serving, preferred, affinity_key, kv_headers = (
+            serving, preferred, affinity_key, kv_headers, route_via = (
                 await _affinity_routing(
                     app, model, instances, operation, body, str(name)
                 )
@@ -868,6 +905,11 @@ def add_openai_routes(app: web.Application) -> None:
                 attrs = {"handoff": bool(kv_headers)}
                 if preferred:
                     attrs["preferred"] = preferred
+                if route_via:
+                    # affinity = exact conversation stickiness;
+                    # directory = cached-prefix-mass (fleet KV fabric);
+                    # prefill = disaggregated prefill offload
+                    attrs["via"] = route_via
                 trace.event("affinity", **attrs)
             # All data-plane traffic flows through the worker's
             # authenticated reverse proxy (or its tunnel): engines bind to

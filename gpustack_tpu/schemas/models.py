@@ -205,6 +205,7 @@ ROLLOUT_FIELDS = (
     "host_kv_cache_mb",
     "kv_block_tokens",
     "kv_cache_int8",
+    "kv_spill_mb",
     "prefill_chunk",
     "engine_pipeline_depth",
     "lora_adapters",
@@ -282,6 +283,11 @@ class Model(Record):
     # int8 host-tier KV (per-block scales, dequantized on upload):
     # ~2x cache capacity per byte of host_kv_cache_mb
     kv_cache_int8: bool = False
+    # disk spill tier under the host cache (docs/KV_CACHE.md "Fleet KV
+    # fabric"): blocks evicted from host RAM spill to local disk and
+    # fault back on a later prefix hit; MiB budget, 0 = off. Requires
+    # host_kv_cache_mb > 0
+    kv_spill_mb: int = 0
     # >0: chunked prefill — prompts longer than this many tokens prefill
     # in chunks with decode steps interleaved (vLLM enable-chunked-prefill
     # role; bounds long-prompt impact on running slots' token cadence)

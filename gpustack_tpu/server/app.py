@@ -767,6 +767,32 @@ def create_app(cfg: Config) -> web.Application:
         app["resilience_watch"] = _asyncio.create_task(
             app["resilience"].watch(), name="resilience-watch"
         )
+        # fleet KV fabric (server/kv_directory.py): scrape each
+        # KV-capable replica's prefix-key summary on a period, and arm
+        # the drain-time prefetch trigger (resilience watch fires it)
+        from gpustack_tpu.server.kv_directory import (
+            directory_refresh_loop,
+            prefetch_for_drain,
+        )
+
+        reg = app["resilience"]
+
+        async def _drain_prefetch(instance_id, keys):
+            try:
+                await prefetch_for_drain(
+                    app, reg.kv_directory, instance_id, keys=keys
+                )
+            except Exception:
+                logger.exception(
+                    "drain prefetch for instance %s failed",
+                    instance_id,
+                )
+
+        reg.kv_prefetch = _drain_prefetch
+        app["kv_directory_task"] = _asyncio.create_task(
+            directory_refresh_loop(app, reg.kv_directory),
+            name="kv-directory-refresh",
+        )
         app["plugin_tasks"] = []
         for plugin in app["plugins"]:
             try:
@@ -793,6 +819,16 @@ def create_app(cfg: Config) -> web.Application:
             watch.cancel()
             try:
                 await watch
+            except (
+                _asyncio.CancelledError,
+                Exception,
+            ):
+                pass
+        kv_task = app.get("kv_directory_task")
+        if kv_task is not None:
+            kv_task.cancel()
+            try:
+                await kv_task
             except (
                 _asyncio.CancelledError,
                 Exception,

@@ -1,0 +1,453 @@
+"""Cluster KV directory: which replica holds which prefix blocks.
+
+The fleet half of the KV fabric (docs/KV_CACHE.md "Fleet KV fabric").
+Every engine with a host KV cache keeps a bounded conversation index
+(engine/kv_fabric.ConvIndex); a server-side refresh loop scrapes each
+RUNNING instance's ``POST /kv/summary`` through the worker reverse
+proxy and folds the result here: conversation-prefix hash →
+``(instance, resident block depth, deepest RAM chain key)``.
+
+The directory is deliberately APPROXIMATE and bounded:
+
+- summaries are refreshed on a period (``kv_directory_refresh_s``), so
+  an entry can say a replica holds blocks it just evicted — routing on
+  it is an optimization, and the engine's radix walk is the ground
+  truth (a stale hit degrades to a partial/cold prefill, counted as
+  ``gpustack_kv_directory_stale_routes_total``);
+- per-instance key counts are capped (``kv_directory_max_keys``), most
+  recent conversations first;
+- instances are dropped on exit from RUNNING / deletion — the same
+  lifecycle hooks that invalidate :class:`PrefixAffinityMap` entries
+  (ResilienceRegistry.watch drives both).
+
+Routing on cached-prefix MASS: ``lookup(chain)`` walks a request's
+conversation-prefix hashes deepest-first and returns the replica
+holding the deepest (then largest) resident run — so a shared system
+prompt used by thousands of tenants becomes a cross-replica hit even
+though no replica ever saw this exact conversation.
+
+The scrape is also the directory's write-back channel: each refresh
+POSTs the fleet-wide sharing counts (hash → number of holding
+replicas) to the engine, which folds them into its two-tier eviction
+economics (bytes × recency / sharing) — widely-shared blocks outlive
+single-tenant ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_REFRESH_S = 5.0
+DEFAULT_MAX_KEYS = 4096
+
+
+class DirectoryHit:
+    """One routing answer: the replica, how deep in the request's
+    chain it matched, and its advertised residency."""
+
+    __slots__ = ("instance_id", "model_id", "depth", "blocks", "tail")
+
+    def __init__(self, instance_id, model_id, depth, blocks, tail):
+        self.instance_id = instance_id
+        self.model_id = model_id
+        self.depth = depth
+        self.blocks = blocks
+        self.tail = tail
+
+
+class _Replica:
+    __slots__ = ("model_id", "keys", "refreshed_at", "conversations")
+
+    def __init__(self, model_id: int):
+        self.model_id = model_id
+        # hash -> (blocks, tail hex)
+        self.keys: Dict[str, Tuple[int, str]] = {}
+        self.refreshed_at = 0.0
+        self.conversations = 0
+
+
+class ClusterKVDirectory:
+    """Bounded approximate fleet index of prefix-key residency."""
+
+    def __init__(
+        self,
+        max_keys_per_instance: int = DEFAULT_MAX_KEYS,
+        clock=time.monotonic,
+    ):
+        self.max_keys_per_instance = max(16, int(max_keys_per_instance))
+        self._clock = clock
+        self._replicas: Dict[int, _Replica] = {}
+        # counters (server /metrics via resilience metrics_lines)
+        self.refreshes = 0
+        self.refresh_failures = 0
+        self.invalidations = 0
+        self.hits = 0
+        self.misses = 0
+        self.stale_routes = 0
+        self.prefetches = 0
+
+    # ---- feed ------------------------------------------------------------
+
+    def update(
+        self, instance_id: int, model_id: int, summary: dict
+    ) -> int:
+        """Fold one replica's scraped summary in. Returns the key
+        count retained (bounded — deepest runs win past the cap)."""
+        keys = summary.get("keys") or {}
+        rep = _Replica(model_id)
+        items: List[Tuple[str, Tuple[int, str]]] = []
+        for h, entry in keys.items():
+            try:
+                blocks = int(entry.get("blocks") or 0)
+            except (AttributeError, TypeError, ValueError):
+                continue
+            if blocks <= 0:
+                continue
+            items.append((str(h), (blocks, str(entry.get("tail") or ""))))
+        if len(items) > self.max_keys_per_instance:
+            items.sort(key=lambda kv: kv[1][0], reverse=True)
+            items = items[: self.max_keys_per_instance]
+        rep.keys = dict(items)
+        rep.refreshed_at = self._clock()
+        try:
+            rep.conversations = int(summary.get("conversations") or 0)
+        except (TypeError, ValueError):
+            rep.conversations = 0
+        self._replicas[instance_id] = rep
+        self.refreshes += 1
+        return len(rep.keys)
+
+    def invalidate_instance(self, instance_id: int) -> int:
+        """Instance left RUNNING (or was deleted): its engine — and
+        every block it advertised — is gone."""
+        rep = self._replicas.pop(instance_id, None)
+        if rep is None:
+            return 0
+        self.invalidations += 1
+        return len(rep.keys)
+
+    # ---- routing ---------------------------------------------------------
+
+    def lookup(
+        self,
+        chain: Sequence[str],
+        candidate_ids=None,
+    ) -> Optional[DirectoryHit]:
+        """Deepest-prefix-first: the first chain hash (walking from
+        the newest message prefix down) that ANY replica advertises
+        wins; among holders of that hash the largest resident run
+        wins. ``candidate_ids`` (when given) restricts holders to the
+        dialable serving set. ONE hit or miss counted per call."""
+        best: Optional[DirectoryHit] = None
+        for depth in range(len(chain) - 1, -1, -1):
+            h = chain[depth]
+            for iid, rep in self._replicas.items():
+                if candidate_ids is not None and iid not in candidate_ids:
+                    continue
+                entry = rep.keys.get(h)
+                if entry is None:
+                    continue
+                if best is None or entry[0] > best.blocks:
+                    best = DirectoryHit(
+                        iid, rep.model_id, depth, entry[0], entry[1]
+                    )
+            if best is not None:
+                break
+        if best is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return best
+
+    # ---- fleet aggregates ------------------------------------------------
+
+    def sharing(self, model_id: Optional[int] = None) -> Dict[str, int]:
+        """hash → number of replicas advertising it (the eviction-
+        economics boost shipped back to engines on the next scrape)."""
+        counts: Dict[str, int] = {}
+        for rep in self._replicas.values():
+            if model_id is not None and rep.model_id != model_id:
+                continue
+            for h in rep.keys:
+                counts[h] = counts.get(h, 0) + 1
+        return counts
+
+    def instance_keys(self, instance_id: int) -> Dict[str, Tuple[int, str]]:
+        rep = self._replicas.get(instance_id)
+        return dict(rep.keys) if rep else {}
+
+    @property
+    def instances(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def total_keys(self) -> int:
+        return sum(len(r.keys) for r in self._replicas.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "instances": self.instances,
+            "keys": self.total_keys,
+            "refreshes": self.refreshes,
+            "refresh_failures": self.refresh_failures,
+            "invalidations": self.invalidations,
+            "hits": self.hits,
+            "misses": self.misses,
+            "stale_routes": self.stale_routes,
+            "prefetches": self.prefetches,
+        }
+
+    def metrics_lines(self) -> List[str]:
+        return [
+            "# TYPE gpustack_kv_directory_instances gauge",
+            f"gpustack_kv_directory_instances {self.instances}",
+            "# TYPE gpustack_kv_directory_keys gauge",
+            f"gpustack_kv_directory_keys {self.total_keys}",
+            "# TYPE gpustack_kv_directory_refreshes_total counter",
+            f"gpustack_kv_directory_refreshes_total {self.refreshes}",
+            "# TYPE gpustack_kv_directory_refresh_failures_total counter",
+            f"gpustack_kv_directory_refresh_failures_total "
+            f"{self.refresh_failures}",
+            "# TYPE gpustack_kv_directory_invalidations_total counter",
+            f"gpustack_kv_directory_invalidations_total "
+            f"{self.invalidations}",
+            "# TYPE gpustack_kv_directory_hits_total counter",
+            f"gpustack_kv_directory_hits_total {self.hits}",
+            "# TYPE gpustack_kv_directory_misses_total counter",
+            f"gpustack_kv_directory_misses_total {self.misses}",
+            "# TYPE gpustack_kv_directory_stale_routes_total counter",
+            f"gpustack_kv_directory_stale_routes_total "
+            f"{self.stale_routes}",
+            "# TYPE gpustack_kv_directory_prefetches_total counter",
+            f"gpustack_kv_directory_prefetches_total {self.prefetches}",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Server-side refresh loop + drain-time prefetch
+# ---------------------------------------------------------------------------
+
+
+async def _kv_capable_instances():
+    """(instance, model) pairs whose engines run a host KV cache —
+    the only replicas with anything to summarize."""
+    from gpustack_tpu.schemas import (
+        Model,
+        ModelInstance,
+        ModelInstanceState,
+    )
+
+    out = []
+    models = {m.id: m for m in await Model.all()}
+    for inst in await ModelInstance.filter(
+        state=ModelInstanceState.RUNNING
+    ):
+        model = models.get(inst.model_id or 0)
+        if model is None or not model.host_kv_cache_mb:
+            continue
+        out.append((inst, model))
+    return out
+
+
+async def refresh_directory_once(app, directory) -> int:
+    """One scrape round: POST each KV-capable RUNNING instance's
+    /kv/summary (carrying the current fleet sharing counts down),
+    fold the returned summaries in. Per-instance failures count and
+    skip — one wedged worker must not starve the rest of the fleet's
+    refresh. Returns instances refreshed."""
+    import aiohttp
+
+    from gpustack_tpu.schemas import Worker
+
+    session = app.get("proxy_session")
+    if session is None or session.closed:
+        return 0
+    cfg = app.get("config")
+    max_keys = int(
+        getattr(cfg, "kv_directory_max_keys", DEFAULT_MAX_KEYS)
+    )
+    refreshed = 0
+    for inst, model in await _kv_capable_instances():
+        worker = await Worker.get(inst.worker_id or 0)
+        if worker is None or not worker.ip or not worker.port:
+            continue
+        url = (
+            f"http://{worker.ip}:{worker.port}"
+            f"/proxy/instances/{inst.id}/kv/summary"
+        )
+        headers = {}
+        if worker.proxy_secret:
+            headers["Authorization"] = f"Bearer {worker.proxy_secret}"
+        try:
+            async with session.post(
+                url,
+                json={
+                    "sharing": directory.sharing(model.id),
+                    "max_keys": max_keys,
+                },
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=5.0),
+            ) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"HTTP {resp.status}")
+                summary = await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — per-replica fault
+            directory.refresh_failures += 1
+            logger.debug(
+                "kv directory refresh of instance %s failed: %s",
+                inst.id, str(e) or type(e).__name__,
+            )
+            continue
+        directory.update(inst.id, model.id, summary)
+        refreshed += 1
+        # affinity-staleness fix: an entry steering turns at this
+        # replica for a conversation whose blocks EVICTED is worse
+        # than a directory lookup — demote it now, on eviction
+        # evidence, not only on instance exit
+        reg = app.get("resilience")
+        if reg is not None:
+            reg.affinity.demote_stale(
+                inst.id, set((summary.get("keys") or {}).keys())
+            )
+    return refreshed
+
+
+async def directory_refresh_loop(app, directory) -> None:
+    """The background scrape: period from ``kv_directory_refresh_s``.
+    Transient failures (DB, worker, decode) never kill the loop."""
+    cfg = app.get("config")
+    interval = float(
+        getattr(cfg, "kv_directory_refresh_s", DEFAULT_REFRESH_S)
+    )
+    while True:
+        try:
+            await refresh_directory_once(app, directory)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("kv directory refresh round failed")
+        await asyncio.sleep(max(0.5, interval))
+
+
+async def prefetch_for_drain(
+    app, directory, instance_id: int, keys=None, limit: int = 0
+) -> int:
+    """Drain-time warm-ahead: the draining replica's hottest
+    conversations (largest advertised runs) are pulled to the
+    least-outstanding RUNNING sibling BEFORE the engine exits — turn
+    N+1 lands warm instead of re-prefilling the fleet's hottest
+    prefixes. Advisory end to end: any failure leaves the fleet cold,
+    never blocks the drain. Returns pulls triggered."""
+    import aiohttp
+
+    from gpustack_tpu.api.auth import mint_kv_token
+    from gpustack_tpu.schemas import (
+        ModelInstance,
+        ModelInstanceState,
+        Worker,
+    )
+
+    cfg = app.get("config")
+    if limit <= 0:
+        limit = int(getattr(cfg, "kv_prefetch_conversations", 0))
+    if limit <= 0:
+        return 0
+    if keys is None:
+        # callers on the DRAINING edge snapshot keys BEFORE the
+        # directory drops the instance; direct callers let us look
+        keys = directory.instance_keys(instance_id)
+    if not keys:
+        return 0
+    src = await ModelInstance.get(instance_id)
+    if src is None:
+        return 0
+    model_id = src.model_id or 0
+    src_worker = await Worker.get(src.worker_id or 0)
+    if src_worker is None or not src_worker.ip or not src_worker.port:
+        return 0
+    # target: the least-outstanding RUNNING sibling (skip the drainer)
+    reg = app.get("resilience")
+    siblings = [
+        i for i in await ModelInstance.filter(
+            model_id=model_id, state=ModelInstanceState.RUNNING
+        )
+        if i.id != instance_id
+    ]
+    if not siblings or reg is None:
+        return 0
+    target = reg.order(siblings)[0]
+    dst_worker = await Worker.get(target.worker_id or 0)
+    if dst_worker is None or not dst_worker.ip or not dst_worker.port:
+        return 0
+    session = app.get("proxy_session")
+    if session is None or session.closed:
+        return 0
+    # deepest advertised runs first; dedup by tail key (many
+    # conversation-prefix hashes share one deepest block)
+    ranked = sorted(
+        keys.items(), key=lambda kv: kv[1][0], reverse=True
+    )
+    source_url = (
+        f"http://{src_worker.ip}:{src_worker.port}"
+        f"/proxy/instances/{src.id}/kv/export"
+    )
+    ttl = float(getattr(cfg, "kv_token_ttl", 60.0))
+    auth = ""
+    if src_worker.proxy_secret:
+        auth = "Bearer " + mint_kv_token(
+            src_worker.proxy_secret, src.id, ttl
+        )
+    headers = {}
+    if dst_worker.proxy_secret:
+        headers["Authorization"] = (
+            f"Bearer {dst_worker.proxy_secret}"
+        )
+    pull_url = (
+        f"http://{dst_worker.ip}:{dst_worker.port}"
+        f"/proxy/instances/{target.id}/kv/pull"
+    )
+    triggered = 0
+    seen_tails = set()
+    for _h, (_blocks, tail) in ranked:
+        if triggered >= limit:
+            break
+        if not tail or tail in seen_tails:
+            continue
+        seen_tails.add(tail)
+        try:
+            async with session.post(
+                pull_url,
+                json={
+                    "source": source_url,
+                    "auth": auth,
+                    "tail_key": tail,
+                },
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=5.0),
+            ) as resp:
+                if resp.status not in (200, 202):
+                    raise RuntimeError(f"HTTP {resp.status}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — advisory
+            logger.debug(
+                "drain prefetch %s -> %s failed: %s",
+                instance_id, target.id, str(e) or type(e).__name__,
+            )
+            continue
+        triggered += 1
+        directory.prefetches += 1
+    if triggered:
+        logger.info(
+            "drain prefetch: %d conversation(s) of instance %s "
+            "pulled ahead to instance %s", triggered, instance_id,
+            target.id,
+        )
+    return triggered

@@ -124,6 +124,24 @@ class PrefixAffinityMap:
         self.invalidations += len(doomed)
         return len(doomed)
 
+    def demote_stale(self, instance_id: int, live_keys) -> int:
+        """Eviction-driven invalidation (the affinity-staleness fix):
+        drop entries steering conversations at ``instance_id`` whose
+        prefix hash is NOT in its freshly scraped summary — the
+        replica evicted those blocks, so "sticky" routing there buys a
+        cold prefill while blinding the router to a warmer holder the
+        directory knows about. Entries still advertised stay sticky
+        (exact-holder routing beats a directory lookup when both
+        agree)."""
+        doomed = [
+            k for k, (iid, _) in self._entries.items()
+            if iid == instance_id and k not in live_keys
+        ]
+        for k in doomed:
+            del self._entries[k]
+        self.invalidations += len(doomed)
+        return len(doomed)
+
 
 class BreakerState(str, enum.Enum):
     CLOSED = "closed"
@@ -243,6 +261,7 @@ class ResilienceRegistry:
         breaker_open_seconds: float = 10.0,
         model_max_outstanding: int = 256,
         affinity_max_entries: int = 4096,
+        kv_directory_max_keys: int = 4096,
         clock=time.monotonic,
     ):
         self.failover_attempts = max(1, failover_attempts)
@@ -257,6 +276,19 @@ class ResilienceRegistry:
         # prefix-affinity routing (docs/KV_CACHE.md): conversation →
         # the replica whose radix KV cache already holds its prefix
         self.affinity = PrefixAffinityMap(affinity_max_entries)
+        # fleet block directory (server/kv_directory.py): prefix-hash
+        # residency across replicas, refreshed by the server's scrape
+        # loop and invalidated by the SAME watch hooks as affinity
+        from gpustack_tpu.server.kv_directory import ClusterKVDirectory
+
+        self.kv_directory = ClusterKVDirectory(
+            max_keys_per_instance=kv_directory_max_keys,
+            clock=clock,
+        )
+        # drain-time prefetch trigger: async callable
+        # (instance_id, keys) set by the server app when the fabric is
+        # wired (server/app.py); None = prefetch disabled
+        self.kv_prefetch = None
         # counters (exported via server /metrics)
         self.failovers_total = 0
         self.shed_total = 0
@@ -286,6 +318,9 @@ class ResilienceRegistry:
             affinity_max_entries=int(
                 getattr(cfg, "affinity_max_entries", 4096)
             ),
+            kv_directory_max_keys=int(
+                getattr(cfg, "kv_directory_max_keys", 4096)
+            ),
         )
 
     # ---- per-instance state ---------------------------------------------
@@ -312,6 +347,7 @@ class ResilienceRegistry:
         affinity entries (its KV died with its engine)."""
         self._instances.pop(instance_id, None)
         self.affinity.invalidate_instance(instance_id)
+        self.kv_directory.invalidate_instance(instance_id)
 
     def reset(self, instance_id: int) -> None:
         """Instance freshly RUNNING (restart recovered): clean slate so a
@@ -479,6 +515,23 @@ class ResilienceRegistry:
                         # unreachable, re-drive) invalidates affinity:
                         # the engine — and its radix KV — is going away
                         self.affinity.invalidate_instance(event.id)
+                        # drain-time warm-ahead rides the same edge:
+                        # snapshot the directory's view of this replica
+                        # BEFORE dropping it, so the prefetcher knows
+                        # which conversations are worth pulling to a
+                        # sibling while the engine still answers
+                        if (
+                            state == ModelInstanceState.DRAINING.value
+                            and self.kv_prefetch is not None
+                        ):
+                            keys = self.kv_directory.instance_keys(
+                                event.id
+                            )
+                            if keys:
+                                asyncio.create_task(
+                                    self.kv_prefetch(event.id, keys)
+                                )
+                        self.kv_directory.invalidate_instance(event.id)
                     if state in (
                         ModelInstanceState.ERROR.value,
                         ModelInstanceState.UNREACHABLE.value,
@@ -579,4 +632,7 @@ class ResilienceRegistry:
                     f"gpustack_proxy_outstanding_requests"
                     f'{{instance_id="{iid}"}} {h.outstanding}'
                 )
+        # fleet KV directory (server/kv_directory.py) rides the same
+        # exporter append
+        lines.extend(self.kv_directory.metrics_lines())
         return lines

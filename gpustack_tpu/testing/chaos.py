@@ -127,6 +127,20 @@ DISAGG_FAULT_KINDS = (
     "kv_handoff_abort",
 )
 
+# fleet KV directory faults (ISSUE 16): require a KV-cache-backed
+# deployment (host_kv_cache_mb > 0) so cached-prefix-mass routing
+# engages — kept out of FAULT_KINDS
+#   * directory_stale — the cluster KV directory is poisoned with an
+#     entry naming a replica that no longer exists (the scrape raced
+#     an instance teardown), then a real proxied chat request whose
+#     conversation chain matches the poisoned key is fired: the proxy
+#     must count the stale route, degrade to cold routing, and
+#     complete the request well inside the handoff timeout — never
+#     stall dialing the dead holder
+KV_DIRECTORY_FAULT_KINDS = (
+    "directory_stale",
+)
+
 # tenant QoS faults: require the shrunken model cap + fair watermark
 # (TENANT_CFG) so saturation is reachable — kept out of FAULT_KINDS
 #   * tenant_flood — two flooding API-key tenants (weights 3:1) hammer
@@ -167,6 +181,7 @@ FAULT_CLASSES: Dict[str, Tuple[str, ...]] = {
     "server-restart": ("server_restart",),
     "ha-failover": HA_FAULT_KINDS,
     "kv-handoff": DISAGG_FAULT_KINDS,
+    "kv-directory": KV_DIRECTORY_FAULT_KINDS,
     "noisy-neighbor": TENANT_FAULT_KINDS,
     "acquire-storm": ("acquire_storm",),
     "rolling-server-restart": SCALE_FAULT_KINDS,
@@ -351,11 +366,19 @@ class StubWorker:
             token = (
                 auth[7:] if auth.startswith("Bearer ") else ""
             )
-            kv_scoped = (
+            is_export = (
                 request.match_info["tail"].rstrip("/") == "kv/export"
-                and verify_kv_token(token, self.proxy_secret, iid)
             )
-            if token != self.proxy_secret and not kv_scoped:
+            if is_export:
+                # export path is kv-token-ONLY (worker/server.py
+                # middleware contract): the full proxy secret is
+                # rejected here so it never has a reason to travel
+                # engine→engine
+                if not verify_kv_token(token, self.proxy_secret, iid):
+                    return web.json_response(
+                        {"error": "forbidden"}, status=403
+                    )
+            elif token != self.proxy_secret:
                 return web.json_response(
                     {"error": "forbidden"}, status=403
                 )
@@ -889,6 +912,8 @@ class ChaosHarness:
         self.probe_results: List = []
         # kv_handoff_abort outcomes: one entry per executed op
         self.handoff_results: List[Dict] = []
+        # directory_stale outcomes: one entry per executed op
+        self.directory_results: List[Dict] = []
         # tenant_flood outcomes: one entry per executed op (statuses,
         # headers, polite-probe latencies — the tier-1 e2e judges
         # isolation and headers from these; fairness is judged in
@@ -1140,6 +1165,7 @@ class ChaosHarness:
         *,
         prefill_replicas: int = 0,
         decode_replicas: int = 0,
+        host_kv_cache_mb: int = 0,
     ) -> dict:
         spec = {
             "name": name,
@@ -1151,6 +1177,11 @@ class ChaosHarness:
             "max_slots": 2,
             "distributable": False,
         }
+        if host_kv_cache_mb:
+            # KV-cache-backed deployment (kv-directory class): the
+            # proxy's affinity/directory routing only engages when the
+            # engines carry a radix host cache
+            spec.update(host_kv_cache_mb=host_kv_cache_mb)
         if prefill_replicas and decode_replicas:
             # disaggregated deployment (kv-handoff class): role-tagged
             # replicas + a host KV cache so the proxy's handoff path
@@ -1283,6 +1314,8 @@ class ChaosHarness:
             await self._rolling_server_restart(op)
         elif op.kind == "kv_handoff_abort":
             await self._kv_handoff_abort(op)
+        elif op.kind == "directory_stale":
+            await self._directory_stale(op)
         elif op.kind == "tenant_flood":
             await self._tenant_flood(op)
         elif op.kind == "lease_expire":
@@ -1457,6 +1490,89 @@ class ChaosHarness:
             "status": status,
             "killed_mid_stream": started,
             "decode_outcomes": outcomes,
+            "content": (
+                (body.get("choices") or [{}])[0]
+                .get("message", {}).get("content", "")
+                if isinstance(body, dict) else ""
+            ),
+        })
+
+    async def _directory_stale(self, op: ChaosOp) -> None:
+        """Poison the fleet KV directory with an entry naming a
+        replica id that does not exist (the scrape raced an instance
+        teardown — the exact window invalidate-on-exit can lose to),
+        then fire a real proxied chat request whose conversation chain
+        matches the poisoned key. Degradation contract: the stale
+        route is COUNTED, the request completes cold on a live
+        replica, and it never stalls past the handoff-timeout bound
+        dialing the dead holder."""
+        from gpustack_tpu.server.resilience import conversation_chain
+
+        srv = self.server
+        if srv is None:
+            self.skipped_ops.append(op)
+            return
+        reg = srv.app["resilience"]
+        models = await self.admin.list_all("models")
+        model = next(
+            (
+                m for m in models
+                if m["name"] == self._deployed_model
+            ),
+            None,
+        )
+        if model is None or not model.get("host_kv_cache_mb"):
+            # directory routing never engages without a radix host
+            # cache on the deployment: nothing this op can prove
+            self.skipped_ops.append(op)
+            return
+        insts = await self.admin.list_all("model-instances")
+        ghost = (
+            max((i["id"] for i in insts), default=0)
+            + 1000 + op.target
+        )
+        messages = [{
+            "role": "user",
+            "content": f"chaos directory probe {op.at}-{op.target}",
+        }]
+        chain = conversation_chain(self._deployed_model, messages)
+        reg.kv_directory.update(ghost, model["id"], {
+            "keys": {h: {"blocks": 8, "tail": ""} for h in chain},
+            "conversations": 1,
+        })
+        stale0 = reg.kv_directory.stale_routes
+        headers = {"Authorization": f"Bearer {self._admin_token}"}
+        payload = {
+            "model": self._deployed_model,
+            "messages": messages,
+            "max_tokens": 4,
+        }
+        bound = float(
+            getattr(self.cfg, "kv_handoff_timeout", 10.0) or 10.0
+        )
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            async with aiohttp.ClientSession() as http:
+                async with http.post(
+                    self.base + "/v1/chat/completions",
+                    json=payload, headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=max(30.0, bound * 3)
+                    ),
+                ) as r:
+                    status, body = r.status, await r.json()
+        except CLIENT_ERRORS as e:
+            status, body = 0, {"error": repr(e)}
+        elapsed = loop.time() - t0
+        self.directory_results.append({
+            "status": status,
+            "elapsed_s": round(elapsed, 4),
+            "bound_s": bound,
+            "stale_counted": (
+                reg.kv_directory.stale_routes > stale0
+            ),
+            "ghost_instance": ghost,
             "content": (
                 (body.get("choices") or [{}])[0]
                 .get("message", {}).get("content", "")
@@ -1853,6 +1969,10 @@ async def run_seeded(
             await harness.deploy(
                 prefill_replicas=1, decode_replicas=1
             )
+        elif any(k in KV_DIRECTORY_FAULT_KINDS for k in kinds):
+            # directory faults need a KV-cache-backed deployment so
+            # cached-prefix-mass routing engages
+            await harness.deploy(host_kv_cache_mb=64)
         else:
             await harness.deploy()
         await harness.wait_converged(timeout=converge_timeout)
@@ -1874,6 +1994,7 @@ async def run_seeded(
             },
             "servers": servers,
             "handoffs": list(harness.handoff_results),
+            "directory_probes": list(harness.directory_results),
             "floods": [
                 {
                     "admitted": fr["admitted"],

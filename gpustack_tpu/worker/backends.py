@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import tempfile
 from typing import Dict, List, Optional, Tuple
 
 from gpustack_tpu.schemas import Model, ModelInstance
@@ -193,6 +194,17 @@ def _tpu_native_command(
             argv += ["--kv-block-tokens", str(model.kv_block_tokens)]
         if model.kv_cache_int8:
             argv += ["--kv-cache-int8"]
+        if getattr(model, "kv_spill_mb", 0):
+            # disk spill tier rides the host cache; a stable per-
+            # instance directory keeps the tier warm across restarts
+            argv += ["--kv-spill-mb", str(model.kv_spill_mb)]
+            argv += [
+                "--kv-spill-dir",
+                os.path.join(
+                    tempfile.gettempdir(),
+                    f"gpustack-kv-spill-{instance.name}",
+                ),
+            ]
     if instance.role:
         # disaggregated prefill/decode role tag (ModelSpec
         # prefill_replicas/decode_replicas → controllers role deficit).

@@ -39,6 +39,21 @@ METRIC_MAP: Dict[str, str] = {
     "gpustack_kv_handoff_failures_total":
         "gpustack_tpu:kv_handoff_failures_total",
     "gpustack_kv_handoff_seconds": "gpustack_tpu:kv_handoff_seconds",
+    # disk spill tier + fleet prefetch (engine/kv_spill.py, the fleet
+    # KV fabric — docs/KV_CACHE.md)
+    "gpustack_kv_spill_bytes_total":
+        "gpustack_tpu:kv_spill_bytes_total",
+    "gpustack_kv_spill_blocks_total":
+        "gpustack_tpu:kv_spill_blocks_total",
+    "gpustack_kv_spill_resident_bytes":
+        "gpustack_tpu:kv_spill_resident_bytes",
+    "gpustack_kv_spill_corrupt_total":
+        "gpustack_tpu:kv_spill_corrupt_total",
+    "gpustack_kv_spill_evictions_total":
+        "gpustack_tpu:kv_spill_evictions_total",
+    "gpustack_kv_spill_faultbacks_total":
+        "gpustack_tpu:kv_spill_faultbacks_total",
+    "gpustack_kv_prefetch_total": "gpustack_tpu:kv_prefetch_total",
     # engine flight recorder (observability/flight.py): per-step
     # scheduler telemetry — the fleet rollup's saturation signals
     "gpustack_engine_step_seconds": "gpustack_tpu:engine_step_seconds",
@@ -116,6 +131,13 @@ NORMALIZED_FAMILIES: Dict[str, str] = {
     "gpustack_tpu:kv_handoff_blocks_total": "counter",
     "gpustack_tpu:kv_handoff_failures_total": "counter",
     "gpustack_tpu:kv_handoff_seconds": "histogram",
+    "gpustack_tpu:kv_spill_bytes_total": "counter",
+    "gpustack_tpu:kv_spill_blocks_total": "counter",
+    "gpustack_tpu:kv_spill_resident_bytes": "gauge",
+    "gpustack_tpu:kv_spill_corrupt_total": "counter",
+    "gpustack_tpu:kv_spill_evictions_total": "counter",
+    "gpustack_tpu:kv_spill_faultbacks_total": "counter",
+    "gpustack_tpu:kv_prefetch_total": "counter",
     "gpustack_tpu:audio_requests_total": "counter",
     "gpustack_tpu:audio_seconds_total": "counter",
     "gpustack_tpu:engine_step_seconds": "histogram",

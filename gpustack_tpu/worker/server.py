@@ -113,16 +113,27 @@ class WorkerServer:
                 {"error": "worker proxy authentication required"},
                 status=401,
             )
-        if _hmac.compare_digest(token, secret):
-            return await handler(request)
         kv_target = self._KV_EXPORT_RE.match(request.path)
         if kv_target is not None:
+            # the export relay accepts ONLY the instance-scoped token:
+            # a peer engine holding the credential for this path must
+            # not be able to replay it (or a captured full secret)
+            # anywhere else — and conversely the full secret staying
+            # off the engine→engine wire means a compromised engine
+            # process never saw a credential that opens other routes
             from gpustack_tpu.api.auth import verify_kv_token
 
             if verify_kv_token(
                 token, secret, int(kv_target.group(1))
             ):
                 return await handler(request)
+            return web.json_response(
+                {"error": "kv export requires an instance-scoped "
+                          "kv token"},
+                status=401,
+            )
+        if _hmac.compare_digest(token, secret):
+            return await handler(request)
         return web.json_response(
             {"error": "worker proxy authentication required"},
             status=401,

@@ -1,0 +1,65 @@
+"""Fleet KV directory staleness under chaos (ISSUE 16 acceptance).
+
+``directory_stale``: the cluster KV directory is poisoned with an
+entry naming a replica id that no longer exists (the scrape raced an
+instance teardown), then a real proxied chat request whose
+conversation chain matches the poisoned key is fired. Degradation
+contract: the stale route is COUNTED (``stale_routes``), the request
+completes cold on a live replica with a clean 200, and it never
+stalls past the handoff-timeout bound dialing the dead holder. The
+schedule must replay bit-for-bit from the seed and the cluster must
+re-converge with zero invariant violations.
+
+Rides tier-1 (fast subset, like tests/e2e/test_kv_handoff_chaos.py).
+"""
+
+import asyncio
+import dataclasses
+
+from gpustack_tpu.testing import chaos
+
+
+def _run(tmp_path, seed, kinds, **kw):
+    return asyncio.run(chaos.run_seeded(
+        str(tmp_path), seed, kinds=kinds, converge_timeout=45.0, **kw
+    ))
+
+
+def test_directory_stale_degrades_cold_and_converges(tmp_path):
+    report = _run(
+        tmp_path, 7, chaos.KV_DIRECTORY_FAULT_KINDS, ops=2, workers=2,
+    )
+    # acceptance: zero invariant violations after the poisoned routes
+    assert report["violations"] == []
+    # the schedule replays bit-for-bit from the seed alone
+    regenerated = [
+        dataclasses.asdict(o)
+        for o in chaos.generate_schedule(
+            7, kinds=chaos.KV_DIRECTORY_FAULT_KINDS, ops=2, workers=2,
+        )
+    ]
+    assert report["schedule"] == regenerated
+    # every op executed (the KV-cache-backed deployment existed)
+    assert report["directory_probes"], report["skipped_ops"]
+    assert len(report["directory_probes"]) == 2
+    for probe in report["directory_probes"]:
+        # the stale answer was COUNTED, not silently swallowed …
+        assert probe["stale_counted"] is True
+        # … the request completed cold on a live replica …
+        assert probe["status"] == 200
+        assert probe["content"]
+        # … and never stalled past the handoff-timeout bound waiting
+        # on the dead holder
+        assert probe["elapsed_s"] < probe["bound_s"]
+
+
+def test_kv_directory_class_is_seed_deterministic():
+    a = chaos.generate_schedule(
+        11, kinds=chaos.KV_DIRECTORY_FAULT_KINDS, ops=2
+    )
+    b = chaos.generate_schedule(
+        11, kinds=chaos.KV_DIRECTORY_FAULT_KINDS, ops=2
+    )
+    assert a == b
+    assert {o.kind for o in a} == {"directory_stale"}
+    assert "kv-directory" in chaos.FAULT_CLASSES

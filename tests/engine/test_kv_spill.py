@@ -1,0 +1,259 @@
+"""Disk spill tier + eviction economics (fleet KV fabric, ISSUE 16).
+
+Unit layer: spill-on-evict → disk-extended match → fault-back with
+content parity; durability across a process restart (re-indexed
+directory); corruption quarantined as a miss, never a crash; the
+byte-budget cap on the spill directory; and the
+bytes × age / sharing eviction scoring.
+"""
+
+import os
+
+import numpy as np
+
+from gpustack_tpu.engine.kv_host_cache import HostKVCache
+from gpustack_tpu.engine.kv_spill import (
+    SPILL_SUFFIX,
+    DiskKVSpill,
+    encode_spill_frame,
+)
+
+L, H, HD = 2, 2, 4  # toy KV dims (match test_kv_host_cache)
+BT = 4
+
+
+def _kv(n_tokens, seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    v = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    return k, v
+
+
+def _block_bytes():
+    """RAM nbytes of one fp32 block at the toy dims."""
+    return 2 * L * BT * H * HD * 4
+
+
+def _cache(tmp_path, ram_blocks=2, disk_mb=4):
+    cache = HostKVCache(
+        max_bytes=ram_blocks * _block_bytes(), block_tokens=BT
+    )
+    cache.spill = DiskKVSpill(
+        str(tmp_path / "spill"), max_bytes=disk_mb << 20
+    )
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# spill on evict → disk-extended match → fault-back
+# ---------------------------------------------------------------------------
+
+
+def _spill_tail(tmp_path, ram_blocks=4):
+    """Build a cache where sequence A's TAIL block lives on disk while
+    RAM keeps headroom for the fault-back: insert A (3 blocks), then a
+    decoy B (2 blocks) that pushes the cache over budget — A's tail is
+    the oldest leaf, so it spills."""
+    cache = _cache(tmp_path, ram_blocks=ram_blocks)
+    a = list(range(1, 13))              # 3 blocks
+    ka, va = _kv(12)
+    cache.insert_sequence(a, ka, va)
+    cache.insert_sequence(list(range(101, 109)), *_kv(8, seed=7))
+    assert cache.blocks_evicted >= 1
+    assert cache.spill.entries >= 1
+    return cache, a, ka, va
+
+
+def test_evicted_blocks_spill_and_fault_back_with_parity(tmp_path):
+    cache, a, ka, va = _spill_tail(tmp_path)
+    spill = cache.spill
+    assert spill.blocks_spilled == cache.blocks_evicted
+
+    # a probe long enough to need the spilled tail block counts it
+    # (the extension is capped by what a fault-back can hold in RAM —
+    # here there is headroom) …
+    probe = a + [99]
+    matched = cache.match_prefix_len(probe)
+    assert matched == 12
+
+    # … and gather faults the spilled bytes back with content parity
+    got = cache.gather_prefix(probe, matched)
+    assert got is not None
+    gk, gv = got
+    assert cache.faultbacks >= 1
+    assert spill.blocks_loaded >= 1
+    np.testing.assert_allclose(gk, ka[:, :matched], rtol=0, atol=0)
+    np.testing.assert_allclose(gv, va[:, :matched], rtol=0, atol=0)
+
+
+def test_disk_extension_capped_by_ram_budget(tmp_path):
+    # 2-block RAM budget, 3-block sequence: the tail spills, but a
+    # fault-back could never hold all 3 blocks in RAM — the match must
+    # NOT claim the disk extension it cannot deliver
+    cache = _cache(tmp_path, ram_blocks=2)
+    seq = list(range(1, 13))
+    cache.insert_sequence(seq, *_kv(12))
+    assert cache.spill.entries >= 1
+    probe = seq + [99]
+    assert cache.match_prefix_len(probe) == 2 * BT
+    got = cache.gather_prefix(probe, 2 * BT)
+    assert got is not None and cache.faultbacks == 0
+
+
+def test_resident_keys_spans_both_tiers(tmp_path):
+    cache, a, _, _ = _spill_tail(tmp_path)
+    ram, disk = cache.resident_keys(a + [99])
+    assert len(ram) == 2 and len(disk) == 1
+    # prefix_keys (the wire `have` dedup) stays RAM-only on purpose
+    assert cache.prefix_keys(a + [99]) == ram
+
+
+# ---------------------------------------------------------------------------
+# durability: restart re-indexes the directory
+# ---------------------------------------------------------------------------
+
+
+def test_spill_tier_survives_restart(tmp_path):
+    cache = _cache(tmp_path, ram_blocks=2)
+    seq = list(range(1, 13))
+    k, v = _kv(12)
+    cache.insert_sequence(seq, k, v)
+    spilled = cache.spill.entries
+    assert spilled >= 1
+
+    # "restart": a fresh cache + a fresh DiskKVSpill on the same dir
+    cache2 = HostKVCache(
+        max_bytes=4 * _block_bytes(), block_tokens=BT
+    )
+    cache2.spill = DiskKVSpill(
+        str(tmp_path / "spill"), max_bytes=4 << 20
+    )
+    assert cache2.spill.entries == spilled
+
+    # the RAM trie is empty, so only runs STARTING at the root can
+    # match — re-insert the RAM-resident prefix, then the spilled
+    # tail extends it from disk
+    cache2.insert_sequence(seq[:8], k[:, :8], v[:, :8])
+    matched = cache2.match_prefix_len(seq + [99])
+    assert matched == 12
+    got = cache2.gather_prefix(seq + [99], matched)
+    assert got is not None
+    np.testing.assert_allclose(got[0], k[:, :12], rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# corruption: quarantined as a miss, never a crash
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_spill_file_reads_as_miss(tmp_path):
+    cache, a, _, _ = _spill_tail(tmp_path)
+    spill = cache.spill
+    spilled = spill.entries
+    # truncate every spill file mid-frame
+    spill_dir = str(tmp_path / "spill")
+    for name in os.listdir(spill_dir):
+        if name.endswith(SPILL_SUFFIX):
+            path = os.path.join(spill_dir, name)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(path) // 2))
+    probe = a + [99]
+    # the probe still counts the (now corrupt) disk block; gather must
+    # degrade to a cold start — counted + quarantined, never a crash
+    matched = cache.match_prefix_len(probe)
+    assert matched == 12
+    assert cache.gather_prefix(probe, matched) is None
+    assert spill.corrupt >= 1
+    # quarantined: the corrupt files are gone, later probes RAM-only
+    assert spill.entries < spilled
+    assert cache.match_prefix_len(probe) == 2 * BT
+
+
+def test_misfiled_spill_frame_fails_token_check(tmp_path):
+    cache, a, _, _ = _spill_tail(tmp_path)
+    spill = cache.spill
+    spill_dir = str(tmp_path / "spill")
+    names = [
+        n for n in os.listdir(spill_dir) if n.endswith(SPILL_SUFFIX)
+    ]
+    assert names
+    # a frame stored under the WRONG content key: the frame itself is
+    # intact (crc passes) but its tokens do not match the chain key —
+    # overwrite the spilled tail's file with a DIFFERENT block's frame
+    foreign = encode_spill_frame(
+        cache._blocks[next(iter(cache._blocks))]
+    )[1]
+    with open(os.path.join(spill_dir, names[0]), "wb") as f:
+        f.write(foreign)
+    probe = a + [99]
+    matched = cache.match_prefix_len(probe)
+    assert matched == 12
+    # never wrong bytes: the token check quarantines, reads as a miss
+    assert cache.gather_prefix(probe, matched) is None
+    assert spill.corrupt >= 1
+    assert cache.match_prefix_len(probe) == 2 * BT
+
+
+# ---------------------------------------------------------------------------
+# spill-directory byte budget
+# ---------------------------------------------------------------------------
+
+
+def test_spill_budget_evicts_oldest_files(tmp_path):
+    cache = _cache(tmp_path, ram_blocks=2)
+    seq = list(range(1, 13))
+    cache.insert_sequence(seq, *_kv(12))
+    frame = encode_spill_frame(
+        cache._blocks[next(iter(cache._blocks))]
+    )[1]
+    # a spill dir that can hold ~2 frames
+    tiny = DiskKVSpill(
+        str(tmp_path / "tiny"), max_bytes=int(len(frame) * 2.5)
+    )
+    for i in range(4):
+        assert tiny.store(f"{i:02x}" * 4, frame)
+    assert tiny.evictions >= 1
+    assert tiny.bytes_used <= int(len(frame) * 2.5)
+    # newest keys survive, oldest were dropped
+    assert tiny.has("03" * 4)
+    assert not tiny.has("00" * 4)
+
+
+# ---------------------------------------------------------------------------
+# eviction economics
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_prefers_unshared_untouched_blocks(tmp_path):
+    cache = HostKVCache(
+        max_bytes=2 * _block_bytes(), block_tokens=BT
+    )
+    a = list(range(1, 5))               # block A
+    b = list(range(21, 25))             # block B
+    cache.insert_sequence(a, *_kv(4, seed=1))
+    cache.insert_sequence(b, *_kv(4, seed=2))
+    assert cache.entries == 2
+    # A gets a directory-reported sharing boost; B stays cold
+    ram, _ = cache.resident_keys(a + [99])
+    assert cache.boost_sharing(ram, 4) == 1
+    # inserting C forces one eviction: B (unshared) must be the victim
+    cache.insert_sequence(list(range(41, 45)), *_kv(4, seed=3))
+    assert cache.match_prefix_len(a + [99]) == BT
+    assert cache.match_prefix_len(b + [99]) == 0
+
+
+def test_touches_protect_hot_blocks(tmp_path):
+    cache = HostKVCache(
+        max_bytes=2 * _block_bytes(), block_tokens=BT
+    )
+    a = list(range(1, 5))
+    b = list(range(21, 25))
+    cache.insert_sequence(a, *_kv(4, seed=1))
+    cache.insert_sequence(b, *_kv(4, seed=2))
+    # hammer A through the match path (touch), leave B idle — then
+    # age B well past A's recency
+    for _ in range(6):
+        assert cache.match_prefix_len(a + [99]) == BT
+    cache.insert_sequence(list(range(41, 45)), *_kv(4, seed=3))
+    assert cache.match_prefix_len(a + [99]) == BT
+    assert cache.match_prefix_len(b + [99]) == 0

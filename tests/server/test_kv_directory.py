@@ -1,0 +1,185 @@
+"""Cluster KV directory unit contracts (server/kv_directory.py):
+bounded summary folding, deepest-prefix-first mass routing,
+dead-peer invalidation, fleet sharing counts — plus the engine-side
+ConvIndex bridge feeding it and the affinity map's eviction-driven
+demotion (satellite: affinity entries can no longer outlive the
+blocks they point at).
+"""
+
+import numpy as np
+
+from gpustack_tpu.engine.kv_fabric import ConvIndex
+from gpustack_tpu.engine.kv_host_cache import HostKVCache
+from gpustack_tpu.server.kv_directory import ClusterKVDirectory
+from gpustack_tpu.server.resilience import (
+    PrefixAffinityMap,
+    conversation_chain,
+)
+
+L, H, HD, BT = 2, 2, 4, 4
+
+
+def _kv(n_tokens, seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    v = rng.standard_normal((L, n_tokens, H, HD)).astype(np.float32)
+    return k, v
+
+
+def _summary(keys):
+    return {
+        "keys": {
+            h: {"blocks": b, "tail": ""} for h, b in keys.items()
+        },
+        "conversations": len(keys),
+    }
+
+
+# ---------------------------------------------------------------------------
+# directory core
+# ---------------------------------------------------------------------------
+
+
+def test_update_bounds_keys_deepest_runs_win():
+    d = ClusterKVDirectory(max_keys_per_instance=16)
+    keys = {f"h{i}": i + 1 for i in range(40)}
+    kept = d.update(1, 1, _summary(keys))
+    assert kept == 16
+    held = d.instance_keys(1)
+    # the 16 DEEPEST runs survive the cap
+    assert set(held) == {f"h{i}" for i in range(24, 40)}
+    assert d.total_keys == 16
+
+
+def test_lookup_is_deepest_prefix_first_then_largest_mass():
+    d = ClusterKVDirectory()
+    chain = ["c0", "c1", "c2"]
+    # replica 1 holds the turn-0 prefix; replica 2 holds the FULL
+    # conversation (deeper in the chain) with fewer blocks
+    d.update(1, 1, _summary({"c0": 50}))
+    d.update(2, 1, _summary({"c2": 3}))
+    hit = d.lookup(chain)
+    assert hit is not None
+    assert (hit.instance_id, hit.depth, hit.blocks) == (2, 2, 3)
+    # at EQUAL depth the largest resident run wins
+    d.update(3, 1, _summary({"c2": 9}))
+    assert d.lookup(chain).instance_id == 3
+    # candidate restriction: only dialable replicas considered
+    assert d.lookup(chain, candidate_ids={1}).instance_id == 1
+    assert d.hits == 3 and d.misses == 0
+    assert d.lookup(["nope"]) is None
+    assert d.misses == 1
+
+
+def test_invalidate_instance_drops_its_advertisements():
+    d = ClusterKVDirectory()
+    d.update(1, 1, _summary({"c0": 4}))
+    d.update(2, 1, _summary({"c0": 8}))
+    assert d.lookup(["c0"]).instance_id == 2
+    assert d.invalidate_instance(2) == 1
+    assert d.invalidations == 1
+    assert d.lookup(["c0"]).instance_id == 1
+    # idempotent on unknown ids
+    assert d.invalidate_instance(99) == 0
+
+
+def test_sharing_counts_replicas_per_hash():
+    d = ClusterKVDirectory()
+    d.update(1, 1, _summary({"c0": 4, "c1": 2}))
+    d.update(2, 1, _summary({"c0": 8}))
+    d.update(3, 2, _summary({"c0": 8}))   # other model
+    assert d.sharing(model_id=1) == {"c0": 2, "c1": 1}
+    assert d.sharing()["c0"] == 3
+
+
+def test_metrics_lines_expose_every_counter_family():
+    d = ClusterKVDirectory()
+    d.update(1, 1, _summary({"c0": 4}))
+    d.lookup(["c0"])
+    text = "\n".join(d.metrics_lines())
+    for fam in (
+        "gpustack_kv_directory_instances",
+        "gpustack_kv_directory_keys",
+        "gpustack_kv_directory_refreshes_total",
+        "gpustack_kv_directory_refresh_failures_total",
+        "gpustack_kv_directory_invalidations_total",
+        "gpustack_kv_directory_hits_total",
+        "gpustack_kv_directory_misses_total",
+        "gpustack_kv_directory_stale_routes_total",
+        "gpustack_kv_directory_prefetches_total",
+    ):
+        assert f"# TYPE {fam} " in text
+        assert f"\n{fam} " in "\n" + text
+
+
+# ---------------------------------------------------------------------------
+# the ConvIndex bridge (engine keyspace → proxy keyspace)
+# ---------------------------------------------------------------------------
+
+
+def _bridge(seq):
+    cache = HostKVCache(max_bytes=1 << 20, block_tokens=BT)
+    cache.insert_sequence(seq, *_kv(len(seq)))
+    conv = ConvIndex()
+    chain = conversation_chain(
+        "m", [{"role": "user", "content": "hello"}]
+    )
+    conv.record(chain, seq)
+    return cache, conv, chain
+
+
+def test_summary_rechecks_residency_at_scrape_time():
+    seq = list(range(1, 13))            # 3 blocks
+    cache, conv, chain = _bridge(seq)
+    summary = conv.summary(cache)
+    assert summary["conversations"] == 1
+    entry = summary["keys"][chain[-1]]
+    # proper-prefix convention: a 12-token conversation advertises 2
+    # matchable blocks (the walk never claims the full sequence)
+    assert entry["blocks"] == 2
+    assert entry["tail"]                # deepest RAM chain key
+    # evict everything: the next scrape advertises NOTHING — exactly
+    # what lets the server demote stale affinity entries
+    cache.max_bytes = 0
+    cache.insert_sequence(list(range(50, 54)), *_kv(4, seed=9))
+    summary2 = conv.summary(cache)
+    assert chain[-1] not in summary2["keys"]
+
+
+def test_apply_sharing_boosts_resident_blocks():
+    seq = list(range(1, 13))
+    cache, conv, chain = _bridge(seq)
+    assert conv.apply_sharing(cache, {chain[-1]: 3}) == 2
+    # a sharing count of 1 (just us) is not a boost
+    assert conv.apply_sharing(cache, {chain[-1]: 1}) == 0
+
+
+def test_directory_roundtrip_through_conv_index():
+    seq = list(range(1, 13))
+    cache, conv, chain = _bridge(seq)
+    d = ClusterKVDirectory()
+    d.update(5, 1, conv.summary(cache))
+    hit = d.lookup(chain)
+    assert hit is not None
+    assert hit.instance_id == 5 and hit.blocks == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: eviction-driven affinity demotion
+# ---------------------------------------------------------------------------
+
+
+def test_demote_stale_drops_only_dead_keys_of_that_instance():
+    m = PrefixAffinityMap()
+    c1 = conversation_chain("m", [{"role": "user", "content": "a"}])
+    c2 = conversation_chain("m", [{"role": "user", "content": "b"}])
+    c3 = conversation_chain("m", [{"role": "user", "content": "c"}])
+    m.record(c1[-1], 1, model_id=1)
+    m.record(c2[-1], 1, model_id=1)
+    m.record(c3[-1], 2, model_id=1)
+    # the refresh scraped instance 1 and only c1 is still resident:
+    # c2's entry is demoted, instance 2's entry untouched
+    assert m.demote_stale(1, {c1[-1]}) == 1
+    assert m.lookup(c1) == 1
+    assert m.lookup(c2) is None
+    assert m.lookup(c3) == 2

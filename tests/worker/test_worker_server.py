@@ -253,9 +253,16 @@ def test_kv_scoped_token_auth(tmp_path):
             headers={"Authorization": f"Bearer {stale}"},
         )
         assert r.status == 401
-        # the full secret still opens everything
+        # the FULL proxy secret is rejected on the export path: the
+        # engine→engine pull credential is kv-token-only, so a peer
+        # engine never needs (and never sees) the all-routes secret
         r = await client.post("/proxy/instances/5/kv/export",
                               headers=AUTH)
+        assert r.status == 401
+        # ...while the full secret still opens every other route
+        r = await client.post(
+            "/proxy/instances/5/v1/chat/completions", headers=AUTH
+        )
         assert r.status != 401
 
     _run(cfg, go)
