@@ -1,4 +1,4 @@
-.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze chaos scale
+.PHONY: test test-fast test-engine test-e2e native bench smoke clean verify analyze chaos scale lockdep
 
 test:
 	python -m pytest tests/ -q
@@ -42,6 +42,17 @@ CLASSES ?= all
 SEED ?= 1
 chaos:
 	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes $(CLASSES) --seed $(SEED)
+
+# Chaos under the runtime lockdep monitor (docs/ANALYSIS.md "Runtime
+# lockdep"): every threading.Lock/RLock/Condition the cluster
+# constructs is acquisition-order- and hold-time-tracked; the observed
+# edges merge with the analyzer's static lock graph and any cycle (an
+# ABBA deadlock some interleaving can reach, even if this run never
+# hung) or over-threshold hold fails the class. Narrow with
+# LOCKDEP_CLASSES (default: worker-kill, the densest thread mesh).
+LOCKDEP_CLASSES ?= worker-kill
+lockdep:
+	JAX_PLATFORMS=cpu python -m gpustack_tpu.testing.chaos --classes $(LOCKDEP_CLASSES) --seed $(SEED) --lockdep
 
 # Slow scheduler-at-scale suites (docs/RESILIENCE.md "Scale &
 # crash-consistency"): the 1000+-worker fleet suite (reconcile-pass

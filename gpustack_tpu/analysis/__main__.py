@@ -9,9 +9,50 @@ stay empty for blocking-in-async and state-machine).
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 import time
+
+
+def _changed_files(root):
+    """Repo-relative .py paths touched vs. HEAD (staged, unstaged, and
+    untracked). Returns None when git is unavailable — callers fall
+    back to a full scan rather than silently analyzing nothing."""
+    paths = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0 or status.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    for line in diff.stdout.splitlines():
+        paths.add(line.strip())
+    for line in status.stdout.splitlines():
+        entry = line[3:].strip()
+        if " -> " in entry:  # rename: keep the new path
+            entry = entry.split(" -> ", 1)[1]
+        paths.add(entry.strip('"'))
+    return {p for p in paths if p.endswith(".py")}
+
+
+def _finding_dict(f):
+    return {
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+        "severity": f.severity,
+        "key": f.key,
+    }
 
 
 def main(argv=None) -> int:
@@ -51,6 +92,16 @@ def main(argv=None) -> int:
         "-q", "--quiet", action="store_true",
         help="summary line only",
     )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable report on stdout (findings, summary)",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="scope the scan to files changed vs. HEAD (staged, "
+        "unstaged, untracked) — a fast pre-commit screen; the full "
+        "run remains the gate",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -64,11 +115,40 @@ def main(argv=None) -> int:
         print(e.args[0], file=sys.stderr)
         return 2
 
+    only = None
+    if args.changed_only:
+        only = _changed_files(args.root)
+        if only is None:
+            print(
+                "note: --changed-only needs git; scanning the full "
+                "tree",
+                file=sys.stderr,
+            )
+        elif not only:
+            print("analysis: no changed .py files; nothing to scan")
+            return 0
+
     t0 = time.monotonic()
     result = core.run_analysis(
-        args.root, rules=selected, baseline_path=args.baseline
+        args.root, rules=selected, baseline_path=args.baseline,
+        only=only,
     )
     elapsed = time.monotonic() - t0
+
+    if args.as_json:
+        report = {
+            "ok": result.ok,
+            "new": [_finding_dict(f) for f in result.new],
+            "frozen": [_finding_dict(f) for f in result.frozen],
+            "stale_baseline_keys": result.stale_baseline_keys,
+            "rules_run": result.rules_run,
+            "files_scanned": result.files_scanned,
+            "cache_hits": result.cache_hits,
+            "elapsed_s": round(elapsed, 3),
+            "changed_only": args.changed_only,
+        }
+        print(json.dumps(report, indent=2))
+        return 1 if result.new else 0
 
     if args.update_baseline:
         # a partial run (--rule) must not erase other rules' frozen

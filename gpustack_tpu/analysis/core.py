@@ -61,6 +61,11 @@ class Rule:
 
     id: str = ""
     description: str = ""
+    # True for rules whose findings are only meaningful against the
+    # WHOLE tree (docs cross-checked against every emitter/field).
+    # Scoped --changed-only runs skip them: on a slice, every
+    # out-of-scope emitter reads as drift.
+    whole_program: bool = False
 
     def check(self, project: "Project") -> Iterator[Finding]:
         raise NotImplementedError
@@ -132,10 +137,17 @@ class Project:
     parse cache. ``root`` is the repo root (the directory holding
     ``gpustack_tpu/``, ``docs/``, ``tests/``)."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, only: Optional[Set[str]] = None):
         self.root = os.path.abspath(root)
         self._files: Dict[str, SourceFile] = {}
         self._listing: Dict[str, List[str]] = {}
+        # scope filter (repo-relative paths) for --changed-only runs;
+        # None = whole tree
+        self.only = only
+        # parse-cache hits: every request for an already-parsed file.
+        # N rules over one tree should pay ~1 parse per file — the
+        # analysis test suite asserts this stays hot.
+        self.cache_hits = 0
 
     # ---- discovery ------------------------------------------------------
 
@@ -163,6 +175,8 @@ class Project:
                 rel = f"{rel_dir}/{name}"
                 if not self._excluded(rel):
                     out.append(rel)
+        if self.only is not None:
+            out = [r for r in out if r in self.only]
         self._listing[prefix] = out
         return out
 
@@ -183,6 +197,8 @@ class Project:
             if not os.path.exists(os.path.join(self.root, rel)):
                 return None
             self._files[rel] = SourceFile(self.root, rel)
+        else:
+            self.cache_hits += 1
         return self._files[rel]
 
     def read_text(self, rel: str) -> Optional[str]:
@@ -247,6 +263,7 @@ class AnalysisResult:
     stale_baseline_keys: List[str]
     rules_run: List[str]
     files_scanned: int
+    cache_hits: int = 0
 
     @property
     def ok(self) -> bool:
@@ -258,9 +275,14 @@ def run_analysis(
     rules: Optional[Iterable[Rule]] = None,
     baseline: Optional[Dict[str, int]] = None,
     baseline_path: str = DEFAULT_BASELINE,
+    only: Optional[Set[str]] = None,
 ) -> AnalysisResult:
     """Run ``rules`` (default: all registered) over ``root`` and split
-    findings into new vs. baseline-frozen."""
+    findings into new vs. baseline-frozen. ``only`` scopes the scan to
+    a set of repo-relative paths (--changed-only) and skips
+    ``whole_program`` rules — docs-vs-codebase drift checks can only
+    produce noise on a slice. Scoped runs are a fast pre-commit
+    screen, not the gate."""
     if rules is None:
         from gpustack_tpu.analysis.rules import get_rules
 
@@ -268,10 +290,12 @@ def run_analysis(
     if baseline is None:
         baseline = load_baseline(baseline_path)
 
-    project = Project(root)
+    project = Project(root, only=only)
     findings: List[Finding] = []
     rule_ids: List[str] = []
     for rule in rules:
+        if only is not None and rule.whole_program:
+            continue
         rule_ids.append(rule.id)
         for f in rule.check(project):
             src = project.source(f.path)
@@ -290,10 +314,15 @@ def run_analysis(
         else:
             new.append(f)
     stale = sorted(k for k, n in budget.items() if n > 0)
+    if only is not None:
+        # a scoped run cannot prove a baseline entry fixed — the file
+        # holding it may simply be out of scope
+        stale = []
     return AnalysisResult(
         new=new,
         frozen=frozen,
         stale_baseline_keys=stale,
         rules_run=rule_ids,
         files_scanned=len(project.py_files()),
+        cache_hits=project.cache_hits,
     )

@@ -13,6 +13,9 @@ from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
 from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
 from gpustack_tpu.analysis.rules.sync_dispatch import SyncInDispatchRule
 from gpustack_tpu.analysis.rules.route_auth import RouteAuthRule
+from gpustack_tpu.analysis.rules.guarded_by import GuardedByRule
+from gpustack_tpu.analysis.rules.lock_order import LockOrderRule
+from gpustack_tpu.analysis.rules.thread_boundary import ThreadBoundaryRule
 
 ALL_RULES = (
     BlockingInAsyncRule,
@@ -22,6 +25,9 @@ ALL_RULES = (
     MetricsDriftRule,
     SyncInDispatchRule,
     RouteAuthRule,
+    GuardedByRule,
+    LockOrderRule,
+    ThreadBoundaryRule,
 )
 
 

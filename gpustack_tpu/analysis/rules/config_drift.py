@@ -42,6 +42,7 @@ class ConfigDocDriftRule(Rule):
         "Config fields, docs/CONFIG.md rows, and env reads must agree "
         "(names and GPUSTACK_TPU_ prefix)"
     )
+    whole_program = True
 
     def check(self, project: Project) -> Iterator[Finding]:
         fields = self._config_fields(project)

@@ -1,0 +1,228 @@
+"""guarded-by: declared lock/owner discipline for shared attributes.
+
+The thread mesh built across the overlapped engine (scheduler loop,
+detok worker, KV stager, kv-copy executor, HTTP exporters) shares
+plain-attribute state whose safety rests on conventions the code never
+declared: "this dict is only touched under ``self._mu``", "this slot
+table is scheduler-thread-only". This rule makes the convention a
+checked contract. A module opts in with a module-level literal::
+
+    GUARDED_BY = {
+        "_index": "_mu",                  # lock-guarded attribute
+        "_slots": ("_loop", "step"),      # single-thread-owned: the
+                                          # only methods that may touch
+        "_waiting": OWNER_GROUP_NAME,     # value may name another
+                                          # module-level tuple literal
+    }
+
+Semantics, per declared attribute (``self.<attr>`` accesses in every
+class of the module; bare-``Name`` accesses too when the module assigns
+the name at top level — module-global state like a store registry):
+
+- value is a **string** → the attribute may be read or written only
+  (a) lexically inside a ``with self.<lock>:`` (or module-level
+  ``with <lock>:``) block within the same function — a nested
+  ``def``/``lambda`` does *not* inherit the guard, it may run on any
+  thread later; (b) inside a method whose name ends in ``_locked``
+  (the repo's caller-holds-the-lock suffix convention); or (c) inside
+  ``__init__`` (construction happens-before publication).
+- value is a **tuple/list of strings** → an owner list: only those
+  methods (plus ``__init__``) may touch the attribute. This is the
+  declaration for single-thread-owned state (the scheduler's slot
+  table, the detok worker's buffers) where a lock would be overhead.
+
+Keys may be class-qualified (``"Stager._inflight"``) when two classes
+in one module reuse an attribute name with different guards; an
+unqualified key applies to every class in the module. Reviewed
+cross-thread reads that tolerate a torn value (observational gauges on
+a health endpoint) take ``# analysis: ignore[guarded-by]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple, Union
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+DECLARATION = "GUARDED_BY"
+
+# guard: ("lock", "<lock attr>") or ("owners", frozenset of method names)
+Guard = Tuple[str, Union[str, frozenset]]
+
+_FUNCTION_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _module_level_names(tree: ast.Module) -> Set[str]:
+    """Names assigned at module top level (module-global state)."""
+    names: Set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _string_tuple(node: ast.AST) -> Optional[frozenset]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant)
+                and isinstance(elt.value, str)
+            ):
+                return None
+            out.add(elt.value)
+        return frozenset(out)
+    return None
+
+
+def declared_guards(tree: ast.Module) -> Dict[str, Guard]:
+    """Parse the module-level GUARDED_BY dict literal. Values may be a
+    string (lock attr), a tuple/list of strings (owner methods), or a
+    Name referring to a module-level tuple literal (shared owner
+    group). Unparseable entries are skipped — the declaration is a
+    literal contract, not code."""
+    literals: Dict[str, ast.AST] = {}
+    decl: Optional[ast.Dict] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                literals[tgt.id] = node.value
+                if tgt.id == DECLARATION and isinstance(
+                    node.value, ast.Dict
+                ):
+                    decl = node.value
+    if decl is None:
+        return {}
+    guards: Dict[str, Guard] = {}
+    for key_node, val in zip(decl.keys, decl.values):
+        if not (
+            isinstance(key_node, ast.Constant)
+            and isinstance(key_node.value, str)
+        ):
+            continue
+        key = key_node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            guards[key] = ("lock", val.value)
+            continue
+        owners = _string_tuple(val)
+        if owners is None and isinstance(val, ast.Name):
+            owners = _string_tuple(literals.get(val.id))
+        if owners is not None:
+            guards[key] = ("owners", owners)
+    return guards
+
+
+def _with_guards(node: ast.AST, stop: ast.AST) -> Set[str]:
+    """Dotted names of every ``with`` context manager between ``node``
+    and its nearest enclosing function ``stop`` (exclusive). Walking
+    stops at ``stop`` so a closure cannot inherit its definer's lock."""
+    held: Set[str] = set()
+    cur = getattr(node, "parent", None)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                name = astutil.dotted_name(item.context_expr)
+                if name:
+                    held.add(name)
+        cur = getattr(cur, "parent", None)
+    return held
+
+
+class GuardedByRule(Rule):
+    id = "guarded-by"
+    description = (
+        "access to a GUARDED_BY-declared attribute outside its "
+        "`with self.<lock>` block / `_locked`-suffix method / "
+        "declared owner-method list"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            guards = declared_guards(tree)
+            if not guards:
+                continue
+            module_names = _module_level_names(tree)
+            yield from self._check_module(
+                rel, tree, guards, module_names
+            )
+
+    def _check_module(
+        self,
+        rel: str,
+        tree: ast.Module,
+        guards: Dict[str, Guard],
+        module_names: Set[str],
+    ) -> Iterator[Finding]:
+        bare_keys = {
+            k for k in guards
+            if "." not in k and k in module_names
+        }
+        for node in ast.walk(tree):
+            attr: Optional[str] = None
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                attr = node.attr
+            elif isinstance(node, ast.Name) and node.id in bare_keys:
+                attr = node.id
+            if attr is None:
+                continue
+            guard = self._guard_for(node, attr, guards)
+            if guard is None:
+                continue
+            fn = astutil.enclosing(node, _FUNCTION_KINDS)
+            if fn is None:
+                continue  # module-level (import-time, single-threaded)
+            fn_name = getattr(fn, "name", "<lambda>")
+            if fn_name == "__init__":
+                continue
+            kind, spec = guard
+            if kind == "owners":
+                if fn_name in spec:
+                    continue
+                yield self.finding(
+                    rel,
+                    node.lineno,
+                    f"'{attr}' is owned by "
+                    f"{{{', '.join(sorted(spec))}}} but accessed "
+                    f"from {fn_name}()",
+                )
+                continue
+            if fn_name.endswith("_locked"):
+                continue
+            held = _with_guards(node, fn)
+            if f"self.{spec}" in held or spec in held:
+                continue
+            yield self.finding(
+                rel,
+                node.lineno,
+                f"'{attr}' is guarded by '{spec}' but accessed "
+                f"outside `with self.{spec}` in {fn_name}()",
+            )
+
+    @staticmethod
+    def _guard_for(
+        node: ast.AST, attr: str, guards: Dict[str, Guard]
+    ) -> Optional[Guard]:
+        """Class-qualified key wins over an unqualified one."""
+        cls = astutil.enclosing(node, (ast.ClassDef,))
+        if cls is not None:
+            qualified = guards.get(f"{cls.name}.{attr}")
+            if qualified is not None:
+                return qualified
+        return guards.get(attr)

@@ -1,0 +1,267 @@
+"""lock-order: static lock acquisition graph + cycle detection.
+
+Two threads acquiring the same two locks in opposite orders is a
+deadlock waiting for a scheduler interleaving — the classic ABBA hang,
+invisible in single-threaded tests and fatal the first time the
+overlapped engine runs on real parallelism. This rule extracts the
+static acquisition-order graph and fails on any cycle.
+
+Edges come from two shapes (shared parse cache, whole tree):
+
+- **nested ``with`` blocks**: ``with self._a: ... with self._b:``
+  within one function adds the edge ``_a → _b`` (multiple items in one
+  ``with a, b:`` count left-to-right);
+- **cross-function calls**: a call made while holding a lock adds an
+  edge to every lock the callee (resolved within the same module —
+  ``self.helper()`` / bare ``helper()``) acquires anywhere, computed
+  transitively with memoization, so ``with self._a: self.f()`` where
+  ``f`` calls ``g`` and ``g`` takes ``self._b`` still yields
+  ``_a → _b``.
+
+Lock expressions are recognized by name: the last dotted segment must
+look lock-like (``_mu``, ``_lock``, ``_wake``, ``_cv``, ``mutex``,
+``*_sem``, ``_cond``, case-insensitive). Nodes are labeled
+``<path>::<Class>.<attr>`` (or ``<path>::<name>`` for module-level
+locks), so two classes' same-named locks stay distinct edges; the
+runtime lockdep harness (gpustack_tpu/testing/lockdep.py) merges this
+graph with observed acquisition edges after normalizing labels.
+
+A genuinely ordered-by-construction pair that the rule cannot see
+(e.g. ids sorted before acquisition) takes
+``# analysis: ignore[lock-order]`` on the inner acquisition line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+LOCK_NAME = re.compile(
+    r"(^|_)(r?lock|mu|mutex|sem|cond(ition)?|cv|wake)$", re.I
+)
+
+_FUNCTION_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# (src label, dst label) -> (path, line) of the first inner acquisition
+EdgeMap = Dict[Tuple[str, str], Tuple[str, int]]
+
+
+def _lock_label(
+    expr: ast.AST, rel: str, cls_name: str
+) -> Optional[str]:
+    name = astutil.dotted_name(expr)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if not LOCK_NAME.search(last):
+        return None
+    if name.startswith("self."):
+        prefix = f"{cls_name}." if cls_name else ""
+        return f"{rel}::{prefix}{last}"
+    if "." in name:
+        return None  # foreign object's lock: unresolvable statically
+    return f"{rel}::{last}"
+
+
+class _ModuleGraph:
+    """Per-module extraction: function index, per-function acquired
+    lock sets (transitive over same-module calls), and edges."""
+
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        # "Class.method" and "method" and "func" -> function node
+        self.functions: Dict[str, ast.AST] = {}
+        self._acquires: Dict[str, Set[str]] = {}
+        self._index()
+
+    def _index(self) -> None:
+        for node in self.tree.body:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.functions.setdefault(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self.functions[f"{node.name}.{sub.name}"] = sub
+                        self.functions.setdefault(sub.name, sub)
+
+    def _cls_of(self, fn: ast.AST) -> str:
+        cls = astutil.enclosing(fn, (ast.ClassDef,))
+        return cls.name if cls is not None else ""
+
+    def _resolve_call(self, call: ast.Call, cls_name: str) -> List[str]:
+        """Keys into ``self.functions`` for a same-module call."""
+        name = astutil.dotted_name(call.func)
+        if not name:
+            return []
+        if name.startswith("self."):
+            meth = name[len("self."):]
+            if "." in meth:
+                return []
+            qualified = f"{cls_name}.{meth}"
+            if qualified in self.functions:
+                return [qualified]
+            return [meth] if meth in self.functions else []
+        if "." not in name and name in self.functions:
+            return [name]
+        return []
+
+    def acquired_by(
+        self, key: str, _visiting: Optional[Set[str]] = None
+    ) -> Set[str]:
+        """Every lock label ``key``'s function may acquire, same-module
+        callees included (memoized, cycle-guarded)."""
+        if key in self._acquires:
+            return self._acquires[key]
+        visiting = _visiting if _visiting is not None else set()
+        if key in visiting:
+            return set()
+        visiting.add(key)
+        fn = self.functions.get(key)
+        out: Set[str] = set()
+        if fn is not None:
+            cls_name = self._cls_of(fn)
+            for node in self._scope_walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        label = _lock_label(
+                            item.context_expr, self.rel, cls_name
+                        )
+                        if label:
+                            out.add(label)
+                elif isinstance(node, ast.Call):
+                    for callee in self._resolve_call(node, cls_name):
+                        out |= self.acquired_by(callee, visiting)
+        visiting.discard(key)
+        self._acquires[key] = out
+        return out
+
+    @staticmethod
+    def _scope_walk(fn: ast.AST) -> Iterator[ast.AST]:
+        """Nodes lexically in ``fn``, nested def/lambda bodies skipped
+        (a closure runs on whatever thread later calls it)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNCTION_KINDS):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def edges(self) -> EdgeMap:
+        out: EdgeMap = {}
+        seen_fns = {id(fn): fn for fn in self.functions.values()}
+        for fn in seen_fns.values():
+            cls_name = self._cls_of(fn)
+            self._edges_under(fn, [], cls_name, out)
+        return out
+
+    def _edges_under(
+        self,
+        node: ast.AST,
+        held: List[str],
+        cls_name: str,
+        out: EdgeMap,
+    ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCTION_KINDS):
+                continue
+            acquired: List[str] = []
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    label = _lock_label(
+                        item.context_expr, self.rel, cls_name
+                    )
+                    if label:
+                        for h in held + acquired:
+                            if h != label:
+                                out.setdefault(
+                                    (h, label),
+                                    (self.rel, child.lineno),
+                                )
+                        acquired.append(label)
+            elif isinstance(child, ast.Call) and held:
+                for callee in self._resolve_call(child, cls_name):
+                    for label in self.acquired_by(callee):
+                        for h in held:
+                            if h != label:
+                                out.setdefault(
+                                    (h, label),
+                                    (self.rel, child.lineno),
+                                )
+            self._edges_under(child, held + acquired, cls_name, out)
+
+
+def acquisition_edges(project: Project) -> EdgeMap:
+    """The whole tree's static acquisition graph — shared with the
+    runtime lockdep harness, which merges observed edges into it."""
+    edges: EdgeMap = {}
+    for rel in project.py_files("gpustack_tpu"):
+        src = project.source(rel)
+        tree = src.tree if src else None
+        if tree is None:
+            continue
+        edges.update(_ModuleGraph(rel, tree).edges())
+    return edges
+
+
+def find_cycles(
+    edges: Set[Tuple[str, str]]
+) -> List[List[str]]:
+    """Elementary cycles, each rotated to start at its smallest label
+    and deduplicated — deterministic across runs."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in path and nxt > start:
+                # only explore labels > start: each cycle is found
+                # exactly once, rooted at its smallest node
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return [list(c) for c in sorted(cycles)]
+
+
+class LockOrderRule(Rule):
+    id = "lock-order"
+    description = (
+        "cycle in the static lock acquisition-order graph (nested "
+        "`with` blocks + same-module call chains) — an ABBA deadlock "
+        "waiting for an interleaving"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        edges = acquisition_edges(project)
+        for cycle in find_cycles(set(edges)):
+            ring = cycle + [cycle[0]]
+            locations = []
+            for a, b in zip(ring, ring[1:]):
+                loc = edges.get((a, b))
+                if loc is not None:
+                    locations.append(loc)
+            path, line = min(locations) if locations else ("", 0)
+            yield self.finding(
+                path,
+                line,
+                "lock acquisition cycle: "
+                + " -> ".join(ring)
+                + " (some thread can hold each lock while wanting "
+                "the next)",
+            )

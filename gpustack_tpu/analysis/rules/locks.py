@@ -11,13 +11,17 @@ parses as a different node. Matched context managers:
 - names/attributes whose last segment looks lock-like (``lock``,
   ``_lock``, ``mutex``, ``rlock``) or session-like (``session``,
   ``*_session``)
+- calls to a same-module helper whose body takes such a lock — one
+  level of resolution, so ``with self._entries_view():`` where the
+  ``@contextmanager`` helper does ``with self._lock: yield`` is still
+  flagged. An innocuously named helper that holds no lock stays quiet.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator
+from typing import Dict, Iterator, Optional
 
 from gpustack_tpu.analysis import astutil
 from gpustack_tpu.analysis.core import Finding, Project, Rule
@@ -47,11 +51,12 @@ class HeldAcrossAwaitRule(Rule):
             if tree is None:
                 continue
             aliases = astutil.import_aliases(tree)
+            helpers = _local_functions(tree)
             for fn in astutil.async_functions(tree):
                 for node in astutil.scope_walk(fn):
                     if not isinstance(node, ast.With):
                         continue
-                    held = self._lock_expr(node, aliases)
+                    held = self._lock_expr(node, aliases, helpers)
                     if held and any(
                         astutil.contains_await(stmt)
                         for stmt in node.body
@@ -63,13 +68,21 @@ class HeldAcrossAwaitRule(Rule):
                             f"async def {fn.name}()",
                         )
 
-    def _lock_expr(self, node: ast.With, aliases) -> str:
+    def _lock_expr(
+        self,
+        node: ast.With,
+        aliases,
+        helpers: Dict[str, ast.AST],
+    ) -> str:
         for item in node.items:
             expr = item.context_expr
             if isinstance(expr, ast.Call):
                 name = astutil.resolve_call(expr, aliases)
                 if name in LOCK_FACTORIES:
                     return f"{name}()"
+                inner = self._helper_lock(expr, aliases, helpers)
+                if inner:
+                    return inner
                 expr_name = name
             else:
                 expr_name = astutil.dotted_name(expr)
@@ -78,3 +91,58 @@ class HeldAcrossAwaitRule(Rule):
             ):
                 return expr_name
         return ""
+
+    @staticmethod
+    def _helper_lock(
+        call: ast.Call, aliases, helpers: Dict[str, ast.AST]
+    ) -> Optional[str]:
+        """One level of same-module resolution: a `with helper():`
+        whose body takes a sync lock is as held as the lock itself."""
+        dotted = astutil.dotted_name(call.func)
+        if not dotted:
+            return None
+        fn = helpers.get(dotted.rsplit(".", 1)[-1])
+        if fn is None:
+            return None
+        for sub in astutil.scope_walk(fn):
+            lockname: Optional[str] = None
+            if isinstance(sub, ast.With):
+                for it in sub.items:
+                    expr = it.context_expr
+                    if isinstance(expr, ast.Call):
+                        n = astutil.resolve_call(expr, aliases)
+                        if n in LOCK_FACTORIES:
+                            lockname = f"{n}()"
+                    else:
+                        n = astutil.dotted_name(expr)
+                        if n and LOCKLIKE_NAME.search(
+                            n.rsplit(".", 1)[-1]
+                        ):
+                            lockname = n
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "acquire"
+            ):
+                n = astutil.dotted_name(sub.func.value)
+                if n and LOCKLIKE_NAME.search(n.rsplit(".", 1)[-1]):
+                    lockname = n
+            if lockname:
+                return f"{dotted}() (acquires {lockname})"
+        return None
+
+
+def _local_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Same-module callables by bare name — top-level defs and class
+    methods — for one-level helper resolution."""
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    out.setdefault(sub.name, sub)
+    return out

@@ -62,6 +62,7 @@ class MetricsDriftRule(Rule):
         "metric names unique/snake_case in emitters; docs and tests "
         "reference only names the code can emit"
     )
+    whole_program = True
 
     def check(self, project: Project) -> Iterator[Finding]:
         decls: List[Tuple[str, str, str, int]] = []  # name,kind,file,line

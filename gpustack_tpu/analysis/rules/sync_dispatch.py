@@ -21,7 +21,14 @@ vocabulary is flagged:
 - ``np.asarray(...)`` (``numpy.asarray`` after alias resolution) — a
   device→host copy when handed a device array;
 - ``.item()`` — a device scalar sync;
-- ``jax.block_until_ready(...)`` / ``jax.device_get(...)``.
+- ``jax.block_until_ready(...)`` / ``jax.device_get(...)``;
+- blocking file I/O — ``open(...)``, ``os.replace(...)``,
+  ``os.unlink(...)``, and ``.read_bytes()``/``.write_bytes()``/
+  ``.read_text()``/``.write_text()`` (the ``pathlib`` spellings): the
+  disk spill tier's store/load path must never run on the scheduler —
+  residency probes (``DiskKVSpill.has``/``size``) and the host cache's
+  match path declare themselves sync-free, keeping a disk seek off
+  every step.
 
 Host syncs belong in the module's designated fetch/drain helpers
 (simply not listed in ``DISPATCH_SYNC_FREE``); a genuinely host-only
@@ -45,6 +52,23 @@ SYNC_CALLS = {
     "numpy.asarray": "device→host copy np.asarray()",
     "jax.block_until_ready": "jax.block_until_ready()",
     "jax.device_get": "jax.device_get()",
+    # PR 16 spill tier: dispatch must never touch the filesystem — a
+    # disk seek on the scheduler re-serializes the pipeline exactly
+    # like a device sync does
+    "open": "blocking file I/O open()",
+    "io.open": "blocking file I/O io.open()",
+    "os.replace": "blocking file I/O os.replace()",
+    "os.unlink": "blocking file I/O os.unlink()",
+}
+
+# argless pathlib-style sync methods (``p.read_bytes()``), matched by
+# attribute like ``.item()`` is
+SYNC_METHODS = {
+    "item": "device scalar sync .item()",
+    "read_bytes": "blocking file I/O .read_bytes()",
+    "write_bytes": "blocking file I/O .write_bytes()",
+    "read_text": "blocking file I/O .read_text()",
+    "write_text": "blocking file I/O .write_text()",
 }
 
 
@@ -122,11 +146,15 @@ class SyncInDispatchRule(Rule):
         name = astutil.resolve_call(call, aliases)
         if name in SYNC_CALLS:
             return SYNC_CALLS[name]
-        if (
-            isinstance(call.func, ast.Attribute)
-            and call.func.attr == "item"
-            and not call.args
-            and not call.keywords
-        ):
-            return "device scalar sync .item()"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            msg = SYNC_METHODS.get(attr)
+            # `.item()`/`.read_*()` must be argless to count (keeps
+            # dict-ish `.item(key)` lookalikes out); the pathlib
+            # write methods take their payload argument
+            if msg and (
+                attr.startswith("write_")
+                or (not call.args and not call.keywords)
+            ):
+                return msg
         return None

@@ -1,0 +1,178 @@
+"""thread-boundary: declared thread-owned state vs. the asyncio loop.
+
+The async-loop/scheduler-thread seam is where the overlapped engine's
+wakeup bugs lived: state owned by a worker thread mutated from an
+``async def`` body (or loop-owned state mutated from a thread entry
+point) races without any lock to point at. Modules declare the seam as
+module-level literals::
+
+    THREAD_OWNED = ("_slots", "_detok_batch")   # worker/scheduler
+                                                # thread state
+    LOOP_OWNED = ("_hb", "_status")             # event-loop state
+
+and the rule flags, on ``self.<attr>`` (or module-global bare-name)
+accesses:
+
+- a ``THREAD_OWNED`` attribute touched lexically inside an
+  ``async def`` body (nested ``def``/``lambda`` bodies excluded —
+  those run wherever they are called, typically a thread pool);
+- a ``LOOP_OWNED`` attribute touched inside a function used as a
+  thread entry point — any function the module passes as ``target=``
+  to ``threading.Thread(...)``.
+
+``__init__`` is exempt (construction happens-before thread start). A
+reviewed crossing (e.g. a racy-tolerant gauge read for an HTTP
+handler) takes ``# analysis: ignore[thread-boundary]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from gpustack_tpu.analysis import astutil
+from gpustack_tpu.analysis.core import Finding, Project, Rule
+
+THREAD_DECL = "THREAD_OWNED"
+LOOP_DECL = "LOOP_OWNED"
+
+_FUNCTION_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _declared_tuple(tree: ast.Module, name: str) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.add(elt.value)
+    return out
+
+
+def _thread_targets(tree: ast.Module, aliases) -> Set[str]:
+    """Function names the module hands to ``threading.Thread(target=)``
+    — the thread entry points."""
+    targets: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if astutil.resolve_call(node, aliases) != "threading.Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            name = astutil.dotted_name(kw.value)
+            if name:
+                targets.add(name.rsplit(".", 1)[-1])
+    return targets
+
+
+def _accesses(node: ast.AST, attrs: Set[str], bare: Set[str]):
+    """(line, attr) for every self.<attr>/bare-name access in scope."""
+    if isinstance(node, ast.Attribute):
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in attrs
+        ):
+            yield node.lineno, node.attr
+    elif isinstance(node, ast.Name) and node.id in bare:
+        yield node.lineno, node.id
+
+
+class ThreadBoundaryRule(Rule):
+    id = "thread-boundary"
+    description = (
+        "THREAD_OWNED attribute touched from an `async def` body, or "
+        "LOOP_OWNED attribute touched from a thread entry point "
+        "(the async-loop/worker-thread seam)"
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for rel in project.py_files("gpustack_tpu"):
+            src = project.source(rel)
+            tree = src.tree if src else None
+            if tree is None:
+                continue
+            thread_owned = _declared_tuple(tree, THREAD_DECL)
+            loop_owned = _declared_tuple(tree, LOOP_DECL)
+            if not thread_owned and not loop_owned:
+                continue
+            aliases = astutil.import_aliases(tree)
+            module_names = {
+                n
+                for n in (thread_owned | loop_owned)
+                if n in _module_level_assigns(tree)
+            }
+            # thread-owned state in async bodies
+            for fn in astutil.async_functions(tree):
+                for node in astutil.scope_walk(fn):
+                    for line, attr in _accesses(
+                        node, thread_owned,
+                        thread_owned & module_names,
+                    ):
+                        yield self.finding(
+                            rel,
+                            line,
+                            f"thread-owned '{attr}' touched from "
+                            f"async def {fn.name}() — loop code must "
+                            f"not reach across the thread boundary",
+                        )
+            # loop-owned state in thread entry points
+            entries = _thread_targets(tree, aliases)
+            if not (entries and loop_owned):
+                continue
+            for fn in ast.walk(tree):
+                if not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if fn.name not in entries or isinstance(
+                    fn, ast.AsyncFunctionDef
+                ):
+                    continue
+                for node in self._sync_scope_walk(fn):
+                    for line, attr in _accesses(
+                        node, loop_owned, loop_owned & module_names
+                    ):
+                        yield self.finding(
+                            rel,
+                            line,
+                            f"loop-owned '{attr}' touched from "
+                            f"thread entry point {fn.name}() — "
+                            f"thread code must not reach across the "
+                            f"loop boundary",
+                        )
+
+    @staticmethod
+    def _sync_scope_walk(fn: ast.AST) -> Iterator[ast.AST]:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNCTION_KINDS):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _module_level_assigns(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names

@@ -76,6 +76,47 @@ DISPATCH_SYNC_FREE = (
     "_flush_detok",
 )
 
+# guarded-by contract (analysis/rules/guarded_by.py): lock-guarded
+# shared state, plus the scheduler thread's single-owner state. An
+# owner list means "only these methods — all of which run on the
+# scheduler thread — may touch the attribute"; a lock there would be
+# pure overhead on the dispatch path. Cross-thread observational reads
+# (health gauges) carry explicit `# analysis: ignore[guarded-by]`.
+_SCHEDULER_METHODS = (
+    "step", "_loop", "_admit", "_advance_chunk", "_advance_one_shot",
+    "_build_proposals", "_decode_once", "_draft_propose",
+    "_fail_all_requests", "_finalize_start", "_finalize_start_sync",
+    "_finish", "_flight_record", "_process_fetch", "_drain_pending",
+    "_drain_ready", "_start_request", "_deliver", "_flush_detok",
+    "_store_finished_sequence", "_upload_prefix",
+    "_resolve_staged_prefix", "_plan_chunk_job", "_new_slot_info",
+    "_emit_text", "_push", "_note_spec_dispatch", "_spec_safe",
+    "_entry_ready", "_submit_kv_copy",
+)
+
+GUARDED_BY = {
+    "_overlap_s": "_overlap_mu",
+    "_profile": "_profile_mu",
+    "_KVStager._inflight": "_mu",
+    "_slots": _SCHEDULER_METHODS,
+    "_free": _SCHEDULER_METHODS,
+    "_pending": _SCHEDULER_METHODS,
+    "_chunk_jobs": _SCHEDULER_METHODS,
+    "_detok_batch": _SCHEDULER_METHODS,
+    "_overlap_seen": _SCHEDULER_METHODS,
+    "_state": _SCHEDULER_METHODS,
+    "_key": _SCHEDULER_METHODS,
+}
+
+# thread-boundary contract (analysis/rules/thread_boundary.py): the
+# scheduler's working state must never be reached from `async def`
+# bodies — the HTTP layer talks to the engine through submit()/health()
+# and the thread-safe queues only.
+THREAD_OWNED = (
+    "_slots", "_free", "_pending", "_chunk_jobs", "_detok_batch",
+    "_state",
+)
+
 
 class LatencyHistogram:
     """Fixed-bucket Prometheus-style histogram (counts are cumulative
@@ -733,7 +774,9 @@ class LLMEngine:
             "error": self._fatal,
             "model": self.cfg.name,
             "slots_total": self.max_slots,
-            "slots_used": self.max_slots - len(self._free),
+            # racy-tolerated gauge: HTTP thread reads the scheduler's
+            # slot list length; worst case one admit stale
+            "slots_used": self.max_slots - len(self._free),  # analysis: ignore[guarded-by]
             "waiting": self._waiting.qsize(),
             "steps": self._step_count,
             "tokens_generated": self._tokens_generated,
@@ -961,7 +1004,9 @@ class LLMEngine:
                 kv.prefix_tokens_reused if kv is not None else 0
             ),
         )
-        if self._profile is not None:
+        # unlocked fast-path probe: None is the steady state, and a
+        # stale non-None just pays one _profile_step() lock round-trip
+        if self._profile is not None:  # analysis: ignore[guarded-by]
             self._profile_step()
 
     # ---- on-demand profiler capture -----------------------------------

@@ -89,6 +89,32 @@ class _Block:
         self.sharing = 0     # fleet sharing (directory-reported)
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`,
+# rule guarded-by): the trie and its accounting are shared between the
+# engine scheduler (match path) and the kv-copy executor (store/import/
+# evict) — always under `_lock`; quantize/assemble/file I/O stay
+# outside it (blocks are immutable once attached).
+GUARDED_BY = {
+    "_root": "_lock",
+    "_blocks": "_lock",
+    "_bytes": "_lock",
+    "_tick": "_lock",
+    "hits": "_lock",
+    "misses": "_lock",
+    "faultbacks": "_lock",
+    "blocks_inserted": "_lock",
+    "blocks_evicted": "_lock",
+}
+
+# sync-in-dispatch: the scheduler calls the match path every admit —
+# trie probes and in-memory spill-index lookups only, no file I/O and
+# no device syncs (fault-back and assembly run on the kv-copy
+# executor via gather_prefix).
+DISPATCH_SYNC_FREE = (
+    "match_prefix_len", "peek_prefix_len", "_walk", "_disk_extension",
+)
+
+
 class HostKVCache:
     """Byte-bounded block-granular radix prefix cache in host RAM.
 
@@ -296,7 +322,7 @@ class HostKVCache:
             if tuple(frame.tokens) != block:
                 # file content does not match its key (rename, foreign
                 # file): corruption — quarantine and read as a miss
-                spill.corrupt += 1
+                spill.note_corrupt()
                 spill.remove(key.hex())
                 complete = False
                 break
@@ -578,7 +604,7 @@ class HostKVCache:
             node = child
         return inserted, self._evict_locked()
 
-    def _eviction_score(self, blk: _Block) -> float:
+    def _eviction_score_locked(self, blk: _Block) -> float:
         """Eviction economics (docs/KV_CACHE.md "Fleet KV fabric"):
         bytes × age / (1 + sharing) instead of plain LRU — a large
         stale block evicts before a small one, but a block many
@@ -603,7 +629,7 @@ class HostKVCache:
             for blk in self._blocks.values():
                 if blk.refs:
                     continue
-                s = self._eviction_score(blk)
+                s = self._eviction_score_locked(blk)
                 if s > score:
                     victim, score = blk, s
             if victim is None:       # all blocks interior (can't happen
@@ -665,8 +691,10 @@ class HostKVCache:
 
     @property
     def entries(self) -> int:
-        return len(self._blocks)
+        with self._lock:
+            return len(self._blocks)
 
     @property
     def bytes_used(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes

@@ -38,6 +38,28 @@ logger = logging.getLogger(__name__)
 SPILL_SUFFIX = ".kvb"
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`,
+# rule guarded-by): the index and every counter are shared between the
+# kv-copy executor (store/load) and scheduler/HTTP readers (snapshot,
+# residency probes) — always under `_mu`, never held across file I/O.
+GUARDED_BY = {
+    "_index": "_mu",
+    "_bytes": "_mu",
+    "_tick": "_mu",
+    "blocks_spilled": "_mu",
+    "blocks_loaded": "_mu",
+    "bytes_spilled": "_mu",
+    "bytes_loaded": "_mu",
+    "corrupt": "_mu",
+    "evictions": "_mu",
+}
+
+# sync-in-dispatch: the scheduler may probe residency/size every step —
+# these never touch the filesystem. store()/load() (open/os.replace/
+# mmap) stay OFF this list: they run on the kv-copy executor only.
+DISPATCH_SYNC_FREE = ("has", "size")
+
+
 class DiskKVSpill:
     """Byte-bounded one-file-per-block spill store.
 
@@ -193,18 +215,24 @@ class DiskKVSpill:
             names = os.listdir(self.directory)
         except OSError:
             return
-        for name in sorted(names):
-            if not name.endswith(SPILL_SUFFIX):
-                continue
-            key_hex = name[: -len(SPILL_SUFFIX)]
-            try:
-                size = os.path.getsize(os.path.join(self.directory, name))
-            except OSError:
-                continue
-            self._tick += 1
-            self._index[key_hex] = (size, self._tick)
-            self._bytes += size
-        doomed = self._collect_over_budget_locked()
+        # construction-time only, but taken under `_mu` anyway: the
+        # index must never be observable half-built, and the uniform
+        # discipline is what the guarded-by contract checks
+        with self._mu:
+            for name in sorted(names):
+                if not name.endswith(SPILL_SUFFIX):
+                    continue
+                key_hex = name[: -len(SPILL_SUFFIX)]
+                try:
+                    size = os.path.getsize(
+                        os.path.join(self.directory, name)
+                    )
+                except OSError:
+                    continue
+                self._tick += 1
+                self._index[key_hex] = (size, self._tick)
+                self._bytes += size
+            doomed = self._collect_over_budget_locked()
         for victim in doomed:
             self._unlink(victim)
 
@@ -216,7 +244,9 @@ class DiskKVSpill:
         if self.max_bytes <= 0:
             return doomed
         while self._bytes > self.max_bytes and self._index:
-            key = min(self._index, key=lambda k: self._index[k][1])
+            # key the min on the materialized items — a closure over
+            # self._index would escape the locked scope statically
+            key = min(self._index.items(), key=lambda kv: kv[1][1])[0]
             size, _ = self._index.pop(key)
             self._bytes -= size
             self.evictions += 1
@@ -228,8 +258,15 @@ class DiskKVSpill:
         if entry is not None:
             self._bytes -= entry[0]
 
+    def note_corrupt(self) -> None:
+        """Count a corruption detected by a caller (the host cache's
+        fault-back decode path finds defects this tier's own verify
+        can't see)."""
+        with self._mu:
+            self.corrupt += 1
+
     def _quarantine(self, key_hex: str) -> None:
-        self.corrupt += 1
+        self.note_corrupt()
         self._unlink(key_hex)
 
     def _unlink(self, key_hex: str) -> None:

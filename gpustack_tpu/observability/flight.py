@@ -152,6 +152,31 @@ def aggregate_records(
     return out
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`,
+# rule guarded-by): one writer (the engine scheduler's record/note_*
+# calls), many readers (HTTP exporters, bench) — every touch of the
+# ring, histogram, counters, and self-measurement under `_mu`.
+GUARDED_BY = {
+    "_ring": "_mu",
+    "_hist": "_mu",
+    "tokens_real_total": "_mu",
+    "tokens_padded_total": "_mu",
+    "tokens_out_total": "_mu",
+    "prompt_tokens_total": "_mu",
+    "spec_proposed_total": "_mu",
+    "spec_accepted_total": "_mu",
+    "_last_slots_used": "_mu",
+    "_last_waiting": "_mu",
+    "_last_oldest_wait_s": "_mu",
+    "_last_kv_blocks": "_mu",
+    "host_overlap_s_total": "_mu",
+    "idle_wait_s_total": "_mu",
+    "rollback_tokens_total": "_mu",
+    "_record_s": "_mu",
+    "_step_s": "_mu",
+}
+
+
 class FlightRecorder:
     """Bounded ring of per-step records + cumulative counters.
 
@@ -288,16 +313,18 @@ class FlightRecorder:
     def overhead_ratio(self) -> float:
         """Seconds spent recording / seconds of recorded step wall time
         (0.0 until the first step)."""
-        if self._step_s <= 0.0:
-            return 0.0
-        return self._record_s / self._step_s
+        with self._mu:
+            if self._step_s <= 0.0:
+                return 0.0
+            return self._record_s / self._step_s
 
     def host_overlap_ratio(self) -> float:
         """Cumulative overlapped host seconds / cumulative step wall
         time (can exceed 1.0 with several overlapping workers)."""
-        if self._step_s <= 0.0:
-            return 0.0
-        return self.host_overlap_s_total / self._step_s
+        with self._mu:
+            if self._step_s <= 0.0:
+                return 0.0
+            return self.host_overlap_s_total / self._step_s
 
     def snapshot(self, limit: int = 200) -> List[Dict[str, Any]]:
         """Newest-last copy of the most recent ``limit`` records."""
@@ -351,6 +378,8 @@ class FlightRecorder:
                 mode: (list(h[0]), h[1], h[2])
                 for mode, h in self._hist.items()
             }
+            idle_wait_s = self.idle_wait_s_total
+            rollback_tokens = self.rollback_tokens_total
         lines = [decl("gpustack_engine_step_seconds")]
         for mode in sorted(hist):
             counts, total, count = hist[mode]
@@ -404,9 +433,9 @@ class FlightRecorder:
             f"{self.host_overlap_ratio():.6f}",
             decl("gpustack_engine_idle_wait_seconds_total"),
             f"gpustack_engine_idle_wait_seconds_total "
-            f"{self.idle_wait_s_total:.6f}",
+            f"{idle_wait_s:.6f}",
             decl("gpustack_engine_rollback_tokens_total"),
             f"gpustack_engine_rollback_tokens_total "
-            f"{self.rollback_tokens_total}",
+            f"{rollback_tokens}",
         ]
         return lines

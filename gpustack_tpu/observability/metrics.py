@@ -176,6 +176,18 @@ def format_labels(labels: Sequence[Tuple[str, str]]) -> str:
     return "{" + inner + "}"
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`,
+# rule guarded-by): series maps and registry tables are written from
+# bench/executor threads and scraped from HTTP handlers — always under
+# the owning object's `_mu` (the registry map under its module lock).
+GUARDED_BY = {
+    "_series": "_mu",
+    "_hists": "_mu",
+    "_counters": "_mu",
+    "_REGISTRIES": "_REGISTRIES_MU",
+}
+
+
 class Histogram:
     """One histogram family with optional labels.
 

@@ -146,6 +146,16 @@ def from_headers(headers) -> TraceContext:
     return TraceContext(make_trace_id())
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`,
+# rule guarded-by): the trace ring and the store registry are touched
+# from proxy threads, the asyncio loop, and debug handlers — always
+# under their lock.
+GUARDED_BY = {
+    "_ring": "_mu",
+    "_STORES": "_STORES_MU",
+}
+
+
 class TraceStore:
     """Bounded ring of finished hop traces, newest last. Reads and
     writes are tiny and lock-guarded (never held across an await —

@@ -63,6 +63,25 @@ from gpustack_tpu.server.collectors import PeriodicTask
 from gpustack_tpu.utils.profiling import timed
 
 
+# concurrency contract (checked by `python -m gpustack_tpu.analysis`):
+# the combiner is event-loop-only — no locks, no threads. The queues
+# are single-thread-owned by the declared method set (guarded-by rule,
+# owner-list form), and LOOP_OWNED marks the seam for the
+# thread-boundary rule: a worker thread must never reach into these.
+_QUEUE_OWNERS = (
+    "offer_heartbeat", "offer_status", "queue_depth", "_requeue",
+    "flush",
+)
+
+GUARDED_BY = {
+    "_hb": _QUEUE_OWNERS,
+    "_status": _QUEUE_OWNERS,
+    "_freshness": ("_note_fresh", "freshness_for", "flush", "snapshot"),
+}
+
+LOOP_OWNED = ("_hb", "_status", "_freshness")
+
+
 class ControlWriteCombiner(PeriodicTask):
     task_name = "control-write-combiner"
 

@@ -1935,10 +1935,16 @@ async def run_seeded(
     servers: int = 1,
     ha_ttl: float = 1.0,
     converge_timeout: float = 30.0,
+    lockdep=None,
     **harness_kw,
 ) -> dict:
     """Boot a cluster, deploy, run the seeded schedule, wait for
-    convergence; returns a report dict (raises on non-convergence)."""
+    convergence; returns a report dict (raises on non-convergence).
+
+    ``lockdep`` (a ``testing.lockdep.LockDep``) is installed for the
+    whole run — every lock the cluster constructs is order- and
+    hold-time-tracked — and its verdict (merged with the static
+    acquisition graph) lands in the report under ``"lockdep"``."""
     gap = (0.2, 0.8)
     if any(
         k in HA_FAULT_KINDS or k in SCALE_FAULT_KINDS for k in kinds
@@ -1958,6 +1964,10 @@ async def run_seeded(
     schedule = generate_schedule(
         seed, kinds=kinds, ops=ops, workers=workers, gap=gap
     )
+    if lockdep is not None:
+        # install BEFORE the harness exists so the servers', workers'
+        # and engines' locks are all constructed tracked
+        lockdep.install()
     harness = ChaosHarness(
         data_dir, workers=workers, replicas=replicas,
         servers=servers, ha_ttl=ha_ttl, **harness_kw
@@ -1979,7 +1989,7 @@ async def run_seeded(
         await harness.run_schedule(schedule)
         await harness.wait_converged(timeout=converge_timeout)
         violations = harness.violations()
-        return {
+        report = {
             "seed": seed,
             "schedule": [dataclasses.asdict(o) for o in schedule],
             "skipped_ops": [
@@ -2016,8 +2026,19 @@ async def run_seeded(
                 if not w["landed"] and w["lease_epoch"] > w["epoch"]
             ),
         }
+        if lockdep is not None:
+            from gpustack_tpu.testing.lockdep import (
+                static_acquisition_edges,
+            )
+
+            report["lockdep"] = lockdep.report(
+                static_acquisition_edges()
+            )
+        return report
     finally:
         await harness.stop()
+        if lockdep is not None:
+            lockdep.uninstall()
 
 
 def main(argv=None) -> int:
@@ -2043,6 +2064,18 @@ def main(argv=None) -> int:
     p.add_argument("--ha-ttl", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=40.0)
     p.add_argument("--verbose", action="store_true")
+    p.add_argument(
+        "--lockdep", action="store_true",
+        help="run under the runtime lockdep monitor "
+             "(testing/lockdep.py): every lock constructed by the "
+             "cluster is order- and hold-time-tracked; a cycle in the "
+             "merged static+observed graph or an over-threshold hold "
+             "fails the class",
+    )
+    p.add_argument(
+        "--lockdep-max-hold", type=float, default=1.0,
+        help="seconds a lock may be held before lockdep flags it",
+    )
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -2065,6 +2098,11 @@ def main(argv=None) -> int:
             2 if cls_name in MULTI_SERVER_CLASSES else 1
         )
         print(f"=== {cls_name} (seed {seed}, servers {servers}) ===")
+        monitor = None
+        if args.lockdep:
+            from gpustack_tpu.testing.lockdep import LockDep
+
+            monitor = LockDep(max_hold_s=args.lockdep_max_hold)
         try:
             report = asyncio.run(run_seeded(
                 tmp, seed,
@@ -2075,14 +2113,22 @@ def main(argv=None) -> int:
                 servers=servers,
                 ha_ttl=args.ha_ttl,
                 converge_timeout=args.timeout,
+                lockdep=monitor,
             ))
         except Exception as e:  # noqa: BLE001 — CLI boundary
             print(f"FAIL {cls_name}: {e}")
             failures += 1
             continue
+        lock_findings = (
+            report.get("lockdep", {}).get("findings", [])
+        )
         if report["violations"]:
             print(f"FAIL {cls_name}: invariant violations")
             print(jsonlib.dumps(report["violations"], indent=2))
+            failures += 1
+        elif lock_findings:
+            print(f"FAIL {cls_name}: lockdep findings")
+            print(jsonlib.dumps(lock_findings, indent=2))
             failures += 1
         else:
             print(
@@ -2090,6 +2136,14 @@ def main(argv=None) -> int:
                 f"{report['observed_transitions']} transitions observed, "
                 f"schedule {report['schedule']}"
             )
+            if monitor is not None:
+                ld = report.get("lockdep", {})
+                print(
+                    f"    lockdep: {ld.get('locks_tracked', 0)} locks, "
+                    f"{ld.get('observed_edges', 0)} observed + "
+                    f"{ld.get('static_edges', 0)} static edges, "
+                    f"0 findings"
+                )
     return 1 if failures else 0
 
 

@@ -1,0 +1,384 @@
+"""Runtime lockdep: observed lock-order + hold-time discipline.
+
+The static lock-order rule (analysis/rules/lock_order.py) sees the
+acquisition edges the AST can prove; this harness sees the ones that
+actually happen — including orders created dynamically (callbacks,
+executors, locks passed across objects) that no static resolution
+reaches. Modeled on the kernel's lockdep: every acquisition while
+other locks are held adds an ordering edge, and a cycle in the merged
+(static ∪ observed) graph is a deadlock some interleaving can reach,
+reported even though this particular run never hung.
+
+Usage (the chaos harness and tier-1 e2e smokes wire this up)::
+
+    dep = LockDep(max_hold_s=1.0)
+    dep.install()          # patches threading.Lock/RLock/Condition
+    try:
+        ...                # run the system under test
+    finally:
+        dep.uninstall()
+    report = dep.report(static_edges=...)   # fails the run on findings
+
+Tracked facts, per thread (a ``threading.local`` held-stack):
+
+- **acquisition-order edges**: acquiring B while holding A records
+  A → B. RLock re-entry on an already-held label records nothing (a
+  self-edge is not an ordering).
+- **hold times**: wall seconds between acquire and release; a hold
+  beyond ``max_hold_s`` is a finding — the repo's locks guard tiny
+  critical sections, so a long hold means file/device/network I/O
+  crept under one. ``Condition.wait`` releases the lock, so parked
+  time never counts as held.
+
+Labels come from the construction site: ``self._mu = threading.Lock()``
+in ``engine/kv_spill.py`` labels the lock
+``gpustack_tpu/engine/kv_spill.py::_mu`` — the same namespace the
+static graph uses once class qualifiers are normalized away
+(:func:`normalize_label`), so the two graphs merge by plain set union.
+
+Disabled (not installed) the module costs nothing: ``threading.Lock``
+stays the original builtin and no shim exists on any acquire path.
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+import re
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from gpustack_tpu.analysis.rules.lock_order import find_cycles
+
+# ``self._wake = threading.Condition()`` / ``mu = threading.Lock()``
+_ATTR_SITE_RE = re.compile(r"self\.(\w+)\s*(?::[^=]*?)?=")
+_NAME_SITE_RE = re.compile(r"(\w+)\s*(?::[^=]*?)?=\s*\w+\.\w+\(")
+
+_REPO_MARKER = "gpustack_tpu"
+
+
+def normalize_label(label: str) -> str:
+    """Strip the class qualifier from a static lock label so the two
+    graphs share one namespace: ``path::Class.attr`` → ``path::attr``
+    (runtime labels never see the class, only the assignment site)."""
+    if "::" in label:
+        path, _, name = label.partition("::")
+        return f"{path}::{name.rsplit('.', 1)[-1]}"
+    return label
+
+
+def _site_rel(filename: str) -> str:
+    """Repo-relative path for a construction site (best effort)."""
+    norm = filename.replace(os.sep, "/")
+    marker = f"/{_REPO_MARKER}/"
+    idx = norm.rfind(marker)
+    if idx >= 0:
+        return norm[idx + 1:]
+    return norm.rsplit("/", 1)[-1]
+
+
+class LockDep:
+    """Injectable lock monitor. ``install()`` patches the ``threading``
+    factories; every lock constructed afterwards is tracked. Locks that
+    predate ``install()`` stay raw (wrap them explicitly with
+    :meth:`wrap` when a test needs them observed)."""
+
+    def __init__(
+        self,
+        max_hold_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_hold_s = float(max_hold_s)
+        self._clock = clock
+        # saved originals — every internal lock below MUST come from
+        # these, never from (possibly patched) threading.*
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        self._orig_condition = threading.Condition
+        self._mu = self._orig_lock()
+        self._installed = False
+        # (src label, dst label) -> observation count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        # (label, held seconds) beyond max_hold_s
+        self.long_holds: List[Tuple[str, float]] = []
+        self.locks_tracked = 0
+        self._held = threading.local()
+
+    # ---- install / uninstall -------------------------------------------
+
+    def install(self) -> "LockDep":
+        if self._installed:
+            return self
+        self._installed = True
+        dep = self
+
+        def make_lock():
+            return _TrackedLock(dep, dep._label_site(), dep._orig_lock())
+
+        def make_rlock():
+            return _TrackedLock(
+                dep, dep._label_site(), dep._orig_rlock(), reentrant=True
+            )
+
+        def make_condition(lock=None):
+            return _TrackedCondition(dep, dep._label_site(), lock)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        threading.Condition = self._orig_condition
+        self._installed = False
+
+    def __enter__(self) -> "LockDep":
+        return self.install()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.uninstall()
+
+    def wrap(self, lock: Any, name: str) -> "_TrackedLock":
+        """Explicitly track an existing lock under ``name`` (unit
+        tests; locks constructed before install())."""
+        return _TrackedLock(self, name, lock)
+
+    # ---- labeling -------------------------------------------------------
+
+    def _label_site(self) -> str:
+        """Label a lock by its construction site: the first caller
+        frame outside this module, ``{rel}::{attr}`` when the source
+        line is an attribute/name assignment, ``{rel}:{line}``
+        otherwise."""
+        f = sys._getframe(1)
+        while f is not None and f.f_globals.get("__name__") == __name__:
+            f = f.f_back
+        if f is None:
+            return "<unknown>"
+        rel = _site_rel(f.f_code.co_filename)
+        line = linecache.getline(f.f_code.co_filename, f.f_lineno)
+        m = _ATTR_SITE_RE.search(line) or _NAME_SITE_RE.search(line)
+        if m:
+            return f"{rel}::{m.group(1)}"
+        return f"{rel}:{f.f_lineno}"
+
+    # ---- per-thread bookkeeping ----------------------------------------
+
+    def _stack(self) -> List[Tuple[str, float]]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def held_labels(self) -> List[str]:
+        """This thread's currently-held lock labels, oldest first."""
+        return [label for label, _ in self._stack()]
+
+    def note_acquired(self, label: str) -> None:
+        stack = self._stack()
+        if any(h == label for h, _ in stack):
+            # RLock re-entry: not a new ordering, not a new hold
+            return
+        now = self._clock()
+        if stack:
+            with self._mu:
+                for h, _ in stack:
+                    key = (h, label)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+        stack.append((label, now))
+
+    def note_released(self, label: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == label:
+                _, t0 = stack.pop(i)
+                held_s = self._clock() - t0
+                if held_s > self.max_hold_s:
+                    with self._mu:
+                        self.long_holds.append((label, held_s))
+                return
+
+    # ---- verdict --------------------------------------------------------
+
+    def report(
+        self,
+        static_edges: Optional[Dict[Tuple[str, str], Any]] = None,
+    ) -> Dict[str, Any]:
+        """Merge observed edges with the static graph (labels
+        normalized) and return the findings dict the chaos report
+        embeds. Empty ``findings`` = discipline held."""
+        with self._mu:
+            observed = dict(self.edges)
+            long_holds = list(self.long_holds)
+        merged = {
+            (normalize_label(a), normalize_label(b))
+            for a, b in observed
+        }
+        static_count = 0
+        if static_edges:
+            for a, b in static_edges:
+                merged.add((normalize_label(a), normalize_label(b)))
+                static_count += 1
+        cycles = find_cycles(merged)
+        findings: List[Dict[str, Any]] = []
+        for cycle in cycles:
+            findings.append({
+                "kind": "lock-cycle",
+                "cycle": cycle + [cycle[0]],
+            })
+        for label, held_s in long_holds:
+            findings.append({
+                "kind": "long-hold",
+                "lock": label,
+                "held_s": round(held_s, 4),
+                "max_hold_s": self.max_hold_s,
+            })
+        return {
+            "locks_tracked": self.locks_tracked,
+            "observed_edges": len(observed),
+            "static_edges": static_count,
+            "cycles": cycles,
+            "long_holds": [
+                {"lock": lbl, "held_s": round(s, 4)}
+                for lbl, s in long_holds
+            ],
+            "findings": findings,
+        }
+
+
+def static_acquisition_edges(
+    root: Optional[str] = None,
+) -> Dict[Tuple[str, str], Any]:
+    """The analyzer's static lock graph for ``root`` (default: the
+    repo this package was imported from) — the other half of
+    :meth:`LockDep.report`'s merge."""
+    from gpustack_tpu.analysis.core import Project
+    from gpustack_tpu.analysis.rules.lock_order import acquisition_edges
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+    return acquisition_edges(Project(root))
+
+
+class _TrackedLock:
+    """Proxy around a real lock. Only acquire/release (and the context
+    protocol) are intercepted; everything else delegates."""
+
+    def __init__(
+        self,
+        dep: LockDep,
+        label: str,
+        inner: Any,
+        reentrant: bool = False,
+    ):
+        self._dep = dep
+        self._label = label
+        self._inner = inner
+        self._reentrant = reentrant
+        dep.locks_tracked += 1
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._dep.note_acquired(self._label)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._dep.note_released(self._label)
+
+    def __enter__(self) -> "_TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<tracked {self._label} {self._inner!r}>"
+
+
+class _TrackedCondition:
+    """Condition variable whose lock side is a :class:`_TrackedLock`.
+    ``wait`` unwinds the held bookkeeping while parked — parked time is
+    not held time — and restores it on wakeup."""
+
+    def __init__(self, dep: LockDep, label: str, lock: Any = None):
+        if isinstance(lock, _TrackedLock):
+            self._lock = lock
+        elif lock is not None:
+            self._lock = _TrackedLock(dep, label, lock)
+        else:
+            # plain Condition() default: an RLock, from the ORIGINAL
+            # factory (the patched one would double-track)
+            self._lock = _TrackedLock(
+                dep, label, dep._orig_rlock(), reentrant=True
+            )
+        # the real condition binds the RAW lock: its wait() must
+        # release the actual mutex, not the proxy
+        self._cond = dep._orig_condition(self._lock._inner)
+        self._dep = dep
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        return self._lock.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "_TrackedCondition":
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._dep.note_released(self._lock._label)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._dep.note_acquired(self._lock._label)
+
+    def wait_for(
+        self,
+        predicate: Callable[[], Any],
+        timeout: Optional[float] = None,
+    ) -> Any:
+        # reimplemented over OUR wait() so parked time stays untracked
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<tracked-cond {self._lock._label}>"

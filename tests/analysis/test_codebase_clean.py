@@ -34,7 +34,7 @@ def tree_result():
 # review), never frozen
 NO_BASELINE_RULES = (
     "blocking-in-async", "state-machine", "sync-in-dispatch",
-    "route-auth",
+    "route-auth", "guarded-by", "lock-order",
 )
 
 
@@ -65,6 +65,26 @@ def test_all_rules_ran(tree_result):
     assert result.files_scanned > 100  # the real tree, not a stub
 
 
+def test_parse_cache_shared_across_rules(tree_result):
+    """Ten rules over one tree must pay ~one parse per file — every
+    rule after the first reads the shared cache. A refactor that gives
+    each rule its own Project would silently 10x the gate's cost; this
+    pins the sharing."""
+    result = tree_result
+    assert result.cache_hits > result.files_scanned, (
+        f"parse cache barely hit ({result.cache_hits} hits over "
+        f"{result.files_scanned} files) — rules are re-parsing"
+    )
+
+
+def test_concurrency_rules_can_never_be_baselined():
+    """guarded-by and lock-order ship with an empty baseline FOREVER:
+    a deadlock cycle or an unguarded shared write is fixed or
+    explicitly ignore-commented at the site, never frozen."""
+    assert "guarded-by" in NO_BASELINE_RULES
+    assert "lock-order" in NO_BASELINE_RULES
+
+
 def test_baseline_empty_for_loop_safety_and_state_rules():
     with open(core.DEFAULT_BASELINE) as f:
         baseline = json.load(f)
@@ -85,3 +105,22 @@ def test_cli_rejects_unknown_rule():
     from gpustack_tpu.analysis.__main__ import main
 
     assert main(["--rule", "no-such-rule"]) == 2
+
+
+def test_cli_json_report(capsys):
+    """--json: the machine-readable report CI consumers parse — keys,
+    exit code, and the cache-hit counter all surface."""
+    from gpustack_tpu.analysis.__main__ import main
+
+    rc = main(["--root", REPO_ROOT, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["new"] == []
+    assert report["changed_only"] is False
+    assert report["files_scanned"] > 100
+    assert report["cache_hits"] > report["files_scanned"]
+    assert sorted(report["rules_run"]) == sorted(
+        cls().id for cls in rules.ALL_RULES
+    )
+    assert report["elapsed_s"] < 10.0

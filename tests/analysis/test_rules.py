@@ -20,10 +20,13 @@ import pytest
 from gpustack_tpu.analysis import core
 from gpustack_tpu.analysis.rules.blocking import BlockingInAsyncRule
 from gpustack_tpu.analysis.rules.config_drift import ConfigDocDriftRule
+from gpustack_tpu.analysis.rules.guarded_by import GuardedByRule
+from gpustack_tpu.analysis.rules.lock_order import LockOrderRule
 from gpustack_tpu.analysis.rules.locks import HeldAcrossAwaitRule
 from gpustack_tpu.analysis.rules.metrics_drift import MetricsDriftRule
 from gpustack_tpu.analysis.rules.state_machine import StateMachineRule
 from gpustack_tpu.analysis.rules.sync_dispatch import SyncInDispatchRule
+from gpustack_tpu.analysis.rules.thread_boundary import ThreadBoundaryRule
 
 
 def make_tree(root, files):
@@ -1094,3 +1097,631 @@ class TestRouteAuth:
         found = run(tmp_path, [RouteAuthRule()]).new
         assert len(found) == 1
         assert "PUBLIC_PATHS" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedBy:
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [GuardedByRule()]).new
+
+    def test_fires_on_unlocked_access(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def peek(self):
+                    return len(self._index)
+        """)
+        assert len(found) == 1, found
+        assert found[0].rule == "guarded-by"
+        assert "'_index' is guarded by '_mu'" in found[0].message
+        assert "peek()" in found[0].message
+
+    def test_quiet_under_with_lock(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def peek(self):
+                    with self._mu:
+                        return len(self._index)
+        """)
+        assert found == []
+
+    def test_closure_does_not_inherit_guard(self, tmp_path):
+        # the lambda runs later, on whatever thread calls it — the
+        # lexically-enclosing `with` proves nothing about that thread
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def sorter(self):
+                    with self._mu:
+                        return sorted([], key=lambda k: self._index[k])
+        """)
+        assert len(found) == 1, found
+        assert "<lambda>" in found[0].message
+
+    def test_locked_suffix_method_is_exempt(self, tmp_path):
+        # the repo's caller-holds-the-lock convention
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def _evict_locked(self):
+                    self._index.clear()
+        """)
+        assert found == []
+
+    def test_init_is_exempt(self, tmp_path):
+        # construction happens-before publication
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def __init__(self):
+                    self._index = {}
+        """)
+        assert found == []
+
+    def test_owner_list_fires_from_foreign_method(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_slots": ("_loop", "step")}
+
+            class Engine:
+                def health(self):
+                    return len(self._slots)
+        """)
+        assert len(found) == 1, found
+        assert "'_slots' is owned by" in found[0].message
+        assert "health()" in found[0].message
+
+    def test_owner_list_quiet_in_owner(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_slots": ("_loop", "step")}
+
+            class Engine:
+                def step(self):
+                    self._slots.append(1)
+        """)
+        assert found == []
+
+    def test_owner_group_by_module_level_name(self, tmp_path):
+        # the value may NAME a module-level tuple so several attrs
+        # share one owner list without repeating it
+        found = self.fire(tmp_path, """\
+            _OWNERS = ("offer", "flush")
+            GUARDED_BY = {"_hb": _OWNERS}
+
+            class Combiner:
+                def offer(self):
+                    self._hb.append(1)
+
+                def snapshot(self):
+                    return list(self._hb)
+        """)
+        assert len(found) == 1, found
+        assert "snapshot()" in found[0].message
+
+    def test_class_qualified_key_wins(self, tmp_path):
+        # two classes reuse an attribute name with different locks:
+        # the qualified entry governs its class, the bare one the rest
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {
+                "_inflight": "_lock",
+                "Stager._inflight": "_mu",
+            }
+
+            class Stager:
+                def poll(self):
+                    with self._mu:
+                        return len(self._inflight)
+
+            class Pool:
+                def poll(self):
+                    with self._lock:
+                        return len(self._inflight)
+        """)
+        assert found == []
+
+    def test_bare_module_global_is_checked(self, tmp_path):
+        # module-global registries (tracing._STORES) are shared state
+        # too — bare-name accesses are checked when the module assigns
+        # the name at top level
+        found = self.fire(tmp_path, """\
+            _STORES = {}
+            GUARDED_BY = {"_STORES": "_STORES_MU"}
+
+            def get_store(name):
+                return _STORES.get(name)
+        """)
+        assert len(found) == 1, found
+        assert "get_store()" in found[0].message
+
+    def test_suppression_silences(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            GUARDED_BY = {"_index": "_mu"}
+
+            class Store:
+                def health(self):
+                    # racy-tolerated gauge, reviewed
+                    return len(self._index)  # analysis: ignore[guarded-by]
+        """)
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrder:
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [LockOrderRule()]).new
+
+    def test_nested_with_abba_fires(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            class S:
+                def ab(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def ba(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert len(found) == 1, found
+        assert found[0].rule == "lock-order"
+        assert "lock acquisition cycle" in found[0].message
+        assert "_a_lock" in found[0].message
+        assert "_b_lock" in found[0].message
+
+    def test_multi_item_with_counts_left_to_right(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            class S:
+                def ab(self):
+                    with self._a_lock, self._b_lock:
+                        pass
+
+                def ba(self):
+                    with self._b_lock, self._a_lock:
+                        pass
+        """)
+        assert len(found) == 1, found
+
+    def test_call_chain_abba_fires(self, tmp_path):
+        # f holds A and calls g -> h which takes B; k takes B then A.
+        # The transitive callee resolution must produce the A->B edge.
+        found = self.fire(tmp_path, """\
+            class S:
+                def f(self):
+                    with self._a_lock:
+                        self.g()
+
+                def g(self):
+                    self.h()
+
+                def h(self):
+                    with self._b_lock:
+                        pass
+
+                def k(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert len(found) == 1, found
+
+    def test_consistent_order_is_quiet(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            class S:
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """)
+        assert found == []
+
+    def test_two_classes_same_attr_are_distinct(self, tmp_path):
+        # labels are class-qualified: X's locks and Y's locks are
+        # different objects, opposite nesting across them is no cycle
+        found = self.fire(tmp_path, """\
+            class X:
+                def f(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+            class Y:
+                def f(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert found == []
+
+    def test_reentry_is_not_a_self_edge(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            class S:
+                def f(self):
+                    with self._mu:
+                        with self._mu:
+                            pass
+        """)
+        assert found == []
+
+    def test_suppression_on_reported_line(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            class S:
+                def ab(self):
+                    with self._a_lock:
+                        # ids sorted before acquisition, reviewed
+                        with self._b_lock:  # analysis: ignore[lock-order]
+                            pass
+
+                def ba(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# thread-boundary
+# ---------------------------------------------------------------------------
+
+
+class TestThreadBoundary:
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [ThreadBoundaryRule()]).new
+
+    def test_thread_owned_in_async_fires(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            THREAD_OWNED = ("_slots",)
+
+            class Engine:
+                async def handle(self):
+                    return len(self._slots)
+        """)
+        assert len(found) == 1, found
+        assert found[0].rule == "thread-boundary"
+        assert "thread-owned '_slots'" in found[0].message
+        assert "handle()" in found[0].message
+
+    def test_loop_owned_in_thread_target_fires(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            import threading
+
+            LOOP_OWNED = ("_hb",)
+
+            class Combiner:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self._hb.clear()
+        """)
+        assert len(found) == 1, found
+        assert "loop-owned '_hb'" in found[0].message
+        assert "_run()" in found[0].message
+
+    def test_sync_method_may_touch_thread_owned(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            THREAD_OWNED = ("_slots",)
+
+            class Engine:
+                def step(self):
+                    self._slots.append(1)
+        """)
+        assert found == []
+
+    def test_nested_def_in_async_is_exempt(self, tmp_path):
+        # the closure is shipped to an executor — it runs on a worker
+        # thread, which is exactly where thread-owned state lives
+        found = self.fire(tmp_path, """\
+            THREAD_OWNED = ("_slots",)
+
+            class Engine:
+                async def kick(self, pool):
+                    def work():
+                        return len(self._slots)
+                    return await pool.run(work)
+        """)
+        assert found == []
+
+    def test_non_target_function_may_touch_loop_owned(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            import threading
+
+            LOOP_OWNED = ("_hb",)
+
+            class Combiner:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    pass
+
+                def offer(self):
+                    self._hb.append(1)
+        """)
+        assert found == []
+
+    def test_suppression_silences(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            THREAD_OWNED = ("_slots",)
+
+            class Engine:
+                async def health(self):
+                    # racy-tolerant gauge read, reviewed
+                    return len(self._slots)  # analysis: ignore[thread-boundary]
+        """)
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# sync-in-dispatch: blocking file I/O vocabulary
+# ---------------------------------------------------------------------------
+
+
+class TestSyncInDispatchFileIO:
+    """A disk seek on the scheduler re-serializes the pipeline exactly
+    like a device sync — the spill tier's store/load must stay on the
+    kv-copy executor."""
+
+    def run_on(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/eng.py": body})
+        return run(tmp_path, [SyncInDispatchRule()]).new
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            'DISPATCH_SYNC_FREE = ("step",)\n'
+            "class E:\n    def step(self):\n"
+            "        with open('/tmp/x', 'rb') as f:\n"
+            "            return f.read()\n",
+            'import os\nDISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(tmp, path):\n    os.replace(tmp, path)\n",
+            'import os\nDISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(path):\n    os.unlink(path)\n",
+            # pathlib spellings, matched as methods like .item() is
+            'DISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(p):\n    return p.read_bytes()\n",
+            'DISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(p, buf):\n    p.write_bytes(buf)\n",
+        ],
+    )
+    def test_fires(self, tmp_path, snippet):
+        found = self.run_on(tmp_path, snippet)
+        assert len(found) == 1, found
+        assert "file I/O" in found[0].message
+
+    @pytest.mark.parametrize(
+        "snippet",
+        [
+            # the same I/O in an UNLISTED helper (the executor-side
+            # store/load path) is the designated escape hatch
+            'import os\nDISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(t, p):\n    return store(t, p)\n"
+            "def store(t, p):\n    os.replace(t, p)\n",
+            # .read_bytes(n) with args is a socket-ish lookalike, not
+            # the argless pathlib spelling
+            'DISPATCH_SYNC_FREE = ("step",)\n'
+            "def step(sock):\n    return sock.read_bytes(4096)\n",
+        ],
+    )
+    def test_quiet(self, tmp_path, snippet):
+        assert self.run_on(tmp_path, snippet) == []
+
+    def test_spill_store_declares_probes_only(self):
+        """The spill tier's declaration lists the dict-probe methods
+        and must never grow store/load (which open files)."""
+        from gpustack_tpu.engine import kv_spill
+
+        assert "store" not in kv_spill.DISPATCH_SYNC_FREE
+        assert "load" not in kv_spill.DISPATCH_SYNC_FREE
+        for name in kv_spill.DISPATCH_SYNC_FREE:
+            assert hasattr(kv_spill.DiskKVSpill, name)
+
+
+# ---------------------------------------------------------------------------
+# held-across-await: one-level helper resolution
+# ---------------------------------------------------------------------------
+
+
+class TestHeldAcrossAwaitHelpers:
+    """`with self._entries_view():` is as held as the lock the helper's
+    body takes — one level of same-module resolution."""
+
+    def fire(self, tmp_path, body):
+        make_tree(tmp_path, {"gpustack_tpu/mod.py": body})
+        return run(tmp_path, [HeldAcrossAwaitRule()]).new
+
+    def test_lock_taking_helper_fires(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            import contextlib
+
+            class Cache:
+                @contextlib.contextmanager
+                def _entries_view(self):
+                    with self._lock:
+                        yield self._entries
+
+                async def dump(self, sink):
+                    with self._entries_view() as view:
+                        await sink.write(view)
+        """)
+        assert len(found) == 1, found
+        assert found[0].rule == "held-across-await"
+        assert "_entries_view()" in found[0].message
+        assert "_lock" in found[0].message
+
+    def test_acquire_style_helper_fires(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            import contextlib
+
+            class Cache:
+                @contextlib.contextmanager
+                def _pinned(self):
+                    self._mutex.acquire()
+                    try:
+                        yield
+                    finally:
+                        self._mutex.release()
+
+                async def dump(self, sink):
+                    with self._pinned():
+                        await sink.flush()
+        """)
+        assert len(found) == 1, found
+        assert "_mutex" in found[0].message
+
+    def test_lockless_helper_stays_quiet(self, tmp_path):
+        found = self.fire(tmp_path, """\
+            import contextlib
+
+            class Cache:
+                @contextlib.contextmanager
+                def _timer(self):
+                    t0 = 0.0
+                    yield
+                    self._elapsed = t0
+
+                async def dump(self, sink):
+                    with self._timer():
+                        await sink.flush()
+        """)
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: --changed-only scoping
+# ---------------------------------------------------------------------------
+
+
+class TestChangedOnly:
+    def _git(self, root, *argv):
+        import subprocess
+
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+            cwd=str(root), capture_output=True, text=True,
+        )
+
+    def _init_repo(self, tmp_path):
+        make_tree(tmp_path, {
+            "gpustack_tpu/clean.py": "def f():\n    return 1\n",
+        })
+        assert self._git(tmp_path, "init", "-q").returncode == 0
+        assert self._git(tmp_path, "add", "-A").returncode == 0
+        assert self._git(
+            tmp_path, "commit", "-q", "-m", "base"
+        ).returncode == 0
+
+    def test_scopes_to_changed_files(self, tmp_path, capsys):
+        from gpustack_tpu.analysis.__main__ import main
+
+        self._init_repo(tmp_path)
+        # a NEW untracked file with a violation: only it is scanned
+        make_tree(tmp_path, {
+            "gpustack_tpu/dirty.py": (
+                "import time\nasync def g():\n    time.sleep(1)\n"
+            ),
+        })
+        rc = main([
+            "--root", str(tmp_path), "--changed-only", "--json",
+            "--rule", "blocking-in-async",
+            "--baseline", os.path.join(str(tmp_path), "nope.json"),
+        ])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["changed_only"] is True
+        assert report["files_scanned"] == 1
+        assert [f["path"] for f in report["new"]] == [
+            "gpustack_tpu/dirty.py"
+        ]
+
+    def test_scoped_run_skips_whole_program_rules(
+        self, tmp_path, capsys
+    ):
+        """docs-vs-codebase drift rules are meaningless on a slice:
+        a doc referencing a metric emitted by an UNCHANGED file must
+        not read as drift just because the emitter is out of scope."""
+        from gpustack_tpu.analysis.__main__ import main
+
+        self._init_repo(tmp_path)
+        make_tree(tmp_path, {
+            "gpustack_tpu/emitter.py": (
+                'def emit(reg):\n'
+                '    reg.counter("gpustack_widget_spins_total")\n'
+            ),
+            "docs/WIDGETS.md": (
+                "Watch `gpustack_widget_spins_total` for spin rate.\n"
+            ),
+        })
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-q", "-m", "emitter")
+        # touch an unrelated file; the doc's emitter is out of scope
+        make_tree(tmp_path, {
+            "gpustack_tpu/other.py": "def h():\n    return 2\n",
+        })
+        rc = main([
+            "--root", str(tmp_path), "--changed-only", "--json",
+            "--rule", "metrics-drift", "--rule", "config-doc-drift",
+            "--baseline", os.path.join(str(tmp_path), "nope.json"),
+        ])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0, report["new"]
+        assert report["new"] == []
+        assert report["rules_run"] == []
+        # the full (unscoped) run still carries them
+        rc = main([
+            "--root", str(tmp_path), "--json",
+            "--rule", "metrics-drift", "--rule", "config-doc-drift",
+            "--baseline", os.path.join(str(tmp_path), "nope.json"),
+        ])
+        report = json.loads(capsys.readouterr().out)
+        assert "metrics-drift" in report["rules_run"]
+
+    def test_clean_tree_scans_nothing(self, tmp_path, capsys):
+        from gpustack_tpu.analysis.__main__ import main
+
+        self._init_repo(tmp_path)
+        rc = main(["--root", str(tmp_path), "--changed-only"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no changed .py files" in out
+
+    def test_non_git_root_falls_back_to_full_scan(
+        self, tmp_path, capsys
+    ):
+        from gpustack_tpu.analysis.__main__ import main
+
+        make_tree(tmp_path, {
+            "gpustack_tpu/mod.py": "def f():\n    return 1\n",
+        })
+        rc = main([
+            "--root", str(tmp_path), "--changed-only", "--json",
+            "--rule", "blocking-in-async",
+            "--baseline", os.path.join(str(tmp_path), "nope.json"),
+        ])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert json.loads(captured.out)["files_scanned"] == 1
+        assert "needs git" in captured.err

@@ -57,6 +57,27 @@ def test_chaos_engine_crash_and_server_restart_converges(tmp_path):
     assert report["observed_transitions"] > 0
 
 
+def test_chaos_worker_kill_under_lockdep(tmp_path):
+    """One fault class under the runtime lockdep monitor
+    (docs/ANALYSIS.md "Runtime lockdep"): every lock the cluster
+    constructs is order- and hold-tracked, the observed edges merge
+    with the analyzer's static lock graph, and the class must converge
+    with zero lock findings. The generous hold budget keeps slow-CI
+    scheduling stalls from reading as discipline violations."""
+    from gpustack_tpu.testing.lockdep import LockDep
+
+    dep = LockDep(max_hold_s=60.0)
+    report = _run(tmp_path, 1, ("worker_kill",), lockdep=dep)
+    assert report["violations"] == []
+    lockdep_report = report["lockdep"]
+    assert lockdep_report["locks_tracked"] > 0
+    assert lockdep_report["findings"] == [], lockdep_report
+    # uninstall happened inside run_seeded: the factory is the builtin
+    import threading
+
+    assert threading.Lock is dep._orig_lock
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "cls_name,seed",

@@ -89,6 +89,33 @@ def test_overlap_parity_with_stop_texts(setup):
         assert s.output_text == o.output_text
 
 
+def test_overlap_run_under_lockdep(setup):
+    """The overlapped engine's whole thread mesh (scheduler loop, detok
+    worker, KV stager, kv-copy executor, HTTP-facing locks) runs under
+    the runtime lockdep monitor: observed acquisition edges merged with
+    the analyzer's static lock graph must stay acyclic, and no lock may
+    be held past the (generous, CI-tolerant) budget."""
+    from gpustack_tpu.testing.lockdep import (
+        LockDep,
+        static_acquisition_edges,
+    )
+
+    cfg, params = setup
+    sched = _schedule(cfg, seed=9, n=3)
+    dep = LockDep(max_hold_s=60.0)
+    dep.install()
+    try:
+        # the engine (and every lock it builds) is constructed while
+        # the patched factories are live
+        _, reqs = _run(cfg, params, sched, depth=2)
+    finally:
+        dep.uninstall()
+    assert all(r.finish_reason for r in reqs)
+    report = dep.report(static_acquisition_edges())
+    assert report["locks_tracked"] > 0
+    assert report["findings"] == [], report
+
+
 def test_overlap_logprobs_takes_sync_path_and_matches(setup):
     """logprobs requests fall back to the synchronous first-token path;
     outputs and logprob alignment still match the serial engine."""
